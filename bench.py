@@ -1,0 +1,77 @@
+"""Benchmark: batched rule-check throughput on one chip.
+
+North-star config from BASELINE.json: ~1M flow rules loaded, 100k+
+buffered entries checked + accounted in one flush. The reference
+publishes no numbers (BASELINE.md), so ``vs_baseline`` is reported
+against the north-star target of 1 ms per 131072-entry flush
+(vs_baseline > 1.0 means faster than target).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sentinel_tpu.metrics.nodes import make_stats
+    from sentinel_tpu.rules.flow_table import FlowIndex, FlowRuleDynState, FlowTableDevice
+    from sentinel_tpu.runtime.flush import flush_step_jit
+    from __graft_entry__ import _example_batch
+
+    n_rules = 1 << 20  # ~1M rules / resources
+    n_rows = 1 << 20
+    n_entries = 1 << 17  # 131072 buffered entries per flush
+    k = 1
+
+    stats = make_stats(n_rows)
+    # Build the device rule table directly (bypasses the Python bean
+    # layer, which is not the hot path being measured).
+    dev = FlowTableDevice(
+        grade=jnp.ones(n_rules, dtype=jnp.int32),
+        count=jnp.full(n_rules, 20.0, dtype=jnp.float32),
+        behavior=jnp.zeros(n_rules, dtype=jnp.int32),
+        max_queueing_time_ms=jnp.zeros(n_rules, dtype=jnp.int32),
+        warmup_warning_token=jnp.zeros(n_rules, dtype=jnp.int32),
+        warmup_max_token=jnp.zeros(n_rules, dtype=jnp.int32),
+        warmup_slope=jnp.zeros(n_rules, dtype=jnp.float32),
+        warmup_count=jnp.zeros(n_rules, dtype=jnp.float32),
+    )
+    dyn = FlowRuleDynState(
+        latest_passed_time=jnp.full(n_rules, -(10**9), dtype=jnp.int32),
+        stored_tokens=jnp.zeros(n_rules, dtype=jnp.float32),
+        last_filled_time=jnp.full(n_rules, -(10**9), dtype=jnp.int32),
+    )
+    batch = _example_batch(n_entries, n_rows, n_rules, k)
+
+    # Warm-up / compile.
+    stats, dyn, result = flush_step_jit(stats, dev, dyn, batch)
+    jax.block_until_ready(result.admitted)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        stats, dyn, result = flush_step_jit(stats, dev, dyn, batch)
+    jax.block_until_ready(result.admitted)
+    dt = (time.perf_counter() - t0) / iters
+
+    checks_per_sec = n_entries / dt
+    target_ms = 1.0
+    out = {
+        "metric": "batched_entry_checks_per_sec_per_chip_1M_rules",
+        "value": round(checks_per_sec, 1),
+        "unit": "entries/sec",
+        "vs_baseline": round((target_ms / 1000.0) / dt, 4),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""sentinel_tpu — a TPU-native flow-control / reliability framework.
+
+A from-scratch redesign of the capabilities of Alibaba Sentinel 1.8.4
+(reference: /root/reference, all-Java) for JAX/XLA/Pallas on TPU.
+
+The reference is request-driven: every thread races lock-free counters
+(LeapArray CAS loops, LongAdder buckets) at `SphU.entry()` time
+(reference: sentinel-core/.../CtSph.java:117, .../LeapArray.java:41).
+A TPU cannot serve per-request syscalls, so this framework inverts the
+design to be *batch-driven*: entries are buffered as
+``(row, rule, ts, count, origin, param_hash)`` tuples and flushed through
+a single jitted kernel over HBM-resident counter tensors — scatter-add,
+windowed reduction and threshold compare for every rule at once, with
+cluster-global limits computed by ``psum`` over ICI instead of the
+reference's Netty token-server RPC.
+
+Public API (mirrors SphU / SphO / Tracer / ContextUtil, reference:
+sentinel-core/.../SphU.java:84, Tracer.java:45, context/ContextUtil.java:120):
+
+    import sentinel_tpu as st
+
+    st.flow_rule_manager.load_rules([st.FlowRule("res", count=20)])
+    with st.entry("res") as e:       # raises BlockError when blocked
+        ...                           # protected logic
+    if st.try_entry("res"):           # SphO-style boolean variant
+        ...
+"""
+
+from sentinel_tpu.version import __version__
+
+from sentinel_tpu.core.errors import (
+    BlockError,
+    FlowBlockError,
+    DegradeBlockError,
+    SystemBlockError,
+    AuthorityBlockError,
+    ParamFlowBlockError,
+)
+from sentinel_tpu.core.context import Context, ContextUtil, context_enter, context_exit
+from sentinel_tpu.core.api import (
+    entry,
+    try_entry,
+    entry_async,
+    trace,
+    trace_context,
+    get_engine,
+    reset as reset_all,
+)
+from sentinel_tpu.models.rules import (
+    FlowRule,
+    DegradeRule,
+    SystemRule,
+    AuthorityRule,
+    ParamFlowRule,
+)
+from sentinel_tpu.models import constants
+from sentinel_tpu.rules.flow_manager import flow_rule_manager
+from sentinel_tpu.rules.degrade_manager import degrade_rule_manager
+from sentinel_tpu.rules.system_manager import system_rule_manager
+from sentinel_tpu.rules.authority_manager import authority_rule_manager
+from sentinel_tpu.rules.param_manager import param_flow_rule_manager
+
+__all__ = [
+    "__version__",
+    "BlockError",
+    "FlowBlockError",
+    "DegradeBlockError",
+    "SystemBlockError",
+    "AuthorityBlockError",
+    "ParamFlowBlockError",
+    "Context",
+    "ContextUtil",
+    "context_enter",
+    "context_exit",
+    "entry",
+    "try_entry",
+    "entry_async",
+    "trace",
+    "trace_context",
+    "get_engine",
+    "reset_all",
+    "FlowRule",
+    "DegradeRule",
+    "SystemRule",
+    "AuthorityRule",
+    "ParamFlowRule",
+    "constants",
+    "flow_rule_manager",
+    "degrade_rule_manager",
+    "system_rule_manager",
+    "authority_rule_manager",
+    "param_flow_rule_manager",
+]

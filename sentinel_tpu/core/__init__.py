@@ -1,0 +1,6 @@
+"""Public API layer: entry/exit, context, errors, tracing.
+
+Equivalent of the reference's root API package (reference:
+sentinel-core/.../SphU.java, SphO.java, Tracer.java, CtSph.java,
+context/ContextUtil.java) re-shaped for a batch-driven engine.
+"""

@@ -1,0 +1,261 @@
+"""Public entry/exit API — the SphU / SphO / Tracer facade.
+
+Reference mapping:
+
+* ``entry(resource, ...)`` ≙ ``SphU.entry`` (reference: sentinel-core/
+  .../SphU.java:84) — raises :class:`BlockError` when blocked, returns an
+  :class:`Entry` handle otherwise, usable as a context manager.
+* ``try_entry`` ≙ ``SphO.entry`` (SphO.java) — returns the Entry or
+  ``None`` instead of raising.
+* ``trace`` ≙ ``Tracer.trace`` (Tracer.java:45) — marks the current
+  entry's business exception; it is counted at exit
+  (StatisticSlot.recordCompleteFor).
+* ``entry_async`` ≙ ``SphU.asyncEntry`` — an Entry detached from the
+  ambient context stack, exitable from another thread/task.
+
+A process-global :class:`Engine` instance plays the role of ``Env.sph``
+(Env.java); ``get_engine()`` initializes it on first use, like
+InitExecutor.doInit.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.core.context import Context, ContextUtil
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.runtime.engine import Engine, Verdict
+from sentinel_tpu.utils.clock import Clock
+
+_engine: Optional[Engine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Engine:
+    global _engine
+    if _engine is None:
+        with _engine_lock:
+            if _engine is None:
+                _engine = Engine()
+                _run_init_funcs()
+    return _engine
+
+
+def _run_init_funcs() -> None:
+    """SPI-discovered one-time init callbacks (InitExecutor.doInit,
+    reference: sentinel-core/.../init/InitExecutor.java:33-95)."""
+    from sentinel_tpu.utils.registry import Registry
+
+    for fn in Registry.of("InitFunc").load_instance_list_sorted():
+        try:
+            fn.init() if hasattr(fn, "init") else fn()
+        except Exception:
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log.error("[InitExecutor] InitFunc failed", exc_info=True)
+
+
+def set_engine(engine: Optional[Engine]) -> Optional[Engine]:
+    """Swap the global engine (tests); returns the previous one."""
+    global _engine
+    with _engine_lock:
+        prev = _engine
+        _engine = engine
+        return prev
+
+
+def reset(clock: Optional[Clock] = None) -> Engine:
+    """Full reset: fresh engine (+optional test clock), cleared rules.
+
+    Rule managers re-attach to the new engine lazily.
+    """
+    from sentinel_tpu.rules import all_managers
+
+    with _engine_lock:
+        global _engine
+        _engine = Engine(clock=clock)
+    ContextUtil.replace_context(None)
+    for mgr in all_managers():
+        mgr.clear()
+    return _engine
+
+
+class Entry:
+    """A live protected invocation (reference: CtEntry.java:35-150)."""
+
+    def __init__(
+        self,
+        resource: str,
+        rows: Tuple[int, int, int, int],
+        context: Optional[Context],
+        create_ts: int,
+        acquire: int,
+        pass_through: bool = False,
+    ) -> None:
+        self.resource = resource
+        self.rows = rows
+        self.context = context
+        self.create_ts = create_ts
+        # Wall-clock anchor: RT must survive an epoch rebase of the
+        # relative device clock (Engine._maybe_rebase).
+        self.create_wall = get_engine().clock.to_wall(create_ts)
+        self.acquire = acquire
+        self.error: Optional[BaseException] = None
+        self.block_error: Optional[E.BlockError] = None
+        self.pass_through = pass_through
+        self._exited = False
+
+    def set_error(self, e: BaseException) -> None:
+        """Tracer.traceEntry target (Tracer.java:110-116)."""
+        if self.error is None:
+            self.error = e
+
+    def exit(self, count: Optional[int] = None) -> None:
+        """CtEntry.trueExit: record RT + success, release thread slot."""
+        if self._exited:
+            return
+        self._exited = True
+        engine = get_engine()
+        if not self.pass_through:
+            rt = engine.clock.wall_ms() - self.create_wall
+            err = 0
+            if self.error is not None and not isinstance(self.error, E.BlockError):
+                err = count if count is not None else self.acquire
+            engine.submit_exit(
+                self.rows, rt=rt, count=count if count is not None else self.acquire, err=err
+            )
+        ctx = self.context
+        if ctx is not None and ctx.entry_stack and ctx.entry_stack[-1] is self:
+            ctx.entry_stack.pop()
+            if not ctx.entry_stack and ctx.auto:
+                ContextUtil.exit()
+
+    def __enter__(self) -> "Entry":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        # Unlike Java's try-with-resources (where Tracer.trace must be
+        # called manually), the context-manager form auto-traces
+        # non-Block exceptions — the @SentinelResource aspect behavior
+        # (SentinelResourceAspect.java:36-83).
+        if exc is not None and not isinstance(exc, E.BlockError):
+            self.set_error(exc)
+        self.exit()
+        return False
+
+
+def _do_entry(
+    resource: str,
+    entry_type: C.EntryType,
+    acquire: int,
+    origin: Optional[str],
+    prio: bool,
+    with_context: bool,
+) -> Tuple[Optional[Entry], Optional[Verdict]]:
+    engine = get_engine()
+    ctx = ContextUtil.get_context()
+    if ctx is None:
+        ctx = ContextUtil.true_enter(C.CONTEXT_DEFAULT_NAME, origin or "")
+    eff_origin = origin if origin is not None else ctx.origin
+    context_name = ctx.name if not ctx.is_null else C.CONTEXT_DEFAULT_NAME
+
+    op, verdict = engine.entry_sync(
+        resource,
+        context_name=context_name,
+        origin=eff_origin,
+        acquire=acquire,
+        entry_type=entry_type,
+        prio=prio,
+    )
+    if op is None:
+        # Above resource cap — pass-through entry with no statistics,
+        # like CtSph returning an Entry with a null chain.
+        e = Entry(resource, (-1, -1, -1, -1), ctx if with_context else None,
+                  engine.clock.now_ms(), acquire, pass_through=True)
+        if with_context:
+            ctx.entry_stack.append(e)
+        elif ctx.auto and not ctx.entry_stack:
+            ContextUtil.exit()
+        return e, verdict
+    if not verdict.admitted:
+        if ctx.auto and not ctx.entry_stack:
+            ContextUtil.exit()
+        return None, verdict
+    e = Entry(resource, op.rows, ctx if with_context else None, op.ts, acquire)
+    if with_context:
+        ctx.entry_stack.append(e)
+    elif ctx.auto and not ctx.entry_stack:
+        # Detached (async) entry created an implicit context; don't leave
+        # it ambient (SphU.asyncEntry clears via initializeAsyncContext).
+        ContextUtil.exit()
+    return e, verdict
+
+
+def entry(
+    resource: str,
+    entry_type: C.EntryType = C.EntryType.OUT,
+    count: int = 1,
+    origin: Optional[str] = None,
+    prio: bool = False,
+) -> Entry:
+    """SphU.entry: returns an Entry or raises a BlockError subclass."""
+    e, verdict = _do_entry(resource, entry_type, count, origin, prio, with_context=True)
+    if e is None:
+        assert verdict is not None
+        rule = verdict.blocked_rule
+        err = E.error_for_code(verdict.reason, resource)
+        err.rule = rule
+        raise err
+    return e
+
+
+def try_entry(
+    resource: str,
+    entry_type: C.EntryType = C.EntryType.OUT,
+    count: int = 1,
+    origin: Optional[str] = None,
+) -> Optional[Entry]:
+    """SphO.entry: boolean-style variant — Entry on pass, None on block."""
+    e, _ = _do_entry(resource, entry_type, count, origin, False, with_context=True)
+    return e
+
+
+def entry_async(
+    resource: str,
+    entry_type: C.EntryType = C.EntryType.OUT,
+    count: int = 1,
+    origin: Optional[str] = None,
+) -> Entry:
+    """SphU.asyncEntry: not pushed on the ambient stack; exit from anywhere."""
+    e, verdict = _do_entry(resource, entry_type, count, origin, False, with_context=False)
+    if e is None:
+        assert verdict is not None
+        err = E.error_for_code(verdict.reason, resource)
+        err.rule = verdict.blocked_rule
+        raise err
+    return e
+
+
+def trace(e: BaseException, count: int = 1) -> None:
+    """Tracer.trace: attach a business exception to the current entry.
+
+    ``count`` is accepted for API compatibility with the deprecated
+    Tracer.trace(e, count); like the 1.8 reference, the exception is
+    counted at exit with the exit batch count
+    (StatisticSlot.recordCompleteFor), not with this value.
+    """
+    ctx = ContextUtil.get_context()
+    if ctx is None:
+        return
+    cur = ctx.cur_entry
+    if isinstance(cur, Entry):
+        cur.set_error(e)
+
+
+def trace_context(e: BaseException, ctx: Context, count: int = 1) -> None:
+    """Tracer.traceContext."""
+    cur = ctx.cur_entry
+    if isinstance(cur, Entry):
+        cur.set_error(e)

@@ -1,0 +1,121 @@
+"""Per-task invocation context and call tree.
+
+Equivalent of the reference's Context/ContextUtil (reference:
+sentinel-core/.../context/Context.java, context/ContextUtil.java:120-190)
+and the entry parent/child chaining done by CtEntry
+(CtEntry.java:35-110). The reference uses a ThreadLocal; here a
+``contextvars.ContextVar`` covers both threads and asyncio tasks (the
+async story the reference handles with AsyncEntry/ContextSwitchEntry).
+
+Names are interned to rows: each context name gets an *entrance node*
+row (EntranceNode, aggregating its children), capped at
+MAX_CONTEXT_NAME_SIZE=2000 like ContextUtil.trueEnter — beyond the cap a
+shared NULL context is returned and statistics are not recorded for the
+entrance dimension.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import List, Optional
+
+from sentinel_tpu.models import constants as C
+
+
+class Context:
+    """One invocation chain: (name, origin) plus the current entry stack."""
+
+    __slots__ = ("name", "origin", "entry_stack", "async_mode", "auto", "_is_null")
+
+    def __init__(self, name: str, origin: str = "", *, is_null: bool = False) -> None:
+        self.name = name
+        self.origin = origin
+        self.entry_stack: List[object] = []  # stack of Entry, parent chaining
+        self.async_mode = False
+        # True when implicitly created for the default context — such
+        # contexts auto-exit when their last entry exits (CtEntry
+        # clean-up for the default context, CtEntry.java:60-110).
+        self.auto = False
+        self._is_null = is_null
+
+    @property
+    def is_null(self) -> bool:
+        """True when the 2000-context cap was hit (NullContext.java)."""
+        return self._is_null
+
+    @property
+    def cur_entry(self) -> Optional[object]:
+        return self.entry_stack[-1] if self.entry_stack else None
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Context(name={self.name!r}, origin={self.origin!r}, depth={len(self.entry_stack)})"
+
+
+_current: contextvars.ContextVar[Optional[Context]] = contextvars.ContextVar(
+    "sentinel_tpu_context", default=None
+)
+
+
+class ContextUtil:
+    """Static facade mirroring the reference's ContextUtil."""
+
+    @staticmethod
+    def enter(name: str, origin: str = "") -> Context:
+        if name == C.CONTEXT_DEFAULT_NAME:
+            # Reference forbids entering the default context explicitly
+            # (ContextUtil.enter throws ContextNameDefineException).
+            raise ValueError(
+                f"The {C.CONTEXT_DEFAULT_NAME} can't be permitted to defined!"
+            )
+        return ContextUtil.true_enter(name, origin)
+
+    @staticmethod
+    def true_enter(name: str, origin: str) -> Context:
+        ctx = _current.get()
+        if ctx is None:
+            from sentinel_tpu.core.api import get_engine
+
+            engine = get_engine()
+            row = engine.nodes.entrance_row(name)
+            ctx = Context(name, origin, is_null=row is None)
+            ctx.auto = name == C.CONTEXT_DEFAULT_NAME
+            _current.set(ctx)
+        return ctx
+
+    @staticmethod
+    def get_context() -> Optional[Context]:
+        return _current.get()
+
+    @staticmethod
+    def exit() -> None:
+        ctx = _current.get()
+        if ctx is not None and not ctx.entry_stack:
+            _current.set(None)
+
+    @staticmethod
+    def replace_context(ctx: Optional[Context]) -> Optional[Context]:
+        """Swap the ambient context (async hand-off); returns the old one.
+
+        Mirrors ContextUtil.replaceContext used by AsyncEntry
+        (reference: context/ContextUtil.java:262, AsyncEntry.java).
+        """
+        old = _current.get()
+        _current.set(ctx)
+        return old
+
+    @staticmethod
+    def run_on_context(ctx: Context, fn, *args, **kwargs):
+        """Execute ``fn`` with ``ctx`` ambient (ContextUtil.runOnContext)."""
+        old = ContextUtil.replace_context(ctx)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            ContextUtil.replace_context(old)
+
+
+def context_enter(name: str, origin: str = "") -> Context:
+    return ContextUtil.enter(name, origin)
+
+
+def context_exit() -> None:
+    ContextUtil.exit()

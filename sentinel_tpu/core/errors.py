@@ -1,0 +1,100 @@
+"""Block exceptions.
+
+Mirrors the reference's BlockException hierarchy (reference:
+sentinel-core/.../slots/block/BlockException.java and subclasses
+FlowException, DegradeException, SystemBlockException,
+AuthorityException, ParamFlowException). ``BlockError`` is deliberately
+cheap to raise: like the reference (BlockException disables stack-trace
+fill), blocking is control flow, not a fault.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class BlockError(Exception):
+    """A request was blocked by a rule. Base of all block errors."""
+
+    # Block type tag used in metric/block logs (matches reference log tags).
+    block_type = "Block"
+
+    def __init__(
+        self,
+        resource: str = "",
+        rule_limit_app: str = "default",
+        message: str = "",
+        rule: Optional[Any] = None,
+    ) -> None:
+        super().__init__(message or f"{self.block_type}ed by rule on resource [{resource}]")
+        self.resource = resource
+        self.rule_limit_app = rule_limit_app
+        self.rule = rule
+
+    # Match BlockException#isBlockException utility semantics.
+    @staticmethod
+    def is_block_error(t: BaseException) -> bool:
+        seen: set = set()
+        cur: Optional[BaseException] = t
+        while cur is not None and id(cur) not in seen:
+            if isinstance(cur, BlockError):
+                return True
+            seen.add(id(cur))
+            cur = cur.__cause__ or cur.__context__
+        return False
+
+
+class FlowBlockError(BlockError):
+    """Blocked by a flow rule (reference: FlowException.java)."""
+
+    block_type = "Flow"
+
+
+class DegradeBlockError(BlockError):
+    """Blocked by an open circuit breaker (reference: DegradeException.java)."""
+
+    block_type = "Degrade"
+
+
+class SystemBlockError(BlockError):
+    """Blocked by system protection (reference: SystemBlockException.java)."""
+
+    block_type = "System"
+
+    def __init__(self, resource: str = "", limit_type: str = "", message: str = "") -> None:
+        super().__init__(resource, "default", message or f"SystemBlock [{limit_type}] on [{resource}]")
+        self.limit_type = limit_type
+
+
+class AuthorityBlockError(BlockError):
+    """Blocked by origin authority rule (reference: AuthorityException.java)."""
+
+    block_type = "Authority"
+
+
+class ParamFlowBlockError(BlockError):
+    """Blocked by a hot-parameter rule (reference: ParamFlowException.java)."""
+
+    block_type = "ParamFlow"
+
+
+# Block reason codes used on-device (verdict tensors). 0 = pass.
+PASS = 0
+BLOCK_FLOW = 1
+BLOCK_DEGRADE = 2
+BLOCK_SYSTEM = 3
+BLOCK_AUTHORITY = 4
+BLOCK_PARAM = 5
+
+_ERROR_BY_CODE = {
+    BLOCK_FLOW: FlowBlockError,
+    BLOCK_DEGRADE: DegradeBlockError,
+    BLOCK_SYSTEM: SystemBlockError,
+    BLOCK_AUTHORITY: AuthorityBlockError,
+    BLOCK_PARAM: ParamFlowBlockError,
+}
+
+
+def error_for_code(code: int, resource: str) -> BlockError:
+    cls = _ERROR_BY_CODE.get(int(code), BlockError)
+    return cls(resource)

@@ -1,0 +1,35 @@
+"""Statistics layer: sliding-window counter tensors and node views.
+
+Equivalent of the reference's statistics core (reference:
+sentinel-core/.../slots/statistic/base/LeapArray.java:41-222,
+data/MetricBucket.java:28-120, metric/ArrayMetric.java:37-58 and
+node/StatisticNode.java:90-112) — redesigned from per-request CAS loops
+over ``AtomicReferenceArray`` buckets into batched, single-writer
+vectorized updates over an HBM-resident tensor
+``counts[rows, buckets, events]``.
+"""
+
+from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
+from sentinel_tpu.metrics.metric_array import (
+    MetricArrayConfig,
+    MetricArrayState,
+    make_state,
+    update,
+    window_sums,
+    window_min_rt,
+    bucket_windows,
+    grow,
+)
+
+__all__ = [
+    "MetricEvent",
+    "NUM_EVENTS",
+    "MetricArrayConfig",
+    "MetricArrayState",
+    "make_state",
+    "update",
+    "window_sums",
+    "window_min_rt",
+    "bucket_windows",
+    "grow",
+]

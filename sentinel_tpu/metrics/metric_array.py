@@ -1,0 +1,187 @@
+"""The sliding-window counter tensor — LeapArray, the TPU way.
+
+The reference's hot data structure is ``LeapArray<MetricBucket>``: an
+``AtomicReferenceArray`` of time-bucketed counter cells, where every
+request CAS-creates / reuses / tryLock-resets its current bucket
+(reference: sentinel-core/.../slots/statistic/base/LeapArray.java:41-222)
+and bumps ``LongAdder`` cells (data/MetricBucket.java:28-120).
+
+Here the whole fleet of LeapArrays for all nodes is ONE tensor per
+geometry::
+
+    counts       int32 [rows, buckets, NUM_EVENTS]
+    min_rt       int32 [rows, buckets]
+    window_start int32 [rows, buckets]     (ms relative to clock epoch)
+
+and a batch of updates is applied by a single jitted, single-writer
+kernel — the CAS loop becomes::
+
+    new_ws = window_start.at[rows, idx].max(entry_ws)   # who rolls the bucket
+    stale  = new_ws > window_start                      # buckets that rolled
+    counts = where(stale, 0, counts).at[rows, idx].add(deltas_in_new_window)
+
+Semantics intentionally preserved from the reference:
+
+* bucket index ``(ts // window_len) % buckets`` and aligned window start
+  ``ts - ts % window_len`` (LeapArray.java:109-119);
+* a bucket is deprecated for reads iff ``now - window_start > interval``
+  (LeapArray#isWindowDeprecated, strict inequality);
+* updates whose window is older than the bucket's (post-batch) window are
+  discarded — identical to the sequential outcome where the newer request
+  resets the bucket after the older one wrote it;
+* ``min_rt`` starts at the statistic max RT (4900 by default), matching
+  MetricBucket's ``minRt`` initialisation.
+
+Time is int32 ms relative to the engine epoch (see utils/clock.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.metrics.events import NUM_EVENTS
+
+
+class MetricArrayConfig(NamedTuple):
+    """Geometry of one window array family.
+
+    sample_count × window_len_ms = interval_ms, exactly like
+    LeapArray's constructor invariant (LeapArray.java:58-76).
+    """
+
+    sample_count: int
+    interval_ms: int
+    max_rt: int = 4900  # reference: Constants.TIME_DROP_VALVE / statisticMaxRt
+
+    @property
+    def window_len_ms(self) -> int:
+        return self.interval_ms // self.sample_count
+
+    @property
+    def empty_ws(self) -> int:
+        # A window start so old that it is always deprecated for ts >= 0.
+        return -self.interval_ms - 1
+
+
+class MetricArrayState(NamedTuple):
+    counts: jax.Array  # int32 [R, B, E]
+    min_rt: jax.Array  # int32 [R, B]
+    window_start: jax.Array  # int32 [R, B]
+
+    @property
+    def n_rows(self) -> int:
+        return self.counts.shape[0]
+
+
+def make_state(n_rows: int, cfg: MetricArrayConfig) -> MetricArrayState:
+    b = cfg.sample_count
+    return MetricArrayState(
+        counts=jnp.zeros((n_rows, b, NUM_EVENTS), dtype=jnp.int32),
+        min_rt=jnp.full((n_rows, b), cfg.max_rt, dtype=jnp.int32),
+        window_start=jnp.full((n_rows, b), cfg.empty_ws, dtype=jnp.int32),
+    )
+
+
+def grow(state: MetricArrayState, new_rows: int, cfg: MetricArrayConfig) -> MetricArrayState:
+    """Host-side row-capacity growth (new rows empty)."""
+    extra = new_rows - state.n_rows
+    if extra <= 0:
+        return state
+    tail = make_state(extra, cfg)
+    return MetricArrayState(
+        counts=jnp.concatenate([state.counts, tail.counts], axis=0),
+        min_rt=jnp.concatenate([state.min_rt, tail.min_rt], axis=0),
+        window_start=jnp.concatenate([state.window_start, tail.window_start], axis=0),
+    )
+
+
+def update(
+    cfg: MetricArrayConfig,
+    state: MetricArrayState,
+    rows: jax.Array,  # int32 [N]
+    ts: jax.Array,  # int32 [N], ms rel epoch, >= 0
+    deltas: jax.Array,  # int32 [N, NUM_EVENTS]
+    rt_sample: Optional[jax.Array] = None,  # int32 [N] per-entry RT for min tracking
+    mask: Optional[jax.Array] = None,  # bool [N] entry validity
+) -> MetricArrayState:
+    """Apply a batch of bucket updates (the LeapArray.currentWindow + add path).
+
+    Masked-out entries contribute nothing. Duplicate (row, bucket) keys in
+    one batch accumulate; entries from a superseded window are dropped
+    (see module docstring).
+    """
+    wlen = cfg.window_len_ms
+    b = cfg.sample_count
+    idx = (ts // wlen) % b
+    ws = ts - ts % wlen
+
+    if mask is None:
+        mask = jnp.ones(rows.shape, dtype=bool)
+    rows_eff = jnp.where(mask, rows, 0).astype(jnp.int32)
+    ws_eff = jnp.where(mask, ws, jnp.int32(cfg.empty_ws))
+
+    # 1. Advance window starts (scatter-max — newest write wins the bucket).
+    new_ws = state.window_start.at[rows_eff, idx].max(ws_eff, mode="drop")
+
+    # 2. Zero buckets that rolled to a newer window (the vectorized
+    #    equivalent of LeapArray's tryLock+reset, LeapArray.java:180-221).
+    stale = new_ws > state.window_start
+    counts = jnp.where(stale[:, :, None], 0, state.counts)
+    min_rt = jnp.where(stale, jnp.int32(cfg.max_rt), state.min_rt)
+
+    # 3. Accumulate entries that belong to the bucket's (new) window.
+    contrib = mask & (ws_eff == new_ws[rows_eff, idx])
+    deltas_eff = jnp.where(contrib[:, None], deltas, 0).astype(jnp.int32)
+    counts = counts.at[rows_eff, idx, :].add(deltas_eff, mode="drop")
+
+    if rt_sample is not None:
+        rt_eff = jnp.where(contrib, rt_sample, jnp.int32(2**31 - 1))
+        min_rt = min_rt.at[rows_eff, idx].min(rt_eff, mode="drop")
+
+    return MetricArrayState(counts=counts, min_rt=min_rt, window_start=new_ws)
+
+
+def _valid_mask(cfg: MetricArrayConfig, state: MetricArrayState, now: jax.Array) -> jax.Array:
+    # Reference: LeapArray#isWindowDeprecated — deprecated iff
+    # time - windowStart > intervalInMs (strict).
+    return (now - state.window_start) <= cfg.interval_ms
+
+
+def window_sums(
+    cfg: MetricArrayConfig, state: MetricArrayState, now: jax.Array
+) -> jax.Array:
+    """Windowed event sums per row: int32 [R, NUM_EVENTS].
+
+    Equivalent of ArrayMetric.pass_()/block()/success()/rt()... which sum
+    MetricBucket cells over non-deprecated windows (ArrayMetric.java:37+).
+    QPS values are these sums divided by ``interval_ms/1000`` (float) —
+    division left to callers to keep this integer-exact.
+    """
+    valid = _valid_mask(cfg, state, now)
+    return jnp.sum(state.counts * valid[:, :, None].astype(jnp.int32), axis=1)
+
+
+def window_min_rt(cfg: MetricArrayConfig, state: MetricArrayState, now: jax.Array) -> jax.Array:
+    """Windowed min RT per row (int32 [R]); ``max_rt`` when empty.
+
+    Reference: ArrayMetric#minRt over valid buckets, floored at 1 by
+    StatisticNode.minRt readers (StatisticNode.java keeps the raw value;
+    SystemRuleManager's BBR uses max(1, minRt) — flooring is done there).
+    """
+    valid = _valid_mask(cfg, state, now)
+    masked = jnp.where(valid, state.min_rt, jnp.int32(cfg.max_rt))
+    return jnp.min(masked, axis=1)
+
+
+def bucket_windows(
+    cfg: MetricArrayConfig, state: MetricArrayState, now: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(window_start [R,B], counts [R,B,E], valid [R,B]) for the metric
+    log pipeline (MetricTimerListener reads per-second buckets via
+    node.metrics(); reference: node/metric/MetricTimerListener.java:34-70).
+    """
+    valid = _valid_mask(cfg, state, now)
+    return state.window_start, state.counts, valid

@@ -1,0 +1,230 @@
+"""Node registry and per-node statistics state.
+
+The reference keeps a tree of stat-holding objects per dimension:
+
+* ``DefaultNode`` per (resource, context) linked into a call tree
+  (reference: slots/nodeselector/NodeSelectorSlot.java:127-186);
+* one shared ``ClusterNode`` per resource plus per-origin sub-nodes
+  (reference: slots/clusterbuilder/ClusterBuilderSlot.java:49);
+* ``EntranceNode`` per context aggregating its children
+  (reference: node/EntranceNode.java, context/ContextUtil.java:129-190);
+* the global inbound ``Constants.ENTRY_NODE``
+  (reference: Constants.java:66).
+
+Every such node here is **one row** of the shared stats tensors
+(second window, minute window, thread gauge) — the node "tree" is a
+host-side id table plus parent/child lists used only by the
+introspection plane; the hot path touches rows, never objects.
+
+Each node kind gets a distinct key prefix in one interner so row ids are
+dense across kinds. Capacity caps mirror the reference: 6000 resources
+(MAX_SLOT_CHAIN_SIZE), 2000 contexts (MAX_CONTEXT_NAME_SIZE); above the
+cap callers receive ``None`` and degrade to pass-through, like
+CtSph.lookProcessChain / ContextUtil.trueEnter.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.metrics.events import NUM_EVENTS
+from sentinel_tpu.metrics import metric_array as ma
+from sentinel_tpu.models import constants as C
+
+
+SECOND_CFG = ma.MetricArrayConfig(
+    sample_count=C.DEFAULT_SAMPLE_COUNT, interval_ms=C.DEFAULT_WINDOW_INTERVAL_MS
+)
+MINUTE_CFG = ma.MetricArrayConfig(
+    sample_count=C.MINUTE_SAMPLE_COUNT, interval_ms=C.MINUTE_INTERVAL_MS
+)
+
+
+class StatsState(NamedTuple):
+    """Device-resident statistics for all nodes.
+
+    The reference's StatisticNode holds exactly these three things: a 1 s
+    rolling window (2×500 ms), a 60 s window (60×1 s) and a thread gauge
+    (reference: node/StatisticNode.java:90-112).
+    """
+
+    second: ma.MetricArrayState
+    minute: ma.MetricArrayState
+    threads: jax.Array  # int32 [R]
+
+    @property
+    def n_rows(self) -> int:
+        return self.threads.shape[0]
+
+
+def make_stats(n_rows: int) -> StatsState:
+    return StatsState(
+        second=ma.make_state(n_rows, SECOND_CFG),
+        minute=ma.make_state(n_rows, MINUTE_CFG),
+        threads=jnp.zeros((n_rows,), dtype=jnp.int32),
+    )
+
+
+def grow_stats(state: StatsState, new_rows: int) -> StatsState:
+    if new_rows <= state.n_rows:
+        return state
+    return StatsState(
+        second=ma.grow(state.second, new_rows, SECOND_CFG),
+        minute=ma.grow(state.minute, new_rows, MINUTE_CFG),
+        threads=jnp.concatenate(
+            [state.threads, jnp.zeros((new_rows - state.n_rows,), dtype=jnp.int32)]
+        ),
+    )
+
+
+def apply_updates(
+    state: StatsState,
+    rows: jax.Array,  # int32 [M]
+    ts: jax.Array,  # int32 [M]
+    deltas: jax.Array,  # int32 [M, NUM_EVENTS]
+    rt_sample: Optional[jax.Array],  # int32 [M] or None
+    thread_delta: jax.Array,  # int32 [M]
+    mask: jax.Array,  # bool [M]
+) -> StatsState:
+    """One scatter pass over both windows + the thread gauge."""
+    second = ma.update(SECOND_CFG, state.second, rows, ts, deltas, rt_sample, mask)
+    minute = ma.update(MINUTE_CFG, state.minute, rows, ts, deltas, rt_sample, mask)
+    rows_eff = jnp.where(mask, rows, 0).astype(jnp.int32)
+    thr = jnp.where(mask, thread_delta, 0).astype(jnp.int32)
+    threads = state.threads.at[rows_eff].add(thr, mode="drop")
+    return StatsState(second=second, minute=minute, threads=threads)
+
+
+class NodeKind:
+    CLUSTER = "C"  # per-resource ClusterNode
+    DEFAULT = "D"  # per-(resource, context) DefaultNode
+    ORIGIN = "O"  # per-(resource, origin) origin StatisticNode
+    ENTRANCE = "E"  # per-context EntranceNode
+
+
+class NodeRegistry:
+    """Host-side name→row table plus the call-tree structure."""
+
+    def __init__(
+        self,
+        max_resources: int = C.MAX_SLOT_CHAIN_SIZE,
+        max_contexts: int = C.MAX_CONTEXT_NAME_SIZE,
+    ) -> None:
+        self._lock = threading.RLock()
+        self._rows: Dict[str, int] = {}
+        self._keys: List[str] = []
+        self.max_resources = max_resources
+        self.max_contexts = max_contexts
+        self._n_resources = 0
+        self._n_contexts = 0
+        # Call tree: entrance row -> child default rows (EntranceNode children).
+        self.children: Dict[int, List[int]] = {}
+        # Origin rows per cluster row (ClusterNode#originCountMap analog).
+        self.origin_rows: Dict[int, Dict[str, int]] = {}
+        # The global inbound node is always row 0 (Constants.ENTRY_NODE).
+        self.entry_node_row = self._alloc(NodeKind.CLUSTER + ":" + C.TOTAL_IN_RESOURCE_NAME)
+        assert self.entry_node_row == 0
+
+    def _alloc(self, key: str) -> int:
+        row = self._rows.get(key)
+        if row is None:
+            row = len(self._keys)
+            self._rows[key] = row
+            self._keys.append(key)
+        return row
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._keys)
+
+    def key_of(self, row: int) -> str:
+        with self._lock:
+            return self._keys[row]
+
+    def cluster_row(self, resource: str) -> Optional[int]:
+        """Row of the resource's ClusterNode; None above the resource cap."""
+        key = NodeKind.CLUSTER + ":" + resource
+        with self._lock:
+            row = self._rows.get(key)
+            if row is not None:
+                return row
+            if self._n_resources >= self.max_resources:
+                return None
+            self._n_resources += 1
+            return self._alloc(key)
+
+    def default_row(self, resource: str, context: str) -> Optional[int]:
+        """Row of the per-context DefaultNode (NodeSelectorSlot.java:135-180)."""
+        key = NodeKind.DEFAULT + ":" + resource + "|" + context
+        with self._lock:
+            row = self._rows.get(key)
+            if row is not None:
+                return row
+            row = self._alloc(key)
+            ent = self.entrance_row(context)
+            if ent is not None:
+                self.children.setdefault(ent, []).append(row)
+            return row
+
+    def origin_row(self, resource: str, origin: str) -> Optional[int]:
+        """Row of the per-origin node under the resource's ClusterNode
+        (ClusterBuilderSlot.java:49+, ClusterNode#getOrCreateOriginNode)."""
+        if not origin:
+            return None
+        crow = self.cluster_row(resource)
+        if crow is None:
+            return None
+        key = NodeKind.ORIGIN + ":" + resource + "|" + origin
+        with self._lock:
+            row = self._rows.get(key)
+            if row is None:
+                row = self._alloc(key)
+                self.origin_rows.setdefault(crow, {})[origin] = row
+            return row
+
+    def entrance_row(self, context: str) -> Optional[int]:
+        """Row of the context's EntranceNode; None above the 2000 cap."""
+        key = NodeKind.ENTRANCE + ":" + context
+        with self._lock:
+            row = self._rows.get(key)
+            if row is not None:
+                return row
+            if self._n_contexts >= self.max_contexts:
+                return None
+            self._n_contexts += 1
+            return self._alloc(key)
+
+    def lookup_cluster_row(self, resource: str) -> Optional[int]:
+        with self._lock:
+            return self._rows.get(NodeKind.CLUSTER + ":" + resource)
+
+    def resources(self) -> List[Tuple[str, int]]:
+        """All (resource, cluster_row) pairs (ClusterBuilderSlot map view)."""
+        prefix = NodeKind.CLUSTER + ":"
+        with self._lock:
+            return [
+                (k[len(prefix):], r)
+                for k, r in self._rows.items()
+                if k.startswith(prefix) and r != self.entry_node_row
+            ]
+
+    def entrance_children(self, context: str) -> List[int]:
+        with self._lock:
+            row = self._rows.get(NodeKind.ENTRANCE + ":" + context)
+            if row is None:
+                return []
+            return list(self.children.get(row, ()))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows.clear()
+            self._keys.clear()
+            self.children.clear()
+            self.origin_rows.clear()
+            self._n_resources = 0
+            self._n_contexts = 0
+            self.entry_node_row = self._alloc(NodeKind.CLUSTER + ":" + C.TOTAL_IN_RESOURCE_NAME)

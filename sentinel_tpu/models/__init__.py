@@ -1,0 +1,33 @@
+"""Rule models and constants (the framework's data model layer).
+
+Equivalent of the reference's rule classes (reference: sentinel-core/.../
+slots/block/flow/FlowRule.java:52-90, degrade/DegradeRule.java:59-84,
+system/SystemRule.java:43-50, authority/AuthorityRule.java and
+sentinel-extension/sentinel-parameter-flow-control/.../ParamFlowRule.java)
+expressed as frozen dataclasses. Rule *compilation* to SoA device tensors
+lives in :mod:`sentinel_tpu.rules`.
+"""
+
+from sentinel_tpu.models import constants
+from sentinel_tpu.models.rules import (
+    AbstractRule,
+    FlowRule,
+    ClusterFlowConfig,
+    DegradeRule,
+    SystemRule,
+    AuthorityRule,
+    ParamFlowRule,
+    ParamFlowItem,
+)
+
+__all__ = [
+    "constants",
+    "AbstractRule",
+    "FlowRule",
+    "ClusterFlowConfig",
+    "DegradeRule",
+    "SystemRule",
+    "AuthorityRule",
+    "ParamFlowRule",
+    "ParamFlowItem",
+]

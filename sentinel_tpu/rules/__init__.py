@@ -1,0 +1,31 @@
+"""Rule engine: compilation of rule beans into device SoA tensors plus
+the host-side indexes the op encoder uses.
+
+Equivalent of the reference's rule managers + checkers
+(reference: sentinel-core/.../slots/block/flow/FlowRuleManager.java,
+FlowRuleUtil.java:84-161, FlowRuleChecker.java:44-230 and the sibling
+Degrade/System/Authority/ParamFlow managers). Where the reference builds
+one ``TrafficShapingController`` object per rule, this build compiles
+all rules of a kind into parallel arrays (grade/count/behavior/...) that
+one vectorized kernel evaluates for the whole batch at once; a rule
+update rebuilds the arrays and swaps them in (the analog of the
+volatile map swap in FlowRuleManager.java:159).
+"""
+
+from typing import List
+
+
+def all_managers() -> List[object]:
+    from sentinel_tpu.rules.authority_manager import authority_rule_manager
+    from sentinel_tpu.rules.degrade_manager import degrade_rule_manager
+    from sentinel_tpu.rules.flow_manager import flow_rule_manager
+    from sentinel_tpu.rules.param_manager import param_flow_rule_manager
+    from sentinel_tpu.rules.system_manager import system_rule_manager
+
+    return [
+        flow_rule_manager,
+        degrade_rule_manager,
+        system_rule_manager,
+        authority_rule_manager,
+        param_flow_rule_manager,
+    ]

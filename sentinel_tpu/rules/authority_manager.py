@@ -1,0 +1,44 @@
+"""Authority rule manager (reference: AuthorityRuleManager.java +
+AuthorityRuleChecker.java:31-60). Origin white/black lists per resource;
+the check itself is origin-id set membership, wired into the flush
+kernel in the authority milestone."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import AuthorityRule
+from sentinel_tpu.rules.manager_base import RuleManager
+
+
+class AuthorityRuleManager(RuleManager[AuthorityRule]):
+    rule_kind = "authority"
+
+    def __init__(self) -> None:
+        super().__init__()
+        # resource -> rule (reference keeps one rule per resource).
+        self.by_resource: Dict[str, AuthorityRule] = {}
+
+    def _apply(self, rules: List[AuthorityRule]) -> None:
+        self.by_resource = {r.resource: r for r in rules if r.is_valid()}
+        from sentinel_tpu.core.api import get_engine
+
+        engine = get_engine()
+        if hasattr(engine, "set_authority_rules"):
+            engine.set_authority_rules(self.by_resource)
+
+    @staticmethod
+    def passes(rule: AuthorityRule, origin: str) -> bool:
+        """AuthorityRuleChecker.passCheck: contains-check on the comma
+        list, then white→must-contain / black→must-not-contain."""
+        if not origin or not rule.limit_app:
+            return True
+        apps = {a.strip() for a in rule.limit_app.split(",")}
+        contains = origin in apps
+        if rule.strategy == C.AUTHORITY_BLACK:
+            return not contains
+        return contains
+
+
+authority_rule_manager = AuthorityRuleManager()

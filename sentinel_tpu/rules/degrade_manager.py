@@ -1,0 +1,26 @@
+"""Degrade (circuit breaker) rule manager (reference:
+DegradeRuleManager.java). Rule storage + validation land here now;
+breaker state-machine enforcement is wired into the flush kernel in the
+degrade milestone (SURVEY.md §7 stage 5)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from sentinel_tpu.models.rules import DegradeRule
+from sentinel_tpu.rules.manager_base import RuleManager
+
+
+class DegradeRuleManager(RuleManager[DegradeRule]):
+    rule_kind = "degrade"
+
+    def _apply(self, rules: List[DegradeRule]) -> None:
+        from sentinel_tpu.core.api import get_engine
+
+        valid = [r for r in rules if r.is_valid()]
+        engine = get_engine()
+        if hasattr(engine, "set_degrade_rules"):
+            engine.set_degrade_rules(valid)
+
+
+degrade_rule_manager = DegradeRuleManager()

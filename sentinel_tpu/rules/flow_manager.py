@@ -1,0 +1,43 @@
+"""Flow rule manager (reference: FlowRuleManager.java:56-170).
+
+``load_rules`` validates + compiles the rule set to the device SoA table
+(FlowIndex) and swaps it into the engine — the analog of
+FlowRuleUtil.buildFlowRuleMap + the volatile map swap
+(FlowRuleUtil.java:84-161, FlowRuleManager.java:159).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import FlowRule
+from sentinel_tpu.rules.manager_base import RuleManager
+from sentinel_tpu.utils.record_log import record_log
+
+
+class FlowRuleManager(RuleManager[FlowRule]):
+    rule_kind = "flow"
+
+    def _apply(self, rules: List[FlowRule]) -> None:
+        from sentinel_tpu.core.api import get_engine
+
+        for r in rules:
+            if r.control_behavior != C.CONTROL_BEHAVIOR_DEFAULT:
+                # Rate-limiter / warm-up shaping ships in the controllers
+                # milestone; until then these degrade to DEFAULT checking.
+                record_log.warn(
+                    "[FlowRuleManager] control_behavior=%d not yet enforced for %s; "
+                    "treating as DEFAULT",
+                    r.control_behavior,
+                    r.resource,
+                )
+        get_engine().set_flow_rules(rules)
+
+    def is_other_origin(self, origin: str, resource: str) -> bool:
+        from sentinel_tpu.core.api import get_engine
+
+        return get_engine().flow_index.is_other_origin(origin, resource)
+
+
+flow_rule_manager = FlowRuleManager()

@@ -1,0 +1,244 @@
+"""Flow rule compilation and per-entry rule-slot resolution.
+
+Compiles ``FlowRule`` beans into:
+
+* **device SoA tensors** (one slot per rule, padded to a power of two):
+  grade / count / control-behavior / shaping parameters — read by the
+  vectorized admission kernel (equivalent to FlowRuleUtil.buildFlowRuleMap
+  + generateRater, reference: FlowRuleUtil.java:84-161);
+* a **host index**: rules grouped per resource in FlowRuleComparator
+  order (origin-specific first, ``default`` last — reference:
+  FlowRuleComparator.java), plus the limit-app set per resource needed
+  for ``other`` matching (FlowRuleManager.isOtherOrigin).
+
+Per-entry node selection (FlowRuleChecker.selectNodeByRequesterAndStrategy,
+reference: FlowRuleChecker.java:96-165) runs on the host when an op is
+encoded, yielding for each entry up to K ``(rule_gid, check_row)`` slots;
+a rule that does not apply to the entry (null node in the reference)
+contributes no slot and therefore passes trivially.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.metrics.nodes import NodeRegistry
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import FlowRule
+from sentinel_tpu.utils.numeric import pad_pow2 as _pad_pow2
+from sentinel_tpu.utils.record_log import record_log
+
+
+class FlowTableDevice(NamedTuple):
+    """Per-rule static parameters on device (padded; padding = always-pass)."""
+
+    grade: jax.Array  # int32 [NR] FLOW_GRADE_THREAD / FLOW_GRADE_QPS
+    count: jax.Array  # float32 [NR] threshold
+    behavior: jax.Array  # int32 [NR] CONTROL_BEHAVIOR_*
+    max_queueing_time_ms: jax.Array  # int32 [NR] (rate limiter)
+    warmup_warning_token: jax.Array  # int32 [NR] (warm up)
+    warmup_max_token: jax.Array  # int32 [NR]
+    warmup_slope: jax.Array  # float32 [NR]
+    warmup_count: jax.Array  # float32 [NR] (rule count for warm-up math)
+
+    @property
+    def n_rules(self) -> int:
+        return self.grade.shape[0]
+
+
+class FlowRuleDynState(NamedTuple):
+    """Per-rule *mutable* shaping state, carried across flushes.
+
+    latest_passed_time ≙ RateLimiterController.latestPassedTime
+    (reference: controller/RateLimiterController.java:28-90);
+    stored_tokens / last_filled_time ≙ WarmUpController.storedTokens /
+    lastFilledTime (reference: controller/WarmUpController.java:64-130).
+    """
+
+    latest_passed_time: jax.Array  # int32 [NR], ms rel epoch (-large init)
+    stored_tokens: jax.Array  # float32 [NR]
+    last_filled_time: jax.Array  # int32 [NR]
+
+
+@dataclass
+class CompiledFlowRule:
+    gid: int
+    rule: FlowRule
+
+
+class FlowIndex:
+    """Host-side compiled view of the active flow rules."""
+
+    def __init__(self, rules: Sequence[FlowRule], cold_factor: int = 3) -> None:
+        valid: List[FlowRule] = []
+        for r in rules:
+            if isinstance(r, dict):
+                from sentinel_tpu.models.rules import rules_from_json
+
+                r = rules_from_json([r], FlowRule)[0]
+            if r.is_valid():
+                valid.append(r)
+            else:
+                record_log.warn("[FlowIndex] Ignoring invalid flow rule: %s", r)
+
+        # FlowRuleComparator: origin-specific first, LIMIT_APP_OTHER next,
+        # LIMIT_APP_DEFAULT last (stable within class).
+        def order_key(r: FlowRule) -> int:
+            if r.limit_app == C.LIMIT_APP_DEFAULT:
+                return 2
+            if r.limit_app == C.LIMIT_APP_OTHER:
+                return 1
+            return 0
+
+        self.rules: List[CompiledFlowRule] = []
+        self.by_resource: Dict[str, List[CompiledFlowRule]] = {}
+        self.limit_apps: Dict[str, Set[str]] = {}
+        by_res: Dict[str, List[FlowRule]] = {}
+        for r in valid:
+            by_res.setdefault(r.resource, []).append(r)
+        for res, rs in by_res.items():
+            rs_sorted = sorted(rs, key=order_key)
+            compiled = []
+            for r in rs_sorted:
+                cr = CompiledFlowRule(gid=len(self.rules), rule=r)
+                self.rules.append(cr)
+                compiled.append(cr)
+            self.by_resource[res] = compiled
+            self.limit_apps[res] = {r.limit_app for r in rs}
+
+        self.max_rules_per_resource = max((len(v) for v in self.by_resource.values()), default=0)
+        self.cold_factor = cold_factor
+        self.device = self._build_device()
+
+    def _build_device(self) -> FlowTableDevice:
+        n = _pad_pow2(len(self.rules))
+        grade = [C.FLOW_GRADE_QPS] * n
+        count = [float("inf")] * n  # padding threshold: always pass
+        behavior = [C.CONTROL_BEHAVIOR_DEFAULT] * n
+        maxq = [0] * n
+        w_warn = [0] * n
+        w_max = [0] * n
+        w_slope = [0.0] * n
+        w_count = [0.0] * n
+        for cr in self.rules:
+            r = cr.rule
+            grade[cr.gid] = r.grade
+            count[cr.gid] = float(r.count)
+            behavior[cr.gid] = r.control_behavior
+            maxq[cr.gid] = int(r.max_queueing_time_ms)
+            if r.control_behavior in (
+                C.CONTROL_BEHAVIOR_WARM_UP,
+                C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER,
+            ):
+                # Guava SmoothWarmingUp-derived constants, computed exactly
+                # as the reference does (WarmUpController.construct,
+                # reference: controller/WarmUpController.java:64-107):
+                #   warningToken = (warmupPeriodSec * count) / (coldFactor - 1)
+                #   maxToken = warningToken + 2*warmupPeriodSec*count/(1+coldFactor)
+                #   slope = (coldFactor - 1) / count / (maxToken - warningToken)
+                cf = self.cold_factor
+                warning = int(r.warm_up_period_sec * r.count / (cf - 1))
+                max_tok = warning + int(2 * r.warm_up_period_sec * r.count / (1.0 + cf))
+                slope = (cf - 1.0) / r.count / max(1, (max_tok - warning)) if r.count > 0 else 0.0
+                w_warn[cr.gid] = warning
+                w_max[cr.gid] = max_tok
+                w_slope[cr.gid] = slope
+                w_count[cr.gid] = float(r.count)
+        return FlowTableDevice(
+            grade=jnp.array(grade, dtype=jnp.int32),
+            count=jnp.array(count, dtype=jnp.float32),
+            behavior=jnp.array(behavior, dtype=jnp.int32),
+            max_queueing_time_ms=jnp.array(maxq, dtype=jnp.int32),
+            warmup_warning_token=jnp.array(w_warn, dtype=jnp.int32),
+            warmup_max_token=jnp.array(w_max, dtype=jnp.int32),
+            warmup_slope=jnp.array(w_slope, dtype=jnp.float32),
+            warmup_count=jnp.array(w_count, dtype=jnp.float32),
+        )
+
+    def make_dyn_state(self, prev: Optional[FlowRuleDynState] = None) -> FlowRuleDynState:
+        """Fresh mutable columns; carried values are NOT preserved across
+        rule reloads, matching the reference where loadRules builds new
+        controller objects with fresh state (FlowRuleUtil.java:141-161)."""
+        n = self.device.n_rules
+        return FlowRuleDynState(
+            latest_passed_time=jnp.full((n,), -(10**9), dtype=jnp.int32),
+            stored_tokens=jnp.zeros((n,), dtype=jnp.float32),
+            last_filled_time=jnp.full((n,), -(10**9), dtype=jnp.int32),
+        )
+
+    def is_other_origin(self, origin: str, resource: str) -> bool:
+        """Reference: FlowRuleManager.isOtherOrigin — origin counts as
+        "other" iff no rule of this resource names it as limitApp."""
+        if not origin:
+            return False
+        return origin not in self.limit_apps.get(resource, set())
+
+    def resolve_slots(
+        self,
+        resource: str,
+        context_name: str,
+        origin: str,
+        nodes: NodeRegistry,
+    ) -> List[Tuple[int, int]]:
+        """(rule_gid, check_row) for every rule that applies to this entry.
+
+        Mirrors selectNodeByRequesterAndStrategy
+        (FlowRuleChecker.java:96-165). A rule returning "no node" there is
+        simply omitted (it passes trivially).
+        """
+        out: List[Tuple[int, int]] = []
+        for cr in self.by_resource.get(resource, ()):
+            r = cr.rule
+            row = self._select_row(r, resource, context_name, origin, nodes)
+            if row is not None:
+                out.append((cr.gid, row))
+        return out
+
+    def _select_row(
+        self,
+        r: FlowRule,
+        resource: str,
+        context_name: str,
+        origin: str,
+        nodes: NodeRegistry,
+    ) -> Optional[int]:
+        la = r.limit_app
+        if la == origin and origin not in (C.LIMIT_APP_DEFAULT, C.LIMIT_APP_OTHER):
+            if r.strategy == C.STRATEGY_DIRECT:
+                return nodes.origin_row(resource, origin)
+            return self._reference_row(r, resource, context_name, nodes)
+        if la == C.LIMIT_APP_DEFAULT:
+            if r.strategy == C.STRATEGY_DIRECT:
+                return nodes.cluster_row(resource)
+            return self._reference_row(r, resource, context_name, nodes)
+        if la == C.LIMIT_APP_OTHER and self.is_other_origin(origin, resource):
+            if r.strategy == C.STRATEGY_DIRECT:
+                return nodes.origin_row(resource, origin)
+            return self._reference_row(r, resource, context_name, nodes)
+        return None
+
+    def _reference_row(
+        self, r: FlowRule, resource: str, context_name: str, nodes: NodeRegistry
+    ) -> Optional[int]:
+        # Reference: FlowRuleChecker.selectReferenceNode.
+        if not r.ref_resource:
+            return None
+        if r.strategy == C.STRATEGY_RELATE:
+            return nodes.lookup_cluster_row(r.ref_resource)
+        if r.strategy == C.STRATEGY_CHAIN:
+            if r.ref_resource != context_name:
+                return None
+            return nodes.default_row(resource, context_name)
+        return None
+
+    def get_rules(self) -> List[FlowRule]:
+        return [cr.rule for cr in self.rules]
+
+    def rule_of_gid(self, gid: int) -> Optional[FlowRule]:
+        if 0 <= gid < len(self.rules):
+            return self.rules[gid].rule
+        return None

@@ -1,0 +1,81 @@
+"""Common rule-manager machinery.
+
+Every reference rule manager follows one shape (reference:
+FlowRuleManager.java:56-170): a static rule map, a SentinelProperty it
+listens on, ``loadRules`` = ``property.updateValue``, and
+``register2Property`` to re-bind to a datasource's property. This base
+class reproduces that shape; subclasses implement ``_apply`` to compile
+and push the new rule set into the engine.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Generic, List, Optional, Sequence, TypeVar
+
+from sentinel_tpu.core.property import (
+    DynamicSentinelProperty,
+    PropertyListener,
+    SentinelProperty,
+)
+from sentinel_tpu.utils.record_log import record_log
+
+R = TypeVar("R")
+
+
+class RuleManager(Generic[R]):
+    rule_kind = "rule"
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._rules: List[R] = []
+        self._listener = _ManagerListener(self)
+        self._property: SentinelProperty = DynamicSentinelProperty()
+        self._property.add_listener(self._listener)
+
+    def load_rules(self, rules: Optional[Sequence[R]]) -> None:
+        """FlowRuleManager.loadRules: push through the property so
+        datasource-driven and manual updates share one path."""
+        self._property.update_value(list(rules) if rules else [])
+
+    def register_property(self, prop: SentinelProperty) -> None:
+        """FlowRuleManager.register2Property."""
+        with self._lock:
+            self._property.remove_listener(self._listener)
+            self._property = prop
+            prop.add_listener(self._listener)
+
+    def get_rules(self) -> List[R]:
+        with self._lock:
+            return list(self._rules)
+
+    def has_rules(self) -> bool:
+        with self._lock:
+            return bool(self._rules)
+
+    def clear(self) -> None:
+        self.load_rules([])
+
+    # -- internal --
+    def _on_update(self, rules: Optional[Sequence[R]]) -> None:
+        rules = list(rules) if rules else []
+        with self._lock:
+            self._rules = rules
+            try:
+                self._apply(rules)
+            except Exception:
+                record_log.error(
+                    "[%s] Failed to apply rules", type(self).__name__, exc_info=True
+                )
+        record_log.info("[%s] Rules loaded: %d", type(self).__name__, len(rules))
+
+    def _apply(self, rules: List[R]) -> None:
+        raise NotImplementedError
+
+
+class _ManagerListener(PropertyListener):
+    def __init__(self, mgr: RuleManager) -> None:
+        self._mgr = mgr
+
+    def config_update(self, value) -> None:
+        self._mgr._on_update(value)

@@ -1,0 +1,34 @@
+"""Hot-parameter flow rule manager (reference:
+sentinel-extension/sentinel-parameter-flow-control/.../ParamFlowRuleManager.java).
+Rule storage now; hashed-row token buckets in the param-flow milestone
+(SURVEY.md §7 stage 5)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from sentinel_tpu.models.rules import ParamFlowRule
+from sentinel_tpu.rules.manager_base import RuleManager
+
+
+class ParamFlowRuleManager(RuleManager[ParamFlowRule]):
+    rule_kind = "param-flow"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.by_resource: Dict[str, List[ParamFlowRule]] = {}
+
+    def _apply(self, rules: List[ParamFlowRule]) -> None:
+        by_res: Dict[str, List[ParamFlowRule]] = {}
+        for r in rules:
+            if r.is_valid():
+                by_res.setdefault(r.resource, []).append(r)
+        self.by_resource = by_res
+        from sentinel_tpu.core.api import get_engine
+
+        engine = get_engine()
+        if hasattr(engine, "set_param_rules"):
+            engine.set_param_rules(by_res)
+
+
+param_flow_rule_manager = ParamFlowRuleManager()

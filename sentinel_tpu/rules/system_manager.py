@@ -1,0 +1,65 @@
+"""System rule manager (reference: SystemRuleManager.java:298-353).
+
+Stores adaptive-protection rules; the effective config is the minimum
+across rules per dimension, matching loadSystemConf. Kernel enforcement
+(global QPS / thread / RT / BBR load+CPU on the ENTRY_NODE row) is wired
+in the system-protection milestone."""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+from sentinel_tpu.models.rules import SystemRule
+from sentinel_tpu.rules.manager_base import RuleManager
+
+
+class SystemConfig(NamedTuple):
+    highest_system_load: float = -1.0
+    highest_cpu_usage: float = -1.0
+    qps: float = -1.0
+    max_rt: int = -1
+    max_thread: int = -1
+
+    @property
+    def any_enabled(self) -> bool:
+        return (
+            self.highest_system_load >= 0
+            or self.highest_cpu_usage >= 0
+            or self.qps >= 0
+            or self.max_rt >= 0
+            or self.max_thread >= 0
+        )
+
+
+def _min_enabled(cur: float, new: float) -> float:
+    if new < 0:
+        return cur
+    return new if cur < 0 else min(cur, new)
+
+
+class SystemRuleManager(RuleManager[SystemRule]):
+    rule_kind = "system"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.effective = SystemConfig()
+
+    def _apply(self, rules: List[SystemRule]) -> None:
+        cfg = SystemConfig()
+        for r in rules:
+            cfg = SystemConfig(
+                highest_system_load=_min_enabled(cfg.highest_system_load, r.highest_system_load),
+                highest_cpu_usage=_min_enabled(cfg.highest_cpu_usage, r.highest_cpu_usage),
+                qps=_min_enabled(cfg.qps, r.qps),
+                max_rt=int(_min_enabled(cfg.max_rt, r.avg_rt)),
+                max_thread=int(_min_enabled(cfg.max_thread, r.max_thread)),
+            )
+        self.effective = cfg
+        from sentinel_tpu.core.api import get_engine
+
+        engine = get_engine()
+        if hasattr(engine, "set_system_config"):
+            engine.set_system_config(cfg)
+
+
+system_rule_manager = SystemRuleManager()

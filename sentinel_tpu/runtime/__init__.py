@@ -1,0 +1,12 @@
+"""Batched execution runtime.
+
+This is the TPU-native replacement for the reference's request-driven
+slot-chain hot path (reference: sentinel-core/.../CtSph.java:117-233 and
+slots/statistic/StatisticSlot.java:51-148): instead of every request
+racing CAS counters, ops are buffered host-side and flushed through one
+jitted kernel that checks and accounts the whole batch at once.
+"""
+
+from sentinel_tpu.runtime.engine import Engine, Verdict
+
+__all__ = ["Engine", "Verdict"]

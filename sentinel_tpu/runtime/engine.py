@@ -1,0 +1,395 @@
+"""The batched engine: host-side op buffering, encoding, and flushing.
+
+This object plays the role of the reference's ``CtSph`` + slot chain +
+node map (reference: sentinel-core/.../CtSph.java:43-233): it owns the
+device-resident statistics (`StatsState`), the compiled rule tables, the
+host node registry, and the pending-op buffer. ``entry()``-style calls
+enqueue ops; ``flush()`` encodes them into padded arrays and runs the
+jitted flush kernel once for the whole batch.
+
+Two usage modes:
+
+* **sync** (default for the public API): every entry call flushes the
+  pending buffer and returns that entry's verdict — semantically the
+  reference's synchronous ``SphU.entry``. Batching still happens
+  naturally whenever multiple ops accumulated since the last flush
+  (exits, traces, other threads' entries).
+* **deferred**: callers submit many ops and flush once — the high
+  throughput path (the analog of the reference's cluster client, which
+  already tolerates decision latency; see SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.metrics.events import MetricEvent
+from sentinel_tpu.metrics import metric_array as ma
+from sentinel_tpu.metrics.nodes import (
+    MINUTE_CFG,
+    SECOND_CFG,
+    NodeRegistry,
+    StatsState,
+    grow_stats,
+    make_stats,
+)
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import FlowRule
+from sentinel_tpu.rules.flow_table import FlowIndex, FlowRuleDynState
+from sentinel_tpu.runtime.flush import FlushBatch, flush_step_jit
+from sentinel_tpu.utils.clock import Clock, SystemClock, default_clock
+from sentinel_tpu.utils.config import config
+from sentinel_tpu.utils.numeric import pad_pow2 as _pad_pow2
+
+
+class Verdict(NamedTuple):
+    admitted: bool
+    reason: int  # errors.PASS / BLOCK_*
+    wait_ms: int
+    blocked_rule: Optional[object]  # the rule bean that blocked, if attributable
+
+
+@dataclass
+class _EntryOp:
+    resource: str
+    ts: int
+    acquire: int
+    rows: Tuple[int, int, int, int]  # default, cluster, origin|-1, entry|-1
+    slots: List[Tuple[int, int]]  # (rule_gid, check_row)
+    prio: bool = False
+    verdict: Optional[Verdict] = None
+
+
+@dataclass
+class _ExitOp:
+    ts: int
+    rows: Tuple[int, int, int, int]
+    count: int = 0  # success delta
+    rt: int = 0
+    err: int = 0  # exception delta
+    thr: int = 0  # thread delta (-1 for exits, 0 for traces)
+
+
+class Engine:
+    """Owns device state + host indexes; thread-safe op submission."""
+
+    def __init__(self, clock: Optional[Clock] = None, initial_rows: Optional[int] = None) -> None:
+        self.clock = clock or default_clock()
+        self.nodes = NodeRegistry()
+        rows = _pad_pow2(initial_rows or config.get_int(config.INITIAL_ROWS, 1024))
+        self.stats: StatsState = make_stats(rows)
+        self.flow_index = FlowIndex([], cold_factor=config.cold_factor)
+        self.flow_dyn: FlowRuleDynState = self.flow_index.make_dyn_state()
+        self._entries: List[_EntryOp] = []
+        self._exits: List[_ExitOp] = []
+        self._lock = threading.RLock()
+        self.max_batch = config.get_int(config.FLUSH_MAX_BATCH, 131072)
+
+    # ------------------------------------------------------------------
+    # rule plumbing (called by rule managers)
+    # ------------------------------------------------------------------
+    def set_flow_rules(self, rules: Sequence[FlowRule]) -> None:
+        with self._lock:
+            self.flush()  # decisions for pending ops use the old rules
+            self.flow_index = FlowIndex(rules, cold_factor=config.cold_factor)
+            self.flow_dyn = self.flow_index.make_dyn_state()
+
+    # ------------------------------------------------------------------
+    # op submission
+    # ------------------------------------------------------------------
+    def resolve_entry_rows(
+        self, resource: str, context_name: str, origin: str, entry_type: C.EntryType
+    ) -> Optional[Tuple[int, int, int, int]]:
+        """The NodeSelectorSlot/ClusterBuilderSlot work: rows for the
+        default node, cluster node, origin node and global entry node.
+        Returns None above the resource cap (pass-through, like
+        CtSph.lookProcessChain returning null)."""
+        crow = self.nodes.cluster_row(resource)
+        if crow is None:
+            return None
+        drow = self.nodes.default_row(resource, context_name)
+        orow = self.nodes.origin_row(resource, origin) if origin else None
+        erow = self.nodes.entry_node_row if entry_type == C.EntryType.IN else None
+        return (
+            drow if drow is not None else -1,
+            crow,
+            orow if orow is not None else -1,
+            erow if erow is not None else -1,
+        )
+
+    def submit_entry(
+        self,
+        resource: str,
+        context_name: str = C.CONTEXT_DEFAULT_NAME,
+        origin: str = "",
+        acquire: int = 1,
+        entry_type: C.EntryType = C.EntryType.OUT,
+        prio: bool = False,
+        ts: Optional[int] = None,
+    ) -> Optional[_EntryOp]:
+        """Enqueue an entry op; returns None for pass-through (over cap)."""
+        # Slot resolution + append happen under the engine lock so a
+        # concurrent rule reload cannot swap the flow index between
+        # resolving gids and flushing them against the device table.
+        with self._lock:
+            rows = self.resolve_entry_rows(resource, context_name, origin, entry_type)
+            if rows is None:
+                return None
+            slots = self.flow_index.resolve_slots(resource, context_name, origin, self.nodes)
+            op = _EntryOp(
+                resource=resource,
+                ts=self.clock.now_ms() if ts is None else ts,
+                acquire=acquire,
+                rows=rows,
+                slots=slots,
+                prio=prio,
+            )
+            self._entries.append(op)
+        return op
+
+    def submit_exit(
+        self,
+        rows: Tuple[int, int, int, int],
+        rt: int,
+        count: int = 1,
+        err: int = 0,
+        ts: Optional[int] = None,
+    ) -> None:
+        """StatisticSlot.exit: success + RT + thread release (+exception)."""
+        op = _ExitOp(
+            ts=self.clock.now_ms() if ts is None else ts,
+            rows=rows,
+            count=count,
+            rt=min(int(rt), config.statistic_max_rt),
+            err=err,
+            thr=-1,
+        )
+        with self._lock:
+            self._exits.append(op)
+
+    def submit_trace(
+        self, rows: Tuple[int, int, int, int], count: int = 1, ts: Optional[int] = None
+    ) -> None:
+        """Tracer-style direct exception recording (no thread/success)."""
+        op = _ExitOp(
+            ts=self.clock.now_ms() if ts is None else ts,
+            rows=rows,
+            count=0,
+            rt=0,
+            err=count,
+            thr=0,
+        )
+        with self._lock:
+            self._exits.append(op)
+
+    # ------------------------------------------------------------------
+    # flushing
+    # ------------------------------------------------------------------
+    # Rebase when less than ~2 days of int32-ms headroom remain.
+    REBASE_HEADROOM_MS = 2 * 24 * 3600 * 1000
+
+    def _maybe_rebase(self) -> None:
+        """Shift the relative-ms epoch forward before int32 overflow.
+
+        Device timestamps are int32 ms since the clock epoch (see
+        utils/clock.py); after ~22 days the epoch is re-anchored and all
+        stored window starts / shaping timestamps shift accordingly.
+        Runs under the engine lock from flush().
+        """
+        clock = self.clock
+        if not isinstance(clock, SystemClock):
+            return
+        if clock.rebase_headroom_ms() > self.REBASE_HEADROOM_MS:
+            return
+        offset = clock.rebase()
+        if offset <= 0:
+            return
+
+        def shift_ws(ws, floor):
+            return jnp.maximum(ws - jnp.int32(offset), jnp.int32(floor))
+
+        self.stats = StatsState(
+            second=self.stats.second._replace(
+                window_start=shift_ws(self.stats.second.window_start, SECOND_CFG.empty_ws)
+            ),
+            minute=self.stats.minute._replace(
+                window_start=shift_ws(self.stats.minute.window_start, MINUTE_CFG.empty_ws)
+            ),
+            threads=self.stats.threads,
+        )
+        self.flow_dyn = self.flow_dyn._replace(
+            latest_passed_time=shift_ws(self.flow_dyn.latest_passed_time, -(10**9)),
+            last_filled_time=shift_ws(self.flow_dyn.last_filled_time, -(10**9)),
+        )
+        for op in self._entries:
+            op.ts = max(op.ts - offset, 0)
+        for op in self._exits:
+            op.ts = max(op.ts - offset, 0)
+
+    def _ensure_capacity(self) -> None:
+        need = len(self.nodes)
+        if need > self.stats.n_rows:
+            self.stats = grow_stats(self.stats, _pad_pow2(need))
+
+    def flush(self) -> List[_EntryOp]:
+        """Encode + run the kernel for all pending ops; fills verdicts."""
+        with self._lock:
+            self._maybe_rebase()
+            entries, self._entries = self._entries, []
+            exits, self._exits = self._exits, []
+            if not entries and not exits:
+                return []
+            self._ensure_capacity()
+
+            n = _pad_pow2(len(entries), 8)
+            m = _pad_pow2(len(exits), 8)
+            k = _pad_pow2(max(1, max((len(op.slots) for op in entries), default=1)), 1)
+
+            e_valid = np.zeros(n, dtype=bool)
+            e_ts = np.zeros(n, dtype=np.int32)
+            e_acquire = np.ones(n, dtype=np.int32)
+            e_rows = np.full((n, 4), -1, dtype=np.int32)
+            e_gid = np.full((n, k), -1, dtype=np.int32)
+            e_crow = np.full((n, k), -1, dtype=np.int32)
+            e_prio = np.zeros(n, dtype=bool)
+            for i, op in enumerate(entries):
+                e_valid[i] = True
+                e_ts[i] = op.ts
+                e_acquire[i] = op.acquire
+                e_rows[i] = op.rows
+                for j, (gid, crow) in enumerate(op.slots[:k]):
+                    e_gid[i, j] = gid
+                    e_crow[i, j] = crow
+                e_prio[i] = op.prio
+
+            x_valid = np.zeros(m, dtype=bool)
+            x_ts = np.zeros(m, dtype=np.int32)
+            x_count = np.zeros(m, dtype=np.int32)
+            x_rows = np.full((m, 4), -1, dtype=np.int32)
+            x_rt = np.zeros(m, dtype=np.int32)
+            x_err = np.zeros(m, dtype=np.int32)
+            x_thr = np.zeros(m, dtype=np.int32)
+            for i, op in enumerate(exits):
+                x_valid[i] = True
+                x_ts[i] = op.ts
+                x_count[i] = op.count
+                x_rows[i] = op.rows
+                x_rt[i] = op.rt
+                x_err[i] = op.err
+                x_thr[i] = op.thr
+
+            batch = FlushBatch(
+                now=jnp.int32(self.clock.now_ms()),
+                e_valid=jnp.asarray(e_valid),
+                e_ts=jnp.asarray(e_ts),
+                e_acquire=jnp.asarray(e_acquire),
+                e_rows=jnp.asarray(e_rows),
+                e_rule_gid=jnp.asarray(e_gid),
+                e_check_row=jnp.asarray(e_crow),
+                e_prio=jnp.asarray(e_prio),
+                x_valid=jnp.asarray(x_valid),
+                x_ts=jnp.asarray(x_ts),
+                x_count=jnp.asarray(x_count),
+                x_rows=jnp.asarray(x_rows),
+                x_rt=jnp.asarray(x_rt),
+                x_err=jnp.asarray(x_err),
+                x_thr=jnp.asarray(x_thr),
+            )
+
+            self.stats, self.flow_dyn, result = flush_step_jit(
+                self.stats, self.flow_index.device, self.flow_dyn, batch
+            )
+
+            # One batched device->host fetch (each separate fetch costs a
+            # full round-trip on remote-tunnel backends).
+            admitted, reason, slot_ok, wait_ms = jax.device_get(
+                (result.admitted, result.reason, result.slot_ok, result.wait_ms)
+            )
+            for i, op in enumerate(entries):
+                blocked_rule = None
+                if not admitted[i]:
+                    for j, (gid, _) in enumerate(op.slots[:k]):
+                        if not slot_ok[i, j]:
+                            blocked_rule = self.flow_index.rule_of_gid(gid)
+                            break
+                op.verdict = Verdict(
+                    admitted=bool(admitted[i]),
+                    reason=int(reason[i]),
+                    wait_ms=int(wait_ms[i]),
+                    blocked_rule=blocked_rule,
+                )
+            return entries
+
+    def entry_sync(
+        self,
+        resource: str,
+        context_name: str = C.CONTEXT_DEFAULT_NAME,
+        origin: str = "",
+        acquire: int = 1,
+        entry_type: C.EntryType = C.EntryType.OUT,
+        prio: bool = False,
+    ) -> Tuple[Optional[_EntryOp], Verdict]:
+        """Submit + flush: synchronous SphU.entry semantics."""
+        op = self.submit_entry(resource, context_name, origin, acquire, entry_type, prio)
+        if op is None:
+            return None, Verdict(True, E.PASS, 0, None)  # over cap: pass-through
+        self.flush()
+        assert op.verdict is not None
+        return op, op.verdict
+
+    # ------------------------------------------------------------------
+    # reads (command/metric plane; used heavily by tests)
+    # ------------------------------------------------------------------
+    def _row_stats(self, row: int, now: Optional[int] = None) -> Dict[str, float]:
+        now_i = jnp.int32(self.clock.now_ms() if now is None else now)
+        sec = np.asarray(ma.window_sums(SECOND_CFG, self.stats.second, now_i)[row])
+        minute = np.asarray(ma.window_sums(MINUTE_CFG, self.stats.minute, now_i)[row])
+        min_rt = int(np.asarray(ma.window_min_rt(SECOND_CFG, self.stats.second, now_i)[row]))
+        threads = int(np.asarray(self.stats.threads[row]))
+        interval_sec = SECOND_CFG.interval_ms / 1000.0
+        success = int(sec[MetricEvent.SUCCESS])
+        rt_sum = int(sec[MetricEvent.RT])
+        return {
+            "pass_qps": sec[MetricEvent.PASS] / interval_sec,
+            "block_qps": sec[MetricEvent.BLOCK] / interval_sec,
+            "success_qps": success / interval_sec,
+            "exception_qps": sec[MetricEvent.EXCEPTION] / interval_sec,
+            "occupied_pass_qps": sec[MetricEvent.OCCUPIED_PASS] / interval_sec,
+            # StatisticNode.avgRt: rt sum / success count (0-safe).
+            "avg_rt": (rt_sum / success) if success > 0 else 0.0,
+            "min_rt": min_rt,
+            "cur_thread_num": threads,
+            "total_pass_minute": int(minute[MetricEvent.PASS]),
+            "total_block_minute": int(minute[MetricEvent.BLOCK]),
+            "total_success_minute": int(minute[MetricEvent.SUCCESS]),
+            "total_exception_minute": int(minute[MetricEvent.EXCEPTION]),
+        }
+
+    def cluster_node_stats(self, resource: str, flush: bool = True) -> Optional[Dict[str, float]]:
+        if flush:
+            self.flush()
+        row = self.nodes.lookup_cluster_row(resource)
+        if row is None:
+            return None
+        return self._row_stats(row)
+
+    def entry_node_stats(self, flush: bool = True) -> Dict[str, float]:
+        if flush:
+            self.flush()
+        return self._row_stats(self.nodes.entry_node_row)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._exits.clear()
+            self.nodes.clear()
+            self.stats = make_stats(self.stats.n_rows)
+            self.flow_index = FlowIndex([], cold_factor=config.cold_factor)
+            self.flow_dyn = self.flow_index.make_dyn_state()

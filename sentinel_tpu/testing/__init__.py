@@ -1,0 +1,4 @@
+"""Test support: the sequential oracle re-deriving the reference's
+request-at-a-time semantics in plain Python, used as the parity yardstick
+for the batched TPU kernels (BASELINE.md: the baseline for this build is
+pass/block parity vs the reference's DefaultController/LeapArray)."""

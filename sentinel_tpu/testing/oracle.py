@@ -1,0 +1,162 @@
+"""Sequential oracle: the reference's hot-path semantics, one request at
+a time, in plain Python.
+
+This is a *re-derivation from the documented semantics* of LeapArray
+(reference: slots/statistic/base/LeapArray.java:41-222), MetricBucket
+(data/MetricBucket.java), StatisticNode (node/StatisticNode.java:90-112)
+and the traffic controllers (controller/DefaultController.java:44-79,
+RateLimiterController.java:28-90, WarmUpController.java:64-130) — used
+only in tests, to check that the batched kernels make the same
+pass/block decisions the reference would.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
+
+
+class OracleBucket:
+    __slots__ = ("window_start", "counts", "min_rt")
+
+    def __init__(self, window_start: int, max_rt: int) -> None:
+        self.window_start = window_start
+        self.counts = [0] * NUM_EVENTS
+        self.min_rt = max_rt
+
+
+class OracleLeapArray:
+    """LeapArray semantics: idx = (t/windowLen)%n, ws = t - t%windowLen,
+    lazy reset of deprecated buckets, reads skip deprecated buckets."""
+
+    def __init__(self, sample_count: int, interval_ms: int, max_rt: int = 4900) -> None:
+        self.sample_count = sample_count
+        self.interval_ms = interval_ms
+        self.window_len = interval_ms // sample_count
+        self.max_rt = max_rt
+        self.buckets: List[Optional[OracleBucket]] = [None] * sample_count
+
+    def current_bucket(self, t: int) -> OracleBucket:
+        idx = (t // self.window_len) % self.sample_count
+        ws = t - t % self.window_len
+        b = self.buckets[idx]
+        if b is None or b.window_start < ws:
+            b = OracleBucket(ws, self.max_rt)
+            self.buckets[idx] = b
+        # b.window_start > ws (clock drift backwards) keeps the newer
+        # bucket, matching the reset-to-newer CAS loop outcome.
+        return b
+
+    def _deprecated(self, t: int, b: OracleBucket) -> bool:
+        return t - b.window_start > self.interval_ms
+
+    def values(self, t: int) -> List[int]:
+        out = [0] * NUM_EVENTS
+        for b in self.buckets:
+            if b is None or self._deprecated(t, b):
+                continue
+            for e in range(NUM_EVENTS):
+                out[e] += b.counts[e]
+        return out
+
+    def min_rt_value(self, t: int) -> int:
+        out = self.max_rt
+        for b in self.buckets:
+            if b is None or self._deprecated(t, b):
+                continue
+            out = min(out, b.min_rt)
+        return out
+
+    def add(self, t: int, event: MetricEvent, count: int) -> None:
+        self.current_bucket(t).counts[event] += count
+
+    def add_rt(self, t: int, rt: int) -> None:
+        b = self.current_bucket(t)
+        b.counts[MetricEvent.RT] += rt
+        if rt < b.min_rt:
+            b.min_rt = rt
+
+
+class OracleNode:
+    """StatisticNode: 1 s window (2×500 ms), 60 s window (60×1 s), thread gauge."""
+
+    def __init__(self) -> None:
+        self.second = OracleLeapArray(2, 1000)
+        self.minute = OracleLeapArray(60, 60000)
+        self.cur_thread_num = 0
+
+    def pass_qps(self, t: int) -> float:
+        return self.second.values(t)[MetricEvent.PASS] / (self.second.interval_ms / 1000.0)
+
+    def block_qps(self, t: int) -> float:
+        return self.second.values(t)[MetricEvent.BLOCK] / (self.second.interval_ms / 1000.0)
+
+    def success_qps(self, t: int) -> float:
+        return self.second.values(t)[MetricEvent.SUCCESS] / (self.second.interval_ms / 1000.0)
+
+    def add_pass(self, t: int, count: int) -> None:
+        self.second.add(t, MetricEvent.PASS, count)
+        self.minute.add(t, MetricEvent.PASS, count)
+
+    def add_block(self, t: int, count: int) -> None:
+        self.second.add(t, MetricEvent.BLOCK, count)
+        self.minute.add(t, MetricEvent.BLOCK, count)
+
+    def add_rt_and_success(self, t: int, rt: int, count: int) -> None:
+        self.second.add(t, MetricEvent.SUCCESS, count)
+        self.second.add_rt(t, rt)
+        self.minute.add(t, MetricEvent.SUCCESS, count)
+        self.minute.add_rt(t, rt)
+
+
+class OracleDefaultController:
+    """DefaultController.canPass (DefaultController.java:49-79)."""
+
+    def __init__(self, count: float, grade: int) -> None:
+        self.count = count
+        self.grade = grade  # 0 thread, 1 qps
+
+    def can_pass(self, node: OracleNode, t: int, acquire: int = 1) -> bool:
+        if self.grade == 1:
+            cur = int(node.pass_qps(t))
+        else:
+            cur = node.cur_thread_num
+        return cur + acquire <= self.count
+
+
+class OracleFlowEngine:
+    """Single-resource sequential engine: rules with DIRECT/default only.
+
+    Mirrors the StatisticSlot ordering: check first, then account
+    pass/block on the cluster node.
+    """
+
+    def __init__(self) -> None:
+        self.nodes: Dict[str, OracleNode] = {}
+        self.rules: Dict[str, List[OracleDefaultController]] = {}
+
+    def node(self, resource: str) -> OracleNode:
+        return self.nodes.setdefault(resource, OracleNode())
+
+    def set_qps_rule(self, resource: str, count: float) -> None:
+        self.rules.setdefault(resource, []).append(OracleDefaultController(count, 1))
+
+    def set_thread_rule(self, resource: str, count: float) -> None:
+        self.rules.setdefault(resource, []).append(OracleDefaultController(count, 0))
+
+    def entry(self, resource: str, t: int, acquire: int = 1) -> bool:
+        node = self.node(resource)
+        for ctl in self.rules.get(resource, ()):
+            if not ctl.can_pass(node, t, acquire):
+                node.add_block(t, acquire)
+                return False
+        node.add_pass(t, acquire)
+        node.cur_thread_num += 1
+        return True
+
+    def exit(self, resource: str, t: int, rt: int, acquire: int = 1) -> None:
+        node = self.node(resource)
+        node.add_rt_and_success(t, rt, acquire)
+        node.cur_thread_num -= 1

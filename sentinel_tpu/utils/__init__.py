@@ -1,0 +1,25 @@
+"""Runtime substrate: clock, config, plugin registry, interning, record log.
+
+Equivalent layer to the reference's CORE/util, CORE/config, CORE/spi,
+CORE/log packages (reference: sentinel-core/.../util/TimeUtil.java:42,
+config/SentinelConfig.java:54, spi/SpiLoader.java:73, log/RecordLog.java).
+"""
+
+from sentinel_tpu.utils.clock import Clock, SystemClock, ManualClock, default_clock
+from sentinel_tpu.utils.config import SentinelConfig, config
+from sentinel_tpu.utils.registry import Registry, provider
+from sentinel_tpu.utils.interner import Interner
+from sentinel_tpu.utils.record_log import record_log
+
+__all__ = [
+    "Clock",
+    "SystemClock",
+    "ManualClock",
+    "default_clock",
+    "SentinelConfig",
+    "config",
+    "Registry",
+    "provider",
+    "Interner",
+    "record_log",
+]

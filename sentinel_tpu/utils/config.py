@@ -1,0 +1,166 @@
+"""Layered configuration.
+
+Mirrors the reference's config stack (reference: sentinel-core/.../config/
+SentinelConfigLoader.java:38-59 — JVM ``-Dcsp.sentinel.*`` > properties
+file > defaults; SentinelConfig.java:54-65 for the key set). Here the
+layers are: runtime ``set()`` > environment ``SENTINEL_TPU_*`` (or the
+reference-compatible ``CSP_SENTINEL_*``) > properties file > defaults.
+
+The properties file path comes from ``SENTINEL_TPU_CONFIG_FILE`` /
+``CSP_SENTINEL_CONFIG_FILE`` (reference: SentinelConfigLoader.java:41) or
+defaults to ``./sentinel.properties`` if present.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+
+def _parse_properties(text: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or line.startswith("!"):
+            continue
+        for sep in ("=", ":"):
+            if sep in line:
+                k, _, v = line.partition(sep)
+                out[k.strip()] = v.strip()
+                break
+    return out
+
+
+class SentinelConfig:
+    """Key registry + typed accessors.
+
+    Key names keep the reference's ``csp.sentinel.*`` spelling
+    (reference: SentinelConfig.java:54-65) so existing property files
+    carry over.
+    """
+
+    APP_NAME = "project.name"
+    APP_TYPE = "csp.sentinel.app.type"
+    CHARSET = "csp.sentinel.charset"
+    SINGLE_METRIC_FILE_SIZE = "csp.sentinel.metric.file.single.size"
+    TOTAL_METRIC_FILE_COUNT = "csp.sentinel.metric.file.total.count"
+    COLD_FACTOR = "csp.sentinel.flow.cold.factor"
+    STATISTIC_MAX_RT = "csp.sentinel.statistic.max.rt"
+    SPI_CLASSLOADER = "csp.sentinel.spi.classloader"
+    METRIC_FLUSH_INTERVAL = "csp.sentinel.metric.flush.interval"
+    # TPU-native keys (no reference equivalent).
+    FLUSH_INTERVAL_MS = "sentinel.tpu.flush.interval.ms"
+    FLUSH_MAX_BATCH = "sentinel.tpu.flush.max.batch"
+    INITIAL_ROWS = "sentinel.tpu.rows.initial"
+    LOG_DIR = "csp.sentinel.log.dir"
+
+    DEFAULTS: Dict[str, str] = {
+        APP_TYPE: "0",
+        CHARSET: "utf-8",
+        SINGLE_METRIC_FILE_SIZE: str(1024 * 1024 * 50),
+        TOTAL_METRIC_FILE_COUNT: "6",
+        COLD_FACTOR: "3",
+        STATISTIC_MAX_RT: "4900",  # reference: SentinelConfig.java DEFAULT_STATISTIC_MAX_RT
+        METRIC_FLUSH_INTERVAL: "1",
+        FLUSH_INTERVAL_MS: "2",
+        FLUSH_MAX_BATCH: "131072",
+        INITIAL_ROWS: "1024",
+    }
+
+    def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:
+        self._lock = threading.RLock()
+        self._runtime: Dict[str, str] = {}
+        self._file: Dict[str, str] = {}
+        self._env: Dict[str, str] = {}
+        if load_env:
+            self._load_file(config_file)
+            self._load_env()
+
+    def _load_file(self, config_file: Optional[str]) -> None:
+        path = (
+            config_file
+            or os.environ.get("SENTINEL_TPU_CONFIG_FILE")
+            or os.environ.get("CSP_SENTINEL_CONFIG_FILE")
+            or "sentinel.properties"
+        )
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                self._file = _parse_properties(f.read())
+        except OSError:
+            self._file = {}
+
+    def _load_env(self) -> None:
+        # Accept each key upper-cased with dots as underscores — both the
+        # exact form (CSP_SENTINEL_FLOW_COLD_FACTOR, PROJECT_NAME) and a
+        # SENTINEL_TPU_-prefixed form for keys not already namespaced.
+        for key in list(self.DEFAULTS) + [self.APP_NAME, self.LOG_DIR]:
+            env_key = key.replace(".", "_").upper()
+            candidates = [env_key]
+            if not env_key.startswith(("CSP_", "SENTINEL_TPU_")):
+                candidates.append("SENTINEL_TPU_" + env_key)
+            for cand in candidates:
+                v = os.environ.get(cand)
+                if v is not None:
+                    self._env[key] = v
+                    break
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        with self._lock:
+            for layer in (self._runtime, self._env, self._file):
+                if key in layer:
+                    return layer[key]
+        if key in self.DEFAULTS:
+            return self.DEFAULTS[key]
+        return default
+
+    def set(self, key: str, value: str) -> None:
+        with self._lock:
+            self._runtime[key] = str(value)
+
+    def set_if_absent(self, key: str, value: str) -> None:
+        with self._lock:
+            if self.get(key) is None:
+                self._runtime[key] = str(value)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self.get(key)
+        try:
+            return int(v) if v is not None else default
+        except ValueError:
+            return default
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self.get(key)
+        try:
+            return float(v) if v is not None else default
+        except ValueError:
+            return default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key)
+        if v is None:
+            return default
+        return v.strip().lower() in ("1", "true", "yes", "on")
+
+    # --- commonly used typed views (reference: SentinelConfig.java) ---
+    @property
+    def app_name(self) -> str:
+        return self.get(self.APP_NAME) or "sentinel-tpu-app"
+
+    @property
+    def cold_factor(self) -> int:
+        # Reference clamps coldFactor <= 1 back to 3 (SentinelConfig#coldFactor).
+        v = self.get_int(self.COLD_FACTOR, 3)
+        return 3 if v <= 1 else v
+
+    @property
+    def statistic_max_rt(self) -> int:
+        return self.get_int(self.STATISTIC_MAX_RT, 4900)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._runtime.clear()
+
+
+config = SentinelConfig()

@@ -1,0 +1,76 @@
+"""Internal record log.
+
+Equivalent of the reference's RecordLog (reference: sentinel-core/.../log/
+RecordLog.java) with the SLF4J bridge role played by the stdlib
+``logging`` module (reference: sentinel-logging/sentinel-logging-slf4j —
+the Logger SPI there maps to handlers here). Files land under
+``$SENTINEL_TPU_LOG_DIR`` or ``~/logs/csp/`` like the reference's
+``${user.home}/logs/csp``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from logging.handlers import RotatingFileHandler
+
+_lock = threading.Lock()
+_configured = False
+
+
+def _log_dir() -> str:
+    from sentinel_tpu.utils.config import config
+
+    d = config.get(config.LOG_DIR) or os.environ.get("SENTINEL_TPU_LOG_DIR")
+    if not d:
+        d = os.path.join(os.path.expanduser("~"), "logs", "csp")
+    return d
+
+
+def _configure() -> logging.Logger:
+    global _configured
+    logger = logging.getLogger("sentinel_tpu.record")
+    with _lock:
+        if _configured:
+            return logger
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+        try:
+            d = _log_dir()
+            os.makedirs(d, exist_ok=True)
+            handler: logging.Handler = RotatingFileHandler(
+                os.path.join(d, "sentinel-tpu-record.log"),
+                maxBytes=50 * 1024 * 1024,
+                backupCount=3,
+                encoding="utf-8",
+            )
+        except OSError:
+            handler = logging.NullHandler()
+        handler.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(message)s"))
+        logger.addHandler(handler)
+        _configured = True
+    return logger
+
+
+class _RecordLog:
+    """API shape of RecordLog.info/warn/error(fmt, *args)."""
+
+    @property
+    def _logger(self) -> logging.Logger:
+        return _configure()
+
+    def info(self, msg: str, *args: object) -> None:
+        self._logger.info(msg, *args)
+
+    def warn(self, msg: str, *args: object) -> None:
+        self._logger.warning(msg, *args)
+
+    def error(self, msg: str, *args: object, exc_info: bool = False) -> None:
+        self._logger.error(msg, *args, exc_info=exc_info)
+
+    def debug(self, msg: str, *args: object) -> None:
+        self._logger.debug(msg, *args)
+
+
+record_log = _RecordLog()

@@ -1,0 +1,8 @@
+"""Framework version.
+
+Mirrors the reference's version constant
+(reference: sentinel-core/.../Constants.java:34, SENTINEL_VERSION = "1.8.4");
+this framework tracks its own versioning.
+"""
+
+__version__ = "0.1.0"

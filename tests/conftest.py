@@ -1,0 +1,47 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh BEFORE jax import, so the
+multi-chip sharding paths compile and run without TPU hardware — the
+in-process analog of the reference's strategy of testing the cluster
+token service directly in-JVM (SURVEY.md §4)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's site hook may pre-register an accelerator plugin and
+# pin jax_platforms before env vars are read; force CPU explicitly.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def manual_clock():
+    """The fake-clock fixture — equivalent of the reference's
+    AbstractTimeBasedTest (PowerMock-mocked TimeUtil). Installs a
+    ManualClock as the process default, resets the global engine to use
+    it, and restores afterwards."""
+    from sentinel_tpu.core import api
+    from sentinel_tpu.utils.clock import ManualClock, set_default_clock
+
+    clock = ManualClock(start_ms=0)
+    prev = set_default_clock(clock)
+    api.reset(clock=clock)
+    yield clock
+    set_default_clock(prev)
+    api.reset()
+
+
+@pytest.fixture()
+def engine(manual_clock):
+    from sentinel_tpu.core import api
+
+    return api.get_engine()

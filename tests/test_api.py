@@ -1,0 +1,152 @@
+"""Public API tests: entry/exit/trace, context, statistics accounting —
+mirroring the reference's CtSphTest / StatisticSlot behaviors."""
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core.context import ContextUtil
+from sentinel_tpu.models import constants as C
+
+
+class TestEntryExit:
+    def test_entry_without_rules_passes(self, manual_clock, engine):
+        e = st.entry("free")
+        assert e.resource == "free"
+        e.exit()
+
+    def test_context_manager_and_stats(self, manual_clock, engine):
+        manual_clock.set_ms(0)
+        with st.entry("resA") as e:
+            manual_clock.advance(25)  # RT = 25ms
+        stats = engine.cluster_node_stats("resA")
+        assert stats["pass_qps"] == 1
+        assert stats["success_qps"] == 1
+        assert stats["avg_rt"] == 25
+        assert stats["min_rt"] == 25
+        assert stats["cur_thread_num"] == 0
+
+    def test_double_exit_is_noop(self, manual_clock, engine):
+        e = st.entry("dbl")
+        e.exit()
+        e.exit()
+        stats = engine.cluster_node_stats("dbl")
+        assert stats["success_qps"] == 1
+
+    def test_trace_records_exception_at_exit(self, manual_clock, engine):
+        with pytest.raises(ValueError):
+            with st.entry("exc"):
+                raise ValueError("biz error")
+        stats = engine.cluster_node_stats("exc")
+        assert stats["exception_qps"] == 1
+        assert stats["success_qps"] == 1  # success still counted (Java: rt+success recorded, plus exception)
+
+    def test_manual_trace(self, manual_clock, engine):
+        e = st.entry("exc2")
+        st.trace(RuntimeError("x"))
+        e.exit()
+        stats = engine.cluster_node_stats("exc2")
+        assert stats["exception_qps"] == 1
+
+    def test_block_error_not_traced(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("blk", count=0)])
+        with pytest.raises(st.BlockError):
+            st.entry("blk")
+        stats = engine.cluster_node_stats("blk")
+        assert stats["block_qps"] == 1
+        assert stats["exception_qps"] == 0
+        assert stats["cur_thread_num"] == 0
+
+    def test_entry_async_detached(self, manual_clock, engine):
+        e = st.entry_async("async-res")
+        assert ContextUtil.get_context() is None or e not in (
+            ContextUtil.get_context().entry_stack
+        )
+        e.exit()
+        stats = engine.cluster_node_stats("async-res")
+        assert stats["success_qps"] == 1
+
+
+class TestContext:
+    def test_named_context_and_origin(self, manual_clock, engine):
+        ctx = st.context_enter("api-gateway", origin="caller-1")
+        assert ctx.name == "api-gateway"
+        with st.entry("downstream"):
+            pass
+        st.context_exit()
+        assert ContextUtil.get_context() is None
+
+    def test_default_context_forbidden(self, manual_clock, engine):
+        with pytest.raises(ValueError):
+            st.context_enter(C.CONTEXT_DEFAULT_NAME)
+
+    def test_nested_entries_stack(self, manual_clock, engine):
+        ctx = st.context_enter("chain")
+        e1 = st.entry("outer")
+        e2 = st.entry("inner")
+        assert ctx.cur_entry is e2
+        e2.exit()
+        assert ctx.cur_entry is e1
+        e1.exit()
+        st.context_exit()
+
+
+class TestEntryNode:
+    def test_inbound_counted_globally(self, manual_clock, engine):
+        with st.entry("in1", entry_type=C.EntryType.IN):
+            pass
+        with st.entry("out1", entry_type=C.EntryType.OUT):
+            pass
+        g = engine.entry_node_stats()
+        assert g["pass_qps"] == 1  # only the IN entry
+
+    def test_origin_rows_tracked(self, manual_clock, engine):
+        st.context_enter("up", origin="svc-a")
+        with st.entry("shared", entry_type=C.EntryType.IN):
+            pass
+        st.context_exit()
+        row = engine.nodes.origin_row("shared", "svc-a")
+        assert row is not None
+        assert engine._row_stats(row)["pass_qps"] == 1
+
+
+class TestLimitAppRouting:
+    def test_origin_specific_rule(self, manual_clock, engine):
+        """A rule with limit_app=caller1 throttles only caller1."""
+        st.flow_rule_manager.load_rules(
+            [st.FlowRule("api", count=1, limit_app="caller1")]
+        )
+        # caller1 limited to 1
+        st.context_enter("c1", origin="caller1")
+        e = st.try_entry("api")
+        assert e is not None
+        assert st.try_entry("api") is None
+        e.exit()
+        st.context_exit()
+        # caller2 unlimited (no matching rule)
+        st.context_enter("c2", origin="caller2")
+        for _ in range(5):
+            e = st.try_entry("api")
+            assert e is not None
+            e.exit()
+        st.context_exit()
+
+    def test_other_rule(self, manual_clock, engine):
+        """limit_app=other applies to origins not named by any rule."""
+        st.flow_rule_manager.load_rules(
+            [
+                st.FlowRule("api", count=100, limit_app="vip"),
+                st.FlowRule("api", count=1, limit_app=C.LIMIT_APP_OTHER),
+            ]
+        )
+        st.context_enter("cv", origin="vip")
+        for _ in range(3):
+            e = st.try_entry("api")
+            assert e is not None
+            e.exit()
+        st.context_exit()
+        st.context_enter("cx", origin="rando")
+        e = st.try_entry("api")
+        assert e is not None
+        e.exit()
+        assert st.try_entry("api") is None
+        st.context_exit()

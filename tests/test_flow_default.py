@@ -1,0 +1,148 @@
+"""DefaultController flow-rule parity tests.
+
+The acceptance bar from BASELINE.md: pass/block parity with the
+reference's DefaultController — exercised here as (a) the FlowQpsDemo
+scenario (QPS=20 rule pins passes at 20/s under open-loop load,
+reference: sentinel-demo-basic FlowQpsDemo / README.md:108-118), (b)
+thread-grade concurrency limiting, and (c) randomized batched-mode
+parity against the sequential oracle."""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.testing.oracle import OracleFlowEngine
+
+
+class TestFlowQpsDemo:
+    def test_qps_rule_pins_pass_rate(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("demo", count=20)])
+        passes = blocks = 0
+        # Open-loop load: 100 requests/second for 5 seconds.
+        for sec in range(5):
+            sec_pass = 0
+            for i in range(100):
+                manual_clock.set_ms(sec * 1000 + i * 10)
+                try:
+                    e = st.entry("demo")
+                    e.exit()
+                    passes += 1
+                    sec_pass += 1
+                except st.FlowBlockError:
+                    blocks += 1
+            assert sec_pass == 20, f"second {sec}: expected 20 passes, got {sec_pass}"
+        assert passes == 100
+        assert blocks == 400
+
+    def test_window_slide_refills(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("res", count=2)])
+        manual_clock.set_ms(0)
+        assert st.try_entry("res") is not None
+        assert st.try_entry("res") is not None
+        assert st.try_entry("res") is None  # 2 + 1 > 2
+        # The t=0 bucket is deprecated when its age EXCEEDS the interval
+        # (strict >, LeapArray#isWindowDeprecated): at exactly t=1000 it
+        # still counts; at t=1001 it no longer does.
+        manual_clock.set_ms(1000)
+        assert st.try_entry("res") is None
+        manual_clock.set_ms(1001)
+        assert st.try_entry("res") is not None
+
+    def test_blocked_rule_attribution(self, manual_clock, engine):
+        rule = st.FlowRule("attrib", count=0)
+        st.flow_rule_manager.load_rules([rule])
+        with pytest.raises(st.FlowBlockError) as ei:
+            st.entry("attrib")
+        assert ei.value.rule == rule
+        assert ei.value.resource == "attrib"
+
+
+class TestThreadGrade:
+    def test_concurrency_limit(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules(
+            [st.FlowRule("svc", grade=C.FLOW_GRADE_THREAD, count=2)]
+        )
+        e1 = st.try_entry("svc")
+        e2 = st.try_entry("svc")
+        assert e1 is not None and e2 is not None
+        assert st.try_entry("svc") is None  # 2 running + 1 > 2
+        e1.exit()
+        manual_clock.advance(1)
+        e3 = st.try_entry("svc")
+        assert e3 is not None
+        e2.exit()
+        e3.exit()
+
+    def test_thread_gauge_reads(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules(
+            [st.FlowRule("g", grade=C.FLOW_GRADE_THREAD, count=10)]
+        )
+        entries = [st.try_entry("g") for _ in range(3)]
+        stats = engine.cluster_node_stats("g")
+        assert stats["cur_thread_num"] == 3
+        for e in entries:
+            e.exit()
+        stats = engine.cluster_node_stats("g")
+        assert stats["cur_thread_num"] == 0
+
+
+class TestMultiRuleSameNode:
+    def test_two_rules_same_node_admit_min(self, manual_clock, engine):
+        """Two default QPS rules on one resource: the tighter one governs
+        and an entry must NOT charge its own acquire to itself (regression:
+        second rule-slot on the same node once saw the entry's own
+        contribution, under-admitting by one)."""
+        st.flow_rule_manager.load_rules(
+            [st.FlowRule("r", count=10), st.FlowRule("r", count=7)]
+        )
+        ops = [engine.submit_entry("r", ts=0) for _ in range(10)]
+        engine.flush()
+        admitted = [op.verdict.admitted for op in ops]
+        assert sum(admitted) == 7
+        assert admitted == [True] * 7 + [False] * 3
+
+
+class TestBatchedParity:
+    @pytest.mark.parametrize("seed", [0, 7])
+    def test_deferred_batch_matches_oracle(self, manual_clock, engine, seed):
+        """Many entries submitted then flushed once must produce exactly
+        the sequential oracle's pass/block pattern (uniform acquire,
+        single rule per resource — the exact-parity regime)."""
+        rng = np.random.default_rng(seed)
+        st.flow_rule_manager.load_rules(
+            [st.FlowRule("A", count=10), st.FlowRule("B", count=3)]
+        )
+        oracle = OracleFlowEngine()
+        oracle.set_qps_rule("A", 10)
+        oracle.set_qps_rule("B", 3)
+
+        resources = rng.choice(["A", "B"], 80)
+        ts = np.sort(rng.integers(0, 400, 80))  # all within bucket [0,500)
+        manual_clock.set_ms(int(ts[-1]))
+
+        ops = [
+            engine.submit_entry(res, ts=int(t), entry_type=C.EntryType.IN)
+            for res, t in zip(resources, ts)
+        ]
+        engine.flush()
+        got = [op.verdict.admitted for op in ops]
+        want = [oracle.entry(res, int(t)) for res, t in zip(resources, ts)]
+        assert got == want
+
+    def test_sync_stream_matches_oracle_across_windows(self, manual_clock, engine):
+        """Sync (per-entry flush) stream over several windows."""
+        st.flow_rule_manager.load_rules([st.FlowRule("S", count=5)])
+        oracle = OracleFlowEngine()
+        oracle.set_qps_rule("S", 5)
+        rng = np.random.default_rng(3)
+        t = 0
+        for _ in range(300):
+            t += int(rng.choice([1, 5, 30, 120], p=[0.4, 0.3, 0.2, 0.1]))
+            manual_clock.set_ms(t)
+            got = st.try_entry("S")
+            want = oracle.entry("S", t)
+            assert (got is not None) == want, f"t={t}"
+            if got is not None:
+                got.exit()
+                oracle.exit("S", t, 0)

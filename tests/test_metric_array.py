@@ -1,0 +1,129 @@
+"""MetricArray semantics tests — the equivalent of the reference's
+LeapArrayTest (window rollover, bucket reuse, deprecated-window reset)
+plus randomized batch-vs-sequential-oracle parity."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sentinel_tpu.metrics import (
+    MetricArrayConfig,
+    MetricEvent,
+    NUM_EVENTS,
+    make_state,
+    update,
+    window_min_rt,
+    window_sums,
+)
+from sentinel_tpu.testing.oracle import OracleLeapArray
+
+CFG = MetricArrayConfig(sample_count=2, interval_ms=1000)
+
+
+def _upd(state, rows, ts, event, counts, rt=None):
+    rows = jnp.asarray(rows, dtype=jnp.int32)
+    ts = jnp.asarray(ts, dtype=jnp.int32)
+    n = rows.shape[0]
+    deltas = jnp.zeros((n, NUM_EVENTS), dtype=jnp.int32).at[:, event].set(
+        jnp.asarray(counts, dtype=jnp.int32)
+    )
+    rt_arr = None if rt is None else jnp.asarray(rt, dtype=jnp.int32)
+    return update(CFG, state, rows, ts, deltas, rt_arr)
+
+
+def _pass_sum(state, now, row=0):
+    return int(window_sums(CFG, state, jnp.int32(now))[row, MetricEvent.PASS])
+
+
+class TestWindowBasics:
+    def test_single_window_accumulates(self):
+        s = make_state(4, CFG)
+        s = _upd(s, [0, 0, 0], [0, 100, 499], MetricEvent.PASS, [1, 2, 3])
+        assert _pass_sum(s, 499) == 6
+
+    def test_two_buckets_within_interval(self):
+        s = make_state(4, CFG)
+        s = _upd(s, [0, 0], [0, 600], MetricEvent.PASS, [1, 10])
+        # at t=900 both buckets valid
+        assert _pass_sum(s, 900) == 11
+
+    def test_old_bucket_deprecated_on_read(self):
+        s = make_state(4, CFG)
+        s = _upd(s, [0], [0], MetricEvent.PASS, [5])
+        # At t=1400, bucket [0,500) is 1400ms old > 1000 -> deprecated.
+        assert _pass_sum(s, 1400) == 0
+        # At t=1000 exactly: age 1000, not > interval -> still counted
+        # (LeapArray#isWindowDeprecated is strict).
+        assert _pass_sum(s, 1000) == 5
+
+    def test_rollover_resets_bucket(self):
+        s = make_state(4, CFG)
+        s = _upd(s, [0], [0], MetricEvent.PASS, [5])  # bucket idx 0, ws 0
+        s = _upd(s, [0], [1000], MetricEvent.PASS, [7])  # idx 0 again, ws 1000
+        # Old ws=0 content must be discarded, not merged.
+        assert _pass_sum(s, 1000) == 7
+
+    def test_stale_entry_in_same_batch_dropped(self):
+        # Two entries a full interval apart in ONE batch hitting the same
+        # slot: sequentially the newer resets the bucket after the older
+        # wrote it, so only the newer survives.
+        s = make_state(4, CFG)
+        s = _upd(s, [0, 0], [0, 1000], MetricEvent.PASS, [5, 7])
+        assert _pass_sum(s, 1000) == 7
+
+    def test_rows_independent(self):
+        s = make_state(4, CFG)
+        s = _upd(s, [0, 1, 2], [0, 0, 0], MetricEvent.PASS, [1, 2, 3])
+        sums = window_sums(CFG, s, jnp.int32(0))
+        assert sums[0, MetricEvent.PASS] == 1
+        assert sums[1, MetricEvent.PASS] == 2
+        assert sums[2, MetricEvent.PASS] == 3
+
+    def test_min_rt_tracking(self):
+        s = make_state(2, CFG)
+        s = _upd(s, [0, 0], [0, 1], MetricEvent.RT, [30, 12], rt=[30, 12])
+        assert int(window_min_rt(CFG, s, jnp.int32(10))[0]) == 12
+        # empty row keeps the max-RT default
+        assert int(window_min_rt(CFG, s, jnp.int32(10))[1]) == CFG.max_rt
+        # after expiry it resets
+        assert int(window_min_rt(CFG, s, jnp.int32(5000))[0]) == CFG.max_rt
+
+    def test_mask_drops_entries(self):
+        s = make_state(2, CFG)
+        rows = jnp.asarray([0, 1], dtype=jnp.int32)
+        ts = jnp.asarray([0, 0], dtype=jnp.int32)
+        deltas = jnp.ones((2, NUM_EVENTS), dtype=jnp.int32)
+        s = update(CFG, s, rows, ts, deltas, mask=jnp.asarray([True, False]))
+        sums = window_sums(CFG, s, jnp.int32(0))
+        assert int(sums[0].sum()) == NUM_EVENTS
+        assert int(sums[1].sum()) == 0
+
+
+class TestOracleParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_randomized_batch_parity(self, seed):
+        """Random (row, ts, count) streams: batched update must match the
+        sequential oracle's window sums at every probe time, for both
+        geometries' shapes of traffic."""
+        rng = np.random.default_rng(seed)
+        n_rows, n_ops = 5, 400
+        rows = rng.integers(0, n_rows, n_ops)
+        # Nondecreasing timestamps with occasional big jumps.
+        ts = np.cumsum(rng.choice([0, 1, 3, 40, 700], n_ops, p=[0.3, 0.4, 0.2, 0.08, 0.02]))
+        counts = rng.integers(1, 5, n_ops)
+
+        oracles = [OracleLeapArray(2, 1000) for _ in range(n_rows)]
+        for r, t, c in zip(rows, ts, counts):
+            oracles[r].add(int(t), MetricEvent.PASS, int(c))
+
+        s = make_state(n_rows, CFG)
+        # Apply in flush-sized chunks (mixed-window batches included).
+        for lo in range(0, n_ops, 64):
+            hi = min(lo + 64, n_ops)
+            s = _upd(s, rows[lo:hi], ts[lo:hi], MetricEvent.PASS, counts[lo:hi])
+
+        now = int(ts[-1])
+        got = window_sums(CFG, s, jnp.int32(now))
+        for r in range(n_rows):
+            want = oracles[r].values(now)[MetricEvent.PASS]
+            assert int(got[r, MetricEvent.PASS]) == want, f"row {r}"

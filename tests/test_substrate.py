@@ -1,0 +1,132 @@
+"""Substrate tests: clock, config, registry (SPI), interner, property."""
+
+import pytest
+
+from sentinel_tpu.core.property import DynamicSentinelProperty, FuncListener
+from sentinel_tpu.utils.clock import ManualClock, SystemClock
+from sentinel_tpu.utils.config import SentinelConfig
+from sentinel_tpu.utils.interner import Interner, PairInterner
+from sentinel_tpu.utils.registry import Registry, provider
+
+
+class TestClock:
+    def test_manual_clock(self):
+        c = ManualClock(start_ms=100)
+        assert c.now_ms() == 100
+        c.advance(50)
+        assert c.now_ms() == 150
+        c.sleep_ms(10)
+        assert c.now_ms() == 160
+        assert c.wall_ms() == c.epoch_wall_ms + 160
+
+    def test_system_clock_monotone(self):
+        c = SystemClock()
+        a = c.now_ms()
+        b = c.now_ms()
+        assert b >= a >= 0
+        assert c.rebase_headroom_ms() > 0
+
+    def test_system_clock_rebase(self):
+        c = SystemClock()
+        wall_before = c.wall_ms()
+        off = c.rebase()
+        assert off >= 0
+        assert c.now_ms() <= 1
+        assert abs(c.wall_ms() - wall_before) <= 50
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = SentinelConfig(load_env=False)
+        assert cfg.cold_factor == 3
+        assert cfg.statistic_max_rt == 4900
+        assert cfg.get_int(SentinelConfig.TOTAL_METRIC_FILE_COUNT) == 6
+
+    def test_layering_and_types(self):
+        cfg = SentinelConfig(load_env=False)
+        cfg.set(SentinelConfig.COLD_FACTOR, "5")
+        assert cfg.cold_factor == 5
+        cfg.set(SentinelConfig.COLD_FACTOR, "1")  # clamped back to 3
+        assert cfg.cold_factor == 3
+        cfg.set("x.bool", "true")
+        assert cfg.get_bool("x.bool") is True
+        assert cfg.get_float("missing", 1.5) == 1.5
+
+    def test_env_layer(self, monkeypatch):
+        monkeypatch.setenv("CSP_SENTINEL_FLOW_COLD_FACTOR", "5")
+        monkeypatch.setenv("SENTINEL_TPU_FLUSH_MAX_BATCH", "999")
+        cfg = SentinelConfig()
+        assert cfg.cold_factor == 5
+        assert cfg.get_int(SentinelConfig.FLUSH_MAX_BATCH) == 999
+
+    def test_properties_file(self, tmp_path):
+        f = tmp_path / "sentinel.properties"
+        f.write_text("project.name=my-app\n# comment\ncsp.sentinel.flow.cold.factor: 7\n")
+        cfg = SentinelConfig(config_file=str(f))
+        assert cfg.app_name == "my-app"
+        assert cfg.cold_factor == 7
+
+
+class TestRegistry:
+    def test_order_and_default(self):
+        class Iface:
+            pass
+
+        @provider(Iface, order=10)
+        class B:
+            pass
+
+        @provider(Iface, order=-10)
+        class A:
+            pass
+
+        @provider(Iface, order=50, default=True)
+        class D:
+            pass
+
+        insts = Registry.of(Iface).load_instance_list_sorted()
+        assert [type(i).__name__ for i in insts] == ["A", "B", "D"]
+        assert type(Registry.of(Iface).load_highest_priority_instance()).__name__ == "A"
+        assert type(Registry.of(Iface).load_default()).__name__ == "D"
+
+    def test_singleton_semantics(self):
+        reg = Registry("test.singleton")
+
+        class X:
+            pass
+
+        reg.register(X, name="x", singleton=True)
+        assert reg.load_by_name("x") is reg.load_by_name("x")
+        reg2 = Registry("test.proto")
+        reg2.register(X, name="x", singleton=False)
+        assert reg2.load_by_name("x") is not reg2.load_by_name("x")
+
+
+class TestInterner:
+    def test_dense_ids_and_cap(self):
+        it = Interner(capacity=2)
+        assert it.intern("a") == 0
+        assert it.intern("b") == 1
+        assert it.intern("a") == 0
+        assert it.intern("c") is None  # over cap -> pass-through signal
+        assert it.name_of(1) == "b"
+        assert len(it) == 2
+
+    def test_pair_interner(self):
+        it = PairInterner()
+        assert it.intern(1, 2) == 0
+        assert it.intern(1, 3) == 1
+        assert it.intern(1, 2) == 0
+        assert it.pair_of(1) == (1, 3)
+
+
+class TestProperty:
+    def test_listener_fires_on_change_only(self):
+        prop = DynamicSentinelProperty()
+        seen = []
+        prop.add_listener(FuncListener(seen.append))
+        assert seen == [None]  # config_load on registration
+        assert prop.update_value([1, 2]) is True
+        assert prop.update_value([1, 2]) is False  # unchanged -> no fan-out
+        assert prop.update_value([3]) is True
+        assert seen == [None, [1, 2], [3]]
