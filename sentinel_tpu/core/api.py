@@ -183,6 +183,11 @@ def _do_entry(
         if ctx.auto and not ctx.entry_stack:
             ContextUtil.exit()
         return None, verdict
+    if verdict.wait_ms > 0:
+        # Rate-limiter queued pass: the reference sleeps inside
+        # canPass (RateLimiterController.java:80); here the wait
+        # surfaces after the batched decision.
+        engine.clock.sleep_ms(verdict.wait_ms)
     e = Entry(resource, op.rows, ctx if with_context else None, op.ts, acquire)
     if with_context:
         ctx.entry_stack.append(e)

@@ -10,10 +10,8 @@ from __future__ import annotations
 
 from typing import List
 
-from sentinel_tpu.models import constants as C
 from sentinel_tpu.models.rules import FlowRule
 from sentinel_tpu.rules.manager_base import RuleManager
-from sentinel_tpu.utils.record_log import record_log
 
 
 class FlowRuleManager(RuleManager[FlowRule]):
@@ -22,16 +20,6 @@ class FlowRuleManager(RuleManager[FlowRule]):
     def _apply(self, rules: List[FlowRule]) -> None:
         from sentinel_tpu.core.api import get_engine
 
-        for r in rules:
-            if r.control_behavior != C.CONTROL_BEHAVIOR_DEFAULT:
-                # Rate-limiter / warm-up shaping ships in the controllers
-                # milestone; until then these degrade to DEFAULT checking.
-                record_log.warn(
-                    "[FlowRuleManager] control_behavior=%d not yet enforced for %s; "
-                    "treating as DEFAULT",
-                    r.control_behavior,
-                    r.resource,
-                )
         get_engine().set_flow_rules(rules)
 
     def is_other_origin(self, origin: str, resource: str) -> bool:

@@ -40,10 +40,14 @@ class FlowTableDevice(NamedTuple):
     count: jax.Array  # float32 [NR] threshold
     behavior: jax.Array  # int32 [NR] CONTROL_BEHAVIOR_*
     max_queueing_time_ms: jax.Array  # int32 [NR] (rate limiter)
+    cost1_ms: jax.Array  # int32 [NR] host-precomputed round(1000/count) — the
+    # acquire==1 rate-limiter cost in exact float64 (Java Math.round of a
+    # double; device floats are f32, so the common case is computed on host)
     warmup_warning_token: jax.Array  # int32 [NR] (warm up)
     warmup_max_token: jax.Array  # int32 [NR]
     warmup_slope: jax.Array  # float32 [NR]
-    warmup_count: jax.Array  # float32 [NR] (rule count for warm-up math)
+    warmup_refill_threshold: jax.Array  # int32 [NR] (int)count / coldFactor
+    # (integer division, the refill gate in WarmUpController.coolDownTokens)
 
     @property
     def n_rules(self) -> int:
@@ -113,6 +117,11 @@ class FlowIndex:
         self.max_rules_per_resource = max((len(v) for v in self.by_resource.values()), default=0)
         self.cold_factor = cold_factor
         self.device = self._build_device()
+        self.shaping_gids = {
+            cr.gid
+            for cr in self.rules
+            if cr.rule.control_behavior != C.CONTROL_BEHAVIOR_DEFAULT
+        }
 
     def _build_device(self) -> FlowTableDevice:
         n = _pad_pow2(len(self.rules))
@@ -120,43 +129,60 @@ class FlowIndex:
         count = [float("inf")] * n  # padding threshold: always pass
         behavior = [C.CONTROL_BEHAVIOR_DEFAULT] * n
         maxq = [0] * n
+        cost1 = [0] * n
         w_warn = [0] * n
         w_max = [0] * n
         w_slope = [0.0] * n
-        w_count = [0.0] * n
+        w_refill = [0] * n
+        self.has_shaping = False
         for cr in self.rules:
             r = cr.rule
             grade[cr.gid] = r.grade
             count[cr.gid] = float(r.count)
             behavior[cr.gid] = r.control_behavior
             maxq[cr.gid] = int(r.max_queueing_time_ms)
+            if r.control_behavior != C.CONTROL_BEHAVIOR_DEFAULT:
+                self.has_shaping = True
+            if r.count > 0:
+                # Java Math.round(1.0 * 1 / count * 1000) in float64
+                # (Math.round is floor(x + 0.5), not round-half-even;
+                # int() truncates = floor for positives).
+                cost1[cr.gid] = int(1.0 / r.count * 1000 + 0.5)
             if r.control_behavior in (
                 C.CONTROL_BEHAVIOR_WARM_UP,
                 C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER,
             ):
                 # Guava SmoothWarmingUp-derived constants, computed exactly
                 # as the reference does (WarmUpController.construct,
-                # reference: controller/WarmUpController.java:64-107):
-                #   warningToken = (warmupPeriodSec * count) / (coldFactor - 1)
-                #   maxToken = warningToken + 2*warmupPeriodSec*count/(1+coldFactor)
+                # reference: controller/WarmUpController.java:84-107):
+                #   warningToken = (int)(warmupSec * count) / (coldFactor-1)
+                #     [int cast of the product, then INTEGER division]
+                #   maxToken = warningToken + (int)(2*warmupSec*count/(1+coldFactor))
                 #   slope = (coldFactor - 1) / count / (maxToken - warningToken)
                 cf = self.cold_factor
-                warning = int(r.warm_up_period_sec * r.count / (cf - 1))
+                warning = int(r.warm_up_period_sec * r.count) // (cf - 1)
                 max_tok = warning + int(2 * r.warm_up_period_sec * r.count / (1.0 + cf))
-                slope = (cf - 1.0) / r.count / max(1, (max_tok - warning)) if r.count > 0 else 0.0
+                slope = (
+                    (cf - 1.0) / r.count / (max_tok - warning)
+                    if r.count > 0 and max_tok > warning
+                    else 0.0
+                )
                 w_warn[cr.gid] = warning
                 w_max[cr.gid] = max_tok
                 w_slope[cr.gid] = slope
-                w_count[cr.gid] = float(r.count)
+                # coolDownTokens refill gate: passQps < (int)count / coldFactor
+                # ((int) binds to count; then integer division).
+                w_refill[cr.gid] = int(r.count) // cf
         return FlowTableDevice(
             grade=jnp.array(grade, dtype=jnp.int32),
             count=jnp.array(count, dtype=jnp.float32),
             behavior=jnp.array(behavior, dtype=jnp.int32),
             max_queueing_time_ms=jnp.array(maxq, dtype=jnp.int32),
+            cost1_ms=jnp.array(cost1, dtype=jnp.int32),
             warmup_warning_token=jnp.array(w_warn, dtype=jnp.int32),
             warmup_max_token=jnp.array(w_max, dtype=jnp.int32),
             warmup_slope=jnp.array(w_slope, dtype=jnp.float32),
-            warmup_count=jnp.array(w_count, dtype=jnp.float32),
+            warmup_refill_threshold=jnp.array(w_refill, dtype=jnp.int32),
         )
 
     def make_dyn_state(self, prev: Optional[FlowRuleDynState] = None) -> FlowRuleDynState:

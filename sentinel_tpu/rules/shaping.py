@@ -1,0 +1,218 @@
+"""Traffic-shaping controllers: RateLimiter, WarmUp, WarmUpRateLimiter.
+
+These three behaviors carry *per-rule mutable state* across requests —
+``latestPassedTime`` for the leaky-bucket pacer (reference: controller/
+RateLimiterController.java:28-90), ``storedTokens``/``lastFilledTime``
+for the Guava-style warm-up ramp (reference: controller/
+WarmUpController.java:84-175, WarmUpRateLimiterController.java:25-90) —
+which makes them a *recurrence* over each rule's request sequence, not a
+stateless threshold like DefaultController.
+
+Batched execution: shaping slots (a tiny minority of traffic in
+practice) are gathered into their own compact array, sorted by
+``(rule, ts, entry)``, and resolved by ONE ``lax.scan`` whose carry is
+the current rule's shaping state; segment boundaries reload from /
+write back to the per-rule state columns (FlowRuleDynState). The scan
+reproduces the reference's per-request logic step for step — including
+the per-second token re-fill (syncToken) — so it is exact even when a
+batch spans multiple seconds. The vectorized DEFAULT path never pays
+for this: when no shaping rules are loaded the scan is skipped
+entirely.
+
+Numerics: Java computes in float64; the scan uses float32 for the
+warm-up slope math (divergence only possible exactly at a threshold
+boundary for extreme rule counts) and host-precomputed exact int
+``cost1_ms`` for the ubiquitous acquire==1 rate-limiter case. Java's
+``latestPassedTime``/``lastFilledTime`` start effectively "infinitely
+past" because wall-clock ms are huge; with the engine's relative clock
+the same effect comes from the -1e9 initialisation in
+FlowIndex.make_dyn_state.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.rules.flow_table import FlowRuleDynState, FlowTableDevice
+
+
+class ShapingBatch(NamedTuple):
+    """Compact per-slot arrays for shaping-controlled rule slots.
+
+    ``flat_pos`` indexes back into the [N*K] flattened slot matrix so
+    verdicts/waits can be scattered into the main result.
+    """
+
+    valid: jax.Array  # bool [S]
+    gid: jax.Array  # int32 [S] rule id
+    row: jax.Array  # int32 [S] check-node row
+    eidx: jax.Array  # int32 [S] entry index
+    flat_pos: jax.Array  # int32 [S] position in the [N*K] slot matrix
+    ts: jax.Array  # int32 [S]
+    acquire: jax.Array  # int32 [S]
+
+
+class _Carry(NamedTuple):
+    gid: jax.Array  # int32 — rule whose state is loaded
+    latest: jax.Array  # int32 — latestPassedTime
+    stored: jax.Array  # float32 — storedTokens
+    lastfill: jax.Array  # int32 — lastFilledTime (second-aligned)
+
+
+def run_shaping(
+    flow_dev: FlowTableDevice,
+    flow_dyn: FlowRuleDynState,
+    shaping: ShapingBatch,
+    pass_consumed: jax.Array,  # int32 [S] — windowed pass sum + intra-batch charge
+    prev_pass: jax.Array,  # int32 [S] — previous 1s-bucket pass count (minute array)
+    interval_sec: float,
+) -> Tuple[FlowRuleDynState, jax.Array, jax.Array]:
+    """Evaluate shaping slots; returns (new_dyn, ok [S], wait_ms [S])
+    in the *sorted* order it establishes internally — results are
+    scattered back via shaping.flat_pos by the caller.
+
+    The three behaviors (reference files in module docstring):
+
+    * RATE_LIMITER — pace requests ``cost = round(acquire/count*1000)``
+      ms apart; queue up to ``max_queueing_time_ms``, else block.
+    * WARM_UP — token bucket from cold: above the warning line the
+      admitted QPS is ``1/(aboveToken*slope + 1/count)``; refill happens
+      once per second, consuming the previous second's pass count.
+    * WARM_UP_RATE_LIMITER — the pacer with the warm-up-adjusted cost.
+    """
+    s = shaping.valid.shape[0]
+    nr = flow_dev.n_rules
+
+    # Sort by (gid, ts, eidx); invalid slots sort last (gid = nr).
+    gid_key = jnp.where(shaping.valid, shaping.gid, jnp.int32(nr))
+    pos = jnp.arange(s, dtype=jnp.int32)
+    gid_s, ts_s, ei_s, p_s = jax.lax.sort(
+        (gid_key, shaping.ts, shaping.eidx, pos), num_keys=3
+    )
+    gid_c = jnp.clip(gid_s, 0, nr - 1)
+    valid_s = shaping.valid[p_s]
+    acq_s = shaping.acquire[p_s].astype(jnp.float32)
+    acq_i = shaping.acquire[p_s]
+    passq_s = jnp.floor(pass_consumed[p_s].astype(jnp.float32) / interval_sec)
+    prevq_s = prev_pass[p_s].astype(jnp.float32)
+
+    beh = flow_dev.behavior[gid_c]
+    count = flow_dev.count[gid_c]
+    maxq = flow_dev.max_queueing_time_ms[gid_c]
+    cost1 = flow_dev.cost1_ms[gid_c]
+    warn = flow_dev.warmup_warning_token[gid_c].astype(jnp.float32)
+    maxtok = flow_dev.warmup_max_token[gid_c].astype(jnp.float32)
+    slope = flow_dev.warmup_slope[gid_c]
+    refill_thr = flow_dev.warmup_refill_threshold[gid_c].astype(jnp.float32)
+
+    def step(carry: _Carry, x):
+        (g, valid, ts, acq_f, acq, passq, prevq, b, cnt, mq, c1, wn, mx, sl, rt) = x
+        new_seg = g != carry.gid
+        latest = jnp.where(new_seg, flow_dyn.latest_passed_time[g], carry.latest)
+        stored = jnp.where(new_seg, flow_dyn.stored_tokens[g], carry.stored)
+        lastfill = jnp.where(new_seg, flow_dyn.last_filled_time[g], carry.lastfill)
+
+        is_wu = (b == C.CONTROL_BEHAVIOR_WARM_UP) | (
+            b == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER
+        )
+
+        # --- syncToken (WarmUpController.syncToken/coolDownTokens) ---
+        sec = ts - ts % 1000
+        do_sync = is_wu & (sec > lastfill) & valid
+        elapsed = (sec - lastfill).astype(jnp.float32)
+        refill_ok = (stored < wn) | ((stored > wn) & (prevq < rt))
+        refilled = jnp.minimum(jnp.floor(stored + elapsed * cnt / 1000.0), mx)
+        stored1 = jnp.where(do_sync & refill_ok, refilled, stored)
+        stored2 = jnp.where(do_sync, jnp.maximum(stored1 - prevq, 0.0), stored1)
+        lastfill2 = jnp.where(do_sync, sec, lastfill)
+
+        # --- warm-up admitted-QPS (above the warning line) ---
+        above = jnp.maximum(stored2 - wn, 0.0)
+        inv = above * sl + 1.0 / jnp.maximum(cnt, 1e-9)
+        # Math.nextUp on the Java double; nextafter on f32 here.
+        warning_qps = jnp.nextafter(1.0 / inv, jnp.float32(jnp.inf))
+        cold = stored2 >= wn
+
+        wu_ok = jnp.where(cold, passq + acq_f <= warning_qps, passq + acq_f <= cnt)
+
+        # --- pacer cost (RateLimiter / WarmUpRateLimiter) ---
+        cost_generic = jnp.floor(acq_f / jnp.maximum(cnt, 1e-9) * 1000.0 + 0.5)
+        cost_rl = jnp.where(acq == 1, c1.astype(jnp.float32), cost_generic)
+        cost_wurl_cold = jnp.floor(acq_f / warning_qps * 1000.0 + 0.5)
+        cost_wurl = jnp.where(cold, cost_wurl_cold, cost_rl)
+        cost = jnp.where(
+            b == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER, cost_wurl, cost_rl
+        ).astype(jnp.int32)
+
+        expected = latest + cost
+        imm = expected <= ts
+        wait = expected - ts
+        queued = (~imm) & (wait <= mq)
+        pacer_ok = (imm | queued) & (cnt > 0)
+        pacer_ok = pacer_ok | (acq <= 0)  # acquire<=0 always passes
+        latest2 = jnp.where(
+            valid & pacer_ok & (acq > 0), jnp.where(imm, ts, latest + cost), latest
+        )
+        wait_out = jnp.where(queued & pacer_ok, wait, 0)
+
+        is_pacer = (b == C.CONTROL_BEHAVIOR_RATE_LIMITER) | (
+            b == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER
+        )
+        ok = jnp.where(
+            b == C.CONTROL_BEHAVIOR_WARM_UP,
+            wu_ok,
+            jnp.where(is_pacer, pacer_ok, True),
+        )
+        ok = ok | ~valid
+        wait_out = jnp.where(valid & is_pacer, wait_out, 0)
+
+        # Pacer state only advances for pacer behaviors; warm-up state
+        # only via sync. Invalid slots must not touch the carry.
+        latest3 = jnp.where(valid & is_pacer, latest2, latest)
+        new_carry = _Carry(
+            gid=jnp.where(valid, g, carry.gid),
+            latest=jnp.where(valid, latest3, carry.latest),
+            stored=jnp.where(valid, stored2, carry.stored),
+            lastfill=jnp.where(valid, lastfill2, carry.lastfill),
+        )
+        # But a new segment must load fresh state even when this
+        # particular slot is invalid — invalid slots all sort to the
+        # tail, so an invalid slot never precedes a valid one; the
+        # simple form above is safe.
+        return new_carry, (ok, wait_out, latest3, stored2, lastfill2)
+
+    init = _Carry(
+        gid=jnp.int32(-1),
+        latest=jnp.int32(0),
+        stored=jnp.float32(0.0),
+        lastfill=jnp.int32(0),
+    )
+    xs = (
+        gid_c, valid_s, ts_s, acq_s, acq_i, passq_s, prevq_s,
+        beh, count, maxq, cost1, warn, maxtok, slope, refill_thr,
+    )
+    _, (ok_s, wait_s, latest_s, stored_s, lastfill_s) = jax.lax.scan(step, init, xs)
+
+    # Write final per-rule state back at segment ends (last write wins).
+    seg_end = jnp.concatenate(
+        [gid_s[1:] != gid_s[:-1], jnp.ones((1,), dtype=bool)]
+    ) & valid_s
+    scatter_gid = jnp.where(seg_end, gid_c, jnp.int32(nr))  # nr -> dropped
+    new_dyn = FlowRuleDynState(
+        latest_passed_time=flow_dyn.latest_passed_time.at[scatter_gid].set(
+            latest_s, mode="drop"
+        ),
+        stored_tokens=flow_dyn.stored_tokens.at[scatter_gid].set(stored_s, mode="drop"),
+        last_filled_time=flow_dyn.last_filled_time.at[scatter_gid].set(
+            lastfill_s, mode="drop"
+        ),
+    )
+
+    # Un-sort results back to the caller's slot order.
+    ok_out = jnp.ones((s,), dtype=bool).at[p_s].set(ok_s)
+    wait_out = jnp.zeros((s,), dtype=jnp.int32).at[p_s].set(wait_s)
+    return new_dyn, ok_out, wait_out

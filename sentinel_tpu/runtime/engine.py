@@ -43,7 +43,12 @@ from sentinel_tpu.metrics.nodes import (
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.models.rules import FlowRule
 from sentinel_tpu.rules.flow_table import FlowIndex, FlowRuleDynState
-from sentinel_tpu.runtime.flush import FlushBatch, flush_step_jit
+from sentinel_tpu.rules.shaping import ShapingBatch
+from sentinel_tpu.runtime.flush import (
+    FlushBatch,
+    flush_step_jit,
+    flush_step_shaping_jit,
+)
 from sentinel_tpu.utils.clock import Clock, SystemClock, default_clock
 from sentinel_tpu.utils.config import config
 from sentinel_tpu.utils.numeric import pad_pow2 as _pad_pow2
@@ -303,9 +308,15 @@ class Engine:
                 x_thr=jnp.asarray(x_thr),
             )
 
-            self.stats, self.flow_dyn, result = flush_step_jit(
-                self.stats, self.flow_index.device, self.flow_dyn, batch
-            )
+            shaping = self._encode_shaping(entries, k)
+            if shaping is None:
+                self.stats, self.flow_dyn, result = flush_step_jit(
+                    self.stats, self.flow_index.device, self.flow_dyn, batch
+                )
+            else:
+                self.stats, self.flow_dyn, result = flush_step_shaping_jit(
+                    self.stats, self.flow_index.device, self.flow_dyn, batch, shaping
+                )
 
             # One batched device->host fetch (each separate fetch costs a
             # full round-trip on remote-tunnel backends).
@@ -326,6 +337,46 @@ class Engine:
                     blocked_rule=blocked_rule,
                 )
             return entries
+
+    def _encode_shaping(self, entries: List[_EntryOp], k: int) -> Optional[ShapingBatch]:
+        """Gather (entry, slot) pairs governed by shaping controllers
+        into the compact arrays the lax.scan path consumes. None when the
+        batch touches no shaping rules (the fast path)."""
+        sg = self.flow_index.shaping_gids
+        if not sg:
+            return None
+        items = []
+        for i, op in enumerate(entries):
+            for j, (gid, crow) in enumerate(op.slots[:k]):
+                if gid in sg:
+                    items.append((i * k + j, gid, crow, i, op.ts, op.acquire))
+        if not items:
+            return None
+        s = _pad_pow2(len(items), 8)
+        valid = np.zeros(s, dtype=bool)
+        gid = np.zeros(s, dtype=np.int32)
+        row = np.zeros(s, dtype=np.int32)
+        eidx = np.zeros(s, dtype=np.int32)
+        flat_pos = np.zeros(s, dtype=np.int32)
+        ts = np.zeros(s, dtype=np.int32)
+        acquire = np.ones(s, dtype=np.int32)
+        for a, (fp, g, r, i, t, acq) in enumerate(items):
+            valid[a] = True
+            flat_pos[a] = fp
+            gid[a] = g
+            row[a] = r
+            eidx[a] = i
+            ts[a] = t
+            acquire[a] = acq
+        return ShapingBatch(
+            valid=jnp.asarray(valid),
+            gid=jnp.asarray(gid),
+            row=jnp.asarray(row),
+            eidx=jnp.asarray(eidx),
+            flat_pos=jnp.asarray(flat_pos),
+            ts=jnp.asarray(ts),
+            acquire=jnp.asarray(acquire),
+        )
 
     def entry_sync(
         self,

@@ -38,7 +38,7 @@ is not observable through 500 ms buckets).
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,9 +46,10 @@ import jax.numpy as jnp
 from sentinel_tpu.core import errors as E
 from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
 from sentinel_tpu.metrics import metric_array as ma
-from sentinel_tpu.metrics.nodes import SECOND_CFG, StatsState, apply_updates
+from sentinel_tpu.metrics.nodes import MINUTE_CFG, SECOND_CFG, StatsState, apply_updates
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.rules.flow_table import FlowRuleDynState, FlowTableDevice
+from sentinel_tpu.rules.shaping import ShapingBatch, run_shaping
 
 _I32_MAX = jnp.int32(2**31 - 1)
 
@@ -106,10 +107,15 @@ def flow_admission(
     stats: StatsState,
     flow_dev: FlowTableDevice,
     batch: FlushBatch,
-) -> Tuple[jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Vectorized FlowRuleChecker + DefaultController.
 
-    Returns (slot_ok [N,K] bool, flow_pass [N] bool).
+    Returns (slot_ok [N,K] bool, flow_pass [N] bool,
+    pass_plus_consumed [N*K] int32 — the windowed pass sum plus the
+    intra-batch charge per slot, which the shaping scan reuses as its
+    ``passQps`` input). Slots whose behavior is not
+    CONTROL_BEHAVIOR_DEFAULT are reported as ok here; their verdict is
+    decided by the shaping scan (rules/shaping.py).
     """
     n, k = batch.e_rule_gid.shape
     r_rows = stats.n_rows
@@ -134,6 +140,7 @@ def flow_admission(
     acq_s = batch.e_acquire[ei_s]
     grade_s = flow_dev.grade[gid_s]
     count_s = flow_dev.count[gid_s]
+    behavior_s = flow_dev.behavior[gid_s]
 
     ones = jnp.ones((1,), dtype=bool)
     new_grp = jnp.concatenate([ones, rk_s[1:] != rk_s[:-1]])
@@ -156,11 +163,17 @@ def flow_admission(
 
     # canPass: block iff curCount + acquireCount > count.
     ok = (cur + acq_s.astype(jnp.float32)) <= count_s
-    ok = ok | ~active_s
+    # Non-DEFAULT behaviors are decided by the shaping scan, not here.
+    ok = ok | ~active_s | (behavior_s != C.CONTROL_BEHAVIOR_DEFAULT)
 
     slot_ok = jnp.ones((n * k,), dtype=bool).at[pos_s].set(ok).reshape(n, k)
     flow_pass = slot_ok.all(axis=1)
-    return slot_ok, flow_pass
+    pass_plus_consumed = (
+        jnp.zeros((n * k,), dtype=jnp.int32)
+        .at[pos_s]
+        .set((base_pass + consumed_acq).astype(jnp.int32))
+    )
+    return slot_ok, flow_pass, pass_plus_consumed
 
 
 def _scatter_cols(n: int, **cols: jax.Array) -> jax.Array:
@@ -171,11 +184,27 @@ def _scatter_cols(n: int, **cols: jax.Array) -> jax.Array:
     return out
 
 
+def _prev_second_pass(stats: StatsState, rows: jax.Array, ts: jax.Array) -> jax.Array:
+    """Pass count of the previous 1s bucket of the minute window —
+    ``node.previousPassQps()`` (reference: node/StatisticNode.java:185
+    reads rollingCounterInMinute.previousWindowPass())."""
+    wlen = MINUTE_CFG.window_len_ms  # 1000
+    b = MINUTE_CFG.sample_count
+    tprev = ts - wlen
+    aligned = tprev - tprev % wlen
+    idx = (tprev // wlen) % b
+    rows_c = jnp.clip(rows, 0, stats.n_rows - 1)
+    ws = stats.minute.window_start[rows_c, idx]
+    val = stats.minute.counts[rows_c, idx, MetricEvent.PASS]
+    return jnp.where(ws == aligned, val, 0)
+
+
 def flush_step(
     stats: StatsState,
     flow_dev: FlowTableDevice,
     flow_dyn: FlowRuleDynState,
     batch: FlushBatch,
+    shaping: Optional[ShapingBatch] = None,
 ) -> Tuple[StatsState, FlowRuleDynState, FlushResult]:
     """Pure function: apply one batch. See module docstring for phases."""
     n = batch.e_valid.shape[0]
@@ -197,7 +226,28 @@ def flush_step(
     stats = apply_updates(stats, x_rows_f, x_ts_f, x_deltas, x_rt_sample, x_thr_f, x_mask)
 
     # ---- phase 2: admission (FlowSlot / FlowRuleChecker) ----
-    slot_ok, flow_pass = flow_admission(stats, flow_dev, batch)
+    slot_ok, flow_pass, pass_plus_consumed = flow_admission(stats, flow_dev, batch)
+    wait_ms = jnp.zeros((n,), dtype=jnp.int32)
+    if shaping is not None:
+        # ---- phase 2b: shaping controllers (rate-limiter / warm-up) ----
+        ppc_s = pass_plus_consumed[jnp.clip(shaping.flat_pos, 0, n * shaping_k(batch) - 1)]
+        prev_s = _prev_second_pass(stats, shaping.row, shaping.ts)
+        interval_sec = SECOND_CFG.interval_ms / 1000.0
+        flow_dyn, ok_s, wait_s = run_shaping(
+            flow_dev, flow_dyn, shaping, ppc_s, prev_s, interval_sec
+        )
+        flat_ok = slot_ok.reshape(-1)
+        scatter_pos = jnp.where(
+            shaping.valid, shaping.flat_pos, jnp.int32(flat_ok.shape[0])
+        )
+        # bool .min scatter == logical AND with existing verdicts.
+        flat_ok = flat_ok.at[scatter_pos].min(ok_s, mode="drop")
+        slot_ok = flat_ok.reshape(slot_ok.shape)
+        flow_pass = slot_ok.all(axis=1)
+        eidx_scatter = jnp.where(shaping.valid, shaping.eidx, jnp.int32(n))
+        wait_ms = wait_ms.at[eidx_scatter].max(wait_s, mode="drop")
+        wait_ms = jnp.where(flow_pass, wait_ms, 0)
+
     admitted = batch.e_valid & flow_pass
     reason = jnp.where(
         batch.e_valid & ~flow_pass, jnp.int32(E.BLOCK_FLOW), jnp.int32(E.PASS)
@@ -218,8 +268,11 @@ def flush_step(
         stats, e_rows_f, jnp.repeat(batch.e_ts, 4), e_deltas, None, e_thr, e_mask
     )
 
-    wait_ms = jnp.zeros((n,), dtype=jnp.int32)
     return stats, flow_dyn, FlushResult(admitted=admitted, reason=reason, slot_ok=slot_ok, wait_ms=wait_ms)
+
+
+def shaping_k(batch: FlushBatch) -> int:
+    return batch.e_rule_gid.shape[1]
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -230,3 +283,14 @@ def flush_step_jit(
     batch: FlushBatch,
 ) -> Tuple[StatsState, FlowRuleDynState, FlushResult]:
     return flush_step(stats, flow_dev, flow_dyn, batch)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2))
+def flush_step_shaping_jit(
+    stats: StatsState,
+    flow_dev: FlowTableDevice,
+    flow_dyn: FlowRuleDynState,
+    batch: FlushBatch,
+    shaping: ShapingBatch,
+) -> Tuple[StatsState, FlowRuleDynState, FlushResult]:
+    return flush_step(stats, flow_dev, flow_dyn, batch, shaping)

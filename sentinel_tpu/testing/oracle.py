@@ -13,7 +13,7 @@ pass/block decisions the reference would.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
 
@@ -124,6 +124,95 @@ class OracleDefaultController:
         else:
             cur = node.cur_thread_num
         return cur + acquire <= self.count
+
+
+class OracleRateLimiter:
+    """RateLimiterController.canPass (RateLimiterController.java:46-90),
+    single-threaded (the CAS race branches collapse). ``latest`` starts
+    effectively at -infinity to match wall-clock Java behavior under the
+    engine's relative clock."""
+
+    def __init__(self, count: float, max_queueing_time_ms: int) -> None:
+        self.count = count
+        self.maxq = max_queueing_time_ms
+        self.latest = -(10**9)
+
+    def can_pass(self, t: int, acquire: int = 1):
+        """Returns (ok, wait_ms)."""
+        if acquire <= 0:
+            return True, 0
+        if self.count <= 0:
+            return False, 0
+        cost = int(1.0 * acquire / self.count * 1000 + 0.5)  # Math.round
+        expected = cost + self.latest
+        if expected <= t:
+            self.latest = t
+            return True, 0
+        wait = cost + self.latest - t
+        if wait > self.maxq:
+            return False, 0
+        self.latest += cost
+        wait = self.latest - t
+        if wait > self.maxq:  # single-threaded: cannot trigger, kept for shape
+            self.latest -= cost
+            return False, 0
+        return True, max(wait, 0)
+
+
+class OracleWarmUp:
+    """WarmUpController (WarmUpController.java:84-175)."""
+
+    def __init__(self, count: float, warmup_sec: int, cold_factor: int = 3) -> None:
+        self.count = count
+        self.cold_factor = cold_factor
+        self.warning_token = int(warmup_sec * count) // (cold_factor - 1)
+        self.max_token = self.warning_token + int(2 * warmup_sec * count / (1.0 + cold_factor))
+        self.slope = (
+            (cold_factor - 1.0) / count / (self.max_token - self.warning_token)
+            if count > 0 and self.max_token > self.warning_token
+            else 0.0
+        )
+        self.stored = 0
+        self.last_filled = -(10**9)
+
+    def sync_token(self, t: int, prev_qps: int) -> None:
+        sec = t - t % 1000
+        if sec <= self.last_filled:
+            return
+        old = self.stored
+        new = old
+        if old < self.warning_token:
+            new = int(old + (sec - self.last_filled) * self.count / 1000)
+        elif old > self.warning_token:
+            if prev_qps < int(self.count) // self.cold_factor:
+                new = int(old + (sec - self.last_filled) * self.count / 1000)
+        self.stored = min(new, self.max_token)
+        self.stored = max(self.stored - prev_qps, 0)
+        self.last_filled = sec
+
+    def warning_qps(self) -> float:
+        above = self.stored - self.warning_token
+        return math.nextafter(1.0 / (above * self.slope + 1.0 / self.count), math.inf)
+
+    def can_pass(self, node: "OracleNode", t: int, acquire: int = 1) -> bool:
+        pass_qps = int(node.pass_qps(t))
+        # previousPassQps: the minute array's bucket covering t-1000.
+        prev_qps = self._previous_pass(node, t)
+        self.sync_token(t, prev_qps)
+        if self.stored >= self.warning_token:
+            return pass_qps + acquire <= self.warning_qps()
+        return pass_qps + acquire <= self.count
+
+    @staticmethod
+    def _previous_pass(node: "OracleNode", t: int) -> int:
+        arr = node.minute
+        tprev = t - arr.window_len
+        idx = (tprev // arr.window_len) % arr.sample_count
+        ws = tprev - tprev % arr.window_len
+        b = arr.buckets[idx]
+        if b is None or b.window_start != ws:
+            return 0
+        return b.counts[MetricEvent.PASS]
 
 
 class OracleFlowEngine:

@@ -1,0 +1,177 @@
+"""Traffic-shaping controller parity tests: RateLimiter, WarmUp,
+WarmUpRateLimiter — against the sequential oracle re-derivation of
+RateLimiterController.java / WarmUpController.java semantics."""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.testing.oracle import OracleRateLimiter, OracleWarmUp, OracleNode
+
+
+def rate_rule(resource, count, maxq):
+    return st.FlowRule(
+        resource,
+        count=count,
+        control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+        max_queueing_time_ms=maxq,
+    )
+
+
+class TestRateLimiter:
+    def test_paces_requests(self, manual_clock, engine):
+        """count=10 -> 100ms spacing; queueing up to 500ms."""
+        st.flow_rule_manager.load_rules([rate_rule("paced", 10, 500)])
+        oracle = OracleRateLimiter(10, 500)
+
+        # Burst of 10 at t=0 (sync mode: each entry sleeps its wait on
+        # the manual clock, exactly like the reference's in-check sleep).
+        results = []
+        for _ in range(10):
+            t = manual_clock.now_ms()
+            e = st.try_entry("paced")
+            want_ok, want_wait = oracle.can_pass(t)
+            results.append((e is not None, want_ok))
+            if want_ok and want_wait:
+                # oracle mirrors the sleep the API already performed
+                pass
+            if e is not None:
+                e.exit()
+        got = [g for g, _ in results]
+        want = [w for _, w in results]
+        assert got == want
+        assert all(got[:6])  # first ~6 fit in the 500ms queue
+
+    def test_block_beyond_queue(self, manual_clock, engine):
+        """Deferred batch: all at t=0; only 1 immediate + maxq/cost queued pass."""
+        st.flow_rule_manager.load_rules([rate_rule("q", 10, 300)])  # cost=100
+        ops = [engine.submit_entry("q", ts=0) for _ in range(8)]
+        engine.flush()
+        oracle = OracleRateLimiter(10, 300)
+        want = [oracle.can_pass(0) for _ in range(8)]
+        got = [(op.verdict.admitted, op.verdict.wait_ms) for op in ops]
+        assert got == [(ok, w) for ok, w in want]
+        # 1 immediate + 3 queued (100/200/300ms), rest blocked
+        assert [g[0] for g in got] == [True, True, True, True, False, False, False, False]
+        assert [g[1] for g in got][:4] == [0, 100, 200, 300]
+
+    def test_spaced_stream_matches_oracle(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([rate_rule("s", 5, 1000)])  # cost=200
+        oracle = OracleRateLimiter(5, 1000)
+        rng = np.random.default_rng(1)
+        t = 0
+        for _ in range(60):
+            t += int(rng.choice([10, 50, 150, 400]))
+            manual_clock.set_ms(t)
+            e = st.try_entry("s")
+            want_ok, want_wait = oracle.can_pass(t)
+            assert (e is not None) == want_ok, f"t={t}"
+            if e is not None:
+                # The API slept want_wait on the manual clock; re-sync
+                # our notion of t for the next iteration.
+                t = manual_clock.now_ms()
+                e.exit()
+
+    def test_count_zero_blocks(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([rate_rule("z", 0, 500)])
+        assert st.try_entry("z") is None
+
+
+class TestWarmUp:
+    def _rule(self, resource, count=20, warmup=10):
+        return st.FlowRule(
+            resource,
+            count=count,
+            control_behavior=C.CONTROL_BEHAVIOR_WARM_UP,
+            warm_up_period_sec=warmup,
+        )
+
+    def test_cold_start_limits_qps(self, manual_clock, engine):
+        """count=20, warmup=10s, cf=3: cold warningQps ≈ 6.67 — a burst
+        in the first second admits only 6."""
+        st.flow_rule_manager.load_rules([self._rule("wu")])
+        manual_clock.set_ms(100)
+        ops = [engine.submit_entry("wu", ts=100) for _ in range(20)]
+        engine.flush()
+        admitted = sum(op.verdict.admitted for op in ops)
+        assert admitted == 6
+
+    def test_matches_oracle_over_warmup(self, manual_clock, engine):
+        """Stream spread over several seconds matches the oracle's
+        decisions while tokens cool down."""
+        st.flow_rule_manager.load_rules([self._rule("wo", count=10, warmup=4)])
+        oracle = OracleWarmUp(10, 4, 3)
+        onode = OracleNode()
+        t = 0
+        mismatches = []
+        for step in range(200):
+            t += 37  # prime-ish stride crossing second boundaries
+            manual_clock.set_ms(t)
+            e = st.try_entry("wo")
+            want = oracle.can_pass(onode, t)
+            if want:
+                onode.add_pass(t, 1)
+                onode.cur_thread_num += 1
+            else:
+                onode.add_block(t, 1)
+            if (e is not None) != want:
+                mismatches.append((step, t, e is not None, want))
+            if e is not None:
+                e.exit()
+                onode.add_rt_and_success(t, 0, 1)
+                onode.cur_thread_num -= 1
+        assert not mismatches, mismatches[:5]
+
+    def test_warm_state_allows_full_count(self, manual_clock, engine):
+        """After the warm-up period of sustained traffic, the full count
+        is admitted (tokens below warning line)."""
+        st.flow_rule_manager.load_rules([self._rule("wf", count=10, warmup=2)])
+        oracle = OracleWarmUp(10, 2, 3)
+        onode = OracleNode()
+        # Drive sustained near-limit traffic for several seconds.
+        last_sec_admits = 0
+        for sec in range(8):
+            admits = 0
+            for i in range(12):
+                t = sec * 1000 + i * 80
+                manual_clock.set_ms(t)
+                e = st.try_entry("wf")
+                want = oracle.can_pass(onode, t)
+                if want:
+                    onode.add_pass(t, 1)
+                else:
+                    onode.add_block(t, 1)
+                assert (e is not None) == want, f"t={t}"
+                if e is not None:
+                    admits += 1
+                    e.exit()
+                    onode.add_rt_and_success(t, 0, 1)
+            last_sec_admits = admits
+        assert last_sec_admits >= 9  # warmed up to ~full count
+
+
+class TestWarmUpRateLimiter:
+    def test_cold_pacing_spacing(self, manual_clock, engine):
+        """Cold state paces at the warming QPS (≈6.67 -> ~150ms cost),
+        not the stable rate (100ms)."""
+        st.flow_rule_manager.load_rules(
+            [
+                st.FlowRule(
+                    "wrl",
+                    count=20,
+                    control_behavior=C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER,
+                    warm_up_period_sec=10,
+                    max_queueing_time_ms=2000,
+                )
+            ]
+        )
+        manual_clock.set_ms(50)
+        ops = [engine.submit_entry("wrl", ts=50) for _ in range(4)]
+        engine.flush()
+        waits = [op.verdict.wait_ms for op in ops]
+        assert all(op.verdict.admitted for op in ops)
+        assert waits[0] == 0
+        # Cold warningQps = 1/((200-100)*0.001 + 0.05) = 6.666…;
+        # cost = round(1000/6.666…) = 150ms spacing.
+        assert waits[1:] == [150, 300, 450]
