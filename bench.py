@@ -22,8 +22,10 @@ def main() -> None:
     import jax.numpy as jnp
 
     from sentinel_tpu.metrics.nodes import make_stats
-    from sentinel_tpu.rules.flow_table import FlowIndex, FlowRuleDynState, FlowTableDevice
-    from sentinel_tpu.runtime.flush import flush_step_jit
+    from sentinel_tpu.rules.degrade_table import DegradeIndex
+    from sentinel_tpu.rules.flow_table import FlowRuleDynState, FlowTableDevice
+    from sentinel_tpu.rules.param_table import make_param_state
+    from sentinel_tpu.runtime.flush import SystemDevice, flush_step_jit
     from __graft_entry__ import _example_batch
 
     n_rules = 1 << 20  # ~1M rules / resources
@@ -32,6 +34,18 @@ def main() -> None:
     k = 1
 
     stats = make_stats(n_rows)
+    dindex = DegradeIndex([])
+    ddev, ddyn = dindex.device, dindex.make_dyn_state()
+    inf = float("inf")
+    sysdev = SystemDevice(
+        qps=jnp.float32(inf),
+        max_thread=jnp.float32(inf),
+        max_rt=jnp.float32(inf),
+        load_threshold=jnp.float32(-1.0),
+        cpu_threshold=jnp.float32(-1.0),
+        cur_load=jnp.float32(-1.0),
+        cur_cpu=jnp.float32(-1.0),
+    )
     # Build the device rule table directly (bypasses the Python bean
     # layer, which is not the hot path being measured).
     dev = FlowTableDevice(
@@ -39,10 +53,11 @@ def main() -> None:
         count=jnp.full(n_rules, 20.0, dtype=jnp.float32),
         behavior=jnp.zeros(n_rules, dtype=jnp.int32),
         max_queueing_time_ms=jnp.zeros(n_rules, dtype=jnp.int32),
+        cost1_ms=jnp.full(n_rules, 50, dtype=jnp.int32),
         warmup_warning_token=jnp.zeros(n_rules, dtype=jnp.int32),
         warmup_max_token=jnp.zeros(n_rules, dtype=jnp.int32),
         warmup_slope=jnp.zeros(n_rules, dtype=jnp.float32),
-        warmup_count=jnp.zeros(n_rules, dtype=jnp.float32),
+        warmup_refill_threshold=jnp.zeros(n_rules, dtype=jnp.int32),
     )
     dyn = FlowRuleDynState(
         latest_passed_time=jnp.full(n_rules, -(10**9), dtype=jnp.int32),
@@ -51,14 +66,20 @@ def main() -> None:
     )
     batch = _example_batch(n_entries, n_rows, n_rules, k)
 
+    pdyn = make_param_state(8)
+
     # Warm-up / compile.
-    stats, dyn, result = flush_step_jit(stats, dev, dyn, batch)
+    stats, dyn, ddyn, pdyn, result = flush_step_jit(
+        stats, dev, dyn, ddev, ddyn, pdyn, sysdev, batch
+    )
     jax.block_until_ready(result.admitted)
 
     iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
-        stats, dyn, result = flush_step_jit(stats, dev, dyn, batch)
+        stats, dyn, ddyn, pdyn, result = flush_step_jit(
+            stats, dev, dyn, ddev, ddyn, pdyn, sysdev, batch
+        )
     jax.block_until_ready(result.admitted)
     dt = (time.perf_counter() - t0) / iters
 

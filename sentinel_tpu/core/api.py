@@ -93,6 +93,7 @@ class Entry:
         create_ts: int,
         acquire: int,
         pass_through: bool = False,
+        param_rows: Sequence[int] = (),
     ) -> None:
         self.resource = resource
         self.rows = rows
@@ -102,6 +103,7 @@ class Entry:
         # relative device clock (Engine._maybe_rebase).
         self.create_wall = get_engine().clock.to_wall(create_ts)
         self.acquire = acquire
+        self.param_rows = tuple(param_rows)  # per-value thread gauges to release
         self.error: Optional[BaseException] = None
         self.block_error: Optional[E.BlockError] = None
         self.pass_through = pass_through
@@ -124,7 +126,12 @@ class Entry:
             if self.error is not None and not isinstance(self.error, E.BlockError):
                 err = count if count is not None else self.acquire
             engine.submit_exit(
-                self.rows, rt=rt, count=count if count is not None else self.acquire, err=err
+                self.rows,
+                rt=rt,
+                count=count if count is not None else self.acquire,
+                err=err,
+                resource=self.resource,
+                param_rows=self.param_rows,
             )
         ctx = self.context
         if ctx is not None and ctx.entry_stack and ctx.entry_stack[-1] is self:
@@ -153,6 +160,7 @@ def _do_entry(
     origin: Optional[str],
     prio: bool,
     with_context: bool,
+    args: Sequence[object] = (),
 ) -> Tuple[Optional[Entry], Optional[Verdict]]:
     engine = get_engine()
     ctx = ContextUtil.get_context()
@@ -168,6 +176,7 @@ def _do_entry(
         acquire=acquire,
         entry_type=entry_type,
         prio=prio,
+        args=args,
     )
     if op is None:
         # Above resource cap — pass-through entry with no statistics,
@@ -188,7 +197,14 @@ def _do_entry(
         # canPass (RateLimiterController.java:80); here the wait
         # surfaces after the batched decision.
         engine.clock.sleep_ms(verdict.wait_ms)
-    e = Entry(resource, op.rows, ctx if with_context else None, op.ts, acquire)
+    e = Entry(
+        resource,
+        op.rows,
+        ctx if with_context else None,
+        op.ts,
+        acquire,
+        param_rows=op.param_thread_rows,
+    )
     if with_context:
         ctx.entry_stack.append(e)
     elif ctx.auto and not ctx.entry_stack:
@@ -204,16 +220,28 @@ def entry(
     count: int = 1,
     origin: Optional[str] = None,
     prio: bool = False,
+    args: Sequence[object] = (),
 ) -> Entry:
-    """SphU.entry: returns an Entry or raises a BlockError subclass."""
-    e, verdict = _do_entry(resource, entry_type, count, origin, prio, with_context=True)
+    """SphU.entry: returns an Entry or raises a BlockError subclass.
+
+    ``args`` are the invocation arguments checked by hot-parameter rules
+    (SphU.entry(name, type, count, args...) in the reference).
+    """
+    e, verdict = _do_entry(
+        resource, entry_type, count, origin, prio, with_context=True, args=args
+    )
     if e is None:
         assert verdict is not None
-        rule = verdict.blocked_rule
-        err = E.error_for_code(verdict.reason, resource)
-        err.rule = rule
-        raise err
+        raise _block_error(verdict, resource)
     return e
+
+
+def _block_error(verdict, resource: str) -> E.BlockError:
+    if verdict.reason == E.BLOCK_SYSTEM:
+        return E.SystemBlockError(resource, verdict.limit_type)
+    err = E.error_for_code(verdict.reason, resource)
+    err.rule = verdict.blocked_rule
+    return err
 
 
 def try_entry(
@@ -221,9 +249,12 @@ def try_entry(
     entry_type: C.EntryType = C.EntryType.OUT,
     count: int = 1,
     origin: Optional[str] = None,
+    args: Sequence[object] = (),
 ) -> Optional[Entry]:
     """SphO.entry: boolean-style variant — Entry on pass, None on block."""
-    e, _ = _do_entry(resource, entry_type, count, origin, False, with_context=True)
+    e, _ = _do_entry(
+        resource, entry_type, count, origin, False, with_context=True, args=args
+    )
     return e
 
 
@@ -232,14 +263,15 @@ def entry_async(
     entry_type: C.EntryType = C.EntryType.OUT,
     count: int = 1,
     origin: Optional[str] = None,
+    args: Sequence[object] = (),
 ) -> Entry:
     """SphU.asyncEntry: not pushed on the ambient stack; exit from anywhere."""
-    e, verdict = _do_entry(resource, entry_type, count, origin, False, with_context=False)
+    e, verdict = _do_entry(
+        resource, entry_type, count, origin, False, with_context=False, args=args
+    )
     if e is None:
         assert verdict is not None
-        err = E.error_for_code(verdict.reason, resource)
-        err.rule = verdict.blocked_rule
-        raise err
+        raise _block_error(verdict, resource)
     return e
 
 

@@ -1,0 +1,311 @@
+"""Circuit breakers — the degrade subsystem, batched.
+
+The reference implements two breaker families on a CLOSED/OPEN/HALF_OPEN
+CAS state machine with a per-rule 1-bucket sliding window (reference:
+slots/block/degrade/circuitbreaker/AbstractCircuitBreaker.java:40-150,
+ExceptionCircuitBreaker.java:35-134, ResponseTimeCircuitBreaker.java:34-120,
+DegradeSlot.java:37-90). Here every breaker is one row of SoA columns:
+
+static (DegradeTableDevice):  grade / threshold / slow-ratio /
+    min-request / stat-interval / retry-timeout / max-allowed-RT
+dynamic (DegradeDynState):    state / next-retry / bad / total / window-start
+
+Exit-driven transitions are computed *per prefix*, not per batch total:
+the reference evaluates the threshold after every completed request, and
+an error ratio is not monotone within a bucket (later successes dilute
+it), so the batched kernel computes cumulative (bad, total) at every
+exit in (rule, ts) order and opens the breaker at the FIRST prefix that
+crosses — exactly the sequential outcome — all with cumsum/segment math,
+no scan. Entry-side probing admits exactly one candidate per OPEN
+breaker whose retry timeout arrived (rank 0 in ts order), mirroring
+fromOpenToHalfOpen; the HALF_OPEN transition is applied only if that
+entry is admitted end-to-end, which reproduces the reference's
+``whenTerminate`` revert workaround for probes blocked by later rules.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import DegradeRule
+from sentinel_tpu.utils.numeric import pad_pow2
+from sentinel_tpu.utils.record_log import record_log
+
+# Breaker states (CircuitBreaker.State ordinals).
+CLOSED = 0
+OPEN = 1
+HALF_OPEN = 2
+
+_I32_MAX = 2**31 - 1
+
+
+class DegradeTableDevice(NamedTuple):
+    grade: jax.Array  # int32 [ND]
+    threshold: jax.Array  # float32 [ND] rule count (ratio / count / RT)
+    slow_ratio: jax.Array  # float32 [ND]
+    min_request: jax.Array  # int32 [ND]
+    interval_ms: jax.Array  # int32 [ND] statIntervalMs (per-rule window)
+    retry_ms: jax.Array  # int32 [ND] timeWindow * 1000
+    max_rt: jax.Array  # int32 [ND] Math.round(count) for RT breakers
+
+    @property
+    def n_rules(self) -> int:
+        return self.grade.shape[0]
+
+
+class DegradeDynState(NamedTuple):
+    state: jax.Array  # int32 [ND]
+    next_retry: jax.Array  # int32 [ND]
+    bad: jax.Array  # int32 [ND] slow/error count in current window
+    total: jax.Array  # int32 [ND]
+    ws: jax.Array  # int32 [ND] current window start
+
+
+class DegradeIndex:
+    """Host-side compiled degrade rules (DegradeRuleManager equivalent)."""
+
+    def __init__(self, rules: Sequence[DegradeRule]) -> None:
+        valid = []
+        for r in rules:
+            if r.is_valid():
+                valid.append(r)
+            else:
+                record_log.warn("[DegradeIndex] Ignoring invalid degrade rule: %s", r)
+        self.rules: List[DegradeRule] = valid
+        self.by_resource: Dict[str, List[int]] = {}
+        for gid, r in enumerate(valid):
+            self.by_resource.setdefault(r.resource, []).append(gid)
+        self.max_rules_per_resource = max(
+            (len(v) for v in self.by_resource.values()), default=0
+        )
+        self.device = self._build_device()
+
+    def _build_device(self) -> DegradeTableDevice:
+        n = pad_pow2(len(self.rules), 8)
+        grade = [C.DEGRADE_GRADE_RT] * n
+        thr = [float("inf")] * n
+        slow_ratio = [1.0] * n
+        min_req = [_I32_MAX] * n  # padding never trips
+        interval = [1000] * n
+        retry = [0] * n
+        max_rt = [_I32_MAX] * n
+        for gid, r in enumerate(self.rules):
+            grade[gid] = r.grade
+            thr[gid] = float(r.count)
+            slow_ratio[gid] = float(r.slow_ratio_threshold)
+            min_req[gid] = int(r.min_request_amount)
+            interval[gid] = int(r.stat_interval_ms)
+            retry[gid] = int(r.time_window) * 1000
+            # Java: maxAllowedRt = Math.round(rule.getCount()).
+            max_rt[gid] = int(r.count + 0.5)
+        return DegradeTableDevice(
+            grade=jnp.array(grade, dtype=jnp.int32),
+            threshold=jnp.array(thr, dtype=jnp.float32),
+            slow_ratio=jnp.array(slow_ratio, dtype=jnp.float32),
+            min_request=jnp.array(min_req, dtype=jnp.int32),
+            interval_ms=jnp.array(interval, dtype=jnp.int32),
+            retry_ms=jnp.array(retry, dtype=jnp.int32),
+            max_rt=jnp.array(max_rt, dtype=jnp.int32),
+        )
+
+    def make_dyn_state(self) -> DegradeDynState:
+        n = self.device.n_rules
+        return DegradeDynState(
+            state=jnp.full((n,), CLOSED, dtype=jnp.int32),
+            next_retry=jnp.zeros((n,), dtype=jnp.int32),
+            bad=jnp.zeros((n,), dtype=jnp.int32),
+            total=jnp.zeros((n,), dtype=jnp.int32),
+            ws=jnp.full((n,), -(10**9), dtype=jnp.int32),
+        )
+
+    def gids_for(self, resource: str) -> List[int]:
+        return self.by_resource.get(resource, [])
+
+    def rule_of_gid(self, gid: int):
+        if 0 <= gid < len(self.rules):
+            return self.rules[gid]
+        return None
+
+
+def _segment_cum(new_grp: jax.Array, x: jax.Array) -> jax.Array:
+    """Inclusive per-segment cumulative sum (segments flagged at starts)."""
+    total = jnp.cumsum(x)
+    excl = total - x
+    base = jax.lax.cummax(jnp.where(new_grp, excl, 0))
+    return total - base
+
+
+def breaker_on_exits(
+    ddev: DegradeTableDevice,
+    dyn: DegradeDynState,
+    x_dgid: jax.Array,  # int32 [M, KD] (-1 empty)
+    x_ts: jax.Array,  # int32 [M]
+    x_rt: jax.Array,  # int32 [M]
+    x_err: jax.Array,  # int32 [M] (>0 = business error recorded)
+    x_valid: jax.Array,  # bool [M]
+) -> DegradeDynState:
+    """onRequestComplete for a batch of completions (exit ops)."""
+    m, kd = x_dgid.shape
+    nd = ddev.n_rules
+    gid_f = x_dgid.reshape(-1)
+    eidx = jnp.arange(m * kd, dtype=jnp.int32) // kd
+    valid = (gid_f >= 0) & x_valid[eidx]
+    ts_f = x_ts[eidx]
+    rt_f = x_rt[eidx]
+    err_f = x_err[eidx]
+
+    gid_key = jnp.where(valid, gid_f, jnp.int32(nd))
+    pos = jnp.arange(m * kd, dtype=jnp.int32)
+    gid_s, ts_s, p_s = jax.lax.sort((gid_key, ts_f, pos), num_keys=2)
+    gid_c = jnp.clip(gid_s, 0, nd - 1)
+    valid_s = valid[p_s]
+    rt_s = rt_f[p_s]
+    err_s = err_f[p_s]
+
+    grade = ddev.grade[gid_c]
+    is_rt = grade == C.DEGRADE_GRADE_RT
+    bad_s = jnp.where(is_rt, rt_s > ddev.max_rt[gid_c], err_s > 0) & valid_s
+
+    ones = jnp.ones((1,), dtype=bool)
+    new_grp = jnp.concatenate([ones, gid_s[1:] != gid_s[:-1]])
+
+    # ---- window rollover (per-rule interval, 1 bucket) ----
+    iv = ddev.interval_ms[gid_c]
+    aligned = ts_s - ts_s % jnp.maximum(iv, 1)
+    ws_new = dyn.ws.at[jnp.where(valid_s, gid_c, jnp.int32(nd))].max(aligned, mode="drop")
+    rolled = ws_new > dyn.ws
+    base_bad = jnp.where(rolled, 0, dyn.bad)
+    base_total = jnp.where(rolled, 0, dyn.total)
+    # Exits from a superseded window do not contribute (sequentially the
+    # newer request reset the bucket after them).
+    in_win = valid_s & (aligned == ws_new[gid_c])
+
+    inc = in_win.astype(jnp.int32)
+    bad_inc = (bad_s & in_win).astype(jnp.int32)
+    cum_total = _segment_cum(new_grp, inc)
+    cum_bad = _segment_cum(new_grp, bad_inc)
+
+    g_base_bad = base_bad[gid_c]
+    g_base_total = base_total[gid_c]
+    run_bad = (g_base_bad + cum_bad).astype(jnp.float32)
+    run_total = (g_base_total + cum_total).astype(jnp.float32)
+
+    # ---- CLOSED -> OPEN: first prefix crossing the threshold ----
+    thr = ddev.threshold[gid_c]
+    ratio = run_bad / jnp.maximum(run_total, 1.0)
+    is_exc_ratio = grade == C.DEGRADE_GRADE_EXCEPTION_RATIO
+    is_exc_count = grade == C.DEGRADE_GRADE_EXCEPTION_COUNT
+    sr = ddev.slow_ratio[gid_c]
+    # RT breaker: open iff slowRatio > threshold, with the ratio==1.0
+    # boundary opening when the threshold is >= 1
+    # (ResponseTimeCircuitBreaker.java:120-130).
+    rt_trip = (ratio > sr) | ((sr >= 1.0) & (ratio >= 1.0))
+    exc_ratio_trip = ratio > thr
+    exc_count_trip = run_bad > thr
+    trip = jnp.where(is_rt, rt_trip, jnp.where(is_exc_ratio, exc_ratio_trip, exc_count_trip))
+    crossing = in_win & (run_total >= ddev.min_request[gid_c]) & trip
+
+    was_closed = dyn.state == CLOSED
+    crossing_eff = crossing & was_closed[gid_c]
+    gid_cross = jnp.where(crossing_eff, gid_c, jnp.int32(nd))
+    first_cross_ts = (
+        jnp.full((nd,), _I32_MAX, dtype=jnp.int32).at[gid_cross].min(ts_s, mode="drop")
+    )
+    opened = first_cross_ts < _I32_MAX
+
+    # ---- HALF_OPEN probe outcome: decided by the FIRST completion ----
+    was_half = dyn.state == HALF_OPEN
+    seg_start = new_grp & valid_s & was_half[gid_c]
+    gid_first = jnp.where(seg_start, gid_c, jnp.int32(nd))
+    probe_bad = jnp.zeros((nd,), dtype=jnp.int32).at[gid_first].max(
+        bad_s.astype(jnp.int32), mode="drop"
+    )
+    probe_seen = jnp.zeros((nd,), dtype=jnp.int32).at[gid_first].max(1, mode="drop") > 0
+    probe_ts = jnp.full((nd,), 0, dtype=jnp.int32).at[gid_first].max(ts_s, mode="drop")
+
+    # ---- final per-rule accumulation + state resolution ----
+    gid_scatter = jnp.where(in_win, gid_c, jnp.int32(nd))
+    total_new = base_total.at[gid_scatter].add(inc, mode="drop")
+    bad_new = base_bad.at[gid_scatter].add(bad_inc, mode="drop")
+
+    state = dyn.state
+    next_retry = dyn.next_retry
+    # CLOSED -> OPEN
+    state = jnp.where(was_closed & opened, OPEN, state)
+    next_retry = jnp.where(
+        was_closed & opened, first_cross_ts + ddev.retry_ms, next_retry
+    )
+    # HALF_OPEN -> OPEN / CLOSED (probe outcome; CLOSED resets the bucket,
+    # ExceptionCircuitBreaker.resetStat / fromHalfOpenToClose)
+    half_to_open = was_half & probe_seen & (probe_bad > 0)
+    half_to_close = was_half & probe_seen & (probe_bad == 0)
+    state = jnp.where(half_to_open, OPEN, state)
+    next_retry = jnp.where(half_to_open, probe_ts + ddev.retry_ms, next_retry)
+    state = jnp.where(half_to_close, CLOSED, state)
+    total_new = jnp.where(half_to_close, 0, total_new)
+    bad_new = jnp.where(half_to_close, 0, bad_new)
+
+    return DegradeDynState(
+        state=state, next_retry=next_retry, bad=bad_new, total=total_new, ws=ws_new
+    )
+
+
+def breaker_try_pass(
+    ddev: DegradeTableDevice,
+    dyn: DegradeDynState,
+    e_dgid: jax.Array,  # int32 [N, KD]
+    e_ts: jax.Array,  # int32 [N]
+    e_live: jax.Array,  # bool [N] — entries not blocked by earlier slots
+) -> Tuple[jax.Array, jax.Array]:
+    """tryPass for a batch of entries.
+
+    Returns (slot_ok [N,KD], probe_slot [N,KD]) — probe_slot marks the
+    single admitted OPEN->HALF_OPEN probe candidate per breaker; the
+    caller applies the HALF_OPEN transition only for entries admitted
+    end-to-end.
+    """
+    n, kd = e_dgid.shape
+    nd = ddev.n_rules
+    gid_f = e_dgid.reshape(-1)
+    eidx = jnp.arange(n * kd, dtype=jnp.int32) // kd
+    valid = (gid_f >= 0) & e_live[eidx]
+    ts_f = e_ts[eidx]
+
+    gid_c = jnp.clip(gid_f, 0, nd - 1)
+    st = dyn.state[gid_c]
+    closed = st == CLOSED
+    open_ = st == OPEN
+    retry_ok = ts_f >= dyn.next_retry[gid_c]
+    candidate = valid & open_ & retry_ok
+
+    # rank-0 candidate per breaker gets the probe.
+    gid_key = jnp.where(candidate, gid_f, jnp.int32(nd))
+    pos = jnp.arange(n * kd, dtype=jnp.int32)
+    gid_s, ts_s, ei_s, p_s = jax.lax.sort((gid_key, ts_f, eidx, pos), num_keys=3)
+    ones = jnp.ones((1,), dtype=bool)
+    new_grp = jnp.concatenate([ones, gid_s[1:] != gid_s[:-1]])
+    first_s = new_grp & (gid_s < nd)
+    probe_flat = jnp.zeros((n * kd,), dtype=bool).at[p_s].set(first_s)
+
+    ok = closed | probe_flat
+    ok = ok | ~valid
+    return ok.reshape(n, kd), (probe_flat & valid).reshape(n, kd)
+
+
+def apply_probe_transitions(
+    dyn: DegradeDynState,
+    e_dgid: jax.Array,  # int32 [N, KD]
+    probe_slot: jax.Array,  # bool [N, KD]
+    admitted: jax.Array,  # bool [N]
+) -> DegradeDynState:
+    """OPEN -> HALF_OPEN for probes whose entry was admitted end-to-end."""
+    n, kd = e_dgid.shape
+    nd = dyn.state.shape[0]
+    go = probe_slot & admitted[:, None]
+    gid = jnp.where(go, e_dgid, jnp.int32(nd)).reshape(-1)
+    state = dyn.state.at[gid].set(HALF_OPEN, mode="drop")
+    return dyn._replace(state=state)

@@ -1,0 +1,359 @@
+"""Hot-parameter flow control — ParamFlowChecker, batched.
+
+The reference rate-limits per *parameter value*: a CacheMap per rule maps
+each seen value to token/time counters (reference: sentinel-extension/
+sentinel-parameter-flow-control/.../ParamFlowChecker.java:46-280,
+ParameterMetric.java:37-108, caps 4000 values/rule base — scaled by
+durationSec, total 200k). Here every (rule, value) pair is interned by
+the host to a **param row** in SoA state columns:
+
+    tokens / last_add   — the simplified token bucket (passDefaultLocalCheck)
+    latest              — the throttle pacer (passThrottleLocalCheck)
+    threads             — per-value concurrency (FLOW_GRADE_THREAD)
+
+Like the shaping controllers, per-value checks are a recurrence over
+that value's request sequence, resolved by one ``lax.scan`` over the
+batch's param slots sorted by (row, ts, entry). LRU eviction happens on
+the host; evicted rows are recycled and reset by the kernel on the next
+flush (the CacheMap eviction equivalent).
+
+Semantics preserved exactly (single-threaded collapse of the CAS loops):
+
+* token bucket: first-seen value => tokens = maxCount - acquire, pass;
+  within a window => decrement-if-enough; past the window => refill
+  ``passTime*tokenCount/durationMs`` (integer division), clamp at
+  maxCount, reject if the post-consume balance would go negative —
+  without touching state on reject (the CAS-failure return path);
+* throttle: cost = round(1000*acquire*durationSec/tokenCount) computed
+  host-side in float64; first-seen passes free; queueing accepts waits
+  STRICTLY below maxQueueingTimeMs and records ``latest = expected``;
+* per-value thread grade: ++threadCount <= threshold, incremented only
+  for entries admitted end-to-end (the StatisticSlot callback path),
+  decremented at exit;
+* hot items (paramFlowItemList) override the threshold per value,
+  matched by string form of the value.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import ParamFlowRule
+from sentinel_tpu.utils.record_log import record_log
+
+PARAM_NEVER = -(2**30)  # "no state yet" sentinel for last_add/latest
+
+
+class ParamDynState(NamedTuple):
+    tokens: jax.Array  # int32 [PR]
+    last_add: jax.Array  # int32 [PR]
+    latest: jax.Array  # int32 [PR]
+    threads: jax.Array  # int32 [PR]
+
+
+def make_param_state(n_rows: int) -> ParamDynState:
+    return ParamDynState(
+        tokens=jnp.zeros((n_rows,), dtype=jnp.int32),
+        last_add=jnp.full((n_rows,), PARAM_NEVER, dtype=jnp.int32),
+        latest=jnp.full((n_rows,), PARAM_NEVER, dtype=jnp.int32),
+        threads=jnp.zeros((n_rows,), dtype=jnp.int32),
+    )
+
+
+def grow_param_state(state: ParamDynState, n_rows: int) -> ParamDynState:
+    if n_rows <= state.tokens.shape[0]:
+        return state
+    extra = make_param_state(n_rows - state.tokens.shape[0])
+    return ParamDynState(*(jnp.concatenate([a, b]) for a, b in zip(state, extra)))
+
+
+class ParamBatch(NamedTuple):
+    """Per-slot arrays for this flush's param checks ([S] each)."""
+
+    valid: jax.Array  # bool
+    prow: jax.Array  # int32 param state row
+    eidx: jax.Array  # int32 entry index
+    ts: jax.Array  # int32
+    acquire: jax.Array  # int32
+    grade: jax.Array  # int32 FLOW_GRADE_*
+    behavior: jax.Array  # int32 DEFAULT or RATE_LIMITER
+    token_count: jax.Array  # int32 threshold (hot-item resolved)
+    burst: jax.Array  # int32
+    duration_ms: jax.Array  # int32
+    maxq: jax.Array  # int32 maxQueueingTimeMs
+    cost_ms: jax.Array  # int32 host-precomputed throttle cost (f64 exact)
+    reset_rows: jax.Array  # int32 [Q] rows recycled by LRU eviction (-1 pad)
+    exit_rows: jax.Array  # int32 [SX] thread-grade rows released by exits (-1 pad)
+
+
+@dataclass
+class ParamSlotInfo:
+    """Host-side resolved slot (before encoding)."""
+
+    prow: int
+    grade: int
+    behavior: int
+    token_count: int
+    burst: int
+    duration_ms: int
+    maxq: int
+    cost_ms: int
+    rule: Optional[ParamFlowRule] = None  # for block attribution
+
+
+class _Carry(NamedTuple):
+    prow: jax.Array
+    tokens: jax.Array
+    last_add: jax.Array
+    latest: jax.Array
+    thr_used: jax.Array  # intra-batch thread charge
+
+
+def run_param(
+    dyn: ParamDynState,
+    pb: ParamBatch,
+) -> Tuple[ParamDynState, jax.Array, jax.Array]:
+    """Evaluate param slots; returns (new_dyn, ok [S] in caller order,
+    wait_ms [S] in caller order)."""
+    s = pb.valid.shape[0]
+    pr = dyn.tokens.shape[0]
+
+    # Recycle evicted rows first.
+    rr = jnp.where(pb.reset_rows >= 0, pb.reset_rows, jnp.int32(pr))
+    dyn = ParamDynState(
+        tokens=dyn.tokens.at[rr].set(0, mode="drop"),
+        last_add=dyn.last_add.at[rr].set(PARAM_NEVER, mode="drop"),
+        latest=dyn.latest.at[rr].set(PARAM_NEVER, mode="drop"),
+        threads=dyn.threads.at[rr].set(0, mode="drop"),
+    )
+
+    key = jnp.where(pb.valid, pb.prow, jnp.int32(pr))
+    pos = jnp.arange(s, dtype=jnp.int32)
+    row_s, ts_s, ei_s, p_s = jax.lax.sort((key, pb.ts, pb.eidx, pos), num_keys=3)
+    row_c = jnp.clip(row_s, 0, pr - 1)
+    valid_s = pb.valid[p_s]
+    acq_s = pb.acquire[p_s]
+    grade_s = pb.grade[p_s]
+    beh_s = pb.behavior[p_s]
+    tc_s = pb.token_count[p_s]
+    burst_s = pb.burst[p_s]
+    dur_s = jnp.maximum(pb.duration_ms[p_s], 1)
+    maxq_s = pb.maxq[p_s]
+    cost_s = pb.cost_ms[p_s]
+
+    def step(carry: _Carry, x):
+        (row, valid, ts, acq, grade, beh, tc, burst, dur, maxq, cost) = x
+        new_seg = row != carry.prow
+        tokens = jnp.where(new_seg, dyn.tokens[row], carry.tokens)
+        last = jnp.where(new_seg, dyn.last_add[row], carry.last_add)
+        latest = jnp.where(new_seg, dyn.latest[row], carry.latest)
+        thr_used = jnp.where(new_seg, 0, carry.thr_used)
+
+        max_count = tc + burst
+        never = last == PARAM_NEVER
+
+        # --- token bucket (passDefaultLocalCheck) ---
+        first_tokens = max_count - acq
+        pass_time = ts - last
+        refill_win = pass_time > dur
+        to_add = (pass_time * tc) // dur
+        new_qps = jnp.where(
+            to_add + tokens > max_count, max_count - acq, tokens + to_add - acq
+        )
+        tb_ok = jnp.where(
+            never,
+            True,
+            jnp.where(refill_win, new_qps >= 0, tokens - acq >= 0),
+        )
+        tb_ok = tb_ok & (tc > 0) & (acq <= max_count)
+        tokens2 = jnp.where(
+            never,
+            first_tokens,
+            jnp.where(refill_win, jnp.where(new_qps >= 0, new_qps, tokens), tokens - acq),
+        )
+        tokens2 = jnp.where(tb_ok, tokens2, tokens)
+        last2 = jnp.where(tb_ok & (never | refill_win), ts, last)
+
+        # --- throttle (passThrottleLocalCheck) ---
+        t_never = latest == PARAM_NEVER
+        expected = latest + cost
+        th_imm = expected <= ts
+        th_wait = expected - ts
+        th_q = (~th_imm) & (th_wait < maxq)  # STRICT < (ParamFlowChecker.java:258)
+        th_ok = (t_never | th_imm | th_q) & (tc > 0)
+        latest2 = jnp.where(
+            t_never, ts, jnp.where(th_imm, ts, jnp.where(th_q, expected, latest))
+        )
+        latest2 = jnp.where(th_ok, latest2, latest)
+        th_wait_out = jnp.where(th_q & th_ok & ~t_never, jnp.maximum(th_wait, 0), 0)
+
+        # --- per-value thread grade ---
+        thr_cnt = dyn.threads[row] + thr_used
+        thr_ok = thr_cnt + 1 <= tc
+        thr_used2 = thr_used + jnp.where(thr_ok, 1, 0)
+
+        is_qps = grade == C.FLOW_GRADE_QPS
+        is_throttle = is_qps & (beh == C.CONTROL_BEHAVIOR_RATE_LIMITER)
+        ok = jnp.where(
+            is_throttle, th_ok, jnp.where(is_qps, tb_ok, thr_ok)
+        )
+        ok = ok | ~valid
+        wait = jnp.where(is_throttle & valid, th_wait_out, 0)
+
+        # Only the behavior in effect mutates its state column.
+        tokens3 = jnp.where(valid & is_qps & ~is_throttle, tokens2, tokens)
+        last3 = jnp.where(valid & is_qps & ~is_throttle, last2, last)
+        latest3 = jnp.where(valid & is_throttle, latest2, latest)
+        thr_used3 = jnp.where(valid & ~is_qps, thr_used2, thr_used)
+
+        carry2 = _Carry(
+            prow=jnp.where(valid, row, carry.prow),
+            tokens=jnp.where(valid, tokens3, carry.tokens),
+            last_add=jnp.where(valid, last3, carry.last_add),
+            latest=jnp.where(valid, latest3, carry.latest),
+            thr_used=jnp.where(valid, thr_used3, carry.thr_used),
+        )
+        return carry2, (ok, wait, tokens3, last3, latest3)
+
+    init = _Carry(
+        prow=jnp.int32(-1),
+        tokens=jnp.int32(0),
+        last_add=jnp.int32(PARAM_NEVER),
+        latest=jnp.int32(PARAM_NEVER),
+        thr_used=jnp.int32(0),
+    )
+    xs = (row_c, valid_s, ts_s, acq_s, grade_s, beh_s, tc_s, burst_s, dur_s, maxq_s, cost_s)
+    _, (ok_s, wait_s, tok_s, last_s, lat_s) = jax.lax.scan(step, init, xs)
+
+    seg_end = jnp.concatenate(
+        [row_s[1:] != row_s[:-1], jnp.ones((1,), dtype=bool)]
+    ) & valid_s
+    sc = jnp.where(seg_end, row_c, jnp.int32(pr))
+    new_dyn = ParamDynState(
+        tokens=dyn.tokens.at[sc].set(tok_s, mode="drop"),
+        last_add=dyn.last_add.at[sc].set(last_s, mode="drop"),
+        latest=dyn.latest.at[sc].set(lat_s, mode="drop"),
+        threads=dyn.threads,
+    )
+
+    ok_out = jnp.ones((s,), dtype=bool).at[p_s].set(ok_s)
+    wait_out = jnp.zeros((s,), dtype=jnp.int32).at[p_s].set(wait_s)
+    return new_dyn, ok_out, wait_out
+
+
+class ParamIndex:
+    """Host-side compiled hot-param rules + per-rule value interning."""
+
+    def __init__(self, by_resource: Dict[str, List[ParamFlowRule]]) -> None:
+        self.by_resource: Dict[str, List[Tuple[int, ParamFlowRule]]] = {}
+        self.rules: List[ParamFlowRule] = []
+        for res, rs in by_resource.items():
+            lst = []
+            for r in rs:
+                gid = len(self.rules)
+                self.rules.append(r)
+                lst.append((gid, r))
+            self.by_resource[res] = lst
+        # (gid) -> {value_key -> prow}; LRU by insertion-move.
+        self._values: List[Dict[str, int]] = [dict() for _ in self.rules]
+        self._hot: List[Dict[str, int]] = [
+            {it.object: int(it.count) for it in r.param_flow_item_list} for r in self.rules
+        ]
+        self._caps: List[int] = [
+            min(C.PARAM_FLOW_DEFAULT_CACHE_SIZE * max(1, int(r.duration_in_sec)), 200_000)
+            for r in self.rules
+        ]
+        self._free_rows: List[int] = []
+        self._next_row = 0
+        self.pending_resets: List[int] = []
+
+    @property
+    def n_rows(self) -> int:
+        return self._next_row
+
+    def has_rules(self) -> bool:
+        return bool(self.rules)
+
+    def _intern(self, gid: int, key: str) -> int:
+        vals = self._values[gid]
+        row = vals.get(key)
+        if row is not None:
+            # LRU touch.
+            del vals[key]
+            vals[key] = row
+            return row
+        if len(vals) >= self._caps[gid]:
+            old_key = next(iter(vals))
+            old_row = vals.pop(old_key)
+            self.pending_resets.append(old_row)
+            row = old_row
+        elif self._free_rows:
+            row = self._free_rows.pop()
+        else:
+            row = self._next_row
+            self._next_row += 1
+        vals[key] = row
+        return row
+
+    @staticmethod
+    def _value_key(value: object) -> Optional[str]:
+        if value is None:
+            return None
+        if hasattr(value, "param_flow_key"):
+            value = value.param_flow_key()  # ParamFlowArgument equivalent
+            if value is None:
+                return None
+        return str(value)
+
+    def slots_for(
+        self, resource: str, args: Sequence[object], max_slots: int = 64
+    ) -> List[ParamSlotInfo]:
+        """Resolve the entry's param slots (ParamFlowChecker.passCheck:
+        one check per rule per value, collections/arrays expand)."""
+        out: List[ParamSlotInfo] = []
+        for gid, r in self.by_resource.get(resource, ()):
+            if r.param_idx is None or r.param_idx >= len(args):
+                continue
+            value = args[r.param_idx]
+            values = (
+                list(value) if isinstance(value, (list, tuple, set, frozenset)) else [value]
+            )
+            for v in values:
+                key = self._value_key(v)
+                if key is None:
+                    continue
+                tc = self._hot[gid].get(key, int(r.count))
+                cost = 0
+                if r.control_behavior == C.CONTROL_BEHAVIOR_RATE_LIMITER and tc > 0:
+                    # Math.round(1.0*1000*acquire*durationSec/tokenCount)
+                    # for acquire=1; recomputed host-side per acquire at
+                    # submit if needed (acquire==1 is the API default).
+                    cost = int(1000.0 * r.duration_in_sec / tc + 0.5)
+                out.append(
+                    ParamSlotInfo(
+                        prow=self._intern(gid, key),
+                        grade=r.grade,
+                        behavior=r.control_behavior,
+                        token_count=tc,
+                        burst=int(r.burst_count),
+                        duration_ms=int(r.duration_in_sec) * 1000,
+                        maxq=int(r.max_queueing_time_ms),
+                        cost_ms=cost,
+                        rule=r,
+                    )
+                )
+                if len(out) >= max_slots:
+                    record_log.warn(
+                        "[ParamIndex] truncating param slots for %s at %d", resource, max_slots
+                    )
+                    return out
+        return out
+
+    def take_resets(self) -> List[int]:
+        out, self.pending_resets = self.pending_resets, []
+        return out

@@ -43,12 +43,27 @@ from sentinel_tpu.metrics.nodes import (
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.models.rules import FlowRule
 from sentinel_tpu.rules.flow_table import FlowIndex, FlowRuleDynState
+from sentinel_tpu.models.rules import AuthorityRule, DegradeRule, ParamFlowRule
+from sentinel_tpu.rules.degrade_table import DegradeDynState, DegradeIndex
+from sentinel_tpu.rules.param_table import (
+    ParamBatch,
+    ParamDynState,
+    ParamIndex,
+    ParamSlotInfo,
+    grow_param_state,
+    make_param_state,
+)
 from sentinel_tpu.rules.shaping import ShapingBatch
 from sentinel_tpu.runtime.flush import (
+    SYS_TYPE_NAMES,
     FlushBatch,
+    SystemDevice,
+    flush_step_full_jit,
     flush_step_jit,
+    flush_step_param_jit,
     flush_step_shaping_jit,
 )
+from sentinel_tpu.utils.system_status import sampler as system_sampler
 from sentinel_tpu.utils.clock import Clock, SystemClock, default_clock
 from sentinel_tpu.utils.config import config
 from sentinel_tpu.utils.numeric import pad_pow2 as _pad_pow2
@@ -59,6 +74,7 @@ class Verdict(NamedTuple):
     reason: int  # errors.PASS / BLOCK_*
     wait_ms: int
     blocked_rule: Optional[object]  # the rule bean that blocked, if attributable
+    limit_type: str = ""  # system block dimension (qps/thread/rt/load/cpu)
 
 
 @dataclass
@@ -68,8 +84,17 @@ class _EntryOp:
     acquire: int
     rows: Tuple[int, int, int, int]  # default, cluster, origin|-1, entry|-1
     slots: List[Tuple[int, int]]  # (rule_gid, check_row)
+    d_gids: List[int] = field(default_factory=list)  # degrade rule ids
+    p_slots: List[ParamSlotInfo] = field(default_factory=list)  # hot-param slots
+    auth_ok: bool = True
     prio: bool = False
     verdict: Optional[Verdict] = None
+
+    @property
+    def param_thread_rows(self) -> List[int]:
+        from sentinel_tpu.models import constants as _C
+
+        return [s.prow for s in self.p_slots if s.grade == _C.FLOW_GRADE_THREAD]
 
 
 @dataclass
@@ -80,6 +105,8 @@ class _ExitOp:
     rt: int = 0
     err: int = 0  # exception delta
     thr: int = 0  # thread delta (-1 for exits, 0 for traces)
+    d_gids: List[int] = field(default_factory=list)  # breakers to complete
+    p_rows: List[int] = field(default_factory=list)  # param thread rows to release
 
 
 class Engine:
@@ -92,6 +119,12 @@ class Engine:
         self.stats: StatsState = make_stats(rows)
         self.flow_index = FlowIndex([], cold_factor=config.cold_factor)
         self.flow_dyn: FlowRuleDynState = self.flow_index.make_dyn_state()
+        self.degrade_index = DegradeIndex([])
+        self.degrade_dyn: DegradeDynState = self.degrade_index.make_dyn_state()
+        self.param_index = ParamIndex({})
+        self.param_dyn: ParamDynState = make_param_state(8)
+        self.system_config = None  # rules/system_manager.SystemConfig or None
+        self.authority_rules: Dict[str, AuthorityRule] = {}
         self._entries: List[_EntryOp] = []
         self._exits: List[_ExitOp] = []
         self._lock = threading.RLock()
@@ -105,6 +138,64 @@ class Engine:
             self.flush()  # decisions for pending ops use the old rules
             self.flow_index = FlowIndex(rules, cold_factor=config.cold_factor)
             self.flow_dyn = self.flow_index.make_dyn_state()
+
+    def set_degrade_rules(self, rules: Sequence[DegradeRule]) -> None:
+        """Breaker state is NOT carried across reloads — the reference
+        builds fresh CircuitBreaker objects per load (DegradeRuleManager)."""
+        with self._lock:
+            self.flush()
+            self.degrade_index = DegradeIndex(rules)
+            self.degrade_dyn = self.degrade_index.make_dyn_state()
+
+    def set_param_rules(self, by_resource: Dict[str, List[ParamFlowRule]]) -> None:
+        """Param caches are rebuilt on reload, like
+        ParamFlowRuleManager clearing ParameterMetric for changed rules."""
+        with self._lock:
+            self.flush()
+            self.param_index = ParamIndex(by_resource)
+            self.param_dyn = make_param_state(8)
+
+    def set_system_config(self, cfg) -> None:
+        with self._lock:
+            self.flush()
+            self.system_config = cfg if cfg is not None and cfg.any_enabled else None
+            if self.system_config is not None and (
+                self.system_config.highest_system_load >= 0
+                or self.system_config.highest_cpu_usage >= 0
+            ):
+                system_sampler.start()
+
+    def set_authority_rules(self, by_resource: Dict[str, AuthorityRule]) -> None:
+        with self._lock:
+            self.flush()
+            self.authority_rules = dict(by_resource)
+
+    def _system_device(self) -> SystemDevice:
+        cfg = self.system_config
+        inf = float("inf")
+
+        def thr(v):
+            return float(v) if v is not None and v >= 0 else inf
+
+        if cfg is None:
+            return SystemDevice(
+                qps=jnp.float32(inf),
+                max_thread=jnp.float32(inf),
+                max_rt=jnp.float32(inf),
+                load_threshold=jnp.float32(-1.0),
+                cpu_threshold=jnp.float32(-1.0),
+                cur_load=jnp.float32(-1.0),
+                cur_cpu=jnp.float32(-1.0),
+            )
+        return SystemDevice(
+            qps=jnp.float32(thr(cfg.qps)),
+            max_thread=jnp.float32(thr(cfg.max_thread)),
+            max_rt=jnp.float32(thr(cfg.max_rt)),
+            load_threshold=jnp.float32(cfg.highest_system_load),
+            cpu_threshold=jnp.float32(cfg.highest_cpu_usage),
+            cur_load=jnp.float32(system_sampler.load),
+            cur_cpu=jnp.float32(system_sampler.cpu),
+        )
 
     # ------------------------------------------------------------------
     # op submission
@@ -138,6 +229,7 @@ class Engine:
         entry_type: C.EntryType = C.EntryType.OUT,
         prio: bool = False,
         ts: Optional[int] = None,
+        args: Sequence[object] = (),
     ) -> Optional[_EntryOp]:
         """Enqueue an entry op; returns None for pass-through (over cap)."""
         # Slot resolution + append happen under the engine lock so a
@@ -148,12 +240,24 @@ class Engine:
             if rows is None:
                 return None
             slots = self.flow_index.resolve_slots(resource, context_name, origin, self.nodes)
+            auth_ok = True
+            arule = self.authority_rules.get(resource)
+            if arule is not None:
+                from sentinel_tpu.rules.authority_manager import AuthorityRuleManager
+
+                auth_ok = AuthorityRuleManager.passes(arule, origin)
+            p_slots: List[ParamSlotInfo] = []
+            if args and self.param_index.has_rules():
+                p_slots = self.param_index.slots_for(resource, args)
             op = _EntryOp(
                 resource=resource,
                 ts=self.clock.now_ms() if ts is None else ts,
                 acquire=acquire,
                 rows=rows,
                 slots=slots,
+                d_gids=self.degrade_index.gids_for(resource),
+                p_slots=p_slots,
+                auth_ok=auth_ok,
                 prio=prio,
             )
             self._entries.append(op)
@@ -166,17 +270,30 @@ class Engine:
         count: int = 1,
         err: int = 0,
         ts: Optional[int] = None,
+        resource: Optional[str] = None,
+        param_rows: Sequence[int] = (),
     ) -> None:
-        """StatisticSlot.exit: success + RT + thread release (+exception)."""
-        op = _ExitOp(
-            ts=self.clock.now_ms() if ts is None else ts,
-            rows=rows,
-            count=count,
-            rt=min(int(rt), config.statistic_max_rt),
-            err=err,
-            thr=-1,
-        )
+        """StatisticSlot.exit: success + RT + thread release (+exception).
+
+        ``resource`` routes the completion to the resource's circuit
+        breakers (DegradeSlot.exit → onRequestComplete), resolved against
+        the degrade rules active at exit time, like the reference.
+        ``param_rows`` are per-value thread-gauge rows to release.
+        """
         with self._lock:
+            d_gids = (
+                self.degrade_index.gids_for(resource) if resource is not None else []
+            )
+            op = _ExitOp(
+                ts=self.clock.now_ms() if ts is None else ts,
+                rows=rows,
+                count=count,
+                rt=min(int(rt), config.statistic_max_rt),
+                err=err,
+                thr=-1,
+                d_gids=d_gids,
+                p_rows=list(param_rows),
+            )
             self._exits.append(op)
 
     def submit_trace(
@@ -242,6 +359,69 @@ class Engine:
         need = len(self.nodes)
         if need > self.stats.n_rows:
             self.stats = grow_stats(self.stats, _pad_pow2(need))
+        pneed = self.param_index.n_rows
+        if pneed > self.param_dyn.tokens.shape[0]:
+            self.param_dyn = grow_param_state(self.param_dyn, _pad_pow2(pneed))
+
+    def _encode_param(
+        self, entries: List[_EntryOp], exits: List[_ExitOp]
+    ) -> Optional[ParamBatch]:
+        items = []
+        for i, op in enumerate(entries):
+            for ps in op.p_slots:
+                items.append((i, op.ts, op.acquire, ps))
+        exit_rows = [r for op in exits for r in op.p_rows]
+        resets = self.param_index.take_resets()
+        if not items and not exit_rows and not resets:
+            return None
+        s = _pad_pow2(max(1, len(items)), 8)
+        sx = _pad_pow2(max(1, len(exit_rows)), 8)
+        q = _pad_pow2(max(1, len(resets)), 8)
+        valid = np.zeros(s, dtype=bool)
+        prow = np.zeros(s, dtype=np.int32)
+        eidx = np.zeros(s, dtype=np.int32)
+        ts = np.zeros(s, dtype=np.int32)
+        acquire = np.ones(s, dtype=np.int32)
+        grade = np.zeros(s, dtype=np.int32)
+        behavior = np.zeros(s, dtype=np.int32)
+        token_count = np.zeros(s, dtype=np.int32)
+        burst = np.zeros(s, dtype=np.int32)
+        duration_ms = np.ones(s, dtype=np.int32)
+        maxq = np.zeros(s, dtype=np.int32)
+        cost_ms = np.zeros(s, dtype=np.int32)
+        for a, (i, t, acq, ps) in enumerate(items):
+            valid[a] = True
+            prow[a] = ps.prow
+            eidx[a] = i
+            ts[a] = t
+            acquire[a] = acq
+            grade[a] = ps.grade
+            behavior[a] = ps.behavior
+            token_count[a] = ps.token_count
+            burst[a] = ps.burst
+            duration_ms[a] = ps.duration_ms
+            maxq[a] = ps.maxq
+            cost_ms[a] = ps.cost_ms
+        xr = np.full(sx, -1, dtype=np.int32)
+        xr[: len(exit_rows)] = exit_rows
+        rs = np.full(q, -1, dtype=np.int32)
+        rs[: len(resets)] = resets
+        return ParamBatch(
+            valid=jnp.asarray(valid),
+            prow=jnp.asarray(prow),
+            eidx=jnp.asarray(eidx),
+            ts=jnp.asarray(ts),
+            acquire=jnp.asarray(acquire),
+            grade=jnp.asarray(grade),
+            behavior=jnp.asarray(behavior),
+            token_count=jnp.asarray(token_count),
+            burst=jnp.asarray(burst),
+            duration_ms=jnp.asarray(duration_ms),
+            maxq=jnp.asarray(maxq),
+            cost_ms=jnp.asarray(cost_ms),
+            reset_rows=jnp.asarray(rs),
+            exit_rows=jnp.asarray(xr),
+        )
 
     def flush(self) -> List[_EntryOp]:
         """Encode + run the kernel for all pending ops; fills verdicts."""
@@ -256,6 +436,14 @@ class Engine:
             n = _pad_pow2(len(entries), 8)
             m = _pad_pow2(len(exits), 8)
             k = _pad_pow2(max(1, max((len(op.slots) for op in entries), default=1)), 1)
+            kd = _pad_pow2(
+                max(
+                    1,
+                    max((len(op.d_gids) for op in entries), default=1),
+                    max((len(op.d_gids) for op in exits), default=1),
+                ),
+                1,
+            )
 
             e_valid = np.zeros(n, dtype=bool)
             e_ts = np.zeros(n, dtype=np.int32)
@@ -264,6 +452,8 @@ class Engine:
             e_gid = np.full((n, k), -1, dtype=np.int32)
             e_crow = np.full((n, k), -1, dtype=np.int32)
             e_prio = np.zeros(n, dtype=bool)
+            e_auth = np.ones(n, dtype=bool)
+            e_dgid = np.full((n, kd), -1, dtype=np.int32)
             for i, op in enumerate(entries):
                 e_valid[i] = True
                 e_ts[i] = op.ts
@@ -272,7 +462,10 @@ class Engine:
                 for j, (gid, crow) in enumerate(op.slots[:k]):
                     e_gid[i, j] = gid
                     e_crow[i, j] = crow
+                for j, dg in enumerate(op.d_gids[:kd]):
+                    e_dgid[i, j] = dg
                 e_prio[i] = op.prio
+                e_auth[i] = op.auth_ok
 
             x_valid = np.zeros(m, dtype=bool)
             x_ts = np.zeros(m, dtype=np.int32)
@@ -281,6 +474,7 @@ class Engine:
             x_rt = np.zeros(m, dtype=np.int32)
             x_err = np.zeros(m, dtype=np.int32)
             x_thr = np.zeros(m, dtype=np.int32)
+            x_dgid = np.full((m, kd), -1, dtype=np.int32)
             for i, op in enumerate(exits):
                 x_valid[i] = True
                 x_ts[i] = op.ts
@@ -289,6 +483,8 @@ class Engine:
                 x_rt[i] = op.rt
                 x_err[i] = op.err
                 x_thr[i] = op.thr
+                for j, dg in enumerate(op.d_gids[:kd]):
+                    x_dgid[i, j] = dg
 
             batch = FlushBatch(
                 now=jnp.int32(self.clock.now_ms()),
@@ -299,6 +495,8 @@ class Engine:
                 e_rule_gid=jnp.asarray(e_gid),
                 e_check_row=jnp.asarray(e_crow),
                 e_prio=jnp.asarray(e_prio),
+                e_auth_ok=jnp.asarray(e_auth),
+                e_dgid=jnp.asarray(e_dgid),
                 x_valid=jnp.asarray(x_valid),
                 x_ts=jnp.asarray(x_ts),
                 x_count=jnp.asarray(x_count),
@@ -306,35 +504,71 @@ class Engine:
                 x_rt=jnp.asarray(x_rt),
                 x_err=jnp.asarray(x_err),
                 x_thr=jnp.asarray(x_thr),
+                x_dgid=jnp.asarray(x_dgid),
             )
 
+            sysdev = self._system_device()
             shaping = self._encode_shaping(entries, k)
-            if shaping is None:
-                self.stats, self.flow_dyn, result = flush_step_jit(
-                    self.stats, self.flow_index.device, self.flow_dyn, batch
-                )
+            param = self._encode_param(entries, exits)
+            common = (
+                self.stats,
+                self.flow_index.device,
+                self.flow_dyn,
+                self.degrade_index.device,
+                self.degrade_dyn,
+                self.param_dyn,
+                sysdev,
+                batch,
+            )
+            if shaping is None and param is None:
+                out = flush_step_jit(*common)
+            elif param is None:
+                out = flush_step_shaping_jit(*common, shaping)
+            elif shaping is None:
+                out = flush_step_param_jit(*common, param)
             else:
-                self.stats, self.flow_dyn, result = flush_step_shaping_jit(
-                    self.stats, self.flow_index.device, self.flow_dyn, batch, shaping
-                )
+                out = flush_step_full_jit(*common, shaping, param)
+            self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn, result = out
 
             # One batched device->host fetch (each separate fetch costs a
             # full round-trip on remote-tunnel backends).
-            admitted, reason, slot_ok, wait_ms = jax.device_get(
-                (result.admitted, result.reason, result.slot_ok, result.wait_ms)
+            admitted, reason, slot_ok, wait_ms, sys_type, dslot_ok = jax.device_get(
+                (
+                    result.admitted,
+                    result.reason,
+                    result.slot_ok,
+                    result.wait_ms,
+                    result.sys_type,
+                    result.dslot_ok,
+                )
             )
             for i, op in enumerate(entries):
                 blocked_rule = None
+                limit_type = ""
+                r = int(reason[i])
                 if not admitted[i]:
-                    for j, (gid, _) in enumerate(op.slots[:k]):
-                        if not slot_ok[i, j]:
-                            blocked_rule = self.flow_index.rule_of_gid(gid)
-                            break
+                    if r == E.BLOCK_AUTHORITY:
+                        blocked_rule = self.authority_rules.get(op.resource)
+                    elif r == E.BLOCK_SYSTEM:
+                        limit_type = SYS_TYPE_NAMES.get(int(sys_type[i]), "")
+                    elif r == E.BLOCK_FLOW:
+                        for j, (gid, _) in enumerate(op.slots[:k]):
+                            if not slot_ok[i, j]:
+                                blocked_rule = self.flow_index.rule_of_gid(gid)
+                                break
+                    elif r == E.BLOCK_PARAM:
+                        blocked_rule = op.p_slots[0].rule if op.p_slots else None
+                    elif r == E.BLOCK_DEGRADE:
+                        for j, dg in enumerate(op.d_gids[:kd]):
+                            if not dslot_ok[i, j]:
+                                blocked_rule = self.degrade_index.rule_of_gid(dg)
+                                break
                 op.verdict = Verdict(
                     admitted=bool(admitted[i]),
-                    reason=int(reason[i]),
+                    reason=r,
                     wait_ms=int(wait_ms[i]),
                     blocked_rule=blocked_rule,
+                    limit_type=limit_type,
                 )
             return entries
 
@@ -386,9 +620,12 @@ class Engine:
         acquire: int = 1,
         entry_type: C.EntryType = C.EntryType.OUT,
         prio: bool = False,
+        args: Sequence[object] = (),
     ) -> Tuple[Optional[_EntryOp], Verdict]:
         """Submit + flush: synchronous SphU.entry semantics."""
-        op = self.submit_entry(resource, context_name, origin, acquire, entry_type, prio)
+        op = self.submit_entry(
+            resource, context_name, origin, acquire, entry_type, prio, args=args
+        )
         if op is None:
             return None, Verdict(True, E.PASS, 0, None)  # over cap: pass-through
         self.flush()
@@ -444,3 +681,9 @@ class Engine:
             self.stats = make_stats(self.stats.n_rows)
             self.flow_index = FlowIndex([], cold_factor=config.cold_factor)
             self.flow_dyn = self.flow_index.make_dyn_state()
+            self.degrade_index = DegradeIndex([])
+            self.degrade_dyn = self.degrade_index.make_dyn_state()
+            self.param_index = ParamIndex({})
+            self.param_dyn = make_param_state(8)
+            self.system_config = None
+            self.authority_rules = {}

@@ -48,7 +48,15 @@ from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
 from sentinel_tpu.metrics import metric_array as ma
 from sentinel_tpu.metrics.nodes import MINUTE_CFG, SECOND_CFG, StatsState, apply_updates
 from sentinel_tpu.models import constants as C
+from sentinel_tpu.rules.degrade_table import (
+    DegradeDynState,
+    DegradeTableDevice,
+    apply_probe_transitions,
+    breaker_on_exits,
+    breaker_try_pass,
+)
 from sentinel_tpu.rules.flow_table import FlowRuleDynState, FlowTableDevice
+from sentinel_tpu.rules.param_table import ParamBatch, ParamDynState, run_param
 from sentinel_tpu.rules.shaping import ShapingBatch, run_shaping
 
 _I32_MAX = jnp.int32(2**31 - 1)
@@ -66,6 +74,9 @@ class FlushBatch(NamedTuple):
     e_rule_gid: jax.Array  # int32 [N, K], -1 = empty slot
     e_check_row: jax.Array  # int32 [N, K], -1 = rule passes trivially
     e_prio: jax.Array  # bool [N] (occupy/priority — not yet active)
+    e_auth_ok: jax.Array  # bool [N] — AuthoritySlot verdict (host-resolved
+    # origin set membership, AuthorityRuleChecker.java:31-60)
+    e_dgid: jax.Array  # int32 [N, KD] degrade-rule ids of the resource
     # --- exits and traces ---
     x_valid: jax.Array  # bool [M]
     x_ts: jax.Array  # int32 [M]
@@ -74,6 +85,24 @@ class FlushBatch(NamedTuple):
     x_rt: jax.Array  # int32 [M] RT delta (0 for trace ops)
     x_err: jax.Array  # int32 [M] exception delta
     x_thr: jax.Array  # int32 [M] thread delta (-1 exit, 0 trace)
+    x_dgid: jax.Array  # int32 [M, KD] degrade-rule ids (breaker completion)
+
+
+class SystemDevice(NamedTuple):
+    """Effective system-protection config + current host samples.
+
+    Thresholds are +inf when disabled (a disabled dimension never
+    blocks); load/cpu follow the reference's ">= 0 means set" flags
+    (SystemRuleManager.java:298-353).
+    """
+
+    qps: jax.Array  # f32 scalar
+    max_thread: jax.Array  # f32 scalar
+    max_rt: jax.Array  # f32 scalar
+    load_threshold: jax.Array  # f32 scalar (-1 disabled)
+    cpu_threshold: jax.Array  # f32 scalar (-1 disabled)
+    cur_load: jax.Array  # f32 scalar
+    cur_cpu: jax.Array  # f32 scalar
 
 
 class FlushResult(NamedTuple):
@@ -81,6 +110,24 @@ class FlushResult(NamedTuple):
     reason: jax.Array  # int32 [N] — errors.PASS / BLOCK_*
     slot_ok: jax.Array  # bool [N, K] per-rule verdicts (block attribution)
     wait_ms: jax.Array  # int32 [N] shaping wait (rate-limiter; 0 for now)
+    sys_type: jax.Array  # int32 [N] — system block dimension (see SYS_*)
+    dslot_ok: jax.Array  # bool [N, KD] per-breaker verdicts
+
+
+# System block dimension codes (limit types in SystemBlockException).
+SYS_NONE = 0
+SYS_QPS = 1
+SYS_THREAD = 2
+SYS_RT = 3
+SYS_LOAD = 4
+SYS_CPU = 5
+SYS_TYPE_NAMES = {
+    SYS_QPS: "qps",
+    SYS_THREAD: "thread",
+    SYS_RT: "rt",
+    SYS_LOAD: "load",
+    SYS_CPU: "cpu",
+}
 
 
 def _exclusive_cumsum(x: jax.Array) -> jax.Array:
@@ -184,6 +231,88 @@ def _scatter_cols(n: int, **cols: jax.Array) -> jax.Array:
     return out
 
 
+def system_check(
+    stats: StatsState,
+    sysdev: SystemDevice,
+    batch: FlushBatch,
+    live: jax.Array,  # bool [N]
+) -> Tuple[jax.Array, jax.Array]:
+    """Vectorized SystemRuleManager.checkSystem (SystemRuleManager.java:
+    298-353) against the global inbound row (Constants.ENTRY_NODE, row 0).
+
+    Only inbound (EntryType.IN) entries are checked. QPS/thread see the
+    intra-batch charge of earlier inbound entries (same rank math and
+    prefix-exactness caveats as flow_admission); RT / load / cpu use the
+    flush-time snapshot, like the reference's once-a-second samples.
+
+    Returns (ok [N], sys_type [N]).
+    """
+    n = batch.e_valid.shape[0]
+    is_in = batch.e_rows[:, 3] >= 0
+    checked = live & is_in
+
+    sums = ma.window_sums(SECOND_CFG, stats.second, batch.now)[0]
+    pass_sum = sums[MetricEvent.PASS].astype(jnp.float32)
+    success = sums[MetricEvent.SUCCESS].astype(jnp.float32)
+    rt_sum = sums[MetricEvent.RT].astype(jnp.float32)
+    threads0 = stats.threads[0].astype(jnp.float32)
+    interval_sec = SECOND_CFG.interval_ms / 1000.0
+
+    # Intra-batch charge among inbound entries, in (ts, arrival) order.
+    key = jnp.where(checked, 0, 1).astype(jnp.int32)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    key_s, ts_s, p_s = jax.lax.sort((key, batch.e_ts, pos), num_keys=3)
+    acq_s = batch.e_acquire[p_s].astype(jnp.int32)
+    in_grp = key_s == 0
+    consumed_acq_s = jnp.where(in_grp, _exclusive_cumsum(jnp.where(in_grp, acq_s, 0)), 0)
+    consumed_cnt_s = jnp.where(
+        in_grp, _exclusive_cumsum(in_grp.astype(jnp.int32)), 0
+    )
+    consumed_acq = jnp.zeros((n,), dtype=jnp.int32).at[p_s].set(consumed_acq_s)
+    consumed_cnt = jnp.zeros((n,), dtype=jnp.int32).at[p_s].set(consumed_cnt_s)
+
+    acq = batch.e_acquire.astype(jnp.float32)
+    cur_qps = (pass_sum + consumed_acq) / interval_sec
+    qps_block = cur_qps + acq > sysdev.qps
+
+    cur_thread = threads0 + consumed_cnt
+    thread_block = cur_thread > sysdev.max_thread
+
+    avg_rt = jnp.where(success > 0, rt_sum / jnp.maximum(success, 1.0), 0.0)
+    rt_block = avg_rt > sysdev.max_rt
+
+    # BBR (checkBbr): under high load, block unless
+    # curThread <= maxSuccessQps * minRt / 1000 (or curThread <= 1).
+    valid_b = (batch.now - stats.second.window_start[0]) <= SECOND_CFG.interval_ms
+    succ_buckets = jnp.where(
+        valid_b, stats.second.counts[0, :, MetricEvent.SUCCESS], 0
+    )
+    max_success_qps = (
+        jnp.max(succ_buckets).astype(jnp.float32) * SECOND_CFG.sample_count
+    )
+    min_rt = jnp.min(
+        jnp.where(valid_b, stats.second.min_rt[0], jnp.int32(SECOND_CFG.max_rt))
+    ).astype(jnp.float32)
+    load_on = (sysdev.load_threshold >= 0) & (sysdev.cur_load > sysdev.load_threshold)
+    bbr_bad = (cur_thread > 1) & (cur_thread > max_success_qps * min_rt / 1000.0)
+    load_block = load_on & bbr_bad
+
+    cpu_block = (sysdev.cpu_threshold >= 0) & (sysdev.cur_cpu > sysdev.cpu_threshold)
+
+    # First matching dimension wins, in the reference's check order.
+    sys_type = jnp.full((n,), SYS_NONE, dtype=jnp.int32)
+    for blocked, code in (
+        (cpu_block, SYS_CPU),
+        (load_block, SYS_LOAD),
+        (rt_block, SYS_RT),
+        (thread_block, SYS_THREAD),
+        (qps_block, SYS_QPS),
+    ):
+        sys_type = jnp.where(checked & blocked, jnp.int32(code), sys_type)
+    ok = sys_type == SYS_NONE
+    return ok, sys_type
+
+
 def _prev_second_pass(stats: StatsState, rows: jax.Array, ts: jax.Array) -> jax.Array:
     """Pass count of the previous 1s bucket of the minute window —
     ``node.previousPassQps()`` (reference: node/StatisticNode.java:185
@@ -203,10 +332,22 @@ def flush_step(
     stats: StatsState,
     flow_dev: FlowTableDevice,
     flow_dyn: FlowRuleDynState,
+    ddev: DegradeTableDevice,
+    ddyn: DegradeDynState,
+    pdyn: ParamDynState,
+    sysdev: SystemDevice,
     batch: FlushBatch,
     shaping: Optional[ShapingBatch] = None,
-) -> Tuple[StatsState, FlowRuleDynState, FlushResult]:
-    """Pure function: apply one batch. See module docstring for phases."""
+    param: Optional[ParamBatch] = None,
+) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
+    """Pure function: apply one batch.
+
+    Check order matches the slot chain (DefaultSlotChainBuilder order:
+    Authority −6000 → System −5000 → [ParamFlow −3000] → Flow −2000 →
+    Degrade −1000); entries blocked by an earlier stage neither consume
+    later stages' state (pacer time, breaker probes, param tokens) nor
+    count toward their thresholds.
+    """
     n = batch.e_valid.shape[0]
     m = batch.x_valid.shape[0]
 
@@ -225,33 +366,90 @@ def flush_step(
     x_rt_sample = jnp.where(x_thr_f < 0, jnp.repeat(batch.x_rt, 4), _I32_MAX)
     stats = apply_updates(stats, x_rows_f, x_ts_f, x_deltas, x_rt_sample, x_thr_f, x_mask)
 
-    # ---- phase 2: admission (FlowSlot / FlowRuleChecker) ----
+    # ---- phase 1b: breaker completions (DegradeSlot.exit:67-90) ----
+    ddyn = breaker_on_exits(
+        ddev, ddyn, batch.x_dgid, batch.x_ts, batch.x_rt, batch.x_err, batch.x_valid
+    )
+
+    # ---- phase 2a: authority (AuthoritySlot) ----
+    live = batch.e_valid & batch.e_auth_ok
+
+    # ---- phase 2b: system protection (SystemSlot) ----
+    sys_ok, sys_type = system_check(stats, sysdev, batch, live)
+    live = live & sys_ok
+
+    # ---- phase 2b': hot-parameter rules (ParamFlowSlot, order -3000) ----
+    wait_param = jnp.zeros((n,), dtype=jnp.int32)
+    param_ok = jnp.ones((n,), dtype=bool)
+    if param is not None:
+        # Exits release per-value thread slots before this batch's checks
+        # (ParamFlowStatisticExitCallback runs at completion).
+        pr0 = pdyn.threads.shape[0]
+        dec_rows = jnp.where(param.exit_rows >= 0, param.exit_rows, jnp.int32(pr0))
+        pdyn = pdyn._replace(threads=pdyn.threads.at[dec_rows].add(-1, mode="drop"))
+        param_live = param._replace(valid=param.valid & live[param.eidx])
+        pdyn, p_ok_s, p_wait_s = run_param(pdyn, param_live)
+        eidx_p = jnp.where(param_live.valid, param.eidx, jnp.int32(n))
+        param_ok = param_ok.at[eidx_p].min(p_ok_s, mode="drop")
+        wait_param = wait_param.at[eidx_p].max(p_wait_s, mode="drop")
+    live = live & param_ok
+
+    # ---- phase 2c: flow rules (FlowSlot / FlowRuleChecker) ----
     slot_ok, flow_pass, pass_plus_consumed = flow_admission(stats, flow_dev, batch)
     wait_ms = jnp.zeros((n,), dtype=jnp.int32)
     if shaping is not None:
-        # ---- phase 2b: shaping controllers (rate-limiter / warm-up) ----
-        ppc_s = pass_plus_consumed[jnp.clip(shaping.flat_pos, 0, n * shaping_k(batch) - 1)]
+        # shaping controllers (rate-limiter / warm-up); entries already
+        # blocked upstream must not advance pacer state.
+        k = batch.e_rule_gid.shape[1]
+        ppc_s = pass_plus_consumed[jnp.clip(shaping.flat_pos, 0, n * k - 1)]
         prev_s = _prev_second_pass(stats, shaping.row, shaping.ts)
         interval_sec = SECOND_CFG.interval_ms / 1000.0
+        shaping_live = shaping._replace(valid=shaping.valid & live[shaping.eidx])
         flow_dyn, ok_s, wait_s = run_shaping(
-            flow_dev, flow_dyn, shaping, ppc_s, prev_s, interval_sec
+            flow_dev, flow_dyn, shaping_live, ppc_s, prev_s, interval_sec
         )
         flat_ok = slot_ok.reshape(-1)
         scatter_pos = jnp.where(
-            shaping.valid, shaping.flat_pos, jnp.int32(flat_ok.shape[0])
+            shaping_live.valid, shaping.flat_pos, jnp.int32(flat_ok.shape[0])
         )
         # bool .min scatter == logical AND with existing verdicts.
         flat_ok = flat_ok.at[scatter_pos].min(ok_s, mode="drop")
         slot_ok = flat_ok.reshape(slot_ok.shape)
         flow_pass = slot_ok.all(axis=1)
-        eidx_scatter = jnp.where(shaping.valid, shaping.eidx, jnp.int32(n))
+        eidx_scatter = jnp.where(shaping_live.valid, shaping.eidx, jnp.int32(n))
         wait_ms = wait_ms.at[eidx_scatter].max(wait_s, mode="drop")
-        wait_ms = jnp.where(flow_pass, wait_ms, 0)
+    live2 = live & flow_pass
+    wait_ms = jnp.where(live2, wait_ms, 0)
 
-    admitted = batch.e_valid & flow_pass
+    # ---- phase 2d: circuit breakers (DegradeSlot.entry) ----
+    dslot_ok, probe_slot = breaker_try_pass(ddev, ddyn, batch.e_dgid, batch.e_ts, live2)
+    deg_pass = dslot_ok.all(axis=1)
+
+    admitted = live2 & deg_pass
+    ddyn = apply_probe_transitions(ddyn, batch.e_dgid, probe_slot, admitted)
+    wait_ms = jnp.maximum(wait_ms, jnp.where(admitted, wait_param, 0))
+
+    # Per-value thread acquire (ParamFlowStatisticEntryCallback.onPass):
+    # +1 per thread-grade param slot of an admitted entry.
+    if param is not None:
+        pr = pdyn.threads.shape[0]
+        inc_slot = (
+            param.valid
+            & (param.grade == C.FLOW_GRADE_THREAD)
+            & admitted[param.eidx]
+        )
+        inc_rows = jnp.where(inc_slot, param.prow, jnp.int32(pr))
+        pdyn = pdyn._replace(threads=pdyn.threads.at[inc_rows].add(1, mode="drop"))
+
+    reason = jnp.full((n,), E.PASS, dtype=jnp.int32)
+    reason = jnp.where(batch.e_valid & ~deg_pass, jnp.int32(E.BLOCK_DEGRADE), reason)
+    reason = jnp.where(batch.e_valid & ~flow_pass, jnp.int32(E.BLOCK_FLOW), reason)
+    reason = jnp.where(batch.e_valid & ~param_ok, jnp.int32(E.BLOCK_PARAM), reason)
+    reason = jnp.where(batch.e_valid & ~sys_ok, jnp.int32(E.BLOCK_SYSTEM), reason)
     reason = jnp.where(
-        batch.e_valid & ~flow_pass, jnp.int32(E.BLOCK_FLOW), jnp.int32(E.PASS)
+        batch.e_valid & ~batch.e_auth_ok, jnp.int32(E.BLOCK_AUTHORITY), reason
     )
+    reason = jnp.where(admitted, jnp.int32(E.PASS), reason)
 
     # ---- phase 3: entry accounting (StatisticSlot.entry:64-120) ----
     e_rows_f = batch.e_rows.reshape(-1)
@@ -268,29 +466,47 @@ def flush_step(
         stats, e_rows_f, jnp.repeat(batch.e_ts, 4), e_deltas, None, e_thr, e_mask
     )
 
-    return stats, flow_dyn, FlushResult(admitted=admitted, reason=reason, slot_ok=slot_ok, wait_ms=wait_ms)
+    result = FlushResult(
+        admitted=admitted,
+        reason=reason,
+        slot_ok=slot_ok,
+        wait_ms=wait_ms,
+        sys_type=sys_type,
+        dslot_ok=dslot_ok,
+    )
+    return stats, flow_dyn, ddyn, pdyn, result
 
 
-def shaping_k(batch: FlushBatch) -> int:
-    return batch.e_rule_gid.shape[1]
+# Four jit variants keyed by which optional batches are present; the
+# engine picks per flush so DEFAULT-only traffic never pays for the
+# shaping/param machinery.
+@functools.partial(jax.jit, donate_argnums=(0, 4, 5))
+def flush_step_jit(stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch):
+    return flush_step(stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def flush_step_jit(
-    stats: StatsState,
-    flow_dev: FlowTableDevice,
-    flow_dyn: FlowRuleDynState,
-    batch: FlushBatch,
-) -> Tuple[StatsState, FlowRuleDynState, FlushResult]:
-    return flush_step(stats, flow_dev, flow_dyn, batch)
-
-
-@functools.partial(jax.jit, donate_argnums=(0, 2))
+@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5))
 def flush_step_shaping_jit(
-    stats: StatsState,
-    flow_dev: FlowTableDevice,
-    flow_dyn: FlowRuleDynState,
-    batch: FlushBatch,
-    shaping: ShapingBatch,
-) -> Tuple[StatsState, FlowRuleDynState, FlushResult]:
-    return flush_step(stats, flow_dev, flow_dyn, batch, shaping)
+    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping
+):
+    return flush_step(
+        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 4, 5))
+def flush_step_param_jit(
+    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, param
+):
+    return flush_step(
+        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, None, param
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5))
+def flush_step_full_jit(
+    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param
+):
+    return flush_step(
+        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param
+    )

@@ -215,6 +215,87 @@ class OracleWarmUp:
         return b.counts[MetricEvent.PASS]
 
 
+class OracleCircuitBreaker:
+    """Sequential breaker semantics (AbstractCircuitBreaker.java:40-150 +
+    ExceptionCircuitBreaker.java / ResponseTimeCircuitBreaker.java):
+    1-bucket window of (bad, total), CLOSED/OPEN/HALF_OPEN transitions
+    evaluated after every completion."""
+
+    CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+
+    def __init__(
+        self,
+        grade: int,  # 0 RT, 1 exception-ratio, 2 exception-count
+        count: float,
+        time_window_sec: int,
+        min_request: int = 5,
+        slow_ratio: float = 1.0,
+        stat_interval_ms: int = 1000,
+    ) -> None:
+        self.grade = grade
+        self.count = count
+        self.max_rt = int(count + 0.5)
+        self.slow_ratio = slow_ratio
+        self.min_request = min_request
+        self.interval = stat_interval_ms
+        self.retry_ms = time_window_sec * 1000
+        self.state = self.CLOSED
+        self.next_retry = 0
+        self.bad = 0
+        self.total = 0
+        self.ws = -(10**9)
+
+    def _roll(self, t: int) -> None:
+        aligned = t - t % self.interval
+        if aligned > self.ws:
+            self.ws = aligned
+            self.bad = 0
+            self.total = 0
+
+    def try_pass(self, t: int) -> bool:
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.OPEN and t >= self.next_retry:
+            self.state = self.HALF_OPEN
+            return True
+        return False
+
+    def revert_probe(self) -> None:
+        """whenTerminate workaround: probe blocked downstream."""
+        if self.state == self.HALF_OPEN:
+            self.state = self.OPEN
+
+    def on_complete(self, t: int, rt: int = 0, error: bool = False) -> None:
+        self._roll(t)
+        is_bad = (rt > self.max_rt) if self.grade == 0 else error
+        if is_bad:
+            self.bad += 1
+        self.total += 1
+        if self.state == self.OPEN:
+            return
+        if self.state == self.HALF_OPEN:
+            if is_bad:
+                self.state = self.OPEN
+                self.next_retry = t + self.retry_ms
+            else:
+                self.state = self.CLOSED
+                self.bad = 0
+                self.total = 0
+            return
+        if self.total < self.min_request:
+            return
+        ratio = self.bad / self.total
+        if self.grade == 0:
+            trip = ratio > self.slow_ratio or (self.slow_ratio >= 1.0 and ratio >= 1.0)
+        elif self.grade == 1:
+            trip = ratio > self.count
+        else:
+            trip = self.bad > self.count
+        if trip:
+            self.state = self.OPEN
+            self.next_retry = t + self.retry_ms
+
+
 class OracleFlowEngine:
     """Single-resource sequential engine: rules with DIRECT/default only.
 

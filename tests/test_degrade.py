@@ -1,0 +1,182 @@
+"""Circuit breaker tests — mirroring the reference's
+ExceptionCircuitBreakerTest / ResponseTimeCircuitBreakerTest semantics
+under the fake clock, plus randomized oracle parity."""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.testing.oracle import OracleCircuitBreaker
+
+
+def exc_ratio_rule(resource, ratio=0.5, tw=5, min_req=5):
+    return st.DegradeRule(
+        resource,
+        grade=C.DEGRADE_GRADE_EXCEPTION_RATIO,
+        count=ratio,
+        time_window=tw,
+        min_request_amount=min_req,
+    )
+
+
+def run_one(clock, resource, rt=0, error=False):
+    """One entry/exit cycle; returns admitted?"""
+    e = st.try_entry(resource)
+    if e is None:
+        return False
+    if rt:
+        clock.advance(rt)
+    if error:
+        e.set_error(RuntimeError("biz"))
+    e.exit()
+    return True
+
+
+class TestExceptionBreaker:
+    def test_opens_on_error_ratio(self, manual_clock, engine):
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("svc", 0.5, tw=5)])
+        # 5 requests, 4 errors -> ratio 0.8 > 0.5 after min_request reached.
+        for i in range(5):
+            manual_clock.set_ms(i * 10)
+            assert run_one(manual_clock, "svc", error=(i > 0))
+        # breaker now OPEN
+        manual_clock.set_ms(100)
+        assert st.try_entry("svc") is None
+
+    def test_min_request_amount_gate(self, manual_clock, engine):
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("g", 0.1, min_req=10)])
+        for i in range(9):
+            manual_clock.set_ms(i)
+            assert run_one(manual_clock, "g", error=True)  # all errors, below min
+        manual_clock.set_ms(20)
+        assert st.try_entry("g") is not None  # still CLOSED (9 < 10)
+
+    def test_half_open_probe_recovers(self, manual_clock, engine):
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("rec", 0.4, tw=2)])
+        for i in range(5):
+            manual_clock.set_ms(i)
+            run_one(manual_clock, "rec", error=True)
+        manual_clock.set_ms(100)
+        assert st.try_entry("rec") is None  # OPEN
+        # After the 2s recovery window: one probe allowed.
+        manual_clock.set_ms(2010)
+        e = st.try_entry("rec")
+        assert e is not None
+        # Concurrent second request while HALF_OPEN: blocked.
+        assert st.try_entry("rec") is None
+        e.exit()  # success -> CLOSED
+        manual_clock.set_ms(2050)
+        assert run_one(manual_clock, "rec")
+
+    def test_half_open_probe_failure_reopens(self, manual_clock, engine):
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("bad", 0.4, tw=1)])
+        for i in range(5):
+            manual_clock.set_ms(i)
+            run_one(manual_clock, "bad", error=True)
+        manual_clock.set_ms(1100)
+        e = st.try_entry("bad")
+        assert e is not None
+        e.set_error(RuntimeError("still failing"))
+        e.exit()  # probe failed -> OPEN again
+        manual_clock.set_ms(1200)
+        assert st.try_entry("bad") is None
+        # next retry only after another full time window
+        manual_clock.set_ms(2150)
+        assert st.try_entry("bad") is not None
+
+    def test_exception_count_grade(self, manual_clock, engine):
+        st.degrade_rule_manager.load_rules(
+            [
+                st.DegradeRule(
+                    "cnt",
+                    grade=C.DEGRADE_GRADE_EXCEPTION_COUNT,
+                    count=3,
+                    time_window=5,
+                    min_request_amount=1,
+                )
+            ]
+        )
+        for i in range(4):
+            manual_clock.set_ms(i)
+            assert run_one(manual_clock, "cnt", error=True)
+        # 4 errors > 3 -> OPEN
+        assert st.try_entry("cnt") is None
+
+
+class TestResponseTimeBreaker:
+    def test_opens_on_slow_ratio(self, manual_clock, engine):
+        st.degrade_rule_manager.load_rules(
+            [
+                st.DegradeRule(
+                    "slow",
+                    grade=C.DEGRADE_GRADE_RT,
+                    count=50,  # max RT 50ms
+                    slow_ratio_threshold=0.6,
+                    time_window=3,
+                    min_request_amount=3,
+                )
+            ]
+        )
+        # All-slow completions (100ms > 50ms): the breaker opens as soon
+        # as min_request_amount=3 completions are in the window with
+        # ratio 1.0 > 0.6 — so requests 1-3 pass, request 4 is blocked.
+        for i in range(3):
+            manual_clock.set_ms(i * 200)
+            assert run_one(manual_clock, "slow", rt=100)
+        manual_clock.set_ms(600)
+        assert st.try_entry("slow") is None
+
+    def test_fast_requests_keep_closed(self, manual_clock, engine):
+        st.degrade_rule_manager.load_rules(
+            [
+                st.DegradeRule(
+                    "fast",
+                    grade=C.DEGRADE_GRADE_RT,
+                    count=50,
+                    slow_ratio_threshold=0.5,
+                    time_window=3,
+                    min_request_amount=3,
+                )
+            ]
+        )
+        for i in range(10):
+            manual_clock.set_ms(i * 20)
+            assert run_one(manual_clock, "fast", rt=5)
+
+
+class TestOracleParity:
+    @pytest.mark.parametrize("grade", [C.DEGRADE_GRADE_RT, C.DEGRADE_GRADE_EXCEPTION_RATIO])
+    def test_randomized_stream(self, manual_clock, engine, grade):
+        if grade == C.DEGRADE_GRADE_RT:
+            rule = st.DegradeRule(
+                "r",
+                grade=grade,
+                count=30,
+                slow_ratio_threshold=0.5,
+                time_window=2,
+                min_request_amount=4,
+            )
+            ob = OracleCircuitBreaker(0, 30, 2, 4, 0.5)
+        else:
+            rule = st.DegradeRule(
+                "r", grade=grade, count=0.5, time_window=2, min_request_amount=4
+            )
+            ob = OracleCircuitBreaker(1, 0.5, 2, 4)
+        st.degrade_rule_manager.load_rules([rule])
+        rng = np.random.default_rng(5)
+        t = 0
+        for step in range(150):
+            t += int(rng.choice([5, 40, 300, 1200], p=[0.4, 0.3, 0.2, 0.1]))
+            manual_clock.set_ms(t)
+            e = st.try_entry("r")
+            want = ob.try_pass(t)
+            assert (e is not None) == want, f"step {step} t={t}"
+            if e is not None:
+                rt = int(rng.choice([5, 80]))
+                err = bool(rng.random() < 0.4)
+                manual_clock.advance(rt)
+                if err:
+                    e.set_error(RuntimeError("x"))
+                e.exit()
+                ob.on_complete(manual_clock.now_ms(), rt=rt, error=err)

@@ -1,0 +1,128 @@
+"""System-adaptive protection + authority rule tests (reference:
+SystemRuleManager.checkSystem / AuthorityRuleChecker semantics)."""
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.utils.system_status import sampler
+
+
+class TestSystemRules:
+    def test_qps_limit_inbound_only(self, manual_clock, engine):
+        st.system_rule_manager.load_rules([st.SystemRule(qps=3)])
+        # Inbound capped at 3.
+        for i in range(3):
+            with st.entry(f"in{i}", entry_type=C.EntryType.IN):
+                pass
+        with pytest.raises(st.SystemBlockError) as ei:
+            st.entry("in4", entry_type=C.EntryType.IN)
+        assert ei.value.limit_type == "qps"
+        # Outbound unaffected.
+        with st.entry("out", entry_type=C.EntryType.OUT):
+            pass
+
+    def test_thread_limit(self, manual_clock, engine):
+        # checkSystem uses strict > on the PRE-increment gauge
+        # (SystemRuleManager.java:321-324): with max_thread=2 the third
+        # concurrent entry still passes (2 > 2 is false); the fourth is
+        # blocked (3 > 2).
+        st.system_rule_manager.load_rules([st.SystemRule(max_thread=2)])
+        e1 = st.entry("a", entry_type=C.EntryType.IN)
+        e2 = st.entry("b", entry_type=C.EntryType.IN)
+        e3 = st.entry("c", entry_type=C.EntryType.IN)
+        with pytest.raises(st.SystemBlockError) as ei:
+            st.entry("d", entry_type=C.EntryType.IN)
+        assert ei.value.limit_type == "thread"
+        e1.exit()
+        e2.exit()
+        e3.exit()
+
+    def test_avg_rt_limit(self, manual_clock, engine):
+        st.system_rule_manager.load_rules([st.SystemRule(avg_rt=50)])
+        manual_clock.set_ms(0)
+        e = st.entry("slow", entry_type=C.EntryType.IN)
+        manual_clock.advance(200)  # RT 200ms
+        e.exit()
+        with pytest.raises(st.SystemBlockError) as ei:
+            st.entry("next", entry_type=C.EntryType.IN)
+        assert ei.value.limit_type == "rt"
+
+    def test_load_bbr(self, manual_clock, engine):
+        st.system_rule_manager.load_rules([st.SystemRule(highest_system_load=1.0)])
+        sampler.force(load=5.0, cpu=-1.0)
+        try:
+            # checkBbr blocks only when the PRE-increment concurrency
+            # exceeds 1 AND the BBR capacity (maxSuccessQps*minRt/1000,
+            # here 0 with an idle window): entries 1-2 pass (gauge 0,1),
+            # the third (gauge 2 > 1) is blocked.
+            e1 = st.entry("l1", entry_type=C.EntryType.IN)
+            e2 = st.entry("l2", entry_type=C.EntryType.IN)
+            with pytest.raises(st.SystemBlockError) as ei:
+                st.entry("l3", entry_type=C.EntryType.IN)
+            assert ei.value.limit_type == "load"
+            e1.exit()
+            e2.exit()
+        finally:
+            sampler.force(load=-1.0, cpu=-1.0)
+
+    def test_cpu_limit(self, manual_clock, engine):
+        st.system_rule_manager.load_rules([st.SystemRule(highest_cpu_usage=0.5)])
+        sampler.force(load=-1.0, cpu=0.9)
+        try:
+            with pytest.raises(st.SystemBlockError) as ei:
+                st.entry("c1", entry_type=C.EntryType.IN)
+            assert ei.value.limit_type == "cpu"
+        finally:
+            sampler.force(load=-1.0, cpu=-1.0)
+
+    def test_min_across_rules(self, manual_clock, engine):
+        st.system_rule_manager.load_rules(
+            [st.SystemRule(qps=100), st.SystemRule(qps=2)]
+        )
+        assert st.system_rule_manager.effective.qps == 2
+
+    def test_system_block_counts_stats(self, manual_clock, engine):
+        st.system_rule_manager.load_rules([st.SystemRule(qps=1)])
+        with st.entry("s1", entry_type=C.EntryType.IN):
+            pass
+        with pytest.raises(st.SystemBlockError):
+            st.entry("s2", entry_type=C.EntryType.IN)
+        g = engine.entry_node_stats()
+        assert g["pass_qps"] == 1
+        assert g["block_qps"] == 1
+
+
+class TestAuthorityRules:
+    def test_white_list(self, manual_clock, engine):
+        st.authority_rule_manager.load_rules(
+            [st.AuthorityRule("api", limit_app="appA,appB", strategy=C.AUTHORITY_WHITE)]
+        )
+        st.context_enter("cw", origin="appA")
+        with st.entry("api"):
+            pass
+        st.context_exit()
+        st.context_enter("cw2", origin="appC")
+        with pytest.raises(st.AuthorityBlockError):
+            st.entry("api")
+        st.context_exit()
+
+    def test_black_list(self, manual_clock, engine):
+        st.authority_rule_manager.load_rules(
+            [st.AuthorityRule("api2", limit_app="evil", strategy=C.AUTHORITY_BLACK)]
+        )
+        st.context_enter("cb", origin="evil")
+        with pytest.raises(st.AuthorityBlockError):
+            st.entry("api2")
+        st.context_exit()
+        st.context_enter("cb2", origin="good")
+        with st.entry("api2"):
+            pass
+        st.context_exit()
+
+    def test_empty_origin_passes(self, manual_clock, engine):
+        st.authority_rule_manager.load_rules(
+            [st.AuthorityRule("api3", limit_app="appA", strategy=C.AUTHORITY_WHITE)]
+        )
+        with st.entry("api3"):  # no origin -> not checked
+            pass
