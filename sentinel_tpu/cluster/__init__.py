@@ -1,0 +1,49 @@
+"""Cluster flow control — the distributed backend.
+
+Equivalent of sentinel-cluster (reference: sentinel-cluster/
+sentinel-cluster-server-default/.../flow/ClusterFlowChecker.java:36-118,
+DefaultTokenService.java:36-84, GlobalRequestLimiter, ClusterFlowRuleManager,
+ClusterServerConfigManager; client side DefaultClusterTokenClient.java:45 +
+NettyTransportClient.java:61-228; wire constants ClusterConstants.java:24-41).
+
+Three deployment shapes, mirroring and extending the reference:
+
+1. **Embedded token service** (:mod:`token_service`) — the token
+   decision engine runs in-process, backed by the same batched JAX
+   kernel style as the local engine (a [flows × buckets] counter
+   matrix). ≙ DefaultEmbeddedTokenServer.
+2. **TCP token server/client** (:mod:`server`, :mod:`client`) — a
+   length-framed binary protocol with xid request correlation serving
+   non-TPU clients. ≙ SentinelDefaultTokenServer over Netty.
+3. **ICI mesh mode** (:mod:`ici`) — the TPU-native replacement for the
+   token-server RPC hop: every chip keeps local counters and the
+   global limit is enforced with ``psum`` over the mesh inside the
+   jitted flush; chip-indexed greedy allocation distributes the
+   remaining capacity deterministically.
+"""
+
+from sentinel_tpu.cluster.state import (
+    ClusterStateManager,
+    TokenClientProvider,
+    EmbeddedClusterTokenServerProvider,
+)
+from sentinel_tpu.cluster.token_service import (
+    TokenResult,
+    TokenService,
+    DefaultTokenService,
+)
+from sentinel_tpu.cluster.flow_rules import (
+    cluster_flow_rule_manager,
+    cluster_server_config_manager,
+)
+
+__all__ = [
+    "ClusterStateManager",
+    "TokenClientProvider",
+    "EmbeddedClusterTokenServerProvider",
+    "TokenResult",
+    "TokenService",
+    "DefaultTokenService",
+    "cluster_flow_rule_manager",
+    "cluster_server_config_manager",
+]

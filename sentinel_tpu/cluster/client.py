@@ -1,0 +1,172 @@
+"""Cluster token client.
+
+Reference: DefaultClusterTokenClient + NettyTransportClient
+(sentinel-cluster-client-default/.../DefaultClusterTokenClient.java:45,
+NettyTransportClient.java:61-228): framed TCP, xid → pending-result
+correlation, request timeout mapped to FAIL, scheduled reconnect on
+connection loss. The caller (FlowRuleChecker.passClusterCheck analog in
+the engine) maps FAIL/NO_RULE_EXISTS to fallback-to-local.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from sentinel_tpu.cluster import protocol
+from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.utils.record_log import record_log
+
+
+class ClusterTokenClient(TokenService):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 18730,
+        request_timeout_sec: float = 2.0,
+        reconnect_interval_sec: float = 2.0,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.timeout = request_timeout_sec
+        self.reconnect_interval = reconnect_interval_sec
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, "_Pending"] = {}
+        self._pending_lock = threading.Lock()
+        self._xid = itertools.count(1)
+        self._reader: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self._last_reconnect = 0.0
+
+    # ------------------------------------------------------------------
+    def start(self) -> "ClusterTokenClient":
+        self._stopped.clear()
+        self._connect()
+        return self
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._close()
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def _connect(self) -> bool:
+        with self._send_lock:
+            if self._sock is not None:
+                return True
+            try:
+                s = socket.create_connection((self.host, self.port), timeout=self.timeout)
+                s.settimeout(None)
+                self._sock = s
+            except OSError as e:
+                record_log.warn("[TokenClient] connect failed: %s", e)
+                return False
+        self._reader = threading.Thread(
+            target=self._read_loop, name="sentinel-token-client", daemon=True
+        )
+        self._reader.start()
+        return True
+
+    def _close(self) -> None:
+        with self._send_lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+        # Fail all pending waits.
+        with self._pending_lock:
+            for p in self._pending.values():
+                p.set(TokenResult(C.TokenResultStatus.FAIL))
+            self._pending.clear()
+
+    def _maybe_reconnect(self) -> bool:
+        now = time.monotonic()
+        if now - self._last_reconnect < self.reconnect_interval:
+            return False
+        self._last_reconnect = now
+        return self._connect()
+
+    def _read_loop(self) -> None:
+        sock = self._sock
+        try:
+            while not self._stopped.is_set() and sock is not None:
+                payload = protocol.read_frame(sock)
+                if payload is None:
+                    break
+                xid, _mt, status, remaining, wait_ms = protocol.unpack_response(payload)
+                with self._pending_lock:
+                    p = self._pending.pop(xid, None)
+                if p is not None:
+                    p.set(TokenResult(C.TokenResultStatus(status), remaining, wait_ms))
+        except (OSError, ValueError):
+            pass
+        finally:
+            self._close()
+
+    # ------------------------------------------------------------------
+    def _send_request(self, frame: bytes, xid: int) -> TokenResult:
+        pending = _Pending()
+        with self._pending_lock:
+            self._pending[xid] = pending
+        try:
+            with self._send_lock:
+                if self._sock is None:
+                    raise OSError("not connected")
+                self._sock.sendall(frame)
+        except OSError:
+            with self._pending_lock:
+                self._pending.pop(xid, None)
+            self._close()
+            self._maybe_reconnect()
+            return TokenResult(C.TokenResultStatus.FAIL)
+        result = pending.wait(self.timeout)
+        if result is None:
+            with self._pending_lock:
+                self._pending.pop(xid, None)
+            return TokenResult(C.TokenResultStatus.FAIL)
+        return result
+
+    def request_token(
+        self, flow_id: int, acquire_count: int = 1, prioritized: bool = False
+    ) -> TokenResult:
+        if self._sock is None and not self._maybe_reconnect():
+            return TokenResult(C.TokenResultStatus.FAIL)
+        xid = next(self._xid)
+        return self._send_request(
+            protocol.pack_flow_request(xid, flow_id, acquire_count, prioritized), xid
+        )
+
+    def request_param_token(
+        self, flow_id: int, acquire_count: int, params: List[object]
+    ) -> TokenResult:
+        if self._sock is None and not self._maybe_reconnect():
+            return TokenResult(C.TokenResultStatus.FAIL)
+        xid = next(self._xid)
+        return self._send_request(
+            protocol.pack_param_request(xid, flow_id, acquire_count, [str(p) for p in params]),
+            xid,
+        )
+
+
+class _Pending:
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._result: Optional[TokenResult] = None
+
+    def set(self, result: TokenResult) -> None:
+        self._result = result
+        self._event.set()
+
+    def wait(self, timeout: float) -> Optional[TokenResult]:
+        if not self._event.wait(timeout):
+            return None
+        return self._result

@@ -1,0 +1,104 @@
+"""Cluster wire protocol.
+
+Concepts from the reference's binary codec (reference:
+sentinel-cluster-common-default/.../ClusterConstants.java:24-41 — msg
+types PING=0 FLOW=1 PARAM_FLOW=2 CONCURRENT acquire/release, 2-byte
+length-field framing in NettyTransportServer.java:89; xid request
+correlation in TokenClientPromiseHolder.java:30). The byte layout here
+is this framework's own (little-endian struct packing), not a copy of
+the reference's codec.
+
+Frame:   [u32 length][payload]
+Request: [u32 xid][u8 type][body]
+  FLOW body:        [i64 flow_id][i32 acquire][u8 prioritized]
+  PARAM_FLOW body:  [i64 flow_id][i32 acquire][u16 n][n × (u16 len, bytes)]
+  PING body:        []
+Response:[u32 xid][u8 type][i8 status][i32 remaining][i32 wait_ms]
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+from sentinel_tpu.models import constants as C
+
+_REQ_HDR = struct.Struct("<IB")
+_FLOW_BODY = struct.Struct("<qiB")
+_RESP = struct.Struct("<IBbii")
+_LEN = struct.Struct("<I")
+
+
+def pack_flow_request(xid: int, flow_id: int, acquire: int, prioritized: bool) -> bytes:
+    payload = _REQ_HDR.pack(xid, C.MSG_TYPE_FLOW) + _FLOW_BODY.pack(
+        flow_id, acquire, 1 if prioritized else 0
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def pack_param_request(xid: int, flow_id: int, acquire: int, params: List[str]) -> bytes:
+    body = _FLOW_BODY.pack(flow_id, acquire, 0) + struct.pack("<H", len(params))
+    for p in params:
+        raw = str(p).encode("utf-8")[:65535]
+        body += struct.pack("<H", len(raw)) + raw
+    payload = _REQ_HDR.pack(xid, C.MSG_TYPE_PARAM_FLOW) + body
+    return _LEN.pack(len(payload)) + payload
+
+
+def pack_ping(xid: int) -> bytes:
+    payload = _REQ_HDR.pack(xid, C.MSG_TYPE_PING)
+    return _LEN.pack(len(payload)) + payload
+
+
+def pack_response(xid: int, msg_type: int, status: int, remaining: int = 0, wait_ms: int = 0) -> bytes:
+    payload = _RESP.pack(xid, msg_type, status, remaining, wait_ms)
+    return _LEN.pack(len(payload)) + payload
+
+
+def unpack_request(payload: bytes) -> Tuple[int, int, tuple]:
+    """-> (xid, msg_type, body_tuple)."""
+    xid, msg_type = _REQ_HDR.unpack_from(payload, 0)
+    off = _REQ_HDR.size
+    if msg_type == C.MSG_TYPE_PING:
+        return xid, msg_type, ()
+    flow_id, acquire, prio = _FLOW_BODY.unpack_from(payload, off)
+    off += _FLOW_BODY.size
+    if msg_type == C.MSG_TYPE_FLOW:
+        return xid, msg_type, (flow_id, acquire, bool(prio))
+    if msg_type == C.MSG_TYPE_PARAM_FLOW:
+        (n,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        params = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<H", payload, off)
+            off += 2
+            params.append(payload[off : off + ln].decode("utf-8"))
+            off += ln
+        return xid, msg_type, (flow_id, acquire, params)
+    raise ValueError(f"unknown msg type {msg_type}")
+
+
+def unpack_response(payload: bytes) -> Tuple[int, int, int, int, int]:
+    """-> (xid, msg_type, status, remaining, wait_ms)."""
+    return _RESP.unpack(payload)
+
+
+def read_frame(sock) -> Optional[bytes]:
+    """Blocking read of one length-framed payload; None on EOF."""
+    hdr = _read_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (length,) = _LEN.unpack(hdr)
+    if length > 1 << 20:
+        raise ValueError("frame too large")
+    return _read_exact(sock, length)
+
+
+def _read_exact(sock, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf

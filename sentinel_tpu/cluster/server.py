@@ -1,0 +1,109 @@
+"""Standalone / embedded token server over TCP.
+
+Reference: SentinelDefaultTokenServer + NettyTransportServer +
+TokenServerHandler (sentinel-cluster-server-default/.../
+SentinelDefaultTokenServer.java:37, NettyTransportServer.java:78-93,
+handler/TokenServerHandler.java:39-75). A threaded TCP acceptor decodes
+framed requests and dispatches to the in-process
+:class:`DefaultTokenService`; connection counts feed the AVG_LOCAL
+threshold like ConnectionManager's connectedCount.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Optional
+
+from sentinel_tpu.cluster import protocol
+from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenService
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.utils.record_log import record_log
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: "SentinelTokenServer" = self.server.token_server  # type: ignore[attr-defined]
+        server._conn_changed(+1)
+        try:
+            while True:
+                payload = protocol.read_frame(self.request)
+                if payload is None:
+                    return
+                try:
+                    xid, msg_type, body = protocol.unpack_request(payload)
+                except ValueError:
+                    record_log.warn("[TokenServer] bad frame dropped")
+                    return
+                if msg_type == C.MSG_TYPE_PING:
+                    resp = protocol.pack_response(xid, msg_type, int(C.TokenResultStatus.OK))
+                elif msg_type == C.MSG_TYPE_FLOW:
+                    flow_id, acquire, prio = body
+                    r = server.service.request_token(flow_id, acquire, prio)
+                    resp = protocol.pack_response(
+                        xid, msg_type, int(r.status), r.remaining, r.wait_in_ms
+                    )
+                elif msg_type == C.MSG_TYPE_PARAM_FLOW:
+                    flow_id, acquire, params = body
+                    r = server.service.request_param_token(flow_id, acquire, params)
+                    resp = protocol.pack_response(
+                        xid, msg_type, int(r.status), r.remaining, r.wait_in_ms
+                    )
+                else:
+                    resp = protocol.pack_response(
+                        xid, msg_type, int(C.TokenResultStatus.BAD_REQUEST)
+                    )
+                self.request.sendall(resp)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            server._conn_changed(-1)
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class SentinelTokenServer:
+    """Standalone token server; also usable embedded (the service is
+    directly callable in-process, DefaultEmbeddedTokenServer style)."""
+
+    def __init__(self, port: int = 0, service: Optional[TokenService] = None) -> None:
+        self.service = service or DefaultTokenService()
+        self._requested_port = port
+        self._server: Optional[_TCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._conn_count = 0
+        self._lock = threading.Lock()
+
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            return self._requested_port
+        return self._server.server_address[1]
+
+    def _conn_changed(self, delta: int) -> None:
+        with self._lock:
+            self._conn_count = max(0, self._conn_count + delta)
+            if hasattr(self.service, "set_connected_count"):
+                self.service.set_connected_count(max(1, self._conn_count))
+
+    def start(self) -> "SentinelTokenServer":
+        if self._server is not None:
+            return self
+        self._server = _TCPServer(("0.0.0.0", self._requested_port), _Handler)
+        self._server.token_server = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="sentinel-token-server", daemon=True
+        )
+        self._thread.start()
+        record_log.info("[TokenServer] listening on %d", self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

@@ -1,0 +1,284 @@
+"""The token decision engine.
+
+Reference: DefaultTokenService.requestToken →
+ClusterFlowChecker.acquireClusterToken (sentinel-cluster-server-default/
+.../flow/ClusterFlowChecker.java:36-118):
+
+    globalThreshold = count × (GLOBAL ? 1 : connectedCount) × exceedCount
+    latestQps = ClusterMetric.getAvg(PASS)
+    nextRemaining = globalThreshold - latestQps - acquire
+    pass → metric.add(PASS); else (prioritized occupy …) else BLOCKED
+
+plus the per-namespace GlobalRequestLimiter QPS guard (default 30000/s)
+and NO_RULE_EXISTS / TOO_MANY_REQUEST statuses.
+
+Here the server's per-flowId ClusterMetric LeapArrays are rows of one
+counter tensor (sample 10 × 100 ms, the reference's cluster default) and
+a batch of token requests resolves with the same rank math as the local
+flow kernel; requests arriving in one batch are sequenced
+deterministically, which is strictly tighter than the reference's
+arbitrary Netty arrival order.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.cluster.flow_rules import (
+    cluster_flow_rule_manager,
+    cluster_server_config_manager,
+)
+from sentinel_tpu.metrics import metric_array as ma
+from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.utils.clock import Clock, default_clock
+from sentinel_tpu.utils.numeric import pad_pow2
+
+CLUSTER_CFG = ma.MetricArrayConfig(sample_count=10, interval_ms=1000)
+
+
+class TokenResult(NamedTuple):
+    """Reference: TokenResult.java — status + remaining + waitInMs."""
+
+    status: C.TokenResultStatus
+    remaining: int = 0
+    wait_in_ms: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.status == C.TokenResultStatus.OK
+
+
+class TokenService:
+    """Reference: TokenService.java."""
+
+    def request_token(
+        self, flow_id: int, acquire_count: int = 1, prioritized: bool = False
+    ) -> TokenResult:
+        raise NotImplementedError
+
+    def request_param_token(
+        self, flow_id: int, acquire_count: int, params: List[object]
+    ) -> TokenResult:
+        raise NotImplementedError
+
+
+def _batch_decide(
+    state: ma.MetricArrayState,
+    rows: jax.Array,  # int32 [B] metric row per request
+    ns_rows: jax.Array,  # int32 [B] namespace-limiter row (-1 none)
+    acquire: jax.Array,  # int32 [B]
+    thresholds: jax.Array,  # float32 [B] global threshold per request
+    ns_thresholds: jax.Array,  # float32 [B]
+    valid: jax.Array,  # bool [B]
+    now: jax.Array,  # int32 scalar
+):
+    """One jitted decision pass: namespace guard then flow check, both
+    with intra-batch charging; admitted requests scatter PASS."""
+    interval_sec = CLUSTER_CFG.interval_ms / 1000.0
+    sums = ma.window_sums(CLUSTER_CFG, state, now)[:, MetricEvent.PASS]
+    nrows = state.n_rows
+
+    def consumed(keys: jax.Array) -> jax.Array:
+        pos = jnp.arange(keys.shape[0], dtype=jnp.int32)
+        k_s, p_s = jax.lax.sort((keys, pos), num_keys=1)
+        acq_s = acquire[p_s]
+        excl = jnp.cumsum(acq_s) - acq_s
+        grp = jax.lax.cummax(jnp.where(
+            jnp.concatenate([jnp.ones((1,), bool), k_s[1:] != k_s[:-1]]), excl, 0
+        ))
+        out = jnp.zeros_like(excl).at[p_s].set(excl - grp)
+        return out
+
+    # Namespace guard (GlobalRequestLimiter.tryPass): passQps + acquire
+    # <= maxAllowedQps, charging all prior requests in the batch.
+    ns_key = jnp.where(valid & (ns_rows >= 0), ns_rows, jnp.int32(nrows))
+    ns_consumed = consumed(ns_key)
+    ns_qps = (sums[jnp.clip(ns_rows, 0, nrows - 1)] + ns_consumed).astype(jnp.float32) / interval_sec
+    ns_ok = (ns_rows < 0) | (ns_qps + acquire.astype(jnp.float32) <= ns_thresholds)
+
+    flow_key = jnp.where(valid & ns_ok, rows, jnp.int32(nrows))
+    f_consumed = consumed(flow_key)
+    latest_qps = (sums[jnp.clip(rows, 0, nrows - 1)] + f_consumed).astype(jnp.float32) / interval_sec
+    next_remaining = thresholds - latest_qps - acquire.astype(jnp.float32)
+    flow_ok = next_remaining >= 0
+
+    admitted = valid & ns_ok & flow_ok
+    # Scatter PASS for admitted requests on flow rows and namespace rows.
+    upd_rows = jnp.concatenate(
+        [
+            jnp.where(admitted, rows, jnp.int32(nrows)),
+            jnp.where(admitted & (ns_rows >= 0), ns_rows, jnp.int32(nrows)),
+        ]
+    )
+    upd_ts = jnp.concatenate([jnp.full_like(rows, now), jnp.full_like(rows, now)])
+    deltas = jnp.zeros((upd_rows.shape[0], NUM_EVENTS), dtype=jnp.int32).at[
+        :, MetricEvent.PASS
+    ].set(jnp.concatenate([acquire, acquire]))
+    mask = upd_rows < nrows
+    state = ma.update(CLUSTER_CFG, state, jnp.clip(upd_rows, 0, nrows - 1), upd_ts, deltas, None, mask)
+    return state, admitted, ns_ok, next_remaining
+
+
+_decide_jit = jax.jit(_batch_decide, donate_argnums=(0,))
+
+
+class DefaultTokenService(TokenService):
+    """In-process (embeddable) token service over the batched kernel."""
+
+    def __init__(self, clock: Optional[Clock] = None, initial_rows: int = 64) -> None:
+        self.clock = clock or default_clock()
+        self._lock = threading.RLock()
+        self.state = ma.make_state(pad_pow2(initial_rows), CLUSTER_CFG)
+        self._flow_rows: Dict[int, int] = {}
+        self._ns_rows: Dict[str, int] = {}
+        self._next_row = 0
+        self.connected_count = 1  # ConnectionManager connectedCount analog
+
+    def _row_for_flow(self, flow_id: int) -> int:
+        row = self._flow_rows.get(flow_id)
+        if row is None:
+            row = self._next_row
+            self._next_row += 1
+            self._flow_rows[flow_id] = row
+        return row
+
+    def _row_for_ns(self, namespace: str) -> int:
+        row = self._ns_rows.get(namespace)
+        if row is None:
+            row = self._next_row
+            self._next_row += 1
+            self._ns_rows[namespace] = row
+        return row
+
+    def _ensure_capacity(self) -> None:
+        if self._next_row > self.state.n_rows:
+            self.state = ma.grow(self.state, pad_pow2(self._next_row), CLUSTER_CFG)
+
+    def set_connected_count(self, n: int) -> None:
+        self.connected_count = max(1, n)
+
+    def request_token(
+        self, flow_id: int, acquire_count: int = 1, prioritized: bool = False
+    ) -> TokenResult:
+        results = self.request_tokens([(flow_id, acquire_count, prioritized)])
+        return results[0]
+
+    def request_tokens(self, requests) -> List[TokenResult]:
+        """Batched entry point: [(flow_id, acquire, prioritized)] —
+        the natural fit for both the batched engine and a TCP server
+        draining its accept queue."""
+        out: List[Optional[TokenResult]] = [None] * len(requests)
+        idxs: List[int] = []
+        rows: List[int] = []
+        ns_rows: List[int] = []
+        acq: List[int] = []
+        thr: List[float] = []
+        ns_thr: List[float] = []
+        cfg = cluster_server_config_manager.config
+        with self._lock:
+            for i, (flow_id, acquire_count, _prio) in enumerate(requests):
+                rule = cluster_flow_rule_manager.get_rule_by_id(int(flow_id))
+                if rule is None:
+                    out[i] = TokenResult(C.TokenResultStatus.NO_RULE_EXISTS)
+                    continue
+                cc = rule.cluster_config
+                if cc.threshold_type == C.FLOW_THRESHOLD_GLOBAL:
+                    threshold = rule.count * cfg.exceed_count
+                else:
+                    threshold = rule.count * self.connected_count * cfg.exceed_count
+                ns = cluster_flow_rule_manager.namespace_of(int(flow_id)) or "default"
+                idxs.append(i)
+                rows.append(self._row_for_flow(int(flow_id)))
+                ns_rows.append(self._row_for_ns(ns))
+                acq.append(int(acquire_count))
+                thr.append(float(threshold))
+                ns_thr.append(float(cfg.max_allowed_qps))
+            if not idxs:
+                return [r if r is not None else TokenResult(C.TokenResultStatus.FAIL) for r in out]
+            self._ensure_capacity()
+            b = pad_pow2(len(idxs), 8)
+
+            def pad(arr, fill, dtype):
+                a = np.full(b, fill, dtype=dtype)
+                a[: len(arr)] = arr
+                return jnp.asarray(a)
+
+            now = jnp.int32(self.clock.now_ms())
+            self.state, admitted, ns_ok, remaining = _decide_jit(
+                self.state,
+                pad(rows, 0, np.int32),
+                pad(ns_rows, -1, np.int32),
+                pad(acq, 1, np.int32),
+                pad(thr, 0.0, np.float32),
+                pad(ns_thr, 0.0, np.float32),
+                pad([True] * len(idxs), False, bool),
+                now,
+            )
+            admitted_h, ns_ok_h, rem_h = jax.device_get((admitted, ns_ok, remaining))
+        for j, i in enumerate(idxs):
+            if not ns_ok_h[j]:
+                out[i] = TokenResult(C.TokenResultStatus.TOO_MANY_REQUEST)
+            elif admitted_h[j]:
+                out[i] = TokenResult(C.TokenResultStatus.OK, remaining=int(max(rem_h[j], 0)))
+            else:
+                out[i] = TokenResult(C.TokenResultStatus.BLOCKED)
+        return [r if r is not None else TokenResult(C.TokenResultStatus.FAIL) for r in out]
+
+    def request_param_token(
+        self, flow_id: int, acquire_count: int, params: List[object]
+    ) -> TokenResult:
+        # Cluster hot-param tokens: same decision shape keyed by
+        # (flow_id, param value) rows (ClusterParamFlowChecker). The
+        # row space is shared with flow rows via string keys.
+        rule = cluster_flow_rule_manager.get_rule_by_id(int(flow_id))
+        if rule is None:
+            return TokenResult(C.TokenResultStatus.NO_RULE_EXISTS)
+        reqs = []
+        with self._lock:
+            for p in params:
+                key = f"p:{flow_id}:{p}"
+                row = self._flow_rows.get(key)  # type: ignore[arg-type]
+                if row is None:
+                    row = self._next_row
+                    self._next_row += 1
+                    self._flow_rows[key] = row  # type: ignore[index]
+                reqs.append(row)
+        # Reuse request_tokens machinery by faking per-param "flows":
+        # simplest correct behavior: check each param row against the
+        # rule count; any blocked param blocks the request.
+        cfg = cluster_server_config_manager.config
+        with self._lock:
+            self._ensure_capacity()
+            b = pad_pow2(len(reqs), 8)
+            rows_a = np.zeros(b, dtype=np.int32)
+            rows_a[: len(reqs)] = reqs
+            valid = np.zeros(b, dtype=bool)
+            valid[: len(reqs)] = True
+            now = jnp.int32(self.clock.now_ms())
+            self.state, admitted, _, _ = _decide_jit(
+                self.state,
+                jnp.asarray(rows_a),
+                jnp.full(b, -1, dtype=jnp.int32),
+                jnp.full(b, int(acquire_count), dtype=jnp.int32),
+                jnp.full(b, float(rule.count * cfg.exceed_count), dtype=jnp.float32),
+                jnp.zeros(b, dtype=jnp.float32),
+                jnp.asarray(valid),
+                now,
+            )
+            admitted_h = np.asarray(jax.device_get(admitted))
+        if bool(admitted_h[: len(reqs)].all()):
+            return TokenResult(C.TokenResultStatus.OK)
+        return TokenResult(C.TokenResultStatus.BLOCKED)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.state = ma.make_state(self.state.n_rows, CLUSTER_CFG)
+            self._flow_rows.clear()
+            self._ns_rows.clear()
+            self._next_row = 0

@@ -1,0 +1,44 @@
+"""Dynamic rule datasources.
+
+Equivalent of sentinel-datasource-extension (reference:
+sentinel-extension/sentinel-datasource-extension/.../datasource/
+ReadableDataSource.java:28-44, AbstractDataSource.java:29-48,
+AutoRefreshDataSource.java:32-69, FileRefreshableDataSource.java:39,
+FileWritableDataSource.java:33): a datasource adapts an external config
+store to a SentinelProperty that rule managers listen on. The reference
+ships adapters for Nacos/ZooKeeper/Apollo/etcd/Redis/Consul/Eureka —
+all following the same watch-callback → ``property.update_value`` shape;
+here the file and in-memory sources are first-class and the push-style
+base class (:class:`PushDataSource`) is the extension point for any
+external store client.
+"""
+
+from sentinel_tpu.datasource.base import (
+    AbstractDataSource,
+    AutoRefreshDataSource,
+    Converter,
+    InMemoryDataSource,
+    PushDataSource,
+    ReadableDataSource,
+    WritableDataSource,
+    WritableDataSourceRegistry,
+    json_converter,
+)
+from sentinel_tpu.datasource.file_source import (
+    FileRefreshableDataSource,
+    FileWritableDataSource,
+)
+
+__all__ = [
+    "AbstractDataSource",
+    "AutoRefreshDataSource",
+    "Converter",
+    "InMemoryDataSource",
+    "PushDataSource",
+    "ReadableDataSource",
+    "WritableDataSource",
+    "WritableDataSourceRegistry",
+    "json_converter",
+    "FileRefreshableDataSource",
+    "FileWritableDataSource",
+]

@@ -1,0 +1,27 @@
+"""Multi-chip execution: device meshes and ICI collectives.
+
+The reference scales cluster flow control through a Netty token server
+(SURVEY.md §2.3); the TPU-native design replaces that RPC hop with XLA
+collectives over ICI: every chip runs the same jitted flush on its shard
+of the traffic against replicated counters, and window deltas +
+cluster-global limits are combined with ``psum``/``pmax`` inside the
+step (see :mod:`sentinel_tpu.parallel.ici`).
+"""
+
+from sentinel_tpu.parallel.mesh import make_mesh
+from sentinel_tpu.parallel.ici import (
+    merge_window_across,
+    merge_stats_across,
+    cluster_allocate,
+    make_sharded_flush,
+    batch_partition_specs,
+)
+
+__all__ = [
+    "make_mesh",
+    "merge_window_across",
+    "merge_stats_across",
+    "cluster_allocate",
+    "make_sharded_flush",
+    "batch_partition_specs",
+]

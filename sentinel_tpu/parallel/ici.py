@@ -1,0 +1,149 @@
+"""ICI-collective building blocks for multi-chip flow control.
+
+This module is the TPU-native replacement for the reference's
+token-server RPC (SURVEY.md §5 "Distributed communication backend"):
+instead of every app instance RPCing a single Netty server that owns the
+global ClusterMetric (reference: sentinel-cluster-server-default/.../
+flow/ClusterFlowChecker.java:36-118), every chip holds replicated
+counter tensors, processes its shard of the entry batch, and the merged
+global state is reconstructed with ``psum``/``pmax``/``pmin`` inside the
+jitted step — one ICI all-reduce instead of a network round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sentinel_tpu.metrics.metric_array import MetricArrayState
+from sentinel_tpu.metrics.nodes import StatsState
+
+
+def merge_window_across(old: MetricArrayState, new: MetricArrayState, axis: str) -> MetricArrayState:
+    """Rollover-aware all-reduce of one window array.
+
+    A device that rolled a bucket to a newer window zeroed the old
+    counts, so a naive delta-psum would subtract the old counts once per
+    rolling device. Instead: the merged window start is the max across
+    devices; only devices whose final window matches it contribute
+    (their counts minus the shared base, which is the old counts iff the
+    old window already was the merged one).
+    """
+    g_ws = jax.lax.pmax(new.window_start, axis)
+    old_cur = (old.window_start == g_ws)[:, :, None]
+    new_cur = (new.window_start == g_ws)[:, :, None]
+    base = jnp.where(old_cur, old.counts, 0)
+    contrib = jnp.where(new_cur, new.counts - base, 0)
+    counts = base + jax.lax.psum(contrib, axis)
+    big = jnp.int32(2**31 - 1)
+    min_rt = jnp.minimum(
+        jnp.where(old.window_start == g_ws, old.min_rt, big),
+        jax.lax.pmin(jnp.where(new.window_start == g_ws, new.min_rt, big), axis),
+    )
+    return MetricArrayState(counts=counts, min_rt=min_rt, window_start=g_ws)
+
+
+def merge_stats_across(old: StatsState, new: StatsState, axis: str) -> StatsState:
+    """All-reduce the full stats family (second + minute + thread gauge)."""
+    return StatsState(
+        second=merge_window_across(old.second, new.second, axis),
+        minute=merge_window_across(old.minute, new.minute, axis),
+        threads=old.threads + jax.lax.psum(new.threads - old.threads, axis),
+    )
+
+
+def cluster_allocate(
+    axis: str, demand: jax.Array, capacity: jax.Array
+) -> jax.Array:
+    """Greedy chip-indexed allocation of global capacity.
+
+    Each chip has ``demand`` admission candidates for a cluster rule;
+    the global remaining capacity is split by exclusive prefix over the
+    mesh axis: chip i may admit ``min(demand_i, capacity -
+    sum_{j<i} demand_j)``. Deterministic and conserving — the analog of
+    the token server serializing client requests in arrival order
+    (arrival order there is nondeterministic; chip index here is).
+    Shapes: demand/capacity broadcastable; returns per-chip grant.
+    """
+    idx = jax.lax.axis_index(axis)
+    n = jax.lax.axis_size(axis)
+    # Exclusive prefix sum over the axis via one-hot matmul-free trick:
+    # gather all demands, mask those with lower index.
+    all_d = jax.lax.all_gather(demand, axis)  # [n, ...]
+    ranks = jnp.arange(n)
+    shape = (n,) + (1,) * (all_d.ndim - 1)
+    before = jnp.sum(jnp.where(ranks.reshape(shape) < idx, all_d, 0), axis=0)
+    left = jnp.maximum(capacity - before, 0)
+    return jnp.minimum(demand, left)
+
+
+def batch_partition_specs(axis: str = "data"):
+    """PartitionSpec pytree for a FlushBatch: entries/exits sharded over
+    the mesh, scalars replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from sentinel_tpu.runtime.flush import FlushBatch
+
+    return FlushBatch(
+        now=P(),
+        e_valid=P(axis),
+        e_ts=P(axis),
+        e_acquire=P(axis),
+        e_rows=P(axis, None),
+        e_rule_gid=P(axis, None),
+        e_check_row=P(axis, None),
+        e_prio=P(axis),
+        e_auth_ok=P(axis),
+        e_cluster_ok=P(axis),
+        e_dgid=P(axis, None),
+        x_valid=P(axis),
+        x_ts=P(axis),
+        x_count=P(axis),
+        x_rows=P(axis, None),
+        x_rt=P(axis),
+        x_err=P(axis),
+        x_thr=P(axis),
+        x_dgid=P(axis, None),
+    )
+
+
+def make_sharded_flush(mesh, axis: str = "data"):
+    """The full batched step over an n-device mesh.
+
+    Entries and exits are data-parallel across chips; counter tensors
+    and rule tables are replicated; after each local flush the window
+    deltas and breaker state are all-reduced so every chip ends the step
+    with the identical global state. Returns a jitted callable with the
+    same signature as ``flush_step`` (without shaping/param batches —
+    their per-rule scans are inherently serializing and stay
+    single-chip for now).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from sentinel_tpu.runtime.flush import flush_step
+
+    def sharded_step(stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch):
+        new_stats, new_fdyn, new_ddyn, new_pdyn, result = flush_step(
+            stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch
+        )
+        merged = merge_stats_across(stats, new_stats, axis)
+        merged_ddyn = type(ddyn)(
+            state=jax.lax.pmax(new_ddyn.state, axis),
+            next_retry=jax.lax.pmax(new_ddyn.next_retry, axis),
+            bad=ddyn.bad + jax.lax.psum(new_ddyn.bad - ddyn.bad, axis),
+            total=ddyn.total + jax.lax.psum(new_ddyn.total - ddyn.total, axis),
+            ws=jax.lax.pmax(new_ddyn.ws, axis),
+        )
+        return merged, new_fdyn, merged_ddyn, new_pdyn, result
+
+    fn = shard_map(
+        sharded_step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(), P(), P(), P(), batch_partition_specs(axis)),
+        out_specs=(P(), P(), P(), P(), P(axis)),
+        check_vma=False,
+    )
+    return jax.jit(fn)

@@ -1,0 +1,22 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = "data"):
+    """1-D mesh over the first ``n_devices`` devices (data-parallel over
+    entries — the natural layout for flow-control traffic; counter rows
+    are replicated and merged with collectives)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), axis_names=(axis_name,))

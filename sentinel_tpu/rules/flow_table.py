@@ -122,6 +122,11 @@ class FlowIndex:
             for cr in self.rules
             if cr.rule.control_behavior != C.CONTROL_BEHAVIOR_DEFAULT
         }
+        # Cluster-mode rules route through the token service
+        # (FlowRuleChecker.passClusterCheck) instead of the local check.
+        self.cluster_gids = {
+            cr.gid: cr.rule for cr in self.rules if cr.rule.cluster_mode
+        }
 
     def _build_device(self) -> FlowTableDevice:
         n = _pad_pow2(len(self.rules))

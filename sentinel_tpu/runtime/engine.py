@@ -88,6 +88,7 @@ class _EntryOp:
     p_slots: List[ParamSlotInfo] = field(default_factory=list)  # hot-param slots
     auth_ok: bool = True
     prio: bool = False
+    cluster_blocked_rule: Optional[object] = None  # token server said BLOCKED
     verdict: Optional[Verdict] = None
 
     @property
@@ -240,6 +241,7 @@ class Engine:
             if rows is None:
                 return None
             slots = self.flow_index.resolve_slots(resource, context_name, origin, self.nodes)
+            cluster_gids = self.flow_index.cluster_gids
             auth_ok = True
             arule = self.authority_rules.get(resource)
             if arule is not None:
@@ -260,8 +262,61 @@ class Engine:
                 auth_ok=auth_ok,
                 prio=prio,
             )
+        # Cluster-mode rules consult the token service OUTSIDE the engine
+        # lock (it may be a network RPC — FlowRuleChecker.passClusterCheck
+        # crossing to the token server, FlowRuleChecker.java:168-230).
+        if cluster_gids and any(gid in cluster_gids for gid, _ in op.slots):
+            self._apply_cluster_checks(op, cluster_gids)
+        with self._lock:
             self._entries.append(op)
         return op
+
+    def _apply_cluster_checks(self, op: _EntryOp, cluster_gids) -> None:
+        """applyTokenResult (FlowRuleChecker.java:207-230): OK → pass
+        (drop the local slot), SHOULD_WAIT → sleep then pass, BLOCKED →
+        block, anything else → fallback to local checking when the rule
+        allows it, else pass."""
+        from sentinel_tpu.cluster.state import (
+            ClusterStateManager,
+            EmbeddedClusterTokenServerProvider,
+            TokenClientProvider,
+        )
+        from sentinel_tpu.models import constants as _C
+
+        service = None
+        if ClusterStateManager.is_client():
+            service = TokenClientProvider.get_client()
+        elif ClusterStateManager.is_server():
+            server = EmbeddedClusterTokenServerProvider.get_server()
+            service = getattr(server, "service", server)
+        kept = []
+        for gid, crow in op.slots:
+            rule = cluster_gids.get(gid)
+            if rule is None:
+                kept.append((gid, crow))
+                continue
+            cc = rule.cluster_config
+            if service is None:
+                if cc.fallback_to_local_when_fail:
+                    kept.append((gid, crow))
+                continue
+            try:
+                result = service.request_token(cc.flow_id, op.acquire, op.prio)
+            except Exception:
+                result = None
+            status = result.status if result is not None else _C.TokenResultStatus.FAIL
+            if status == _C.TokenResultStatus.OK:
+                continue  # token granted: rule passes
+            if status == _C.TokenResultStatus.SHOULD_WAIT:
+                self.clock.sleep_ms(result.wait_in_ms)
+                continue
+            if status == _C.TokenResultStatus.BLOCKED:
+                op.cluster_blocked_rule = rule
+                continue
+            # FAIL / NO_RULE_EXISTS / TOO_MANY_REQUEST / BAD_REQUEST ...
+            if cc.fallback_to_local_when_fail:
+                kept.append((gid, crow))
+        op.slots = kept
 
     def submit_exit(
         self,
@@ -453,6 +508,7 @@ class Engine:
             e_crow = np.full((n, k), -1, dtype=np.int32)
             e_prio = np.zeros(n, dtype=bool)
             e_auth = np.ones(n, dtype=bool)
+            e_cluster = np.ones(n, dtype=bool)
             e_dgid = np.full((n, kd), -1, dtype=np.int32)
             for i, op in enumerate(entries):
                 e_valid[i] = True
@@ -466,6 +522,7 @@ class Engine:
                     e_dgid[i, j] = dg
                 e_prio[i] = op.prio
                 e_auth[i] = op.auth_ok
+                e_cluster[i] = op.cluster_blocked_rule is None
 
             x_valid = np.zeros(m, dtype=bool)
             x_ts = np.zeros(m, dtype=np.int32)
@@ -496,6 +553,7 @@ class Engine:
                 e_check_row=jnp.asarray(e_crow),
                 e_prio=jnp.asarray(e_prio),
                 e_auth_ok=jnp.asarray(e_auth),
+                e_cluster_ok=jnp.asarray(e_cluster),
                 e_dgid=jnp.asarray(e_dgid),
                 x_valid=jnp.asarray(x_valid),
                 x_ts=jnp.asarray(x_ts),
@@ -552,10 +610,13 @@ class Engine:
                     elif r == E.BLOCK_SYSTEM:
                         limit_type = SYS_TYPE_NAMES.get(int(sys_type[i]), "")
                     elif r == E.BLOCK_FLOW:
-                        for j, (gid, _) in enumerate(op.slots[:k]):
-                            if not slot_ok[i, j]:
-                                blocked_rule = self.flow_index.rule_of_gid(gid)
-                                break
+                        if op.cluster_blocked_rule is not None:
+                            blocked_rule = op.cluster_blocked_rule
+                        else:
+                            for j, (gid, _) in enumerate(op.slots[:k]):
+                                if not slot_ok[i, j]:
+                                    blocked_rule = self.flow_index.rule_of_gid(gid)
+                                    break
                     elif r == E.BLOCK_PARAM:
                         blocked_rule = op.p_slots[0].rule if op.p_slots else None
                     elif r == E.BLOCK_DEGRADE:

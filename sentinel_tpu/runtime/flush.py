@@ -76,6 +76,8 @@ class FlushBatch(NamedTuple):
     e_prio: jax.Array  # bool [N] (occupy/priority — not yet active)
     e_auth_ok: jax.Array  # bool [N] — AuthoritySlot verdict (host-resolved
     # origin set membership, AuthorityRuleChecker.java:31-60)
+    e_cluster_ok: jax.Array  # bool [N] — token-server verdict for
+    # cluster-mode flow rules (BLOCKED → False; FlowRuleChecker.java:207)
     e_dgid: jax.Array  # int32 [N, KD] degrade-rule ids of the resource
     # --- exits and traces ---
     x_valid: jax.Array  # bool [M]
@@ -418,6 +420,7 @@ def flush_step(
         flow_pass = slot_ok.all(axis=1)
         eidx_scatter = jnp.where(shaping_live.valid, shaping.eidx, jnp.int32(n))
         wait_ms = wait_ms.at[eidx_scatter].max(wait_s, mode="drop")
+    flow_pass = flow_pass & batch.e_cluster_ok
     live2 = live & flow_pass
     wait_ms = jnp.where(live2, wait_ms, 0)
 

@@ -1,0 +1,204 @@
+"""Cluster flow control tests: token service decisions
+(ClusterFlowChecker semantics), TCP server/client round trip, engine
+integration (passClusterCheck/applyTokenResult), ICI allocation."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import (
+    ClusterStateManager,
+    DefaultTokenService,
+    EmbeddedClusterTokenServerProvider,
+    TokenClientProvider,
+    cluster_flow_rule_manager,
+    cluster_server_config_manager,
+)
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.server import SentinelTokenServer
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import ClusterFlowConfig, FlowRule
+from sentinel_tpu.utils.clock import ManualClock
+
+
+def cluster_rule(resource, count, flow_id, threshold_type=C.FLOW_THRESHOLD_GLOBAL,
+                 fallback=True):
+    return FlowRule(
+        resource,
+        count=count,
+        cluster_mode=True,
+        cluster_config=ClusterFlowConfig(
+            flow_id=flow_id, threshold_type=threshold_type,
+            fallback_to_local_when_fail=fallback,
+        ),
+    )
+
+
+@pytest.fixture()
+def cluster_env():
+    cluster_flow_rule_manager.clear()
+    cluster_server_config_manager.load_global_flow_config(
+        exceed_count=1.0, max_allowed_qps=30000.0
+    )
+    yield
+    cluster_flow_rule_manager.clear()
+    ClusterStateManager.stop()
+    TokenClientProvider.clear()
+    EmbeddedClusterTokenServerProvider.clear()
+
+
+class TestTokenService:
+    def test_global_threshold(self, cluster_env):
+        clock = ManualClock(0)
+        svc = DefaultTokenService(clock=clock)
+        cluster_flow_rule_manager.load_rules(
+            "default", [cluster_rule("r", 5, flow_id=101)]
+        )
+        results = [svc.request_token(101) for _ in range(7)]
+        assert [r.ok for r in results] == [True] * 5 + [False] * 2
+        assert results[-1].status == C.TokenResultStatus.BLOCKED
+
+    def test_no_rule(self, cluster_env):
+        svc = DefaultTokenService(clock=ManualClock(0))
+        assert svc.request_token(999).status == C.TokenResultStatus.NO_RULE_EXISTS
+
+    def test_avg_local_scales_with_connections(self, cluster_env):
+        clock = ManualClock(0)
+        svc = DefaultTokenService(clock=clock)
+        svc.set_connected_count(3)
+        cluster_flow_rule_manager.load_rules(
+            "default",
+            [cluster_rule("r", 2, flow_id=7, threshold_type=C.FLOW_THRESHOLD_AVG_LOCAL)],
+        )
+        # threshold = 2 * 3 connections = 6
+        results = [svc.request_token(7) for _ in range(8)]
+        assert sum(r.ok for r in results) == 6
+
+    def test_window_slides(self, cluster_env):
+        clock = ManualClock(0)
+        svc = DefaultTokenService(clock=clock)
+        cluster_flow_rule_manager.load_rules("default", [cluster_rule("r", 2, flow_id=1)])
+        assert svc.request_token(1).ok
+        assert svc.request_token(1).ok
+        assert not svc.request_token(1).ok
+        clock.set_ms(1101)  # pass counts at t=0 fall out of the 1s window
+        assert svc.request_token(1).ok
+
+    def test_namespace_guard(self, cluster_env):
+        clock = ManualClock(0)
+        cluster_server_config_manager.load_global_flow_config(max_allowed_qps=3.0)
+        svc = DefaultTokenService(clock=clock)
+        cluster_flow_rule_manager.load_rules("default", [cluster_rule("r", 100, flow_id=2)])
+        results = [svc.request_token(2) for _ in range(5)]
+        assert sum(r.ok for r in results) == 3
+        assert results[-1].status == C.TokenResultStatus.TOO_MANY_REQUEST
+
+    def test_batched_requests(self, cluster_env):
+        svc = DefaultTokenService(clock=ManualClock(0))
+        cluster_flow_rule_manager.load_rules("default", [cluster_rule("r", 4, flow_id=3)])
+        results = svc.request_tokens([(3, 1, False)] * 6)
+        assert [r.ok for r in results] == [True] * 4 + [False] * 2
+
+
+class TestTcpRoundTrip:
+    def test_client_server(self, cluster_env):
+        cluster_flow_rule_manager.load_rules("default", [cluster_rule("r", 3, flow_id=42)])
+        server = SentinelTokenServer(port=0, service=DefaultTokenService(clock=ManualClock(0)))
+        server.start()
+        try:
+            client = ClusterTokenClient("127.0.0.1", server.port).start()
+            results = [client.request_token(42) for _ in range(5)]
+            assert [r.ok for r in results] == [True] * 3 + [False] * 2
+            assert client.request_token(777).status == C.TokenResultStatus.NO_RULE_EXISTS
+            client.stop()
+        finally:
+            server.stop()
+
+    def test_client_fail_when_no_server(self, cluster_env):
+        client = ClusterTokenClient("127.0.0.1", 1)  # nothing listens
+        assert client.request_token(1).status == C.TokenResultStatus.FAIL
+
+    def test_concurrent_clients(self, cluster_env):
+        cluster_flow_rule_manager.load_rules("default", [cluster_rule("r", 50, flow_id=9)])
+        server = SentinelTokenServer(port=0, service=DefaultTokenService(clock=ManualClock(0)))
+        server.start()
+        try:
+            client = ClusterTokenClient("127.0.0.1", server.port).start()
+            oks = []
+            lock = threading.Lock()
+
+            def worker():
+                r = client.request_token(9)
+                with lock:
+                    oks.append(r.ok)
+
+            threads = [threading.Thread(target=worker) for _ in range(60)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert sum(oks) == 50
+            client.stop()
+        finally:
+            server.stop()
+
+
+class TestEngineIntegration:
+    def test_embedded_server_mode(self, cluster_env, manual_clock, engine):
+        """Engine entries route cluster rules through the embedded token
+        service; BLOCKED maps to FlowBlockError."""
+        rule = cluster_rule("svc", 2, flow_id=55)
+        cluster_flow_rule_manager.load_rules("default", [rule])
+        service = DefaultTokenService(clock=manual_clock)
+        server = SentinelTokenServer(port=0, service=service)  # not started: embedded
+        EmbeddedClusterTokenServerProvider.register(server)
+        ClusterStateManager.set_to_server()
+        st.flow_rule_manager.load_rules([rule])
+        assert st.try_entry("svc") is not None
+        assert st.try_entry("svc") is not None
+        assert st.try_entry("svc") is None  # token server says BLOCKED
+        with pytest.raises(st.FlowBlockError) as ei:
+            st.entry("svc")
+        assert ei.value.rule == rule
+
+    def test_fallback_to_local_when_no_service(self, cluster_env, manual_clock, engine):
+        rule = cluster_rule("fb", 1, flow_id=66, fallback=True)
+        st.flow_rule_manager.load_rules([rule])
+        ClusterStateManager.stop()
+        # no client/server -> local check applies count=1
+        assert st.try_entry("fb") is not None
+        assert st.try_entry("fb") is None
+
+    def test_pass_when_no_service_and_no_fallback(self, cluster_env, manual_clock, engine):
+        rule = cluster_rule("nf", 1, flow_id=67, fallback=False)
+        st.flow_rule_manager.load_rules([rule])
+        ClusterStateManager.stop()
+        for _ in range(5):
+            e = st.try_entry("nf")
+            assert e is not None
+            e.exit()
+
+
+class TestIciAllocation:
+    def test_cluster_allocate_conserves_capacity(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from sentinel_tpu.parallel import cluster_allocate, make_mesh
+
+        mesh = make_mesh(8)
+        demands = jnp.asarray(np.array([5, 3, 7, 0, 2, 9, 1, 4], dtype=np.int32))
+
+        def alloc(d):
+            return cluster_allocate("data", d, jnp.int32(10))
+
+        fn = shard_map(alloc, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+        grants = np.asarray(jax.jit(fn)(demands))
+        assert grants.sum() == 10  # exactly the capacity
+        # Greedy by chip index: 5, 3, 2, 0, 0, ...
+        assert list(grants) == [5, 3, 2, 0, 0, 0, 0, 0]
+        assert (grants <= np.asarray(demands)).all()
