@@ -1,0 +1,28 @@
+"""Dashboard: machine discovery, metric aggregation, rule management.
+
+Equivalent of sentinel-dashboard (reference: .../dashboard/metric/
+MetricFetcher.java:70-282 polling every machine's /metric each second
+into an InMemoryMetricsRepository with 5-minute retention;
+discovery/SimpleMachineDiscovery fed by /registry/machine heartbeats;
+client/SentinelApiClient.java:93 pushing/pulling rules through the
+command API; REST controllers per rule type). The AngularJS console is
+out of scope — the JSON REST surface it sits on is here.
+"""
+
+from sentinel_tpu.dashboard.app import (
+    DashboardServer,
+    AppManagement,
+    InMemoryMetricsRepository,
+    MachineInfo,
+    MetricFetcher,
+    SentinelApiClient,
+)
+
+__all__ = [
+    "DashboardServer",
+    "AppManagement",
+    "InMemoryMetricsRepository",
+    "MachineInfo",
+    "MetricFetcher",
+    "SentinelApiClient",
+]

@@ -1,0 +1,330 @@
+"""Dashboard server: discovery + metrics + rule REST.
+
+HTTP surface (JSON unless noted):
+
+    GET  /registry/machine?app=&ip=&port=...   heartbeat registration
+    GET  /apps                                 known apps + machines
+    GET  /metric?app=&identity=&startTime=&endTime=   aggregated metrics
+    GET  /rules?app=&type=flow|degrade|...     pull rules from machines
+    POST /rules?app=&type=&data=<json>         push rules to machines
+    GET  /clusterNode?app=                     live cluster-node stats
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+from collections import defaultdict
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qsl, urlparse
+
+from sentinel_tpu.metrics.metric_log import MetricNodeLine
+from sentinel_tpu.utils.record_log import record_log
+
+
+@dataclass
+class MachineInfo:
+    app: str
+    ip: str
+    port: int
+    hostname: str = ""
+    version: str = ""
+    last_heartbeat_ms: float = field(default_factory=lambda: time.time() * 1000)
+
+    @property
+    def key(self) -> Tuple[str, str, int]:
+        return (self.app, self.ip, self.port)
+
+    def is_healthy(self, timeout_ms: float = 60_000) -> bool:
+        return time.time() * 1000 - self.last_heartbeat_ms < timeout_ms
+
+
+class AppManagement:
+    """SimpleMachineDiscovery + AppManagement."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._machines: Dict[Tuple[str, str, int], MachineInfo] = {}
+
+    def register(self, info: MachineInfo) -> None:
+        with self._lock:
+            existing = self._machines.get(info.key)
+            if existing is not None:
+                existing.last_heartbeat_ms = time.time() * 1000
+                existing.version = info.version or existing.version
+            else:
+                self._machines[info.key] = info
+
+    def apps(self) -> Dict[str, List[MachineInfo]]:
+        with self._lock:
+            out: Dict[str, List[MachineInfo]] = defaultdict(list)
+            for m in self._machines.values():
+                out[m.app].append(m)
+            return dict(out)
+
+    def machines_of(self, app: str) -> List[MachineInfo]:
+        with self._lock:
+            return [m for m in self._machines.values() if m.app == app]
+
+
+class InMemoryMetricsRepository:
+    """5-minute in-memory metric store keyed by (app, resource)
+    (repository/metric/InMemoryMetricsRepository.java:40)."""
+
+    RETENTION_MS = 5 * 60 * 1000
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._data: Dict[Tuple[str, str], List[MetricNodeLine]] = defaultdict(list)
+
+    def save_all(self, app: str, nodes: List[MetricNodeLine]) -> None:
+        now = time.time() * 1000
+        with self._lock:
+            for n in nodes:
+                lst = self._data[(app, n.resource)]
+                lst.append(n)
+                cutoff = now - self.RETENTION_MS
+                while lst and lst[0].timestamp < cutoff:
+                    lst.pop(0)
+
+    def query(self, app: str, resource: str, begin_ms: int, end_ms: int) -> List[MetricNodeLine]:
+        with self._lock:
+            return [
+                n
+                for n in self._data.get((app, resource), ())
+                if begin_ms <= n.timestamp <= end_ms
+            ]
+
+    def resources_of(self, app: str) -> List[str]:
+        with self._lock:
+            return sorted({r for (a, r) in self._data if a == app})
+
+
+class SentinelApiClient:
+    """Pull/push from/to app machines via their command API
+    (client/SentinelApiClient.java:93)."""
+
+    def __init__(self, timeout_sec: float = 3.0) -> None:
+        self.timeout = timeout_sec
+
+    def _get(self, ip: str, port: int, path: str, params: Dict[str, str]) -> Optional[str]:
+        qs = urllib.parse.urlencode(params)
+        url = f"http://{ip}:{port}/{path}?{qs}"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+                return resp.read().decode("utf-8")
+        except OSError:
+            record_log.warn("[SentinelApiClient] GET %s failed", url)
+            return None
+
+    def fetch_metrics(self, m: MachineInfo, begin_ms: int, end_ms: int) -> List[MetricNodeLine]:
+        raw = self._get(m.ip, m.port, "metric", {"startTime": begin_ms, "endTime": end_ms})
+        if not raw:
+            return []
+        out = []
+        for line in raw.splitlines():
+            node = MetricNodeLine.from_line(line)
+            if node is not None:
+                out.append(node)
+        return out
+
+    def fetch_rules(self, m: MachineInfo, kind: str) -> Optional[List[dict]]:
+        raw = self._get(m.ip, m.port, "getRules", {"type": kind})
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    def set_rules(self, m: MachineInfo, kind: str, rules_json: str) -> bool:
+        raw = self._get(m.ip, m.port, "setRules", {"type": kind, "data": rules_json})
+        return raw == "success"
+
+    def fetch_cluster_nodes(self, m: MachineInfo) -> Optional[List[dict]]:
+        raw = self._get(m.ip, m.port, "clusterNode", {})
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+
+class MetricFetcher:
+    """Polls every healthy machine's /metric window into the repository
+    (metric/MetricFetcher.java:70-282)."""
+
+    def __init__(
+        self,
+        apps: AppManagement,
+        repo: InMemoryMetricsRepository,
+        client: Optional[SentinelApiClient] = None,
+        interval_sec: float = 1.0,
+    ) -> None:
+        self.apps = apps
+        self.repo = repo
+        self.client = client or SentinelApiClient()
+        self.interval = interval_sec
+        self._last_fetch: Dict[Tuple[str, str, int], int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def fetch_once(self) -> int:
+        total = 0
+        now = int(time.time() * 1000)
+        for app, machines in self.apps.apps().items():
+            for m in machines:
+                if not m.is_healthy():
+                    continue
+                begin = self._last_fetch.get(m.key, now - 6000)
+                nodes = self.client.fetch_metrics(m, begin + 1, now)
+                if nodes:
+                    self.repo.save_all(app, nodes)
+                    self._last_fetch[m.key] = max(n.timestamp for n in nodes)
+                    total += len(nodes)
+        return total
+
+    def start(self) -> "MetricFetcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="sentinel-metric-fetcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.fetch_once()
+            except Exception:
+                record_log.error("[MetricFetcher] fetch failed", exc_info=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class DashboardServer:
+    """The REST facade over discovery + repo + api client."""
+
+    def __init__(self, port: int = 0, fetch_interval_sec: float = 1.0) -> None:
+        self.apps = AppManagement()
+        self.repo = InMemoryMetricsRepository()
+        self.client = SentinelApiClient()
+        self.fetcher = MetricFetcher(self.apps, self.repo, self.client, fetch_interval_sec)
+        self._requested_port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            return self._requested_port
+        return self._server.server_address[1]
+
+    # ---- request handling ----
+    def _handle(self, path: str, params: Dict[str, str]) -> Tuple[int, str]:
+        if path == "/registry/machine":
+            try:
+                info = MachineInfo(
+                    app=params.get("app", "unknown"),
+                    ip=params.get("ip", "127.0.0.1"),
+                    port=int(params.get("port", 0)),
+                    hostname=params.get("hostname", ""),
+                    version=params.get("version", params.get("v", "")),
+                )
+            except ValueError:
+                return 400, json.dumps({"code": -1, "msg": "bad port"})
+            self.apps.register(info)
+            return 200, json.dumps({"code": 0, "msg": "success"})
+        if path == "/apps":
+            return 200, json.dumps(
+                {
+                    app: [
+                        {"ip": m.ip, "port": m.port, "healthy": m.is_healthy()}
+                        for m in machines
+                    ]
+                    for app, machines in self.apps.apps().items()
+                }
+            )
+        if path == "/metric":
+            app = params.get("app", "")
+            resource = params.get("identity", "")
+            begin = int(params.get("startTime", 0))
+            end = int(params.get("endTime", 2**62))
+            if resource:
+                nodes = self.repo.query(app, resource, begin, end)
+            else:
+                nodes = []
+                for r in self.repo.resources_of(app):
+                    nodes.extend(self.repo.query(app, r, begin, end))
+            return 200, json.dumps([n.__dict__ for n in nodes])
+        if path == "/resources":
+            return 200, json.dumps(self.repo.resources_of(params.get("app", "")))
+        if path == "/rules":
+            app = params.get("app", "")
+            kind = params.get("type", "flow")
+            data = params.get("data")
+            machines = [m for m in self.apps.machines_of(app) if m.is_healthy()]
+            if not machines:
+                return 404, json.dumps({"code": -1, "msg": f"no machines for {app}"})
+            if data is not None:
+                ok = all(self.client.set_rules(m, kind, data) for m in machines)
+                return 200, json.dumps({"code": 0 if ok else -1})
+            rules = self.client.fetch_rules(machines[0], kind)
+            return 200, json.dumps(rules if rules is not None else [])
+        if path == "/clusterNode":
+            app = params.get("app", "")
+            machines = [m for m in self.apps.machines_of(app) if m.is_healthy()]
+            if not machines:
+                return 200, json.dumps([])
+            return 200, json.dumps(self.client.fetch_cluster_nodes(machines[0]) or [])
+        if path == "/version":
+            from sentinel_tpu.version import __version__
+
+            return 200, __version__
+        return 404, json.dumps({"code": -1, "msg": f"unknown path {path}"})
+
+    def start(self) -> "DashboardServer":
+        if self._server is not None:
+            return self
+        dashboard = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                record_log.debug("[Dashboard] " + fmt, *args)
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                params = dict(parse_qsl(parsed.query))
+                code, body = dashboard._handle(parsed.path, params)
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            do_POST = do_GET
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", self._requested_port), _Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="sentinel-dashboard", daemon=True
+        )
+        self._thread.start()
+        self.fetcher.start()
+        record_log.info("[Dashboard] listening on %d", self.port)
+        return self
+
+    def stop(self) -> None:
+        self.fetcher.stop()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

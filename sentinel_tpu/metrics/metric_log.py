@@ -1,0 +1,298 @@
+"""Metric log pipeline: per-second aggregation, rolled files, search.
+
+Reference: MetricTimerListener (node/metric/MetricTimerListener.java:34-70)
+aggregates every ClusterNode + the global ENTRY_NODE once per second
+into MetricNode lines; MetricWriter (MetricWriter.java:47-94) writes
+size-rolled ``{app}-metrics.log.N`` files with ``.idx`` second→offset
+index files; MetricSearcher/MetricsReader read them back by time range
+for the dashboard's /metric pull (SendMetricCommandHandler.java:41-89).
+
+Line format matches MetricNode.toThinString order so existing parsers
+carry over::
+
+    timestamp|yyyy-MM-dd HH:mm:ss|resource|passQps|blockQps|successQps|
+    exceptionQps|rt|occupiedPassQps|concurrency|classification
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sentinel_tpu.metrics.events import MetricEvent
+from sentinel_tpu.utils.config import config
+from sentinel_tpu.utils.record_log import record_log
+
+
+@dataclass
+class MetricNodeLine:
+    """One (second, resource) aggregate (reference: node/metric/MetricNode.java)."""
+
+    timestamp: int  # wall ms, second-aligned
+    resource: str
+    pass_qps: int = 0
+    block_qps: int = 0
+    success_qps: int = 0
+    exception_qps: int = 0
+    rt: float = 0.0
+    occupied_pass_qps: int = 0
+    concurrency: int = 0
+    classification: int = 0
+
+    SEPARATOR = "|"
+
+    def to_line(self) -> str:
+        ts_str = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(self.timestamp / 1000))
+        resource = self.resource.replace("|", "_")
+        return self.SEPARATOR.join(
+            str(x)
+            for x in (
+                self.timestamp,
+                ts_str,
+                resource,
+                self.pass_qps,
+                self.block_qps,
+                self.success_qps,
+                self.exception_qps,
+                round(self.rt, 1),
+                self.occupied_pass_qps,
+                self.concurrency,
+                self.classification,
+            )
+        )
+
+    @classmethod
+    def from_line(cls, line: str) -> Optional["MetricNodeLine"]:
+        parts = line.rstrip("\n").split(cls.SEPARATOR)
+        if len(parts) < 11:
+            return None
+        try:
+            return cls(
+                timestamp=int(parts[0]),
+                resource=parts[2],
+                pass_qps=int(parts[3]),
+                block_qps=int(parts[4]),
+                success_qps=int(parts[5]),
+                exception_qps=int(parts[6]),
+                rt=float(parts[7]),
+                occupied_pass_qps=int(parts[8]),
+                concurrency=int(parts[9]),
+                classification=int(parts[10]),
+            )
+        except ValueError:
+            return None
+
+
+class MetricWriter:
+    """Size-rolled metric log files + second index."""
+
+    def __init__(
+        self,
+        base_dir: Optional[str] = None,
+        app_name: Optional[str] = None,
+        single_file_size: Optional[int] = None,
+        total_file_count: Optional[int] = None,
+    ) -> None:
+        from sentinel_tpu.utils.record_log import _log_dir
+
+        self.base_dir = base_dir or _log_dir()
+        self.app_name = app_name or config.app_name
+        self.single_file_size = single_file_size or config.get_int(
+            config.SINGLE_METRIC_FILE_SIZE, 50 * 1024 * 1024
+        )
+        self.total_file_count = total_file_count or config.get_int(
+            config.TOTAL_METRIC_FILE_COUNT, 6
+        )
+        self._lock = threading.Lock()
+        self._cur_path: Optional[str] = None
+        os.makedirs(self.base_dir, exist_ok=True)
+
+    @property
+    def base_name(self) -> str:
+        return os.path.join(self.base_dir, f"{self.app_name}-metrics.log")
+
+    def _list_files(self) -> List[str]:
+        prefix = os.path.basename(self.base_name)
+        try:
+            names = sorted(
+                n
+                for n in os.listdir(self.base_dir)
+                if n.startswith(prefix) and not n.endswith(".idx")
+            )
+        except OSError:
+            return []
+        return [os.path.join(self.base_dir, n) for n in names]
+
+    def _next_file(self) -> str:
+        files = self._list_files()
+        idx = len(files) + 1
+        while True:
+            path = f"{self.base_name}.{idx}"
+            if not os.path.exists(path):
+                return path
+            idx += 1
+
+    def _roll_if_needed(self) -> str:
+        if self._cur_path is None:
+            files = self._list_files()
+            self._cur_path = files[-1] if files else f"{self.base_name}.1"
+        try:
+            size = os.path.getsize(self._cur_path)
+        except OSError:
+            size = 0
+        if size >= self.single_file_size:
+            self._cur_path = self._next_file()
+            # The new file is about to be created: prune to count-1 now
+            # so the total stays within the cap after the first append.
+            self._cleanup(self.total_file_count - 1)
+        return self._cur_path
+
+    def _cleanup(self, keep: Optional[int] = None) -> None:
+        keep = self.total_file_count if keep is None else keep
+        files = self._list_files()
+        while len(files) > keep:
+            victim = files.pop(0)
+            for p in (victim, victim + ".idx"):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+    def write(self, ts_ms: int, nodes: List[MetricNodeLine]) -> None:
+        if not nodes:
+            return
+        with self._lock:
+            path = self._roll_if_needed()
+            try:
+                with open(path, "a", encoding="utf-8") as f:
+                    offset = f.tell()
+                    for n in nodes:
+                        f.write(n.to_line() + "\n")
+                with open(path + ".idx", "a", encoding="utf-8") as f:
+                    f.write(f"{ts_ms // 1000 * 1000} {offset}\n")
+            except OSError:
+                record_log.error("[MetricWriter] write failed", exc_info=True)
+
+
+class MetricSearcher:
+    """Read metric lines back by time range (MetricSearcher.java)."""
+
+    def __init__(self, base_dir: Optional[str] = None, app_name: Optional[str] = None) -> None:
+        self.writer_view = MetricWriter(base_dir=base_dir, app_name=app_name)
+
+    def find(
+        self,
+        begin_ms: int,
+        end_ms: int,
+        resource: Optional[str] = None,
+        max_lines: int = 12000,
+    ) -> List[MetricNodeLine]:
+        out: List[MetricNodeLine] = []
+        for path in self.writer_view._list_files():
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    for line in f:
+                        node = MetricNodeLine.from_line(line)
+                        if node is None:
+                            continue
+                        if node.timestamp < begin_ms or node.timestamp > end_ms:
+                            continue
+                        if resource is not None and node.resource != resource:
+                            continue
+                        out.append(node)
+                        if len(out) >= max_lines:
+                            return out
+            except OSError:
+                continue
+        return out
+
+
+class MetricTimer:
+    """The scheduled aggregator (MetricTimerListener): every second,
+    read the past seconds' buckets from the engine's minute window for
+    every resource (+ the global inbound node) and append them to the
+    metric log."""
+
+    def __init__(self, engine, writer: Optional[MetricWriter] = None, interval_sec: float = 1.0):
+        self.engine = engine
+        self.writer = writer or MetricWriter()
+        self.interval = interval_sec
+        self._last_written_sec = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricTimer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="sentinel-metric-timer", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.run_once()
+            except Exception:
+                record_log.error("[MetricTimer] aggregation failed", exc_info=True)
+
+    def run_once(self) -> List[MetricNodeLine]:
+        """Aggregate complete seconds since the last run; returns what
+        was written (also the unit-test surface)."""
+        lines = self.collect()
+        if lines:
+            self.writer.write(lines[-1].timestamp, lines)
+        return lines
+
+    def collect(self) -> List[MetricNodeLine]:
+        engine = self.engine
+        engine.flush()
+        now_rel = engine.clock.now_ms()
+        # Complete seconds only (the current second is still filling).
+        upto = now_rel // 1000 * 1000
+        begin = max(self._last_written_sec, upto - 60_000 + 1000)
+        if begin >= upto:
+            return []
+        rows: List[Tuple[str, int]] = [("__total_inbound_traffic__", engine.nodes.entry_node_row)]
+        rows += engine.nodes.resources()
+        from sentinel_tpu.metrics import metric_array as ma
+        from sentinel_tpu.metrics.nodes import MINUTE_CFG
+
+        ws, counts, valid = ma.bucket_windows(
+            MINUTE_CFG, engine.stats.minute, np.int32(now_rel)
+        )
+        ws = np.asarray(ws)
+        counts = np.asarray(counts)
+        valid = np.asarray(valid)
+        out: List[MetricNodeLine] = []
+        for sec in range(begin, upto, 1000):
+            for name, row in rows:
+                b = (sec // 1000) % MINUTE_CFG.sample_count
+                if not valid[row, b] or ws[row, b] != sec:
+                    continue
+                c = counts[row, b]
+                if not c.any():
+                    continue
+                success = int(c[MetricEvent.SUCCESS])
+                out.append(
+                    MetricNodeLine(
+                        timestamp=engine.clock.to_wall(sec),
+                        resource=name,
+                        pass_qps=int(c[MetricEvent.PASS]),
+                        block_qps=int(c[MetricEvent.BLOCK]),
+                        success_qps=success,
+                        exception_qps=int(c[MetricEvent.EXCEPTION]),
+                        rt=(int(c[MetricEvent.RT]) / success) if success else 0.0,
+                        occupied_pass_qps=int(c[MetricEvent.OCCUPIED_PASS]),
+                    )
+                )
+        self._last_written_sec = upto
+        return out

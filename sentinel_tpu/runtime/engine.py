@@ -130,6 +130,9 @@ class Engine:
         self._exits: List[_ExitOp] = []
         self._lock = threading.RLock()
         self.max_batch = config.get_int(config.FLUSH_MAX_BATCH, 131072)
+        # Global on/off switch (Constants.ON, flipped by the setSwitch
+        # command): when off, entries pass through unchecked + unrecorded.
+        self.enabled = True
 
     # ------------------------------------------------------------------
     # rule plumbing (called by rule managers)
@@ -232,7 +235,10 @@ class Engine:
         ts: Optional[int] = None,
         args: Sequence[object] = (),
     ) -> Optional[_EntryOp]:
-        """Enqueue an entry op; returns None for pass-through (over cap)."""
+        """Enqueue an entry op; returns None for pass-through (over cap
+        or the global switch being off)."""
+        if not self.enabled:
+            return None
         # Slot resolution + append happen under the engine lock so a
         # concurrent rule reload cannot swap the flow index between
         # resolving gids and flushing them against the device table.

@@ -1,0 +1,265 @@
+"""Built-in command handlers.
+
+Reference: the ~18 handlers in sentinel-transport-common/.../command/
+handler/ — ModifyRulesCommandHandler (setRules),
+FetchActiveRuleCommandHandler (getRules), SendMetricCommandHandler
+(metric by time range), fetch tree / clusterNode / systemStatus,
+on/off switch, cluster-mode handlers — plus the param-flow handlers
+from sentinel-parameter-flow-control.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import List
+
+from sentinel_tpu.metrics.metric_log import MetricSearcher
+from sentinel_tpu.models.rules import (
+    AuthorityRule,
+    DegradeRule,
+    FlowRule,
+    ParamFlowRule,
+    SystemRule,
+    rules_from_json,
+)
+from sentinel_tpu.transport.command_center import (
+    CommandRequest,
+    CommandResponse,
+    all_commands,
+    command_mapping,
+)
+from sentinel_tpu.utils.config import config
+from sentinel_tpu.version import __version__
+
+
+def _engine():
+    from sentinel_tpu.core.api import get_engine
+
+    return get_engine()
+
+
+def _managers():
+    from sentinel_tpu.rules.authority_manager import authority_rule_manager
+    from sentinel_tpu.rules.degrade_manager import degrade_rule_manager
+    from sentinel_tpu.rules.flow_manager import flow_rule_manager
+    from sentinel_tpu.rules.param_manager import param_flow_rule_manager
+    from sentinel_tpu.rules.system_manager import system_rule_manager
+
+    return {
+        "flow": (flow_rule_manager, FlowRule),
+        "degrade": (degrade_rule_manager, DegradeRule),
+        "system": (system_rule_manager, SystemRule),
+        "authority": (authority_rule_manager, AuthorityRule),
+        "paramFlow": (param_flow_rule_manager, ParamFlowRule),
+    }
+
+
+def _camel(obj: dict) -> dict:
+    def cc(k: str) -> str:
+        parts = k.split("_")
+        return parts[0] + "".join(p.title() for p in parts[1:])
+
+    return {cc(k): v for k, v in obj.items() if v is not None}
+
+
+def _rules_json(rules: List) -> str:
+    return json.dumps([_camel(dataclasses.asdict(r)) for r in rules])
+
+
+@command_mapping("version", "get sentinel version")
+def version_handler(req: CommandRequest) -> CommandResponse:
+    return CommandResponse.of_success(__version__)
+
+
+@command_mapping("api", "list available commands")
+def api_handler(req: CommandRequest) -> CommandResponse:
+    return CommandResponse.of_json(all_commands())
+
+
+@command_mapping("basicInfo", "basic machine/app info")
+def basic_info_handler(req: CommandRequest) -> CommandResponse:
+    return CommandResponse.of_json(
+        {
+            "appName": config.app_name,
+            "appType": config.get_int(config.APP_TYPE, 0),
+            "version": __version__,
+            "pid": os.getpid(),
+            "currentTime": int(time.time() * 1000),
+        }
+    )
+
+
+@command_mapping("getRules", "get rules by type: flow|degrade|system|authority|paramFlow")
+def get_rules_handler(req: CommandRequest) -> CommandResponse:
+    kind = req.params.get("type", "flow")
+    entry = _managers().get(kind)
+    if entry is None:
+        return CommandResponse.of_failure(f"invalid type: {kind}")
+    mgr, _cls = entry
+    return CommandResponse.of_success(_rules_json(mgr.get_rules()), json_body=True)
+
+
+@command_mapping("setRules", "set rules: type=...&data=<json list>")
+def set_rules_handler(req: CommandRequest) -> CommandResponse:
+    kind = req.params.get("type", "flow")
+    data = req.params.get("data", "[]")
+    entry = _managers().get(kind)
+    if entry is None:
+        return CommandResponse.of_failure(f"invalid type: {kind}")
+    mgr, cls = entry
+    try:
+        rules = rules_from_json(json.loads(data), cls)
+    except (ValueError, TypeError) as e:
+        return CommandResponse.of_failure(f"bad rule payload: {e}")
+    mgr.load_rules(rules)
+    # Push-persistence when a writable datasource is registered
+    # (WritableDataSourceRegistry / ModifyRulesCommandHandler).
+    from sentinel_tpu.datasource import WritableDataSourceRegistry
+
+    WritableDataSourceRegistry.try_write(kind, rules)
+    return CommandResponse.of_success("success")
+
+
+@command_mapping("metric", "metric log by time range: startTime&endTime[&identity]")
+def metric_handler(req: CommandRequest) -> CommandResponse:
+    try:
+        begin = int(req.params.get("startTime", 0))
+        end = int(req.params.get("endTime", 2**62))
+    except ValueError:
+        return CommandResponse.of_failure("invalid time range")
+    resource = req.params.get("identity")
+    searcher = MetricSearcher()
+    lines = searcher.find(begin, end, resource)
+    return CommandResponse.of_success("\n".join(n.to_line() for n in lines))
+
+
+@command_mapping("tree", "node tree with per-node statistics")
+def tree_handler(req: CommandRequest) -> CommandResponse:
+    engine = _engine()
+    engine.flush()
+    out = []
+    for name, row in [("machine-root", engine.nodes.entry_node_row)] + engine.nodes.resources():
+        s = engine._row_stats(row)
+        out.append(
+            f"{name}: thread={s['cur_thread_num']} pass={s['pass_qps']:.0f} "
+            f"block={s['block_qps']:.0f} success={s['success_qps']:.0f} "
+            f"exception={s['exception_qps']:.0f} rt={s['avg_rt']:.1f}"
+        )
+    return CommandResponse.of_success("\n".join(out))
+
+
+@command_mapping("clusterNode", "cluster node statistics as JSON")
+def cluster_node_handler(req: CommandRequest) -> CommandResponse:
+    engine = _engine()
+    engine.flush()
+    out = []
+    for name, row in engine.nodes.resources():
+        s = engine._row_stats(row)
+        out.append({"resourceName": name, **{k: float(v) for k, v in s.items()}})
+    return CommandResponse.of_json(out)
+
+
+@command_mapping("origin", "per-origin statistics for a resource: id=<resource>")
+def origin_handler(req: CommandRequest) -> CommandResponse:
+    engine = _engine()
+    resource = req.params.get("id", "")
+    crow = engine.nodes.lookup_cluster_row(resource)
+    if crow is None:
+        return CommandResponse.of_failure(f"unknown resource: {resource}")
+    engine.flush()
+    out = []
+    for origin, row in engine.nodes.origin_rows.get(crow, {}).items():
+        s = engine._row_stats(row)
+        out.append({"origin": origin, **{k: float(v) for k, v in s.items()}})
+    return CommandResponse.of_json(out)
+
+
+@command_mapping("systemStatus", "system protection status")
+def system_status_handler(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.utils.system_status import sampler
+
+    engine = _engine()
+    g = engine.entry_node_stats()
+    return CommandResponse.of_json(
+        {
+            "qps": g["pass_qps"],
+            "thread": g["cur_thread_num"],
+            "rt": g["avg_rt"],
+            "load": sampler.load,
+            "cpu": sampler.cpu,
+        }
+    )
+
+
+@command_mapping("getSwitch", "get the global protection switch")
+def get_switch_handler(req: CommandRequest) -> CommandResponse:
+    return CommandResponse.of_success(str(_engine().enabled).lower())
+
+
+@command_mapping("setSwitch", "set the global protection switch: value=true|false")
+def set_switch_handler(req: CommandRequest) -> CommandResponse:
+    value = req.params.get("value", "").lower()
+    if value not in ("true", "false"):
+        return CommandResponse.of_failure("invalid value")
+    _engine().enabled = value == "true"
+    return CommandResponse.of_success("success")
+
+
+@command_mapping("getClusterMode", "cluster mode state")
+def get_cluster_mode_handler(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.cluster.state import ClusterStateManager
+
+    return CommandResponse.of_json({"mode": ClusterStateManager.get_mode()})
+
+
+@command_mapping("setClusterMode", "set cluster mode: mode=0(client)|1(server)|-1(off)")
+def set_cluster_mode_handler(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.cluster.state import ClusterStateManager
+
+    try:
+        mode = int(req.params.get("mode", "-1"))
+    except ValueError:
+        return CommandResponse.of_failure("invalid mode")
+    ClusterStateManager.apply_state(mode)
+    return CommandResponse.of_success("success")
+
+
+@command_mapping("cluster/server/flowRules", "cluster server flow rules: namespace=")
+def cluster_server_flow_rules_handler(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.cluster.flow_rules import cluster_flow_rule_manager
+
+    ns = req.params.get("namespace", "default")
+    with cluster_flow_rule_manager._lock:
+        rules = list(cluster_flow_rule_manager._rules.get(ns, {}).values())
+    return CommandResponse.of_success(_rules_json(rules), json_body=True)
+
+
+@command_mapping("cluster/server/modifyFlowRules", "set cluster flow rules: namespace=&data=")
+def cluster_server_modify_flow_rules_handler(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.cluster.flow_rules import cluster_flow_rule_manager
+
+    ns = req.params.get("namespace", "default")
+    try:
+        rules = rules_from_json(json.loads(req.params.get("data", "[]")), FlowRule)
+    except (ValueError, TypeError) as e:
+        return CommandResponse.of_failure(f"bad payload: {e}")
+    cluster_flow_rule_manager.load_rules(ns, rules)
+    return CommandResponse.of_success("success")
+
+
+@command_mapping("cluster/server/config", "cluster server config")
+def cluster_server_config_handler(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.cluster.flow_rules import cluster_server_config_manager
+
+    cfg = cluster_server_config_manager.config
+    return CommandResponse.of_json(
+        {
+            "port": cfg.port,
+            "exceedCount": cfg.exceed_count,
+            "maxAllowedQps": cfg.max_allowed_qps,
+            "namespaces": sorted(cfg.namespaces),
+        }
+    )

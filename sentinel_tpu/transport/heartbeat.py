@@ -1,0 +1,86 @@
+"""Heartbeat to the dashboard.
+
+Reference: SimpleHttpHeartbeatSender posts to the dashboard's
+``/registry/machine`` every ~10 s with app / ip / port / version
+(sentinel-transport-simple-http/.../heartbeat/
+SimpleHttpHeartbeatSender.java:36-65); the dashboard feeds these into
+its machine discovery (SimpleMachineDiscovery).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from sentinel_tpu.utils.config import config
+from sentinel_tpu.utils.record_log import record_log
+from sentinel_tpu.version import __version__
+
+
+def local_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+class HeartbeatSender:
+    def __init__(
+        self,
+        dashboard_addr: str,  # "host:port"
+        command_port: int,
+        app_name: Optional[str] = None,
+        interval_sec: float = 10.0,
+    ) -> None:
+        self.dashboard_addr = dashboard_addr
+        self.command_port = command_port
+        self.app_name = app_name or config.app_name
+        self.interval = interval_sec
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def heartbeat_once(self) -> bool:
+        params = urllib.parse.urlencode(
+            {
+                "app": self.app_name,
+                "app_type": config.get_int(config.APP_TYPE, 0),
+                "version": __version__,
+                "v": __version__,
+                "hostname": socket.gethostname(),
+                "ip": local_ip(),
+                "port": self.command_port,
+                "pid": 0,
+            }
+        )
+        url = f"http://{self.dashboard_addr}/registry/machine?{params}"
+        try:
+            with urllib.request.urlopen(url, timeout=3) as resp:
+                return 200 <= resp.status < 300
+        except OSError:
+            return False
+
+    def start(self) -> "HeartbeatSender":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="sentinel-heartbeat", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            ok = self.heartbeat_once()
+            if not ok:
+                record_log.warn("[HeartbeatSender] heartbeat to %s failed", self.dashboard_addr)
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,203 @@
+"""Transport + metric log + dashboard plane tests, including the full
+observability loop: engine stats -> metric log -> command center /metric
+-> dashboard fetcher -> in-memory repository (SURVEY.md §3.5)."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.dashboard import DashboardServer, MachineInfo
+from sentinel_tpu.metrics.metric_log import (
+    MetricNodeLine,
+    MetricSearcher,
+    MetricTimer,
+    MetricWriter,
+)
+from sentinel_tpu.transport.command_center import CommandCenter
+from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+
+def http_get(srv_port, path, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{srv_port}/{path}" + (f"?{qs}" if qs else "")
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+class TestMetricLog:
+    def test_line_roundtrip(self):
+        n = MetricNodeLine(
+            timestamp=1700000000000, resource="api|x", pass_qps=5, block_qps=2,
+            success_qps=5, exception_qps=1, rt=12.5, concurrency=3,
+        )
+        parsed = MetricNodeLine.from_line(n.to_line())
+        assert parsed.resource == "api_x"  # separator sanitized
+        assert parsed.pass_qps == 5 and parsed.rt == 12.5
+
+    def test_writer_searcher_roundtrip(self, tmp_path):
+        w = MetricWriter(base_dir=str(tmp_path), app_name="t")
+        nodes = [
+            MetricNodeLine(timestamp=1000_000, resource="a", pass_qps=1),
+            MetricNodeLine(timestamp=1001_000, resource="b", pass_qps=2),
+        ]
+        w.write(1001_000, nodes)
+        s = MetricSearcher(base_dir=str(tmp_path), app_name="t")
+        found = s.find(999_000, 1002_000)
+        assert len(found) == 2
+        assert [n.resource for n in s.find(0, 2**60, resource="b")] == ["b"]
+        assert s.find(2000_000, 3000_000) == []
+
+    def test_rolling(self, tmp_path):
+        w = MetricWriter(base_dir=str(tmp_path), app_name="r",
+                         single_file_size=200, total_file_count=2)
+        for i in range(20):
+            w.write(i * 1000, [MetricNodeLine(timestamp=i * 1000, resource="x", pass_qps=i)])
+        files = w._list_files()
+        assert 1 <= len(files) <= 2  # rolled and pruned
+
+    def test_metric_timer_collects_engine_seconds(self, manual_clock, engine, tmp_path):
+        st.flow_rule_manager.load_rules([st.FlowRule("mt", count=100)])
+        for sec in range(3):
+            for i in range(5):
+                manual_clock.set_ms(sec * 1000 + i * 10)
+                with st.entry("mt"):
+                    pass
+        manual_clock.set_ms(3500)  # seconds 0..2 complete
+        timer = MetricTimer(engine, writer=MetricWriter(base_dir=str(tmp_path), app_name="mt"))
+        lines = timer.run_once()
+        mt_lines = [l for l in lines if l.resource == "mt"]
+        assert len(mt_lines) == 3
+        assert all(l.pass_qps == 5 for l in mt_lines)
+        # Incremental: a second run with no new complete seconds is empty.
+        assert timer.run_once() == []
+
+
+class TestCommandCenter:
+    @pytest.fixture()
+    def cc(self):
+        center = CommandCenter(port=0).start()
+        yield center
+        center.stop()
+
+    def test_version_and_api(self, cc, manual_clock, engine):
+        assert http_get(cc.port, "version")[1] == st.__version__
+        status, body = http_get(cc.port, "api")
+        assert "getRules" in json.loads(body)
+
+    def test_rules_roundtrip(self, cc, manual_clock, engine):
+        rules = json.dumps([{"resource": "cc-r", "count": 3}])
+        status, body = http_get(cc.port, "setRules", type="flow", data=rules)
+        assert body == "success"
+        status, body = http_get(cc.port, "getRules", type="flow")
+        got = json.loads(body)
+        assert got[0]["resource"] == "cc-r" and got[0]["count"] == 3
+        # the rules are actually live
+        for _ in range(3):
+            st.try_entry("cc-r").exit()
+        assert st.try_entry("cc-r") is None
+
+    def test_unknown_command(self, cc):
+        try:
+            http_get(cc.port, "nope")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+    def test_switch(self, cc, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("sw", count=0)])
+        assert st.try_entry("sw") is None
+        http_get(cc.port, "setSwitch", value="false")
+        e = st.try_entry("sw")  # protection off -> pass-through
+        assert e is not None and e.pass_through
+        http_get(cc.port, "setSwitch", value="true")
+        assert st.try_entry("sw") is None
+
+    def test_tree_and_cluster_node(self, cc, manual_clock, engine):
+        with st.entry("tree-res"):
+            pass
+        status, body = http_get(cc.port, "tree")
+        assert "tree-res" in body
+        status, body = http_get(cc.port, "clusterNode")
+        nodes = json.loads(body)
+        assert any(n["resourceName"] == "tree-res" for n in nodes)
+
+    def test_system_status(self, cc, manual_clock, engine):
+        status, body = http_get(cc.port, "systemStatus")
+        data = json.loads(body)
+        assert set(data) >= {"qps", "thread", "rt", "load", "cpu"}
+
+
+class TestDashboard:
+    def test_registry_and_apps(self):
+        dash = DashboardServer(port=0, fetch_interval_sec=999).start()
+        try:
+            status, body = http_get(
+                dash.port, "registry/machine", app="my-app", ip="127.0.0.1", port=1234
+            )
+            assert json.loads(body)["code"] == 0
+            status, body = http_get(dash.port, "apps")
+            apps = json.loads(body)
+            assert apps["my-app"][0]["port"] == 1234
+        finally:
+            dash.stop()
+
+    def test_full_observability_loop(self, manual_clock, engine, tmp_path):
+        """entry stats -> metric log -> command center -> dashboard repo."""
+        import sentinel_tpu.transport.handlers as handlers
+        from sentinel_tpu.metrics import metric_log as ml
+
+        # Traffic for seconds 0..1.
+        st.flow_rule_manager.load_rules([st.FlowRule("loop-res", count=100)])
+        for sec in range(2):
+            for i in range(4):
+                manual_clock.set_ms(sec * 1000 + i * 10)
+                with st.entry("loop-res"):
+                    pass
+        manual_clock.set_ms(2500)
+        writer = MetricWriter(base_dir=str(tmp_path), app_name="loop-app")
+        MetricTimer(engine, writer=writer).run_once()
+
+        # Point the command center's searcher at our tmp dir.
+        orig = ml.MetricSearcher.__init__
+        ml.MetricSearcher.__init__ = (
+            lambda self, base_dir=None, app_name=None: orig(self, str(tmp_path), "loop-app")
+        )
+        cc = CommandCenter(port=0).start()
+        dash = DashboardServer(port=0, fetch_interval_sec=999).start()
+        try:
+            http_get(dash.port, "registry/machine", app="loop-app", ip="127.0.0.1", port=cc.port)
+            # The manual clock's wall epoch is in the past; widen the
+            # fetcher's initial window to cover it.
+            m = dash.apps.machines_of("loop-app")[0]
+            dash.fetcher._last_fetch[m.key] = engine.clock.to_wall(0) - 1
+            # Manual-clock timestamps are in the past relative to real
+            # wall time; disable retention pruning for the assertion.
+            dash.repo.RETENTION_MS = 1 << 62
+            fetched = dash.fetcher.fetch_once()
+            assert fetched > 0
+            begin = engine.clock.to_wall(0)
+            nodes = dash.repo.query("loop-app", "loop-res", begin, begin + 10_000)
+            assert sum(n.pass_qps for n in nodes) == 8
+        finally:
+            ml.MetricSearcher.__init__ = orig
+            cc.stop()
+            dash.stop()
+
+
+class TestHeartbeat:
+    def test_heartbeat_registers(self):
+        dash = DashboardServer(port=0, fetch_interval_sec=999).start()
+        try:
+            hb = HeartbeatSender(f"127.0.0.1:{dash.port}", command_port=9999, app_name="hb-app")
+            assert hb.heartbeat_once() is True
+            assert any(m.port == 9999 for m in dash.apps.machines_of("hb-app"))
+        finally:
+            dash.stop()
+
+    def test_heartbeat_failure(self):
+        hb = HeartbeatSender("127.0.0.1:1", command_port=1, app_name="x")
+        assert hb.heartbeat_once() is False
