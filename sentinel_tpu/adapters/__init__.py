@@ -1,0 +1,35 @@
+"""Framework adapters.
+
+Equivalent of sentinel-adapter's 17 modules + the annotation extension
+(reference: sentinel-adapter/* and sentinel-extension/
+sentinel-annotation-aspectj/.../SentinelResourceAspect.java:36-83). All
+reference adapters follow one pattern — map an invocation to
+``ContextUtil.enter(context, origin) + SphU.entry(resource, type) +
+Tracer.trace + exit`` with configurable origin parser / resource-name
+customizer / fallback — and so do these:
+
+* :func:`sentinel_resource` — the ``@SentinelResource`` decorator
+  (blockHandler / fallback / defaultFallback dispatch, sync + async).
+* :class:`SentinelWSGIMiddleware` — sentinel-web-servlet /
+  spring-webmvc (total + per-URL resources, origin parser, block page).
+* :class:`SentinelASGIMiddleware` — spring-webflux / reactor.
+* gRPC server/client interceptors — sentinel-grpc-adapter.
+* :func:`guard_call` / :class:`GuardedClient` — the outbound-client
+  adapters (okhttp / apache-httpclient).
+* :mod:`sentinel_tpu.adapters.gateway` — api-gateway-adapter-common:
+  GatewayFlowRule with param matching, ApiDefinition groups, conversion
+  to hot-param rules.
+"""
+
+from sentinel_tpu.adapters.decorator import sentinel_resource
+from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
+from sentinel_tpu.adapters.asgi import SentinelASGIMiddleware
+from sentinel_tpu.adapters.client import GuardedClient, guard_call
+
+__all__ = [
+    "sentinel_resource",
+    "SentinelWSGIMiddleware",
+    "SentinelASGIMiddleware",
+    "GuardedClient",
+    "guard_call",
+]

@@ -1,0 +1,69 @@
+"""ASGI middleware (async web frameworks: Starlette/FastAPI/Quart...).
+
+Reference: sentinel-spring-webflux-adapter / sentinel-reactor-adapter —
+the reactive pipeline wraps each exchange in an entry and maps blocks
+to a 429 response.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from sentinel_tpu.core import api
+from sentinel_tpu.core.context import ContextUtil
+from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.models import constants as C
+
+DEFAULT_BLOCK_BODY = b"Blocked by Sentinel (flow limiting)"
+WEB_CONTEXT_NAME = "sentinel_web_context"
+
+
+class SentinelASGIMiddleware:
+    def __init__(
+        self,
+        app,
+        *,
+        resource_extractor: Optional[Callable[[dict], str]] = None,
+        origin_parser: Optional[Callable[[dict], str]] = None,
+        total_resource: Optional[str] = "web-total",
+    ) -> None:
+        self.app = app
+        self.resource_extractor = resource_extractor or (
+            lambda scope: f"{scope.get('method', 'GET')}:{scope.get('path', '/')}"
+        )
+        self.origin_parser = origin_parser or (lambda scope: "")
+        self.total_resource = total_resource
+
+    async def __call__(self, scope, receive, send):
+        if scope.get("type") != "http":
+            await self.app(scope, receive, send)
+            return
+        resource = self.resource_extractor(scope)
+        origin = self.origin_parser(scope)
+        ctx = ContextUtil.enter(WEB_CONTEXT_NAME, origin)
+        entries = []
+        try:
+            try:
+                if self.total_resource:
+                    entries.append(api.entry(self.total_resource, entry_type=C.EntryType.IN))
+                entries.append(api.entry(resource, entry_type=C.EntryType.IN))
+            except BlockError:
+                await send(
+                    {
+                        "type": "http.response.start",
+                        "status": 429,
+                        "headers": [(b"content-type", b"text/plain")],
+                    }
+                )
+                await send({"type": "http.response.body", "body": DEFAULT_BLOCK_BODY})
+                return
+            try:
+                await self.app(scope, receive, send)
+            except BaseException as e:
+                for en in entries:
+                    en.set_error(e)
+                raise
+        finally:
+            for en in reversed(entries):
+                en.exit()
+            ContextUtil.exit()

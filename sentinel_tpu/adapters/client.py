@@ -1,0 +1,67 @@
+"""Outbound-client guards.
+
+Reference: sentinel-okhttp-adapter / sentinel-apache-httpclient-adapter:
+wrap outbound calls in an OUT-typed entry named after the request
+(cleaner: ``METHOD:host/path``) so downstream dependencies get their own
+flow rules and circuit breakers.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, TypeVar
+
+from sentinel_tpu.core import api
+from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.models import constants as C
+
+T = TypeVar("T")
+
+
+def guard_call(resource: str, fn: Callable[..., T], *args, fallback=None, **kwargs) -> T:
+    """Run ``fn`` under an OUT entry; trace errors; on block call
+    ``fallback(error)`` or raise."""
+    try:
+        entry = api.entry(resource, entry_type=C.EntryType.OUT)
+    except BlockError as e:
+        if fallback is not None:
+            return fallback(e)
+        raise
+    try:
+        result = fn(*args, **kwargs)
+    except BaseException as e:
+        entry.set_error(e)
+        raise
+    finally:
+        entry.exit()
+    return result
+
+
+class GuardedClient:
+    """Wrap any HTTP-client-like object whose request method is
+    ``request(method, url, ...)`` (requests.Session, httpx.Client...)."""
+
+    def __init__(
+        self,
+        client,
+        resource_extractor: Optional[Callable[[str, str], str]] = None,
+        fallback: Optional[Callable] = None,
+    ) -> None:
+        self._client = client
+        self._extract = resource_extractor or (lambda method, url: f"{method.upper()}:{url}")
+        self._fallback = fallback
+
+    def request(self, method: str, url: str, *args, **kwargs):
+        resource = self._extract(method, url)
+        return guard_call(
+            resource, self._client.request, method, url, *args,
+            fallback=self._fallback, **kwargs,
+        )
+
+    def get(self, url: str, **kwargs):
+        return self.request("GET", url, **kwargs)
+
+    def post(self, url: str, **kwargs):
+        return self.request("POST", url, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._client, name)

@@ -1,0 +1,116 @@
+"""The @sentinel_resource decorator.
+
+Reference: sentinel-annotation-aspectj's @SentinelResource +
+SentinelResourceAspect (SentinelResourceAspect.java:36-83,
+AbstractSentinelAspectSupport.java:83): wrap the function in
+entry/exit; on BlockError dispatch to ``block_handler``; on business
+exceptions dispatch to ``fallback`` (or ``default_fallback``) and trace
+the exception; otherwise re-raise. Handlers receive the original
+arguments plus the exception as a trailing argument, like the
+reference's handler signature convention.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Optional, Sequence, Tuple
+
+from sentinel_tpu.core import api
+from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.models import constants as C
+
+
+def sentinel_resource(
+    resource: Optional[str] = None,
+    *,
+    entry_type: C.EntryType = C.EntryType.OUT,
+    resource_type: int = 0,
+    block_handler: Optional[Callable] = None,
+    fallback: Optional[Callable] = None,
+    default_fallback: Optional[Callable] = None,
+    exceptions_to_ignore: Tuple[type, ...] = (),
+    param_args: bool = False,
+):
+    """Decorate a callable as a protected resource.
+
+    ``param_args=True`` forwards the call's positional arguments to
+    hot-parameter rules (SphU.entry(..., args)).
+    """
+
+    def deco(fn: Callable) -> Callable:
+        name = resource or f"{fn.__module__}:{fn.__qualname__}"
+
+        def handle_block(e: BlockError, args, kwargs):
+            if block_handler is not None:
+                return block_handler(*args, **kwargs, error=e) if _wants_kw(
+                    block_handler, "error"
+                ) else block_handler(*args, e, **kwargs)
+            raise e
+
+        def handle_fallback(e: BaseException, args, kwargs):
+            handler = fallback or default_fallback
+            if handler is not None and not isinstance(e, exceptions_to_ignore):
+                return handler(*args, **kwargs, error=e) if _wants_kw(
+                    handler, "error"
+                ) else handler(*args, e, **kwargs)
+            raise e
+
+        if inspect.iscoroutinefunction(fn):
+
+            @functools.wraps(fn)
+            async def async_wrapper(*args, **kwargs):
+                try:
+                    entry = api.entry(
+                        name,
+                        entry_type=entry_type,
+                        args=args if param_args else (),
+                    )
+                except BlockError as e:
+                    return handle_block(e, args, kwargs)
+                try:
+                    result = await fn(*args, **kwargs)
+                except BlockError:
+                    raise
+                except BaseException as e:
+                    entry.set_error(e)
+                    entry.exit()
+                    return handle_fallback(e, args, kwargs)
+                entry.exit()
+                return result
+
+            return async_wrapper
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            try:
+                entry = api.entry(
+                    name,
+                    entry_type=entry_type,
+                    args=args if param_args else (),
+                )
+            except BlockError as e:
+                return handle_block(e, args, kwargs)
+            try:
+                result = fn(*args, **kwargs)
+            except BlockError:
+                raise
+            except BaseException as e:
+                entry.set_error(e)
+                entry.exit()
+                return handle_fallback(e, args, kwargs)
+            entry.exit()
+            return result
+
+        return wrapper
+
+    return deco
+
+
+def _wants_kw(fn: Callable, kw: str) -> bool:
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    p = sig.parameters.get(kw)
+    return p is not None and p.kind in (p.KEYWORD_ONLY, p.POSITIONAL_OR_KEYWORD)

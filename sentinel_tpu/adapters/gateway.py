@@ -1,0 +1,241 @@
+"""API-gateway flow control.
+
+Reference: sentinel-api-gateway-adapter-common — GatewayFlowRule
+(per-route / per-custom-API rules with parameter matching on client IP /
+host / header / URL param / cookie, exact-prefix-regex matchers),
+converted to hot-param rules by GatewayRuleConverter, params extracted
+by GatewayParamParser, checked by GatewayFlowSlot, plus ApiDefinition
+route groups (reference: .../gateway/common/rule/GatewayRuleManager.java:39,
+slot/GatewayFlowSlot.java:37, param/GatewayParamParser.java,
+api/ApiDefinition.java).
+
+Usage::
+
+    gateway_rule_manager.load_rules([
+        GatewayFlowRule("my_route", count=10,
+                        param_item=GatewayParamFlowItem(parse_strategy=PARAM_PARSE_STRATEGY_CLIENT_IP)),
+    ])
+    info = GatewayRequestInfo(path="/api/x", client_ip="1.2.3.4", ...)
+    with gateway_entry("my_route", info):
+        ...
+"""
+
+from __future__ import annotations
+
+import re
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from sentinel_tpu.core import api
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import ParamFlowRule
+
+# Resource modes (SentinelGatewayConstants).
+RESOURCE_MODE_ROUTE_ID = 0
+RESOURCE_MODE_CUSTOM_API_NAME = 1
+
+# Param parse strategies.
+PARAM_PARSE_STRATEGY_CLIENT_IP = 0
+PARAM_PARSE_STRATEGY_HOST = 1
+PARAM_PARSE_STRATEGY_HEADER = 2
+PARAM_PARSE_STRATEGY_URL_PARAM = 3
+PARAM_PARSE_STRATEGY_COOKIE = 4
+
+# String match strategies.
+PARAM_MATCH_STRATEGY_EXACT = 0
+PARAM_MATCH_STRATEGY_PREFIX = 1
+PARAM_MATCH_STRATEGY_REGEX = 2
+
+# URL match strategies for ApiDefinition predicates.
+URL_MATCH_STRATEGY_EXACT = 0
+URL_MATCH_STRATEGY_PREFIX = 1
+URL_MATCH_STRATEGY_REGEX = 2
+
+# The constant param value used when a rule has no param item
+# (SentinelGatewayConstants.GATEWAY_DEFAULT_PARAM).
+GATEWAY_DEFAULT_PARAM = "$D"
+
+
+@dataclass(frozen=True)
+class GatewayParamFlowItem:
+    parse_strategy: int = PARAM_PARSE_STRATEGY_CLIENT_IP
+    field_name: Optional[str] = None  # header/url-param/cookie name
+    pattern: Optional[str] = None
+    match_strategy: int = PARAM_MATCH_STRATEGY_EXACT
+
+
+@dataclass(frozen=True)
+class GatewayFlowRule:
+    resource: str = ""
+    resource_mode: int = RESOURCE_MODE_ROUTE_ID
+    grade: int = C.FLOW_GRADE_QPS
+    count: float = 0.0
+    interval_sec: int = 1
+    control_behavior: int = C.CONTROL_BEHAVIOR_DEFAULT
+    burst: int = 0
+    max_queueing_timeout_ms: int = 500
+    param_item: Optional[GatewayParamFlowItem] = None
+
+
+@dataclass(frozen=True)
+class ApiPredicateItem:
+    pattern: str = ""
+    match_strategy: int = URL_MATCH_STRATEGY_EXACT
+
+    def matches(self, path: str) -> bool:
+        if self.match_strategy == URL_MATCH_STRATEGY_PREFIX:
+            return path.startswith(self.pattern)
+        if self.match_strategy == URL_MATCH_STRATEGY_REGEX:
+            try:
+                return re.fullmatch(self.pattern, path) is not None
+            except re.error:
+                return False
+        return path == self.pattern
+
+
+@dataclass(frozen=True)
+class ApiDefinition:
+    api_name: str
+    predicate_items: Tuple[ApiPredicateItem, ...] = ()
+
+    def matches(self, path: str) -> bool:
+        return any(p.matches(path) for p in self.predicate_items)
+
+
+@dataclass
+class GatewayRequestInfo:
+    """The request attributes GatewayParamParser reads."""
+
+    path: str = "/"
+    client_ip: str = ""
+    host: str = ""
+    headers: Dict[str, str] = field(default_factory=dict)
+    url_params: Dict[str, str] = field(default_factory=dict)
+    cookies: Dict[str, str] = field(default_factory=dict)
+
+
+class GatewayApiDefinitionManager:
+    """Custom API groups (GatewayApiDefinitionManager)."""
+
+    def __init__(self) -> None:
+        self._apis: Dict[str, ApiDefinition] = {}
+
+    def load_api_definitions(self, defs: Sequence[ApiDefinition]) -> None:
+        self._apis = {d.api_name: d for d in defs}
+
+    def get_api_definitions(self) -> List[ApiDefinition]:
+        return list(self._apis.values())
+
+    def matching_apis(self, path: str) -> List[str]:
+        return [name for name, d in self._apis.items() if d.matches(path)]
+
+
+class GatewayRuleManager:
+    """Holds gateway rules, converts them to hot-param rules
+    (GatewayRuleConverter.applyToParamRule) and contributes them to the
+    param-flow manager; extracts each entry's param tuple."""
+
+    def __init__(self) -> None:
+        self._rules: List[GatewayFlowRule] = []
+        self._by_resource: Dict[str, List[GatewayFlowRule]] = {}
+
+    def load_rules(self, rules: Sequence[GatewayFlowRule]) -> None:
+        self._rules = [r for r in rules if r.resource and r.count >= 0]
+        self._by_resource = {}
+        for r in self._rules:
+            self._by_resource.setdefault(r.resource, []).append(r)
+        converted: List[ParamFlowRule] = []
+        for res, rs in self._by_resource.items():
+            for idx, r in enumerate(rs):
+                converted.append(
+                    ParamFlowRule(
+                        resource=res,
+                        grade=r.grade,
+                        param_idx=idx,
+                        count=r.count,
+                        control_behavior=r.control_behavior,
+                        max_queueing_time_ms=r.max_queueing_timeout_ms,
+                        burst_count=r.burst,
+                        duration_in_sec=max(1, r.interval_sec),
+                    )
+                )
+        from sentinel_tpu.rules.param_manager import param_flow_rule_manager
+
+        param_flow_rule_manager.set_gateway_rules(converted)
+
+    def get_rules(self) -> List[GatewayFlowRule]:
+        return list(self._rules)
+
+    def rules_for(self, resource: str) -> List[GatewayFlowRule]:
+        return self._by_resource.get(resource, [])
+
+    # --- GatewayParamParser ---
+    def parse_params(self, resource: str, info: GatewayRequestInfo) -> Tuple:
+        out = []
+        for r in self.rules_for(resource):
+            out.append(self._parse_one(r, info))
+        return tuple(out)
+
+    @staticmethod
+    def _parse_one(rule: GatewayFlowRule, info: GatewayRequestInfo) -> Optional[str]:
+        item = rule.param_item
+        if item is None:
+            # No param matching: the whole route shares one bucket.
+            return GATEWAY_DEFAULT_PARAM
+        ps = item.parse_strategy
+        if ps == PARAM_PARSE_STRATEGY_CLIENT_IP:
+            value = info.client_ip
+        elif ps == PARAM_PARSE_STRATEGY_HOST:
+            value = info.host
+        elif ps == PARAM_PARSE_STRATEGY_HEADER:
+            value = info.headers.get(item.field_name or "", "")
+        elif ps == PARAM_PARSE_STRATEGY_URL_PARAM:
+            value = info.url_params.get(item.field_name or "", "")
+        elif ps == PARAM_PARSE_STRATEGY_COOKIE:
+            value = info.cookies.get(item.field_name or "", "")
+        else:
+            value = ""
+        if not value:
+            return None  # nothing to limit on -> rule passes
+        if item.pattern:
+            if item.match_strategy == PARAM_MATCH_STRATEGY_PREFIX:
+                matched = value.startswith(item.pattern)
+            elif item.match_strategy == PARAM_MATCH_STRATEGY_REGEX:
+                try:
+                    matched = re.fullmatch(item.pattern, value) is not None
+                except re.error:
+                    matched = False
+            else:
+                matched = value == item.pattern
+            if not matched:
+                return None  # unmatched values are not limited
+        return value
+
+
+gateway_rule_manager = GatewayRuleManager()
+gateway_api_definition_manager = GatewayApiDefinitionManager()
+
+
+@contextmanager
+def gateway_entry(route_id: str, info: GatewayRequestInfo):
+    """Enter the route resource (+ any matching custom-API resources)
+    with the extracted gateway params; the GatewayFlowSlot equivalent.
+    Raises ParamFlowBlockError/BlockError when limited."""
+    resources = [route_id] + gateway_api_definition_manager.matching_apis(info.path)
+    entries = []
+    try:
+        for res in resources:
+            args = gateway_rule_manager.parse_params(res, info)
+            entries.append(api.entry(res, entry_type=C.EntryType.IN, args=args))
+        yield entries
+    except BaseException as e:
+        from sentinel_tpu.core.errors import BlockError
+
+        if not isinstance(e, BlockError):
+            for en in entries:
+                en.set_error(e)
+        raise
+    finally:
+        for en in reversed(entries):
+            en.exit()

@@ -1,0 +1,90 @@
+"""gRPC interceptors.
+
+Reference: sentinel-grpc-adapter's SentinelGrpcServerInterceptor /
+SentinelGrpcClientInterceptor. Gated on grpcio being installed (it is
+not a framework dependency).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from sentinel_tpu.core import api
+from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.models import constants as C
+
+try:  # pragma: no cover - exercised only when grpcio is present
+    import grpc
+except ImportError:  # pragma: no cover
+    grpc = None
+
+
+def _require_grpc():
+    if grpc is None:
+        raise ImportError("grpcio is not installed; gRPC adapters unavailable")
+
+
+if grpc is not None:
+
+    class SentinelServerInterceptor(grpc.ServerInterceptor):  # pragma: no cover
+        """Every inbound RPC enters an IN resource named by the method."""
+
+        def intercept_service(self, continuation, handler_call_details):
+            resource = handler_call_details.method
+            try:
+                entry = api.entry(resource, entry_type=C.EntryType.IN)
+            except BlockError:
+                def abort(request, context):
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED, "Blocked by Sentinel"
+                    )
+
+                return grpc.unary_unary_rpc_method_handler(abort)
+            handler = continuation(handler_call_details)
+            if handler is None or not handler.unary_unary:
+                entry.exit()
+                return handler
+
+            inner = handler.unary_unary
+
+            def wrapped(request, context):
+                try:
+                    return inner(request, context)
+                except BaseException as e:
+                    entry.set_error(e)
+                    raise
+                finally:
+                    entry.exit()
+
+            return grpc.unary_unary_rpc_method_handler(
+                wrapped,
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+
+    class SentinelClientInterceptor(
+        grpc.UnaryUnaryClientInterceptor
+    ):  # pragma: no cover
+        """Outbound RPCs enter an OUT resource; blocks raise before the wire."""
+
+        def intercept_unary_unary(self, continuation, client_call_details, request):
+            resource = client_call_details.method
+            entry = api.entry(resource, entry_type=C.EntryType.OUT)
+            try:
+                result = continuation(client_call_details, request)
+                return result
+            except BaseException as e:
+                entry.set_error(e)
+                raise
+            finally:
+                entry.exit()
+
+else:  # keep the names importable for documentation/tests
+
+    class SentinelServerInterceptor:  # type: ignore[no-redef]
+        def __init__(self, *a, **k):
+            _require_grpc()
+
+    class SentinelClientInterceptor:  # type: ignore[no-redef]
+        def __init__(self, *a, **k):
+            _require_grpc()

@@ -1,0 +1,78 @@
+"""WSGI middleware.
+
+Reference: sentinel-web-servlet's CommonFilter + the spring-webmvc
+interceptor: each request enters the web context with a parsed origin,
+then a total-inbound resource plus the per-URL resource; blocks render a
+429 page (configurable); business errors are traced on exit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+from sentinel_tpu.core import api
+from sentinel_tpu.core.context import ContextUtil
+from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.models import constants as C
+
+DEFAULT_BLOCK_BODY = b"Blocked by Sentinel (flow limiting)"
+WEB_CONTEXT_NAME = "sentinel_web_context"
+
+
+class SentinelWSGIMiddleware:
+    def __init__(
+        self,
+        app,
+        *,
+        resource_extractor: Optional[Callable[[dict], str]] = None,
+        origin_parser: Optional[Callable[[dict], str]] = None,
+        block_handler: Optional[Callable[[dict, BlockError], tuple]] = None,
+        total_resource: Optional[str] = "web-total",
+        http_method_specify: bool = True,
+    ) -> None:
+        self.app = app
+        self.resource_extractor = resource_extractor or self._default_resource
+        self.origin_parser = origin_parser or (lambda env: "")
+        self.block_handler = block_handler
+        self.total_resource = total_resource
+        self.http_method_specify = http_method_specify
+
+    def _default_resource(self, environ: dict) -> str:
+        path = environ.get("PATH_INFO", "/")
+        if self.http_method_specify:
+            return f"{environ.get('REQUEST_METHOD', 'GET')}:{path}"
+        return path
+
+    def __call__(self, environ: dict, start_response):
+        resource = self.resource_extractor(environ)
+        origin = self.origin_parser(environ)
+        ctx = ContextUtil.enter(WEB_CONTEXT_NAME, origin)
+        entries = []
+        try:
+            try:
+                if self.total_resource:
+                    entries.append(api.entry(self.total_resource, entry_type=C.EntryType.IN))
+                entries.append(api.entry(resource, entry_type=C.EntryType.IN))
+            except BlockError as e:
+                return self._blocked(environ, start_response, e)
+            try:
+                result = self.app(environ, start_response)
+                return result
+            except BaseException as e:
+                for en in entries:
+                    en.set_error(e)
+                raise
+        finally:
+            for en in reversed(entries):
+                en.exit()
+            ContextUtil.exit()
+
+    def _blocked(self, environ, start_response, e: BlockError) -> Iterable[bytes]:
+        if self.block_handler is not None:
+            status, headers, body = self.block_handler(environ, e)
+        else:
+            status = "429 Too Many Requests"
+            body = DEFAULT_BLOCK_BODY
+            headers = [("Content-Type", "text/plain"), ("Content-Length", str(len(body)))]
+        start_response(status, headers)
+        return [body]

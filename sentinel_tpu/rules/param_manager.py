@@ -17,10 +17,18 @@ class ParamFlowRuleManager(RuleManager[ParamFlowRule]):
     def __init__(self) -> None:
         super().__init__()
         self.by_resource: Dict[str, List[ParamFlowRule]] = {}
+        # Converted gateway rules contribute alongside user rules
+        # (GatewayRuleManager feeds GatewayFlowSlot via param checking
+        # in the reference; here both share the engine's param index).
+        self._gateway_rules: List[ParamFlowRule] = []
+
+    def set_gateway_rules(self, rules: List[ParamFlowRule]) -> None:
+        self._gateway_rules = list(rules)
+        self._apply(self.get_rules())
 
     def _apply(self, rules: List[ParamFlowRule]) -> None:
         by_res: Dict[str, List[ParamFlowRule]] = {}
-        for r in rules:
+        for r in list(rules) + self._gateway_rules:
             if r.is_valid():
                 by_res.setdefault(r.resource, []).append(r)
         self.by_resource = by_res

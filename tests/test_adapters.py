@@ -1,0 +1,263 @@
+"""Adapter tests: decorator, WSGI/ASGI middleware, guarded client,
+gateway rules (reference: per-adapter tests with each framework's test
+kit — here plain WSGI/ASGI callables)."""
+
+import asyncio
+import io
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.adapters import (
+    GuardedClient,
+    SentinelASGIMiddleware,
+    SentinelWSGIMiddleware,
+    guard_call,
+    sentinel_resource,
+)
+from sentinel_tpu.adapters.gateway import (
+    ApiDefinition,
+    ApiPredicateItem,
+    GatewayFlowRule,
+    GatewayParamFlowItem,
+    GatewayRequestInfo,
+    PARAM_PARSE_STRATEGY_CLIENT_IP,
+    PARAM_PARSE_STRATEGY_HEADER,
+    PARAM_MATCH_STRATEGY_PREFIX,
+    URL_MATCH_STRATEGY_PREFIX,
+    gateway_api_definition_manager,
+    gateway_entry,
+    gateway_rule_manager,
+)
+from sentinel_tpu.models import constants as C
+
+
+class TestDecorator:
+    def test_basic_protection(self, manual_clock, engine):
+        calls = []
+
+        @sentinel_resource("deco-res")
+        def work(x):
+            calls.append(x)
+            return x * 2
+
+        st.flow_rule_manager.load_rules([st.FlowRule("deco-res", count=2)])
+        assert work(1) == 2
+        assert work(2) == 4
+        with pytest.raises(st.FlowBlockError):
+            work(3)
+        assert calls == [1, 2]
+
+    def test_block_handler(self, manual_clock, engine):
+        @sentinel_resource("bh-res", block_handler=lambda x, error: f"blocked:{x}")
+        def work(x):
+            return f"ok:{x}"
+
+        st.flow_rule_manager.load_rules([st.FlowRule("bh-res", count=1)])
+        assert work(1) == "ok:1"
+        assert work(2) == "blocked:2"
+
+    def test_fallback_on_error(self, manual_clock, engine):
+        @sentinel_resource("fb-res", fallback=lambda x, error: f"fallback:{x}")
+        def work(x):
+            raise ValueError("boom")
+
+        assert work(5) == "fallback:5"
+        stats = engine.cluster_node_stats("fb-res")
+        assert stats["exception_qps"] == 1
+
+    def test_default_resource_name(self, manual_clock, engine):
+        @sentinel_resource()
+        def named_fn():
+            return 1
+
+        assert named_fn() == 1
+        resources = [r for r, _ in engine.nodes.resources()]
+        assert any("named_fn" in r for r in resources)
+
+    def test_async_function(self, manual_clock, engine):
+        @sentinel_resource("async-res", block_handler=lambda error: "blocked")
+        async def awork():
+            return "ok"
+
+        st.flow_rule_manager.load_rules([st.FlowRule("async-res", count=1)])
+        assert asyncio.run(awork()) == "ok"
+        assert asyncio.run(awork()) == "blocked"
+
+    def test_param_args(self, manual_clock, engine):
+        @sentinel_resource("pa-res", param_args=True, block_handler=lambda uid, error: "limited")
+        def get_user(uid):
+            return f"user:{uid}"
+
+        st.param_flow_rule_manager.load_rules(
+            [st.ParamFlowRule("pa-res", param_idx=0, count=1)]
+        )
+        assert get_user("a") == "user:a"
+        assert get_user("a") == "limited"
+        assert get_user("b") == "user:b"
+
+
+def wsgi_call(app, path="/x", method="GET"):
+    environ = {"PATH_INFO": path, "REQUEST_METHOD": method, "REMOTE_ADDR": "1.1.1.1"}
+    status_headers = {}
+
+    def start_response(status, headers):
+        status_headers["status"] = status
+
+    body = b"".join(app(environ, start_response))
+    return status_headers["status"], body
+
+
+class TestWSGI:
+    def test_pass_and_block(self, manual_clock, engine):
+        def inner(environ, start_response):
+            start_response("200 OK", [])
+            return [b"hello"]
+
+        app = SentinelWSGIMiddleware(inner)
+        st.flow_rule_manager.load_rules([st.FlowRule("GET:/x", count=1)])
+        assert wsgi_call(app) == ("200 OK", b"hello")
+        status, body = wsgi_call(app)
+        assert status.startswith("429")
+        # another URL not limited
+        assert wsgi_call(app, path="/y")[0] == "200 OK"
+
+    def test_total_resource_counted(self, manual_clock, engine):
+        def inner(environ, start_response):
+            start_response("200 OK", [])
+            return [b"ok"]
+
+        app = SentinelWSGIMiddleware(inner)
+        wsgi_call(app, path="/a")
+        wsgi_call(app, path="/b")
+        stats = engine.cluster_node_stats("web-total")
+        assert stats["pass_qps"] == 2
+
+    def test_error_traced(self, manual_clock, engine):
+        def inner(environ, start_response):
+            raise RuntimeError("app failure")
+
+        app = SentinelWSGIMiddleware(inner)
+        with pytest.raises(RuntimeError):
+            wsgi_call(app, path="/err")
+        stats = engine.cluster_node_stats("GET:/err")
+        assert stats["exception_qps"] == 1
+
+
+class TestASGI:
+    def test_pass_and_block(self, manual_clock, engine):
+        sent = []
+
+        async def inner(scope, receive, send):
+            await send({"type": "http.response.start", "status": 200, "headers": []})
+            await send({"type": "http.response.body", "body": b"ok"})
+
+        app = SentinelASGIMiddleware(inner)
+        st.flow_rule_manager.load_rules([st.FlowRule("GET:/a", count=1)])
+
+        async def call(path):
+            msgs = []
+
+            async def send(msg):
+                msgs.append(msg)
+
+            async def receive():
+                return {"type": "http.request"}
+
+            await app({"type": "http", "method": "GET", "path": path}, receive, send)
+            return msgs
+
+        msgs = asyncio.run(call("/a"))
+        assert msgs[0]["status"] == 200
+        msgs = asyncio.run(call("/a"))
+        assert msgs[0]["status"] == 429
+
+
+class TestGuardedClient:
+    def test_guard_call(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("GET:http://api/x", count=1)])
+
+        class FakeClient:
+            def request(self, method, url):
+                return f"{method} {url} -> 200"
+
+        client = GuardedClient(FakeClient())
+        assert client.get("http://api/x").endswith("200")
+        with pytest.raises(st.FlowBlockError):
+            client.get("http://api/x")
+        # with fallback
+        client2 = GuardedClient(FakeClient(), fallback=lambda e: "degraded")
+        assert client2.get("http://api/x") == "degraded"
+
+
+class TestGateway:
+    @pytest.fixture(autouse=True)
+    def _clean(self, manual_clock, engine):
+        yield
+        gateway_rule_manager.load_rules([])
+        gateway_api_definition_manager.load_api_definitions([])
+
+    def test_route_limit_by_client_ip(self, manual_clock, engine):
+        gateway_rule_manager.load_rules(
+            [
+                GatewayFlowRule(
+                    "route-1",
+                    count=1,
+                    param_item=GatewayParamFlowItem(
+                        parse_strategy=PARAM_PARSE_STRATEGY_CLIENT_IP
+                    ),
+                )
+            ]
+        )
+        info_a = GatewayRequestInfo(path="/svc", client_ip="10.0.0.1")
+        info_b = GatewayRequestInfo(path="/svc", client_ip="10.0.0.2")
+        with gateway_entry("route-1", info_a):
+            pass
+        with pytest.raises(st.ParamFlowBlockError):
+            with gateway_entry("route-1", info_a):
+                pass
+        with gateway_entry("route-1", info_b):  # other client ip independent
+            pass
+
+    def test_header_prefix_match_only(self, manual_clock, engine):
+        gateway_rule_manager.load_rules(
+            [
+                GatewayFlowRule(
+                    "route-h",
+                    count=0,  # matched values are fully blocked
+                    param_item=GatewayParamFlowItem(
+                        parse_strategy=PARAM_PARSE_STRATEGY_HEADER,
+                        field_name="X-Tenant",
+                        pattern="bad-",
+                        match_strategy=PARAM_MATCH_STRATEGY_PREFIX,
+                    ),
+                )
+            ]
+        )
+        bad = GatewayRequestInfo(path="/p", headers={"X-Tenant": "bad-guy"})
+        good = GatewayRequestInfo(path="/p", headers={"X-Tenant": "good-guy"})
+        with pytest.raises(st.ParamFlowBlockError):
+            with gateway_entry("route-h", bad):
+                pass
+        with gateway_entry("route-h", good):  # unmatched -> not limited
+            pass
+
+    def test_custom_api_group(self, manual_clock, engine):
+        gateway_api_definition_manager.load_api_definitions(
+            [
+                ApiDefinition(
+                    "my-api",
+                    (ApiPredicateItem("/api/", URL_MATCH_STRATEGY_PREFIX),),
+                )
+            ]
+        )
+        gateway_rule_manager.load_rules([GatewayFlowRule("my-api", count=1)])
+        info = GatewayRequestInfo(path="/api/orders")
+        with gateway_entry("some-route", info):
+            pass
+        with pytest.raises(st.ParamFlowBlockError):
+            with gateway_entry("some-route", info):
+                pass
+        # non-matching path not limited by the api group
+        with gateway_entry("some-route", GatewayRequestInfo(path="/other")):
+            pass
