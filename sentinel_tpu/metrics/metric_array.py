@@ -112,36 +112,88 @@ def update(
     Masked-out entries contribute nothing. Duplicate (row, bucket) keys in
     one batch accumulate; entries from a superseded window are dropped
     (see module docstring).
+
+    Implementation: O(batch log batch), never O(rows). The batch is
+    sorted by (row, bucket) and reduced to one aggregate per touched
+    bucket with segment sums; each touched bucket is then updated with a
+    UNIQUE-index scatter, choosing between
+
+    * add — the aggregate belongs to the bucket's stored window;
+    * set — the aggregate's window is newer (the bucket rolled: the
+      reference's tryLock+reset, LeapArray.java:180-221);
+    * drop — the aggregate's window is older than the stored one.
+
+    Touched-only writes keep the flush cost independent of the number of
+    rows (the minute tensor alone is GBs at 1M rows); untouched stale
+    buckets are excluded lazily by the read-side deprecation mask.
     """
     wlen = cfg.window_len_ms
     b = cfg.sample_count
-    idx = (ts // wlen) % b
-    ws = ts - ts % wlen
+    n = rows.shape[0]
+    r_rows = state.n_rows
+    idx = ((ts // wlen) % b).astype(jnp.int32)
+    ws = (ts - ts % wlen).astype(jnp.int32)
 
     if mask is None:
         mask = jnp.ones(rows.shape, dtype=bool)
-    rows_eff = jnp.where(mask, rows, 0).astype(jnp.int32)
-    ws_eff = jnp.where(mask, ws, jnp.int32(cfg.empty_ws))
 
-    # 1. Advance window starts (scatter-max — newest write wins the bucket).
-    new_ws = state.window_start.at[rows_eff, idx].max(ws_eff, mode="drop")
+    # Sort by flat bucket key; masked-out entries sort to the tail.
+    key = jnp.where(mask, rows.astype(jnp.int32) * b + idx, jnp.int32(r_rows * b))
+    pos = jnp.arange(n, dtype=jnp.int32)
+    key_s, p_s = jax.lax.sort((key, pos), num_keys=1)
+    ws_s = ws[p_s]
+    mask_s = mask[p_s]
 
-    # 2. Zero buckets that rolled to a newer window (the vectorized
-    #    equivalent of LeapArray's tryLock+reset, LeapArray.java:180-221).
-    stale = new_ws > state.window_start
-    counts = jnp.where(stale[:, :, None], 0, state.counts)
-    min_rt = jnp.where(stale, jnp.int32(cfg.max_rt), state.min_rt)
+    new_seg = jnp.concatenate([jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
+    seg_id = jnp.cumsum(new_seg.astype(jnp.int32)) - 1  # [n], ids dense by position
 
-    # 3. Accumulate entries that belong to the bucket's (new) window.
-    contrib = mask & (ws_eff == new_ws[rows_eff, idx])
-    deltas_eff = jnp.where(contrib[:, None], deltas, 0).astype(jnp.int32)
-    counts = counts.at[rows_eff, idx, :].add(deltas_eff, mode="drop")
+    # Newest window per touched bucket wins; older-window entries drop.
+    seg_ws = jax.ops.segment_max(
+        jnp.where(mask_s, ws_s, jnp.int32(cfg.empty_ws)), seg_id, num_segments=n
+    )
+    contrib = mask_s & (ws_s == seg_ws[seg_id])
 
+    deltas_s = jnp.where(contrib[:, None], deltas[p_s], 0).astype(jnp.int32)
+    seg_sums = jax.ops.segment_sum(deltas_s, seg_id, num_segments=n)  # [n, E]
     if rt_sample is not None:
-        rt_eff = jnp.where(contrib, rt_sample, jnp.int32(2**31 - 1))
-        min_rt = min_rt.at[rows_eff, idx].min(rt_eff, mode="drop")
+        rt_s = jnp.where(contrib, rt_sample[p_s], jnp.int32(2**31 - 1))
+        seg_rt = jax.ops.segment_min(rt_s, seg_id, num_segments=n)
 
-    return MetricArrayState(counts=counts, min_rt=min_rt, window_start=new_ws)
+    # One representative position per touched bucket (segment starts).
+    valid_seg = new_seg & mask_s
+    u_key = jnp.where(valid_seg, key_s, jnp.int32(r_rows * b))
+    u_row = jnp.minimum(u_key // b, r_rows)  # r_rows -> dropped by mode="drop"
+    u_idx = u_key % b
+    u_sid = seg_id  # at segment-start positions, seg_id is the segment's id
+    u_ws = seg_ws[u_sid]
+    u_sums = seg_sums[u_sid]
+
+    old_ws = state.window_start[jnp.clip(u_row, 0, r_rows - 1), u_idx]
+    same_win = valid_seg & (u_ws == old_ws)
+    newer_win = valid_seg & (u_ws > old_ws)
+
+    drop_i = jnp.int32(r_rows)
+    add_row = jnp.where(same_win, u_row, drop_i)
+    set_row = jnp.where(newer_win, u_row, drop_i)
+
+    counts = state.counts.at[add_row, u_idx, :].add(u_sums, mode="drop", unique_indices=True)
+    counts = counts.at[set_row, u_idx, :].set(u_sums, mode="drop", unique_indices=True)
+
+    new_ws_arr = state.window_start.at[set_row, u_idx].set(u_ws, mode="drop", unique_indices=True)
+
+    min_rt = state.min_rt
+    if rt_sample is not None:
+        u_rt = seg_rt[u_sid]
+        min_rt = min_rt.at[add_row, u_idx].min(u_rt, mode="drop", unique_indices=True)
+        min_rt = min_rt.at[set_row, u_idx].set(
+            jnp.minimum(u_rt, jnp.int32(cfg.max_rt)), mode="drop", unique_indices=True
+        )
+    else:
+        min_rt = min_rt.at[set_row, u_idx].set(
+            jnp.int32(cfg.max_rt), mode="drop", unique_indices=True
+        )
+
+    return MetricArrayState(counts=counts, min_rt=min_rt, window_start=new_ws_arr)
 
 
 def _valid_mask(cfg: MetricArrayConfig, state: MetricArrayState, now: jax.Array) -> jax.Array:
