@@ -30,16 +30,61 @@ from sentinel_tpu.runtime.engine import Engine, Verdict
 from sentinel_tpu.utils.clock import Clock
 
 _engine: Optional[Engine] = None
-_engine_lock = threading.Lock()
+_engine_lock = threading.RLock()
+# The engine under construction, visible only to re-entrant calls from
+# the initializing thread (the RLock blocks everyone else). ``_engine``
+# is published only once fully initialized, so the lock-free fast path
+# can never observe an engine whose pre-loaded rules aren't applied yet.
+_boot_engine: Optional[Engine] = None
+
+
+def _reapply_all_managers(engine: Engine) -> None:
+    """Push rules loaded before first engine use (stored but not applied
+    — managers never force engine construction, see
+    RuleManager._on_update) into the engine. Each manager is guarded
+    individually: one bad rule set must not drop the others' rules."""
+    from sentinel_tpu.rules import all_managers
+    from sentinel_tpu.utils.record_log import record_log
+
+    for mgr in all_managers():
+        try:
+            mgr.re_apply(engine)
+        except Exception:
+            record_log.error(
+                "[InitExecutor] %s re_apply failed", type(mgr).__name__, exc_info=True
+            )
 
 
 def get_engine() -> Engine:
-    global _engine
-    if _engine is None:
-        with _engine_lock:
-            if _engine is None:
-                _engine = Engine()
+    global _engine, _boot_engine
+    eng = _engine
+    if eng is not None:
+        return eng
+    initialized = False
+    with _engine_lock:
+        if _engine is None:
+            if _boot_engine is not None:
+                return _boot_engine  # re-entrant call during init
+            _boot_engine = Engine()
+            try:
                 _run_init_funcs()
+                _reapply_all_managers(_boot_engine)
+                _engine = _boot_engine
+                initialized = True
+            finally:
+                _boot_engine = None
+    if initialized:
+        # Close the boot race: a load_rules() that stored rules during
+        # init (peek_engine() still None) may have been missed by the
+        # first pass; now that the engine is published, re-apply once
+        # more (idempotent — _apply replaces whole tables).
+        _reapply_all_managers(_engine)
+    return _engine
+
+
+def peek_engine() -> Optional[Engine]:
+    """The fully-initialized global engine, or None (never constructs,
+    never exposes an engine mid-boot)."""
     return _engine
 
 

@@ -20,12 +20,9 @@ class AuthorityRuleManager(RuleManager[AuthorityRule]):
         # resource -> rule (reference keeps one rule per resource).
         self.by_resource: Dict[str, AuthorityRule] = {}
 
-    def _apply(self, rules: List[AuthorityRule]) -> None:
+    def _apply(self, rules: List[AuthorityRule], engine) -> None:
         self.by_resource = {r.resource: r for r in rules if r.is_valid()}
-        from sentinel_tpu.core.api import get_engine
-
-        engine = get_engine()
-        if hasattr(engine, "set_authority_rules"):
+        if engine is not None:
             engine.set_authority_rules(self.by_resource)
 
     @staticmethod

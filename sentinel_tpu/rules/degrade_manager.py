@@ -14,13 +14,9 @@ from sentinel_tpu.rules.manager_base import RuleManager
 class DegradeRuleManager(RuleManager[DegradeRule]):
     rule_kind = "degrade"
 
-    def _apply(self, rules: List[DegradeRule]) -> None:
-        from sentinel_tpu.core.api import get_engine
-
-        valid = [r for r in rules if r.is_valid()]
-        engine = get_engine()
-        if hasattr(engine, "set_degrade_rules"):
-            engine.set_degrade_rules(valid)
+    def _apply(self, rules: List[DegradeRule], engine) -> None:
+        if engine is not None:
+            engine.set_degrade_rules([r for r in rules if r.is_valid()])
 
 
 degrade_rule_manager = DegradeRuleManager()

@@ -17,10 +17,9 @@ from sentinel_tpu.rules.manager_base import RuleManager
 class FlowRuleManager(RuleManager[FlowRule]):
     rule_kind = "flow"
 
-    def _apply(self, rules: List[FlowRule]) -> None:
-        from sentinel_tpu.core.api import get_engine
-
-        get_engine().set_flow_rules(rules)
+    def _apply(self, rules: List[FlowRule], engine) -> None:
+        if engine is not None:
+            engine.set_flow_rules(rules)
 
     def is_other_origin(self, origin: str, resource: str) -> bool:
         from sentinel_tpu.core.api import get_engine

@@ -29,6 +29,11 @@ class RuleManager(Generic[R]):
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._rules: List[R] = []
+        # Monotonic store counter vs. the last version pushed into an
+        # engine: lets the boot path's second re-apply pass skip
+        # managers whose rules were already applied (no double compile).
+        self._version = 0
+        self._applied_version = 0
         self._listener = _ManagerListener(self)
         self._property: SentinelProperty = DynamicSentinelProperty()
         self._property.add_listener(self._listener)
@@ -56,20 +61,62 @@ class RuleManager(Generic[R]):
     def clear(self) -> None:
         self.load_rules([])
 
+    def re_apply(self, engine) -> None:
+        """Push the stored rules into the given engine if they haven't
+        been pushed yet. Called by ``api.get_engine()`` on first engine
+        construction, so rules loaded before any entry call (the
+        reference allows loadRules before InitExecutor.doInit runs) are
+        not lost."""
+        with self._lock:
+            if self._version == self._applied_version:
+                return
+            self._applied_version = self._version
+            if self._has_pending_state():
+                self._apply(self._rules, engine)
+
+    def _has_pending_state(self) -> bool:
+        return bool(self._rules)
+
     # -- internal --
     def _on_update(self, rules: Optional[Sequence[R]]) -> None:
+        from sentinel_tpu.core.api import peek_engine
+
         rules = list(rules) if rules else []
         with self._lock:
             self._rules = rules
+            self._version += 1
+            # Do not force engine construction from a rule load: module
+            # import instantiates the managers with an empty load, and
+            # creating the Engine allocates device tensors — importing
+            # this library must never commit a JAX backend. When no
+            # engine exists, _apply still runs (manager-local derived
+            # state like SystemRuleManager.effective must track the
+            # stored rules) with engine=None, and the engine push
+            # happens when the engine first comes up (re_apply).
+            # NOTE: the peek must happen AFTER storing self._rules (the
+            # boot thread's post-publication re_apply pass then cannot
+            # miss them), and _apply receives the peeked engine rather
+            # than calling get_engine() — taking api._engine_lock while
+            # holding self._lock would invert the boot path's lock order
+            # (ABBA deadlock with _reapply_all_managers).
+            engine = peek_engine()
+            applied = engine is not None
+            if applied:
+                self._applied_version = self._version
             try:
-                self._apply(rules)
+                self._apply(rules, engine)
             except Exception:
                 record_log.error(
                     "[%s] Failed to apply rules", type(self).__name__, exc_info=True
                 )
-        record_log.info("[%s] Rules loaded: %d", type(self).__name__, len(rules))
+        record_log.info(
+            "[%s] Rules loaded: %d%s",
+            type(self).__name__,
+            len(rules),
+            "" if applied else " (stored; engine not yet up)",
+        )
 
-    def _apply(self, rules: List[R]) -> None:
+    def _apply(self, rules: List[R], engine) -> None:
         raise NotImplementedError
 
 

@@ -23,19 +23,30 @@ class ParamFlowRuleManager(RuleManager[ParamFlowRule]):
         self._gateway_rules: List[ParamFlowRule] = []
 
     def set_gateway_rules(self, rules: List[ParamFlowRule]) -> None:
-        self._gateway_rules = list(rules)
-        self._apply(self.get_rules())
+        from sentinel_tpu.core.api import peek_engine
 
-    def _apply(self, rules: List[ParamFlowRule]) -> None:
+        with self._lock:
+            self._gateway_rules = list(rules)
+            self._version += 1
+            engine = peek_engine()
+            if engine is not None:
+                self._applied_version = self._version
+            self._apply(self._rules, engine)
+        # engine None: stored; the boot re_apply pass folds them in.
+
+    def _has_pending_state(self) -> bool:
+        # Gateway-converted rules count as stored rules too — without
+        # this, a gateway-only config loaded pre-boot would never reach
+        # the engine (base re_apply skips when nothing is pending).
+        return bool(self._rules or self._gateway_rules)
+
+    def _apply(self, rules: List[ParamFlowRule], engine) -> None:
         by_res: Dict[str, List[ParamFlowRule]] = {}
         for r in list(rules) + self._gateway_rules:
             if r.is_valid():
                 by_res.setdefault(r.resource, []).append(r)
         self.by_resource = by_res
-        from sentinel_tpu.core.api import get_engine
-
-        engine = get_engine()
-        if hasattr(engine, "set_param_rules"):
+        if engine is not None:
             engine.set_param_rules(by_res)
 
 

@@ -44,7 +44,7 @@ class SystemRuleManager(RuleManager[SystemRule]):
         super().__init__()
         self.effective = SystemConfig()
 
-    def _apply(self, rules: List[SystemRule]) -> None:
+    def _apply(self, rules: List[SystemRule], engine) -> None:
         cfg = SystemConfig()
         for r in rules:
             cfg = SystemConfig(
@@ -55,10 +55,7 @@ class SystemRuleManager(RuleManager[SystemRule]):
                 max_thread=int(_min_enabled(cfg.max_thread, r.max_thread)),
             )
         self.effective = cfg
-        from sentinel_tpu.core.api import get_engine
-
-        engine = get_engine()
-        if hasattr(engine, "set_system_config"):
+        if engine is not None:
             engine.set_system_config(cfg)
 
 

@@ -59,7 +59,10 @@ from sentinel_tpu.rules.flow_table import FlowRuleDynState, FlowTableDevice
 from sentinel_tpu.rules.param_table import ParamBatch, ParamDynState, run_param
 from sentinel_tpu.rules.shaping import ShapingBatch, run_shaping
 
-_I32_MAX = jnp.int32(2**31 - 1)
+# Plain int, not jnp.int32: creating a device array at import time would
+# commit the JAX backend before callers can pick a platform (see
+# utils/backend.py) — importing this library must never touch a device.
+_I32_MAX = 2**31 - 1
 
 
 class FlushBatch(NamedTuple):

@@ -1,0 +1,41 @@
+"""Backend/platform selection helpers.
+
+The environment's site hook may pre-register an accelerator plugin and
+pin ``jax_platforms`` before env vars are read, and ``jax.devices()``
+(or any compile) commits the backend irrevocably — after that,
+``jax.config.update("jax_platforms", ...)`` is a no-op. Every caller
+that wants the virtual-CPU mesh must therefore (1) set the env vars,
+(2) import jax, (3) set the config explicitly, all BEFORE the first
+backend touch. This helper is the single copy of that dance (used by
+tests/conftest.py, __graft_entry__.dryrun_multichip and bench.py).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Pin JAX to the host-CPU platform, optionally as ``n_devices``
+    virtual devices. Must run before the first backend use; safe to call
+    whether or not jax is already imported."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if n_devices is not None:
+        m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+        if m is None:
+            flags = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+        elif int(m.group(1)) < n_devices:
+            # Only widen — an externally-requested larger mesh stands.
+            flags = re.sub(rf"{_COUNT_FLAG}=\d+", f"{_COUNT_FLAG}={n_devices}", flags)
+        os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already committed; caller checks device count

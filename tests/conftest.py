@@ -1,24 +1,13 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh BEFORE jax import, so the
-multi-chip sharding paths compile and run without TPU hardware — the
-in-process analog of the reference's strategy of testing the cluster
-token service directly in-JVM (SURVEY.md §4)."""
+Forces JAX onto a virtual 8-device CPU mesh BEFORE any backend use, so
+the multi-chip sharding paths compile and run without TPU hardware —
+the in-process analog of the reference's strategy of testing the
+cluster token service directly in-JVM (SURVEY.md §4)."""
 
-import os
+from sentinel_tpu.utils.backend import force_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-# The environment's site hook may pre-register an accelerator plugin and
-# pin jax_platforms before env vars are read; force CPU explicitly.
-jax.config.update("jax_platforms", "cpu")
+force_cpu(8)
 
 import pytest  # noqa: E402
 
