@@ -194,9 +194,13 @@ def _spawn_stage(
         return None
     for line in reversed(r.stdout.strip().splitlines()):
         try:
-            return json.loads(line)
+            out = json.loads(line)
         except json.JSONDecodeError:
             continue
+        if "error" in out:
+            _log(f"stage rules={n_rules} reported an error: {out['error']}")
+            return None
+        return out
     _log(f"stage rules={n_rules} produced no JSON")
     return None
 
@@ -275,7 +279,10 @@ if __name__ == "__main__":
         main()
     except SystemExit:
         raise
-    except BaseException as exc:  # the ONE-JSON-line contract holds even here
+    except BaseException as exc:
+        if "--run-stage" in sys.argv:
+            raise  # children must fail loudly (rc != 0) for the parent
+        # Parent: the ONE-JSON-line contract holds even here.
         _emit(
             {
                 "metric": "batched_entry_checks_per_sec_per_chip",

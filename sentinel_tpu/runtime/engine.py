@@ -394,6 +394,22 @@ class Engine:
         offset = clock.rebase()
         if offset <= 0:
             return
+        self._apply_rebase(offset)
+
+    def _apply_rebase(self, offset: int) -> None:
+        """Shift every stored absolute-ms tensor by ``offset``. Every
+        dyn-state family holding timestamps must appear here — a missed
+        one wedges after the ~22-day rebase (e.g. an OPEN breaker whose
+        next_retry lands 22 days in the future).
+
+        ``offset`` must be a multiple of SystemClock.REBASE_GRANULARITY_MS
+        (rebase() guarantees it): window bucket indices are
+        (ts // window_len) % n, so an unaligned shift would remap or
+        reset every live bucket.
+        """
+        assert offset % SystemClock.REBASE_GRANULARITY_MS == 0, (
+            f"rebase offset {offset} not aligned to window grids"
+        )
 
         def shift_ws(ws, floor):
             return jnp.maximum(ws - jnp.int32(offset), jnp.int32(floor))
@@ -410,6 +426,34 @@ class Engine:
         self.flow_dyn = self.flow_dyn._replace(
             latest_passed_time=shift_ws(self.flow_dyn.latest_passed_time, -(10**9)),
             last_filled_time=shift_ws(self.flow_dyn.last_filled_time, -(10**9)),
+        )
+        # Breakers: an OPEN breaker's retry deadline and the current
+        # window anchor are absolute ms and must shift too — otherwise a
+        # rebase leaves next_retry ~epoch-width in the future (resource
+        # stuck OPEN with no probes) and every exit looks older than ws.
+        # A breaker's statIntervalMs is per-rule and need not divide the
+        # rebase granularity, so the shifted ws is floor-aligned to each
+        # rule's own grid (exits compute aligned = ts - ts % interval;
+        # an off-grid ws would drop or wedge the live window). This can
+        # stretch the in-progress window by < interval once per ~22
+        # days — counts are kept, never lost.
+        ws_floor = -(10**9)
+        iv = jnp.maximum(self.degrade_index.device.interval_ms, 1)
+        ws_shifted = shift_ws(self.degrade_dyn.ws, ws_floor)
+        ws_aligned = jnp.where(
+            ws_shifted > jnp.int32(ws_floor), ws_shifted - ws_shifted % iv, ws_shifted
+        )
+        self.degrade_dyn = self.degrade_dyn._replace(
+            next_retry=shift_ws(self.degrade_dyn.next_retry, ws_floor),
+            ws=ws_aligned,
+        )
+        # Hot-param token buckets / pacers (PARAM_NEVER marks "no state
+        # yet" and must stay put).
+        from sentinel_tpu.rules.param_table import PARAM_NEVER
+
+        self.param_dyn = self.param_dyn._replace(
+            last_add=shift_ws(self.param_dyn.last_add, PARAM_NEVER),
+            latest=shift_ws(self.param_dyn.latest, PARAM_NEVER),
         )
         for op in self._entries:
             op.ts = max(op.ts - offset, 0)

@@ -79,13 +79,26 @@ class SystemClock(Clock):
         """How far from int32 overflow the relative clock is."""
         return self.INT32_MAX - self.now_ms()
 
+    # Rebase offsets must preserve every window grid: bucket index is
+    # (ts // window_len) % n, so a shift must be ≡ 0 modulo
+    # lcm(second-window 500ms grid over 2 buckets, minute-window 1000ms
+    # grid over 60 buckets, breaker 1000ms window) = 60_000 ms.
+    # An unaligned shift silently remaps/resets every live bucket.
+    # (Per-rule breaker windows may use any statIntervalMs; those are
+    # floor-realigned to their own grid in Engine._apply_rebase.)
+    REBASE_GRANULARITY_MS = 60_000
+
     def rebase(self) -> int:
-        """Re-anchor the epoch at *now*; returns the previous offset.
+        """Re-anchor the epoch (aligned down to REBASE_GRANULARITY_MS);
+        returns the shift applied.
 
         Callers (the engine, during an idle flush) must shift any stored
         relative timestamps by the returned offset.
         """
         offset = self.now_ms()
+        offset -= offset % self.REBASE_GRANULARITY_MS
+        if offset <= 0:
+            return 0
         self._epoch_wall_ms += offset
         self._mono_base_ns += offset * 1_000_000
         return offset

@@ -1,0 +1,193 @@
+"""Epoch-rebase tests.
+
+Device timestamps are int32 ms since the clock epoch; after ~22 days
+the engine re-anchors the epoch and shifts every stored absolute-ms
+tensor (Engine._apply_rebase). The offset is aligned to
+SystemClock.REBASE_GRANULARITY_MS (60 s) so every window grid —
+second-window 500 ms buckets, minute-window 1000 ms buckets, breaker
+windows — keeps both its bucket indices and its alignment.
+
+These tests drive the shift directly under the fake clock and assert
+each dyn-state family keeps behaving as if time were continuous — the
+ADVICE-r1 bug was that breaker and hot-param state were NOT shifted,
+so an OPEN breaker stayed blocked ~22 days and param token buckets
+wedged after a rebase.
+"""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.rules.param_table import PARAM_NEVER
+from sentinel_tpu.utils.clock import SystemClock
+
+OFF = SystemClock.REBASE_GRANULARITY_MS  # 60_000
+BASE = 2 * OFF  # run the pre-rebase phase at t≈120s
+
+
+def exc_ratio_rule(resource, ratio=0.5, tw=2, min_req=5):
+    return st.DegradeRule(
+        resource,
+        grade=C.DEGRADE_GRADE_EXCEPTION_RATIO,
+        count=ratio,
+        time_window=tw,
+        min_request_amount=min_req,
+    )
+
+
+def _trip_open(clock, resource):
+    """5 consecutive errors starting at BASE → breaker OPEN with
+    next_retry ≈ BASE + tw*1000."""
+    for i in range(5):
+        clock.set_ms(BASE + i)
+        e = st.try_entry(resource)
+        assert e is not None
+        e.set_error(RuntimeError("boom"))
+        e.exit()
+    clock.set_ms(BASE + 100)
+    assert st.try_entry(resource) is None  # OPEN
+
+
+class TestRebaseShiftsDegradeState:
+    def test_open_breaker_probes_after_rebase(self, manual_clock, engine):
+        """OPEN breaker with retry ≈ BASE+2004; shift epoch by 60s → the
+        probe must open at (shifted) BASE-60000+2004, not 22 days on."""
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("svc", 0.4, tw=2)])
+        _trip_open(manual_clock, "svc")
+
+        engine._apply_rebase(OFF)
+        shifted_retry = BASE - OFF + 2004
+        manual_clock.set_ms(shifted_retry - 500)
+        assert st.try_entry("svc") is None  # still OPEN before retry
+
+        manual_clock.set_ms(shifted_retry + 100)
+        e = st.try_entry("svc")
+        assert e is not None, "OPEN breaker never probed after rebase"
+        e.exit()  # success → CLOSED
+
+    def test_closed_breaker_window_keeps_accumulating(self, manual_clock, engine):
+        """CLOSED breaker: exits after a rebase must still land in the
+        same breaker window (the r1 bug made every exit look stale)."""
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("c", 0.4, tw=2, min_req=5)])
+        # Two errors pre-rebase (below min_request_amount), same second.
+        for i in range(2):
+            manual_clock.set_ms(BASE + i * 10)
+            e = st.try_entry("c")
+            e.set_error(RuntimeError("x"))
+            e.exit()
+        engine.flush()
+        engine._apply_rebase(OFF)
+        # Three more errors post-rebase, same (shifted) second window.
+        for i in range(3):
+            manual_clock.set_ms(BASE - OFF + 30 + i * 10)
+            e = st.try_entry("c")
+            e.set_error(RuntimeError("x"))
+            e.exit()
+        manual_clock.set_ms(BASE - OFF + 90)
+        assert st.try_entry("c") is None, (
+            "errors across the rebase did not accumulate — breaker never opened"
+        )
+
+    def test_odd_stat_interval_survives_rebase(self, manual_clock, engine):
+        """A breaker whose statIntervalMs (7s) does not divide the 60s
+        rebase granularity: its ws is floor-realigned to its own grid so
+        exits keep accumulating instead of being dropped or wedged."""
+        st.degrade_rule_manager.load_rules(
+            [
+                st.DegradeRule(
+                    "odd",
+                    grade=C.DEGRADE_GRADE_EXCEPTION_RATIO,
+                    count=0.4,
+                    time_window=2,
+                    min_request_amount=5,
+                    stat_interval_ms=7000,
+                )
+            ]
+        )
+        # Two errors pre-rebase inside the window [119000, 126000).
+        for i in range(2):
+            manual_clock.set_ms(BASE + i * 10)  # BASE=120000
+            e = st.try_entry("odd")
+            e.set_error(RuntimeError("x"))
+            e.exit()
+        engine.flush()
+        engine._apply_rebase(OFF)
+        ws = int(np.asarray(engine.degrade_dyn.ws)[0])
+        assert ws % 7000 == 0, f"breaker ws {ws} off its 7000ms grid after rebase"
+        # Three more errors in the same shifted window → breaker opens.
+        for i in range(3):
+            manual_clock.set_ms(BASE - OFF + 30 + i * 10)
+            e = st.try_entry("odd")
+            e.set_error(RuntimeError("x"))
+            e.exit()
+        manual_clock.set_ms(BASE - OFF + 90)
+        assert st.try_entry("odd") is None, (
+            "odd-interval breaker lost its counts across the rebase"
+        )
+
+    def test_sentinel_floor_preserved(self, manual_clock, engine):
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("s")])
+        engine.flush()
+        engine._apply_rebase(OFF)
+        assert int(np.asarray(engine.degrade_dyn.ws)[0]) == -(10**9)
+
+    def test_unaligned_offset_rejected(self, manual_clock, engine):
+        with pytest.raises(AssertionError):
+            engine._apply_rebase(7)
+
+
+class TestRebaseShiftsParamState:
+    def test_token_bucket_refills_after_rebase(self, manual_clock, engine):
+        """Param token bucket: last_add shifted with the epoch keeps the
+        per-second refill schedule; unshifted it blocks all refills."""
+        rule = st.ParamFlowRule("p", param_idx=0, count=2, duration_in_sec=1)
+        st.param_flow_rule_manager.load_rules([rule])
+        manual_clock.set_ms(BASE)
+        assert st.try_entry("p", args=("k",)) is not None
+        assert st.try_entry("p", args=("k",)) is not None
+        assert st.try_entry("p", args=("k",)) is None  # bucket drained
+
+        engine._apply_rebase(OFF)
+        manual_clock.set_ms(BASE - OFF + 200)
+        assert st.try_entry("p", args=("k",)) is None  # still drained
+        # 1s (shifted) after the first acquire: bucket refilled.
+        manual_clock.set_ms(BASE - OFF + 1100)
+        assert st.try_entry("p", args=("k",)) is not None, (
+            "token bucket never refilled after rebase"
+        )
+
+    def test_param_never_sentinel_preserved(self, manual_clock, engine):
+        rule = st.ParamFlowRule("q", param_idx=0, count=2)
+        st.param_flow_rule_manager.load_rules([rule])
+        engine.flush()
+        engine._apply_rebase(OFF)
+        assert int(np.asarray(engine.param_dyn.last_add)[-1]) == PARAM_NEVER
+
+
+class TestRebaseShiftsPacer:
+    def test_rate_limiter_pacing_continuous(self, manual_clock, engine):
+        """RateLimiter latest_passed_time (already shifted in r1) still
+        paces correctly across a rebase — regression guard."""
+        st.flow_rule_manager.load_rules(
+            [
+                st.FlowRule(
+                    "rl",
+                    count=10.0,  # 100ms spacing
+                    control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                    max_queueing_time_ms=500,
+                )
+            ]
+        )
+        manual_clock.set_ms(BASE)
+        assert st.try_entry("rl") is not None  # passes, latest=BASE
+        engine._apply_rebase(OFF)
+        # Next permitted slot was BASE+100 → shifted BASE-OFF+100; a
+        # request at +40 queues within the 500ms budget.
+        manual_clock.set_ms(BASE - OFF + 40)
+        assert st.try_entry("rl") is not None
+        # Burst past the queueing budget must block.
+        for _ in range(10):
+            st.try_entry("rl")
+        manual_clock.set_ms(BASE - OFF + 41)
+        assert st.try_entry("rl") is None
