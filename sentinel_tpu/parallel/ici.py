@@ -55,8 +55,8 @@ def merge_stats_across(old: StatsState, new: StatsState, axis: str) -> StatsStat
 
 
 def cluster_allocate(
-    axis: str, demand: jax.Array, capacity: jax.Array
-) -> jax.Array:
+    axis: str, demand: jax.Array, capacity: jax.Array, *, with_before: bool = False
+):
     """Greedy chip-indexed allocation of global capacity.
 
     Each chip has ``demand`` admission candidates for a cluster rule;
@@ -65,7 +65,10 @@ def cluster_allocate(
     sum_{j<i} demand_j)``. Deterministic and conserving — the analog of
     the token server serializing client requests in arrival order
     (arrival order there is nondeterministic; chip index here is).
-    Shapes: demand/capacity broadcastable; returns per-chip grant.
+    Shapes: demand/capacity broadcastable; returns the per-chip grant,
+    or ``(grant, before)`` when ``with_before`` (``before`` = the
+    exclusive demand prefix, i.e. this chip's starting offset into the
+    global budget).
     """
     idx = jax.lax.axis_index(axis)
     n = jax.lax.axis_size(axis)
@@ -76,7 +79,8 @@ def cluster_allocate(
     shape = (n,) + (1,) * (all_d.ndim - 1)
     before = jnp.sum(jnp.where(ranks.reshape(shape) < idx, all_d, 0), axis=0)
     left = jnp.maximum(capacity - before, 0)
-    return jnp.minimum(demand, left)
+    grant = jnp.minimum(demand, left)
+    return (grant, before) if with_before else grant
 
 
 def batch_partition_specs(axis: str = "data"):
@@ -109,29 +113,179 @@ def batch_partition_specs(axis: str = "data"):
     )
 
 
+def _demote_over_grant(
+    axis: str, stats_pre, stats_x, flow_dev, batch, flow_live: jax.Array
+) -> jax.Array:
+    """Cap each DEFAULT-behavior flow rule's admissions at the globally
+    allocated grant; returns the per-entry keep mask.
+
+    Budgeting happens at the FLOW level (``flow_live`` = passed every
+    stage up to the breaker): the reference's FlowSlot (order −2000)
+    grants tokens before DegradeSlot (−1000) runs, and budgeting on the
+    post-breaker set would let a demoted HALF_OPEN probe shift to a
+    different, un-budgeted entry in pass 2.
+
+    Per rule: each chip's demand is the budget-unit sum of its
+    flow-passing entries; ``cluster_allocate`` splits the global
+    remaining capacity by chip-indexed exclusive prefix (the
+    deterministic analog of the token server serializing grants,
+    reference: ClusterFlowChecker.java:55-112); within a chip the grant
+    is spent in (ts, arrival) order and the remainder demoted.
+
+    Budget units follow DefaultController.canPass (reference:
+    controller/DefaultController.java:49-78): QPS grade spends
+    ``acquire`` per entry against ``count − floor(passQps)``; THREAD
+    grade spends 1 per entry (the gauge rises by 1 regardless of
+    acquire) against ``count − curThreadNum``, with the per-entry
+    admission check ``prefix + acquire ≤ grant`` in both grades.
+
+    Exits are sharded, so each chip's post-exit view (``stats_x``)
+    carries only its own releases: the global THREAD capacity is
+    reconstructed as pre-stats plus the psum of per-chip exit deltas
+    (pass counts are exit-invariant, so ``stats_x`` serves directly).
+    Rows are per-slot in general (limitApp×strategy); budgets are
+    conserved per rule against the most-loaded row the rule touches in
+    this batch — exact for the dominant single-row case, conservative
+    for origin-split topologies.
+    """
+    from sentinel_tpu.metrics import metric_array as ma
+    from sentinel_tpu.metrics.events import MetricEvent
+    from sentinel_tpu.metrics.nodes import SECOND_CFG
+    from sentinel_tpu.models import constants as C
+    from sentinel_tpu.runtime.flush import segment_excl_cumsum
+
+    n, k = batch.e_rule_gid.shape
+    nr = flow_dev.n_rules
+    r_rows = stats_x.n_rows
+    interval_sec = SECOND_CFG.interval_ms / 1000.0
+
+    gid_f = batch.e_rule_gid.reshape(-1)
+    row_f = batch.e_check_row.reshape(-1)
+    eidx_f = jnp.arange(n * k, dtype=jnp.int32) // k
+    gid_c = jnp.clip(gid_f, 0, nr - 1)
+    is_qps = flow_dev.grade[gid_c] == C.FLOW_GRADE_QPS
+    # Only DEFAULT-behavior slots consume budget here; shaping slots are
+    # governed by their pacer scan, not the windowed count.
+    constrained = (
+        (gid_f >= 0)
+        & (row_f >= 0)
+        & batch.e_valid[eidx_f]
+        & flow_live[eidx_f]
+        & (flow_dev.behavior[gid_c] == C.CONTROL_BEHAVIOR_DEFAULT)
+    )
+    acq_f = batch.e_acquire[eidx_f]
+    unit_f = jnp.where(is_qps, acq_f, 1)
+
+    # --- global remaining capacity per rule: the MIN over every row the
+    # rule is checked against in this batch (pass counts are replicated;
+    # thread gauges are reconstructed globally). A per-(rule,row) budget
+    # would be exact; per-rule min is conservative for origin-split
+    # topologies and exact for the dominant single-row case. ---
+    pass_sums = ma.window_sums(SECOND_CFG, stats_x.second, batch.now)[:, MetricEvent.PASS]
+    threads_global = stats_pre.threads + jax.lax.psum(
+        stats_x.threads - stats_pre.threads, axis
+    )
+    row_fc = jnp.clip(row_f, 0, r_rows - 1)
+    base_qps_slot = jnp.floor(pass_sums[row_fc].astype(jnp.float32) / interval_sec)
+    base_thr_slot = threads_global[row_fc].astype(jnp.float32)
+    base_slot = jnp.where(is_qps, base_qps_slot, base_thr_slot)
+    cap_slot = jnp.maximum(
+        jnp.floor(flow_dev.count[gid_c]) - base_slot, 0.0
+    ).astype(jnp.int32)
+    big = jnp.int32(2**31 - 1)
+    cap = (
+        jnp.full((nr,), big, dtype=jnp.int32)
+        .at[jnp.where(constrained, gid_f, nr)]
+        .min(jnp.where(constrained, cap_slot, big), mode="drop")
+    )
+    cap = jnp.where(cap == big, 0, cap)  # rules unseen in batch: no demand anyway
+
+    demand = (
+        jnp.zeros((nr,), dtype=jnp.int32)
+        .at[jnp.where(constrained, gid_f, nr)]
+        .add(unit_f, mode="drop")
+    )
+    _, before = cluster_allocate(axis, demand, cap, with_before=True)
+
+    # Spend the budget in (ts, arrival) order within each rule segment.
+    # Per-slot admission = the reference's sequential check run at this
+    # chip's offset into the global budget:
+    #   before (earlier chips' demand) + prefix (earlier local units)
+    #   + acquire ≤ cap.
+    # Since unit ≤ acquire, kept spend per chip stays ≤ cap − before,
+    # so the total across the mesh never exceeds cap.
+    pos = jnp.arange(n * k, dtype=jnp.int32)
+    gid_key = jnp.where(constrained, gid_f, jnp.int32(nr))
+    ts_f = batch.e_ts[eidx_f]
+    key_s, ts_s, ei_s, pos_s = jax.lax.sort((gid_key, ts_f, eidx_f, pos), num_keys=3)
+    acq_s = acq_f[pos_s]
+    con_s = constrained[pos_s]
+    ones = jnp.ones((1,), dtype=bool)
+    new_grp = jnp.concatenate([ones, key_s[1:] != key_s[:-1]])
+    prefix = segment_excl_cumsum(new_grp, jnp.where(con_s, unit_f[pos_s], 0))
+    key_c = jnp.clip(key_s, 0, nr - 1)
+    keep_s = ~con_s | ((before[key_c] + prefix + acq_s) <= cap[key_c])
+    keep_slot = jnp.ones((n * k,), dtype=bool).at[pos_s].set(keep_s)
+    return keep_slot.reshape(n, k).all(axis=1)
+
+
 def make_sharded_flush(mesh, axis: str = "data"):
     """The full batched step over an n-device mesh.
 
     Entries and exits are data-parallel across chips; counter tensors
     and rule tables are replicated; after each local flush the window
     deltas and breaker state are all-reduced so every chip ends the step
-    with the identical global state. Returns a jitted callable with the
-    same signature as ``flush_step`` (without shaping/param batches —
-    their per-rule scans are inherently serializing and stay
-    single-chip for now).
+    with the identical global state.
+
+    Flow budgets are conserved across the mesh in two passes: pass 1
+    computes each chip's locally-admitted demand, ``cluster_allocate``
+    splits the global remaining capacity deterministically, over-grant
+    admissions are demoted to BLOCK via the batch's ``e_cluster_ok``
+    channel, and pass 2 re-runs the step so accounting, breaker probes
+    and verdicts all see the demotions coherently. This replaces the
+    reference's token-server RPC (one all-gather over ICI instead of a
+    Netty round-trip per request).
+
+    Returns a jitted callable with the same signature as ``flush_step``
+    (without shaping/param batches — their per-rule scans are
+    inherently serializing and stay single-chip for now).
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from sentinel_tpu.runtime.flush import flush_step
+    from sentinel_tpu.runtime.flush import apply_exit_phase, flush_entries
 
     def sharded_step(stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch):
-        new_stats, new_fdyn, new_ddyn, new_pdyn, result = flush_step(
-            stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch
+        # Exits once; both admission passes see the post-exit stats.
+        stats_x, ddyn_x = apply_exit_phase(stats, ddev, ddyn, batch)
+        # Pass 1 (no state writes): local flow-level admission demand.
+        _, _, _, _, r1 = flush_entries(
+            stats_x, flow_dev, flow_dyn, ddev, ddyn_x, pdyn, sysdev, batch,
+            commit=False,
+        )
+        keep = _demote_over_grant(axis, stats, stats_x, flow_dev, batch, r1.flow_live)
+        batch2 = batch._replace(
+            e_cluster_ok=batch.e_cluster_ok & (keep | ~r1.flow_live)
+        )
+        # Pass 2: the real step with over-grants demoted.
+        new_stats, new_fdyn, new_ddyn, new_pdyn, result = flush_entries(
+            stats_x, flow_dev, flow_dyn, ddev, ddyn_x, pdyn, sysdev, batch2
         )
         merged = merge_stats_across(stats, new_stats, axis)
+        # Breaker state machine: transitions happen on the one chip
+        # whose shard carried the probe's entry/exit, so "any chip that
+        # changed wins" — a plain pmax would discard HALF_OPEN→CLOSED
+        # (0 < 2) and HALF_OPEN→OPEN (1 < 2), wedging the breaker
+        # forever. If several chips transitioned differently in one
+        # flush, the max changed state wins (OPEN over CLOSED —
+        # pessimistic, like the reference resolving concurrent probe
+        # outcomes through its CAS, AbstractCircuitBreaker.java:40-150).
+        changed = new_ddyn.state != ddyn.state
+        cand = jnp.where(changed, new_ddyn.state, jnp.int32(-1))
+        best = jax.lax.pmax(cand, axis)
+        merged_state = jnp.where(best >= 0, best, ddyn.state)
         merged_ddyn = type(ddyn)(
-            state=jax.lax.pmax(new_ddyn.state, axis),
+            state=merged_state,
             next_retry=jax.lax.pmax(new_ddyn.next_retry, axis),
             bad=ddyn.bad + jax.lax.psum(new_ddyn.bad - ddyn.bad, axis),
             total=ddyn.total + jax.lax.psum(new_ddyn.total - ddyn.total, axis),

@@ -117,6 +117,9 @@ class FlushResult(NamedTuple):
     wait_ms: jax.Array  # int32 [N] shaping wait (rate-limiter; 0 for now)
     sys_type: jax.Array  # int32 [N] — system block dimension (see SYS_*)
     dslot_ok: jax.Array  # bool [N, KD] per-breaker verdicts
+    flow_live: jax.Array  # bool [N] — passed every stage up to (excl.)
+    # the breaker; the sharded path budgets on this (reference: FlowSlot
+    # order −2000 grants tokens before DegradeSlot −1000 runs)
 
 
 # System block dimension codes (limit types in SystemBlockException).
@@ -139,6 +142,18 @@ def _exclusive_cumsum(x: jax.Array) -> jax.Array:
     return jnp.cumsum(x) - x
 
 
+def segment_excl_cumsum(new_grp: jax.Array, contrib: jax.Array) -> jax.Array:
+    """Exclusive running sum of ``contrib`` restarting at every group
+    start (``new_grp`` marks segment boundaries in an already-sorted
+    array). Requires ``contrib >= 0``: the cumsum is nondecreasing, so a
+    running max over group-start snapshots recovers each segment's base.
+    Shared by flow_admission's rank math and the sharded budget demotion
+    (parallel/ici._demote_over_grant)."""
+    excl = _exclusive_cumsum(contrib)
+    grp_base = jax.lax.cummax(jnp.where(new_grp, excl, 0))
+    return excl - grp_base
+
+
 def _segment_consumed(new_grp: jax.Array, last_of_ent: jax.Array, contrib: jax.Array) -> jax.Array:
     """Per-position sum of *prior entries'* contributions within its group.
 
@@ -148,11 +163,7 @@ def _segment_consumed(new_grp: jax.Array, last_of_ent: jax.Array, contrib: jax.A
     of its slots (a rule must not charge the entry's own acquire to
     itself) while later entries still see it.
     """
-    excl = _exclusive_cumsum(jnp.where(last_of_ent, contrib, 0))
-    # Value of the exclusive cumsum at each group's start; cumsum is
-    # nondecreasing so a running max over group-start snapshots works.
-    grp_base = jax.lax.cummax(jnp.where(new_grp, excl, 0))
-    return excl - grp_base
+    return segment_excl_cumsum(new_grp, jnp.where(last_of_ent, contrib, 0))
 
 
 def flow_admission(
@@ -333,27 +344,18 @@ def _prev_second_pass(stats: StatsState, rows: jax.Array, ts: jax.Array) -> jax.
     return jnp.where(ws == aligned, val, 0)
 
 
-def flush_step(
+def apply_exit_phase(
     stats: StatsState,
-    flow_dev: FlowTableDevice,
-    flow_dyn: FlowRuleDynState,
     ddev: DegradeTableDevice,
     ddyn: DegradeDynState,
-    pdyn: ParamDynState,
-    sysdev: SystemDevice,
     batch: FlushBatch,
-    shaping: Optional[ShapingBatch] = None,
-    param: Optional[ParamBatch] = None,
-) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
-    """Pure function: apply one batch.
+) -> Tuple[StatsState, DegradeDynState]:
+    """Phases 1 + 1b: exits, traces and breaker completions.
 
-    Check order matches the slot chain (DefaultSlotChainBuilder order:
-    Authority −6000 → System −5000 → [ParamFlow −3000] → Flow −2000 →
-    Degrade −1000); entries blocked by an earlier stage neither consume
-    later stages' state (pacer time, breaker probes, param tokens) nor
-    count toward their thresholds.
+    Split out of :func:`flush_step` so the sharded two-pass path can
+    apply exits once and run admission twice against the post-exit
+    statistics (parallel/ici.make_sharded_flush).
     """
-    n = batch.e_valid.shape[0]
     m = batch.x_valid.shape[0]
 
     # ---- phase 1: exits + traces (StatisticSlot.exit:148+) ----
@@ -375,6 +377,29 @@ def flush_step(
     ddyn = breaker_on_exits(
         ddev, ddyn, batch.x_dgid, batch.x_ts, batch.x_rt, batch.x_err, batch.x_valid
     )
+    return stats, ddyn
+
+
+def flush_entries(
+    stats: StatsState,
+    flow_dev: FlowTableDevice,
+    flow_dyn: FlowRuleDynState,
+    ddev: DegradeTableDevice,
+    ddyn: DegradeDynState,
+    pdyn: ParamDynState,
+    sysdev: SystemDevice,
+    batch: FlushBatch,
+    shaping: Optional[ShapingBatch] = None,
+    param: Optional[ParamBatch] = None,
+    commit: bool = True,
+) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
+    """Phases 2-3: admission checks and (when ``commit``) accounting.
+
+    ``commit=False`` evaluates the checks but skips every state write
+    (pass/block scatters, breaker probe transitions, param thread
+    gauges) — the demand-probe pass of the sharded path.
+    """
+    n = batch.e_valid.shape[0]
 
     # ---- phase 2a: authority (AuthoritySlot) ----
     live = batch.e_valid & batch.e_auth_ok
@@ -432,12 +457,13 @@ def flush_step(
     deg_pass = dslot_ok.all(axis=1)
 
     admitted = live2 & deg_pass
-    ddyn = apply_probe_transitions(ddyn, batch.e_dgid, probe_slot, admitted)
+    if commit:
+        ddyn = apply_probe_transitions(ddyn, batch.e_dgid, probe_slot, admitted)
     wait_ms = jnp.maximum(wait_ms, jnp.where(admitted, wait_param, 0))
 
     # Per-value thread acquire (ParamFlowStatisticEntryCallback.onPass):
     # +1 per thread-grade param slot of an admitted entry.
-    if param is not None:
+    if param is not None and commit:
         pr = pdyn.threads.shape[0]
         inc_slot = (
             param.valid
@@ -458,19 +484,20 @@ def flush_step(
     reason = jnp.where(admitted, jnp.int32(E.PASS), reason)
 
     # ---- phase 3: entry accounting (StatisticSlot.entry:64-120) ----
-    e_rows_f = batch.e_rows.reshape(-1)
-    e_mask = (e_rows_f >= 0) & jnp.repeat(batch.e_valid, 4)
-    adm4 = jnp.repeat(admitted, 4)
-    acq4 = jnp.repeat(batch.e_acquire, 4)
-    e_deltas = _scatter_cols(
-        4 * n,
-        PASS=jnp.where(adm4, acq4, 0),
-        BLOCK=jnp.where(adm4, 0, acq4),
-    )
-    e_thr = jnp.where(adm4, 1, 0).astype(jnp.int32)
-    stats = apply_updates(
-        stats, e_rows_f, jnp.repeat(batch.e_ts, 4), e_deltas, None, e_thr, e_mask
-    )
+    if commit:
+        e_rows_f = batch.e_rows.reshape(-1)
+        e_mask = (e_rows_f >= 0) & jnp.repeat(batch.e_valid, 4)
+        adm4 = jnp.repeat(admitted, 4)
+        acq4 = jnp.repeat(batch.e_acquire, 4)
+        e_deltas = _scatter_cols(
+            4 * n,
+            PASS=jnp.where(adm4, acq4, 0),
+            BLOCK=jnp.where(adm4, 0, acq4),
+        )
+        e_thr = jnp.where(adm4, 1, 0).astype(jnp.int32)
+        stats = apply_updates(
+            stats, e_rows_f, jnp.repeat(batch.e_ts, 4), e_deltas, None, e_thr, e_mask
+        )
 
     result = FlushResult(
         admitted=admitted,
@@ -479,8 +506,35 @@ def flush_step(
         wait_ms=wait_ms,
         sys_type=sys_type,
         dslot_ok=dslot_ok,
+        flow_live=live2,
     )
     return stats, flow_dyn, ddyn, pdyn, result
+
+
+def flush_step(
+    stats: StatsState,
+    flow_dev: FlowTableDevice,
+    flow_dyn: FlowRuleDynState,
+    ddev: DegradeTableDevice,
+    ddyn: DegradeDynState,
+    pdyn: ParamDynState,
+    sysdev: SystemDevice,
+    batch: FlushBatch,
+    shaping: Optional[ShapingBatch] = None,
+    param: Optional[ParamBatch] = None,
+) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
+    """Pure function: apply one batch.
+
+    Check order matches the slot chain (DefaultSlotChainBuilder order:
+    Authority −6000 → System −5000 → [ParamFlow −3000] → Flow −2000 →
+    Degrade −1000); entries blocked by an earlier stage neither consume
+    later stages' state (pacer time, breaker probes, param tokens) nor
+    count toward their thresholds.
+    """
+    stats, ddyn = apply_exit_phase(stats, ddev, ddyn, batch)
+    return flush_entries(
+        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param
+    )
 
 
 # Four jit variants keyed by which optional batches are present; the

@@ -1,4 +1,223 @@
-"""Multi-chip dryrun stays green on the virtual 8-device CPU mesh."""
+"""Multi-chip sharded flush on the virtual 8-device CPU mesh.
+
+The key property (reference analog: a single token server serializing
+all grants, ClusterFlowChecker.java:55-112): a flow rule's budget is
+conserved ACROSS the mesh within one flush — N chips × M entries
+against count=K admit exactly K in total, not N×K.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+
+def _sharded_fixture(n_devices=8, n_rules=4, n_rows=16, per_chip=16, count=20.0,
+                     acquire=1, grade=None, n_exits=0, threads0=0,
+                     degrade_rule_on_r0=False, exits_complete_dgid0=False):
+    from sentinel_tpu.metrics.nodes import make_stats
+    from sentinel_tpu.models.rules import DegradeRule, FlowRule
+    from sentinel_tpu.rules.degrade_table import DegradeIndex
+    from sentinel_tpu.rules.flow_table import FlowIndex
+    from sentinel_tpu.rules.param_table import make_param_state
+    from sentinel_tpu.runtime.flush import FlushBatch, SystemDevice
+    from sentinel_tpu.parallel import make_mesh, make_sharded_flush
+
+    from sentinel_tpu.models import constants as C
+
+    n = per_chip * n_devices
+    stats = make_stats(n_rows)
+    if threads0:
+        stats = stats._replace(threads=stats.threads.at[0].set(threads0))
+    index = FlowIndex(
+        [
+            FlowRule(
+                resource=f"r{i}",
+                count=count,
+                grade=grade if grade is not None else C.FLOW_GRADE_QPS,
+            )
+            for i in range(n_rules)
+        ]
+    )
+    dindex = DegradeIndex([DegradeRule(resource="r0", grade=1, count=0.5, time_window=10)])
+    inf = float("inf")
+    sysdev = SystemDevice(
+        qps=jnp.float32(inf), max_thread=jnp.float32(inf), max_rt=jnp.float32(inf),
+        load_threshold=jnp.float32(-1.0), cpu_threshold=jnp.float32(-1.0),
+        cur_load=jnp.float32(-1.0), cur_cpu=jnp.float32(-1.0),
+    )
+    # All entries hit rule 0 on row 0.
+    rows = np.zeros((n, 4), dtype=np.int32)
+    rows[:, 1:] = -1
+    gid = np.zeros((n, 1), dtype=np.int32)
+    crow = np.zeros((n, 1), dtype=np.int32)
+    m = max(n_devices, ((n_exits + n_devices - 1) // n_devices) * n_devices)
+    x_valid = np.zeros(m, dtype=bool)
+    x_rows = np.full((m, 4), -1, dtype=np.int32)
+    x_thr = np.zeros(m, dtype=np.int32)
+    if n_exits:
+        # n_exits thread releases on row 0 in the same batch.
+        x_valid[:n_exits] = True
+        x_rows[:n_exits, 0] = 0
+        x_thr[:n_exits] = -1
+    batch = FlushBatch(
+        now=jnp.int32(1000),
+        e_valid=jnp.ones(n, dtype=bool),
+        e_ts=jnp.asarray(600 + np.arange(n, dtype=np.int32) % 400),
+        e_acquire=jnp.full(n, acquire, dtype=jnp.int32),
+        e_rows=jnp.asarray(rows),
+        e_rule_gid=jnp.asarray(gid),
+        e_check_row=jnp.asarray(crow),
+        e_prio=jnp.zeros(n, dtype=bool),
+        e_auth_ok=jnp.ones(n, dtype=bool),
+        e_cluster_ok=jnp.ones(n, dtype=bool),
+        e_dgid=jnp.full((n, 1), -1, dtype=jnp.int32),
+        x_valid=jnp.asarray(x_valid),
+        x_ts=jnp.full(m, 700, dtype=jnp.int32),
+        x_count=jnp.zeros(m, dtype=jnp.int32),
+        x_rows=jnp.asarray(x_rows),
+        x_rt=jnp.zeros(m, dtype=jnp.int32),
+        x_err=jnp.zeros(m, dtype=jnp.int32),
+        x_thr=jnp.asarray(x_thr),
+        x_dgid=jnp.full((m, 1), -1, dtype=jnp.int32),
+    )
+    if degrade_rule_on_r0:
+        dg = np.full((n, 1), -1, dtype=np.int32)
+        dg[:, 0] = 0  # every entry checks breaker gid 0
+        batch = batch._replace(e_dgid=jnp.asarray(dg))
+    if exits_complete_dgid0 and n_exits:
+        xd = np.full((m, 1), -1, dtype=np.int32)
+        xd[:n_exits, 0] = 0  # exits complete breaker gid 0
+        batch = batch._replace(x_dgid=jnp.asarray(xd))
+    mesh = make_mesh(n_devices)
+    jitted = make_sharded_flush(mesh)
+    state = (stats, index.device, index.make_dyn_state(), dindex.device,
+             dindex.make_dyn_state(), make_param_state(8), sysdev)
+    return jitted, state, batch
+
+
+class TestClusterBudgetConservation:
+    def test_8x16_entries_count20_admit_exactly_20(self):
+        from sentinel_tpu.metrics.events import MetricEvent
+
+        jitted, state, batch = _sharded_fixture(count=20.0)
+        stats2, fdyn, ddyn, pdyn, result = jitted(*state, batch)
+        admitted = np.asarray(result.admitted)
+        assert admitted.shape[0] == 128
+        assert int(admitted.sum()) == 20, (
+            f"budget not conserved across mesh: {int(admitted.sum())} != 20"
+        )
+        # Accounting agrees: merged PASS on row 0 is exactly 20, BLOCK 108.
+        counts = np.asarray(stats2.second.counts)[0].sum(axis=0)
+        assert int(counts[MetricEvent.PASS]) == 20
+        assert int(counts[MetricEvent.BLOCK]) == 108
+
+    def test_second_flush_sees_spent_budget(self):
+        jitted, state, batch = _sharded_fixture(count=20.0)
+        stats2, fdyn, ddyn, pdyn, r1 = jitted(*state, batch)
+        assert int(np.asarray(r1.admitted).sum()) == 20
+        # Same batch again in the same window: budget exhausted → 0.
+        state2 = (stats2, state[1], fdyn, state[3], ddyn, pdyn, state[6])
+        _, _, _, _, r2 = jitted(*state2, batch._replace(now=jnp.int32(1200)))
+        assert int(np.asarray(r2.admitted).sum()) == 0
+
+    def test_acquire_units_respected(self):
+        jitted, state, batch = _sharded_fixture(count=20.0, acquire=3)
+        _, _, _, _, result = jitted(*state, batch)
+        # 6 entries × 3 tokens = 18 ≤ 20; a 7th would need 21.
+        assert int(np.asarray(result.admitted).sum()) == 6
+
+    def test_under_capacity_all_admitted(self):
+        jitted, state, batch = _sharded_fixture(count=1000.0)
+        _, _, _, _, result = jitted(*state, batch)
+        assert int(np.asarray(result.admitted).sum()) == 128
+
+
+class TestThreadGradeConservation:
+    def test_thread_grade_counts_entries_not_acquire(self):
+        """THREAD grade spends 1 budget unit per entry (the gauge rises
+        by 1 regardless of acquire), per DefaultController.avgUsedTokens:
+        with count=20, 128 entries of acquire=3 admit 18 (17 prior
+        threads + 3 ≤ 20), not 6."""
+        from sentinel_tpu.models import constants as C
+
+        jitted, state, batch = _sharded_fixture(
+            count=20.0, acquire=3, grade=C.FLOW_GRADE_THREAD
+        )
+        _, _, _, _, result = jitted(*state, batch)
+        assert int(np.asarray(result.admitted).sum()) == 18
+
+    def test_same_batch_releases_count(self):
+        """20 threads in flight + 20 releases in the same batch: the
+        sequential reference admits 20 new entries; the sharded path
+        must too (capacity computed post-exit, psum'd across chips)."""
+        from sentinel_tpu.models import constants as C
+
+        jitted, state, batch = _sharded_fixture(
+            count=20.0, grade=C.FLOW_GRADE_THREAD, threads0=20, n_exits=20
+        )
+        stats2, _, _, _, result = jitted(*state, batch)
+        assert int(np.asarray(result.admitted).sum()) == 20
+        # Gauge balances: 20 - 20 released + 20 acquired.
+        assert int(np.asarray(stats2.threads)[0]) == 20
+
+    def test_no_release_no_capacity(self):
+        from sentinel_tpu.models import constants as C
+
+        jitted, state, batch = _sharded_fixture(
+            count=20.0, grade=C.FLOW_GRADE_THREAD, threads0=20
+        )
+        _, _, _, _, result = jitted(*state, batch)
+        assert int(np.asarray(result.admitted).sum()) == 0
+
+
+class TestBudgetWithBreaker:
+    def test_half_open_probe_stays_within_grant(self):
+        """Budget is allocated at the flow level, so a breaker in
+        HALF_OPEN admitting only probes can never push total admissions
+        beyond the flow grant (the probe-shift hole)."""
+        from sentinel_tpu.rules import degrade_table as dt
+
+        jitted, state, batch = _sharded_fixture(count=2.0, degrade_rule_on_r0=True)
+        stats, fdev, fdyn, ddev, ddyn, pdyn, sysdev = state
+        ddyn = ddyn._replace(
+            state=ddyn.state.at[0].set(dt.OPEN),
+            next_retry=ddyn.next_retry.at[0].set(500),  # past retry at now=1000
+        )
+        _, _, ddyn2, _, result = jitted(stats, fdev, fdyn, ddev, ddyn, pdyn, sysdev, batch)
+        total = int(np.asarray(result.admitted).sum())
+        assert total <= 2, f"admitted {total} > flow grant 2"
+
+    def test_half_open_probe_success_closes_across_mesh(self):
+        """The probe's successful exit lands in ONE chip's exit shard;
+        the merged breaker state must become CLOSED — a plain pmax merge
+        would keep HALF_OPEN (2 > 0) and wedge the resource forever."""
+        from sentinel_tpu.rules import degrade_table as dt
+
+        jitted, state, batch = _sharded_fixture(
+            count=1000.0, n_exits=1, exits_complete_dgid0=True
+        )
+        stats, fdev, fdyn, ddev, ddyn, pdyn, sysdev = state
+        ddyn = ddyn._replace(state=ddyn.state.at[0].set(dt.HALF_OPEN))
+        # Entries must not touch the breaker (e_dgid = -1 in fixture).
+        _, _, ddyn2, _, _ = jitted(stats, fdev, fdyn, ddev, ddyn, pdyn, sysdev, batch)
+        assert int(np.asarray(ddyn2.state)[0]) == dt.CLOSED, (
+            "HALF_OPEN→CLOSED transition lost in the mesh merge"
+        )
+
+    def test_half_open_probe_failure_reopens_across_mesh(self):
+        from sentinel_tpu.rules import degrade_table as dt
+
+        jitted, state, batch = _sharded_fixture(
+            count=1000.0, n_exits=1, exits_complete_dgid0=True
+        )
+        stats, fdev, fdyn, ddev, ddyn, pdyn, sysdev = state
+        ddyn = ddyn._replace(state=ddyn.state.at[0].set(dt.HALF_OPEN))
+        batch = batch._replace(x_err=batch.x_err.at[0].set(1))  # probe failed
+        _, _, ddyn2, _, _ = jitted(stats, fdev, fdyn, ddev, ddyn, pdyn, sysdev, batch)
+        assert int(np.asarray(ddyn2.state)[0]) == dt.OPEN, (
+            "HALF_OPEN→OPEN transition lost in the mesh merge"
+        )
 
 
 def test_dryrun_multichip_8():
