@@ -47,14 +47,25 @@ MINUTE_CFG = ma.MetricArrayConfig(
 class StatsState(NamedTuple):
     """Device-resident statistics for all nodes.
 
-    The reference's StatisticNode holds exactly these three things: a 1 s
-    rolling window (2×500 ms), a 60 s window (60×1 s) and a thread gauge
-    (reference: node/StatisticNode.java:90-112).
+    The reference's StatisticNode holds a 1 s rolling window (2×500 ms),
+    a 60 s window (60×1 s) and a thread gauge
+    (reference: node/StatisticNode.java:90-112), plus — for prioritized
+    entries — a future-bucket slab tracking tokens borrowed from
+    not-yet-current windows (reference: OccupiableBucketLeapArray +
+    FutureBucketLeapArray, slots/statistic/metric/occupy/
+    OccupiableBucketLeapArray.java:29-75). ``future_pass[r, b]`` holds
+    tokens borrowed for the window starting at ``future_ws[r, b]``;
+    while that start is still ahead of now they count as *waiting*, and
+    once it becomes current they count as PASS of that window — a
+    read-side fold (``occupied_in_window``) instead of the reference's
+    bucket-reset materialisation, so no dense per-flush sweep is needed.
     """
 
     second: ma.MetricArrayState
     minute: ma.MetricArrayState
     threads: jax.Array  # int32 [R]
+    future_pass: jax.Array  # int32 [R, B] borrowed tokens per future bucket
+    future_ws: jax.Array  # int32 [R, B] aligned start of the borrowed window
 
     @property
     def n_rows(self) -> int:
@@ -62,23 +73,48 @@ class StatsState(NamedTuple):
 
 
 def make_stats(n_rows: int) -> StatsState:
+    b = SECOND_CFG.sample_count
     return StatsState(
         second=ma.make_state(n_rows, SECOND_CFG),
         minute=ma.make_state(n_rows, MINUTE_CFG),
         threads=jnp.zeros((n_rows,), dtype=jnp.int32),
+        future_pass=jnp.zeros((n_rows, b), dtype=jnp.int32),
+        future_ws=jnp.full((n_rows, b), SECOND_CFG.empty_ws, dtype=jnp.int32),
     )
 
 
 def grow_stats(state: StatsState, new_rows: int) -> StatsState:
     if new_rows <= state.n_rows:
         return state
+    extra = make_stats(new_rows - state.n_rows)
     return StatsState(
         second=ma.grow(state.second, new_rows, SECOND_CFG),
         minute=ma.grow(state.minute, new_rows, MINUTE_CFG),
-        threads=jnp.concatenate(
-            [state.threads, jnp.zeros((new_rows - state.n_rows,), dtype=jnp.int32)]
-        ),
+        threads=jnp.concatenate([state.threads, extra.threads]),
+        future_pass=jnp.concatenate([state.future_pass, extra.future_pass]),
+        future_ws=jnp.concatenate([state.future_ws, extra.future_ws]),
     )
+
+
+def occupied_in_window(state: StatsState, now: jax.Array) -> jax.Array:
+    """Borrowed tokens whose window is now current (int32 [R]).
+
+    The reference materialises these into the second window when the
+    bucket resets (OccupiableBucketLeapArray.newEmptyBucket copies
+    borrowArray's matured count); here they are folded in at read time:
+    a slab entry counts iff its window has started and is not yet
+    deprecated (same strict-age rule as the window arrays).
+    """
+    age = now - state.future_ws
+    current = (age >= 0) & (age <= SECOND_CFG.interval_ms)
+    return jnp.sum(jnp.where(current, state.future_pass, 0), axis=1)
+
+
+def waiting_tokens(state: StatsState, now: jax.Array) -> jax.Array:
+    """Tokens borrowed for still-future windows (int32 [R]) —
+    ``StatisticNode.waiting()`` (reference: node/StatisticNode.java:337)."""
+    future = state.future_ws > now
+    return jnp.sum(jnp.where(future, state.future_pass, 0), axis=1)
 
 
 def apply_updates(
@@ -96,7 +132,7 @@ def apply_updates(
     rows_eff = jnp.where(mask, rows, 0).astype(jnp.int32)
     thr = jnp.where(mask, thread_delta, 0).astype(jnp.int32)
     threads = state.threads.at[rows_eff].add(thr, mode="drop")
-    return StatsState(second=second, minute=minute, threads=threads)
+    return state._replace(second=second, minute=minute, threads=threads)
 
 
 class NodeKind:

@@ -46,11 +46,22 @@ def merge_window_across(old: MetricArrayState, new: MetricArrayState, axis: str)
 
 
 def merge_stats_across(old: StatsState, new: StatsState, axis: str) -> StatsState:
-    """All-reduce the full stats family (second + minute + thread gauge)."""
+    """All-reduce the full stats family (second + minute + thread gauge
+    + occupy future slab)."""
+    # Future slab: same rollover-aware merge as the window arrays (max
+    # window start wins; only chips whose final ws matches contribute).
+    g_ws = jax.lax.pmax(new.future_ws, axis)
+    old_cur = old.future_ws == g_ws
+    new_cur = new.future_ws == g_ws
+    base = jnp.where(old_cur, old.future_pass, 0)
+    contrib = jnp.where(new_cur, new.future_pass - base, 0)
+    fut_pass = base + jax.lax.psum(contrib, axis)
     return StatsState(
         second=merge_window_across(old.second, new.second, axis),
         minute=merge_window_across(old.minute, new.minute, axis),
         threads=old.threads + jax.lax.psum(new.threads - old.threads, axis),
+        future_pass=fut_pass,
+        future_ws=g_ws,
     )
 
 
@@ -263,10 +274,12 @@ def make_sharded_flush(mesh, axis: str = "data"):
             stats_x, flow_dev, flow_dyn, ddev, ddyn_x, pdyn, sysdev, batch,
             commit=False,
         )
-        keep = _demote_over_grant(axis, stats, stats_x, flow_dev, batch, r1.flow_live)
-        batch2 = batch._replace(
-            e_cluster_ok=batch.e_cluster_ok & (keep | ~r1.flow_live)
-        )
+        # Occupied entries borrow from future windows, not the current
+        # budget — exclude them from the grant math (their slab commits
+        # merge like window counters).
+        budgeted = r1.flow_live & ~r1.occupied
+        keep = _demote_over_grant(axis, stats, stats_x, flow_dev, batch, budgeted)
+        batch2 = batch._replace(e_cluster_ok=batch.e_cluster_ok & (keep | ~budgeted))
         # Pass 2: the real step with over-grants demoted.
         new_stats, new_fdyn, new_ddyn, new_pdyn, result = flush_entries(
             stats_x, flow_dev, flow_dyn, ddev, ddyn_x, pdyn, sysdev, batch2

@@ -414,14 +414,14 @@ class Engine:
         def shift_ws(ws, floor):
             return jnp.maximum(ws - jnp.int32(offset), jnp.int32(floor))
 
-        self.stats = StatsState(
+        self.stats = self.stats._replace(
             second=self.stats.second._replace(
                 window_start=shift_ws(self.stats.second.window_start, SECOND_CFG.empty_ws)
             ),
             minute=self.stats.minute._replace(
                 window_start=shift_ws(self.stats.minute.window_start, MINUTE_CFG.empty_ws)
             ),
-            threads=self.stats.threads,
+            future_ws=shift_ws(self.stats.future_ws, SECOND_CFG.empty_ws),
         )
         self.flow_dyn = self.flow_dyn._replace(
             latest_passed_time=shift_ws(self.flow_dyn.latest_passed_time, -(10**9)),
@@ -618,6 +618,7 @@ class Engine:
             sysdev = self._system_device()
             shaping = self._encode_shaping(entries, k)
             param = self._encode_param(entries, exits)
+            occ_ms = config.occupy_timeout_ms
             common = (
                 self.stats,
                 self.flow_index.device,
@@ -629,13 +630,13 @@ class Engine:
                 batch,
             )
             if shaping is None and param is None:
-                out = flush_step_jit(*common)
+                out = flush_step_jit(*common, occupy_timeout_ms=occ_ms)
             elif param is None:
-                out = flush_step_shaping_jit(*common, shaping)
+                out = flush_step_shaping_jit(*common, shaping, occupy_timeout_ms=occ_ms)
             elif shaping is None:
-                out = flush_step_param_jit(*common, param)
+                out = flush_step_param_jit(*common, param, occupy_timeout_ms=occ_ms)
             else:
-                out = flush_step_full_jit(*common, shaping, param)
+                out = flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms)
             self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn, result = out
 
             # One batched device->host fetch (each separate fetch costs a
@@ -747,16 +748,23 @@ class Engine:
     # reads (command/metric plane; used heavily by tests)
     # ------------------------------------------------------------------
     def _row_stats(self, row: int, now: Optional[int] = None) -> Dict[str, float]:
+        from sentinel_tpu.metrics.nodes import occupied_in_window, waiting_tokens
+
         now_i = jnp.int32(self.clock.now_ms() if now is None else now)
         sec = np.asarray(ma.window_sums(SECOND_CFG, self.stats.second, now_i)[row])
         minute = np.asarray(ma.window_sums(MINUTE_CFG, self.stats.minute, now_i)[row])
         min_rt = int(np.asarray(ma.window_min_rt(SECOND_CFG, self.stats.second, now_i)[row]))
         threads = int(np.asarray(self.stats.threads[row]))
+        occ_cur = int(np.asarray(occupied_in_window(self.stats, now_i)[row]))
+        waiting = int(np.asarray(waiting_tokens(self.stats, now_i)[row]))
         interval_sec = SECOND_CFG.interval_ms / 1000.0
         success = int(sec[MetricEvent.SUCCESS])
         rt_sum = int(sec[MetricEvent.RT])
         return {
-            "pass_qps": sec[MetricEvent.PASS] / interval_sec,
+            # Matured borrowed tokens count as pass, like the reference
+            # materialising them into the bucket on reset.
+            "pass_qps": (int(sec[MetricEvent.PASS]) + occ_cur) / interval_sec,
+            "waiting": waiting,
             "block_qps": sec[MetricEvent.BLOCK] / interval_sec,
             "success_qps": success / interval_sec,
             "exception_qps": sec[MetricEvent.EXCEPTION] / interval_sec,

@@ -46,7 +46,14 @@ import jax.numpy as jnp
 from sentinel_tpu.core import errors as E
 from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
 from sentinel_tpu.metrics import metric_array as ma
-from sentinel_tpu.metrics.nodes import MINUTE_CFG, SECOND_CFG, StatsState, apply_updates
+from sentinel_tpu.metrics.nodes import (
+    MINUTE_CFG,
+    SECOND_CFG,
+    StatsState,
+    apply_updates,
+    occupied_in_window,
+    waiting_tokens,
+)
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.rules.degrade_table import (
     DegradeDynState,
@@ -120,6 +127,8 @@ class FlushResult(NamedTuple):
     flow_live: jax.Array  # bool [N] — passed every stage up to (excl.)
     # the breaker; the sharded path budgets on this (reference: FlowSlot
     # order −2000 grants tokens before DegradeSlot −1000 runs)
+    occupied: jax.Array  # bool [N] — admitted by borrowing future-window
+    # tokens (prioritized entries; PriorityWaitException semantics)
 
 
 # System block dimension codes (limit types in SystemBlockException).
@@ -170,22 +179,44 @@ def flow_admission(
     stats: StatsState,
     flow_dev: FlowTableDevice,
     batch: FlushBatch,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Vectorized FlowRuleChecker + DefaultController.
+    live: Optional[jax.Array] = None,
+    occupy_timeout_ms: int = 500,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, StatsState]:
+    """Vectorized FlowRuleChecker + DefaultController (incl. occupy).
 
     Returns (slot_ok [N,K] bool, flow_pass [N] bool,
     pass_plus_consumed [N*K] int32 — the windowed pass sum plus the
     intra-batch charge per slot, which the shaping scan reuses as its
-    ``passQps`` input). Slots whose behavior is not
+    ``passQps`` input, occupied [N] bool, occupy_wait_ms [N] int32,
+    stats with new future-slab borrows). Slots whose behavior is not
     CONTROL_BEHAVIOR_DEFAULT are reported as ok here; their verdict is
     decided by the shaping scan (rules/shaping.py).
+
+    The occupy branch (DefaultController.java:49-75 → StatisticNode.
+    tryOccupyNext, node/StatisticNode.java:302-340): a prioritized
+    QPS-grade entry that fails the plain check may borrow tokens from a
+    future window if, after the windows between now and then expire,
+    the borrowed total stays under the threshold and the wait is below
+    ``occupy_timeout_ms`` (OccupyTimeoutProperty). Granted entries pass
+    with ``wait_ms`` and their tokens land in the future slab; the
+    intra-batch borrow charge among prioritized entries of one row is
+    conservative (every earlier candidate charges, granted or not —
+    same stance as the main rank math).
     """
     n, k = batch.e_rule_gid.shape
     r_rows = stats.n_rows
     nr = flow_dev.n_rules
-    interval_sec = SECOND_CFG.interval_ms / 1000.0
+    interval = SECOND_CFG.interval_ms
+    wlen = SECOND_CFG.window_len_ms
+    nb = SECOND_CFG.sample_count
+    interval_sec = interval / 1000.0
 
-    pass_sums = ma.window_sums(SECOND_CFG, stats.second, batch.now)[:, MetricEvent.PASS]
+    # Windowed pass including matured borrowed tokens (the reference
+    # materialises borrows into the bucket on reset; we fold at read).
+    pass_sums = (
+        ma.window_sums(SECOND_CFG, stats.second, batch.now)[:, MetricEvent.PASS]
+        + occupied_in_window(stats, batch.now)
+    )
 
     gid_f = batch.e_rule_gid.reshape(-1)
     row_f = batch.e_check_row.reshape(-1)
@@ -226,8 +257,100 @@ def flow_admission(
 
     # canPass: block iff curCount + acquireCount > count.
     ok = (cur + acq_s.astype(jnp.float32)) <= count_s
+    is_default = behavior_s == C.CONTROL_BEHAVIOR_DEFAULT
+
+    # ---- occupy branch (prioritized entries borrowing the future) ----
+    live_s = jnp.ones((n * k,), dtype=bool) if live is None else live[ei_s]
+    eligible = (
+        active_s
+        & ~ok
+        & is_default
+        & live_s
+        & batch.e_prio[ei_s]
+        & (grade_s == C.FLOW_GRADE_QPS)
+    )
+    max_count = count_s * interval_sec
+    waiting = waiting_tokens(stats, batch.now)[rk_c]
+    # Conservative intra-batch borrow charge among this row's earlier
+    # prioritized candidates (granted or not).
+    borrow_charge = _segment_consumed(
+        new_grp, last_of_ent, jnp.where(eligible, acq_s, 0)
+    )
+    cur_borrow = (waiting + borrow_charge).astype(jnp.float32)
+    cur_pass = (base_pass + consumed_acq).astype(jnp.float32)
+    acq_fs = acq_s.astype(jnp.float32)
+
+    now_mod = batch.now % wlen
+    occ_slot = jnp.zeros((n * k,), dtype=bool)
+    occ_wait = jnp.zeros((n * k,), dtype=jnp.int32)
+    occ_target = jnp.zeros((n * k,), dtype=jnp.int32)
+    # Static unroll over the (small) bucket count — tryOccupyNext's
+    # while-loop over candidate future windows.
+    for i in range(nb):
+        wait_i = i * wlen + wlen - now_mod  # tryOccupyNext waitInMs
+        expiring_ws = batch.now - now_mod + wlen - interval + i * wlen
+        bidx = (expiring_ws // wlen) % nb
+        in_bucket = stats.second.window_start[rk_c, bidx] == expiring_ws
+        win_pass = jnp.where(
+            in_bucket, stats.second.counts[rk_c, bidx, MetricEvent.PASS], 0
+        )
+        # A matured borrow in the expiring window frees up too.
+        fut_match = stats.future_ws[rk_c, bidx] == expiring_ws
+        win_pass = win_pass + jnp.where(fut_match, stats.future_pass[rk_c, bidx], 0)
+        cond = (
+            eligible
+            & (wait_i < occupy_timeout_ms)
+            & (cur_pass + cur_borrow + acq_fs - win_pass.astype(jnp.float32) <= max_count)
+        )
+        fresh = cond & ~occ_slot
+        occ_wait = jnp.where(fresh, wait_i, occ_wait)
+        occ_target = jnp.where(fresh, batch.now - now_mod + (i + 1) * wlen, occ_target)
+        occ_slot = occ_slot | cond
+
+    ok = ok | occ_slot
     # Non-DEFAULT behaviors are decided by the shaping scan, not here.
-    ok = ok | ~active_s | (behavior_s != C.CONTROL_BEHAVIOR_DEFAULT)
+    ok = ok | ~active_s | ~is_default
+
+    # Per-entry occupy view: an entry is "occupied" if at least one of
+    # its slots borrowed; its wait is the max over borrowing slots.
+    drop_e = jnp.int32(n)
+    e_scatter = jnp.where(occ_slot, ei_s, drop_e)
+    occupied = (
+        jnp.zeros((n,), dtype=bool).at[e_scatter].set(True, mode="drop")
+    )
+    occupy_wait = (
+        jnp.zeros((n,), dtype=jnp.int32).at[e_scatter].max(occ_wait, mode="drop")
+    )
+
+    # ---- commit borrows into the future slab (set-if-newer per bucket,
+    # like FutureBucketLeapArray's reset-then-add) ----
+    tb = (occ_target // wlen) % nb
+    slab_key = jnp.where(occ_slot, rk_c * nb + tb.astype(jnp.int32), jnp.int32(r_rows * nb))
+    sk_s, sp_s = jax.lax.sort((slab_key, jnp.arange(n * k, dtype=jnp.int32)), num_keys=1)
+    s_new = jnp.concatenate([ones, sk_s[1:] != sk_s[:-1]])
+    s_sid = jnp.cumsum(s_new.astype(jnp.int32)) - 1
+    s_valid = occ_slot[sp_s]
+    s_ws = jnp.where(s_valid, occ_target[sp_s], jnp.int32(SECOND_CFG.empty_ws))
+    s_acq = jnp.where(s_valid, acq_s[sp_s], 0)
+    seg_ws = jax.ops.segment_max(s_ws, s_sid, num_segments=n * k)
+    contrib = s_valid & (s_ws == seg_ws[s_sid])
+    seg_sum = jax.ops.segment_sum(jnp.where(contrib, s_acq, 0), s_sid, num_segments=n * k)
+    u_valid = s_new & s_valid
+    u_key = jnp.where(u_valid, sk_s, jnp.int32(r_rows * nb))
+    u_row = jnp.minimum(u_key // nb, r_rows)
+    u_b = u_key % nb
+    u_ws = seg_ws[s_sid]
+    u_sum = seg_sum[s_sid]
+    old_ws = stats.future_ws[jnp.clip(u_row, 0, r_rows - 1), u_b]
+    same = u_valid & (u_ws == old_ws)
+    newer = u_valid & (u_ws > old_ws)
+    drop_r = jnp.int32(r_rows)
+    add_row = jnp.where(same, u_row, drop_r)
+    set_row = jnp.where(newer, u_row, drop_r)
+    fut_pass = stats.future_pass.at[add_row, u_b].add(u_sum, mode="drop", unique_indices=True)
+    fut_pass = fut_pass.at[set_row, u_b].set(u_sum, mode="drop", unique_indices=True)
+    fut_ws = stats.future_ws.at[set_row, u_b].set(u_ws, mode="drop", unique_indices=True)
+    stats = stats._replace(future_pass=fut_pass, future_ws=fut_ws)
 
     slot_ok = jnp.ones((n * k,), dtype=bool).at[pos_s].set(ok).reshape(n, k)
     flow_pass = slot_ok.all(axis=1)
@@ -236,7 +359,7 @@ def flow_admission(
         .at[pos_s]
         .set((base_pass + consumed_acq).astype(jnp.int32))
     )
-    return slot_ok, flow_pass, pass_plus_consumed
+    return slot_ok, flow_pass, pass_plus_consumed, occupied, occupy_wait, stats
 
 
 def _scatter_cols(n: int, **cols: jax.Array) -> jax.Array:
@@ -392,6 +515,7 @@ def flush_entries(
     shaping: Optional[ShapingBatch] = None,
     param: Optional[ParamBatch] = None,
     commit: bool = True,
+    occupy_timeout_ms: int = 500,
 ) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
     """Phases 2-3: admission checks and (when ``commit``) accounting.
 
@@ -425,8 +549,13 @@ def flush_entries(
     live = live & param_ok
 
     # ---- phase 2c: flow rules (FlowSlot / FlowRuleChecker) ----
-    slot_ok, flow_pass, pass_plus_consumed = flow_admission(stats, flow_dev, batch)
-    wait_ms = jnp.zeros((n,), dtype=jnp.int32)
+    slot_ok, flow_pass, pass_plus_consumed, occupied, occupy_wait, stats_b = (
+        flow_admission(stats, flow_dev, batch, live, occupy_timeout_ms)
+    )
+    if commit:
+        stats = stats_b  # future-slab borrows persist only when committing
+    occupied = occupied & live
+    wait_ms = jnp.maximum(jnp.zeros((n,), dtype=jnp.int32), jnp.where(occupied, occupy_wait, 0))
     if shaping is not None:
         # shaping controllers (rate-limiter / warm-up); entries already
         # blocked upstream must not advance pacer state.
@@ -453,12 +582,19 @@ def flush_entries(
     wait_ms = jnp.where(live2, wait_ms, 0)
 
     # ---- phase 2d: circuit breakers (DegradeSlot.entry) ----
-    dslot_ok, probe_slot = breaker_try_pass(ddev, ddyn, batch.e_dgid, batch.e_ts, live2)
-    deg_pass = dslot_ok.all(axis=1)
+    # Occupied entries bypass the breaker: the reference's
+    # PriorityWaitException aborts the slot chain before DegradeSlot
+    # (FlowSlot order −2000 < DegradeSlot −1000), and StatisticSlot
+    # catches it to count only the thread acquire.
+    occ_live = occupied & live2
+    dslot_ok, probe_slot = breaker_try_pass(
+        ddev, ddyn, batch.e_dgid, batch.e_ts, live2 & ~occupied
+    )
+    deg_pass = dslot_ok.all(axis=1) | occ_live
 
     admitted = live2 & deg_pass
     if commit:
-        ddyn = apply_probe_transitions(ddyn, batch.e_dgid, probe_slot, admitted)
+        ddyn = apply_probe_transitions(ddyn, batch.e_dgid, probe_slot, admitted & ~occupied)
     wait_ms = jnp.maximum(wait_ms, jnp.where(admitted, wait_param, 0))
 
     # Per-value thread acquire (ParamFlowStatisticEntryCallback.onPass):
@@ -488,11 +624,17 @@ def flush_entries(
         e_rows_f = batch.e_rows.reshape(-1)
         e_mask = (e_rows_f >= 0) & jnp.repeat(batch.e_valid, 4)
         adm4 = jnp.repeat(admitted, 4)
+        # Occupied entries: thread acquire + OCCUPIED_PASS now; their
+        # PASS materialises when the borrowed window becomes current
+        # (StatisticSlot's PriorityWaitException branch + the
+        # DefaultController addOccupiedPass call).
+        occ4 = jnp.repeat(occupied & admitted, 4)
         acq4 = jnp.repeat(batch.e_acquire, 4)
         e_deltas = _scatter_cols(
             4 * n,
-            PASS=jnp.where(adm4, acq4, 0),
+            PASS=jnp.where(adm4 & ~occ4, acq4, 0),
             BLOCK=jnp.where(adm4, 0, acq4),
+            OCCUPIED_PASS=jnp.where(occ4, acq4, 0),
         )
         e_thr = jnp.where(adm4, 1, 0).astype(jnp.int32)
         stats = apply_updates(
@@ -507,6 +649,7 @@ def flush_entries(
         sys_type=sys_type,
         dslot_ok=dslot_ok,
         flow_live=live2,
+        occupied=occupied & admitted,
     )
     return stats, flow_dyn, ddyn, pdyn, result
 
@@ -522,6 +665,7 @@ def flush_step(
     batch: FlushBatch,
     shaping: Optional[ShapingBatch] = None,
     param: Optional[ParamBatch] = None,
+    occupy_timeout_ms: int = 500,
 ) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
     """Pure function: apply one batch.
 
@@ -533,40 +677,53 @@ def flush_step(
     """
     stats, ddyn = apply_exit_phase(stats, ddev, ddyn, batch)
     return flush_entries(
-        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param
+        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
+        occupy_timeout_ms=occupy_timeout_ms,
     )
 
 
 # Four jit variants keyed by which optional batches are present; the
 # engine picks per flush so DEFAULT-only traffic never pays for the
-# shaping/param machinery.
-@functools.partial(jax.jit, donate_argnums=(0, 4, 5))
-def flush_step_jit(stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch):
-    return flush_step(stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch)
+# shaping/param machinery. occupy_timeout_ms is static (a config value
+# that rarely changes; a change recompiles once).
+@functools.partial(jax.jit, donate_argnums=(0, 4, 5), static_argnames=("occupy_timeout_ms",))
+def flush_step_jit(
+    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, occupy_timeout_ms=500
+):
+    return flush_step(
+        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch,
+        occupy_timeout_ms=occupy_timeout_ms,
+    )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5))
+@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5), static_argnames=("occupy_timeout_ms",))
 def flush_step_shaping_jit(
-    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping
+    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
+    occupy_timeout_ms=500,
 ):
     return flush_step(
-        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping
+        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
+        occupy_timeout_ms=occupy_timeout_ms,
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 4, 5))
+@functools.partial(jax.jit, donate_argnums=(0, 4, 5), static_argnames=("occupy_timeout_ms",))
 def flush_step_param_jit(
-    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, param
+    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, param,
+    occupy_timeout_ms=500,
 ):
     return flush_step(
-        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, None, param
+        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, None, param,
+        occupy_timeout_ms=occupy_timeout_ms,
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5))
+@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5), static_argnames=("occupy_timeout_ms",))
 def flush_step_full_jit(
-    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param
+    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
+    occupy_timeout_ms=500,
 ):
     return flush_step(
-        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param
+        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
+        occupy_timeout_ms=occupy_timeout_ms,
     )

@@ -52,6 +52,9 @@ class SentinelConfig:
     # TPU-native keys (no reference equivalent).
     FLUSH_INTERVAL_MS = "sentinel.tpu.flush.interval.ms"
     FLUSH_MAX_BATCH = "sentinel.tpu.flush.max.batch"
+    # OccupyTimeoutProperty (reference: CORE/node/OccupyTimeoutProperty.java):
+    # max borrowable wait for prioritized entries, < interval.
+    OCCUPY_TIMEOUT_MS = "csp.sentinel.statistic.occupy.timeout"
     INITIAL_ROWS = "sentinel.tpu.rows.initial"
     LOG_DIR = "csp.sentinel.log.dir"
 
@@ -66,6 +69,7 @@ class SentinelConfig:
         FLUSH_INTERVAL_MS: "2",
         FLUSH_MAX_BATCH: "131072",
         INITIAL_ROWS: "1024",
+        OCCUPY_TIMEOUT_MS: "500",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:
@@ -157,6 +161,15 @@ class SentinelConfig:
     @property
     def statistic_max_rt(self) -> int:
         return self.get_int(self.STATISTIC_MAX_RT, 4900)
+
+    @property
+    def occupy_timeout_ms(self) -> int:
+        # Clamped to the statistic interval like OccupyTimeoutProperty
+        # (a wait beyond one interval can never be satisfied).
+        from sentinel_tpu.models import constants as C
+
+        v = self.get_int(self.OCCUPY_TIMEOUT_MS, 500)
+        return max(0, min(v, C.DEFAULT_WINDOW_INTERVAL_MS))
 
     def reset(self) -> None:
         with self._lock:
