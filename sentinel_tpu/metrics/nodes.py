@@ -31,7 +31,7 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from sentinel_tpu.metrics.events import NUM_EVENTS
+from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
 from sentinel_tpu.metrics import metric_array as ma
 from sentinel_tpu.models import constants as C
 
@@ -55,10 +55,12 @@ class StatsState(NamedTuple):
     FutureBucketLeapArray, slots/statistic/metric/occupy/
     OccupiableBucketLeapArray.java:29-75). ``future_pass[r, b]`` holds
     tokens borrowed for the window starting at ``future_ws[r, b]``;
-    while that start is still ahead of now they count as *waiting*, and
-    once it becomes current they count as PASS of that window — a
-    read-side fold (``occupied_in_window``) instead of the reference's
-    bucket-reset materialisation, so no dense per-flush sweep is needed.
+    while that start is still ahead of now they count as *waiting*.
+    Once matured they are swept into the second window by
+    :func:`materialize_matured` at the start of every flush (the
+    batched form of the reference's bucket-reset copy); between flushes
+    the read-side fold (:func:`occupied_in_window`) makes them visible
+    to metric reads without mutating state.
     """
 
     second: ma.MetricArrayState
@@ -110,6 +112,43 @@ def occupied_in_window(state: StatsState, now: jax.Array) -> jax.Array:
     return jnp.sum(jnp.where(current, state.future_pass, 0), axis=1)
 
 
+def materialize_matured(state: StatsState, now: jax.Array) -> StatsState:
+    """Fold matured borrows into the second window and clear their slab
+    slots — the batched analog of OccupiableBucketLeapArray.resetWindowTo
+    copying borrowArray's bucket into the rolled window (reference:
+    OccupiableBucketLeapArray.java:41-55).
+
+    Run once per flush, before admission. The read-side fold
+    (:func:`occupied_in_window`) alone is not enough: the slab has only
+    ``sample_count`` slots per row, so a *new* borrow whose target
+    window reuses a slot would evict matured tokens that no bucket ever
+    absorbed, silently refunding them. Materialising first makes slot
+    reuse safe. Slab entries land at bucket index
+    ``(ws // window_len) % n`` — the same index their window occupies in
+    the main array, so the fold is a pure per-(row, bucket) operation.
+    """
+    ws = state.future_ws  # [R, B]
+    age = now - ws
+    matured = age >= 0
+    live = matured & (age <= SECOND_CFG.interval_ms)
+    bws = state.second.window_start
+    newer = live & (ws > bws)  # the roll the reference does lazily
+    same = live & (ws == bws)  # bucket already current: plain add
+    counts = jnp.where(newer[:, :, None], 0, state.second.counts)
+    add = jnp.where(same | newer, state.future_pass, 0)
+    counts = counts.at[:, :, MetricEvent.PASS].add(add)
+    second = state.second._replace(
+        counts=counts,
+        window_start=jnp.where(newer, ws, bws),
+        min_rt=jnp.where(newer, jnp.int32(SECOND_CFG.max_rt), state.second.min_rt),
+    )
+    return state._replace(
+        second=second,
+        future_ws=jnp.where(matured, jnp.int32(SECOND_CFG.empty_ws), state.future_ws),
+        future_pass=jnp.where(matured, 0, state.future_pass),
+    )
+
+
 def waiting_tokens(state: StatsState, now: jax.Array) -> jax.Array:
     """Tokens borrowed for still-future windows (int32 [R]) —
     ``StatisticNode.waiting()`` (reference: node/StatisticNode.java:337)."""
@@ -125,10 +164,20 @@ def apply_updates(
     rt_sample: Optional[jax.Array],  # int32 [M] or None
     thread_delta: jax.Array,  # int32 [M]
     mask: jax.Array,  # bool [M]
+    minute_deltas: Optional[jax.Array] = None,
 ) -> StatsState:
-    """One scatter pass over both windows + the thread gauge."""
+    """One scatter pass over both windows + the thread gauge.
+
+    ``minute_deltas`` overrides the event deltas for the minute window —
+    occupied entries diverge between windows (addOccupiedPass writes
+    PASS + OCCUPIED_PASS to the minute counter only, reference:
+    node/StatisticNode.java:343-346, while the second window's pass
+    materialises when the borrowed window becomes current)."""
     second = ma.update(SECOND_CFG, state.second, rows, ts, deltas, rt_sample, mask)
-    minute = ma.update(MINUTE_CFG, state.minute, rows, ts, deltas, rt_sample, mask)
+    minute = ma.update(
+        MINUTE_CFG, state.minute, rows, ts,
+        deltas if minute_deltas is None else minute_deltas, rt_sample, mask,
+    )
     rows_eff = jnp.where(mask, rows, 0).astype(jnp.int32)
     thr = jnp.where(mask, thread_delta, 0).astype(jnp.int32)
     threads = state.threads.at[rows_eff].add(thr, mode="drop")

@@ -124,6 +124,59 @@ def batch_partition_specs(axis: str = "data"):
     )
 
 
+def _split_and_spend(
+    axis: str, batch, nr: int, mask: jax.Array, unit_f: jax.Array, cap_slot: jax.Array
+) -> jax.Array:
+    """The shared mesh-budget recipe behind both demotion passes:
+    per-rule capacity = min over the rule's participating slots of
+    ``cap_slot``; per-chip demand = sum of participating slots'
+    ``unit_f``; ``cluster_allocate`` splits the global capacity by
+    chip-indexed exclusive prefix; within the chip the grant is spent in
+    (rule, ts, arrival) order with the per-slot admission check
+    ``before + prefix + acquire ≤ cap``. Returns the per-entry keep
+    mask (an entry is kept iff every participating slot fits)."""
+    from sentinel_tpu.runtime.flush import segment_excl_cumsum
+
+    n, k = batch.e_rule_gid.shape
+    gid_f = batch.e_rule_gid.reshape(-1)
+    eidx_f = jnp.arange(n * k, dtype=jnp.int32) // k
+    acq_f = batch.e_acquire[eidx_f]
+
+    big = jnp.int32(2**31 - 1)
+    cap = (
+        jnp.full((nr,), big, dtype=jnp.int32)
+        .at[jnp.where(mask, gid_f, nr)]
+        .min(jnp.where(mask, cap_slot, big), mode="drop")
+    )
+    cap = jnp.where(cap == big, 0, cap)  # rules unseen in batch: no demand anyway
+
+    demand = (
+        jnp.zeros((nr,), dtype=jnp.int32)
+        .at[jnp.where(mask, gid_f, nr)]
+        .add(jnp.where(mask, unit_f, 0), mode="drop")
+    )
+    _, before = cluster_allocate(axis, demand, cap, with_before=True)
+
+    # Spend the budget in (ts, arrival) order within each rule segment.
+    # Per-slot admission = the reference's sequential check run at this
+    # chip's offset into the global budget. Since unit ≤ acquire, kept
+    # spend per chip stays ≤ cap − before, so the total across the mesh
+    # never exceeds cap.
+    pos = jnp.arange(n * k, dtype=jnp.int32)
+    gid_key = jnp.where(mask, gid_f, jnp.int32(nr))
+    ts_f = batch.e_ts[eidx_f]
+    key_s, ts_s, ei_s, pos_s = jax.lax.sort((gid_key, ts_f, eidx_f, pos), num_keys=3)
+    acq_s = acq_f[pos_s]
+    m_s = mask[pos_s]
+    ones = jnp.ones((1,), dtype=bool)
+    new_grp = jnp.concatenate([ones, key_s[1:] != key_s[:-1]])
+    prefix = segment_excl_cumsum(new_grp, jnp.where(m_s, unit_f[pos_s], 0))
+    key_c = jnp.clip(key_s, 0, nr - 1)
+    keep_s = ~m_s | ((before[key_c] + prefix + acq_s) <= cap[key_c])
+    keep_slot = jnp.ones((n * k,), dtype=bool).at[pos_s].set(keep_s)
+    return keep_slot.reshape(n, k).all(axis=1)
+
+
 def _demote_over_grant(
     axis: str, stats_pre, stats_x, flow_dev, batch, flow_live: jax.Array
 ) -> jax.Array:
@@ -163,7 +216,6 @@ def _demote_over_grant(
     from sentinel_tpu.metrics.events import MetricEvent
     from sentinel_tpu.metrics.nodes import SECOND_CFG
     from sentinel_tpu.models import constants as C
-    from sentinel_tpu.runtime.flush import segment_excl_cumsum
 
     n, k = batch.e_rule_gid.shape
     nr = flow_dev.n_rules
@@ -203,44 +255,51 @@ def _demote_over_grant(
     cap_slot = jnp.maximum(
         jnp.floor(flow_dev.count[gid_c]) - base_slot, 0.0
     ).astype(jnp.int32)
-    big = jnp.int32(2**31 - 1)
-    cap = (
-        jnp.full((nr,), big, dtype=jnp.int32)
-        .at[jnp.where(constrained, gid_f, nr)]
-        .min(jnp.where(constrained, cap_slot, big), mode="drop")
-    )
-    cap = jnp.where(cap == big, 0, cap)  # rules unseen in batch: no demand anyway
-
-    demand = (
-        jnp.zeros((nr,), dtype=jnp.int32)
-        .at[jnp.where(constrained, gid_f, nr)]
-        .add(unit_f, mode="drop")
-    )
-    _, before = cluster_allocate(axis, demand, cap, with_before=True)
-
-    # Spend the budget in (ts, arrival) order within each rule segment.
-    # Per-slot admission = the reference's sequential check run at this
-    # chip's offset into the global budget:
-    #   before (earlier chips' demand) + prefix (earlier local units)
-    #   + acquire ≤ cap.
-    # Since unit ≤ acquire, kept spend per chip stays ≤ cap − before,
-    # so the total across the mesh never exceeds cap.
-    pos = jnp.arange(n * k, dtype=jnp.int32)
-    gid_key = jnp.where(constrained, gid_f, jnp.int32(nr))
-    ts_f = batch.e_ts[eidx_f]
-    key_s, ts_s, ei_s, pos_s = jax.lax.sort((gid_key, ts_f, eidx_f, pos), num_keys=3)
-    acq_s = acq_f[pos_s]
-    con_s = constrained[pos_s]
-    ones = jnp.ones((1,), dtype=bool)
-    new_grp = jnp.concatenate([ones, key_s[1:] != key_s[:-1]])
-    prefix = segment_excl_cumsum(new_grp, jnp.where(con_s, unit_f[pos_s], 0))
-    key_c = jnp.clip(key_s, 0, nr - 1)
-    keep_s = ~con_s | ((before[key_c] + prefix + acq_s) <= cap[key_c])
-    keep_slot = jnp.ones((n * k,), dtype=bool).at[pos_s].set(keep_s)
-    return keep_slot.reshape(n, k).all(axis=1)
+    return _split_and_spend(axis, batch, nr, constrained, unit_f, cap_slot)
 
 
-def make_sharded_flush(mesh, axis: str = "data"):
+def _demote_over_borrow(
+    axis, stats_pre, flow_dev, batch, occ_slot: jax.Array
+) -> jax.Array:
+    """Cap occupy borrows at the global borrow budget; returns the
+    per-entry keep mask over pass-1-borrowing entries.
+
+    A chip-local occupy grant honors ``waiting + borrow ≤ maxCount``
+    only against its own slab writes (StatisticNode.tryOccupyNext's
+    ``currentBorrow`` bound, reference: node/StatisticNode.java:305-307)
+    — n chips could each borrow up to the full budget. Same recipe as
+    ``_demote_over_grant``: per rule, demand = the borrowing slots'
+    acquire units (``occ_slot`` from pass 1 — only slots that actually
+    borrowed charge the budget, not the entry's other slots whose plain
+    check passed), capacity = maxCount − already-waiting tokens
+    (replicated pre-flush state, so identical on every chip), split by
+    chip-indexed exclusive prefix, spent in (ts, arrival) order within
+    the chip.
+    """
+    from sentinel_tpu.metrics.nodes import SECOND_CFG, waiting_tokens
+
+    n, k = batch.e_rule_gid.shape
+    nr = flow_dev.n_rules
+    r_rows = stats_pre.n_rows
+    interval_sec = SECOND_CFG.interval_ms / 1000.0
+
+    gid_f = batch.e_rule_gid.reshape(-1)
+    row_f = batch.e_check_row.reshape(-1)
+    eidx_f = jnp.arange(n * k, dtype=jnp.int32) // k
+    gid_c = jnp.clip(gid_f, 0, nr - 1)
+    borrower = occ_slot.reshape(-1)
+    acq_f = batch.e_acquire[eidx_f]
+
+    waiting = waiting_tokens(stats_pre, batch.now)
+    row_fc = jnp.clip(row_f, 0, r_rows - 1)
+    max_count = jnp.floor(flow_dev.count[gid_c] * interval_sec)
+    cap_slot = jnp.maximum(
+        max_count - waiting[row_fc].astype(jnp.float32), 0.0
+    ).astype(jnp.int32)
+    return _split_and_spend(axis, batch, nr, borrower, acq_f, cap_slot)
+
+
+def make_sharded_flush(mesh, axis: str = "data", occupy_timeout_ms: int = 500):
     """The full batched step over an n-device mesh.
 
     Entries and exits are data-parallel across chips; counter tensors
@@ -267,22 +326,40 @@ def make_sharded_flush(mesh, axis: str = "data"):
     from sentinel_tpu.runtime.flush import apply_exit_phase, flush_entries
 
     def sharded_step(stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch):
+        from sentinel_tpu.metrics.nodes import materialize_matured
+
+        # Matured borrows fold into the window FIRST — deterministic on
+        # replicated state, so it must happen before per-shard writes
+        # diverge and must be the merge base (otherwise every chip's
+        # identical materialisation would be summed once per chip).
+        stats = materialize_matured(stats, batch.now)
         # Exits once; both admission passes see the post-exit stats.
         stats_x, ddyn_x = apply_exit_phase(stats, ddev, ddyn, batch)
         # Pass 1 (no state writes): local flow-level admission demand.
         _, _, _, _, r1 = flush_entries(
             stats_x, flow_dev, flow_dyn, ddev, ddyn_x, pdyn, sysdev, batch,
-            commit=False,
+            commit=False, occupy_timeout_ms=occupy_timeout_ms,
         )
         # Occupied entries borrow from future windows, not the current
         # budget — exclude them from the grant math (their slab commits
-        # merge like window counters).
+        # merge like window counters) and budget them separately against
+        # the global borrow allowance.
         budgeted = r1.flow_live & ~r1.occupied
         keep = _demote_over_grant(axis, stats, stats_x, flow_dev, batch, budgeted)
-        batch2 = batch._replace(e_cluster_ok=batch.e_cluster_ok & (keep | ~budgeted))
+        keep_occ = _demote_over_borrow(axis, stats, flow_dev, batch, r1.occ_slot)
+        # Pass 2 borrows only what pass 1 granted within the global
+        # budget: demoted borrowers lose prio (they fall to plain BLOCK
+        # — their plain check already failed, that's why they borrowed);
+        # entries pass 1 never occupied must not start borrowing now
+        # that demotions shrank the intra-chip charge.
+        batch2 = batch._replace(
+            e_cluster_ok=batch.e_cluster_ok & (keep | ~budgeted),
+            e_prio=batch.e_prio & r1.occupied & keep_occ,
+        )
         # Pass 2: the real step with over-grants demoted.
         new_stats, new_fdyn, new_ddyn, new_pdyn, result = flush_entries(
-            stats_x, flow_dev, flow_dyn, ddev, ddyn_x, pdyn, sysdev, batch2
+            stats_x, flow_dev, flow_dyn, ddev, ddyn_x, pdyn, sysdev, batch2,
+            occupy_timeout_ms=occupy_timeout_ms,
         )
         merged = merge_stats_across(stats, new_stats, axis)
         # Breaker state machine: transitions happen on the one chip

@@ -768,7 +768,10 @@ class Engine:
             "block_qps": sec[MetricEvent.BLOCK] / interval_sec,
             "success_qps": success / interval_sec,
             "exception_qps": sec[MetricEvent.EXCEPTION] / interval_sec,
-            "occupied_pass_qps": sec[MetricEvent.OCCUPIED_PASS] / interval_sec,
+            # occupiedPassQps reads the minute counter (StatisticNode.
+            # java:195-198: rollingCounterInMinute.occupiedPass() / 60).
+            "occupied_pass_qps": minute[MetricEvent.OCCUPIED_PASS]
+            / (MINUTE_CFG.interval_ms / 1000.0),
             # StatisticNode.avgRt: rt sum / success count (0-safe).
             "avg_rt": (rt_sum / success) if success > 0 else 0.0,
             "min_rt": min_rt,

@@ -51,7 +51,6 @@ from sentinel_tpu.metrics.nodes import (
     SECOND_CFG,
     StatsState,
     apply_updates,
-    occupied_in_window,
     waiting_tokens,
 )
 from sentinel_tpu.models import constants as C
@@ -83,7 +82,8 @@ class FlushBatch(NamedTuple):
     e_rows: jax.Array  # int32 [N, 4]: default, cluster, origin|-1, entry|-1
     e_rule_gid: jax.Array  # int32 [N, K], -1 = empty slot
     e_check_row: jax.Array  # int32 [N, K], -1 = rule passes trivially
-    e_prio: jax.Array  # bool [N] (occupy/priority — not yet active)
+    e_prio: jax.Array  # bool [N] — prioritized entries may borrow from
+    # future windows when over threshold (entryWithPriority occupy path)
     e_auth_ok: jax.Array  # bool [N] — AuthoritySlot verdict (host-resolved
     # origin set membership, AuthorityRuleChecker.java:31-60)
     e_cluster_ok: jax.Array  # bool [N] — token-server verdict for
@@ -129,6 +129,9 @@ class FlushResult(NamedTuple):
     # order −2000 grants tokens before DegradeSlot −1000 runs)
     occupied: jax.Array  # bool [N] — admitted by borrowing future-window
     # tokens (prioritized entries; PriorityWaitException semantics)
+    occ_slot: jax.Array  # bool [N, K] — the specific slots that borrowed
+    # (admission-gated); the sharded borrow budget charges these, not
+    # the entry's other slots whose plain check passed
 
 
 # System block dimension codes (limit types in SystemBlockException).
@@ -181,14 +184,18 @@ def flow_admission(
     batch: FlushBatch,
     live: Optional[jax.Array] = None,
     occupy_timeout_ms: int = 500,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, StatsState]:
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Vectorized FlowRuleChecker + DefaultController (incl. occupy).
 
     Returns (slot_ok [N,K] bool, flow_pass [N] bool,
     pass_plus_consumed [N*K] int32 — the windowed pass sum plus the
     intra-batch charge per slot, which the shaping scan reuses as its
     ``passQps`` input, occupied [N] bool, occupy_wait_ms [N] int32,
-    stats with new future-slab borrows). Slots whose behavior is not
+    occ_slot [N,K] bool — which slots borrowed, occ_target [N,K] int32
+    — each borrow's target window start). Borrows are NOT committed
+    here: the caller gates :func:`commit_borrow_slab` on the entry's
+    final admission, because a borrow by an entry vetoed by another
+    slot must not leak into the slab. Slots whose behavior is not
     CONTROL_BEHAVIOR_DEFAULT are reported as ok here; their verdict is
     decided by the shaping scan (rules/shaping.py).
 
@@ -211,12 +218,11 @@ def flow_admission(
     nb = SECOND_CFG.sample_count
     interval_sec = interval / 1000.0
 
-    # Windowed pass including matured borrowed tokens (the reference
-    # materialises borrows into the bucket on reset; we fold at read).
-    pass_sums = (
-        ma.window_sums(SECOND_CFG, stats.second, batch.now)[:, MetricEvent.PASS]
-        + occupied_in_window(stats, batch.now)
-    )
+    # Matured borrowed tokens are already in the buckets:
+    # materialize_matured runs before admission in every flush path
+    # (flush_step and the sharded two-pass), which the expiring-window
+    # math in the occupy loop below also relies on.
+    pass_sums = ma.window_sums(SECOND_CFG, stats.second, batch.now)[:, MetricEvent.PASS]
 
     gid_f = batch.e_rule_gid.reshape(-1)
     row_f = batch.e_check_row.reshape(-1)
@@ -260,6 +266,9 @@ def flow_admission(
     is_default = behavior_s == C.CONTROL_BEHAVIOR_DEFAULT
 
     # ---- occupy branch (prioritized entries borrowing the future) ----
+    # An entry the token server already BLOCKED never reaches the local
+    # controller, so it must not borrow either (FlowRuleChecker.java:
+    # 207-230: BLOCKED returns before passLocalCheck).
     live_s = jnp.ones((n * k,), dtype=bool) if live is None else live[ei_s]
     eligible = (
         active_s
@@ -267,6 +276,7 @@ def flow_admission(
         & is_default
         & live_s
         & batch.e_prio[ei_s]
+        & batch.e_cluster_ok[ei_s]
         & (grade_s == C.FLOW_GRADE_QPS)
     )
     max_count = count_s * interval_sec
@@ -285,20 +295,25 @@ def flow_admission(
     occ_wait = jnp.zeros((n * k,), dtype=jnp.int32)
     occ_target = jnp.zeros((n * k,), dtype=jnp.int32)
     # Static unroll over the (small) bucket count — tryOccupyNext's
-    # while-loop over candidate future windows.
+    # while-loop over candidate future windows (StatisticNode.java:
+    # 302-333). ``cur_pass`` is decremented by each expiring window's
+    # pass as the unroll advances — the loop's cumulative
+    # ``currentPass -= windowPass`` — so step *i*'s check sees the pass
+    # count that will remain once windows 0..i have all expired.
     for i in range(nb):
         wait_i = i * wlen + wlen - now_mod  # tryOccupyNext waitInMs
         expiring_ws = batch.now - now_mod + wlen - interval + i * wlen
         bidx = (expiring_ws // wlen) % nb
+        # Matured borrows are already IN the bucket: materialize_matured
+        # runs before admission in every flush path, so the slab holds
+        # only strictly-future windows and never overlaps expiring_ws.
         in_bucket = stats.second.window_start[rk_c, bidx] == expiring_ws
         win_pass = jnp.where(
             in_bucket, stats.second.counts[rk_c, bidx, MetricEvent.PASS], 0
         )
-        # A matured borrow in the expiring window frees up too.
-        fut_match = stats.future_ws[rk_c, bidx] == expiring_ws
-        win_pass = win_pass + jnp.where(fut_match, stats.future_pass[rk_c, bidx], 0)
         cond = (
             eligible
+            & (expiring_ws < batch.now)  # while (earliestTime < currentTime)
             & (wait_i < occupy_timeout_ms)
             & (cur_pass + cur_borrow + acq_fs - win_pass.astype(jnp.float32) <= max_count)
         )
@@ -306,6 +321,7 @@ def flow_admission(
         occ_wait = jnp.where(fresh, wait_i, occ_wait)
         occ_target = jnp.where(fresh, batch.now - now_mod + (i + 1) * wlen, occ_target)
         occ_slot = occ_slot | cond
+        cur_pass = cur_pass - win_pass.astype(jnp.float32)
 
     ok = ok | occ_slot
     # Non-DEFAULT behaviors are decided by the shaping scan, not here.
@@ -322,16 +338,63 @@ def flow_admission(
         jnp.zeros((n,), dtype=jnp.int32).at[e_scatter].max(occ_wait, mode="drop")
     )
 
-    # ---- commit borrows into the future slab (set-if-newer per bucket,
-    # like FutureBucketLeapArray's reset-then-add) ----
-    tb = (occ_target // wlen) % nb
-    slab_key = jnp.where(occ_slot, rk_c * nb + tb.astype(jnp.int32), jnp.int32(r_rows * nb))
+    slot_ok = jnp.ones((n * k,), dtype=bool).at[pos_s].set(ok).reshape(n, k)
+    flow_pass = slot_ok.all(axis=1)
+    pass_plus_consumed = (
+        jnp.zeros((n * k,), dtype=jnp.int32)
+        .at[pos_s]
+        .set((base_pass + consumed_acq).astype(jnp.int32))
+    )
+    occ_slot_nk = (
+        jnp.zeros((n * k,), dtype=bool).at[pos_s].set(occ_slot).reshape(n, k)
+    )
+    occ_target_nk = (
+        jnp.zeros((n * k,), dtype=jnp.int32).at[pos_s].set(occ_target).reshape(n, k)
+    )
+    return (
+        slot_ok, flow_pass, pass_plus_consumed, occupied, occupy_wait,
+        occ_slot_nk, occ_target_nk,
+    )
+
+
+def commit_borrow_slab(
+    stats: StatsState,
+    occ_slot: jax.Array,  # bool [N, K] — admission-gated borrow slots
+    occ_target: jax.Array,  # int32 [N, K] — target window starts
+    acquire: jax.Array,  # int32 [N]
+    check_row: jax.Array,  # int32 [N, K]
+) -> StatsState:
+    """Write granted borrows into the future slab — addWaitingRequest ≙
+    FutureBucketLeapArray currentWindow().addPass (StatisticNode.java:
+    342-345), set-if-newer per bucket like the borrow array's
+    reset-then-add roll.
+
+    ``occ_slot`` must be gated on the entry's FINAL admission: the
+    reference can never both block and borrow (PriorityWaitException
+    aborts the chain with a pass), so a borrow by an entry vetoed by
+    another slot (THREAD rule, shaping pacer) must not leak tokens into
+    waiting()/future pass.
+    """
+    n, k = occ_slot.shape
+    r_rows = stats.n_rows
+    nb = SECOND_CFG.sample_count
+    wlen = SECOND_CFG.window_len_ms
+
+    occ_f = occ_slot.reshape(-1)
+    tgt_f = occ_target.reshape(-1)
+    eidx = jnp.arange(n * k, dtype=jnp.int32) // k
+    acq_f = acquire[eidx]
+    row_c = jnp.clip(check_row.reshape(-1), 0, r_rows - 1)
+
+    tb = (tgt_f // wlen) % nb
+    slab_key = jnp.where(occ_f, row_c * nb + tb.astype(jnp.int32), jnp.int32(r_rows * nb))
     sk_s, sp_s = jax.lax.sort((slab_key, jnp.arange(n * k, dtype=jnp.int32)), num_keys=1)
+    ones = jnp.ones((1,), dtype=bool)
     s_new = jnp.concatenate([ones, sk_s[1:] != sk_s[:-1]])
     s_sid = jnp.cumsum(s_new.astype(jnp.int32)) - 1
-    s_valid = occ_slot[sp_s]
-    s_ws = jnp.where(s_valid, occ_target[sp_s], jnp.int32(SECOND_CFG.empty_ws))
-    s_acq = jnp.where(s_valid, acq_s[sp_s], 0)
+    s_valid = occ_f[sp_s]
+    s_ws = jnp.where(s_valid, tgt_f[sp_s], jnp.int32(SECOND_CFG.empty_ws))
+    s_acq = jnp.where(s_valid, acq_f[sp_s], 0)
     seg_ws = jax.ops.segment_max(s_ws, s_sid, num_segments=n * k)
     contrib = s_valid & (s_ws == seg_ws[s_sid])
     seg_sum = jax.ops.segment_sum(jnp.where(contrib, s_acq, 0), s_sid, num_segments=n * k)
@@ -350,16 +413,7 @@ def flow_admission(
     fut_pass = stats.future_pass.at[add_row, u_b].add(u_sum, mode="drop", unique_indices=True)
     fut_pass = fut_pass.at[set_row, u_b].set(u_sum, mode="drop", unique_indices=True)
     fut_ws = stats.future_ws.at[set_row, u_b].set(u_ws, mode="drop", unique_indices=True)
-    stats = stats._replace(future_pass=fut_pass, future_ws=fut_ws)
-
-    slot_ok = jnp.ones((n * k,), dtype=bool).at[pos_s].set(ok).reshape(n, k)
-    flow_pass = slot_ok.all(axis=1)
-    pass_plus_consumed = (
-        jnp.zeros((n * k,), dtype=jnp.int32)
-        .at[pos_s]
-        .set((base_pass + consumed_acq).astype(jnp.int32))
-    )
-    return slot_ok, flow_pass, pass_plus_consumed, occupied, occupy_wait, stats
+    return stats._replace(future_pass=fut_pass, future_ws=fut_ws)
 
 
 def _scatter_cols(n: int, **cols: jax.Array) -> jax.Array:
@@ -549,11 +603,10 @@ def flush_entries(
     live = live & param_ok
 
     # ---- phase 2c: flow rules (FlowSlot / FlowRuleChecker) ----
-    slot_ok, flow_pass, pass_plus_consumed, occupied, occupy_wait, stats_b = (
-        flow_admission(stats, flow_dev, batch, live, occupy_timeout_ms)
-    )
-    if commit:
-        stats = stats_b  # future-slab borrows persist only when committing
+    (
+        slot_ok, flow_pass, pass_plus_consumed, occupied, occupy_wait,
+        occ_slot_nk, occ_target_nk,
+    ) = flow_admission(stats, flow_dev, batch, live, occupy_timeout_ms)
     occupied = occupied & live
     wait_ms = jnp.maximum(jnp.zeros((n,), dtype=jnp.int32), jnp.where(occupied, occupy_wait, 0))
     if shaping is not None:
@@ -595,6 +648,17 @@ def flush_entries(
     admitted = live2 & deg_pass
     if commit:
         ddyn = apply_probe_transitions(ddyn, batch.e_dgid, probe_slot, admitted & ~occupied)
+        # Borrows persist only for entries that were finally admitted —
+        # an entry vetoed by another slot never borrowed in the
+        # reference (PriorityWaitException would have aborted the chain
+        # with a pass before that slot could veto).
+        stats = commit_borrow_slab(
+            stats,
+            occ_slot_nk & (admitted & occupied)[:, None],
+            occ_target_nk,
+            batch.e_acquire,
+            batch.e_check_row,
+        )
     wait_ms = jnp.maximum(wait_ms, jnp.where(admitted, wait_param, 0))
 
     # Per-value thread acquire (ParamFlowStatisticEntryCallback.onPass):
@@ -634,11 +698,21 @@ def flush_entries(
             4 * n,
             PASS=jnp.where(adm4 & ~occ4, acq4, 0),
             BLOCK=jnp.where(adm4, 0, acq4),
+        )
+        # Minute window: occupied entries count PASS + OCCUPIED_PASS
+        # immediately (StatisticNode.addOccupiedPass writes both to
+        # rollingCounterInMinute, node/StatisticNode.java:343-346); the
+        # second window's pass arrives via the future slab instead.
+        e_deltas_min = _scatter_cols(
+            4 * n,
+            PASS=jnp.where(adm4, acq4, 0),
+            BLOCK=jnp.where(adm4, 0, acq4),
             OCCUPIED_PASS=jnp.where(occ4, acq4, 0),
         )
         e_thr = jnp.where(adm4, 1, 0).astype(jnp.int32)
         stats = apply_updates(
-            stats, e_rows_f, jnp.repeat(batch.e_ts, 4), e_deltas, None, e_thr, e_mask
+            stats, e_rows_f, jnp.repeat(batch.e_ts, 4), e_deltas, None, e_thr, e_mask,
+            minute_deltas=e_deltas_min,
         )
 
     result = FlushResult(
@@ -650,6 +724,7 @@ def flush_entries(
         dslot_ok=dslot_ok,
         flow_live=live2,
         occupied=occupied & admitted,
+        occ_slot=occ_slot_nk & (admitted & occupied)[:, None],
     )
     return stats, flow_dyn, ddyn, pdyn, result
 
@@ -675,6 +750,9 @@ def flush_step(
     later stages' state (pacer time, breaker probes, param tokens) nor
     count toward their thresholds.
     """
+    from sentinel_tpu.metrics.nodes import materialize_matured
+
+    stats = materialize_matured(stats, batch.now)
     stats, ddyn = apply_exit_phase(stats, ddev, ddyn, batch)
     return flush_entries(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,

@@ -79,13 +79,115 @@ class OracleLeapArray:
             b.min_rt = rt
 
 
+class OracleFutureArray(OracleLeapArray):
+    """FutureBucketLeapArray: a LeapArray whose deprecation rule is
+    inverted — only strictly-future windows count (reference:
+    slots/statistic/metric/occupy/FutureBucketLeapArray.java:29-43,
+    ``isWindowDeprecated: time >= windowStart``)."""
+
+    def _deprecated(self, t: int, b: OracleBucket) -> bool:
+        return t >= b.window_start
+
+    def get_window_value(self, t: int) -> Optional[OracleBucket]:
+        """LeapArray.getWindowValue: the bucket covering ``t`` iff its
+        start matches (isTimeInWindow), else None."""
+        idx = (t // self.window_len) % self.sample_count
+        ws = t - t % self.window_len
+        b = self.buckets[idx]
+        if b is None or b.window_start != ws:
+            return None
+        return b
+
+
+class OracleOccupiableArray(OracleLeapArray):
+    """OccupiableBucketLeapArray: the main second window plus a borrow
+    array; bucket create/reset folds the matured borrow pass in
+    (reference: OccupiableBucketLeapArray.java:29-75)."""
+
+    def __init__(self, sample_count: int, interval_ms: int, max_rt: int = 4900) -> None:
+        super().__init__(sample_count, interval_ms, max_rt)
+        self.borrow = OracleFutureArray(sample_count, interval_ms, max_rt)
+
+    def current_bucket(self, t: int) -> OracleBucket:
+        idx = (t // self.window_len) % self.sample_count
+        ws = t - t % self.window_len
+        b = self.buckets[idx]
+        if b is None or b.window_start < ws:
+            b = OracleBucket(ws, self.max_rt)
+            bb = self.borrow.get_window_value(ws)
+            if bb is not None:  # newEmptyBucket / resetWindowTo copy
+                b.counts[MetricEvent.PASS] += bb.counts[MetricEvent.PASS]
+            self.buckets[idx] = b
+        return b
+
+    def waiting(self, t: int) -> int:
+        """currentWaiting: borrowed tokens for strictly-future windows."""
+        return sum(
+            b.counts[MetricEvent.PASS]
+            for b in self.borrow.buckets
+            if b is not None and not self.borrow._deprecated(t, b)
+        )
+
+    def add_waiting(self, future_time: int, acquire: int) -> None:
+        self.borrow.add(future_time, MetricEvent.PASS, acquire)
+
+    def get_window_pass(self, t: int) -> int:
+        """ArrayMetric.getWindowPass: one bucket's pass by exact start."""
+        idx = (t // self.window_len) % self.sample_count
+        ws = t - t % self.window_len
+        b = self.buckets[idx]
+        if b is None or b.window_start != ws:
+            return 0
+        return b.counts[MetricEvent.PASS]
+
+
 class OracleNode:
-    """StatisticNode: 1 s window (2×500 ms), 60 s window (60×1 s), thread gauge."""
+    """StatisticNode: 1 s occupiable window (2×500 ms), 60 s window
+    (60×1 s), thread gauge, occupy API (StatisticNode.java:302-346)."""
 
     def __init__(self) -> None:
-        self.second = OracleLeapArray(2, 1000)
+        self.second = OracleOccupiableArray(2, 1000)
         self.minute = OracleLeapArray(60, 60000)
         self.cur_thread_num = 0
+
+    def waiting(self, t: int) -> int:
+        return self.second.waiting(t)
+
+    def try_occupy_next(
+        self, t: int, acquire: int, threshold: float, occupy_timeout_ms: int = 500
+    ) -> int:
+        """StatisticNode.tryOccupyNext (java:302-333): the wait in ms
+        until a future window can absorb the borrow, or the timeout
+        sentinel when no window qualifies. Note the *cumulative*
+        ``current_pass -= window_pass`` — step i's check sees the pass
+        count remaining after windows 0..i all expire."""
+        max_count = threshold * self.second.interval_ms / 1000.0
+        current_borrow = self.waiting(t)
+        if current_borrow >= max_count:
+            return occupy_timeout_ms
+        wlen = self.second.window_len
+        earliest = t - t % wlen + wlen - self.second.interval_ms
+        idx = 0
+        current_pass = self.second.values(t)[MetricEvent.PASS]
+        while earliest < t:
+            wait_ms = idx * wlen + wlen - t % wlen
+            if wait_ms >= occupy_timeout_ms:
+                break
+            window_pass = self.second.get_window_pass(earliest)
+            if current_pass + current_borrow + acquire - window_pass <= max_count:
+                return wait_ms
+            earliest += wlen
+            current_pass -= window_pass
+            idx += 1
+        return occupy_timeout_ms
+
+    def add_waiting_request(self, future_time: int, acquire: int) -> None:
+        self.second.add_waiting(future_time, acquire)
+
+    def add_occupied_pass(self, t: int, acquire: int) -> None:
+        """addOccupiedPass: minute window only (java:343-346)."""
+        self.minute.add(t, MetricEvent.OCCUPIED_PASS, acquire)
+        self.minute.add(t, MetricEvent.PASS, acquire)
 
     def pass_qps(self, t: int) -> float:
         return self.second.values(t)[MetricEvent.PASS] / (self.second.interval_ms / 1000.0)
@@ -114,9 +216,10 @@ class OracleNode:
 class OracleDefaultController:
     """DefaultController.canPass (DefaultController.java:49-79)."""
 
-    def __init__(self, count: float, grade: int) -> None:
+    def __init__(self, count: float, grade: int, occupy_timeout_ms: int = 500) -> None:
         self.count = count
         self.grade = grade  # 0 thread, 1 qps
+        self.occupy_timeout_ms = occupy_timeout_ms
 
     def can_pass(self, node: OracleNode, t: int, acquire: int = 1) -> bool:
         if self.grade == 1:
@@ -124,6 +227,26 @@ class OracleDefaultController:
         else:
             cur = node.cur_thread_num
         return cur + acquire <= self.count
+
+    def can_pass_prio(
+        self, node: OracleNode, t: int, acquire: int = 1
+    ) -> Tuple[bool, int, bool]:
+        """The prioritized branch (DefaultController.java:49-75).
+
+        Returns (ok, wait_ms, occupied); ``occupied`` models the
+        PriorityWaitException outcome — passes after waiting, with the
+        borrow recorded via addWaitingRequest + addOccupiedPass.
+        """
+        if self.can_pass(node, t, acquire):
+            return True, 0, False
+        if self.grade != 1:  # occupy is QPS-grade only
+            return False, 0, False
+        wait = node.try_occupy_next(t, acquire, self.count, self.occupy_timeout_ms)
+        if wait < self.occupy_timeout_ms:
+            node.add_waiting_request(t + wait, acquire)
+            node.add_occupied_pass(t, acquire)
+            return True, wait, True
+        return False, 0, False
 
 
 class OracleRateLimiter:
@@ -317,14 +440,32 @@ class OracleFlowEngine:
         self.rules.setdefault(resource, []).append(OracleDefaultController(count, 0))
 
     def entry(self, resource: str, t: int, acquire: int = 1) -> bool:
+        ok, _ = self.entry_prio(resource, t, acquire, prio=False)
+        return ok
+
+    def entry_prio(
+        self, resource: str, t: int, acquire: int = 1, prio: bool = False
+    ) -> Tuple[bool, int]:
+        """Returns (admitted, wait_ms). An occupied pass takes the
+        StatisticSlot PriorityWaitException branch: thread acquire only
+        (StatisticSlot.java:84-96); the minute pass was recorded by
+        addOccupiedPass and the second-window pass matures with the
+        borrowed window."""
         node = self.node(resource)
         for ctl in self.rules.get(resource, ()):
-            if not ctl.can_pass(node, t, acquire):
+            if prio:
+                ok, wait, occupied = ctl.can_pass_prio(node, t, acquire)
+            else:
+                ok, wait, occupied = ctl.can_pass(node, t, acquire), 0, False
+            if not ok:
                 node.add_block(t, acquire)
-                return False
+                return False, 0
+            if occupied:
+                node.cur_thread_num += 1
+                return True, wait
         node.add_pass(t, acquire)
         node.cur_thread_num += 1
-        return True
+        return True, 0
 
     def exit(self, resource: str, t: int, rt: int, acquire: int = 1) -> None:
         node = self.node(resource)
