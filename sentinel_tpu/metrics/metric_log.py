@@ -266,12 +266,16 @@ class MetricTimer:
         from sentinel_tpu.metrics import metric_array as ma
         from sentinel_tpu.metrics.nodes import MINUTE_CFG
 
-        ws, counts, valid = ma.bucket_windows(
-            MINUTE_CFG, engine.stats.minute, np.int32(now_rel)
-        )
-        ws = np.asarray(ws)
-        counts = np.asarray(counts)
-        valid = np.asarray(valid)
+        # Under the flush lock: a concurrent flush donates engine.stats
+        # to the kernel, which would invalidate the buffers mid-read
+        # (same hazard Engine._row_stats guards against).
+        with engine._flush_lock:
+            ws, counts, valid = ma.bucket_windows(
+                MINUTE_CFG, engine.stats.minute, np.int32(now_rel)
+            )
+            ws = np.asarray(ws)
+            counts = np.asarray(counts)
+            valid = np.asarray(valid)
         out: List[MetricNodeLine] = []
         for sec in range(begin, upto, 1000):
             for name, row in rows:

@@ -14,9 +14,19 @@ Two usage modes:
   reference's synchronous ``SphU.entry``. Batching still happens
   naturally whenever multiple ops accumulated since the last flush
   (exits, traces, other threads' entries).
-* **deferred**: callers submit many ops and flush once — the high
-  throughput path (the analog of the reference's cluster client, which
-  already tolerates decision latency; see SURVEY.md §7).
+* **deferred**: callers ``submit_many`` (or ``submit_entry`` in a loop)
+  and ``flush()`` once — the high-throughput path (the analog of the
+  reference's cluster client, which already tolerates decision latency;
+  see SURVEY.md §7). Verdicts appear on the returned ops after the
+  flush. The pending buffer is bounded: reaching ``max_batch``
+  (csp.sentinel.flush.max.batch) triggers a flush-on-size, and one
+  flush processes at most ``max_batch`` ops per kernel launch.
+
+Locking: ``_lock`` guards the pending buffers and host indexes and is
+held only briefly; ``_flush_lock`` serializes flushes and owns the
+device state during a flush. Kernel dispatch and the device→host fetch
+run under ``_flush_lock`` alone, so submission proceeds concurrently
+with a device round-trip (lock order: ``_flush_lock`` → ``_lock``).
 """
 
 from __future__ import annotations
@@ -129,6 +139,9 @@ class Engine:
         self._entries: List[_EntryOp] = []
         self._exits: List[_ExitOp] = []
         self._lock = threading.RLock()
+        # Serializes flushes + rule-table swaps; never taken while
+        # holding _lock (fixed order _flush_lock → _lock).
+        self._flush_lock = threading.RLock()
         self.max_batch = config.get_int(config.FLUSH_MAX_BATCH, 131072)
         # Global on/off switch (Constants.ON, flipped by the setSwitch
         # command): when off, entries pass through unchecked + unrecorded.
@@ -138,41 +151,48 @@ class Engine:
     # rule plumbing (called by rule managers)
     # ------------------------------------------------------------------
     def set_flow_rules(self, rules: Sequence[FlowRule]) -> None:
-        with self._lock:
-            self.flush()  # decisions for pending ops use the old rules
-            self.flow_index = FlowIndex(rules, cold_factor=config.cold_factor)
-            self.flow_dyn = self.flow_index.make_dyn_state()
+        with self._flush_lock:
+            self._flush_locked()  # decisions for pending ops use the old rules
+            with self._lock:
+                self.flow_index = FlowIndex(rules, cold_factor=config.cold_factor)
+                self.flow_dyn = self.flow_index.make_dyn_state()
 
     def set_degrade_rules(self, rules: Sequence[DegradeRule]) -> None:
         """Breaker state is NOT carried across reloads — the reference
         builds fresh CircuitBreaker objects per load (DegradeRuleManager)."""
-        with self._lock:
-            self.flush()
-            self.degrade_index = DegradeIndex(rules)
-            self.degrade_dyn = self.degrade_index.make_dyn_state()
+        with self._flush_lock:
+            self._flush_locked()
+            with self._lock:
+                self.degrade_index = DegradeIndex(rules)
+                self.degrade_dyn = self.degrade_index.make_dyn_state()
 
     def set_param_rules(self, by_resource: Dict[str, List[ParamFlowRule]]) -> None:
         """Param caches are rebuilt on reload, like
         ParamFlowRuleManager clearing ParameterMetric for changed rules."""
-        with self._lock:
-            self.flush()
-            self.param_index = ParamIndex(by_resource)
-            self.param_dyn = make_param_state(8)
+        with self._flush_lock:
+            self._flush_locked()
+            with self._lock:
+                self.param_index = ParamIndex(by_resource)
+                self.param_dyn = make_param_state(8)
 
     def set_system_config(self, cfg) -> None:
-        with self._lock:
-            self.flush()
-            self.system_config = cfg if cfg is not None and cfg.any_enabled else None
-            if self.system_config is not None and (
-                self.system_config.highest_system_load >= 0
-                or self.system_config.highest_cpu_usage >= 0
-            ):
-                system_sampler.start()
+        with self._flush_lock:
+            self._flush_locked()
+            with self._lock:
+                self.system_config = (
+                    cfg if cfg is not None and cfg.any_enabled else None
+                )
+                if self.system_config is not None and (
+                    self.system_config.highest_system_load >= 0
+                    or self.system_config.highest_cpu_usage >= 0
+                ):
+                    system_sampler.start()
 
     def set_authority_rules(self, by_resource: Dict[str, AuthorityRule]) -> None:
-        with self._lock:
-            self.flush()
-            self.authority_rules = dict(by_resource)
+        with self._flush_lock:
+            self._flush_locked()
+            with self._lock:
+                self.authority_rules = dict(by_resource)
 
     def _system_device(self) -> SystemDevice:
         cfg = self.system_config
@@ -239,43 +259,76 @@ class Engine:
         or the global switch being off)."""
         if not self.enabled:
             return None
-        # Slot resolution + append happen under the engine lock so a
-        # concurrent rule reload cannot swap the flow index between
-        # resolving gids and flushing them against the device table.
-        with self._lock:
-            rows = self.resolve_entry_rows(resource, context_name, origin, entry_type)
-            if rows is None:
-                return None
-            slots = self.flow_index.resolve_slots(resource, context_name, origin, self.nodes)
-            cluster_gids = self.flow_index.cluster_gids
-            auth_ok = True
-            arule = self.authority_rules.get(resource)
-            if arule is not None:
-                from sentinel_tpu.rules.authority_manager import AuthorityRuleManager
+        # Slot resolution and the append are two lock acquisitions (the
+        # cluster token RPC must run unlocked in between); if a rule
+        # reload swapped any index in the gap, the resolved gids would
+        # be flushed against the wrong device table — detect the swap at
+        # append time and re-resolve.
+        while True:
+            with self._lock:
+                findex = self.flow_index
+                dindex = self.degrade_index
+                pindex = self.param_index
+                rows = self.resolve_entry_rows(resource, context_name, origin, entry_type)
+                if rows is None:
+                    return None
+                slots = findex.resolve_slots(resource, context_name, origin, self.nodes)
+                cluster_gids = findex.cluster_gids
+                auth_ok = True
+                arule = self.authority_rules.get(resource)
+                if arule is not None:
+                    from sentinel_tpu.rules.authority_manager import AuthorityRuleManager
 
-                auth_ok = AuthorityRuleManager.passes(arule, origin)
-            p_slots: List[ParamSlotInfo] = []
-            if args and self.param_index.has_rules():
-                p_slots = self.param_index.slots_for(resource, args)
-            op = _EntryOp(
-                resource=resource,
-                ts=self.clock.now_ms() if ts is None else ts,
-                acquire=acquire,
-                rows=rows,
-                slots=slots,
-                d_gids=self.degrade_index.gids_for(resource),
-                p_slots=p_slots,
-                auth_ok=auth_ok,
-                prio=prio,
-            )
-        # Cluster-mode rules consult the token service OUTSIDE the engine
-        # lock (it may be a network RPC — FlowRuleChecker.passClusterCheck
-        # crossing to the token server, FlowRuleChecker.java:168-230).
-        if cluster_gids and any(gid in cluster_gids for gid, _ in op.slots):
-            self._apply_cluster_checks(op, cluster_gids)
-        with self._lock:
-            self._entries.append(op)
+                    auth_ok = AuthorityRuleManager.passes(arule, origin)
+                p_slots: List[ParamSlotInfo] = []
+                if args and pindex.has_rules():
+                    p_slots = pindex.slots_for(resource, args)
+                op = _EntryOp(
+                    resource=resource,
+                    ts=self.clock.now_ms() if ts is None else ts,
+                    acquire=acquire,
+                    rows=rows,
+                    slots=slots,
+                    d_gids=dindex.gids_for(resource),
+                    p_slots=p_slots,
+                    auth_ok=auth_ok,
+                    prio=prio,
+                )
+            # Cluster-mode rules consult the token service OUTSIDE the
+            # engine lock (it may be a network RPC —
+            # FlowRuleChecker.passClusterCheck crossing to the token
+            # server, FlowRuleChecker.java:168-230).
+            if cluster_gids and any(gid in cluster_gids for gid, _ in op.slots):
+                self._apply_cluster_checks(op, cluster_gids)
+            with self._lock:
+                if (
+                    self.flow_index is not findex
+                    or self.degrade_index is not dindex
+                    or self.param_index is not pindex
+                ):
+                    continue  # reload raced us: re-resolve under the new tables
+                self._entries.append(op)
+                over = len(self._entries) >= self.max_batch
+            break
+        if over:
+            self.flush()  # flush-on-size: the pending buffer is bounded
         return op
+
+    def submit_many(self, requests: Sequence[Dict]) -> List[Optional[_EntryOp]]:
+        """Deferred-mode batch submission: enqueue many entries without
+        flushing; verdicts appear on the returned ops after ``flush()``
+        (None entries are over-cap pass-throughs). Each request is a
+        kwargs dict for :meth:`submit_entry` (``{"resource": ...}`` at
+        minimum). Reaching ``max_batch`` triggers an automatic flush of
+        the ops queued so far — their verdicts are then already filled.
+
+        This is the public high-throughput path (round-1 #7): the
+        batched analog of firing many ``SphU.entry`` calls whose
+        decisions tolerate one flush of latency, like the reference's
+        cluster token client (FlowRuleChecker.passClusterCheck crossing
+        to the token server, FlowRuleChecker.java:168-230).
+        """
+        return [self.submit_entry(**req) for req in requests]
 
     def _apply_cluster_checks(self, op: _EntryOp, cluster_gids) -> None:
         """applyTokenResult (FlowRuleChecker.java:207-230): OK → pass
@@ -356,6 +409,9 @@ class Engine:
                 p_rows=list(param_rows),
             )
             self._exits.append(op)
+            over = len(self._exits) >= self.max_batch
+        if over:
+            self.flush()
 
     def submit_trace(
         self, rows: Tuple[int, int, int, int], count: int = 1, ts: Optional[int] = None
@@ -371,6 +427,9 @@ class Engine:
         )
         with self._lock:
             self._exits.append(op)
+            over = len(self._exits) >= self.max_batch
+        if over:
+            self.flush()
 
     # ------------------------------------------------------------------
     # flushing
@@ -469,14 +528,14 @@ class Engine:
             self.param_dyn = grow_param_state(self.param_dyn, _pad_pow2(pneed))
 
     def _encode_param(
-        self, entries: List[_EntryOp], exits: List[_ExitOp]
+        self, entries: List[_EntryOp], exits: List[_ExitOp], pindex: ParamIndex
     ) -> Optional[ParamBatch]:
         items = []
         for i, op in enumerate(entries):
             for ps in op.p_slots:
                 items.append((i, op.ts, op.acquire, ps))
         exit_rows = [r for op in exits for r in op.p_rows]
-        resets = self.param_index.take_resets()
+        resets = pindex.take_resets()
         if not items and not exit_rows and not resets:
             return None
         s = _pad_pow2(max(1, len(items)), 8)
@@ -529,7 +588,21 @@ class Engine:
         )
 
     def flush(self) -> List[_EntryOp]:
-        """Encode + run the kernel for all pending ops; fills verdicts."""
+        """Encode + run the kernel for all pending ops; fills verdicts.
+
+        The submission lock is held only to swap the pending buffers and
+        snapshot the rule indexes; encoding, kernel dispatch and the
+        device→host fetch happen outside it, so other threads keep
+        submitting while a device round-trip is in flight. Concurrent
+        flushes serialize on the flush lock; a caller whose ops were
+        drained by another thread's flush returns with the verdicts
+        already filled (the other flush cannot release the lock before
+        filling them).
+        """
+        with self._flush_lock:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> List[_EntryOp]:
         with self._lock:
             self._maybe_rebase()
             entries, self._entries = self._entries, []
@@ -537,158 +610,189 @@ class Engine:
             if not entries and not exits:
                 return []
             self._ensure_capacity()
+            findex = self.flow_index
+            dindex = self.degrade_index
+            pindex = self.param_index
+            auth_rules = self.authority_rules
+        # One kernel launch per max_batch slice: bounds device memory
+        # for the padded batch regardless of how much queued up.
+        mb = max(self.max_batch, 1)
+        for off in range(0, max(len(entries), len(exits)), mb):
+            self._run_chunk(
+                entries[off : off + mb],
+                exits[off : off + mb],
+                findex,
+                dindex,
+                pindex,
+                auth_rules,
+            )
+        return entries
 
-            n = _pad_pow2(len(entries), 8)
-            m = _pad_pow2(len(exits), 8)
-            k = _pad_pow2(max(1, max((len(op.slots) for op in entries), default=1)), 1)
-            kd = _pad_pow2(
-                max(
-                    1,
-                    max((len(op.d_gids) for op in entries), default=1),
-                    max((len(op.d_gids) for op in exits), default=1),
-                ),
+    def _run_chunk(
+        self,
+        entries: List[_EntryOp],
+        exits: List[_ExitOp],
+        findex: FlowIndex,
+        dindex: DegradeIndex,
+        pindex: ParamIndex,
+        auth_rules: Dict[str, AuthorityRule],
+    ) -> None:
+        """Encode one chunk, run the kernel, fill verdicts. Runs under
+        the flush lock only — the indexes are the snapshot taken when
+        the pending buffers were swapped (ops were resolved against
+        them; a reload drains pending ops first)."""
+        n = _pad_pow2(len(entries), 8)
+        m = _pad_pow2(len(exits), 8)
+        k = _pad_pow2(max(1, max((len(op.slots) for op in entries), default=1)), 1)
+        kd = _pad_pow2(
+            max(
                 1,
+                max((len(op.d_gids) for op in entries), default=1),
+                max((len(op.d_gids) for op in exits), default=1),
+            ),
+            1,
+        )
+
+        e_valid = np.zeros(n, dtype=bool)
+        e_ts = np.zeros(n, dtype=np.int32)
+        e_acquire = np.ones(n, dtype=np.int32)
+        e_rows = np.full((n, 4), -1, dtype=np.int32)
+        e_gid = np.full((n, k), -1, dtype=np.int32)
+        e_crow = np.full((n, k), -1, dtype=np.int32)
+        e_prio = np.zeros(n, dtype=bool)
+        e_auth = np.ones(n, dtype=bool)
+        e_cluster = np.ones(n, dtype=bool)
+        e_dgid = np.full((n, kd), -1, dtype=np.int32)
+        for i, op in enumerate(entries):
+            e_valid[i] = True
+            e_ts[i] = op.ts
+            e_acquire[i] = op.acquire
+            e_rows[i] = op.rows
+            for j, (gid, crow) in enumerate(op.slots[:k]):
+                e_gid[i, j] = gid
+                e_crow[i, j] = crow
+            for j, dg in enumerate(op.d_gids[:kd]):
+                e_dgid[i, j] = dg
+            e_prio[i] = op.prio
+            e_auth[i] = op.auth_ok
+            e_cluster[i] = op.cluster_blocked_rule is None
+
+        x_valid = np.zeros(m, dtype=bool)
+        x_ts = np.zeros(m, dtype=np.int32)
+        x_count = np.zeros(m, dtype=np.int32)
+        x_rows = np.full((m, 4), -1, dtype=np.int32)
+        x_rt = np.zeros(m, dtype=np.int32)
+        x_err = np.zeros(m, dtype=np.int32)
+        x_thr = np.zeros(m, dtype=np.int32)
+        x_dgid = np.full((m, kd), -1, dtype=np.int32)
+        for i, op in enumerate(exits):
+            x_valid[i] = True
+            x_ts[i] = op.ts
+            x_count[i] = op.count
+            x_rows[i] = op.rows
+            x_rt[i] = op.rt
+            x_err[i] = op.err
+            x_thr[i] = op.thr
+            for j, dg in enumerate(op.d_gids[:kd]):
+                x_dgid[i, j] = dg
+
+        batch = FlushBatch(
+            now=jnp.int32(self.clock.now_ms()),
+            e_valid=jnp.asarray(e_valid),
+            e_ts=jnp.asarray(e_ts),
+            e_acquire=jnp.asarray(e_acquire),
+            e_rows=jnp.asarray(e_rows),
+            e_rule_gid=jnp.asarray(e_gid),
+            e_check_row=jnp.asarray(e_crow),
+            e_prio=jnp.asarray(e_prio),
+            e_auth_ok=jnp.asarray(e_auth),
+            e_cluster_ok=jnp.asarray(e_cluster),
+            e_dgid=jnp.asarray(e_dgid),
+            x_valid=jnp.asarray(x_valid),
+            x_ts=jnp.asarray(x_ts),
+            x_count=jnp.asarray(x_count),
+            x_rows=jnp.asarray(x_rows),
+            x_rt=jnp.asarray(x_rt),
+            x_err=jnp.asarray(x_err),
+            x_thr=jnp.asarray(x_thr),
+            x_dgid=jnp.asarray(x_dgid),
+        )
+
+        sysdev = self._system_device()
+        shaping = self._encode_shaping(entries, k, findex)
+        param = self._encode_param(entries, exits, pindex)
+        occ_ms = config.occupy_timeout_ms
+        common = (
+            self.stats,
+            findex.device,
+            self.flow_dyn,
+            dindex.device,
+            self.degrade_dyn,
+            self.param_dyn,
+            sysdev,
+            batch,
+        )
+        if shaping is None and param is None:
+            out = flush_step_jit(*common, occupy_timeout_ms=occ_ms)
+        elif param is None:
+            out = flush_step_shaping_jit(*common, shaping, occupy_timeout_ms=occ_ms)
+        elif shaping is None:
+            out = flush_step_param_jit(*common, param, occupy_timeout_ms=occ_ms)
+        else:
+            out = flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms)
+        self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn, result = out
+
+        # One batched device->host fetch (each separate fetch costs a
+        # full round-trip on remote-tunnel backends).
+        admitted, reason, slot_ok, wait_ms, sys_type, dslot_ok = jax.device_get(
+            (
+                result.admitted,
+                result.reason,
+                result.slot_ok,
+                result.wait_ms,
+                result.sys_type,
+                result.dslot_ok,
             )
-
-            e_valid = np.zeros(n, dtype=bool)
-            e_ts = np.zeros(n, dtype=np.int32)
-            e_acquire = np.ones(n, dtype=np.int32)
-            e_rows = np.full((n, 4), -1, dtype=np.int32)
-            e_gid = np.full((n, k), -1, dtype=np.int32)
-            e_crow = np.full((n, k), -1, dtype=np.int32)
-            e_prio = np.zeros(n, dtype=bool)
-            e_auth = np.ones(n, dtype=bool)
-            e_cluster = np.ones(n, dtype=bool)
-            e_dgid = np.full((n, kd), -1, dtype=np.int32)
-            for i, op in enumerate(entries):
-                e_valid[i] = True
-                e_ts[i] = op.ts
-                e_acquire[i] = op.acquire
-                e_rows[i] = op.rows
-                for j, (gid, crow) in enumerate(op.slots[:k]):
-                    e_gid[i, j] = gid
-                    e_crow[i, j] = crow
-                for j, dg in enumerate(op.d_gids[:kd]):
-                    e_dgid[i, j] = dg
-                e_prio[i] = op.prio
-                e_auth[i] = op.auth_ok
-                e_cluster[i] = op.cluster_blocked_rule is None
-
-            x_valid = np.zeros(m, dtype=bool)
-            x_ts = np.zeros(m, dtype=np.int32)
-            x_count = np.zeros(m, dtype=np.int32)
-            x_rows = np.full((m, 4), -1, dtype=np.int32)
-            x_rt = np.zeros(m, dtype=np.int32)
-            x_err = np.zeros(m, dtype=np.int32)
-            x_thr = np.zeros(m, dtype=np.int32)
-            x_dgid = np.full((m, kd), -1, dtype=np.int32)
-            for i, op in enumerate(exits):
-                x_valid[i] = True
-                x_ts[i] = op.ts
-                x_count[i] = op.count
-                x_rows[i] = op.rows
-                x_rt[i] = op.rt
-                x_err[i] = op.err
-                x_thr[i] = op.thr
-                for j, dg in enumerate(op.d_gids[:kd]):
-                    x_dgid[i, j] = dg
-
-            batch = FlushBatch(
-                now=jnp.int32(self.clock.now_ms()),
-                e_valid=jnp.asarray(e_valid),
-                e_ts=jnp.asarray(e_ts),
-                e_acquire=jnp.asarray(e_acquire),
-                e_rows=jnp.asarray(e_rows),
-                e_rule_gid=jnp.asarray(e_gid),
-                e_check_row=jnp.asarray(e_crow),
-                e_prio=jnp.asarray(e_prio),
-                e_auth_ok=jnp.asarray(e_auth),
-                e_cluster_ok=jnp.asarray(e_cluster),
-                e_dgid=jnp.asarray(e_dgid),
-                x_valid=jnp.asarray(x_valid),
-                x_ts=jnp.asarray(x_ts),
-                x_count=jnp.asarray(x_count),
-                x_rows=jnp.asarray(x_rows),
-                x_rt=jnp.asarray(x_rt),
-                x_err=jnp.asarray(x_err),
-                x_thr=jnp.asarray(x_thr),
-                x_dgid=jnp.asarray(x_dgid),
-            )
-
-            sysdev = self._system_device()
-            shaping = self._encode_shaping(entries, k)
-            param = self._encode_param(entries, exits)
-            occ_ms = config.occupy_timeout_ms
-            common = (
-                self.stats,
-                self.flow_index.device,
-                self.flow_dyn,
-                self.degrade_index.device,
-                self.degrade_dyn,
-                self.param_dyn,
-                sysdev,
-                batch,
-            )
-            if shaping is None and param is None:
-                out = flush_step_jit(*common, occupy_timeout_ms=occ_ms)
-            elif param is None:
-                out = flush_step_shaping_jit(*common, shaping, occupy_timeout_ms=occ_ms)
-            elif shaping is None:
-                out = flush_step_param_jit(*common, param, occupy_timeout_ms=occ_ms)
-            else:
-                out = flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms)
-            self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn, result = out
-
-            # One batched device->host fetch (each separate fetch costs a
-            # full round-trip on remote-tunnel backends).
-            admitted, reason, slot_ok, wait_ms, sys_type, dslot_ok = jax.device_get(
-                (
-                    result.admitted,
-                    result.reason,
-                    result.slot_ok,
-                    result.wait_ms,
-                    result.sys_type,
-                    result.dslot_ok,
-                )
-            )
-            for i, op in enumerate(entries):
-                blocked_rule = None
-                limit_type = ""
-                r = int(reason[i])
-                if not admitted[i]:
-                    if r == E.BLOCK_AUTHORITY:
-                        blocked_rule = self.authority_rules.get(op.resource)
-                    elif r == E.BLOCK_SYSTEM:
-                        limit_type = SYS_TYPE_NAMES.get(int(sys_type[i]), "")
-                    elif r == E.BLOCK_FLOW:
-                        if op.cluster_blocked_rule is not None:
-                            blocked_rule = op.cluster_blocked_rule
-                        else:
-                            for j, (gid, _) in enumerate(op.slots[:k]):
-                                if not slot_ok[i, j]:
-                                    blocked_rule = self.flow_index.rule_of_gid(gid)
-                                    break
-                    elif r == E.BLOCK_PARAM:
-                        blocked_rule = op.p_slots[0].rule if op.p_slots else None
-                    elif r == E.BLOCK_DEGRADE:
-                        for j, dg in enumerate(op.d_gids[:kd]):
-                            if not dslot_ok[i, j]:
-                                blocked_rule = self.degrade_index.rule_of_gid(dg)
+        )
+        for i, op in enumerate(entries):
+            blocked_rule = None
+            limit_type = ""
+            r = int(reason[i])
+            if not admitted[i]:
+                if r == E.BLOCK_AUTHORITY:
+                    blocked_rule = auth_rules.get(op.resource)
+                elif r == E.BLOCK_SYSTEM:
+                    limit_type = SYS_TYPE_NAMES.get(int(sys_type[i]), "")
+                elif r == E.BLOCK_FLOW:
+                    if op.cluster_blocked_rule is not None:
+                        blocked_rule = op.cluster_blocked_rule
+                    else:
+                        for j, (gid, _) in enumerate(op.slots[:k]):
+                            if not slot_ok[i, j]:
+                                blocked_rule = findex.rule_of_gid(gid)
                                 break
-                op.verdict = Verdict(
-                    admitted=bool(admitted[i]),
-                    reason=r,
-                    wait_ms=int(wait_ms[i]),
-                    blocked_rule=blocked_rule,
-                    limit_type=limit_type,
-                )
-            return entries
+                elif r == E.BLOCK_PARAM:
+                    blocked_rule = op.p_slots[0].rule if op.p_slots else None
+                elif r == E.BLOCK_DEGRADE:
+                    for j, dg in enumerate(op.d_gids[:kd]):
+                        if not dslot_ok[i, j]:
+                            blocked_rule = dindex.rule_of_gid(dg)
+                            break
+            op.verdict = Verdict(
+                admitted=bool(admitted[i]),
+                reason=r,
+                wait_ms=int(wait_ms[i]),
+                blocked_rule=blocked_rule,
+                limit_type=limit_type,
+            )
 
-    def _encode_shaping(self, entries: List[_EntryOp], k: int) -> Optional[ShapingBatch]:
+    def _encode_shaping(
+        self, entries: List[_EntryOp], k: int, findex: FlowIndex
+    ) -> Optional[ShapingBatch]:
         """Gather (entry, slot) pairs governed by shaping controllers
         into the compact arrays the lax.scan path consumes. None when the
         batch touches no shaping rules (the fast path)."""
-        sg = self.flow_index.shaping_gids
+        sg = findex.shaping_gids
         if not sg:
             return None
         items = []
@@ -748,6 +852,12 @@ class Engine:
     # reads (command/metric plane; used heavily by tests)
     # ------------------------------------------------------------------
     def _row_stats(self, row: int, now: Optional[int] = None) -> Dict[str, float]:
+        # Under the flush lock: a concurrent flush donates self.stats to
+        # the kernel, which would invalidate the buffers mid-read.
+        with self._flush_lock:
+            return self._row_stats_locked(row, now)
+
+    def _row_stats_locked(self, row: int, now: Optional[int] = None) -> Dict[str, float]:
         from sentinel_tpu.metrics.nodes import occupied_in_window, waiting_tokens
 
         now_i = jnp.int32(self.clock.now_ms() if now is None else now)
@@ -796,7 +906,7 @@ class Engine:
         return self._row_stats(self.nodes.entry_node_row)
 
     def reset(self) -> None:
-        with self._lock:
+        with self._flush_lock, self._lock:
             self._entries.clear()
             self._exits.clear()
             self.nodes.clear()

@@ -1,0 +1,179 @@
+"""Engine throughput hardening: submission must proceed while a flush's
+device round-trip is in flight, the pending buffer is bounded by
+``max_batch`` (flush-on-size), and one flush processes arbitrarily many
+queued ops in ``max_batch`` chunks with sequential semantics preserved
+across chunk boundaries.
+
+The reference never needs any of this — every request runs the slot
+chain on its own thread — but the batched engine serializes decisions
+through a device kernel, so the submission path must not sit behind the
+kernel's latency (round-1 weak #7).
+"""
+
+import threading
+
+import pytest
+
+
+@pytest.fixture()
+def qps_rule(manual_clock, engine):
+    import sentinel_tpu as st
+
+    st.flow_rule_manager.load_rules([st.FlowRule("res", count=1000)])
+    return engine
+
+
+class TestConcurrentSubmission:
+    def test_submit_proceeds_during_device_roundtrip(self, qps_rule, monkeypatch):
+        """While one thread's flush is blocked inside the kernel call,
+        another thread's submit_entry must complete (it only takes the
+        submission lock, never the flush lock)."""
+        engine = qps_rule
+        # Warm up: compile the kernel once so the block below is clean.
+        engine.submit_entry("res")
+        engine.flush()
+
+        from sentinel_tpu.runtime import engine as eng_mod
+
+        real = eng_mod.flush_step_jit
+        in_kernel = threading.Event()
+        release = threading.Event()
+
+        def slow_kernel(*args, **kwargs):
+            in_kernel.set()
+            assert release.wait(30), "test deadlock: release never set"
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(eng_mod, "flush_step_jit", slow_kernel)
+
+        op_a = engine.submit_entry("res")
+        flusher = threading.Thread(target=engine.flush)
+        flusher.start()
+        try:
+            assert in_kernel.wait(30), "flush never reached the kernel"
+            # The flush is now parked inside the device call holding only
+            # the flush lock. Submission must not block on it.
+            done = threading.Event()
+
+            def submit():
+                engine.submit_entry("res")
+                done.set()
+
+            submitter = threading.Thread(target=submit)
+            submitter.start()
+            assert done.wait(10), (
+                "submit_entry blocked behind an in-flight device round-trip"
+            )
+            assert not release.is_set()  # kernel genuinely still parked
+        finally:
+            release.set()
+            flusher.join(30)
+        assert op_a.verdict is not None and op_a.verdict.admitted
+        # The op submitted mid-flight decides on the next flush.
+        monkeypatch.setattr(eng_mod, "flush_step_jit", real)
+        ops = engine.flush()
+        assert len(ops) == 1 and ops[0].verdict.admitted
+
+    def test_flush_fills_verdicts_for_ops_drained_by_other_thread(self, qps_rule):
+        """A caller whose op was drained by a concurrent flush still
+        finds its verdict filled once its own flush() returns."""
+        engine = qps_rule
+        ops = [engine.submit_entry("res") for _ in range(4)]
+        threads = [threading.Thread(target=engine.flush) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert all(op.verdict is not None for op in ops)
+
+
+class TestMaxBatch:
+    def test_flush_on_size_bounds_pending_buffer(self, qps_rule):
+        """Reaching max_batch triggers an automatic flush: the first
+        max_batch ops have verdicts without any explicit flush()."""
+        engine = qps_rule
+        engine.max_batch = 8
+        ops = engine.submit_many([{"resource": "res"} for _ in range(8)])
+        assert all(op.verdict is not None for op in ops)
+        assert len(engine._entries) == 0
+
+    def test_chunked_flush_preserves_sequential_semantics(self, qps_rule):
+        """One flush over 3 chunks: the admitted prefix must match the
+        un-chunked sequential outcome (each chunk sees the previous
+        chunks' pass counts in the windows)."""
+        import sentinel_tpu as st
+
+        engine = qps_rule
+        st.flow_rule_manager.load_rules([st.FlowRule("res", count=10)])
+        engine.max_batch = 1 << 20  # accumulate without flush-on-size
+        now = engine.clock.now_ms()
+        ops = engine.submit_many(
+            [{"resource": "res", "ts": now} for _ in range(20)]
+        )
+        engine.max_batch = 8
+        engine.flush()
+        admitted = [op.verdict.admitted for op in ops]
+        assert sum(admitted) == 10
+        assert admitted == [True] * 10 + [False] * 10
+        stats = engine.cluster_node_stats("res")
+        assert stats["pass_qps"] == pytest.approx(10.0)
+        assert stats["total_block_minute"] == 10
+
+    def test_exits_flush_on_size(self, qps_rule):
+        engine = qps_rule
+        op = engine.submit_entry("res")
+        engine.flush()
+        engine.max_batch = 4
+        for _ in range(4):
+            engine.submit_exit(op.rows, rt=5, resource="res")
+        assert len(engine._exits) == 0  # auto-flushed
+
+
+class TestRuleReloadConcurrency:
+    def test_reload_during_flush_keeps_old_rules_for_pending(self, qps_rule, monkeypatch):
+        """A rule reload arriving while a flush is in flight waits for
+        the flush lock; pending ops decide under the rules they were
+        submitted against."""
+        import sentinel_tpu as st
+
+        engine = qps_rule
+        engine.submit_entry("res")
+        engine.flush()
+
+        from sentinel_tpu.runtime import engine as eng_mod
+
+        real = eng_mod.flush_step_jit
+        in_kernel = threading.Event()
+        release = threading.Event()
+
+        def slow_kernel(*args, **kwargs):
+            in_kernel.set()
+            assert release.wait(30)
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(eng_mod, "flush_step_jit", slow_kernel)
+        op = engine.submit_entry("res")
+        flusher = threading.Thread(target=engine.flush)
+        flusher.start()
+        try:
+            assert in_kernel.wait(30)
+            reloaded = threading.Event()
+
+            def reload():
+                st.flow_rule_manager.load_rules([st.FlowRule("res", count=0)])
+                reloaded.set()
+
+            reloader = threading.Thread(target=reload)
+            reloader.start()
+            # The reload must NOT complete while the flush is parked.
+            assert not reloaded.wait(0.3)
+        finally:
+            release.set()
+            flusher.join(30)
+        reloader.join(30)
+        assert reloaded.is_set()
+        assert op.verdict is not None and op.verdict.admitted  # old count=1000
+        monkeypatch.setattr(eng_mod, "flush_step_jit", real)
+        nop = engine.submit_entry("res")
+        engine.flush()
+        assert not nop.verdict.admitted  # new count=0
