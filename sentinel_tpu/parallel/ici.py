@@ -327,6 +327,7 @@ def make_sharded_flush(mesh, axis: str = "data", occupy_timeout_ms: int = 500):
 
     def sharded_step(stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch):
         from sentinel_tpu.metrics.nodes import materialize_matured
+        from sentinel_tpu.rules.degrade_table import CLOSED as _CLOSED, OPEN as _OPEN
 
         # Matured borrows fold into the window FIRST — deterministic on
         # replicated state, so it must happen before per-shard writes
@@ -356,10 +357,38 @@ def make_sharded_flush(mesh, axis: str = "data", occupy_timeout_ms: int = 500):
             e_cluster_ok=batch.e_cluster_ok & (keep | ~budgeted),
             e_prio=batch.e_prio & r1.occupied & keep_occ,
         )
+        # Probe election: exactly ONE entry across the mesh may probe an
+        # OPEN breaker (fromOpenToHalfOpen is a single CAS,
+        # AbstractCircuitBreaker.java:91-110); without this every chip
+        # admits its own local rank-0 candidate. Each chip offers its
+        # best candidate ts; the global (ts, chip) minimum wins.
+        nd = ddev.n_rules
+        n, kd = batch.e_dgid.shape
+        gid_f = batch.e_dgid.reshape(-1)
+        eidx_d = jnp.arange(n * kd, dtype=jnp.int32) // kd
+        gid_dc = jnp.clip(gid_f, 0, nd - 1)
+        big = jnp.int32(2**31 - 1)
+        cand = (
+            (gid_f >= 0)
+            & r1.flow_live[eidx_d]
+            & (ddyn.state[gid_dc] == _OPEN)
+            & (batch.e_ts[eidx_d] >= ddyn.next_retry[gid_dc])
+        )
+        best_ts = (
+            jnp.full((nd,), big, dtype=jnp.int32)
+            .at[jnp.where(cand, gid_f, nd)]
+            .min(batch.e_ts[eidx_d], mode="drop")
+        )
+        g_ts = jax.lax.pmin(best_ts, axis)
+        idx = jax.lax.axis_index(axis)
+        nch = jax.lax.axis_size(axis)
+        chip_rank = jnp.where(best_ts == g_ts, idx, jnp.int32(nch))
+        g_chip = jax.lax.pmin(chip_rank, axis)
+        probe_allowed = (g_ts < big) & (idx == g_chip)
         # Pass 2: the real step with over-grants demoted.
         new_stats, new_fdyn, new_ddyn, new_pdyn, result = flush_entries(
             stats_x, flow_dev, flow_dyn, ddev, ddyn_x, pdyn, sysdev, batch2,
-            occupy_timeout_ms=occupy_timeout_ms,
+            occupy_timeout_ms=occupy_timeout_ms, probe_allowed=probe_allowed,
         )
         merged = merge_stats_across(stats, new_stats, axis)
         # Breaker state machine: transitions happen on the one chip
@@ -374,12 +403,50 @@ def make_sharded_flush(mesh, axis: str = "data", occupy_timeout_ms: int = 500):
         cand = jnp.where(changed, new_ddyn.state, jnp.int32(-1))
         best = jax.lax.pmax(cand, axis)
         merged_state = jnp.where(best >= 0, best, ddyn.state)
+        # Window counters merge rollover-aware, like merge_window_across:
+        # chips that rolled a rule's window to a newer start report
+        # counts of the NEW window, so a plain old+psum(new−old) would
+        # go negative whenever two chips roll in one flush. Only chips
+        # whose final window matches the merged (max) start contribute,
+        # against the shared base.
+        g_dws = jax.lax.pmax(new_ddyn.ws, axis)
+        d_old_cur = ddyn.ws == g_dws
+        d_new_cur = new_ddyn.ws == g_dws
+        base_bad = jnp.where(d_old_cur, ddyn.bad, 0)
+        base_total = jnp.where(d_old_cur, ddyn.total, 0)
         merged_ddyn = type(ddyn)(
             state=merged_state,
             next_retry=jax.lax.pmax(new_ddyn.next_retry, axis),
-            bad=ddyn.bad + jax.lax.psum(new_ddyn.bad - ddyn.bad, axis),
-            total=ddyn.total + jax.lax.psum(new_ddyn.total - ddyn.total, axis),
-            ws=jax.lax.pmax(new_ddyn.ws, axis),
+            bad=base_bad
+            + jax.lax.psum(jnp.where(d_new_cur, new_ddyn.bad - base_bad, 0), axis),
+            total=base_total
+            + jax.lax.psum(jnp.where(d_new_cur, new_ddyn.total - base_total, 0), axis),
+            ws=g_dws,
+        )
+        # Cross-chip trip: each chip evaluated thresholds on its own
+        # shard of completions, so a breaker whose merged window crosses
+        # the threshold may have tripped on NO single chip (e.g. 8
+        # errors spread 1-per-chip with minRequestAmount=5). Re-evaluate
+        # the CLOSED->OPEN condition on the merged counts; the retry
+        # deadline anchors at flush time rather than the crossing
+        # completion's ts — later by at most one flush interval.
+        from sentinel_tpu.rules.degrade_table import trip_condition
+
+        trip = trip_condition(
+            ddev, ddev.grade, ddev.threshold, ddev.slow_ratio,
+            merged_ddyn.bad.astype(jnp.float32),
+            merged_ddyn.total.astype(jnp.float32),
+        )
+        cross = (
+            (merged_ddyn.state == _CLOSED)
+            & (merged_ddyn.total >= ddev.min_request)
+            & trip
+        )
+        merged_ddyn = merged_ddyn._replace(
+            state=jnp.where(cross, _OPEN, merged_ddyn.state),
+            next_retry=jnp.where(
+                cross, batch.now + ddev.retry_ms, merged_ddyn.next_retry
+            ),
         )
         return merged, new_fdyn, merged_ddyn, new_pdyn, result
 

@@ -25,7 +25,7 @@ entry is admitted end-to-end, which reproduces the reference's
 
 from __future__ import annotations
 
-from typing import Dict, List, NamedTuple, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -131,6 +131,33 @@ class DegradeIndex:
         return None
 
 
+def trip_condition(
+    ddev: DegradeTableDevice,
+    grade: jax.Array,  # int32 — per-element grade (gathered or full table)
+    threshold: jax.Array,  # float32
+    slow_ratio: jax.Array,  # float32
+    bad: jax.Array,  # float32
+    total: jax.Array,  # float32
+) -> jax.Array:
+    """The CLOSED→OPEN threshold predicate, shared by the per-exit
+    prefix evaluation and the sharded path's merged-count re-check.
+
+    RT breakers open when slowRatio exceeds the configured ratio, with
+    the ratio==1.0 boundary opening when the threshold is >= 1
+    (ResponseTimeCircuitBreaker.java:120-130); exception-ratio compares
+    the ratio, exception-count the absolute count
+    (ExceptionCircuitBreaker.java:110-134). min_request gating is the
+    caller's job (it differs between prefix and merged evaluation).
+    """
+    ratio = bad / jnp.maximum(total, 1.0)
+    is_rt = grade == C.DEGRADE_GRADE_RT
+    is_exc_ratio = grade == C.DEGRADE_GRADE_EXCEPTION_RATIO
+    rt_trip = (ratio > slow_ratio) | ((slow_ratio >= 1.0) & (ratio >= 1.0))
+    return jnp.where(
+        is_rt, rt_trip, jnp.where(is_exc_ratio, ratio > threshold, bad > threshold)
+    )
+
+
 def _segment_cum(new_grp: jax.Array, x: jax.Array) -> jax.Array:
     """Inclusive per-segment cumulative sum (segments flagged at starts)."""
     total = jnp.cumsum(x)
@@ -195,18 +222,9 @@ def breaker_on_exits(
     run_total = (g_base_total + cum_total).astype(jnp.float32)
 
     # ---- CLOSED -> OPEN: first prefix crossing the threshold ----
-    thr = ddev.threshold[gid_c]
-    ratio = run_bad / jnp.maximum(run_total, 1.0)
-    is_exc_ratio = grade == C.DEGRADE_GRADE_EXCEPTION_RATIO
-    is_exc_count = grade == C.DEGRADE_GRADE_EXCEPTION_COUNT
-    sr = ddev.slow_ratio[gid_c]
-    # RT breaker: open iff slowRatio > threshold, with the ratio==1.0
-    # boundary opening when the threshold is >= 1
-    # (ResponseTimeCircuitBreaker.java:120-130).
-    rt_trip = (ratio > sr) | ((sr >= 1.0) & (ratio >= 1.0))
-    exc_ratio_trip = ratio > thr
-    exc_count_trip = run_bad > thr
-    trip = jnp.where(is_rt, rt_trip, jnp.where(is_exc_ratio, exc_ratio_trip, exc_count_trip))
+    trip = trip_condition(
+        ddev, grade, ddev.threshold[gid_c], ddev.slow_ratio[gid_c], run_bad, run_total
+    )
     crossing = in_win & (run_total >= ddev.min_request[gid_c]) & trip
 
     was_closed = dyn.state == CLOSED
@@ -260,13 +278,18 @@ def breaker_try_pass(
     e_dgid: jax.Array,  # int32 [N, KD]
     e_ts: jax.Array,  # int32 [N]
     e_live: jax.Array,  # bool [N] — entries not blocked by earlier slots
+    probe_allowed: Optional[jax.Array] = None,  # bool [ND]
 ) -> Tuple[jax.Array, jax.Array]:
     """tryPass for a batch of entries.
 
     Returns (slot_ok [N,KD], probe_slot [N,KD]) — probe_slot marks the
     single admitted OPEN->HALF_OPEN probe candidate per breaker; the
     caller applies the HALF_OPEN transition only for entries admitted
-    end-to-end.
+    end-to-end. ``probe_allowed`` restricts which breakers this batch
+    may probe at all — the sharded path's cross-chip election passes
+    the per-chip winner mask so only ONE chip (hence one entry) probes
+    each OPEN breaker (fromOpenToHalfOpen is a single CAS in the
+    reference, AbstractCircuitBreaker.java:91-110).
     """
     n, kd = e_dgid.shape
     nd = ddev.n_rules
@@ -281,6 +304,8 @@ def breaker_try_pass(
     open_ = st == OPEN
     retry_ok = ts_f >= dyn.next_retry[gid_c]
     candidate = valid & open_ & retry_ok
+    if probe_allowed is not None:
+        candidate = candidate & probe_allowed[gid_c]
 
     # rank-0 candidate per breaker gets the probe.
     gid_key = jnp.where(candidate, gid_f, jnp.int32(nd))

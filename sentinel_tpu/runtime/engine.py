@@ -100,6 +100,13 @@ class _EntryOp:
     prio: bool = False
     cluster_blocked_rule: Optional[object] = None  # token server said BLOCKED
     verdict: Optional[Verdict] = None
+    # Resolution context: which index objects the gids/rows above came
+    # from, plus what is needed to re-resolve if a rule reload swapped
+    # the tables between submit and flush (see _flush_locked).
+    context_name: str = C.CONTEXT_DEFAULT_NAME
+    origin: str = ""
+    args: Tuple[object, ...] = ()
+    src: Optional[Tuple[object, object, object]] = None  # (findex, dindex, pindex)
 
     @property
     def param_thread_rows(self) -> List[int]:
@@ -118,6 +125,8 @@ class _ExitOp:
     thr: int = 0  # thread delta (-1 for exits, 0 for traces)
     d_gids: List[int] = field(default_factory=list)  # breakers to complete
     p_rows: List[int] = field(default_factory=list)  # param thread rows to release
+    resource: Optional[str] = None  # for d_gid re-resolution after a reload
+    src_dindex: Optional[object] = None
 
 
 class Engine:
@@ -146,6 +155,69 @@ class Engine:
         # Global on/off switch (Constants.ON, flipped by the setSwitch
         # command): when off, entries pass through unchecked + unrecorded.
         self.enabled = True
+        # Sharded (multi-chip) mode — see enable_mesh().
+        self.mesh = None
+        self._sharded_fn = None
+        self._n_shards = 1
+
+    # ------------------------------------------------------------------
+    # multi-chip mode
+    # ------------------------------------------------------------------
+    def enable_mesh(self, n_devices: Optional[int] = None) -> None:
+        """Switch the engine to sharded multi-chip flushing: entries and
+        exits are data-parallel over an n-device ``jax.sharding.Mesh``,
+        counter windows / breaker state are all-reduced after each local
+        step, and flow budgets (incl. occupy borrows) are conserved
+        across the mesh by the two-pass grant split (parallel/ici) — the
+        deployable cluster unit, ≙ the reference's token server
+        (sentinel-cluster-server-default/.../SentinelDefaultTokenServer.
+        java:37) collapsed into ICI collectives.
+
+        Traffic-shaping flow rules and hot-param rules are rejected at
+        rule load while the mesh is enabled: their pacer scans are
+        serializing per rule and stay single-chip — loading one raises
+        instead of silently leaving it unenforced (round-2 weak #3).
+        """
+        from sentinel_tpu.parallel import make_mesh, make_sharded_flush
+
+        with self._flush_lock:
+            self._flush_locked()
+            with self._lock:
+                n = n_devices if n_devices is not None else len(jax.devices())
+                if n < 1 or (n & (n - 1)) != 0:
+                    raise ValueError(
+                        f"mesh size must be a power of two, got {n}"
+                    )
+                self._validate_mesh_rules(self.flow_index, self.param_index)
+                self.mesh = make_mesh(n)
+                self._n_shards = n
+                self._sharded_fn = make_sharded_flush(
+                    self.mesh, occupy_timeout_ms=config.occupy_timeout_ms
+                )
+
+    def disable_mesh(self) -> None:
+        with self._flush_lock:
+            self._flush_locked()
+            with self._lock:
+                self.mesh = None
+                self._sharded_fn = None
+                self._n_shards = 1
+
+    @staticmethod
+    def _validate_mesh_rules(findex: FlowIndex, pindex: ParamIndex) -> None:
+        if findex.shaping_gids:
+            raise ValueError(
+                "sharded mode: traffic-shaping flow rules (rate-limiter/"
+                "warm-up controlBehavior) are not supported on the mesh — "
+                "their pacer state is serializing per rule; load them on a "
+                "single-chip engine or drop controlBehavior to DEFAULT"
+            )
+        if pindex.has_rules():
+            raise ValueError(
+                "sharded mode: hot-param rules are not supported on the "
+                "mesh — per-value token buckets are serializing per rule; "
+                "use a single-chip engine for param flow"
+            )
 
     # ------------------------------------------------------------------
     # rule plumbing (called by rule managers)
@@ -154,8 +226,11 @@ class Engine:
         with self._flush_lock:
             self._flush_locked()  # decisions for pending ops use the old rules
             with self._lock:
-                self.flow_index = FlowIndex(rules, cold_factor=config.cold_factor)
-                self.flow_dyn = self.flow_index.make_dyn_state()
+                findex = FlowIndex(rules, cold_factor=config.cold_factor)
+                if self.mesh is not None:
+                    self._validate_mesh_rules(findex, self.param_index)
+                self.flow_index = findex
+                self.flow_dyn = findex.make_dyn_state()
 
     def set_degrade_rules(self, rules: Sequence[DegradeRule]) -> None:
         """Breaker state is NOT carried across reloads — the reference
@@ -172,7 +247,10 @@ class Engine:
         with self._flush_lock:
             self._flush_locked()
             with self._lock:
-                self.param_index = ParamIndex(by_resource)
+                pindex = ParamIndex(by_resource)
+                if self.mesh is not None:
+                    self._validate_mesh_rules(self.flow_index, pindex)
+                self.param_index = pindex
                 self.param_dyn = make_param_state(8)
 
     def set_system_config(self, cfg) -> None:
@@ -259,57 +337,54 @@ class Engine:
         or the global switch being off)."""
         if not self.enabled:
             return None
-        # Slot resolution and the append are two lock acquisitions (the
-        # cluster token RPC must run unlocked in between); if a rule
-        # reload swapped any index in the gap, the resolved gids would
-        # be flushed against the wrong device table — detect the swap at
-        # append time and re-resolve.
-        while True:
-            with self._lock:
-                findex = self.flow_index
-                dindex = self.degrade_index
-                pindex = self.param_index
-                rows = self.resolve_entry_rows(resource, context_name, origin, entry_type)
-                if rows is None:
-                    return None
-                slots = findex.resolve_slots(resource, context_name, origin, self.nodes)
-                cluster_gids = findex.cluster_gids
-                auth_ok = True
-                arule = self.authority_rules.get(resource)
-                if arule is not None:
-                    from sentinel_tpu.rules.authority_manager import AuthorityRuleManager
+        # Slot resolution happens here against the current tables; if a
+        # rule reload swaps any index before this op flushes, the flush
+        # re-resolves it against the snapshot it will actually be
+        # encoded with (see _flush_locked) — the op records which
+        # indexes produced its gids for that check. Submission itself
+        # never retries: a retry would re-run the cluster token RPC and
+        # double-acquire the global budget.
+        with self._lock:
+            findex = self.flow_index
+            dindex = self.degrade_index
+            pindex = self.param_index
+            rows = self.resolve_entry_rows(resource, context_name, origin, entry_type)
+            if rows is None:
+                return None
+            slots = findex.resolve_slots(resource, context_name, origin, self.nodes)
+            cluster_gids = findex.cluster_gids
+            auth_ok = True
+            arule = self.authority_rules.get(resource)
+            if arule is not None:
+                from sentinel_tpu.rules.authority_manager import AuthorityRuleManager
 
-                    auth_ok = AuthorityRuleManager.passes(arule, origin)
-                p_slots: List[ParamSlotInfo] = []
-                if args and pindex.has_rules():
-                    p_slots = pindex.slots_for(resource, args)
-                op = _EntryOp(
-                    resource=resource,
-                    ts=self.clock.now_ms() if ts is None else ts,
-                    acquire=acquire,
-                    rows=rows,
-                    slots=slots,
-                    d_gids=dindex.gids_for(resource),
-                    p_slots=p_slots,
-                    auth_ok=auth_ok,
-                    prio=prio,
-                )
-            # Cluster-mode rules consult the token service OUTSIDE the
-            # engine lock (it may be a network RPC —
-            # FlowRuleChecker.passClusterCheck crossing to the token
-            # server, FlowRuleChecker.java:168-230).
-            if cluster_gids and any(gid in cluster_gids for gid, _ in op.slots):
-                self._apply_cluster_checks(op, cluster_gids)
-            with self._lock:
-                if (
-                    self.flow_index is not findex
-                    or self.degrade_index is not dindex
-                    or self.param_index is not pindex
-                ):
-                    continue  # reload raced us: re-resolve under the new tables
-                self._entries.append(op)
-                over = len(self._entries) >= self.max_batch
-            break
+                auth_ok = AuthorityRuleManager.passes(arule, origin)
+            p_slots: List[ParamSlotInfo] = []
+            if args and pindex.has_rules():
+                p_slots = pindex.slots_for(resource, args)
+            op = _EntryOp(
+                resource=resource,
+                ts=self.clock.now_ms() if ts is None else ts,
+                acquire=acquire,
+                rows=rows,
+                slots=slots,
+                d_gids=dindex.gids_for(resource),
+                p_slots=p_slots,
+                auth_ok=auth_ok,
+                prio=prio,
+                context_name=context_name,
+                origin=origin,
+                args=tuple(args),
+                src=(findex, dindex, pindex),
+            )
+        # Cluster-mode rules consult the token service OUTSIDE the engine
+        # lock (it may be a network RPC — FlowRuleChecker.passClusterCheck
+        # crossing to the token server, FlowRuleChecker.java:168-230).
+        if cluster_gids and any(gid in cluster_gids for gid, _ in op.slots):
+            self._apply_cluster_checks(op, cluster_gids)
+        with self._lock:
+            self._entries.append(op)
+            over = len(self._entries) >= self.max_batch
         if over:
             self.flush()  # flush-on-size: the pending buffer is bounded
         return op
@@ -395,9 +470,8 @@ class Engine:
         ``param_rows`` are per-value thread-gauge rows to release.
         """
         with self._lock:
-            d_gids = (
-                self.degrade_index.gids_for(resource) if resource is not None else []
-            )
+            dindex = self.degrade_index
+            d_gids = dindex.gids_for(resource) if resource is not None else []
             op = _ExitOp(
                 ts=self.clock.now_ms() if ts is None else ts,
                 rows=rows,
@@ -407,6 +481,8 @@ class Engine:
                 thr=-1,
                 d_gids=d_gids,
                 p_rows=list(param_rows),
+                resource=resource,
+                src_dindex=dindex if resource is not None else None,
             )
             self._exits.append(op)
             over = len(self._exits) >= self.max_batch
@@ -614,6 +690,28 @@ class Engine:
             dindex = self.degrade_index
             pindex = self.param_index
             auth_rules = self.authority_rules
+            # Ops resolved against superseded tables (a reload swapped
+            # an index between their submit and this flush — including
+            # submits that landed while the reload's own drain-flush was
+            # in the kernel) are re-resolved against this snapshot, so
+            # gids always match the device tables they are checked with.
+            cur = (findex, dindex, pindex)
+            for op in entries:
+                if op.src is not None and op.src != cur:
+                    op.slots = findex.resolve_slots(
+                        op.resource, op.context_name, op.origin, self.nodes
+                    )
+                    op.d_gids = dindex.gids_for(op.resource)
+                    op.p_slots = (
+                        pindex.slots_for(op.resource, op.args)
+                        if op.args and pindex.has_rules()
+                        else []
+                    )
+                    op.src = cur
+            for x in exits:
+                if x.resource is not None and x.src_dindex is not None and x.src_dindex is not dindex:
+                    x.d_gids = dindex.gids_for(x.resource)
+                    x.src_dindex = dindex
         # One kernel launch per max_batch slice: bounds device memory
         # for the padded batch regardless of how much queued up.
         mb = max(self.max_batch, 1)
@@ -639,10 +737,12 @@ class Engine:
     ) -> None:
         """Encode one chunk, run the kernel, fill verdicts. Runs under
         the flush lock only — the indexes are the snapshot taken when
-        the pending buffers were swapped (ops were resolved against
-        them; a reload drains pending ops first)."""
-        n = _pad_pow2(len(entries), 8)
-        m = _pad_pow2(len(exits), 8)
+        the pending buffers were swapped; _flush_locked re-resolved any
+        op whose submit-time tables were superseded by a reload."""
+        # Pow2 padding is shard-divisible on any power-of-two mesh once
+        # raised to at least n_shards (enable_mesh enforces pow2).
+        n = max(_pad_pow2(len(entries), 8), self._n_shards)
+        m = max(_pad_pow2(len(exits), 8), self._n_shards)
         k = _pad_pow2(max(1, max((len(op.slots) for op in entries), default=1)), 1)
         kd = _pad_pow2(
             max(
@@ -732,7 +832,12 @@ class Engine:
             sysdev,
             batch,
         )
-        if shaping is None and param is None:
+        if self._sharded_fn is not None:
+            # Mesh mode: one global batch sharded over the chips; rule
+            # validation guarantees no shaping/param batches exist.
+            assert shaping is None and param is None
+            out = self._sharded_fn(*common)
+        elif shaping is None and param is None:
             out = flush_step_jit(*common, occupy_timeout_ms=occ_ms)
         elif param is None:
             out = flush_step_shaping_jit(*common, shaping, occupy_timeout_ms=occ_ms)

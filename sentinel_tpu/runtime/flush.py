@@ -570,12 +570,15 @@ def flush_entries(
     param: Optional[ParamBatch] = None,
     commit: bool = True,
     occupy_timeout_ms: int = 500,
+    probe_allowed: Optional[jax.Array] = None,
 ) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
     """Phases 2-3: admission checks and (when ``commit``) accounting.
 
     ``commit=False`` evaluates the checks but skips every state write
     (pass/block scatters, breaker probe transitions, param thread
     gauges) — the demand-probe pass of the sharded path.
+    ``probe_allowed`` (bool [ND]) restricts HALF_OPEN probe candidacy to
+    elected breakers — the sharded path's cross-chip probe election.
     """
     n = batch.e_valid.shape[0]
 
@@ -641,7 +644,7 @@ def flush_entries(
     # catches it to count only the thread acquire.
     occ_live = occupied & live2
     dslot_ok, probe_slot = breaker_try_pass(
-        ddev, ddyn, batch.e_dgid, batch.e_ts, live2 & ~occupied
+        ddev, ddyn, batch.e_dgid, batch.e_ts, live2 & ~occupied, probe_allowed
     )
     deg_pass = dslot_ok.all(axis=1) | occ_live
 

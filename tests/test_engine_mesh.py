@@ -1,0 +1,190 @@
+"""Sharded Engine mode: the real product API (rules → submit → flush →
+verdicts → stats) running over the 8-device CPU mesh — the deployable
+cluster unit, ≙ the reference's standalone token server
+(SentinelDefaultTokenServer.java:37) collapsed into ICI collectives.
+"""
+
+import pytest
+
+
+@pytest.fixture()
+def mesh_engine(manual_clock, engine):
+    engine.enable_mesh(8)
+    return engine
+
+
+class TestEngineMesh:
+    def test_budget_conserved_through_engine_api(self, mesh_engine):
+        """128 same-window entries against count=20 admit exactly 20 —
+        end to end through rules manager, submit_many and verdicts."""
+        import sentinel_tpu as st
+
+        st.flow_rule_manager.load_rules([st.FlowRule("res", count=20)])
+        now = mesh_engine.clock.now_ms()
+        ops = mesh_engine.submit_many(
+            [{"resource": "res", "ts": now} for _ in range(128)]
+        )
+        mesh_engine.flush()
+        admitted = [op.verdict.admitted for op in ops]
+        assert sum(admitted) == 20
+        stats = mesh_engine.cluster_node_stats("res")
+        assert stats["pass_qps"] == pytest.approx(20.0)
+        assert stats["total_block_minute"] == 108
+
+    def test_thread_grade_and_exits_on_mesh(self, mesh_engine, manual_clock):
+        import sentinel_tpu as st
+
+        st.flow_rule_manager.load_rules(
+            [st.FlowRule("svc", grade=0, count=4)]  # THREAD grade
+        )
+        ops = mesh_engine.submit_many([{"resource": "svc"} for _ in range(16)])
+        mesh_engine.flush()
+        assert sum(op.verdict.admitted for op in ops) == 4
+        stats = mesh_engine.cluster_node_stats("svc")
+        assert stats["cur_thread_num"] == 4
+        # Release two slots; two more fit.
+        first = next(op for op in ops if op.verdict.admitted)
+        for _ in range(2):
+            mesh_engine.submit_exit(first.rows, rt=5, resource="svc")
+        ops2 = mesh_engine.submit_many([{"resource": "svc"} for _ in range(8)])
+        mesh_engine.flush()
+        assert sum(op.verdict.admitted for op in ops2) == 2
+
+    def test_breaker_trips_and_recovers_on_mesh(self, mesh_engine, manual_clock):
+        """Degrade slot exercised end-to-end in sharded mode: error
+        completions trip the breaker on whichever chips carried them;
+        the merged OPEN state blocks everywhere; the HALF_OPEN probe
+        recovers it."""
+        import sentinel_tpu as st
+
+        st.flow_rule_manager.load_rules([st.FlowRule("d", count=1000)])
+        st.degrade_rule_manager.load_rules(
+            [st.DegradeRule(resource="d", grade=1, count=0.5, time_window=2,
+                            min_request_amount=5)]
+        )
+        manual_clock.set_ms(1000)
+        ops = mesh_engine.submit_many([{"resource": "d"} for _ in range(8)])
+        mesh_engine.flush()
+        assert all(op.verdict.admitted for op in ops)
+        for op in ops:
+            mesh_engine.submit_exit(op.rows, rt=5, err=1, resource="d")
+        mesh_engine.flush()
+        manual_clock.set_ms(1100)
+        blocked = mesh_engine.submit_many([{"resource": "d"} for _ in range(8)])
+        mesh_engine.flush()
+        assert not any(op.verdict.admitted for op in blocked)
+        # After the retry window one probe goes through (HALF_OPEN).
+        manual_clock.set_ms(3200)
+        probe = mesh_engine.submit_many([{"resource": "d"} for _ in range(8)])
+        mesh_engine.flush()
+        assert sum(op.verdict.admitted for op in probe) == 1
+
+    def test_breaker_counts_survive_multi_chip_window_roll(self, mesh_engine, manual_clock):
+        """Several chips rolling the same breaker window in one flush
+        must merge to the true counts (a naive old+psum(new-old) merge
+        goes negative when 2+ chips roll), and the merged window must
+        trip."""
+        import sentinel_tpu as st
+
+        st.flow_rule_manager.load_rules([st.FlowRule("w", count=1000)])
+        st.degrade_rule_manager.load_rules(
+            [st.DegradeRule(resource="w", grade=1, count=0.5, time_window=5,
+                            min_request_amount=4)]
+        )
+        # Window 1: 4 completions, 2 errors — under min_request? No:
+        # 4 >= 4 but ratio 0.5 is not > 0.5 → stays CLOSED.
+        manual_clock.set_ms(500)
+        ops = mesh_engine.submit_many([{"resource": "w", "ts": 500} for _ in range(4)])
+        mesh_engine.flush()
+        for i, op in enumerate(ops):
+            mesh_engine.submit_exit(op.rows, rt=5, err=1 if i < 2 else 0,
+                                    resource="w", ts=500)
+        mesh_engine.flush()
+        # Window 2 (rolls on every chip carrying an exit): 4 errors
+        # spread across chips → merged 4/4 must read exactly 4/4, trip.
+        manual_clock.set_ms(1500)
+        ops2 = mesh_engine.submit_many([{"resource": "w", "ts": 1500} for _ in range(4)])
+        mesh_engine.flush()
+        for op in ops2:
+            mesh_engine.submit_exit(op.rows, rt=5, err=1, resource="w", ts=1500)
+        mesh_engine.flush()
+        manual_clock.set_ms(1600)
+        blocked = mesh_engine.submit_many([{"resource": "w"} for _ in range(8)])
+        mesh_engine.flush()
+        assert not any(op.verdict.admitted for op in blocked)
+
+    def test_occupy_borrows_conserved_on_mesh_engine(self, mesh_engine, manual_clock):
+        """Prioritized entries on the mesh borrow at most maxCount in
+        total across all chips."""
+        import sentinel_tpu as st
+        from sentinel_tpu.utils.config import config
+
+        config.set(config.OCCUPY_TIMEOUT_MS, "1000")
+        try:
+            mesh_engine.enable_mesh(8)  # recompile with the new timeout
+            st.flow_rule_manager.load_rules([st.FlowRule("p", count=4)])
+            manual_clock.set_ms(1000)
+            ops = mesh_engine.submit_many(
+                [{"resource": "p", "ts": 1000} for _ in range(4)]
+            )
+            mesh_engine.flush()
+            assert sum(op.verdict.admitted for op in ops) == 4
+            manual_clock.set_ms(1100)
+            prio = mesh_engine.submit_many(
+                [{"resource": "p", "ts": 1100, "prio": True} for _ in range(16)]
+            )
+            mesh_engine.flush()
+            granted = [op for op in prio if op.verdict.admitted]
+            assert len(granted) == 4  # borrow budget == maxCount
+            assert all(op.verdict.wait_ms > 0 for op in granted)
+            stats = mesh_engine.cluster_node_stats("p")
+            assert stats["waiting"] == 4
+        finally:
+            config.set(config.OCCUPY_TIMEOUT_MS, "500")
+
+    def test_shaping_rules_rejected_on_mesh(self, mesh_engine):
+        import sentinel_tpu as st
+        from sentinel_tpu.models import constants as C
+
+        with pytest.raises(ValueError, match="shaping"):
+            mesh_engine.set_flow_rules(
+                [st.FlowRule("s", count=10,
+                             control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER)]
+            )
+
+    def test_param_rules_rejected_on_mesh(self, mesh_engine):
+        import sentinel_tpu as st
+
+        with pytest.raises(ValueError, match="param"):
+            mesh_engine.set_param_rules(
+                {"p": [st.ParamFlowRule(resource="p", param_idx=0, count=5)]}
+            )
+
+    def test_enable_mesh_rejects_existing_shaping_rules(self, manual_clock, engine):
+        import sentinel_tpu as st
+        from sentinel_tpu.models import constants as C
+
+        engine.set_flow_rules(
+            [st.FlowRule("s", count=10,
+                         control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER)]
+        )
+        with pytest.raises(ValueError, match="shaping"):
+            engine.enable_mesh(8)
+
+    def test_non_pow2_mesh_rejected(self, manual_clock, engine):
+        with pytest.raises(ValueError, match="power of two"):
+            engine.enable_mesh(3)
+
+    def test_disable_mesh_returns_to_single_chip(self, mesh_engine):
+        import sentinel_tpu as st
+        from sentinel_tpu.models import constants as C
+
+        mesh_engine.disable_mesh()
+        # Shaping rules load fine again off-mesh.
+        mesh_engine.set_flow_rules(
+            [st.FlowRule("s", count=10,
+                         control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER)]
+        )
+        op = mesh_engine.submit_entry("s")
+        mesh_engine.flush()
+        assert op.verdict.admitted
