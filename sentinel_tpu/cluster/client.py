@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import itertools
 import socket
+import struct
 import threading
 import time
 from typing import Dict, List, Optional
@@ -78,6 +79,15 @@ class ClusterTokenClient(TokenService):
         with self._send_lock:
             if self._sock is not None:
                 try:
+                    # shutdown() first: close() alone does not send FIN
+                    # while the reader thread is blocked in recv on the
+                    # same fd (the in-flight syscall pins the file
+                    # description open), deadlocking both this reader
+                    # and the server's handler.
+                    self._sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
                     self._sock.close()
                 except OSError:
                     pass
@@ -102,12 +112,17 @@ class ClusterTokenClient(TokenService):
                 payload = protocol.read_frame(sock)
                 if payload is None:
                     break
-                xid, _mt, status, remaining, wait_ms = protocol.unpack_response(payload)
+                xid, _mt, status, remaining, wait_ms, token_id = protocol.unpack_response(payload)
                 with self._pending_lock:
                     p = self._pending.pop(xid, None)
                 if p is not None:
-                    p.set(TokenResult(C.TokenResultStatus(status), remaining, wait_ms))
-        except (OSError, ValueError):
+                    p.set(TokenResult(
+                        C.TokenResultStatus(status), remaining, wait_ms, token_id
+                    ))
+        except (OSError, ValueError, struct.error):
+            # struct.error is NOT a ValueError: a version-skewed peer
+            # sending a differently-sized response must take the silent
+            # close/reconnect path, not kill the reader.
             pass
         finally:
             self._close()
@@ -154,6 +169,27 @@ class ClusterTokenClient(TokenService):
         return self._send_request(
             protocol.pack_param_request(xid, flow_id, acquire_count, [str(p) for p in params]),
             xid,
+        )
+
+    def request_concurrent_token(
+        self, flow_id: int, acquire_count: int = 1, client_address: str = "local"
+    ) -> TokenResult:
+        """requestConcurrentToken over the wire; the server derives the
+        client address from the connection (the argument is unused here,
+        kept for TokenService interface parity)."""
+        if self._sock is None and not self._maybe_reconnect():
+            return TokenResult(C.TokenResultStatus.FAIL)
+        xid = next(self._xid)
+        return self._send_request(
+            protocol.pack_concurrent_acquire(xid, flow_id, acquire_count), xid
+        )
+
+    def release_concurrent_token(self, token_id: int) -> TokenResult:
+        if self._sock is None and not self._maybe_reconnect():
+            return TokenResult(C.TokenResultStatus.FAIL)
+        xid = next(self._xid)
+        return self._send_request(
+            protocol.pack_concurrent_release(xid, token_id), xid
         )
 
 

@@ -12,8 +12,10 @@ Frame:   [u32 length][payload]
 Request: [u32 xid][u8 type][body]
   FLOW body:        [i64 flow_id][i32 acquire][u8 prioritized]
   PARAM_FLOW body:  [i64 flow_id][i32 acquire][u16 n][n × (u16 len, bytes)]
+  CONCURRENT_FLOW_ACQUIRE body: [i64 flow_id][i32 acquire][u8 0]
+  CONCURRENT_FLOW_RELEASE body: [i64 token_id]
   PING body:        []
-Response:[u32 xid][u8 type][i8 status][i32 remaining][i32 wait_ms]
+Response:[u32 xid][u8 type][i8 status][i32 remaining][i32 wait_ms][i64 token_id]
 """
 
 from __future__ import annotations
@@ -25,7 +27,8 @@ from sentinel_tpu.models import constants as C
 
 _REQ_HDR = struct.Struct("<IB")
 _FLOW_BODY = struct.Struct("<qiB")
-_RESP = struct.Struct("<IBbii")
+_RELEASE_BODY = struct.Struct("<q")
+_RESP = struct.Struct("<IBbiiq")
 _LEN = struct.Struct("<I")
 
 
@@ -50,8 +53,25 @@ def pack_ping(xid: int) -> bytes:
     return _LEN.pack(len(payload)) + payload
 
 
-def pack_response(xid: int, msg_type: int, status: int, remaining: int = 0, wait_ms: int = 0) -> bytes:
-    payload = _RESP.pack(xid, msg_type, status, remaining, wait_ms)
+def pack_concurrent_acquire(xid: int, flow_id: int, acquire: int) -> bytes:
+    payload = _REQ_HDR.pack(xid, C.MSG_TYPE_CONCURRENT_FLOW_ACQUIRE) + _FLOW_BODY.pack(
+        flow_id, acquire, 0
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def pack_concurrent_release(xid: int, token_id: int) -> bytes:
+    payload = _REQ_HDR.pack(xid, C.MSG_TYPE_CONCURRENT_FLOW_RELEASE) + _RELEASE_BODY.pack(
+        token_id
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def pack_response(
+    xid: int, msg_type: int, status: int, remaining: int = 0, wait_ms: int = 0,
+    token_id: int = 0,
+) -> bytes:
+    payload = _RESP.pack(xid, msg_type, status, remaining, wait_ms, token_id)
     return _LEN.pack(len(payload)) + payload
 
 
@@ -61,10 +81,15 @@ def unpack_request(payload: bytes) -> Tuple[int, int, tuple]:
     off = _REQ_HDR.size
     if msg_type == C.MSG_TYPE_PING:
         return xid, msg_type, ()
+    if msg_type == C.MSG_TYPE_CONCURRENT_FLOW_RELEASE:
+        (token_id,) = _RELEASE_BODY.unpack_from(payload, off)
+        return xid, msg_type, (token_id,)
     flow_id, acquire, prio = _FLOW_BODY.unpack_from(payload, off)
     off += _FLOW_BODY.size
     if msg_type == C.MSG_TYPE_FLOW:
         return xid, msg_type, (flow_id, acquire, bool(prio))
+    if msg_type == C.MSG_TYPE_CONCURRENT_FLOW_ACQUIRE:
+        return xid, msg_type, (flow_id, acquire)
     if msg_type == C.MSG_TYPE_PARAM_FLOW:
         (n,) = struct.unpack_from("<H", payload, off)
         off += 2
@@ -78,8 +103,8 @@ def unpack_request(payload: bytes) -> Tuple[int, int, tuple]:
     raise ValueError(f"unknown msg type {msg_type}")
 
 
-def unpack_response(payload: bytes) -> Tuple[int, int, int, int, int]:
-    """-> (xid, msg_type, status, remaining, wait_ms)."""
+def unpack_response(payload: bytes) -> Tuple[int, int, int, int, int, int]:
+    """-> (xid, msg_type, status, remaining, wait_ms, token_id)."""
     return _RESP.unpack(payload)
 
 

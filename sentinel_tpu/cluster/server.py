@@ -26,6 +26,7 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         server: "SentinelTokenServer" = self.server.token_server  # type: ignore[attr-defined]
         server._conn_changed(+1)
+        client_addr = "%s:%d" % self.client_address[:2]
         try:
             while True:
                 payload = protocol.read_frame(self.request)
@@ -50,6 +51,19 @@ class _Handler(socketserver.BaseRequestHandler):
                     resp = protocol.pack_response(
                         xid, msg_type, int(r.status), r.remaining, r.wait_in_ms
                     )
+                elif msg_type == C.MSG_TYPE_CONCURRENT_FLOW_ACQUIRE:
+                    flow_id, acquire = body
+                    r = server.service.request_concurrent_token(
+                        flow_id, acquire, client_address=client_addr
+                    )
+                    resp = protocol.pack_response(
+                        xid, msg_type, int(r.status), r.remaining, r.wait_in_ms,
+                        token_id=r.token_id,
+                    )
+                elif msg_type == C.MSG_TYPE_CONCURRENT_FLOW_RELEASE:
+                    (token_id,) = body
+                    r = server.service.release_concurrent_token(token_id)
+                    resp = protocol.pack_response(xid, msg_type, int(r.status))
                 else:
                     resp = protocol.pack_response(
                         xid, msg_type, int(C.TokenResultStatus.BAD_REQUEST)
@@ -59,6 +73,16 @@ class _Handler(socketserver.BaseRequestHandler):
             pass
         finally:
             server._conn_changed(-1)
+            # A vanished client cannot release its held concurrency
+            # tokens — free them eagerly (the clientOfflineTime story).
+            concurrent = getattr(server.service, "concurrent", None)
+            if concurrent is not None:
+                freed = concurrent.release_client(client_addr)
+                if freed:
+                    record_log.info(
+                        "[TokenServer] released %d concurrency tokens of %s",
+                        freed, client_addr,
+                    )
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):

@@ -43,11 +43,13 @@ CLUSTER_CFG = ma.MetricArrayConfig(sample_count=10, interval_ms=1000)
 
 
 class TokenResult(NamedTuple):
-    """Reference: TokenResult.java — status + remaining + waitInMs."""
+    """Reference: TokenResult.java — status + remaining + waitInMs
+    (+ tokenId for concurrent acquire)."""
 
     status: C.TokenResultStatus
     remaining: int = 0
     wait_in_ms: int = 0
+    token_id: int = 0
 
     @property
     def ok(self) -> bool:
@@ -55,7 +57,8 @@ class TokenResult(NamedTuple):
 
 
 class TokenService:
-    """Reference: TokenService.java."""
+    """Reference: TokenService.java (incl. the concurrent-token surface,
+    TokenService.java:56-62)."""
 
     def request_token(
         self, flow_id: int, acquire_count: int = 1, prioritized: bool = False
@@ -65,6 +68,14 @@ class TokenService:
     def request_param_token(
         self, flow_id: int, acquire_count: int, params: List[object]
     ) -> TokenResult:
+        raise NotImplementedError
+
+    def request_concurrent_token(
+        self, flow_id: int, acquire_count: int = 1, client_address: str = "local"
+    ) -> TokenResult:
+        raise NotImplementedError
+
+    def release_concurrent_token(self, token_id: int) -> TokenResult:
         raise NotImplementedError
 
 
@@ -132,6 +143,8 @@ class DefaultTokenService(TokenService):
     """In-process (embeddable) token service over the batched kernel."""
 
     def __init__(self, clock: Optional[Clock] = None, initial_rows: int = 64) -> None:
+        from sentinel_tpu.cluster.concurrent import ConcurrentFlowManager
+
         self.clock = clock or default_clock()
         self._lock = threading.RLock()
         self.state = ma.make_state(pad_pow2(initial_rows), CLUSTER_CFG)
@@ -139,6 +152,7 @@ class DefaultTokenService(TokenService):
         self._ns_rows: Dict[str, int] = {}
         self._next_row = 0
         self.connected_count = 1  # ConnectionManager connectedCount analog
+        self.concurrent = ConcurrentFlowManager(clock=self.clock)
 
     def _row_for_flow(self, flow_id: int) -> int:
         row = self._flow_rows.get(flow_id)
@@ -276,9 +290,29 @@ class DefaultTokenService(TokenService):
             return TokenResult(C.TokenResultStatus.OK)
         return TokenResult(C.TokenResultStatus.BLOCKED)
 
+    def request_concurrent_token(
+        self, flow_id: int, acquire_count: int = 1, client_address: str = "local"
+    ) -> TokenResult:
+        """DefaultTokenService.requestConcurrentToken →
+        ConcurrentClusterFlowChecker.acquireConcurrentToken."""
+        if acquire_count <= 0:
+            return TokenResult(C.TokenResultStatus.BAD_REQUEST)
+        rule = cluster_flow_rule_manager.get_rule_by_id(int(flow_id))
+        if rule is None:
+            # nowCalls missing for an unknown flowId → FAIL (java:52-56).
+            return TokenResult(C.TokenResultStatus.FAIL)
+        status, token_id = self.concurrent.acquire(
+            client_address, rule, int(acquire_count), self.connected_count
+        )
+        return TokenResult(status, token_id=token_id)
+
+    def release_concurrent_token(self, token_id: int) -> TokenResult:
+        return TokenResult(self.concurrent.release(int(token_id)))
+
     def reset(self) -> None:
         with self._lock:
             self.state = ma.make_state(self.state.n_rows, CLUSTER_CFG)
             self._flow_rows.clear()
             self._ns_rows.clear()
             self._next_row = 0
+            self.concurrent.clear()

@@ -139,6 +139,7 @@ class Entry:
         acquire: int,
         pass_through: bool = False,
         param_rows: Sequence[int] = (),
+        cluster_tokens: Sequence = (),
     ) -> None:
         self.resource = resource
         self.rows = rows
@@ -149,6 +150,10 @@ class Entry:
         self.create_wall = get_engine().clock.to_wall(create_ts)
         self.acquire = acquire
         self.param_rows = tuple(param_rows)  # per-value thread gauges to release
+        # Held cluster concurrency tokens [(service, token_id)] —
+        # released at trueExit (the reference's releaseConcurrentToken
+        # on invocation completion).
+        self.cluster_tokens = list(cluster_tokens)
         self.error: Optional[BaseException] = None
         self.block_error: Optional[E.BlockError] = None
         self.pass_through = pass_through
@@ -178,6 +183,11 @@ class Entry:
                 resource=self.resource,
                 param_rows=self.param_rows,
             )
+        if self.cluster_tokens:
+            from sentinel_tpu.runtime.engine import release_cluster_tokens
+
+            release_cluster_tokens(self.cluster_tokens)
+            self.cluster_tokens = []
         ctx = self.context
         if ctx is not None and ctx.entry_stack and ctx.entry_stack[-1] is self:
             ctx.entry_stack.pop()
@@ -249,6 +259,7 @@ def _do_entry(
         op.ts,
         acquire,
         param_rows=op.param_thread_rows,
+        cluster_tokens=op.cluster_tokens,
     )
     if with_context:
         ctx.entry_stack.append(e)

@@ -49,6 +49,10 @@ class ClusterFlowConfig:
     sample_count: int = 10  # ClusterRuleConstant.DEFAULT_CLUSTER_SAMPLE_COUNT
     window_interval_ms: int = C.DEFAULT_WINDOW_INTERVAL_MS
     acquire_refuse_strategy: int = C.DEFAULT_BLOCK_STRATEGY
+    # Concurrent (held-token) mode timeouts, ms (ClusterFlowConfig.java:
+    # resourceTimeout / clientOfflineTime defaults).
+    resource_timeout: int = 2000
+    client_offline_time: int = 2000
 
 
 @dataclass(frozen=True)

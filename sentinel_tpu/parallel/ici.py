@@ -433,7 +433,7 @@ def make_sharded_flush(mesh, axis: str = "data", occupy_timeout_ms: int = 500):
         from sentinel_tpu.rules.degrade_table import trip_condition
 
         trip = trip_condition(
-            ddev, ddev.grade, ddev.threshold, ddev.slow_ratio,
+            ddev.grade, ddev.threshold, ddev.slow_ratio,
             merged_ddyn.bad.astype(jnp.float32),
             merged_ddyn.total.astype(jnp.float32),
         )

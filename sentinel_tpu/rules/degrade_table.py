@@ -132,7 +132,6 @@ class DegradeIndex:
 
 
 def trip_condition(
-    ddev: DegradeTableDevice,
     grade: jax.Array,  # int32 — per-element grade (gathered or full table)
     threshold: jax.Array,  # float32
     slow_ratio: jax.Array,  # float32
@@ -223,7 +222,7 @@ def breaker_on_exits(
 
     # ---- CLOSED -> OPEN: first prefix crossing the threshold ----
     trip = trip_condition(
-        ddev, grade, ddev.threshold[gid_c], ddev.slow_ratio[gid_c], run_bad, run_total
+        grade, ddev.threshold[gid_c], ddev.slow_ratio[gid_c], run_bad, run_total
     )
     crossing = in_win & (run_total >= ddev.min_request[gid_c]) & trip
 

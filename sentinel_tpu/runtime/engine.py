@@ -100,6 +100,10 @@ class _EntryOp:
     prio: bool = False
     cluster_blocked_rule: Optional[object] = None  # token server said BLOCKED
     verdict: Optional[Verdict] = None
+    # Held concurrency tokens acquired from the token service for
+    # cluster THREAD-grade rules: [(service, token_id)] — released at
+    # exit, or immediately if the entry is ultimately blocked.
+    cluster_tokens: List[Tuple[object, int]] = field(default_factory=list)
     # Resolution context: which index objects the gids/rows above came
     # from, plus what is needed to re-resolve if a rule reload swapped
     # the tables between submit and flush (see _flush_locked).
@@ -127,6 +131,18 @@ class _ExitOp:
     p_rows: List[int] = field(default_factory=list)  # param thread rows to release
     resource: Optional[str] = None  # for d_gid re-resolution after a reload
     src_dindex: Optional[object] = None
+
+
+def release_cluster_tokens(tokens: Sequence[Tuple[object, int]]) -> None:
+    """Best-effort release of held cluster concurrency tokens; a failed
+    release is covered by the server's resourceTimeout sweep."""
+    from sentinel_tpu.utils.record_log import record_log
+
+    for service, token_id in tokens:
+        try:
+            service.release_concurrent_token(token_id)
+        except Exception:
+            record_log.warn("[Engine] release of cluster token %d failed", token_id)
 
 
 class Engine:
@@ -434,6 +450,26 @@ class Engine:
                 if cc.fallback_to_local_when_fail:
                     kept.append((gid, crow))
                 continue
+            if rule.grade == C.FLOW_GRADE_THREAD:
+                # Cluster concurrency: a HELD token (acquire/release)
+                # rather than a windowed QPS grant —
+                # ConcurrentClusterFlowChecker.acquireConcurrentToken.
+                try:
+                    result = service.request_concurrent_token(cc.flow_id, op.acquire)
+                except Exception:
+                    result = None
+                status = (
+                    result.status if result is not None else _C.TokenResultStatus.FAIL
+                )
+                if status == _C.TokenResultStatus.OK:
+                    op.cluster_tokens.append((service, result.token_id))
+                    continue
+                if status == _C.TokenResultStatus.BLOCKED:
+                    op.cluster_blocked_rule = rule
+                    continue
+                if cc.fallback_to_local_when_fail:
+                    kept.append((gid, crow))
+                continue
             try:
                 result = service.request_token(cc.flow_id, op.acquire, op.prio)
             except Exception:
@@ -461,6 +497,7 @@ class Engine:
         ts: Optional[int] = None,
         resource: Optional[str] = None,
         param_rows: Sequence[int] = (),
+        cluster_tokens: Sequence[Tuple[object, int]] = (),
     ) -> None:
         """StatisticSlot.exit: success + RT + thread release (+exception).
 
@@ -468,6 +505,12 @@ class Engine:
         breakers (DegradeSlot.exit → onRequestComplete), resolved against
         the degrade rules active at exit time, like the reference.
         ``param_rows`` are per-value thread-gauge rows to release.
+        ``cluster_tokens`` are held cluster concurrency tokens
+        (``op.cluster_tokens`` from the admitted entry) — deferred-mode
+        callers must pass them here (or call
+        :func:`release_cluster_tokens` themselves) or the global
+        concurrency gauge stays pinned until the server's
+        resourceTimeout sweep.
         """
         with self._lock:
             dindex = self.degrade_index
@@ -486,6 +529,8 @@ class Engine:
             )
             self._exits.append(op)
             over = len(self._exits) >= self.max_batch
+        if cluster_tokens:
+            release_cluster_tokens(cluster_tokens)
         if over:
             self.flush()
 
@@ -698,9 +743,19 @@ class Engine:
             cur = (findex, dindex, pindex)
             for op in entries:
                 if op.src is not None and op.src != cur:
-                    op.slots = findex.resolve_slots(
-                        op.resource, op.context_name, op.origin, self.nodes
-                    )
+                    # Cluster-mode slots are excluded: the op's token
+                    # verdict (acquired / stripped / BLOCKED) was taken
+                    # at submit time and stands — re-adding the slot
+                    # would double-check a granted token against the
+                    # local window, and re-running the RPC would
+                    # double-acquire the global budget.
+                    op.slots = [
+                        s
+                        for s in findex.resolve_slots(
+                            op.resource, op.context_name, op.origin, self.nodes
+                        )
+                        if s[0] not in findex.cluster_gids
+                    ]
                     op.d_gids = dindex.gids_for(op.resource)
                     op.p_slots = (
                         pindex.slots_for(op.resource, op.args)
@@ -724,6 +779,15 @@ class Engine:
                 pindex,
                 auth_rules,
             )
+        # An entry that acquired cluster concurrency tokens but was then
+        # blocked by another stage must hand them back (the reference's
+        # releaseConcurrentToken on abort). Synchronous: the embedded
+        # service releases instantly; over the wire this is one RPC per
+        # blocked multi-rule entry — rare.
+        for op in entries:
+            if op.cluster_tokens and op.verdict is not None and not op.verdict.admitted:
+                release_cluster_tokens(op.cluster_tokens)
+                op.cluster_tokens = []
         return entries
 
     def _run_chunk(

@@ -1,0 +1,261 @@
+"""Cluster concurrent (in-flight) flow control: the held-token protocol
+(MSG_TYPE_CONCURRENT_FLOW_ACQUIRE=3 / RELEASE=4) against the reference's
+ConcurrentClusterFlowChecker + CurrentConcurrencyManager semantics
+(sentinel-cluster-server-default/.../flow/ConcurrentClusterFlowChecker.
+java:30-100) — direct service calls, TCP round trips, engine
+integration with release-on-exit, connected-count scaling, and the
+resourceTimeout sweep.
+"""
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import (
+    ClusterStateManager,
+    DefaultTokenService,
+    EmbeddedClusterTokenServerProvider,
+    TokenClientProvider,
+    cluster_flow_rule_manager,
+    cluster_server_config_manager,
+)
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.server import SentinelTokenServer
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import ClusterFlowConfig, FlowRule
+from sentinel_tpu.utils.clock import ManualClock
+
+
+def concurrent_rule(resource, count, flow_id,
+                    threshold_type=C.FLOW_THRESHOLD_GLOBAL,
+                    resource_timeout=2000, fallback=False):
+    return FlowRule(
+        resource,
+        count=count,
+        grade=C.FLOW_GRADE_THREAD,
+        cluster_mode=True,
+        cluster_config=ClusterFlowConfig(
+            flow_id=flow_id,
+            threshold_type=threshold_type,
+            fallback_to_local_when_fail=fallback,
+            resource_timeout=resource_timeout,
+        ),
+    )
+
+
+@pytest.fixture()
+def cluster_env():
+    cluster_flow_rule_manager.clear()
+    cluster_server_config_manager.load_global_flow_config(
+        exceed_count=1.0, max_allowed_qps=30000.0
+    )
+    yield
+    cluster_flow_rule_manager.clear()
+    ClusterStateManager.stop()
+    TokenClientProvider.clear()
+    EmbeddedClusterTokenServerProvider.clear()
+
+
+class TestConcurrentService:
+    def test_acquire_until_threshold_then_block(self, cluster_env):
+        svc = DefaultTokenService(clock=ManualClock(0))
+        cluster_flow_rule_manager.load_rules(
+            "default", [concurrent_rule("c", 3, flow_id=10)]
+        )
+        tokens = []
+        for _ in range(3):
+            r = svc.request_concurrent_token(10)
+            assert r.ok and r.token_id != 0
+            tokens.append(r.token_id)
+        assert svc.request_concurrent_token(10).status == C.TokenResultStatus.BLOCKED
+        assert svc.concurrent.now_calls(10) == 3
+        # Releasing one frees one slot.
+        assert (
+            svc.release_concurrent_token(tokens[0]).status
+            == C.TokenResultStatus.RELEASE_OK
+        )
+        assert svc.request_concurrent_token(10).ok
+        # Double release of the same token.
+        assert (
+            svc.release_concurrent_token(tokens[0]).status
+            == C.TokenResultStatus.ALREADY_RELEASE
+        )
+
+    def test_unknown_flow_fails(self, cluster_env):
+        svc = DefaultTokenService(clock=ManualClock(0))
+        assert svc.request_concurrent_token(999).status == C.TokenResultStatus.FAIL
+
+    def test_acquire_count_batches(self, cluster_env):
+        svc = DefaultTokenService(clock=ManualClock(0))
+        cluster_flow_rule_manager.load_rules(
+            "default", [concurrent_rule("c", 5, flow_id=11)]
+        )
+        r = svc.request_concurrent_token(11, acquire_count=4)
+        assert r.ok
+        assert svc.request_concurrent_token(11, acquire_count=2).status \
+            == C.TokenResultStatus.BLOCKED
+        assert svc.request_concurrent_token(11, acquire_count=1).ok
+
+    def test_connected_count_scales_avg_local(self, cluster_env):
+        """AVG_LOCAL: threshold = count × connectedCount
+        (calcGlobalThreshold, java:33-45)."""
+        svc = DefaultTokenService(clock=ManualClock(0))
+        cluster_flow_rule_manager.load_rules(
+            "default",
+            [concurrent_rule("c", 2, flow_id=12,
+                             threshold_type=C.FLOW_THRESHOLD_AVG_LOCAL)],
+        )
+        svc.set_connected_count(1)
+        assert svc.request_concurrent_token(12).ok
+        assert svc.request_concurrent_token(12).ok
+        assert svc.request_concurrent_token(12).status == C.TokenResultStatus.BLOCKED
+        svc.set_connected_count(3)  # capacity now 6, 2 held
+        for _ in range(4):
+            assert svc.request_concurrent_token(12).ok
+        assert svc.request_concurrent_token(12).status == C.TokenResultStatus.BLOCKED
+
+    def test_resource_timeout_sweep(self, cluster_env):
+        """Tokens held past resourceTimeout are force-freed — the
+        client-died story (TokenCacheNode.resourceTimeout)."""
+        clock = ManualClock(0)
+        svc = DefaultTokenService(clock=clock)
+        cluster_flow_rule_manager.load_rules(
+            "default", [concurrent_rule("c", 1, flow_id=13, resource_timeout=500)]
+        )
+        r = svc.request_concurrent_token(13)
+        assert r.ok
+        assert svc.request_concurrent_token(13).status == C.TokenResultStatus.BLOCKED
+        clock.set_ms(600)
+        assert svc.concurrent.sweep_expired() == 1
+        assert svc.request_concurrent_token(13).ok
+        # The swept token's late release is ALREADY_RELEASE, not a
+        # double decrement.
+        assert (
+            svc.release_concurrent_token(r.token_id).status
+            == C.TokenResultStatus.ALREADY_RELEASE
+        )
+        assert svc.concurrent.now_calls(13) == 1
+
+
+    def test_expired_token_freed_at_capacity_without_explicit_sweep(self, cluster_env):
+        """acquire() at capacity force-sweeps: an expired token must not
+        keep the flow blocked until the next throttled sweep."""
+        clock = ManualClock(0)
+        svc = DefaultTokenService(clock=clock)
+        cluster_flow_rule_manager.load_rules(
+            "default", [concurrent_rule("c", 1, flow_id=14, resource_timeout=500)]
+        )
+        assert svc.request_concurrent_token(14).ok
+        clock.set_ms(600)  # token expired; throttled sweep not due yet
+        assert svc.request_concurrent_token(14).ok
+
+    def test_deferred_exit_releases_tokens(self, cluster_env, manual_clock, engine):
+        """Deferred-mode callers pass op.cluster_tokens to submit_exit."""
+        rule = concurrent_rule("dfr", 2, flow_id=32)
+        cluster_flow_rule_manager.load_rules("default", [rule])
+        svc = DefaultTokenService(clock=manual_clock)
+        EmbeddedClusterTokenServerProvider.register(
+            SentinelTokenServer(port=0, service=svc)
+        )
+        ClusterStateManager.set_to_server()
+        st.flow_rule_manager.load_rules([rule])
+        ops = engine.submit_many([{"resource": "dfr"} for _ in range(2)])
+        engine.flush()
+        assert all(op.verdict.admitted for op in ops)
+        assert svc.concurrent.now_calls(32) == 2
+        for op in ops:
+            engine.submit_exit(op.rows, rt=5, resource="dfr",
+                               cluster_tokens=op.cluster_tokens)
+        engine.flush()
+        assert svc.concurrent.now_calls(32) == 0
+
+
+class TestConcurrentTcp:
+    def test_acquire_release_round_trip(self, cluster_env):
+        cluster_flow_rule_manager.load_rules(
+            "default", [concurrent_rule("c", 2, flow_id=20)]
+        )
+        server = SentinelTokenServer(port=0, service=DefaultTokenService(ManualClock(0)))
+        server.start()
+        try:
+            client = ClusterTokenClient(port=server.port).start()
+            r1 = client.request_concurrent_token(20)
+            r2 = client.request_concurrent_token(20)
+            assert r1.ok and r2.ok and r1.token_id != r2.token_id
+            assert (
+                client.request_concurrent_token(20).status
+                == C.TokenResultStatus.BLOCKED
+            )
+            assert (
+                client.release_concurrent_token(r1.token_id).status
+                == C.TokenResultStatus.RELEASE_OK
+            )
+            assert client.request_concurrent_token(20).ok
+            client.stop()
+        finally:
+            server.stop()
+
+    def test_client_disconnect_frees_held_tokens(self, cluster_env):
+        """The server eagerly frees a vanished client's held tokens
+        (clientOfflineTime / ConnectionManager story)."""
+        import time
+
+        cluster_flow_rule_manager.load_rules(
+            "default", [concurrent_rule("c", 1, flow_id=21)]
+        )
+        svc = DefaultTokenService(ManualClock(0))
+        server = SentinelTokenServer(port=0, service=svc)
+        server.start()
+        try:
+            client = ClusterTokenClient(port=server.port).start()
+            assert client.request_concurrent_token(21).ok
+            assert svc.concurrent.now_calls(21) == 1
+            client.stop()  # connection drops without release
+            deadline = time.time() + 5
+            while time.time() < deadline and svc.concurrent.now_calls(21) != 0:
+                time.sleep(0.02)
+            assert svc.concurrent.now_calls(21) == 0
+        finally:
+            server.stop()
+
+
+class TestEngineConcurrentIntegration:
+    def test_entry_acquires_and_exit_releases(self, cluster_env, manual_clock, engine):
+        """A cluster THREAD-grade rule routes through the concurrent
+        token API; Entry.exit hands the token back."""
+        rule = concurrent_rule("svc", 2, flow_id=30)
+        cluster_flow_rule_manager.load_rules("default", [rule])
+        svc = DefaultTokenService(clock=manual_clock)
+        server = SentinelTokenServer(port=0, service=svc)  # embedded
+        EmbeddedClusterTokenServerProvider.register(server)
+        ClusterStateManager.set_to_server()
+        st.flow_rule_manager.load_rules([rule])
+
+        e1 = st.try_entry("svc")
+        e2 = st.try_entry("svc")
+        assert e1 is not None and e2 is not None
+        assert svc.concurrent.now_calls(30) == 2
+        assert st.try_entry("svc") is None  # concurrency exhausted
+        e1.exit()
+        assert svc.concurrent.now_calls(30) == 1
+        e3 = st.try_entry("svc")
+        assert e3 is not None
+        e2.exit()
+        e3.exit()
+        assert svc.concurrent.now_calls(30) == 0
+        assert svc.concurrent.held_tokens() == 0
+
+    def test_blocked_entry_returns_its_token(self, cluster_env, manual_clock, engine):
+        """An entry that acquired a concurrency token but was blocked by
+        another rule releases the token immediately."""
+        rule = concurrent_rule("mix", 5, flow_id=31)
+        local = FlowRule("mix", count=0)  # always blocks locally
+        cluster_flow_rule_manager.load_rules("default", [rule])
+        svc = DefaultTokenService(clock=manual_clock)
+        EmbeddedClusterTokenServerProvider.register(
+            SentinelTokenServer(port=0, service=svc)
+        )
+        ClusterStateManager.set_to_server()
+        st.flow_rule_manager.load_rules([rule, local])
+        assert st.try_entry("mix") is None
+        assert svc.concurrent.now_calls(31) == 0  # token handed back
+        assert svc.concurrent.held_tokens() == 0
