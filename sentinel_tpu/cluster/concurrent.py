@@ -32,14 +32,18 @@ from sentinel_tpu.utils.record_log import record_log
 
 @dataclass
 class TokenCacheNode:
-    """One held concurrency token (TokenCacheNode.java:20-75)."""
+    """One held concurrency token (TokenCacheNode.java:20-75).
+
+    The reference also stamps a clientTimeout (clientOfflineTime grace
+    before a disconnected client's tokens expire); here the server
+    frees a vanished client's tokens eagerly on disconnect
+    (cluster/server.py), so only the resource timeout is tracked."""
 
     token_id: int
     flow_id: int
     acquire_count: int
     client_address: str
-    client_timeout_at: int  # ms, rel clock
-    resource_timeout_at: int
+    resource_timeout_at: int  # ms, rel clock
 
 
 class ConcurrentFlowManager:
@@ -99,7 +103,6 @@ class ConcurrentFlowManager:
                 flow_id=flow_id,
                 acquire_count=acquire_count,
                 client_address=client_address,
-                client_timeout_at=now + int(cc.client_offline_time),
                 resource_timeout_at=now + int(cc.resource_timeout),
             )
             return C.TokenResultStatus.OK, token_id

@@ -9,7 +9,9 @@ vectorized updates over an HBM-resident tensor
 ``counts[rows, buckets, events]``.
 """
 
+from sentinel_tpu.metrics.block_log import BlockLogger
 from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
+from sentinel_tpu.metrics.extension import MetricExtension, MetricExtensionProvider
 from sentinel_tpu.metrics.metric_array import (
     MetricArrayConfig,
     MetricArrayState,
@@ -22,6 +24,9 @@ from sentinel_tpu.metrics.metric_array import (
 )
 
 __all__ = [
+    "BlockLogger",
+    "MetricExtension",
+    "MetricExtensionProvider",
     "MetricEvent",
     "NUM_EVENTS",
     "MetricArrayConfig",

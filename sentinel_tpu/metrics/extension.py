@@ -1,0 +1,124 @@
+"""Metric extension SPI — pluggable per-event metric callbacks.
+
+Reference: MetricExtension / AdvancedMetricExtension
+(sentinel-core/.../metric/extension/MetricExtension.java) wired into the
+StatisticSlot through MetricEntryCallback / MetricExitCallback
+(metric/extension/callback/MetricEntryCallback.java:33-56,
+MetricExitCallback.java:34-60) and registered via the InitFunc SPI
+(MetricCallbackInit). The engine invokes registered extensions with each
+flush's verdicts — same callback surface, batched delivery.
+
+Extensions run under the engine's flush lock on the flushing thread
+(the reference runs them inline on the request thread): keep them fast
+and non-blocking; exceptions are swallowed and logged.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Sequence
+
+from sentinel_tpu.utils.record_log import record_log
+
+
+class MetricExtension:
+    """Callback surface (MetricExtension.java method-for-method; Python
+    names snake_cased). Subclass and override what you need."""
+
+    def add_pass(self, resource: str, n: int, *args: object) -> None:
+        """Invocation passed all checks (n = acquire count)."""
+
+    def add_block(
+        self, resource: str, n: int, origin: str, block_error: object, *args: object
+    ) -> None:
+        """Invocation blocked; ``block_error`` is the BlockError."""
+
+    def add_success(self, resource: str, n: int, *args: object) -> None:
+        """Invocation completed successfully."""
+
+    def add_exception(self, resource: str, n: int, throwable: object) -> None:
+        """Business exception recorded (Tracer)."""
+
+    def add_rt(self, resource: str, rt_ms: int, *args: object) -> None:
+        """Response time recorded at completion."""
+
+    def increase_thread_num(self, resource: str, *args: object) -> None:
+        pass
+
+    def decrease_thread_num(self, resource: str, *args: object) -> None:
+        pass
+
+
+class MetricExtensionProvider:
+    """Registry (MetricExtensionProvider.java) — explicit registration
+    plus entry-point SPI discovery on first use."""
+
+    _lock = threading.Lock()
+    _extensions: List[MetricExtension] = []
+    _spi_loaded = False
+
+    @classmethod
+    def get_extensions(cls) -> Sequence[MetricExtension]:
+        if not cls._spi_loaded:
+            cls._load_spi()
+        return cls._extensions
+
+    @classmethod
+    def _load_spi(cls) -> None:
+        with cls._lock:
+            if cls._spi_loaded:
+                return
+            cls._spi_loaded = True
+            try:
+                from sentinel_tpu.utils.registry import Registry
+
+                for ext in Registry.of("MetricExtension").load_instance_list_sorted():
+                    cls._extensions.append(ext)
+            except Exception:
+                record_log.error("[MetricExtension] SPI load failed", exc_info=True)
+
+    @classmethod
+    def register(cls, ext: MetricExtension) -> None:
+        with cls._lock:
+            cls._extensions.append(ext)
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._extensions.clear()
+            cls._spi_loaded = False
+
+    # ------------------------------------------------------------------
+    # Batched dispatch helpers (called by the engine; one guard per
+    # extension so one misbehaving extension cannot starve the rest).
+    @classmethod
+    def on_pass(cls, resource: str, n: int, args: Sequence[object]) -> None:
+        for ext in cls.get_extensions():
+            try:
+                ext.add_pass(resource, n, *args)
+                ext.increase_thread_num(resource, *args)
+            except Exception:
+                record_log.error("[MetricExtension] add_pass failed", exc_info=True)
+
+    @classmethod
+    def on_blocked(
+        cls, resource: str, n: int, origin: str, block_error: object,
+        args: Sequence[object],
+    ) -> None:
+        for ext in cls.get_extensions():
+            try:
+                ext.add_block(resource, n, origin, block_error, *args)
+            except Exception:
+                record_log.error("[MetricExtension] add_block failed", exc_info=True)
+
+    @classmethod
+    def on_complete(cls, resource: str, rt_ms: int, n: int, err: int) -> None:
+        for ext in cls.get_extensions():
+            try:
+                ext.add_rt(resource, rt_ms)
+                ext.add_success(resource, n)
+                if err:
+                    ext.add_exception(resource, err, None)
+                ext.decrease_thread_num(resource)
+            except Exception:
+                record_log.error("[MetricExtension] on_complete failed", exc_info=True)

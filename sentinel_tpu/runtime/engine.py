@@ -104,6 +104,11 @@ class _EntryOp:
     # cluster THREAD-grade rules: [(service, token_id)] — released at
     # exit, or immediately if the entry is ultimately blocked.
     cluster_tokens: List[Tuple[object, int]] = field(default_factory=list)
+    # Cluster flow-ids whose verdict the token server already issued
+    # (OK/BLOCKED) — a post-reload re-resolve must not re-add these as
+    # local slots, but must keep fallback-to-local slots. Keyed by
+    # flow_id, which is stable across reloads (gids are not).
+    token_decided_flow_ids: frozenset = frozenset()
     # Resolution context: which index objects the gids/rows above came
     # from, plus what is needed to re-resolve if a rule reload swapped
     # the tables between submit and flush (see _flush_locked).
@@ -131,6 +136,17 @@ class _ExitOp:
     p_rows: List[int] = field(default_factory=list)  # param thread rows to release
     resource: Optional[str] = None  # for d_gid re-resolution after a reload
     src_dindex: Optional[object] = None
+
+
+# Block-log exception names per verdict reason (the reference logs
+# e.getClass().getSimpleName() — LogSlot.java:24).
+_BLOCK_EXC_NAMES = {
+    E.BLOCK_FLOW: "FlowException",
+    E.BLOCK_DEGRADE: "DegradeException",
+    E.BLOCK_SYSTEM: "SystemBlockException",
+    E.BLOCK_AUTHORITY: "AuthorityException",
+    E.BLOCK_PARAM: "ParamFlowException",
+}
 
 
 def release_cluster_tokens(tokens: Sequence[Tuple[object, int]]) -> None:
@@ -175,6 +191,11 @@ class Engine:
         self.mesh = None
         self._sharded_fn = None
         self._n_shards = 1
+        # Block log (LogSlot → sentinel-block.log); file IO happens only
+        # when a blocked verdict is actually aggregated out.
+        from sentinel_tpu.metrics.block_log import BlockLogger
+
+        self.block_log = BlockLogger(clock=self.clock)
 
     # ------------------------------------------------------------------
     # multi-chip mode
@@ -197,7 +218,7 @@ class Engine:
         from sentinel_tpu.parallel import make_mesh, make_sharded_flush
 
         with self._flush_lock:
-            self._flush_locked()
+            drained = self._flush_locked()
             with self._lock:
                 n = n_devices if n_devices is not None else len(jax.devices())
                 if n < 1 or (n & (n - 1)) != 0:
@@ -211,14 +232,16 @@ class Engine:
                     self.mesh, occupy_timeout_ms=config.occupy_timeout_ms
                 )
 
+        self._release_blocked_tokens(drained)
     def disable_mesh(self) -> None:
         with self._flush_lock:
-            self._flush_locked()
+            drained = self._flush_locked()
             with self._lock:
                 self.mesh = None
                 self._sharded_fn = None
                 self._n_shards = 1
 
+        self._release_blocked_tokens(drained)
     @staticmethod
     def _validate_mesh_rules(findex: FlowIndex, pindex: ParamIndex) -> None:
         if findex.shaping_gids:
@@ -240,7 +263,7 @@ class Engine:
     # ------------------------------------------------------------------
     def set_flow_rules(self, rules: Sequence[FlowRule]) -> None:
         with self._flush_lock:
-            self._flush_locked()  # decisions for pending ops use the old rules
+            drained = self._flush_locked()  # decisions for pending ops use the old rules
             with self._lock:
                 findex = FlowIndex(rules, cold_factor=config.cold_factor)
                 if self.mesh is not None:
@@ -248,20 +271,22 @@ class Engine:
                 self.flow_index = findex
                 self.flow_dyn = findex.make_dyn_state()
 
+        self._release_blocked_tokens(drained)
     def set_degrade_rules(self, rules: Sequence[DegradeRule]) -> None:
         """Breaker state is NOT carried across reloads — the reference
         builds fresh CircuitBreaker objects per load (DegradeRuleManager)."""
         with self._flush_lock:
-            self._flush_locked()
+            drained = self._flush_locked()
             with self._lock:
                 self.degrade_index = DegradeIndex(rules)
                 self.degrade_dyn = self.degrade_index.make_dyn_state()
 
+        self._release_blocked_tokens(drained)
     def set_param_rules(self, by_resource: Dict[str, List[ParamFlowRule]]) -> None:
         """Param caches are rebuilt on reload, like
         ParamFlowRuleManager clearing ParameterMetric for changed rules."""
         with self._flush_lock:
-            self._flush_locked()
+            drained = self._flush_locked()
             with self._lock:
                 pindex = ParamIndex(by_resource)
                 if self.mesh is not None:
@@ -269,9 +294,10 @@ class Engine:
                 self.param_index = pindex
                 self.param_dyn = make_param_state(8)
 
+        self._release_blocked_tokens(drained)
     def set_system_config(self, cfg) -> None:
         with self._flush_lock:
-            self._flush_locked()
+            drained = self._flush_locked()
             with self._lock:
                 self.system_config = (
                     cfg if cfg is not None and cfg.any_enabled else None
@@ -282,12 +308,14 @@ class Engine:
                 ):
                     system_sampler.start()
 
+        self._release_blocked_tokens(drained)
     def set_authority_rules(self, by_resource: Dict[str, AuthorityRule]) -> None:
         with self._flush_lock:
-            self._flush_locked()
+            drained = self._flush_locked()
             with self._lock:
                 self.authority_rules = dict(by_resource)
 
+        self._release_blocked_tokens(drained)
     def _system_device(self) -> SystemDevice:
         cfg = self.system_config
         inf = float("inf")
@@ -440,6 +468,7 @@ class Engine:
             server = EmbeddedClusterTokenServerProvider.get_server()
             service = getattr(server, "service", server)
         kept = []
+        decided = set()
         for gid, crow in op.slots:
             rule = cluster_gids.get(gid)
             if rule is None:
@@ -463,9 +492,11 @@ class Engine:
                 )
                 if status == _C.TokenResultStatus.OK:
                     op.cluster_tokens.append((service, result.token_id))
+                    decided.add(cc.flow_id)
                     continue
                 if status == _C.TokenResultStatus.BLOCKED:
                     op.cluster_blocked_rule = rule
+                    decided.add(cc.flow_id)
                     continue
                 if cc.fallback_to_local_when_fail:
                     kept.append((gid, crow))
@@ -476,17 +507,21 @@ class Engine:
                 result = None
             status = result.status if result is not None else _C.TokenResultStatus.FAIL
             if status == _C.TokenResultStatus.OK:
+                decided.add(cc.flow_id)
                 continue  # token granted: rule passes
             if status == _C.TokenResultStatus.SHOULD_WAIT:
                 self.clock.sleep_ms(result.wait_in_ms)
+                decided.add(cc.flow_id)
                 continue
             if status == _C.TokenResultStatus.BLOCKED:
                 op.cluster_blocked_rule = rule
+                decided.add(cc.flow_id)
                 continue
             # FAIL / NO_RULE_EXISTS / TOO_MANY_REQUEST / BAD_REQUEST ...
             if cc.fallback_to_local_when_fail:
                 kept.append((gid, crow))
         op.slots = kept
+        op.token_decided_flow_ids = frozenset(decided)
 
     def submit_exit(
         self,
@@ -721,7 +756,9 @@ class Engine:
         filling them).
         """
         with self._flush_lock:
-            return self._flush_locked()
+            entries = self._flush_locked()
+        self._release_blocked_tokens(entries)
+        return entries
 
     def _flush_locked(self) -> List[_EntryOp]:
         with self._lock:
@@ -743,18 +780,27 @@ class Engine:
             cur = (findex, dindex, pindex)
             for op in entries:
                 if op.src is not None and op.src != cur:
-                    # Cluster-mode slots are excluded: the op's token
-                    # verdict (acquired / stripped / BLOCKED) was taken
-                    # at submit time and stands — re-adding the slot
-                    # would double-check a granted token against the
-                    # local window, and re-running the RPC would
-                    # double-acquire the global budget.
+                    # Slots the token server already decided (granted or
+                    # BLOCKED at submit time) must not reappear as local
+                    # slots — that would double-check a granted token
+                    # against the local window; re-running the RPC would
+                    # double-acquire the global budget. Everything else
+                    # (kept fallback slots, rules that became
+                    # cluster-mode after submit) stays locally enforced.
+                    def _decided(gid: int) -> bool:
+                        rule = findex.cluster_gids.get(gid)
+                        return (
+                            rule is not None
+                            and rule.cluster_config.flow_id
+                            in op.token_decided_flow_ids
+                        )
+
                     op.slots = [
                         s
                         for s in findex.resolve_slots(
                             op.resource, op.context_name, op.origin, self.nodes
                         )
-                        if s[0] not in findex.cluster_gids
+                        if not _decided(s[0])
                     ]
                     op.d_gids = dindex.gids_for(op.resource)
                     op.p_slots = (
@@ -779,16 +825,18 @@ class Engine:
                 pindex,
                 auth_rules,
             )
-        # An entry that acquired cluster concurrency tokens but was then
-        # blocked by another stage must hand them back (the reference's
-        # releaseConcurrentToken on abort). Synchronous: the embedded
-        # service releases instantly; over the wire this is one RPC per
-        # blocked multi-rule entry — rare.
+        return entries
+
+    @staticmethod
+    def _release_blocked_tokens(entries: List[_EntryOp]) -> None:
+        """Hand back concurrency tokens of entries that were ultimately
+        blocked (the reference's releaseConcurrentToken on abort). Runs
+        OUTSIDE the flush lock — over the wire each release is an RPC
+        that must not stall concurrent flush()/entry_sync callers."""
         for op in entries:
             if op.cluster_tokens and op.verdict is not None and not op.verdict.admitted:
                 release_cluster_tokens(op.cluster_tokens)
                 op.cluster_tokens = []
-        return entries
 
     def _run_chunk(
         self,
@@ -954,6 +1002,46 @@ class Engine:
                 blocked_rule=blocked_rule,
                 limit_type=limit_type,
             )
+
+        # ---- block log + metric-extension callbacks ----
+        # LogSlot (order −8000) writing sentinel-block.log, and the
+        # StatisticSlot entry/exit callback registry (MetricEntryCallback
+        # / MetricExitCallback), delivered per flush.
+        from sentinel_tpu.metrics.extension import MetricExtensionProvider
+
+        exts = MetricExtensionProvider.get_extensions()
+        blocked_items = []
+        for op in entries:
+            v = op.verdict
+            if v is None:
+                continue
+            if v.admitted:
+                if exts:
+                    MetricExtensionProvider.on_pass(op.resource, op.acquire, op.args)
+            else:
+                exc_name = _BLOCK_EXC_NAMES.get(v.reason, "BlockException")
+                limit_app = getattr(v.blocked_rule, "limit_app", None) or "default"
+                blocked_items.append(
+                    (op.resource, exc_name, limit_app, op.origin, op.acquire)
+                )
+                if exts:
+                    # Extensions receive a real BlockError (the contract
+                    # mirrors the reference's BlockException argument).
+                    if v.reason == E.BLOCK_SYSTEM:
+                        err = E.SystemBlockError(op.resource, v.limit_type)
+                    else:
+                        err = E.error_for_code(v.reason, op.resource)
+                        err.rule = v.blocked_rule
+                    MetricExtensionProvider.on_blocked(
+                        op.resource, op.acquire, op.origin, err, op.args
+                    )
+        if blocked_items:
+            self.block_log.log_batch(blocked_items)
+        self.block_log.maybe_flush()
+        if exts:
+            for x in exits:
+                if x.resource is not None and x.thr < 0:
+                    MetricExtensionProvider.on_complete(x.resource, x.rt, x.count, x.err)
 
     def _encode_shaping(
         self, entries: List[_EntryOp], k: int, findex: FlowIndex

@@ -1,0 +1,151 @@
+"""Block log + metric-extension callbacks: blocked requests leave a
+durable aggregated trace (LogSlot → sentinel-block.log, reference:
+slots/logger/LogSlot.java:31-40 + EagleEyeLogUtil.java:20-40), and
+registered MetricExtension callbacks observe every flush's pass/block/
+complete events (metric/extension/callback/MetricEntryCallback.java:
+33-56).
+"""
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.metrics.block_log import BlockLogger
+from sentinel_tpu.metrics.extension import MetricExtension, MetricExtensionProvider
+
+
+@pytest.fixture()
+def block_env(manual_clock, engine, tmp_path):
+    engine.block_log = BlockLogger(base_dir=str(tmp_path), clock=manual_clock)
+    MetricExtensionProvider.clear()
+    yield engine
+    MetricExtensionProvider.clear()
+
+
+class TestBlockLog:
+    def test_blocked_entries_aggregate_per_second(self, block_env, manual_clock):
+        engine = block_env
+        st.flow_rule_manager.load_rules([st.FlowRule("res", count=0)])
+        manual_clock.set_ms(100)
+        for _ in range(5):
+            assert st.try_entry("res") is None
+        manual_clock.set_ms(1200)  # next interval: rolls the first out
+        assert st.try_entry("res") is None
+        engine.block_log.flush()
+        entries = engine.block_log.read_entries()
+        assert len(entries) == 2
+        wall0 = manual_clock.epoch_wall_ms + 0  # second of ts=100
+        ts0, key0, count0 = entries[0]
+        assert ts0 == wall0
+        assert key0 == ("res", "FlowException", "default", "")
+        assert count0 == 5
+        assert entries[1][2] == 1
+
+    def test_exception_name_per_block_type(self, block_env, manual_clock):
+        engine = block_env
+        st.flow_rule_manager.load_rules([st.FlowRule("d", count=100)])
+        st.degrade_rule_manager.load_rules(
+            [st.DegradeRule(resource="d", grade=1, count=0.5, time_window=5,
+                            min_request_amount=1)]
+        )
+        manual_clock.set_ms(500)
+        e = st.entry("d")
+        e.set_error(RuntimeError("boom"))
+        e.exit()
+        assert st.try_entry("d") is None  # breaker OPEN
+        engine.block_log.flush()
+        names = {k[1] for _, k, _ in engine.block_log.read_entries()}
+        assert names == {"DegradeException"}
+
+    def test_origin_and_limit_app_in_key(self, block_env, manual_clock):
+        engine = block_env
+        st.flow_rule_manager.load_rules([st.FlowRule("o", count=0, limit_app="appA")])
+        manual_clock.set_ms(100)
+        st.ContextUtil.enter("ctx", "appA")
+        try:
+            assert st.try_entry("o") is None
+        finally:
+            st.ContextUtil.exit()
+        engine.block_log.flush()
+        (_, key, _), = engine.block_log.read_entries()
+        assert key == ("o", "FlowException", "appA", "appA")
+
+    def test_rolling_keeps_backups(self, tmp_path, manual_clock):
+        log = BlockLogger(base_dir=str(tmp_path), clock=manual_clock,
+                          max_file_size=200, max_backup_index=2)
+        for sec in range(30):
+            log.log("r", "FlowException", now_wall_ms=manual_clock.epoch_wall_ms + sec * 1000)
+        log.flush()
+        files = sorted(p.name for p in tmp_path.iterdir())
+        assert "sentinel-block.log" in files
+        assert any(n.endswith(".1") for n in files)
+        assert not any(n.endswith(".3") for n in files)  # backup cap
+
+
+class Recorder(MetricExtension):
+    def __init__(self):
+        self.events = []
+
+    def add_pass(self, resource, n, *args):
+        self.events.append(("pass", resource, n))
+
+    def add_block(self, resource, n, origin, block_error, *args):
+        self.events.append(("block", resource, n, origin))
+
+    def add_success(self, resource, n, *args):
+        self.events.append(("success", resource, n))
+
+    def add_rt(self, resource, rt, *args):
+        self.events.append(("rt", resource, rt))
+
+    def add_exception(self, resource, n, throwable):
+        self.events.append(("exception", resource, n))
+
+    def increase_thread_num(self, resource, *args):
+        self.events.append(("thr+", resource))
+
+    def decrease_thread_num(self, resource, *args):
+        self.events.append(("thr-", resource))
+
+
+class TestMetricExtension:
+    def test_callbacks_observe_pass_block_complete(self, block_env, manual_clock):
+        rec = Recorder()
+        MetricExtensionProvider.register(rec)
+        st.flow_rule_manager.load_rules([st.FlowRule("m", count=1)])
+        manual_clock.set_ms(100)
+        e = st.entry("m")
+        assert st.try_entry("m") is None  # blocked
+        manual_clock.set_ms(150)
+        e.exit()
+        block_env.flush()  # exit callbacks deliver with the exit's flush
+        kinds = [ev[0] for ev in rec.events]
+        assert kinds.count("pass") == 1
+        assert kinds.count("thr+") == 1
+        assert ("block", "m", 1, "") in rec.events
+        assert ("rt", "m", 50) in rec.events
+        assert ("success", "m", 1) in rec.events
+        assert kinds.count("thr-") == 1
+
+    def test_exception_counted_on_complete(self, block_env, manual_clock):
+        rec = Recorder()
+        MetricExtensionProvider.register(rec)
+        st.flow_rule_manager.load_rules([st.FlowRule("x", count=10)])
+        manual_clock.set_ms(100)
+        e = st.entry("x")
+        e.set_error(RuntimeError("boom"))
+        e.exit()
+        block_env.flush()
+        assert ("exception", "x", 1) in rec.events
+
+    def test_misbehaving_extension_does_not_break_flush(self, block_env, manual_clock):
+        class Bad(MetricExtension):
+            def add_pass(self, resource, n, *args):
+                raise RuntimeError("broken extension")
+
+        rec = Recorder()
+        MetricExtensionProvider.register(Bad())
+        MetricExtensionProvider.register(rec)
+        st.flow_rule_manager.load_rules([st.FlowRule("b", count=10)])
+        e = st.entry("b")  # must not raise
+        e.exit()
+        assert ("pass", "b", 1) in rec.events
