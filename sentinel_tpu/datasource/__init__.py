@@ -8,9 +8,11 @@ FileWritableDataSource.java:33): a datasource adapts an external config
 store to a SentinelProperty that rule managers listen on. The reference
 ships adapters for Nacos/ZooKeeper/Apollo/etcd/Redis/Consul/Eureka —
 all following the same watch-callback → ``property.update_value`` shape;
-here the file and in-memory sources are first-class and the push-style
+here the file and in-memory sources are first-class, the push-style
 base class (:class:`PushDataSource`) is the extension point for any
-external store client.
+external store client, and :class:`RedisDataSource` is a full network
+adapter (RESP over a socket: GET for the initial value, SUBSCRIBE for
+live updates — sentinel-datasource-redis/.../RedisDataSource.java).
 """
 
 from sentinel_tpu.datasource.base import (
@@ -28,9 +30,11 @@ from sentinel_tpu.datasource.file_source import (
     FileRefreshableDataSource,
     FileWritableDataSource,
 )
+from sentinel_tpu.datasource.redis_source import RedisDataSource
 
 __all__ = [
     "AbstractDataSource",
+    "RedisDataSource",
     "AutoRefreshDataSource",
     "Converter",
     "InMemoryDataSource",

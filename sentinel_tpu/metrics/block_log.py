@@ -29,6 +29,28 @@ FILE_NAME = "sentinel-block.log"
 # (resource, exception_name, rule_limit_app, origin)
 BlockKey = Tuple[str, str, str, str]
 
+_live_loggers: "weakref.WeakSet[BlockLogger]" = None  # type: ignore[assignment]
+
+
+def _init_atexit() -> None:
+    global _live_loggers
+    import atexit
+    import weakref
+
+    _live_loggers = weakref.WeakSet()
+
+    def _flush_all() -> None:
+        for logger in list(_live_loggers):
+            try:
+                logger.flush()
+            except Exception:
+                pass
+
+    atexit.register(_flush_all)
+
+
+_init_atexit()
+
 
 class BlockLogger:
     """Per-second aggregated block log with size-rolled output."""
@@ -58,10 +80,10 @@ class BlockLogger:
         self._cur_sec: Optional[int] = None  # wall-ms aligned interval start
         self._entries: Dict[BlockKey, int] = {}
         # The last partial interval must survive process exit — an
-        # operator investigating an incident reads this file.
-        import atexit
-
-        atexit.register(self.flush)
+        # operator investigating an incident reads this file. One
+        # process-level hook over a weak set: discarded loggers are
+        # collectable, not pinned by the atexit registry.
+        _live_loggers.add(self)
 
     # ------------------------------------------------------------------
     def log(

@@ -91,14 +91,25 @@ class MetricExtensionProvider:
     # ------------------------------------------------------------------
     # Batched dispatch helpers (called by the engine; one guard per
     # extension so one misbehaving extension cannot starve the rest).
+    @staticmethod
+    def _call(fn, *args) -> None:
+        # Each callback is guarded independently: one throwing method
+        # must not suppress the extension's other deliveries (e.g. a
+        # failing add_rt skipping decrease_thread_num would drift the
+        # extension's concurrency gauge forever).
+        try:
+            fn(*args)
+        except Exception:
+            record_log.error(
+                "[MetricExtension] %s failed", getattr(fn, "__name__", fn),
+                exc_info=True,
+            )
+
     @classmethod
     def on_pass(cls, resource: str, n: int, args: Sequence[object]) -> None:
         for ext in cls.get_extensions():
-            try:
-                ext.add_pass(resource, n, *args)
-                ext.increase_thread_num(resource, *args)
-            except Exception:
-                record_log.error("[MetricExtension] add_pass failed", exc_info=True)
+            cls._call(ext.add_pass, resource, n, *args)
+            cls._call(ext.increase_thread_num, resource, *args)
 
     @classmethod
     def on_blocked(
@@ -106,19 +117,13 @@ class MetricExtensionProvider:
         args: Sequence[object],
     ) -> None:
         for ext in cls.get_extensions():
-            try:
-                ext.add_block(resource, n, origin, block_error, *args)
-            except Exception:
-                record_log.error("[MetricExtension] add_block failed", exc_info=True)
+            cls._call(ext.add_block, resource, n, origin, block_error, *args)
 
     @classmethod
     def on_complete(cls, resource: str, rt_ms: int, n: int, err: int) -> None:
         for ext in cls.get_extensions():
-            try:
-                ext.add_rt(resource, rt_ms)
-                ext.add_success(resource, n)
-                if err:
-                    ext.add_exception(resource, err, None)
-                ext.decrease_thread_num(resource)
-            except Exception:
-                record_log.error("[MetricExtension] on_complete failed", exc_info=True)
+            cls._call(ext.add_rt, resource, rt_ms)
+            cls._call(ext.add_success, resource, n)
+            if err:
+                cls._call(ext.add_exception, resource, err, None)
+            cls._call(ext.decrease_thread_num, resource)

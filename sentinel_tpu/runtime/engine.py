@@ -217,31 +217,35 @@ class Engine:
         """
         from sentinel_tpu.parallel import make_mesh, make_sharded_flush
 
-        with self._flush_lock:
-            drained = self._flush_locked()
-            with self._lock:
-                n = n_devices if n_devices is not None else len(jax.devices())
-                if n < 1 or (n & (n - 1)) != 0:
-                    raise ValueError(
-                        f"mesh size must be a power of two, got {n}"
+        drained = ([], [])
+        try:
+            with self._flush_lock:
+                drained = self._flush_locked()
+                with self._lock:
+                    n = n_devices if n_devices is not None else len(jax.devices())
+                    if n < 1 or (n & (n - 1)) != 0:
+                        raise ValueError(
+                            f"mesh size must be a power of two, got {n}"
+                        )
+                    self._validate_mesh_rules(self.flow_index, self.param_index)
+                    self.mesh = make_mesh(n)
+                    self._n_shards = n
+                    self._sharded_fn = make_sharded_flush(
+                        self.mesh, occupy_timeout_ms=config.occupy_timeout_ms
                     )
-                self._validate_mesh_rules(self.flow_index, self.param_index)
-                self.mesh = make_mesh(n)
-                self._n_shards = n
-                self._sharded_fn = make_sharded_flush(
-                    self.mesh, occupy_timeout_ms=config.occupy_timeout_ms
-                )
-
-        self._release_blocked_tokens(drained)
+        finally:
+            self._post_flush(drained)
     def disable_mesh(self) -> None:
-        with self._flush_lock:
-            drained = self._flush_locked()
-            with self._lock:
-                self.mesh = None
-                self._sharded_fn = None
-                self._n_shards = 1
-
-        self._release_blocked_tokens(drained)
+        drained = ([], [])
+        try:
+            with self._flush_lock:
+                drained = self._flush_locked()
+                with self._lock:
+                    self.mesh = None
+                    self._sharded_fn = None
+                    self._n_shards = 1
+        finally:
+            self._post_flush(drained)
     @staticmethod
     def _validate_mesh_rules(findex: FlowIndex, pindex: ParamIndex) -> None:
         if findex.shaping_gids:
@@ -262,60 +266,70 @@ class Engine:
     # rule plumbing (called by rule managers)
     # ------------------------------------------------------------------
     def set_flow_rules(self, rules: Sequence[FlowRule]) -> None:
-        with self._flush_lock:
-            drained = self._flush_locked()  # decisions for pending ops use the old rules
-            with self._lock:
-                findex = FlowIndex(rules, cold_factor=config.cold_factor)
-                if self.mesh is not None:
-                    self._validate_mesh_rules(findex, self.param_index)
-                self.flow_index = findex
-                self.flow_dyn = findex.make_dyn_state()
-
-        self._release_blocked_tokens(drained)
+        drained = ([], [])
+        try:
+            with self._flush_lock:
+                drained = self._flush_locked()  # decisions for pending ops use the old rules
+                with self._lock:
+                    findex = FlowIndex(rules, cold_factor=config.cold_factor)
+                    if self.mesh is not None:
+                        self._validate_mesh_rules(findex, self.param_index)
+                    self.flow_index = findex
+                    self.flow_dyn = findex.make_dyn_state()
+        finally:
+            self._post_flush(drained)
     def set_degrade_rules(self, rules: Sequence[DegradeRule]) -> None:
         """Breaker state is NOT carried across reloads — the reference
         builds fresh CircuitBreaker objects per load (DegradeRuleManager)."""
-        with self._flush_lock:
-            drained = self._flush_locked()
-            with self._lock:
-                self.degrade_index = DegradeIndex(rules)
-                self.degrade_dyn = self.degrade_index.make_dyn_state()
-
-        self._release_blocked_tokens(drained)
+        drained = ([], [])
+        try:
+            with self._flush_lock:
+                drained = self._flush_locked()
+                with self._lock:
+                    self.degrade_index = DegradeIndex(rules)
+                    self.degrade_dyn = self.degrade_index.make_dyn_state()
+        finally:
+            self._post_flush(drained)
     def set_param_rules(self, by_resource: Dict[str, List[ParamFlowRule]]) -> None:
         """Param caches are rebuilt on reload, like
         ParamFlowRuleManager clearing ParameterMetric for changed rules."""
-        with self._flush_lock:
-            drained = self._flush_locked()
-            with self._lock:
-                pindex = ParamIndex(by_resource)
-                if self.mesh is not None:
-                    self._validate_mesh_rules(self.flow_index, pindex)
-                self.param_index = pindex
-                self.param_dyn = make_param_state(8)
-
-        self._release_blocked_tokens(drained)
+        drained = ([], [])
+        try:
+            with self._flush_lock:
+                drained = self._flush_locked()
+                with self._lock:
+                    pindex = ParamIndex(by_resource)
+                    if self.mesh is not None:
+                        self._validate_mesh_rules(self.flow_index, pindex)
+                    self.param_index = pindex
+                    self.param_dyn = make_param_state(8)
+        finally:
+            self._post_flush(drained)
     def set_system_config(self, cfg) -> None:
-        with self._flush_lock:
-            drained = self._flush_locked()
-            with self._lock:
-                self.system_config = (
-                    cfg if cfg is not None and cfg.any_enabled else None
-                )
-                if self.system_config is not None and (
-                    self.system_config.highest_system_load >= 0
-                    or self.system_config.highest_cpu_usage >= 0
-                ):
-                    system_sampler.start()
-
-        self._release_blocked_tokens(drained)
+        drained = ([], [])
+        try:
+            with self._flush_lock:
+                drained = self._flush_locked()
+                with self._lock:
+                    self.system_config = (
+                        cfg if cfg is not None and cfg.any_enabled else None
+                    )
+                    if self.system_config is not None and (
+                        self.system_config.highest_system_load >= 0
+                        or self.system_config.highest_cpu_usage >= 0
+                    ):
+                        system_sampler.start()
+        finally:
+            self._post_flush(drained)
     def set_authority_rules(self, by_resource: Dict[str, AuthorityRule]) -> None:
-        with self._flush_lock:
-            drained = self._flush_locked()
-            with self._lock:
-                self.authority_rules = dict(by_resource)
-
-        self._release_blocked_tokens(drained)
+        drained = ([], [])
+        try:
+            with self._flush_lock:
+                drained = self._flush_locked()
+                with self._lock:
+                    self.authority_rules = dict(by_resource)
+        finally:
+            self._post_flush(drained)
     def _system_device(self) -> SystemDevice:
         cfg = self.system_config
         inf = float("inf")
@@ -755,18 +769,21 @@ class Engine:
         already filled (the other flush cannot release the lock before
         filling them).
         """
-        with self._flush_lock:
-            entries = self._flush_locked()
-        self._release_blocked_tokens(entries)
-        return entries
+        drained = ([], [])
+        try:
+            with self._flush_lock:
+                drained = self._flush_locked()
+        finally:
+            self._post_flush(drained)
+        return drained[0]
 
-    def _flush_locked(self) -> List[_EntryOp]:
+    def _flush_locked(self) -> Tuple[List[_EntryOp], List[tuple]]:
         with self._lock:
             self._maybe_rebase()
             entries, self._entries = self._entries, []
             exits, self._exits = self._exits, []
             if not entries and not exits:
-                return []
+                return [], []
             self._ensure_capacity()
             findex = self.flow_index
             dindex = self.degrade_index
@@ -816,8 +833,9 @@ class Engine:
         # One kernel launch per max_batch slice: bounds device memory
         # for the padded batch regardless of how much queued up.
         mb = max(self.max_batch, 1)
+        blocked_items: List[tuple] = []
         for off in range(0, max(len(entries), len(exits)), mb):
-            self._run_chunk(
+            blocked_items += self._run_chunk(
                 entries[off : off + mb],
                 exits[off : off + mb],
                 findex,
@@ -825,14 +843,19 @@ class Engine:
                 pindex,
                 auth_rules,
             )
-        return entries
+        return entries, blocked_items
 
-    @staticmethod
-    def _release_blocked_tokens(entries: List[_EntryOp]) -> None:
-        """Hand back concurrency tokens of entries that were ultimately
-        blocked (the reference's releaseConcurrentToken on abort). Runs
-        OUTSIDE the flush lock — over the wire each release is an RPC
-        that must not stall concurrent flush()/entry_sync callers."""
+    def _post_flush(self, drained: Tuple[List[_EntryOp], List[tuple]]) -> None:
+        """Work that must happen after a flush but OUTSIDE the flush
+        lock (disk IO and release RPCs must not stall concurrent
+        flush()/entry_sync callers): write the flush's blocked verdicts
+        to the block log, and hand back concurrency tokens of entries
+        that were ultimately blocked (the reference's
+        releaseConcurrentToken on abort)."""
+        entries, blocked_items = drained
+        if blocked_items:
+            self.block_log.log_batch(blocked_items)
+        self.block_log.maybe_flush()
         for op in entries:
             if op.cluster_tokens and op.verdict is not None and not op.verdict.admitted:
                 release_cluster_tokens(op.cluster_tokens)
@@ -846,8 +869,10 @@ class Engine:
         dindex: DegradeIndex,
         pindex: ParamIndex,
         auth_rules: Dict[str, AuthorityRule],
-    ) -> None:
-        """Encode one chunk, run the kernel, fill verdicts. Runs under
+    ) -> List[tuple]:
+        """Encode one chunk, run the kernel, fill verdicts; returns the
+        chunk's blocked-verdict block-log items (file IO happens outside
+        the flush lock, in _post_flush). Runs under
         the flush lock only — the indexes are the snapshot taken when
         the pending buffers were swapped; _flush_locked re-resolved any
         op whose submit-time tables were superseded by a reload."""
@@ -1035,13 +1060,11 @@ class Engine:
                     MetricExtensionProvider.on_blocked(
                         op.resource, op.acquire, op.origin, err, op.args
                     )
-        if blocked_items:
-            self.block_log.log_batch(blocked_items)
-        self.block_log.maybe_flush()
         if exts:
             for x in exits:
                 if x.resource is not None and x.thr < 0:
                     MetricExtensionProvider.on_complete(x.resource, x.rt, x.count, x.err)
+        return blocked_items
 
     def _encode_shaping(
         self, entries: List[_EntryOp], k: int, findex: FlowIndex
@@ -1114,16 +1137,44 @@ class Engine:
         with self._flush_lock:
             return self._row_stats_locked(row, now)
 
-    def _row_stats_locked(self, row: int, now: Optional[int] = None) -> Dict[str, float]:
+    def _all_stats_arrays(self, now: Optional[int] = None):
+        """One device round-trip for every row's windowed stats —
+        readers that touch many rows (a Prometheus scrape, the metric
+        timer) must not pay a full-tensor reduction per row."""
         from sentinel_tpu.metrics.nodes import occupied_in_window, waiting_tokens
 
         now_i = jnp.int32(self.clock.now_ms() if now is None else now)
-        sec = np.asarray(ma.window_sums(SECOND_CFG, self.stats.second, now_i)[row])
-        minute = np.asarray(ma.window_sums(MINUTE_CFG, self.stats.minute, now_i)[row])
-        min_rt = int(np.asarray(ma.window_min_rt(SECOND_CFG, self.stats.second, now_i)[row]))
-        threads = int(np.asarray(self.stats.threads[row]))
-        occ_cur = int(np.asarray(occupied_in_window(self.stats, now_i)[row]))
-        waiting = int(np.asarray(waiting_tokens(self.stats, now_i)[row]))
+        return jax.device_get(
+            (
+                ma.window_sums(SECOND_CFG, self.stats.second, now_i),
+                ma.window_sums(MINUTE_CFG, self.stats.minute, now_i),
+                ma.window_min_rt(SECOND_CFG, self.stats.second, now_i),
+                self.stats.threads,
+                occupied_in_window(self.stats, now_i),
+                waiting_tokens(self.stats, now_i),
+            )
+        )
+
+    def rows_stats(
+        self, rows: Sequence[int], now: Optional[int] = None
+    ) -> Dict[int, Dict[str, float]]:
+        """Stats dicts for many rows with one batched device read."""
+        with self._flush_lock:
+            arrays = self._all_stats_arrays(now)
+        return {row: self._stats_from_arrays(arrays, row) for row in rows}
+
+    def _row_stats_locked(self, row: int, now: Optional[int] = None) -> Dict[str, float]:
+        return self._stats_from_arrays(self._all_stats_arrays(now), row)
+
+    @staticmethod
+    def _stats_from_arrays(arrays, row: int) -> Dict[str, float]:
+        sec_all, minute_all, min_rt_all, threads_all, occ_all, wait_all = arrays
+        sec = np.asarray(sec_all[row])
+        minute = np.asarray(minute_all[row])
+        min_rt = int(min_rt_all[row])
+        threads = int(threads_all[row])
+        occ_cur = int(occ_all[row])
+        waiting = int(wait_all[row])
         interval_sec = SECOND_CFG.interval_ms / 1000.0
         success = int(sec[MetricEvent.SUCCESS])
         rt_sum = int(sec[MetricEvent.RT])

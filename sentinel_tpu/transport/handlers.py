@@ -263,3 +263,12 @@ def cluster_server_config_handler(req: CommandRequest) -> CommandResponse:
             "namespaces": sorted(cfg.namespaces),
         }
     )
+
+
+@command_mapping("metrics", "Prometheus text-format metrics (JMX exporter analog)")
+def prometheus_handler(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.transport.prometheus import render_metrics
+
+    return CommandResponse(
+        True, render_metrics(_engine()), "text/plain; version=0.0.4; charset=utf-8"
+    )

@@ -1,0 +1,64 @@
+"""Prometheus text-format metric exporter.
+
+Reference: sentinel-metric-exporter/.../jmx/JMXMetricExporter.java:31 —
+the reference exports per-resource metric beans over JMX; the Python-
+native analog is a ``/metrics`` endpoint on the command center in the
+Prometheus exposition format (text/plain; version=0.0.4), scraping the
+same per-resource statistics the dashboard pulls.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+_GAUGES: List[Tuple[str, str, str]] = [
+    # (prometheus metric suffix, engine stat key, help text)
+    ("pass_qps", "pass_qps", "Passed requests per second (1s window)"),
+    ("block_qps", "block_qps", "Blocked requests per second (1s window)"),
+    ("success_qps", "success_qps", "Completed requests per second (1s window)"),
+    ("exception_qps", "exception_qps", "Business exceptions per second (1s window)"),
+    ("avg_rt_ms", "avg_rt", "Average response time, ms"),
+    ("min_rt_ms", "min_rt", "Minimum response time in window, ms"),
+    ("cur_thread_num", "cur_thread_num", "In-flight (concurrent) requests"),
+    ("waiting_requests", "waiting", "Tokens borrowed for future windows (occupy)"),
+    ("pass_total_minute", "total_pass_minute", "Passed requests, last 60s"),
+    ("block_total_minute", "total_block_minute", "Blocked requests, last 60s"),
+    ("success_total_minute", "total_success_minute", "Completed requests, last 60s"),
+    ("exception_total_minute", "total_exception_minute", "Exceptions, last 60s"),
+]
+
+_PREFIX = "sentinel"
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_metrics(engine) -> str:
+    """All resources' stats in the Prometheus exposition format."""
+    engine.flush()
+    resources = engine.nodes.resources()
+    all_rows = [row for _, row in resources] + [engine.nodes.entry_node_row]
+    by_row = engine.rows_stats(all_rows)  # one batched device read
+    rows: Dict[str, Dict[str, float]] = {
+        resource: by_row[row] for resource, row in resources
+    }
+    entry_stats = by_row[engine.nodes.entry_node_row]
+
+    out: List[str] = []
+    for suffix, key, help_text in _GAUGES:
+        name = f"{_PREFIX}_{suffix}"
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} gauge")
+        for resource, stats in sorted(rows.items()):
+            v = stats.get(key, 0)
+            out.append(f'{name}{{resource="{_escape_label(resource)}"}} {v}')
+        out.append(f'{name}{{resource="__total_inbound_traffic__"}} {entry_stats.get(key, 0)}')
+    # Engine gauges.
+    out.append(f"# HELP {_PREFIX}_engine_enabled Global protection switch (1 on)")
+    out.append(f"# TYPE {_PREFIX}_engine_enabled gauge")
+    out.append(f"{_PREFIX}_engine_enabled {1 if engine.enabled else 0}")
+    out.append(f"# HELP {_PREFIX}_resources Known protected resources")
+    out.append(f"# TYPE {_PREFIX}_resources gauge")
+    out.append(f"{_PREFIX}_resources {len(rows)}")
+    return "\n".join(out) + "\n"

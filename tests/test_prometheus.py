@@ -1,0 +1,55 @@
+"""Prometheus exporter: /metrics on the command center exposes
+per-resource pass/block/rt/thread gauges in the exposition format
+(the JMXMetricExporter analog, reference:
+sentinel-metric-exporter/.../jmx/JMXMetricExporter.java:31).
+"""
+
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.transport.command_center import CommandCenter
+from sentinel_tpu.transport.prometheus import render_metrics
+
+
+class TestRenderMetrics:
+    def test_gauges_per_resource(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("api", count=2)])
+        manual_clock.set_ms(100)
+        e = st.entry("api")
+        st.entry("api")
+        assert st.try_entry("api") is None
+        manual_clock.set_ms(150)
+        e.exit()
+        text = render_metrics(engine)
+        assert '# TYPE sentinel_pass_qps gauge' in text
+        assert 'sentinel_pass_qps{resource="api"} 2.0' in text
+        assert 'sentinel_block_qps{resource="api"} 1.0' in text
+        assert 'sentinel_cur_thread_num{resource="api"} 1' in text
+        assert 'sentinel_block_total_minute{resource="api"} 1' in text
+        assert "sentinel_engine_enabled 1" in text
+        assert "sentinel_resources 1" in text
+
+    def test_label_escaping(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule('we"ird', count=5)])
+        st.entry('we"ird')
+        text = render_metrics(engine)
+        assert 'resource="we\\"ird"' in text
+
+
+class TestMetricsEndpoint:
+    def test_scrape_over_http(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("api", count=10)])
+        st.entry("api")
+        center = CommandCenter(port=0).start()
+        try:
+            url = f"http://127.0.0.1:{center.port}/metrics"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                assert resp.status == 200
+                ctype = resp.headers.get("Content-Type", "")
+                assert ctype.startswith("text/plain")
+                body = resp.read().decode()
+            assert 'sentinel_pass_qps{resource="api"} 1.0' in body
+        finally:
+            center.stop()
