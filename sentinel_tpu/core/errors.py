@@ -85,6 +85,21 @@ BLOCK_DEGRADE = 2
 BLOCK_SYSTEM = 3
 BLOCK_AUTHORITY = 4
 BLOCK_PARAM = 5
+# Host-side custom slot veto (never appears in device tensors; the
+# engine attributes it when a registered ProcessorSlot blocked the op).
+BLOCK_CUSTOM = 6
+
+
+class CustomBlockError(BlockError):
+    """A registered custom slot vetoed the entry (the analog of a
+    user slot's BlockException subclass in an SPI-assembled chain)."""
+
+    def __init__(self, resource: str, slot_name: str = "") -> None:
+        super().__init__(resource)
+        self.slot_name = slot_name
+
+    def __str__(self) -> str:
+        return f"CustomBlockError(resource={self.resource!r}, slot={self.slot_name!r})"
 
 _ERROR_BY_CODE = {
     BLOCK_FLOW: FlowBlockError,

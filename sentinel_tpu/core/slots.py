@@ -1,0 +1,130 @@
+"""Pluggable processor slots — the custom half of the slot chain.
+
+Reference: the slot chain is SPI-assembled
+(slots/DefaultSlotChainBuilder.java:36-57 + META-INF/services), so a
+user can insert a ProcessorSlot anywhere by order. In the batched
+design the eight built-in slots are fused into the device kernel
+(runtime/flush.py phases) — an arbitrary user slot cannot run between
+kernel phases, but the chain is still open at the host boundary:
+
+* a registered :class:`ProcessorSlot`'s ``entry`` runs for every entry
+  op at flush time BEFORE the device chain (the position of a
+  first-in-chain custom slot); returning a veto blocks the entry with
+  full attribution (``CustomBlockError`` carrying the slot name) and
+  the block is accounted in the windows like any other;
+* ``exit`` runs for every completed invocation in the flush that
+  processes its exit op (the chain's exit traversal).
+
+Slots run on the flushing thread under the flush lock, like the
+reference's slots run inline on the request thread — keep them fast.
+Ordering between custom slots follows ``order`` ascending (negative =
+earlier), mirroring @Spi(order).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+from sentinel_tpu.utils.record_log import record_log
+
+
+class SlotEntryContext(NamedTuple):
+    """What a custom slot sees for one entry op (the host-side view of
+    (context, resourceWrapper, count, args))."""
+
+    resource: str
+    context_name: str
+    origin: str
+    acquire: int
+    prio: bool
+    args: Tuple[object, ...]
+
+
+class ProcessorSlot:
+    """Subclass and register via :class:`SlotChainRegistry` (or the
+    ``ProcessorSlot`` entry-point group)."""
+
+    name: str = ""
+    order: int = 0
+
+    def entry(self, ctx: SlotEntryContext) -> Optional[object]:
+        """Return None to pass; any other value vetoes the entry (the
+        value is attached to the verdict as ``blocked_rule``)."""
+        return None
+
+    def exit(self, resource: str, rt_ms: int, count: int, err: int) -> None:
+        """Invocation completed (exit traversal)."""
+
+
+class SlotChainRegistry:
+    """Host-side DefaultSlotChainBuilder: explicit registration plus
+    entry-point SPI discovery, sorted by ``order``."""
+
+    _lock = threading.Lock()
+    _slots: List[ProcessorSlot] = []
+    _spi_loaded = False
+
+    @classmethod
+    def slots(cls) -> Sequence[ProcessorSlot]:
+        if not cls._spi_loaded:
+            cls._load_spi()
+        return cls._slots
+
+    @classmethod
+    def _load_spi(cls) -> None:
+        with cls._lock:
+            if cls._spi_loaded:
+                return
+            cls._spi_loaded = True
+            try:
+                from sentinel_tpu.utils.registry import Registry
+
+                for slot in Registry.of("ProcessorSlot").load_instance_list_sorted():
+                    cls._slots.append(slot)
+                cls._slots.sort(key=lambda s: s.order)
+            except Exception:
+                record_log.error("[SlotChain] SPI load failed", exc_info=True)
+
+    @classmethod
+    def register(cls, slot: ProcessorSlot) -> None:
+        with cls._lock:
+            cls._slots.append(slot)
+            cls._slots.sort(key=lambda s: s.order)
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._slots.clear()
+            cls._spi_loaded = False
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def check_entry(cls, ctx: SlotEntryContext):
+        """Run all slots' entry checks in order; first veto wins.
+        Returns (slot, veto) or None. A raising slot is skipped (fail
+        open, like an unexpected non-Block exception in the chain —
+        LogSlot.java:26-28 logs and continues)."""
+        for slot in cls.slots():
+            try:
+                veto = slot.entry(ctx)
+            except Exception:
+                record_log.error(
+                    "[SlotChain] slot %s entry failed", slot.name or type(slot).__name__,
+                    exc_info=True,
+                )
+                continue
+            if veto is not None:
+                return slot, veto
+        return None
+
+    @classmethod
+    def on_exit(cls, resource: str, rt_ms: int, count: int, err: int) -> None:
+        for slot in cls.slots():
+            try:
+                slot.exit(resource, rt_ms, count, err)
+            except Exception:
+                record_log.error(
+                    "[SlotChain] slot %s exit failed", slot.name or type(slot).__name__,
+                    exc_info=True,
+                )

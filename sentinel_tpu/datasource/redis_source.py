@@ -158,7 +158,6 @@ class RedisDataSource(PushDataSource[S, T]):
         return self
 
     def _subscribe_loop(self) -> None:
-        first = True
         while not self._stop.is_set():
             try:
                 conn = RespConnection(self.host, self.port)
@@ -169,11 +168,11 @@ class RedisDataSource(PushDataSource[S, T]):
                 ack = conn.read_reply()  # [b'subscribe', channel, n]
                 if not (isinstance(ack, list) and len(ack) == 3):
                     raise RespError(f"unexpected SUBSCRIBE ack {ack!r}")
-                if not first:
-                    # Publishes during the outage are gone (pub/sub has
-                    # no replay): re-read the key to catch up.
-                    self.on_update(self.read_source())
-                first = False
+                # Publishes before this SUBSCRIBE took effect are gone
+                # (pub/sub has no replay) — both at startup (between the
+                # initial GET and here) and across reconnects: re-read
+                # the key after EVERY subscribe ack to catch up.
+                self.on_update(self.read_source())
                 while not self._stop.is_set():
                     msg = conn.read_reply()
                     if (

@@ -85,6 +85,7 @@ class Verdict(NamedTuple):
     wait_ms: int
     blocked_rule: Optional[object]  # the rule bean that blocked, if attributable
     limit_type: str = ""  # system block dimension (qps/thread/rt/load/cpu)
+    slot_name: str = ""  # custom slot that vetoed (reason BLOCK_CUSTOM)
 
 
 @dataclass
@@ -109,6 +110,8 @@ class _EntryOp:
     # local slots, but must keep fallback-to-local slots. Keyed by
     # flow_id, which is stable across reloads (gids are not).
     token_decided_flow_ids: frozenset = frozenset()
+    # (slot, veto) when a registered custom ProcessorSlot vetoed this op.
+    custom_veto: Optional[Tuple[object, object]] = None
     # Resolution context: which index objects the gids/rows above came
     # from, plus what is needed to re-resolve if a rule reload swapped
     # the tables between submit and flush (see _flush_locked).
@@ -146,6 +149,7 @@ _BLOCK_EXC_NAMES = {
     E.BLOCK_SYSTEM: "SystemBlockException",
     E.BLOCK_AUTHORITY: "AuthorityException",
     E.BLOCK_PARAM: "ParamFlowException",
+    E.BLOCK_CUSTOM: "CustomBlockException",
 }
 
 
@@ -220,7 +224,7 @@ class Engine:
         drained = ([], [])
         try:
             with self._flush_lock:
-                drained = self._flush_locked()
+                self._flush_locked(drained)
                 with self._lock:
                     n = n_devices if n_devices is not None else len(jax.devices())
                     if n < 1 or (n & (n - 1)) != 0:
@@ -239,7 +243,7 @@ class Engine:
         drained = ([], [])
         try:
             with self._flush_lock:
-                drained = self._flush_locked()
+                self._flush_locked(drained)
                 with self._lock:
                     self.mesh = None
                     self._sharded_fn = None
@@ -269,7 +273,7 @@ class Engine:
         drained = ([], [])
         try:
             with self._flush_lock:
-                drained = self._flush_locked()  # decisions for pending ops use the old rules
+                self._flush_locked(drained)  # decisions for pending ops use the old rules
                 with self._lock:
                     findex = FlowIndex(rules, cold_factor=config.cold_factor)
                     if self.mesh is not None:
@@ -284,7 +288,7 @@ class Engine:
         drained = ([], [])
         try:
             with self._flush_lock:
-                drained = self._flush_locked()
+                self._flush_locked(drained)
                 with self._lock:
                     self.degrade_index = DegradeIndex(rules)
                     self.degrade_dyn = self.degrade_index.make_dyn_state()
@@ -296,7 +300,7 @@ class Engine:
         drained = ([], [])
         try:
             with self._flush_lock:
-                drained = self._flush_locked()
+                self._flush_locked(drained)
                 with self._lock:
                     pindex = ParamIndex(by_resource)
                     if self.mesh is not None:
@@ -309,7 +313,7 @@ class Engine:
         drained = ([], [])
         try:
             with self._flush_lock:
-                drained = self._flush_locked()
+                self._flush_locked(drained)
                 with self._lock:
                     self.system_config = (
                         cfg if cfg is not None and cfg.any_enabled else None
@@ -325,7 +329,7 @@ class Engine:
         drained = ([], [])
         try:
             with self._flush_lock:
-                drained = self._flush_locked()
+                self._flush_locked(drained)
                 with self._lock:
                     self.authority_rules = dict(by_resource)
         finally:
@@ -769,21 +773,26 @@ class Engine:
         already filled (the other flush cannot release the lock before
         filling them).
         """
-        drained = ([], [])
+        drained: Tuple[List[_EntryOp], List[tuple]] = ([], [])
         try:
             with self._flush_lock:
-                drained = self._flush_locked()
+                self._flush_locked(drained)
         finally:
             self._post_flush(drained)
         return drained[0]
 
-    def _flush_locked(self) -> Tuple[List[_EntryOp], List[tuple]]:
+    def _flush_locked(self, out: Optional[Tuple[List[_EntryOp], List[tuple]]] = None) -> Tuple[List[_EntryOp], List[tuple]]:
+        """Drain + process pending ops. ``out`` (entries, blocked_items)
+        is filled IN PLACE chunk by chunk so the caller's finally still
+        delivers completed chunks' block-log records and token releases
+        if a later chunk's kernel raises."""
+        out = out if out is not None else ([], [])
         with self._lock:
             self._maybe_rebase()
             entries, self._entries = self._entries, []
             exits, self._exits = self._exits, []
             if not entries and not exits:
-                return [], []
+                return out
             self._ensure_capacity()
             findex = self.flow_index
             dindex = self.degrade_index
@@ -833,17 +842,19 @@ class Engine:
         # One kernel launch per max_batch slice: bounds device memory
         # for the padded batch regardless of how much queued up.
         mb = max(self.max_batch, 1)
-        blocked_items: List[tuple] = []
         for off in range(0, max(len(entries), len(exits)), mb):
-            blocked_items += self._run_chunk(
-                entries[off : off + mb],
+            e_chunk = entries[off : off + mb]
+            items = self._run_chunk(
+                e_chunk,
                 exits[off : off + mb],
                 findex,
                 dindex,
                 pindex,
                 auth_rules,
             )
-        return entries, blocked_items
+            out[0].extend(e_chunk)
+            out[1].extend(items)
+        return out
 
     def _post_flush(self, drained: Tuple[List[_EntryOp], List[tuple]]) -> None:
         """Work that must happen after a flush but OUTSIDE the flush
@@ -876,6 +887,22 @@ class Engine:
         the flush lock only — the indexes are the snapshot taken when
         the pending buffers were swapped; _flush_locked re-resolved any
         op whose submit-time tables were superseded by a reload."""
+        # ---- custom processor slots (SPI-assembled chain head) ----
+        # A registered slot's veto blocks the entry before every device
+        # stage — accounted like a first-slot BlockException (the block
+        # scatter shares the authority channel; attribution is kept
+        # host-side on the op).
+        from sentinel_tpu.core.slots import SlotChainRegistry, SlotEntryContext
+
+        if SlotChainRegistry.slots():
+            for op in entries:
+                if op.custom_veto is None:
+                    op.custom_veto = SlotChainRegistry.check_entry(
+                        SlotEntryContext(
+                            op.resource, op.context_name, op.origin,
+                            op.acquire, op.prio, op.args,
+                        )
+                    )
         # Pow2 padding is shard-divisible on any power-of-two mesh once
         # raised to at least n_shards (enable_mesh enforces pow2).
         n = max(_pad_pow2(len(entries), 8), self._n_shards)
@@ -911,7 +938,7 @@ class Engine:
             for j, dg in enumerate(op.d_gids[:kd]):
                 e_dgid[i, j] = dg
             e_prio[i] = op.prio
-            e_auth[i] = op.auth_ok
+            e_auth[i] = op.auth_ok and op.custom_veto is None
             e_cluster[i] = op.cluster_blocked_rule is None
 
         x_valid = np.zeros(m, dtype=bool)
@@ -999,9 +1026,15 @@ class Engine:
         for i, op in enumerate(entries):
             blocked_rule = None
             limit_type = ""
+            slot_name = ""
             r = int(reason[i])
             if not admitted[i]:
-                if r == E.BLOCK_AUTHORITY:
+                if op.custom_veto is not None:
+                    slot, veto = op.custom_veto
+                    r = E.BLOCK_CUSTOM
+                    blocked_rule = veto if veto is not True else None
+                    slot_name = getattr(slot, "name", "") or type(slot).__name__
+                elif r == E.BLOCK_AUTHORITY:
                     blocked_rule = auth_rules.get(op.resource)
                 elif r == E.BLOCK_SYSTEM:
                     limit_type = SYS_TYPE_NAMES.get(int(sys_type[i]), "")
@@ -1026,6 +1059,7 @@ class Engine:
                 wait_ms=int(wait_ms[i]),
                 blocked_rule=blocked_rule,
                 limit_type=limit_type,
+                slot_name=slot_name,
             )
 
         # ---- block log + metric-extension callbacks ----
@@ -1054,6 +1088,9 @@ class Engine:
                     # mirrors the reference's BlockException argument).
                     if v.reason == E.BLOCK_SYSTEM:
                         err = E.SystemBlockError(op.resource, v.limit_type)
+                    elif v.reason == E.BLOCK_CUSTOM:
+                        err = E.CustomBlockError(op.resource, v.slot_name)
+                        err.rule = v.blocked_rule
                     else:
                         err = E.error_for_code(v.reason, op.resource)
                         err.rule = v.blocked_rule
@@ -1064,6 +1101,10 @@ class Engine:
             for x in exits:
                 if x.resource is not None and x.thr < 0:
                     MetricExtensionProvider.on_complete(x.resource, x.rt, x.count, x.err)
+        if SlotChainRegistry.slots():
+            for x in exits:
+                if x.resource is not None and x.thr < 0:
+                    SlotChainRegistry.on_exit(x.resource, x.rt, x.count, x.err)
         return blocked_items
 
     def _encode_shaping(

@@ -141,8 +141,10 @@ def tree_handler(req: CommandRequest) -> CommandResponse:
     engine = _engine()
     engine.flush()
     out = []
-    for name, row in [("machine-root", engine.nodes.entry_node_row)] + engine.nodes.resources():
-        s = engine._row_stats(row)
+    pairs = [("machine-root", engine.nodes.entry_node_row)] + engine.nodes.resources()
+    by_row = engine.rows_stats([row for _, row in pairs])
+    for name, row in pairs:
+        s = by_row[row]
         out.append(
             f"{name}: thread={s['cur_thread_num']} pass={s['pass_qps']:.0f} "
             f"block={s['block_qps']:.0f} success={s['success_qps']:.0f} "
@@ -156,8 +158,10 @@ def cluster_node_handler(req: CommandRequest) -> CommandResponse:
     engine = _engine()
     engine.flush()
     out = []
-    for name, row in engine.nodes.resources():
-        s = engine._row_stats(row)
+    pairs = engine.nodes.resources()
+    by_row = engine.rows_stats([row for _, row in pairs])
+    for name, row in pairs:
+        s = by_row[row]
         out.append({"resourceName": name, **{k: float(v) for k, v in s.items()}})
     return CommandResponse.of_json(out)
 
@@ -171,8 +175,10 @@ def origin_handler(req: CommandRequest) -> CommandResponse:
         return CommandResponse.of_failure(f"unknown resource: {resource}")
     engine.flush()
     out = []
-    for origin, row in engine.nodes.origin_rows.get(crow, {}).items():
-        s = engine._row_stats(row)
+    origin_pairs = list(engine.nodes.origin_rows.get(crow, {}).items())
+    by_row = engine.rows_stats([row for _, row in origin_pairs])
+    for origin, row in origin_pairs:
+        s = by_row[row]
         out.append({"origin": origin, **{k: float(v) for k, v in s.items()}})
     return CommandResponse.of_json(out)
 

@@ -1,0 +1,108 @@
+"""Pluggable processor slots: a registered custom slot can veto entries
+ahead of the device chain with full attribution, and observes exits —
+the SPI-assembled chain extension point
+(slots/DefaultSlotChainBuilder.java:36-57 + META-INF/services).
+"""
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core.errors import CustomBlockError
+from sentinel_tpu.core.slots import ProcessorSlot, SlotChainRegistry, SlotEntryContext
+
+
+@pytest.fixture(autouse=True)
+def clean_slots():
+    SlotChainRegistry.clear()
+    yield
+    SlotChainRegistry.clear()
+
+
+class PaywallSlot(ProcessorSlot):
+    """Blocks a named resource unless the first arg is 'paid'."""
+
+    name = "paywall"
+    order = -100
+
+    def __init__(self, protected="premium"):
+        self.protected = protected
+        self.exits = []
+
+    def entry(self, ctx: SlotEntryContext):
+        if ctx.resource == self.protected and (not ctx.args or ctx.args[0] != "paid"):
+            return {"reason": "payment required"}
+        return None
+
+    def exit(self, resource, rt_ms, count, err):
+        self.exits.append((resource, rt_ms, count, err))
+
+
+class TestCustomSlots:
+    def test_veto_blocks_with_attribution(self, manual_clock, engine):
+        slot = PaywallSlot()
+        SlotChainRegistry.register(slot)
+        st.flow_rule_manager.load_rules([st.FlowRule("premium", count=100)])
+        manual_clock.set_ms(100)
+        with pytest.raises(CustomBlockError) as ei:
+            st.entry("premium")
+        assert ei.value.slot_name == "paywall"
+        assert ei.value.rule == {"reason": "payment required"}
+        # Accounted as a block in the windows like any slot's veto.
+        stats = engine.cluster_node_stats("premium")
+        assert stats["block_qps"] == pytest.approx(1.0)
+        assert stats["pass_qps"] == 0.0
+
+    def test_args_admit_and_exit_observed(self, manual_clock, engine):
+        slot = PaywallSlot()
+        SlotChainRegistry.register(slot)
+        manual_clock.set_ms(100)
+        e = st.entry("premium", args=("paid",))
+        manual_clock.set_ms(130)
+        e.exit()
+        engine.flush()
+        assert slot.exits == [("premium", 30, 1, 0)]
+
+    def test_other_resources_unaffected(self, manual_clock, engine):
+        SlotChainRegistry.register(PaywallSlot())
+        assert st.try_entry("free") is not None
+
+    def test_slot_order_first_veto_wins(self, manual_clock, engine):
+        class A(ProcessorSlot):
+            name, order = "a", 10
+
+            def entry(self, ctx):
+                return "a-veto"
+
+        class B(ProcessorSlot):
+            name, order = "b", -10
+
+            def entry(self, ctx):
+                return "b-veto"
+
+        SlotChainRegistry.register(A())
+        SlotChainRegistry.register(B())
+        with pytest.raises(CustomBlockError) as ei:
+            st.entry("x")
+        assert ei.value.slot_name == "b"  # lower order runs first
+
+    def test_raising_slot_fails_open(self, manual_clock, engine):
+        class Broken(ProcessorSlot):
+            name = "broken"
+
+            def entry(self, ctx):
+                raise RuntimeError("slot bug")
+
+        SlotChainRegistry.register(Broken())
+        assert st.try_entry("y") is not None  # fail open, like the chain
+
+    def test_veto_appears_in_block_log(self, manual_clock, engine, tmp_path):
+        from sentinel_tpu.metrics.block_log import BlockLogger
+
+        engine.block_log = BlockLogger(base_dir=str(tmp_path), clock=manual_clock)
+        SlotChainRegistry.register(PaywallSlot())
+        manual_clock.set_ms(100)
+        assert st.try_entry("premium") is None
+        engine.block_log.flush()
+        (_, key, count), = engine.block_log.read_entries()
+        assert key[0] == "premium" and key[1] == "CustomBlockException"
+        assert count == 1

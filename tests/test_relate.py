@@ -1,0 +1,86 @@
+"""RELATE-strategy parity: a rule on resource A whose check node is
+resource B (FlowRuleChecker.selectNodeByRequesterAndStrategy, reference:
+slots/block/flow/FlowRuleChecker.java:96-165 — STRATEGY_RELATE reads the
+ref resource's ClusterNode while accounting stays on A).
+
+Pins the documented intra-batch conservatism (runtime/flush.py module
+docstring): the batched rank math charges earlier same-batch entries'
+acquires on the CHECK node, so same-flush RELATE entries under-admit
+relative to the sequential reference — never over-admit. Flush-per-entry
+sequences match the oracle exactly.
+"""
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.models import constants as C
+
+
+def _relate_rule(count):
+    return st.FlowRule(
+        "A", count=count, strategy=C.STRATEGY_RELATE, ref_resource="B"
+    )
+
+
+class TestRelateSequential:
+    def test_checks_ref_resource_stats(self, manual_clock, engine):
+        """Oracle semantics, one flush per entry: A admits while B's
+        passQps stays under the rule count; A's own passes never charge
+        the check node."""
+        st.flow_rule_manager.load_rules([_relate_rule(5)])
+        manual_clock.set_ms(100)
+        for _ in range(3):
+            assert st.try_entry("B") is not None  # B unthrottled, counted
+        # Sequential A entries: each check sees B's passQps == 3
+        # (3 + 1 <= 5), and A's accounting never bumps B — like the
+        # reference, ALL sequential A entries are admitted.
+        for _ in range(10):
+            assert st.try_entry("A") is not None
+        stats_b = engine.cluster_node_stats("B")
+        assert stats_b["pass_qps"] == pytest.approx(3.0)  # untouched by A
+        stats_a = engine.cluster_node_stats("A")
+        assert stats_a["total_pass_minute"] == 10
+
+    def test_blocks_when_ref_over_count(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([_relate_rule(2)])
+        manual_clock.set_ms(100)
+        for _ in range(2):
+            st.try_entry("B")
+        assert st.try_entry("A") is None  # 2 + 1 > 2
+        # B's window expires -> A admits again.
+        manual_clock.set_ms(1500)
+        assert st.try_entry("A") is not None
+
+
+class TestRelateBatchedConservatism:
+    def test_same_batch_under_admits_never_over(self, manual_clock, engine):
+        """One flush with 10 A entries: the kernel charges each A entry's
+        acquire to B's row for later entries in the batch, admitting
+        exactly count − pass(B) = 2 where the sequential reference admits
+        all 10. Pinned: the deviation is one-sided (under, never over)
+        and exactly the remaining headroom on the check node."""
+        st.flow_rule_manager.load_rules([_relate_rule(5)])
+        manual_clock.set_ms(100)
+        for _ in range(3):
+            st.try_entry("B")
+        now = engine.clock.now_ms()
+        ops = engine.submit_many([{"resource": "A", "ts": now} for _ in range(10)])
+        engine.flush()
+        admitted = [op.verdict.admitted for op in ops]
+        assert sum(admitted) == 2  # count(5) - pass_B(3)
+        assert admitted == [True, True] + [False] * 8  # prefix, ts order
+        # Never over: bound holds for any batch size.
+        assert sum(admitted) <= 10
+
+    def test_direct_rules_in_same_batch_stay_exact(self, manual_clock, engine):
+        """The conservatism is scoped to cross-resource topologies: a
+        plain DIRECT rule in the same flush keeps exact prefix
+        semantics."""
+        st.flow_rule_manager.load_rules(
+            [_relate_rule(5), st.FlowRule("D", count=4)]
+        )
+        manual_clock.set_ms(100)
+        now = engine.clock.now_ms()
+        ops = engine.submit_many([{"resource": "D", "ts": now} for _ in range(10)])
+        engine.flush()
+        assert sum(op.verdict.admitted for op in ops) == 4
