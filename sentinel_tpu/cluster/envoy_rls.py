@@ -1,0 +1,353 @@
+"""Envoy Rate Limit Service (RLS) gRPC front-end over the token service.
+
+Reference: sentinel-cluster-server-envoy-rls —
+SentinelEnvoyRlsServiceImpl.shouldRateLimit (checks each descriptor
+against a cluster flow rule and answers OK / OVER_LIMIT; a descriptor
+with no rule passes), EnvoySentinelRuleConverter (rule key =
+``domain|k|v|k|v...``, flowId hashed from the key, GLOBAL threshold,
+1-bucket sampling, no local fallback) and SentinelRlsGrpcServer.
+
+The wire layer speaks Envoy's ``ratelimit.v2`` protobuf messages with a
+hand-rolled codec (the schemas are tiny and stable; generated stubs
+would need the Envoy proto tree):
+
+    RateLimitRequest  { string domain = 1;
+                        repeated RateLimitDescriptor descriptors = 2;
+                        uint32 hits_addend = 3; }
+    RateLimitDescriptor { repeated Entry entries = 1; }
+    Entry             { string key = 1; string value = 2; }
+    RateLimitResponse { Code overall_code = 1;   // OK=1 OVER_LIMIT=2
+                        repeated DescriptorStatus statuses = 2; }
+    DescriptorStatus  { Code code = 1; RateLimit current_limit = 2;
+                        uint32 limit_remaining = 3; }
+    RateLimit         { uint32 requests_per_unit = 1; Unit unit = 2; }
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import ClusterFlowConfig, FlowRule
+from sentinel_tpu.utils.record_log import record_log
+
+SEPARATOR = "|"
+
+# RateLimitResponse.Code
+CODE_UNKNOWN = 0
+CODE_OK = 1
+CODE_OVER_LIMIT = 2
+UNIT_SECOND = 1
+
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire codec (varints + length-delimited fields).
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, off: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, off
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message payload;
+    value is bytes for length-delimited fields, int for varints."""
+    off = 0
+    while off < len(buf):
+        tag, off = _read_varint(buf, off)
+        fnum, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            val, off = _read_varint(buf, off)
+        elif wire == 2:  # length-delimited
+            ln, off = _read_varint(buf, off)
+            val = buf[off : off + ln]
+            off += ln
+        elif wire == 5:  # fixed32 (skip)
+            val = buf[off : off + 4]
+            off += 4
+        elif wire == 1:  # fixed64 (skip)
+            val = buf[off : off + 8]
+            off += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield fnum, wire, val
+
+
+def _ld(fnum: int, payload: bytes) -> bytes:
+    return _varint((fnum << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _vi(fnum: int, value: int) -> bytes:
+    if not value:
+        return b""  # proto3 default omitted
+    return _varint(fnum << 3) + _varint(value)
+
+
+def decode_rate_limit_request(raw: bytes) -> Tuple[str, List[List[Tuple[str, str]]], int]:
+    """-> (domain, descriptors as [(key, value), ...] lists, hits_addend)."""
+    domain = ""
+    descriptors: List[List[Tuple[str, str]]] = []
+    hits = 0
+    for fnum, _wire, val in _fields(raw):
+        if fnum == 1:
+            domain = val.decode("utf-8")
+        elif fnum == 2:
+            entries: List[Tuple[str, str]] = []
+            for efn, _w, ev in _fields(val):
+                if efn == 1:
+                    key = value = ""
+                    for kfn, _kw, kv in _fields(ev):
+                        if kfn == 1:
+                            key = kv.decode("utf-8")
+                        elif kfn == 2:
+                            value = kv.decode("utf-8")
+                    entries.append((key, value))
+            descriptors.append(entries)
+        elif fnum == 3:
+            hits = int(val)
+    return domain, descriptors, hits
+
+
+def encode_rate_limit_request(
+    domain: str, descriptors: Sequence[Sequence[Tuple[str, str]]], hits_addend: int = 0
+) -> bytes:
+    out = _ld(1, domain.encode("utf-8"))
+    for entries in descriptors:
+        desc = b"".join(
+            _ld(1, _ld(1, k.encode("utf-8")) + _ld(2, v.encode("utf-8")))
+            for k, v in entries
+        )
+        out += _ld(2, desc)
+    out += _vi(3, hits_addend)
+    return out
+
+
+def encode_rate_limit_response(
+    overall_code: int, statuses: Sequence[Tuple[int, Optional[int], int]]
+) -> bytes:
+    """statuses: [(code, requests_per_unit or None, limit_remaining)]."""
+    out = _vi(1, overall_code)
+    for code, rpu, remaining in statuses:
+        body = _vi(1, code)
+        if rpu is not None:
+            body += _ld(2, _vi(1, rpu) + _vi(2, UNIT_SECOND))
+        body += _vi(3, remaining)
+        out += _ld(2, body)
+    return out
+
+
+def decode_rate_limit_response(raw: bytes) -> Tuple[int, List[Tuple[int, Optional[int], int]]]:
+    overall = CODE_UNKNOWN
+    statuses: List[Tuple[int, Optional[int], int]] = []
+    for fnum, _w, val in _fields(raw):
+        if fnum == 1:
+            overall = int(val)
+        elif fnum == 2:
+            code, rpu, remaining = CODE_UNKNOWN, None, 0
+            for sfn, _sw, sv in _fields(val):
+                if sfn == 1:
+                    code = int(sv)
+                elif sfn == 2:
+                    for lfn, _lw, lv in _fields(sv):
+                        if lfn == 1:
+                            rpu = int(lv)
+                elif sfn == 3:
+                    remaining = int(sv)
+            statuses.append((code, rpu, remaining))
+    return overall, statuses
+
+
+# ---------------------------------------------------------------------------
+# Rules (EnvoyRlsRule + EnvoySentinelRuleConverter)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class RlsDescriptor:
+    """One limited descriptor: ordered key/value resources + the
+    per-second count (EnvoyRlsRule.ResourceDescriptor)."""
+
+    resources: Tuple[Tuple[str, str], ...]
+    count: float
+
+
+@dataclass(frozen=True)
+class EnvoyRlsRule:
+    domain: str
+    descriptors: Tuple[RlsDescriptor, ...] = field(default_factory=tuple)
+
+
+def generate_key(domain: str, resources: Sequence[Tuple[str, str]]) -> str:
+    parts = [domain]
+    for k, v in resources:
+        parts += [k, v]
+    return SEPARATOR.join(parts)
+
+
+def generate_flow_id(key: str) -> int:
+    """Deterministic positive id from the key (≙ generateFlowId's
+    hash + offset; crc32 keeps it stable across processes, unlike
+    Python's salted hash())."""
+    return (1 << 31) + zlib.crc32(key.encode("utf-8"))
+
+
+def to_flow_rules(rule: EnvoyRlsRule) -> List[FlowRule]:
+    """EnvoySentinelRuleConverter.toSentinelFlowRules: one cluster-mode
+    GLOBAL rule per descriptor, 1-bucket sampling, no local fallback."""
+    out = []
+    for d in rule.descriptors:
+        key = generate_key(rule.domain, d.resources)
+        out.append(
+            FlowRule(
+                key,
+                count=float(d.count),
+                cluster_mode=True,
+                cluster_config=ClusterFlowConfig(
+                    flow_id=generate_flow_id(key),
+                    threshold_type=C.FLOW_THRESHOLD_GLOBAL,
+                    sample_count=1,
+                    fallback_to_local_when_fail=False,
+                ),
+            )
+        )
+    return out
+
+
+class EnvoyRlsRuleManager:
+    """Namespace-per-domain rule registry feeding the shared cluster
+    flow rule manager (≙ EnvoyRlsRuleDataSourceService applying
+    converted rules under the domain namespace)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_domain: Dict[str, EnvoyRlsRule] = {}
+
+    def load_rules(self, rules: Sequence[EnvoyRlsRule]) -> None:
+        from sentinel_tpu.cluster.flow_rules import cluster_flow_rule_manager
+
+        with self._lock:
+            self._by_domain = {r.domain: r for r in rules}
+            for r in rules:
+                cluster_flow_rule_manager.load_rules(r.domain, to_flow_rules(r))
+
+    def flow_id_for(self, domain: str, entries: Sequence[Tuple[str, str]]) -> Optional[int]:
+        """The flow id of the rule matching this descriptor exactly, or
+        None (no rule → the request passes)."""
+        with self._lock:
+            rule = self._by_domain.get(domain)
+            if rule is None:
+                return None
+            want = tuple(entries)
+            for d in rule.descriptors:
+                if d.resources == want:
+                    return generate_flow_id(generate_key(domain, d.resources))
+        return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._by_domain.clear()
+
+
+envoy_rls_rule_manager = EnvoyRlsRuleManager()
+
+
+# ---------------------------------------------------------------------------
+# The gRPC service (SentinelEnvoyRlsServiceImpl + SentinelRlsGrpcServer)
+# ---------------------------------------------------------------------------
+
+SERVICE_NAME = "envoy.service.ratelimit.v2.RateLimitService"
+METHOD = "ShouldRateLimit"
+
+
+class EnvoyRlsService:
+    """shouldRateLimit over the shared token service."""
+
+    def __init__(self, token_service=None) -> None:
+        self.token_service = token_service
+
+    def _service(self):
+        if self.token_service is not None:
+            return self.token_service
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+
+        self.token_service = DefaultTokenService()
+        return self.token_service
+
+    def should_rate_limit(self, raw_request: bytes, context=None) -> bytes:
+        domain, descriptors, hits = decode_rate_limit_request(raw_request)
+        acquire = hits if hits > 0 else 1  # absent → 1
+        blocked = False
+        statuses: List[Tuple[int, Optional[int], int]] = []
+        service = self._service()
+        from sentinel_tpu.cluster.flow_rules import cluster_flow_rule_manager
+
+        for entries in descriptors:
+            flow_id = envoy_rls_rule_manager.flow_id_for(domain, entries)
+            if flow_id is None:
+                statuses.append((CODE_OK, None, 0))  # no rule → pass
+                continue
+            result = service.request_token(flow_id, acquire)
+            ok = result.status in (
+                C.TokenResultStatus.OK,
+                C.TokenResultStatus.NO_RULE_EXISTS,  # absent rule passes
+            )
+            blocked = blocked or not ok
+            rule = cluster_flow_rule_manager.get_rule_by_id(flow_id)
+            rpu = int(rule.count) if rule is not None else None
+            statuses.append(
+                (CODE_OK if ok else CODE_OVER_LIMIT, rpu, max(result.remaining, 0))
+            )
+        overall = CODE_OVER_LIMIT if blocked else CODE_OK
+        return encode_rate_limit_response(overall, statuses)
+
+
+class SentinelRlsGrpcServer:
+    """A grpc.Server exposing the RLS service (generic handler — no
+    generated stubs needed)."""
+
+    def __init__(self, port: int = 0, token_service=None, max_workers: int = 8) -> None:
+        import grpc
+        from concurrent import futures
+
+        self.service = EnvoyRlsService(token_service)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        handler = grpc.method_handlers_generic_handler(
+            SERVICE_NAME,
+            {
+                METHOD: grpc.unary_unary_rpc_method_handler(
+                    lambda req, ctx: self.service.should_rate_limit(req, ctx),
+                    request_deserializer=None,  # raw bytes in
+                    response_serializer=None,  # raw bytes out
+                )
+            },
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    def start(self) -> "SentinelRlsGrpcServer":
+        self._server.start()
+        record_log.info("[EnvoyRls] gRPC RLS server on %d", self.port)
+        return self
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)

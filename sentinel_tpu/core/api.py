@@ -293,15 +293,13 @@ def entry(
 
 
 def _block_error(verdict, resource: str) -> E.BlockError:
-    if verdict.reason == E.BLOCK_SYSTEM:
-        return E.SystemBlockError(resource, verdict.limit_type)
-    if verdict.reason == E.BLOCK_CUSTOM:
-        err = E.CustomBlockError(resource, verdict.slot_name)
-        err.rule = verdict.blocked_rule
-        return err
-    err = E.error_for_code(verdict.reason, resource)
-    err.rule = verdict.blocked_rule
-    return err
+    return E.error_for_verdict(
+        verdict.reason,
+        resource,
+        limit_type=verdict.limit_type,
+        slot_name=verdict.slot_name,
+        rule=verdict.blocked_rule,
+    )
 
 
 def try_entry(

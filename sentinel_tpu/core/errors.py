@@ -113,3 +113,24 @@ _ERROR_BY_CODE = {
 def error_for_code(code: int, resource: str) -> BlockError:
     cls = _ERROR_BY_CODE.get(int(code), BlockError)
     return cls(resource)
+
+
+def error_for_verdict(
+    reason: int,
+    resource: str,
+    *,
+    limit_type: str = "",
+    slot_name: str = "",
+    rule=None,
+) -> BlockError:
+    """One verdict→BlockError construction shared by the public API's
+    raise path and the engine's metric-extension callbacks — the typed
+    subclass with its attribution, whatever the block reason."""
+    if reason == BLOCK_SYSTEM:
+        return SystemBlockError(resource, limit_type)
+    if reason == BLOCK_CUSTOM:
+        err: BlockError = CustomBlockError(resource, slot_name)
+    else:
+        err = error_for_code(reason, resource)
+    err.rule = rule
+    return err

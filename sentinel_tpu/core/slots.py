@@ -62,6 +62,10 @@ class SlotChainRegistry:
     entry-point SPI discovery, sorted by ``order``."""
 
     _lock = threading.Lock()
+    # Copy-on-write: readers iterate whatever list object they grabbed;
+    # writers build a NEW sorted list and swap the reference atomically,
+    # so a flush mid-iteration never sees an in-place sort reorder (the
+    # COW map pattern of the reference's chain cache, CtSph.java:224-228).
     _slots: List[ProcessorSlot] = []
     _spi_loaded = False
 
@@ -76,26 +80,25 @@ class SlotChainRegistry:
         with cls._lock:
             if cls._spi_loaded:
                 return
-            cls._spi_loaded = True
+            loaded: List[ProcessorSlot] = []
             try:
                 from sentinel_tpu.utils.registry import Registry
 
-                for slot in Registry.of("ProcessorSlot").load_instance_list_sorted():
-                    cls._slots.append(slot)
-                cls._slots.sort(key=lambda s: s.order)
+                loaded = list(Registry.of("ProcessorSlot").load_instance_list_sorted())
             except Exception:
                 record_log.error("[SlotChain] SPI load failed", exc_info=True)
+            cls._slots = sorted(cls._slots + loaded, key=lambda s: s.order)
+            cls._spi_loaded = True  # after population: no reader sees a gap
 
     @classmethod
     def register(cls, slot: ProcessorSlot) -> None:
         with cls._lock:
-            cls._slots.append(slot)
-            cls._slots.sort(key=lambda s: s.order)
+            cls._slots = sorted(cls._slots + [slot], key=lambda s: s.order)
 
     @classmethod
     def clear(cls) -> None:
         with cls._lock:
-            cls._slots.clear()
+            cls._slots = []
             cls._spi_loaded = False
 
     # ------------------------------------------------------------------

@@ -1086,14 +1086,10 @@ class Engine:
                 if exts:
                     # Extensions receive a real BlockError (the contract
                     # mirrors the reference's BlockException argument).
-                    if v.reason == E.BLOCK_SYSTEM:
-                        err = E.SystemBlockError(op.resource, v.limit_type)
-                    elif v.reason == E.BLOCK_CUSTOM:
-                        err = E.CustomBlockError(op.resource, v.slot_name)
-                        err.rule = v.blocked_rule
-                    else:
-                        err = E.error_for_code(v.reason, op.resource)
-                        err.rule = v.blocked_rule
+                    err = E.error_for_verdict(
+                        v.reason, op.resource, limit_type=v.limit_type,
+                        slot_name=v.slot_name, rule=v.blocked_rule,
+                    )
                     MetricExtensionProvider.on_blocked(
                         op.resource, op.acquire, op.origin, err, op.args
                     )
@@ -1200,6 +1196,8 @@ class Engine:
         self, rows: Sequence[int], now: Optional[int] = None
     ) -> Dict[int, Dict[str, float]]:
         """Stats dicts for many rows with one batched device read."""
+        if not rows:
+            return {}
         with self._flush_lock:
             arrays = self._all_stats_arrays(now)
         return {row: self._stats_from_arrays(arrays, row) for row in rows}

@@ -1,0 +1,148 @@
+"""Envoy RLS gRPC front-end: ShouldRateLimit over a real gRPC channel
+with the v2 request shape (domain + descriptors + hits_addend), backed
+by the shared token service (≙ SentinelEnvoyRlsServiceImpl +
+SentinelEnvoyRlsServiceImplTest's pass/block scenarios).
+"""
+
+import pytest
+
+from sentinel_tpu.cluster import cluster_flow_rule_manager
+from sentinel_tpu.cluster.envoy_rls import (
+    CODE_OK,
+    CODE_OVER_LIMIT,
+    EnvoyRlsRule,
+    RlsDescriptor,
+    SentinelRlsGrpcServer,
+    decode_rate_limit_response,
+    encode_rate_limit_request,
+    envoy_rls_rule_manager,
+    generate_flow_id,
+    generate_key,
+    to_flow_rules,
+)
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.utils.clock import ManualClock
+
+
+@pytest.fixture()
+def rls_rules():
+    cluster_flow_rule_manager.clear()
+    envoy_rls_rule_manager.load_rules(
+        [
+            EnvoyRlsRule(
+                domain="mesh",
+                descriptors=(
+                    RlsDescriptor(resources=(("destination", "svcA"),), count=3),
+                    RlsDescriptor(
+                        resources=(("destination", "svcB"), ("method", "POST")),
+                        count=1,
+                    ),
+                ),
+            )
+        ]
+    )
+    yield
+    envoy_rls_rule_manager.clear()
+    cluster_flow_rule_manager.clear()
+
+
+class TestRuleConversion:
+    def test_converter_shape(self):
+        rule = EnvoyRlsRule(
+            "d", (RlsDescriptor(resources=(("k", "v"),), count=7),)
+        )
+        (fr,) = to_flow_rules(rule)
+        assert fr.resource == "d|k|v"
+        assert fr.count == 7 and fr.cluster_mode
+        cc = fr.cluster_config
+        assert cc.flow_id == generate_flow_id("d|k|v")
+        assert cc.sample_count == 1 and not cc.fallback_to_local_when_fail
+
+    def test_flow_id_stable_and_positive(self):
+        key = generate_key("d", [("a", "b"), ("c", "d")])
+        assert key == "d|a|b|c|d"
+        assert generate_flow_id(key) == generate_flow_id(key) > 0
+
+
+class TestShouldRateLimitGrpc:
+    def _call(self, channel, domain, descriptors, hits=0):
+        import grpc  # noqa: F401
+
+        method = channel.unary_unary(
+            "/envoy.service.ratelimit.v2.RateLimitService/ShouldRateLimit",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        raw = method(encode_rate_limit_request(domain, descriptors, hits))
+        return decode_rate_limit_response(raw)
+
+    def test_pass_then_over_limit(self, rls_rules):
+        import grpc
+
+        svc = DefaultTokenService(clock=ManualClock(0))
+        server = SentinelRlsGrpcServer(port=0, token_service=svc).start()
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{server.port}") as channel:
+                desc = [[("destination", "svcA")]]
+                for i in range(3):
+                    overall, statuses = self._call(channel, "mesh", desc)
+                    assert overall == CODE_OK, f"request {i} should pass"
+                    assert statuses[0][0] == CODE_OK
+                    assert statuses[0][1] == 3  # current_limit requests/s
+                overall, statuses = self._call(channel, "mesh", desc)
+                assert overall == CODE_OVER_LIMIT
+                assert statuses[0][0] == CODE_OVER_LIMIT
+        finally:
+            server.stop()
+
+    def test_unknown_descriptor_passes(self, rls_rules):
+        import grpc
+
+        server = SentinelRlsGrpcServer(
+            port=0, token_service=DefaultTokenService(clock=ManualClock(0))
+        ).start()
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{server.port}") as channel:
+                overall, statuses = self._call(
+                    channel, "mesh", [[("destination", "unknown-svc")]]
+                )
+                assert overall == CODE_OK
+                assert statuses == [(CODE_OK, None, 0)]
+        finally:
+            server.stop()
+
+    def test_multi_descriptor_any_block_is_over_limit(self, rls_rules):
+        import grpc
+
+        svc = DefaultTokenService(clock=ManualClock(0))
+        server = SentinelRlsGrpcServer(port=0, token_service=svc).start()
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{server.port}") as channel:
+                descs = [
+                    [("destination", "svcA")],
+                    [("destination", "svcB"), ("method", "POST")],
+                ]
+                overall, statuses = self._call(channel, "mesh", descs)
+                assert overall == CODE_OK
+                # svcB's count=1 is spent; next call blocks on it only.
+                overall, statuses = self._call(channel, "mesh", descs)
+                assert overall == CODE_OVER_LIMIT
+                assert statuses[0][0] == CODE_OK  # svcA still has room
+                assert statuses[1][0] == CODE_OVER_LIMIT
+        finally:
+            server.stop()
+
+    def test_hits_addend_spends_batch(self, rls_rules):
+        import grpc
+
+        svc = DefaultTokenService(clock=ManualClock(0))
+        server = SentinelRlsGrpcServer(port=0, token_service=svc).start()
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{server.port}") as channel:
+                desc = [[("destination", "svcA")]]
+                overall, _ = self._call(channel, "mesh", desc, hits=3)
+                assert overall == CODE_OK
+                overall, _ = self._call(channel, "mesh", desc, hits=1)
+                assert overall == CODE_OVER_LIMIT
+        finally:
+            server.stop()

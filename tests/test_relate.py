@@ -69,8 +69,9 @@ class TestRelateBatchedConservatism:
         admitted = [op.verdict.admitted for op in ops]
         assert sum(admitted) == 2  # count(5) - pass_B(3)
         assert admitted == [True, True] + [False] * 8  # prefix, ts order
-        # Never over: bound holds for any batch size.
-        assert sum(admitted) <= 10
+        # Never over: the admitted set cannot exceed the check node's
+        # remaining headroom.
+        assert sum(admitted) <= 5 - 3
 
     def test_direct_rules_in_same_batch_stay_exact(self, manual_clock, engine):
         """The conservatism is scoped to cross-resource topologies: a
