@@ -82,6 +82,8 @@ class ConcurrentFlowManager:
         """acquireConcurrentToken (java:48-76). Returns
         (status, token_id): OK grants and caches a token; BLOCKED when
         ``nowCalls + acquire`` would exceed the global threshold."""
+        from sentinel_tpu.cluster import stat_log
+
         flow_id = int(rule.cluster_config.flow_id)
         now = self.clock.now_ms()
         threshold = self._threshold(rule, connected_count)
@@ -93,31 +95,42 @@ class ConcurrentFlowManager:
                 # keep the flow blocked until the next throttled sweep.
                 self._sweep_locked(now)
                 calls = self._now_calls.get(flow_id, 0)
-            if calls + acquire_count > threshold:
-                return C.TokenResultStatus.BLOCKED, 0
-            self._now_calls[flow_id] = calls + acquire_count
-            token_id = uuid.uuid4().int >> 65  # 63-bit, like the UUID msb
-            cc = rule.cluster_config
-            self._tokens[token_id] = TokenCacheNode(
-                token_id=token_id,
-                flow_id=flow_id,
-                acquire_count=acquire_count,
-                client_address=client_address,
-                resource_timeout_at=now + int(cc.resource_timeout),
-            )
-            return C.TokenResultStatus.OK, token_id
+            blocked = calls + acquire_count > threshold
+            token_id = 0
+            if not blocked:
+                self._now_calls[flow_id] = calls + acquire_count
+                token_id = uuid.uuid4().int >> 65  # 63-bit, like the UUID msb
+                cc = rule.cluster_config
+                self._tokens[token_id] = TokenCacheNode(
+                    token_id=token_id,
+                    flow_id=flow_id,
+                    acquire_count=acquire_count,
+                    client_address=client_address,
+                    resource_timeout_at=now + int(cc.resource_timeout),
+                )
+        # Stat-log outside the lock: the interval roll does file IO and
+        # must not stall acquire/release cluster-wide on a disk hiccup.
+        if blocked:
+            stat_log.log("concurrent", "block", flow_id, acquire_count)
+            return C.TokenResultStatus.BLOCKED, 0
+        stat_log.log("concurrent", "pass", flow_id, acquire_count)
+        return C.TokenResultStatus.OK, token_id
 
     def release(self, token_id: int):
         """releaseConcurrentToken (java:78-99). Returns the status:
         RELEASE_OK, or ALREADY_RELEASE when the token is unknown
         (double release / expired-and-swept)."""
+        from sentinel_tpu.cluster import stat_log
+
         with self._lock:
             self._maybe_sweep(self.clock.now_ms())
             node = self._tokens.pop(int(token_id), None)
-            if node is None:
-                return C.TokenResultStatus.ALREADY_RELEASE
-            self._drop_locked(node)
-            return C.TokenResultStatus.RELEASE_OK
+            if node is not None:
+                self._drop_locked(node)
+        if node is None:
+            return C.TokenResultStatus.ALREADY_RELEASE
+        stat_log.log("concurrent", "release", node.flow_id, node.acquire_count)
+        return C.TokenResultStatus.RELEASE_OK
 
     def _drop_locked(self, node: TokenCacheNode) -> None:
         calls = self._now_calls.get(node.flow_id, 0)

@@ -241,31 +241,43 @@ class EnvoyRlsRuleManager:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._by_domain: Dict[str, EnvoyRlsRule] = {}
+        # Precomputed hot-path lookup: (domain, resources) -> flow_id.
+        self._flow_ids: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], int] = {}
 
     def load_rules(self, rules: Sequence[EnvoyRlsRule]) -> None:
         from sentinel_tpu.cluster.flow_rules import cluster_flow_rule_manager
 
         with self._lock:
+            old_domains = set(self._by_domain)
             self._by_domain = {r.domain: r for r in rules}
+            self._flow_ids = {
+                (r.domain, d.resources): generate_flow_id(
+                    generate_key(r.domain, d.resources)
+                )
+                for r in rules
+                for d in r.descriptors
+            }
             for r in rules:
                 cluster_flow_rule_manager.load_rules(r.domain, to_flow_rules(r))
+            # Dropped domains must stop being enforced: an operator
+            # deleting a rule expects its flow_id to stop rate-limiting.
+            for domain in old_domains - set(self._by_domain):
+                cluster_flow_rule_manager.load_rules(domain, [])
 
     def flow_id_for(self, domain: str, entries: Sequence[Tuple[str, str]]) -> Optional[int]:
         """The flow id of the rule matching this descriptor exactly, or
         None (no rule → the request passes)."""
         with self._lock:
-            rule = self._by_domain.get(domain)
-            if rule is None:
-                return None
-            want = tuple(entries)
-            for d in rule.descriptors:
-                if d.resources == want:
-                    return generate_flow_id(generate_key(domain, d.resources))
-        return None
+            return self._flow_ids.get((domain, tuple(entries)))
 
     def clear(self) -> None:
+        from sentinel_tpu.cluster.flow_rules import cluster_flow_rule_manager
+
         with self._lock:
+            for domain in self._by_domain:
+                cluster_flow_rule_manager.load_rules(domain, [])
             self._by_domain.clear()
+            self._flow_ids.clear()
 
 
 envoy_rls_rule_manager = EnvoyRlsRuleManager()
@@ -284,13 +296,19 @@ class EnvoyRlsService:
 
     def __init__(self, token_service=None) -> None:
         self.token_service = token_service
+        self._init_lock = threading.Lock()
 
     def _service(self):
         if self.token_service is not None:
             return self.token_service
-        from sentinel_tpu.cluster.token_service import DefaultTokenService
+        with self._init_lock:
+            # Double-checked: concurrent first requests on the gRPC
+            # worker pool must share ONE token service, or each would
+            # enforce the limit against private state.
+            if self.token_service is None:
+                from sentinel_tpu.cluster.token_service import DefaultTokenService
 
-        self.token_service = DefaultTokenService()
+                self.token_service = DefaultTokenService()
         return self.token_service
 
     def should_rate_limit(self, raw_request: bytes, context=None) -> bytes:

@@ -1,0 +1,46 @@
+"""Cluster server stat log — ClusterServerStatLogUtil.
+
+Reference: the token server stat-logs every decision through an
+EagleEye StatLogger into ``sentinel-cluster.log`` (e.g.
+``ClusterServerStatLogUtil.log("concurrent|block|" + flowId, n)``,
+ConcurrentClusterFlowChecker.java:58-86; flow decisions likewise).
+Same aggregation machinery as the block log: per-second counts keyed by
+the tag, size-rolled output.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from sentinel_tpu.metrics.block_log import BlockLogger
+
+FILE_NAME = "sentinel-cluster.log"
+
+_lock = threading.Lock()
+_logger: Optional[BlockLogger] = None
+
+
+def _get_logger() -> BlockLogger:
+    global _logger
+    with _lock:
+        if _logger is None:
+            _logger = BlockLogger(file_name=FILE_NAME)
+        return _logger
+
+
+def set_logger(logger: Optional[BlockLogger]) -> None:
+    """Swap the sink (tests point it at a tmp dir)."""
+    global _logger
+    with _lock:
+        _logger = logger
+
+
+def log(category: str, outcome: str, flow_id: int, count: int = 1) -> None:
+    """``log("concurrent", "block", flowId, n)`` ≙
+    ClusterServerStatLogUtil.log("concurrent|block|<id>", n)."""
+    _get_logger().stat(category, outcome, str(int(flow_id)), count=count)
+
+
+def flush() -> None:
+    _get_logger().flush()

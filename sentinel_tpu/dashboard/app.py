@@ -302,10 +302,16 @@ class DashboardServer:
             def do_GET(self):
                 parsed = urlparse(self.path)
                 params = dict(parse_qsl(parsed.query))
-                code, body = dashboard._handle(parsed.path, params)
+                if parsed.path in ("/", "/index.html"):
+                    from sentinel_tpu.dashboard.webui import CONSOLE_HTML
+
+                    code, body, ctype = 200, CONSOLE_HTML, "text/html; charset=utf-8"
+                else:
+                    code, body = dashboard._handle(parsed.path, params)
+                    ctype = "application/json"
                 data = body.encode("utf-8")
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

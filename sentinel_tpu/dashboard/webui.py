@@ -1,0 +1,166 @@
+"""Embedded dashboard console — a single-file web UI over the JSON API.
+
+Reference: sentinel-dashboard ships an AngularJS webapp
+(sentinel-dashboard/src/main/webapp/) with app list, real-time metrics
+and rule management screens. A full SPA port is out of scope; this is a
+dependency-free vanilla HTML/JS console served straight from the
+dashboard process covering the same core screens: application list,
+per-resource live QPS table with pass/block sparklines, and a rule
+viewer/editor that pushes through the machine command API.
+"""
+
+CONSOLE_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>Sentinel TPU Console</title>
+<style>
+  :root { --bg:#0f1419; --panel:#1a2129; --fg:#d8dee6; --dim:#7d8a99;
+          --accent:#4aa8ff; --ok:#3fb68b; --bad:#e05d5d; --line:#2a333e; }
+  * { box-sizing:border-box; }
+  body { margin:0; font:14px/1.45 system-ui,sans-serif; background:var(--bg); color:var(--fg); }
+  header { padding:14px 22px; border-bottom:1px solid var(--line); display:flex; gap:14px; align-items:baseline; }
+  header h1 { font-size:17px; margin:0; letter-spacing:.4px; }
+  header .sub { color:var(--dim); font-size:12px; }
+  main { display:grid; grid-template-columns:220px 1fr; min-height:calc(100vh - 53px); }
+  nav { border-right:1px solid var(--line); padding:14px; }
+  nav h2, section h2 { font-size:12px; text-transform:uppercase; color:var(--dim); margin:0 0 8px; }
+  nav button { display:block; width:100%; text-align:left; margin:2px 0; padding:7px 10px;
+    background:none; border:1px solid transparent; border-radius:6px; color:var(--fg); cursor:pointer; }
+  nav button.active, nav button:hover { background:var(--panel); border-color:var(--line); }
+  nav .dot { display:inline-block; width:7px; height:7px; border-radius:50%; margin-right:7px; }
+  section { padding:16px 22px; overflow:auto; }
+  table { border-collapse:collapse; width:100%; margin-bottom:22px; }
+  th, td { text-align:right; padding:6px 10px; border-bottom:1px solid var(--line); font-variant-numeric:tabular-nums; }
+  th { color:var(--dim); font-weight:500; font-size:12px; }
+  th:first-child, td:first-child { text-align:left; }
+  td.res { color:var(--accent); }
+  .pass { color:var(--ok); } .block { color:var(--bad); }
+  svg.spark { vertical-align:middle; }
+  textarea { width:100%; height:180px; background:var(--panel); color:var(--fg);
+    border:1px solid var(--line); border-radius:6px; padding:10px; font:12px/1.5 ui-monospace,monospace; }
+  .rulebar { display:flex; gap:8px; margin:8px 0 16px; align-items:center; }
+  select, .rulebar button { background:var(--panel); color:var(--fg); border:1px solid var(--line);
+    border-radius:6px; padding:6px 12px; cursor:pointer; }
+  .rulebar button:hover { border-color:var(--accent); }
+  #status { color:var(--dim); font-size:12px; margin-left:auto; }
+  .empty { color:var(--dim); padding:30px 0; }
+</style>
+</head>
+<body>
+<header><h1>Sentinel&nbsp;TPU</h1><span class="sub">flow control console</span>
+  <span id="status"></span></header>
+<main>
+  <nav><h2>Applications</h2><div id="apps" class="empty">loading…</div></nav>
+  <section>
+    <h2>Real-time metrics <span id="appname"></span></h2>
+    <table id="metrics"><thead><tr>
+      <th>resource</th><th>pass/s</th><th>block/s</th><th>rt ms</th>
+      <th>threads</th><th>trend (60s)</th>
+    </tr></thead><tbody></tbody></table>
+    <h2>Rules</h2>
+    <div class="rulebar">
+      <select id="ruletype">
+        <option value="flow">flow</option><option value="degrade">degrade</option>
+        <option value="system">system</option><option value="authority">authority</option>
+        <option value="paramFlow">paramFlow</option>
+      </select>
+      <button onclick="loadRules()">Load</button>
+      <button onclick="pushRules()">Push to machines</button>
+    </div>
+    <textarea id="rules" spellcheck="false"></textarea>
+  </section>
+</main>
+<script>
+let app = null;
+const hist = {};           // resource -> [{t, pass, block}]
+const $ = (id) => document.getElementById(id);
+const fetchJson = (url) => fetch(url).then(r => r.json());
+// Names arrive from the unauthenticated registry endpoint: escape
+// EVERYTHING interpolated into markup (stored-XSS surface otherwise).
+const esc = (s) => String(s).replace(/[&<>"']/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+
+async function refreshApps() {
+  try {
+    const apps = await fetchJson('/apps');
+    const names = Object.keys(apps);
+    const el = $('apps');
+    if (!names.length) { el.className = 'empty'; el.textContent = 'no apps registered'; return; }
+    el.className = '';
+    if (!app || !names.includes(app)) app = names[0];
+    el.innerHTML = names.map((n, i) => {
+      const healthy = apps[n].some(m => m.healthy);
+      return `<button class="${n === app ? 'active' : ''}" data-i="${i}">` +
+        `<span class="dot" style="background:${healthy ? 'var(--ok)' : 'var(--bad)'}"></span>${esc(n)}</button>`;
+    }).join('');
+    el.querySelectorAll('button').forEach(b =>
+      b.addEventListener('click', () => selectApp(names[+b.dataset.i])));
+    $('appname').textContent = '— ' + app;
+  } catch (e) { $('status').textContent = 'apps: ' + e; }
+}
+function selectApp(n) { app = n; refreshApps(); refreshMetrics(); loadRules(); }
+
+function spark(points, key, color) {
+  if (points.length < 2) return '';
+  const max = Math.max(1, ...points.map(p => p[key]));
+  const xs = points.map((p, i) => (i / (points.length - 1)) * 118 + 1);
+  const ys = points.map(p => 19 - (p[key] / max) * 17);
+  const d = xs.map((x, i) => `${i ? 'L' : 'M'}${x.toFixed(1)},${ys[i].toFixed(1)}`).join('');
+  return `<path d="${d}" fill="none" stroke="${color}" stroke-width="1.4"/>`;
+}
+
+async function refreshMetrics() {
+  if (!app) return;
+  try {
+    const now = Date.now();
+    const nodes = await fetchJson(`/metric?app=${encodeURIComponent(app)}&startTime=${now - 65000}&endTime=${now}`);
+    const latest = {};
+    for (const n of nodes) {
+      (hist[n.resource] = hist[n.resource] || []).push({ t: n.timestamp, pass: n.pass_qps, block: n.block_qps });
+      if (!latest[n.resource] || n.timestamp > latest[n.resource].timestamp) latest[n.resource] = n;
+    }
+    for (const r in hist) {
+      const seen = new Set(); // dedupe by ts, keep last 60
+      hist[r] = hist[r].filter(p => !seen.has(p.t) && seen.add(p.t)).slice(-60);
+    }
+    const body = $('metrics').tBodies[0];
+    const rows = Object.keys(latest).sort().map(r => {
+      const n = latest[r];
+      return `<tr><td class="res">${esc(r)}</td><td class="pass">${n.pass_qps}</td>` +
+        `<td class="block">${n.block_qps}</td><td>${(n.rt ?? 0).toFixed(1)}</td>` +
+        `<td>${n.concurrency ?? 0}</td>` +
+        `<td><svg class="spark" width="120" height="20">` +
+        spark(hist[r], 'pass', 'var(--ok)') + spark(hist[r], 'block', 'var(--bad)') +
+        `</svg></td></tr>`;
+    });
+    body.innerHTML = rows.join('') || '<tr><td colspan="6" class="empty">no traffic yet</td></tr>';
+    $('status').textContent = 'updated ' + new Date().toLocaleTimeString();
+  } catch (e) { $('status').textContent = 'metrics: ' + e; }
+}
+
+async function loadRules() {
+  if (!app) return;
+  const kind = $('ruletype').value;
+  try {
+    const rules = await fetchJson(`/rules?app=${encodeURIComponent(app)}&type=${kind}`);
+    $('rules').value = JSON.stringify(rules, null, 2);
+  } catch (e) { $('status').textContent = 'rules: ' + e; }
+}
+async function pushRules() {
+  if (!app) return;
+  const kind = $('ruletype').value;
+  let data;
+  try { data = JSON.stringify(JSON.parse($('rules').value)); }
+  catch (e) { $('status').textContent = 'rules are not valid JSON'; return; }
+  const resp = await fetchJson(`/rules?app=${encodeURIComponent(app)}&type=${kind}&data=${encodeURIComponent(data)}`);
+  $('status').textContent = resp.code === 0 ? 'rules pushed' : 'push failed';
+}
+
+refreshApps(); setInterval(refreshApps, 5000);
+refreshMetrics(); setInterval(refreshMetrics, 2000);
+loadRules();
+</script>
+</body>
+</html>
+"""

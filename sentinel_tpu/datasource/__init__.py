@@ -30,10 +30,13 @@ from sentinel_tpu.datasource.file_source import (
     FileRefreshableDataSource,
     FileWritableDataSource,
 )
+from sentinel_tpu.datasource.http_source import HttpDataSource, HttpLongPollDataSource
 from sentinel_tpu.datasource.redis_source import RedisDataSource
 
 __all__ = [
     "AbstractDataSource",
+    "HttpDataSource",
+    "HttpLongPollDataSource",
     "RedisDataSource",
     "AutoRefreshDataSource",
     "Converter",

@@ -101,10 +101,13 @@ class BlockLogger:
 
     def log_batch(
         self,
-        items: Iterable[Tuple[str, str, str, str, int]],
+        items: Iterable[Tuple],
         now_wall_ms: Optional[int] = None,
     ) -> None:
-        """One lock acquisition for a whole flush's blocked verdicts."""
+        """One lock acquisition for a whole flush's items. Each item is
+        ``(*key_parts, count)`` — key arity is free (the block log uses
+        4 parts; the cluster stat log uses whatever the tag needs,
+        StatLogger.stat(...) style)."""
         now = self.clock.wall_ms() if now_wall_ms is None else now_wall_ms
         aligned = now - now % self.interval_ms
         with self._lock:
@@ -112,11 +115,16 @@ class BlockLogger:
                 self._write_locked()
             if self._cur_sec is None or aligned > self._cur_sec:
                 self._cur_sec = aligned
-            for resource, exc, limit_app, origin, count in items:
-                key = (resource, exc, limit_app, origin)
+            for item in items:
+                key, count = tuple(str(p) for p in item[:-1]), int(item[-1])
                 if key not in self._entries and len(self._entries) >= self.max_entry_count:
                     continue  # maxEntryCount cap: drop new keys, keep hot ones
-                self._entries[key] = self._entries.get(key, 0) + int(count)
+                self._entries[key] = self._entries.get(key, 0) + count
+
+    def stat(self, *key_parts: str, count: int = 1,
+             now_wall_ms: Optional[int] = None) -> None:
+        """StatLogger.stat(keys...).count(n) shorthand."""
+        self.log_batch([(*key_parts, count)], now_wall_ms)
 
     def flush(self) -> None:
         """Force-write the current interval (tests / shutdown)."""
@@ -142,8 +150,8 @@ class BlockLogger:
             self._entries = {}
             return
         lines: List[str] = []
-        for (resource, exc, limit_app, origin), count in self._entries.items():
-            key = ",".join((resource, exc, limit_app, origin))
+        for key_parts, count in self._entries.items():
+            key = ",".join(key_parts)
             lines.append(f"{self._cur_sec}|{self.STAT_TYPE}|{key}|{count}\n")
         self._entries = {}
         try:
@@ -180,8 +188,6 @@ class BlockLogger:
                         continue
                     ts, _stat, key, count = parts
                     fields = key.split(",")
-                    if len(fields) != 4:
-                        continue
                     out.append((int(ts), tuple(fields), int(count)))  # type: ignore[arg-type]
         except OSError:
             pass

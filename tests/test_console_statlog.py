@@ -1,0 +1,77 @@
+"""Dashboard web console + cluster server stat log."""
+
+import urllib.request
+
+import pytest
+
+from sentinel_tpu.cluster import (
+    DefaultTokenService,
+    cluster_flow_rule_manager,
+    stat_log,
+)
+from sentinel_tpu.dashboard import DashboardServer
+from sentinel_tpu.metrics.block_log import BlockLogger
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import ClusterFlowConfig, FlowRule
+from sentinel_tpu.utils.clock import ManualClock
+
+
+class TestWebConsole:
+    def test_root_serves_console(self):
+        srv = DashboardServer(port=0).start()
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/", timeout=5) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/html")
+                body = r.read().decode()
+            assert "Sentinel" in body and "Real-time metrics" in body
+            assert "/metric?app=" in body  # wired to the JSON API
+            # The JSON API remains reachable alongside the UI.
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/apps", timeout=5
+            ) as r:
+                assert r.headers["Content-Type"].startswith("application/json")
+        finally:
+            srv.stop()
+
+
+class TestClusterStatLog:
+    @pytest.fixture(autouse=True)
+    def _sink(self, tmp_path):
+        clock = ManualClock(0)
+        logger = BlockLogger(base_dir=str(tmp_path), file_name="sentinel-cluster.log",
+                             clock=clock)
+        stat_log.set_logger(logger)
+        cluster_flow_rule_manager.clear()
+        yield logger
+        stat_log.set_logger(None)
+        cluster_flow_rule_manager.clear()
+
+    def test_flow_decisions_logged(self, _sink):
+        rule = FlowRule("r", count=1, cluster_mode=True,
+                        cluster_config=ClusterFlowConfig(
+                            flow_id=42, threshold_type=C.FLOW_THRESHOLD_GLOBAL))
+        cluster_flow_rule_manager.load_rules("default", [rule])
+        svc = DefaultTokenService(clock=ManualClock(0))
+        assert svc.request_token(42).ok
+        assert not svc.request_token(42).ok
+        _sink.flush()
+        entries = {k: c for _, k, c in _sink.read_entries()}
+        assert entries[("flow", "pass", "42")] == 1
+        assert entries[("flow", "block", "42")] == 1
+
+    def test_concurrent_decisions_logged(self, _sink):
+        rule = FlowRule("c", count=1, grade=C.FLOW_GRADE_THREAD, cluster_mode=True,
+                        cluster_config=ClusterFlowConfig(
+                            flow_id=77, threshold_type=C.FLOW_THRESHOLD_GLOBAL))
+        cluster_flow_rule_manager.load_rules("default", [rule])
+        svc = DefaultTokenService(clock=ManualClock(0))
+        r = svc.request_concurrent_token(77)
+        assert r.ok
+        assert not svc.request_concurrent_token(77).ok
+        svc.release_concurrent_token(r.token_id)
+        _sink.flush()
+        entries = {k: c for _, k, c in _sink.read_entries()}
+        assert entries[("concurrent", "pass", "77")] == 1
+        assert entries[("concurrent", "block", "77")] == 1
+        assert entries[("concurrent", "release", "77")] == 1
