@@ -1,0 +1,45 @@
+"""Cluster flow control — a standalone token server over TCP, clients
+requesting QPS tokens and held concurrency tokens
+(sentinel-demo-cluster).
+"""
+
+import _bootstrap  # noqa: F401
+
+from sentinel_tpu.cluster import (
+    DefaultTokenService,
+    cluster_flow_rule_manager,
+)
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.server import SentinelTokenServer
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import ClusterFlowConfig, FlowRule
+from sentinel_tpu.utils.clock import ManualClock
+
+qps_rule = FlowRule("api", count=3, cluster_mode=True,
+                    cluster_config=ClusterFlowConfig(
+                        flow_id=100, threshold_type=C.FLOW_THRESHOLD_GLOBAL))
+conc_rule = FlowRule("job", count=2, grade=C.FLOW_GRADE_THREAD, cluster_mode=True,
+                     cluster_config=ClusterFlowConfig(flow_id=200))
+cluster_flow_rule_manager.load_rules("default", [qps_rule, conc_rule])
+
+server = SentinelTokenServer(port=0, service=DefaultTokenService(ManualClock(0)))
+server.start()
+print(f"token server on 127.0.0.1:{server.port}")
+
+client = ClusterTokenClient(port=server.port).start()
+
+print("-- global QPS tokens (count=3):")
+for i in range(5):
+    r = client.request_token(100)
+    print(f"  request {i + 1}: {r.status.name}")
+
+print("-- held concurrency tokens (count=2): acquire/release lifecycle")
+t1 = client.request_concurrent_token(200)
+t2 = client.request_concurrent_token(200)
+t3 = client.request_concurrent_token(200)
+print(f"  acquire x3: {t1.status.name}, {t2.status.name}, {t3.status.name}")
+print(f"  release first: {client.release_concurrent_token(t1.token_id).status.name}")
+print(f"  acquire again: {client.request_concurrent_token(200).status.name}")
+
+client.stop()
+server.stop()

@@ -1,0 +1,46 @@
+"""The full observability plane: engine + command center + heartbeat +
+metric log timer + dashboard with the embedded web console
+(sentinel-dashboard + sentinel-transport wired together).
+
+Open http://127.0.0.1:18720/ while it runs.
+"""
+
+import _bootstrap  # noqa: F401
+
+import time
+
+import sentinel_tpu as st
+from sentinel_tpu.dashboard import DashboardServer
+from sentinel_tpu.metrics.metric_log import MetricTimer
+from sentinel_tpu.transport.command_center import CommandCenter
+from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+st.flow_rule_manager.load_rules([
+    st.FlowRule("checkout", count=3),
+    st.FlowRule("search", count=50),
+])
+
+center = CommandCenter(port=18719).start()
+dashboard = DashboardServer(port=18720, fetch_interval_sec=0.5).start()
+HeartbeatSender("127.0.0.1:18720", command_port=18719, interval_sec=1.0).start()
+MetricTimer(st.get_engine(), interval_sec=0.5).start()
+
+print("command API  : http://127.0.0.1:18719/api")
+print("Prometheus   : http://127.0.0.1:18719/metrics")
+print("web console  : http://127.0.0.1:18720/")
+print("offering traffic for 60s (checkout pinned at 3/s) — ctrl-c to stop")
+
+deadline = time.time() + 60
+try:
+    while time.time() < deadline:
+        for _ in range(5):
+            for resource in ("checkout", "search"):
+                e = st.try_entry(resource)
+                if e:
+                    e.exit()
+        time.sleep(0.25)
+except KeyboardInterrupt:
+    pass
+finally:
+    dashboard.stop()
+    center.stop()

@@ -1,0 +1,43 @@
+"""Circuit breaking — exception-ratio breaker trips OPEN, rejects while
+open, probes HALF_OPEN after the time window, recovers on a good probe
+(sentinel-demo-basic degrade demos).
+"""
+
+import _bootstrap  # noqa: F401
+
+import sentinel_tpu as st
+from sentinel_tpu.core import api
+from sentinel_tpu.utils.clock import ManualClock, set_default_clock
+
+# A manual clock makes the state machine visible step by step.
+clock = ManualClock(0)
+set_default_clock(clock)
+api.reset(clock=clock)
+
+st.flow_rule_manager.load_rules([st.FlowRule("backend", count=1000)])
+st.degrade_rule_manager.load_rules([
+    st.DegradeRule(resource="backend", grade=1, count=0.5,  # >50% errors
+                   time_window=5, min_request_amount=5)
+])
+
+
+def call(ts, fail):
+    clock.set_ms(ts)
+    try:
+        e = st.entry("backend")
+    except st.DegradeBlockError:
+        return "BLOCKED (breaker open)"
+    if fail:
+        e.set_error(RuntimeError("downstream 500"))
+    e.exit()
+    return "error" if fail else "ok"
+
+
+print("-- 6 failing calls (ratio 100% > 50%, minRequest=5): breaker trips")
+for i in range(6):
+    print(f"  t={i * 10}ms: {call(i * 10, fail=True)}")
+print(f"-- while OPEN: {call(1000, fail=False)}")
+print(f"-- still OPEN: {call(3000, fail=False)}")
+print("-- after the 5s time window, one probe goes through HALF_OPEN:")
+print(f"  t=5200ms: {call(5200, fail=False)}")
+print(f"-- good probe closed the breaker: {call(5300, fail=False)}")

@@ -1,0 +1,37 @@
+"""Hot-parameter flow control — per-argument-value rate limits with an
+exception item (sentinel-demo-parameter-flow-control).
+"""
+
+import _bootstrap  # noqa: F401
+
+import sentinel_tpu as st
+from sentinel_tpu.core import api
+from sentinel_tpu.models.rules import ParamFlowItem
+from sentinel_tpu.utils.clock import ManualClock, set_default_clock
+
+clock = ManualClock(0)
+set_default_clock(clock)
+api.reset(clock=clock)
+
+# 2 QPS per product id, but the flash-sale item gets 5.
+st.param_flow_rule_manager.load_rules([
+    st.ParamFlowRule(
+        resource="buy", param_idx=0, count=2,
+        param_flow_item_list=[ParamFlowItem(object="flash-sale", count=5)],
+    )
+])
+
+
+def attempt(ts, product):
+    clock.set_ms(ts)
+    e = st.try_entry("buy", args=(product,))
+    if e:
+        e.exit()
+        return "pass"
+    return "BLOCK"
+
+
+for product in ("normal-item", "flash-sale"):
+    results = [attempt(100 + i, product) for i in range(7)]
+    print(f"{product:12s}: {' '.join(results)}")
+print("normal-item passes 2, flash-sale passes 5 — per-value budgets")

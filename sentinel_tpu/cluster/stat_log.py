@@ -23,6 +23,9 @@ _logger: Optional[BlockLogger] = None
 
 def _get_logger() -> BlockLogger:
     global _logger
+    logger = _logger
+    if logger is not None:  # fast path: no lock once initialized
+        return logger
     with _lock:
         if _logger is None:
             _logger = BlockLogger(file_name=FILE_NAME)
@@ -40,6 +43,14 @@ def log(category: str, outcome: str, flow_id: int, count: int = 1) -> None:
     """``log("concurrent", "block", flowId, n)`` ≙
     ClusterServerStatLogUtil.log("concurrent|block|<id>", n)."""
     _get_logger().stat(category, outcome, str(int(flow_id)), count=count)
+
+
+def log_many(items) -> None:
+    """Batched variant: one lock acquisition for a whole flush's
+    decisions — items of (category, outcome, flow_id, count)."""
+    _get_logger().log_batch(
+        [(c, o, str(int(f)), n) for c, o, f, n in items]
+    )
 
 
 def flush() -> None:

@@ -237,17 +237,20 @@ class DefaultTokenService(TokenService):
             admitted_h, ns_ok_h, rem_h = jax.device_get((admitted, ns_ok, remaining))
         from sentinel_tpu.cluster import stat_log
 
+        stat_items = []
         for j, i in enumerate(idxs):
             flow_id, acquire_count, _ = requests[i]
             if not ns_ok_h[j]:
                 out[i] = TokenResult(C.TokenResultStatus.TOO_MANY_REQUEST)
-                stat_log.log("flow", "tooManyRequest", flow_id, int(acquire_count))
+                stat_items.append(("flow", "tooManyRequest", flow_id, int(acquire_count)))
             elif admitted_h[j]:
                 out[i] = TokenResult(C.TokenResultStatus.OK, remaining=int(max(rem_h[j], 0)))
-                stat_log.log("flow", "pass", flow_id, int(acquire_count))
+                stat_items.append(("flow", "pass", flow_id, int(acquire_count)))
             else:
                 out[i] = TokenResult(C.TokenResultStatus.BLOCKED)
-                stat_log.log("flow", "block", flow_id, int(acquire_count))
+                stat_items.append(("flow", "block", flow_id, int(acquire_count)))
+        if stat_items:
+            stat_log.log_many(stat_items)
         return [r if r is not None else TokenResult(C.TokenResultStatus.FAIL) for r in out]
 
     def request_param_token(

@@ -73,6 +73,7 @@ CONSOLE_HTML = """<!DOCTYPE html>
 </main>
 <script>
 let app = null;
+let rulesLoaded = false;  // first successful app discovery loads rules
 const hist = {};           // resource -> [{t, pass, block}]
 const $ = (id) => document.getElementById(id);
 const fetchJson = (url) => fetch(url).then(r => r.json());
@@ -97,6 +98,7 @@ async function refreshApps() {
     el.querySelectorAll('button').forEach(b =>
       b.addEventListener('click', () => selectApp(names[+b.dataset.i])));
     $('appname').textContent = '— ' + app;
+    if (!rulesLoaded) { rulesLoaded = true; loadRules(); }
   } catch (e) { $('status').textContent = 'apps: ' + e; }
 }
 function selectApp(n) { app = n; refreshApps(); refreshMetrics(); loadRules(); }
@@ -153,13 +155,14 @@ async function pushRules() {
   let data;
   try { data = JSON.stringify(JSON.parse($('rules').value)); }
   catch (e) { $('status').textContent = 'rules are not valid JSON'; return; }
-  const resp = await fetchJson(`/rules?app=${encodeURIComponent(app)}&type=${kind}&data=${encodeURIComponent(data)}`);
-  $('status').textContent = resp.code === 0 ? 'rules pushed' : 'push failed';
+  try {
+    const resp = await fetchJson(`/rules?app=${encodeURIComponent(app)}&type=${kind}&data=${encodeURIComponent(data)}`);
+    $('status').textContent = resp.code === 0 ? 'rules pushed' : 'push failed';
+  } catch (e) { $('status').textContent = 'push failed: ' + e; }
 }
 
 refreshApps(); setInterval(refreshApps, 5000);
 refreshMetrics(); setInterval(refreshMetrics, 2000);
-loadRules();
 </script>
 </body>
 </html>
