@@ -299,7 +299,117 @@ def _demote_over_borrow(
     return _split_and_spend(axis, batch, nr, borrower, acq_f, cap_slot)
 
 
-def make_sharded_flush(mesh, axis: str = "data", occupy_timeout_ms: int = 500):
+def _global_param_scan(axis, pdyn, param_g, live_up, n_local):
+    """Run the hot-param scan once per chip on the GLOBALLY-replicated
+    item batch — every chip computes the identical new param state (no
+    merge needed), and the scan sees the global (value-row, ts)-ordered
+    stream, so token-bucket/throttle/thread semantics are exactly the
+    single-chip ones.
+
+    Item liveness (auth/system verdicts of the item's entry) lives on
+    the entry's owner chip only; one psum ORs the owner bits so every
+    chip gates the scan identically. Returns (new_pdyn, per-local-entry
+    (param_ok, wait_param), owner mask, local entry idx).
+    """
+    from sentinel_tpu.rules.param_table import run_param
+
+    c = jax.lax.axis_index(axis)
+    owner = (param_g.eidx // n_local) == c
+    lidx = jnp.clip(param_g.eidx % n_local, 0, n_local - 1)
+    # Exits release per-value thread slots first (replicated op —
+    # identical on every chip).
+    pr0 = pdyn.threads.shape[0]
+    dec_rows = jnp.where(param_g.exit_rows >= 0, param_g.exit_rows, jnp.int32(pr0))
+    pdyn = pdyn._replace(threads=pdyn.threads.at[dec_rows].add(-1, mode="drop"))
+    live_bit = owner & live_up[lidx]
+    item_live = jax.lax.psum(live_bit.astype(jnp.int32), axis) > 0
+    pg_live = param_g._replace(valid=param_g.valid & item_live)
+    new_pdyn, p_ok, p_wait = run_param(pdyn, pg_live)
+    drop = jnp.int32(n_local)
+    sc = jnp.where(pg_live.valid & owner, lidx, drop)
+    param_ok_local = jnp.ones((n_local,), dtype=bool).at[sc].min(p_ok, mode="drop")
+    wait_local = jnp.zeros((n_local,), dtype=jnp.int32).at[sc].max(p_wait, mode="drop")
+    return new_pdyn, (param_ok_local, wait_local), owner, lidx
+
+
+def _global_shaping_scan(axis, stats_x, flow_dev, flow_dyn, shaping_g, batch, live_up, n_local, k):
+    """Run the shaping pacer scan once per chip on the GLOBALLY-
+    replicated item batch: replicated ``flow_dyn`` in, identical new
+    ``flow_dyn`` out on every chip, and the ``lax.scan`` sees the global
+    (rule, ts)-ordered request stream — exactly the single-chip pacer
+    semantics (a chip-local scan would let every chip pace its own
+    sub-stream and admit n× the configured rate).
+
+    ``passQps`` for the warm-up math is rebuilt deterministically from
+    the replicated post-exit windows plus the intra-batch charge among
+    the global shaping items themselves — charged over ALL valid items
+    regardless of upstream liveness, exactly like flow_admission's
+    unmasked ``consumed_acq`` on the single-chip path. Charges from
+    co-row DEFAULT slots within this same flush are not visible to it
+    (they land in the windows by the next flush) — a within-one-flush
+    optimism that only matters when a warm-up rule shares its check row
+    with a DEFAULT rule matching a *different* entry set.
+    """
+    from sentinel_tpu.metrics import metric_array as ma
+    from sentinel_tpu.metrics.events import MetricEvent
+    from sentinel_tpu.metrics.nodes import SECOND_CFG
+    from sentinel_tpu.runtime.flush import (
+        _prev_second_pass,
+        _segment_consumed,
+    )
+    from sentinel_tpu.rules.shaping import run_shaping
+
+    c = jax.lax.axis_index(axis)
+    owner = (shaping_g.eidx // n_local) == c
+    lidx = jnp.clip(shaping_g.eidx % n_local, 0, n_local - 1)
+    live_bit = owner & live_up[lidx]
+    item_live = jax.lax.psum(live_bit.astype(jnp.int32), axis) > 0
+    sg_live = shaping_g._replace(valid=shaping_g.valid & item_live)
+
+    s = sg_live.valid.shape[0]
+    r_rows = stats_x.n_rows
+    pass_sums = ma.window_sums(SECOND_CFG, stats_x.second, batch.now)[:, MetricEvent.PASS]
+    # Charge population = every valid item, NOT gated by liveness: the
+    # single-chip pass_plus_consumed charges upstream-blocked entries
+    # too (flow_admission's consumed_acq is unmasked), and parity with
+    # it is the contract. Only the scan's state advance is live-gated.
+    rkey = jnp.where(shaping_g.valid, shaping_g.row, jnp.int32(r_rows))
+    pos = jnp.arange(s, dtype=jnp.int32)
+    rk_s, _, ei_s, p_s = jax.lax.sort(
+        (rkey, shaping_g.ts, shaping_g.eidx, pos), num_keys=3
+    )
+    ones = jnp.ones((1,), dtype=bool)
+    new_grp = jnp.concatenate([ones, rk_s[1:] != rk_s[:-1]])
+    last_of_ent = jnp.concatenate([rk_s[1:] != rk_s[:-1], ones]) | jnp.concatenate(
+        [ei_s[1:] != ei_s[:-1], ones]
+    )
+    valid_sorted = shaping_g.valid[p_s]
+    consumed = _segment_consumed(
+        new_grp, last_of_ent, jnp.where(valid_sorted, shaping_g.acquire[p_s], 0)
+    )
+    base = pass_sums[jnp.clip(rk_s, 0, r_rows - 1)]
+    ppc = (
+        jnp.zeros((s,), dtype=jnp.int32)
+        .at[p_s]
+        .set((base + consumed).astype(jnp.int32))
+    )
+    prev = _prev_second_pass(stats_x, shaping_g.row, shaping_g.ts)
+    interval_sec = SECOND_CFG.interval_ms / 1000.0
+    new_fdyn, ok_s, wait_s = run_shaping(
+        flow_dev, flow_dyn, sg_live, ppc, prev, interval_sec
+    )
+    lflat = lidx * k + shaping_g.flat_pos % k
+    shaping_pre = (sg_live.valid & owner, lflat, lidx, ok_s, wait_s)
+    return new_fdyn, shaping_pre
+
+
+def make_sharded_flush(
+    mesh,
+    axis: str = "data",
+    occupy_timeout_ms: int = 500,
+    with_shaping: bool = False,
+    with_param: bool = False,
+):
     """The full batched step over an n-device mesh.
 
     Entries and exits are data-parallel across chips; counter tensors
@@ -316,16 +426,24 @@ def make_sharded_flush(mesh, axis: str = "data", occupy_timeout_ms: int = 500):
     reference's token-server RPC (one all-gather over ICI instead of a
     Netty round-trip per request).
 
-    Returns a jitted callable with the same signature as ``flush_step``
-    (without shaping/param batches — their per-rule scans are
-    inherently serializing and stay single-chip for now).
+    ``with_shaping`` / ``with_param`` extend the signature with a
+    ShapingBatch / ParamBatch holding the GLOBAL item set (replicated on
+    every chip, ``eidx``/``flat_pos`` in global coordinates): the
+    serializing per-rule scans run once per chip on replicated state —
+    identical results everywhere, global-stream ordering — and each chip
+    scatters its own entries' verdicts (see the helpers above). The
+    returned callable's signature then matches ``flush_step`` with the
+    same optional batches appended.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from sentinel_tpu.runtime.flush import apply_exit_phase, flush_entries
+    from sentinel_tpu.runtime.flush import apply_exit_phase, flush_entries, system_check
 
-    def sharded_step(stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch):
+    def sharded_step(
+        stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch,
+        shaping_g=None, param_g=None,
+    ):
         from sentinel_tpu.metrics.nodes import materialize_matured
         from sentinel_tpu.rules.degrade_table import CLOSED as _CLOSED, OPEN as _OPEN
 
@@ -336,10 +454,37 @@ def make_sharded_flush(mesh, axis: str = "data", occupy_timeout_ms: int = 500):
         stats = materialize_matured(stats, batch.now)
         # Exits once; both admission passes see the post-exit stats.
         stats_x, ddyn_x = apply_exit_phase(stats, ddev, ddyn, batch)
+
+        # ---- global serializing scans (shaping pacers, hot params) ----
+        # Upstream liveness (auth + system) for this chip's entries —
+        # the owner-chip bits gate the replicated global scans.
+        n_local = batch.e_valid.shape[0]
+        k = batch.e_rule_gid.shape[1]
+        param_pre = None
+        shaping_pre = None
+        new_pdyn_scan = None
+        new_fdyn_scan = None
+        p_owner = p_lidx = None
+        if shaping_g is not None or param_g is not None:
+            live0 = batch.e_valid & batch.e_auth_ok
+            sys_ok, _ = system_check(stats_x, sysdev, batch, live0)
+            live_up = live0 & sys_ok
+            if param_g is not None:
+                new_pdyn_scan, param_pre, p_owner, p_lidx = _global_param_scan(
+                    axis, pdyn, param_g, live_up, n_local
+                )
+                live_up = live_up & param_pre[0]
+            if shaping_g is not None:
+                new_fdyn_scan, shaping_pre = _global_shaping_scan(
+                    axis, stats_x, flow_dev, flow_dyn, shaping_g, batch,
+                    live_up, n_local, k,
+                )
+
         # Pass 1 (no state writes): local flow-level admission demand.
         _, _, _, _, r1 = flush_entries(
             stats_x, flow_dev, flow_dyn, ddev, ddyn_x, pdyn, sysdev, batch,
             commit=False, occupy_timeout_ms=occupy_timeout_ms,
+            param_pre=param_pre, shaping_pre=shaping_pre,
         )
         # Occupied entries borrow from future windows, not the current
         # budget — exclude them from the grant math (their slab commits
@@ -389,7 +534,26 @@ def make_sharded_flush(mesh, axis: str = "data", occupy_timeout_ms: int = 500):
         new_stats, new_fdyn, new_ddyn, new_pdyn, result = flush_entries(
             stats_x, flow_dev, flow_dyn, ddev, ddyn_x, pdyn, sysdev, batch2,
             occupy_timeout_ms=occupy_timeout_ms, probe_allowed=probe_allowed,
+            param_pre=param_pre, shaping_pre=shaping_pre,
         )
+        # The serializing scans own their state families: the global
+        # shaping scan's pacer columns and the global param scan's
+        # buckets (plus thread-gauge increments for finally-admitted
+        # entries, ORed across owner chips) replace the untouched
+        # pass-through values.
+        if new_fdyn_scan is not None:
+            new_fdyn = new_fdyn_scan
+        if new_pdyn_scan is not None:
+            from sentinel_tpu.models import constants as _C
+
+            adm_bit = p_owner & param_g.valid & result.admitted[p_lidx]
+            adm_item = jax.lax.psum(adm_bit.astype(jnp.int32), axis) > 0
+            inc = param_g.valid & (param_g.grade == _C.FLOW_GRADE_THREAD) & adm_item
+            pr = new_pdyn_scan.threads.shape[0]
+            inc_rows = jnp.where(inc, param_g.prow, jnp.int32(pr))
+            new_pdyn = new_pdyn_scan._replace(
+                threads=new_pdyn_scan.threads.at[inc_rows].add(1, mode="drop")
+            )
         merged = merge_stats_across(stats, new_stats, axis)
         # Breaker state machine: transitions happen on the one chip
         # whose shard carried the probe's entry/exit, so "any chip that
@@ -450,10 +614,21 @@ def make_sharded_flush(mesh, axis: str = "data", occupy_timeout_ms: int = 500):
         )
         return merged, new_fdyn, merged_ddyn, new_pdyn, result
 
+    # Shaping/param item batches are replicated (P() pytree prefix):
+    # every chip holds the full global item set for the scans.
+    in_specs = [P(), P(), P(), P(), P(), P(), P(), batch_partition_specs(axis)]
+    if with_shaping:
+        in_specs.append(P())
+    if with_param:
+        in_specs.append(P())
+    names = [
+        kw for kw, on in (("shaping_g", with_shaping), ("param_g", with_param)) if on
+    ]
+    body = lambda *a: sharded_step(*a[:8], **dict(zip(names, a[8:])))  # noqa: E731
     fn = shard_map(
-        sharded_step,
+        body,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(), P(), P(), batch_partition_specs(axis)),
+        in_specs=tuple(in_specs),
         out_specs=(P(), P(), P(), P(), P(axis)),
         check_vma=False,
     )

@@ -193,7 +193,7 @@ class Engine:
         self.enabled = True
         # Sharded (multi-chip) mode — see enable_mesh().
         self.mesh = None
-        self._sharded_fn = None
+        self._sharded_fns: Optional[Dict[Tuple[bool, bool], object]] = None
         self._n_shards = 1
         # Block log (LogSlot → sentinel-block.log); file IO happens only
         # when a blocked verdict is actually aggregated out.
@@ -214,12 +214,14 @@ class Engine:
         (sentinel-cluster-server-default/.../SentinelDefaultTokenServer.
         java:37) collapsed into ICI collectives.
 
-        Traffic-shaping flow rules and hot-param rules are rejected at
-        rule load while the mesh is enabled: their pacer scans are
-        serializing per rule and stay single-chip — loading one raises
-        instead of silently leaving it unenforced (round-2 weak #3).
+        All four control behaviors plus hot-param rules run on the
+        mesh: the serializing per-rule scans (shaping pacers, param
+        token buckets) execute once per chip on globally-replicated
+        item batches — identical results everywhere, global-stream
+        ordering — so their semantics match single-chip exactly
+        (parallel/ici._global_shaping_scan / _global_param_scan).
         """
-        from sentinel_tpu.parallel import make_mesh, make_sharded_flush
+        from sentinel_tpu.parallel import make_mesh
 
         drained = ([], [])
         try:
@@ -231,12 +233,9 @@ class Engine:
                         raise ValueError(
                             f"mesh size must be a power of two, got {n}"
                         )
-                    self._validate_mesh_rules(self.flow_index, self.param_index)
                     self.mesh = make_mesh(n)
                     self._n_shards = n
-                    self._sharded_fn = make_sharded_flush(
-                        self.mesh, occupy_timeout_ms=config.occupy_timeout_ms
-                    )
+                    self._sharded_fns = {}
         finally:
             self._post_flush(drained)
     def disable_mesh(self) -> None:
@@ -246,25 +245,27 @@ class Engine:
                 self._flush_locked(drained)
                 with self._lock:
                     self.mesh = None
-                    self._sharded_fn = None
+                    self._sharded_fns = None
                     self._n_shards = 1
         finally:
             self._post_flush(drained)
-    @staticmethod
-    def _validate_mesh_rules(findex: FlowIndex, pindex: ParamIndex) -> None:
-        if findex.shaping_gids:
-            raise ValueError(
-                "sharded mode: traffic-shaping flow rules (rate-limiter/"
-                "warm-up controlBehavior) are not supported on the mesh — "
-                "their pacer state is serializing per rule; load them on a "
-                "single-chip engine or drop controlBehavior to DEFAULT"
+    def _sharded_fn_for(self, with_shaping: bool, with_param: bool):
+        """Lazily-built sharded kernel variants (like the four single-
+        chip jit variants: traffic without shaping/param rules never
+        pays for their machinery)."""
+        from sentinel_tpu.parallel import make_sharded_flush
+
+        key = (with_shaping, with_param)
+        fn = self._sharded_fns.get(key)
+        if fn is None:
+            fn = make_sharded_flush(
+                self.mesh,
+                occupy_timeout_ms=config.occupy_timeout_ms,
+                with_shaping=with_shaping,
+                with_param=with_param,
             )
-        if pindex.has_rules():
-            raise ValueError(
-                "sharded mode: hot-param rules are not supported on the "
-                "mesh — per-value token buckets are serializing per rule; "
-                "use a single-chip engine for param flow"
-            )
+            self._sharded_fns[key] = fn
+        return fn
 
     # ------------------------------------------------------------------
     # rule plumbing (called by rule managers)
@@ -276,8 +277,6 @@ class Engine:
                 self._flush_locked(drained)  # decisions for pending ops use the old rules
                 with self._lock:
                     findex = FlowIndex(rules, cold_factor=config.cold_factor)
-                    if self.mesh is not None:
-                        self._validate_mesh_rules(findex, self.param_index)
                     self.flow_index = findex
                     self.flow_dyn = findex.make_dyn_state()
         finally:
@@ -303,8 +302,6 @@ class Engine:
                 self._flush_locked(drained)
                 with self._lock:
                     pindex = ParamIndex(by_resource)
-                    if self.mesh is not None:
-                        self._validate_mesh_rules(self.flow_index, pindex)
                     self.param_index = pindex
                     self.param_dyn = make_param_state(8)
         finally:
@@ -996,11 +993,13 @@ class Engine:
             sysdev,
             batch,
         )
-        if self._sharded_fn is not None:
-            # Mesh mode: one global batch sharded over the chips; rule
-            # validation guarantees no shaping/param batches exist.
-            assert shaping is None and param is None
-            out = self._sharded_fn(*common)
+        if self._sharded_fns is not None:
+            # Mesh mode: one global batch sharded over the chips;
+            # shaping/param item batches (global coordinates) ride
+            # replicated into the globally-ordered scans.
+            fn = self._sharded_fn_for(shaping is not None, param is not None)
+            extra = tuple(b for b in (shaping, param) if b is not None)
+            out = fn(*common, *extra)
         elif shaping is None and param is None:
             out = flush_step_jit(*common, occupy_timeout_ms=occ_ms)
         elif param is None:

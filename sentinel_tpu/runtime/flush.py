@@ -571,6 +571,8 @@ def flush_entries(
     commit: bool = True,
     occupy_timeout_ms: int = 500,
     probe_allowed: Optional[jax.Array] = None,
+    param_pre: Optional[Tuple[jax.Array, jax.Array]] = None,
+    shaping_pre: Optional[Tuple[jax.Array, ...]] = None,
 ) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
     """Phases 2-3: admission checks and (when ``commit``) accounting.
 
@@ -579,6 +581,14 @@ def flush_entries(
     gauges) — the demand-probe pass of the sharded path.
     ``probe_allowed`` (bool [ND]) restricts HALF_OPEN probe candidacy to
     elected breakers — the sharded path's cross-chip probe election.
+
+    ``param_pre`` / ``shaping_pre`` carry verdicts precomputed OUTSIDE
+    this call — the sharded path runs the serializing per-rule scans
+    once on globally-replicated item batches (parallel/ici) and feeds
+    each chip its local slice here; no pacer/param state is touched:
+    * ``param_pre = (param_ok [N] bool, wait_param [N] int32)``
+    * ``shaping_pre = (valid [S] bool, flat_pos [S], eidx [S],
+      ok [S] bool, wait_ms [S] int32)`` with local positions.
     """
     n = batch.e_valid.shape[0]
 
@@ -592,7 +602,9 @@ def flush_entries(
     # ---- phase 2b': hot-parameter rules (ParamFlowSlot, order -3000) ----
     wait_param = jnp.zeros((n,), dtype=jnp.int32)
     param_ok = jnp.ones((n,), dtype=bool)
-    if param is not None:
+    if param_pre is not None:
+        param_ok, wait_param = param_pre
+    elif param is not None:
         # Exits release per-value thread slots before this batch's checks
         # (ParamFlowStatisticExitCallback runs at completion).
         pr0 = pdyn.threads.shape[0]
@@ -633,6 +645,15 @@ def flush_entries(
         flow_pass = slot_ok.all(axis=1)
         eidx_scatter = jnp.where(shaping_live.valid, shaping.eidx, jnp.int32(n))
         wait_ms = wait_ms.at[eidx_scatter].max(wait_s, mode="drop")
+    if shaping_pre is not None:
+        sp_valid, sp_flat, sp_eidx, sp_ok, sp_wait = shaping_pre
+        flat_ok = slot_ok.reshape(-1)
+        scatter_pos = jnp.where(sp_valid, sp_flat, jnp.int32(flat_ok.shape[0]))
+        flat_ok = flat_ok.at[scatter_pos].min(sp_ok, mode="drop")
+        slot_ok = flat_ok.reshape(slot_ok.shape)
+        flow_pass = slot_ok.all(axis=1)
+        eidx_scatter = jnp.where(sp_valid, sp_eidx, jnp.int32(n))
+        wait_ms = wait_ms.at[eidx_scatter].max(sp_wait, mode="drop")
     flow_pass = flow_pass & batch.e_cluster_ok
     live2 = live & flow_pass
     wait_ms = jnp.where(live2, wait_ms, 0)

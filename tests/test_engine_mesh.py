@@ -142,34 +142,185 @@ class TestEngineMesh:
         finally:
             config.set(config.OCCUPY_TIMEOUT_MS, "500")
 
-    def test_shaping_rules_rejected_on_mesh(self, mesh_engine):
+    def test_rate_limiter_parity_with_single_chip(self, mesh_engine, manual_clock):
+        """The pacer scan on the mesh sees the GLOBAL (rule, ts)-ordered
+        stream: verdicts and queue waits match a single-chip engine on
+        the identical op stream exactly (a chip-local pacer would admit
+        up to n_chips× the configured rate)."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models import constants as C
+        from sentinel_tpu.runtime.engine import Engine
+
+        rules = [
+            st.FlowRule(
+                "rl", count=10,
+                control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                max_queueing_time_ms=500,
+            )
+        ]
+        mesh_engine.set_flow_rules(rules)
+        ref = Engine(clock=manual_clock)
+        ref.set_flow_rules(rules)
+        manual_clock.set_ms(1000)
+        reqs = [{"resource": "rl", "ts": 1000 + 7 * i} for i in range(24)]
+        ops_m = mesh_engine.submit_many([dict(r) for r in reqs])
+        mesh_engine.flush()
+        ops_r = ref.submit_many([dict(r) for r in reqs])
+        ref.flush()
+        got = [(o.verdict.admitted, o.verdict.wait_ms) for o in ops_m]
+        want = [(o.verdict.admitted, o.verdict.wait_ms) for o in ops_r]
+        assert got == want
+        # cost=100ms, maxq=500ms: 1 immediate + queued while wait ≤ 500.
+        assert 1 < sum(a for a, _ in got) < len(reqs)
+
+    def test_warmup_parity_with_single_chip(self, mesh_engine, manual_clock):
+        """Warm-up token ramp on the mesh: cold-start admission across
+        two flushes matches single-chip exactly (replicated syncToken +
+        global intra-batch charge)."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models import constants as C
+        from sentinel_tpu.runtime.engine import Engine
+
+        rules = [
+            st.FlowRule(
+                "wu", count=100,
+                control_behavior=C.CONTROL_BEHAVIOR_WARM_UP,
+                warm_up_period_sec=10,
+            )
+        ]
+        mesh_engine.set_flow_rules(rules)
+        ref = Engine(clock=manual_clock)
+        ref.set_flow_rules(rules)
+        for t in (1000, 2500):
+            manual_clock.set_ms(t)
+            reqs = [{"resource": "wu", "ts": t} for _ in range(64)]
+            ops_m = mesh_engine.submit_many([dict(r) for r in reqs])
+            mesh_engine.flush()
+            ops_r = ref.submit_many([dict(r) for r in reqs])
+            ref.flush()
+            got = [o.verdict.admitted for o in ops_m]
+            want = [o.verdict.admitted for o in ops_r]
+            assert got == want
+            # Cold system: some but not all of the burst is admitted.
+            assert 0 < sum(got) < len(reqs)
+
+    def test_warmup_parity_with_upstream_blocked_entries(self, mesh_engine, manual_clock):
+        """Upstream-blocked (authority) entries still charge the
+        warm-up passQps input on both paths — the mesh rebuild uses the
+        same unmasked charge population as flow_admission, so verdicts
+        stay identical even when the batch mixes blocked origins in."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models import constants as C
+        from sentinel_tpu.models.rules import AuthorityRule
+        from sentinel_tpu.runtime.engine import Engine
+
+        rules = [
+            st.FlowRule(
+                "wb", count=100,
+                control_behavior=C.CONTROL_BEHAVIOR_WARM_UP,
+                warm_up_period_sec=10,
+            )
+        ]
+        auth = {"wb": AuthorityRule(resource="wb", limit_app="bad",
+                                    strategy=C.AUTHORITY_BLACK)}
+        mesh_engine.set_flow_rules(rules)
+        mesh_engine.set_authority_rules(auth)
+        ref = Engine(clock=manual_clock)
+        ref.set_flow_rules(rules)
+        ref.set_authority_rules(auth)
+        manual_clock.set_ms(1000)
+        reqs = [
+            {"resource": "wb", "ts": 1000, "origin": "bad" if i % 3 == 0 else "ok"}
+            for i in range(48)
+        ]
+        ops_m = mesh_engine.submit_many([dict(r) for r in reqs])
+        mesh_engine.flush()
+        ops_r = ref.submit_many([dict(r) for r in reqs])
+        ref.flush()
+        got = [(o.verdict.admitted, o.verdict.reason) for o in ops_m]
+        want = [(o.verdict.admitted, o.verdict.reason) for o in ops_r]
+        assert got == want
+        assert any(not a for a, _ in got)
+
+    def test_param_bucket_conserved_and_parity_on_mesh(self, mesh_engine, manual_clock):
+        """One hot value's token bucket spans all chips: exactly
+        ``count`` admissions globally, verdict-for-verdict equal to
+        single-chip."""
+        import sentinel_tpu as st
+        from sentinel_tpu.runtime.engine import Engine
+
+        rules = {"pp": [st.ParamFlowRule(resource="pp", param_idx=0, count=5)]}
+        mesh_engine.set_param_rules(rules)
+        ref = Engine(clock=manual_clock)
+        ref.set_param_rules(rules)
+        manual_clock.set_ms(1000)
+        reqs = [
+            {"resource": "pp", "ts": 1000, "args": ("user-1",)} for _ in range(16)
+        ]
+        ops_m = mesh_engine.submit_many([dict(r) for r in reqs])
+        mesh_engine.flush()
+        ops_r = ref.submit_many([dict(r) for r in reqs])
+        ref.flush()
+        got = [o.verdict.admitted for o in ops_m]
+        assert got == [o.verdict.admitted for o in ops_r]
+        assert sum(got) == 5
+
+    def test_param_thread_grade_with_exits_on_mesh(self, mesh_engine, manual_clock):
+        """Per-value concurrency on the mesh: the global gauge caps at
+        the threshold; exits release slots for the next flush."""
         import sentinel_tpu as st
         from sentinel_tpu.models import constants as C
 
-        with pytest.raises(ValueError, match="shaping"):
-            mesh_engine.set_flow_rules(
-                [st.FlowRule("s", count=10,
-                             control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER)]
-            )
-
-    def test_param_rules_rejected_on_mesh(self, mesh_engine):
-        import sentinel_tpu as st
-
-        with pytest.raises(ValueError, match="param"):
-            mesh_engine.set_param_rules(
-                {"p": [st.ParamFlowRule(resource="p", param_idx=0, count=5)]}
-            )
-
-    def test_enable_mesh_rejects_existing_shaping_rules(self, manual_clock, engine):
-        import sentinel_tpu as st
-        from sentinel_tpu.models import constants as C
-
-        engine.set_flow_rules(
-            [st.FlowRule("s", count=10,
-                         control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER)]
+        mesh_engine.set_param_rules(
+            {"tg": [st.ParamFlowRule(resource="tg", param_idx=0, count=3,
+                                     grade=C.FLOW_GRADE_THREAD)]}
         )
-        with pytest.raises(ValueError, match="shaping"):
-            engine.enable_mesh(8)
+        ops = mesh_engine.submit_many(
+            [{"resource": "tg", "args": ("v",)} for _ in range(8)]
+        )
+        mesh_engine.flush()
+        assert sum(op.verdict.admitted for op in ops) == 3
+        winner = next(op for op in ops if op.verdict.admitted)
+        for _ in range(2):
+            mesh_engine.submit_exit(
+                winner.rows, rt=5, resource="tg",
+                param_rows=winner.param_thread_rows,
+            )
+        ops2 = mesh_engine.submit_many(
+            [{"resource": "tg", "args": ("v",)} for _ in range(8)]
+        )
+        mesh_engine.flush()
+        assert sum(op.verdict.admitted for op in ops2) == 2
+
+    def test_shaping_and_default_budget_together_on_mesh(self, mesh_engine, manual_clock):
+        """A DEFAULT rule and a rate-limiter rule on one resource: the
+        cross-chip budget demotion and the global pacer compose — and
+        match single-chip verdict-for-verdict."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models import constants as C
+        from sentinel_tpu.runtime.engine import Engine
+
+        rules = [
+            st.FlowRule("mix", count=20),
+            st.FlowRule(
+                "mix", count=50,
+                control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                max_queueing_time_ms=500,
+            ),
+        ]
+        mesh_engine.set_flow_rules(rules)
+        ref = Engine(clock=manual_clock)
+        ref.set_flow_rules(rules)
+        manual_clock.set_ms(1000)
+        reqs = [{"resource": "mix", "ts": 1000} for _ in range(128)]
+        ops_m = mesh_engine.submit_many([dict(r) for r in reqs])
+        mesh_engine.flush()
+        ops_r = ref.submit_many([dict(r) for r in reqs])
+        ref.flush()
+        got = [o.verdict.admitted for o in ops_m]
+        assert got == [o.verdict.admitted for o in ops_r]
+        # DEFAULT budget (20) binds tighter than the pacer here.
+        assert sum(got) == 20
 
     def test_non_pow2_mesh_rejected(self, manual_clock, engine):
         with pytest.raises(ValueError, match="power of two"):
@@ -180,7 +331,6 @@ class TestEngineMesh:
         from sentinel_tpu.models import constants as C
 
         mesh_engine.disable_mesh()
-        # Shaping rules load fine again off-mesh.
         mesh_engine.set_flow_rules(
             [st.FlowRule("s", count=10,
                          control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER)]
