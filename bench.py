@@ -225,7 +225,9 @@ def _run_engine_stage(n_rules: int, n_ops: int, iters: int) -> dict:
     """Engine-level deferred-mode throughput: submit_many + flush through
     the real host path (string interning, slot resolution, encode,
     kernel, verdict fill) — the end-to-end ops/sec a product user sees
-    (round-1 #7 bench case)."""
+    (round-1 #7 bench case). Also measures the columnar bulk path
+    (``submit_bulk``: one resolution per group, numpy-slice encode,
+    array verdicts) at a proportionally larger op count."""
     from sentinel_tpu.models.rules import FlowRule
     from sentinel_tpu.runtime.engine import Engine
 
@@ -243,10 +245,28 @@ def _run_engine_stage(n_rules: int, n_ops: int, iters: int) -> dict:
     dt = (time.perf_counter() - t0) / iters
     ops_per_sec = n_ops / dt
     _log(f"engine stage done: {ops_per_sec:,.0f} ops/sec end-to-end")
+
+    # Bulk path: the same end-to-end surface, columnar. 64 resources
+    # per flush, bulk_n entries each.
+    groups = 64
+    bulk_n = max(1024, min(eng.max_batch // groups, 4096))
+    gs = [eng.submit_bulk(f"r{i % n_rules}", bulk_n) for i in range(groups)]
+    eng.flush()
+    assert all(g.admitted is not None for g in gs)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        for i in range(groups):
+            eng.submit_bulk(f"r{i % n_rules}", bulk_n)
+        eng.flush()
+    dtb = (time.perf_counter() - t0) / iters
+    bulk_ops_per_sec = groups * bulk_n / dtb
+    _log(f"engine bulk done: {bulk_ops_per_sec:,.0f} ops/sec end-to-end")
     return {
         "engine_ops_per_sec": round(ops_per_sec, 1),
         "engine_n_rules": n_rules,
         "engine_n_ops": n_ops,
+        "engine_bulk_ops_per_sec": round(bulk_ops_per_sec, 1),
+        "engine_bulk_n_ops": groups * bulk_n,
     }
 
 
@@ -300,10 +320,16 @@ def _run_stage(n_rules: int, n_entries: int, iters: int) -> dict:
     batch = _example_batch(n_entries, n_rows, n_rules, k)
     pdyn = make_param_state(8)
 
+    # The same host-known specialization the Engine picks for this
+    # workload: no prioritized entries, no system/degrade rules, no
+    # exits in the batch (runtime/engine._run_chunk `flags`).
+    flags = dict(
+        with_occupy=False, with_system=False, with_degrade=False, with_exits=False
+    )
     _log("compiling + warm-up")
     t0 = time.perf_counter()
     stats, dyn, ddyn, pdyn, result = flush_step_jit(
-        stats, dev, dyn, ddev, ddyn, pdyn, sysdev, batch
+        stats, dev, dyn, ddev, ddyn, pdyn, sysdev, batch, **flags
     )
     jax.block_until_ready(result.admitted)
     _log(f"compile+first-run {time.perf_counter() - t0:.1f}s; timing {iters} iters")
@@ -311,7 +337,7 @@ def _run_stage(n_rules: int, n_entries: int, iters: int) -> dict:
     t0 = time.perf_counter()
     for _ in range(iters):
         stats, dyn, ddyn, pdyn, result = flush_step_jit(
-            stats, dev, dyn, ddev, ddyn, pdyn, sysdev, batch
+            stats, dev, dyn, ddev, ddyn, pdyn, sysdev, batch, **flags
         )
     jax.block_until_ready(result.admitted)
     dt = (time.perf_counter() - t0) / iters

@@ -54,6 +54,7 @@ from sentinel_tpu.models.rules import (
     ParamFlowRule,
 )
 from sentinel_tpu.models import constants
+from sentinel_tpu.runtime.engine import BulkOp
 from sentinel_tpu.rules.flow_manager import flow_rule_manager
 from sentinel_tpu.rules.degrade_manager import degrade_rule_manager
 from sentinel_tpu.rules.system_manager import system_rule_manager
@@ -84,6 +85,7 @@ __all__ = [
     "SystemRule",
     "AuthorityRule",
     "ParamFlowRule",
+    "BulkOp",
     "constants",
     "flow_rule_manager",
     "degrade_rule_manager",

@@ -146,12 +146,21 @@ def run_param(
     maxq_s = pb.maxq[p_s]
     cost_s = pb.cost_ms[p_s]
 
+    # Segment-start state is pre-gathered OUTSIDE the scan (one
+    # vectorized gather instead of a dynamic gather per scan step) —
+    # the scan body then runs on registers only.
+    seg_tokens = dyn.tokens[row_c]
+    seg_last = dyn.last_add[row_c]
+    seg_latest = dyn.latest[row_c]
+    seg_threads = dyn.threads[row_c]
+
     def step(carry: _Carry, x):
-        (row, valid, ts, acq, grade, beh, tc, burst, dur, maxq, cost) = x
+        (row, valid, ts, acq, grade, beh, tc, burst, dur, maxq, cost,
+         g_tokens, g_last, g_latest, g_threads) = x
         new_seg = row != carry.prow
-        tokens = jnp.where(new_seg, dyn.tokens[row], carry.tokens)
-        last = jnp.where(new_seg, dyn.last_add[row], carry.last_add)
-        latest = jnp.where(new_seg, dyn.latest[row], carry.latest)
+        tokens = jnp.where(new_seg, g_tokens, carry.tokens)
+        last = jnp.where(new_seg, g_last, carry.last_add)
+        latest = jnp.where(new_seg, g_latest, carry.latest)
         thr_used = jnp.where(new_seg, 0, carry.thr_used)
 
         max_count = tc + burst
@@ -193,7 +202,7 @@ def run_param(
         th_wait_out = jnp.where(th_q & th_ok & ~t_never, jnp.maximum(th_wait, 0), 0)
 
         # --- per-value thread grade ---
-        thr_cnt = dyn.threads[row] + thr_used
+        thr_cnt = g_threads + thr_used
         thr_ok = thr_cnt + 1 <= tc
         thr_used2 = thr_used + jnp.where(thr_ok, 1, 0)
 
@@ -227,7 +236,10 @@ def run_param(
         latest=jnp.int32(PARAM_NEVER),
         thr_used=jnp.int32(0),
     )
-    xs = (row_c, valid_s, ts_s, acq_s, grade_s, beh_s, tc_s, burst_s, dur_s, maxq_s, cost_s)
+    xs = (
+        row_c, valid_s, ts_s, acq_s, grade_s, beh_s, tc_s, burst_s, dur_s, maxq_s,
+        cost_s, seg_tokens, seg_last, seg_latest, seg_threads,
+    )
     _, (ok_s, wait_s, tok_s, last_s, lat_s) = jax.lax.scan(step, init, xs)
 
     seg_end = jnp.concatenate(

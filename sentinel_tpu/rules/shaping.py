@@ -109,12 +109,21 @@ def run_shaping(
     slope = flow_dev.warmup_slope[gid_c]
     refill_thr = flow_dev.warmup_refill_threshold[gid_c].astype(jnp.float32)
 
+    # Segment-start state is pre-gathered OUTSIDE the scan: a dynamic
+    # gather per scan step serializes into s round-trips to HBM, while
+    # one vectorized gather up front costs a single pass — the scan body
+    # then runs on registers only (pure arithmetic + selects).
+    seg_latest = flow_dyn.latest_passed_time[gid_c]
+    seg_stored = flow_dyn.stored_tokens[gid_c]
+    seg_lastfill = flow_dyn.last_filled_time[gid_c]
+
     def step(carry: _Carry, x):
-        (g, valid, ts, acq_f, acq, passq, prevq, b, cnt, mq, c1, wn, mx, sl, rt) = x
+        (g, valid, ts, acq_f, acq, passq, prevq, b, cnt, mq, c1, wn, mx, sl, rt,
+         g_latest, g_stored, g_lastfill) = x
         new_seg = g != carry.gid
-        latest = jnp.where(new_seg, flow_dyn.latest_passed_time[g], carry.latest)
-        stored = jnp.where(new_seg, flow_dyn.stored_tokens[g], carry.stored)
-        lastfill = jnp.where(new_seg, flow_dyn.last_filled_time[g], carry.lastfill)
+        latest = jnp.where(new_seg, g_latest, carry.latest)
+        stored = jnp.where(new_seg, g_stored, carry.stored)
+        lastfill = jnp.where(new_seg, g_lastfill, carry.lastfill)
 
         is_wu = (b == C.CONTROL_BEHAVIOR_WARM_UP) | (
             b == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER
@@ -194,6 +203,7 @@ def run_shaping(
     xs = (
         gid_c, valid_s, ts_s, acq_s, acq_i, passq_s, prevq_s,
         beh, count, maxq, cost1, warn, maxtok, slope, refill_thr,
+        seg_latest, seg_stored, seg_lastfill,
     )
     _, (ok_s, wait_s, latest_s, stored_s, lastfill_s) = jax.lax.scan(step, init, xs)
 

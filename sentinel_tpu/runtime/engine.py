@@ -128,6 +128,60 @@ class _EntryOp:
 
 
 @dataclass
+class BulkOp:
+    """A columnar group of ``n`` identical-shape entries on one
+    resource — the TPU-idiomatic bulk path (one slot resolution, one
+    numpy-slice encode, array verdicts; no per-op Python objects).
+
+    The reference has no analog — its API is one CAS-racing call per
+    request (SphU.entry, CORE/SphU.java:84) — but its *cluster client*
+    already concedes that decisions tolerate batch latency; this is
+    that concession made into the primary high-throughput surface
+    (SURVEY.md §7 "batch-driven" inversion).
+
+    After ``flush()``: ``admitted``/``reason``/``wait_ms`` are dense
+    numpy arrays of length ``n``.
+    """
+
+    resource: str
+    n: int
+    ts: np.ndarray  # int32 [n]
+    acquire: np.ndarray  # int32 [n]
+    rows: Tuple[int, int, int, int]
+    slots: List[Tuple[int, int]]
+    d_gids: List[int]
+    auth_ok: bool
+    context_name: str
+    origin: str
+    src: Optional[Tuple[object, object, object]] = None
+    custom_veto: Optional[Tuple[object, object]] = None
+    # results (filled by flush)
+    admitted: Optional[np.ndarray] = None
+    reason: Optional[np.ndarray] = None
+    wait_ms: Optional[np.ndarray] = None
+
+    @property
+    def admitted_count(self) -> int:
+        return int(self.admitted.sum()) if self.admitted is not None else 0
+
+
+@dataclass
+class _BulkExitOp:
+    """Columnar group of ``n`` exits/completions on one resource."""
+
+    rows: Tuple[int, int, int, int]
+    n: int
+    ts: np.ndarray  # int32 [n]
+    count: np.ndarray  # int32 [n]
+    rt: np.ndarray  # int32 [n]
+    err: np.ndarray  # int32 [n]
+    thr: int  # -1 exits, 0 traces
+    d_gids: List[int] = field(default_factory=list)
+    resource: Optional[str] = None
+    src_dindex: Optional[object] = None
+
+
+@dataclass
 class _ExitOp:
     ts: int
     rows: Tuple[int, int, int, int]
@@ -151,6 +205,14 @@ _BLOCK_EXC_NAMES = {
     E.BLOCK_PARAM: "ParamFlowException",
     E.BLOCK_CUSTOM: "CustomBlockException",
 }
+
+
+def _weighted_rt(gx: "_BulkExitOp") -> int:
+    """Count-weighted mean RT for aggregated completion callbacks — an
+    unweighted mean would skew extensions that reconstruct total time
+    as rt × count."""
+    total = int(gx.count.sum())
+    return int((gx.rt * gx.count).sum() / total) if total > 0 else 0
 
 
 def release_cluster_tokens(tokens: Sequence[Tuple[object, int]]) -> None:
@@ -183,6 +245,12 @@ class Engine:
         self.authority_rules: Dict[str, AuthorityRule] = {}
         self._entries: List[_EntryOp] = []
         self._exits: List[_ExitOp] = []
+        self._bulk_entries: List[BulkOp] = []
+        self._bulk_exits: List[_BulkExitOp] = []
+        # Running totals of pending bulk rows (flush-on-size checks must
+        # not re-sum every group per submit).
+        self._bulk_pending_n = 0
+        self._bulk_exit_pending_n = 0
         self._lock = threading.RLock()
         # Serializes flushes + rule-table swaps; never taken while
         # holding _lock (fixed order _flush_lock → _lock).
@@ -584,6 +652,136 @@ class Engine:
         if over:
             self.flush()
 
+    @staticmethod
+    def _bulk_col(v, n: int, default: int) -> np.ndarray:
+        """Broadcast a scalar / validate an array into an int32 [n]
+        column. Always a fresh OWNED buffer: the engine mutates these in
+        place (RT clamp, epoch rebase), and aliasing a caller's array —
+        or one caller's array shared across groups — would corrupt it."""
+        if v is None:
+            return np.full(n, default, dtype=np.int32)
+        a = np.array(v, dtype=np.int32, copy=True)
+        if a.ndim == 0:
+            return np.full(n, int(a), dtype=np.int32)
+        if a.shape != (n,):
+            raise ValueError(f"bulk column shape {a.shape} != ({n},)")
+        return a
+
+    def submit_bulk(
+        self,
+        resource: str,
+        n: int,
+        ts=None,
+        acquire=1,
+        context_name: str = C.CONTEXT_DEFAULT_NAME,
+        origin: str = "",
+        entry_type: C.EntryType = C.EntryType.OUT,
+    ) -> Optional[BulkOp]:
+        """Enqueue ``n`` entries on one resource as a single columnar
+        group — the high-throughput path: slot resolution happens once
+        for the group, encoding is numpy slicing, and verdicts come
+        back as arrays on the returned :class:`BulkOp` after
+        ``flush()``. ``ts``/``acquire`` may be scalars or [n] arrays.
+
+        Not supported on this path (use :meth:`submit_entry` /
+        :meth:`submit_many`): prioritized (occupy) entries, per-entry
+        args for hot-param rules, and cluster-mode rules (those need a
+        token-service RPC per entry — raises ``ValueError``).
+        Returns None for pass-through (over the resource cap or the
+        global switch off), like :meth:`submit_entry`.
+        """
+        if not self.enabled:
+            return None
+        if n < 1:
+            raise ValueError("submit_bulk: n must be >= 1")
+        if n > self.max_batch:
+            raise ValueError(
+                f"submit_bulk: n={n} exceeds max_batch={self.max_batch}; split the group"
+            )
+        with self._lock:
+            findex = self.flow_index
+            dindex = self.degrade_index
+            rows = self.resolve_entry_rows(resource, context_name, origin, entry_type)
+            if rows is None:
+                return None
+            slots = findex.resolve_slots(resource, context_name, origin, self.nodes)
+            if findex.cluster_gids and any(
+                gid in findex.cluster_gids for gid, _ in slots
+            ):
+                raise ValueError(
+                    "submit_bulk: resource has cluster-mode flow rules (the "
+                    "token-service RPC is per entry) — use submit_many"
+                )
+            auth_ok = True
+            arule = self.authority_rules.get(resource)
+            if arule is not None:
+                from sentinel_tpu.rules.authority_manager import AuthorityRuleManager
+
+                auth_ok = AuthorityRuleManager.passes(arule, origin)
+            now = self.clock.now_ms()
+            op = BulkOp(
+                resource=resource,
+                n=n,
+                ts=self._bulk_col(ts, n, now),
+                acquire=self._bulk_col(acquire, n, 1),
+                rows=rows,
+                slots=slots,
+                d_gids=dindex.gids_for(resource),
+                auth_ok=auth_ok,
+                context_name=context_name,
+                origin=origin,
+                src=(findex, dindex, self.param_index),
+            )
+            self._bulk_entries.append(op)
+            self._bulk_pending_n += n
+            over = len(self._entries) + self._bulk_pending_n >= self.max_batch
+        if over:
+            self.flush()
+        return op
+
+    def submit_exit_bulk(
+        self,
+        rows: Tuple[int, int, int, int],
+        n: int,
+        rt=0,
+        count=1,
+        err=0,
+        ts=None,
+        resource: Optional[str] = None,
+    ) -> None:
+        """Columnar exits: ``n`` completions on one node-row set in one
+        group (success + RT + thread release; breaker completions when
+        ``resource`` is given). Scalars broadcast; arrays are per-exit.
+        """
+        if n < 1:
+            raise ValueError("submit_exit_bulk: n must be >= 1")
+        if n > self.max_batch:
+            raise ValueError(
+                f"submit_exit_bulk: n={n} exceeds max_batch={self.max_batch}; split the group"
+            )
+        with self._lock:
+            dindex = self.degrade_index
+            now = self.clock.now_ms()
+            rt_col = self._bulk_col(rt, n, 0)
+            np.minimum(rt_col, config.statistic_max_rt, out=rt_col)
+            op = _BulkExitOp(
+                rows=rows,
+                n=n,
+                ts=self._bulk_col(ts, n, now),
+                count=self._bulk_col(count, n, 1),
+                rt=rt_col,
+                err=self._bulk_col(err, n, 0),
+                thr=-1,
+                d_gids=dindex.gids_for(resource) if resource is not None else [],
+                resource=resource,
+                src_dindex=dindex if resource is not None else None,
+            )
+            self._bulk_exits.append(op)
+            self._bulk_exit_pending_n += n
+            over = len(self._exits) + self._bulk_exit_pending_n >= self.max_batch
+        if over:
+            self.flush()
+
     def submit_trace(
         self, rows: Tuple[int, int, int, int], count: int = 1, ts: Optional[int] = None
     ) -> None:
@@ -689,6 +887,10 @@ class Engine:
             op.ts = max(op.ts - offset, 0)
         for op in self._exits:
             op.ts = max(op.ts - offset, 0)
+        for g in self._bulk_entries:
+            np.maximum(g.ts - offset, 0, out=g.ts)
+        for g in self._bulk_exits:
+            np.maximum(g.ts - offset, 0, out=g.ts)
 
     def _ensure_capacity(self) -> None:
         need = len(self.nodes)
@@ -788,7 +990,11 @@ class Engine:
             self._maybe_rebase()
             entries, self._entries = self._entries, []
             exits, self._exits = self._exits, []
-            if not entries and not exits:
+            bulk_e, self._bulk_entries = self._bulk_entries, []
+            bulk_x, self._bulk_exits = self._bulk_exits, []
+            self._bulk_pending_n = 0
+            self._bulk_exit_pending_n = 0
+            if not entries and not exits and not bulk_e and not bulk_x:
                 return out
             self._ensure_capacity()
             findex = self.flow_index
@@ -836,6 +1042,22 @@ class Engine:
                 if x.resource is not None and x.src_dindex is not None and x.src_dindex is not dindex:
                     x.d_gids = dindex.gids_for(x.resource)
                     x.src_dindex = dindex
+            for g in bulk_e:
+                if g.src is not None and g.src != cur:
+                    # Bulk groups never hold token-service verdicts
+                    # (cluster rules are rejected at submit), so the
+                    # re-resolve is a plain slot refresh; a rule that
+                    # became cluster-mode after submit stays locally
+                    # enforced for this group.
+                    g.slots = findex.resolve_slots(
+                        g.resource, g.context_name, g.origin, self.nodes
+                    )
+                    g.d_gids = dindex.gids_for(g.resource)
+                    g.src = cur
+            for gx in bulk_x:
+                if gx.resource is not None and gx.src_dindex is not None and gx.src_dindex is not dindex:
+                    gx.d_gids = dindex.gids_for(gx.resource)
+                    gx.src_dindex = dindex
         # One kernel launch per max_batch slice: bounds device memory
         # for the padded batch regardless of how much queued up.
         mb = max(self.max_batch, 1)
@@ -844,12 +1066,42 @@ class Engine:
             items = self._run_chunk(
                 e_chunk,
                 exits[off : off + mb],
+                [],
+                [],
                 findex,
                 dindex,
                 pindex,
                 auth_rules,
             )
             out[0].extend(e_chunk)
+            out[1].extend(items)
+        # Bulk groups ride in their own chunks, greedy-packed to the
+        # same max_batch bound (each group's n ≤ max_batch is enforced
+        # at submit).
+        def _pack(groups):
+            chunks, cur_c, cur_n = [], [], 0
+            for g in groups:
+                if cur_c and cur_n + g.n > mb:
+                    chunks.append(cur_c)
+                    cur_c, cur_n = [], 0
+                cur_c.append(g)
+                cur_n += g.n
+            if cur_c:
+                chunks.append(cur_c)
+            return chunks
+        be_chunks = _pack(bulk_e)
+        bx_chunks = _pack(bulk_x)
+        for i in range(max(len(be_chunks), len(bx_chunks))):
+            items = self._run_chunk(
+                [],
+                [],
+                be_chunks[i] if i < len(be_chunks) else [],
+                bx_chunks[i] if i < len(bx_chunks) else [],
+                findex,
+                dindex,
+                pindex,
+                auth_rules,
+            )
             out[1].extend(items)
         return out
 
@@ -873,6 +1125,8 @@ class Engine:
         self,
         entries: List[_EntryOp],
         exits: List[_ExitOp],
+        bulk: List[BulkOp],
+        bulk_exits: List[_BulkExitOp],
         findex: FlowIndex,
         dindex: DegradeIndex,
         pindex: ParamIndex,
@@ -883,12 +1137,17 @@ class Engine:
         the flush lock, in _post_flush). Runs under
         the flush lock only — the indexes are the snapshot taken when
         the pending buffers were swapped; _flush_locked re-resolved any
-        op whose submit-time tables were superseded by a reload."""
+        op whose submit-time tables were superseded by a reload.
+
+        Bulk groups (``bulk`` / ``bulk_exits``) occupy contiguous row
+        ranges after the singles and are encoded with numpy slicing —
+        no per-entry Python work anywhere on their path."""
         # ---- custom processor slots (SPI-assembled chain head) ----
         # A registered slot's veto blocks the entry before every device
         # stage — accounted like a first-slot BlockException (the block
         # scatter shares the authority channel; attribution is kept
-        # host-side on the op).
+        # host-side on the op). Bulk groups are checked once per group
+        # (identical resource/origin/acquire shape by construction).
         from sentinel_tpu.core.slots import SlotChainRegistry, SlotEntryContext
 
         if SlotChainRegistry.slots():
@@ -900,16 +1159,35 @@ class Engine:
                             op.acquire, op.prio, op.args,
                         )
                     )
+            for g in bulk:
+                if g.custom_veto is None:
+                    g.custom_veto = SlotChainRegistry.check_entry(
+                        SlotEntryContext(
+                            g.resource, g.context_name, g.origin,
+                            int(g.acquire[0]), False, (),
+                        )
+                    )
         # Pow2 padding is shard-divisible on any power-of-two mesh once
         # raised to at least n_shards (enable_mesh enforces pow2).
-        n = max(_pad_pow2(len(entries), 8), self._n_shards)
-        m = max(_pad_pow2(len(exits), 8), self._n_shards)
-        k = _pad_pow2(max(1, max((len(op.slots) for op in entries), default=1)), 1)
+        n_bulk = sum(g.n for g in bulk)
+        m_bulk = sum(g.n for g in bulk_exits)
+        n = max(_pad_pow2(len(entries) + n_bulk, 8), self._n_shards)
+        m = max(_pad_pow2(len(exits) + m_bulk, 8), self._n_shards)
+        k = _pad_pow2(
+            max(
+                1,
+                max((len(op.slots) for op in entries), default=1),
+                max((len(g.slots) for g in bulk), default=1),
+            ),
+            1,
+        )
         kd = _pad_pow2(
             max(
                 1,
                 max((len(op.d_gids) for op in entries), default=1),
                 max((len(op.d_gids) for op in exits), default=1),
+                max((len(g.d_gids) for g in bulk), default=1),
+                max((len(g.d_gids) for g in bulk_exits), default=1),
             ),
             1,
         )
@@ -937,6 +1215,20 @@ class Engine:
             e_prio[i] = op.prio
             e_auth[i] = op.auth_ok and op.custom_veto is None
             e_cluster[i] = op.cluster_blocked_rule is None
+        off_b = len(entries)
+        for g in bulk:
+            sl = slice(off_b, off_b + g.n)
+            e_valid[sl] = True
+            e_ts[sl] = g.ts
+            e_acquire[sl] = g.acquire
+            e_rows[sl] = g.rows
+            for j, (gid, crow) in enumerate(g.slots[:k]):
+                e_gid[sl, j] = gid
+                e_crow[sl, j] = crow
+            for j, dg in enumerate(g.d_gids[:kd]):
+                e_dgid[sl, j] = dg
+            e_auth[sl] = g.auth_ok and g.custom_veto is None
+            off_b += g.n
 
         x_valid = np.zeros(m, dtype=bool)
         x_ts = np.zeros(m, dtype=np.int32)
@@ -956,6 +1248,19 @@ class Engine:
             x_thr[i] = op.thr
             for j, dg in enumerate(op.d_gids[:kd]):
                 x_dgid[i, j] = dg
+        off_x = len(exits)
+        for g in bulk_exits:
+            sl = slice(off_x, off_x + g.n)
+            x_valid[sl] = True
+            x_ts[sl] = g.ts
+            x_count[sl] = g.count
+            x_rows[sl] = g.rows
+            x_rt[sl] = g.rt
+            x_err[sl] = g.err
+            x_thr[sl] = g.thr
+            for j, dg in enumerate(g.d_gids[:kd]):
+                x_dgid[sl, j] = dg
+            off_x += g.n
 
         batch = FlushBatch(
             now=jnp.int32(self.clock.now_ms()),
@@ -980,7 +1285,7 @@ class Engine:
         )
 
         sysdev = self._system_device()
-        shaping = self._encode_shaping(entries, k, findex)
+        shaping = self._encode_shaping(entries, bulk, k, findex)
         param = self._encode_param(entries, exits, pindex)
         occ_ms = config.occupy_timeout_ms
         common = (
@@ -993,6 +1298,15 @@ class Engine:
             sysdev,
             batch,
         )
+        # Host-known stage specializations (exact — each skipped stage's
+        # masks would be all-pass): plain DEFAULT-flow traffic compiles
+        # to a much leaner kernel than the fully-general one.
+        flags = dict(
+            with_occupy=any(op.prio for op in entries),
+            with_system=self.system_config is not None,
+            with_degrade=bool(dindex.rules),
+            with_exits=bool(exits) or bool(bulk_exits),
+        )
         if self._sharded_fns is not None:
             # Mesh mode: one global batch sharded over the chips;
             # shaping/param item batches (global coordinates) ride
@@ -1001,13 +1315,13 @@ class Engine:
             extra = tuple(b for b in (shaping, param) if b is not None)
             out = fn(*common, *extra)
         elif shaping is None and param is None:
-            out = flush_step_jit(*common, occupy_timeout_ms=occ_ms)
+            out = flush_step_jit(*common, occupy_timeout_ms=occ_ms, **flags)
         elif param is None:
-            out = flush_step_shaping_jit(*common, shaping, occupy_timeout_ms=occ_ms)
+            out = flush_step_shaping_jit(*common, shaping, occupy_timeout_ms=occ_ms, **flags)
         elif shaping is None:
-            out = flush_step_param_jit(*common, param, occupy_timeout_ms=occ_ms)
+            out = flush_step_param_jit(*common, param, occupy_timeout_ms=occ_ms, **flags)
         else:
-            out = flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms)
+            out = flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms, **flags)
         self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn, result = out
 
         # One batched device->host fetch (each separate fetch costs a
@@ -1060,6 +1374,18 @@ class Engine:
                 limit_type=limit_type,
                 slot_name=slot_name,
             )
+        off_b = len(entries)
+        bulk_slices: List[Tuple[BulkOp, slice]] = []
+        for g in bulk:
+            sl = slice(off_b, off_b + g.n)
+            bulk_slices.append((g, sl))
+            g.admitted = np.array(admitted[sl])
+            reasons = np.array(reason[sl], dtype=np.int32)
+            if g.custom_veto is not None:
+                reasons[~g.admitted] = E.BLOCK_CUSTOM
+            g.reason = reasons
+            g.wait_ms = np.array(wait_ms[sl])
+            off_b += g.n
 
         # ---- block log + metric-extension callbacks ----
         # LogSlot (order −8000) writing sentinel-block.log, and the
@@ -1092,22 +1418,86 @@ class Engine:
                     MetricExtensionProvider.on_blocked(
                         op.resource, op.acquire, op.origin, err, op.args
                     )
+        # Bulk groups: aggregated block-log items (the block log counts
+        # per (resource, exc, limitApp, origin) key, so summed items per
+        # key are exact) and aggregated extension calls. Flow/degrade
+        # blocks attribute the blocking rule's limitApp like the singles
+        # path — first failing slot per entry, grouped by slot.
+        for g, sl in bulk_slices:
+            if g.admitted is None:
+                continue
+            blocked = ~g.admitted
+
+            def _bulk_block_items(r: int) -> List[Tuple[str, int]]:
+                """(limit_app, count) aggregates for reason ``r``."""
+                sel = blocked & (g.reason == r)
+                if r == E.BLOCK_FLOW and g.slots:
+                    first_bad = np.argmax(~slot_ok[sl][sel], axis=1)
+                    out_items = []
+                    for j in np.unique(first_bad):
+                        rule = findex.rule_of_gid(g.slots[int(j)][0]) if int(j) < len(g.slots) else None
+                        la = getattr(rule, "limit_app", None) or "default"
+                        out_items.append((la, int(g.acquire[sel][first_bad == j].sum())))
+                    return out_items
+                if r == E.BLOCK_DEGRADE and g.d_gids:
+                    first_bad = np.argmax(~dslot_ok[sl][sel], axis=1)
+                    out_items = []
+                    for j in np.unique(first_bad):
+                        rule = dindex.rule_of_gid(g.d_gids[int(j)]) if int(j) < len(g.d_gids) else None
+                        la = getattr(rule, "limit_app", None) or "default"
+                        out_items.append((la, int(g.acquire[sel][first_bad == j].sum())))
+                    return out_items
+                if r == E.BLOCK_AUTHORITY:
+                    rule = auth_rules.get(g.resource)
+                    la = getattr(rule, "limit_app", None) or "default"
+                    return [(la, int(g.acquire[sel].sum()))]
+                return [("default", int(g.acquire[sel].sum()))]
+
+            if blocked.any():
+                for r in np.unique(g.reason[blocked]):
+                    exc_name = _BLOCK_EXC_NAMES.get(int(r), "BlockException")
+                    for la, cnt in _bulk_block_items(int(r)):
+                        blocked_items.append((g.resource, exc_name, la, g.origin, cnt))
+                    if exts:
+                        err = E.error_for_verdict(int(r), g.resource)
+                        MetricExtensionProvider.on_blocked(
+                            g.resource, int(g.acquire[blocked & (g.reason == r)].sum()),
+                            g.origin, err, (),
+                        )
+            if exts and g.admitted.any():
+                MetricExtensionProvider.on_pass(
+                    g.resource, int(g.acquire[g.admitted].sum()), ()
+                )
         if exts:
             for x in exits:
                 if x.resource is not None and x.thr < 0:
                     MetricExtensionProvider.on_complete(x.resource, x.rt, x.count, x.err)
+            for gx in bulk_exits:
+                if gx.resource is not None and gx.thr < 0:
+                    MetricExtensionProvider.on_complete(
+                        gx.resource, _weighted_rt(gx), int(gx.count.sum()),
+                        int(gx.err.sum()),
+                    )
         if SlotChainRegistry.slots():
             for x in exits:
                 if x.resource is not None and x.thr < 0:
                     SlotChainRegistry.on_exit(x.resource, x.rt, x.count, x.err)
+            for gx in bulk_exits:
+                if gx.resource is not None and gx.thr < 0:
+                    SlotChainRegistry.on_exit(
+                        gx.resource, _weighted_rt(gx), int(gx.count.sum()),
+                        int(gx.err.sum()),
+                    )
         return blocked_items
 
     def _encode_shaping(
-        self, entries: List[_EntryOp], k: int, findex: FlowIndex
+        self, entries: List[_EntryOp], bulk: List[BulkOp], k: int, findex: FlowIndex
     ) -> Optional[ShapingBatch]:
         """Gather (entry, slot) pairs governed by shaping controllers
         into the compact arrays the lax.scan path consumes. None when the
-        batch touches no shaping rules (the fast path)."""
+        batch touches no shaping rules (the fast path). Bulk groups
+        contribute column blocks (an item per group entry per shaping
+        slot) without per-entry Python."""
         sg = findex.shaping_gids
         if not sg:
             return None
@@ -1116,32 +1506,51 @@ class Engine:
             for j, (gid, crow) in enumerate(op.slots[:k]):
                 if gid in sg:
                     items.append((i * k + j, gid, crow, i, op.ts, op.acquire))
-        if not items:
+        cols: List[Tuple[np.ndarray, ...]] = []
+        if items:
+            arr = np.asarray(
+                [(fp, g, r, i, t, a) for fp, g, r, i, t, a in items], dtype=np.int32
+            )
+            cols.append(
+                (arr[:, 0], arr[:, 1], arr[:, 2], arr[:, 3], arr[:, 4], arr[:, 5])
+            )
+        off = len(entries)
+        for g in bulk:
+            for j, (gid, crow) in enumerate(g.slots[:k]):
+                if gid in sg:
+                    ei = np.arange(off, off + g.n, dtype=np.int32)
+                    cols.append(
+                        (
+                            ei * k + j,
+                            np.full(g.n, gid, dtype=np.int32),
+                            np.full(g.n, crow, dtype=np.int32),
+                            ei,
+                            g.ts,
+                            g.acquire,
+                        )
+                    )
+            off += g.n
+        if not cols:
             return None
-        s = _pad_pow2(len(items), 8)
-        valid = np.zeros(s, dtype=bool)
-        gid = np.zeros(s, dtype=np.int32)
-        row = np.zeros(s, dtype=np.int32)
-        eidx = np.zeros(s, dtype=np.int32)
-        flat_pos = np.zeros(s, dtype=np.int32)
-        ts = np.zeros(s, dtype=np.int32)
-        acquire = np.ones(s, dtype=np.int32)
-        for a, (fp, g, r, i, t, acq) in enumerate(items):
-            valid[a] = True
-            flat_pos[a] = fp
-            gid[a] = g
-            row[a] = r
-            eidx[a] = i
-            ts[a] = t
-            acquire[a] = acq
+        flat_pos, gid, row, eidx, ts, acquire = (
+            np.concatenate([c[a] for c in cols]) for a in range(6)
+        )
+        total = flat_pos.shape[0]
+        s = _pad_pow2(total, 8)
+        pad = s - total
+
+        def _p(a, fill=0):
+            return np.pad(a, (0, pad), constant_values=fill) if pad else a
+
+        valid = _p(np.ones(total, dtype=bool))
         return ShapingBatch(
             valid=jnp.asarray(valid),
-            gid=jnp.asarray(gid),
-            row=jnp.asarray(row),
-            eidx=jnp.asarray(eidx),
-            flat_pos=jnp.asarray(flat_pos),
-            ts=jnp.asarray(ts),
-            acquire=jnp.asarray(acquire),
+            gid=jnp.asarray(_p(gid)),
+            row=jnp.asarray(_p(row)),
+            eidx=jnp.asarray(_p(eidx)),
+            flat_pos=jnp.asarray(_p(flat_pos)),
+            ts=jnp.asarray(_p(ts)),
+            acquire=jnp.asarray(_p(acquire, 1)),
         )
 
     def entry_sync(
@@ -1255,6 +1664,10 @@ class Engine:
         with self._flush_lock, self._lock:
             self._entries.clear()
             self._exits.clear()
+            self._bulk_entries.clear()
+            self._bulk_exits.clear()
+            self._bulk_pending_n = 0
+            self._bulk_exit_pending_n = 0
             self.nodes.clear()
             self.stats = make_stats(self.stats.n_rows)
             self.flow_index = FlowIndex([], cold_factor=config.cold_factor)

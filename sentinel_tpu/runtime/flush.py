@@ -184,6 +184,7 @@ def flow_admission(
     batch: FlushBatch,
     live: Optional[jax.Array] = None,
     occupy_timeout_ms: int = 500,
+    with_occupy: bool = True,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Vectorized FlowRuleChecker + DefaultController (incl. occupy).
 
@@ -269,59 +270,63 @@ def flow_admission(
     # An entry the token server already BLOCKED never reaches the local
     # controller, so it must not borrow either (FlowRuleChecker.java:
     # 207-230: BLOCKED returns before passLocalCheck).
-    live_s = jnp.ones((n * k,), dtype=bool) if live is None else live[ei_s]
-    eligible = (
-        active_s
-        & ~ok
-        & is_default
-        & live_s
-        & batch.e_prio[ei_s]
-        & batch.e_cluster_ok[ei_s]
-        & (grade_s == C.FLOW_GRADE_QPS)
-    )
-    max_count = count_s * interval_sec
-    waiting = waiting_tokens(stats, batch.now)[rk_c]
-    # Conservative intra-batch borrow charge among this row's earlier
-    # prioritized candidates (granted or not).
-    borrow_charge = _segment_consumed(
-        new_grp, last_of_ent, jnp.where(eligible, acq_s, 0)
-    )
-    cur_borrow = (waiting + borrow_charge).astype(jnp.float32)
-    cur_pass = (base_pass + consumed_acq).astype(jnp.float32)
-    acq_fs = acq_s.astype(jnp.float32)
-
-    now_mod = batch.now % wlen
+    # ``with_occupy=False`` (host knows the batch has no prioritized
+    # entries) compiles all of this away — ``eligible`` would be all-
+    # False anyway, so the specialization is exact.
     occ_slot = jnp.zeros((n * k,), dtype=bool)
     occ_wait = jnp.zeros((n * k,), dtype=jnp.int32)
     occ_target = jnp.zeros((n * k,), dtype=jnp.int32)
-    # Static unroll over the (small) bucket count — tryOccupyNext's
-    # while-loop over candidate future windows (StatisticNode.java:
-    # 302-333). ``cur_pass`` is decremented by each expiring window's
-    # pass as the unroll advances — the loop's cumulative
-    # ``currentPass -= windowPass`` — so step *i*'s check sees the pass
-    # count that will remain once windows 0..i have all expired.
-    for i in range(nb):
-        wait_i = i * wlen + wlen - now_mod  # tryOccupyNext waitInMs
-        expiring_ws = batch.now - now_mod + wlen - interval + i * wlen
-        bidx = (expiring_ws // wlen) % nb
-        # Matured borrows are already IN the bucket: materialize_matured
-        # runs before admission in every flush path, so the slab holds
-        # only strictly-future windows and never overlaps expiring_ws.
-        in_bucket = stats.second.window_start[rk_c, bidx] == expiring_ws
-        win_pass = jnp.where(
-            in_bucket, stats.second.counts[rk_c, bidx, MetricEvent.PASS], 0
+    if with_occupy:
+        live_s = jnp.ones((n * k,), dtype=bool) if live is None else live[ei_s]
+        eligible = (
+            active_s
+            & ~ok
+            & is_default
+            & live_s
+            & batch.e_prio[ei_s]
+            & batch.e_cluster_ok[ei_s]
+            & (grade_s == C.FLOW_GRADE_QPS)
         )
-        cond = (
-            eligible
-            & (expiring_ws < batch.now)  # while (earliestTime < currentTime)
-            & (wait_i < occupy_timeout_ms)
-            & (cur_pass + cur_borrow + acq_fs - win_pass.astype(jnp.float32) <= max_count)
+        max_count = count_s * interval_sec
+        waiting = waiting_tokens(stats, batch.now)[rk_c]
+        # Conservative intra-batch borrow charge among this row's earlier
+        # prioritized candidates (granted or not).
+        borrow_charge = _segment_consumed(
+            new_grp, last_of_ent, jnp.where(eligible, acq_s, 0)
         )
-        fresh = cond & ~occ_slot
-        occ_wait = jnp.where(fresh, wait_i, occ_wait)
-        occ_target = jnp.where(fresh, batch.now - now_mod + (i + 1) * wlen, occ_target)
-        occ_slot = occ_slot | cond
-        cur_pass = cur_pass - win_pass.astype(jnp.float32)
+        cur_borrow = (waiting + borrow_charge).astype(jnp.float32)
+        cur_pass = (base_pass + consumed_acq).astype(jnp.float32)
+        acq_fs = acq_s.astype(jnp.float32)
+
+        now_mod = batch.now % wlen
+        # Static unroll over the (small) bucket count — tryOccupyNext's
+        # while-loop over candidate future windows (StatisticNode.java:
+        # 302-333). ``cur_pass`` is decremented by each expiring window's
+        # pass as the unroll advances — the loop's cumulative
+        # ``currentPass -= windowPass`` — so step *i*'s check sees the pass
+        # count that will remain once windows 0..i have all expired.
+        for i in range(nb):
+            wait_i = i * wlen + wlen - now_mod  # tryOccupyNext waitInMs
+            expiring_ws = batch.now - now_mod + wlen - interval + i * wlen
+            bidx = (expiring_ws // wlen) % nb
+            # Matured borrows are already IN the bucket: materialize_matured
+            # runs before admission in every flush path, so the slab holds
+            # only strictly-future windows and never overlaps expiring_ws.
+            in_bucket = stats.second.window_start[rk_c, bidx] == expiring_ws
+            win_pass = jnp.where(
+                in_bucket, stats.second.counts[rk_c, bidx, MetricEvent.PASS], 0
+            )
+            cond = (
+                eligible
+                & (expiring_ws < batch.now)  # while (earliestTime < currentTime)
+                & (wait_i < occupy_timeout_ms)
+                & (cur_pass + cur_borrow + acq_fs - win_pass.astype(jnp.float32) <= max_count)
+            )
+            fresh = cond & ~occ_slot
+            occ_wait = jnp.where(fresh, wait_i, occ_wait)
+            occ_target = jnp.where(fresh, batch.now - now_mod + (i + 1) * wlen, occ_target)
+            occ_slot = occ_slot | cond
+            cur_pass = cur_pass - win_pass.astype(jnp.float32)
 
     ok = ok | occ_slot
     # Non-DEFAULT behaviors are decided by the shaping scan, not here.
@@ -526,13 +531,22 @@ def apply_exit_phase(
     ddev: DegradeTableDevice,
     ddyn: DegradeDynState,
     batch: FlushBatch,
+    with_exits: bool = True,
+    with_degrade: bool = True,
 ) -> Tuple[StatsState, DegradeDynState]:
     """Phases 1 + 1b: exits, traces and breaker completions.
 
     Split out of :func:`flush_step` so the sharded two-pass path can
     apply exits once and run admission twice against the post-exit
     statistics (parallel/ici.make_sharded_flush).
+
+    ``with_exits=False`` (host knows the exit buffer is empty) /
+    ``with_degrade=False`` (no degrade rules loaded) compile the
+    corresponding scatters away — all masks would be all-False anyway,
+    so the specialization is exact.
     """
+    if not with_exits:
+        return stats, ddyn
     m = batch.x_valid.shape[0]
 
     # ---- phase 1: exits + traces (StatisticSlot.exit:148+) ----
@@ -551,9 +565,10 @@ def apply_exit_phase(
     stats = apply_updates(stats, x_rows_f, x_ts_f, x_deltas, x_rt_sample, x_thr_f, x_mask)
 
     # ---- phase 1b: breaker completions (DegradeSlot.exit:67-90) ----
-    ddyn = breaker_on_exits(
-        ddev, ddyn, batch.x_dgid, batch.x_ts, batch.x_rt, batch.x_err, batch.x_valid
-    )
+    if with_degrade:
+        ddyn = breaker_on_exits(
+            ddev, ddyn, batch.x_dgid, batch.x_ts, batch.x_rt, batch.x_err, batch.x_valid
+        )
     return stats, ddyn
 
 
@@ -573,8 +588,17 @@ def flush_entries(
     probe_allowed: Optional[jax.Array] = None,
     param_pre: Optional[Tuple[jax.Array, jax.Array]] = None,
     shaping_pre: Optional[Tuple[jax.Array, ...]] = None,
+    with_occupy: bool = True,
+    with_system: bool = True,
+    with_degrade: bool = True,
 ) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
     """Phases 2-3: admission checks and (when ``commit``) accounting.
+
+    The ``with_*`` flags are host-known specializations — "no
+    prioritized entries in this batch" / "no system rules configured" /
+    "no degrade rules loaded" — that compile the corresponding stages
+    away; each stage's masks would be all-pass anyway, so the flags
+    never change a verdict, only the op count.
 
     ``commit=False`` evaluates the checks but skips every state write
     (pass/block scatters, breaker probe transitions, param thread
@@ -596,8 +620,12 @@ def flush_entries(
     live = batch.e_valid & batch.e_auth_ok
 
     # ---- phase 2b: system protection (SystemSlot) ----
-    sys_ok, sys_type = system_check(stats, sysdev, batch, live)
-    live = live & sys_ok
+    if with_system:
+        sys_ok, sys_type = system_check(stats, sysdev, batch, live)
+        live = live & sys_ok
+    else:
+        sys_ok = jnp.ones((n,), dtype=bool)
+        sys_type = jnp.full((n,), SYS_NONE, dtype=jnp.int32)
 
     # ---- phase 2b': hot-parameter rules (ParamFlowSlot, order -3000) ----
     wait_param = jnp.zeros((n,), dtype=jnp.int32)
@@ -621,7 +649,9 @@ def flush_entries(
     (
         slot_ok, flow_pass, pass_plus_consumed, occupied, occupy_wait,
         occ_slot_nk, occ_target_nk,
-    ) = flow_admission(stats, flow_dev, batch, live, occupy_timeout_ms)
+    ) = flow_admission(
+        stats, flow_dev, batch, live, occupy_timeout_ms, with_occupy=with_occupy
+    )
     occupied = occupied & live
     wait_ms = jnp.maximum(jnp.zeros((n,), dtype=jnp.int32), jnp.where(occupied, occupy_wait, 0))
     if shaping is not None:
@@ -664,25 +694,33 @@ def flush_entries(
     # (FlowSlot order −2000 < DegradeSlot −1000), and StatisticSlot
     # catches it to count only the thread acquire.
     occ_live = occupied & live2
-    dslot_ok, probe_slot = breaker_try_pass(
-        ddev, ddyn, batch.e_dgid, batch.e_ts, live2 & ~occupied, probe_allowed
-    )
-    deg_pass = dslot_ok.all(axis=1) | occ_live
+    if with_degrade:
+        dslot_ok, probe_slot = breaker_try_pass(
+            ddev, ddyn, batch.e_dgid, batch.e_ts, live2 & ~occupied, probe_allowed
+        )
+        deg_pass = dslot_ok.all(axis=1) | occ_live
+    else:
+        dslot_ok = jnp.ones(batch.e_dgid.shape, dtype=bool)
+        deg_pass = jnp.ones((n,), dtype=bool)
 
     admitted = live2 & deg_pass
     if commit:
-        ddyn = apply_probe_transitions(ddyn, batch.e_dgid, probe_slot, admitted & ~occupied)
+        if with_degrade:
+            ddyn = apply_probe_transitions(
+                ddyn, batch.e_dgid, probe_slot, admitted & ~occupied
+            )
         # Borrows persist only for entries that were finally admitted —
         # an entry vetoed by another slot never borrowed in the
         # reference (PriorityWaitException would have aborted the chain
         # with a pass before that slot could veto).
-        stats = commit_borrow_slab(
-            stats,
-            occ_slot_nk & (admitted & occupied)[:, None],
-            occ_target_nk,
-            batch.e_acquire,
-            batch.e_check_row,
-        )
+        if with_occupy:
+            stats = commit_borrow_slab(
+                stats,
+                occ_slot_nk & (admitted & occupied)[:, None],
+                occ_target_nk,
+                batch.e_acquire,
+                batch.e_check_row,
+            )
     wait_ms = jnp.maximum(wait_ms, jnp.where(admitted, wait_param, 0))
 
     # Per-value thread acquire (ParamFlowStatisticEntryCallback.onPass):
@@ -765,6 +803,10 @@ def flush_step(
     shaping: Optional[ShapingBatch] = None,
     param: Optional[ParamBatch] = None,
     occupy_timeout_ms: int = 500,
+    with_occupy: bool = True,
+    with_system: bool = True,
+    with_degrade: bool = True,
+    with_exits: bool = True,
 ) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
     """Pure function: apply one batch.
 
@@ -773,59 +815,87 @@ def flush_step(
     Degrade −1000); entries blocked by an earlier stage neither consume
     later stages' state (pacer time, breaker probes, param tokens) nor
     count toward their thresholds.
+
+    The ``with_*`` flags are exact host-known specializations (see
+    :func:`flush_entries`) — the engine passes "this batch has no
+    prioritized entries / exits" and "no system/degrade rules are
+    loaded" so plain DEFAULT-flow traffic compiles to a much leaner
+    kernel. ``materialize_matured`` stays unconditional: the future
+    slab may hold borrows committed by a *previous* (prioritized)
+    flush.
     """
     from sentinel_tpu.metrics.nodes import materialize_matured
 
     stats = materialize_matured(stats, batch.now)
-    stats, ddyn = apply_exit_phase(stats, ddev, ddyn, batch)
+    stats, ddyn = apply_exit_phase(
+        stats, ddev, ddyn, batch, with_exits=with_exits, with_degrade=with_degrade
+    )
     return flush_entries(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
         occupy_timeout_ms=occupy_timeout_ms,
+        with_occupy=with_occupy, with_system=with_system, with_degrade=with_degrade,
     )
 
 
 # Four jit variants keyed by which optional batches are present; the
 # engine picks per flush so DEFAULT-only traffic never pays for the
-# shaping/param machinery. occupy_timeout_ms is static (a config value
-# that rarely changes; a change recompiles once).
-@functools.partial(jax.jit, donate_argnums=(0, 4, 5), static_argnames=("occupy_timeout_ms",))
+# shaping/param machinery. occupy_timeout_ms and the with_* stage
+# flags are static (each used combination compiles once and is cached).
+_STATIC_FLAGS = (
+    "occupy_timeout_ms", "with_occupy", "with_system", "with_degrade", "with_exits",
+)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 4, 5), static_argnames=_STATIC_FLAGS)
 def flush_step_jit(
-    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, occupy_timeout_ms=500
+    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, occupy_timeout_ms=500,
+    with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch,
         occupy_timeout_ms=occupy_timeout_ms,
+        with_occupy=with_occupy, with_system=with_system,
+        with_degrade=with_degrade, with_exits=with_exits,
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5), static_argnames=("occupy_timeout_ms",))
+@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5), static_argnames=_STATIC_FLAGS)
 def flush_step_shaping_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
     occupy_timeout_ms=500,
+    with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
         occupy_timeout_ms=occupy_timeout_ms,
+        with_occupy=with_occupy, with_system=with_system,
+        with_degrade=with_degrade, with_exits=with_exits,
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 4, 5), static_argnames=("occupy_timeout_ms",))
+@functools.partial(jax.jit, donate_argnums=(0, 4, 5), static_argnames=_STATIC_FLAGS)
 def flush_step_param_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, param,
     occupy_timeout_ms=500,
+    with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, None, param,
         occupy_timeout_ms=occupy_timeout_ms,
+        with_occupy=with_occupy, with_system=with_system,
+        with_degrade=with_degrade, with_exits=with_exits,
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5), static_argnames=("occupy_timeout_ms",))
+@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5), static_argnames=_STATIC_FLAGS)
 def flush_step_full_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
     occupy_timeout_ms=500,
+    with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
         occupy_timeout_ms=occupy_timeout_ms,
+        with_occupy=with_occupy, with_system=with_system,
+        with_degrade=with_degrade, with_exits=with_exits,
     )
