@@ -195,11 +195,17 @@ def _run_mixed_stage(n_rules: int, n_entries: int, iters: int) -> dict:
         exit_rows=jnp.full(8, -1, dtype=jnp.int32),
     )
 
-    _log("mixed: compiling + warm-up")
+    # The same host-known rounds bounds the Engine computes: max items
+    # per rule / per value row, pow2-bucketed (engine._rounds_bucket).
+    from sentinel_tpu.runtime.engine import _rounds_bucket
+
+    sh_rounds = _rounds_bucket((sh_gid[sh_mask] % n_rules).astype(np_.int32))
+    p_rounds = _rounds_bucket(np_.asarray(pb.prow))
+    _log(f"mixed: compiling + warm-up (sh_rounds={sh_rounds} p_rounds={p_rounds})")
     t0 = time.perf_counter()
     out = flush_step_full_jit(
         stats, dev, dyn, dindex.device, dindex.make_dyn_state(), pdyn, sysdev,
-        batch, sb, pb,
+        batch, sb, pb, shaping_rounds=sh_rounds, param_rounds=p_rounds,
     )
     stats, dyn, ddyn, pdyn, result = out
     jax.block_until_ready(result.admitted)
@@ -207,7 +213,8 @@ def _run_mixed_stage(n_rules: int, n_entries: int, iters: int) -> dict:
     t0 = time.perf_counter()
     for _ in range(iters):
         stats, dyn, ddyn, pdyn, result = flush_step_full_jit(
-            stats, dev, dyn, dindex.device, ddyn, pdyn, sysdev, batch, sb, pb
+            stats, dev, dyn, dindex.device, ddyn, pdyn, sysdev, batch, sb, pb,
+            shaping_rounds=sh_rounds, param_rounds=p_rounds,
         )
     jax.block_until_ready(result.admitted)
     dt = (time.perf_counter() - t0) / iters

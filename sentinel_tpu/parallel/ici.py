@@ -299,7 +299,7 @@ def _demote_over_borrow(
     return _split_and_spend(axis, batch, nr, borrower, acq_f, cap_slot)
 
 
-def _global_param_scan(axis, pdyn, param_g, live_up, n_local):
+def _global_param_scan(axis, pdyn, param_g, live_up, n_local, rounds=0):
     """Run the hot-param scan once per chip on the GLOBALLY-replicated
     item batch — every chip computes the identical new param state (no
     merge needed), and the scan sees the global (value-row, ts)-ordered
@@ -324,7 +324,7 @@ def _global_param_scan(axis, pdyn, param_g, live_up, n_local):
     live_bit = owner & live_up[lidx]
     item_live = jax.lax.psum(live_bit.astype(jnp.int32), axis) > 0
     pg_live = param_g._replace(valid=param_g.valid & item_live)
-    new_pdyn, p_ok, p_wait = run_param(pdyn, pg_live)
+    new_pdyn, p_ok, p_wait = run_param(pdyn, pg_live, rounds=rounds)
     drop = jnp.int32(n_local)
     sc = jnp.where(pg_live.valid & owner, lidx, drop)
     param_ok_local = jnp.ones((n_local,), dtype=bool).at[sc].min(p_ok, mode="drop")
@@ -332,7 +332,9 @@ def _global_param_scan(axis, pdyn, param_g, live_up, n_local):
     return new_pdyn, (param_ok_local, wait_local), owner, lidx
 
 
-def _global_shaping_scan(axis, stats_x, flow_dev, flow_dyn, shaping_g, batch, live_up, n_local, k):
+def _global_shaping_scan(
+    axis, stats_x, flow_dev, flow_dyn, shaping_g, batch, live_up, n_local, k, rounds=0
+):
     """Run the shaping pacer scan once per chip on the GLOBALLY-
     replicated item batch: replicated ``flow_dyn`` in, identical new
     ``flow_dyn`` out on every chip, and the ``lax.scan`` sees the global
@@ -396,7 +398,7 @@ def _global_shaping_scan(axis, stats_x, flow_dev, flow_dyn, shaping_g, batch, li
     prev = _prev_second_pass(stats_x, shaping_g.row, shaping_g.ts)
     interval_sec = SECOND_CFG.interval_ms / 1000.0
     new_fdyn, ok_s, wait_s = run_shaping(
-        flow_dev, flow_dyn, sg_live, ppc, prev, interval_sec
+        flow_dev, flow_dyn, sg_live, ppc, prev, interval_sec, rounds=rounds
     )
     lflat = lidx * k + shaping_g.flat_pos % k
     shaping_pre = (sg_live.valid & owner, lflat, lidx, ok_s, wait_s)
@@ -409,6 +411,8 @@ def make_sharded_flush(
     occupy_timeout_ms: int = 500,
     with_shaping: bool = False,
     with_param: bool = False,
+    shaping_rounds: int = 0,
+    param_rounds: int = 0,
 ):
     """The full batched step over an n-device mesh.
 
@@ -471,13 +475,13 @@ def make_sharded_flush(
             live_up = live0 & sys_ok
             if param_g is not None:
                 new_pdyn_scan, param_pre, p_owner, p_lidx = _global_param_scan(
-                    axis, pdyn, param_g, live_up, n_local
+                    axis, pdyn, param_g, live_up, n_local, rounds=param_rounds
                 )
                 live_up = live_up & param_pre[0]
             if shaping_g is not None:
                 new_fdyn_scan, shaping_pre = _global_shaping_scan(
                     axis, stats_x, flow_dev, flow_dyn, shaping_g, batch,
-                    live_up, n_local, k,
+                    live_up, n_local, k, rounds=shaping_rounds,
                 )
 
         # Pass 1 (no state writes): local flow-level admission demand.

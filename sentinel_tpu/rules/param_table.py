@@ -106,20 +106,84 @@ class ParamSlotInfo:
     rule: Optional[ParamFlowRule] = None  # for block attribution
 
 
-class _Carry(NamedTuple):
-    prow: jax.Array
-    tokens: jax.Array
-    last_add: jax.Array
-    latest: jax.Array
-    thr_used: jax.Array  # intra-batch thread charge
+def _transition(tokens, last, latest, thr_used, x):
+    """One param slot's check + state update, vector-friendly (used by
+    both the rounds path and the scan). Invalid items are identity on
+    state and ok=True. Returns (ok, wait, tokens', last', latest',
+    thr_used')."""
+    (valid, ts, acq, grade, beh, tc, burst, dur, maxq, cost, g_threads) = x
+
+    max_count = tc + burst
+    never = last == PARAM_NEVER
+
+    # --- token bucket (passDefaultLocalCheck) ---
+    first_tokens = max_count - acq
+    pass_time = ts - last
+    refill_win = pass_time > dur
+    to_add = (pass_time * tc) // dur
+    new_qps = jnp.where(
+        to_add + tokens > max_count, max_count - acq, tokens + to_add - acq
+    )
+    tb_ok = jnp.where(
+        never,
+        True,
+        jnp.where(refill_win, new_qps >= 0, tokens - acq >= 0),
+    )
+    tb_ok = tb_ok & (tc > 0) & (acq <= max_count)
+    tokens2 = jnp.where(
+        never,
+        first_tokens,
+        jnp.where(refill_win, jnp.where(new_qps >= 0, new_qps, tokens), tokens - acq),
+    )
+    tokens2 = jnp.where(tb_ok, tokens2, tokens)
+    last2 = jnp.where(tb_ok & (never | refill_win), ts, last)
+
+    # --- throttle (passThrottleLocalCheck) ---
+    t_never = latest == PARAM_NEVER
+    expected = latest + cost
+    th_imm = expected <= ts
+    th_wait = expected - ts
+    th_q = (~th_imm) & (th_wait < maxq)  # STRICT < (ParamFlowChecker.java:258)
+    th_ok = (t_never | th_imm | th_q) & (tc > 0)
+    latest2 = jnp.where(
+        t_never, ts, jnp.where(th_imm, ts, jnp.where(th_q, expected, latest))
+    )
+    latest2 = jnp.where(th_ok, latest2, latest)
+    th_wait_out = jnp.where(th_q & th_ok & ~t_never, jnp.maximum(th_wait, 0), 0)
+
+    # --- per-value thread grade ---
+    thr_cnt = g_threads + thr_used
+    thr_ok = thr_cnt + 1 <= tc
+    thr_used2 = thr_used + jnp.where(thr_ok, 1, 0)
+
+    is_qps = grade == C.FLOW_GRADE_QPS
+    is_throttle = is_qps & (beh == C.CONTROL_BEHAVIOR_RATE_LIMITER)
+    ok = jnp.where(is_throttle, th_ok, jnp.where(is_qps, tb_ok, thr_ok))
+    ok = ok | ~valid
+    wait = jnp.where(is_throttle & valid, th_wait_out, 0)
+
+    # Only the behavior in effect mutates its state column.
+    tokens3 = jnp.where(valid & is_qps & ~is_throttle, tokens2, tokens)
+    last3 = jnp.where(valid & is_qps & ~is_throttle, last2, last)
+    latest3 = jnp.where(valid & is_throttle, latest2, latest)
+    thr_used3 = jnp.where(valid & ~is_qps, thr_used2, thr_used)
+    return ok, wait, tokens3, last3, latest3, thr_used3
 
 
 def run_param(
     dyn: ParamDynState,
     pb: ParamBatch,
+    rounds: int = 0,
 ) -> Tuple[ParamDynState, jax.Array, jax.Array]:
     """Evaluate param slots; returns (new_dyn, ok [S] in caller order,
-    wait_ms [S] in caller order)."""
+    wait_ms [S] in caller order).
+
+    ``rounds`` (static): host-known upper bound on items-per-value-row
+    in this batch — picks the vectorized rounds path (round *r*
+    resolves every row's *r*-th item in parallel, each item chaining
+    from its predecessor in the sorted order); 0 falls back to the
+    sequential ``lax.scan``.
+    """
     s = pb.valid.shape[0]
     pr = dyn.tokens.shape[0]
 
@@ -137,110 +201,37 @@ def run_param(
     row_s, ts_s, ei_s, p_s = jax.lax.sort((key, pb.ts, pb.eidx, pos), num_keys=3)
     row_c = jnp.clip(row_s, 0, pr - 1)
     valid_s = pb.valid[p_s]
-    acq_s = pb.acquire[p_s]
-    grade_s = pb.grade[p_s]
-    beh_s = pb.behavior[p_s]
-    tc_s = pb.token_count[p_s]
-    burst_s = pb.burst[p_s]
-    dur_s = jnp.maximum(pb.duration_ms[p_s], 1)
-    maxq_s = pb.maxq[p_s]
-    cost_s = pb.cost_ms[p_s]
 
-    # Segment-start state is pre-gathered OUTSIDE the scan (one
-    # vectorized gather instead of a dynamic gather per scan step) —
-    # the scan body then runs on registers only.
+    # Segment-start state is pre-gathered OUTSIDE the recurrence (one
+    # vectorized gather instead of per-step dynamic gathers).
     seg_tokens = dyn.tokens[row_c]
     seg_last = dyn.last_add[row_c]
     seg_latest = dyn.latest[row_c]
     seg_threads = dyn.threads[row_c]
 
-    def step(carry: _Carry, x):
-        (row, valid, ts, acq, grade, beh, tc, burst, dur, maxq, cost,
-         g_tokens, g_last, g_latest, g_threads) = x
-        new_seg = row != carry.prow
-        tokens = jnp.where(new_seg, g_tokens, carry.tokens)
-        last = jnp.where(new_seg, g_last, carry.last_add)
-        latest = jnp.where(new_seg, g_latest, carry.latest)
-        thr_used = jnp.where(new_seg, 0, carry.thr_used)
-
-        max_count = tc + burst
-        never = last == PARAM_NEVER
-
-        # --- token bucket (passDefaultLocalCheck) ---
-        first_tokens = max_count - acq
-        pass_time = ts - last
-        refill_win = pass_time > dur
-        to_add = (pass_time * tc) // dur
-        new_qps = jnp.where(
-            to_add + tokens > max_count, max_count - acq, tokens + to_add - acq
-        )
-        tb_ok = jnp.where(
-            never,
-            True,
-            jnp.where(refill_win, new_qps >= 0, tokens - acq >= 0),
-        )
-        tb_ok = tb_ok & (tc > 0) & (acq <= max_count)
-        tokens2 = jnp.where(
-            never,
-            first_tokens,
-            jnp.where(refill_win, jnp.where(new_qps >= 0, new_qps, tokens), tokens - acq),
-        )
-        tokens2 = jnp.where(tb_ok, tokens2, tokens)
-        last2 = jnp.where(tb_ok & (never | refill_win), ts, last)
-
-        # --- throttle (passThrottleLocalCheck) ---
-        t_never = latest == PARAM_NEVER
-        expected = latest + cost
-        th_imm = expected <= ts
-        th_wait = expected - ts
-        th_q = (~th_imm) & (th_wait < maxq)  # STRICT < (ParamFlowChecker.java:258)
-        th_ok = (t_never | th_imm | th_q) & (tc > 0)
-        latest2 = jnp.where(
-            t_never, ts, jnp.where(th_imm, ts, jnp.where(th_q, expected, latest))
-        )
-        latest2 = jnp.where(th_ok, latest2, latest)
-        th_wait_out = jnp.where(th_q & th_ok & ~t_never, jnp.maximum(th_wait, 0), 0)
-
-        # --- per-value thread grade ---
-        thr_cnt = g_threads + thr_used
-        thr_ok = thr_cnt + 1 <= tc
-        thr_used2 = thr_used + jnp.where(thr_ok, 1, 0)
-
-        is_qps = grade == C.FLOW_GRADE_QPS
-        is_throttle = is_qps & (beh == C.CONTROL_BEHAVIOR_RATE_LIMITER)
-        ok = jnp.where(
-            is_throttle, th_ok, jnp.where(is_qps, tb_ok, thr_ok)
-        )
-        ok = ok | ~valid
-        wait = jnp.where(is_throttle & valid, th_wait_out, 0)
-
-        # Only the behavior in effect mutates its state column.
-        tokens3 = jnp.where(valid & is_qps & ~is_throttle, tokens2, tokens)
-        last3 = jnp.where(valid & is_qps & ~is_throttle, last2, last)
-        latest3 = jnp.where(valid & is_throttle, latest2, latest)
-        thr_used3 = jnp.where(valid & ~is_qps, thr_used2, thr_used)
-
-        carry2 = _Carry(
-            prow=jnp.where(valid, row, carry.prow),
-            tokens=jnp.where(valid, tokens3, carry.tokens),
-            last_add=jnp.where(valid, last3, carry.last_add),
-            latest=jnp.where(valid, latest3, carry.latest),
-            thr_used=jnp.where(valid, thr_used3, carry.thr_used),
-        )
-        return carry2, (ok, wait, tokens3, last3, latest3)
-
-    init = _Carry(
-        prow=jnp.int32(-1),
-        tokens=jnp.int32(0),
-        last_add=jnp.int32(PARAM_NEVER),
-        latest=jnp.int32(PARAM_NEVER),
-        thr_used=jnp.int32(0),
+    items = (
+        valid_s, ts_s, pb.acquire[p_s], pb.grade[p_s], pb.behavior[p_s],
+        pb.token_count[p_s], pb.burst[p_s], jnp.maximum(pb.duration_ms[p_s], 1),
+        pb.maxq[p_s], pb.cost_ms[p_s], seg_threads,
     )
-    xs = (
-        row_c, valid_s, ts_s, acq_s, grade_s, beh_s, tc_s, burst_s, dur_s, maxq_s,
-        cost_s, seg_tokens, seg_last, seg_latest, seg_threads,
+    ones = jnp.ones((1,), dtype=bool)
+    new_grp = jnp.concatenate([ones, row_s[1:] != row_s[:-1]])
+
+    def transition(states, item_vals):
+        tokens, last, latest, thr_used = states
+        ok, wait, t2, l2, lt2, thr2 = _transition(
+            tokens, last, latest, thr_used, item_vals
+        )
+        return (ok, wait), (t2, l2, lt2, thr2)
+
+    from sentinel_tpu.rules.recurrence import run_segmented
+
+    # thr_used (intra-batch thread charge) restarts at 0 per segment.
+    seg_thr_used = jnp.zeros((s,), dtype=jnp.int32)
+    ok_s, wait_s, (tok_s, last_s, lat_s, _) = run_segmented(
+        new_grp, (seg_tokens, seg_last, seg_latest, seg_thr_used),
+        items, transition, rounds,
     )
-    _, (ok_s, wait_s, tok_s, last_s, lat_s) = jax.lax.scan(step, init, xs)
 
     seg_end = jnp.concatenate(
         [row_s[1:] != row_s[:-1], jnp.ones((1,), dtype=bool)]

@@ -10,17 +10,29 @@ stateless threshold like DefaultController.
 
 Batched execution: shaping slots (a tiny minority of traffic in
 practice) are gathered into their own compact array, sorted by
-``(rule, ts, entry)``, and resolved by ONE ``lax.scan`` whose carry is
-the current rule's shaping state; segment boundaries reload from /
-write back to the per-rule state columns (FlowRuleDynState). The scan
-reproduces the reference's per-request logic step for step — including
-the per-second token re-fill (syncToken) — so it is exact even when a
-batch spans multiple seconds. The vectorized DEFAULT path never pays
-for this: when no shaping rules are loaded the scan is skipped
-entirely.
+``(rule, ts, entry)``, and resolved per rule-segment. Two exact
+implementations share one transition function:
 
-Numerics: Java computes in float64; the scan uses float32 for the
-warm-up slope math (divergence only possible exactly at a threshold
+* ``rounds > 0`` — the vectorized path: within a segment each item's
+  state comes from its immediate predecessor in the sorted order, so
+  round *r* resolves every segment's *r*-th item in parallel; ``rounds``
+  is the host-known max items-per-rule in the batch (a static arg —
+  each bucket compiles once). M full-vector passes instead of an
+  s-step sequential scan: on TPU this is the difference between ~µs
+  and ~ms, because a ``lax.scan`` iteration costs per-step loop
+  overhead regardless of how little work the body does.
+* ``rounds == 0`` — one ``lax.scan`` whose carry is the current rule's
+  shaping state; the fallback when one rule dominates the batch
+  (max-per-rule too large for unrolled rounds).
+
+Both reproduce the reference's per-request logic step for step —
+including the per-second token re-fill (syncToken) — so they are exact
+even when a batch spans multiple seconds. The vectorized DEFAULT path
+never pays for any of this: when no shaping rules are loaded the module
+is never entered.
+
+Numerics: Java computes in float64; the math uses float32 for the
+warm-up slope (divergence only possible exactly at a threshold
 boundary for extreme rule counts) and host-precomputed exact int
 ``cost1_ms`` for the ubiquitous acquire==1 rate-limiter case. Java's
 ``latestPassedTime``/``lastFilledTime`` start effectively "infinitely
@@ -56,11 +68,73 @@ class ShapingBatch(NamedTuple):
     acquire: jax.Array  # int32 [S]
 
 
-class _Carry(NamedTuple):
-    gid: jax.Array  # int32 — rule whose state is loaded
-    latest: jax.Array  # int32 — latestPassedTime
-    stored: jax.Array  # float32 — storedTokens
-    lastfill: jax.Array  # int32 — lastFilledTime (second-aligned)
+def _transition(latest, stored, lastfill, x):
+    """One item's controller decision + state update, vector-friendly
+    (works elementwise on arrays of items as well as on scan scalars).
+    Invalid items are identity on state and ok=True.
+    Returns (ok, wait_out, latest', stored', lastfill')."""
+    (valid, ts, acq_f, acq, passq, prevq, b, cnt, mq, c1, wn, mx, sl, rt) = x
+
+    is_wu = (b == C.CONTROL_BEHAVIOR_WARM_UP) | (
+        b == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER
+    )
+
+    # --- syncToken (WarmUpController.syncToken/coolDownTokens) ---
+    sec = ts - ts % 1000
+    do_sync = is_wu & (sec > lastfill) & valid
+    elapsed = (sec - lastfill).astype(jnp.float32)
+    refill_ok = (stored < wn) | ((stored > wn) & (prevq < rt))
+    refilled = jnp.minimum(jnp.floor(stored + elapsed * cnt / 1000.0), mx)
+    stored1 = jnp.where(do_sync & refill_ok, refilled, stored)
+    stored2 = jnp.where(do_sync, jnp.maximum(stored1 - prevq, 0.0), stored1)
+    lastfill2 = jnp.where(do_sync, sec, lastfill)
+
+    # --- warm-up admitted-QPS (above the warning line) ---
+    above = jnp.maximum(stored2 - wn, 0.0)
+    inv = above * sl + 1.0 / jnp.maximum(cnt, 1e-9)
+    # Math.nextUp on the Java double; nextafter on f32 here.
+    warning_qps = jnp.nextafter(1.0 / inv, jnp.float32(jnp.inf))
+    cold = stored2 >= wn
+
+    wu_ok = jnp.where(cold, passq + acq_f <= warning_qps, passq + acq_f <= cnt)
+
+    # --- pacer cost (RateLimiter / WarmUpRateLimiter) ---
+    cost_generic = jnp.floor(acq_f / jnp.maximum(cnt, 1e-9) * 1000.0 + 0.5)
+    cost_rl = jnp.where(acq == 1, c1.astype(jnp.float32), cost_generic)
+    cost_wurl_cold = jnp.floor(acq_f / warning_qps * 1000.0 + 0.5)
+    cost_wurl = jnp.where(cold, cost_wurl_cold, cost_rl)
+    cost = jnp.where(
+        b == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER, cost_wurl, cost_rl
+    ).astype(jnp.int32)
+
+    expected = latest + cost
+    imm = expected <= ts
+    wait = expected - ts
+    queued = (~imm) & (wait <= mq)
+    pacer_ok = (imm | queued) & (cnt > 0)
+    pacer_ok = pacer_ok | (acq <= 0)  # acquire<=0 always passes
+    latest2 = jnp.where(
+        valid & pacer_ok & (acq > 0), jnp.where(imm, ts, latest + cost), latest
+    )
+    wait_out = jnp.where(queued & pacer_ok, wait, 0)
+
+    is_pacer = (b == C.CONTROL_BEHAVIOR_RATE_LIMITER) | (
+        b == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER
+    )
+    ok = jnp.where(
+        b == C.CONTROL_BEHAVIOR_WARM_UP,
+        wu_ok,
+        jnp.where(is_pacer, pacer_ok, True),
+    )
+    ok = ok | ~valid
+    wait_out = jnp.where(valid & is_pacer, wait_out, 0)
+
+    # Pacer state only advances for pacer behaviors; warm-up state
+    # only via sync. Invalid items must not touch state.
+    latest3 = jnp.where(valid & is_pacer, latest2, latest)
+    stored3 = jnp.where(valid, stored2, stored)
+    lastfill3 = jnp.where(valid, lastfill2, lastfill)
+    return ok, wait_out, latest3, stored3, lastfill3
 
 
 def run_shaping(
@@ -70,10 +144,14 @@ def run_shaping(
     pass_consumed: jax.Array,  # int32 [S] — windowed pass sum + intra-batch charge
     prev_pass: jax.Array,  # int32 [S] — previous 1s-bucket pass count (minute array)
     interval_sec: float,
+    rounds: int = 0,
 ) -> Tuple[FlowRuleDynState, jax.Array, jax.Array]:
     """Evaluate shaping slots; returns (new_dyn, ok [S], wait_ms [S])
-    in the *sorted* order it establishes internally — results are
-    scattered back via shaping.flat_pos by the caller.
+    in the caller's slot order.
+
+    ``rounds`` (static): host-known upper bound on items-per-rule in
+    this batch — picks the vectorized rounds path; 0 falls back to the
+    sequential ``lax.scan`` (see module docstring).
 
     The three behaviors (reference files in module docstring):
 
@@ -109,103 +187,29 @@ def run_shaping(
     slope = flow_dev.warmup_slope[gid_c]
     refill_thr = flow_dev.warmup_refill_threshold[gid_c].astype(jnp.float32)
 
-    # Segment-start state is pre-gathered OUTSIDE the scan: a dynamic
-    # gather per scan step serializes into s round-trips to HBM, while
-    # one vectorized gather up front costs a single pass — the scan body
-    # then runs on registers only (pure arithmetic + selects).
+    # Segment-start state is pre-gathered OUTSIDE the recurrence (one
+    # vectorized gather instead of per-step dynamic gathers).
     seg_latest = flow_dyn.latest_passed_time[gid_c]
     seg_stored = flow_dyn.stored_tokens[gid_c]
     seg_lastfill = flow_dyn.last_filled_time[gid_c]
 
-    def step(carry: _Carry, x):
-        (g, valid, ts, acq_f, acq, passq, prevq, b, cnt, mq, c1, wn, mx, sl, rt,
-         g_latest, g_stored, g_lastfill) = x
-        new_seg = g != carry.gid
-        latest = jnp.where(new_seg, g_latest, carry.latest)
-        stored = jnp.where(new_seg, g_stored, carry.stored)
-        lastfill = jnp.where(new_seg, g_lastfill, carry.lastfill)
-
-        is_wu = (b == C.CONTROL_BEHAVIOR_WARM_UP) | (
-            b == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER
-        )
-
-        # --- syncToken (WarmUpController.syncToken/coolDownTokens) ---
-        sec = ts - ts % 1000
-        do_sync = is_wu & (sec > lastfill) & valid
-        elapsed = (sec - lastfill).astype(jnp.float32)
-        refill_ok = (stored < wn) | ((stored > wn) & (prevq < rt))
-        refilled = jnp.minimum(jnp.floor(stored + elapsed * cnt / 1000.0), mx)
-        stored1 = jnp.where(do_sync & refill_ok, refilled, stored)
-        stored2 = jnp.where(do_sync, jnp.maximum(stored1 - prevq, 0.0), stored1)
-        lastfill2 = jnp.where(do_sync, sec, lastfill)
-
-        # --- warm-up admitted-QPS (above the warning line) ---
-        above = jnp.maximum(stored2 - wn, 0.0)
-        inv = above * sl + 1.0 / jnp.maximum(cnt, 1e-9)
-        # Math.nextUp on the Java double; nextafter on f32 here.
-        warning_qps = jnp.nextafter(1.0 / inv, jnp.float32(jnp.inf))
-        cold = stored2 >= wn
-
-        wu_ok = jnp.where(cold, passq + acq_f <= warning_qps, passq + acq_f <= cnt)
-
-        # --- pacer cost (RateLimiter / WarmUpRateLimiter) ---
-        cost_generic = jnp.floor(acq_f / jnp.maximum(cnt, 1e-9) * 1000.0 + 0.5)
-        cost_rl = jnp.where(acq == 1, c1.astype(jnp.float32), cost_generic)
-        cost_wurl_cold = jnp.floor(acq_f / warning_qps * 1000.0 + 0.5)
-        cost_wurl = jnp.where(cold, cost_wurl_cold, cost_rl)
-        cost = jnp.where(
-            b == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER, cost_wurl, cost_rl
-        ).astype(jnp.int32)
-
-        expected = latest + cost
-        imm = expected <= ts
-        wait = expected - ts
-        queued = (~imm) & (wait <= mq)
-        pacer_ok = (imm | queued) & (cnt > 0)
-        pacer_ok = pacer_ok | (acq <= 0)  # acquire<=0 always passes
-        latest2 = jnp.where(
-            valid & pacer_ok & (acq > 0), jnp.where(imm, ts, latest + cost), latest
-        )
-        wait_out = jnp.where(queued & pacer_ok, wait, 0)
-
-        is_pacer = (b == C.CONTROL_BEHAVIOR_RATE_LIMITER) | (
-            b == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER
-        )
-        ok = jnp.where(
-            b == C.CONTROL_BEHAVIOR_WARM_UP,
-            wu_ok,
-            jnp.where(is_pacer, pacer_ok, True),
-        )
-        ok = ok | ~valid
-        wait_out = jnp.where(valid & is_pacer, wait_out, 0)
-
-        # Pacer state only advances for pacer behaviors; warm-up state
-        # only via sync. Invalid slots must not touch the carry.
-        latest3 = jnp.where(valid & is_pacer, latest2, latest)
-        new_carry = _Carry(
-            gid=jnp.where(valid, g, carry.gid),
-            latest=jnp.where(valid, latest3, carry.latest),
-            stored=jnp.where(valid, stored2, carry.stored),
-            lastfill=jnp.where(valid, lastfill2, carry.lastfill),
-        )
-        # But a new segment must load fresh state even when this
-        # particular slot is invalid — invalid slots all sort to the
-        # tail, so an invalid slot never precedes a valid one; the
-        # simple form above is safe.
-        return new_carry, (ok, wait_out, latest3, stored2, lastfill2)
-
-    init = _Carry(
-        gid=jnp.int32(-1),
-        latest=jnp.int32(0),
-        stored=jnp.float32(0.0),
-        lastfill=jnp.int32(0),
-    )
-    xs = (
-        gid_c, valid_s, ts_s, acq_s, acq_i, passq_s, prevq_s,
+    items = (
+        valid_s, ts_s, acq_s, acq_i, passq_s, prevq_s,
         beh, count, maxq, cost1, warn, maxtok, slope, refill_thr,
-        seg_latest, seg_stored, seg_lastfill,
     )
-    _, (ok_s, wait_s, latest_s, stored_s, lastfill_s) = jax.lax.scan(step, init, xs)
+    ones = jnp.ones((1,), dtype=bool)
+    new_grp = jnp.concatenate([ones, gid_s[1:] != gid_s[:-1]])
+
+    def transition(states, item_vals):
+        latest, stored, lastfill = states
+        ok, wait_out, l2, s2, f2 = _transition(latest, stored, lastfill, item_vals)
+        return (ok, wait_out), (l2, s2, f2)
+
+    from sentinel_tpu.rules.recurrence import run_segmented
+
+    ok_s, wait_s, (latest_s, stored_s, lastfill_s) = run_segmented(
+        new_grp, (seg_latest, seg_stored, seg_lastfill), items, transition, rounds
+    )
 
     # Write final per-rule state back at segment ends (last write wins).
     seg_end = jnp.concatenate(

@@ -155,6 +155,9 @@ class BulkOp:
     origin: str
     src: Optional[Tuple[object, object, object]] = None
     custom_veto: Optional[Tuple[object, object]] = None
+    # Which entries a custom slot vetoed (per-acquire-value checks);
+    # None = no veto anywhere in the group.
+    custom_veto_mask: Optional[np.ndarray] = None
     # results (filled by flush)
     admitted: Optional[np.ndarray] = None
     reason: Optional[np.ndarray] = None
@@ -207,12 +210,28 @@ _BLOCK_EXC_NAMES = {
 }
 
 
+def _rounds_bucket(keys: np.ndarray) -> int:
+    """Host-known max items-per-key in a scan batch, bucketed to a
+    power of two (so each bucket compiles once) and capped: above 16
+    return 0, selecting the sequential lax.scan fallback (one rule
+    dominating the batch makes unrolled rounds pointless)."""
+    if keys.size == 0:
+        return 1
+    m = int(np.unique(keys, return_counts=True)[1].max())
+    if m > 16:
+        return 0
+    return 1 if m <= 1 else 1 << (m - 1).bit_length()
+
+
 def _weighted_rt(gx: "_BulkExitOp") -> int:
     """Count-weighted mean RT for aggregated completion callbacks — an
     unweighted mean would skew extensions that reconstruct total time
-    as rt × count."""
+    as rt × count. int64 product: rt·count overflows int32 at
+    aggregated counts well within bulk range."""
     total = int(gx.count.sum())
-    return int((gx.rt * gx.count).sum() / total) if total > 0 else 0
+    if total <= 0:
+        return 0
+    return int((gx.rt.astype(np.int64) * gx.count).sum() // total)
 
 
 def release_cluster_tokens(tokens: Sequence[Tuple[object, int]]) -> None:
@@ -317,13 +336,17 @@ class Engine:
                     self._n_shards = 1
         finally:
             self._post_flush(drained)
-    def _sharded_fn_for(self, with_shaping: bool, with_param: bool):
+    def _sharded_fn_for(
+        self, with_shaping: bool, with_param: bool,
+        shaping_rounds: int = 0, param_rounds: int = 0,
+    ):
         """Lazily-built sharded kernel variants (like the four single-
         chip jit variants: traffic without shaping/param rules never
-        pays for their machinery)."""
+        pays for their machinery; the rounds buckets pick the
+        vectorized recurrence path for the global scans)."""
         from sentinel_tpu.parallel import make_sharded_flush
 
-        key = (with_shaping, with_param)
+        key = (with_shaping, with_param, shaping_rounds, param_rounds)
         fn = self._sharded_fns.get(key)
         if fn is None:
             fn = make_sharded_flush(
@@ -331,6 +354,8 @@ class Engine:
                 occupy_timeout_ms=config.occupy_timeout_ms,
                 with_shaping=with_shaping,
                 with_param=with_param,
+                shaping_rounds=shaping_rounds,
+                param_rounds=param_rounds,
             )
             self._sharded_fns[key] = fn
         return fn
@@ -902,7 +927,9 @@ class Engine:
 
     def _encode_param(
         self, entries: List[_EntryOp], exits: List[_ExitOp], pindex: ParamIndex
-    ) -> Optional[ParamBatch]:
+    ) -> Tuple[Optional[ParamBatch], int]:
+        """Encode hot-param slots plus the host-known rounds bound (max
+        items per value row, pow2-bucketed; 0 → scan fallback)."""
         items = []
         for i, op in enumerate(entries):
             for ps in op.p_slots:
@@ -910,7 +937,7 @@ class Engine:
         exit_rows = [r for op in exits for r in op.p_rows]
         resets = pindex.take_resets()
         if not items and not exit_rows and not resets:
-            return None
+            return None, 1
         s = _pad_pow2(max(1, len(items)), 8)
         sx = _pad_pow2(max(1, len(exit_rows)), 8)
         q = _pad_pow2(max(1, len(resets)), 8)
@@ -958,7 +985,7 @@ class Engine:
             cost_ms=jnp.asarray(cost_ms),
             reset_rows=jnp.asarray(rs),
             exit_rows=jnp.asarray(xr),
-        )
+        ), _rounds_bucket(prow[: len(items)])
 
     def flush(self) -> List[_EntryOp]:
         """Encode + run the kernel for all pending ops; fills verdicts.
@@ -1061,6 +1088,22 @@ class Engine:
         # One kernel launch per max_batch slice: bounds device memory
         # for the padded batch regardless of how much queued up.
         mb = max(self.max_batch, 1)
+        n_bulk = sum(g.n for g in bulk_e)
+        m_bulk = sum(g.n for g in bulk_x)
+        if len(entries) + n_bulk <= mb and len(exits) + m_bulk <= mb:
+            # Everything fits one kernel call — singles and bulk share
+            # one flush, so ALL exits (incl. bulk-exit groups) apply
+            # before ALL admissions, exactly like the unbatched path.
+            items = self._run_chunk(
+                entries, exits, bulk_e, bulk_x, findex, dindex, pindex, auth_rules
+            )
+            out[0].extend(entries)
+            out[1].extend(items)
+            return out
+        # Oversized backlog: singles chunks, then packed bulk chunks.
+        # Exits in a later chunk are not visible to earlier chunks'
+        # admissions — the same caveat the singles chunk split already
+        # has at this size.
         for off in range(0, max(len(entries), len(exits)), mb):
             e_chunk = entries[off : off + mb]
             items = self._run_chunk(
@@ -1146,8 +1189,9 @@ class Engine:
         # A registered slot's veto blocks the entry before every device
         # stage — accounted like a first-slot BlockException (the block
         # scatter shares the authority channel; attribution is kept
-        # host-side on the op). Bulk groups are checked once per group
-        # (identical resource/origin/acquire shape by construction).
+        # host-side on the op). Bulk groups run the check once per
+        # DISTINCT acquire value (the only per-entry field a slot can
+        # see on this path) and veto exactly the matching entries.
         from sentinel_tpu.core.slots import SlotChainRegistry, SlotEntryContext
 
         if SlotChainRegistry.slots():
@@ -1160,13 +1204,21 @@ class Engine:
                         )
                     )
             for g in bulk:
-                if g.custom_veto is None:
-                    g.custom_veto = SlotChainRegistry.check_entry(
-                        SlotEntryContext(
-                            g.resource, g.context_name, g.origin,
-                            int(g.acquire[0]), False, (),
+                if g.custom_veto is None and g.custom_veto_mask is None:
+                    vetoed_vals = []
+                    for a in np.unique(g.acquire):
+                        veto = SlotChainRegistry.check_entry(
+                            SlotEntryContext(
+                                g.resource, g.context_name, g.origin,
+                                int(a), False, (),
+                            )
                         )
-                    )
+                        if veto is not None:
+                            if g.custom_veto is None:
+                                g.custom_veto = veto
+                            vetoed_vals.append(int(a))
+                    if vetoed_vals:
+                        g.custom_veto_mask = np.isin(g.acquire, vetoed_vals)
         # Pow2 padding is shard-divisible on any power-of-two mesh once
         # raised to at least n_shards (enable_mesh enforces pow2).
         n_bulk = sum(g.n for g in bulk)
@@ -1227,7 +1279,10 @@ class Engine:
                 e_crow[sl, j] = crow
             for j, dg in enumerate(g.d_gids[:kd]):
                 e_dgid[sl, j] = dg
-            e_auth[sl] = g.auth_ok and g.custom_veto is None
+            if g.custom_veto_mask is not None:
+                e_auth[sl] = g.auth_ok & ~g.custom_veto_mask
+            else:
+                e_auth[sl] = g.auth_ok
             off_b += g.n
 
         x_valid = np.zeros(m, dtype=bool)
@@ -1285,8 +1340,8 @@ class Engine:
         )
 
         sysdev = self._system_device()
-        shaping = self._encode_shaping(entries, bulk, k, findex)
-        param = self._encode_param(entries, exits, pindex)
+        shaping, sh_rounds = self._encode_shaping(entries, bulk, k, findex)
+        param, p_rounds = self._encode_param(entries, exits, pindex)
         occ_ms = config.occupy_timeout_ms
         common = (
             self.stats,
@@ -1306,12 +1361,16 @@ class Engine:
             with_system=self.system_config is not None,
             with_degrade=bool(dindex.rules),
             with_exits=bool(exits) or bool(bulk_exits),
+            shaping_rounds=sh_rounds,
+            param_rounds=p_rounds,
         )
         if self._sharded_fns is not None:
             # Mesh mode: one global batch sharded over the chips;
             # shaping/param item batches (global coordinates) ride
             # replicated into the globally-ordered scans.
-            fn = self._sharded_fn_for(shaping is not None, param is not None)
+            fn = self._sharded_fn_for(
+                shaping is not None, param is not None, sh_rounds, p_rounds
+            )
             extra = tuple(b for b in (shaping, param) if b is not None)
             out = fn(*common, *extra)
         elif shaping is None and param is None:
@@ -1381,8 +1440,8 @@ class Engine:
             bulk_slices.append((g, sl))
             g.admitted = np.array(admitted[sl])
             reasons = np.array(reason[sl], dtype=np.int32)
-            if g.custom_veto is not None:
-                reasons[~g.admitted] = E.BLOCK_CUSTOM
+            if g.custom_veto_mask is not None:
+                reasons[~g.admitted & g.custom_veto_mask] = E.BLOCK_CUSTOM
             g.reason = reasons
             g.wait_ms = np.array(wait_ms[sl])
             off_b += g.n
@@ -1492,15 +1551,17 @@ class Engine:
 
     def _encode_shaping(
         self, entries: List[_EntryOp], bulk: List[BulkOp], k: int, findex: FlowIndex
-    ) -> Optional[ShapingBatch]:
+    ) -> Tuple[Optional[ShapingBatch], int]:
         """Gather (entry, slot) pairs governed by shaping controllers
-        into the compact arrays the lax.scan path consumes. None when the
-        batch touches no shaping rules (the fast path). Bulk groups
-        contribute column blocks (an item per group entry per shaping
-        slot) without per-entry Python."""
+        into the compact arrays the pacer recurrence consumes, plus the
+        host-known rounds bound (max items per rule, pow2-bucketed; 0 →
+        scan fallback). (None, 1) when the batch touches no shaping
+        rules (the fast path). Bulk groups contribute column blocks (an
+        item per group entry per shaping slot) without per-entry
+        Python."""
         sg = findex.shaping_gids
         if not sg:
-            return None
+            return None, 1
         items = []
         for i, op in enumerate(entries):
             for j, (gid, crow) in enumerate(op.slots[:k]):
@@ -1531,7 +1592,7 @@ class Engine:
                     )
             off += g.n
         if not cols:
-            return None
+            return None, 1
         flat_pos, gid, row, eidx, ts, acquire = (
             np.concatenate([c[a] for c in cols]) for a in range(6)
         )
@@ -1551,7 +1612,7 @@ class Engine:
             flat_pos=jnp.asarray(_p(flat_pos)),
             ts=jnp.asarray(_p(ts)),
             acquire=jnp.asarray(_p(acquire, 1)),
-        )
+        ), _rounds_bucket(gid)
 
     def entry_sync(
         self,

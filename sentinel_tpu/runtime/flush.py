@@ -591,8 +591,15 @@ def flush_entries(
     with_occupy: bool = True,
     with_system: bool = True,
     with_degrade: bool = True,
+    shaping_rounds: int = 0,
+    param_rounds: int = 0,
 ) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
     """Phases 2-3: admission checks and (when ``commit``) accounting.
+
+    ``shaping_rounds`` / ``param_rounds`` (static) are the host-known
+    max-items-per-rule bounds selecting the vectorized rounds path of
+    the serializing scans (rules/shaping.py, rules/param_table.py);
+    0 = sequential lax.scan fallback.
 
     The ``with_*`` flags are host-known specializations — "no
     prioritized entries in this batch" / "no system rules configured" /
@@ -639,7 +646,7 @@ def flush_entries(
         dec_rows = jnp.where(param.exit_rows >= 0, param.exit_rows, jnp.int32(pr0))
         pdyn = pdyn._replace(threads=pdyn.threads.at[dec_rows].add(-1, mode="drop"))
         param_live = param._replace(valid=param.valid & live[param.eidx])
-        pdyn, p_ok_s, p_wait_s = run_param(pdyn, param_live)
+        pdyn, p_ok_s, p_wait_s = run_param(pdyn, param_live, rounds=param_rounds)
         eidx_p = jnp.where(param_live.valid, param.eidx, jnp.int32(n))
         param_ok = param_ok.at[eidx_p].min(p_ok_s, mode="drop")
         wait_param = wait_param.at[eidx_p].max(p_wait_s, mode="drop")
@@ -663,7 +670,8 @@ def flush_entries(
         interval_sec = SECOND_CFG.interval_ms / 1000.0
         shaping_live = shaping._replace(valid=shaping.valid & live[shaping.eidx])
         flow_dyn, ok_s, wait_s = run_shaping(
-            flow_dev, flow_dyn, shaping_live, ppc_s, prev_s, interval_sec
+            flow_dev, flow_dyn, shaping_live, ppc_s, prev_s, interval_sec,
+            rounds=shaping_rounds,
         )
         flat_ok = slot_ok.reshape(-1)
         scatter_pos = jnp.where(
@@ -807,6 +815,8 @@ def flush_step(
     with_system: bool = True,
     with_degrade: bool = True,
     with_exits: bool = True,
+    shaping_rounds: int = 0,
+    param_rounds: int = 0,
 ) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
     """Pure function: apply one batch.
 
@@ -834,6 +844,7 @@ def flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
         occupy_timeout_ms=occupy_timeout_ms,
         with_occupy=with_occupy, with_system=with_system, with_degrade=with_degrade,
+        shaping_rounds=shaping_rounds, param_rounds=param_rounds,
     )
 
 
@@ -843,6 +854,7 @@ def flush_step(
 # flags are static (each used combination compiles once and is cached).
 _STATIC_FLAGS = (
     "occupy_timeout_ms", "with_occupy", "with_system", "with_degrade", "with_exits",
+    "shaping_rounds", "param_rounds",
 )
 
 
@@ -850,12 +862,14 @@ _STATIC_FLAGS = (
 def flush_step_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
+    shaping_rounds=0, param_rounds=0,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch,
         occupy_timeout_ms=occupy_timeout_ms,
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
+        shaping_rounds=shaping_rounds, param_rounds=param_rounds,
     )
 
 
@@ -864,12 +878,14 @@ def flush_step_shaping_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
     occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
+    shaping_rounds=0, param_rounds=0,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
         occupy_timeout_ms=occupy_timeout_ms,
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
+        shaping_rounds=shaping_rounds, param_rounds=param_rounds,
     )
 
 
@@ -878,12 +894,14 @@ def flush_step_param_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, param,
     occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
+    shaping_rounds=0, param_rounds=0,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, None, param,
         occupy_timeout_ms=occupy_timeout_ms,
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
+        shaping_rounds=shaping_rounds, param_rounds=param_rounds,
     )
 
 
@@ -892,10 +910,12 @@ def flush_step_full_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
     occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
+    shaping_rounds=0, param_rounds=0,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
         occupy_timeout_ms=occupy_timeout_ms,
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
+        shaping_rounds=shaping_rounds, param_rounds=param_rounds,
     )

@@ -194,6 +194,78 @@ class TestBulkEntries:
         assert key[2] == "appA"
         assert count == 6
 
+    def test_bulk_exits_apply_before_singles_entries(self, manual_clock, engine):
+        """One flush mixing a bulk-exit group with singles entries:
+        the exits release thread slots BEFORE admission, exactly like
+        the unbatched path."""
+        import sentinel_tpu as st
+
+        engine.set_flow_rules([st.FlowRule("ord", grade=0, count=4)])
+        g = engine.submit_bulk("ord", 4)
+        engine.flush()
+        assert g.admitted_count == 4  # gauge now at 4
+        engine.submit_exit_bulk(g.rows, 4, rt=5, resource="ord")
+        ops = engine.submit_many([{"resource": "ord"} for _ in range(4)])
+        engine.flush()  # one flush: bulk exits + singles entries
+        assert sum(o.verdict.admitted for o in ops) == 4
+
+    def test_bulk_exit_weighted_rt_no_overflow(self, manual_clock, engine):
+        """Aggregated rt×count products overflow int32 — the callback
+        must receive the true count-weighted mean."""
+        import sentinel_tpu as st
+        from sentinel_tpu.metrics.extension import MetricExtension, MetricExtensionProvider
+
+        seen = []
+
+        class Ext(MetricExtension):
+            def add_rt(self, resource, rt_ms, *args):
+                seen.append(("rt", resource, rt_ms))
+
+            def add_success(self, resource, n, *args):
+                seen.append(("success", resource, n))
+
+        engine.set_flow_rules([st.FlowRule("w8", count=100)])
+        MetricExtensionProvider.register(Ext())
+        try:
+            g = engine.submit_bulk("w8", 2)
+            engine.flush()
+            engine.submit_exit_bulk(
+                g.rows, 2, rt=np.array([4000, 10], dtype=np.int32),
+                count=np.array([600_000, 1], dtype=np.int32), resource="w8",
+            )
+            engine.flush()
+            (rt,) = [v for k, r, v in seen if k == "rt" and r == "w8"]
+            (count,) = [v for k, r, v in seen if k == "success" and r == "w8"]
+            assert count == 600_001
+            assert rt == (4000 * 600_000 + 10) // 600_001  # ≈ 3999, not negative
+        finally:
+            MetricExtensionProvider.clear()
+
+    def test_bulk_custom_slot_vetoes_per_acquire(self, manual_clock, engine):
+        """A custom slot that vetoes by acquire blocks exactly the
+        matching entries of a mixed-acquire group."""
+        import sentinel_tpu as st
+        from sentinel_tpu.core import errors as E
+        from sentinel_tpu.core.slots import ProcessorSlot, SlotChainRegistry
+
+        class BigAcquireVeto(ProcessorSlot):
+            name = "big-acquire"
+
+            def entry(self, ctx):
+                return "too-big" if ctx.acquire > 10 else None
+
+        engine.set_flow_rules([st.FlowRule("cs", count=1000)])
+        SlotChainRegistry.register(BigAcquireVeto())
+        try:
+            g = engine.submit_bulk(
+                "cs", 3, acquire=np.array([1, 50, 50], dtype=np.int32)
+            )
+            engine.flush()
+            assert g.admitted.tolist() == [True, False, False]
+            assert g.reason[1] == E.BLOCK_CUSTOM
+        finally:
+            SlotChainRegistry.clear()
+
     def test_bulk_size_guards(self, manual_clock, engine):
         with pytest.raises(ValueError, match="n must be"):
             engine.submit_bulk("x", 0)

@@ -1,0 +1,137 @@
+"""The vectorized rounds path of the shaping/param recurrences must be
+bit-identical to the sequential lax.scan on any batch whose
+max-items-per-key fits the rounds bound — both resolve the same sorted
+(rule, ts, arrival) stream; only the execution schedule differs.
+"""
+
+import numpy as np
+import pytest
+
+
+def _random_shaping_case(rng, s, n_rules):
+    import jax.numpy as jnp
+
+    from sentinel_tpu.models import constants as C
+    from sentinel_tpu.rules.flow_table import FlowRuleDynState, FlowTableDevice
+    from sentinel_tpu.rules.shaping import ShapingBatch
+
+    beh = rng.choice(
+        [C.CONTROL_BEHAVIOR_RATE_LIMITER, C.CONTROL_BEHAVIOR_WARM_UP,
+         C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER],
+        n_rules,
+    ).astype(np.int32)
+    count = rng.integers(1, 50, n_rules).astype(np.float32)
+    dev = FlowTableDevice(
+        grade=np.ones(n_rules, dtype=np.int32),
+        count=jnp.asarray(count),
+        behavior=jnp.asarray(beh),
+        max_queueing_time_ms=jnp.asarray(rng.integers(0, 500, n_rules).astype(np.int32)),
+        cost1_ms=jnp.asarray((1000.0 / count + 0.5).astype(np.int32)),
+        warmup_warning_token=jnp.asarray(rng.integers(1, 100, n_rules).astype(np.int32)),
+        warmup_max_token=jnp.asarray(rng.integers(100, 300, n_rules).astype(np.int32)),
+        warmup_slope=jnp.asarray(rng.random(n_rules).astype(np.float32) * 1e-3),
+        warmup_refill_threshold=jnp.asarray(rng.integers(1, 30, n_rules).astype(np.int32)),
+    )
+    dyn = FlowRuleDynState(
+        latest_passed_time=jnp.asarray(rng.integers(-1000, 2000, n_rules).astype(np.int32)),
+        stored_tokens=jnp.asarray(rng.integers(0, 200, n_rules).astype(np.float32)),
+        last_filled_time=jnp.asarray(rng.integers(-1000, 2000, n_rules).astype(np.int32)),
+    )
+    gid = rng.integers(0, n_rules, s).astype(np.int32)
+    valid = rng.random(s) < 0.9
+    sb = ShapingBatch(
+        valid=jnp.asarray(valid),
+        gid=jnp.asarray(gid),
+        row=jnp.asarray(gid),
+        eidx=jnp.asarray(np.arange(s, dtype=np.int32)),
+        flat_pos=jnp.asarray(np.arange(s, dtype=np.int32)),
+        ts=jnp.asarray(np.sort(rng.integers(1000, 4000, s)).astype(np.int32)),
+        acquire=jnp.asarray(rng.integers(1, 3, s).astype(np.int32)),
+    )
+    ppc = jnp.asarray(rng.integers(0, 40, s).astype(np.int32))
+    prev = jnp.asarray(rng.integers(0, 40, s).astype(np.int32))
+    max_per_rule = int(np.unique(gid[valid], return_counts=True)[1].max()) if valid.any() else 1
+    return dev, dyn, sb, ppc, prev, max_per_rule
+
+
+class TestRoundsParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_shaping_rounds_equals_scan(self, seed):
+        import jax
+        from sentinel_tpu.rules.shaping import run_shaping
+
+        rng = np.random.default_rng(seed)
+        dev, dyn, sb, ppc, prev, m = _random_shaping_case(rng, 64, 12)
+        rounds = 1 << (max(m, 1) - 1).bit_length()
+        d0, ok0, w0 = jax.jit(run_shaping, static_argnames=("rounds",))(
+            dev, dyn, sb, ppc, prev, 1.0, rounds=0
+        )
+        d1, ok1, w1 = jax.jit(run_shaping, static_argnames=("rounds",))(
+            dev, dyn, sb, ppc, prev, 1.0, rounds=rounds
+        )
+        assert np.array_equal(np.asarray(ok0), np.asarray(ok1))
+        assert np.array_equal(np.asarray(w0), np.asarray(w1))
+        for a, b in zip(d0, d1):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_param_rounds_equals_scan(self, seed):
+        import jax
+        import jax.numpy as jnp
+
+        from sentinel_tpu.models import constants as C
+        from sentinel_tpu.rules.param_table import ParamBatch, make_param_state, run_param
+
+        rng = np.random.default_rng(seed + 100)
+        s, pr = 64, 16
+        dyn = make_param_state(pr)
+        dyn = dyn._replace(
+            tokens=jnp.asarray(rng.integers(0, 10, pr).astype(np.int32)),
+            threads=jnp.asarray(rng.integers(0, 3, pr).astype(np.int32)),
+        )
+        prow = rng.integers(0, pr, s).astype(np.int32)
+        valid = rng.random(s) < 0.9
+        grade = rng.choice([C.FLOW_GRADE_QPS, C.FLOW_GRADE_THREAD], s).astype(np.int32)
+        behavior = rng.choice([0, C.CONTROL_BEHAVIOR_RATE_LIMITER], s).astype(np.int32)
+        pb = ParamBatch(
+            valid=jnp.asarray(valid),
+            prow=jnp.asarray(prow),
+            eidx=jnp.asarray(np.arange(s, dtype=np.int32)),
+            ts=jnp.asarray(np.sort(rng.integers(1000, 4000, s)).astype(np.int32)),
+            acquire=jnp.asarray(rng.integers(1, 3, s).astype(np.int32)),
+            grade=jnp.asarray(grade),
+            behavior=jnp.asarray(behavior),
+            token_count=jnp.asarray(rng.integers(1, 10, s).astype(np.int32)),
+            burst=jnp.asarray(rng.integers(0, 3, s).astype(np.int32)),
+            duration_ms=jnp.asarray(rng.integers(500, 2000, s).astype(np.int32)),
+            maxq=jnp.asarray(rng.integers(0, 300, s).astype(np.int32)),
+            cost_ms=jnp.asarray(rng.integers(10, 200, s).astype(np.int32)),
+            reset_rows=jnp.asarray(np.array([1, -1, -1, -1], dtype=np.int32)),
+            exit_rows=jnp.full(4, -1, dtype=np.int32),
+        )
+        m = int(np.unique(prow[valid], return_counts=True)[1].max()) if valid.any() else 1
+        rounds = 1 << (max(m, 1) - 1).bit_length()
+        d0, ok0, w0 = jax.jit(run_param, static_argnames=("rounds",))(dyn, pb, rounds=0)
+        d1, ok1, w1 = jax.jit(run_param, static_argnames=("rounds",))(dyn, pb, rounds=rounds)
+        assert np.array_equal(np.asarray(ok0), np.asarray(ok1))
+        assert np.array_equal(np.asarray(w0), np.asarray(w1))
+        for a, b in zip(d0, d1):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_engine_picks_scan_fallback_above_cap(self, manual_clock, engine):
+        """More than 16 same-rule shaping items in one flush: the
+        engine falls back to the scan (rounds=0) and still decides
+        correctly."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models import constants as C
+
+        engine.set_flow_rules(
+            [st.FlowRule("big", count=10,
+                         control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                         max_queueing_time_ms=2000)]
+        )
+        manual_clock.set_ms(1000)
+        g = engine.submit_bulk("big", 24, ts=1000)
+        engine.flush()
+        # cost=100ms, maxq=2000 → 1 immediate + 20 queued.
+        assert g.admitted_count == 21
