@@ -78,6 +78,8 @@ class FlowIndex:
     """Host-side compiled view of the active flow rules."""
 
     def __init__(self, rules: Sequence[FlowRule], cold_factor: int = 3) -> None:
+        # (resource, context, origin) -> resolved slots; see resolve_slots.
+        self._slot_cache: Dict[Tuple[str, str, str], List[Tuple[int, int]]] = {}
         valid: List[FlowRule] = []
         for r in rules:
             if isinstance(r, dict):
@@ -220,13 +222,40 @@ class FlowIndex:
         Mirrors selectNodeByRequesterAndStrategy
         (FlowRuleChecker.java:96-165). A rule returning "no node" there is
         simply omitted (it passes trivially).
+
+        Memoized per (resource, context, origin): node rows are stable
+        once interned and the rule set is frozen per index, so repeat
+        submissions skip the per-rule row selection (the submit hot
+        path — the analog of the reference caching one slot chain per
+        resource, CtSph.lookProcessChain). The cache assumes one
+        NodeRegistry per index, which the engine guarantees (a reload
+        builds a fresh index; reset builds both fresh). Callers must
+        not mutate the returned list.
         """
+        key = (resource, context_name, origin)
+        hit = self._slot_cache.get(key)
+        if hit is not None:
+            return hit
         out: List[Tuple[int, int]] = []
+        cacheable = True
         for cr in self.by_resource.get(resource, ()):
             r = cr.rule
             row = self._select_row(r, resource, context_name, origin, nodes)
             if row is not None:
                 out.append((cr.gid, row))
+            elif (
+                r.strategy == C.STRATEGY_RELATE
+                and r.ref_resource
+                and nodes.lookup_cluster_row(r.ref_resource) is None
+            ):
+                # RELATE omission is TRANSIENT: the referenced
+                # resource's node appears when it first sees traffic
+                # (lookup is non-creating, matching selectReferenceNode
+                # returning null until then) — pinning the omission
+                # would disable the cross-resource limit forever.
+                cacheable = False
+        if cacheable:
+            self._slot_cache[key] = out
         return out
 
     def _select_row(

@@ -270,6 +270,8 @@ class Engine:
         # not re-sum every group per submit).
         self._bulk_pending_n = 0
         self._bulk_exit_pending_n = 0
+        # (resource, ctx, origin, entry_type) -> rows tuple | None.
+        self._rows_cache: Dict[tuple, Optional[Tuple[int, int, int, int]]] = {}
         self._lock = threading.RLock()
         # Serializes flushes + rule-table swaps; never taken while
         # holding _lock (fixed order _flush_lock → _lock).
@@ -460,19 +462,29 @@ class Engine:
         """The NodeSelectorSlot/ClusterBuilderSlot work: rows for the
         default node, cluster node, origin node and global entry node.
         Returns None above the resource cap (pass-through, like
-        CtSph.lookProcessChain returning null)."""
+        CtSph.lookProcessChain returning null). Memoized — rows are
+        stable once interned; this is the submit hot path. The over-cap
+        None is NOT cached: past the cap the registry deliberately
+        stops allocating, and caching per unique name would reintroduce
+        unbounded growth on exactly the path the cap bounds."""
+        key = (resource, context_name, origin, entry_type)
+        hit = self._rows_cache.get(key)
+        if hit is not None:
+            return hit
         crow = self.nodes.cluster_row(resource)
         if crow is None:
             return None
         drow = self.nodes.default_row(resource, context_name)
         orow = self.nodes.origin_row(resource, origin) if origin else None
         erow = self.nodes.entry_node_row if entry_type == C.EntryType.IN else None
-        return (
+        rows = (
             drow if drow is not None else -1,
             crow,
             orow if orow is not None else -1,
             erow if erow is not None else -1,
         )
+        self._rows_cache[key] = rows
+        return rows
 
     def submit_entry(
         self,
@@ -1729,6 +1741,7 @@ class Engine:
             self._bulk_exits.clear()
             self._bulk_pending_n = 0
             self._bulk_exit_pending_n = 0
+            self._rows_cache = {}
             self.nodes.clear()
             self.stats = make_stats(self.stats.n_rows)
             self.flow_index = FlowIndex([], cold_factor=config.cold_factor)

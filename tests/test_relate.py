@@ -85,3 +85,21 @@ class TestRelateBatchedConservatism:
         ops = engine.submit_many([{"resource": "D", "ts": now} for _ in range(10)])
         engine.flush()
         assert sum(op.verdict.admitted for op in ops) == 4
+
+
+class TestRelateResolutionCache:
+    def test_relate_enforced_after_ref_appears(self, manual_clock, engine):
+        """Traffic to A BEFORE B's node exists must not pin the rule to
+        'omitted' — once B sees traffic, the cross-resource limit
+        engages (selectReferenceNode is re-evaluated per entry in the
+        reference; the resolution memo must not cache the transient
+        miss)."""
+        st.flow_rule_manager.load_rules([_relate_rule(0)])  # count=0: blocks
+        manual_clock.set_ms(100)
+        # B's node doesn't exist yet → the rule passes trivially.
+        assert st.try_entry("A") is not None
+        # B appears.
+        assert st.try_entry("B") is not None
+        # Now the RELATE rule binds (count=0 → block), even for the
+        # same (resource, context, origin) key as the first entry.
+        assert st.try_entry("A") is None
