@@ -27,8 +27,9 @@ eng = st.get_engine()
 # Warm-up flush: pays the one-time XLA compile for this batch shape.
 w = eng.submit_bulk(RESOURCE, 100_000)
 eng.flush()
-eng.submit_exit_bulk(w.rows, w.admitted_count, rt=7, resource=RESOURCE)
-eng.flush()
+if w.admitted_count:
+    eng.submit_exit_bulk(w.rows, w.admitted_count, rt=7, resource=RESOURCE)
+    eng.flush()
 
 # One columnar group: 100k entries, one resolve, one kernel launch.
 n = 100_000
@@ -54,6 +55,7 @@ print(
 
 # Release the admitted entries in one bulk exit group (success + RT +
 # thread release + breaker completions).
-eng.submit_exit_bulk(g.rows, g.admitted_count, rt=7, resource=RESOURCE)
-eng.flush()
+if g.admitted_count:
+    eng.submit_exit_bulk(g.rows, g.admitted_count, rt=7, resource=RESOURCE)
+    eng.flush()
 print(f"after exits: threads={eng.cluster_node_stats(RESOURCE)['cur_thread_num']}")

@@ -117,8 +117,41 @@ class OracleOccupiableArray(OracleLeapArray):
             bb = self.borrow.get_window_value(ws)
             if bb is not None:  # newEmptyBucket / resetWindowTo copy
                 b.counts[MetricEvent.PASS] += bb.counts[MetricEvent.PASS]
+                # Consume the slot: a later materialize(t) must not fold
+                # the same borrow again (the reference's borrow array is
+                # read per roll too — each window's tokens land once).
+                for bi, cand in enumerate(self.borrow.buckets):
+                    if cand is bb:
+                        self.borrow.buckets[bi] = None
             self.buckets[idx] = b
         return b
+
+    def materialize(self, t: int) -> None:
+        """The engine's per-flush fold (metrics/nodes.materialize_matured):
+        every matured borrow rolls-or-adds into its window's bucket and
+        clears its slab slot. The reference does this lazily via
+        currentWindow's newEmptyBucket/resetWindowTo on the next touch;
+        the engine does it eagerly each flush — oracle models driving
+        flush-per-op sequences must call this where the engine flushes,
+        or a matured borrow that no write ever touched stays invisible
+        to reads."""
+        for bi, bb in enumerate(self.borrow.buckets):
+            if bb is None:
+                continue
+            ws = bb.window_start
+            age = t - ws
+            if age < 0:
+                continue
+            if age <= self.interval_ms:
+                idx = (ws // self.window_len) % self.sample_count
+                b = self.buckets[idx]
+                if b is None or b.window_start < ws:
+                    nb = OracleBucket(ws, self.max_rt)
+                    nb.counts[MetricEvent.PASS] = bb.counts[MetricEvent.PASS]
+                    self.buckets[idx] = nb
+                elif b.window_start == ws:
+                    b.counts[MetricEvent.PASS] += bb.counts[MetricEvent.PASS]
+            self.borrow.buckets[bi] = None
 
     def waiting(self, t: int) -> int:
         """currentWaiting: borrowed tokens for strictly-future windows."""
@@ -152,6 +185,11 @@ class OracleNode:
 
     def waiting(self, t: int) -> int:
         return self.second.waiting(t)
+
+    def materialize(self, t: int) -> None:
+        """Mirror of the engine's per-flush borrow maturation — see
+        OracleOccupiableArray.materialize."""
+        self.second.materialize(t)
 
     def try_occupy_next(
         self, t: int, acquire: int, threshold: float, occupy_timeout_ms: int = 500
