@@ -9,6 +9,8 @@ divergence is a real semantic bug, not a documented batching
 conservatism.
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -67,6 +69,11 @@ class _Model:
         return self.ctrl.can_pass(self.node, t), 0
 
     def account_entry(self, t: int, admitted: bool, occupied_wait: int) -> None:
+        # Mirrors OracleFlowEngine.entry_prio's StatisticSlot branches
+        # (testing/oracle.py) — that method is the authoritative model;
+        # keep the two in sync if the PriorityWaitException accounting
+        # ever changes (this copy exists because _Model also drives
+        # shaping controllers OracleFlowEngine doesn't hold).
         if not admitted:
             self.node.add_block(t, 1)
             return
@@ -92,7 +99,7 @@ def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
     for i, kind in enumerate(kinds):
         m = _Model(kind, rng)
         res = f"res-{kind}"
-        m.rule = m.rule.__class__(**{**m.rule.__dict__, "resource": res})
+        m.rule = dataclasses.replace(m.rule, resource=res)
         models[res] = m
         rules.append(m.rule)
     st.flow_rule_manager.load_rules(rules)
@@ -140,6 +147,60 @@ def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
 
     # Final gauge + block-window stats agree too (pass windows involve
     # borrow-maturation bookkeeping asserted by tests/test_occupy.py).
+    for res, m in models.items():
+        stats = engine.cluster_node_stats(res, flush=False)
+        assert stats["block_qps"] == pytest.approx(m.node.block_qps(t), abs=1e-6), res
+        assert stats["cur_thread_num"] == m.node.cur_thread_num, res
+
+
+def test_random_sequential_stream_matches_oracle_on_mesh(manual_clock, engine):
+    """The same differential harness against the SHARDED engine: a
+    sequential stream on the 8-device mesh must still match the oracle
+    exactly (merges, demotion passes and the global scans collapse to
+    the single-chip semantics when one op flushes at a time)."""
+    engine.enable_mesh(8)
+    rng = np.random.default_rng(7)
+    models = {}
+    rules = []
+    for kind in ["qps", "thread", "rl"]:
+        m = _Model(kind, rng)
+        res = f"res-{kind}"
+        m.rule = dataclasses.replace(m.rule, resource=res)
+        models[res] = m
+        rules.append(m.rule)
+    st.flow_rule_manager.load_rules(rules)
+    resources = list(models)
+
+    t = 1000
+    manual_clock.set_ms(t)
+    open_entries = []
+    for step in range(60):
+        t += int(rng.integers(0, 400))
+        manual_clock.set_ms(t)
+        for m in models.values():
+            m.node.materialize(t)
+        if rng.random() < 0.72 or not open_entries:
+            res = resources[int(rng.integers(0, len(resources)))]
+            m = models[res]
+            want, want_wait = m.decide(t, False)
+            op = engine.submit_entry(res, ts=t)
+            engine.flush()
+            assert op.verdict.admitted == want, (step, res, t)
+            assert op.verdict.wait_ms == want_wait, (step, res, t)
+            m.account_entry(t, want, 0)
+            if want:
+                open_entries.append((res, op))
+        else:
+            idx = int(rng.integers(0, len(open_entries)))
+            res, op = open_entries.pop(idx)
+            rt = int(rng.integers(1, 60))
+            engine.submit_exit(op.rows, rt=rt, ts=t, resource=res)
+            engine.flush()
+            models[res].account_exit(t, rt)
+
+    # The merged (all-reduced) gauges and block windows must match too —
+    # a merge that double-counted per device would pass every
+    # sequential-stream verdict and only show up here.
     for res, m in models.items():
         stats = engine.cluster_node_stats(res, flush=False)
         assert stats["block_qps"] == pytest.approx(m.node.block_qps(t), abs=1e-6), res
