@@ -272,6 +272,9 @@ class Engine:
         self._bulk_exit_pending_n = 0
         # (resource, ctx, origin, entry_type) -> rows tuple | None.
         self._rows_cache: Dict[tuple, Optional[Tuple[int, int, int, int]]] = {}
+        # Background flusher (see start_auto_flush).
+        self._auto_flush_thread: Optional[threading.Thread] = None
+        self._auto_flush_stop: Optional[threading.Event] = None
         self._lock = threading.RLock()
         # Serializes flushes + rule-table swaps; never taken while
         # holding _lock (fixed order _flush_lock → _lock).
@@ -998,6 +1001,57 @@ class Engine:
             reset_rows=jnp.asarray(rs),
             exit_rows=jnp.asarray(xr),
         ), _rounds_bucket(prow[: len(items)])
+
+    def start_auto_flush(self, interval_ms: Optional[float] = None) -> None:
+        """Background flusher for deferred mode: pending ops are
+        decided within ~``interval_ms`` (config
+        ``sentinel.tpu.flush.interval.ms``, default 2) even when no
+        caller invokes :meth:`flush` — submit-and-await callers (async
+        entries, fire-and-forget adapters) get bounded decision latency
+        the way the reference's cluster client bounds its RPC wait.
+        Idempotent; the thread is a daemon and survives :meth:`reset`.
+        """
+        with self._lock:
+            if self._auto_flush_thread is not None:
+                return
+            iv = (
+                interval_ms
+                if interval_ms is not None
+                else config.get_float(config.FLUSH_INTERVAL_MS, 2.0)
+            ) / 1000.0
+            # Clamp: a zero/negative interval (bad config) must not
+            # turn the daemon into a busy-spin hammering the locks.
+            iv = max(iv, 1e-4)
+            stop = threading.Event()
+            self._auto_flush_stop = stop
+
+            def _loop() -> None:
+                from sentinel_tpu.utils.record_log import record_log
+
+                while not stop.wait(iv):
+                    try:
+                        with self._lock:
+                            pending = bool(
+                                self._entries or self._exits
+                                or self._bulk_entries or self._bulk_exits
+                            )
+                        if pending:
+                            self.flush()
+                    except Exception:
+                        record_log.error("[Engine] auto-flush failed", exc_info=True)
+
+            t = threading.Thread(target=_loop, name="sentinel-auto-flush", daemon=True)
+            self._auto_flush_thread = t
+            t.start()
+
+    def stop_auto_flush(self) -> None:
+        with self._lock:
+            t, stop = self._auto_flush_thread, self._auto_flush_stop
+            self._auto_flush_thread = None
+            self._auto_flush_stop = None
+        if t is not None and stop is not None:
+            stop.set()
+            t.join(timeout=5)
 
     def flush(self) -> List[_EntryOp]:
         """Encode + run the kernel for all pending ops; fills verdicts.

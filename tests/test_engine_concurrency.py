@@ -177,3 +177,31 @@ class TestRuleReloadConcurrency:
         nop = engine.submit_entry("res")
         engine.flush()
         assert not nop.verdict.admitted  # new count=0
+
+
+class TestAutoFlush:
+    def test_auto_flush_decides_pending_ops(self, manual_clock, engine):
+        """Deferred submissions get verdicts without any explicit
+        flush() once the background flusher runs."""
+        import time
+
+        import sentinel_tpu as st
+
+        engine.set_flow_rules([st.FlowRule("af", count=100)])
+        engine.start_auto_flush(interval_ms=5)
+        try:
+            op = engine.submit_entry("af")
+            deadline = time.monotonic() + 5.0
+            while op.verdict is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert op.verdict is not None and op.verdict.admitted
+        finally:
+            engine.stop_auto_flush()
+
+    def test_auto_flush_idempotent_start_stop(self, manual_clock, engine):
+        engine.start_auto_flush(interval_ms=5)
+        engine.start_auto_flush(interval_ms=5)  # no second thread
+        assert engine._auto_flush_thread is not None
+        engine.stop_auto_flush()
+        assert engine._auto_flush_thread is None
+        engine.stop_auto_flush()  # no-op
