@@ -1009,11 +1009,21 @@ class Engine:
         caller invokes :meth:`flush` — submit-and-await callers (async
         entries, fire-and-forget adapters) get bounded decision latency
         the way the reference's cluster client bounds its RPC wait.
-        Idempotent; the thread is a daemon and survives :meth:`reset`.
+        The thread is a daemon and survives :meth:`reset`. Calling
+        again while running is a no-op UNLESS an explicit
+        ``interval_ms`` is given — then the flusher restarts at the new
+        cadence (silently dropping a requested interval would leave the
+        caller believing it took effect).
         """
         with self._lock:
-            if self._auto_flush_thread is not None:
+            running = self._auto_flush_thread is not None
+        if running:
+            if interval_ms is None:
                 return
+            self.stop_auto_flush()
+        with self._lock:
+            if self._auto_flush_thread is not None:
+                return  # lost a start race; the other caller's flusher runs
             iv = (
                 interval_ms
                 if interval_ms is not None
@@ -1028,7 +1038,10 @@ class Engine:
             def _loop() -> None:
                 from sentinel_tpu.utils.record_log import record_log
 
-                while not stop.wait(iv):
+                failures = 0
+                while not stop.wait(
+                    iv if failures == 0 else min(1.0, iv * 2**failures)
+                ):
                     try:
                         with self._lock:
                             pending = bool(
@@ -1037,8 +1050,17 @@ class Engine:
                             )
                         if pending:
                             self.flush()
+                        failures = 0
                     except Exception:
-                        record_log.error("[Engine] auto-flush failed", exc_info=True)
+                        # Backoff to ≤1 Hz and log only the streak's
+                        # first failure — at a 2 ms period a persistent
+                        # device error would otherwise churn the record
+                        # log with ~500 tracebacks/second.
+                        if failures == 0:
+                            record_log.error(
+                                "[Engine] auto-flush failed", exc_info=True
+                            )
+                        failures = min(failures + 1, 16)
 
             t = threading.Thread(target=_loop, name="sentinel-auto-flush", daemon=True)
             self._auto_flush_thread = t

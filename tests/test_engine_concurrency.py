@@ -205,3 +205,12 @@ class TestAutoFlush:
         engine.stop_auto_flush()
         assert engine._auto_flush_thread is None
         engine.stop_auto_flush()  # no-op
+
+    def test_auto_flush_restart_with_new_interval(self, manual_clock, engine):
+        engine.start_auto_flush(interval_ms=5)
+        t1 = engine._auto_flush_thread
+        engine.start_auto_flush()  # no interval: no-op
+        assert engine._auto_flush_thread is t1
+        engine.start_auto_flush(interval_ms=50)  # explicit: restart
+        assert engine._auto_flush_thread is not t1
+        engine.stop_auto_flush()

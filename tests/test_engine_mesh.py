@@ -322,6 +322,50 @@ class TestEngineMesh:
         # DEFAULT budget (20) binds tighter than the pacer here.
         assert sum(got) == 20
 
+    def test_origin_split_budget_is_conservative(self, mesh_engine, manual_clock):
+        """One rule checked against several origin rows in a batch: the
+        sharded budget takes the per-rule MIN across touched rows
+        (parallel/ici._demote_over_grant) — conservative, never
+        admitting more than single-chip, and never over any row's cap."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models import constants as C
+        from sentinel_tpu.runtime.engine import Engine
+
+        rules = [st.FlowRule("os", count=10, limit_app=C.LIMIT_APP_OTHER)]
+        mesh_engine.set_flow_rules(rules)
+        ref = Engine(clock=manual_clock)
+        ref.set_flow_rules(rules)
+        manual_clock.set_ms(1000)
+        pre = [{"resource": "os", "origin": "o1", "ts": 1000} for _ in range(6)]
+        a = mesh_engine.submit_many([dict(r) for r in pre])
+        mesh_engine.flush()
+        b = ref.submit_many([dict(r) for r in pre])
+        ref.flush()
+        assert sum(o.verdict.admitted for o in a) == 6
+        assert sum(o.verdict.admitted for o in b) == 6
+        manual_clock.set_ms(1100)
+        reqs = [
+            {"resource": "os", "origin": "o1" if i % 2 == 0 else "o2", "ts": 1100}
+            for i in range(16)
+        ]
+        gm = mesh_engine.submit_many([dict(r) for r in reqs])
+        mesh_engine.flush()
+        gr = ref.submit_many([dict(r) for r in reqs])
+        ref.flush()
+        adm_m = sum(o.verdict.admitted for o in gm)
+        adm_r = sum(o.verdict.admitted for o in gr)
+        # Single-chip (row-exact): o1 admits its remaining 4, o2 all 8.
+        assert adm_r == 12
+        # Mesh: per-rule min across rows = 10-6 = 4 — conservative.
+        assert adm_m == 4
+        assert adm_m <= adm_r
+        # Never over any single row's cap.
+        for origin in ("o1", "o2"):
+            adm_o = sum(
+                o.verdict.admitted for o, r in zip(gm, reqs) if r["origin"] == origin
+            )
+            assert adm_o <= 10
+
     def test_non_pow2_mesh_rejected(self, manual_clock, engine):
         with pytest.raises(ValueError, match="power of two"):
             engine.enable_mesh(3)
