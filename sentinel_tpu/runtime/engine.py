@@ -1342,19 +1342,26 @@ class Engine:
         e_auth = np.ones(n, dtype=bool)
         e_cluster = np.ones(n, dtype=bool)
         e_dgid = np.full((n, kd), -1, dtype=np.int32)
-        for i, op in enumerate(entries):
-            e_valid[i] = True
-            e_ts[i] = op.ts
-            e_acquire[i] = op.acquire
-            e_rows[i] = op.rows
-            for j, (gid, crow) in enumerate(op.slots[:k]):
-                e_gid[i, j] = gid
-                e_crow[i, j] = crow
-            for j, dg in enumerate(op.d_gids[:kd]):
-                e_dgid[i, j] = dg
-            e_prio[i] = op.prio
-            e_auth[i] = op.auth_ok and op.custom_veto is None
-            e_cluster[i] = op.cluster_blocked_rule is None
+        ne = len(entries)
+        if ne:
+            # Flat fields fill via one C-level assignment per column
+            # (a per-op per-field Python loop costs ~3× more); only the
+            # ragged slot/dgid columns keep the nested loop.
+            e_valid[:ne] = True
+            e_ts[:ne] = [op.ts for op in entries]
+            e_acquire[:ne] = [op.acquire for op in entries]
+            e_rows[:ne] = [op.rows for op in entries]
+            e_prio[:ne] = [op.prio for op in entries]
+            e_auth[:ne] = [
+                op.auth_ok and op.custom_veto is None for op in entries
+            ]
+            e_cluster[:ne] = [op.cluster_blocked_rule is None for op in entries]
+            for i, op in enumerate(entries):
+                for j, (gid, crow) in enumerate(op.slots[:k]):
+                    e_gid[i, j] = gid
+                    e_crow[i, j] = crow
+                for j, dg in enumerate(op.d_gids[:kd]):
+                    e_dgid[i, j] = dg
         off_b = len(entries)
         for g in bulk:
             sl = slice(off_b, off_b + g.n)
@@ -1381,16 +1388,18 @@ class Engine:
         x_err = np.zeros(m, dtype=np.int32)
         x_thr = np.zeros(m, dtype=np.int32)
         x_dgid = np.full((m, kd), -1, dtype=np.int32)
-        for i, op in enumerate(exits):
-            x_valid[i] = True
-            x_ts[i] = op.ts
-            x_count[i] = op.count
-            x_rows[i] = op.rows
-            x_rt[i] = op.rt
-            x_err[i] = op.err
-            x_thr[i] = op.thr
-            for j, dg in enumerate(op.d_gids[:kd]):
-                x_dgid[i, j] = dg
+        nx = len(exits)
+        if nx:
+            x_valid[:nx] = True
+            x_ts[:nx] = [op.ts for op in exits]
+            x_count[:nx] = [op.count for op in exits]
+            x_rows[:nx] = [op.rows for op in exits]
+            x_rt[:nx] = [op.rt for op in exits]
+            x_err[:nx] = [op.err for op in exits]
+            x_thr[:nx] = [op.thr for op in exits]
+            for i, op in enumerate(exits):
+                for j, dg in enumerate(op.d_gids[:kd]):
+                    x_dgid[i, j] = dg
         off_x = len(exits)
         for g in bulk_exits:
             sl = slice(off_x, off_x + g.n)
