@@ -287,11 +287,36 @@ class OracleDefaultController:
         return False, 0, False
 
 
+def _leaky_bucket_check(pacer, t: int, acquire: int, rate: float):
+    """The shared pacer body (RateLimiterController.java:46-90,
+    single-threaded — the CAS race branches collapse). ``pacer`` holds
+    mutable ``latest`` and ``maxq``; ``rate`` is the admitted QPS the
+    cost derives from (the stable count, or the warm-up warning QPS).
+    Returns (ok, wait_ms)."""
+    if acquire <= 0:
+        return True, 0
+    if rate <= 0:
+        return False, 0
+    cost = int(1.0 * acquire / rate * 1000 + 0.5)  # Math.round
+    expected = cost + pacer.latest
+    if expected <= t:
+        pacer.latest = t
+        return True, 0
+    wait = cost + pacer.latest - t
+    if wait > pacer.maxq:
+        return False, 0
+    pacer.latest += cost
+    wait = pacer.latest - t
+    if wait > pacer.maxq:  # single-threaded: cannot trigger, kept for shape
+        pacer.latest -= cost
+        return False, 0
+    return True, max(wait, 0)
+
+
 class OracleRateLimiter:
-    """RateLimiterController.canPass (RateLimiterController.java:46-90),
-    single-threaded (the CAS race branches collapse). ``latest`` starts
-    effectively at -infinity to match wall-clock Java behavior under the
-    engine's relative clock."""
+    """RateLimiterController — the shared pacer at the stable rate.
+    ``latest`` starts effectively at -infinity to match wall-clock Java
+    behavior under the engine's relative clock."""
 
     def __init__(self, count: float, max_queueing_time_ms: int) -> None:
         self.count = count
@@ -300,24 +325,7 @@ class OracleRateLimiter:
 
     def can_pass(self, t: int, acquire: int = 1):
         """Returns (ok, wait_ms)."""
-        if acquire <= 0:
-            return True, 0
-        if self.count <= 0:
-            return False, 0
-        cost = int(1.0 * acquire / self.count * 1000 + 0.5)  # Math.round
-        expected = cost + self.latest
-        if expected <= t:
-            self.latest = t
-            return True, 0
-        wait = cost + self.latest - t
-        if wait > self.maxq:
-            return False, 0
-        self.latest += cost
-        wait = self.latest - t
-        if wait > self.maxq:  # single-threaded: cannot trigger, kept for shape
-            self.latest -= cost
-            return False, 0
-        return True, max(wait, 0)
+        return _leaky_bucket_check(self, t, acquire, self.count)
 
 
 class OracleWarmUp:
@@ -374,6 +382,34 @@ class OracleWarmUp:
         if b is None or b.window_start != ws:
             return 0
         return b.counts[MetricEvent.PASS]
+
+
+class OracleWarmUpRateLimiter(OracleWarmUp):
+    """WarmUpRateLimiterController (WarmUpRateLimiterController.java:
+    25-90): the leaky-bucket pacer whose cost per request uses the
+    warm-up warning QPS while the system is cold (storedTokens at or
+    above the warning line), the stable rate otherwise."""
+
+    def __init__(
+        self, count: float, warmup_sec: int, max_queueing_time_ms: int,
+        cold_factor: int = 3,
+    ) -> None:
+        super().__init__(count, warmup_sec, cold_factor)
+        self.maxq = max_queueing_time_ms
+        self.latest = -(10**9)
+
+    def can_pass_pacer(self, node: "OracleNode", t: int, acquire: int = 1):
+        """Returns (ok, wait_ms); syncs tokens first, like the kernel
+        scan step (rules/shaping.py::_transition), then runs the shared
+        pacer at the cold-adjusted rate."""
+        prev_qps = self._previous_pass(node, t)
+        self.sync_token(t, prev_qps)
+        if self.count <= 0:
+            return False, 0
+        rate = (
+            self.warning_qps() if self.stored >= self.warning_token else self.count
+        )
+        return _leaky_bucket_check(self, t, acquire, rate)
 
 
 class OracleCircuitBreaker:

@@ -21,6 +21,7 @@ from sentinel_tpu.testing.oracle import (
     OracleNode,
     OracleRateLimiter,
     OracleWarmUp,
+    OracleWarmUpRateLimiter,
 )
 
 
@@ -47,7 +48,7 @@ class _Model:
                 max_queueing_time_ms=maxq,
             )
             self.ctrl = OracleRateLimiter(self.count, maxq)
-        else:  # warmup
+        elif kind == "warmup":
             self.count = int(rng.integers(10, 60))
             warmup = int(rng.integers(2, 8))
             self.rule = st.FlowRule(
@@ -56,11 +57,24 @@ class _Model:
                 warm_up_period_sec=warmup,
             )
             self.ctrl = OracleWarmUp(self.count, warmup)
+        else:  # wurl
+            self.count = int(rng.integers(10, 60))
+            warmup = int(rng.integers(2, 8))
+            maxq = int(rng.integers(0, 800))
+            self.rule = st.FlowRule(
+                resource="", count=self.count,
+                control_behavior=C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER,
+                warm_up_period_sec=warmup,
+                max_queueing_time_ms=maxq,
+            )
+            self.ctrl = OracleWarmUpRateLimiter(self.count, warmup, maxq)
 
     def decide(self, t: int, prio: bool) -> tuple:
         """Returns (admitted, wait_ms)."""
         if self.kind == "rl":
             return self.ctrl.can_pass(t)
+        if self.kind == "wurl":
+            return self.ctrl.can_pass_pacer(self.node, t)
         if self.kind == "warmup":
             return self.ctrl.can_pass(self.node, t), 0
         if prio and self.kind == "qps":
@@ -92,7 +106,7 @@ class _Model:
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
     rng = np.random.default_rng(seed)
-    kinds = ["qps", "thread", "rl", "warmup"]
+    kinds = ["qps", "thread", "rl", "warmup", "wurl"]
     rng.shuffle(kinds)
     models = {}
     rules = []
