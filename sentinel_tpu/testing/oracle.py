@@ -287,17 +287,19 @@ class OracleDefaultController:
         return False, 0, False
 
 
-def _leaky_bucket_check(pacer, t: int, acquire: int, rate: float):
+def _leaky_bucket_check(pacer, t: int, acquire: int, rate: float, cost=None):
     """The shared pacer body (RateLimiterController.java:46-90,
     single-threaded — the CAS race branches collapse). ``pacer`` holds
     mutable ``latest`` and ``maxq``; ``rate`` is the admitted QPS the
-    cost derives from (the stable count, or the warm-up warning QPS).
-    Returns (ok, wait_ms)."""
+    cost derives from (the stable count, or the warm-up warning QPS);
+    a caller that must mirror the kernel's float32 cost math passes
+    ``cost`` precomputed. Returns (ok, wait_ms)."""
     if acquire <= 0:
         return True, 0
     if rate <= 0:
         return False, 0
-    cost = int(1.0 * acquire / rate * 1000 + 0.5)  # Math.round
+    if cost is None:
+        cost = int(1.0 * acquire / rate * 1000 + 0.5)  # Math.round
     expected = cost + pacer.latest
     if expected <= t:
         pacer.latest = t
@@ -401,15 +403,32 @@ class OracleWarmUpRateLimiter(OracleWarmUp):
     def can_pass_pacer(self, node: "OracleNode", t: int, acquire: int = 1):
         """Returns (ok, wait_ms); syncs tokens first, like the kernel
         scan step (rules/shaping.py::_transition), then runs the shared
-        pacer at the cold-adjusted rate."""
+        pacer at the cold-adjusted rate.
+
+        The COLD cost mirrors the kernel's float32 arithmetic digit for
+        digit (f32 nextafter + f32 divide + floor(x + 0.5)): a float64
+        re-derivation can round the cost 1 ms differently when
+        acq/warningQps·1000 lands near a half-integer, which exact
+        differential wait assertions would flag as a fake bug. The warm
+        cost stays float64 — it matches the host-precomputed exact
+        ``cost1_ms`` path the kernel uses for acquire==1."""
+        import numpy as _np
+
         prev_qps = self._previous_pass(node, t)
         self.sync_token(t, prev_qps)
         if self.count <= 0:
             return False, 0
-        rate = (
-            self.warning_qps() if self.stored >= self.warning_token else self.count
-        )
-        return _leaky_bucket_check(self, t, acquire, rate)
+        if self.stored >= self.warning_token:
+            above = _np.float32(max(self.stored - self.warning_token, 0))
+            inv = above * _np.float32(self.slope) + _np.float32(1.0) / _np.float32(
+                max(self.count, 1e-9)
+            )
+            wq = _np.nextafter(_np.float32(1.0) / inv, _np.float32(_np.inf))
+            cost = int(
+                _np.floor(_np.float32(acquire) / wq * _np.float32(1000.0) + _np.float32(0.5))
+            )
+            return _leaky_bucket_check(self, t, acquire, float(wq), cost=cost)
+        return _leaky_bucket_check(self, t, acquire, self.count)
 
 
 class OracleCircuitBreaker:

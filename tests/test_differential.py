@@ -17,6 +17,7 @@ import pytest
 import sentinel_tpu as st
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.testing.oracle import (
+    OracleCircuitBreaker,
     OracleDefaultController,
     OracleNode,
     OracleRateLimiter,
@@ -31,10 +32,25 @@ class _Model:
     def __init__(self, kind: str, rng) -> None:
         self.kind = kind
         self.node = OracleNode()
+        self.breaker = None
+        self.drule = None
         if kind == "qps":
             self.count = int(rng.integers(1, 8))
             self.rule = st.FlowRule(resource="", count=self.count)
             self.ctrl = OracleDefaultController(self.count, grade=1)
+            # The QPS resource also carries an exception-ratio breaker:
+            # random erroring exits trip it mid-stream. The oracle is
+            # built FROM the rule bean so the two cannot skew.
+            self.drule = st.DegradeRule(
+                resource="", grade=1, count=0.4, time_window=2,
+                min_request_amount=4,
+            )
+            self.breaker = OracleCircuitBreaker(
+                grade=self.drule.grade,
+                count=self.drule.count,
+                time_window_sec=self.drule.time_window,
+                min_request=self.drule.min_request_amount,
+            )
         elif kind == "thread":
             self.count = int(rng.integers(1, 5))
             self.rule = st.FlowRule(resource="", grade=0, count=self.count)
@@ -117,6 +133,13 @@ def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
         models[res] = m
         rules.append(m.rule)
     st.flow_rule_manager.load_rules(rules)
+    st.degrade_rule_manager.load_rules(
+        [
+            dataclasses.replace(m.drule, resource=res)
+            for res, m in models.items()
+            if m.drule is not None
+        ]
+    )
     resources = list(models)
 
     t = 1000
@@ -135,6 +158,12 @@ def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
             m = models[res]
             prio = m.kind == "qps" and rng.random() < 0.3
             want, want_wait = m.decide(t, prio)
+            occupied = prio and want and want_wait > 0
+            if want and m.breaker is not None and not occupied:
+                # DegradeSlot runs last; occupied entries bypass it
+                # (PriorityWaitException aborts the chain first).
+                if not m.breaker.try_pass(t):
+                    want, want_wait = False, 0
             op = engine.submit_entry(res, ts=t, prio=prio)
             engine.flush()
             got = op.verdict.admitted
@@ -153,10 +182,14 @@ def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
         else:
             idx = int(rng.integers(0, len(open_entries)))
             res, op = open_entries.pop(idx)
+            m = models[res]
             rt = int(rng.integers(1, 60))
-            engine.submit_exit(op.rows, rt=rt, ts=t, resource=res)
+            err = int(rng.random() < 0.35)
+            engine.submit_exit(op.rows, rt=rt, ts=t, err=err, resource=res)
             engine.flush()
-            models[res].account_exit(t, rt)
+            if m.breaker is not None:
+                m.breaker.on_complete(t, rt, error=bool(err))
+            m.account_exit(t, rt)
     assert checked > 100
 
     # Final gauge + block-window stats agree too (pass windows involve
