@@ -431,6 +431,79 @@ class OracleWarmUpRateLimiter(OracleWarmUp):
         return _leaky_bucket_check(self, t, acquire, self.count)
 
 
+class OracleParamBucket:
+    """passDefaultLocalCheck for ONE parameter value (reference:
+    sentinel-parameter-flow-control/.../ParamFlowChecker.java:46-137):
+    first-seen fills the bucket minus the acquire; within a window the
+    balance decrements-if-enough; past the window the refill is
+    ``passTime*tokenCount/durationMs`` integer division clamped at
+    maxCount, and a rejection never touches state (the CAS-failure
+    return path)."""
+
+    def __init__(self, count: int, burst: int, duration_ms: int) -> None:
+        self.tc = count
+        self.burst = burst
+        self.dur = max(duration_ms, 1)
+        self.tokens = 0
+        self.last = None  # None = value never seen
+
+    def check(self, t: int, acquire: int = 1) -> bool:
+        max_count = self.tc + self.burst
+        if self.tc <= 0 or acquire > max_count:
+            return False
+        if self.last is None:
+            self.tokens = max_count - acquire
+            self.last = t
+            return True
+        pass_time = t - self.last
+        if pass_time > self.dur:
+            to_add = pass_time * self.tc // self.dur
+            if to_add + self.tokens > max_count:
+                new_qps = max_count - acquire
+            else:
+                new_qps = self.tokens + to_add - acquire
+            if new_qps < 0:
+                return False
+            self.tokens = new_qps
+            self.last = t
+            return True
+        if self.tokens - acquire >= 0:
+            self.tokens -= acquire
+            return True
+        return False
+
+
+class OracleParamThrottle:
+    """passThrottleLocalCheck for ONE parameter value (reference:
+    ParamFlowChecker.java:234-262): first-seen passes free; queueing
+    accepts waits STRICTLY below maxQueueingTimeMs and records
+    ``latest = expected``."""
+
+    def __init__(self, count: int, duration_sec: int, maxq: int) -> None:
+        self.tc = count
+        self.maxq = maxq
+        # Host-side f64 cost, like ParamIndex.slots_for.
+        self.cost = int(1000.0 * duration_sec / count + 0.5) if count > 0 else 0
+        self.latest = None  # None = value never seen
+
+    def check(self, t: int, acquire: int = 1):
+        """Returns (ok, wait_ms)."""
+        if self.tc <= 0:
+            return False, 0
+        if self.latest is None:
+            self.latest = t
+            return True, 0
+        expected = self.latest + self.cost
+        if expected <= t:
+            self.latest = t
+            return True, 0
+        wait = expected - t
+        if wait < self.maxq:  # STRICT <
+            self.latest = expected
+            return True, max(wait, 0)
+        return False, 0
+
+
 class OracleCircuitBreaker:
     """Sequential breaker semantics (AbstractCircuitBreaker.java:40-150 +
     ExceptionCircuitBreaker.java / ResponseTimeCircuitBreaker.java):

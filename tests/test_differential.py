@@ -2,10 +2,12 @@
 
 Sequential streams (one flush per op) must match the reference-model
 oracle verdict-for-verdict — across random rule kinds (QPS / THREAD /
-rate-limiter / warm-up), random clock advances spanning window rolls,
-exits releasing threads, and prioritized (occupy) entries. Sequential
-submission removes intra-batch ordering from the picture, so any
-divergence is a real semantic bug, not a documented batching
+rate-limiter / warm-up / warm-up-rate-limiter / hot-param token bucket
+/ hot-param throttle, plus an exception-ratio circuit breaker tripped
+by random erroring exits), random clock advances spanning window
+rolls, exits releasing threads, and prioritized (occupy) entries.
+Sequential submission removes intra-batch ordering from the picture,
+so any divergence is a real semantic bug, not a documented batching
 conservatism.
 """
 
@@ -20,6 +22,8 @@ from sentinel_tpu.testing.oracle import (
     OracleCircuitBreaker,
     OracleDefaultController,
     OracleNode,
+    OracleParamBucket,
+    OracleParamThrottle,
     OracleRateLimiter,
     OracleWarmUp,
     OracleWarmUpRateLimiter,
@@ -34,6 +38,7 @@ class _Model:
         self.node = OracleNode()
         self.breaker = None
         self.drule = None
+        self.prule = None
         if kind == "qps":
             self.count = int(rng.integers(1, 8))
             self.rule = st.FlowRule(resource="", count=self.count)
@@ -73,7 +78,7 @@ class _Model:
                 warm_up_period_sec=warmup,
             )
             self.ctrl = OracleWarmUp(self.count, warmup)
-        else:  # wurl
+        elif kind == "wurl":
             self.count = int(rng.integers(10, 60))
             warmup = int(rng.integers(2, 8))
             maxq = int(rng.integers(0, 800))
@@ -84,8 +89,44 @@ class _Model:
                 max_queueing_time_ms=maxq,
             )
             self.ctrl = OracleWarmUpRateLimiter(self.count, warmup, maxq)
+        elif kind == "pbucket":
+            self.count = int(rng.integers(1, 6))
+            self.rule = None
+            self.prule = st.ParamFlowRule(
+                resource="", param_idx=0, count=self.count,
+                burst_count=int(rng.integers(0, 4)),
+                duration_in_sec=int(rng.integers(1, 4)),
+            )
+            self._values = {}
+        else:  # pthrottle
+            self.count = int(rng.integers(2, 12))
+            self.rule = None
+            self.prule = st.ParamFlowRule(
+                resource="", param_idx=0, count=self.count,
+                control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                max_queueing_time_ms=int(rng.integers(0, 600)),
+                duration_in_sec=int(rng.integers(1, 3)),
+            )
+            self._values = {}
 
-    def decide(self, t: int, prio: bool) -> tuple:
+    def param_model(self, value: str):
+        """Per-value oracle, built FROM the rule bean so the two cannot
+        skew (like the breaker)."""
+        m = self._values.get(value)
+        if m is None:
+            r = self.prule
+            if self.kind == "pbucket":
+                m = OracleParamBucket(
+                    int(r.count), int(r.burst_count), int(r.duration_in_sec) * 1000
+                )
+            else:
+                m = OracleParamThrottle(
+                    int(r.count), int(r.duration_in_sec), int(r.max_queueing_time_ms)
+                )
+            self._values[value] = m
+        return m
+
+    def decide(self, t: int, prio: bool, value: str = "") -> tuple:
         """Returns (admitted, wait_ms)."""
         if self.kind == "rl":
             return self.ctrl.can_pass(t)
@@ -93,6 +134,10 @@ class _Model:
             return self.ctrl.can_pass_pacer(self.node, t)
         if self.kind == "warmup":
             return self.ctrl.can_pass(self.node, t), 0
+        if self.kind == "pbucket":
+            return self.param_model(value).check(t), 0
+        if self.kind == "pthrottle":
+            return self.param_model(value).check(t)
         if prio and self.kind == "qps":
             ok, wait, occupied = self.ctrl.can_pass_prio(self.node, t)
             return (ok, wait) if occupied else (ok, 0)
@@ -122,16 +167,19 @@ class _Model:
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
     rng = np.random.default_rng(seed)
-    kinds = ["qps", "thread", "rl", "warmup", "wurl"]
+    kinds = ["qps", "thread", "rl", "warmup", "wurl", "pbucket", "pthrottle"]
     rng.shuffle(kinds)
     models = {}
     rules = []
     for i, kind in enumerate(kinds):
         m = _Model(kind, rng)
         res = f"res-{kind}"
-        m.rule = dataclasses.replace(m.rule, resource=res)
+        if m.rule is not None:
+            m.rule = dataclasses.replace(m.rule, resource=res)
+            rules.append(m.rule)
+        if m.prule is not None:
+            m.prule = dataclasses.replace(m.prule, resource=res)
         models[res] = m
-        rules.append(m.rule)
     st.flow_rule_manager.load_rules(rules)
     st.degrade_rule_manager.load_rules(
         [
@@ -139,6 +187,9 @@ def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
             for res, m in models.items()
             if m.drule is not None
         ]
+    )
+    st.param_flow_rule_manager.load_rules(
+        [m.prule for m in models.values() if m.prule is not None]
     )
     resources = list(models)
 
@@ -157,14 +208,16 @@ def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
             res = resources[int(rng.integers(0, len(resources)))]
             m = models[res]
             prio = m.kind == "qps" and rng.random() < 0.3
-            want, want_wait = m.decide(t, prio)
+            value = f"v{int(rng.integers(0, 2))}"
+            args = (value,) if m.prule is not None else ()
+            want, want_wait = m.decide(t, prio, value)
             occupied = prio and want and want_wait > 0
             if want and m.breaker is not None and not occupied:
                 # DegradeSlot runs last; occupied entries bypass it
                 # (PriorityWaitException aborts the chain first).
                 if not m.breaker.try_pass(t):
                     want, want_wait = False, 0
-            op = engine.submit_entry(res, ts=t, prio=prio)
+            op = engine.submit_entry(res, ts=t, prio=prio, args=args)
             engine.flush()
             got = op.verdict.admitted
             assert got == want, (
