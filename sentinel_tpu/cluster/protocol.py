@@ -75,9 +75,37 @@ def pack_response(
     return _LEN.pack(len(payload)) + payload
 
 
+class UnknownMsgType(ValueError):
+    """Unknown message type in a well-framed request — carries the xid
+    so the server can answer BAD_REQUEST instead of dropping the
+    connection (the reference responds through the same channel,
+    TokenServerHandler.java:39-75)."""
+
+    def __init__(self, xid: int, msg_type: int) -> None:
+        super().__init__(f"unknown msg type {msg_type}")
+        self.xid = xid
+        self.msg_type = msg_type
+
+
+_KNOWN_MSG_TYPES = frozenset(
+    (
+        C.MSG_TYPE_PING,
+        C.MSG_TYPE_FLOW,
+        C.MSG_TYPE_PARAM_FLOW,
+        C.MSG_TYPE_CONCURRENT_FLOW_ACQUIRE,
+        C.MSG_TYPE_CONCURRENT_FLOW_RELEASE,
+    )
+)
+
+
 def unpack_request(payload: bytes) -> Tuple[int, int, tuple]:
-    """-> (xid, msg_type, body_tuple)."""
+    """-> (xid, msg_type, body_tuple). Raises :class:`UnknownMsgType`
+    for an unrecognized type (checked BEFORE the body parse — a short
+    body must not mask the type error as struct garbage), plain
+    ValueError / struct.error for malformed bodies."""
     xid, msg_type = _REQ_HDR.unpack_from(payload, 0)
+    if msg_type not in _KNOWN_MSG_TYPES:
+        raise UnknownMsgType(xid, msg_type)
     off = _REQ_HDR.size
     if msg_type == C.MSG_TYPE_PING:
         return xid, msg_type, ()
@@ -97,10 +125,14 @@ def unpack_request(payload: bytes) -> Tuple[int, int, tuple]:
         for _ in range(n):
             (ln,) = struct.unpack_from("<H", payload, off)
             off += 2
+            if off + ln > len(payload):
+                raise ValueError("truncated param value")
             params.append(payload[off : off + ln].decode("utf-8"))
             off += ln
+        if off != len(payload):
+            raise ValueError("trailing bytes after params")
         return xid, msg_type, (flow_id, acquire, params)
-    raise ValueError(f"unknown msg type {msg_type}")
+    raise AssertionError("unreachable: type checked against _KNOWN_MSG_TYPES")
 
 
 def unpack_response(payload: bytes) -> Tuple[int, int, int, int, int, int]:

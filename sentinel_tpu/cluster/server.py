@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import socket
 import socketserver
+import struct
 import threading
 from typing import Optional
 
@@ -29,12 +30,29 @@ class _Handler(socketserver.BaseRequestHandler):
         client_addr = "%s:%d" % self.client_address[:2]
         try:
             while True:
-                payload = protocol.read_frame(self.request)
+                try:
+                    payload = protocol.read_frame(self.request)
+                except ValueError:
+                    # Oversized length prefix: like the reference's
+                    # LengthFieldBasedFrameDecoder rejecting the frame,
+                    # drop the connection without a handler crash.
+                    record_log.warn("[TokenServer] oversized frame, closing")
+                    return
                 if payload is None:
                     return
                 try:
                     xid, msg_type, body = protocol.unpack_request(payload)
-                except ValueError:
+                except protocol.UnknownMsgType as e:
+                    # Well-framed but unknown type: answer BAD_REQUEST
+                    # through the channel, keep the connection.
+                    self.request.sendall(
+                        protocol.pack_response(
+                            e.xid, e.msg_type, int(C.TokenResultStatus.BAD_REQUEST)
+                        )
+                    )
+                    continue
+                except (ValueError, struct.error):
+                    # Truncated/garbage body: not recoverable mid-stream.
                     record_log.warn("[TokenServer] bad frame dropped")
                     return
                 if msg_type == C.MSG_TYPE_PING:
@@ -65,6 +83,10 @@ class _Handler(socketserver.BaseRequestHandler):
                     r = server.service.release_concurrent_token(token_id)
                     resp = protocol.pack_response(xid, msg_type, int(r.status))
                 else:
+                    # Defensive: unpack raises UnknownMsgType before
+                    # dispatch, but a type added to _KNOWN_MSG_TYPES
+                    # without a branch here must answer BAD_REQUEST,
+                    # not kill the handler thread.
                     resp = protocol.pack_response(
                         xid, msg_type, int(C.TokenResultStatus.BAD_REQUEST)
                     )

@@ -481,10 +481,15 @@ class OracleParamThrottle:
 
     def __init__(self, count: int, duration_sec: int, maxq: int) -> None:
         self.tc = count
+        self.dur_sec = duration_sec
         self.maxq = maxq
-        # Host-side f64 cost, like ParamIndex.slots_for.
-        self.cost = int(1000.0 * duration_sec / count + 0.5) if count > 0 else 0
         self.latest = None  # None = value never seen
+
+    def _cost(self, acquire: int) -> int:
+        # Math.round(1.0*1000*acquireCount*durationSec/count) —
+        # reference ParamFlowChecker.java:244; host f64 like
+        # ParamIndex.slots_for (which precomputes the acquire==1 case).
+        return int(1000.0 * acquire * self.dur_sec / self.tc + 0.5)
 
     def check(self, t: int, acquire: int = 1):
         """Returns (ok, wait_ms)."""
@@ -493,7 +498,7 @@ class OracleParamThrottle:
         if self.latest is None:
             self.latest = t
             return True, 0
-        expected = self.latest + self.cost
+        expected = self.latest + self._cost(acquire)
         if expected <= t:
             self.latest = t
             return True, 0

@@ -1,0 +1,128 @@
+"""Wire-protocol robustness: the cluster token server must survive
+malformed, truncated, oversized, and random frames — the reference's
+Netty pipeline drops bad frames at the LengthFieldBasedFrameDecoder and
+keeps serving (NettyTransportServer.java:78-93); ours must not crash,
+leak the connection gauge, or stop answering well-formed requests.
+"""
+
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.cluster import protocol
+from sentinel_tpu.cluster.flow_rules import cluster_flow_rule_manager
+from sentinel_tpu.cluster.server import SentinelTokenServer
+from sentinel_tpu.cluster.token_service import (
+    DefaultTokenService,
+    cluster_server_config_manager,
+)
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.utils.clock import ManualClock
+
+
+@pytest.fixture()
+def server():
+    cluster_flow_rule_manager.clear()
+    cluster_server_config_manager.load_global_flow_config(
+        exceed_count=1.0, max_allowed_qps=30000.0
+    )
+    srv = SentinelTokenServer(port=0, service=DefaultTokenService(clock=ManualClock(0)))
+    srv.start()
+    yield srv
+    srv.stop()
+    cluster_flow_rule_manager.clear()
+
+
+def _frame(payload: bytes) -> bytes:
+    return struct.pack("<I", len(payload)) + payload
+
+
+def _send_raw(port: int, data: bytes) -> None:
+    with socket.create_connection(("127.0.0.1", port), timeout=2) as s:
+        s.sendall(data)
+        s.settimeout(0.5)
+        try:
+            while s.recv(4096):
+                pass
+        except (socket.timeout, ConnectionError):
+            pass
+
+
+def _ping_ok(port: int) -> bool:
+    with socket.create_connection(("127.0.0.1", port), timeout=2) as s:
+        s.sendall(protocol.pack_ping(1))
+        payload = protocol.read_frame(s)
+        if payload is None:
+            return False
+        xid, _, status, _, _, _ = protocol.unpack_response(payload)
+        return xid == 1 and status == int(C.TokenResultStatus.OK)
+
+
+class TestProtocolFuzz:
+    def test_server_survives_garbage(self, server, capfd):
+        """Every malformed shape is dropped GRACEFULLY: the server keeps
+        answering and no handler thread dies with a traceback (a
+        swallowed per-connection crash would keep serving too, but
+        that's not the graceful-drop contract)."""
+        rng = np.random.default_rng(0)
+        port = server.port
+        blobs = [
+            b"",  # connect + close
+            b"\x00",  # truncated length prefix
+            struct.pack("<I", 2**30),  # oversized frame length
+            struct.pack("<I", 100),  # length promising bytes that never come
+            bytes(rng.integers(0, 256, 64, dtype=np.uint8)),
+            bytes(rng.integers(0, 256, 4096, dtype=np.uint8)),
+            _frame(b""),  # empty payload
+            _frame(b"\x01"),  # payload shorter than any header
+            _frame(bytes(rng.integers(0, 256, 32, dtype=np.uint8))),
+            # Well-framed PARAM_FLOW whose param length field promises
+            # 100 bytes but only 3 follow — must be dropped as a bad
+            # frame, not rate-limit the truncated value.
+            _frame(
+                struct.pack("<IB", 5, C.MSG_TYPE_PARAM_FLOW)
+                + struct.pack("<qiB", 1, 1, 0)
+                + struct.pack("<H", 1)
+                + struct.pack("<H", 100)
+                + b"abc"
+            ),
+        ]
+        for blob in blobs:
+            _send_raw(port, blob)
+            assert _ping_ok(port), f"server stopped answering after {blob[:16]!r}"
+        err = capfd.readouterr().err
+        assert "Traceback" not in err, err
+
+    def test_unknown_message_type(self, server, capfd):
+        """A well-framed request of an unknown type gets BAD_REQUEST
+        through the channel and the connection stays usable — like the
+        reference answering through TokenServerHandler rather than
+        killing the socket."""
+        port = server.port
+        with socket.create_connection(("127.0.0.1", port), timeout=2) as s:
+            s.sendall(_frame(struct.pack("<IB", 7, 99)))
+            s.settimeout(2.0)
+            payload = protocol.read_frame(s)
+            assert payload is not None
+            xid, _, status, _, _, _ = protocol.unpack_response(payload)
+            assert xid == 7
+            assert status == int(C.TokenResultStatus.BAD_REQUEST)
+            # Same connection still serves well-formed requests.
+            s.sendall(protocol.pack_ping(8))
+            payload = protocol.read_frame(s)
+            assert payload is not None and protocol.unpack_response(payload)[0] == 8
+        err = capfd.readouterr().err
+        assert "Traceback" not in err, err
+
+    def test_connection_gauge_not_leaked(self, server):
+        port = server.port
+        before = server._conn_count
+        for _ in range(5):
+            _send_raw(port, struct.pack("<I", 2**30))
+        deadline = time.monotonic() + 3
+        while server._conn_count > before and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server._conn_count == before
