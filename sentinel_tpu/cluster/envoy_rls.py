@@ -62,6 +62,10 @@ def _varint(n: int) -> bytes:
 def _read_varint(buf: bytes, off: int) -> Tuple[int, int]:
     result = shift = 0
     while True:
+        if off >= len(buf):
+            raise ValueError("truncated varint")
+        if shift > 63:
+            raise ValueError("varint too long")
         b = buf[off]
         off += 1
         result |= (b & 0x7F) << shift
@@ -72,7 +76,9 @@ def _read_varint(buf: bytes, off: int) -> Tuple[int, int]:
 
 def _fields(buf: bytes):
     """Yield (field_number, wire_type, value) over a message payload;
-    value is bytes for length-delimited fields, int for varints."""
+    value is bytes for length-delimited fields, int for varints.
+    Truncated payloads raise ValueError — a silent short slice would
+    parse a garbled message as a different valid one."""
     off = 0
     while off < len(buf):
         tag, off = _read_varint(buf, off)
@@ -81,12 +87,18 @@ def _fields(buf: bytes):
             val, off = _read_varint(buf, off)
         elif wire == 2:  # length-delimited
             ln, off = _read_varint(buf, off)
+            if off + ln > len(buf):
+                raise ValueError("truncated length-delimited field")
             val = buf[off : off + ln]
             off += ln
         elif wire == 5:  # fixed32 (skip)
+            if off + 4 > len(buf):
+                raise ValueError("truncated fixed32")
             val = buf[off : off + 4]
             off += 4
         elif wire == 1:  # fixed64 (skip)
+            if off + 8 > len(buf):
+                raise ValueError("truncated fixed64")
             val = buf[off : off + 8]
             off += 8
         else:
@@ -104,27 +116,42 @@ def _vi(fnum: int, value: int) -> bytes:
     return _varint(fnum << 3) + _varint(value)
 
 
+def _want_wire(fnum: int, wire: int, expected: int) -> None:
+    """A field number sent with the wrong wire type is a malformed
+    message, not a crash: consumers below index/decode by type, so an
+    unchecked mismatch would surface as AttributeError/TypeError and
+    bypass the ValueError-based bad-request handling."""
+    if wire != expected:
+        raise ValueError(f"field {fnum}: wire type {wire}, expected {expected}")
+
+
 def decode_rate_limit_request(raw: bytes) -> Tuple[str, List[List[Tuple[str, str]]], int]:
     """-> (domain, descriptors as [(key, value), ...] lists, hits_addend)."""
     domain = ""
     descriptors: List[List[Tuple[str, str]]] = []
     hits = 0
-    for fnum, _wire, val in _fields(raw):
+    for fnum, wire, val in _fields(raw):
         if fnum == 1:
+            _want_wire(fnum, wire, 2)
             domain = val.decode("utf-8")
         elif fnum == 2:
+            _want_wire(fnum, wire, 2)
             entries: List[Tuple[str, str]] = []
-            for efn, _w, ev in _fields(val):
+            for efn, ew, ev in _fields(val):
                 if efn == 1:
+                    _want_wire(efn, ew, 2)
                     key = value = ""
-                    for kfn, _kw, kv in _fields(ev):
+                    for kfn, kw, kv in _fields(ev):
                         if kfn == 1:
+                            _want_wire(kfn, kw, 2)
                             key = kv.decode("utf-8")
                         elif kfn == 2:
+                            _want_wire(kfn, kw, 2)
                             value = kv.decode("utf-8")
                     entries.append((key, value))
             descriptors.append(entries)
         elif fnum == 3:
+            _want_wire(fnum, wire, 0)
             hits = int(val)
     return domain, descriptors, hits
 
@@ -312,7 +339,19 @@ class EnvoyRlsService:
         return self.token_service
 
     def should_rate_limit(self, raw_request: bytes, context=None) -> bytes:
-        domain, descriptors, hits = decode_rate_limit_request(raw_request)
+        try:
+            domain, descriptors, hits = decode_rate_limit_request(raw_request)
+        except (ValueError, IndexError):
+            # Malformed protobuf: answer INVALID_ARGUMENT through gRPC
+            # (what a generated-stub deserializer failure would yield)
+            # instead of crashing the handler with a raw traceback.
+            if context is not None:
+                import grpc
+
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, "malformed RateLimitRequest"
+                )
+            raise ValueError("malformed RateLimitRequest")
         acquire = hits if hits > 0 else 1  # absent → 1
         blocked = False
         statuses: List[Tuple[int, Optional[int], int]] = []

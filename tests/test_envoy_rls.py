@@ -146,3 +146,48 @@ class TestShouldRateLimitGrpc:
                 assert overall == CODE_OVER_LIMIT
         finally:
             server.stop()
+
+
+class TestRlsMalformedRequests:
+    def test_decoder_rejects_truncation(self):
+        import pytest as _pytest
+
+        from sentinel_tpu.cluster.envoy_rls import decode_rate_limit_request
+
+        bad = [
+            b"\x80",  # truncated varint
+            b"\x80" * 12,  # over-long varint
+            b"\x0a\x64abc",  # length-delimited promising 100 bytes, 3 given
+            b"\x0d\x01",  # truncated fixed32
+            b"\x0b",  # unsupported wire type (3)
+            b"\x08\x01",  # field 1 (domain) sent as varint, not bytes
+            b"\x10\x01",  # field 2 (descriptor) sent as varint
+            b"\x1d1234",  # field 3 (hits) sent as fixed32
+        ]
+        for raw in bad:
+            with _pytest.raises(ValueError):
+                decode_rate_limit_request(raw)
+
+    def test_service_answers_invalid_argument_and_survives(self):
+        import grpc
+        import pytest as _pytest
+
+        from sentinel_tpu.cluster.envoy_rls import (
+            EnvoyRlsService,
+            decode_rate_limit_response,
+            encode_rate_limit_request,
+        )
+
+        svc = EnvoyRlsService()
+
+        class Ctx:
+            def abort(self, code, details):
+                assert code == grpc.StatusCode.INVALID_ARGUMENT
+                raise grpc.RpcError(details)
+
+        with _pytest.raises(grpc.RpcError):
+            svc.should_rate_limit(b"\x80\x80\x80", Ctx())
+        # A well-formed request still serves afterwards.
+        raw = encode_rate_limit_request("d", [[("k", "v")]], 1)
+        overall, statuses = decode_rate_limit_response(svc.should_rate_limit(raw))
+        assert overall in (1, 2) and len(statuses) == 1
