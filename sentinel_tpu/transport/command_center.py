@@ -103,8 +103,22 @@ class _HttpHandler(BaseHTTPRequestHandler):
         self._dispatch("")
 
     def do_POST(self) -> None:
-        length = int(self.headers.get("Content-Length") or 0)
-        body = self.rfile.read(length).decode("utf-8") if length else ""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = -1
+        if length < 0:
+            self._respond(400, "bad Content-Length")
+            return
+        if length > 10 * 1024 * 1024:
+            self._respond(413, "body too large")
+            return
+        raw = self.rfile.read(length) if length else b""
+        try:
+            body = raw.decode("utf-8")
+        except UnicodeDecodeError:
+            self._respond(400, "body is not valid UTF-8")
+            return
         self._dispatch(body)
 
 

@@ -201,3 +201,46 @@ class TestHeartbeat:
     def test_heartbeat_failure(self):
         hb = HeartbeatSender("127.0.0.1:1", command_port=1, app_name="x")
         assert hb.heartbeat_once() is False
+
+
+class TestCommandCenterRobustness:
+    def test_malformed_posts_and_garbage(self, manual_clock, engine):
+        """Garbage HTTP, bad Content-Length, non-UTF-8 bodies: the
+        command center answers 4xx (or drops the line) and keeps
+        serving."""
+        import http.client
+        import socket
+
+        from sentinel_tpu.transport.command_center import CommandCenter
+
+        cc = CommandCenter(port=0).start()
+        try:
+            port = cc.port
+
+            def api_ok() -> bool:
+                conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+                conn.request("GET", "/api")
+                ok = conn.getresponse().status == 200
+                conn.close()
+                return ok
+
+            assert api_ok()
+            # Raw garbage request line.
+            with socket.create_connection(("127.0.0.1", port), timeout=2) as s:
+                s.sendall(b"\xff\xfe NOT HTTP\r\n\r\n")
+            assert api_ok()
+            # Garbage Content-Length.
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+            conn.putrequest("POST", "/setRules")
+            conn.putheader("Content-Length", "abc")
+            conn.endheaders()
+            assert conn.getresponse().status == 400
+            conn.close()
+            # Non-UTF-8 body.
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+            conn.request("POST", "/setRules", body=b"\xff\xfe\xfd")
+            assert conn.getresponse().status == 400
+            conn.close()
+            assert api_ok()
+        finally:
+            cc.stop()
