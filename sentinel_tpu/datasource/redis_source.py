@@ -30,12 +30,29 @@ class RespError(Exception):
     pass
 
 
+# Reply-size sanity caps: a corrupted stream read as a length must not
+# allocate unbounded memory before failing. Redis itself allows bulk
+# strings up to proto-max-bulk-len (512 MB default) — raise
+# ``max_bulk_bytes`` for legitimately huge rule payloads.
+DEFAULT_MAX_BULK_BYTES = 64 * 1024 * 1024
+DEFAULT_MAX_ARRAY_ELEMS = 1 << 20
+
+
 class RespConnection:
     """One RESP connection: encode commands, decode replies."""
 
-    def __init__(self, host: str, port: int, timeout_sec: Optional[float] = 5.0) -> None:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout_sec: Optional[float] = 5.0,
+        max_bulk_bytes: int = DEFAULT_MAX_BULK_BYTES,
+        max_array_elems: int = DEFAULT_MAX_ARRAY_ELEMS,
+    ) -> None:
         self._sock = socket.create_connection((host, port), timeout=timeout_sec)
         self._buf = b""
+        self.max_bulk_bytes = max_bulk_bytes
+        self.max_array_elems = max_array_elems
 
     def settimeout(self, t: Optional[float]) -> None:
         self._sock.settimeout(t)
@@ -94,11 +111,15 @@ class RespConnection:
             n = int(rest)
             if n < 0:
                 return None
+            if n > self.max_bulk_bytes:
+                raise RespError(f"bulk string too large ({n} bytes)")
             return self._read_exact(n).decode("utf-8")
         if kind == b"*":
             n = int(rest)
             if n < 0:
                 return None
+            if n > self.max_array_elems:
+                raise RespError(f"array too large ({n} elements)")
             return [self.read_reply() for _ in range(n)]
         raise RespError(f"bad RESP type byte {kind!r}")
 

@@ -221,3 +221,43 @@ class TestRedisDataSource:
             assert src.get_property().value[0].count == 2  # unchanged
         finally:
             src.close()
+
+
+class TestRespRobustness:
+    def test_oversize_length_reconnects_and_recovers(self, fake_redis):
+        """A corrupted stream claiming an absurd bulk length must hit
+        the size cap (no unbounded allocation), drop the connection,
+        reconnect, and keep applying later publishes."""
+        fake_redis.data["k"] = _rules_json(5)
+        src = RedisDataSource(
+            json_converter(st.FlowRule), "127.0.0.1", fake_redis.port,
+            rule_key="k", channel="ch", reconnect_interval_sec=0.05,
+        ).start()
+        try:
+            assert _wait(lambda: fake_redis.subscribers.get("ch"))
+            assert _wait(
+                lambda: src.get_property().value
+                and src.get_property().value[0].count == 5
+            )
+            # Corrupt the live subscription with an oversize bulk
+            # length FIRST (exercises the cap), then garbage bytes.
+            with fake_redis.sub_lock:
+                socks = [s for v in fake_redis.subscribers.values() for s in v]
+            assert socks
+            for s in socks:
+                try:
+                    s.sendall(b"$999999999999\r\n\xff garbage\r\n")
+                except OSError:
+                    pass
+            # After reconnect (which re-reads the key), a new value
+            # still lands via publish.
+            fake_redis.data["k"] = _rules_json(9)
+
+            def recovered():
+                fake_redis.publish("ch", _rules_json(9))
+                v = src.get_property().value
+                return bool(v) and v[0].count == 9
+
+            assert _wait(recovered), "datasource did not recover after corruption"
+        finally:
+            src.close()
