@@ -7,6 +7,7 @@ Open http://127.0.0.1:18720/ while it runs.
 
 import _bootstrap  # noqa: F401
 
+import os
 import time
 
 import sentinel_tpu as st
@@ -20,17 +21,26 @@ st.flow_rule_manager.load_rules([
     st.FlowRule("search", count=50),
 ])
 
-center = CommandCenter(port=18719).start()
-dashboard = DashboardServer(port=18720, fetch_interval_sec=0.5).start()
-HeartbeatSender("127.0.0.1:18720", command_port=18719, interval_sec=1.0).start()
+# SENTINEL_DEMO_PORT=0 (the test default) binds ephemeral ports so
+# parallel runs never collide; SENTINEL_DEMO_DURATION shortens the
+# traffic loop.
+_port = int(os.environ.get("SENTINEL_DEMO_PORT", "18719"))
+duration = float(os.environ.get("SENTINEL_DEMO_DURATION", "60"))
+center = CommandCenter(port=_port).start()
+dashboard = DashboardServer(
+    port=_port + 1 if _port else 0, fetch_interval_sec=0.5
+).start()
+HeartbeatSender(
+    f"127.0.0.1:{dashboard.port}", command_port=center.port, interval_sec=1.0
+).start()
 MetricTimer(st.get_engine(), interval_sec=0.5).start()
 
-print("command API  : http://127.0.0.1:18719/api")
-print("Prometheus   : http://127.0.0.1:18719/metrics")
-print("web console  : http://127.0.0.1:18720/")
-print("offering traffic for 60s (checkout pinned at 3/s) — ctrl-c to stop")
+print(f"command API  : http://127.0.0.1:{center.port}/api")
+print(f"Prometheus   : http://127.0.0.1:{center.port}/metrics")
+print(f"web console  : http://127.0.0.1:{dashboard.port}/")
+print(f"offering traffic for {duration:.0f}s (checkout pinned at 3/s) — ctrl-c to stop")
 
-deadline = time.time() + 60
+deadline = time.time() + duration
 try:
     while time.time() < deadline:
         for _ in range(5):
