@@ -164,23 +164,11 @@ class _Model:
         self.node.add_rt_and_success(t, rt, 1)
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
-def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
-    rng = np.random.default_rng(seed)
-    kinds = ["qps", "thread", "rl", "warmup", "wurl", "pbucket", "pthrottle"]
-    rng.shuffle(kinds)
-    models = {}
-    rules = []
-    for i, kind in enumerate(kinds):
-        m = _Model(kind, rng)
-        res = f"res-{kind}"
-        if m.rule is not None:
-            m.rule = dataclasses.replace(m.rule, resource=res)
-            rules.append(m.rule)
-        if m.prule is not None:
-            m.prule = dataclasses.replace(m.prule, resource=res)
-        models[res] = m
-    st.flow_rule_manager.load_rules(rules)
+def _load_rules(models):
+    """Load flow/degrade/param rules for the models (keyed by resource)."""
+    st.flow_rule_manager.load_rules(
+        [m.rule for m in models.values() if m.rule is not None]
+    )
     st.degrade_rule_manager.load_rules(
         [
             dataclasses.replace(m.drule, resource=res)
@@ -191,6 +179,60 @@ def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
     st.param_flow_rule_manager.load_rules(
         [m.prule for m in models.values() if m.prule is not None]
     )
+
+
+def _step_entry(engine, m, res, t, rng, allow_prio, ctx):
+    """One entry op: oracle decision (flow → breaker, with the occupied
+    bypass) vs engine verdict. Returns the op when admitted."""
+    prio = allow_prio and m.kind == "qps" and rng.random() < 0.3
+    value = f"v{int(rng.integers(0, 2))}"
+    args = (value,) if m.prule is not None else ()
+    want, want_wait = m.decide(t, prio, value)
+    occupied = prio and want and want_wait > 0
+    if want and m.breaker is not None and not occupied:
+        # DegradeSlot runs last; occupied entries bypass it
+        # (PriorityWaitException aborts the chain first).
+        if not m.breaker.try_pass(t):
+            want, want_wait = False, 0
+    op = engine.submit_entry(res, ts=t, prio=prio, args=args)
+    engine.flush()
+    assert op.verdict.admitted == want, (
+        f"{ctx} res={res} t={t} prio={prio}: "
+        f"engine={op.verdict.admitted} oracle={want}"
+    )
+    assert op.verdict.wait_ms == want_wait, (
+        f"{ctx} res={res} t={t}: wait engine={op.verdict.wait_ms} oracle={want_wait}"
+    )
+    m.account_entry(t, want, want_wait if prio else 0)
+    return op if want else None
+
+
+def _step_exit(engine, m, res, op, t, rng):
+    """One exit op with a random RT and error bit, fed to both sides."""
+    rt = int(rng.integers(1, 60))
+    err = int(rng.random() < 0.35)
+    engine.submit_exit(op.rows, rt=rt, ts=t, err=err, resource=res)
+    engine.flush()
+    if m.breaker is not None:
+        m.breaker.on_complete(t, rt, error=bool(err))
+    m.account_exit(t, rt)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
+    rng = np.random.default_rng(seed)
+    kinds = ["qps", "thread", "rl", "warmup", "wurl", "pbucket", "pthrottle"]
+    rng.shuffle(kinds)
+    models = {}
+    for kind in kinds:
+        m = _Model(kind, rng)
+        res = f"res-{kind}"
+        if m.rule is not None:
+            m.rule = dataclasses.replace(m.rule, resource=res)
+        if m.prule is not None:
+            m.prule = dataclasses.replace(m.prule, resource=res)
+        models[res] = m
+    _load_rules(models)
     resources = list(models)
 
     t = 1000
@@ -206,43 +248,16 @@ def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
             m.node.materialize(t)
         if rng.random() < 0.72 or not open_entries:
             res = resources[int(rng.integers(0, len(resources)))]
-            m = models[res]
-            prio = m.kind == "qps" and rng.random() < 0.3
-            value = f"v{int(rng.integers(0, 2))}"
-            args = (value,) if m.prule is not None else ()
-            want, want_wait = m.decide(t, prio, value)
-            occupied = prio and want and want_wait > 0
-            if want and m.breaker is not None and not occupied:
-                # DegradeSlot runs last; occupied entries bypass it
-                # (PriorityWaitException aborts the chain first).
-                if not m.breaker.try_pass(t):
-                    want, want_wait = False, 0
-            op = engine.submit_entry(res, ts=t, prio=prio, args=args)
-            engine.flush()
-            got = op.verdict.admitted
-            assert got == want, (
-                f"seed={seed} step={step} res={res} t={t} prio={prio}: "
-                f"engine={got} oracle={want}"
+            op = _step_entry(
+                engine, models[res], res, t, rng, True, f"seed={seed} step={step}"
             )
-            assert op.verdict.wait_ms == want_wait, (
-                f"seed={seed} step={step} res={res} t={t}: "
-                f"wait engine={op.verdict.wait_ms} oracle={want_wait}"
-            )
-            m.account_entry(t, got, want_wait if prio else 0)
             checked += 1
-            if got:
+            if op is not None:
                 open_entries.append((res, op))
         else:
             idx = int(rng.integers(0, len(open_entries)))
             res, op = open_entries.pop(idx)
-            m = models[res]
-            rt = int(rng.integers(1, 60))
-            err = int(rng.random() < 0.35)
-            engine.submit_exit(op.rows, rt=rt, ts=t, err=err, resource=res)
-            engine.flush()
-            if m.breaker is not None:
-                m.breaker.on_complete(t, rt, error=bool(err))
-            m.account_exit(t, rt)
+            _step_exit(engine, models[res], res, op, t, rng)
     assert checked > 100
 
     # Final gauge + block-window stats agree too (pass windows involve
@@ -261,14 +276,12 @@ def test_random_sequential_stream_matches_oracle_on_mesh(manual_clock, engine):
     engine.enable_mesh(8)
     rng = np.random.default_rng(7)
     models = {}
-    rules = []
     for kind in ["qps", "thread", "rl"]:
         m = _Model(kind, rng)
         res = f"res-{kind}"
         m.rule = dataclasses.replace(m.rule, resource=res)
         models[res] = m
-        rules.append(m.rule)
-    st.flow_rule_manager.load_rules(rules)
+    _load_rules(models)
     resources = list(models)
 
     t = 1000
@@ -281,22 +294,15 @@ def test_random_sequential_stream_matches_oracle_on_mesh(manual_clock, engine):
             m.node.materialize(t)
         if rng.random() < 0.72 or not open_entries:
             res = resources[int(rng.integers(0, len(resources)))]
-            m = models[res]
-            want, want_wait = m.decide(t, False)
-            op = engine.submit_entry(res, ts=t)
-            engine.flush()
-            assert op.verdict.admitted == want, (step, res, t)
-            assert op.verdict.wait_ms == want_wait, (step, res, t)
-            m.account_entry(t, want, 0)
-            if want:
+            op = _step_entry(
+                engine, models[res], res, t, rng, False, f"mesh step={step}"
+            )
+            if op is not None:
                 open_entries.append((res, op))
         else:
             idx = int(rng.integers(0, len(open_entries)))
             res, op = open_entries.pop(idx)
-            rt = int(rng.integers(1, 60))
-            engine.submit_exit(op.rows, rt=rt, ts=t, resource=res)
-            engine.flush()
-            models[res].account_exit(t, rt)
+            _step_exit(engine, models[res], res, op, t, rng)
 
     # The merged (all-reduced) gauges and block windows must match too —
     # a merge that double-counted per device would pass every
