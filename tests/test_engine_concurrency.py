@@ -214,3 +214,53 @@ class TestAutoFlush:
         engine.start_auto_flush(interval_ms=50)  # explicit: restart
         assert engine._auto_flush_thread is not t1
         engine.stop_auto_flush()
+
+    def test_auto_flush_with_concurrent_submitters(self, manual_clock, engine):
+        """The background flusher racing threaded bulk + singles
+        submitters: no exceptions, every op decided, and the admitted
+        total equals the submitted total (no lost or double-counted
+        rows under the lock handoffs)."""
+        import threading
+
+        import sentinel_tpu as st
+
+        engine.set_flow_rules([st.FlowRule("c", count=1e9)])
+        engine.start_auto_flush(interval_ms=1)
+        errs = []
+        groups = []
+        ops_all = []
+        lock = threading.Lock()
+
+        def worker(i):
+            try:
+                for _ in range(20):
+                    if i % 2 == 0:
+                        g = engine.submit_bulk("c", 50)
+                        with lock:
+                            groups.append(g)
+                    else:
+                        ops = engine.submit_many(
+                            [{"resource": "c"} for _ in range(20)]
+                        )
+                        with lock:
+                            ops_all.extend(ops)
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        engine.flush()
+        engine.stop_auto_flush()
+        assert not errs
+        assert all(op.verdict is not None for op in ops_all)
+        assert all(g.admitted is not None for g in groups)
+        total = sum(g.n for g in groups) + len(ops_all)
+        admitted = sum(g.admitted_count for g in groups) + sum(
+            1 for op in ops_all if op.verdict.admitted
+        )
+        assert admitted == total  # count=1e9: nothing should block
+        stats = engine.cluster_node_stats("c")
+        assert stats["total_pass_minute"] == total
