@@ -150,3 +150,31 @@ class TestLimitAppRouting:
         e.exit()
         assert st.try_entry("api") is None
         st.context_exit()
+
+
+class TestInvalidRules:
+    def test_invalid_rules_ignored_not_crashed(self, manual_clock, engine):
+        """Invalid beans (empty resource, negative counts, bad refs) are
+        filtered with a warning — the valid remainder still loads and
+        enforces (reference: FlowRuleUtil.buildFlowRuleMap validation)."""
+        st.flow_rule_manager.load_rules([
+            st.FlowRule("", count=5),                 # empty resource
+            st.FlowRule("ok", count=-3),              # negative count
+            st.FlowRule("ok", count=2),               # the one valid rule
+        ])
+        manual_clock.set_ms(100)
+        # Only the valid count=2 rule is compiled into the engine: the
+        # negative-count bean must neither block everything nor crash.
+        admitted = sum(1 for _ in range(5) if st.try_entry("ok") is not None)
+        assert admitted == 2
+        st.degrade_rule_manager.load_rules([
+            st.DegradeRule(resource="", grade=1, count=0.5, time_window=2),
+            st.DegradeRule(resource="d", grade=1, count=0.5, time_window=-1),
+        ])
+        st.param_flow_rule_manager.load_rules([
+            st.ParamFlowRule(resource="p", param_idx=None, count=5),
+            st.ParamFlowRule(resource="", param_idx=0, count=5),
+        ])
+        # Nothing crashed; entries on those resources pass through.
+        assert st.try_entry("d") is not None
+        assert st.try_entry("p") is not None
