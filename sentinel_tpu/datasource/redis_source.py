@@ -36,6 +36,7 @@ class RespError(Exception):
 # ``max_bulk_bytes`` for legitimately huge rule payloads.
 DEFAULT_MAX_BULK_BYTES = 64 * 1024 * 1024
 DEFAULT_MAX_ARRAY_ELEMS = 1 << 20
+MAX_NESTING_DEPTH = 32
 
 
 class RespConnection:
@@ -98,7 +99,12 @@ class RespConnection:
         data, self._buf = self._buf[:n], self._buf[n + 2:]
         return data
 
-    def read_reply(self):
+    def read_reply(self, _depth: int = 0):
+        if _depth > MAX_NESTING_DEPTH:
+            # A stream of nested '*1\r\n' headers costs ~4 bytes/level:
+            # without this cap it recurses past the size caps straight
+            # into RecursionError instead of the RespError contract.
+            raise RespError(f"reply nested deeper than {MAX_NESTING_DEPTH}")
         line = self._read_line()
         kind, rest = line[:1], line[1:]
         if kind == b"+":
@@ -120,7 +126,7 @@ class RespConnection:
                 return None
             if n > self.max_array_elems:
                 raise RespError(f"array too large ({n} elements)")
-            return [self.read_reply() for _ in range(n)]
+            return [self.read_reply(_depth + 1) for _ in range(n)]
         raise RespError(f"bad RESP type byte {kind!r}")
 
 

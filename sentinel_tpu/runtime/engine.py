@@ -275,6 +275,7 @@ class Engine:
         # Background flusher (see start_auto_flush).
         self._auto_flush_thread: Optional[threading.Thread] = None
         self._auto_flush_stop: Optional[threading.Event] = None
+        self._auto_flush_interval_s: float = 0.0
         self._lock = threading.RLock()
         # Serializes flushes + rule-table swaps; never taken while
         # holding _lock (fixed order _flush_lock → _lock).
@@ -700,7 +701,24 @@ class Engine:
         or one caller's array shared across groups — would corrupt it."""
         if v is None:
             return np.full(n, default, dtype=np.int32)
-        a = np.array(v, dtype=np.int32, copy=True)
+        src = np.asarray(v)
+        if src.dtype.kind not in "iub":
+            # np.array(v, int32) would silently truncate 1.9 -> 1; a
+            # float ts/acquire column is a caller bug that must fail as
+            # loudly as a shape mismatch does.
+            raise TypeError(
+                f"bulk column dtype {src.dtype} is not integral; "
+                "pass int values (ms timestamps, counts)"
+            )
+        info = np.iinfo(np.int32)
+        if src.size and (src.min() < info.min or src.max() > info.max):
+            # astype would silently wrap (absolute epoch-ms is the
+            # classic case — the engine clock is relative int32 ms).
+            raise OverflowError(
+                "bulk column value out of int32 range; pass relative-ms "
+                "timestamps (engine clock), not absolute epoch ms"
+            )
+        a = src.astype(np.int32, copy=True)
         if a.ndim == 0:
             return np.full(n, int(a), dtype=np.int32)
         if a.shape != (n,):
@@ -1015,56 +1033,68 @@ class Engine:
         cadence (silently dropping a requested interval would leave the
         caller believing it took effect).
         """
-        with self._lock:
-            running = self._auto_flush_thread is not None
-        if running:
-            if interval_ms is None:
-                return
+        # Clamp: a zero/negative interval (bad config) must not turn
+        # the daemon into a busy-spin hammering the locks.
+        requested = (
+            None if interval_ms is None else max(interval_ms / 1000.0, 1e-4)
+        )
+        while True:
+            with self._lock:
+                if self._auto_flush_thread is None:
+                    self._start_auto_flush_locked(requested)
+                    return
+                if requested is None or self._auto_flush_interval_s == requested:
+                    return  # a flusher at an acceptable cadence runs
+            # Running at a different cadence than the explicit request:
+            # restart and re-check — losing a restart race to a caller
+            # with a DIFFERENT interval must loop until OUR cadence (or
+            # a matching one) is in effect, not silently return. The
+            # stop/join happens outside the lock: the flusher thread
+            # takes it, so joining while holding it would deadlock.
             self.stop_auto_flush()
-        with self._lock:
-            if self._auto_flush_thread is not None:
-                return  # lost a start race; the other caller's flusher runs
-            iv = (
-                interval_ms
-                if interval_ms is not None
-                else config.get_float(config.FLUSH_INTERVAL_MS, 2.0)
-            ) / 1000.0
-            # Clamp: a zero/negative interval (bad config) must not
-            # turn the daemon into a busy-spin hammering the locks.
-            iv = max(iv, 1e-4)
-            stop = threading.Event()
-            self._auto_flush_stop = stop
 
-            def _loop() -> None:
-                from sentinel_tpu.utils.record_log import record_log
+    def _start_auto_flush_locked(self, requested: Optional[float]) -> None:
+        """Create + start the flusher thread. Caller holds ``_lock``
+        and has verified no flusher is running."""
+        iv = (
+            requested
+            if requested is not None
+            else max(config.get_float(config.FLUSH_INTERVAL_MS, 2.0) / 1000.0, 1e-4)
+        )
+        self._auto_flush_interval_s = iv
+        stop = threading.Event()
+        self._auto_flush_stop = stop
 
-                failures = 0
-                while not stop.wait(
-                    iv if failures == 0 else min(1.0, iv * 2**failures)
-                ):
-                    try:
-                        with self._lock:
-                            pending = bool(
-                                self._entries or self._exits
-                                or self._bulk_entries or self._bulk_exits
-                            )
-                        if pending:
-                            self.flush()
-                        failures = 0
-                    except Exception:
-                        # Backoff to ≤1 Hz and log only the streak's
-                        # first failure — at a 2 ms period a persistent
-                        # device error would otherwise churn the record
-                        # log with ~500 tracebacks/second.
-                        if failures == 0:
-                            record_log.error(
-                                "[Engine] auto-flush failed", exc_info=True
-                            )
-                        failures = min(failures + 1, 16)
+        def _loop() -> None:
+            from sentinel_tpu.utils.record_log import record_log
 
-            t = threading.Thread(target=_loop, name="sentinel-auto-flush", daemon=True)
-            self._auto_flush_thread = t
-            t.start()
+            failures = 0
+            while not stop.wait(
+                iv if failures == 0 else min(1.0, iv * 2**failures)
+            ):
+                try:
+                    with self._lock:
+                        pending = bool(
+                            self._entries or self._exits
+                            or self._bulk_entries or self._bulk_exits
+                        )
+                    if pending:
+                        self.flush()
+                    failures = 0
+                except Exception:
+                    # Backoff to ≤1 Hz and log only the streak's
+                    # first failure — at a 2 ms period a persistent
+                    # device error would otherwise churn the record
+                    # log with ~500 tracebacks/second.
+                    if failures == 0:
+                        record_log.error(
+                            "[Engine] auto-flush failed", exc_info=True
+                        )
+                    failures = min(failures + 1, 16)
+
+        t = threading.Thread(target=_loop, name="sentinel-auto-flush", daemon=True)
+        self._auto_flush_thread = t
+        t.start()
 
     def stop_auto_flush(self) -> None:
         with self._lock:

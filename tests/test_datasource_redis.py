@@ -224,6 +224,33 @@ class TestRedisDataSource:
 
 
 class TestRespRobustness:
+    def test_deep_nesting_raises_resp_error(self):
+        """A stream of nested '*1' headers (~4 bytes/level) must hit the
+        depth cap as a RespError, not recurse into RecursionError."""
+        import socket
+
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+
+        def feed():
+            s, _ = srv.accept()
+            s.sendall(b"*1\r\n" * 600)
+            s.close()
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        conn = RespConnection("127.0.0.1", port)
+        try:
+            from sentinel_tpu.datasource.redis_source import RespError
+
+            with pytest.raises(RespError, match="nested deeper"):
+                conn.read_reply()
+        finally:
+            conn.close()
+            srv.close()
+
     def test_oversize_length_reconnects_and_recovers(self, fake_redis):
         """A corrupted stream claiming an absurd bulk length must hit
         the size cap (no unbounded allocation), drop the connection,

@@ -273,3 +273,17 @@ class TestBulkEntries:
             engine.submit_bulk("x", engine.max_batch + 1)
         with pytest.raises(ValueError, match="shape"):
             engine.submit_bulk("x", 4, ts=np.zeros(3, dtype=np.int32))
+
+    def test_bulk_rejects_float_columns(self, manual_clock, engine):
+        """A float ts/acquire column must fail as loudly as a shape
+        mismatch — np.array(v, int32) used to truncate 1.9 -> 1."""
+        with pytest.raises(TypeError, match="not integral"):
+            engine.submit_bulk("x", 4, ts=np.array([1.0, 2.0, 3.0, 4.9]))
+        with pytest.raises(TypeError, match="not integral"):
+            engine.submit_bulk("x", 4, acquire=1.5)
+        # Out-of-int32-range values must not silently wrap either.
+        with pytest.raises(OverflowError, match="int32 range"):
+            engine.submit_bulk("x", 4, ts=np.full(4, 1_700_000_000_000))
+        # Integer dtypes of any width still pass when in range.
+        g = engine.submit_bulk("x", 4, ts=np.arange(4, dtype=np.int64), acquire=2)
+        assert g is not None

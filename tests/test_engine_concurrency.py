@@ -213,6 +213,35 @@ class TestAutoFlush:
         assert engine._auto_flush_thread is t1
         engine.start_auto_flush(interval_ms=50)  # explicit: restart
         assert engine._auto_flush_thread is not t1
+        # The documented guarantee: an explicit interval is never
+        # silently dropped — the running flusher's cadence matches it.
+        assert engine._auto_flush_interval_s == pytest.approx(0.050)
+        t2 = engine._auto_flush_thread
+        engine.start_auto_flush(interval_ms=50)  # same cadence: no restart
+        assert engine._auto_flush_thread is t2
+        engine.stop_auto_flush()
+
+    def test_auto_flush_concurrent_explicit_intervals(self, manual_clock, engine):
+        """Racing explicit-interval starts: whichever flusher survives
+        must run at one of the requested cadences, and a follow-up
+        explicit call always converges to ITS cadence (the round-3
+        advisor race: losing the restart race used to silently keep the
+        other caller's interval)."""
+        import threading
+
+        ivs = [3, 7, 11, 13]
+        threads = [
+            threading.Thread(target=engine.start_auto_flush, kwargs={"interval_ms": iv})
+            for iv in ivs
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert engine._auto_flush_thread is not None
+        assert engine._auto_flush_interval_s in [iv / 1000.0 for iv in ivs]
+        engine.start_auto_flush(interval_ms=29)
+        assert engine._auto_flush_interval_s == pytest.approx(0.029)
         engine.stop_auto_flush()
 
     def test_auto_flush_with_concurrent_submitters(self, manual_clock, engine):
