@@ -1,0 +1,193 @@
+"""Bisect the k=2 slot-width cliff on TPU (PERF_NOTES.md round 3).
+
+Round-3 measurement: the flags-off kernel stage at n=131072 entries ran
+0.14 ms with k=1 slots but 392 ms with k=2 (second slot all -1) — a
+2800x jump for doubling the flat [n*k] width, while CPU shows +8%. This
+probe times each suspect in isolation so the cliff can be attributed:
+
+  sortP   lax.sort with P operands over the [n*k] flat slots
+  admis   flow_admission alone, k=1 vs k=2
+  flush   flush_step_jit (flags off), k=1 vs k=2
+  stats   the metric-array batched window update alone
+  seg     the segment cumsum/cummax rank math alone
+
+Run: python tools/k2probe.py [--platform cpu] [--n 131072]
+Each stage prints one line; a final JSON summary goes to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _time(fn, *args, iters=5, **kw):
+    import jax
+
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--n", type=int, default=131072)
+    ap.add_argument("--rules", type=int, default=1 << 20)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from __graft_entry__ import _example_batch
+    from sentinel_tpu.metrics.nodes import make_stats
+    from sentinel_tpu.rules.degrade_table import DegradeIndex
+    from sentinel_tpu.rules.flow_table import FlowRuleDynState, FlowTableDevice
+    from sentinel_tpu.rules.param_table import make_param_state
+    from sentinel_tpu.runtime import flush as F
+    from sentinel_tpu.runtime.flush import SystemDevice, flush_step_jit
+
+    n, nr = args.n, args.rules
+    results: dict[str, float] = {"platform": jax.default_backend(), "n": n}
+    rng = np.random.default_rng(0)
+
+    def report(name: str, dt: float) -> None:
+        results[name] = round(dt * 1e3, 4)
+        print(f"[k2probe] {name}: {dt * 1e3:.3f} ms", file=sys.stderr, flush=True)
+
+    # --- isolated sorts over the flat slot array -----------------------
+    for k in (1, 2):
+        size = n * k
+        row_key = jnp.asarray(rng.integers(0, nr, size).astype(np.int32))
+        ts = jnp.asarray(rng.integers(0, 400, size).astype(np.int32))
+        eidx = jnp.arange(size, dtype=jnp.int32) // k
+        pos = jnp.arange(size, dtype=jnp.int32)
+
+        s4 = jax.jit(lambda a, b, c, d: jax.lax.sort((a, b, c, d), num_keys=3))
+        s3 = jax.jit(lambda a, b, c: jax.lax.sort((a, b, c), num_keys=2))
+        s2 = jax.jit(lambda a, b: jax.lax.sort((a, b), num_keys=1))
+        s1 = jax.jit(lambda a: jax.lax.sort((a,), num_keys=1))
+        report(f"sort4_k{k}", _time(s4, row_key, ts, eidx, pos, iters=args.iters))
+        report(f"sort3_k{k}", _time(s3, row_key, ts, pos, iters=args.iters))
+        report(f"sort2_k{k}", _time(s2, row_key, pos, iters=args.iters))
+        report(f"sort1_k{k}", _time(s1, row_key, iters=args.iters))
+
+    # --- segment rank math alone ---------------------------------------
+    for k in (1, 2):
+        size = n * k
+        rk_s = jnp.sort(jnp.asarray(rng.integers(0, nr, size).astype(np.int32)))
+        acq = jnp.ones(size, dtype=jnp.int32)
+
+        @jax.jit
+        def seg(rk_s, acq):
+            ones = jnp.ones((1,), dtype=bool)
+            new_grp = jnp.concatenate([ones, rk_s[1:] != rk_s[:-1]])
+            return F.segment_excl_cumsum(new_grp, acq)
+
+        report(f"seg_k{k}", _time(seg, rk_s, acq, iters=args.iters))
+
+    # --- stats window update alone -------------------------------------
+    from sentinel_tpu.metrics import metric_array as ma
+    from sentinel_tpu.metrics.nodes import SECOND_CFG
+
+    stats = make_stats(nr)
+    for k in (1, 2):
+        size = n * k
+        rows = jnp.asarray(rng.integers(0, nr, size).astype(np.int32))
+        ts = jnp.asarray(rng.integers(0, 400, size).astype(np.int32))
+        deltas = jnp.ones((size, 1), dtype=jnp.int32) * jnp.ones(
+            (1, F.NUM_EVENTS), dtype=jnp.int32
+        )
+
+        @jax.jit
+        def upd(second, rows, ts, deltas):
+            return ma.update(SECOND_CFG, second, rows, ts, deltas)
+
+        try:
+            report(
+                f"stats_k{k}",
+                _time(upd, stats.second, rows, ts, deltas, iters=args.iters),
+            )
+        except Exception as exc:  # signature drift — report, keep going
+            print(f"[k2probe] stats_k{k} skipped: {exc}", file=sys.stderr)
+            break
+
+    # --- flow_admission alone, then the full flags-off kernel ----------
+    dev = FlowTableDevice(
+        grade=jnp.ones(nr, dtype=jnp.int32),
+        count=jnp.full(nr, 20.0, dtype=jnp.float32),
+        behavior=jnp.zeros(nr, dtype=jnp.int32),
+        max_queueing_time_ms=jnp.zeros(nr, dtype=jnp.int32),
+        cost1_ms=jnp.full(nr, 50, dtype=jnp.int32),
+        warmup_warning_token=jnp.zeros(nr, dtype=jnp.int32),
+        warmup_max_token=jnp.zeros(nr, dtype=jnp.int32),
+        warmup_slope=jnp.zeros(nr, dtype=jnp.float32),
+        warmup_refill_threshold=jnp.zeros(nr, dtype=jnp.int32),
+    )
+    dyn = FlowRuleDynState(
+        latest_passed_time=jnp.full(nr, -(10**9), dtype=jnp.int32),
+        stored_tokens=jnp.zeros(nr, dtype=jnp.float32),
+        last_filled_time=jnp.full(nr, -(10**9), dtype=jnp.int32),
+    )
+    dindex = DegradeIndex([])
+    pdyn = make_param_state(8)
+    inf = float("inf")
+    sysdev = SystemDevice(
+        qps=jnp.float32(inf), max_thread=jnp.float32(inf), max_rt=jnp.float32(inf),
+        load_threshold=jnp.float32(-1.0), cpu_threshold=jnp.float32(-1.0),
+        cur_load=jnp.float32(-1.0), cur_cpu=jnp.float32(-1.0),
+    )
+    flags = dict(
+        with_occupy=False, with_system=False, with_degrade=False, with_exits=False
+    )
+    for k in (1, 2):
+        batch = _example_batch(n, nr, nr, k)
+        admis = jax.jit(
+            lambda stats, dev, batch: F.flow_admission(
+                stats, dev, batch, with_occupy=False
+            )
+        )
+        report(f"admis_k{k}", _time(admis, stats, dev, batch, iters=args.iters))
+
+        # flush_step_jit donates its dyn state: thread it through, fresh
+        # buffers per k.
+        st_k = make_stats(nr)
+        dyn_k = FlowRuleDynState(
+            latest_passed_time=jnp.full(nr, -(10**9), dtype=jnp.int32),
+            stored_tokens=jnp.zeros(nr, dtype=jnp.float32),
+            last_filled_time=jnp.full(nr, -(10**9), dtype=jnp.int32),
+        )
+        ddyn_k, pdyn_k = dindex.make_dyn_state(), make_param_state(8)
+        out = flush_step_jit(
+            st_k, dev, dyn_k, dindex.device, ddyn_k, pdyn_k, sysdev, batch, **flags
+        )
+        st_k, dyn_k, ddyn_k, pdyn_k, res = out
+        jax.block_until_ready(res.admitted)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            st_k, dyn_k, ddyn_k, pdyn_k, res = flush_step_jit(
+                st_k, dev, dyn_k, dindex.device, ddyn_k, pdyn_k, sysdev, batch,
+                **flags
+            )
+        jax.block_until_ready(res.admitted)
+        report(f"flush_k{k}", (time.perf_counter() - t0) / args.iters)
+
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()
