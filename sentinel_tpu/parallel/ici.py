@@ -125,54 +125,69 @@ def batch_partition_specs(axis: str = "data"):
 
 
 def _split_and_spend(
-    axis: str, batch, nr: int, mask: jax.Array, unit_f: jax.Array, cap_slot: jax.Array
+    axis: str, batch, r_rows: int, mask: jax.Array, unit_f: jax.Array,
+    cap_slot: jax.Array
 ) -> jax.Array:
-    """The shared mesh-budget recipe behind both demotion passes:
-    per-rule capacity = min over the rule's participating slots of
-    ``cap_slot``; per-chip demand = sum of participating slots'
-    ``unit_f``; ``cluster_allocate`` splits the global capacity by
-    chip-indexed exclusive prefix; within the chip the grant is spent in
-    (rule, ts, arrival) order with the per-slot admission check
-    ``before + prefix + acquire ≤ cap``. Returns the per-entry keep
-    mask (an entry is kept iff every participating slot fits)."""
+    """The shared mesh-budget recipe behind both demotion passes, keyed
+    per CHECK ROW — the same key the single-chip rank math segments on
+    (flow_admission sorts slots by ``(row, ts, arrival)`` and charges
+    per row), so the sharded budget is exact wherever single-chip
+    batching is:
+
+    * per-chip demand = sum of participating slots' ``unit_f`` per row;
+    * the cross-chip exclusive demand prefix (``before``) offsets each
+      chip into the global per-row charge stream, the deterministic
+      analog of the token server serializing grants (reference:
+      ClusterFlowChecker.java:55-112);
+    * within the chip the row's stream is spent in (ts, arrival) order
+      with the per-slot admission check ``before + prefix + acquire ≤
+      cap_slot`` — ``cap_slot`` stays per-SLOT, so two rules sharing a
+      row each enforce their own count against the shared row charge,
+      exactly like the single-chip ``(cur + acquire) <= count_s``.
+
+    Earlier rounds keyed this per rule with a MIN cap over the rule's
+    rows, which over-blocked origin-split topologies (a rule checked
+    against several origin rows was capped at its most-loaded row);
+    row keying removes that deviation. Returns the per-entry keep mask
+    (an entry is kept iff every participating slot fits)."""
     from sentinel_tpu.runtime.flush import segment_excl_cumsum
 
     n, k = batch.e_rule_gid.shape
-    gid_f = batch.e_rule_gid.reshape(-1)
+    row_f = batch.e_check_row.reshape(-1)
     eidx_f = jnp.arange(n * k, dtype=jnp.int32) // k
     acq_f = batch.e_acquire[eidx_f]
-
-    big = jnp.int32(2**31 - 1)
-    cap = (
-        jnp.full((nr,), big, dtype=jnp.int32)
-        .at[jnp.where(mask, gid_f, nr)]
-        .min(jnp.where(mask, cap_slot, big), mode="drop")
-    )
-    cap = jnp.where(cap == big, 0, cap)  # rules unseen in batch: no demand anyway
+    row_c = jnp.clip(row_f, 0, r_rows - 1)
 
     demand = (
-        jnp.zeros((nr,), dtype=jnp.int32)
-        .at[jnp.where(mask, gid_f, nr)]
+        jnp.zeros((r_rows,), dtype=jnp.int32)
+        .at[jnp.where(mask, row_c, r_rows)]
         .add(jnp.where(mask, unit_f, 0), mode="drop")
     )
-    _, before = cluster_allocate(axis, demand, cap, with_before=True)
+    # Exclusive cross-chip prefix of per-row demand: chip i's offset
+    # into each row's global charge stream.
+    idx = jax.lax.axis_index(axis)
+    nax = jax.lax.axis_size(axis)
+    all_d = jax.lax.all_gather(demand, axis)  # [nax, r_rows]
+    before = jnp.sum(
+        jnp.where(jnp.arange(nax).reshape(nax, 1) < idx, all_d, 0), axis=0
+    )
 
-    # Spend the budget in (ts, arrival) order within each rule segment.
-    # Per-slot admission = the reference's sequential check run at this
-    # chip's offset into the global budget. Since unit ≤ acquire, kept
-    # spend per chip stays ≤ cap − before, so the total across the mesh
-    # never exceeds cap.
+    # Spend in (ts, arrival) order within each row segment. Per-slot
+    # admission = the reference's sequential check run at this chip's
+    # offset into the global stream. Since unit ≤ acquire, kept spend
+    # per chip stays ≤ its grant, so the total across the mesh never
+    # exceeds any slot's cap.
     pos = jnp.arange(n * k, dtype=jnp.int32)
-    gid_key = jnp.where(mask, gid_f, jnp.int32(nr))
+    row_key = jnp.where(mask, row_c, jnp.int32(r_rows))
     ts_f = batch.e_ts[eidx_f]
-    key_s, ts_s, ei_s, pos_s = jax.lax.sort((gid_key, ts_f, eidx_f, pos), num_keys=3)
+    key_s, ts_s, pos_s = jax.lax.sort((row_key, ts_f, pos), num_keys=3)
     acq_s = acq_f[pos_s]
     m_s = mask[pos_s]
     ones = jnp.ones((1,), dtype=bool)
     new_grp = jnp.concatenate([ones, key_s[1:] != key_s[:-1]])
     prefix = segment_excl_cumsum(new_grp, jnp.where(m_s, unit_f[pos_s], 0))
-    key_c = jnp.clip(key_s, 0, nr - 1)
-    keep_s = ~m_s | ((before[key_c] + prefix + acq_s) <= cap[key_c])
+    key_c = jnp.clip(key_s, 0, r_rows - 1)
+    keep_s = ~m_s | ((before[key_c] + prefix + acq_s) <= cap_slot[pos_s])
     keep_slot = jnp.ones((n * k,), dtype=bool).at[pos_s].set(keep_s)
     return keep_slot.reshape(n, k).all(axis=1)
 
@@ -208,9 +223,9 @@ def _demote_over_grant(
     reconstructed as pre-stats plus the psum of per-chip exit deltas
     (pass counts are exit-invariant, so ``stats_x`` serves directly).
     Rows are per-slot in general (limitApp×strategy); budgets are
-    conserved per rule against the most-loaded row the rule touches in
-    this batch — exact for the dominant single-row case, conservative
-    for origin-split topologies.
+    conserved per CHECK ROW with per-slot caps (see _split_and_spend),
+    matching the single-chip row-keyed rank math — exact for
+    origin-split topologies too.
     """
     from sentinel_tpu.metrics import metric_array as ma
     from sentinel_tpu.metrics.events import MetricEvent
@@ -255,7 +270,7 @@ def _demote_over_grant(
     cap_slot = jnp.maximum(
         jnp.floor(flow_dev.count[gid_c]) - base_slot, 0.0
     ).astype(jnp.int32)
-    return _split_and_spend(axis, batch, nr, constrained, unit_f, cap_slot)
+    return _split_and_spend(axis, batch, r_rows, constrained, unit_f, cap_slot)
 
 
 def _demote_over_borrow(
@@ -296,7 +311,7 @@ def _demote_over_borrow(
     cap_slot = jnp.maximum(
         max_count - waiting[row_fc].astype(jnp.float32), 0.0
     ).astype(jnp.int32)
-    return _split_and_spend(axis, batch, nr, borrower, acq_f, cap_slot)
+    return _split_and_spend(axis, batch, r_rows, borrower, acq_f, cap_slot)
 
 
 def _global_param_scan(axis, pdyn, param_g, live_up, n_local, rounds=0):
@@ -345,8 +360,11 @@ def _global_shaping_scan(
     ``passQps`` for the warm-up math is rebuilt deterministically from
     the replicated post-exit windows plus the intra-batch charge among
     the global shaping items themselves — charged over ALL valid items
-    regardless of upstream liveness, exactly like flow_admission's
-    unmasked ``consumed_acq`` on the single-chip path. Charges from
+    regardless of upstream liveness, like flow_admission's
+    liveness-unmasked ``consumed_acq`` on the single-chip path. (The
+    single-chip charge is own-row-gated for RELATE slots; a RELATE +
+    warm-up combination on the mesh keeps the ungated charge here —
+    one-sided conservative in that corner.) Charges from
     co-row DEFAULT slots within this same flush are not visible to it
     (they land in the windows by the next flush) — a within-one-flush
     optimism that only matters when a warm-up rule shares its check row
@@ -377,9 +395,10 @@ def _global_shaping_scan(
     # it is the contract. Only the scan's state advance is live-gated.
     rkey = jnp.where(shaping_g.valid, shaping_g.row, jnp.int32(r_rows))
     pos = jnp.arange(s, dtype=jnp.int32)
-    rk_s, _, ei_s, p_s = jax.lax.sort(
-        (rkey, shaping_g.ts, shaping_g.eidx, pos), num_keys=3
-    )
+    # Global items concatenate per chip in eidx order, so pos as the
+    # last key reproduces (row, ts, eidx) with one less sort operand.
+    rk_s, _, p_s = jax.lax.sort((rkey, shaping_g.ts, pos), num_keys=3)
+    ei_s = shaping_g.eidx[p_s]
     ones = jnp.ones((1,), dtype=bool)
     new_grp = jnp.concatenate([ones, rk_s[1:] != rk_s[:-1]])
     last_of_ent = jnp.concatenate([rk_s[1:] != rk_s[:-1], ones]) | jnp.concatenate(

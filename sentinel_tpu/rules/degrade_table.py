@@ -309,7 +309,9 @@ def breaker_try_pass(
     # rank-0 candidate per breaker gets the probe.
     gid_key = jnp.where(candidate, gid_f, jnp.int32(nd))
     pos = jnp.arange(n * kd, dtype=jnp.int32)
-    gid_s, ts_s, ei_s, p_s = jax.lax.sort((gid_key, ts_f, eidx, pos), num_keys=3)
+    # pos subsumes eidx as tie-break (eidx == pos // kd is
+    # nondecreasing in pos): one less sort operand, deterministic.
+    gid_s, ts_s, p_s = jax.lax.sort((gid_key, ts_f, pos), num_keys=3)
     ones = jnp.ones((1,), dtype=bool)
     new_grp = jnp.concatenate([ones, gid_s[1:] != gid_s[:-1]])
     first_s = new_grp & (gid_s < nd)

@@ -198,7 +198,10 @@ def run_param(
 
     key = jnp.where(pb.valid, pb.prow, jnp.int32(pr))
     pos = jnp.arange(s, dtype=jnp.int32)
-    row_s, ts_s, ei_s, p_s = jax.lax.sort((key, pb.ts, pb.eidx, pos), num_keys=3)
+    # Compacted batches are built in entry order (eidx nondecreasing in
+    # item position), so pos as the last key reproduces the
+    # (row, ts, eidx) order with one less sort operand.
+    row_s, ts_s, p_s = jax.lax.sort((key, pb.ts, pos), num_keys=3)
     row_c = jnp.clip(row_s, 0, pr - 1)
     valid_s = pb.valid[p_s]
 

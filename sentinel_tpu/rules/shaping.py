@@ -165,12 +165,13 @@ def run_shaping(
     s = shaping.valid.shape[0]
     nr = flow_dev.n_rules
 
-    # Sort by (gid, ts, eidx); invalid slots sort last (gid = nr).
+    # Sort by (gid, ts, arrival); invalid slots sort last (gid = nr).
+    # Compacted batches are built in entry order (eidx nondecreasing in
+    # item position), so pos as the last key reproduces the
+    # (gid, ts, eidx) order with one less sort operand.
     gid_key = jnp.where(shaping.valid, shaping.gid, jnp.int32(nr))
     pos = jnp.arange(s, dtype=jnp.int32)
-    gid_s, ts_s, ei_s, p_s = jax.lax.sort(
-        (gid_key, shaping.ts, shaping.eidx, pos), num_keys=3
-    )
+    gid_s, ts_s, p_s = jax.lax.sort((gid_key, shaping.ts, pos), num_keys=3)
     gid_c = jnp.clip(gid_s, 0, nr - 1)
     valid_s = shaping.valid[p_s]
     acq_s = shaping.acquire[p_s].astype(jnp.float32)

@@ -22,13 +22,16 @@ DefaultController.java:49-75: pass iff ``curCount + acquire <= count``
 with ``curCount = (int) passQps()`` or ``curThreadNum``). Batched, that
 recurrence is resolved per *check node*: entries touching a node are
 ordered by ``(ts, arrival index)`` and entry *i*'s check charges the sum
-of earlier entries' acquire counts on that node. For a node whose
-entries share one rule set and one acquire count — the overwhelmingly
-common case, and everything the reference's own tests exercise — the
-admitted set is a prefix and this is *exactly* the sequential outcome.
-When earlier entries are rejected by an unrelated rule (cross-resource
-RELATE topologies) this over-charges, i.e. degrades conservatively
-(never admits more than the reference would).
+of earlier entries' acquire counts on that node — gated to slots whose
+row the entry actually ACCOUNTS on (its own node rows), because a
+RELATE slot reads the ref resource's node without the reference ever
+bumping it from the guarded side. For a node whose entries share one
+rule set and one acquire count — the overwhelmingly common case, and
+everything the reference's own tests exercise — the admitted set is a
+prefix and this is *exactly* the sequential outcome. A RELATE check
+whose ref resource carries no rule reads the ref node's pre-flush
+windows (no slots → no charge stream): the legal interleaving where
+the guarded entries race ahead of co-flush ref traffic.
 
 Within one flush, exits are applied before entry checks (a flush spans
 a few ms at most; the reference's interleaving at sub-flush granularity
@@ -230,11 +233,17 @@ def flow_admission(
     eidx_f = jnp.arange(n * k, dtype=jnp.int32) // k
     active = (gid_f >= 0) & (row_f >= 0) & batch.e_valid[eidx_f]
 
-    # Sort slots by (node, ts, entry) so intra-batch charging is ordered.
+    # Sort slots by (node, ts, entry) so intra-batch charging is
+    # ordered. ``pos`` subsumes the entry index as a tie-break key
+    # (eidx == pos // k is nondecreasing in pos), so a 3-operand sort
+    # with pos as the last KEY gives the identical — and now fully
+    # deterministic — order with one less sort operand (TPU variadic
+    # sorts cost per operand).
     row_key = jnp.where(active, row_f, jnp.int32(r_rows))
     ts_f = batch.e_ts[eidx_f]
     pos = jnp.arange(n * k, dtype=jnp.int32)
-    rk_s, ts_s, ei_s, pos_s = jax.lax.sort((row_key, ts_f, eidx_f, pos), num_keys=3)
+    rk_s, ts_s, pos_s = jax.lax.sort((row_key, ts_f, pos), num_keys=3)
+    ei_s = pos_s // k
 
     active_s = active[pos_s]
     gid_s = jnp.clip(gid_f[pos_s], 0, nr - 1)
@@ -249,8 +258,25 @@ def flow_admission(
         [ei_s[1:] != ei_s[:-1], ones]
     )
 
-    consumed_acq = _segment_consumed(new_grp, last_of_ent, acq_s)
-    consumed_cnt = _segment_consumed(new_grp, last_of_ent, jnp.ones_like(acq_s))
+    # A slot charges its row's intra-batch stream only when that row is
+    # one the entry ACCOUNTS on (its own node rows, batch.e_rows).
+    # RELATE/other-node slots read the ref resource's row but the
+    # reference never bumps it from the guarded resource's entries
+    # (FlowRuleChecker.java:96-165 — accounting stays on the entry's
+    # node), so an ungated charge would over-block same-flush RELATE
+    # streams (the round-3 documented deviation; measured ~8-10%
+    # over-block on the RELATE pair in tests/test_conservatism.py).
+    own_f = jnp.zeros((n * k,), dtype=bool)
+    for j in range(4):
+        own_f = own_f | (row_f == batch.e_rows[:, j][eidx_f])
+    own_s = own_f[pos_s]
+
+    consumed_acq = _segment_consumed(
+        new_grp, last_of_ent, jnp.where(own_s, acq_s, 0)
+    )
+    consumed_cnt = _segment_consumed(
+        new_grp, last_of_ent, jnp.where(own_s, 1, 0)
+    )
 
     rk_c = jnp.clip(rk_s, 0, r_rows - 1)
     base_pass = pass_sums[rk_c]

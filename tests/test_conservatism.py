@@ -1,0 +1,126 @@
+"""Quantify the batching conservatism (round-3 weak #5).
+
+The suite's one-sided deviations say the engine may over-BLOCK
+relative to the sequential reference, never over-admit. This test
+measures the over-block *rate* under a realistic mixed workload —
+multi-origin traffic on origin-split rules plus RELATE pairs, batched
+into production-size flushes — against a sequential reference engine
+(one flush per op; pinned exact vs the oracle by
+tests/test_differential.py), and asserts the rate stays under 5%. A
+conservatism bound users can feel is a bug with better marketing; this
+pins it as a number.
+
+Round-4 state of the deviations exercised here:
+* origin-split mesh budgets — EXACT (row-keyed _split_and_spend);
+  contributes zero.
+* RELATE intra-batch over-charge — REMOVED (own-row charge gate in
+  flow_admission); with ruled ref resources (as here) RELATE streams
+  are exact, so the measured rate should be ~0. The <5% bound stays as
+  the product promise this test enforces against regressions.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import sentinel_tpu as st
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.runtime.engine import Engine
+
+
+def _rules():
+    return [
+        # Plain QPS traffic, moderate headroom.
+        st.FlowRule("r0", count=30),
+        st.FlowRule("r1", count=24),
+        st.FlowRule("r2", count=40),
+        st.FlowRule("r3", count=18),
+        # RELATE pairs: A guarded by B's QPS.
+        st.FlowRule("A1", count=20, strategy=C.STRATEGY_RELATE, ref_resource="B1"),
+        st.FlowRule("A2", count=15, strategy=C.STRATEGY_RELATE, ref_resource="B2"),
+        st.FlowRule("B1", count=25),
+        st.FlowRule("B2", count=20),
+        # Origin-split (per-origin budget rows).
+        st.FlowRule("os", count=25, limit_app=C.LIMIT_APP_OTHER),
+    ]
+
+
+_WEIGHTS = [
+    ("r0", 3), ("r1", 2), ("r2", 3), ("r3", 2),
+    ("A1", 3), ("A2", 2), ("B1", 2), ("B2", 2),
+    ("os", 5),
+]
+
+
+def _run_workload(batched: Engine, clock, rng, steps: int, flush_mean: int):
+    """Drive the same random op stream through ``batched`` (one flush
+    per step) and a fresh sequential reference engine (one flush per
+    op). Returns (admits_batched, admits_oracle, checked) per
+    resource."""
+    seq = Engine(clock=clock)
+    seq.set_flow_rules(_rules())
+
+    pool = [r for r, w in _WEIGHTS for _ in range(w)]
+    origins = ["o1", "o2", "o3"]
+    adm_b: dict = {}
+    adm_o: dict = {}
+    checked: dict = {}
+    t = 1000
+    for _ in range(steps):
+        t += int(rng.integers(40, 180))
+        clock.set_ms(t)
+        n_ops = max(1, int(rng.poisson(flush_mean)))
+        reqs = []
+        for _ in range(n_ops):
+            res = pool[int(rng.integers(0, len(pool)))]
+            req = {"resource": res, "ts": t}
+            if res == "os":
+                req["origin"] = origins[int(rng.integers(0, len(origins)))]
+            reqs.append(req)
+        ops_b = batched.submit_many([dict(r) for r in reqs])
+        batched.flush()
+        for req, op in zip(reqs, ops_b):
+            res = req["resource"]
+            checked[res] = checked.get(res, 0) + 1
+            adm_b[res] = adm_b.get(res, 0) + int(op.verdict.admitted)
+        for req in reqs:
+            op = seq.submit_entry(**req)
+            seq.flush()
+            res = req["resource"]
+            adm_o[res] = adm_o.get(res, 0) + int(op.verdict.admitted)
+    return adm_b, adm_o, checked
+
+
+def _assert_rate(adm_b, adm_o, checked, ctx: str):
+    tot_b, tot_o = sum(adm_b.values()), sum(adm_o.values())
+    # One-sided: batching never admits more in aggregate.
+    assert tot_b <= tot_o, f"{ctx}: batched admitted MORE than sequential"
+    rate = (tot_o - tot_b) / max(tot_o, 1)
+    per_res = {
+        r: round((adm_o[r] - adm_b.get(r, 0)) / max(adm_o[r], 1), 4)
+        for r in sorted(adm_o)
+    }
+    print(f"\n[{ctx}] over-block rate: {rate:.4f} "
+          f"({tot_o - tot_b}/{tot_o} over {sum(checked.values())} checks); "
+          f"per-resource: {per_res}")
+    assert rate < 0.05, f"{ctx}: over-block rate {rate:.4f} >= 5%"
+    return rate
+
+
+def test_overblock_rate_single_chip(manual_clock, engine):
+    engine.set_flow_rules(_rules())
+    rng = np.random.default_rng(42)
+    adm_b, adm_o, checked = _run_workload(engine, manual_clock, rng, 60, 24)
+    _assert_rate(adm_b, adm_o, checked, "single-chip")
+
+
+def test_overblock_rate_mesh(manual_clock, engine):
+    """The mesh engine vs the sequential single-chip reference: the
+    sharded budget split must not add measurable conservatism on top of
+    the intra-batch math (origin-split is exact since round 4)."""
+    engine.enable_mesh(8)
+    engine.set_flow_rules(_rules())
+    rng = np.random.default_rng(43)
+    adm_b, adm_o, checked = _run_workload(engine, manual_clock, rng, 30, 24)
+    _assert_rate(adm_b, adm_o, checked, "mesh")

@@ -6,6 +6,8 @@ cluster unit, ≙ the reference's standalone token server
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture()
 def mesh_engine(manual_clock, engine):
@@ -322,11 +324,13 @@ class TestEngineMesh:
         # DEFAULT budget (20) binds tighter than the pacer here.
         assert sum(got) == 20
 
-    def test_origin_split_budget_is_conservative(self, mesh_engine, manual_clock):
+    def test_origin_split_budget_is_exact(self, mesh_engine, manual_clock):
         """One rule checked against several origin rows in a batch: the
-        sharded budget takes the per-rule MIN across touched rows
-        (parallel/ici._demote_over_grant) — conservative, never
-        admitting more than single-chip, and never over any row's cap."""
+        sharded budget is keyed per check ROW with per-slot caps
+        (parallel/ici._split_and_spend), the same key the single-chip
+        rank math segments on — so origin-split admits EXACTLY what
+        single-chip does (earlier rounds MIN-capped the rule across
+        rows, over-blocking the lightly-loaded origin)."""
         import sentinel_tpu as st
         from sentinel_tpu.models import constants as C
         from sentinel_tpu.runtime.engine import Engine
@@ -356,9 +360,10 @@ class TestEngineMesh:
         adm_r = sum(o.verdict.admitted for o in gr)
         # Single-chip (row-exact): o1 admits its remaining 4, o2 all 8.
         assert adm_r == 12
-        # Mesh: per-rule min across rows = 10-6 = 4 — conservative.
-        assert adm_m == 4
-        assert adm_m <= adm_r
+        # Mesh, row-keyed: identical — o1 its remaining 4, o2 all 8.
+        assert adm_m == 12
+        # Per-origin verdicts match single-chip exactly.
+        assert [o.verdict.admitted for o in gm] == [o.verdict.admitted for o in gr]
         # Never over any single row's cap.
         for origin in ("o1", "o2"):
             adm_o = sum(

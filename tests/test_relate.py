@@ -3,11 +3,12 @@ resource B (FlowRuleChecker.selectNodeByRequesterAndStrategy, reference:
 slots/block/flow/FlowRuleChecker.java:96-165 — STRATEGY_RELATE reads the
 ref resource's ClusterNode while accounting stays on A).
 
-Pins the documented intra-batch conservatism (runtime/flush.py module
-docstring): the batched rank math charges earlier same-batch entries'
-acquires on the CHECK node, so same-flush RELATE entries under-admit
-relative to the sequential reference — never over-admit. Flush-per-entry
-sequences match the oracle exactly.
+Round-4 semantics (runtime/flush.py "Intra-batch sequencing"): the
+rank-math charge is own-row-gated, so same-flush RELATE streams match
+the sequential reference exactly when the ref resource is ruled; with
+an unruled ref resource the checks read its pre-flush windows (the
+legal guarded-entries-race-ahead interleaving — documented deviation).
+Flush-per-entry sequences match the oracle exactly either way.
 """
 
 import pytest
@@ -53,12 +54,13 @@ class TestRelateSequential:
 
 
 class TestRelateBatchedConservatism:
-    def test_same_batch_under_admits_never_over(self, manual_clock, engine):
-        """One flush with 10 A entries: the kernel charges each A entry's
-        acquire to B's row for later entries in the batch, admitting
-        exactly count − pass(B) = 2 where the sequential reference admits
-        all 10. Pinned: the deviation is one-sided (under, never over)
-        and exactly the remaining headroom on the check node."""
+    def test_same_batch_matches_sequential_exactly(self, manual_clock, engine):
+        """One flush with 10 A entries: the reference never bumps B's
+        count from A's entries (accounting stays on A), so ALL of them
+        see pass_B(3) + 1 <= 5 and admit — and since round 4 the kernel
+        matches: a slot charges its row's intra-batch stream only when
+        the row is one the entry accounts on (flush.py own-row gate).
+        Rounds 1-3 over-charged here, admitting only count − pass(B)."""
         st.flow_rule_manager.load_rules([_relate_rule(5)])
         manual_clock.set_ms(100)
         for _ in range(3):
@@ -67,11 +69,55 @@ class TestRelateBatchedConservatism:
         ops = engine.submit_many([{"resource": "A", "ts": now} for _ in range(10)])
         engine.flush()
         admitted = [op.verdict.admitted for op in ops]
-        assert sum(admitted) == 2  # count(5) - pass_B(3)
-        assert admitted == [True, True] + [False] * 8  # prefix, ts order
-        # Never over: the admitted set cannot exceed the check node's
-        # remaining headroom.
-        assert sum(admitted) <= 5 - 3
+        assert admitted == [True] * 10  # sequential reference outcome
+
+    def test_same_batch_ruled_b_traffic_still_charges(self, manual_clock, engine):
+        """When B carries its own rule, direct B entries in the flush
+        DO charge B's stream (own-row slots), so later-ordered A checks
+        see them exactly as the sequential reference would — the
+        own-row gate removes only the reverse direction (A charging B).
+        """
+        st.flow_rule_manager.load_rules(
+            [_relate_rule(5), st.FlowRule("B", count=100)]
+        )
+        manual_clock.set_ms(100)
+        for _ in range(3):
+            st.try_entry("B")
+        now = engine.clock.now_ms()
+        # 2 more B entries then 10 A entries, one flush. Sequential:
+        # B's land first (ts ties break by arrival), pass_B -> 5, every
+        # A check sees 5 + 1 > 5 and blocks.
+        reqs = [{"resource": "B", "ts": now}] * 2 + [{"resource": "A", "ts": now}] * 10
+        ops = engine.submit_many([dict(r) for r in reqs])
+        engine.flush()
+        admitted = [op.verdict.admitted for op in ops]
+        assert admitted[:2] == [True, True]
+        assert sum(admitted[2:]) == 0
+
+    def test_same_batch_unruled_b_traffic_lands_next_flush(
+        self, manual_clock, engine
+    ):
+        """When B has NO rule of its own, its entries carry no slots and
+        cannot charge a stream: same-flush A checks read B's pre-flush
+        windows — the legal interleaving where the guarded entries race
+        ahead of the ref traffic (documented deviation; sub-flush
+        interleaving is racy in the reference too). By the NEXT flush
+        the B passes are in the windows and bind."""
+        st.flow_rule_manager.load_rules([_relate_rule(5)])
+        manual_clock.set_ms(100)
+        for _ in range(3):
+            st.try_entry("B")
+        now = engine.clock.now_ms()
+        reqs = [{"resource": "B", "ts": now}] * 2 + [{"resource": "A", "ts": now}] * 10
+        ops = engine.submit_many([dict(r) for r in reqs])
+        engine.flush()
+        admitted = [op.verdict.admitted for op in ops]
+        # A-first interleaving: checks see pass_B == 3 (pre-flush).
+        assert admitted == [True] * 12
+        # Next flush: pass_B == 5 is visible, A blocks.
+        ops2 = engine.submit_many([{"resource": "A", "ts": now}] * 3)
+        engine.flush()
+        assert [o.verdict.admitted for o in ops2] == [False] * 3
 
     def test_direct_rules_in_same_batch_stay_exact(self, manual_clock, engine):
         """The conservatism is scoped to cross-resource topologies: a

@@ -1,0 +1,146 @@
+"""WarmUp f64 boundary pin (round-3 weak #7).
+
+Java computes the warm-up warning QPS in float64
+(WarmUpController.java:64-130: ``warningQps = Math.nextUp(1.0 /
+(aboveToken * slope + 1.0 / count))``); the device kernel uses float32
+(rules/shaping.py::_transition). This suite pins the kernel against
+hand-computed Java-f64 verdicts at the EXACT boundary tick for extreme
+rule counts:
+
+* count <= 1e6: the f32 kernel's pass/block at every f32-representable
+  integer passQps around the boundary equals Java-f64 — no divergence.
+* count = 1e8: divergence exists but is confined to a tick of a few
+  accumulated f32 rounding errors above the f64 boundary — pinned at
+  relative width 2e-7 of the warning QPS (at 1e8 that is <= 20 QPS out
+  of ~67M). Inside that tick the f32 kernel can admit where Java-f64
+  blocks; outside it they agree exactly. The test pins that bound and
+  the direction.
+
+The kernel function under test is the real one (`_transition`), not a
+re-derivation of its arithmetic.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.rules.shaping import _transition
+
+
+def _java_model(count: float, warmup_sec: int, cf: int = 3):
+    """WarmUpController.construct in Java-f64 (Python floats are f64),
+    digit-for-digit: int cast of the product then INTEGER division."""
+    warning = int(warmup_sec * count) // (cf - 1)
+    max_tok = warning + int(2 * warmup_sec * count / (1.0 + cf))
+    slope = (cf - 1.0) / count / (max_tok - warning)
+    return warning, max_tok, slope
+
+
+def _java_verdict(passq: float, acq: float, stored: float, warning: int,
+                  slope: float, count: float) -> bool:
+    above = stored - warning
+    if above <= 0:
+        return passq + acq <= count
+    warning_qps = math.nextafter(1.0 / (above * slope + 1.0 / count), math.inf)
+    return passq + acq <= warning_qps
+
+
+def _kernel_verdict(passq: float, stored: float, warning: int, max_tok: int,
+                    slope: float, count: float) -> bool:
+    """One WARM_UP item through the real kernel transition, with sync
+    disabled (lastfill == current second) so ``stored`` is checked
+    as-is."""
+    ts = 5000
+    one = jnp.ones((1,), dtype=jnp.int32)
+
+    def f(latest, stored_a, lastfill, passq_a):
+        x = (
+            jnp.ones((1,), dtype=bool),          # valid
+            jnp.full((1,), ts, dtype=jnp.int32),  # ts
+            jnp.ones((1,), dtype=jnp.float32),    # acq_f
+            one,                                  # acq
+            passq_a,                              # passq
+            jnp.zeros((1,), dtype=jnp.float32),   # prevq
+            jnp.full((1,), C.CONTROL_BEHAVIOR_WARM_UP, dtype=jnp.int32),
+            jnp.full((1,), count, dtype=jnp.float32),
+            jnp.zeros((1,), dtype=jnp.int32),     # mq
+            jnp.zeros((1,), dtype=jnp.int32),     # c1
+            jnp.full((1,), warning, dtype=jnp.float32),
+            jnp.full((1,), max_tok, dtype=jnp.float32),
+            jnp.full((1,), slope, dtype=jnp.float32),
+            jnp.full((1,), 10**9, dtype=jnp.float32),  # refill thr (unused)
+        )
+        return _transition(latest, stored_a, lastfill, x)[0]
+
+    ok = jax.jit(f)(
+        jnp.zeros((1,), dtype=jnp.int32),
+        jnp.full((1,), stored, dtype=jnp.float32),
+        jnp.full((1,), ts - ts % 1000, dtype=jnp.int32),
+        jnp.full((1,), passq, dtype=jnp.float32),
+    )
+    return bool(np.asarray(ok)[0])
+
+
+def _boundary_probes(wq64: float):
+    """f32-representable integer passQps values straddling the f64
+    boundary (an integer not exactly representable in f32 cannot be a
+    real windowed pass count input at these magnitudes — window sums
+    enter the kernel through a f32 floor)."""
+    bp = math.floor(wq64)
+    step = max(1, int(np.spacing(np.float32(bp))))
+    out = []
+    for p in range(bp - 3 * step, bp + 3 * step + 1):
+        if float(np.float32(p)) == float(p):
+            out.append(p)
+    return out
+
+
+@pytest.mark.parametrize("count", [1e4, 1e6])
+@pytest.mark.parametrize("frac", [0.25, 0.6, 1.0])
+def test_boundary_tick_matches_java_f64_exactly(count, frac):
+    warning, max_tok, slope = _java_model(count, 10)
+    stored = warning + (max_tok - warning) * frac
+    above = stored - warning
+    wq64 = math.nextafter(1.0 / (above * slope + 1.0 / count), math.inf)
+    for p in _boundary_probes(wq64):
+        want = _java_verdict(p, 1.0, stored, warning, slope, count)
+        got = _kernel_verdict(p, stored, warning, max_tok, slope, count)
+        assert got == want, (
+            f"count={count} frac={frac} passQps={p}: kernel={got} java={want} "
+            f"(wq64={wq64})"
+        )
+
+
+@pytest.mark.parametrize("frac", [0.25, 0.6, 1.0])
+def test_boundary_divergence_at_1e8_bounded_by_one_ulp(frac):
+    """At count=1e8 the f32 warning QPS sits a few accumulated f32
+    rounding steps above the f64 value (each of above*slope, +1/count,
+    the divide and nextafter rounds once), so inside that tick the
+    kernel admits where Java blocks. Pin: (a) divergence only ever in
+    that direction, (b) only within relative 2e-7 of the boundary,
+    (c) exact agreement outside."""
+    count = 1e8
+    warning, max_tok, slope = _java_model(count, 10)
+    stored = warning + (max_tok - warning) * frac
+    above = stored - warning
+    wq64 = math.nextafter(1.0 / (above * slope + 1.0 / count), math.inf)
+    tick = 2e-7 * wq64
+    diverged = 0
+    for p in _boundary_probes(wq64):
+        want = _java_verdict(p, 1.0, stored, warning, slope, count)
+        got = _kernel_verdict(p, stored, warning, max_tok, slope, count)
+        if got != want:
+            diverged += 1
+            assert got and not want, "kernel must never BLOCK where Java passes"
+            assert abs((p + 1.0) - wq64) <= tick, (
+                f"divergence outside the pinned tick: passQps={p} wq64={wq64} "
+                f"tick={tick}"
+            )
+    # The known cases (frac 0.25 and 1.0) do diverge inside the tick —
+    # if the kernel ever goes f64 this xfail-style guard flips to full
+    # exactness and the assert above keeps holding vacuously.
+    assert diverged <= 2
