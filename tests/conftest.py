@@ -9,7 +9,28 @@ from sentinel_tpu.utils.backend import force_cpu
 
 force_cpu(8)
 
+import jax  # noqa: E402
+
+# Long single-process runs accumulate XLA:CPU/LLVM JIT state until the
+# compiler itself segfaults (observed deep into the slow tier: crash in
+# backend_compile_and_load after ~45 min of compiles; any single test
+# passes in isolation). Two-part mitigation: persist compiled
+# executables on disk so recompiles skip LLVM entirely, and drop the
+# in-memory executable caches periodically to bound JIT memory.
+jax.config.update("jax_compilation_cache_dir", "/tmp/sentinel_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest  # noqa: E402
+
+_TESTS_SINCE_CLEAR = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _bound_jit_state():
+    yield
+    _TESTS_SINCE_CLEAR["n"] += 1
+    if _TESTS_SINCE_CLEAR["n"] % 25 == 0:
+        jax.clear_caches()
 
 
 @pytest.fixture()

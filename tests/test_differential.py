@@ -16,6 +16,8 @@ import dataclasses
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import sentinel_tpu as st
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.testing.oracle import (

@@ -10,6 +10,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
 DEMOS = sorted(
     f for f in os.listdir(EXAMPLES_DIR)

@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow
+
 
 def _sharded_fixture(n_devices=8, n_rules=4, n_rows=16, per_chip=16, count=20.0,
                      acquire=1, grade=None, n_exits=0, threads0=0,
