@@ -10,9 +10,13 @@ ships adapters for Nacos/ZooKeeper/Apollo/etcd/Redis/Consul/Eureka —
 all following the same watch-callback → ``property.update_value`` shape;
 here the file and in-memory sources are first-class, the push-style
 base class (:class:`PushDataSource`) is the extension point for any
-external store client, and :class:`RedisDataSource` is a full network
-adapter (RESP over a socket: GET for the initial value, SUBSCRIBE for
-live updates — sentinel-datasource-redis/.../RedisDataSource.java).
+external store client, and two full network adapters ship:
+:class:`RedisDataSource` (RESP over a socket: GET for the initial
+value, SUBSCRIBE for live updates —
+sentinel-datasource-redis/.../RedisDataSource.java) and
+:class:`EtcdDataSource` (etcd v3 HTTP gRPC-gateway: range + put +
+streaming watch with revision resume —
+sentinel-datasource-etcd/.../EtcdDataSource.java:41).
 """
 
 from sentinel_tpu.datasource.base import (
@@ -30,11 +34,13 @@ from sentinel_tpu.datasource.file_source import (
     FileRefreshableDataSource,
     FileWritableDataSource,
 )
+from sentinel_tpu.datasource.etcd_source import EtcdDataSource
 from sentinel_tpu.datasource.http_source import HttpDataSource, HttpLongPollDataSource
 from sentinel_tpu.datasource.redis_source import RedisDataSource
 
 __all__ = [
     "AbstractDataSource",
+    "EtcdDataSource",
     "HttpDataSource",
     "HttpLongPollDataSource",
     "RedisDataSource",
