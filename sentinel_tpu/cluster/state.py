@@ -42,6 +42,22 @@ class ClusterStateManager:
                 return True
             cls._mode = cls.CLUSTER_CLIENT
             client = TokenClientProvider.get_client()
+            if client is None and ClusterClientConfigManager.server_host:
+                # No registered client but an assigned server address
+                # (cluster/client/modifyConfig — the dashboard assign
+                # flow): create one, like the reference's
+                # DefaultClusterTokenClient picking up
+                # ClusterClientConfigManager on mode switch.
+                from sentinel_tpu.cluster.client import ClusterTokenClient
+
+                client = ClusterTokenClient(
+                    ClusterClientConfigManager.server_host,
+                    ClusterClientConfigManager.server_port,
+                    request_timeout_sec=(
+                        ClusterClientConfigManager.request_timeout_ms / 1000.0
+                    ),
+                )
+                TokenClientProvider.register(client)
             if client is not None and hasattr(client, "start"):
                 try:
                     client.start()
@@ -76,6 +92,35 @@ class ClusterStateManager:
             return cls.set_to_server()
         cls.stop()
         return True
+
+
+class ClusterClientConfigManager:
+    """Client-side cluster config: the token server address this
+    machine talks to (reference: cluster/client/config/
+    ClusterClientConfigManager.java — serverHost/serverPort pushed by
+    the dashboard's assign flow via cluster/client/modifyConfig)."""
+
+    server_host: str = ""
+    server_port: int = 0
+    request_timeout_ms: int = 200
+    _lock = threading.Lock()
+
+    @classmethod
+    def apply(cls, host: str, port: int, timeout_ms: Optional[int] = None) -> None:
+        with cls._lock:
+            cls.server_host = host
+            cls.server_port = int(port)
+            if timeout_ms is not None:
+                cls.request_timeout_ms = int(timeout_ms)
+
+    @classmethod
+    def snapshot(cls) -> dict:
+        with cls._lock:
+            return {
+                "serverHost": cls.server_host,
+                "serverPort": cls.server_port,
+                "requestTimeout": cls.request_timeout_ms,
+            }
 
 
 class TokenClientProvider:

@@ -318,6 +318,60 @@ class DefaultTokenService(TokenService):
     def release_concurrent_token(self, token_id: int) -> TokenResult:
         return TokenResult(self.concurrent.release(int(token_id)))
 
+    def flow_stats(self) -> List[dict]:
+        """Per-flowId server-side view: current granted QPS (the flow
+        row's windowed PASS) and held concurrency — what the dashboard's
+        cluster screen shows (reference: the dashboard reading the
+        token server's ClusterServerStatLogUtil counters)."""
+        # Snapshot under the lock, compute outside it: the grant path
+        # takes the same RLock, and holding it across a window_sums
+        # device round-trip (a JIT compile on the first poll) would add
+        # that latency to every token request while a dashboard polls.
+        # The state arrays are immutable; a concurrent grant swaps the
+        # reference, leaving this snapshot consistent.
+        def read_sums(state) -> np.ndarray:
+            now = jnp.int32(self.clock.now_ms())
+            return np.asarray(
+                jax.device_get(
+                    ma.window_sums(CLUSTER_CFG, state, now)[:, MetricEvent.PASS]
+                )
+            )
+
+        sums = None
+        for _ in range(5):
+            with self._lock:
+                flows = {
+                    fid: row for fid, row in self._flow_rows.items()
+                    if isinstance(fid, int)  # param rows use string keys
+                }
+                state = self.state
+            if not flows:
+                return []
+            try:
+                sums = read_sums(state)
+                break
+            except RuntimeError:
+                # _decide_jit donates the state buffer: a grant racing
+                # this read can delete the snapshot. Re-snapshot.
+                continue
+        if sums is None:
+            with self._lock:  # continuous grant traffic: read while held
+                sums = read_sums(self.state)
+        interval_sec = CLUSTER_CFG.interval_ms / 1000.0
+        out = []
+        for fid, row in sorted(flows.items()):
+            rule = cluster_flow_rule_manager.get_rule_by_id(fid)
+            out.append({
+                "flowId": fid,
+                "namespace": cluster_flow_rule_manager.namespace_of(fid)
+                or "default",
+                "currentQps": float(sums[row]) / interval_sec
+                if row < sums.shape[0] else 0.0,
+                "concurrency": self.concurrent.now_calls(fid),
+                "threshold": float(rule.count) if rule is not None else None,
+            })
+        return out
+
     def reset(self) -> None:
         with self._lock:
             self.state = ma.make_state(self.state.n_rows, CLUSTER_CFG)

@@ -5,11 +5,16 @@ MetricFetcher.java:70-282 polling every machine's /metric each second
 into an InMemoryMetricsRepository with 5-minute retention;
 discovery/SimpleMachineDiscovery fed by /registry/machine heartbeats;
 client/SentinelApiClient.java:93 pushing/pulling rules through the
-command API; REST controllers per rule type). The AngularJS console is
-out of scope — the JSON REST surface it sits on is here.
+command API; REST controllers per rule type; auth/
+SimpleWebAuthServiceImpl session login; service/cluster assign plane;
+rule/DynamicRuleProvider + Publisher config-center persistence). A
+dependency-free single-file console (webui.py) replaces the AngularJS
+SPA: app list, live QPS sparklines, rule editor, login, cluster
+management.
 """
 
 from sentinel_tpu.dashboard.app import (
+    AuthService,
     DashboardServer,
     AppManagement,
     InMemoryMetricsRepository,
@@ -17,12 +22,25 @@ from sentinel_tpu.dashboard.app import (
     MetricFetcher,
     SentinelApiClient,
 )
+from sentinel_tpu.dashboard.rules import (
+    DynamicRuleProvider,
+    DynamicRulePublisher,
+    EtcdRuleStore,
+    InMemoryRuleStore,
+    RuleStore,
+)
 
 __all__ = [
+    "AuthService",
     "DashboardServer",
     "AppManagement",
     "InMemoryMetricsRepository",
     "MachineInfo",
     "MetricFetcher",
     "SentinelApiClient",
+    "DynamicRuleProvider",
+    "DynamicRulePublisher",
+    "EtcdRuleStore",
+    "InMemoryRuleStore",
+    "RuleStore",
 ]

@@ -8,6 +8,11 @@ HTTP surface (JSON unless noted):
     GET  /rules?app=&type=flow|degrade|...     pull rules from machines
     POST /rules?app=&type=&data=<json>         push rules to machines
     GET  /clusterNode?app=                     live cluster-node stats
+    GET  /cluster/state?app=                   per-machine cluster mode/stats
+    POST /cluster/assign?app=&server=ip:port   server+clients assignment
+    POST /auth/login?username=&password=       session cookie (when enabled)
+    GET  /auth/check                           {"loggedIn": bool}
+    POST /auth/logout
 """
 
 from __future__ import annotations
@@ -155,6 +160,19 @@ class SentinelApiClient:
         except ValueError:
             return None
 
+    def api_json(self, m: MachineInfo, path: str, params: Optional[Dict[str, str]] = None):
+        """Generic command call decoded as JSON (None on failure)."""
+        raw = self._get(m.ip, m.port, path, params or {})
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
+    def api_call(self, m: MachineInfo, path: str, params: Optional[Dict[str, str]] = None) -> bool:
+        return self._get(m.ip, m.port, path, params or {}) == "success"
+
 
 class MetricFetcher:
     """Polls every healthy machine's /metric window into the repository
@@ -209,14 +227,103 @@ class MetricFetcher:
         self._stop.set()
 
 
+class AuthService:
+    """Session login for the console (reference: dashboard auth/
+    SimpleWebAuthServiceImpl.java:30 + LoginAuthenticationFilter —
+    username/password from config, a session cookie afterwards). Auth
+    is DISABLED when no credentials are configured, matching the
+    reference's ``auth.username=`` empty-string behavior."""
+
+    COOKIE = "sentinel_dashboard_session"
+
+    def __init__(
+        self,
+        username: Optional[str] = None,
+        password: Optional[str] = None,
+        session_ttl_sec: float = 3600.0,
+    ) -> None:
+        self.username = username
+        self.password = password
+        self.ttl = session_ttl_sec
+        self._sessions: Dict[str, float] = {}  # token -> expiry
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.username)
+
+    def login(self, username: str, password: str) -> Optional[str]:
+        import hmac
+        import secrets
+
+        if not self.enabled:
+            return None
+        # Compare as utf-8 bytes: compare_digest raises TypeError on
+        # non-ASCII str inputs, which would crash the login handler on
+        # a unicode password instead of returning 401.
+        if not (
+            hmac.compare_digest(
+                username.encode("utf-8"), (self.username or "").encode("utf-8")
+            )
+            and hmac.compare_digest(
+                password.encode("utf-8"), (self.password or "").encode("utf-8")
+            )
+        ):
+            return None
+        token = secrets.token_hex(16)
+        now = time.time()
+        with self._lock:
+            self._sessions = {
+                t: exp for t, exp in self._sessions.items() if exp > now
+            }
+            self._sessions[token] = now + self.ttl
+        return token
+
+    def check(self, token: Optional[str]) -> bool:
+        if not self.enabled:
+            return True
+        if not token:
+            return False
+        with self._lock:
+            exp = self._sessions.get(token)
+            if exp is None or exp <= time.time():
+                self._sessions.pop(token, None)
+                return False
+            return True
+
+    def logout(self, token: Optional[str]) -> None:
+        if token:
+            with self._lock:
+                self._sessions.pop(token, None)
+
+
+# Paths reachable without a session (the reference's auth filter
+# excludes login + the machine registry; the SPA itself is static).
+_AUTH_EXEMPT = {"/", "/index.html", "/auth/login", "/auth/check", "/version",
+                "/registry/machine"}
+
+
 class DashboardServer:
     """The REST facade over discovery + repo + api client."""
 
-    def __init__(self, port: int = 0, fetch_interval_sec: float = 1.0) -> None:
+    def __init__(
+        self,
+        port: int = 0,
+        fetch_interval_sec: float = 1.0,
+        auth_username: Optional[str] = None,
+        auth_password: Optional[str] = None,
+        rule_store=None,
+    ) -> None:
         self.apps = AppManagement()
         self.repo = InMemoryMetricsRepository()
         self.client = SentinelApiClient()
         self.fetcher = MetricFetcher(self.apps, self.repo, self.client, fetch_interval_sec)
+        self.auth = AuthService(auth_username, auth_password)
+        # Optional DynamicRuleProvider/Publisher pair (dashboard/rules
+        # .py): when set, rule reads/writes go to durable storage and
+        # machines pick changes up through their own datasource watch
+        # instead of a direct command-API push.
+        self.rule_store = rule_store
         self._requested_port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -270,6 +377,26 @@ class DashboardServer:
             app = params.get("app", "")
             kind = params.get("type", "flow")
             data = params.get("data")
+            if self.rule_store is not None:
+                # Config-center mode (DynamicRuleProvider/Publisher):
+                # the store is authoritative; machines follow it via
+                # their own datasource watch.
+                if data is not None:
+                    try:
+                        rules = json.loads(data)
+                        if not isinstance(rules, list):
+                            raise ValueError("rules must be a JSON list")
+                    except ValueError as e:
+                        return 400, json.dumps({"code": -1, "msg": str(e)})
+                    try:
+                        self.rule_store.publish(app, kind, rules)
+                    except Exception as e:
+                        return 502, json.dumps({"code": -1, "msg": f"publish: {e}"})
+                    return 200, json.dumps({"code": 0})
+                rules = self.rule_store.get_rules(app, kind)
+                if rules is not None:
+                    return 200, json.dumps(rules)
+                # fall through to machines when the store has nothing
             machines = [m for m in self.apps.machines_of(app) if m.is_healthy()]
             if not machines:
                 return 404, json.dumps({"code": -1, "msg": f"no machines for {app}"})
@@ -284,6 +411,60 @@ class DashboardServer:
             if not machines:
                 return 200, json.dumps([])
             return 200, json.dumps(self.client.fetch_cluster_nodes(machines[0]) or [])
+        if path == "/cluster/state":
+            app = params.get("app", "")
+            out = []
+            for m in self.apps.machines_of(app):
+                if not m.is_healthy():
+                    continue
+                mode = self.client.api_json(m, "getClusterMode") or {}
+                entry = {
+                    "ip": m.ip,
+                    "port": m.port,
+                    "mode": mode.get("mode", -1),
+                }
+                if entry["mode"] == 1:  # server: config + per-flow stats
+                    entry["server"] = {
+                        "config": self.client.api_json(m, "cluster/server/config"),
+                        "stats": self.client.api_json(m, "cluster/server/stats"),
+                    }
+                elif entry["mode"] == 0:  # client: its server address
+                    entry["client"] = self.client.api_json(m, "cluster/client/config")
+                out.append(entry)
+            return 200, json.dumps(out)
+        if path == "/cluster/assign":
+            # ClusterAssignServiceImpl.java:36 — one machine becomes the
+            # token server, the rest its clients.
+            app = params.get("app", "")
+            target = params.get("server", "")
+            if ":" not in target:
+                return 400, json.dumps({"code": -1, "msg": "server=ip:port required"})
+            s_ip, s_port = target.rsplit(":", 1)
+            machines = [m for m in self.apps.machines_of(app) if m.is_healthy()]
+            server_m = next(
+                (m for m in machines if m.ip == s_ip and str(m.port) == s_port), None
+            )
+            if server_m is None:
+                return 404, json.dumps({"code": -1, "msg": f"unknown machine {target}"})
+            ok = self.client.api_call(server_m, "setClusterMode", {"mode": "1"})
+            token_port = (
+                self.client.api_json(server_m, "cluster/server/config") or {}
+            ).get("port")
+            failed = [] if ok else [target]
+            for m in machines:
+                if m is server_m:
+                    continue
+                good = self.client.api_call(
+                    m,
+                    "cluster/client/modifyConfig",
+                    {"serverHost": server_m.ip, "serverPort": str(token_port or 0)},
+                ) and self.client.api_call(m, "setClusterMode", {"mode": "0"})
+                if not good:
+                    failed.append(f"{m.ip}:{m.port}")
+            code = 0 if not failed else -1
+            return 200, json.dumps(
+                {"code": code, "server": target, "failed": failed}
+            )
         if path == "/version":
             from sentinel_tpu.version import __version__
 
@@ -297,24 +478,88 @@ class DashboardServer:
 
         class _Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
+                # Never persist query strings of auth requests (they
+                # could carry credentials a client wrongly put there).
+                args = tuple(
+                    a.split("?")[0] + "?<redacted>"
+                    if isinstance(a, str) and a.startswith(("GET /auth", "POST /auth"))
+                    and "?" in a
+                    else a
+                    for a in args
+                )
                 record_log.debug("[Dashboard] " + fmt, *args)
 
-            def do_GET(self):
-                parsed = urlparse(self.path)
-                params = dict(parse_qsl(parsed.query))
-                if parsed.path in ("/", "/index.html"):
-                    from sentinel_tpu.dashboard.webui import CONSOLE_HTML
+            def _body_params(self) -> Dict[str, str]:
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                except ValueError:
+                    return {}
+                if n <= 0 or n > 1 << 20:
+                    return {}
+                try:
+                    return dict(parse_qsl(self.rfile.read(n).decode("utf-8")))
+                except (UnicodeDecodeError, OSError):
+                    return {}
 
-                    code, body, ctype = 200, CONSOLE_HTML, "text/html; charset=utf-8"
-                else:
-                    code, body = dashboard._handle(parsed.path, params)
-                    ctype = "application/json"
+            def _cookie_token(self) -> Optional[str]:
+                raw = self.headers.get("Cookie", "")
+                for part in raw.split(";"):
+                    k, _, v = part.strip().partition("=")
+                    if k == AuthService.COOKIE:
+                        return v
+                return None
+
+            def _reply(self, code, body, ctype="application/json", cookie=None):
                 data = body.encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                if cookie is not None:
+                    self.send_header("Set-Cookie", cookie)
                 self.end_headers()
                 self.wfile.write(data)
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                params = dict(parse_qsl(parsed.query))
+                if self.command == "POST":
+                    params.update(self._body_params())
+                auth = dashboard.auth
+                token = self._cookie_token()
+                if parsed.path == "/auth/login":
+                    got = auth.login(
+                        params.get("username", ""), params.get("password", "")
+                    )
+                    if got is None and auth.enabled:
+                        return self._reply(
+                            401, json.dumps({"code": -1, "msg": "bad credentials"})
+                        )
+                    cookie = (
+                        f"{AuthService.COOKIE}={got}; HttpOnly; SameSite=Strict; Path=/"
+                        if got
+                        else None
+                    )
+                    return self._reply(200, json.dumps({"code": 0}), cookie=cookie)
+                if parsed.path == "/auth/check":
+                    return self._reply(
+                        200,
+                        json.dumps(
+                            {"enabled": auth.enabled, "loggedIn": auth.check(token)}
+                        ),
+                    )
+                if parsed.path == "/auth/logout":
+                    auth.logout(token)
+                    return self._reply(200, json.dumps({"code": 0}))
+                if parsed.path not in _AUTH_EXEMPT and not auth.check(token):
+                    return self._reply(
+                        401, json.dumps({"code": -1, "msg": "login required"})
+                    )
+                if parsed.path in ("/", "/index.html"):
+                    from sentinel_tpu.dashboard.webui import CONSOLE_HTML
+
+                    return self._reply(200, CONSOLE_HTML, "text/html; charset=utf-8")
+                code, body = dashboard._handle(parsed.path, params)
+                self._reply(code, body)
 
             do_POST = do_GET
 

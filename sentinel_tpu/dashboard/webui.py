@@ -45,11 +45,31 @@ CONSOLE_HTML = """<!DOCTYPE html>
   .rulebar button:hover { border-color:var(--accent); }
   #status { color:var(--dim); font-size:12px; margin-left:auto; }
   .empty { color:var(--dim); padding:30px 0; }
+  #login { position:fixed; inset:0; background:rgba(10,14,18,.93); display:none;
+    align-items:center; justify-content:center; z-index:10; }
+  #login form { background:var(--panel); border:1px solid var(--line); border-radius:10px;
+    padding:26px 30px; display:flex; flex-direction:column; gap:10px; min-width:260px; }
+  #login input { background:var(--bg); color:var(--fg); border:1px solid var(--line);
+    border-radius:6px; padding:8px 10px; }
+  #login button { background:var(--accent); color:#07121d; border:none; border-radius:6px;
+    padding:8px; font-weight:600; cursor:pointer; }
+  #loginerr { color:var(--bad); font-size:12px; min-height:14px; }
+  td.mode-server { color:var(--accent); } td.mode-client { color:var(--ok); }
+  #cluster button { background:var(--panel); color:var(--fg); border:1px solid var(--line);
+    border-radius:6px; padding:4px 10px; cursor:pointer; }
+  #cluster button:hover { border-color:var(--accent); }
 </style>
 </head>
 <body>
 <header><h1>Sentinel&nbsp;TPU</h1><span class="sub">flow control console</span>
   <span id="status"></span></header>
+<div id="login"><form onsubmit="return doLogin(event)">
+  <strong>Sign in</strong>
+  <input id="lu" placeholder="username" autocomplete="username">
+  <input id="lp" type="password" placeholder="password" autocomplete="current-password">
+  <div id="loginerr"></div>
+  <button type="submit">Log in</button>
+</form></div>
 <main>
   <nav><h2>Applications</h2><div id="apps" class="empty">loading…</div></nav>
   <section>
@@ -57,6 +77,10 @@ CONSOLE_HTML = """<!DOCTYPE html>
     <table id="metrics"><thead><tr>
       <th>resource</th><th>pass/s</th><th>block/s</th><th>rt ms</th>
       <th>threads</th><th>trend (60s)</th>
+    </tr></thead><tbody></tbody></table>
+    <h2>Cluster</h2>
+    <table id="cluster"><thead><tr>
+      <th>machine</th><th>mode</th><th>server</th><th>flows (qps / conc / thr)</th><th></th>
     </tr></thead><tbody></tbody></table>
     <h2>Rules</h2>
     <div class="rulebar">
@@ -76,7 +100,22 @@ let app = null;
 let rulesLoaded = false;  // first successful app discovery loads rules
 const hist = {};           // resource -> [{t, pass, block}]
 const $ = (id) => document.getElementById(id);
-const fetchJson = (url) => fetch(url).then(r => r.json());
+const fetchJson = (url) => fetch(url).then(r => {
+  if (r.status === 401) { $('login').style.display = 'flex'; throw new Error('login required'); }
+  return r.json();
+});
+async function doLogin(ev) {
+  ev.preventDefault();
+  // Credentials go in the POST body, never the query string (query
+  // lines end up in access/record logs).
+  const body = `username=${encodeURIComponent($('lu').value)}&password=${encodeURIComponent($('lp').value)}`;
+  const r = await fetch('/auth/login', { method: 'POST', body,
+    headers: { 'Content-Type': 'application/x-www-form-urlencoded' } });
+  if (r.status === 200) { $('login').style.display = 'none'; $('loginerr').textContent = '';
+    refreshApps(); refreshMetrics(); refreshCluster(); }
+  else $('loginerr').textContent = 'bad credentials';
+  return false;
+}
 // Names arrive from the unauthenticated registry endpoint: escape
 // EVERYTHING interpolated into markup (stored-XSS surface otherwise).
 const esc = (s) => String(s).replace(/[&<>"']/g,
@@ -101,7 +140,55 @@ async function refreshApps() {
     if (!rulesLoaded) { rulesLoaded = true; loadRules(); }
   } catch (e) { $('status').textContent = 'apps: ' + e; }
 }
-function selectApp(n) { app = n; refreshApps(); refreshMetrics(); loadRules(); }
+function selectApp(n) { app = n; refreshApps(); refreshMetrics(); refreshCluster(); loadRules(); }
+
+async function refreshCluster() {
+  if (!app) return;
+  try {
+    const ms = await fetchJson(`/cluster/state?app=${encodeURIComponent(app)}`);
+    const body = $('cluster').tBodies[0];
+    // Every machine-supplied field is attacker-reachable through the
+    // auth-exempt registry + proxied command responses: numbers are
+    // coerced (NaN -> 0), strings escaped — nothing lands in markup raw.
+    const num = (v) => (Number.isFinite(+v) ? +v : 0);
+    body.innerHTML = ms.map(m => {
+      const addr = `${esc(m.ip)}:${num(m.port)}`;
+      const mode = m.mode === 1 ? 'server' : m.mode === 0 ? 'client' : 'off';
+      let detail = '—', flows = '—';
+      if (m.server) {
+        const cfg = m.server.config || {};
+        detail = `:${num(cfg.port)} ns=${esc((cfg.namespaces || []).join(','))}`;
+        const st = (m.server.stats || {}).flows || [];
+        flows = st.map(f =>
+          `#${num(f.flowId)}: ${num(f.currentQps).toFixed(1)} / ${num(f.concurrency)}` +
+          ` / ${f.threshold == null ? '∞' : num(f.threshold)}`
+        ).join('<br>') || 'no flows';
+      } else if (m.client) {
+        detail = `→ ${esc(m.client.serverHost ?? '')}:${num(m.client.serverPort)}`;
+      }
+      // No inline-JS interpolation: HTML-entity escaping does not
+      // survive into the onclick JS-string context (entities decode
+      // back before the JS runs). The address rides a data- attribute
+      // and a delegated listener below reads it via the DOM API.
+      return `<tr><td>${addr}</td><td class="mode-${mode}">${mode}</td>` +
+        `<td>${detail}</td><td style="text-align:left">${flows}</td>` +
+        `<td><button class="assign" data-ip="${esc(m.ip)}" data-port="${num(m.port)}">` +
+        `make server</button></td></tr>`;
+    }).join('') || '<tr><td colspan="5" class="empty">no machines</td></tr>';
+    body.querySelectorAll('button.assign').forEach(b =>
+      b.addEventListener('click', () =>
+        assignServer(`${b.dataset.ip}:${b.dataset.port}`)));
+  } catch (e) { $('status').textContent = 'cluster: ' + e; }
+}
+async function assignServer(addr) {
+  try {
+    const r = await fetchJson(
+      `/cluster/assign?app=${encodeURIComponent(app)}&server=${encodeURIComponent(addr)}`);
+    $('status').textContent = r.code === 0 ? `cluster assigned: ${addr} serves`
+      : `assign failed: ${(r.failed || []).join(', ')}`;
+    refreshCluster();
+  } catch (e) { $('status').textContent = 'assign: ' + e; }
+}
 
 function spark(points, key, color) {
   if (points.length < 2) return '';
@@ -161,8 +248,12 @@ async function pushRules() {
   } catch (e) { $('status').textContent = 'push failed: ' + e; }
 }
 
+fetch('/auth/check').then(r => r.json()).then(s => {
+  if (s.enabled && !s.loggedIn) $('login').style.display = 'flex';
+});
 refreshApps(); setInterval(refreshApps, 5000);
 refreshMetrics(); setInterval(refreshMetrics, 2000);
+refreshCluster(); setInterval(refreshCluster, 5000);
 </script>
 </body>
 </html>

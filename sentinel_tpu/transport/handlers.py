@@ -271,6 +271,87 @@ def cluster_server_config_handler(req: CommandRequest) -> CommandResponse:
     )
 
 
+@command_mapping("cluster/server/stats", "token-server per-flowId qps/concurrency")
+def cluster_server_stats_handler(req: CommandRequest) -> CommandResponse:
+    """The dashboard cluster screen's data: per-flowId granted QPS +
+    held concurrency from the embedded token server (reference analog:
+    ClusterServerStatLogUtil counters surfaced to the console)."""
+    from sentinel_tpu.cluster.state import (
+        ClusterStateManager,
+        EmbeddedClusterTokenServerProvider,
+    )
+
+    server = EmbeddedClusterTokenServerProvider.get_server()
+    service = getattr(server, "service", None)
+    flows = service.flow_stats() if hasattr(service, "flow_stats") else []
+    held = 0
+    concurrent = getattr(service, "concurrent", None)
+    if concurrent is not None:
+        held = concurrent.held_tokens()
+    return CommandResponse.of_json(
+        {
+            "mode": ClusterStateManager.get_mode(),
+            "port": getattr(server, "port", None) if server is not None else None,
+            "connectedCount": getattr(service, "connected_count", 0),
+            "heldTokens": held,
+            "flows": flows,
+        }
+    )
+
+
+@command_mapping("cluster/client/config", "cluster client config (server address)")
+def cluster_client_config_handler(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.cluster.state import ClusterClientConfigManager
+
+    return CommandResponse.of_json(ClusterClientConfigManager.snapshot())
+
+
+@command_mapping(
+    "cluster/client/modifyConfig",
+    "point this client at a token server: serverHost=&serverPort=[&requestTimeout=]",
+)
+def cluster_client_modify_config_handler(req: CommandRequest) -> CommandResponse:
+    from sentinel_tpu.cluster.state import (
+        ClusterClientConfigManager,
+        ClusterStateManager,
+        TokenClientProvider,
+    )
+
+    host = req.params.get("serverHost", "")
+    try:
+        port = int(req.params.get("serverPort", "0"))
+        timeout = req.params.get("requestTimeout")
+        timeout_ms = int(timeout) if timeout is not None else None
+    except ValueError:
+        return CommandResponse.of_failure("invalid port/timeout")
+    if not host or port <= 0:
+        return CommandResponse.of_failure("serverHost and serverPort required")
+    ClusterClientConfigManager.apply(host, port, timeout_ms)
+    # Re-point a live client: stop the old one so the next mode apply
+    # (or the current client mode) reconnects at the new address.
+    client = TokenClientProvider.get_client()
+    if client is not None and (
+        getattr(client, "host", None) != host or getattr(client, "port", None) != port
+    ):
+        try:
+            if hasattr(client, "stop"):
+                client.stop()
+        finally:
+            TokenClientProvider.clear()
+        if ClusterStateManager.is_client():
+            from sentinel_tpu.cluster.client import ClusterTokenClient
+
+            new_client = ClusterTokenClient(
+                host,
+                port,
+                request_timeout_sec=ClusterClientConfigManager.request_timeout_ms
+                / 1000.0,
+            )
+            TokenClientProvider.register(new_client)
+            new_client.start()
+    return CommandResponse.of_success("success")
+
+
 @command_mapping("metrics", "Prometheus text-format metrics (JMX exporter analog)")
 def prometheus_handler(req: CommandRequest) -> CommandResponse:
     from sentinel_tpu.transport.prometheus import render_metrics

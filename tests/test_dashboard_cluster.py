@@ -1,0 +1,250 @@
+"""Dashboard auth (session login) + cluster management plane.
+
+Reference parity targets: sentinel-dashboard auth/
+SimpleWebAuthServiceImpl.java:30 (login/session via the auth filter)
+and service/cluster/ClusterAssignServiceImpl.java:36 (assign one
+machine as token server, the rest as its clients; surface the server's
+per-flowId state).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster.flow_rules import (
+    cluster_flow_rule_manager,
+    cluster_server_config_manager,
+)
+from sentinel_tpu.cluster.server import SentinelTokenServer
+from sentinel_tpu.cluster.state import (
+    ClusterClientConfigManager,
+    ClusterStateManager,
+    EmbeddedClusterTokenServerProvider,
+    TokenClientProvider,
+)
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.dashboard import DashboardServer
+from sentinel_tpu.models.rules import ClusterFlowConfig
+from sentinel_tpu.transport.command_center import CommandCenter
+from sentinel_tpu.utils.clock import ManualClock
+
+
+def _req(dport, path, cookie=None, **params):
+    qs = urllib.parse.urlencode(params)
+    url = f"http://127.0.0.1:{dport}/{path}" + (f"?{qs}" if qs else "")
+    req = urllib.request.Request(url)
+    if cookie:
+        req.add_header("Cookie", cookie)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read().decode(), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), e.headers
+
+
+@pytest.fixture()
+def cluster_env():
+    cluster_flow_rule_manager.clear()
+    yield
+    cluster_flow_rule_manager.clear()
+    ClusterStateManager.stop()
+    TokenClientProvider.clear()
+    EmbeddedClusterTokenServerProvider.clear()
+    ClusterClientConfigManager.apply("", 0)
+
+
+class TestDashboardAuth:
+    def test_login_required_and_session_flow(self):
+        dash = DashboardServer(
+            port=0, fetch_interval_sec=999,
+            auth_username="sentinel", auth_password="s3cret",
+        ).start()
+        try:
+            # Protected API: 401 without a session.
+            code, body, _ = _req(dash.port, "apps")
+            assert code == 401
+            # Exempt paths stay open: console shell, version, registry.
+            assert _req(dash.port, "")[0] == 200
+            assert _req(dash.port, "version")[0] == 200
+            assert _req(
+                dash.port, "registry/machine", app="a", ip="1.2.3.4", port="80"
+            )[0] == 200
+            # Bad credentials rejected.
+            code, _, _ = _req(
+                dash.port, "auth/login", username="sentinel", password="wrong"
+            )
+            assert code == 401
+            # Good credentials: cookie, then the API opens up.
+            code, _, headers = _req(
+                dash.port, "auth/login", username="sentinel", password="s3cret"
+            )
+            assert code == 200
+            cookie = headers.get("Set-Cookie", "").split(";")[0]
+            assert cookie.startswith("sentinel_dashboard_session=")
+            code, body, _ = _req(dash.port, "apps", cookie=cookie)
+            assert code == 200
+            assert "a" in json.loads(body)
+            code, _, _ = _req(dash.port, "auth/check", cookie=cookie)
+            # Logout invalidates the session.
+            _req(dash.port, "auth/logout", cookie=cookie)
+            assert _req(dash.port, "apps", cookie=cookie)[0] == 401
+        finally:
+            dash.stop()
+
+    def test_auth_disabled_without_credentials(self):
+        dash = DashboardServer(port=0, fetch_interval_sec=999).start()
+        try:
+            assert _req(dash.port, "apps")[0] == 200
+            code, body, _ = _req(dash.port, "auth/check")
+            assert json.loads(body) == {"enabled": False, "loggedIn": True}
+        finally:
+            dash.stop()
+
+
+class TestClusterManagement:
+    def test_state_assign_and_server_stats(self, cluster_env, manual_clock, engine):
+        """Drive the whole plane over HTTP: register a machine, assign
+        it as token server, read back per-flowId qps/concurrency."""
+        # The machine: a command center backed by this process's engine,
+        # with an embedded (not yet started) token server available.
+        clock = ManualClock(0)
+        EmbeddedClusterTokenServerProvider.register(
+            SentinelTokenServer(port=0, service=DefaultTokenService(clock=clock))
+        )
+        cluster_server_config_manager.load_global_flow_config(
+            exceed_count=1.0, max_allowed_qps=30000.0
+        )
+        cluster_flow_rule_manager.load_rules(
+            "default",
+            [st.FlowRule(
+                "cres", count=5, cluster_mode=True,
+                cluster_config=ClusterFlowConfig(flow_id=7001),
+            )],
+        )
+        cc = CommandCenter(port=0).start()
+        dash = DashboardServer(port=0, fetch_interval_sec=999).start()
+        try:
+            _req(dash.port, "registry/machine", app="capp", ip="127.0.0.1",
+                 port=str(cc.port))
+            # Before assign: mode off.
+            code, body, _ = _req(dash.port, "cluster/state", app="capp")
+            assert code == 200
+            state = json.loads(body)
+            assert state[0]["mode"] == -1
+
+            code, body, _ = _req(
+                dash.port, "cluster/assign", app="capp",
+                server=f"127.0.0.1:{cc.port}",
+            )
+            assert code == 200 and json.loads(body)["code"] == 0
+
+            # Token traffic so the server has per-flow state.
+            svc = EmbeddedClusterTokenServerProvider.get_server().service
+            for _ in range(3):
+                assert svc.request_token(7001).ok
+
+            code, body, _ = _req(dash.port, "cluster/state", app="capp")
+            state = json.loads(body)
+            assert state[0]["mode"] == 1
+            stats = state[0]["server"]["stats"]
+            flows = {f["flowId"]: f for f in stats["flows"]}
+            assert flows[7001]["currentQps"] == pytest.approx(3.0)
+            assert flows[7001]["threshold"] == 5.0
+            assert state[0]["server"]["config"]["namespaces"] == ["default"]
+        finally:
+            cc.stop()
+            dash.stop()
+
+    def test_assign_unknown_machine_404(self, cluster_env):
+        dash = DashboardServer(port=0, fetch_interval_sec=999).start()
+        try:
+            code, body, _ = _req(
+                dash.port, "cluster/assign", app="x", server="9.9.9.9:1"
+            )
+            assert code == 404
+        finally:
+            dash.stop()
+
+    def test_rule_store_publishes_through_config_center(
+        self, manual_clock, engine
+    ):
+        """DynamicRuleProvider/Publisher mode end-to-end: the console
+        pushes rules into etcd; a machine following the same key via
+        EtcdDataSource picks them up through the watch and enforces
+        them — no direct machine push involved (reference:
+        dashboard/rule/DynamicRuleProvider.java:26)."""
+        from tests.test_etcd_source import FakeEtcd, _wait
+        from sentinel_tpu.dashboard import EtcdRuleStore
+        from sentinel_tpu.datasource.base import json_converter
+        from sentinel_tpu.datasource.etcd_source import EtcdDataSource
+
+        fake = FakeEtcd()
+        t = threading.Thread(target=fake.serve_forever, daemon=True)
+        t.start()
+        store = EtcdRuleStore(endpoint=f"http://127.0.0.1:{fake.port}")
+        dash = DashboardServer(
+            port=0, fetch_interval_sec=999, rule_store=store
+        ).start()
+        machine_src = EtcdDataSource(
+            json_converter(st.FlowRule),
+            store.key_for("sapp", "flow"),
+            endpoint=f"http://127.0.0.1:{fake.port}",
+            reconnect_interval_sec=0.05,
+        ).start()
+        try:
+            st.flow_rule_manager.register_property(machine_src.get_property())
+            data = json.dumps([{"resource": "sres", "count": 3}])
+            code, body, _ = _req(
+                dash.port, "rules", app="sapp", type="flow", data=data
+            )
+            assert code == 200 and json.loads(body)["code"] == 0
+            # The console reads back from the store.
+            code, body, _ = _req(dash.port, "rules", app="sapp", type="flow")
+            assert json.loads(body)[0]["count"] == 3
+            # The machine's watch delivered, and the engine enforces.
+            assert _wait(
+                lambda: any(
+                    r.count == 3 for r in (st.flow_rule_manager.get_rules() or [])
+                )
+            ), "published rules never reached the machine"
+            manual_clock.set_ms(500)
+            admitted = sum(1 for _ in range(6) if st.try_entry("sres") is not None)
+            assert admitted == 3
+        finally:
+            machine_src.close()
+            dash.stop()
+            fake.shutdown()
+            fake.server_close()
+
+    def test_client_modify_config_command(self, cluster_env, manual_clock, engine):
+        """cluster/client/modifyConfig updates the client config and
+        cluster/client/config reads it back (the dashboard assign
+        flow's client leg)."""
+        cc = CommandCenter(port=0).start()
+        try:
+            def get(path, **params):
+                qs = urllib.parse.urlencode(params)
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{cc.port}/{path}?{qs}", timeout=5
+                    ) as r:
+                        return r.read().decode()
+                except urllib.error.HTTPError as e:
+                    return e.read().decode()
+
+            assert get(
+                "cluster/client/modifyConfig",
+                serverHost="10.0.0.9", serverPort="18730",
+            ) == "success"
+            cfg = json.loads(get("cluster/client/config"))
+            assert cfg["serverHost"] == "10.0.0.9"
+            assert cfg["serverPort"] == 18730
+            # Bad input fails loudly, config unchanged.
+            out = get("cluster/client/modifyConfig", serverHost="", serverPort="x")
+            assert "success" not in out
+        finally:
+            cc.stop()
