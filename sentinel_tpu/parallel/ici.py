@@ -193,7 +193,7 @@ def _split_and_spend(
 
 
 def _demote_over_grant(
-    axis: str, stats_pre, stats_x, flow_dev, batch, flow_live: jax.Array
+    axis: str, stats_x, flow_dev, batch, flow_live: jax.Array
 ) -> jax.Array:
     """Cap each DEFAULT-behavior flow rule's admissions at the globally
     allocated grant; returns the per-entry keep mask.
@@ -218,14 +218,12 @@ def _demote_over_grant(
     acquire) against ``count − curThreadNum``, with the per-entry
     admission check ``prefix + acquire ≤ grant`` in both grades.
 
-    Exits are sharded, so each chip's post-exit view (``stats_x``)
-    carries only its own releases: the global THREAD capacity is
-    reconstructed as pre-stats plus the psum of per-chip exit deltas
-    (pass counts are exit-invariant, so ``stats_x`` serves directly).
-    Rows are per-slot in general (limitApp×strategy); budgets are
-    conserved per CHECK ROW with per-slot caps (see _split_and_spend),
-    matching the single-chip row-keyed rank math — exact for
-    origin-split topologies too.
+    ``stats_x`` is the GLOBAL post-exit view (the sharded step merges
+    exit deltas across the mesh before any admission), so thread
+    capacity reads directly from its gauge. Rows are per-slot in
+    general (limitApp×strategy); budgets are conserved per CHECK ROW
+    with per-slot caps (see _split_and_spend), matching the single-chip
+    row-keyed rank math — exact for origin-split topologies too.
     """
     from sentinel_tpu.metrics import metric_array as ma
     from sentinel_tpu.metrics.events import MetricEvent
@@ -260,9 +258,7 @@ def _demote_over_grant(
     # would be exact; per-rule min is conservative for origin-split
     # topologies and exact for the dominant single-row case. ---
     pass_sums = ma.window_sums(SECOND_CFG, stats_x.second, batch.now)[:, MetricEvent.PASS]
-    threads_global = stats_pre.threads + jax.lax.psum(
-        stats_x.threads - stats_pre.threads, axis
-    )
+    threads_global = stats_x.threads
     row_fc = jnp.clip(row_f, 0, r_rows - 1)
     base_qps_slot = jnp.floor(pass_sums[row_fc].astype(jnp.float32) / interval_sec)
     base_thr_slot = threads_global[row_fc].astype(jnp.float32)
@@ -470,13 +466,103 @@ def make_sharded_flush(
         from sentinel_tpu.metrics.nodes import materialize_matured
         from sentinel_tpu.rules.degrade_table import CLOSED as _CLOSED, OPEN as _OPEN
 
+        from sentinel_tpu.rules.degrade_table import trip_condition
+
+        def merge_breaker(base_ddyn, new_ddyn):
+            """Merge per-chip breaker windows/state against a replicated
+            base. State: transitions happen on the one chip whose shard
+            carried the triggering op, so "any chip that changed wins" —
+            a plain pmax would discard HALF_OPEN→CLOSED (0 < 2) and
+            HALF_OPEN→OPEN (1 < 2), wedging the breaker forever; if
+            several chips transitioned differently in one flush, the max
+            changed state wins (OPEN over CLOSED — pessimistic, like the
+            reference resolving concurrent probe outcomes through its
+            CAS, AbstractCircuitBreaker.java:40-150). Windows merge
+            rollover-aware like merge_window_across. Finally, a breaker
+            whose MERGED window crosses the threshold may have tripped
+            on no single chip (errors spread 1-per-chip): re-evaluate
+            CLOSED→OPEN on the merged counts, retry deadline anchored
+            at flush time (later than the crossing completion's ts by at
+            most one flush interval)."""
+            changed = new_ddyn.state != base_ddyn.state
+            cand = jnp.where(changed, new_ddyn.state, jnp.int32(-1))
+            best = jax.lax.pmax(cand, axis)
+            merged_state = jnp.where(best >= 0, best, base_ddyn.state)
+            g_dws = jax.lax.pmax(new_ddyn.ws, axis)
+            d_old_cur = base_ddyn.ws == g_dws
+            d_new_cur = new_ddyn.ws == g_dws
+            base_bad = jnp.where(d_old_cur, base_ddyn.bad, 0)
+            base_total = jnp.where(d_old_cur, base_ddyn.total, 0)
+            out = type(base_ddyn)(
+                state=merged_state,
+                next_retry=jax.lax.pmax(new_ddyn.next_retry, axis),
+                bad=base_bad
+                + jax.lax.psum(
+                    jnp.where(d_new_cur, new_ddyn.bad - base_bad, 0), axis
+                ),
+                total=base_total
+                + jax.lax.psum(
+                    jnp.where(d_new_cur, new_ddyn.total - base_total, 0), axis
+                ),
+                ws=g_dws,
+            )
+            trip = trip_condition(
+                ddev.grade, ddev.threshold, ddev.slow_ratio,
+                out.bad.astype(jnp.float32),
+                out.total.astype(jnp.float32),
+            )
+            cross = (
+                (out.state == _CLOSED)
+                & (out.total >= ddev.min_request)
+                & trip
+            )
+            return out._replace(
+                state=jnp.where(cross, _OPEN, out.state),
+                next_retry=jnp.where(
+                    cross, batch.now + ddev.retry_ms, out.next_retry
+                ),
+            )
+
         # Matured borrows fold into the window FIRST — deterministic on
         # replicated state, so it must happen before per-shard writes
         # diverge and must be the merge base (otherwise every chip's
         # identical materialisation would be summed once per chip).
         stats = materialize_matured(stats, batch.now)
-        # Exits once; both admission passes see the post-exit stats.
-        stats_x, ddyn_x = apply_exit_phase(stats, ddev, ddyn, batch)
+        # Exits once, then the post-exit view is made GLOBAL before any
+        # admission: within one flush exits apply before entry checks
+        # on the WHOLE mesh (flush.py "Intra-batch sequencing"), so a
+        # thread release / breaker completion carried by one chip's
+        # shard is visible to every chip's checks — without this an
+        # entry landing on a different chip than its same-flush exit
+        # was blocked against a stale gauge (caught by the batched mesh
+        # differential, round 4). Window tensors are additive: local
+        # apply + rollover-aware merge is exact.
+        stats_x, _ = apply_exit_phase(stats, ddev, ddyn, batch)
+        stats_x = merge_stats_across(stats, stats_x, axis)
+        # Breaker completions are a serializing state machine (the trip
+        # latches at the FIRST prefix crossing the threshold), so a
+        # per-chip run + endpoint merge loses trips whose crossing
+        # prefix spans chips (e.g. errors front-loaded in ts order but
+        # sharded apart: the merged endpoint ratio can sit back under
+        # the threshold). Same treatment as the shaping/param scans:
+        # every chip runs the completion machine once on the GLOBALLY
+        # gathered completion set — identical replicated result, exact
+        # global (ts, chip, arrival) order, nothing to merge.
+        from sentinel_tpu.rules.degrade_table import breaker_on_exits
+
+        def gather_flat(x):
+            g = jax.lax.all_gather(x, axis)  # [nch, M, ...]
+            return g.reshape((-1,) + x.shape[1:])
+
+        ddyn_x = breaker_on_exits(
+            ddev,
+            ddyn,
+            gather_flat(batch.x_dgid),
+            gather_flat(batch.x_ts),
+            gather_flat(batch.x_rt),
+            gather_flat(batch.x_err),
+            gather_flat(batch.x_valid),
+        )
 
         # ---- global serializing scans (shaping pacers, hot params) ----
         # Upstream liveness (auth + system) for this chip's entries —
@@ -514,7 +600,7 @@ def make_sharded_flush(
         # merge like window counters) and budget them separately against
         # the global borrow allowance.
         budgeted = r1.flow_live & ~r1.occupied
-        keep = _demote_over_grant(axis, stats, stats_x, flow_dev, batch, budgeted)
+        keep = _demote_over_grant(axis, stats_x, flow_dev, batch, budgeted)
         keep_occ = _demote_over_borrow(axis, stats, flow_dev, batch, r1.occ_slot)
         # Pass 2 borrows only what pass 1 granted within the global
         # budget: demoted borrowers lose prio (they fall to plain BLOCK
@@ -577,64 +663,11 @@ def make_sharded_flush(
             new_pdyn = new_pdyn_scan._replace(
                 threads=new_pdyn_scan.threads.at[inc_rows].add(1, mode="drop")
             )
-        merged = merge_stats_across(stats, new_stats, axis)
-        # Breaker state machine: transitions happen on the one chip
-        # whose shard carried the probe's entry/exit, so "any chip that
-        # changed wins" — a plain pmax would discard HALF_OPEN→CLOSED
-        # (0 < 2) and HALF_OPEN→OPEN (1 < 2), wedging the breaker
-        # forever. If several chips transitioned differently in one
-        # flush, the max changed state wins (OPEN over CLOSED —
-        # pessimistic, like the reference resolving concurrent probe
-        # outcomes through its CAS, AbstractCircuitBreaker.java:40-150).
-        changed = new_ddyn.state != ddyn.state
-        cand = jnp.where(changed, new_ddyn.state, jnp.int32(-1))
-        best = jax.lax.pmax(cand, axis)
-        merged_state = jnp.where(best >= 0, best, ddyn.state)
-        # Window counters merge rollover-aware, like merge_window_across:
-        # chips that rolled a rule's window to a newer start report
-        # counts of the NEW window, so a plain old+psum(new−old) would
-        # go negative whenever two chips roll in one flush. Only chips
-        # whose final window matches the merged (max) start contribute,
-        # against the shared base.
-        g_dws = jax.lax.pmax(new_ddyn.ws, axis)
-        d_old_cur = ddyn.ws == g_dws
-        d_new_cur = new_ddyn.ws == g_dws
-        base_bad = jnp.where(d_old_cur, ddyn.bad, 0)
-        base_total = jnp.where(d_old_cur, ddyn.total, 0)
-        merged_ddyn = type(ddyn)(
-            state=merged_state,
-            next_retry=jax.lax.pmax(new_ddyn.next_retry, axis),
-            bad=base_bad
-            + jax.lax.psum(jnp.where(d_new_cur, new_ddyn.bad - base_bad, 0), axis),
-            total=base_total
-            + jax.lax.psum(jnp.where(d_new_cur, new_ddyn.total - base_total, 0), axis),
-            ws=g_dws,
-        )
-        # Cross-chip trip: each chip evaluated thresholds on its own
-        # shard of completions, so a breaker whose merged window crosses
-        # the threshold may have tripped on NO single chip (e.g. 8
-        # errors spread 1-per-chip with minRequestAmount=5). Re-evaluate
-        # the CLOSED->OPEN condition on the merged counts; the retry
-        # deadline anchors at flush time rather than the crossing
-        # completion's ts — later by at most one flush interval.
-        from sentinel_tpu.rules.degrade_table import trip_condition
-
-        trip = trip_condition(
-            ddev.grade, ddev.threshold, ddev.slow_ratio,
-            merged_ddyn.bad.astype(jnp.float32),
-            merged_ddyn.total.astype(jnp.float32),
-        )
-        cross = (
-            (merged_ddyn.state == _CLOSED)
-            & (merged_ddyn.total >= ddev.min_request)
-            & trip
-        )
-        merged_ddyn = merged_ddyn._replace(
-            state=jnp.where(cross, _OPEN, merged_ddyn.state),
-            next_retry=jnp.where(
-                cross, batch.now + ddev.retry_ms, merged_ddyn.next_retry
-            ),
-        )
+        # Bases are the GLOBAL post-exit views (replicated identical on
+        # every chip): the merges then sum exactly the per-chip entry
+        # deltas, with the exit deltas counted once inside the base.
+        merged = merge_stats_across(stats_x, new_stats, axis)
+        merged_ddyn = merge_breaker(ddyn_x, new_ddyn)
         return merged, new_fdyn, merged_ddyn, new_pdyn, result
 
     # Shaping/param item batches are replicated (P() pytree prefix):

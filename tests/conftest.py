@@ -5,23 +5,35 @@ the multi-chip sharding paths compile and run without TPU hardware —
 the in-process analog of the reference's strategy of testing the
 cluster token service directly in-JVM (SURVEY.md §4)."""
 
+import os
+
+# Serialize XLA:CPU's LLVM codegen (default split 32 compiles modules on
+# a thread pool): repeated pjit compiles in one long process segfaulted
+# inside backend_compile_and_load / the executable serializer, which
+# smells like concurrent-codegen state corruption — and a 1-core box
+# gains nothing from parallel codegen anyway. Must be set before the
+# first backend use.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_cpu_parallel_codegen_split_count=1"
+).strip()
+
 from sentinel_tpu.utils.backend import force_cpu
 
 force_cpu(8)
 
+import gc  # noqa: E402
+
 import jax  # noqa: E402
-
-# Long single-process runs accumulate XLA:CPU/LLVM JIT state until the
-# compiler itself segfaults (observed deep into the slow tier: crash in
-# backend_compile_and_load after ~45 min of compiles; any single test
-# passes in isolation). Two-part mitigation: persist compiled
-# executables on disk so recompiles skip LLVM entirely, and drop the
-# in-memory executable caches periodically to bound JIT memory.
-jax.config.update("jax_compilation_cache_dir", "/tmp/sentinel_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
 import pytest  # noqa: E402
 
+# Long single-process runs accumulate XLA:CPU/LLVM JIT state until the
+# native compiler eventually segfaults (observed twice deep into the
+# slow tier: once in backend_compile_and_load after ~45 min of
+# compiles, once in the persistent-cache executable serializer; any
+# single test passes in isolation). Mitigation: periodically drop the
+# in-memory executable caches and collect, bounding resident JIT
+# state. The persistent disk cache is deliberately NOT enabled — its
+# serialize path was itself a crash site.
 _TESTS_SINCE_CLEAR = {"n": 0}
 
 
@@ -29,8 +41,9 @@ _TESTS_SINCE_CLEAR = {"n": 0}
 def _bound_jit_state():
     yield
     _TESTS_SINCE_CLEAR["n"] += 1
-    if _TESTS_SINCE_CLEAR["n"] % 25 == 0:
+    if _TESTS_SINCE_CLEAR["n"] % 15 == 0:
         jax.clear_caches()
+        gc.collect()
 
 
 @pytest.fixture()

@@ -1,0 +1,73 @@
+"""Subprocess worker for the batched differential streams.
+
+The batched differential is the suite's heaviest compile generator,
+and long single-process runs on this toolchain eventually segfault
+inside XLA:CPU's LLVM compile (see conftest.py) — reliably while
+compiling for these streams when they run late in the suite, while
+every stream passes in a fresh process. So the pytest entry points
+(test_differential_batched.py) spawn this worker: one fresh process
+per engine mode, with the XLA state horizon all to itself.
+
+Usage: python -m tests.diffbatch_worker single|mesh
+Exit 0 = every seed's stream matched the oracle exactly.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> None:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "single"
+
+    import os
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_parallel_codegen_split_count=1"
+    ).strip()
+    from sentinel_tpu.utils.backend import force_cpu
+
+    force_cpu(8)
+
+    import numpy as np
+
+    from sentinel_tpu.core import api
+    from sentinel_tpu.utils.clock import ManualClock, set_default_clock
+    from tests.test_differential import _load_rules
+    from tests.test_differential_batched import _mk_models, _run_batched_stream
+
+    if mode == "single":
+        cases = [(100 + s, ["qps", "thread", "rl", "warmup", "wurl", "pbucket",
+                            "pthrottle"], 60, False, f"seed={s}") for s in range(5)]
+    elif mode == "mesh":
+        # Warm-up kinds excluded: mesh warm-up passQps not seeing
+        # same-flush co-row charges is a documented one-sided deviation.
+        cases = [(200 + s, ["qps", "thread", "rl", "pbucket", "pthrottle"],
+                  30, True, f"mesh seed={s}") for s in range(2)]
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+
+    for seed, kinds, steps, mesh, ctx in cases:
+        clock = ManualClock(0)
+        prev = set_default_clock(clock)
+        try:
+            api.reset(clock=clock)
+            engine = api.get_engine()
+            if mesh:
+                engine.enable_mesh(8)
+            rng = np.random.default_rng(seed)
+            kinds = list(kinds)
+            rng.shuffle(kinds)
+            models = _mk_models(kinds, rng)
+            _load_rules(models)
+            clock.set_ms(1000)
+            _run_batched_stream(engine, models, rng, steps=steps, ctx=ctx)
+            print(f"[diffbatch_worker] {ctx}: OK", flush=True)
+        finally:
+            set_default_clock(prev)
+            api.reset()
+
+
+if __name__ == "__main__":
+    main()

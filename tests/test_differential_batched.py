@@ -3,11 +3,14 @@
 The sequential suite (test_differential.py) removes intra-batch
 ordering from the picture; production mode is batched. This suite
 replays the same kind of random streams grouped into random-size
-flushes (1-64 ops per flush) and asserts EXACT verdict equality
-against the sequential oracle processing the flush in the engine's
-documented intra-batch order:
+flushes and asserts EXACT verdict equality against the sequential
+oracle processing the flush in the engine's documented intra-batch
+order:
 
-* exits apply before entry checks (flush.py phase 1 vs phase 2);
+* exits apply before entry checks (flush.py phase 1 vs phase 2) — on
+  the mesh this holds across chips: the sharded step merges the
+  post-exit stats globally and runs the breaker completion machine on
+  the all-gathered completion set before any admission;
 * entries touching a node are ordered by (ts, arrival index) — here
   all ops of one flush share a timestamp (a flush spans a few ms in
   production), so arrival order decides;
@@ -16,28 +19,39 @@ documented intra-batch order:
 
 The streams deliberately contain NO documented-deviation pattern: no
 RELATE/cross-resource rules, no multi-origin split, no prioritized
-(occupy) entries whose intra-row borrow charge is conservative, and
-uniform acquire=1. Under those conditions any divergence — in either
-direction — is a real intra-batch bug, which is exactly what this
-suite exists to catch (a non-conservative batching bug would pass the
-sequential suite untouched).
+(occupy) entries, and uniform acquire=1. Under those conditions any
+divergence — in either direction — is a real intra-batch bug, which
+is exactly what this suite exists to catch (it caught two on the mesh
+in round 4: same-flush cross-chip thread releases invisible to
+admission, and breaker trips whose crossing prefix spanned chips).
+
+Execution: the streams run in fresh SUBPROCESSES (tests/
+diffbatch_worker.py) because they are the suite's heaviest compile
+generators and the toolchain segfaults on accumulated XLA:CPU LLVM
+state (conftest.py) — a fresh process per engine mode keeps them well
+under the horizon while the oracle logic stays importable here.
 
 Reference analog: the partial-integration tests exercising the real
 chain (sentinel-core/src/test/java/com/alibaba/csp/sentinel/slots/
 block/flow/FlowPartialIntegrationTest.java).
 """
 
-import dataclasses
+import os
+import subprocess
+import sys
 
-import numpy as np
 import pytest
 
 pytestmark = pytest.mark.slow
 
-from tests.test_differential import _Model, _load_rules
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _mk_models(kinds, rng):
+    import dataclasses
+
+    from tests.test_differential import _Model
+
     models = {}
     for kind in kinds:
         m = _Model(kind, rng)
@@ -51,8 +65,8 @@ def _mk_models(kinds, rng):
 
 
 def _run_batched_stream(engine, models, rng, steps, ctx):
-    """Random flushes of 1-64 buffered ops; oracle replays each flush
-    in the engine's documented order (exits first, then entries by
+    """Random flushes of buffered ops; the oracle replays each flush in
+    the engine's documented order (exits first, then entries by
     arrival) and every verdict + wait must match exactly."""
     resources = list(models)
     t = 1000
@@ -68,11 +82,11 @@ def _run_batched_stream(engine, models, rng, steps, ctx):
         # bucket is reachable, but the number of DISTINCT compiled
         # shapes stays bounded — with fully random 1..64 sizes the
         # (entries, exits, shaping, param) pad-bucket product forces
-        # dozens of one-off XLA compiles and the test becomes
-        # compile-bound (10+ min/seed on a small host).
+        # dozens of one-off XLA compiles and the stream becomes
+        # compile-bound.
         flush_n = int(rng.choice([1, 6, 14, 30, 62]))
         entries = []  # (res, op, value)
-        exits = []  # (res, op, rt, err)
+        exits = []  # (res, rt, err)
         for _ in range(flush_n):
             if rng.random() < 0.72 or not open_entries:
                 res = resources[int(rng.integers(0, len(resources)))]
@@ -127,28 +141,23 @@ def _run_batched_stream(engine, models, rng, steps, ctx):
         assert stats["cur_thread_num"] == m.node.cur_thread_num, res
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
-def test_random_batched_stream_matches_oracle(seed, manual_clock, engine):
-    rng = np.random.default_rng(100 + seed)
-    kinds = ["qps", "thread", "rl", "warmup", "wurl", "pbucket", "pthrottle"]
-    rng.shuffle(kinds)
-    models = _mk_models(kinds, rng)
-    _load_rules(models)
-    manual_clock.set_ms(1000)
-    _run_batched_stream(engine, models, rng, steps=60, ctx=f"seed={seed}")
+def _run_worker(mode: str, timeout_s: float) -> None:
+    r = subprocess.run(
+        [sys.executable, "-m", "tests.diffbatch_worker", mode],
+        cwd=_REPO,
+        capture_output=True,
+        text=True,
+        timeout=timeout_s,
+    )
+    tail = (r.stdout[-4000:] + "\n" + r.stderr[-4000:]).strip()
+    assert r.returncode == 0, f"worker mode={mode} rc={r.returncode}:\n{tail}"
 
 
-@pytest.mark.parametrize("seed", [0, 1])
-def test_random_batched_stream_matches_oracle_on_mesh(seed, manual_clock, engine):
-    """The same batched harness on the 8-device mesh. Warm-up kinds are
-    excluded: mesh warm-up passQps not seeing same-flush co-row charges
-    is a documented one-sided deviation (README 'Documented
-    deviations'); everything else must be exact."""
-    engine.enable_mesh(8)
-    rng = np.random.default_rng(200 + seed)
-    kinds = ["qps", "thread", "rl", "pbucket", "pthrottle"]
-    rng.shuffle(kinds)
-    models = _mk_models(kinds, rng)
-    _load_rules(models)
-    manual_clock.set_ms(1000)
-    _run_batched_stream(engine, models, rng, steps=30, ctx=f"mesh seed={seed}")
+def test_random_batched_streams_match_oracle():
+    """Five random single-chip streams, fresh process."""
+    _run_worker("single", timeout_s=1800)
+
+
+def test_random_batched_streams_match_oracle_on_mesh():
+    """Two random mesh streams, fresh process."""
+    _run_worker("mesh", timeout_s=1800)
