@@ -42,22 +42,15 @@ class ClusterStateManager:
                 return True
             cls._mode = cls.CLUSTER_CLIENT
             client = TokenClientProvider.get_client()
-            if client is None and ClusterClientConfigManager.server_host:
-                # No registered client but an assigned server address
-                # (cluster/client/modifyConfig — the dashboard assign
-                # flow): create one, like the reference's
+            if client is None:
+                # No registered client but maybe an assigned server
+                # address (cluster/client/modifyConfig — the dashboard
+                # assign flow): create one, like the reference's
                 # DefaultClusterTokenClient picking up
                 # ClusterClientConfigManager on mode switch.
-                from sentinel_tpu.cluster.client import ClusterTokenClient
-
-                client = ClusterTokenClient(
-                    ClusterClientConfigManager.server_host,
-                    ClusterClientConfigManager.server_port,
-                    request_timeout_sec=(
-                        ClusterClientConfigManager.request_timeout_ms / 1000.0
-                    ),
-                )
-                TokenClientProvider.register(client)
+                client = ClusterClientConfigManager.build_client()
+                if client is not None:
+                    TokenClientProvider.register(client)
             if client is not None and hasattr(client, "start"):
                 try:
                     client.start()
@@ -121,6 +114,21 @@ class ClusterClientConfigManager:
                 "serverPort": cls.server_port,
                 "requestTimeout": cls.request_timeout_ms,
             }
+
+    @classmethod
+    def build_client(cls):
+        """Construct a ClusterTokenClient from the current config, all
+        fields read under the lock (a concurrent apply() must not yield
+        a torn host-from-new/port-from-old pair). Returns None when no
+        server address is configured."""
+        from sentinel_tpu.cluster.client import ClusterTokenClient
+
+        with cls._lock:
+            host, port = cls.server_host, cls.server_port
+            timeout_s = cls.request_timeout_ms / 1000.0
+        if not host or port <= 0:
+            return None
+        return ClusterTokenClient(host, port, request_timeout_sec=timeout_s)
 
 
 class TokenClientProvider:

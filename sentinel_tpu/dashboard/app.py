@@ -250,7 +250,10 @@ class AuthService:
 
     @property
     def enabled(self) -> bool:
-        return bool(self.username)
+        # BOTH must be set: a username without a password would raise
+        # an auth wall that accepts a blank password (the reference
+        # disables auth only when the credentials are absent).
+        return bool(self.username) and bool(self.password)
 
     def login(self, username: str, password: str) -> Optional[str]:
         import hmac
@@ -447,17 +450,29 @@ class DashboardServer:
             if server_m is None:
                 return 404, json.dumps({"code": -1, "msg": f"unknown machine {target}"})
             ok = self.client.api_call(server_m, "setClusterMode", {"mode": "1"})
+            # The ACTUALLY bound token port (cluster/server/stats reads
+            # it off the live server object) — the static config port
+            # diverges whenever the server bound an ephemeral port.
             token_port = (
+                self.client.api_json(server_m, "cluster/server/stats") or {}
+            ).get("port") or (
                 self.client.api_json(server_m, "cluster/server/config") or {}
             ).get("port")
-            failed = [] if ok else [target]
+            if not ok or not token_port:
+                # Do NOT demote the other machines to clients of a
+                # server that never started — that would degrade every
+                # machine's flow checks in one call.
+                return 200, json.dumps(
+                    {"code": -1, "server": target, "failed": [target]}
+                )
+            failed = []
             for m in machines:
                 if m is server_m:
                     continue
                 good = self.client.api_call(
                     m,
                     "cluster/client/modifyConfig",
-                    {"serverHost": server_m.ip, "serverPort": str(token_port or 0)},
+                    {"serverHost": server_m.ip, "serverPort": str(token_port)},
                 ) and self.client.api_call(m, "setClusterMode", {"mode": "0"})
                 if not good:
                     failed.append(f"{m.ip}:{m.port}")

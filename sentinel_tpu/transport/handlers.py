@@ -339,16 +339,10 @@ def cluster_client_modify_config_handler(req: CommandRequest) -> CommandResponse
         finally:
             TokenClientProvider.clear()
         if ClusterStateManager.is_client():
-            from sentinel_tpu.cluster.client import ClusterTokenClient
-
-            new_client = ClusterTokenClient(
-                host,
-                port,
-                request_timeout_sec=ClusterClientConfigManager.request_timeout_ms
-                / 1000.0,
-            )
-            TokenClientProvider.register(new_client)
-            new_client.start()
+            new_client = ClusterClientConfigManager.build_client()
+            if new_client is not None:
+                TokenClientProvider.register(new_client)
+                new_client.start()
     return CommandResponse.of_success("success")
 
 
