@@ -1,0 +1,95 @@
+"""Cluster management through the authenticated console: a machine
+with an embedded token server registers with the dashboard, the
+operator logs in, assigns it as the app's token server over HTTP, and
+reads back per-flowId state — the sentinel-dashboard cluster screen
+flow (auth/SimpleWebAuthServiceImpl + ClusterAssignServiceImpl) end to
+end.
+
+Login: sentinel / sentinel  (http://127.0.0.1:18722/).
+"""
+
+import _bootstrap  # noqa: F401
+
+import json
+import os
+import time
+import urllib.request
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster.flow_rules import (
+    cluster_flow_rule_manager,
+    cluster_server_config_manager,
+)
+from sentinel_tpu.cluster.server import SentinelTokenServer
+from sentinel_tpu.cluster.state import EmbeddedClusterTokenServerProvider
+from sentinel_tpu.cluster.token_service import DefaultTokenService
+from sentinel_tpu.dashboard import DashboardServer
+from sentinel_tpu.models.rules import ClusterFlowConfig
+from sentinel_tpu.transport.command_center import CommandCenter
+
+_port = int(os.environ.get("SENTINEL_DEMO_PORT", "18721"))
+duration = float(os.environ.get("SENTINEL_DEMO_DURATION", "60"))
+
+# The machine: command API + an embeddable token server + one
+# cluster-mode flow rule.
+EmbeddedClusterTokenServerProvider.register(
+    SentinelTokenServer(port=0, service=DefaultTokenService())
+)
+cluster_server_config_manager.load_global_flow_config(
+    exceed_count=1.0, max_allowed_qps=30000.0
+)
+cluster_flow_rule_manager.load_rules(
+    "default",
+    [st.FlowRule("pay", count=100, cluster_mode=True,
+                 cluster_config=ClusterFlowConfig(flow_id=42))],
+)
+center = CommandCenter(port=_port).start()
+
+# The console, with session auth on.
+dashboard = DashboardServer(
+    port=_port + 1 if _port else 0,
+    fetch_interval_sec=0.5,
+    auth_username="sentinel",
+    auth_password="sentinel",
+).start()
+
+# Register the machine, then do what the console's buttons do: log in,
+# assign this machine as the token server, read the cluster state.
+base = f"http://127.0.0.1:{dashboard.port}"
+urllib.request.urlopen(
+    f"{base}/registry/machine?app=demo&ip=127.0.0.1&port={center.port}",
+    timeout=5,
+)
+import http.cookiejar
+
+jar = http.cookiejar.CookieJar()
+opener = urllib.request.build_opener(urllib.request.HTTPCookieProcessor(jar))
+opener.open(
+    urllib.request.Request(
+        f"{base}/auth/login", data=b"username=sentinel&password=sentinel",
+        method="POST",
+    ),
+    timeout=5,
+)
+assign = json.loads(
+    opener.open(
+        f"{base}/cluster/assign?app=demo&server=127.0.0.1:{center.port}",
+        timeout=10,
+    ).read()
+)
+print(f"assign       : {assign}")
+
+# Token traffic so the server has per-flow state to show.
+svc = EmbeddedClusterTokenServerProvider.get_server().service
+for _ in range(7):
+    svc.request_token(42)
+
+state = json.loads(
+    opener.open(f"{base}/cluster/state?app=demo", timeout=10).read()
+)
+print(f"cluster state: {json.dumps(state, indent=2)[:400]}")
+print(f"web console  : {base}/  (login sentinel/sentinel)")
+
+end = time.time() + duration
+while time.time() < end:
+    time.sleep(0.25)
