@@ -68,6 +68,67 @@ def main() -> None:
     def report(name: str, dt: float) -> None:
         results[name] = round(dt * 1e3, 4)
         print(f"[k2probe] {name}: {dt * 1e3:.3f} ms", file=sys.stderr, flush=True)
+        # Incremental partial line after every stage: tunnel liveness
+        # windows can close mid-run (round 4: a wedge ate two full runs
+        # that had produced zero output).
+        print(json.dumps(results), file=sys.stderr, flush=True)
+
+    # --- the headline cliff FIRST: full flags-off kernel + admission ---
+    # (most valuable number if the tunnel wedges mid-run)
+    dev = FlowTableDevice(
+        grade=jnp.ones(nr, dtype=jnp.int32),
+        count=jnp.full(nr, 20.0, dtype=jnp.float32),
+        behavior=jnp.zeros(nr, dtype=jnp.int32),
+        max_queueing_time_ms=jnp.zeros(nr, dtype=jnp.int32),
+        cost1_ms=jnp.full(nr, 50, dtype=jnp.int32),
+        warmup_warning_token=jnp.zeros(nr, dtype=jnp.int32),
+        warmup_max_token=jnp.zeros(nr, dtype=jnp.int32),
+        warmup_slope=jnp.zeros(nr, dtype=jnp.float32),
+        warmup_refill_threshold=jnp.zeros(nr, dtype=jnp.int32),
+    )
+    dindex = DegradeIndex([])
+    inf = float("inf")
+    sysdev = SystemDevice(
+        qps=jnp.float32(inf), max_thread=jnp.float32(inf), max_rt=jnp.float32(inf),
+        load_threshold=jnp.float32(-1.0), cpu_threshold=jnp.float32(-1.0),
+        cur_load=jnp.float32(-1.0), cur_cpu=jnp.float32(-1.0),
+    )
+    flags = dict(
+        with_occupy=False, with_system=False, with_degrade=False, with_exits=False
+    )
+    stats = make_stats(nr)
+    for k in (1, 2):
+        batch = _example_batch(n, nr, nr, k)
+        st_k = make_stats(nr)
+        dyn_k = FlowRuleDynState(
+            latest_passed_time=jnp.full(nr, -(10**9), dtype=jnp.int32),
+            stored_tokens=jnp.zeros(nr, dtype=jnp.float32),
+            last_filled_time=jnp.full(nr, -(10**9), dtype=jnp.int32),
+        )
+        ddyn_k, pdyn_k = dindex.make_dyn_state(), make_param_state(8)
+        t0 = time.perf_counter()
+        out = flush_step_jit(
+            st_k, dev, dyn_k, dindex.device, ddyn_k, pdyn_k, sysdev, batch, **flags
+        )
+        st_k, dyn_k, ddyn_k, pdyn_k, res = out
+        jax.block_until_ready(res.admitted)
+        print(f"[k2probe] flush_k{k} compile+first {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            st_k, dyn_k, ddyn_k, pdyn_k, res = flush_step_jit(
+                st_k, dev, dyn_k, dindex.device, ddyn_k, pdyn_k, sysdev, batch,
+                **flags
+            )
+        jax.block_until_ready(res.admitted)
+        report(f"flush_k{k}", (time.perf_counter() - t0) / args.iters)
+
+        admis = jax.jit(
+            lambda stats, dev, batch: F.flow_admission(
+                stats, dev, batch, with_occupy=False
+            )
+        )
+        report(f"admis_k{k}", _time(admis, stats, dev, batch, iters=args.iters))
 
     # --- isolated sorts over the flat slot array -----------------------
     for k in (1, 2):
@@ -125,66 +186,6 @@ def main() -> None:
         except Exception as exc:  # signature drift — report, keep going
             print(f"[k2probe] stats_k{k} skipped: {exc}", file=sys.stderr)
             break
-
-    # --- flow_admission alone, then the full flags-off kernel ----------
-    dev = FlowTableDevice(
-        grade=jnp.ones(nr, dtype=jnp.int32),
-        count=jnp.full(nr, 20.0, dtype=jnp.float32),
-        behavior=jnp.zeros(nr, dtype=jnp.int32),
-        max_queueing_time_ms=jnp.zeros(nr, dtype=jnp.int32),
-        cost1_ms=jnp.full(nr, 50, dtype=jnp.int32),
-        warmup_warning_token=jnp.zeros(nr, dtype=jnp.int32),
-        warmup_max_token=jnp.zeros(nr, dtype=jnp.int32),
-        warmup_slope=jnp.zeros(nr, dtype=jnp.float32),
-        warmup_refill_threshold=jnp.zeros(nr, dtype=jnp.int32),
-    )
-    dyn = FlowRuleDynState(
-        latest_passed_time=jnp.full(nr, -(10**9), dtype=jnp.int32),
-        stored_tokens=jnp.zeros(nr, dtype=jnp.float32),
-        last_filled_time=jnp.full(nr, -(10**9), dtype=jnp.int32),
-    )
-    dindex = DegradeIndex([])
-    pdyn = make_param_state(8)
-    inf = float("inf")
-    sysdev = SystemDevice(
-        qps=jnp.float32(inf), max_thread=jnp.float32(inf), max_rt=jnp.float32(inf),
-        load_threshold=jnp.float32(-1.0), cpu_threshold=jnp.float32(-1.0),
-        cur_load=jnp.float32(-1.0), cur_cpu=jnp.float32(-1.0),
-    )
-    flags = dict(
-        with_occupy=False, with_system=False, with_degrade=False, with_exits=False
-    )
-    for k in (1, 2):
-        batch = _example_batch(n, nr, nr, k)
-        admis = jax.jit(
-            lambda stats, dev, batch: F.flow_admission(
-                stats, dev, batch, with_occupy=False
-            )
-        )
-        report(f"admis_k{k}", _time(admis, stats, dev, batch, iters=args.iters))
-
-        # flush_step_jit donates its dyn state: thread it through, fresh
-        # buffers per k.
-        st_k = make_stats(nr)
-        dyn_k = FlowRuleDynState(
-            latest_passed_time=jnp.full(nr, -(10**9), dtype=jnp.int32),
-            stored_tokens=jnp.zeros(nr, dtype=jnp.float32),
-            last_filled_time=jnp.full(nr, -(10**9), dtype=jnp.int32),
-        )
-        ddyn_k, pdyn_k = dindex.make_dyn_state(), make_param_state(8)
-        out = flush_step_jit(
-            st_k, dev, dyn_k, dindex.device, ddyn_k, pdyn_k, sysdev, batch, **flags
-        )
-        st_k, dyn_k, ddyn_k, pdyn_k, res = out
-        jax.block_until_ready(res.admitted)
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            st_k, dyn_k, ddyn_k, pdyn_k, res = flush_step_jit(
-                st_k, dev, dyn_k, dindex.device, ddyn_k, pdyn_k, sysdev, batch,
-                **flags
-            )
-        jax.block_until_ready(res.admitted)
-        report(f"flush_k{k}", (time.perf_counter() - t0) / args.iters)
 
     print(json.dumps(results), flush=True)
 

@@ -1,0 +1,73 @@
+#!/bin/bash
+# TPU evidence capture watcher (round 4).
+#
+# The axon tunnel wedges for hours at a time (rounds 3-4) with short
+# liveness windows in between; measurements must fire the moment a
+# window opens, not when a human notices. Loop: cheap liveness probe
+# every ~4 min; on success immediately run the full pipeline:
+#
+#   1. bench.py --platform tpu  (headline + mixed + engine stages,
+#      incremental BENCH_partial.jsonl)
+#   2. tools/k2probe.py         (k=2 cliff bisect, incremental stderr)
+#
+# Artifacts land in $OUT (default /tmp/tpucap); the session commits
+# them into the repo after review. Exits once a bench run reports
+# platform=tpu AND the k2probe completed, else keeps watching.
+set -u
+cd /root/repo
+OUT=${OUT:-/tmp/tpucap}
+mkdir -p "$OUT"
+LOG="$OUT/watch.log"
+say() { echo "$(date +%F' '%T) $*" >> "$LOG"; }
+
+probe() {
+  # Success requires the TPU backend specifically: a silent CPU-fallback
+  # init would otherwise report ALIVE every cycle and burn the capture
+  # timeouts on CPU-only work forever.
+  timeout 90 python - <<'EOF' >> "$LOG" 2>&1
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print("probe ok:", jax.default_backend())
+raise SystemExit(0 if jax.default_backend() == "tpu" else 1)
+EOF
+}
+
+bench_done=0
+k2_done=0
+say "watcher started (pid $$)"
+while true; do
+  if probe; then
+    say "tunnel ALIVE — starting capture pipeline"
+    if [ "$bench_done" = 0 ]; then
+      say "bench.py starting"
+      SENTINEL_BENCH_BUDGET_S=900 timeout 1100 python bench.py --platform tpu \
+        > "$OUT/bench.json" 2>> "$LOG"
+      if grep -q '"platform": *"tpu"' "$OUT/bench.json" 2>/dev/null; then
+        bench_done=1
+        cp BENCH_partial.jsonl "$OUT/bench_partial.jsonl" 2>/dev/null
+        say "bench CAPTURED on tpu: $(cat "$OUT/bench.json")"
+      else
+        say "bench did not land on tpu: $(cat "$OUT/bench.json" 2>/dev/null | head -c 400)"
+      fi
+    fi
+    if [ "$k2_done" = 0 ]; then
+      say "k2probe starting"
+      timeout 1500 python tools/k2probe.py --iters 3 \
+        > "$OUT/k2probe.json" 2>> "$LOG"
+      if grep -q '"platform": *"tpu"' "$OUT/k2probe.json" 2>/dev/null; then
+        k2_done=1
+        say "k2probe CAPTURED on tpu: $(cat "$OUT/k2probe.json")"
+      else
+        say "k2probe did not land on tpu (partials are in this log): $(head -c 200 "$OUT/k2probe.json" 2>/dev/null)"
+      fi
+    fi
+    if [ "$bench_done" = 1 ] && [ "$k2_done" = 1 ]; then
+      say "all captures done — exiting"
+      exit 0
+    fi
+  else
+    say "probe failed/timed out (wedged)"
+  fi
+  sleep 240
+done
