@@ -15,12 +15,17 @@ class ParamFlowRuleManager(RuleManager[ParamFlowRule]):
     rule_kind = "param-flow"
 
     def __init__(self) -> None:
-        super().__init__()
+        # Fields _apply READS must exist before super().__init__():
+        # the base class attaches the property listener there, and
+        # DynamicSentinelProperty.add_listener fires config_load
+        # synchronously — which runs _apply on this half-built
+        # instance.
         self.by_resource: Dict[str, List[ParamFlowRule]] = {}
         # Converted gateway rules contribute alongside user rules
         # (GatewayRuleManager feeds GatewayFlowSlot via param checking
         # in the reference; here both share the engine's param index).
         self._gateway_rules: List[ParamFlowRule] = []
+        super().__init__()
 
     def set_gateway_rules(self, rules: List[ParamFlowRule]) -> None:
         from sentinel_tpu.core.api import peek_engine

@@ -32,6 +32,7 @@ with a device round-trip (lock order: ``_flush_lock`` → ``_lock``).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -88,6 +89,58 @@ class Verdict(NamedTuple):
     slot_name: str = ""  # custom slot that vetoed (reason BLOCK_CUSTOM)
 
 
+class _PendingFetch:
+    """A dispatched flush whose device→host fetch was deferred
+    (``Engine.flush_async``). ``wait()`` materializes this record —
+    and, FIFO, every older one — filling the chunk's verdicts and
+    running its post work (block log, cluster-token releases). The
+    fetch closure holds its own index/result references, so rule
+    reloads after dispatch cannot skew attribution.
+
+    Each record has its own RLock: the blocking device round-trip and
+    any user callbacks in post work run WITHOUT the engine's deque
+    lock held (concurrent dispatchers must not stall behind a fetch),
+    and re-entrant materialization from a callback is a no-op."""
+
+    __slots__ = ("_engine", "_entries", "_fetch", "_done", "_error", "_lock")
+
+    def __init__(self, engine: "Engine", entries: List["_EntryOp"], fetch) -> None:
+        self._engine = engine
+        self._entries = entries
+        self._fetch = fetch  # () -> blocked_items; runs the device_get
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self._lock = threading.RLock()
+
+    def materialize(self) -> None:
+        """Fetch + verdict fill + post work, exactly once. A failed
+        fetch is stored and re-raised to EVERY caller — a device
+        failure must never read as 'nothing admitted'. References to
+        the chunk (closure, result buffers, op lists) are dropped as
+        soon as they are consumed."""
+        with self._lock:
+            if not self._done:
+                items: Optional[List[tuple]] = None
+                try:
+                    items = self._fetch()
+                except BaseException as exc:
+                    self._error = exc
+                finally:
+                    self._fetch = None
+                    self._done = True
+                entries, self._entries = self._entries, []
+                if self._error is None:
+                    # Post-work failures (log IO, release RPCs) surface
+                    # to this materializer only: the verdicts ARE
+                    # filled, so readers must not see them as poisoned.
+                    self._engine._post_flush((entries, items or []))
+            if self._error is not None:
+                raise self._error
+
+    def wait(self) -> None:
+        self._engine._drain_pending(upto=self)
+
+
 @dataclass
 class _EntryOp:
     resource: str
@@ -100,7 +153,9 @@ class _EntryOp:
     auth_ok: bool = True
     prio: bool = False
     cluster_blocked_rule: Optional[object] = None  # token server said BLOCKED
-    verdict: Optional[Verdict] = None
+    _verdict: Optional[Verdict] = field(default=None, repr=False)
+    # Deferred-fetch record when this op was flushed via flush_async.
+    _pending: Optional[_PendingFetch] = field(default=None, repr=False, compare=False)
     # Held concurrency tokens acquired from the token service for
     # cluster THREAD-grade rules: [(service, token_id)] — released at
     # exit, or immediately if the entry is ultimately blocked.
@@ -125,6 +180,19 @@ class _EntryOp:
         from sentinel_tpu.models import constants as _C
 
         return [s.prow for s in self.p_slots if s.grade == _C.FLOW_GRADE_THREAD]
+
+    @property
+    def verdict(self) -> Optional[Verdict]:
+        """The flush decision; reading it materializes a pending
+        flush_async fetch first, so callers never see a half-flushed
+        op."""
+        if self._verdict is None and self._pending is not None:
+            self._pending.wait()
+        return self._verdict
+
+    @verdict.setter
+    def verdict(self, v: Optional[Verdict]) -> None:
+        self._verdict = v
 
 
 @dataclass
@@ -158,14 +226,47 @@ class BulkOp:
     # Which entries a custom slot vetoed (per-acquire-value checks);
     # None = no veto anywhere in the group.
     custom_veto_mask: Optional[np.ndarray] = None
-    # results (filled by flush)
-    admitted: Optional[np.ndarray] = None
-    reason: Optional[np.ndarray] = None
-    wait_ms: Optional[np.ndarray] = None
+    # results (filled by flush; lazily materialized after flush_async)
+    _admitted: Optional[np.ndarray] = field(default=None, repr=False)
+    _reason: Optional[np.ndarray] = field(default=None, repr=False)
+    _wait_ms: Optional[np.ndarray] = field(default=None, repr=False)
+    _pending: Optional[_PendingFetch] = field(default=None, repr=False, compare=False)
+
+    def _materialize(self) -> None:
+        if self._admitted is None and self._pending is not None:
+            self._pending.wait()
+
+    @property
+    def admitted(self) -> Optional[np.ndarray]:
+        self._materialize()
+        return self._admitted
+
+    @admitted.setter
+    def admitted(self, v: Optional[np.ndarray]) -> None:
+        self._admitted = v
+
+    @property
+    def reason(self) -> Optional[np.ndarray]:
+        self._materialize()
+        return self._reason
+
+    @reason.setter
+    def reason(self, v: Optional[np.ndarray]) -> None:
+        self._reason = v
+
+    @property
+    def wait_ms(self) -> Optional[np.ndarray]:
+        self._materialize()
+        return self._wait_ms
+
+    @wait_ms.setter
+    def wait_ms(self, v: Optional[np.ndarray]) -> None:
+        self._wait_ms = v
 
     @property
     def admitted_count(self) -> int:
-        return int(self.admitted.sum()) if self.admitted is not None else 0
+        a = self.admitted
+        return int(a.sum()) if a is not None else 0
 
 
 @dataclass
@@ -281,6 +382,13 @@ class Engine:
         # holding _lock (fixed order _flush_lock → _lock).
         self._flush_lock = threading.RLock()
         self.max_batch = config.get_int(config.FLUSH_MAX_BATCH, 131072)
+        # Deferred fetches from flush_async, oldest first. Lock order:
+        # _flush_lock → _pending_lock; nothing under _pending_lock takes
+        # another engine lock. RLock: a fetch closure reading a lazy
+        # property of its own chunk must not self-deadlock.
+        self._pending_fetches: "deque[_PendingFetch]" = deque()
+        self._pending_lock = threading.RLock()
+        self.max_inflight = config.get_int(config.FLUSH_MAX_INFLIGHT, 2)
         # Global on/off switch (Constants.ON, flipped by the setSwitch
         # command): when off, entries pass through unchecked + unrecorded.
         self.enabled = True
@@ -1117,6 +1225,10 @@ class Engine:
         already filled (the other flush cannot release the lock before
         filling them).
         """
+        # Earlier flush_async dispatches materialize first (FIFO), so
+        # "after flush() every previously submitted op has a verdict"
+        # keeps holding in pipelined use.
+        self.drain()
         drained: Tuple[List[_EntryOp], List[tuple]] = ([], [])
         try:
             with self._flush_lock:
@@ -1125,12 +1237,110 @@ class Engine:
             self._post_flush(drained)
         return drained[0]
 
-    def _flush_locked(self, out: Optional[Tuple[List[_EntryOp], List[tuple]]] = None) -> Tuple[List[_EntryOp], List[tuple]]:
+    def flush_async(self) -> List[_EntryOp]:
+        """Encode + dispatch all pending ops WITHOUT waiting for device
+        results — the pipelined flush.
+
+        ``flush()`` dispatches the kernel and then blocks on the
+        device→host fetch; on a remote-tunnel backend that serializes
+        every flush behind a full round-trip. ``flush_async`` returns
+        as soon as the kernel is dispatched: JAX's async dispatch then
+        overlaps this flush's device work (and its fetch latency) with
+        the host encode of the next one. Results materialize lazily —
+        on first access of any op's ``verdict`` / bulk group's
+        ``admitted``, at the next ``flush()`` or ``drain()``, or when
+        more than ``max_inflight`` async flushes are outstanding
+        (bounding device memory held by unfetched results). Block-log
+        writes and cluster-token releases for a chunk ride with its
+        materialization.
+        """
+        drained: Tuple[List[_EntryOp], List[tuple]] = ([], [])
+        try:
+            with self._flush_lock:
+                self._flush_locked(drained, defer=True)
+        except BaseException:
+            # Still bound the queue, but never let a drain error mask
+            # the dispatch failure being raised.
+            try:
+                self._drain_pending(keep=self.max_inflight)
+            except BaseException:
+                pass
+            raise
+        self._drain_pending(keep=self.max_inflight)
+        return drained[0]
+
+    def drain(self) -> None:
+        """Materialize every outstanding flush_async fetch (device→host)
+        and run its post work. After drain(), every op from earlier
+        flush_async calls has its verdict filled."""
+        self._drain_pending()
+
+    def _drain_pending(
+        self, upto: Optional[_PendingFetch] = None, keep: int = 0
+    ) -> None:
+        """Materialize queued async fetches oldest-first: through
+        ``upto`` (inclusive) when given, else until at most ``keep``
+        remain. The deque lock is held only for queue ops; each fetch
+        (a blocking device round-trip) and its post work run outside
+        it on the record's own lock, so concurrent dispatchers never
+        stall behind a fetch. The first failure is re-raised after the
+        drain finishes (later records still materialize — one wedged
+        fetch must not strand the queue)."""
+        first_err: Optional[BaseException] = None
+        while True:
+            with self._pending_lock:
+                if upto is not None and (
+                    upto._done or upto not in self._pending_fetches
+                ):
+                    break
+                if upto is None and len(self._pending_fetches) <= keep:
+                    break
+                if not self._pending_fetches:
+                    break
+                rec = self._pending_fetches.popleft()
+            try:
+                rec.materialize()
+            except BaseException as exc:
+                if first_err is None:
+                    first_err = exc
+            if rec is upto:
+                break
+        if upto is not None:
+            # Another thread may have popped it mid-drain: block on the
+            # record itself until it is done (and see its error, if any).
+            try:
+                upto.materialize()
+            except BaseException as exc:
+                if first_err is None:
+                    first_err = exc
+        if first_err is not None:
+            raise first_err
+
+    def _flush_locked(
+        self,
+        out: Optional[Tuple[List[_EntryOp], List[tuple]]] = None,
+        defer: bool = False,
+    ) -> Tuple[List[_EntryOp], List[tuple]]:
         """Drain + process pending ops. ``out`` (entries, blocked_items)
         is filled IN PLACE chunk by chunk so the caller's finally still
         delivers completed chunks' block-log records and token releases
-        if a later chunk's kernel raises."""
+        if a later chunk's kernel raises. With ``defer``, each chunk's
+        device→host fetch is queued as a _PendingFetch instead (out[1]
+        stays empty; post work rides with materialization)."""
         out = out if out is not None else ([], [])
+
+        def _chunk(entries_c, exits_c, bulk_c, bulk_x_c, findex, dindex,
+                   pindex, auth_rules) -> None:
+            res = self._run_chunk(
+                entries_c, exits_c, bulk_c, bulk_x_c, findex, dindex, pindex,
+                auth_rules, defer=defer,
+            )
+            out[0].extend(entries_c)
+            if defer:
+                with self._pending_lock:
+                    self._pending_fetches.append(res)
+            else:
+                out[1].extend(res)
         with self._lock:
             self._maybe_rebase()
             entries, self._entries = self._entries, []
@@ -1212,20 +1422,16 @@ class Engine:
             # Everything fits one kernel call — singles and bulk share
             # one flush, so ALL exits (incl. bulk-exit groups) apply
             # before ALL admissions, exactly like the unbatched path.
-            items = self._run_chunk(
-                entries, exits, bulk_e, bulk_x, findex, dindex, pindex, auth_rules
-            )
-            out[0].extend(entries)
-            out[1].extend(items)
+            _chunk(entries, exits, bulk_e, bulk_x, findex, dindex, pindex,
+                   auth_rules)
             return out
         # Oversized backlog: singles chunks, then packed bulk chunks.
         # Exits in a later chunk are not visible to earlier chunks'
         # admissions — the same caveat the singles chunk split already
         # has at this size.
         for off in range(0, max(len(entries), len(exits)), mb):
-            e_chunk = entries[off : off + mb]
-            items = self._run_chunk(
-                e_chunk,
+            _chunk(
+                entries[off : off + mb],
                 exits[off : off + mb],
                 [],
                 [],
@@ -1234,8 +1440,6 @@ class Engine:
                 pindex,
                 auth_rules,
             )
-            out[0].extend(e_chunk)
-            out[1].extend(items)
         # Bulk groups ride in their own chunks, greedy-packed to the
         # same max_batch bound (each group's n ≤ max_batch is enforced
         # at submit).
@@ -1253,7 +1457,7 @@ class Engine:
         be_chunks = _pack(bulk_e)
         bx_chunks = _pack(bulk_x)
         for i in range(max(len(be_chunks), len(bx_chunks))):
-            items = self._run_chunk(
+            _chunk(
                 [],
                 [],
                 be_chunks[i] if i < len(be_chunks) else [],
@@ -1263,7 +1467,6 @@ class Engine:
                 pindex,
                 auth_rules,
             )
-            out[1].extend(items)
         return out
 
     def _post_flush(self, drained: Tuple[List[_EntryOp], List[tuple]]) -> None:
@@ -1292,10 +1495,13 @@ class Engine:
         dindex: DegradeIndex,
         pindex: ParamIndex,
         auth_rules: Dict[str, AuthorityRule],
-    ) -> List[tuple]:
+        defer: bool = False,
+    ) -> object:
         """Encode one chunk, run the kernel, fill verdicts; returns the
         chunk's blocked-verdict block-log items (file IO happens outside
-        the flush lock, in _post_flush). Runs under
+        the flush lock, in _post_flush) — or, with ``defer``, a
+        _PendingFetch that performs the fetch + fill on
+        materialization. Runs under
         the flush lock only — the indexes are the snapshot taken when
         the pending buffers were swapped; _flush_locked re-resolved any
         op whose submit-time tables were superseded by a reload.
@@ -1510,6 +1716,40 @@ class Engine:
             out = flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms, **flags)
         self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn, result = out
 
+        def _fetch_and_fill(res):
+            return self._fill_results(
+                res, entries, exits, bulk, bulk_exits, findex, dindex,
+                auth_rules, k, kd,
+            )
+
+        if defer:
+            rec = _PendingFetch(
+                self, entries, lambda: _fetch_and_fill(result)
+            )
+            for op in entries:
+                op._pending = rec
+            for g in bulk:
+                g._pending = rec
+            return rec
+        return _fetch_and_fill(result)
+
+    def _fill_results(
+        self,
+        result,
+        entries: List[_EntryOp],
+        exits: List[_ExitOp],
+        bulk: List[BulkOp],
+        bulk_exits: List[_BulkExitOp],
+        findex: FlowIndex,
+        dindex: DegradeIndex,
+        auth_rules: Dict[str, AuthorityRule],
+        k: int,
+        kd: int,
+    ) -> List[tuple]:
+        """Device→host fetch + verdict fill for one dispatched chunk;
+        returns the chunk's blocked-verdict block-log items. Runs
+        either synchronously at the end of _run_chunk or deferred from
+        a _PendingFetch materialization."""
         # One batched device->host fetch (each separate fetch costs a
         # full round-trip on remote-tunnel backends).
         admitted, reason, slot_ok, wait_ms, sys_type, dslot_ok = jax.device_get(
@@ -1560,6 +1800,7 @@ class Engine:
                 limit_type=limit_type,
                 slot_name=slot_name,
             )
+            op._pending = None  # drop the chunk backref once filled
         off_b = len(entries)
         bulk_slices: List[Tuple[BulkOp, slice]] = []
         for g in bulk:
@@ -1568,9 +1809,10 @@ class Engine:
             g.admitted = np.array(admitted[sl])
             reasons = np.array(reason[sl], dtype=np.int32)
             if g.custom_veto_mask is not None:
-                reasons[~g.admitted & g.custom_veto_mask] = E.BLOCK_CUSTOM
+                reasons[~g._admitted & g.custom_veto_mask] = E.BLOCK_CUSTOM
             g.reason = reasons
             g.wait_ms = np.array(wait_ms[sl])
+            g._pending = None  # drop the chunk backref once filled
             off_b += g.n
 
         # ---- block log + metric-extension callbacks ----
@@ -1664,6 +1906,8 @@ class Engine:
                         gx.resource, _weighted_rt(gx), int(gx.count.sum()),
                         int(gx.err.sum()),
                     )
+        from sentinel_tpu.core.slots import SlotChainRegistry
+
         if SlotChainRegistry.slots():
             for x in exits:
                 if x.resource is not None and x.thr < 0:

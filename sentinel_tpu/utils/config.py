@@ -52,6 +52,9 @@ class SentinelConfig:
     # TPU-native keys (no reference equivalent).
     FLUSH_INTERVAL_MS = "sentinel.tpu.flush.interval.ms"
     FLUSH_MAX_BATCH = "sentinel.tpu.flush.max.batch"
+    # Max flush_async dispatches in flight before the oldest fetch is
+    # forced (bounds device memory held by unfetched results).
+    FLUSH_MAX_INFLIGHT = "sentinel.tpu.flush.max.inflight"
     # OccupyTimeoutProperty (reference: CORE/node/OccupyTimeoutProperty.java):
     # max borrowable wait for prioritized entries, < interval.
     OCCUPY_TIMEOUT_MS = "csp.sentinel.statistic.occupy.timeout"
@@ -68,6 +71,7 @@ class SentinelConfig:
         METRIC_FLUSH_INTERVAL: "1",
         FLUSH_INTERVAL_MS: "2",
         FLUSH_MAX_BATCH: "131072",
+        FLUSH_MAX_INFLIGHT: "2",
         INITIAL_ROWS: "1024",
         OCCUPY_TIMEOUT_MS: "500",
     }

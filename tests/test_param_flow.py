@@ -145,3 +145,20 @@ class TestEviction:
         assert st.try_entry("ev", args=("c",)) is not None
         # "b" was evicted; re-seen -> fresh bucket.
         assert st.try_entry("ev", args=("b",)) is not None
+
+
+def test_manager_construction_applies_cleanly(caplog):
+    """Constructing the manager must not run _apply on a half-built
+    instance: DynamicSentinelProperty.add_listener fires config_load
+    synchronously from the base __init__, so subclass fields _apply
+    reads (here _gateway_rules) must be initialized first. The bug's
+    signature was a 'Failed to apply rules' ERROR in the record log on
+    every import."""
+    import logging
+
+    from sentinel_tpu.rules.param_manager import ParamFlowRuleManager
+
+    with caplog.at_level(logging.ERROR, logger="sentinel_tpu.record"):
+        mgr = ParamFlowRuleManager()
+    assert mgr.by_resource == {}
+    assert not [r for r in caplog.records if "Failed to apply" in r.message]
