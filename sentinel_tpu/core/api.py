@@ -117,9 +117,23 @@ def reset(clock: Optional[Clock] = None) -> Engine:
     Rule managers re-attach to the new engine lazily.
     """
     from sentinel_tpu.rules import all_managers
+    from sentinel_tpu.utils.record_log import record_log
 
     with _engine_lock:
         global _engine
+        if _engine is not None:
+            # Settle dispatched-but-unfetched flush_async chunks before
+            # discarding the engine — their block-log records belong to
+            # the pre-reset world, not to whenever a holder of an old
+            # op happens to read its verdict (Engine.reset does the
+            # same for in-place resets).
+            try:
+                _engine.drain()
+            except Exception:
+                record_log.error(
+                    "[api.reset] settling pre-reset async flushes failed",
+                    exc_info=True,
+                )
         _engine = Engine(clock=clock)
     ContextUtil.replace_context(None)
     for mgr in all_managers():

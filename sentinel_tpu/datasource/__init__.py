@@ -10,13 +10,17 @@ ships adapters for Nacos/ZooKeeper/Apollo/etcd/Redis/Consul/Eureka —
 all following the same watch-callback → ``property.update_value`` shape;
 here the file and in-memory sources are first-class, the push-style
 base class (:class:`PushDataSource`) is the extension point for any
-external store client, and two full network adapters ship:
+external store client, and four full network adapters ship:
 :class:`RedisDataSource` (RESP over a socket: GET for the initial
 value, SUBSCRIBE for live updates —
-sentinel-datasource-redis/.../RedisDataSource.java) and
+sentinel-datasource-redis/.../RedisDataSource.java),
 :class:`EtcdDataSource` (etcd v3 HTTP gRPC-gateway: range + put +
 streaming watch with revision resume —
-sentinel-datasource-etcd/.../EtcdDataSource.java:41).
+sentinel-datasource-etcd/.../EtcdDataSource.java:41),
+:class:`ConsulDataSource` (KV blocking queries —
+sentinel-datasource-consul/.../ConsulDataSource.java:38) and
+:class:`NacosDataSource` (config-service long-poll listener —
+sentinel-datasource-nacos/.../NacosDataSource.java:42).
 """
 
 from sentinel_tpu.datasource.base import (
@@ -34,13 +38,17 @@ from sentinel_tpu.datasource.file_source import (
     FileRefreshableDataSource,
     FileWritableDataSource,
 )
+from sentinel_tpu.datasource.consul_source import ConsulDataSource
 from sentinel_tpu.datasource.etcd_source import EtcdDataSource
 from sentinel_tpu.datasource.http_source import HttpDataSource, HttpLongPollDataSource
+from sentinel_tpu.datasource.nacos_source import NacosDataSource
 from sentinel_tpu.datasource.redis_source import RedisDataSource
 
 __all__ = [
     "AbstractDataSource",
+    "ConsulDataSource",
     "EtcdDataSource",
+    "NacosDataSource",
     "HttpDataSource",
     "HttpLongPollDataSource",
     "RedisDataSource",

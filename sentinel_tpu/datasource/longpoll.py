@@ -1,0 +1,130 @@
+"""Kill-able long-poll HTTP requests.
+
+``urllib.request.urlopen`` blocks inside the call until response
+headers arrive — for a long poll that the server holds open (Consul
+blocking queries, Nacos listeners) there is no object a closer could
+use to unblock the request; ``close()`` would have to wait out the
+full server hold. ``http.client`` exposes the connection BEFORE
+blocking on the response, so the closer can shut the socket and turn
+the pending read into an immediate error.
+"""
+
+from __future__ import annotations
+
+import http.client
+import socket
+import threading
+from typing import Callable, Optional, Tuple
+from urllib.parse import urlsplit
+
+from sentinel_tpu.datasource.base import Converter, PushDataSource, S, T
+from sentinel_tpu.utils.record_log import record_log
+
+
+def long_poll(
+    url: str,
+    method: str = "GET",
+    body: Optional[bytes] = None,
+    headers: Optional[dict] = None,
+    timeout: float = 60.0,
+    on_conn: Optional[Callable[[Optional[http.client.HTTPConnection]], None]] = None,
+) -> Tuple[http.client.HTTPConnection, http.client.HTTPResponse]:
+    """Issue one HTTP request, publishing the connection via ``on_conn``
+    before blocking on the response. The caller owns the connection:
+    read the response, then ``conn.close()`` (and call ``on_conn(None)``
+    if it published). Does not raise on HTTP error statuses — the
+    caller checks ``resp.status``."""
+    u = urlsplit(url)
+    cls = (
+        http.client.HTTPSConnection
+        if u.scheme == "https"
+        else http.client.HTTPConnection
+    )
+    conn = cls(u.hostname, u.port, timeout=timeout)
+    if on_conn is not None:
+        on_conn(conn)
+    path = (u.path or "/") + (f"?{u.query}" if u.query else "")
+    conn.request(method, path, body=body, headers=headers or {})
+    return conn, conn.getresponse()
+
+
+def kill_conn(conn: Optional[http.client.HTTPConnection]) -> None:
+    """Unblock any thread waiting on ``conn``'s response: shut the raw
+    socket down (pending reads fail instantly), then close."""
+    if conn is None:
+        return
+    try:
+        sock = conn.sock
+        if sock is not None:
+            sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        conn.close()
+    except OSError:
+        pass
+
+
+class LongPollPushDataSource(PushDataSource[S, T]):
+    """Shared scaffolding for long-poll watcher sources (Consul
+    blocking queries, Nacos listeners): the initial-load-then-daemon
+    -thread start protocol, the published poll connection that
+    ``close()`` kills to unblock an in-flight hold, and the capped
+    response read. Subclasses implement ``_poll_once`` (one held
+    request + push) and ``_on_poll_error`` (their catch-up/backoff
+    stance)."""
+
+    _thread_name = "sentinel-longpoll-watcher"
+
+    def __init__(self, converter: Converter[S, T], max_body_bytes: int) -> None:
+        super().__init__(converter)
+        self._max_body_bytes = max_body_bytes
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # The in-flight poll's CONNECTION (published before the
+        # response blocks), killed on close to unblock the watcher
+        # instantly.
+        self._poll_conn: Optional[http.client.HTTPConnection] = None
+
+    def _set_poll_conn(self, conn) -> None:
+        self._poll_conn = conn
+
+    def _read_capped(self, resp) -> bytes:
+        data = resp.read(self._max_body_bytes + 1)
+        if len(data) > self._max_body_bytes:
+            raise ValueError(f"{type(self).__name__} response exceeds size cap")
+        return data
+
+    def start(self):
+        try:
+            self.on_update(self.read_source())  # initial load
+        except Exception:
+            record_log.error(
+                "[%s] initial load failed", type(self).__name__, exc_info=True
+            )
+        self._thread = threading.Thread(
+            target=self._watch_loop, name=self._thread_name, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _watch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._poll_once()
+            except Exception as e:
+                if self._stop.is_set():
+                    return
+                self._on_poll_error(e)
+
+    def _poll_once(self) -> None:
+        raise NotImplementedError
+
+    def _on_poll_error(self, e: Exception) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        self._stop.set()
+        kill_conn(self._poll_conn)  # unblocks the in-flight poll now
+        if self._thread is not None:
+            self._thread.join(timeout=5)

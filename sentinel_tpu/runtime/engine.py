@@ -2093,6 +2093,20 @@ class Engine:
         return self._row_stats(self.nodes.entry_node_row)
 
     def reset(self) -> None:
+        # Settle dispatched-but-unfetched flush_async chunks FIRST:
+        # discarding them would deadlock readers waiting on their
+        # records, and leaving them queued would deliver pre-reset
+        # block-log records (or a pre-reset device failure) into the
+        # first post-reset flush. A failed settle is logged, not
+        # raised — reset must complete regardless.
+        try:
+            self.drain()
+        except Exception:
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log.error(
+                "[Engine] settling pre-reset async flushes failed", exc_info=True
+            )
         with self._flush_lock, self._lock:
             self._entries.clear()
             self._exits.clear()

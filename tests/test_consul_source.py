@@ -1,0 +1,231 @@
+"""ConsulDataSource against an in-process fake Consul agent — same
+approach as the etcd/Redis tests (fake server, real wire semantics:
+blocking queries with X-Consul-Index).
+
+Reference parity target: sentinel-extension/sentinel-datasource-consul/
+.../ConsulDataSource.java:38 (initial KV get + blocking-query watch),
+plus WritableDataSource semantics.
+"""
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.datasource.base import json_converter
+from sentinel_tpu.datasource.consul_source import ConsulDataSource
+
+
+class FakeConsul(ThreadingHTTPServer):
+    """KV get (with blocking-query support) + put."""
+
+    daemon_threads = True
+
+    def __init__(self):
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self.port = self.server_address[1]
+        self.cond = threading.Condition()
+        self.data = {}  # key -> value
+        self.index = 1  # global modify index
+        self.fail_next_poll = False
+
+    def put(self, key: str, value: str):
+        with self.cond:
+            self.index += 1
+            self.data[key] = value
+            self.cond.notify_all()
+
+    def delete(self, key: str):
+        with self.cond:
+            self.index += 1
+            self.data.pop(key, None)
+            self.cond.notify_all()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def handle(self):
+        try:
+            super().handle()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client killed a held poll (close()) — expected
+
+    def _reply(self, code: int, body: bytes, index: int):
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("X-Consul-Index", str(index))
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv: FakeConsul = self.server
+        parsed = urlparse(self.path)
+        if not parsed.path.startswith("/v1/kv/"):
+            self.send_error(404)
+            return
+        key = parsed.path[len("/v1/kv/"):]
+        q = parse_qs(parsed.query)
+        want_index = int(q.get("index", ["0"])[0])
+        wait_s = float(q.get("wait", ["0s"])[0].rstrip("s") or 0)
+        deadline = time.time() + min(wait_s, 2.0)  # capped for tests
+        with srv.cond:
+            if srv.fail_next_poll and want_index:
+                srv.fail_next_poll = False
+                self.send_error(500)
+                return
+            # Blocking query: hold until index passes or wait expires.
+            while want_index and srv.index <= want_index:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                srv.cond.wait(remaining)
+            idx = srv.index
+            value = srv.data.get(key)
+        if value is None:
+            self._reply(404, b"", idx)
+            return
+        body = json.dumps(
+            [{
+                "Key": key,
+                "Value": base64.b64encode(value.encode()).decode(),
+                "ModifyIndex": idx,
+            }]
+        ).encode()
+        self._reply(200, body, idx)
+
+    def do_PUT(self):
+        srv: FakeConsul = self.server
+        key = urlparse(self.path).path[len("/v1/kv/"):]
+        n = int(self.headers.get("Content-Length", 0))
+        srv.put(key, self.rfile.read(n).decode())
+        self._reply(200, b"true", srv.index)
+
+
+def _rules_json(count):
+    return json.dumps([{"resource": "res", "count": count}])
+
+
+@pytest.fixture()
+def fake_consul():
+    srv = FakeConsul()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _wait(predicate, timeout=5.0):
+    end = time.time() + timeout
+    while time.time() < end:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _src(fake_consul, **kw):
+    kw.setdefault("reconnect_interval_sec", 0.05)
+    kw.setdefault("wait_sec", 1.0)
+    return ConsulDataSource(
+        json_converter(st.FlowRule), "sentinel/rules",
+        endpoint=f"http://127.0.0.1:{fake_consul.port}", **kw,
+    )
+
+
+class TestConsulDataSource:
+    def test_initial_load_and_blocking_query_push(
+        self, fake_consul, manual_clock, engine
+    ):
+        """KV get seeds the rules; a put releases the blocking query
+        and live-swaps the engine table."""
+        fake_consul.put("sentinel/rules", _rules_json(1))
+        src = _src(fake_consul).start()
+        try:
+            st.flow_rule_manager.register_property(src.get_property())
+            manual_clock.set_ms(100)
+            assert st.try_entry("res") is not None
+            assert st.try_entry("res") is None  # count=1 enforced
+
+            fake_consul.put("sentinel/rules", _rules_json(5))
+            assert _wait(
+                lambda: any(
+                    r.count == 5 for r in (st.flow_rule_manager.get_rules() or [])
+                )
+            ), "blocking-query push never reached the manager"
+            manual_clock.set_ms(2000)
+            admitted = sum(1 for _ in range(8) if st.try_entry("res") is not None)
+            assert admitted == 5
+        finally:
+            src.close()
+
+    def test_write_round_trips(self, fake_consul):
+        src = _src(fake_consul)
+        src.write(_rules_json(9))
+        rules = src.load_config()
+        assert len(rules) == 1 and rules[0].count == 9
+        src.close()
+
+    def test_missing_key_reads_none(self, fake_consul):
+        src = _src(fake_consul)
+        assert src.read_source() is None
+        src.close()
+
+    def test_delete_pushes_none(self, fake_consul):
+        fake_consul.put("sentinel/rules", _rules_json(2))
+        src = _src(fake_consul).start()
+        try:
+            assert _wait(lambda: src.get_property()._value)
+            fake_consul.delete("sentinel/rules")
+            assert _wait(lambda: not src.get_property()._value), (
+                "delete never propagated"
+            )
+        finally:
+            src.close()
+
+    def test_outage_recovers_and_catches_up(self, fake_consul):
+        fake_consul.put("sentinel/rules", _rules_json(1))
+        src = _src(fake_consul).start()
+        try:
+            assert _wait(lambda: src.get_property()._value)
+            fake_consul.fail_next_poll = True
+            fake_consul.put("sentinel/rules", _rules_json(7))
+            assert _wait(
+                lambda: any(r.count == 7 for r in (src.get_property()._value or []))
+            ), "update during outage was lost"
+        finally:
+            src.close()
+
+    def test_close_unblocks_inflight_poll_promptly(self, fake_consul):
+        """The blocking query's connection is published BEFORE the
+        response blocks, so close() can kill it mid-hold instead of
+        waiting out the server's window."""
+        fake_consul.put("sentinel/rules", _rules_json(1))
+        src = _src(fake_consul, wait_sec=30.0).start()
+        try:
+            assert _wait(lambda: src._poll_conn is not None), "poll never started"
+        finally:
+            t0 = time.time()
+            src.close()
+            assert time.time() - t0 < 1.5, "close blocked on the long poll"
+        assert not src._thread.is_alive()
+
+    def test_oversized_body_rejected(self, fake_consul, monkeypatch):
+        import sentinel_tpu.datasource.consul_source as mod
+
+        monkeypatch.setattr(mod, "MAX_BODY_BYTES", 64)
+        fake_consul.put("sentinel/rules", "x" * 200)
+        src = _src(fake_consul)
+        with pytest.raises(ValueError, match="size cap"):
+            src.read_source()
+        src.close()

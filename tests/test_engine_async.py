@@ -202,6 +202,48 @@ def test_failed_fetch_raises_to_every_reader(monkeypatch):
     eng.drain()
 
 
+def test_reset_settles_pending_async_flushes(monkeypatch):
+    """reset() must settle dispatched-but-unfetched chunks: their
+    block-log records belong to the pre-reset engine, and a stored
+    fetch failure must not surface into the first post-reset flush."""
+    clock = ManualClock(1000)
+    eng = _engine([FlowRule(resource="r", count=0)], clock)
+    logged = []
+    monkeypatch.setattr(
+        eng.block_log, "log_batch", lambda items: logged.extend(items)
+    )
+    ops = [eng.submit_entry("r", ts=clock.now_ms()) for _ in range(4)]
+    eng.flush_async()
+    assert logged == []
+    eng.reset()
+    # Settled during reset, not delivered into post-reset traffic.
+    assert len(logged) == 4
+    assert all(o._verdict is not None for o in ops)
+    assert len(eng._pending_fetches) == 0
+    # A post-reset flush sees a clean engine.
+    op = eng.submit_entry("r", ts=clock.now_ms())
+    eng.flush()
+    assert op.verdict.admitted  # the count=0 rule was cleared by reset
+
+    # Failed pre-reset fetch: reset logs and completes; the error does
+    # not leak into post-reset flushes (readers of the old ops still
+    # see it).
+    eng2 = _engine([FlowRule(resource="q", count=5)], clock)
+    op2 = eng2.submit_entry("q", ts=clock.now_ms())
+    eng2.flush_async()
+    monkeypatch.setattr(
+        eng2, "_fill_results",
+        lambda *a, **kw: (_ for _ in ()).throw(RuntimeError("wedged")),
+    )
+    eng2.reset()  # swallows + logs
+    monkeypatch.undo()
+    op3 = eng2.submit_entry("q", ts=clock.now_ms())
+    eng2.flush()  # must NOT raise the pre-reset failure
+    assert op3.verdict is not None
+    with pytest.raises(RuntimeError, match="wedged"):
+        op2.verdict
+
+
 def test_flush_async_on_empty_engine_is_noop():
     eng = _engine([FlowRule(resource="r", count=5)])
     assert eng.flush_async() == []
