@@ -25,6 +25,12 @@ from typing import Callable, Tuple
 import jax
 import jax.numpy as jnp
 
+# Rounds counts at or below this unroll at trace time (XLA fuses the
+# whole chain); above it a fori_loop compiles the round body once.
+# tests/test_scan_rounds.py derives its cross-path parity case from
+# this constant.
+UNROLL_MAX_ROUNDS = 4
+
 
 def run_segmented(
     new_grp: jax.Array,  # bool [S] — segment starts in sorted order
@@ -43,14 +49,16 @@ def run_segmented(
         seg_pos = idx - seg_start
         ok = jnp.ones((s,), dtype=bool)
         wait = jnp.zeros((s,), dtype=jnp.int32)
-        out_states = seg_states
-        for r in range(rounds):
-            if r == 0:
-                ins = seg_states
-            else:
-                ins = tuple(
-                    jnp.concatenate([o[:1], o[:-1]]) for o in out_states
-                )
+
+        def one_round(r, ok, wait, out_states):
+            # Round r resolves every segment's r-th item: its input
+            # state is seg-start state (r==0) or the adjacent
+            # predecessor's output from the previous round.
+            shifted = tuple(jnp.concatenate([o[:1], o[:-1]]) for o in out_states)
+            ins = tuple(
+                jnp.where(jnp.equal(r, 0), ss, sh)
+                for ss, sh in zip(seg_states, shifted)
+            )
             (ok_r, wait_r), new_states = transition(ins, items)
             sel = seg_pos == r
             ok = jnp.where(sel, ok_r, ok)
@@ -58,7 +66,31 @@ def run_segmented(
             out_states = tuple(
                 jnp.where(sel, ns, os) for ns, os in zip(new_states, out_states)
             )
-        return ok, wait, out_states
+            return ok, wait, out_states
+
+        if rounds <= UNROLL_MAX_ROUNDS:
+            # Small counts: unroll at trace time so XLA fuses freely.
+            out_states = seg_states
+            for r in range(rounds):
+                ok, wait, out_states = one_round(
+                    jnp.int32(r), ok, wait, out_states
+                )
+            return ok, wait, out_states
+
+        # Large counts: a fori_loop compiles the round body ONCE.
+        # Unrolling 16+ copies of the transition into the HLO multiplied
+        # remote-compile time past the bench's stage timeout (round-4
+        # hardware session) for runtime that is identical.
+        n_st = len(seg_states)
+
+        def body(r, carry):
+            ok, wait = carry[0], carry[1]
+            out_states = tuple(carry[2 : 2 + n_st])
+            ok, wait, out_states = one_round(r, ok, wait, out_states)
+            return (ok, wait, *out_states)
+
+        out = jax.lax.fori_loop(0, rounds, body, (ok, wait, *seg_states))
+        return out[0], out[1], tuple(out[2 : 2 + n_st])
 
     n_st = len(seg_states)
 
