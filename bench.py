@@ -572,30 +572,33 @@ def main() -> None:
         min_mixed = 90.0 if run_platform == "cpu" else 330.0
         min_engine = 45.0 if run_platform == "cpu" else 270.0
         remaining = deadline - time.monotonic()
-        if remaining > min_mixed:
+        # Reserve the engine stage's floor when both still fit; when
+        # they don't, the mixed chain (the headline verdict metric)
+        # gets the room and the engine skip is logged. Either way a
+        # stage's actual timeout is NEVER below its floor — a
+        # sub-floor spawn is exactly the kill-mid-compile case.
+        mixed_t = min(remaining - min_engine, 420.0)
+        if mixed_t < min_mixed:
+            mixed_t = min(remaining - 45, 420.0)
+        if mixed_t >= min_mixed:
             mr, me = (
                 ((1 << 20), (1 << 17)) if run_platform != "cpu" else ((1 << 14), (1 << 13))
             )
-            # Reserve the engine stage's floor when both still fit;
-            # when they don't, the mixed chain (the headline verdict
-            # metric) gets the room and the engine skip is logged.
-            mixed_t = min(remaining - min_engine, 420.0)
-            if mixed_t < min_mixed:
-                mixed_t = min(remaining - 45, 420.0)
             mixed = spawn(mr, me, 5, run_platform, mixed_t, kind="mixed")
             if mixed:
                 best.update(mixed)
         else:
-            _log(f"skipping mixed stage: {remaining:.0f}s left < {min_mixed:.0f}s floor")
+            _log(f"skipping mixed stage: {remaining:.0f}s left gives timeout "
+                 f"{mixed_t:.0f}s < {min_mixed:.0f}s floor")
         remaining = deadline - time.monotonic()
-        if remaining > min_engine:
-            engine = spawn(
-                1024, 8192, 3, run_platform, min(remaining - 15, 420.0), kind="engine"
-            )
+        engine_t = min(remaining - 15, 420.0)
+        if engine_t >= min_engine:
+            engine = spawn(1024, 8192, 3, run_platform, engine_t, kind="engine")
             if engine:
                 best.update(engine)
         else:
-            _log(f"skipping engine stage: {remaining:.0f}s left < {min_engine:.0f}s floor")
+            _log(f"skipping engine stage: {remaining:.0f}s left gives timeout "
+                 f"{engine_t:.0f}s < {min_engine:.0f}s floor")
 
     if best is None:
         _emit(

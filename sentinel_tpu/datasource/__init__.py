@@ -18,9 +18,12 @@ sentinel-datasource-redis/.../RedisDataSource.java),
 streaming watch with revision resume —
 sentinel-datasource-etcd/.../EtcdDataSource.java:41),
 :class:`ConsulDataSource` (KV blocking queries —
-sentinel-datasource-consul/.../ConsulDataSource.java:38) and
+sentinel-datasource-consul/.../ConsulDataSource.java:38),
 :class:`NacosDataSource` (config-service long-poll listener —
-sentinel-datasource-nacos/.../NacosDataSource.java:42).
+sentinel-datasource-nacos/.../NacosDataSource.java:42) and
+:class:`ZookeeperDataSource` (jute wire protocol: znode read + data
+watch + session keepalive —
+sentinel-datasource-zookeeper/.../ZookeeperDataSource.java:43).
 """
 
 from sentinel_tpu.datasource.base import (
@@ -43,6 +46,7 @@ from sentinel_tpu.datasource.etcd_source import EtcdDataSource
 from sentinel_tpu.datasource.http_source import HttpDataSource, HttpLongPollDataSource
 from sentinel_tpu.datasource.nacos_source import NacosDataSource
 from sentinel_tpu.datasource.redis_source import RedisDataSource
+from sentinel_tpu.datasource.zookeeper_source import ZookeeperDataSource
 
 __all__ = [
     "AbstractDataSource",
@@ -52,6 +56,7 @@ __all__ = [
     "HttpDataSource",
     "HttpLongPollDataSource",
     "RedisDataSource",
+    "ZookeeperDataSource",
     "AutoRefreshDataSource",
     "Converter",
     "InMemoryDataSource",

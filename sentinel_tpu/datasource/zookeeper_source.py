@@ -1,0 +1,534 @@
+"""ZooKeeper dynamic datasource speaking the jute wire protocol.
+
+The reference's ZookeeperDataSource (sentinel-extension/
+sentinel-datasource-zookeeper/src/main/java/com/alibaba/csp/sentinel/
+datasource/zookeeper/ZookeeperDataSource.java:43) wraps a Curator
+NodeCache: an initial read of one znode plus a data watch that
+re-reads and pushes into the SentinelProperty on every change, with
+the Nacos-style ``/{groupId}/{dataId}`` path variant
+(ZookeeperDataSource.java:194-196) and optional digest auth
+(ZookeeperDataSource.java:77-85). This adapter provides the same
+surface dependency-free, speaking the ZooKeeper client protocol
+directly (same stance as the Redis RESP / etcd gateway sources):
+
+* framing — every packet is a 4-byte big-endian length prefix + body
+  (jute serialization: ints/longs big-endian, strings and buffers
+  length-prefixed, buffer length -1 encoding null);
+* session — ConnectRequest/ConnectResponse handshake, pings at a
+  third of the negotiated timeout, reconnect with backoff and a
+  catch-up re-read after every (re)connect so changes made during an
+  outage are never missed;
+* watch — ``getData(watch=true)`` arms the data watch (NoNode falls
+  back to ``exists(watch=true)`` to arm a creation watch); server
+  notifications (xid −1) re-read and re-arm, exactly the NodeCache
+  listener loop of the reference;
+* write — ``setData``, creating the node (and parents) on NoNode, so
+  the source is a WritableDataSource like the etcd/consul adapters
+  (the command plane persists rule pushes through it).
+
+Hardening: frames are capped (a corrupted or hostile stream must not
+balloon memory — MAX_FRAME_BYTES mirrors ZooKeeper's own
+``jute.maxbuffer``), any malformed frame kills the connection and the
+session loop reconnects with a fresh read, and every pending request
+fails fast when the connection dies rather than blocking its caller.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+from sentinel_tpu.datasource.base import Converter, PushDataSource, T, WritableDataSource
+from sentinel_tpu.utils.record_log import record_log
+
+# --- op codes / constants (ZooKeeper protocol) -----------------------
+OP_CREATE = 1
+OP_DELETE = 2
+OP_EXISTS = 3
+OP_GETDATA = 4
+OP_SETDATA = 5
+OP_PING = 11
+OP_CLOSE = -11
+OP_AUTH = 100
+
+XID_WATCH = -1
+XID_PING = -2
+XID_AUTH = -4
+
+EVT_NODE_CREATED = 1
+EVT_NODE_DELETED = 2
+EVT_NODE_DATA_CHANGED = 3
+
+ERR_OK = 0
+ERR_NONODE = -101
+ERR_NODEEXISTS = -110
+
+# world:anyone perms=ALL (rcwda = 0b11111)
+_OPEN_ACL = [(31, "world", "anyone")]
+
+# ZooKeeper's own default jute.maxbuffer is 1 MiB plus headroom; a
+# frame beyond this is corruption, not data.
+MAX_FRAME_BYTES = 4 * 1024 * 1024
+
+
+class ZkError(Exception):
+    def __init__(self, msg: str, code: int = 0):
+        super().__init__(msg)
+        self.code = code
+
+
+# --- jute codec helpers ----------------------------------------------
+def _pack_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack(">i", len(b)) + b
+
+
+def _pack_buf(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+class _Reader:
+    """Cursor over one frame body; every read validates bounds so a
+    truncated/corrupted frame raises ZkError instead of IndexError."""
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if n < 0 or self.pos + n > len(self.data):
+            raise ZkError("truncated frame")
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def buf(self) -> Optional[bytes]:
+        n = self.i32()
+        if n == -1:
+            return None
+        if n > MAX_FRAME_BYTES:
+            raise ZkError("oversized buffer in frame")
+        return self._take(n)
+
+    def string(self) -> str:
+        b = self.buf()
+        if b is None:
+            raise ZkError("null string in frame")
+        return b.decode("utf-8", errors="replace")
+
+
+def _read_stat(r: _Reader) -> dict:
+    return {
+        "czxid": r.i64(), "mzxid": r.i64(), "ctime": r.i64(), "mtime": r.i64(),
+        "version": r.i32(), "cversion": r.i32(), "aversion": r.i32(),
+        "ephemeralOwner": r.i64(), "dataLength": r.i32(),
+        "numChildren": r.i32(), "pzxid": r.i64(),
+    }
+
+
+# --- one live connection ---------------------------------------------
+class _ZkConn:
+    """One connected, handshaken session. A reader thread demultiplexes
+    frames: watch events (xid −1) go to ``on_event``, ping replies are
+    dropped, everything else completes the pending-request FIFO (the
+    server answers requests in order)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        session_timeout_ms: int,
+        on_event: "callable",
+        on_dead: "callable",
+        connect_timeout: float = 5.0,
+    ):
+        self.sock = socket.create_connection((host, port), timeout=connect_timeout)
+        self.sock.settimeout(10.0)
+        self._send_lock = threading.Lock()
+        self._pending_lock = threading.Lock()
+        self._pending: deque = deque()  # (event, slot dict)
+        self._xid = 0
+        self._dead = threading.Event()
+        self.on_event = on_event
+        self.on_dead = on_dead
+        # ConnectRequest: protocolVersion, lastZxidSeen, timeOut,
+        # sessionId, passwd. (No readOnly byte: the 3.4-era request
+        # shape, accepted by every later server.)
+        body = struct.pack(">iqiq", 0, 0, session_timeout_ms, 0) + _pack_buf(b"\0" * 16)
+        self._send_frame(body)
+        resp = self._recv_frame()
+        r = _Reader(resp)
+        r.i32()  # protocolVersion
+        self.negotiated_timeout_ms = r.i32()
+        self.session_id = r.i64()
+        r.buf()  # passwd
+        if self.negotiated_timeout_ms <= 0:
+            raise ZkError("session rejected (negotiated timeout 0)")
+        # The reader's recv must outlast the quietest legal gap between
+        # frames (one ping interval = negotiated/3) with slack; a fixed
+        # 10 s would churn any session negotiated above ~30 s.
+        self.sock.settimeout(max(self.negotiated_timeout_ms / 1000.0 + 5.0, 10.0))
+        self._reader = threading.Thread(
+            target=self._read_loop, name="sentinel-zk-reader", daemon=True
+        )
+        self._reader.start()
+
+    # -- framing --
+    def _send_frame(self, body: bytes) -> None:
+        with self._send_lock:
+            self.sock.sendall(struct.pack(">i", len(body)) + body)
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n > 0:
+            b = self.sock.recv(n)
+            if not b:
+                raise ZkError("connection closed")
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def _recv_frame(self) -> bytes:
+        (n,) = struct.unpack(">i", self._recv_exact(4))
+        if n < 0 or n > MAX_FRAME_BYTES:
+            raise ZkError(f"bad frame length {n}")
+        return self._recv_exact(n)
+
+    # -- request/reply --
+    def request(self, op: int, payload: bytes, timeout: float = 10.0) -> Tuple[int, _Reader]:
+        """Send one request; block for its reply. Returns (err, body
+        reader positioned after the ReplyHeader)."""
+        if self._dead.is_set():
+            raise ZkError("connection dead")
+        slot = {"err": None, "body": None, "fail": None}
+        ev = threading.Event()
+        try:
+            # Enqueue AND send under one lock: the server answers in
+            # the order requests hit the wire, so the pending FIFO must
+            # match send order exactly — two concurrent callers racing
+            # between enqueue and send would desync the reply matcher
+            # and tear down the session on a phantom xid mismatch.
+            with self._pending_lock:
+                self._xid += 1
+                xid = self._xid
+                self._pending.append((ev, slot, xid))
+                self._send_frame(struct.pack(">ii", xid, op) + payload)
+        except OSError as exc:
+            self._fail(f"send failed: {exc}")
+            raise ZkError(f"send failed: {exc}")
+        if not ev.wait(timeout):
+            self._fail("request timeout")
+            raise ZkError("request timeout")
+        if slot["fail"] is not None:
+            raise ZkError(slot["fail"])
+        return slot["err"], slot["body"]
+
+    def ping(self) -> None:
+        self._send_frame(struct.pack(">ii", XID_PING, OP_PING))
+
+    def add_auth(self, scheme: str, auth: bytes) -> None:
+        self._send_frame(
+            struct.pack(">ii", XID_AUTH, OP_AUTH)
+            + struct.pack(">i", 0)
+            + _pack_str(scheme)
+            + _pack_buf(auth)
+        )
+
+    def close(self) -> None:
+        try:
+            self._send_frame(struct.pack(">ii", self._xid + 1, OP_CLOSE))
+        except OSError:
+            pass
+        self._fail("closed")
+
+    def _fail(self, why: str) -> None:
+        if self._dead.is_set():
+            return
+        self._dead.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        with self._pending_lock:
+            pending, self._pending = list(self._pending), deque()
+        for ev, slot, _xid in pending:
+            slot["fail"] = why
+            ev.set()
+        self.on_dead(why)
+
+    def _read_loop(self) -> None:
+        try:
+            while not self._dead.is_set():
+                r = _Reader(self._recv_frame())
+                xid, zxid, err = r.i32(), r.i64(), r.i32()
+                del zxid
+                if xid == XID_WATCH:
+                    ev_type = r.i32()
+                    state = r.i32()
+                    path = r.string()
+                    del state
+                    try:
+                        self.on_event(ev_type, path)
+                    except Exception:
+                        record_log.error(
+                            "[ZookeeperDataSource] watch callback failed", exc_info=True
+                        )
+                elif xid in (XID_PING, XID_AUTH):
+                    continue
+                else:
+                    with self._pending_lock:
+                        if not self._pending:
+                            raise ZkError(f"reply xid={xid} with no pending request")
+                        ev, slot, want_xid = self._pending.popleft()
+                    if xid != want_xid:
+                        slot["fail"] = f"xid mismatch ({xid} != {want_xid})"
+                        ev.set()
+                        raise ZkError(slot["fail"])
+                    slot["err"], slot["body"] = err, r
+                    ev.set()
+        except (ZkError, OSError, struct.error) as exc:
+            self._fail(str(exc))
+
+
+class ZookeeperDataSource(PushDataSource[str, T], WritableDataSource[str]):
+    """Readable + writable + watch-push ZooKeeper source for one znode.
+
+    ``ZookeeperDataSource(conv, path="/sentinel/flow")`` or the
+    Nacos-style ``ZookeeperDataSource(conv, group_id="g", data_id="d")``
+    (→ path ``/g/d``, reference ZookeeperDataSource.java:194-196).
+    ``auth`` is a list of ``(scheme, bytes)`` pairs, e.g.
+    ``[("digest", b"user:pass")]``.
+    """
+
+    def __init__(
+        self,
+        converter: Converter[str, T],
+        path: Optional[str] = None,
+        server_addr: str = "127.0.0.1:2181",
+        *,
+        group_id: Optional[str] = None,
+        data_id: Optional[str] = None,
+        session_timeout_ms: int = 10_000,
+        reconnect_interval_sec: float = 1.0,
+        request_timeout_sec: float = 10.0,
+        auth: Optional[List[Tuple[str, bytes]]] = None,
+    ) -> None:
+        super().__init__(converter)
+        if path is None:
+            if not group_id or not data_id:
+                raise ValueError("need either path or (group_id, data_id)")
+            path = f"/{group_id}/{data_id}"
+        if not path.startswith("/"):
+            path = "/" + path
+        self.path = path
+        host, _, port = server_addr.partition(":")
+        self.host, self.port = host, int(port or 2181)
+        self.session_timeout_ms = session_timeout_ms
+        self.reconnect_interval = reconnect_interval_sec
+        self.request_timeout = request_timeout_sec
+        self.auth = list(auth or [])
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._refresh_needed = threading.Event()
+        self._conn: Optional[_ZkConn] = None
+        self._conn_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --
+    def start(self) -> "ZookeeperDataSource":
+        self._thread = threading.Thread(
+            target=self._session_loop, name="sentinel-zk-session", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        with self._conn_lock:
+            conn, self._conn = self._conn, None
+        if conn is not None:
+            conn.close()
+
+    # -- datasource surface --
+    def read_source(self) -> Optional[str]:
+        """One-shot read (no watch) through the live session, or a
+        transient connection when the watcher isn't running."""
+        conn = self._conn
+        if conn is not None and not conn._dead.is_set():
+            data = self._get_data(conn, watch=False)
+        else:
+            conn = self._connect()
+            try:
+                data = self._get_data(conn, watch=False)
+            finally:
+                conn.close()
+        return None if data is None else data.decode("utf-8", errors="replace")
+
+    def write(self, value: str) -> None:
+        """setData, creating the node (and parents) when absent —
+        the persistence half the command plane needs (reference
+        WritableDataSource contract; the Java zookeeper module is
+        read-only, the etcd/consul modules set the writable shape)."""
+        data = value.encode("utf-8")
+        conn = self._conn
+        transient = conn is None or conn._dead.is_set()
+        if transient:
+            conn = self._connect()
+        try:
+            err, _ = conn.request(
+                OP_SETDATA,
+                _pack_str(self.path) + _pack_buf(data) + struct.pack(">i", -1),
+                self.request_timeout,
+            )
+            if err == ERR_NONODE:
+                self._create_recursive(conn, self.path, data)
+            elif err != ERR_OK:
+                raise ZkError(f"setData failed (err={err})", err)
+        finally:
+            if transient:
+                conn.close()
+
+    # -- internals --
+    def _connect(self) -> _ZkConn:
+        conn = _ZkConn(
+            self.host,
+            self.port,
+            self.session_timeout_ms,
+            on_event=self._on_watch_event,
+            on_dead=self._on_conn_dead,
+        )
+        for scheme, creds in self.auth:
+            conn.add_auth(scheme, creds)
+        return conn
+
+    def _create_recursive(self, conn: _ZkConn, path: str, data: bytes) -> None:
+        parts = [p for p in path.split("/") if p]
+        acc = ""
+        for i, part in enumerate(parts):
+            acc += "/" + part
+            node_data = data if i == len(parts) - 1 else b""
+            acl = b"".join(
+                struct.pack(">i", perms) + _pack_str(scheme) + _pack_str(ident)
+                for perms, scheme, ident in _OPEN_ACL
+            )
+            payload = (
+                _pack_str(acc)
+                + _pack_buf(node_data)
+                + struct.pack(">i", len(_OPEN_ACL))
+                + acl
+                + struct.pack(">i", 0)  # flags: persistent
+            )
+            err, _ = conn.request(OP_CREATE, payload, self.request_timeout)
+            if err == ERR_NODEEXISTS:
+                if i == len(parts) - 1:
+                    # Lost the create race — land the data via setData.
+                    err2, _ = conn.request(
+                        OP_SETDATA,
+                        _pack_str(acc) + _pack_buf(node_data) + struct.pack(">i", -1),
+                        self.request_timeout,
+                    )
+                    if err2 != ERR_OK:
+                        raise ZkError(f"setData after create race (err={err2})", err2)
+                continue
+            if err != ERR_OK:
+                raise ZkError(f"create {acc} failed (err={err})", err)
+
+    def _get_data(self, conn: _ZkConn, watch: bool) -> Optional[bytes]:
+        """getData; on NoNode optionally arm a creation watch via
+        exists and return None (the reference's NodeCache equivalent)."""
+        err, r = conn.request(
+            OP_GETDATA,
+            _pack_str(self.path) + (b"\x01" if watch else b"\x00"),
+            self.request_timeout,
+        )
+        if err == ERR_OK:
+            data = r.buf()
+            _read_stat(r)
+            return data
+        if err == ERR_NONODE:
+            if watch:
+                err2, _ = conn.request(
+                    OP_EXISTS, _pack_str(self.path) + b"\x01", self.request_timeout
+                )
+                if err2 not in (ERR_OK, ERR_NONODE):
+                    raise ZkError(f"exists failed (err={err2})", err2)
+            return None
+        raise ZkError(f"getData failed (err={err})", err)
+
+    def _on_watch_event(self, ev_type: int, path: str) -> None:
+        if path != self.path:
+            return
+        if ev_type in (EVT_NODE_CREATED, EVT_NODE_DELETED, EVT_NODE_DATA_CHANGED):
+            self._refresh_needed.set()
+            self._wake.set()
+
+    def _on_conn_dead(self, why: str) -> None:
+        record_log.warn(f"[ZookeeperDataSource] connection lost: {why}")
+        self._wake.set()
+
+    def _session_loop(self) -> None:
+        backoff = self.reconnect_interval
+        while not self._stop.is_set():
+            try:
+                conn = self._connect()
+            except (OSError, ZkError) as exc:
+                record_log.warn(f"[ZookeeperDataSource] connect failed: {exc}")
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, 30.0)
+                continue
+            backoff = self.reconnect_interval
+            with self._conn_lock:
+                if self._stop.is_set():
+                    conn.close()
+                    return
+                self._conn = conn
+            ping_interval = max(conn.negotiated_timeout_ms / 3000.0, 0.5)
+            try:
+                # Catch-up read: (re)arming the watch and reading in one
+                # call means an outage can never swallow an update.
+                self._refresh(conn)
+                last_ping = time.monotonic()
+                while not self._stop.is_set() and not conn._dead.is_set():
+                    self._wake.wait(timeout=ping_interval / 2)
+                    self._wake.clear()
+                    if self._stop.is_set() or conn._dead.is_set():
+                        break
+                    if self._refresh_needed.is_set():
+                        self._refresh_needed.clear()
+                        self._refresh(conn)
+                    if time.monotonic() - last_ping >= ping_interval:
+                        conn.ping()
+                        last_ping = time.monotonic()
+            except (OSError, ZkError) as exc:
+                record_log.warn(f"[ZookeeperDataSource] session error: {exc}")
+            finally:
+                with self._conn_lock:
+                    if self._conn is conn:
+                        self._conn = None
+                conn.close()
+            if self._stop.wait(self.reconnect_interval):
+                return
+
+    def _refresh(self, conn: _ZkConn) -> None:
+        data = self._get_data(conn, watch=True)
+        raw = None if data is None else data.decode("utf-8", errors="replace")
+        if raw is None:
+            record_log.warn(
+                f"[ZookeeperDataSource] node {self.path} absent — pushing None "
+                "(reference warns on null initial config)"
+            )
+        self.on_update(raw)
