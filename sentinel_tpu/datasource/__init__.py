@@ -23,7 +23,9 @@ sentinel-datasource-consul/.../ConsulDataSource.java:38),
 sentinel-datasource-nacos/.../NacosDataSource.java:42) and
 :class:`ZookeeperDataSource` (jute wire protocol: znode read + data
 watch + session keepalive —
-sentinel-datasource-zookeeper/.../ZookeeperDataSource.java:43).
+sentinel-datasource-zookeeper/.../ZookeeperDataSource.java:43) and
+:class:`ApolloDataSource` (namespace property fetch + notifications
+long-poll — sentinel-datasource-apollo/.../ApolloDataSource.java:25).
 """
 
 from sentinel_tpu.datasource.base import (
@@ -41,6 +43,7 @@ from sentinel_tpu.datasource.file_source import (
     FileRefreshableDataSource,
     FileWritableDataSource,
 )
+from sentinel_tpu.datasource.apollo_source import ApolloDataSource
 from sentinel_tpu.datasource.consul_source import ConsulDataSource
 from sentinel_tpu.datasource.etcd_source import EtcdDataSource
 from sentinel_tpu.datasource.http_source import HttpDataSource, HttpLongPollDataSource
@@ -50,6 +53,7 @@ from sentinel_tpu.datasource.zookeeper_source import ZookeeperDataSource
 
 __all__ = [
     "AbstractDataSource",
+    "ApolloDataSource",
     "ConsulDataSource",
     "EtcdDataSource",
     "NacosDataSource",

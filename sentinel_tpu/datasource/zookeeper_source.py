@@ -163,23 +163,34 @@ class _ZkConn:
         self._dead = threading.Event()
         self.on_event = on_event
         self.on_dead = on_dead
-        # ConnectRequest: protocolVersion, lastZxidSeen, timeOut,
-        # sessionId, passwd. (No readOnly byte: the 3.4-era request
-        # shape, accepted by every later server.)
-        body = struct.pack(">iqiq", 0, 0, session_timeout_ms, 0) + _pack_buf(b"\0" * 16)
-        self._send_frame(body)
-        resp = self._recv_frame()
-        r = _Reader(resp)
-        r.i32()  # protocolVersion
-        self.negotiated_timeout_ms = r.i32()
-        self.session_id = r.i64()
-        r.buf()  # passwd
-        if self.negotiated_timeout_ms <= 0:
-            raise ZkError("session rejected (negotiated timeout 0)")
-        # The reader's recv must outlast the quietest legal gap between
-        # frames (one ping interval = negotiated/3) with slack; a fixed
-        # 10 s would churn any session negotiated above ~30 s.
-        self.sock.settimeout(max(self.negotiated_timeout_ms / 1000.0 + 5.0, 10.0))
+        try:
+            # ConnectRequest: protocolVersion, lastZxidSeen, timeOut,
+            # sessionId, passwd. (No readOnly byte: the 3.4-era request
+            # shape, accepted by every later server.)
+            body = struct.pack(">iqiq", 0, 0, session_timeout_ms, 0) + _pack_buf(
+                b"\0" * 16
+            )
+            self._send_frame(body)
+            resp = self._recv_frame()
+            r = _Reader(resp)
+            r.i32()  # protocolVersion
+            self.negotiated_timeout_ms = r.i32()
+            self.session_id = r.i64()
+            r.buf()  # passwd
+            if self.negotiated_timeout_ms <= 0:
+                raise ZkError("session rejected (negotiated timeout 0)")
+            # The reader's recv must outlast the quietest legal gap
+            # between frames (one ping interval = negotiated/3) with
+            # slack; a fixed 10 s would churn any session negotiated
+            # above ~30 s.
+            self.sock.settimeout(max(self.negotiated_timeout_ms / 1000.0 + 5.0, 10.0))
+        except BaseException:
+            # A failed handshake must not strand the fd on GC.
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            raise
         self._reader = threading.Thread(
             target=self._read_loop, name="sentinel-zk-reader", daemon=True
         )
@@ -361,6 +372,11 @@ class ZookeeperDataSource(PushDataSource[str, T], WritableDataSource[str]):
             conn, self._conn = self._conn, None
         if conn is not None:
             conn.close()
+        # Join-on-close, like the long-poll sources: after close()
+        # returns, no session thread is still reconnecting or pushing.
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
 
     # -- datasource surface --
     def read_source(self) -> Optional[str]:
@@ -410,8 +426,12 @@ class ZookeeperDataSource(PushDataSource[str, T], WritableDataSource[str]):
             on_event=self._on_watch_event,
             on_dead=self._on_conn_dead,
         )
-        for scheme, creds in self.auth:
-            conn.add_auth(scheme, creds)
+        try:
+            for scheme, creds in self.auth:
+                conn.add_auth(scheme, creds)
+        except BaseException:
+            conn.close()  # don't strand a handshaken conn + reader
+            raise
         return conn
 
     def _create_recursive(self, conn: _ZkConn, path: str, data: bytes) -> None:

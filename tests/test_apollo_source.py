@@ -1,0 +1,206 @@
+"""ApolloDataSource against a fake in-process Apollo config service
+(real HTTP: /configs fetch with releaseKey 304s, /notifications/v2
+long-poll) — same approach as the etcd/Consul/Nacos fakes.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+import pytest
+
+from sentinel_tpu.datasource.apollo_source import ApolloDataSource
+from sentinel_tpu.datasource.base import json_converter
+from sentinel_tpu.models.rules import FlowRule
+
+
+class FakeApollo(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self):
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self.port = self.server_address[1]
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.configurations = {}  # namespace -> {key: value}
+        self.release = 0
+        self.notification_id = 0
+        self.hold_sec = 10.0  # fake's max hold (kept short for tests)
+
+    def set_prop(self, namespace: str, key: str, value: str):
+        with self.cond:
+            self.configurations.setdefault(namespace, {})[key] = value
+            self.release += 1
+            self.notification_id += 1
+            self.cond.notify_all()
+
+    def drop_namespace(self, namespace: str):
+        with self.cond:
+            self.configurations.pop(namespace, None)
+            self.release += 1
+            self.notification_id += 1
+            self.cond.notify_all()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _json(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv: FakeApollo = self.server
+        u = urlsplit(self.path)
+        parts = u.path.strip("/").split("/")
+        if parts[0] == "configs" and len(parts) == 4:
+            _, app_id, cluster, namespace = parts
+            del app_id, cluster
+            q = parse_qs(u.query)
+            with srv.lock:
+                cfg = srv.configurations.get(namespace)
+                release_key = f"rk-{srv.release}"
+            if cfg is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            if q.get("releaseKey", [""])[0] == release_key:
+                self.send_response(304)
+                self.end_headers()
+                return
+            self._json(
+                {
+                    "appId": parts[1],
+                    "cluster": parts[2],
+                    "namespaceName": namespace,
+                    "configurations": cfg,
+                    "releaseKey": release_key,
+                }
+            )
+        elif parts[0] == "notifications":
+            q = parse_qs(u.query)
+            notifications = json.loads(q.get("notifications", ["[]"])[0])
+            want = {n["namespaceName"]: n["notificationId"] for n in notifications}
+            deadline = time.monotonic() + srv.hold_sec
+            with srv.cond:
+                while time.monotonic() < deadline:
+                    if any(nid != srv.notification_id for nid in want.values()):
+                        break
+                    srv.cond.wait(timeout=0.1)
+                else:
+                    self.send_response(304)
+                    self.end_headers()
+                    return
+                out = [
+                    {"namespaceName": ns, "notificationId": srv.notification_id}
+                    for ns in want
+                ]
+            self._json(out)
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+
+def _rules_json(count):
+    return json.dumps([{"resource": "apres", "count": count}])
+
+
+@pytest.fixture()
+def fake_apollo():
+    srv = FakeApollo()
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _wait(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _src(fake_apollo, **kw):
+    kw.setdefault("namespace_name", "application")
+    kw.setdefault("rule_key", "flowRules")
+    return ApolloDataSource(
+        json_converter(FlowRule),
+        endpoint=f"http://127.0.0.1:{fake_apollo.port}",
+        reconnect_interval_sec=0.1,
+        **kw,
+    )
+
+
+def _value_count(src):
+    v = src.get_property().value
+    return v[0].count if v else None
+
+
+class TestApolloDataSource:
+    def test_initial_load_and_notification_push(self, fake_apollo):
+        fake_apollo.set_prop("application", "flowRules", _rules_json(7))
+        src = _src(fake_apollo).start()
+        try:
+            assert _wait(lambda: _value_count(src) == 7)
+            # A namespace release advances the notification id; the
+            # long-poll returns early and the re-fetch lands the value.
+            fake_apollo.set_prop("application", "flowRules", _rules_json(9))
+            assert _wait(lambda: _value_count(src) == 9)
+        finally:
+            src.close()
+
+    def test_missing_key_falls_back_to_default(self, fake_apollo):
+        fake_apollo.set_prop("application", "otherKey", "x")
+        src = _src(fake_apollo, default_rule_value=_rules_json(3)).start()
+        try:
+            assert _wait(lambda: _value_count(src) == 3)
+        finally:
+            src.close()
+
+    def test_missing_namespace_falls_back_to_default(self, fake_apollo):
+        src = _src(fake_apollo, default_rule_value=_rules_json(2)).start()
+        try:
+            assert _wait(lambda: _value_count(src) == 2)
+            # Namespace appears later → notification → real value.
+            fake_apollo.set_prop("application", "flowRules", _rules_json(5))
+            assert _wait(lambda: _value_count(src) == 5)
+        finally:
+            src.close()
+
+    def test_release_key_304_keeps_value(self, fake_apollo):
+        fake_apollo.set_prop("application", "flowRules", _rules_json(4))
+        src = _src(fake_apollo)
+        assert src.read_source() == _rules_json(4)
+        # Same releaseKey → 304 → the cached raw comes back unchanged.
+        assert src.read_source() == _rules_json(4)
+
+    def test_rules_flow_into_manager(self, fake_apollo, manual_clock, engine):
+        import sentinel_tpu as st
+
+        fake_apollo.set_prop(
+            "application", "flowRules",
+            json.dumps([{"resource": "apflow", "count": 0}]),
+        )
+        src = _src(fake_apollo).start()
+        try:
+            st.flow_rule_manager.register_property(src.get_property())
+            assert _wait(
+                lambda: any(r.resource == "apflow"
+                            for r in st.flow_rule_manager.get_rules() or [])
+            )
+            with pytest.raises(st.FlowBlockError):
+                with st.entry("apflow"):
+                    pass
+        finally:
+            src.close()
