@@ -25,7 +25,13 @@ sentinel-datasource-nacos/.../NacosDataSource.java:42) and
 watch + session keepalive —
 sentinel-datasource-zookeeper/.../ZookeeperDataSource.java:43) and
 :class:`ApolloDataSource` (namespace property fetch + notifications
-long-poll — sentinel-datasource-apollo/.../ApolloDataSource.java:25).
+long-poll — sentinel-datasource-apollo/.../ApolloDataSource.java:25),
+:class:`EurekaDataSource` (instance-metadata polling with multi-server
+failover — sentinel-datasource-eureka/.../EurekaDataSource.java:81) and
+:class:`ConfigServerDataSource` (Spring Cloud Config Server environment
+API — sentinel-datasource-spring-cloud-config/.../
+SpringCloudConfigDataSource.java:41) — every config-center class the
+reference ships now has a wire-level counterpart.
 """
 
 from sentinel_tpu.datasource.base import (
@@ -44,8 +50,10 @@ from sentinel_tpu.datasource.file_source import (
     FileWritableDataSource,
 )
 from sentinel_tpu.datasource.apollo_source import ApolloDataSource
+from sentinel_tpu.datasource.config_server_source import ConfigServerDataSource
 from sentinel_tpu.datasource.consul_source import ConsulDataSource
 from sentinel_tpu.datasource.etcd_source import EtcdDataSource
+from sentinel_tpu.datasource.eureka_source import EurekaDataSource
 from sentinel_tpu.datasource.http_source import HttpDataSource, HttpLongPollDataSource
 from sentinel_tpu.datasource.nacos_source import NacosDataSource
 from sentinel_tpu.datasource.redis_source import RedisDataSource
@@ -54,8 +62,10 @@ from sentinel_tpu.datasource.zookeeper_source import ZookeeperDataSource
 __all__ = [
     "AbstractDataSource",
     "ApolloDataSource",
+    "ConfigServerDataSource",
     "ConsulDataSource",
     "EtcdDataSource",
+    "EurekaDataSource",
     "NacosDataSource",
     "HttpDataSource",
     "HttpLongPollDataSource",

@@ -31,6 +31,18 @@ T = TypeVar("T")  # target (rules) type
 
 Converter = Callable[[S], T]
 
+# Shared response-size stance for every network source: a corrupted or
+# hostile peer must not balloon memory.
+DEFAULT_MAX_BODY_BYTES = 16 * 1024 * 1024
+
+
+def read_capped(resp, max_bytes: int = DEFAULT_MAX_BODY_BYTES) -> bytes:
+    """Read an HTTP response body, raising when it exceeds the cap."""
+    data = resp.read(max_bytes + 1)
+    if len(data) > max_bytes:
+        raise ValueError("response exceeds size cap")
+    return data
+
 
 def json_converter(rule_cls: type) -> Converter[str, List]:
     """Raw JSON string -> list of rules of ``rule_cls`` (accepts the

@@ -1,0 +1,79 @@
+"""Spring Cloud Config Server dynamic datasource.
+
+The reference's sentinel-datasource-spring-cloud-config module
+(sentinel-extension/sentinel-datasource-spring-cloud-config/.../
+SpringCloudConfigDataSource.java:41-80, SentinelRuleLocator.java:68-145)
+reads one rule key out of the config-server-backed Spring environment:
+a PropertySourceLocator fetches ``/{application}/{profile}[/{label}]``,
+stores the merged properties, and a git-webhook-driven ``refresh()``
+re-fetches. Without a Spring runtime the equivalent surface is the
+config server's own HTTP API, spoken directly:
+
+* ``GET {server}/{application}/{profile}[/{label}]`` → JSON
+  ``{"propertySources": [{"name":..., "source": {key: value}}, ...]}``
+  where EARLIER property sources win (Spring's precedence order);
+* ``refresh()`` — the webhook analog — forces an immediate re-fetch
+  and push, on top of the regular polling loop.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from sentinel_tpu.datasource.base import (
+    AutoRefreshDataSource,
+    Converter,
+    T,
+    read_capped,
+)
+
+
+class ConfigServerDataSource(AutoRefreshDataSource[str, T]):
+    """Polls one rule key of a Spring Cloud Config Server environment;
+    ``refresh()`` (inherited) is the webhook hook."""
+
+    def __init__(
+        self,
+        converter: Converter[str, T],
+        application: str,
+        rule_key: str,
+        profile: str = "default",
+        label: Optional[str] = None,
+        endpoint: str = "http://127.0.0.1:8888",
+        refresh_interval_sec: float = 10.0,
+        timeout_sec: float = 5.0,
+    ) -> None:
+        super().__init__(converter, refresh_interval_sec)
+        if not application or not rule_key:
+            raise ValueError("application and rule_key are required")
+        self.application = application
+        self.rule_key = rule_key
+        self.profile = profile
+        self.label = label
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout = timeout_sec
+
+    def read_source(self) -> Optional[str]:
+        # safe="": a '/' in any segment must be escaped, or the config
+        # server mis-parses the path (Spring's own convention for
+        # slashes in git-branch labels is the '(_)' substitution, which
+        # callers can use verbatim — it needs no escaping).
+        quote = lambda s: urllib.parse.quote(s, safe="")  # noqa: E731
+        path = f"/{quote(self.application)}/{quote(self.profile)}"
+        if self.label:
+            path += f"/{quote(self.label)}"
+        with urllib.request.urlopen(self.endpoint + path, timeout=self.timeout) as resp:
+            body = read_capped(resp)
+        data = json.loads(body.decode("utf-8"))
+        # Spring precedence: the FIRST property source containing the
+        # key wins (SentinelRuleStorage stores the composite env the
+        # locator built in that order).
+        for ps in data.get("propertySources") or []:
+            source = ps.get("source") or {}
+            if self.rule_key in source:
+                value = source[self.rule_key]
+                return value if isinstance(value, str) else json.dumps(value)
+        return None

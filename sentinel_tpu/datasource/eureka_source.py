@@ -1,0 +1,80 @@
+"""Eureka instance-metadata dynamic datasource.
+
+The reference's EurekaDataSource (sentinel-extension/
+sentinel-datasource-eureka/src/main/java/com/alibaba/csp/sentinel/
+datasource/eureka/EurekaDataSource.java:81-170) is an
+AutoRefreshDataSource that polls ``GET {serviceUrl}apps/{appId}/
+{instanceId}`` (JSON), extracts ``instance.metadata[ruleKey]``, and
+shuffles across the configured server list retrying the next server on
+any failure. Same protocol here, dependency-free.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import List, Optional, Sequence
+
+from sentinel_tpu.datasource.base import (
+    AutoRefreshDataSource,
+    Converter,
+    T,
+    read_capped,
+)
+from sentinel_tpu.utils.record_log import record_log
+
+
+class EurekaDataSource(AutoRefreshDataSource[str, T]):
+    """Polls one Eureka instance's metadata for the rule key, with
+    multi-server failover."""
+
+    def __init__(
+        self,
+        converter: Converter[str, T],
+        app_id: str,
+        instance_id: str,
+        service_urls: Sequence[str],
+        rule_key: str,
+        refresh_interval_sec: float = 10.0,
+        timeout_sec: float = 3.0,
+    ) -> None:
+        super().__init__(converter, refresh_interval_sec)
+        if not app_id or not instance_id or not rule_key:
+            raise ValueError("app_id, instance_id and rule_key are required")
+        urls = [u.strip().rstrip("/") for u in service_urls if u and u.strip()]
+        if not urls:
+            raise ValueError("service_urls is empty")
+        self.app_id = app_id
+        self.instance_id = instance_id
+        self.service_urls: List[str] = urls
+        self.rule_key = rule_key
+        self.timeout = timeout_sec
+
+    def read_source(self) -> Optional[str]:
+        """Try each server (shuffled, like the reference) until one
+        answers; raise only when every server failed."""
+        shuffled = list(self.service_urls)
+        random.shuffle(shuffled)
+        last_exc: Optional[Exception] = None
+        app = urllib.parse.quote(self.app_id, safe="")
+        inst = urllib.parse.quote(self.instance_id, safe="")
+        for base in shuffled:
+            url = f"{base}/apps/{app}/{inst}"
+            req = urllib.request.Request(
+                url, headers={"Accept": "application/json;charset=utf-8"}
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    body = read_capped(resp)
+                data = json.loads(body.decode("utf-8"))
+                metadata = (data.get("instance") or {}).get("metadata") or {}
+                return metadata.get(self.rule_key)
+            except Exception as exc:  # noqa: BLE001 — try the next server
+                last_exc = exc
+                record_log.warn(
+                    f"[EurekaDataSource] {url} failed ({exc}); trying next server"
+                )
+        raise RuntimeError(f"all eureka servers failed: {last_exc}")
