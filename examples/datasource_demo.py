@@ -1,0 +1,89 @@
+"""Dynamic rule reloads through the datasource plane — the reference's
+sentinel-demo-dynamic-file-rule shape: rules live in a JSON file, a
+FileRefreshableDataSource feeds the flow rule manager, edits to the
+file change live verdicts without touching the app, and rule pushes
+persist back through a FileWritableDataSource.
+
+The same `register_property` wiring works for every network source
+(Redis/etcd/Consul/Nacos/ZooKeeper/Apollo/Eureka/Config Server) — the
+file source is just the one that needs no external server.
+"""
+
+import _bootstrap  # noqa: F401
+
+import json
+import os
+import tempfile
+import time
+
+import sentinel_tpu as st
+from sentinel_tpu.datasource import (
+    FileRefreshableDataSource,
+    FileWritableDataSource,
+    WritableDataSourceRegistry,
+    json_converter,
+)
+
+DURATION = float(os.environ.get("SENTINEL_DEMO_DURATION", 6))
+RESOURCE = "dynamicRes"
+
+with tempfile.TemporaryDirectory() as tmp:
+    path = os.path.join(tmp, "flow-rules.json")
+    with open(path, "w") as f:
+        json.dump([{"resource": RESOURCE, "count": 2}], f)
+
+    src = FileRefreshableDataSource(
+        path, json_converter(st.FlowRule), refresh_interval_sec=0.2
+    ).start()
+    st.flow_rule_manager.register_property(src.get_property())
+    # The registry hands writers RULE OBJECTS (the command plane's
+    # setRules push) — the encoder serializes them back to the file's
+    # JSON shape so the refreshable side can re-read them.
+    WritableDataSourceRegistry.register(
+        "flow",
+        FileWritableDataSource(
+            path, encoder=lambda rules: json.dumps([r.to_dict() for r in rules])
+        ),
+    )
+
+    def offered(n: int) -> int:
+        admitted = 0
+        for _ in range(n):
+            e = st.try_entry(RESOURCE)
+            if e is not None:
+                admitted += 1
+                e.exit()  # release the thread slot + context stack
+        return admitted
+
+    print(f"rules file: {path}")
+    time.sleep(0.5)  # initial load
+    warm = st.try_entry(RESOURCE)  # warm the kernel (first flush compiles)
+    if warm is not None:
+        warm.exit()
+    st.get_engine().flush()  # also compile the entry+exit batch shape
+    time.sleep(1.1)  # fresh QPS window after the warm-up entry
+    print(f"count=2 → admitted {offered(6)}/6 this second")
+
+    # "Operator edits the file" — the poll picks it up.
+    with open(path, "w") as f:
+        json.dump([{"resource": RESOURCE, "count": 5}], f)
+    deadline = time.monotonic() + min(DURATION, 5)
+    while time.monotonic() < deadline:
+        rules = st.flow_rule_manager.get_rules() or []
+        if any(r.count == 5 for r in rules):
+            break
+        time.sleep(0.05)
+    else:
+        print("WARNING: file edit never reached the manager — "
+              "the next line measures the OLD rule")
+    time.sleep(1.0)  # fresh QPS window
+    print(f"count=5 → admitted {offered(8)}/8 this second")
+
+    # Rule push persisting back to the file (the command plane's hop:
+    # the registry hands the writer rule objects).
+    WritableDataSourceRegistry.try_write(
+        "flow", [st.FlowRule(RESOURCE, count=3)]
+    )
+    print("persisted via WritableDataSourceRegistry:", open(path).read())
+    src.close()
+print("done — live reload + persistence, no app restart")
