@@ -194,3 +194,52 @@ class TestConfigServerDataSource:
             endpoint=f"http://127.0.0.1:{srv.port}",
         )
         assert src.read_source() is None
+
+class TestGarbageConfigNeverClobbers:
+    """A corrupted payload must leave the last good rules in place —
+    the reference's converter exceptions are swallowed by the listener
+    (AutoRefreshDataSource.java:53-69 logs and keeps the old value);
+    same stance across every new source's error path."""
+
+    def test_eureka_garbage_keeps_rules(self, fake_http):
+        srv = fake_http()
+        srv.routes["/apps/a/i"] = _eureka_payload(7)
+        src = EurekaDataSource(
+            json_converter(FlowRule), "a", "i",
+            [f"http://127.0.0.1:{srv.port}"], "flowRules",
+            refresh_interval_sec=0.05,
+        ).start()
+        try:
+            assert _wait(lambda: (src.get_property().value or [None])[0]
+                         and src.get_property().value[0].count == 7)
+            # Metadata turns to garbage: converter raises every poll.
+            srv.routes["/apps/a/i"] = {
+                "instance": {"metadata": {"flowRules": "{not json"}}}
+            time.sleep(0.3)
+            assert src.get_property().value[0].count == 7  # unchanged
+            # Recovery: good payload lands again.
+            srv.routes["/apps/a/i"] = _eureka_payload(9)
+            assert _wait(lambda: src.get_property().value[0].count == 9)
+        finally:
+            src.close()
+
+    def test_config_server_garbage_keeps_rules(self, fake_http):
+        srv = fake_http()
+        srv.routes["/myapp/default"] = {
+            "propertySources": [{"name": "s", "source": {"flowRules": _rules_json(5)}}]
+        }
+        src = ConfigServerDataSource(
+            json_converter(FlowRule), "myapp", "flowRules",
+            endpoint=f"http://127.0.0.1:{srv.port}",
+            refresh_interval_sec=0.05,
+        ).start()
+        try:
+            assert _wait(lambda: (src.get_property().value or [None])[0]
+                         and src.get_property().value[0].count == 5)
+            srv.routes["/myapp/default"] = {
+                "propertySources": [{"name": "s", "source": {"flowRules": "]["}}]
+            }
+            time.sleep(0.3)
+            assert src.get_property().value[0].count == 5  # unchanged
+        finally:
+            src.close()
