@@ -86,6 +86,11 @@ def _eureka_payload(count):
     return {"instance": {"metadata": {"flowRules": _rules_json(count)}}}
 
 
+def _env(*sources):
+    return {"propertySources": [{"name": f"s{i}", "source": s}
+                                for i, s in enumerate(sources)]}
+
+
 class TestEurekaDataSource:
     def test_poll_updates(self, fake_http):
         srv = fake_http()
@@ -142,13 +147,9 @@ class TestEurekaDataSource:
 
 
 class TestConfigServerDataSource:
-    def _env(self, *sources):
-        return {"propertySources": [{"name": f"s{i}", "source": s}
-                                    for i, s in enumerate(sources)]}
-
     def test_poll_and_refresh(self, fake_http):
         srv = fake_http()
-        srv.routes["/myapp/default"] = self._env({"flowRules": _rules_json(5)})
+        srv.routes["/myapp/default"] = _env({"flowRules": _rules_json(5)})
         src = ConfigServerDataSource(
             json_converter(FlowRule), "myapp", "flowRules",
             endpoint=f"http://127.0.0.1:{srv.port}",
@@ -157,7 +158,7 @@ class TestConfigServerDataSource:
         try:
             assert _wait(lambda: (src.get_property().value or [None])[0]
                          and src.get_property().value[0].count == 5)
-            srv.routes["/myapp/default"] = self._env({"flowRules": _rules_json(8)})
+            srv.routes["/myapp/default"] = _env({"flowRules": _rules_json(8)})
             src.refresh()  # the git-webhook analog
             assert src.get_property().value[0].count == 8
         finally:
@@ -165,7 +166,7 @@ class TestConfigServerDataSource:
 
     def test_first_property_source_wins(self, fake_http):
         srv = fake_http()
-        srv.routes["/myapp/prod/main"] = self._env(
+        srv.routes["/myapp/prod/main"] = _env(
             {"flowRules": _rules_json(1)}, {"flowRules": _rules_json(99)}
         )
         src = ConfigServerDataSource(
@@ -177,7 +178,7 @@ class TestConfigServerDataSource:
 
     def test_non_string_value_is_json_encoded(self, fake_http):
         srv = fake_http()
-        srv.routes["/myapp/default"] = self._env(
+        srv.routes["/myapp/default"] = _env(
             {"flowRules": [{"resource": "r", "count": 3}]}
         )
         src = ConfigServerDataSource(
@@ -188,7 +189,7 @@ class TestConfigServerDataSource:
 
     def test_missing_key_is_none(self, fake_http):
         srv = fake_http()
-        srv.routes["/myapp/default"] = self._env({"other": "x"})
+        srv.routes["/myapp/default"] = _env({"other": "x"})
         src = ConfigServerDataSource(
             json_converter(FlowRule), "myapp", "flowRules",
             endpoint=f"http://127.0.0.1:{srv.port}",
@@ -213,10 +214,12 @@ class TestGarbageConfigNeverClobbers:
             assert _wait(lambda: (src.get_property().value or [None])[0]
                          and src.get_property().value[0].count == 7)
             # Metadata turns to garbage: converter raises every poll.
+            hits = srv.hits
             srv.routes["/apps/a/i"] = {
                 "instance": {"metadata": {"flowRules": "{not json"}}}
-            time.sleep(0.3)
-            assert src.get_property().value[0].count == 7  # unchanged
+            # Provably at least two garbage polls happened...
+            assert _wait(lambda: srv.hits >= hits + 2)
+            assert src.get_property().value[0].count == 7  # ...unchanged
             # Recovery: good payload lands again.
             srv.routes["/apps/a/i"] = _eureka_payload(9)
             assert _wait(lambda: src.get_property().value[0].count == 9)
@@ -236,10 +239,12 @@ class TestGarbageConfigNeverClobbers:
         try:
             assert _wait(lambda: (src.get_property().value or [None])[0]
                          and src.get_property().value[0].count == 5)
-            srv.routes["/myapp/default"] = {
-                "propertySources": [{"name": "s", "source": {"flowRules": "]["}}]
-            }
-            time.sleep(0.3)
+            hits = srv.hits
+            srv.routes["/myapp/default"] = _env({"flowRules": "]["})
+            assert _wait(lambda: srv.hits >= hits + 2)
             assert src.get_property().value[0].count == 5  # unchanged
+            # And the source is not stuck: a good payload recovers it.
+            srv.routes["/myapp/default"] = _env({"flowRules": _rules_json(6)})
+            assert _wait(lambda: src.get_property().value[0].count == 6)
         finally:
             src.close()

@@ -354,3 +354,22 @@ class TestZookeeperDataSource:
                     pass
         finally:
             src.close()
+
+def test_garbage_rule_payload_keeps_rules(fake_zk):
+    """Converter-level garbage (valid frame, invalid JSON in the znode)
+    must not clobber the last good rules — PushDataSource.on_update
+    swallows convert errors (base.py), matching the reference listener
+    stance. Distinct from the corrupted-FRAME test above (transport)."""
+    fake_zk.set_data("/sentinel/flow", _rules_json(5).encode())
+    src = _src(fake_zk).start()
+    try:
+        assert _wait(lambda: (src.get_property().value or [None])[0]
+                     and src.get_property().value[0].count == 5)
+        fake_zk.set_data("/sentinel/flow", b"{definitely not json")
+        # The watch fires and the bad payload is converted (and
+        # rejected); rules stay. Then a good payload recovers.
+        fake_zk.set_data("/sentinel/flow", _rules_json(8).encode())
+        assert _wait(lambda: src.get_property().value[0].count == 8)
+        assert all(v is not None for v in [src.get_property().value])
+    finally:
+        src.close()
