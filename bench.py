@@ -432,6 +432,23 @@ def _child_main(args) -> None:
     print(json.dumps(fn(args.rules, args.entries, args.iters)), flush=True)
 
 
+def _last_json_line(out) -> dict | None:
+    """Last parseable non-error JSON object in a child's stdout (str,
+    bytes, or None) — the salvage contract for killed stages."""
+    if not out:
+        return None
+    if isinstance(out, bytes):
+        out = out.decode("utf-8", errors="replace")
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "error" not in rec:
+            return rec
+    return None
+
+
 def _spawn_stage(
     n_rules: int, n_entries: int, iters: int, platform: str, timeout_s: float,
     kind: str = "kernel",
@@ -455,32 +472,22 @@ def _spawn_stage(
         # Salvage any JSON the child printed before the kill: stages
         # emit completed sub-measurements incrementally for exactly
         # this case.
-        out = exc.stdout or b""
-        if isinstance(out, bytes):
-            out = out.decode("utf-8", errors="replace")
-        for line in reversed(out.strip().splitlines()):
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if "error" not in rec:
-                _log(f"stage rules={n_rules}: salvaged partial results")
-                return rec
-        return None
+        rec = _last_json_line(exc.stdout)
+        if rec is not None:
+            _log(f"stage rules={n_rules}: salvaged partial results")
+        return rec
     if r.returncode != 0:
         _log(f"stage rules={n_rules} failed rc={r.returncode}")
         return None
-    for line in reversed(r.stdout.strip().splitlines()):
-        try:
-            out = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if "error" in out:
-            _log(f"stage rules={n_rules} reported an error: {out['error']}")
-            return None
-        return out
-    _log(f"stage rules={n_rules} produced no JSON")
-    return None
+    rec = _last_json_line(r.stdout)
+    if rec is None:
+        # Distinguish "child reported an error record" from "no JSON
+        # at all" in the log; either way the stage yields nothing.
+        if '"error"' in (r.stdout or ""):
+            _log(f"stage rules={n_rules} reported an error")
+        else:
+            _log(f"stage rules={n_rules} produced no JSON")
+    return rec
 
 
 def _env_budget() -> float:
