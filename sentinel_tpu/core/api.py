@@ -122,16 +122,18 @@ def reset(clock: Optional[Clock] = None) -> Engine:
     with _engine_lock:
         global _engine
         if _engine is not None:
-            # Settle dispatched-but-unfetched flush_async chunks before
-            # discarding the engine — their block-log records belong to
-            # the pre-reset world, not to whenever a holder of an old
-            # op happens to read its verdict (Engine.reset does the
-            # same for in-place resets).
+            # Quiesce the old engine before discarding it: stop its
+            # auto-flusher (an orphaned daemon would poll — and pin —
+            # the old engine for the process lifetime), DECIDE anything
+            # still queued (a deferred-mode submitter polling
+            # op.verdict must not be stranded undecided), and settle
+            # dispatched-but-unfetched flush_async chunks so their
+            # block-log records land in the pre-reset world.
             try:
-                _engine.drain()
+                _engine.close()
             except Exception:
                 record_log.error(
-                    "[api.reset] settling pre-reset async flushes failed",
+                    "[api.reset] quiescing the pre-reset engine failed",
                     exc_info=True,
                 )
         _engine = Engine(clock=clock)

@@ -1213,6 +1213,17 @@ class Engine:
             stop.set()
             t.join(timeout=5)
 
+    def close(self) -> None:
+        """Graceful quiesce: stop the auto-flusher, decide anything
+        still queued, and settle in-flight async dispatches. Idempotent
+        and non-destructive — the engine stays usable afterwards (the
+        reference has no analog; its counters live for the JVM's
+        lifetime, while an embedded library needs an orderly stop).
+        flush() itself settles earlier flush_async dispatches first,
+        so no separate drain step is needed."""
+        self.stop_auto_flush()
+        self.flush()
+
     def flush(self) -> List[_EntryOp]:
         """Encode + run the kernel for all pending ops; fills verdicts.
 

@@ -293,3 +293,54 @@ class TestAutoFlush:
         assert admitted == total  # count=1e9: nothing should block
         stats = engine.cluster_node_stats("c")
         assert stats["total_pass_minute"] == total
+
+
+class TestLifecycle:
+    def test_reset_stops_old_auto_flusher(self, engine):
+        """api.reset() must terminate the discarded engine's flusher
+        thread — an orphaned daemon would poll (and pin) the old engine
+        for the process lifetime."""
+        import threading
+        import time
+
+        from sentinel_tpu.core import api
+
+        import sentinel_tpu as st
+
+        engine.set_flow_rules([st.FlowRule("rs", count=1e9)])
+        engine.start_auto_flush(interval_ms=5)
+        old_thread = engine._auto_flush_thread
+        assert old_thread is not None and old_thread.is_alive()
+        engine.stop_auto_flush()  # freeze the queue for the race setup
+        engine.start_auto_flush(interval_ms=3600_000)  # won't tick again
+        queued = engine.submit_entry("rs")
+        api.reset(clock=engine.clock)
+        # reset() quiesces the OLD engine via close(): the op queued
+        # behind the (stopped) flusher must still be DECIDED, not
+        # stranded with verdict None forever.
+        assert queued.verdict is not None and queued.verdict.admitted
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and old_thread.is_alive():
+            time.sleep(0.02)
+        assert not old_thread.is_alive(), "old auto-flusher survived reset"
+        assert not any(
+            t.name == "sentinel-auto-flush" and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    def test_close_quiesces_and_decides(self, engine):
+        """close(): flusher stopped, queued ops decided, idempotent,
+        engine still usable afterwards."""
+        import sentinel_tpu as st
+
+        engine.set_flow_rules([st.FlowRule("lc", count=1e9)])
+        engine.start_auto_flush(interval_ms=50)
+        ops = [engine.submit_entry("lc") for _ in range(5)]
+        engine.close()
+        assert engine._auto_flush_thread is None
+        assert all(op.verdict is not None and op.verdict.admitted for op in ops)
+        engine.close()  # idempotent
+        # Still usable.
+        op = engine.submit_entry("lc")
+        engine.flush()
+        assert op.verdict.admitted
