@@ -74,11 +74,36 @@ def _probe_once(timeout_s: float) -> str | None:
     return lines[-1] if lines else None
 
 
+def _transport_exists() -> bool:
+    """Under the axon loopback relay, the tunnel is a local stdio
+    relay process; when it's dead, no probe can EVER succeed this
+    session (round-4 diagnosis, PERF_NOTES.md) — don't burn 15 min of
+    retries proving it. On any other backend layout, assume yes."""
+    if os.environ.get("AXON_LOOPBACK_RELAY") != "1":
+        return True
+    try:
+        out = subprocess.run(
+            ["ps", "-eo", "args"], capture_output=True, text=True, timeout=10
+        ).stdout
+    except Exception:
+        return True  # can't tell — probe normally
+    # Match the relay invocation itself (".relay.py"), not diagnostic
+    # greps/watches that merely mention it ("ps aux | grep relay.py").
+    return any(
+        ".relay.py" in line and "grep" not in line for line in out.splitlines()
+    )
+
+
 def _probe_backend(attempts: int, timeouts: list[float]) -> str:
     """Probe with retries: 'TPU unreachable right now' is a transient
     tunnel condition, not a fact about the hardware (round-3 lesson:
     ONE 120 s attempt turned a wedge into a round of CPU-only
-    evidence). Falls back to 'cpu' only after every attempt fails."""
+    evidence). Falls back to 'cpu' only after every attempt fails —
+    except when the transport provably doesn't exist, which no retry
+    can fix."""
+    if not _transport_exists():
+        _log("axon relay process not found — transport dead, one short probe only")
+        attempts, timeouts = 1, [60.0]
     for i in range(attempts):
         t = timeouts[min(i, len(timeouts) - 1)]
         _log(f"backend probe attempt {i + 1}/{attempts} (timeout {t:.0f}s)")
@@ -561,7 +586,7 @@ def main() -> None:
             # already spent its timeout, so the retry gets only what is
             # left (skipped entirely when nothing is).
             retry_budget = min(timeout_s, deadline - time.monotonic() - 95.0)
-            if retry_budget > 30 and _probe_once(90.0):
+            if retry_budget > 30 and _transport_exists() and _probe_once(90.0):
                 _log(f"stage {kind}/rules={n_rules} failed on {plat}; retrying once")
                 out = _spawn_stage(
                     n_rules, n_entries, iters, plat, retry_budget, kind=kind

@@ -59,3 +59,42 @@ def test_spawn_timeout_salvages_partial(monkeypatch):
     monkeypatch.setattr(subprocess, "run", fake_run)
     out = bench._spawn_stage(16, 16, 1, "cpu", timeout_s=3.0)
     assert out == {"engine_ops_per_sec": 42.0}
+
+
+class TestTransportExists:
+    def test_non_axon_layouts_assume_yes(self, monkeypatch):
+        monkeypatch.delenv("AXON_LOOPBACK_RELAY", raising=False)
+        assert bench._transport_exists() is True
+
+    def test_axon_without_relay_process(self, monkeypatch):
+        monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+
+        def fake_ps(cmd, **kw):
+            class R:
+                # A diagnostic grep mentioning the relay must NOT count
+                # as the relay being alive.
+                stdout = "PID ARGS\npython somethingelse\ngrep .relay.py\n"
+            return R()
+
+        monkeypatch.setattr(subprocess, "run", fake_ps)
+        assert bench._transport_exists() is False
+
+    def test_axon_with_relay_process(self, monkeypatch):
+        monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+
+        def fake_ps(cmd, **kw):
+            class R:
+                stdout = "python3 -u /root/.relay.py\n"
+            return R()
+
+        monkeypatch.setattr(subprocess, "run", fake_ps)
+        assert bench._transport_exists() is True
+
+    def test_ps_failure_probes_normally(self, monkeypatch):
+        monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+
+        def boom(cmd, **kw):
+            raise OSError("no ps")
+
+        monkeypatch.setattr(subprocess, "run", boom)
+        assert bench._transport_exists() is True
