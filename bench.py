@@ -517,9 +517,9 @@ def _spawn_stage(
 
 def _env_budget() -> float:
     try:
-        return float(os.environ.get("SENTINEL_BENCH_BUDGET_S", 900))
+        return float(os.environ.get("SENTINEL_BENCH_BUDGET_S", 1080))
     except ValueError:
-        return 900.0
+        return 1080.0
 
 
 PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
