@@ -5,4 +5,4 @@ Mirrors the reference's version constant
 this framework tracks its own versioning.
 """
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
