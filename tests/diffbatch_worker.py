@@ -8,7 +8,7 @@ every stream passes in a fresh process. So the pytest entry points
 (test_differential_batched.py) spawn this worker: one fresh process
 per engine mode, with the XLA state horizon all to itself.
 
-Usage: python -m tests.diffbatch_worker single|mesh
+Usage: python -m tests.diffbatch_worker single|mesh|dense
 Exit 0 = every seed's stream matched the oracle exactly.
 """
 
@@ -45,6 +45,14 @@ def main() -> None:
         # same-flush co-row charges is a documented one-sided deviation.
         cases = [(200 + s, ["qps", "thread", "rl", "pbucket", "pthrottle"],
                   30, True, f"mesh seed={s}") for s in range(2)]
+    elif mode == "dense":
+        # ONLY the serializing kinds: big flushes over two resources
+        # concentrate 10-45 same-key pacer/bucket items per flush, so
+        # the recurrence randomly crosses every execution schedule —
+        # unrolled rounds (<=4), fori_loop (8/16), and the lax.scan
+        # fallback (>16 items per key) — all against the same oracle.
+        cases = [(300 + s, ["rl", "pthrottle"], 50, False,
+                  f"dense seed={s}") for s in range(2)]
     else:
         raise SystemExit(f"unknown mode {mode!r}")
 

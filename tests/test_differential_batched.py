@@ -161,3 +161,11 @@ def test_random_batched_streams_match_oracle():
 def test_random_batched_streams_match_oracle_on_mesh():
     """Two random mesh streams, fresh process."""
     _run_worker("mesh", timeout_s=1800)
+
+
+def test_dense_serializing_streams_match_oracle():
+    """Two streams concentrated on the serializing kinds (rate-limiter
+    pacer + param throttle): large flushes over two resources drive the
+    per-key recurrence through all three execution schedules (unroll,
+    fori_loop, scan fallback) against the oracle."""
+    _run_worker("dense", timeout_s=1800)
