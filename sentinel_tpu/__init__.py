@@ -41,6 +41,10 @@ from sentinel_tpu.core.api import (
     entry,
     try_entry,
     entry_async,
+    set_exception_predicate,
+    set_exceptions_to_ignore,
+    set_exceptions_to_trace,
+    should_trace,
     trace,
     trace_context,
     get_engine,
@@ -76,6 +80,10 @@ __all__ = [
     "entry",
     "try_entry",
     "entry_async",
+    "set_exception_predicate",
+    "set_exceptions_to_ignore",
+    "set_exceptions_to_trace",
+    "should_trace",
     "trace",
     "trace_context",
     "get_engine",

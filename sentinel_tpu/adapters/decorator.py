@@ -73,7 +73,11 @@ def sentinel_resource(
                 except BlockError:
                     raise
                 except BaseException as e:
-                    entry.set_error(e)
+                    # Per-decorator ignores gate here (the annotation
+                    # check, AbstractSentinelAspectSupport.java:44-53);
+                    # the global Tracer filters apply inside set_error.
+                    if not isinstance(e, exceptions_to_ignore):
+                        entry.set_error(e)
                     entry.exit()
                     return handle_fallback(e, args, kwargs)
                 entry.exit()
@@ -96,7 +100,8 @@ def sentinel_resource(
             except BlockError:
                 raise
             except BaseException as e:
-                entry.set_error(e)
+                if not isinstance(e, exceptions_to_ignore):
+                    entry.set_error(e)
                 entry.exit()
                 return handle_fallback(e, args, kwargs)
             entry.exit()

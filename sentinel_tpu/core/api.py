@@ -21,7 +21,7 @@ InitExecutor.doInit.
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from sentinel_tpu.core import errors as E
 from sentinel_tpu.core.context import Context, ContextUtil
@@ -138,6 +138,7 @@ def reset(clock: Optional[Clock] = None) -> Engine:
                 )
         _engine = Engine(clock=clock)
     ContextUtil.replace_context(None)
+    reset_tracer_filters()
     for mgr in all_managers():
         mgr.clear()
     return _engine
@@ -176,8 +177,23 @@ class Entry:
         self._exited = False
 
     def set_error(self, e: BaseException) -> None:
-        """Tracer.traceEntry target (Tracer.java:110-116)."""
-        if self.error is None:
+        """Tracer.traceEntry (Tracer.java:103-116): the ONE choke point
+        every trace path funnels through — public trace(), the
+        context-manager auto-trace, the decorator, and every adapter —
+        so the Tracer filters apply uniformly. Never raises: a broken
+        user predicate must not leak the entry's thread slot out of
+        ``__exit__``/adapter finally paths (logged, not traced)."""
+        try:
+            traceable = should_trace(e)
+        except Exception:
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log.error(
+                "[Tracer] exception predicate/filter raised — not tracing",
+                exc_info=True,
+            )
+            traceable = False
+        if traceable and self.error is None:
             self.error = e
 
     def exit(self, count: Optional[int] = None) -> None:
@@ -217,8 +233,9 @@ class Entry:
         # Unlike Java's try-with-resources (where Tracer.trace must be
         # called manually), the context-manager form auto-traces
         # non-Block exceptions — the @SentinelResource aspect behavior
-        # (SentinelResourceAspect.java:36-83).
-        if exc is not None and not isinstance(exc, E.BlockError):
+        # (SentinelResourceAspect.java:36-83). set_error applies the
+        # Tracer filters and never raises, so exit() always runs.
+        if exc is not None:
             self.set_error(exc)
         self.exit()
         return False
@@ -349,6 +366,68 @@ def entry_async(
     return e
 
 
+# Tracer exception filters (Tracer.java:33-34, 129-186): BlockError is
+# never traced; a predicate, when set, decides alone; otherwise
+# ignore-classes take precedence over trace-classes, and a set
+# trace-list restricts tracing to its members.
+_trace_classes: Optional[Tuple[type, ...]] = None
+_ignore_classes: Optional[Tuple[type, ...]] = None
+_exception_predicate: Optional[Callable[[BaseException], bool]] = None
+
+
+def _check_exc_classes(classes: Tuple[type, ...], what: str) -> None:
+    # Java's Class<? extends Throwable>... signature precludes
+    # non-class arguments; validate at SET time so a bad value fails
+    # here, not as a TypeError inside every later should_trace call.
+    for c in classes:
+        if not (isinstance(c, type) and issubclass(c, BaseException)):
+            raise ValueError(f"{what} classes must be exception types, got {c!r}")
+
+
+def set_exceptions_to_trace(*classes: type) -> None:
+    """Tracer.setExceptionsToTrace (Tracer.java:129)."""
+    global _trace_classes
+    _check_exc_classes(classes, "trace")
+    _trace_classes = tuple(classes)
+
+
+def set_exceptions_to_ignore(*classes: type) -> None:
+    """Tracer.setExceptionsToIgnore (Tracer.java:155)."""
+    global _ignore_classes
+    _check_exc_classes(classes, "ignore")
+    _ignore_classes = tuple(classes)
+
+
+def set_exception_predicate(pred: Callable[[BaseException], bool]) -> None:
+    """Tracer.setExceptionPredicate (Tracer.java:183)."""
+    global _exception_predicate
+    if pred is None:
+        raise ValueError("exception predicate must not be None")
+    _exception_predicate = pred
+
+
+def reset_tracer_filters() -> None:
+    global _trace_classes, _ignore_classes, _exception_predicate
+    _trace_classes = None
+    _ignore_classes = None
+    _exception_predicate = None
+
+
+def should_trace(e: Optional[BaseException]) -> bool:
+    """Tracer.shouldTrace (Tracer.java:201-225), precedence preserved:
+    never BlockError; predicate decides alone when set; ignore beats
+    trace; a set trace-list is exhaustive."""
+    if e is None or isinstance(e, E.BlockError):
+        return False
+    if _exception_predicate is not None:
+        return bool(_exception_predicate(e))
+    if _ignore_classes is not None and isinstance(e, _ignore_classes):
+        return False
+    if _trace_classes is not None:
+        return isinstance(e, _trace_classes)
+    return True
+
+
 def trace(e: BaseException, count: int = 1) -> None:
     """Tracer.trace: attach a business exception to the current entry.
 
@@ -362,11 +441,11 @@ def trace(e: BaseException, count: int = 1) -> None:
         return
     cur = ctx.cur_entry
     if isinstance(cur, Entry):
-        cur.set_error(e)
+        cur.set_error(e)  # set_error applies the Tracer filters
 
 
 def trace_context(e: BaseException, ctx: Context, count: int = 1) -> None:
     """Tracer.traceContext."""
     cur = ctx.cur_entry
     if isinstance(cur, Entry):
-        cur.set_error(e)
+        cur.set_error(e)  # set_error applies the Tracer filters

@@ -47,6 +47,95 @@ class TestEntryExit:
         stats = engine.cluster_node_stats("exc2")
         assert stats["exception_qps"] == 1
 
+    def test_tracer_filters(self, manual_clock, engine):
+        """Tracer.setExceptionsToTrace/Ignore/Predicate precedence
+        (Tracer.java:129-225): predicate decides alone; ignore beats
+        trace; a set trace-list is exhaustive; BlockError never."""
+
+        def exc_count(res):
+            return engine.cluster_node_stats(res)["total_exception_minute"]
+
+        # Trace-list restricts: KeyError traced, ValueError not.
+        st.set_exceptions_to_trace(KeyError)
+        with st.entry("tf1") as e1:
+            st.trace(ValueError("no"))
+        assert exc_count("tf1") == 0
+        with st.entry("tf1"):
+            st.trace(KeyError("yes"))
+        assert exc_count("tf1") == 1
+
+        # Ignore wins over trace (subclass matching, isAssignableFrom).
+        st.set_exceptions_to_ignore(LookupError)  # KeyError's base
+        with st.entry("tf1"):
+            st.trace(KeyError("now ignored"))
+        assert exc_count("tf1") == 1
+
+        # The auto-trace of the with-block respects the filters too
+        # (the aspect path routes through Tracer).
+        with pytest.raises(KeyError):
+            with st.entry("tf2"):
+                raise KeyError("ignored by LookupError")
+        assert exc_count("tf2") == 0
+
+        # Predicate overrides both lists.
+        st.set_exception_predicate(lambda e: "count me" in str(e))
+        with st.entry("tf3"):
+            st.trace(KeyError("count me"))
+        with st.entry("tf3"):
+            st.trace(RuntimeError("not me"))
+        assert exc_count("tf3") == 1
+
+        # BlockError never traces, predicate or not.
+        assert st.should_trace(st.FlowBlockError("r", None)) is False
+
+    def test_raising_predicate_never_leaks_the_entry(self, manual_clock, engine):
+        """A broken user predicate must not swallow exit(): the thread
+        slot releases and the ORIGINAL exception propagates."""
+        st.set_exception_predicate(lambda e: e.args[0].startswith("x"))
+        with pytest.raises(KeyError):  # NOT IndexError from the predicate
+            with st.entry("tfpred"):
+                raise KeyError()  # empty args → predicate raises
+        stats = engine.cluster_node_stats("tfpred")
+        assert stats["cur_thread_num"] == 0  # slot released
+        assert stats["total_exception_minute"] == 0  # fail-safe: not traced
+
+    def test_filter_setters_reject_non_types(self):
+        with pytest.raises(ValueError):
+            st.set_exceptions_to_ignore("ValueError")
+        with pytest.raises(ValueError):
+            st.set_exceptions_to_trace(int)  # not an exception type
+
+    def test_wsgi_adapter_respects_tracer_filters(self, manual_clock, engine):
+        """Adapters funnel through the same set_error choke point, so
+        the global filters hold there too (Java: every adapter routes
+        via Tracer)."""
+        from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
+
+        st.set_exceptions_to_ignore(ValueError)
+
+        def app(environ, start_response):
+            raise ValueError("ignored")
+
+        wrapped = SentinelWSGIMiddleware(app)
+        environ = {"PATH_INFO": "/w", "REQUEST_METHOD": "GET"}
+        with pytest.raises(ValueError):
+            wrapped(environ, lambda *a: None)
+        stats = engine.cluster_node_stats("GET:/w")
+        assert stats["total_exception_minute"] == 0
+
+    def test_decorator_respects_tracer_filters(self, manual_clock, engine):
+        from sentinel_tpu.adapters.decorator import sentinel_resource
+
+        st.set_exceptions_to_ignore(ValueError)
+
+        @sentinel_resource("tfdec", fallback=lambda *a, **k: "fb")
+        def boom():
+            raise ValueError("ignored")
+
+        assert boom() == "fb"  # fallback still runs
+        stats = engine.cluster_node_stats("tfdec")
+        assert stats["total_exception_minute"] == 0
+
     def test_block_error_not_traced(self, manual_clock, engine):
         st.flow_rule_manager.load_rules([st.FlowRule("blk", count=0)])
         with pytest.raises(st.BlockError):
