@@ -1,0 +1,92 @@
+"""Circuit-breaker state-change observers.
+
+Reference: EventObserverRegistry (sentinel-core/.../slots/block/degrade/
+circuitbreaker/EventObserverRegistry.java) and
+CircuitBreakerStateChangeObserver.onStateChange(prevState, newState,
+rule, snapshotValue) — callbacks fired exactly once per transition
+(the CAS-once contract, AbstractCircuitBreaker.java:40-150), used for
+alerting on CLOSED→OPEN etc.
+
+TPU-first shape: transitions happen INSIDE the flush kernel on
+device-resident state (rules/degrade_table.py), so observers are
+detected host-side by an opt-in post-flush state diff that piggybacks
+on the verdict fetch — zero extra device round-trips, and the
+zero-observer path is completely unchanged. Because a whole flush's
+transitions surface at once, a rule that trips AND recovers within one
+flush reports the net edge (state_before → state_after), not the
+intermediate hop — the batched analog of the reference's point-in-time
+callbacks. Two more consequences of the opt-in design (enforced by the
+engine's epoch/seq mirror discipline, Engine._apply_breaker_snapshot):
+transitions during flushes that ran with NO observers registered are
+not replayed later (the first observed flush resyncs silently), and a
+rule reload starts a fresh epoch so in-flight async fetches from the
+old rule world can never fire against the new one.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from sentinel_tpu.utils.record_log import record_log
+
+# State codes (rules/degrade_table.py:39-41).
+STATE_NAMES = {0: "CLOSED", 1: "OPEN", 2: "HALF_OPEN"}
+
+# observer(prev_state, new_state, rule, resource) — prev/new are the
+# int codes above; ``rule`` is the DegradeRule that transitioned.
+StateChangeObserver = Callable[[int, int, object, str], None]
+
+_lock = threading.Lock()
+_observers: Dict[str, StateChangeObserver] = {}
+
+
+def add_state_change_observer(name: str, observer: StateChangeObserver) -> None:
+    """EventObserverRegistry.addStateChangeObserver."""
+    if not name or observer is None:
+        raise ValueError("observer name and callable are required")
+    with _lock:
+        _observers[name] = observer
+
+
+def remove_state_change_observer(name: str) -> bool:
+    """EventObserverRegistry.removeStateChangeObserver."""
+    with _lock:
+        return _observers.pop(name, None) is not None
+
+
+def get_state_change_observer(name: str) -> Optional[StateChangeObserver]:
+    with _lock:
+        return _observers.get(name)
+
+
+def has_observers() -> bool:
+    return bool(_observers)
+
+
+def clear() -> None:
+    with _lock:
+        _observers.clear()
+
+
+def fire_transitions(prev_states, new_states, dindex) -> None:
+    """Diff two host state vectors and notify every observer of each
+    changed rule. Observer exceptions are logged, never propagated —
+    a broken alert hook must not fail the flush's verdict fill."""
+    with _lock:
+        observers = list(_observers.items())
+    if not observers:
+        return
+    for gid in range(min(len(prev_states), len(new_states))):
+        prev, new = int(prev_states[gid]), int(new_states[gid])
+        if prev == new:
+            continue
+        rule = dindex.rules[gid] if gid < len(dindex.rules) else None
+        resource = getattr(rule, "resource", "")
+        for name, obs in observers:
+            try:
+                obs(prev, new, rule, resource)
+            except Exception:
+                record_log.error(
+                    f"[BreakerEvents] observer {name!r} failed", exc_info=True
+                )

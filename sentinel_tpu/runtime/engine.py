@@ -359,6 +359,19 @@ class Engine:
         self.flow_dyn: FlowRuleDynState = self.flow_index.make_dyn_state()
         self.degrade_index = DegradeIndex([])
         self.degrade_dyn: DegradeDynState = self.degrade_index.make_dyn_state()
+        # Host mirror of breaker states for the opt-in state-change
+        # observers (rules/breaker_events.py); all-CLOSED on (re)build.
+        # Epoch guards stale deferred fetches across rule reloads; seq
+        # orders concurrent/out-of-order _PendingFetch fills; validity
+        # marks gaps where flushes ran unobserved (resync silently).
+        self._breaker_state_host = np.zeros(
+            self.degrade_dyn.state.shape[0], dtype=np.int32
+        )
+        self._breaker_epoch = 0
+        self._breaker_seq = 0
+        self._breaker_applied_seq = 0
+        self._breaker_mirror_valid = True
+        self._breaker_mirror_lock = threading.Lock()
         self.param_index = ParamIndex({})
         self.param_dyn: ParamDynState = make_param_state(8)
         self.system_config = None  # rules/system_manager.SystemConfig or None
@@ -498,6 +511,7 @@ class Engine:
                 with self._lock:
                     self.degrade_index = DegradeIndex(rules)
                     self.degrade_dyn = self.degrade_index.make_dyn_state()
+                    self._reset_breaker_mirror()
         finally:
             self._post_flush(drained)
     def set_param_rules(self, by_resource: Dict[str, List[ParamFlowRule]]) -> None:
@@ -1727,10 +1741,29 @@ class Engine:
             out = flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms, **flags)
         self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn, result = out
 
+        # Opt-in breaker state-change observers: capture THIS chunk's
+        # post-flush state (tagged with epoch+seq — dispatches are
+        # serialized under _flush_lock, so seq follows dispatch order)
+        # so the possibly-deferred fill can diff it against the host
+        # mirror in the same device fetch. A flush dispatched with NO
+        # observers leaves the mirror stale — mark it so the next
+        # observed fill resyncs silently instead of reporting old
+        # transitions as new.
+        from sentinel_tpu.rules import breaker_events
+
+        if breaker_events.has_observers():
+            self._breaker_seq += 1
+            breaker_snap = (self._breaker_epoch, self._breaker_seq,
+                            self.degrade_dyn.state)
+        else:
+            breaker_snap = None
+            with self._breaker_mirror_lock:
+                self._breaker_mirror_valid = False
+
         def _fetch_and_fill(res):
             return self._fill_results(
                 res, entries, exits, bulk, bulk_exits, findex, dindex,
-                auth_rules, k, kd,
+                auth_rules, k, kd, breaker_snap=breaker_snap,
             )
 
         if defer:
@@ -1744,6 +1777,42 @@ class Engine:
             return rec
         return _fetch_and_fill(result)
 
+    def _reset_breaker_mirror(self) -> None:
+        """Fresh all-CLOSED mirror + a new epoch: deferred fetches
+        captured before a rule reload/reset must never diff (or fire)
+        against the rebuilt rule world."""
+        with self._breaker_mirror_lock:
+            self._breaker_state_host = np.zeros(
+                self.degrade_dyn.state.shape[0], dtype=np.int32
+            )
+            self._breaker_epoch += 1
+            self._breaker_seq = 0
+            self._breaker_applied_seq = 0
+            self._breaker_mirror_valid = True
+
+    def _apply_breaker_snapshot(self, epoch, seq, new_state, dindex) -> None:
+        """Ordered, epoch-guarded mirror update + observer dispatch.
+        Out-of-order deferred fills apply newest-wins: a snapshot older
+        than one already applied is dropped (firing it after a newer
+        state would time-travel); after an unobserved gap the first
+        snapshot resyncs silently."""
+        from sentinel_tpu.rules import breaker_events
+
+        with self._breaker_mirror_lock:
+            if epoch != self._breaker_epoch or seq <= self._breaker_applied_seq:
+                return
+            prev = self._breaker_state_host
+            if new_state.shape != prev.shape:
+                return
+            fire = self._breaker_mirror_valid and not np.array_equal(
+                new_state, prev
+            )
+            self._breaker_state_host = new_state
+            self._breaker_applied_seq = seq
+            self._breaker_mirror_valid = True
+        if fire:
+            breaker_events.fire_transitions(prev, new_state, dindex)
+
     def _fill_results(
         self,
         result,
@@ -1756,23 +1825,32 @@ class Engine:
         auth_rules: Dict[str, AuthorityRule],
         k: int,
         kd: int,
+        breaker_snap=None,
     ) -> List[tuple]:
         """Device→host fetch + verdict fill for one dispatched chunk;
         returns the chunk's blocked-verdict block-log items. Runs
         either synchronously at the end of _run_chunk or deferred from
         a _PendingFetch materialization."""
         # One batched device->host fetch (each separate fetch costs a
-        # full round-trip on remote-tunnel backends).
-        admitted, reason, slot_ok, wait_ms, sys_type, dslot_ok = jax.device_get(
-            (
-                result.admitted,
-                result.reason,
-                result.slot_ok,
-                result.wait_ms,
-                result.sys_type,
-                result.dslot_ok,
-            )
+        # full round-trip on remote-tunnel backends). The breaker state
+        # rides the same fetch when observers are registered.
+        fetch = (
+            result.admitted,
+            result.reason,
+            result.slot_ok,
+            result.wait_ms,
+            result.sys_type,
+            result.dslot_ok,
         )
+        if breaker_snap is not None:
+            fetch = fetch + (breaker_snap[2],)
+        got = jax.device_get(fetch)
+        admitted, reason, slot_ok, wait_ms, sys_type, dslot_ok = got[:6]
+        if breaker_snap is not None:
+            self._apply_breaker_snapshot(
+                breaker_snap[0], breaker_snap[1],
+                np.asarray(got[6], dtype=np.int32).reshape(-1), dindex,
+            )
         for i, op in enumerate(entries):
             blocked_rule = None
             limit_type = ""
@@ -2132,6 +2210,7 @@ class Engine:
             self.flow_dyn = self.flow_index.make_dyn_state()
             self.degrade_index = DegradeIndex([])
             self.degrade_dyn = self.degrade_index.make_dyn_state()
+            self._reset_breaker_mirror()
             self.param_index = ParamIndex({})
             self.param_dyn = make_param_state(8)
             self.system_config = None

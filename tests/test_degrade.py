@@ -180,3 +180,159 @@ class TestOracleParity:
                     e.set_error(RuntimeError("x"))
                 e.exit()
                 ob.on_complete(manual_clock.now_ms(), rt=rt, error=err)
+
+
+class TestStateChangeObservers:
+    """EventObserverRegistry + CircuitBreakerStateChangeObserver parity
+    (reference: .../circuitbreaker/EventObserverRegistry.java): opt-in
+    host-side edge detection over the device state, one event per
+    transition, observer failures contained."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_registry(self):
+        from sentinel_tpu.rules import breaker_events
+
+        breaker_events.clear()
+        yield
+        breaker_events.clear()
+
+    def test_open_halfopen_closed_cycle_events(self, manual_clock, engine):
+        from sentinel_tpu.rules import breaker_events
+        from sentinel_tpu.rules.degrade_table import CLOSED, HALF_OPEN, OPEN
+
+        events = []
+        breaker_events.add_state_change_observer(
+            "t", lambda prev, new, rule, res: events.append((prev, new, res))
+        )
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("obs", 0.5, tw=2)])
+        for i in range(5):
+            manual_clock.set_ms(i * 10)
+            run_one(manual_clock, "obs", error=(i > 0))
+        engine.flush()  # settle the tripping exit
+        assert events == [(CLOSED, OPEN, "obs")]
+
+        # Retry window passes -> probe admits (OPEN->HALF_OPEN), its
+        # success closes the breaker (HALF_OPEN->CLOSED).
+        manual_clock.set_ms(3000)
+        assert run_one(manual_clock, "obs", error=False)
+        engine.flush()  # settle the recovering exit
+        assert events[1][:2] == (OPEN, HALF_OPEN)
+        assert events[2][:2] == (HALF_OPEN, CLOSED)
+        assert all(res == "obs" for _, _, res in events)
+
+    def test_observer_exception_contained_and_removal(self, manual_clock, engine):
+        from sentinel_tpu.rules import breaker_events
+
+        calls = []
+
+        def bad(prev, new, rule, res):
+            raise RuntimeError("alert hook down")
+
+        breaker_events.add_state_change_observer("bad", bad)
+        breaker_events.add_state_change_observer(
+            "good", lambda *a: calls.append(a)
+        )
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("ox", 0.5, tw=5)])
+        for i in range(5):
+            manual_clock.set_ms(i * 10)
+            assert run_one(manual_clock, "ox", error=(i > 0))
+        engine.flush()  # the fill with the raising observer survives
+        assert len(calls) == 1  # good observer still notified
+        assert breaker_events.remove_state_change_observer("bad") is True
+        assert breaker_events.remove_state_change_observer("bad") is False
+
+    def test_rule_reload_resets_mirror_without_events(self, manual_clock, engine):
+        from sentinel_tpu.rules import breaker_events
+
+        events = []
+        breaker_events.add_state_change_observer(
+            "t", lambda *a: events.append(a)
+        )
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("r1", 0.5, tw=5)])
+        for i in range(5):
+            manual_clock.set_ms(i * 10)
+            run_one(manual_clock, "r1", error=(i > 0))
+        engine.flush()
+        assert len(events) == 1  # tripped
+        # Reload with a CHANGED rule list: fresh breakers (the
+        # reference builds new CircuitBreaker objects per load; an
+        # IDENTICAL list short-circuits in DynamicSentinelProperty's
+        # equals check and is a no-op there as here) — and the mirror
+        # resets silently: no phantom OPEN->CLOSED event.
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("r1", 0.6, tw=5)])
+        manual_clock.set_ms(200)
+        assert run_one(manual_clock, "r1", error=False)
+        engine.flush()
+        assert len(events) == 1
+
+
+class TestObserverMirrorDiscipline:
+    """The mirror's epoch/seq/validity rules: stale deferred fetches
+    across reloads never fire; unobserved gaps resync silently."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_registry(self):
+        from sentinel_tpu.rules import breaker_events
+
+        breaker_events.clear()
+        yield
+        breaker_events.clear()
+
+    def test_reload_with_inflight_async_fires_no_phantoms(
+        self, manual_clock, engine
+    ):
+        from sentinel_tpu.rules import breaker_events
+
+        events = []
+        breaker_events.add_state_change_observer(
+            "t", lambda *a: events.append(a)
+        )
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("ph", 0.5, tw=5)])
+        # Trip the breaker with the final exit still IN FLIGHT
+        # (flush_async), then reload a same-length rule list before
+        # draining: the stale fetch is from the old epoch and must not
+        # diff against the rebuilt mirror.
+        for i in range(5):
+            manual_clock.set_ms(i * 10)
+            run_one(manual_clock, "ph", error=(i > 0))
+        engine.flush_async()
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("ph", 0.6, tw=5)])
+        engine.drain()
+        manual_clock.set_ms(500)
+        assert run_one(manual_clock, "ph", error=False)  # fresh breaker
+        engine.flush()
+        # The pre-reload trip may or may not have settled before the
+        # reload drained it; either way NO event may reference the new
+        # epoch's all-CLOSED world incorrectly: allowed outcomes are
+        # the genuine old-epoch trip (fired before the reload) or
+        # nothing — never an OPEN->CLOSED phantom afterwards.
+        assert all(e[:2] != (1, 0) for e in events), events
+
+    def test_unobserved_gap_resyncs_silently(self, manual_clock, engine):
+        from sentinel_tpu.rules import breaker_events
+        from sentinel_tpu.rules.degrade_table import CLOSED, OPEN
+
+        events = []
+
+        def obs(*a):
+            events.append(a)
+
+        breaker_events.add_state_change_observer("t", obs)
+        st.degrade_rule_manager.load_rules([exc_ratio_rule("gap", 0.5, tw=2)])
+        for i in range(5):
+            manual_clock.set_ms(i * 10)
+            run_one(manual_clock, "gap", error=(i > 0))
+        engine.flush()
+        assert [e[:2] for e in events] == [(CLOSED, OPEN)]
+        # Observer leaves; the breaker recovers during the gap.
+        breaker_events.remove_state_change_observer("t")
+        manual_clock.set_ms(3000)
+        assert run_one(manual_clock, "gap", error=False)
+        engine.flush()  # OPEN->HALF_OPEN->CLOSED, unobserved
+        # Observer returns: the next flush resyncs the mirror without
+        # replaying the missed transitions at the wrong time.
+        breaker_events.add_state_change_observer("t", obs)
+        manual_clock.set_ms(3500)
+        assert run_one(manual_clock, "gap", error=False)
+        engine.flush()
+        assert [e[:2] for e in events] == [(CLOSED, OPEN)]  # nothing new
