@@ -41,6 +41,7 @@ from sentinel_tpu.core.api import (
     entry,
     try_entry,
     entry_async,
+    reset_tracer_filters,
     set_exception_predicate,
     set_exceptions_to_ignore,
     set_exceptions_to_trace,
@@ -80,6 +81,7 @@ __all__ = [
     "entry",
     "try_entry",
     "entry_async",
+    "reset_tracer_filters",
     "set_exception_predicate",
     "set_exceptions_to_ignore",
     "set_exceptions_to_trace",
