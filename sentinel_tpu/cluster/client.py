@@ -30,9 +30,15 @@ class ClusterTokenClient(TokenService):
         port: int = 18730,
         request_timeout_sec: float = 2.0,
         reconnect_interval_sec: float = 2.0,
+        namespace: str = "default",
     ) -> None:
         self.host = host
         self.port = port
+        # Announced to the server in the connect-time ping; the server
+        # groups connections per namespace for AVG_LOCAL thresholds
+        # (ClusterClientConfigManager's namespace registration +
+        # TokenServerHandler.handlePingRequest).
+        self.namespace = namespace
         self.timeout = request_timeout_sec
         self.reconnect_interval = reconnect_interval_sec
         self._sock: Optional[socket.socket] = None
@@ -73,6 +79,17 @@ class ClusterTokenClient(TokenService):
             target=self._read_loop, name="sentinel-token-client", daemon=True
         )
         self._reader.start()
+        # Namespace announcement; the reply (group count) is consumed by
+        # the reader and dropped — no pending entry is registered, so a
+        # lost reply costs nothing.
+        try:
+            with self._send_lock:
+                if self._sock is not None:
+                    self._sock.sendall(
+                        protocol.pack_ping(next(self._xid), self.namespace)
+                    )
+        except OSError:
+            pass
         return True
 
     def _close(self) -> None:

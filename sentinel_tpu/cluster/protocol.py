@@ -14,7 +14,7 @@ Request: [u32 xid][u8 type][body]
   PARAM_FLOW body:  [i64 flow_id][i32 acquire][u16 n][n × (u16 len, bytes)]
   CONCURRENT_FLOW_ACQUIRE body: [i64 flow_id][i32 acquire][u8 0]
   CONCURRENT_FLOW_RELEASE body: [i64 token_id]
-  PING body:        []
+  PING body:        [] | [u16 len, bytes namespace]
 Response:[u32 xid][u8 type][i8 status][i32 remaining][i32 wait_ms][i64 token_id]
 """
 
@@ -48,8 +48,16 @@ def pack_param_request(xid: int, flow_id: int, acquire: int, params: List[str]) 
     return _LEN.pack(len(payload)) + payload
 
 
-def pack_ping(xid: int) -> bytes:
+def pack_ping(xid: int, namespace: str = "") -> bytes:
+    """PING doubles as the namespace announcement: the reference's ping
+    request carries the client namespace and the server registers the
+    connection under it (TokenServerHandler.handlePingRequest,
+    TokenServerHandler.java:94-106). An empty namespace keeps the legacy
+    empty body for wire compat."""
     payload = _REQ_HDR.pack(xid, C.MSG_TYPE_PING)
+    if namespace:
+        raw = namespace.encode("utf-8")[:65535]
+        payload += struct.pack("<H", len(raw)) + raw
     return _LEN.pack(len(payload)) + payload
 
 
@@ -108,7 +116,13 @@ def unpack_request(payload: bytes) -> Tuple[int, int, tuple]:
         raise UnknownMsgType(xid, msg_type)
     off = _REQ_HDR.size
     if msg_type == C.MSG_TYPE_PING:
-        return xid, msg_type, ()
+        if off == len(payload):
+            return xid, msg_type, ("",)
+        (ln,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        if off + ln != len(payload):
+            raise ValueError("bad ping namespace length")
+        return xid, msg_type, (payload[off : off + ln].decode("utf-8"),)
     if msg_type == C.MSG_TYPE_CONCURRENT_FLOW_RELEASE:
         (token_id,) = _RELEASE_BODY.unpack_from(payload, off)
         return xid, msg_type, (token_id,)

@@ -28,6 +28,7 @@ class _Handler(socketserver.BaseRequestHandler):
         server: "SentinelTokenServer" = self.server.token_server  # type: ignore[attr-defined]
         server._conn_changed(+1)
         client_addr = "%s:%d" % self.client_address[:2]
+        server.connections.on_connect(client_addr)
         try:
             while True:
                 try:
@@ -56,7 +57,17 @@ class _Handler(socketserver.BaseRequestHandler):
                     record_log.warn("[TokenServer] bad frame dropped")
                     return
                 if msg_type == C.MSG_TYPE_PING:
-                    resp = protocol.pack_response(xid, msg_type, int(C.TokenResultStatus.OK))
+                    # Ping = namespace announcement: bind this
+                    # connection to the client's namespace and answer
+                    # with the group's connected count
+                    # (TokenServerHandler.handlePingRequest).
+                    (namespace,) = body
+                    count = server.connections.bind(
+                        client_addr, namespace or "default"
+                    )
+                    resp = protocol.pack_response(
+                        xid, msg_type, int(C.TokenResultStatus.OK), remaining=count
+                    )
                 elif msg_type == C.MSG_TYPE_FLOW:
                     flow_id, acquire, prio = body
                     r = server.service.request_token(flow_id, acquire, prio)
@@ -95,6 +106,7 @@ class _Handler(socketserver.BaseRequestHandler):
             pass
         finally:
             server._conn_changed(-1)
+            server.connections.on_disconnect(client_addr)
             # A vanished client cannot release its held concurrency
             # tokens — free them eagerly (the clientOfflineTime story).
             concurrent = getattr(server.service, "concurrent", None)
@@ -117,7 +129,13 @@ class SentinelTokenServer:
     directly callable in-process, DefaultEmbeddedTokenServer style)."""
 
     def __init__(self, port: int = 0, service: Optional[TokenService] = None) -> None:
+        from sentinel_tpu.cluster.connection import ConnectionManager
+
         self.service = service or DefaultTokenService()
+        self.connections = ConnectionManager()
+        # AVG_LOCAL thresholds read the rule namespace's group count.
+        if hasattr(self.service, "connections"):
+            self.service.connections = self.connections
         self._requested_port = port
         self._server: Optional[_TCPServer] = None
         self._thread: Optional[threading.Thread] = None

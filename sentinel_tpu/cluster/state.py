@@ -96,15 +96,28 @@ class ClusterClientConfigManager:
     server_host: str = ""
     server_port: int = 0
     request_timeout_ms: int = 200
+    # The namespace this client announces on connect — feeds the
+    # server's per-namespace connection groups for AVG_LOCAL
+    # (reference: the client appName/namespace registration,
+    # ConfigSupplierRegistry.getNamespaceSupplier).
+    namespace: str = "default"
     _lock = threading.Lock()
 
     @classmethod
-    def apply(cls, host: str, port: int, timeout_ms: Optional[int] = None) -> None:
+    def apply(
+        cls,
+        host: str,
+        port: int,
+        timeout_ms: Optional[int] = None,
+        namespace: Optional[str] = None,
+    ) -> None:
         with cls._lock:
             cls.server_host = host
             cls.server_port = int(port)
             if timeout_ms is not None:
                 cls.request_timeout_ms = int(timeout_ms)
+            if namespace is not None:
+                cls.namespace = namespace
 
     @classmethod
     def snapshot(cls) -> dict:
@@ -113,6 +126,7 @@ class ClusterClientConfigManager:
                 "serverHost": cls.server_host,
                 "serverPort": cls.server_port,
                 "requestTimeout": cls.request_timeout_ms,
+                "namespace": cls.namespace,
             }
 
     @classmethod
@@ -126,9 +140,12 @@ class ClusterClientConfigManager:
         with cls._lock:
             host, port = cls.server_host, cls.server_port
             timeout_s = cls.request_timeout_ms / 1000.0
+            namespace = cls.namespace
         if not host or port <= 0:
             return None
-        return ClusterTokenClient(host, port, request_timeout_sec=timeout_s)
+        return ClusterTokenClient(
+            host, port, request_timeout_sec=timeout_s, namespace=namespace
+        )
 
 
 class TokenClientProvider:

@@ -22,6 +22,7 @@ arbitrary Netty arrival order.
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Dict, List, NamedTuple, Optional
 
@@ -88,9 +89,16 @@ def _batch_decide(
     ns_thresholds: jax.Array,  # float32 [B]
     valid: jax.Array,  # bool [B]
     now: jax.Array,  # int32 scalar
+    atomic: bool = False,
 ):
     """One jitted decision pass: namespace guard then flow check, both
-    with intra-batch charging; admitted requests scatter PASS."""
+    with intra-batch charging; admitted requests scatter PASS.
+
+    ``atomic`` makes the commit all-or-nothing: if ANY valid request in
+    the batch is refused, nothing is charged. The param path needs this
+    — ClusterParamFlowChecker checks every value before charging any
+    (ClusterParamFlowChecker.java:40-100), so a blocked multi-value
+    request must not drain the budgets of its admitted values."""
     interval_sec = CLUSTER_CFG.interval_ms / 1000.0
     sums = ma.window_sums(CLUSTER_CFG, state, now)[:, MetricEvent.PASS]
     nrows = state.n_rows
@@ -120,11 +128,14 @@ def _batch_decide(
     flow_ok = next_remaining >= 0
 
     admitted = valid & ns_ok & flow_ok
+    charged = admitted
+    if atomic:
+        charged = admitted & jnp.all(admitted | ~valid)
     # Scatter PASS for admitted requests on flow rows and namespace rows.
     upd_rows = jnp.concatenate(
         [
-            jnp.where(admitted, rows, jnp.int32(nrows)),
-            jnp.where(admitted & (ns_rows >= 0), ns_rows, jnp.int32(nrows)),
+            jnp.where(charged, rows, jnp.int32(nrows)),
+            jnp.where(charged & (ns_rows >= 0), ns_rows, jnp.int32(nrows)),
         ]
     )
     upd_ts = jnp.concatenate([jnp.full_like(rows, now), jnp.full_like(rows, now)])
@@ -137,6 +148,9 @@ def _batch_decide(
 
 
 _decide_jit = jax.jit(_batch_decide, donate_argnums=(0,))
+_decide_jit_atomic = jax.jit(
+    functools.partial(_batch_decide, atomic=True), donate_argnums=(0,)
+)
 
 
 class DefaultTokenService(TokenService):
@@ -151,8 +165,22 @@ class DefaultTokenService(TokenService):
         self._flow_rows: Dict[int, int] = {}
         self._ns_rows: Dict[str, int] = {}
         self._next_row = 0
-        self.connected_count = 1  # ConnectionManager connectedCount analog
+        self.connected_count = 1  # global fallback when no manager is attached
+        # Per-namespace accounting (ConnectionManager.java) — attached
+        # by the TCP server; None for bare embedded services.
+        self.connections = None
         self.concurrent = ConcurrentFlowManager(clock=self.clock)
+
+    def _connected_count(self, namespace: str) -> int:
+        """getConnectedCount for AVG_LOCAL thresholds
+        (ClusterFlowChecker.java:38-48): the rule namespace's live
+        connection count, floored at 1 (an embedded server counts
+        itself — SentinelDefaultTokenServer.java:136)."""
+        if self.connections is not None:
+            n = self.connections.count(namespace)
+            if n > 0:
+                return n
+        return max(1, self.connected_count)
 
     def _row_for_flow(self, flow_id: int) -> int:
         row = self._flow_rows.get(flow_id)
@@ -202,11 +230,11 @@ class DefaultTokenService(TokenService):
                     out[i] = TokenResult(C.TokenResultStatus.NO_RULE_EXISTS)
                     continue
                 cc = rule.cluster_config
+                ns = cluster_flow_rule_manager.namespace_of(int(flow_id)) or "default"
                 if cc.threshold_type == C.FLOW_THRESHOLD_GLOBAL:
                     threshold = rule.count * cfg.exceed_count
                 else:
-                    threshold = rule.count * self.connected_count * cfg.exceed_count
-                ns = cluster_flow_rule_manager.namespace_of(int(flow_id)) or "default"
+                    threshold = rule.count * self._connected_count(ns) * cfg.exceed_count
                 idxs.append(i)
                 rows.append(self._row_for_flow(int(flow_id)))
                 ns_rows.append(self._row_for_ns(ns))
@@ -274,8 +302,19 @@ class DefaultTokenService(TokenService):
                 reqs.append(row)
         # Reuse request_tokens machinery by faking per-param "flows":
         # simplest correct behavior: check each param row against the
-        # rule count; any blocked param blocks the request.
+        # rule count; any blocked param blocks the request
+        # (ClusterParamFlowChecker.acquireClusterToken iterates params
+        # and the whole request blocks on the first refused value).
         cfg = cluster_server_config_manager.config
+        cc = getattr(rule, "cluster_config", None)
+        ns = cluster_flow_rule_manager.namespace_of(int(flow_id)) or "default"
+        if cc is not None and cc.threshold_type == C.FLOW_THRESHOLD_GLOBAL:
+            threshold = rule.count * cfg.exceed_count
+        else:
+            # AVG_LOCAL: per-value global budget = local count × the
+            # rule namespace's connected clients
+            # (ClusterParamFlowChecker.calcGlobalThreshold).
+            threshold = rule.count * self._connected_count(ns) * cfg.exceed_count
         with self._lock:
             self._ensure_capacity()
             b = pad_pow2(len(reqs), 8)
@@ -284,12 +323,14 @@ class DefaultTokenService(TokenService):
             valid = np.zeros(b, dtype=bool)
             valid[: len(reqs)] = True
             now = jnp.int32(self.clock.now_ms())
-            self.state, admitted, _, _ = _decide_jit(
+            # Atomic commit: a blocked value must leave the other
+            # values' windows untouched (check-all-then-charge-all).
+            self.state, admitted, _, _ = _decide_jit_atomic(
                 self.state,
                 jnp.asarray(rows_a),
                 jnp.full(b, -1, dtype=jnp.int32),
                 jnp.full(b, int(acquire_count), dtype=jnp.int32),
-                jnp.full(b, float(rule.count * cfg.exceed_count), dtype=jnp.float32),
+                jnp.full(b, float(threshold), dtype=jnp.float32),
                 jnp.zeros(b, dtype=jnp.float32),
                 jnp.asarray(valid),
                 now,
@@ -310,8 +351,9 @@ class DefaultTokenService(TokenService):
         if rule is None:
             # nowCalls missing for an unknown flowId → FAIL (java:52-56).
             return TokenResult(C.TokenResultStatus.FAIL)
+        ns = cluster_flow_rule_manager.namespace_of(int(flow_id)) or "default"
         status, token_id = self.concurrent.acquire(
-            client_address, rule, int(acquire_count), self.connected_count
+            client_address, rule, int(acquire_count), self._connected_count(ns)
         )
         return TokenResult(status, token_id=token_id)
 

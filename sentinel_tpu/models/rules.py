@@ -178,6 +178,10 @@ class ParamFlowRule(AbstractRule):
     duration_in_sec: int = 1
     param_flow_item_list: Tuple[ParamFlowItem, ...] = field(default_factory=tuple)
     cluster_mode: bool = False
+    # ParamFlowClusterConfig (reference: ParamFlowClusterConfig.java:30-49)
+    # shares ClusterFlowConfig's shape: flowId, thresholdType,
+    # fallbackToLocalWhenFail, sampleCount, windowIntervalMs.
+    cluster_config: Optional[ClusterFlowConfig] = None
 
     def __post_init__(self) -> None:
         if isinstance(self.param_flow_item_list, list):
@@ -185,6 +189,10 @@ class ParamFlowRule(AbstractRule):
 
     def is_valid(self) -> bool:
         # Reference: ParamFlowRuleUtil.isValidRule.
+        if self.cluster_mode and (
+            self.cluster_config is None or self.cluster_config.flow_id is None
+        ):
+            return False
         return (
             super().is_valid()
             and self.count >= 0

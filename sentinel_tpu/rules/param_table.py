@@ -104,6 +104,7 @@ class ParamSlotInfo:
     maxq: int
     cost_ms: int
     rule: Optional[ParamFlowRule] = None  # for block attribution
+    value_key: str = ""  # interned value string (cluster RPC payload)
 
 
 def _transition(tokens, last, latest, thr_used, x):
@@ -351,6 +352,7 @@ class ParamIndex:
                         maxq=int(r.max_queueing_time_ms),
                         cost_ms=cost,
                         rule=r,
+                        value_key=key,
                     )
                 )
                 if len(out) >= max_slots:

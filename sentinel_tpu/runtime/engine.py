@@ -672,6 +672,10 @@ class Engine:
         # crossing to the token server, FlowRuleChecker.java:168-230).
         if cluster_gids and any(gid in cluster_gids for gid, _ in op.slots):
             self._apply_cluster_checks(op, cluster_gids)
+        if op.p_slots and any(
+            s.rule is not None and s.rule.cluster_mode for s in op.p_slots
+        ):
+            self._apply_cluster_param_checks(op)
         with self._lock:
             self._entries.append(op)
             over = len(self._entries) >= self.max_batch
@@ -695,24 +699,33 @@ class Engine:
         """
         return [self.submit_entry(**req) for req in requests]
 
-    def _apply_cluster_checks(self, op: _EntryOp, cluster_gids) -> None:
-        """applyTokenResult (FlowRuleChecker.java:207-230): OK → pass
-        (drop the local slot), SHOULD_WAIT → sleep then pass, BLOCKED →
-        block, anything else → fallback to local checking when the rule
-        allows it, else pass."""
+    @staticmethod
+    def _cluster_token_service():
+        """The active token service for this node's cluster role:
+        remote client (TokenClientProvider) or the embedded server's
+        in-process service — FlowRuleChecker.pickClusterService
+        (FlowRuleChecker.java:232-241)."""
         from sentinel_tpu.cluster.state import (
             ClusterStateManager,
             EmbeddedClusterTokenServerProvider,
             TokenClientProvider,
         )
+
+        if ClusterStateManager.is_client():
+            return TokenClientProvider.get_client()
+        if ClusterStateManager.is_server():
+            server = EmbeddedClusterTokenServerProvider.get_server()
+            return getattr(server, "service", server)
+        return None
+
+    def _apply_cluster_checks(self, op: _EntryOp, cluster_gids) -> None:
+        """applyTokenResult (FlowRuleChecker.java:207-230): OK → pass
+        (drop the local slot), SHOULD_WAIT → sleep then pass, BLOCKED →
+        block, anything else → fallback to local checking when the rule
+        allows it, else pass."""
         from sentinel_tpu.models import constants as _C
 
-        service = None
-        if ClusterStateManager.is_client():
-            service = TokenClientProvider.get_client()
-        elif ClusterStateManager.is_server():
-            server = EmbeddedClusterTokenServerProvider.get_server()
-            service = getattr(server, "service", server)
+        service = self._cluster_token_service()
         kept = []
         decided = set()
         for gid, crow in op.slots:
@@ -767,7 +780,73 @@ class Engine:
             if cc.fallback_to_local_when_fail:
                 kept.append((gid, crow))
         op.slots = kept
-        op.token_decided_flow_ids = frozenset(decided)
+        op.token_decided_flow_ids = op.token_decided_flow_ids | frozenset(decided)
+
+    def _apply_cluster_param_checks(self, op: _EntryOp) -> None:
+        """Cluster-mode hot-param admission (ParamFlowChecker.passCheck
+        cluster branch, ParamFlowChecker.java:46-80): QPS-grade rules
+        with ``cluster_mode`` consult the token server per entry with
+        the entry's extracted param values
+        (ClusterParamFlowChecker.acquireClusterToken on the server side,
+        ClusterParamFlowChecker.java:40-100); THREAD-grade stays local
+        like the reference. OK → drop the local slots (token granted),
+        BLOCKED → block the op, FAIL/no-service → fallback to local
+        checking when the rule allows it, else pass."""
+        from sentinel_tpu.models import constants as _C
+
+        def _is_cluster(s) -> bool:
+            r = s.rule
+            return (
+                isinstance(r, ParamFlowRule)
+                and r.cluster_mode
+                and r.grade == C.FLOW_GRADE_QPS
+                and r.cluster_config is not None
+                and r.cluster_config.flow_id is not None
+            )
+
+        groups: Dict[int, Tuple[object, List[str]]] = {}
+        for s in op.p_slots:
+            if _is_cluster(s):
+                fid = int(s.rule.cluster_config.flow_id)
+                if fid not in groups:
+                    groups[fid] = (s.rule, [])
+                groups[fid][1].append(s.value_key)
+        if not groups:
+            return
+        service = self._cluster_token_service()
+        decided = set()
+        fallback_fids = set()
+        for fid, (rule, values) in groups.items():
+            cc = rule.cluster_config
+            if service is None:
+                if cc.fallback_to_local_when_fail:
+                    fallback_fids.add(fid)
+                else:
+                    decided.add(fid)
+                continue
+            try:
+                result = service.request_param_token(fid, op.acquire, values)
+            except Exception:
+                result = None
+            status = result.status if result is not None else _C.TokenResultStatus.FAIL
+            if status == _C.TokenResultStatus.OK:
+                decided.add(fid)
+            elif status == _C.TokenResultStatus.BLOCKED:
+                op.cluster_blocked_rule = rule
+                decided.add(fid)
+            elif cc.fallback_to_local_when_fail:
+                fallback_fids.add(fid)
+            else:
+                decided.add(fid)
+        # Token-decided (and non-fallback failed) rules must not also be
+        # checked locally; fallback rules keep their local slots.
+        op.p_slots = [
+            s
+            for s in op.p_slots
+            if not _is_cluster(s)
+            or int(s.rule.cluster_config.flow_id) in fallback_fids
+        ]
+        op.token_decided_flow_ids = op.token_decided_flow_ids | frozenset(decided)
 
     def submit_exit(
         self,
@@ -1412,11 +1491,26 @@ class Engine:
                         if not _decided(s[0])
                     ]
                     op.d_gids = dindex.gids_for(op.resource)
-                    op.p_slots = (
-                        pindex.slots_for(op.resource, op.args)
-                        if op.args and pindex.has_rules()
-                        else []
-                    )
+
+                    def _param_decided(s) -> bool:
+                        r = s.rule
+                        return (
+                            r is not None
+                            and r.cluster_mode
+                            and r.cluster_config is not None
+                            and r.cluster_config.flow_id
+                            in op.token_decided_flow_ids
+                        )
+
+                    op.p_slots = [
+                        s
+                        for s in (
+                            pindex.slots_for(op.resource, op.args)
+                            if op.args and pindex.has_rules()
+                            else []
+                        )
+                        if not _param_decided(s)
+                    ]
                     op.src = cur
             for x in exits:
                 if x.resource is not None and x.src_dindex is not None and x.src_dindex is not dindex:
@@ -1869,6 +1963,11 @@ class Engine:
                 elif r == E.BLOCK_FLOW:
                     if op.cluster_blocked_rule is not None:
                         blocked_rule = op.cluster_blocked_rule
+                        if isinstance(blocked_rule, ParamFlowRule):
+                            # A token-server param verdict surfaces as
+                            # ParamFlowException, not FlowException
+                            # (ParamFlowChecker cluster branch).
+                            r = E.BLOCK_PARAM
                     else:
                         for j, (gid, _) in enumerate(op.slots[:k]):
                             if not slot_ok[i, j]:

@@ -288,11 +288,19 @@ def cluster_server_stats_handler(req: CommandRequest) -> CommandResponse:
     concurrent = getattr(service, "concurrent", None)
     if concurrent is not None:
         held = concurrent.held_tokens()
+    connections = getattr(service, "connections", None)
+    by_namespace = connections.snapshot() if connections is not None else {}
+    connected = (
+        connections.total()
+        if connections is not None
+        else getattr(service, "connected_count", 0)
+    )
     return CommandResponse.of_json(
         {
             "mode": ClusterStateManager.get_mode(),
             "port": getattr(server, "port", None) if server is not None else None,
-            "connectedCount": getattr(service, "connected_count", 0),
+            "connectedCount": connected,
+            "connectionGroups": by_namespace,
             "heldTokens": held,
             "flows": flows,
         }
@@ -308,7 +316,8 @@ def cluster_client_config_handler(req: CommandRequest) -> CommandResponse:
 
 @command_mapping(
     "cluster/client/modifyConfig",
-    "point this client at a token server: serverHost=&serverPort=[&requestTimeout=]",
+    "point this client at a token server: "
+    "serverHost=&serverPort=[&requestTimeout=][&namespace=]",
 )
 def cluster_client_modify_config_handler(req: CommandRequest) -> CommandResponse:
     from sentinel_tpu.cluster.state import (
@@ -326,12 +335,16 @@ def cluster_client_modify_config_handler(req: CommandRequest) -> CommandResponse
         return CommandResponse.of_failure("invalid port/timeout")
     if not host or port <= 0:
         return CommandResponse.of_failure("serverHost and serverPort required")
-    ClusterClientConfigManager.apply(host, port, timeout_ms)
+    ClusterClientConfigManager.apply(
+        host, port, timeout_ms, namespace=req.params.get("namespace")
+    )
     # Re-point a live client: stop the old one so the next mode apply
     # (or the current client mode) reconnects at the new address.
     client = TokenClientProvider.get_client()
     if client is not None and (
-        getattr(client, "host", None) != host or getattr(client, "port", None) != port
+        getattr(client, "host", None) != host
+        or getattr(client, "port", None) != port
+        or getattr(client, "namespace", None) != ClusterClientConfigManager.namespace
     ):
         try:
             if hasattr(client, "stop"):

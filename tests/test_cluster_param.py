@@ -1,0 +1,283 @@
+"""Cluster-mode hot-parameter flow control.
+
+Reference semantics under test: ParamFlowChecker.passCheck delegating
+QPS-grade cluster rules to the token service
+(ParamFlowChecker.java:46-80), ClusterParamFlowChecker per-value global
+windows + AVG_LOCAL threshold scaling
+(ClusterParamFlowChecker.java:40-108), and
+ConnectionManager/ConnectionGroup per-namespace connection accounting
+(ConnectionManager.java:40-120) feeding those thresholds.
+"""
+
+import threading
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import (
+    ClusterStateManager,
+    DefaultTokenService,
+    EmbeddedClusterTokenServerProvider,
+    TokenClientProvider,
+    cluster_flow_rule_manager,
+    cluster_server_config_manager,
+)
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.connection import ConnectionManager
+from sentinel_tpu.cluster.server import SentinelTokenServer
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import ClusterFlowConfig, ParamFlowRule
+from sentinel_tpu.utils.clock import ManualClock
+
+
+def cluster_param_rule(
+    resource,
+    count,
+    flow_id,
+    threshold_type=C.FLOW_THRESHOLD_GLOBAL,
+    fallback=True,
+    param_idx=0,
+):
+    return ParamFlowRule(
+        resource,
+        count=count,
+        param_idx=param_idx,
+        cluster_mode=True,
+        cluster_config=ClusterFlowConfig(
+            flow_id=flow_id,
+            threshold_type=threshold_type,
+            fallback_to_local_when_fail=fallback,
+        ),
+    )
+
+
+@pytest.fixture()
+def cluster_env():
+    cluster_flow_rule_manager.clear()
+    cluster_server_config_manager.load_global_flow_config(
+        exceed_count=1.0, max_allowed_qps=30000.0
+    )
+    yield
+    cluster_flow_rule_manager.clear()
+    ClusterStateManager.stop()
+    TokenClientProvider.clear()
+    EmbeddedClusterTokenServerProvider.clear()
+
+
+class TestConnectionManager:
+    def test_bind_move_and_counts(self):
+        cm = ConnectionManager()
+        cm.on_connect("a:1")
+        cm.on_connect("b:2")
+        assert cm.count("default") == 2
+        assert cm.bind("a:1", "ns1") == 1
+        assert cm.count("default") == 1
+        assert cm.count("ns1") == 1
+        # Re-announcing the same namespace is idempotent.
+        assert cm.bind("a:1", "ns1") == 1
+        cm.on_disconnect("a:1")
+        assert cm.count("ns1") == 0
+        assert cm.total() == 1
+        assert cm.snapshot() == {"default": 1}
+
+
+class TestServerParamToken:
+    def test_per_value_global_window(self, cluster_env):
+        """Each param value gets its own global budget; conservation is
+        exact across values."""
+        svc = DefaultTokenService(clock=ManualClock(0))
+        cluster_flow_rule_manager.load_rules(
+            "default", [cluster_param_rule("r", 3, flow_id=201)]
+        )
+        oks_a = [svc.request_param_token(201, 1, ["a"]).ok for _ in range(5)]
+        oks_b = [svc.request_param_token(201, 1, ["b"]).ok for _ in range(5)]
+        assert oks_a == [True] * 3 + [False] * 2
+        assert oks_b == [True] * 3 + [False] * 2
+
+    def test_avg_local_scales_with_namespace_connections(self, cluster_env):
+        """AVG_LOCAL threshold = count × the RULE NAMESPACE's connected
+        count, not the global total (ClusterParamFlowChecker
+        .calcGlobalThreshold + ConnectionManager.getConnectedCount)."""
+        svc = DefaultTokenService(clock=ManualClock(0))
+        cm = ConnectionManager()
+        svc.connections = cm
+        # ns1 has 3 clients, ns2 has 1 client (4 total).
+        for i in range(3):
+            cm.bind(f"c{i}:1", "ns1")
+        cm.bind("d0:1", "ns2")
+        cluster_flow_rule_manager.load_rules(
+            "ns1",
+            [cluster_param_rule("r1", 2, flow_id=301,
+                                threshold_type=C.FLOW_THRESHOLD_AVG_LOCAL)],
+        )
+        cluster_flow_rule_manager.load_rules(
+            "ns2",
+            [cluster_param_rule("r2", 2, flow_id=302,
+                                threshold_type=C.FLOW_THRESHOLD_AVG_LOCAL)],
+        )
+        got1 = sum(svc.request_param_token(301, 1, ["x"]).ok for _ in range(10))
+        got2 = sum(svc.request_param_token(302, 1, ["x"]).ok for _ in range(10))
+        assert got1 == 6  # 2 × 3 connections
+        assert got2 == 2  # 2 × 1 connection
+
+    def test_flow_avg_local_uses_namespace_count(self, cluster_env):
+        """Plain FLOW tokens also use per-namespace counts."""
+        from tests.test_cluster import cluster_rule
+
+        svc = DefaultTokenService(clock=ManualClock(0))
+        cm = ConnectionManager()
+        svc.connections = cm
+        cm.bind("a:1", "nsA")
+        cm.bind("b:1", "nsA")
+        cm.bind("c:1", "nsB")
+        cluster_flow_rule_manager.load_rules(
+            "nsA", [cluster_rule("fa", 3, flow_id=311,
+                                 threshold_type=C.FLOW_THRESHOLD_AVG_LOCAL)]
+        )
+        cluster_flow_rule_manager.load_rules(
+            "nsB", [cluster_rule("fb", 3, flow_id=312,
+                                 threshold_type=C.FLOW_THRESHOLD_AVG_LOCAL)]
+        )
+        assert sum(svc.request_token(311).ok for _ in range(10)) == 6
+        assert sum(svc.request_token(312).ok for _ in range(10)) == 3
+
+    def test_no_rule(self, cluster_env):
+        svc = DefaultTokenService(clock=ManualClock(0))
+        r = svc.request_param_token(999, 1, ["v"])
+        assert r.status == C.TokenResultStatus.NO_RULE_EXISTS
+
+    def test_blocked_multi_value_charges_nothing(self, cluster_env):
+        """Check-all-then-charge-all (ClusterParamFlowChecker): a
+        request blocked on one value must not drain the budgets of its
+        other values."""
+        svc = DefaultTokenService(clock=ManualClock(0))
+        cluster_flow_rule_manager.load_rules(
+            "default", [cluster_param_rule("r", 3, flow_id=210)]
+        )
+        for _ in range(3):
+            assert svc.request_param_token(210, 1, ["b"]).ok
+        # 'b' exhausted: mixed requests block and must not charge 'a'.
+        for _ in range(3):
+            r = svc.request_param_token(210, 1, ["a", "b"])
+            assert r.status == C.TokenResultStatus.BLOCKED
+        assert [svc.request_param_token(210, 1, ["a"]).ok for _ in range(4)] == [
+            True, True, True, False,
+        ]
+
+
+class TestWireNamespace:
+    def test_ping_binds_namespace_and_counts(self, cluster_env):
+        server = SentinelTokenServer(
+            port=0, service=DefaultTokenService(clock=ManualClock(0))
+        ).start()
+        try:
+            c1 = ClusterTokenClient("127.0.0.1", server.port, namespace="nsX").start()
+            c2 = ClusterTokenClient("127.0.0.1", server.port, namespace="nsX").start()
+            c3 = ClusterTokenClient("127.0.0.1", server.port, namespace="nsY").start()
+            # Ping is async after connect; wait for the groups to fill.
+            deadline = threading.Event()
+            for _ in range(100):
+                snap = server.connections.snapshot()
+                if snap.get("nsX") == 2 and snap.get("nsY") == 1:
+                    break
+                deadline.wait(0.02)
+            snap = server.connections.snapshot()
+            assert snap.get("nsX") == 2
+            assert snap.get("nsY") == 1
+            c1.stop(); c2.stop(); c3.stop()
+            for _ in range(100):
+                if server.connections.total() == 0:
+                    break
+                deadline.wait(0.02)
+            assert server.connections.total() == 0
+        finally:
+            server.stop()
+
+
+class TestEngineClusterParam:
+    def test_embedded_param_conservation(self, cluster_env, manual_clock, engine):
+        """cluster_mode ParamFlowRule through the engine against the
+        embedded token service: per-value global conservation, BLOCKED →
+        ParamFlowBlockError with the rule attributed."""
+        rule = cluster_param_rule("psvc", 2, flow_id=401)
+        cluster_flow_rule_manager.load_rules("default", [rule])
+        service = DefaultTokenService(clock=manual_clock)
+        server = SentinelTokenServer(port=0, service=service)  # embedded
+        EmbeddedClusterTokenServerProvider.register(server)
+        ClusterStateManager.set_to_server()
+        st.param_flow_rule_manager.load_rules([rule])
+        assert st.try_entry("psvc", args=("u1",)) is not None
+        assert st.try_entry("psvc", args=("u1",)) is not None
+        assert st.try_entry("psvc", args=("u1",)) is None  # server BLOCKED
+        # Another value has its own global budget.
+        assert st.try_entry("psvc", args=("u2",)) is not None
+        with pytest.raises(st.ParamFlowBlockError) as ei:
+            st.entry("psvc", args=("u1",))
+        assert ei.value.rule == rule
+
+    def test_engine_vs_live_tcp_server_conservation(self, cluster_env, manual_clock, engine):
+        """Two token clients hammer one live TCP token server through
+        engine entries; the global grant count is exactly the rule
+        budget (the ClusterParamFlowChecker conservation story)."""
+        rule = cluster_param_rule("tcp_psvc", 10, flow_id=402)
+        cluster_flow_rule_manager.load_rules("default", [rule])
+        server = SentinelTokenServer(
+            port=0, service=DefaultTokenService(clock=ManualClock(0))
+        ).start()
+        try:
+            client = ClusterTokenClient("127.0.0.1", server.port).start()
+            TokenClientProvider.register(client)
+            ClusterStateManager.set_to_client()
+            st.param_flow_rule_manager.load_rules([rule])
+            granted = sum(
+                st.try_entry("tcp_psvc", args=("hot",)) is not None
+                for _ in range(25)
+            )
+            assert granted == 10
+            client.stop()
+        finally:
+            server.stop()
+
+    def test_fallback_to_local_when_no_service(self, cluster_env, manual_clock, engine):
+        """FAIL → local param check (fallbackToLocalWhenFail), local
+        window enforces the rule count."""
+        rule = cluster_param_rule("pfb", 1, flow_id=403, fallback=True)
+        st.param_flow_rule_manager.load_rules([rule])
+        ClusterStateManager.stop()
+        assert st.try_entry("pfb", args=("k",)) is not None
+        assert st.try_entry("pfb", args=("k",)) is None  # local check blocks
+
+    def test_pass_when_no_service_and_no_fallback(self, cluster_env, manual_clock, engine):
+        rule = cluster_param_rule("pnf", 1, flow_id=404, fallback=False)
+        st.param_flow_rule_manager.load_rules([rule])
+        ClusterStateManager.stop()
+        for _ in range(5):
+            e = st.try_entry("pnf", args=("k",))
+            assert e is not None
+            e.exit()
+
+    def test_thread_grade_stays_local(self, cluster_env, manual_clock, engine):
+        """THREAD-grade param rules never consult the token server
+        (ParamFlowChecker only clusters QPS grade)."""
+        rule = ParamFlowRule(
+            "pthr",
+            count=1,
+            param_idx=0,
+            grade=C.FLOW_GRADE_THREAD,
+            cluster_mode=True,
+            cluster_config=ClusterFlowConfig(flow_id=405),
+        )
+
+        class ExplodingService:
+            def request_param_token(self, *a, **k):
+                raise AssertionError("THREAD-grade must not RPC")
+
+        server = SentinelTokenServer(port=0, service=ExplodingService())
+        EmbeddedClusterTokenServerProvider.register(server)
+        ClusterStateManager.set_to_server()
+        st.param_flow_rule_manager.load_rules([rule])
+        e = st.try_entry("pthr", args=("k",))
+        assert e is not None
+        assert st.try_entry("pthr", args=("k",)) is None  # local thread gauge
+        e.exit()
+        assert st.try_entry("pthr", args=("k",)) is not None
