@@ -65,6 +65,10 @@ from sentinel_tpu.rules.degrade_manager import degrade_rule_manager
 from sentinel_tpu.rules.system_manager import system_rule_manager
 from sentinel_tpu.rules.authority_manager import authority_rule_manager
 from sentinel_tpu.rules.param_manager import param_flow_rule_manager
+from sentinel_tpu.metrics.window_properties import (
+    interval_property,
+    sample_count_property,
+)
 
 __all__ = [
     "__version__",
@@ -102,4 +106,6 @@ __all__ = [
     "system_rule_manager",
     "authority_rule_manager",
     "param_flow_rule_manager",
+    "sample_count_property",
+    "interval_property",
 ]

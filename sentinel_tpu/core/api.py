@@ -136,6 +136,23 @@ def reset(clock: Optional[Clock] = None) -> Engine:
                     "[api.reset] quiescing the pre-reset engine failed",
                     exc_info=True,
                 )
+        # Window geometry is engine-scoped runtime state: a fresh engine
+        # starts at the default 2×500 ms second window even if the old
+        # one was retuned (SampleCountProperty defaults).
+        from sentinel_tpu.metrics import nodes as _nodes
+        from sentinel_tpu.metrics import window_properties as _wp
+        from sentinel_tpu.models import constants as _C
+
+        _nodes.set_second_window(
+            _C.DEFAULT_SAMPLE_COUNT, _C.DEFAULT_WINDOW_INTERVAL_MS
+        )
+        # Clear the geometry properties too: leaving stale values would
+        # make a post-reset re-push of the same config a no-op
+        # (DynamicSentinelProperty drops equal values), silently
+        # desyncing the engine from its driving datasource. A None
+        # update fires the listeners, which no-op on None.
+        _wp.sample_count_property.update_value(None)
+        _wp.interval_property.update_value(None)
         _engine = Engine(clock=clock)
     ContextUtil.replace_context(None)
     reset_tracer_filters()

@@ -44,6 +44,31 @@ MINUTE_CFG = ma.MetricArrayConfig(
 )
 
 
+def set_second_window(sample_count: int, interval_ms: int) -> ma.MetricArrayConfig:
+    """Rebind the second-window geometry (reference:
+    SampleCountProperty.java + IntervalProperty.java — updating either
+    rebuilds every StatisticNode's rolling second counter and RESETS its
+    statistics; the minute window and thread gauges are untouched).
+
+    This only swaps the module-global config; callers that own stats
+    tensors (Engine.retune_second_window) must rebuild them to the new
+    geometry. All kernel readers reference ``nodes.SECOND_CFG``
+    dynamically and key their jit caches on it, so the next trace bakes
+    the new constants."""
+    global SECOND_CFG
+    sample_count = int(sample_count)
+    interval_ms = int(interval_ms)
+    if sample_count <= 0 or interval_ms <= 0 or interval_ms % sample_count != 0:
+        # SampleCountProperty ignores invalid updates (java:42-49).
+        raise ValueError(
+            "invalid window geometry: sample_count must divide interval_ms"
+        )
+    SECOND_CFG = ma.MetricArrayConfig(
+        sample_count=sample_count, interval_ms=interval_ms, max_rt=SECOND_CFG.max_rt
+    )
+    return SECOND_CFG
+
+
 class StatsState(NamedTuple):
     """Device-resident statistics for all nodes.
 
@@ -82,6 +107,21 @@ def make_stats(n_rows: int) -> StatsState:
         threads=jnp.zeros((n_rows,), dtype=jnp.int32),
         future_pass=jnp.zeros((n_rows, b), dtype=jnp.int32),
         future_ws=jnp.full((n_rows, b), SECOND_CFG.empty_ws, dtype=jnp.int32),
+    )
+
+
+def rebuild_second(state: StatsState) -> StatsState:
+    """Rebuild the second window + occupy slab to the CURRENT
+    ``SECOND_CFG`` geometry, dropping their contents (the reference's
+    ``rollingCounterInSecond = new ArrayMetric(...)`` on a
+    SampleCountProperty/IntervalProperty update — a clean statistics
+    reset). Minute window and live thread gauges carry over."""
+    n = state.n_rows
+    b = SECOND_CFG.sample_count
+    return state._replace(
+        second=ma.make_state(n, SECOND_CFG),
+        future_pass=jnp.zeros((n, b), dtype=jnp.int32),
+        future_ws=jnp.full((n, b), SECOND_CFG.empty_ws, dtype=jnp.int32),
     )
 
 

@@ -1,0 +1,63 @@
+"""Live second-window geometry properties.
+
+Reference: node/SampleCountProperty.java:26-52 and
+node/IntervalProperty.java:26-50 — two static SentinelProperty<Integer>
+hooks; updating either rebuilds every StatisticNode's rolling second
+counter to ``SampleCountProperty.SAMPLE_COUNT`` buckets over
+``IntervalProperty.INTERVAL`` ms and resets its second-window
+statistics ("All statistics will be reset" in the reference's own
+words). Datasources can drive them like any other property.
+
+Here both feed :meth:`Engine.retune_second_window`, which drains
+pending ops against the old geometry, swaps ``nodes.SECOND_CFG`` and
+rebuilds the shared second-window tensors; the jitted flush kernels key
+their caches on the config so the next flush re-traces with the new
+constants.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from sentinel_tpu.core.property import DynamicSentinelProperty, FuncListener
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.utils.record_log import record_log
+
+# Initial value None: registration fires config_load with the current
+# value, and a None no-ops in the listeners below — importing this
+# module must not instantiate the engine.
+sample_count_property: DynamicSentinelProperty = DynamicSentinelProperty(None)
+interval_property: DynamicSentinelProperty = DynamicSentinelProperty(None)
+
+_lock = threading.Lock()
+
+
+def _apply(sample_count: Optional[int], interval_ms: Optional[int]) -> None:
+    """Combine the updated dimension with the live geometry (the other
+    dimension always reads whatever is currently in force, like the
+    reference pairing SAMPLE_COUNT with IntervalProperty.INTERVAL)."""
+    from sentinel_tpu.core import api
+    from sentinel_tpu.metrics import nodes
+
+    with _lock:
+        sc = int(sample_count) if sample_count is not None else nodes.SECOND_CFG.sample_count
+        iv = int(interval_ms) if interval_ms is not None else nodes.SECOND_CFG.interval_ms
+        try:
+            api.get_engine().retune_second_window(sc, iv)
+            record_log.info(
+                "[WindowProperties] second window retuned to %d x %d ms", sc, iv // sc
+            )
+        except ValueError as e:
+            # SampleCountProperty ignores invalid updates (java:42-49).
+            record_log.warn(
+                "[WindowProperties] rejected geometry %dx%dms: %s", sc, iv, e
+            )
+
+
+sample_count_property.add_listener(
+    FuncListener(lambda v: _apply(v, None) if v is not None else None)
+)
+interval_property.add_listener(
+    FuncListener(lambda v: _apply(None, v) if v is not None else None)
+)

@@ -43,9 +43,9 @@ import numpy as np
 from sentinel_tpu.core import errors as E
 from sentinel_tpu.metrics.events import MetricEvent
 from sentinel_tpu.metrics import metric_array as ma
+from sentinel_tpu.metrics import nodes as _ncfg
 from sentinel_tpu.metrics.nodes import (
     MINUTE_CFG,
-    SECOND_CFG,
     NodeRegistry,
     StatsState,
     grow_stats,
@@ -461,6 +461,38 @@ class Engine:
                     self.mesh = None
                     self._sharded_fns = None
                     self._n_shards = 1
+        finally:
+            self._post_flush(drained)
+
+    def retune_second_window(self, sample_count: int, interval_ms: int) -> None:
+        """Live retune of the second-window geometry (reference:
+        SampleCountProperty.updateSampleCount / IntervalProperty
+        .updateInterval — node/SampleCountProperty.java:33-52): every
+        node's rolling second counter is rebuilt to the new
+        ``sample_count × (interval_ms / sample_count)`` layout and its
+        second-window statistics reset cleanly; minute windows and live
+        thread gauges carry over. Pending ops are drain-flushed against
+        the OLD geometry first, so no batch ever spans two layouts.
+        Invalid geometry (sample_count not dividing interval_ms) raises
+        without touching state, like the reference ignoring the update.
+        """
+        drained = ([], [])
+        try:
+            with self._flush_lock:
+                self._flush_locked(drained)
+                with self._lock:
+                    cur = _ncfg.SECOND_CFG
+                    if (
+                        cur.sample_count == int(sample_count)
+                        and cur.interval_ms == int(interval_ms)
+                    ):
+                        return
+                    _ncfg.set_second_window(sample_count, interval_ms)
+                    self.stats = _ncfg.rebuild_second(self.stats)
+                    if self._sharded_fns is not None:
+                        # Mesh kernels bake the geometry at trace time;
+                        # drop them so the next flush re-traces.
+                        self._sharded_fns = {}
         finally:
             self._post_flush(drained)
     def _sharded_fn_for(
@@ -1103,12 +1135,12 @@ class Engine:
 
         self.stats = self.stats._replace(
             second=self.stats.second._replace(
-                window_start=shift_ws(self.stats.second.window_start, SECOND_CFG.empty_ws)
+                window_start=shift_ws(self.stats.second.window_start, _ncfg.SECOND_CFG.empty_ws)
             ),
             minute=self.stats.minute._replace(
                 window_start=shift_ws(self.stats.minute.window_start, MINUTE_CFG.empty_ws)
             ),
-            future_ws=shift_ws(self.stats.future_ws, SECOND_CFG.empty_ws),
+            future_ws=shift_ws(self.stats.future_ws, _ncfg.SECOND_CFG.empty_ws),
         )
         self.flow_dyn = self.flow_dyn._replace(
             latest_passed_time=shift_ws(self.flow_dyn.latest_passed_time, -(10**9)),
@@ -1815,6 +1847,10 @@ class Engine:
             with_exits=bool(exits) or bool(bulk_exits),
             shaping_rounds=sh_rounds,
             param_rounds=p_rounds,
+            # Keys the jit cache on the live window geometry so a
+            # retune_second_window with unchanged shapes (interval-only
+            # change) cannot hit a stale-constant entry.
+            win_key=_ncfg.SECOND_CFG,
         )
         if self._sharded_fns is not None:
             # Mesh mode: one global batch sharded over the chips;
@@ -1853,6 +1889,15 @@ class Engine:
             breaker_snap = None
             with self._breaker_mirror_lock:
                 self._breaker_mirror_valid = False
+                # Also fence out older in-flight deferred fills: a fill
+                # dispatched BEFORE this unobserved flush would
+                # otherwise land later, set the mirror valid again with
+                # pre-gap state, and make the next observed diff report
+                # THIS flush's transitions — breaking the "first
+                # observed flush resyncs silently" contract. Advancing
+                # applied_seq to the current seq makes the seq guard
+                # drop them.
+                self._breaker_applied_seq = self._breaker_seq
 
         def _fetch_and_fill(res):
             return self._fill_results(
@@ -2211,9 +2256,9 @@ class Engine:
         now_i = jnp.int32(self.clock.now_ms() if now is None else now)
         return jax.device_get(
             (
-                ma.window_sums(SECOND_CFG, self.stats.second, now_i),
+                ma.window_sums(_ncfg.SECOND_CFG, self.stats.second, now_i),
                 ma.window_sums(MINUTE_CFG, self.stats.minute, now_i),
-                ma.window_min_rt(SECOND_CFG, self.stats.second, now_i),
+                ma.window_min_rt(_ncfg.SECOND_CFG, self.stats.second, now_i),
                 self.stats.threads,
                 occupied_in_window(self.stats, now_i),
                 waiting_tokens(self.stats, now_i),
@@ -2242,7 +2287,7 @@ class Engine:
         threads = int(threads_all[row])
         occ_cur = int(occ_all[row])
         waiting = int(wait_all[row])
-        interval_sec = SECOND_CFG.interval_ms / 1000.0
+        interval_sec = _ncfg.SECOND_CFG.interval_ms / 1000.0
         success = int(sec[MetricEvent.SUCCESS])
         rt_sum = int(sec[MetricEvent.RT])
         return {

@@ -49,9 +49,9 @@ import jax.numpy as jnp
 from sentinel_tpu.core import errors as E
 from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
 from sentinel_tpu.metrics import metric_array as ma
+from sentinel_tpu.metrics import nodes as _ncfg
 from sentinel_tpu.metrics.nodes import (
     MINUTE_CFG,
-    SECOND_CFG,
     StatsState,
     apply_updates,
     waiting_tokens,
@@ -217,16 +217,16 @@ def flow_admission(
     n, k = batch.e_rule_gid.shape
     r_rows = stats.n_rows
     nr = flow_dev.n_rules
-    interval = SECOND_CFG.interval_ms
-    wlen = SECOND_CFG.window_len_ms
-    nb = SECOND_CFG.sample_count
+    interval = _ncfg.SECOND_CFG.interval_ms
+    wlen = _ncfg.SECOND_CFG.window_len_ms
+    nb = _ncfg.SECOND_CFG.sample_count
     interval_sec = interval / 1000.0
 
     # Matured borrowed tokens are already in the buckets:
     # materialize_matured runs before admission in every flush path
     # (flush_step and the sharded two-pass), which the expiring-window
     # math in the occupy loop below also relies on.
-    pass_sums = ma.window_sums(SECOND_CFG, stats.second, batch.now)[:, MetricEvent.PASS]
+    pass_sums = ma.window_sums(_ncfg.SECOND_CFG, stats.second, batch.now)[:, MetricEvent.PASS]
 
     gid_f = batch.e_rule_gid.reshape(-1)
     row_f = batch.e_check_row.reshape(-1)
@@ -408,8 +408,8 @@ def commit_borrow_slab(
     """
     n, k = occ_slot.shape
     r_rows = stats.n_rows
-    nb = SECOND_CFG.sample_count
-    wlen = SECOND_CFG.window_len_ms
+    nb = _ncfg.SECOND_CFG.sample_count
+    wlen = _ncfg.SECOND_CFG.window_len_ms
 
     occ_f = occ_slot.reshape(-1)
     tgt_f = occ_target.reshape(-1)
@@ -424,7 +424,7 @@ def commit_borrow_slab(
     s_new = jnp.concatenate([ones, sk_s[1:] != sk_s[:-1]])
     s_sid = jnp.cumsum(s_new.astype(jnp.int32)) - 1
     s_valid = occ_f[sp_s]
-    s_ws = jnp.where(s_valid, tgt_f[sp_s], jnp.int32(SECOND_CFG.empty_ws))
+    s_ws = jnp.where(s_valid, tgt_f[sp_s], jnp.int32(_ncfg.SECOND_CFG.empty_ws))
     s_acq = jnp.where(s_valid, acq_f[sp_s], 0)
     seg_ws = jax.ops.segment_max(s_ws, s_sid, num_segments=n * k)
     contrib = s_valid & (s_ws == seg_ws[s_sid])
@@ -475,12 +475,12 @@ def system_check(
     is_in = batch.e_rows[:, 3] >= 0
     checked = live & is_in
 
-    sums = ma.window_sums(SECOND_CFG, stats.second, batch.now)[0]
+    sums = ma.window_sums(_ncfg.SECOND_CFG, stats.second, batch.now)[0]
     pass_sum = sums[MetricEvent.PASS].astype(jnp.float32)
     success = sums[MetricEvent.SUCCESS].astype(jnp.float32)
     rt_sum = sums[MetricEvent.RT].astype(jnp.float32)
     threads0 = stats.threads[0].astype(jnp.float32)
-    interval_sec = SECOND_CFG.interval_ms / 1000.0
+    interval_sec = _ncfg.SECOND_CFG.interval_ms / 1000.0
 
     # Intra-batch charge among inbound entries, in (ts, arrival) order.
     key = jnp.where(checked, 0, 1).astype(jnp.int32)
@@ -507,15 +507,15 @@ def system_check(
 
     # BBR (checkBbr): under high load, block unless
     # curThread <= maxSuccessQps * minRt / 1000 (or curThread <= 1).
-    valid_b = (batch.now - stats.second.window_start[0]) <= SECOND_CFG.interval_ms
+    valid_b = (batch.now - stats.second.window_start[0]) <= _ncfg.SECOND_CFG.interval_ms
     succ_buckets = jnp.where(
         valid_b, stats.second.counts[0, :, MetricEvent.SUCCESS], 0
     )
     max_success_qps = (
-        jnp.max(succ_buckets).astype(jnp.float32) * SECOND_CFG.sample_count
+        jnp.max(succ_buckets).astype(jnp.float32) * _ncfg.SECOND_CFG.sample_count
     )
     min_rt = jnp.min(
-        jnp.where(valid_b, stats.second.min_rt[0], jnp.int32(SECOND_CFG.max_rt))
+        jnp.where(valid_b, stats.second.min_rt[0], jnp.int32(_ncfg.SECOND_CFG.max_rt))
     ).astype(jnp.float32)
     load_on = (sysdev.load_threshold >= 0) & (sysdev.cur_load > sysdev.load_threshold)
     bbr_bad = (cur_thread > 1) & (cur_thread > max_success_qps * min_rt / 1000.0)
@@ -693,7 +693,7 @@ def flush_entries(
         k = batch.e_rule_gid.shape[1]
         ppc_s = pass_plus_consumed[jnp.clip(shaping.flat_pos, 0, n * k - 1)]
         prev_s = _prev_second_pass(stats, shaping.row, shaping.ts)
-        interval_sec = SECOND_CFG.interval_ms / 1000.0
+        interval_sec = _ncfg.SECOND_CFG.interval_ms / 1000.0
         shaping_live = shaping._replace(valid=shaping.valid & live[shaping.eidx])
         flow_dyn, ok_s, wait_s = run_shaping(
             flow_dev, flow_dyn, shaping_live, ppc_s, prev_s, interval_sec,
@@ -878,9 +878,15 @@ def flush_step(
 # engine picks per flush so DEFAULT-only traffic never pays for the
 # shaping/param machinery. occupy_timeout_ms and the with_* stage
 # flags are static (each used combination compiles once and is cached).
+# ``win_key`` is the current second-window geometry (the engine passes
+# ``_ncfg.SECOND_CFG``): the kernels read the module-global config at
+# trace time, so a live window retune (SampleCountProperty /
+# IntervalProperty parity) must key the jit cache on it — an
+# interval-only change keeps every tensor shape and would otherwise
+# silently hit the stale-constant cache entry.
 _STATIC_FLAGS = (
     "occupy_timeout_ms", "with_occupy", "with_system", "with_degrade", "with_exits",
-    "shaping_rounds", "param_rounds",
+    "shaping_rounds", "param_rounds", "win_key",
 )
 
 
@@ -888,7 +894,7 @@ _STATIC_FLAGS = (
 def flush_step_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0,
+    shaping_rounds=0, param_rounds=0, win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch,
@@ -904,7 +910,7 @@ def flush_step_shaping_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
     occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0,
+    shaping_rounds=0, param_rounds=0, win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
@@ -920,7 +926,7 @@ def flush_step_param_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, param,
     occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0,
+    shaping_rounds=0, param_rounds=0, win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, None, param,
@@ -936,7 +942,7 @@ def flush_step_full_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
     occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0,
+    shaping_rounds=0, param_rounds=0, win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,

@@ -1,0 +1,130 @@
+"""Live retune of the second-window geometry.
+
+Reference semantics: node/SampleCountProperty.java:33-52 +
+node/IntervalProperty.java — updating either property rebuilds every
+node's rolling second counter at runtime and RESETS its second-window
+statistics; minute windows and thread gauges are untouched.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.metrics import nodes
+from sentinel_tpu.models import constants as C
+
+
+def _admitted(n, resource="res"):
+    return sum(st.try_entry(resource) is not None for _ in range(n))
+
+
+class TestRetune:
+    def test_geometry_swap_mid_stream(self, manual_clock, engine):
+        """2×500 ms → 4×250 ms mid-stream: tensors rebuilt, enforcement
+        continues on the new layout with a clean stats reset."""
+        st.flow_rule_manager.load_rules([st.FlowRule("res", count=10)])
+        assert _admitted(15) == 10
+        assert engine.stats.second.counts.shape[1] == 2
+
+        engine.retune_second_window(4, 1000)
+        assert nodes.SECOND_CFG.sample_count == 4
+        assert nodes.SECOND_CFG.window_len_ms == 250
+        assert engine.stats.second.counts.shape[1] == 4
+        assert engine.stats.future_pass.shape[1] == 4
+
+        # Statistics reset (the reference's documented behavior): the
+        # full budget is available again in the same wall-clock window.
+        assert _admitted(15) == 10
+
+        # The new 250 ms buckets roll correctly: after 750 ms, the
+        # first ~3 buckets of spend age out across the window edge.
+        manual_clock.advance(1001)
+        assert _admitted(15) == 10
+
+    def test_interval_only_change_retraces(self, manual_clock, engine):
+        """Interval-only retune keeps every tensor shape; the win_key
+        static arg must still force a re-trace so thresholds use the
+        new interval (a stale cache would admit 5, not 10, per 2 s)."""
+        st.flow_rule_manager.load_rules([st.FlowRule("res", count=5)])
+        assert _admitted(10) == 5  # 5/s over the default 1 s window
+
+        engine.retune_second_window(2, 2000)
+        assert engine.stats.second.counts.shape[1] == 2  # same shape!
+        # count=5 QPS over a 2 s window = 10 admissions per window.
+        assert _admitted(20) == 10
+        manual_clock.advance(2001)
+        assert _admitted(20) == 10
+
+    def test_minute_window_and_threads_survive(self, manual_clock, engine):
+        """Only the second window resets — minute totals and live
+        thread gauges carry over (the reference rebuilds
+        rollingCounterInSecond alone)."""
+        st.flow_rule_manager.load_rules([st.FlowRule("res", count=100)])
+        e1 = st.entry("res")
+        e2 = st.entry("res")
+        for _ in range(10):
+            ee = st.try_entry("res")
+            if ee is not None:
+                ee.exit()
+        stats_before = engine.cluster_node_stats("res")
+        assert stats_before["total_pass_minute"] >= 10
+
+        engine.retune_second_window(4, 1000)
+        stats_after = engine.cluster_node_stats("res")
+        # Minute-window totals survive the retune.
+        assert stats_after["total_pass_minute"] == stats_before["total_pass_minute"]
+        # Thread gauge survives: both held entries still counted.
+        assert stats_after["cur_thread_num"] == 2
+        e1.exit()
+        e2.exit()
+        assert engine.cluster_node_stats("res")["cur_thread_num"] == 0
+
+    def test_invalid_geometry_rejected(self, manual_clock, engine):
+        with pytest.raises(ValueError):
+            engine.retune_second_window(3, 1000)  # 3 does not divide 1000
+        assert nodes.SECOND_CFG.sample_count == C.DEFAULT_SAMPLE_COUNT
+
+    def test_noop_retune_keeps_state(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("res", count=5)])
+        assert _admitted(3) == 3
+        engine.retune_second_window(
+            C.DEFAULT_SAMPLE_COUNT, C.DEFAULT_WINDOW_INTERVAL_MS
+        )
+        # Same geometry → no reset: only 2 of the budget remain.
+        assert _admitted(5) == 2
+
+    def test_properties_drive_retune(self, manual_clock, engine):
+        """SampleCountProperty/IntervalProperty parity: pushing values
+        through the exported properties retunes the live engine."""
+        st.sample_count_property.update_value(4)
+        assert nodes.SECOND_CFG.sample_count == 4
+        assert st.get_engine().stats.second.counts.shape[1] == 4
+        st.interval_property.update_value(2000)
+        assert nodes.SECOND_CFG.interval_ms == 2000
+        assert nodes.SECOND_CFG.window_len_ms == 500
+        # Invalid combos are ignored, not raised (property path).
+        st.sample_count_property.update_value(3)  # 3 ∤ 2000
+        assert nodes.SECOND_CFG.sample_count == 4
+
+    def test_reset_restores_default_geometry(self, manual_clock):
+        from sentinel_tpu.core import api
+
+        api.get_engine().retune_second_window(4, 2000)
+        assert nodes.SECOND_CFG.sample_count == 4
+        api.reset(clock=manual_clock)
+        assert nodes.SECOND_CFG.sample_count == C.DEFAULT_SAMPLE_COUNT
+        assert nodes.SECOND_CFG.interval_ms == C.DEFAULT_WINDOW_INTERVAL_MS
+
+    def test_repush_same_value_after_reset(self, manual_clock):
+        """reset() clears the property values too: re-delivering the
+        SAME geometry after a reset must retune again, not be dropped
+        by the property's equality check."""
+        from sentinel_tpu.core import api
+
+        st.sample_count_property.update_value(4)
+        assert nodes.SECOND_CFG.sample_count == 4
+        api.reset(clock=manual_clock)
+        assert nodes.SECOND_CFG.sample_count == C.DEFAULT_SAMPLE_COUNT
+        st.sample_count_property.update_value(4)  # same value as before
+        assert nodes.SECOND_CFG.sample_count == 4
+        assert api.get_engine().stats.second.counts.shape[1] == 4
