@@ -64,6 +64,7 @@ EVT_NODE_DELETED = 2
 EVT_NODE_DATA_CHANGED = 3
 
 ERR_OK = 0
+ERR_CONNECTIONLOSS = -4
 ERR_NONODE = -101
 ERR_NODEEXISTS = -110
 
@@ -79,6 +80,32 @@ class ZkError(Exception):
     def __init__(self, msg: str, code: int = 0):
         super().__init__(msg)
         self.code = code
+
+
+def _parse_connect_string(addr: str) -> List[Tuple[str, int]]:
+    """Curator connect string → [(host, port)].
+
+    Accepts "h1:p1,h2:p2", bracketed IPv6 ("[fe80::2]:2181"), bare
+    hosts (default port 2181), and bare IPv6 literals without a port
+    (more than one colon, no brackets)."""
+    out: List[Tuple[str, int]] = []
+    for token in addr.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        if token.startswith("["):
+            host, _, rest = token[1:].partition("]")
+            port_s = rest.lstrip(":")
+        elif token.count(":") > 1:
+            # Bare IPv6 literal — no way to carry a port without
+            # brackets, so the whole token is the host.
+            host, port_s = token, ""
+        else:
+            host, _, port_s = token.partition(":")
+        out.append((host, int(port_s or 2181)))
+    if not out:
+        raise ValueError(f"empty zookeeper connect string: {addr!r}")
+    return out
 
 
 # --- jute codec helpers ----------------------------------------------
@@ -222,7 +249,7 @@ class _ZkConn:
         """Send one request; block for its reply. Returns (err, body
         reader positioned after the ReplyHeader)."""
         if self._dead.is_set():
-            raise ZkError("connection dead")
+            raise ZkError("connection dead", ERR_CONNECTIONLOSS)
         slot = {"err": None, "body": None, "fail": None}
         ev = threading.Event()
         try:
@@ -344,8 +371,15 @@ class ZookeeperDataSource(PushDataSource[str, T], WritableDataSource[str]):
         if not path.startswith("/"):
             path = "/" + path
         self.path = path
-        host, _, port = server_addr.partition(":")
-        self.host, self.port = host, int(port or 2181)
+        # Curator-style multi-server connect string
+        # ("host1:2181,host2:2181", ZookeeperDataSource.java's
+        # CuratorFramework connectString): reconnects rotate through the
+        # ensemble. IPv6 literals with a port use brackets
+        # ("[::1]:2181"); a bare multi-colon token is an IPv6 host at
+        # the default port.
+        self._servers = _parse_connect_string(server_addr)
+        self._server_idx = 0
+        self.host, self.port = self._servers[0]
         self.session_timeout_ms = session_timeout_ms
         self.reconnect_interval = reconnect_interval_sec
         self.request_timeout = request_timeout_sec
@@ -381,16 +415,28 @@ class ZookeeperDataSource(PushDataSource[str, T], WritableDataSource[str]):
     # -- datasource surface --
     def read_source(self) -> Optional[str]:
         """One-shot read (no watch) through the live session, or a
-        transient connection when the watcher isn't running."""
+        transient connection when the watcher isn't running. The live
+        attempt races the session loop closing the connection (the
+        dead-check is a snapshot), so a ZkError there falls back to one
+        transient-connection retry instead of surfacing a spurious
+        failure mid-reconnect."""
         conn = self._conn
         if conn is not None and not conn._dead.is_set():
-            data = self._get_data(conn, watch=False)
-        else:
-            conn = self._connect()
             try:
                 data = self._get_data(conn, watch=False)
-            finally:
-                conn.close()
+                return None if data is None else data.decode("utf-8", errors="replace")
+            except ZkError as exc:
+                if exc.code not in (0, ERR_CONNECTIONLOSS):
+                    # A real server verdict (NOAUTH…) would just repeat
+                    # on a fresh connection — surface it instead of
+                    # paying a full extra session per poll.
+                    raise
+                # fall through to the transient path
+        conn = self._connect()
+        try:
+            data = self._get_data(conn, watch=False)
+        finally:
+            conn.close()
         return None if data is None else data.decode("utf-8", errors="replace")
 
     def write(self, value: str) -> None:
@@ -399,11 +445,8 @@ class ZookeeperDataSource(PushDataSource[str, T], WritableDataSource[str]):
         WritableDataSource contract; the Java zookeeper module is
         read-only, the etcd/consul modules set the writable shape)."""
         data = value.encode("utf-8")
-        conn = self._conn
-        transient = conn is None or conn._dead.is_set()
-        if transient:
-            conn = self._connect()
-        try:
+
+        def _set(conn: _ZkConn) -> None:
             err, _ = conn.request(
                 OP_SETDATA,
                 _pack_str(self.path) + _pack_buf(data) + struct.pack(">i", -1),
@@ -413,26 +456,56 @@ class ZookeeperDataSource(PushDataSource[str, T], WritableDataSource[str]):
                 self._create_recursive(conn, self.path, data)
             elif err != ERR_OK:
                 raise ZkError(f"setData failed (err={err})", err)
+
+        conn = self._conn
+        if conn is not None and not conn._dead.is_set():
+            try:
+                _set(conn)
+                return
+            except ZkError as exc:
+                if exc.code not in (0, ERR_CONNECTIONLOSS):
+                    # A real server verdict (NOAUTH, BADVERSION…) would
+                    # just repeat on a fresh connection — surface it.
+                    raise
+                # Session loop closed the live conn under us (the
+                # dead-check is a snapshot): retry once transiently.
+        conn = self._connect()
+        try:
+            _set(conn)
         finally:
-            if transient:
-                conn.close()
+            conn.close()
 
     # -- internals --
     def _connect(self) -> _ZkConn:
-        conn = _ZkConn(
-            self.host,
-            self.port,
-            self.session_timeout_ms,
-            on_event=self._on_watch_event,
-            on_dead=self._on_conn_dead,
-        )
-        try:
-            for scheme, creds in self.auth:
-                conn.add_auth(scheme, creds)
-        except BaseException:
-            conn.close()  # don't strand a handshaken conn + reader
-            raise
-        return conn
+        """Connect to the ensemble, rotating through the server list on
+        failure (Curator's round-robin HostProvider): each attempt that
+        fails advances the rotation so the session loop's next call
+        tries the next server; one full cycle of failures raises."""
+        last_exc: Optional[BaseException] = None
+        for _ in range(len(self._servers)):
+            host, port = self._servers[self._server_idx]
+            try:
+                conn = _ZkConn(
+                    host,
+                    port,
+                    self.session_timeout_ms,
+                    on_event=self._on_watch_event,
+                    on_dead=self._on_conn_dead,
+                )
+            except (OSError, ZkError) as exc:
+                last_exc = exc
+                self._server_idx = (self._server_idx + 1) % len(self._servers)
+                continue
+            try:
+                for scheme, creds in self.auth:
+                    conn.add_auth(scheme, creds)
+            except BaseException:
+                conn.close()  # don't strand a handshaken conn + reader
+                raise
+            self.host, self.port = host, port
+            return conn
+        assert last_exc is not None
+        raise last_exc
 
     def _create_recursive(self, conn: _ZkConn, path: str, data: bytes) -> None:
         parts = [p for p in path.split("/") if p]

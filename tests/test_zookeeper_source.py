@@ -373,3 +373,37 @@ def test_garbage_rule_payload_keeps_rules(fake_zk):
         assert all(v is not None for v in [src.get_property().value])
     finally:
         src.close()
+
+
+class TestConnectString:
+    def test_parse_variants(self):
+        from sentinel_tpu.datasource.zookeeper_source import _parse_connect_string
+
+        assert _parse_connect_string("h1:2181,h2:2182") == [("h1", 2181), ("h2", 2182)]
+        assert _parse_connect_string("h1") == [("h1", 2181)]
+        assert _parse_connect_string("[::1]:2183") == [("::1", 2183)]
+        assert _parse_connect_string("fe80::2") == [("fe80::2", 2181)]
+        assert _parse_connect_string(" a:1 , b:2 ") == [("a", 1), ("b", 2)]
+        with pytest.raises(ValueError):
+            _parse_connect_string("")
+
+    def test_ensemble_failover(self, fake_zk):
+        """First server in the connect string is dead; the session loop
+        rotates to the live one (Curator HostProvider round-robin)."""
+        fake_zk.set_data("/sentinel/flow", _rules_json(9).encode())
+        src = ZookeeperDataSource(
+            json_converter(FlowRule),
+            path="/sentinel/flow",
+            server_addr=f"127.0.0.1:1,127.0.0.1:{fake_zk.port}",
+            reconnect_interval_sec=0.05,
+        )
+        src.start()
+        try:
+            assert _wait(
+                lambda: (src.get_property().value or [None])[0]
+                and src.get_property().value[0].count == 9,
+                timeout=8.0,
+            )
+            assert src.port == fake_zk.port  # settled on the live server
+        finally:
+            src.close()
