@@ -29,6 +29,7 @@ class _Handler(socketserver.BaseRequestHandler):
         server._conn_changed(+1)
         client_addr = "%s:%d" % self.client_address[:2]
         server.connections.on_connect(client_addr)
+        server._track_socket(self.request, add=True)
         try:
             while True:
                 try:
@@ -105,6 +106,7 @@ class _Handler(socketserver.BaseRequestHandler):
         except (ConnectionError, OSError):
             pass
         finally:
+            server._track_socket(self.request, add=False)
             server._conn_changed(-1)
             server.connections.on_disconnect(client_addr)
             # A vanished client cannot release its held concurrency
@@ -141,6 +143,31 @@ class SentinelTokenServer:
         self._thread: Optional[threading.Thread] = None
         self._conn_count = 0
         self._lock = threading.Lock()
+        self._active_socks: set = set()
+        self._stopping = False
+
+    def _track_socket(self, sock, add: bool) -> None:
+        close_now = False
+        with self._lock:
+            if add:
+                if self._stopping:
+                    # Raced stop(): the drain already happened, so
+                    # registering would orphan this socket and leave its
+                    # client a half-dead session — close it instead.
+                    close_now = True
+                else:
+                    self._active_socks.add(sock)
+            else:
+                self._active_socks.discard(sock)
+        if close_now:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     @property
     def port(self) -> int:
@@ -157,6 +184,8 @@ class SentinelTokenServer:
     def start(self) -> "SentinelTokenServer":
         if self._server is not None:
             return self
+        with self._lock:
+            self._stopping = False  # re-armable after a stop()
         self._server = _TCPServer(("0.0.0.0", self._requested_port), _Handler)
         self._server.token_server = self  # type: ignore[attr-defined]
         self._thread = threading.Thread(
@@ -171,3 +200,20 @@ class SentinelTokenServer:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        # Close established connections too (NettyTransportServer.stop
+        # closing its channel group): clients must observe EOF and enter
+        # their reconnect loop, not keep a half-dead session. The
+        # _stopping flag makes a handler that raced past accept close
+        # its own socket instead of registering into the drained set.
+        with self._lock:
+            self._stopping = True
+            socks, self._active_socks = list(self._active_socks), set()
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass

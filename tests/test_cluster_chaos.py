@@ -1,0 +1,251 @@
+"""Chaos tests for the cluster TCP plane.
+
+Reference behaviors under test: the token client survives a token
+server restart mid-load — scheduled reconnect
+(NettyTransportClient.java:114-166) with FAIL→fallback-to-local
+admissions during the outage (FlowRuleChecker.fallbackToLocalOrPass) —
+and both sides survive torn/garbage frames on connections that were
+previously healthy (LengthFieldBasedFrameDecoder drop semantics).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import (
+    ClusterStateManager,
+    DefaultTokenService,
+    EmbeddedClusterTokenServerProvider,
+    TokenClientProvider,
+    cluster_flow_rule_manager,
+    cluster_server_config_manager,
+)
+from sentinel_tpu.cluster import protocol
+from sentinel_tpu.cluster.client import ClusterTokenClient
+from sentinel_tpu.cluster.server import SentinelTokenServer
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import ClusterFlowConfig, FlowRule
+from sentinel_tpu.utils.clock import ManualClock
+
+
+def cluster_rule(resource, count, flow_id, fallback=True):
+    return FlowRule(
+        resource,
+        count=count,
+        cluster_mode=True,
+        cluster_config=ClusterFlowConfig(
+            flow_id=flow_id, fallback_to_local_when_fail=fallback
+        ),
+    )
+
+
+@pytest.fixture()
+def cluster_env():
+    cluster_flow_rule_manager.clear()
+    cluster_server_config_manager.load_global_flow_config(
+        exceed_count=1.0, max_allowed_qps=30000.0
+    )
+    yield
+    cluster_flow_rule_manager.clear()
+    ClusterStateManager.stop()
+    TokenClientProvider.clear()
+    EmbeddedClusterTokenServerProvider.clear()
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestServerRestartUnderLoad:
+    def test_outage_falls_back_then_reconverges(self, cluster_env, manual_clock, engine):
+        """Kill the token server mid-load: admissions fall back to the
+        LOCAL window during the outage; after a restart on the same
+        port the client reconnects and the server grants again."""
+        rule = cluster_rule("svc", 50, flow_id=700)
+        cluster_flow_rule_manager.load_rules("default", [rule])
+        service1 = DefaultTokenService(clock=ManualClock(0))
+        server = SentinelTokenServer(port=0, service=service1).start()
+        port = server.port
+        client = ClusterTokenClient(
+            "127.0.0.1", port, request_timeout_sec=0.5,
+            reconnect_interval_sec=0.05,
+        ).start()
+        TokenClientProvider.register(client)
+        ClusterStateManager.set_to_client()
+        st.flow_rule_manager.load_rules([rule])
+
+        # Phase 1: server up — grants are token-server grants.
+        assert sum(st.try_entry("svc") is not None for _ in range(10)) == 10
+        granted_on_server = sum(
+            f["currentQps"] for f in service1.flow_stats() if f["flowId"] == 700
+        )
+        assert granted_on_server == 10
+
+        # Phase 2: outage — the server dies mid-load. FAILed token RPCs
+        # fall back to the LOCAL window, which still enforces the rule.
+        server.stop()
+        assert _wait(lambda: not client.connected, 5.0)
+        local_grants = sum(st.try_entry("svc") is not None for _ in range(60))
+        # Local window: count=50 minus the 10 token-granted entries the
+        # StatisticSlot already accounted this window (the reference
+        # also bumps pass for cluster grants) → exactly 40.
+        assert local_grants == 40, local_grants
+
+        # Phase 3: restart on the SAME port — scheduled reconnect finds
+        # it; grants come from the fresh server again.
+        service2 = DefaultTokenService(clock=ManualClock(0))
+        server2 = SentinelTokenServer(port=port, service=service2).start()
+        try:
+            def _reconnected():
+                # A request drives _maybe_reconnect; FAIL until then.
+                st.try_entry("svc")
+                return client.connected and any(
+                    f["flowId"] == 700 for f in service2.flow_stats()
+                )
+
+            assert _wait(_reconnected, 10.0), "client never reconverged"
+            before = sum(
+                f["currentQps"] for f in service2.flow_stats() if f["flowId"] == 700
+            )
+            n = sum(st.try_entry("svc") is not None for _ in range(5))
+            after = sum(
+                f["currentQps"] for f in service2.flow_stats() if f["flowId"] == 700
+            )
+            assert after - before >= n - 1  # fresh grants are server grants
+            client.stop()
+        finally:
+            server2.stop()
+
+    def test_no_fallback_rule_passes_during_outage(self, cluster_env, manual_clock, engine):
+        """fallback_to_local_when_fail=False: during an outage entries
+        PASS (the reference's fallbackToLocalOrPass else-branch), they
+        are not blocked."""
+        rule = cluster_rule("nf", 1, flow_id=701, fallback=False)
+        cluster_flow_rule_manager.load_rules("default", [rule])
+        server = SentinelTokenServer(
+            port=0, service=DefaultTokenService(clock=ManualClock(0))
+        ).start()
+        client = ClusterTokenClient(
+            "127.0.0.1", server.port, request_timeout_sec=0.5,
+            reconnect_interval_sec=0.05,
+        ).start()
+        TokenClientProvider.register(client)
+        ClusterStateManager.set_to_client()
+        st.flow_rule_manager.load_rules([rule])
+        assert st.try_entry("nf") is not None
+        server.stop()
+        assert _wait(lambda: not client.connected, 5.0)
+        for _ in range(5):
+            e = st.try_entry("nf")
+            assert e is not None  # pass-through, not local count=1
+            e.exit()
+        client.stop()
+
+
+class TestTornFramesOnLiveConnections:
+    @pytest.fixture()
+    def server(self, cluster_env):
+        srv = SentinelTokenServer(
+            port=0, service=DefaultTokenService(clock=ManualClock(0))
+        ).start()
+        yield srv
+        srv.stop()
+
+    def test_torn_frame_after_valid_traffic(self, server):
+        """A connection that served valid requests then sends a torn
+        frame (length prefix promising more than arrives) is dropped
+        cleanly; other live connections keep working and the
+        per-namespace connection accounting is not leaked."""
+        cluster_flow_rule_manager.load_rules(
+            "default", [cluster_rule("r", 100, flow_id=710)]
+        )
+        healthy = ClusterTokenClient("127.0.0.1", server.port, namespace="ns").start()
+        assert healthy.request_token(710).ok
+
+        evil = socket.create_connection(("127.0.0.1", server.port), timeout=2)
+        # Valid request first — the connection is live and trusted.
+        evil.sendall(protocol.pack_flow_request(1, 710, 1, False))
+        assert protocol.read_frame(evil) is not None
+        # Torn frame: promise 100 bytes, deliver 3, then die.
+        evil.sendall(struct.pack("<I", 100) + b"\x01\x02\x03")
+        evil.close()
+
+        # The healthy client is unaffected.
+        for _ in range(3):
+            assert healthy.request_token(710).ok
+        # The torn connection is reaped from the accounting.
+        assert _wait(
+            lambda: server.connections.total() == 1
+        ), server.connections.snapshot()
+        healthy.stop()
+
+    def test_mid_stream_garbage_body(self, server):
+        """A well-framed but garbage body mid-stream (after valid
+        traffic) must not crash the handler thread; the connection is
+        dropped or answered, and the server keeps serving."""
+        cluster_flow_rule_manager.load_rules(
+            "default", [cluster_rule("r", 100, flow_id=711)]
+        )
+        evil = socket.create_connection(("127.0.0.1", server.port), timeout=2)
+        evil.sendall(protocol.pack_flow_request(1, 711, 1, False))
+        assert protocol.read_frame(evil) is not None
+        # Known type (FLOW) with a truncated body.
+        bad = struct.pack("<IB", 2, C.MSG_TYPE_FLOW) + b"\x00\x00"
+        evil.sendall(struct.pack("<I", len(bad)) + bad)
+        evil.settimeout(1.0)
+        try:
+            while evil.recv(4096):
+                pass
+        except (socket.timeout, ConnectionError, OSError):
+            pass
+        evil.close()
+        healthy = ClusterTokenClient("127.0.0.1", server.port).start()
+        assert healthy.request_token(711).ok
+        healthy.stop()
+
+    def test_client_survives_garbage_response(self, cluster_env):
+        """An evil 'server' answering a live client with a malformed
+        response: the pending request resolves FAIL (no hang, no reader
+        crash) and the client object survives to reconnect elsewhere."""
+        lst = socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        port = lst.getsockname()[1]
+        accepted = []
+
+        def evil_server():
+            conn, _ = lst.accept()
+            accepted.append(conn)
+            try:
+                protocol.read_frame(conn)  # the client's ping
+                protocol.read_frame(conn)  # the request
+                # Reply with a well-framed but short (non-_RESP) body.
+                conn.sendall(struct.pack("<I", 3) + b"\x01\x02\x03")
+            except Exception:
+                pass
+
+        t = threading.Thread(target=evil_server, daemon=True)
+        t.start()
+        client = ClusterTokenClient(
+            "127.0.0.1", port, request_timeout_sec=0.5,
+            reconnect_interval_sec=0.05,
+        ).start()
+        r = client.request_token(42)
+        assert r.status == C.TokenResultStatus.FAIL
+        # Reader died on the garbage; the client closed the socket and
+        # can still answer (FAIL) without hanging.
+        r2 = client.request_token(42)
+        assert r2.status == C.TokenResultStatus.FAIL
+        client.stop()
+        lst.close()
+        for c in accepted:
+            c.close()
