@@ -125,6 +125,17 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
+    def process_request(self, request, client_address):
+        # Stamp the accept-time epoch in the serve_forever thread,
+        # BEFORE the handler thread exists: a handler that only starts
+        # running after a stop()+start() cycle can then be recognized as
+        # belonging to the previous server lifetime and self-close,
+        # instead of registering into the new lifetime's socket set
+        # (its client was promised an EOF by stop()). Socket objects
+        # have __slots__, so the stamp lives in a server-side table.
+        self.token_server._stamp_accept(request)  # type: ignore[attr-defined]
+        super().process_request(request, client_address)
+
 
 class SentinelTokenServer:
     """Standalone token server; also usable embedded (the service is
@@ -145,15 +156,23 @@ class SentinelTokenServer:
         self._lock = threading.Lock()
         self._active_socks: set = set()
         self._stopping = False
+        self._epoch = 0
+        self._accept_epochs: dict = {}  # id(sock) -> accept-time epoch
+
+    def _stamp_accept(self, sock) -> None:
+        with self._lock:
+            self._accept_epochs[id(sock)] = self._epoch
 
     def _track_socket(self, sock, add: bool) -> None:
         close_now = False
         with self._lock:
             if add:
-                if self._stopping:
-                    # Raced stop(): the drain already happened, so
-                    # registering would orphan this socket and leave its
-                    # client a half-dead session — close it instead.
+                accept_epoch = self._accept_epochs.pop(id(sock), self._epoch)
+                if self._stopping or accept_epoch != self._epoch:
+                    # Raced stop() (possibly followed by a restart): the
+                    # drain already happened in this socket's accept
+                    # epoch, so registering would orphan it and leave
+                    # its client a half-dead session — close it instead.
                     close_now = True
                 else:
                     self._active_socks.add(sock)
@@ -207,6 +226,7 @@ class SentinelTokenServer:
         # its own socket instead of registering into the drained set.
         with self._lock:
             self._stopping = True
+            self._epoch += 1
             socks, self._active_socks = list(self._active_socks), set()
         for s in socks:
             try:

@@ -25,9 +25,12 @@ from sentinel_tpu.dashboard.app import (
 from sentinel_tpu.dashboard.rules import (
     DynamicRuleProvider,
     DynamicRulePublisher,
+    ApolloRuleStore,
     EtcdRuleStore,
     InMemoryRuleStore,
+    NacosRuleStore,
     RuleStore,
+    ZookeeperRuleStore,
 )
 
 __all__ = [
@@ -40,7 +43,10 @@ __all__ = [
     "SentinelApiClient",
     "DynamicRuleProvider",
     "DynamicRulePublisher",
+    "ApolloRuleStore",
     "EtcdRuleStore",
     "InMemoryRuleStore",
+    "NacosRuleStore",
     "RuleStore",
+    "ZookeeperRuleStore",
 ]

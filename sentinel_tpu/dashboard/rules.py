@@ -37,6 +37,22 @@ class DynamicRulePublisher:
 class RuleStore(DynamicRuleProvider, DynamicRulePublisher):
     """Both halves on one backend (how every reference impl ships)."""
 
+    def _read_rules(self, tag: str, app: str, kind: str, fetch) -> Optional[List[dict]]:
+        """Shared pull body: fetch raw JSON, parse, validate list shape.
+        ANY failure (transport errors of whatever exception type the
+        backing client raises — ZkError is a plain Exception — or bad
+        JSON) logs and returns None, which the dashboard treats as
+        "fall back to direct machine fetch"."""
+        try:
+            raw = fetch()
+            if raw is None:
+                return None
+            out = json.loads(raw)
+            return out if isinstance(out, list) else None
+        except Exception as e:
+            record_log.warn("[%s] read %s/%s failed: %s", tag, app, kind, e)
+            return None
+
 
 class InMemoryRuleStore(RuleStore):
     def __init__(self) -> None:
@@ -76,16 +92,180 @@ class EtcdRuleStore(RuleStore):
 
     def get_rules(self, app: str, kind: str) -> Optional[List[dict]]:
         src = self._mk(self.key_for(app, kind))
-        try:
-            raw = src.read_source()
-            if raw is None:
-                return None
-            out = json.loads(raw)
-            return out if isinstance(out, list) else None
-        except (OSError, ValueError) as e:
-            record_log.warn("[EtcdRuleStore] read %s/%s failed: %s", app, kind, e)
-            return None
+        return self._read_rules("EtcdRuleStore", app, kind, src.read_source)
 
     def publish(self, app: str, kind: str, rules: List[dict]) -> None:
         src = self._mk(self.key_for(app, kind))
         src.write(json.dumps(rules))
+
+
+class NacosRuleStore(RuleStore):
+    """Rules in Nacos config under dataId ``{app}-{kind}-rules`` /
+    group ``SENTINEL_GROUP`` — the reference dashboard's Nacos
+    provider/publisher conventions (sentinel-dashboard/.../rule/nacos/
+    NacosConfigUtil.java: RULE_*_DATA_ID_POSTFIX + GROUP_ID). Machines
+    watch the same (dataId, group) with
+    :class:`~sentinel_tpu.datasource.NacosDataSource`."""
+
+    def __init__(
+        self,
+        endpoint: str = "http://127.0.0.1:8848",
+        group: str = "SENTINEL_GROUP",
+        tenant: str = "",
+        context_path: str = "/nacos",
+        timeout_sec: float = 5.0,
+    ) -> None:
+        from sentinel_tpu.datasource.nacos_source import NacosDataSource
+
+        self.group = group
+        self._mk = lambda data_id: NacosDataSource(
+            lambda raw: raw,
+            data_id,
+            group=group,
+            endpoint=endpoint,
+            tenant=tenant,
+            context_path=context_path,
+            timeout_sec=timeout_sec,
+        )
+
+    def data_id_for(self, app: str, kind: str) -> str:
+        return f"{app}-{kind}-rules"
+
+    def get_rules(self, app: str, kind: str) -> Optional[List[dict]]:
+        src = self._mk(self.data_id_for(app, kind))
+        return self._read_rules("NacosRuleStore", app, kind, src.read_source)
+
+    def publish(self, app: str, kind: str, rules: List[dict]) -> None:
+        self._mk(self.data_id_for(app, kind)).write(json.dumps(rules))
+
+
+class ZookeeperRuleStore(RuleStore):
+    """Rules in znodes ``{root}/{app}/{kind}`` (reference:
+    sentinel-dashboard/.../rule/zookeeper/ZookeeperConfigUtil.getPath —
+    ``/sentinel_rule_config/{appName}...``). Machines watch the same
+    path with :class:`~sentinel_tpu.datasource.ZookeeperDataSource`;
+    the store reads/writes over transient sessions."""
+
+    def __init__(
+        self,
+        server_addr: str = "127.0.0.1:2181",
+        root: str = "/sentinel_rule_config",
+        timeout_sec: float = 5.0,
+    ) -> None:
+        from sentinel_tpu.datasource.zookeeper_source import ZookeeperDataSource
+
+        self._mk = lambda path: ZookeeperDataSource(
+            lambda raw: raw,
+            path=path,
+            server_addr=server_addr,
+            request_timeout_sec=timeout_sec,
+        )
+        self.root = "/" + root.strip("/")
+
+    def path_for(self, app: str, kind: str) -> str:
+        return f"{self.root}/{app}/{kind}"
+
+    def get_rules(self, app: str, kind: str) -> Optional[List[dict]]:
+        src = self._mk(self.path_for(app, kind))
+        try:
+            return self._read_rules("ZookeeperRuleStore", app, kind, src.read_source)
+        finally:
+            src.close()
+
+    def publish(self, app: str, kind: str, rules: List[dict]) -> None:
+        src = self._mk(self.path_for(app, kind))
+        try:
+            src.write(json.dumps(rules))
+        finally:
+            src.close()
+
+
+class ApolloRuleStore(RuleStore):
+    """Rules as one Apollo property ``{app}-{kind}-rules`` in a
+    namespace. Reads go through the config service (what machines
+    watch via :class:`~sentinel_tpu.datasource.ApolloDataSource`);
+    publishes go through the Portal OpenAPI — upsert the item, then
+    release the namespace (reference: sentinel-dashboard/.../rule/
+    apollo/FlowRuleApolloPublisher.java using ApolloOpenApiClient's
+    createOrUpdateItem + publishNamespace)."""
+
+    def __init__(
+        self,
+        config_endpoint: str = "http://127.0.0.1:8080",
+        portal_endpoint: str = "http://127.0.0.1:8070",
+        token: str = "",
+        app_id: str = "sentinel",
+        env: str = "DEV",
+        cluster: str = "default",
+        namespace: str = "application",
+        operator: str = "sentinel-dashboard",
+        timeout_sec: float = 5.0,
+    ) -> None:
+        self.config_endpoint = config_endpoint.rstrip("/")
+        self.portal_endpoint = portal_endpoint.rstrip("/")
+        self.token = token
+        self.app_id = app_id
+        self.env = env
+        self.cluster = cluster
+        self.namespace = namespace
+        self.operator = operator
+        self.timeout = timeout_sec
+
+    def key_for(self, app: str, kind: str) -> str:
+        return f"{app}-{kind}-rules"
+
+    def get_rules(self, app: str, kind: str) -> Optional[List[dict]]:
+        from sentinel_tpu.datasource.apollo_source import ApolloDataSource
+
+        src = ApolloDataSource(
+            lambda raw: raw,
+            self.namespace,
+            self.key_for(app, kind),
+            endpoint=self.config_endpoint,
+            app_id=self.app_id,
+            cluster=self.cluster,
+            timeout_sec=self.timeout,
+        )
+        return self._read_rules("ApolloRuleStore", app, kind, src.read_source)
+
+    def _portal(self, method: str, path: str, payload: dict) -> None:
+        import urllib.parse
+        import urllib.request
+
+        q = lambda seg: urllib.parse.quote(str(seg), safe="")
+        req = urllib.request.Request(
+            f"{self.portal_endpoint}/openapi/v1/envs/{q(self.env)}"
+            f"/apps/{q(self.app_id)}/clusters/{q(self.cluster)}"
+            f"/namespaces/{q(self.namespace)}{path}",
+            data=json.dumps(payload).encode("utf-8"),
+            headers={
+                "Content-Type": "application/json;charset=UTF-8",
+                "Authorization": self.token,
+            },
+            method=method,
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout):
+            pass
+
+    def publish(self, app: str, kind: str, rules: List[dict]) -> None:
+        import urllib.parse
+
+        key = self.key_for(app, kind)
+        self._portal(
+            "PUT",
+            f"/items/{urllib.parse.quote(key, safe='')}?createIfNotExists=true",
+            {
+                "key": key,
+                "value": json.dumps(rules),
+                "dataChangeLastModifiedBy": self.operator,
+                "dataChangeCreatedBy": self.operator,
+            },
+        )
+        self._portal(
+            "POST",
+            "/releases",
+            {
+                "releaseTitle": f"sentinel-{app}-{kind}",
+                "releasedBy": self.operator,
+            },
+        )
