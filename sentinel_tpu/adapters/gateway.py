@@ -239,3 +239,55 @@ def gateway_entry(route_id: str, info: GatewayRequestInfo):
     finally:
         for en in reversed(entries):
             en.exit()
+
+
+def gateway_submit_bulk(
+    route_id: str,
+    infos: Sequence[GatewayRequestInfo],
+    *,
+    engine=None,
+    ts=None,
+):
+    """Columnar gateway admission — the adapter fast path onto
+    :meth:`Engine.submit_bulk`.
+
+    Parses each request's gateway params (GatewayParamParser, host
+    side) into one args column and submits the whole batch as a single
+    bulk group: one slot resolution for the route, per-value interning
+    once per distinct value, array verdicts after ``flush()``. Three
+    orders of magnitude less per-request Python than ``gateway_entry``
+    (no Entry objects, no context, no per-request engine lock).
+
+    Scope (the high-throughput subset): route-level rules only — custom
+    ApiDefinition resources, THREAD-grade and cluster-mode rules stay
+    on the per-request ``gateway_entry`` path. Returns the
+    :class:`~sentinel_tpu.runtime.engine.BulkOp` (or None for
+    pass-through); ``op.admitted`` is the per-request verdict array
+    after ``flush()``. Callers account completions with
+    ``submit_exit_bulk`` like any bulk group.
+    """
+    eng = engine if engine is not None else api.get_engine()
+    # Single-rule direct-attribute strategies (client IP / host, no
+    # pattern) skip the per-request parser walk — the common gateway
+    # config, and the host-side hot loop at bulk sizes.
+    rules = gateway_rule_manager.rules_for(route_id)
+    fast_attr = None
+    if len(rules) == 1 and rules[0].param_item is not None and not rules[0].param_item.pattern:
+        ps = rules[0].param_item.parse_strategy
+        if ps == PARAM_PARSE_STRATEGY_CLIENT_IP:
+            fast_attr = "client_ip"
+        elif ps == PARAM_PARSE_STRATEGY_HOST:
+            fast_attr = "host"
+    if fast_attr is not None:
+        args_column = [(getattr(info, fast_attr) or None,) for info in infos]
+    else:
+        args_column = [
+            gateway_rule_manager.parse_params(route_id, info) for info in infos
+        ]
+    return eng.submit_bulk(
+        route_id,
+        len(infos),
+        ts=ts,
+        entry_type=C.EntryType.IN,
+        args_column=args_column,
+    )

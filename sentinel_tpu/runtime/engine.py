@@ -196,6 +196,21 @@ class _EntryOp:
 
 
 @dataclass
+class _BulkParamCols:
+    """One param rule's resolved columns over a bulk group: per-entry
+    prow / threshold / throttle-cost arrays (hot items make the
+    threshold per-value), with a validity mask for entries whose args
+    had no value for this rule. Rule-constant fields ride on ``rule``.
+    """
+
+    rule: ParamFlowRule
+    valid: np.ndarray  # bool [n]
+    prow: np.ndarray  # int32 [n]
+    token_count: np.ndarray  # int32 [n]
+    cost_ms: np.ndarray  # int32 [n]
+
+
+@dataclass
 class BulkOp:
     """A columnar group of ``n`` identical-shape entries on one
     resource — the TPU-idiomatic bulk path (one slot resolution, one
@@ -222,6 +237,13 @@ class BulkOp:
     context_name: str
     origin: str
     src: Optional[Tuple[object, object, object]] = None
+    # Hot-param support (QPS grade only): per-entry args (one tuple per
+    # entry, e.g. a column of client IPs) resolved to per-rule COLUMNS
+    # (one _BulkParamCols per param rule) — the columnar analog of
+    # _EntryOp.p_slots. Distinct values intern once via np.unique;
+    # per-request cost is a vectorized gather, not a Python walk.
+    args_column: Optional[Sequence] = None
+    p_cols: List["_BulkParamCols"] = field(default_factory=list)
     custom_veto: Optional[Tuple[object, object]] = None
     # Which entries a custom slot vetoed (per-acquire-value checks);
     # None = no veto anywhere in the group.
@@ -958,6 +980,42 @@ class Engine:
             raise ValueError(f"bulk column shape {a.shape} != ({n},)")
         return a
 
+    @staticmethod
+    def _bulk_param_cols(
+        pindex: ParamIndex, resource: str, args_column: Sequence
+    ) -> List[_BulkParamCols]:
+        """Resolve a per-entry args column to per-rule columns
+        (ParamIndex.bulk_cols: distinct values intern once, per-request
+        assignment is a numpy gather). QPS grade only: THREAD-grade
+        needs per-entry exit bookkeeping, cluster-mode needs a token RPC
+        per entry, and collection values need per-entry expansion — all
+        three raise toward :meth:`submit_many`."""
+        norm = [
+            a if isinstance(a, (list, tuple)) else (a,) for a in args_column
+        ]
+        for _, r in pindex.by_resource.get(resource, ()):
+            if r.grade == C.FLOW_GRADE_THREAD:
+                raise ValueError(
+                    "submit_bulk: THREAD-grade param rules need per-entry"
+                    " exits — use submit_many"
+                )
+            if r.cluster_mode:
+                raise ValueError(
+                    "submit_bulk: resource has cluster-mode param rules"
+                    " (the token-service RPC is per entry) — use submit_many"
+                )
+        cols = pindex.bulk_cols(resource, norm)
+        if cols is None:
+            raise ValueError(
+                "submit_bulk: collection param values expand per entry —"
+                " use submit_many"
+            )
+        return [
+            _BulkParamCols(rule=r, valid=valid, prow=prow, token_count=tc,
+                           cost_ms=cost)
+            for r, valid, prow, tc, cost in cols
+        ]
+
     def submit_bulk(
         self,
         resource: str,
@@ -967,6 +1025,7 @@ class Engine:
         context_name: str = C.CONTEXT_DEFAULT_NAME,
         origin: str = "",
         entry_type: C.EntryType = C.EntryType.OUT,
+        args_column: Optional[Sequence] = None,
     ) -> Optional[BulkOp]:
         """Enqueue ``n`` entries on one resource as a single columnar
         group — the high-throughput path: slot resolution happens once
@@ -974,10 +1033,15 @@ class Engine:
         back as arrays on the returned :class:`BulkOp` after
         ``flush()``. ``ts``/``acquire`` may be scalars or [n] arrays.
 
+        ``args_column`` (length ``n``, one args tuple per entry — e.g. a
+        column of client IPs) enables QPS-grade hot-param rules on this
+        path: distinct values intern once and each entry gets its own
+        per-value verdict, the columnar ParamFlowChecker analog.
+
         Not supported on this path (use :meth:`submit_entry` /
-        :meth:`submit_many`): prioritized (occupy) entries, per-entry
-        args for hot-param rules, and cluster-mode rules (those need a
-        token-service RPC per entry — raises ``ValueError``).
+        :meth:`submit_many`): prioritized (occupy) entries, THREAD-grade
+        param rules, and cluster-mode rules (those need a token-service
+        RPC per entry — raises ``ValueError``).
         Returns None for pass-through (over the resource cap or the
         global switch off), like :meth:`submit_entry`.
         """
@@ -1009,6 +1073,17 @@ class Engine:
                 from sentinel_tpu.rules.authority_manager import AuthorityRuleManager
 
                 auth_ok = AuthorityRuleManager.passes(arule, origin)
+            p_cols: List[_BulkParamCols] = []
+            if args_column is not None:
+                if len(args_column) != n:
+                    raise ValueError(
+                        f"submit_bulk: args_column length {len(args_column)}"
+                        f" != n={n}"
+                    )
+                if self.param_index.has_rules():
+                    p_cols = self._bulk_param_cols(
+                        self.param_index, resource, args_column
+                    )
             now = self.clock.now_ms()
             op = BulkOp(
                 resource=resource,
@@ -1022,6 +1097,8 @@ class Engine:
                 context_name=context_name,
                 origin=origin,
                 src=(findex, dindex, self.param_index),
+                args_column=args_column,
+                p_cols=p_cols,
             )
             self._bulk_entries.append(op)
             self._bulk_pending_n += n
@@ -1192,19 +1269,38 @@ class Engine:
             self.param_dyn = grow_param_state(self.param_dyn, _pad_pow2(pneed))
 
     def _encode_param(
-        self, entries: List[_EntryOp], exits: List[_ExitOp], pindex: ParamIndex
+        self,
+        entries: List[_EntryOp],
+        exits: List[_ExitOp],
+        pindex: ParamIndex,
+        bulk: Sequence[BulkOp] = (),
     ) -> Tuple[Optional[ParamBatch], int]:
         """Encode hot-param slots plus the host-known rounds bound (max
-        items per value row, pow2-bucketed; 0 → scan fallback)."""
+        items per value row, pow2-bucketed; 0 → scan fallback). Bulk
+        groups' p_cols ride the same item stream as numpy slice
+        assignments (no per-request Python), indexed into the flat row
+        space after the singles (the same offsets the main encode gives
+        them)."""
         items = []
         for i, op in enumerate(entries):
             for ps in op.p_slots:
                 items.append((i, op.ts, op.acquire, ps))
+        bulk_cols: List[Tuple[int, BulkOp, _BulkParamCols, int]] = []
+        n_bulk_items = 0
+        off_b = len(entries)
+        for g in bulk:
+            for pc in g.p_cols:
+                cnt = int(pc.valid.sum())
+                if cnt:
+                    bulk_cols.append((off_b, g, pc, cnt))
+                    n_bulk_items += cnt
+            off_b += g.n
         exit_rows = [r for op in exits for r in op.p_rows]
         resets = pindex.take_resets()
-        if not items and not exit_rows and not resets:
+        if not items and not n_bulk_items and not exit_rows and not resets:
             return None, 1
-        s = _pad_pow2(max(1, len(items)), 8)
+        n_items = len(items) + n_bulk_items
+        s = _pad_pow2(max(1, n_items), 8)
         sx = _pad_pow2(max(1, len(exit_rows)), 8)
         q = _pad_pow2(max(1, len(resets)), 8)
         valid = np.zeros(s, dtype=bool)
@@ -1232,6 +1328,27 @@ class Engine:
             duration_ms[a] = ps.duration_ms
             maxq[a] = ps.maxq
             cost_ms[a] = ps.cost_ms
+        a = len(items)
+        for off, g, pc, cnt in bulk_cols:
+            sl = slice(a, a + cnt)
+            m = pc.valid
+            r = pc.rule
+            valid[sl] = True
+            prow[sl] = pc.prow[m]
+            eidx[sl] = off + np.nonzero(m)[0].astype(np.int32)
+            ts[sl] = g.ts[m]
+            acquire[sl] = g.acquire[m]
+            grade[sl] = r.grade
+            behavior[sl] = r.control_behavior
+            token_count[sl] = pc.token_count[m]
+            burst[sl] = int(r.burst_count)
+            # Exactly the singles path's ParamSlotInfo.duration_ms (the
+            # kernel clamps to >=1 itself) — a host-side clamp here
+            # would break submit_many parity for duration 0.
+            duration_ms[sl] = int(r.duration_in_sec) * 1000
+            maxq[sl] = int(r.max_queueing_time_ms)
+            cost_ms[sl] = pc.cost_ms[m]
+            a += cnt
         xr = np.full(sx, -1, dtype=np.int32)
         xr[: len(exit_rows)] = exit_rows
         rs = np.full(q, -1, dtype=np.int32)
@@ -1251,7 +1368,7 @@ class Engine:
             cost_ms=jnp.asarray(cost_ms),
             reset_rows=jnp.asarray(rs),
             exit_rows=jnp.asarray(xr),
-        ), _rounds_bucket(prow[: len(items)])
+        ), _rounds_bucket(prow[:n_items])
 
     def start_auto_flush(self, interval_ms: Optional[float] = None) -> None:
         """Background flusher for deferred mode: pending ops are
@@ -1559,6 +1676,20 @@ class Engine:
                         g.resource, g.context_name, g.origin, self.nodes
                     )
                     g.d_gids = dindex.gids_for(g.resource)
+                    if g.args_column is not None and pindex.has_rules():
+                        # Param prows are index-scoped: re-intern the
+                        # column against the new snapshot. A rule that
+                        # became THREAD/cluster after submit degrades to
+                        # dropping the group's param slots rather than
+                        # raising mid-flush.
+                        try:
+                            g.p_cols = self._bulk_param_cols(
+                                pindex, g.resource, g.args_column
+                            )
+                        except ValueError:
+                            g.p_cols = []
+                    else:
+                        g.p_cols = []
                     g.src = cur
             for gx in bulk_x:
                 if gx.resource is not None and gx.src_dindex is not None and gx.src_dindex is not dindex:
@@ -1825,7 +1956,7 @@ class Engine:
 
         sysdev = self._system_device()
         shaping, sh_rounds = self._encode_shaping(entries, bulk, k, findex)
-        param, p_rounds = self._encode_param(entries, exits, pindex)
+        param, p_rounds = self._encode_param(entries, exits, pindex, bulk)
         occ_ms = config.occupy_timeout_ms
         common = (
             self.stats,

@@ -287,3 +287,167 @@ class TestBulkEntries:
         # Integer dtypes of any width still pass when in range.
         g = engine.submit_bulk("x", 4, ts=np.arange(4, dtype=np.int64), acquire=2)
         assert g is not None
+
+
+class TestBulkParamColumn:
+    """QPS hot-param rules on the columnar path (args_column):
+    per-value budgets must decide exactly like submit_many with the
+    same args stream."""
+
+    def test_param_column_parity_with_submit_many(self, manual_clock, engine):
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import ParamFlowRule
+        from sentinel_tpu.runtime.engine import Engine
+
+        flow = [st.FlowRule("gw", count=1000)]
+        param = {"gw": [ParamFlowRule("gw", param_idx=0, count=3)]}
+        engine.set_flow_rules(flow)
+        engine.set_param_rules(param)
+        ref = Engine(clock=manual_clock)
+        ref.set_flow_rules(flow)
+        ref.set_param_rules(param)
+        manual_clock.set_ms(1000)
+        values = [f"ip-{i % 5}" for i in range(40)]
+        g = engine.submit_bulk(
+            "gw", 40, ts=np.full(40, 1000, dtype=np.int32),
+            args_column=[(v,) for v in values],
+        )
+        engine.flush()
+        ops = ref.submit_many(
+            [{"resource": "gw", "ts": 1000, "args": (v,)} for v in values]
+        )
+        ref.flush()
+        want = [o.verdict.admitted for o in ops]
+        assert g.admitted.tolist() == want
+        assert g.admitted_count == 15  # 5 values × count 3
+
+    def test_param_column_hot_items_and_missing_values(self, manual_clock, engine):
+        """Hot-item per-value thresholds apply on the columnar path;
+        entries whose args carry no value for the rule pass the param
+        check (ParamFlowChecker skips them)."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import ParamFlowItem, ParamFlowRule
+
+        engine.set_flow_rules([st.FlowRule("h", count=1000)])
+        engine.set_param_rules(
+            {"h": [ParamFlowRule(
+                "h", param_idx=0, count=1,
+                param_flow_item_list=(ParamFlowItem(object="vip", count=4),),
+            )]}
+        )
+        manual_clock.set_ms(1000)
+        col = [("vip",)] * 6 + [("plain",)] * 3 + [(None,)] * 2
+        g = engine.submit_bulk(
+            "h", 11, ts=np.full(11, 1000, dtype=np.int32), args_column=col
+        )
+        engine.flush()
+        adm = np.asarray(g.admitted)
+        assert adm[:6].sum() == 4       # hot item threshold
+        assert adm[6:9].sum() == 1      # default count
+        assert adm[9:].all()            # no value -> param check passes
+
+    def test_param_column_rejections(self, manual_clock, engine):
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import (
+            ClusterFlowConfig,
+            ParamFlowRule,
+        )
+        from sentinel_tpu.models import constants as C
+
+        engine.set_flow_rules([st.FlowRule("rj", count=1000)])
+        engine.set_param_rules(
+            {"rj": [ParamFlowRule("rj", param_idx=0, count=1,
+                                  grade=C.FLOW_GRADE_THREAD)]}
+        )
+        with pytest.raises(ValueError, match="THREAD"):
+            engine.submit_bulk("rj", 2, args_column=[("a",), ("b",)])
+        engine.set_param_rules(
+            {"rj": [ParamFlowRule(
+                "rj", param_idx=0, count=1, cluster_mode=True,
+                cluster_config=ClusterFlowConfig(flow_id=1),
+            )]}
+        )
+        with pytest.raises(ValueError, match="cluster"):
+            engine.submit_bulk("rj", 2, args_column=[("a",), ("b",)])
+        engine.set_param_rules(
+            {"rj": [ParamFlowRule("rj", param_idx=0, count=1)]}
+        )
+        with pytest.raises(ValueError, match="collection"):
+            engine.submit_bulk("rj", 2, args_column=[(["a", "b"],), ("c",)])
+        with pytest.raises(ValueError, match="length"):
+            engine.submit_bulk("rj", 3, args_column=[("a",)])
+
+    def test_param_column_reload_semantics(self, manual_clock, engine):
+        """A param-rule reload drain-flushes the pending group against
+        the rules it was submitted under (same contract as the flow
+        path); groups submitted after see the new index."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import ParamFlowRule
+
+        engine.set_flow_rules([st.FlowRule("rr", count=1000)])
+        engine.set_param_rules({"rr": [ParamFlowRule("rr", param_idx=0, count=5)]})
+        manual_clock.set_ms(1000)
+        g = engine.submit_bulk(
+            "rr", 8, ts=np.full(8, 1000, dtype=np.int32),
+            args_column=[("k",)] * 8,
+        )
+        engine.set_param_rules({"rr": [ParamFlowRule("rr", param_idx=0, count=2)]})
+        assert np.asarray(g.admitted).sum() == 5  # decided pre-reload
+        manual_clock.set_ms(3000)
+        g2 = engine.submit_bulk(
+            "rr", 8, ts=np.full(8, 3000, dtype=np.int32),
+            args_column=[("k",)] * 8,
+        )
+        engine.flush()
+        assert np.asarray(g2.admitted).sum() == 2  # new index's count
+
+    def test_gateway_submit_bulk(self, manual_clock, engine):
+        """The adapter fast path: gateway traffic through one bulk
+        group, per-client-IP budgets."""
+        from sentinel_tpu.adapters.gateway import (
+            GatewayFlowRule,
+            GatewayParamFlowItem,
+            GatewayRequestInfo,
+            PARAM_PARSE_STRATEGY_CLIENT_IP,
+            PARAM_PARSE_STRATEGY_HEADER,
+            gateway_rule_manager,
+            gateway_submit_bulk,
+        )
+        import sentinel_tpu as st
+
+        engine.set_flow_rules([st.FlowRule("route", count=1000)])
+        gateway_rule_manager.load_rules([
+            GatewayFlowRule(
+                "route", count=2,
+                param_item=GatewayParamFlowItem(
+                    parse_strategy=PARAM_PARSE_STRATEGY_CLIENT_IP),
+            ),
+        ])
+        manual_clock.set_ms(1000)
+        infos = [
+            GatewayRequestInfo(path="/x", client_ip=f"1.1.1.{i % 2}")
+            for i in range(10)
+        ]
+        g = gateway_submit_bulk("route", infos, engine=engine,
+                                ts=np.full(10, 1000, dtype=np.int32))
+        engine.flush()
+        assert np.asarray(g.admitted).sum() == 4  # 2 IPs × count 2
+
+        # Generic (non-fast) parser path: header strategy.
+        gateway_rule_manager.load_rules([
+            GatewayFlowRule(
+                "route", count=1,
+                param_item=GatewayParamFlowItem(
+                    parse_strategy=PARAM_PARSE_STRATEGY_HEADER,
+                    field_name="X-K"),
+            ),
+        ])
+        manual_clock.set_ms(3000)
+        infos = [
+            GatewayRequestInfo(path="/x", headers={"X-K": f"u{i % 3}"})
+            for i in range(9)
+        ]
+        g2 = gateway_submit_bulk("route", infos, engine=engine,
+                                 ts=np.full(9, 3000, dtype=np.int32))
+        engine.flush()
+        assert np.asarray(g2.admitted).sum() == 3  # 3 header values × 1
