@@ -14,8 +14,15 @@ customizer / fallback — and so do these:
   spring-webmvc (total + per-URL resources, origin parser, block page).
 * :class:`SentinelASGIMiddleware` — spring-webflux / reactor.
 * gRPC server/client interceptors — sentinel-grpc-adapter.
-* :func:`guard_call` / :class:`GuardedClient` — the outbound-client
-  adapters (okhttp / apache-httpclient).
+* :func:`guard_call` / :class:`GuardedClient` (+ async twins) — the
+  outbound-client adapters (okhttp / apache-httpclient), fitting
+  requests.Session / httpx.Client / httpx.AsyncClient.
+* :class:`SentinelHTTPAdapter` — transparent ``requests`` transport
+  adapter (mount once, every call guarded).
+* :mod:`sentinel_tpu.adapters.aiohttp_adapter` — aiohttp server
+  middleware + guarded ClientSession.
+* :class:`SentinelFlask` / :func:`sentinel_guard` — Flask extension and
+  FastAPI dependency sugar (gated on those packages).
 * :mod:`sentinel_tpu.adapters.gateway` — api-gateway-adapter-common:
   GatewayFlowRule with param matching, ApiDefinition groups, conversion
   to hot-param rules.
@@ -24,12 +31,25 @@ customizer / fallback — and so do these:
 from sentinel_tpu.adapters.decorator import sentinel_resource
 from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
 from sentinel_tpu.adapters.asgi import SentinelASGIMiddleware
-from sentinel_tpu.adapters.client import GuardedClient, guard_call
+from sentinel_tpu.adapters.client import (
+    GuardedAsyncClient,
+    GuardedClient,
+    guard_call,
+    guard_call_async,
+)
+from sentinel_tpu.adapters.requests_adapter import SentinelHTTPAdapter
+from sentinel_tpu.adapters.flask_adapter import SentinelFlask
+from sentinel_tpu.adapters.fastapi_adapter import sentinel_guard
 
 __all__ = [
     "sentinel_resource",
     "SentinelWSGIMiddleware",
     "SentinelASGIMiddleware",
     "GuardedClient",
+    "GuardedAsyncClient",
     "guard_call",
+    "guard_call_async",
+    "SentinelHTTPAdapter",
+    "SentinelFlask",
+    "sentinel_guard",
 ]

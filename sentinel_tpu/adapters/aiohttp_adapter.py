@@ -1,0 +1,182 @@
+"""aiohttp integration: server middleware + guarded client session.
+
+Reference analogs: the servlet/spring-webmvc adapters' filter
+(AbstractSentinelInterceptor.java:60-110 — IN entry per request, block
+page on limit) for the server side, and the okhttp interceptor for the
+client side. Both are gated on aiohttp being importable.
+
+Server::
+
+    from aiohttp import web
+    from sentinel_tpu.adapters.aiohttp_adapter import sentinel_middleware
+
+    app = web.Application(middlewares=[sentinel_middleware()])
+
+Client::
+
+    from sentinel_tpu.adapters.aiohttp_adapter import SentinelClientSession
+
+    async with SentinelClientSession() as s:
+        await s.get("http://api.internal/users")
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from sentinel_tpu.core import api
+from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.models import constants as C
+
+BLOCK_BODY = "Blocked by Sentinel (flow limiting)"
+
+
+def sentinel_middleware(
+    resource_extractor: Optional[Callable] = None,
+    origin_parser: Optional[Callable] = None,
+    block_status: int = 429,
+    block_body: str = BLOCK_BODY,
+    total_resource: Optional[str] = None,
+):
+    """aiohttp.web middleware: one IN entry per request (resource =
+    ``METHOD:path`` by default, plus an optional app-total resource
+    like the servlet filter's WebServletConfig total target), 429 +
+    body on block, exceptions traced to the breaker."""
+    from aiohttp import web
+
+    extract = resource_extractor or (lambda req: f"{req.method}:{req.path}")
+    parse_origin = origin_parser or (lambda req: "")
+
+    @web.middleware
+    async def _middleware(request, handler):
+        resources = []
+        if total_resource:
+            resources.append(total_resource)
+        resources.append(extract(request))
+        origin = parse_origin(request)
+        entries = []
+        try:
+            for res in resources:
+                entries.append(
+                    api.entry_async(res, entry_type=C.EntryType.IN, origin=origin)
+                )
+        except BlockError:
+            for en in reversed(entries):
+                en.exit()
+            return web.Response(status=block_status, text=block_body)
+        try:
+            return await handler(request)
+        except web.HTTPException:
+            raise  # normal aiohttp control flow, not a fault
+        except BaseException as e:
+            for en in entries:
+                en.set_error(e)
+            raise
+        finally:
+            for en in reversed(entries):
+                en.exit()
+
+    return _middleware
+
+
+def _default_client_resource(method: str, url) -> str:
+    u = str(url).split("?", 1)[0]
+    return f"{method}:{u}"
+
+
+class _GuardedRequestCtx:
+    """Awaitable + async-context-manager over a guarded request, so
+    both aiohttp idioms work::
+
+        resp = await s.get(url)
+        async with s.get(url) as resp: ...   # releases on exit
+    """
+
+    __slots__ = ("_coro", "_resp")
+
+    def __init__(self, coro) -> None:
+        self._coro = coro
+        self._resp = None
+
+    def __await__(self):
+        return self._coro.__await__()
+
+    async def __aenter__(self):
+        self._resp = await self._coro
+        return self._resp
+
+    async def __aexit__(self, *exc) -> None:
+        resp = self._resp
+        if resp is not None and hasattr(resp, "release"):
+            resp.release()
+
+
+class SentinelClientSession:
+    """An ``aiohttp.ClientSession`` wrapper guarding every request with
+    an OUT entry (the okhttp-interceptor shape). Constructed lazily so
+    importing this module never requires aiohttp; unknown attributes
+    (``patch``-less verbs aside, e.g. ``ws_connect``, ``closed``,
+    ``headers``) delegate to the underlying session UNGUARDED."""
+
+    def __init__(
+        self,
+        *args,
+        resource_extractor: Callable = _default_client_resource,
+        fallback: Optional[Callable] = None,
+        **kwargs,
+    ) -> None:
+        import aiohttp
+
+        self._session = aiohttp.ClientSession(*args, **kwargs)
+        self._extract = resource_extractor
+        self._fallback = fallback
+
+    async def __aenter__(self) -> "SentinelClientSession":
+        await self._session.__aenter__()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self._session.__aexit__(*exc)
+
+    async def close(self) -> None:
+        await self._session.close()
+
+    async def _request(self, method: str, url, **kwargs):
+        from sentinel_tpu.adapters.client import guard_call_async
+
+        resource = self._extract(method, url)
+        return await guard_call_async(
+            resource,
+            self._session.request,
+            method,
+            url,
+            fallback=self._fallback,
+            **kwargs,
+        )
+
+    def request(self, method, url, **kwargs) -> _GuardedRequestCtx:
+        return _GuardedRequestCtx(self._request(method, url, **kwargs))
+
+    def get(self, url, **kwargs) -> _GuardedRequestCtx:
+        return self.request("GET", url, **kwargs)
+
+    def post(self, url, **kwargs) -> _GuardedRequestCtx:
+        return self.request("POST", url, **kwargs)
+
+    def put(self, url, **kwargs) -> _GuardedRequestCtx:
+        return self.request("PUT", url, **kwargs)
+
+    def delete(self, url, **kwargs) -> _GuardedRequestCtx:
+        return self.request("DELETE", url, **kwargs)
+
+    def patch(self, url, **kwargs) -> _GuardedRequestCtx:
+        return self.request("PATCH", url, **kwargs)
+
+    def head(self, url, **kwargs) -> _GuardedRequestCtx:
+        return self.request("HEAD", url, **kwargs)
+
+    def options(self, url, **kwargs) -> _GuardedRequestCtx:
+        return self.request("OPTIONS", url, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._session, name)

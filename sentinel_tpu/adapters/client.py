@@ -63,5 +63,100 @@ class GuardedClient:
     def post(self, url: str, **kwargs):
         return self.request("POST", url, **kwargs)
 
+    def put(self, url: str, **kwargs):
+        return self.request("PUT", url, **kwargs)
+
+    def delete(self, url: str, **kwargs):
+        return self.request("DELETE", url, **kwargs)
+
+    def patch(self, url: str, **kwargs):
+        return self.request("PATCH", url, **kwargs)
+
+    def head(self, url: str, **kwargs):
+        return self.request("HEAD", url, **kwargs)
+
+    def options(self, url: str, **kwargs):
+        return self.request("OPTIONS", url, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._client, name)
+
+
+async def guard_call_async(
+    resource: str, fn: Callable, *args, fallback=None, **kwargs
+):
+    """Async ``guard_call``: await ``fn`` under an OUT entry; trace
+    errors; on block call ``fallback(error)`` (sync or async) or
+    raise."""
+    import inspect
+
+    try:
+        entry = api.entry_async(resource, entry_type=C.EntryType.OUT)
+    except BlockError as e:
+        if fallback is not None:
+            result = fallback(e)
+            if inspect.isawaitable(result):
+                result = await result
+            return result
+        raise
+    try:
+        result = await fn(*args, **kwargs)
+    except BaseException as e:
+        entry.set_error(e)
+        raise
+    finally:
+        entry.exit()
+    return result
+
+
+def _default_extractor(method: str, url: str) -> str:
+    # Query string dropped so resources stay bounded (one node per
+    # endpoint, not per query).
+    return f"{method.upper()}:{str(url).split('?', 1)[0]}"
+
+
+class GuardedAsyncClient:
+    """Async twin of :class:`GuardedClient` for clients whose request
+    method is an ``async request(method, url, ...)``
+    (httpx.AsyncClient, aiohttp.ClientSession...)."""
+
+    def __init__(
+        self,
+        client,
+        resource_extractor: Optional[Callable[[str, str], str]] = None,
+        fallback: Optional[Callable] = None,
+    ) -> None:
+        self._client = client
+        self._extract = resource_extractor or _default_extractor
+        self._fallback = fallback
+
+    async def request(self, method: str, url: str, *args, **kwargs):
+        resource = self._extract(method, str(url))
+        return await guard_call_async(
+            resource, self._client.request, method, url, *args,
+            fallback=self._fallback, **kwargs,
+        )
+
+    async def get(self, url: str, **kwargs):
+        return await self.request("GET", url, **kwargs)
+
+    async def post(self, url: str, **kwargs):
+        return await self.request("POST", url, **kwargs)
+
+    async def put(self, url: str, **kwargs):
+        return await self.request("PUT", url, **kwargs)
+
+    async def delete(self, url: str, **kwargs):
+        return await self.request("DELETE", url, **kwargs)
+
+    async def patch(self, url: str, **kwargs):
+        return await self.request("PATCH", url, **kwargs)
+
+    async def head(self, url: str, **kwargs):
+        return await self.request("HEAD", url, **kwargs)
+
+    async def options(self, url: str, **kwargs):
+        return await self.request("OPTIONS", url, **kwargs)
+
     def __getattr__(self, name):
         return getattr(self._client, name)

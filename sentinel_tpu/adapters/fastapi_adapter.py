@@ -1,0 +1,68 @@
+"""FastAPI sugar over the ASGI integration.
+
+FastAPI apps are ASGI apps, so the generic
+:class:`~sentinel_tpu.adapters.SentinelASGIMiddleware` is the app-wide
+mount (``app.add_middleware(SentinelASGIMiddleware)`` works as-is).
+This module adds the idiomatic per-route dependency::
+
+    from fastapi import Depends, FastAPI
+    from sentinel_tpu.adapters.fastapi_adapter import sentinel_guard
+
+    app = FastAPI()
+
+    @app.get("/users", dependencies=[Depends(sentinel_guard())])
+    async def users(): ...
+
+Blocked requests raise fastapi's HTTPException(429). All fastapi
+imports happen inside the dependency — importing this module never
+requires fastapi.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from sentinel_tpu.core import api
+from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.models import constants as C
+
+BLOCK_DETAIL = "Blocked by Sentinel (flow limiting)"
+
+
+def sentinel_guard(
+    resource: Optional[str] = None,
+    origin_parser: Optional[Callable] = None,
+    block_status: int = 429,
+):
+    """A FastAPI dependency entering an IN-typed resource for the route
+    (default resource = ``METHOD:route-path-template``); the yield
+    teardown exits the entry and traces handler exceptions."""
+
+    async def _dep(request):
+        from fastapi import HTTPException
+
+        route = request.scope.get("route")
+        path = getattr(route, "path", None) or request.url.path
+        res = resource or f"{request.method}:{path}"
+        origin = origin_parser(request) if origin_parser else ""
+        try:
+            entry = api.entry_async(res, entry_type=C.EntryType.IN, origin=origin)
+        except BlockError:
+            raise HTTPException(status_code=block_status, detail=BLOCK_DETAIL)
+        try:
+            yield entry
+        except BaseException as e:
+            entry.set_error(e)
+            raise
+        finally:
+            entry.exit()
+
+    # FastAPI resolves the Request parameter by annotation; attach it
+    # lazily so importing this module works without fastapi installed.
+    try:
+        from fastapi import Request
+
+        _dep.__annotations__["request"] = Request
+    except ImportError:  # pragma: no cover - no fastapi in this env
+        pass
+    return _dep

@@ -1,0 +1,99 @@
+"""Flask extension sugar over the WSGI integration.
+
+Reference analog: sentinel-spring-webmvc-adapter's SentinelWebInterceptor
+(AbstractSentinelInterceptor.java:60-110) registered through framework
+hooks rather than a raw filter. The generic
+:class:`~sentinel_tpu.adapters.SentinelWSGIMiddleware` already works on
+any Flask app (``app.wsgi_app = SentinelWSGIMiddleware(app.wsgi_app)``);
+this extension is the idiomatic mount with per-view resources and a
+configurable block handler::
+
+    from flask import Flask
+    from sentinel_tpu.adapters.flask_adapter import SentinelFlask
+
+    app = Flask(__name__)
+    SentinelFlask(app, total_resource="flask-total")
+
+All imports of flask happen at ``init_app`` time — importing this
+module never requires flask.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from sentinel_tpu.core import api
+from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.models import constants as C
+
+BLOCK_BODY = "Blocked by Sentinel (flow limiting)"
+_ENTRIES_KEY = "_sentinel_entries"
+
+
+class SentinelFlask:
+    """Per-request IN entries via Flask request hooks.
+
+    Resource = ``METHOD:url_rule`` (the route pattern, so path params
+    don't explode the resource space — the spring-webmvc adapter's
+    pattern-based resource), plus an optional app-total resource.
+    Blocked requests return ``(block_body, block_status)``; handler
+    exceptions are traced to the circuit breakers.
+    """
+
+    def __init__(
+        self,
+        app=None,
+        total_resource: Optional[str] = None,
+        origin_parser: Optional[Callable] = None,
+        block_status: int = 429,
+        block_body: str = BLOCK_BODY,
+    ) -> None:
+        self.total_resource = total_resource
+        self.origin_parser = origin_parser or (lambda request: "")
+        self.block_status = block_status
+        self.block_body = block_body
+        if app is not None:
+            self.init_app(app)
+
+    def _resource(self, request) -> str:
+        rule = request.url_rule.rule if request.url_rule is not None else request.path
+        return f"{request.method}:{rule}"
+
+    def init_app(self, app) -> None:
+        from flask import g, request
+
+        ext = self
+
+        @app.before_request
+        def _sentinel_enter():
+            resources = []
+            if ext.total_resource:
+                resources.append(ext.total_resource)
+            resources.append(ext._resource(request))
+            origin = ext.origin_parser(request)
+            entries = []
+            try:
+                for res in resources:
+                    entries.append(
+                        api.entry_async(
+                            res, entry_type=C.EntryType.IN, origin=origin
+                        )
+                    )
+            except BlockError:
+                for en in reversed(entries):
+                    en.exit()
+                return ext.block_body, ext.block_status
+            setattr(g, _ENTRIES_KEY, entries)
+            return None
+
+        @app.teardown_request
+        def _sentinel_exit(exc):
+            entries = getattr(g, _ENTRIES_KEY, None)
+            if not entries:
+                return
+            setattr(g, _ENTRIES_KEY, None)
+            for en in entries:
+                if exc is not None:
+                    en.set_error(exc)
+            for en in reversed(entries):
+                en.exit()

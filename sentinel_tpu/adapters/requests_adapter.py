@@ -1,0 +1,83 @@
+"""First-class `requests` integration.
+
+Reference analog: sentinel-okhttp-adapter's SentinelOkHttpInterceptor
+(okhttp/SentinelOkHttpInterceptor.java:35-60) — an interceptor mounted
+on the client so EVERY outbound call is guarded transparently, with a
+configurable resource extractor and fallback. The Python-native mount
+point is a ``requests`` transport adapter::
+
+    import requests
+    from sentinel_tpu.adapters.requests_adapter import SentinelHTTPAdapter
+
+    s = requests.Session()
+    s.mount("http://", SentinelHTTPAdapter())
+    s.mount("https://", SentinelHTTPAdapter())
+    s.get("http://api.internal/users")   # guarded: OUT entry per call
+
+Blocked calls raise :class:`~sentinel_tpu.core.errors.BlockError` by
+default, or return ``block_response_factory(request, error)`` when
+given (the okhttp adapter's SentinelOkHttpConfig fallback).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from sentinel_tpu.core import api
+from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.models import constants as C
+
+try:  # gated: requests is an optional dependency
+    from requests.adapters import HTTPAdapter as _HTTPAdapter
+except ImportError:  # pragma: no cover - exercised only without requests
+    _HTTPAdapter = object
+
+
+def default_resource_extractor(request) -> str:
+    """``METHOD:scheme://host/path`` — the okhttp adapter's default
+    (method + URL, query string dropped so resources stay bounded)."""
+    url = request.url or ""
+    return f"{request.method}:{url.split('?', 1)[0]}"
+
+
+class SentinelHTTPAdapter(_HTTPAdapter):
+    """A ``requests`` transport adapter guarding every ``send``.
+
+    Parameters mirror the reference interceptor config: a resource
+    extractor (request → resource name), an optional origin, and an
+    optional factory producing a synthetic ``Response`` for blocked
+    calls instead of raising.
+    """
+
+    def __init__(
+        self,
+        resource_extractor: Callable = default_resource_extractor,
+        origin: str = "",
+        block_response_factory: Optional[Callable] = None,
+        **kwargs,
+    ) -> None:
+        if _HTTPAdapter is object:  # pragma: no cover
+            raise ImportError("requests is not installed")
+        super().__init__(**kwargs)
+        self._extract = resource_extractor
+        self._origin = origin
+        self._block_response_factory = block_response_factory
+
+    def send(self, request, **kwargs):
+        resource = self._extract(request)
+        try:
+            entry = api.entry(
+                resource, entry_type=C.EntryType.OUT, origin=self._origin
+            )
+        except BlockError as e:
+            if self._block_response_factory is not None:
+                return self._block_response_factory(request, e)
+            raise
+        try:
+            resp = super().send(request, **kwargs)
+        except BaseException as e:
+            entry.set_error(e)
+            raise
+        finally:
+            entry.exit()
+        return resp

@@ -1,0 +1,255 @@
+"""Python-ecosystem adapter breadth: requests transport adapter,
+aiohttp server middleware + client session, async outbound guards,
+Flask/FastAPI sugar (skipped where the framework isn't installed).
+
+Reference analogs: okhttp/apache-httpclient interceptors for the
+client side (SentinelOkHttpInterceptor.java:35-60), servlet/webmvc
+interceptors for the server side
+(AbstractSentinelInterceptor.java:60-110).
+"""
+
+import asyncio
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core.errors import BlockError
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = b"hello"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def http_server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+
+
+class TestRequestsAdapter:
+    def test_mounted_adapter_guards_and_blocks(self, manual_clock, engine, http_server):
+        import requests
+
+        from sentinel_tpu.adapters import SentinelHTTPAdapter
+
+        port = http_server.server_address[1]
+        url = f"http://127.0.0.1:{port}/x"
+        st.flow_rule_manager.load_rules([st.FlowRule(f"GET:{url}", count=2)])
+        s = requests.Session()
+        s.mount("http://", SentinelHTTPAdapter())
+        assert s.get(url + "?q=1").status_code == 200  # query dropped
+        assert s.get(url).status_code == 200
+        with pytest.raises(BlockError):
+            s.get(url)
+        stats = engine.cluster_node_stats(f"GET:{url}")
+        assert stats["total_pass_minute"] == 2
+        assert stats["total_block_minute"] == 1
+        assert stats["cur_thread_num"] == 0
+
+    def test_block_response_factory(self, manual_clock, engine, http_server):
+        import requests
+
+        from sentinel_tpu.adapters import SentinelHTTPAdapter
+
+        port = http_server.server_address[1]
+        url = f"http://127.0.0.1:{port}/y"
+
+        def synth_429(request, error):
+            resp = requests.Response()
+            resp.status_code = 429
+            resp.request = request
+            return resp
+
+        st.flow_rule_manager.load_rules([st.FlowRule(f"GET:{url}", count=0)])
+        s = requests.Session()
+        s.mount("http://", SentinelHTTPAdapter(block_response_factory=synth_429))
+        assert s.get(url).status_code == 429
+
+
+class TestAiohttpServer:
+    def test_middleware_blocks_and_traces(self, manual_clock, engine):
+        aiohttp = pytest.importorskip("aiohttp")
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from sentinel_tpu.adapters.aiohttp_adapter import sentinel_middleware
+
+        async def hi(request):
+            return web.Response(text="hi")
+
+        async def boom(request):
+            raise RuntimeError("kaput")
+
+        app = web.Application(
+            middlewares=[sentinel_middleware(total_resource="aio-total")]
+        )
+        app.router.add_get("/hi", hi)
+        app.router.add_get("/boom", boom)
+        st.flow_rule_manager.load_rules([st.FlowRule("GET:/hi", count=2)])
+
+        async def drive():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                codes = [(await client.get("/hi")).status for _ in range(3)]
+                boom_status = (await client.get("/boom")).status
+                return codes, boom_status
+            finally:
+                await client.close()
+
+        codes, boom_status = asyncio.run(drive())
+        assert codes == [200, 200, 429]
+        assert boom_status == 500
+        stats = engine.cluster_node_stats("GET:/hi")
+        assert stats["total_pass_minute"] == 2
+        assert stats["total_block_minute"] == 1
+        # The exception on /boom was traced to its resource.
+        bstats = engine.cluster_node_stats("GET:/boom")
+        assert bstats["total_exception_minute"] == 1
+        # The app-total resource saw every request.
+        tstats = engine.cluster_node_stats("aio-total")
+        assert tstats["total_pass_minute"] == 4
+        assert tstats["cur_thread_num"] == 0
+
+    def test_client_session_guard(self, manual_clock, engine):
+        aiohttp = pytest.importorskip("aiohttp")
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        from sentinel_tpu.adapters.aiohttp_adapter import SentinelClientSession
+
+        async def ok(request):
+            return web.Response(text="ok")
+
+        app = web.Application()
+        app.router.add_get("/svc", ok)
+
+        async def drive():
+            server = TestServer(app)
+            await server.start_server()
+            url = server.make_url("/svc")
+            resource = f"GET:{url}"
+            st.flow_rule_manager.load_rules([st.FlowRule(resource, count=2)])
+            async with SentinelClientSession() as s:
+                # Both aiohttp idioms: bare await and async-with.
+                r1 = await s.get(url)
+                async with s.get(url) as r2:
+                    assert r2.status == 200
+                blocked = False
+                try:
+                    await s.get(url)
+                except BlockError:
+                    blocked = True
+                return r1.status, blocked, resource
+
+        status, blocked, resource = asyncio.run(drive())
+        assert status == 200 and blocked
+        stats = engine.cluster_node_stats(resource)
+        assert stats["total_pass_minute"] == 2
+        assert stats["total_block_minute"] == 1
+
+
+class TestAsyncGuards:
+    def test_guard_call_async_traces_errors(self, manual_clock, engine):
+        from sentinel_tpu.adapters import guard_call_async
+
+        async def failing():
+            raise ValueError("x")
+
+        async def drive():
+            with pytest.raises(ValueError):
+                await guard_call_async("dep", failing)
+
+        asyncio.run(drive())
+        stats = engine.cluster_node_stats("dep")
+        assert stats["total_exception_minute"] == 1
+        assert stats["cur_thread_num"] == 0
+
+    def test_guarded_async_client(self, manual_clock, engine):
+        from sentinel_tpu.adapters import GuardedAsyncClient
+
+        class Stub:
+            async def request(self, method, url, **kw):
+                return f"{method} {url}"
+
+        st.flow_rule_manager.load_rules([st.FlowRule("GET:http://a/b", count=1)])
+
+        async def drive():
+            c = GuardedAsyncClient(Stub())
+            # Query string must not explode the resource space.
+            first = await c.get("http://a/b?q=1")
+            blocked = False
+            try:
+                await c.get("http://a/b")
+            except BlockError:
+                blocked = True
+
+            async def async_fb(e):
+                return "afb"
+
+            fb = await GuardedAsyncClient(Stub(), fallback=lambda e: "fb").get(
+                "http://a/b"
+            )
+            afb = await GuardedAsyncClient(Stub(), fallback=async_fb).put(
+                "http://a/b"
+            )
+            return first, blocked, fb, afb
+
+        st.flow_rule_manager.load_rules(
+            [st.FlowRule("GET:http://a/b", count=1),
+             st.FlowRule("PUT:http://a/b", count=0)]
+        )
+        first, blocked, fb, afb = asyncio.run(drive())
+        assert first == "GET http://a/b?q=1" and blocked
+        assert fb == "fb" and afb == "afb"  # sync + async fallbacks
+
+
+class TestFrameworkSugar:
+    def test_flask_extension(self, manual_clock, engine):
+        pytest.importorskip("flask")
+        from flask import Flask
+
+        from sentinel_tpu.adapters import SentinelFlask
+
+        app = Flask(__name__)
+        SentinelFlask(app, total_resource="flask-total")
+
+        @app.get("/u/<int:uid>")
+        def user(uid):
+            return "u"
+
+        st.flow_rule_manager.load_rules([st.FlowRule("GET:/u/<int:uid>", count=1)])
+        c = app.test_client()
+        assert c.get("/u/1").status_code == 200
+        assert c.get("/u/2").status_code == 429
+
+    def test_fastapi_dependency(self, manual_clock, engine):
+        pytest.importorskip("fastapi")
+        from fastapi import Depends, FastAPI
+        from fastapi.testclient import TestClient
+
+        from sentinel_tpu.adapters import sentinel_guard
+
+        app = FastAPI()
+
+        @app.get("/g", dependencies=[Depends(sentinel_guard())])
+        async def g():
+            return {"ok": True}
+
+        st.flow_rule_manager.load_rules([st.FlowRule("GET:/g", count=1)])
+        c = TestClient(app)
+        assert c.get("/g").status_code == 200
+        assert c.get("/g").status_code == 429
