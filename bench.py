@@ -338,10 +338,11 @@ def _run_engine_stage(n_rules: int, n_ops: int, iters: int) -> dict:
     # One columnar group per flush — the gateway batching-window shape —
     # clamped to max_batch (submit_bulk rejects larger groups).
     adapter_n = min(groups * bulk_n, eng.max_batch)
-    # IP mix sized to ≤16 requests per distinct value per flush — the
-    # vectorized param-rounds path; heavier per-value multiplicity
-    # falls to the sequential scan by design (PERF_NOTES).
-    n_ips = max(256, adapter_n // 16)
+    # Heavy-hitter mix (~256 requests per distinct value): same-ts
+    # uniform-acquire batches take the closed-form rank path
+    # (param_rounds = −1), so per-value multiplicity no longer forces
+    # the sequential scan.
+    n_ips = max(256, adapter_n // 256)
     infos = [
         GatewayRequestInfo(
             path="/api/x",

@@ -1368,7 +1368,30 @@ class Engine:
             cost_ms=jnp.asarray(cost_ms),
             reset_rows=jnp.asarray(rs),
             exit_rows=jnp.asarray(xr),
-        ), _rounds_bucket(prow[:n_items])
+        ), self._param_rounds_for(
+            prow[:n_items], grade[:n_items], behavior[:n_items],
+            ts[:n_items], acquire[:n_items],
+        )
+
+    @staticmethod
+    def _param_rounds_for(prow, grade, behavior, ts, acquire) -> int:
+        """Host-known param execution mode: −1 selects the closed-form
+        rank path (every item QPS-grade DEFAULT at one ts with one
+        acquire — any per-value multiplicity in O(sort)); otherwise the
+        pow2 rounds bound, with 0 = the sequential-scan fallback."""
+        n = prow.shape[0]
+        if (
+            n > 0
+            and (grade == C.FLOW_GRADE_QPS).all()
+            and (behavior == C.CONTROL_BEHAVIOR_DEFAULT).all()
+            and ts.min() == ts.max()
+            and acquire.min() == acquire.max()
+            # acquire<1 admits unconditionally in the recurrence
+            # (tokens − 0 ≥ 0); the rank math has no such case.
+            and acquire.min() >= 1
+        ):
+            return -1
+        return _rounds_bucket(prow)
 
     def start_auto_flush(self, interval_ms: Optional[float] = None) -> None:
         """Background flusher for deferred mode: pending ops are

@@ -1,0 +1,210 @@
+"""Closed-form heavy-hitter param path (rounds = −1).
+
+Pins the rank math against the sequential scan (rounds = 0, the
+reference-semantics recurrence) on identical batches and state: same
+verdicts, same post-state — for any per-value multiplicity, including
+far past the 16-round unroll cap.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.rules.param_table import (
+    PARAM_NEVER,
+    ParamBatch,
+    ParamDynState,
+    make_param_state,
+    run_param,
+)
+
+
+def _batch(rng, s, pr, ts_val, acq_val, max_tc=6):
+    prow = rng.integers(0, pr, s).astype(np.int32)
+    tc = rng.integers(1, max_tc, s).astype(np.int32)
+    # Per-row constant tc/burst/duration (a row is one (rule, value)).
+    row_tc = rng.integers(1, max_tc, pr).astype(np.int32)
+    row_burst = rng.integers(0, 3, pr).astype(np.int32)
+    row_dur = (rng.integers(1, 4, pr) * 500).astype(np.int32)
+    tc = row_tc[prow]
+    burst = row_burst[prow]
+    dur = row_dur[prow]
+    valid = rng.random(s) < 0.9
+    return ParamBatch(
+        valid=jnp.asarray(valid),
+        prow=jnp.asarray(prow),
+        eidx=jnp.arange(s, dtype=jnp.int32),
+        ts=jnp.full(s, ts_val, dtype=jnp.int32),
+        acquire=jnp.full(s, acq_val, dtype=jnp.int32),
+        grade=jnp.full(s, C.FLOW_GRADE_QPS, dtype=jnp.int32),
+        behavior=jnp.full(s, C.CONTROL_BEHAVIOR_DEFAULT, dtype=jnp.int32),
+        token_count=jnp.asarray(tc),
+        burst=jnp.asarray(burst),
+        duration_ms=jnp.asarray(dur),
+        maxq=jnp.zeros(s, dtype=jnp.int32),
+        cost_ms=jnp.zeros(s, dtype=jnp.int32),
+        reset_rows=jnp.full(8, -1, dtype=jnp.int32),
+        exit_rows=jnp.full(8, -1, dtype=jnp.int32),
+    )
+
+
+def _rand_state(rng, pr):
+    return ParamDynState(
+        tokens=jnp.asarray(rng.integers(0, 8, pr).astype(np.int32)),
+        last_add=jnp.asarray(
+            np.where(
+                rng.random(pr) < 0.3,
+                PARAM_NEVER,
+                rng.integers(0, 2000, pr),
+            ).astype(np.int32)
+        ),
+        latest=jnp.full(pr, PARAM_NEVER, dtype=jnp.int32),
+        threads=jnp.zeros(pr, dtype=np.int32),
+    )
+
+
+def _assert_same(dyn_a, ok_a, dyn_b, ok_b):
+    assert np.array_equal(np.asarray(ok_a), np.asarray(ok_b))
+    assert np.array_equal(np.asarray(dyn_a.tokens), np.asarray(dyn_b.tokens))
+    assert np.array_equal(np.asarray(dyn_a.last_add), np.asarray(dyn_b.last_add))
+    assert np.array_equal(np.asarray(dyn_a.latest), np.asarray(dyn_b.latest))
+
+
+class TestClosedFormParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_batches_match_scan(self, seed):
+        """Heavy multiplicity (s >> pr): closed form ≡ scan on verdicts
+        AND post-state, across never/refill/steady rows."""
+        rng = np.random.default_rng(seed)
+        s, pr = 512, 9  # ~57 items per value — far past the rounds cap
+        ts_val = int(rng.integers(500, 3000))
+        acq = int(rng.integers(1, 3))
+        pb = _batch(rng, s, pr, ts_val, acq)
+        dyn0 = _rand_state(rng, pr)
+        dyn_cf, ok_cf, wait_cf = run_param(dyn0, pb, rounds=-1)
+        dyn_sc, ok_sc, wait_sc = run_param(dyn0, pb, rounds=0)
+        _assert_same(dyn_cf, ok_cf, dyn_sc, ok_sc)
+        assert np.array_equal(np.asarray(wait_cf), np.asarray(wait_sc))
+
+    def test_acquire_zero_not_eligible(self, engine):
+        """acquire<1 admits unconditionally in the recurrence
+        (tokens−0 ≥ 0); the selector must not hand such batches to the
+        rank path."""
+        import numpy as np
+
+        z = np.zeros(4, dtype=np.int32)
+        assert engine._param_rounds_for(
+            z, np.full(4, C.FLOW_GRADE_QPS, np.int32),
+            np.full(4, C.CONTROL_BEHAVIOR_DEFAULT, np.int32),
+            np.full(4, 1000, np.int32), np.zeros(4, np.int32),
+        ) != -1
+        assert engine._param_rounds_for(
+            z, np.full(4, C.FLOW_GRADE_QPS, np.int32),
+            np.full(4, C.CONTROL_BEHAVIOR_DEFAULT, np.int32),
+            np.full(4, 1000, np.int32), np.ones(4, np.int32),
+        ) == -1
+
+    def test_second_flush_refill_boundary(self):
+        """State chains correctly across flushes: spend the window,
+        then at exactly dur+1 later the refill reopens the budget."""
+        pr = 2
+        dyn = make_param_state(pr)
+
+        def batch(ts, n):
+            rng = np.random.default_rng(0)
+            return ParamBatch(
+                valid=jnp.ones(n, dtype=bool),
+                prow=jnp.zeros(n, dtype=jnp.int32),
+                eidx=jnp.arange(n, dtype=jnp.int32),
+                ts=jnp.full(n, ts, dtype=jnp.int32),
+                acquire=jnp.ones(n, dtype=jnp.int32),
+                grade=jnp.full(n, C.FLOW_GRADE_QPS, dtype=jnp.int32),
+                behavior=jnp.full(n, C.CONTROL_BEHAVIOR_DEFAULT, dtype=jnp.int32),
+                token_count=jnp.full(n, 3, dtype=jnp.int32),
+                burst=jnp.zeros(n, dtype=jnp.int32),
+                duration_ms=jnp.full(n, 1000, dtype=jnp.int32),
+                maxq=jnp.zeros(n, dtype=jnp.int32),
+                cost_ms=jnp.zeros(n, dtype=jnp.int32),
+                reset_rows=jnp.full(8, -1, dtype=jnp.int32),
+                exit_rows=jnp.full(8, -1, dtype=jnp.int32),
+            )
+
+        dyn, ok, _ = run_param(dyn, batch(1000, 40), rounds=-1)
+        assert int(np.asarray(ok).sum()) == 3  # first fill: maxCount
+        dyn, ok, _ = run_param(dyn, batch(1100, 40), rounds=-1)
+        assert int(np.asarray(ok).sum()) == 0  # window spent
+        dyn, ok, _ = run_param(dyn, batch(2101, 40), rounds=-1)
+        assert int(np.asarray(ok).sum()) == 3  # refilled
+
+    def test_engine_selects_closed_form_for_heavy_hitter_bulk(
+        self, manual_clock, engine
+    ):
+        """A heavy-hitter bulk column (multiplicity way past the rounds
+        cap) picks rounds=-1 on the host and still grants exactly the
+        per-value budget."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import ParamFlowRule
+
+        engine.set_flow_rules([st.FlowRule("hh", count=100000)])
+        engine.set_param_rules({"hh": [ParamFlowRule("hh", param_idx=0, count=4)]})
+        manual_clock.set_ms(1000)
+        n = 600  # 300 per value — scan territory without the closed form
+        col = [("a",) if i % 2 == 0 else ("b",) for i in range(n)]
+        g = engine.submit_bulk(
+            "hh", n, ts=np.full(n, 1000, dtype=np.int32), args_column=col
+        )
+        engine.flush()
+        adm = np.asarray(g.admitted)
+        assert adm[::2].sum() == 4 and adm[1::2].sum() == 4
+
+    def test_mixed_ts_not_eligible(self, manual_clock, engine):
+        """Mixed timestamps fall back to the rounds/scan path and stay
+        correct (two windows' worth of grants across the ts gap)."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import ParamFlowRule
+        from sentinel_tpu.models import constants as C2
+
+        grades = np.array([C2.FLOW_GRADE_QPS], dtype=np.int32)
+        ts = np.array([1000, 2500], dtype=np.int32)
+        acq = np.array([1, 1], dtype=np.int32)
+        beh = np.array([C2.CONTROL_BEHAVIOR_DEFAULT] * 2, dtype=np.int32)
+        assert engine._param_rounds_for(
+            np.array([0, 0], dtype=np.int32), np.repeat(grades, 2), beh, ts, acq
+        ) != -1
+
+        engine.set_flow_rules([st.FlowRule("mx", count=100000)])
+        engine.set_param_rules({"mx": [ParamFlowRule("mx", param_idx=0, count=2)]})
+        ops = engine.submit_many(
+            [{"resource": "mx", "ts": 1000, "args": ("k",)} for _ in range(4)]
+            + [{"resource": "mx", "ts": 2500, "args": ("k",)} for _ in range(4)]
+        )
+        engine.flush()
+        adm = [op.verdict.admitted for op in ops]
+        assert sum(adm[:4]) == 2 and sum(adm[4:]) == 2  # window rolled at 2500
+
+    def test_throttle_items_not_eligible(self, manual_clock, engine):
+        """RATE_LIMITER behavior must keep the exact pacer recurrence."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import ParamFlowRule
+        from sentinel_tpu.models import constants as C2
+
+        engine.set_flow_rules([st.FlowRule("th", count=100000)])
+        engine.set_param_rules(
+            {"th": [ParamFlowRule(
+                "th", param_idx=0, count=10,
+                control_behavior=C2.CONTROL_BEHAVIOR_RATE_LIMITER,
+                max_queueing_time_ms=500,
+            )]}
+        )
+        manual_clock.set_ms(1000)
+        ops = engine.submit_many(
+            [{"resource": "th", "ts": 1000, "args": ("k",)} for _ in range(8)]
+        )
+        engine.flush()
+        grants = [op.verdict for op in ops]
+        # 1 immediate + 4 queued (100 ms cost; wait must be STRICTLY
+        # under maxQueueingTimeMs=500 — ParamFlowChecker.java:258).
+        assert [v.admitted for v in grants] == [True] * 5 + [False] * 3
+        assert [v.wait_ms for v in grants[:5]] == [0, 100, 200, 300, 400]
