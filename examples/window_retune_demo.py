@@ -1,0 +1,40 @@
+"""Live second-window retune (SampleCountProperty / IntervalProperty).
+
+The reference can rebuild every node's rolling second counter at
+runtime; here the same knobs retune the shared window tensors — the
+kernels re-trace on the new geometry, statistics reset cleanly, and
+QPS rules reinterpret over the new interval.
+"""
+
+import _bootstrap  # noqa: F401
+
+import sentinel_tpu as st
+from sentinel_tpu.core import api
+from sentinel_tpu.metrics import nodes
+from sentinel_tpu.utils.clock import ManualClock, set_default_clock
+
+clock = ManualClock(0)
+set_default_clock(clock)
+api.reset(clock=clock)
+
+st.flow_rule_manager.load_rules([st.FlowRule("svc", count=5)])
+
+
+def grants(n):
+    return sum(st.try_entry("svc") is not None for _ in range(n))
+
+
+print(f"geometry: {nodes.SECOND_CFG.sample_count} x "
+      f"{nodes.SECOND_CFG.window_len_ms} ms")
+print(f"  5-QPS rule over 1 s window: {grants(10)} of 10 admitted")
+
+# Retune live: 4 buckets over a 2 s interval.
+st.sample_count_property.update_value(4)
+st.interval_property.update_value(2000)
+print(f"retuned: {nodes.SECOND_CFG.sample_count} x "
+      f"{nodes.SECOND_CFG.window_len_ms} ms (stats reset, kernels re-trace)")
+print(f"  same rule over the 2 s window: {grants(20)} of 20 admitted "
+      "(5 QPS x 2 s = 10)")
+
+clock.advance(2001)
+print(f"  next window: {grants(20)} of 20 admitted")

@@ -35,6 +35,8 @@ from sentinel_tpu.models.rules import DegradeRule
 from sentinel_tpu.utils.numeric import pad_pow2
 from sentinel_tpu.utils.record_log import record_log
 
+_NO_GIDS: list = []  # shared empty default for gids_for (never mutated)
+
 # Breaker states (CircuitBreaker.State ordinals).
 CLOSED = 0
 OPEN = 1
@@ -123,7 +125,9 @@ class DegradeIndex:
         )
 
     def gids_for(self, resource: str) -> List[int]:
-        return self.by_resource.get(resource, [])
+        # Shared immutable default: this runs once per submitted entry,
+        # so a per-call empty-list allocation is measurable host cost.
+        return self.by_resource.get(resource, _NO_GIDS)
 
     def rule_of_gid(self, gid: int):
         if 0 <= gid < len(self.rules):

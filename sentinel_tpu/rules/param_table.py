@@ -264,7 +264,6 @@ def run_param(
         gate = (tc_x > 0) & (acq_x <= max_count)
         cap = jnp.where(gate, avail // jnp.maximum(acq_x, 1), 0)
         ok_s = (valid_x & gate & (seg_rank < cap)) | ~valid_x
-        wait_s = jnp.zeros((s,), dtype=jnp.int32)
 
         # Per-item "state if the segment ended here" — the existing
         # seg-end write-back picks the last item's version.
@@ -283,7 +282,7 @@ def run_param(
             threads=dyn.threads,
         )
         ok_out = jnp.ones((s,), dtype=bool).at[p_s].set(ok_s)
-        del wait_s  # all grants are immediate on this path
+        # All grants are immediate on this path: wait is identically 0.
         return new_dyn, ok_out, jnp.zeros((s,), dtype=jnp.int32)
 
     def transition(states, item_vals):

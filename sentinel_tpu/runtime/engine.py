@@ -141,7 +141,7 @@ class _PendingFetch:
         self._engine._drain_pending(upto=self)
 
 
-@dataclass
+@dataclass(slots=True)
 class _EntryOp:
     resource: str
     ts: int
@@ -307,7 +307,7 @@ class _BulkExitOp:
     src_dindex: Optional[object] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class _ExitOp:
     ts: int
     rows: Tuple[int, int, int, int]

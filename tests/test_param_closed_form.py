@@ -23,7 +23,6 @@ from sentinel_tpu.rules.param_table import (
 
 def _batch(rng, s, pr, ts_val, acq_val, max_tc=6):
     prow = rng.integers(0, pr, s).astype(np.int32)
-    tc = rng.integers(1, max_tc, s).astype(np.int32)
     # Per-row constant tc/burst/duration (a row is one (rule, value)).
     row_tc = rng.integers(1, max_tc, pr).astype(np.int32)
     row_burst = rng.integers(0, 3, pr).astype(np.int32)
