@@ -30,10 +30,10 @@ center = CommandCenter(port=_port).start()
 dashboard = DashboardServer(
     port=_port + 1 if _port else 0, fetch_interval_sec=0.5
 ).start()
-HeartbeatSender(
+heartbeat = HeartbeatSender(
     f"127.0.0.1:{dashboard.port}", command_port=center.port, interval_sec=1.0
 ).start()
-MetricTimer(st.get_engine(), interval_sec=0.5).start()
+timer = MetricTimer(st.get_engine(), interval_sec=0.5).start()
 
 print(f"command API  : http://127.0.0.1:{center.port}/api")
 print(f"Prometheus   : http://127.0.0.1:{center.port}/metrics")
@@ -52,5 +52,10 @@ try:
 except KeyboardInterrupt:
     pass
 finally:
+    # Stop every background thread BEFORE interpreter teardown: a
+    # daemon still inside a JAX/XLA call when the process exits can
+    # abort in native code (observed flakily under machine load).
+    timer.stop()
+    heartbeat.stop()
     dashboard.stop()
     center.stop()

@@ -192,7 +192,10 @@ def run_param(
     in this batch — picks the vectorized rounds path (round *r*
     resolves every row's *r*-th item in parallel, each item chaining
     from its predecessor in the sorted order); 0 falls back to the
-    sequential ``lax.scan``.
+    sequential ``lax.scan``; −1 selects the closed-form rank path,
+    ONLY valid when the host verified every item is QPS-grade DEFAULT
+    at one ts with one acquire ≥ 1 (Engine._param_rounds_for owns that
+    predicate — run_param does not re-validate).
     """
     s = pb.valid.shape[0]
     pr = dyn.tokens.shape[0]

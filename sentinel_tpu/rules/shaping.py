@@ -68,6 +68,17 @@ class ShapingBatch(NamedTuple):
     acquire: jax.Array  # int32 [S]
 
 
+def _pacer_cost(acq_f, acq_i, cnt, c1):
+    """RateLimiter pacing cost in ms: the host-precomputed exact cost1
+    for the acquire==1 fast path, else round(acquire/count*1000).
+    Shared by the recurrence and the closed-form rank path — their
+    bit-exact parity depends on one cost formula."""
+    cost_generic = jnp.floor(acq_f / jnp.maximum(cnt, 1e-9) * 1000.0 + 0.5)
+    return jnp.where(acq_i == 1, c1.astype(jnp.float32), cost_generic).astype(
+        jnp.int32
+    )
+
+
 def _transition(latest, stored, lastfill, x):
     """One item's controller decision + state update, vector-friendly
     (works elementwise on arrays of items as well as on scan scalars).
@@ -99,8 +110,7 @@ def _transition(latest, stored, lastfill, x):
     wu_ok = jnp.where(cold, passq + acq_f <= warning_qps, passq + acq_f <= cnt)
 
     # --- pacer cost (RateLimiter / WarmUpRateLimiter) ---
-    cost_generic = jnp.floor(acq_f / jnp.maximum(cnt, 1e-9) * 1000.0 + 0.5)
-    cost_rl = jnp.where(acq == 1, c1.astype(jnp.float32), cost_generic)
+    cost_rl = _pacer_cost(acq_f, acq, cnt, c1).astype(jnp.float32)
     cost_wurl_cold = jnp.floor(acq_f / warning_qps * 1000.0 + 0.5)
     cost_wurl = jnp.where(cold, cost_wurl_cold, cost_rl)
     cost = jnp.where(
@@ -151,7 +161,11 @@ def run_shaping(
 
     ``rounds`` (static): host-known upper bound on items-per-rule in
     this batch — picks the vectorized rounds path; 0 falls back to the
-    sequential ``lax.scan`` (see module docstring).
+    sequential ``lax.scan`` (see module docstring); −1 selects the
+    closed-form pacer rank path, which is ONLY valid when the host
+    verified every item is a plain RATE_LIMITER at one ts with one
+    acquire ≥ 1 (Engine._shaping_rounds_for owns that predicate —
+    run_shaping does not re-validate).
 
     The three behaviors (reference files in module docstring):
 
@@ -200,6 +214,70 @@ def run_shaping(
     )
     ones = jnp.ones((1,), dtype=bool)
     new_grp = jnp.concatenate([ones, gid_s[1:] != gid_s[:-1]])
+
+    if rounds == -1:
+        # Closed-form pacer path (host-selected when EVERY item is a
+        # plain RATE_LIMITER at ONE timestamp with ONE acquire ≥ 1 —
+        # the columnar-bulk shape): with a single ts per rule, at most
+        # the FIRST grant can be immediate (it pins latest to ts), and
+        # each further grant queues exactly one more ``cost`` out, so
+        # the r-th grant's wait is a closed form of the segment-start
+        # state and admission is prefix-monotone rank math — any
+        # per-rule multiplicity in O(sort), no unroll, no scan.
+        idx = jnp.arange(s, dtype=jnp.int32)
+        seg_start = jax.lax.cummax(jnp.where(new_grp, idx, 0))
+        r1 = idx - seg_start + 1  # rank within segment, 1-indexed
+
+        cost = _pacer_cost(acq_s, acq_i, count, cost1)
+        latest0 = seg_latest
+        imm0 = latest0 + cost <= ts_s
+        gate = count > 0
+
+        # Segment grant cap G, division math only — ``r1 <= cap`` is
+        # the admission test precisely BECAUSE rank×cost products can
+        # overflow int32 for large segments × large costs (a wait-based
+        # test wraps negative and wrongly admits); the cap form never
+        # multiplies. (cost==0 → unbounded: every grant is immediate /
+        # same constant wait, latest never advances.)
+        big = jnp.int32(1 << 30)
+        safe_cost = jnp.maximum(cost, 1)
+        g_imm = jnp.where(cost > 0, 1 + maxq // safe_cost, big)
+        g_queue = jnp.where(
+            cost > 0,
+            jnp.maximum((ts_s + maxq - latest0) // safe_cost, 0),
+            jnp.where(latest0 - ts_s <= maxq, big, 0),
+        )
+        cap = jnp.where(gate, jnp.where(imm0, g_imm, g_queue), 0)
+        ok_s = (valid_s & (r1 <= cap)) | ~valid_s
+        # Waits only for admitted items, whose rank×cost is bounded by
+        # maxq (+ts−latest0) and cannot overflow; blocked lanes may
+        # wrap but are masked to 0.
+        wait_r = jnp.where(imm0, (r1 - 1) * cost, latest0 + r1 * cost - ts_s)
+        wait_out_s = jnp.where(valid_s & ok_s & (wait_r > 0), wait_r, 0)
+        granted_here = jnp.minimum(r1, cap)
+        latest_here = jnp.where(
+            granted_here > 0,
+            jnp.where(
+                imm0, ts_s + (granted_here - 1) * cost,
+                latest0 + granted_here * cost,
+            ),
+            latest0,
+        )
+        seg_end = jnp.concatenate(
+            [gid_s[1:] != gid_s[:-1], jnp.ones((1,), dtype=bool)]
+        ) & valid_s
+        scatter_gid = jnp.where(seg_end, gid_c, jnp.int32(nr))
+        new_dyn = FlowRuleDynState(
+            latest_passed_time=flow_dyn.latest_passed_time.at[scatter_gid].set(
+                latest_here, mode="drop"
+            ),
+            # Warm-up columns untouched: no WARM_UP items are eligible.
+            stored_tokens=flow_dyn.stored_tokens,
+            last_filled_time=flow_dyn.last_filled_time,
+        )
+        ok_out = jnp.ones((s,), dtype=bool).at[p_s].set(ok_s)
+        wait_out = jnp.zeros((s,), dtype=jnp.int32).at[p_s].set(wait_out_s)
+        return new_dyn, ok_out, wait_out
 
     def transition(states, item_vals):
         latest, stored, lastfill = states

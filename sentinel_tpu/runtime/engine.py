@@ -2370,7 +2370,26 @@ class Engine:
             flat_pos=jnp.asarray(_p(flat_pos)),
             ts=jnp.asarray(_p(ts)),
             acquire=jnp.asarray(_p(acquire, 1)),
-        ), _rounds_bucket(gid)
+        ), self._shaping_rounds_for(gid, ts, acquire, findex)
+
+    @staticmethod
+    def _shaping_rounds_for(gid, ts, acquire, findex: FlowIndex) -> int:
+        """Host-known shaping execution mode: −1 selects the closed-form
+        pacer path (every item a plain RATE_LIMITER at one ts with one
+        acquire ≥ 1); otherwise the pow2 rounds bound (0 = scan)."""
+        if (
+            gid.shape[0] > 0
+            and ts.min() == ts.max()
+            and acquire.min() == acquire.max()
+            and acquire.min() >= 1
+            and all(
+                (r := findex.rule_of_gid(int(g))) is not None
+                and r.control_behavior == C.CONTROL_BEHAVIOR_RATE_LIMITER
+                for g in np.unique(gid)
+            )
+        ):
+            return -1
+        return _rounds_bucket(gid)
 
     def entry_sync(
         self,

@@ -623,6 +623,8 @@ def flush_entries(
     """Phases 2-3: admission checks and (when ``commit``) accounting.
 
     ``shaping_rounds`` / ``param_rounds`` (static) are the host-known
+    execution modes (−1 = closed-form rank paths with host-verified
+    preconditions, >0 = unrolled rounds, 0 = scan) — the host-known
     max-items-per-rule bounds selecting the vectorized rounds path of
     the serializing scans (rules/shaping.py, rules/param_table.py);
     0 = sequential lax.scan fallback.

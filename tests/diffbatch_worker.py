@@ -75,6 +75,20 @@ def main() -> None:
         finally:
             set_default_clock(prev)
             api.reset()
+            # Drop compiled executables between streams: each stream's
+            # compiles pin JIT code pages whose mmap count accumulates
+            # toward vm.max_map_count (65530 default) — the actual
+            # mechanism behind the "LLVM compilation error: Cannot
+            # allocate memory" → SIGSEGV this worker exists to dodge
+            # (observed: ~30k maps after two streams; the crash lands
+            # around stream 5). Same mitigation as conftest's periodic
+            # clear, which the worker process otherwise lacks.
+            import gc
+
+            import jax
+
+            jax.clear_caches()
+            gc.collect()
 
 
 if __name__ == "__main__":

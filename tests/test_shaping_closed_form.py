@@ -1,0 +1,161 @@
+"""Closed-form pacer path (shaping rounds = −1).
+
+Pins the rank math against the sequential scan (rounds = 0, the
+reference RateLimiterController recurrence) on identical batches and
+state, for same-ts uniform-acquire RATE_LIMITER traffic of any
+per-rule multiplicity.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import sentinel_tpu as st
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.rules.flow_table import FlowIndex
+from sentinel_tpu.rules.shaping import ShapingBatch, run_shaping
+
+
+def _index(n_rules, rng):
+    rules = [
+        st.FlowRule(
+            resource=f"r{i}",
+            count=float(rng.integers(5, 80)),
+            control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+            max_queueing_time_ms=int(rng.integers(0, 600)),
+        )
+        for i in range(n_rules)
+    ]
+    return FlowIndex(rules)
+
+
+def _batch(rng, s, n_rules, ts_val, acq_val):
+    gid = rng.integers(0, n_rules, s).astype(np.int32)
+    valid = rng.random(s) < 0.9
+    return ShapingBatch(
+        valid=jnp.asarray(valid),
+        gid=jnp.asarray(gid),
+        row=jnp.asarray(gid),
+        eidx=jnp.arange(s, dtype=jnp.int32),
+        flat_pos=jnp.arange(s, dtype=jnp.int32),
+        ts=jnp.full(s, ts_val, dtype=jnp.int32),
+        acquire=jnp.full(s, acq_val, dtype=jnp.int32),
+    )
+
+
+class TestPacerClosedFormParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_batches_match_scan(self, seed):
+        rng = np.random.default_rng(seed)
+        n_rules, s = 7, 512  # ~73 items/rule — far past the rounds cap
+        index = _index(n_rules, rng)
+        dyn = index.make_dyn_state()
+        # Random pre-state: some rules mid-pace, some never-seen.
+        latest = np.where(
+            rng.random(n_rules) < 0.3,
+            -(10**9),
+            rng.integers(500, 2500, n_rules),
+        ).astype(np.int32)
+        dyn = dyn._replace(latest_passed_time=jnp.asarray(latest))
+        ts_val = int(rng.integers(1000, 3000))
+        acq = int(rng.integers(1, 3))
+        pb = _batch(rng, s, n_rules, ts_val, acq)
+        zeros = jnp.zeros(s, dtype=jnp.int32)
+        dyn_cf, ok_cf, wait_cf = run_shaping(
+            index.device, dyn, pb, zeros, zeros, 1.0, rounds=-1
+        )
+        dyn_sc, ok_sc, wait_sc = run_shaping(
+            index.device, dyn, pb, zeros, zeros, 1.0, rounds=0
+        )
+        assert np.array_equal(np.asarray(ok_cf), np.asarray(ok_sc))
+        assert np.array_equal(np.asarray(wait_cf), np.asarray(wait_sc))
+        assert np.array_equal(
+            np.asarray(dyn_cf.latest_passed_time),
+            np.asarray(dyn_sc.latest_passed_time),
+        )
+
+    def test_large_cost_times_rank_does_not_overflow(self):
+        """count=1 + acquire=1000 → cost = 1,000,000 ms; rank×cost
+        wraps int32 past ~2149 items. The cap-based admission must
+        still admit exactly what the scan admits (1 item)."""
+        rng = np.random.default_rng(0)
+        index = FlowIndex([
+            st.FlowRule(
+                "r0", count=1.0,
+                control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                max_queueing_time_ms=500,
+            )
+        ])
+        dyn = index.make_dyn_state()
+        s = 4096
+        pb = ShapingBatch(
+            valid=jnp.ones(s, dtype=bool),
+            gid=jnp.zeros(s, dtype=jnp.int32),
+            row=jnp.zeros(s, dtype=jnp.int32),
+            eidx=jnp.arange(s, dtype=jnp.int32),
+            flat_pos=jnp.arange(s, dtype=jnp.int32),
+            ts=jnp.full(s, 1000, dtype=jnp.int32),
+            acquire=jnp.full(s, 1000, dtype=jnp.int32),
+        )
+        zeros = jnp.zeros(s, dtype=jnp.int32)
+        dyn_cf, ok_cf, _ = run_shaping(index.device, dyn, pb, zeros, zeros, 1.0, rounds=-1)
+        dyn_sc, ok_sc, _ = run_shaping(index.device, dyn, pb, zeros, zeros, 1.0, rounds=0)
+        assert int(np.asarray(ok_cf).sum()) == int(np.asarray(ok_sc).sum()) == 1
+        assert np.array_equal(
+            np.asarray(dyn_cf.latest_passed_time),
+            np.asarray(dyn_sc.latest_passed_time),
+        )
+
+    def test_engine_bulk_rate_limiter_ladder(self, manual_clock, engine):
+        """A bulk group on a rate-limited resource (multiplicity far
+        past the rounds cap → previously the scan): 1 immediate + the
+        queueing ladder, exact waits."""
+        engine.set_flow_rules([
+            st.FlowRule(
+                "paced", count=10.0,  # cost 100 ms
+                control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                max_queueing_time_ms=300,
+            )
+        ])
+        manual_clock.set_ms(1000)
+        n = 100
+        g = engine.submit_bulk("paced", n, ts=np.full(n, 1000, dtype=np.int32))
+        engine.flush()
+        adm = np.asarray(g.admitted)
+        waits = np.asarray(g.wait_ms)
+        assert adm.sum() == 4  # immediate + 100/200/300ms queue slots
+        assert waits[adm].tolist() == [0, 100, 200, 300]
+
+        # Next flush chains off the advanced pacer state.
+        manual_clock.set_ms(1050)
+        g2 = engine.submit_bulk("paced", n, ts=np.full(n, 1050, dtype=np.int32))
+        engine.flush()
+        adm2 = np.asarray(g2.admitted)
+        waits2 = np.asarray(g2.wait_ms)
+        # latest = 1300; waits from 1300+100-1050=350 > 300 → none fit.
+        assert adm2.sum() == 0, (adm2.sum(), waits2[adm2])
+
+    def test_mixed_behavior_not_eligible(self, manual_clock, engine):
+        """A WARM_UP rule in the batch keeps the exact recurrence (the
+        selector must not pick the pacer-only closed form)."""
+        import numpy as np
+        from sentinel_tpu.rules.flow_table import FlowIndex as FI
+
+        rules = [
+            st.FlowRule("a", count=10.0,
+                        control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER),
+            st.FlowRule("b", count=10.0,
+                        control_behavior=C.CONTROL_BEHAVIOR_WARM_UP,
+                        warm_up_period_sec=5),
+        ]
+        findex = FI(rules)
+        gid = np.array([0, 1], dtype=np.int32)
+        ts = np.array([1000, 1000], dtype=np.int32)
+        acq = np.array([1, 1], dtype=np.int32)
+        assert engine._shaping_rounds_for(gid, ts, acq, findex) != -1
+        gid_rl = np.array([0, 0], dtype=np.int32)
+        assert engine._shaping_rounds_for(gid_rl, ts, acq, findex) == -1
+        # Mixed ts also disqualifies.
+        ts2 = np.array([1000, 1200], dtype=np.int32)
+        assert engine._shaping_rounds_for(gid_rl, ts2, acq, findex) != -1
