@@ -30,10 +30,15 @@ import pytest  # noqa: E402
 # native compiler eventually segfaults (observed twice deep into the
 # slow tier: once in backend_compile_and_load after ~45 min of
 # compiles, once in the persistent-cache executable serializer; any
-# single test passes in isolation). Mitigation: periodically drop the
-# in-memory executable caches and collect, bounding resident JIT
-# state. The persistent disk cache is deliberately NOT enabled — its
-# serialize path was itself a crash site.
+# single test passes in isolation). Round-5 diagnosis: each compiled
+# executable pins JIT code-page mmaps, and the process walks into
+# vm.max_map_count (65530 default) — LLVM then reports "Cannot
+# allocate memory" and segfaults; /proc/<pid>/maps showed ~30k maps
+# after two differential streams, dropping to ~1k on clear_caches().
+# Mitigation: periodically drop the in-memory executable caches and
+# collect, bounding resident JIT state (diffbatch_worker does the same
+# between streams). The persistent disk cache is deliberately NOT
+# enabled — its serialize path was itself a crash site.
 _TESTS_SINCE_CLEAR = {"n": 0}
 
 
