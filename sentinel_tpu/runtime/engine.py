@@ -195,6 +195,15 @@ class _EntryOp:
         self._verdict = v
 
 
+# submit_entry's keyword surface — the submit_many fast path accepts
+# exactly these request-dict keys and defers anything else to
+# submit_entry(**req) so typos still raise TypeError.
+_SUBMIT_ENTRY_KEYS = frozenset(
+    ("resource", "context_name", "origin", "acquire", "entry_type",
+     "prio", "ts", "args")
+)
+
+
 @dataclass
 class _BulkParamCols:
     """One param rule's resolved columns over a bulk group: per-entry
@@ -690,37 +699,14 @@ class Engine:
         # double-acquire the global budget.
         with self._lock:
             findex = self.flow_index
-            dindex = self.degrade_index
-            pindex = self.param_index
-            rows = self.resolve_entry_rows(resource, context_name, origin, entry_type)
-            if rows is None:
-                return None
-            slots = findex.resolve_slots(resource, context_name, origin, self.nodes)
             cluster_gids = findex.cluster_gids
-            auth_ok = True
-            arule = self.authority_rules.get(resource)
-            if arule is not None:
-                from sentinel_tpu.rules.authority_manager import AuthorityRuleManager
-
-                auth_ok = AuthorityRuleManager.passes(arule, origin)
-            p_slots: List[ParamSlotInfo] = []
-            if args and pindex.has_rules():
-                p_slots = pindex.slots_for(resource, args)
-            op = _EntryOp(
-                resource=resource,
-                ts=self.clock.now_ms() if ts is None else ts,
-                acquire=acquire,
-                rows=rows,
-                slots=slots,
-                d_gids=dindex.gids_for(resource),
-                p_slots=p_slots,
-                auth_ok=auth_ok,
-                prio=prio,
-                context_name=context_name,
-                origin=origin,
-                args=tuple(args),
-                src=(findex, dindex, pindex),
+            op = self._resolve_entry_locked(
+                findex, self.degrade_index, self.param_index,
+                resource, context_name, origin, acquire, entry_type, prio,
+                ts, tuple(args),
             )
+        if op is None:
+            return None
         # Cluster-mode rules consult the token service OUTSIDE the engine
         # lock (it may be a network RPC — FlowRuleChecker.passClusterCheck
         # crossing to the token server, FlowRuleChecker.java:168-230).
@@ -737,6 +723,44 @@ class Engine:
             self.flush()  # flush-on-size: the pending buffer is bounded
         return op
 
+    def _resolve_entry_locked(
+        self, findex, dindex, pindex, resource, context_name, origin,
+        acquire, entry_type, prio, ts, args,
+    ) -> Optional[_EntryOp]:
+        """Build one resolved (NOT yet enqueued) op against the given
+        index snapshot. Caller holds ``self._lock``. The single source
+        of resolution truth for submit_entry AND the submit_many fast
+        path — any divergence between the two would make semantics
+        depend on which path a request happens to take."""
+        from sentinel_tpu.rules.authority_manager import AuthorityRuleManager
+
+        rows = self.resolve_entry_rows(resource, context_name, origin, entry_type)
+        if rows is None:
+            return None
+        slots = findex.resolve_slots(resource, context_name, origin, self.nodes)
+        auth_ok = True
+        arule = self.authority_rules.get(resource)
+        if arule is not None:
+            auth_ok = AuthorityRuleManager.passes(arule, origin)
+        p_slots: List[ParamSlotInfo] = []
+        if args and pindex.has_rules():
+            p_slots = pindex.slots_for(resource, args)
+        return _EntryOp(
+            resource=resource,
+            ts=self.clock.now_ms() if ts is None else ts,
+            acquire=acquire,
+            rows=rows,
+            slots=slots,
+            d_gids=dindex.gids_for(resource),
+            p_slots=p_slots,
+            auth_ok=auth_ok,
+            prio=prio,
+            context_name=context_name,
+            origin=origin,
+            args=args,
+            src=(findex, dindex, pindex),
+        )
+
     def submit_many(self, requests: Sequence[Dict]) -> List[Optional[_EntryOp]]:
         """Deferred-mode batch submission: enqueue many entries without
         flushing; verdicts appear on the returned ops after ``flush()``
@@ -750,8 +774,72 @@ class Engine:
         decisions tolerate one flush of latency, like the reference's
         cluster token client (FlowRuleChecker.passClusterCheck crossing
         to the token server, FlowRuleChecker.java:168-230).
+
+        Resolution for the whole batch happens under ONE lock
+        acquisition (two per op otherwise — measurable at 100k+ ops/s).
+        The moment a request needs the token service (cluster-mode flow
+        or param rules — RPCs must run OUTSIDE the lock) or the pending
+        buffer hits max_batch, the fast path hands the REMAINING
+        requests to :meth:`submit_entry`, preserving arrival order
+        exactly (already-appended ops stay; the rest append in request
+        order).
         """
-        return [self.submit_entry(**req) for req in requests]
+        if not self.enabled:
+            return [None] * len(requests)
+        out: List[Optional[_EntryOp]] = []
+        resume_at = 0
+        over = False
+        with self._lock:
+            findex = self.flow_index
+            dindex = self.degrade_index
+            pindex = self.param_index
+            cluster_gids = findex.cluster_gids
+            for i, req in enumerate(requests):
+                if not req.keys() <= _SUBMIT_ENTRY_KEYS:
+                    # Unknown kwargs must raise like submit_entry(**req)
+                    # would — hand this one (and the rest) to it.
+                    resume_at = i
+                    break
+                op = self._resolve_entry_locked(
+                    findex, dindex, pindex,
+                    req["resource"],
+                    req.get("context_name", C.CONTEXT_DEFAULT_NAME),
+                    req.get("origin", ""),
+                    req.get("acquire", 1),
+                    req.get("entry_type", C.EntryType.OUT),
+                    req.get("prio", False),
+                    req.get("ts"),
+                    tuple(req.get("args", ())),
+                )
+                if op is None:
+                    out.append(None)
+                    resume_at = i + 1
+                    continue
+                if (
+                    cluster_gids
+                    and any(gid in cluster_gids for gid, _ in op.slots)
+                ) or any(
+                    s.rule is not None and s.rule.cluster_mode for s in op.p_slots
+                ):
+                    # Token-service RPCs happen outside the lock: the
+                    # resolved op is DISCARDED (it holds no state) and
+                    # this request re-resolves through submit_entry.
+                    resume_at = i
+                    break
+                self._entries.append(op)
+                out.append(op)
+                resume_at = i + 1
+                if len(self._entries) >= self.max_batch:
+                    over = True
+                    break
+        if over:
+            self.flush()  # flush-on-size, same as submit_entry
+        # Remainder (cluster-needing request onward, or post-flush):
+        # the per-op path keeps RPC-outside-lock + flush-on-size
+        # semantics and appends in request order.
+        for req in requests[resume_at:]:
+            out.append(self.submit_entry(**req))
+        return out
 
     @staticmethod
     def _cluster_token_service():
