@@ -315,6 +315,7 @@ def _run_engine_stage(n_rules: int, n_ops: int, iters: int) -> dict:
     from sentinel_tpu.adapters.gateway import (
         GatewayFlowRule,
         GatewayParamFlowItem,
+        GatewayRequestBatch,
         GatewayRequestInfo,
         PARAM_PARSE_STRATEGY_CLIENT_IP,
         gateway_rule_manager,
@@ -336,8 +337,10 @@ def _run_engine_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         + [FlowRule(resource=route, count=1e9)]
     )
     # One columnar group per flush — the gateway batching-window shape —
-    # clamped to max_batch (submit_bulk rejects larger groups).
-    adapter_n = min(groups * bulk_n, eng.max_batch)
+    # sized just under max_batch so the explicit flush() below does the
+    # work (at exactly max_batch, flush-on-size fires inside submit and
+    # the submit/flush breakdown splits in the wrong place).
+    adapter_n = min(groups * bulk_n, eng.max_batch) - 1
     # Heavy-hitter mix (~256 requests per distinct value): same-ts
     # uniform-acquire batches take the closed-form rank path
     # (param_rounds = −1), so per-value multiplicity no longer forces
@@ -353,15 +356,34 @@ def _run_engine_stage(n_rules: int, n_ops: int, iters: int) -> dict:
     g = gateway_submit_bulk(route, infos, engine=eng)
     eng.flush()  # warm-up: interning + param-kernel compile
     assert g is not None and g.admitted is not None
+    # Timed loop with host-side breakdown: parse_ms is the per-window
+    # column extraction (the true ingest floor — one attribute read per
+    # request into a GatewayRequestBatch column), submit_ms the
+    # gateway parse + bulk enqueue, encode_ms / kernel_ms from the
+    # engine's own flush attribution.
+    t_parse = t_submit = t_encode = t_kernel = 0.0
     t0 = time.perf_counter()
     for _ in range(iters):
-        gateway_submit_bulk(route, infos, engine=eng)
+        ta = time.perf_counter()
+        batch = GatewayRequestBatch(
+            n=adapter_n, client_ip=[i.client_ip for i in infos]
+        )
+        tb = time.perf_counter()
+        gateway_submit_bulk(route, batch, engine=eng)
+        tc = time.perf_counter()
         eng.flush()
+        ft = eng.last_flush_host_ms
+        t_parse += tb - ta
+        t_submit += tc - tb
+        t_encode += ft["encode_ms"]
+        t_kernel += ft["kernel_ms"]
     dta = (time.perf_counter() - t0) / iters
     adapter_ops_per_sec = adapter_n / dta
     _log(
         f"engine adapter (gateway bulk) done: {adapter_ops_per_sec:,.0f} ops/sec"
-        f" ({adapter_ops_per_sec / bulk_ops_per_sec:.2f}x of bulk)"
+        f" ({adapter_ops_per_sec / bulk_ops_per_sec:.2f}x of bulk; "
+        f"parse {t_parse / iters * 1e3:.1f} submit {t_submit / iters * 1e3:.1f} "
+        f"encode {t_encode / iters:.1f} kernel {t_kernel / iters:.1f} ms)"
     )
 
     # Pipelined bulk: flush_async keeps up to max_inflight device
@@ -389,6 +411,12 @@ def _run_engine_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         "engine_bulk_n_ops": groups * bulk_n,
         "engine_adapter_ops_per_sec": round(adapter_ops_per_sec, 1),
         "engine_adapter_vs_bulk": round(adapter_ops_per_sec / bulk_ops_per_sec, 3),
+        # Host-side adapter breakdown (per flush, ms) — attributes the
+        # adapter-vs-bulk gap for the next TPU window.
+        "parse_ms": round(t_parse / iters * 1e3, 3),
+        "submit_ms": round(t_submit / iters * 1e3, 3),
+        "encode_ms": round(t_encode / iters, 3),
+        "kernel_ms": round(t_kernel / iters, 3),
         "engine_pipelined_ops_per_sec": round(pipe_ops_per_sec, 1),
         "engine_pipelined_flushes": n_flushes,
     }

@@ -16,6 +16,7 @@ import sentinel_tpu as st
 from sentinel_tpu.adapters.gateway import (
     GatewayFlowRule,
     GatewayParamFlowItem,
+    GatewayRequestBatch,
     GatewayRequestInfo,
     PARAM_PARSE_STRATEGY_CLIENT_IP,
     gateway_rule_manager,
@@ -57,3 +58,23 @@ assert int(adm[500:].sum()) == 100
 eng.submit_exit_bulk(group.rows, int(adm.sum()), rt=4, resource="orders_route")
 eng.flush()
 print("per-IP budgets enforced in one columnar flush — OK")
+
+# Second window, columnar ingest: a gateway that buffers its batching
+# window as COLUMNS hands them straight in (GatewayRequestBatch) —
+# zero per-request Python objects, and the chatty clients' values are
+# already interned from the first window (the persistent value cache).
+clock.advance(2000)
+batch = GatewayRequestBatch(
+    n=600,
+    client_ip=["10.0.0.1"] * 250 + ["10.0.0.2"] * 250
+    + [f"10.9.9.{i}" for i in range(100)],
+)
+group2 = gateway_submit_bulk("orders_route", batch)
+eng.flush()
+adm2 = np.asarray(group2.admitted)
+print(f"columnar window -> {int(adm2.sum())} admitted "
+      f"(encode {eng.last_flush_host_ms['encode_ms']:.2f} ms, "
+      f"kernel {eng.last_flush_host_ms['kernel_ms']:.2f} ms)")
+assert int(adm2[:250].sum()) == 3 and int(adm2[250:500].sum()) == 3
+assert int(adm2[500:].sum()) == 100
+print("columnar GatewayRequestBatch ingest — OK")

@@ -56,6 +56,23 @@ URL_MATCH_STRATEGY_REGEX = 2
 # (SentinelGatewayConstants.GATEWAY_DEFAULT_PARAM).
 GATEWAY_DEFAULT_PARAM = "$D"
 
+# Parse strategy → the request attribute/column it reads. The ONE home
+# of that mapping, shared by the per-request parser, the columnar
+# parser and the needed-columns transpose — a strategy added to only
+# one of them would silently diverge the fast and slow paths.
+_STRATEGY_FIELD = {
+    PARAM_PARSE_STRATEGY_CLIENT_IP: "client_ip",
+    PARAM_PARSE_STRATEGY_HOST: "host",
+    PARAM_PARSE_STRATEGY_HEADER: "headers",
+    PARAM_PARSE_STRATEGY_URL_PARAM: "url_params",
+    PARAM_PARSE_STRATEGY_COOKIE: "cookies",
+}
+# Strategies whose field holds per-request dicts read via field_name.
+_DICT_STRATEGIES = frozenset(
+    (PARAM_PARSE_STRATEGY_HEADER, PARAM_PARSE_STRATEGY_URL_PARAM,
+     PARAM_PARSE_STRATEGY_COOKIE)
+)
+
 
 @dataclass(frozen=True)
 class GatewayParamFlowItem:
@@ -113,6 +130,57 @@ class GatewayRequestInfo:
     headers: Dict[str, str] = field(default_factory=dict)
     url_params: Dict[str, str] = field(default_factory=dict)
     cookies: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class GatewayRequestBatch:
+    """Columnar request attributes for :func:`gateway_submit_bulk` —
+    the host-ingest fast path. Each column is a length-``n`` sequence
+    (list or numpy object array); columns the loaded rules never read
+    may stay ``None`` (their rules then pass, like an empty attribute).
+    A gateway that buffers a batching window can fill these columns
+    directly from its own row storage and skip per-request
+    ``GatewayRequestInfo`` objects entirely; parsing then touches each
+    column once instead of walking attribute-by-attribute per request.
+    """
+
+    n: int
+    client_ip: Optional[Sequence[str]] = None
+    host: Optional[Sequence[str]] = None
+    path: Optional[Sequence[str]] = None
+    headers: Optional[Sequence[Dict[str, str]]] = None
+    url_params: Optional[Sequence[Dict[str, str]]] = None
+    cookies: Optional[Sequence[Dict[str, str]]] = None
+
+    # The ONE home of the column-name set: validation and from_infos
+    # both iterate it, so a new column added here is covered by both.
+    _FIELDS = ("client_ip", "host", "path", "headers", "url_params", "cookies")
+
+    def __post_init__(self) -> None:
+        for name in self._FIELDS:
+            col = getattr(self, name)
+            if col is not None and len(col) != self.n:
+                raise ValueError(
+                    f"GatewayRequestBatch: column {name!r} has length"
+                    f" {len(col)} != n={self.n}"
+                )
+
+    @classmethod
+    def from_infos(
+        cls,
+        infos: Sequence[GatewayRequestInfo],
+        fields: Optional[Sequence[str]] = None,
+    ) -> "GatewayRequestBatch":
+        """Transpose per-request infos into columns (one pass per
+        column). ``fields`` limits the transpose to the columns a
+        caller will actually read — the rules' parse strategies, on
+        the ingest hot path. Callers that already hold columns should
+        construct the batch directly and skip the info objects."""
+        want = cls._FIELDS if fields is None else fields
+        cols = {
+            f: [getattr(i, f) for i in infos] for f in cls._FIELDS if f in want
+        }
+        return cls(n=len(infos), **cols)
 
 
 class GatewayApiDefinitionManager:
@@ -178,39 +246,92 @@ class GatewayRuleManager:
         return tuple(out)
 
     @staticmethod
-    def _parse_one(rule: GatewayFlowRule, info: GatewayRequestInfo) -> Optional[str]:
+    def _value_matcher(item: GatewayParamFlowItem):
+        """The ONE home of param-item match semantics, shared by the
+        per-request parser and the columnar parser: None when every
+        non-empty value is limited (no pattern), else a predicate.
+        A bad regex never matches, like the reference swallowing the
+        PatternSyntaxException."""
+        if not item.pattern:
+            return None
+        pat = item.pattern
+        if item.match_strategy == PARAM_MATCH_STRATEGY_PREFIX:
+            return lambda v: v.startswith(pat)
+        if item.match_strategy == PARAM_MATCH_STRATEGY_REGEX:
+            try:
+                rx = re.compile(pat)
+            except re.error:
+                return lambda v: False
+            return lambda v: rx.fullmatch(v) is not None
+        return lambda v: v == pat
+
+    @classmethod
+    def _parse_one(cls, rule: GatewayFlowRule, info: GatewayRequestInfo) -> Optional[str]:
         item = rule.param_item
         if item is None:
             # No param matching: the whole route shares one bucket.
             return GATEWAY_DEFAULT_PARAM
         ps = item.parse_strategy
-        if ps == PARAM_PARSE_STRATEGY_CLIENT_IP:
-            value = info.client_ip
-        elif ps == PARAM_PARSE_STRATEGY_HOST:
-            value = info.host
-        elif ps == PARAM_PARSE_STRATEGY_HEADER:
-            value = info.headers.get(item.field_name or "", "")
-        elif ps == PARAM_PARSE_STRATEGY_URL_PARAM:
-            value = info.url_params.get(item.field_name or "", "")
-        elif ps == PARAM_PARSE_STRATEGY_COOKIE:
-            value = info.cookies.get(item.field_name or "", "")
-        else:
+        field_name = _STRATEGY_FIELD.get(ps)
+        if field_name is None:
             value = ""
+        elif ps in _DICT_STRATEGIES:
+            value = getattr(info, field_name).get(item.field_name or "", "")
+        else:
+            value = getattr(info, field_name)
         if not value:
             return None  # nothing to limit on -> rule passes
-        if item.pattern:
-            if item.match_strategy == PARAM_MATCH_STRATEGY_PREFIX:
-                matched = value.startswith(item.pattern)
-            elif item.match_strategy == PARAM_MATCH_STRATEGY_REGEX:
-                try:
-                    matched = re.fullmatch(item.pattern, value) is not None
-                except re.error:
-                    matched = False
-            else:
-                matched = value == item.pattern
-            if not matched:
-                return None  # unmatched values are not limited
+        keep = cls._value_matcher(item)
+        if keep is not None and not keep(value):
+            return None  # unmatched values are not limited
         return value
+
+    # --- columnar GatewayParamParser (host-ingest fast path) ---
+    def parse_params_batch(self, resource: str, batch: GatewayRequestBatch):
+        """:meth:`parse_params` over a whole batch, one value column
+        per rule — the strategy dispatch and pattern compile run once
+        per rule instead of once per request. Returns an
+        :class:`~sentinel_tpu.rules.param_table.ArgsColumns` suitable
+        for ``Engine.submit_bulk``'s ``args_column``."""
+        from sentinel_tpu.rules.param_table import ArgsColumns
+
+        return ArgsColumns(
+            batch.n,
+            {
+                idx: self._parse_col(r, batch)
+                for idx, r in enumerate(self.rules_for(resource))
+            },
+        )
+
+    @classmethod
+    def _parse_col(cls, rule: GatewayFlowRule, batch: GatewayRequestBatch) -> List[Optional[str]]:
+        """One rule's per-request value column — semantics identical to
+        ``_parse_one`` per request (empty/unmatched values become None:
+        nothing to limit on, the rule passes), with the strategy
+        dispatch and matcher compile hoisted out of the request loop."""
+        n = batch.n
+        item = rule.param_item
+        if item is None:
+            # No param matching: the whole route shares one bucket.
+            return [GATEWAY_DEFAULT_PARAM] * n
+        ps = item.parse_strategy
+        field_name = _STRATEGY_FIELD.get(ps)
+        if field_name is None:
+            return [None] * n
+        col = getattr(batch, field_name)
+        if col is None:
+            return [None] * n
+        if ps in _DICT_STRATEGIES:
+            name = item.field_name or ""
+            # A None element means "this request had no headers/params/
+            # cookies" — treat like the info default {} (rule passes).
+            raw = [d.get(name, "") if d else "" for d in col]
+        else:
+            raw = col
+        keep = cls._value_matcher(item)
+        if keep is None:
+            return [v or None for v in raw]
+        return [v if v and keep(v) else None for v in raw]
 
 
 gateway_rule_manager = GatewayRuleManager()
@@ -243,7 +364,7 @@ def gateway_entry(route_id: str, info: GatewayRequestInfo):
 
 def gateway_submit_bulk(
     route_id: str,
-    infos: Sequence[GatewayRequestInfo],
+    infos,
     *,
     engine=None,
     ts=None,
@@ -251,12 +372,19 @@ def gateway_submit_bulk(
     """Columnar gateway admission — the adapter fast path onto
     :meth:`Engine.submit_bulk`.
 
-    Parses each request's gateway params (GatewayParamParser, host
-    side) into one args column and submits the whole batch as a single
+    Parses the batch's gateway params (GatewayParamParser, host side)
+    into per-rule value columns and submits the whole batch as a single
     bulk group: one slot resolution for the route, per-value interning
-    once per distinct value, array verdicts after ``flush()``. Three
-    orders of magnitude less per-request Python than ``gateway_entry``
-    (no Entry objects, no context, no per-request engine lock).
+    once per distinct value (persistently cached across flushes), array
+    verdicts after ``flush()``. Three orders of magnitude less
+    per-request Python than ``gateway_entry`` (no Entry objects, no
+    context, no per-request engine lock).
+
+    ``infos`` is either a ``Sequence[GatewayRequestInfo]`` (the
+    original signature) or a :class:`GatewayRequestBatch` of columns —
+    the columnar form skips every per-request attribute walk: the
+    fast-attr case (single rule on client IP / host, no pattern)
+    becomes one vectorized column view with no tuple allocation at all.
 
     Scope (the high-throughput subset): route-level rules only — custom
     ApiDefinition resources, THREAD-grade and cluster-mode rules stay
@@ -266,7 +394,11 @@ def gateway_submit_bulk(
     after ``flush()``. Callers account completions with
     ``submit_exit_bulk`` like any bulk group.
     """
+    from sentinel_tpu.rules.param_table import ArgsColumns
+
     eng = engine if engine is not None else api.get_engine()
+    is_batch = isinstance(infos, GatewayRequestBatch)
+    n = infos.n if is_batch else len(infos)
     # Single-rule direct-attribute strategies (client IP / host, no
     # pattern) skip the per-request parser walk — the common gateway
     # config, and the host-side hot loop at bulk sizes.
@@ -278,15 +410,34 @@ def gateway_submit_bulk(
             fast_attr = "client_ip"
         elif ps == PARAM_PARSE_STRATEGY_HOST:
             fast_attr = "host"
-    if fast_attr is not None:
-        args_column = [(getattr(info, fast_attr) or None,) for info in infos]
+    if is_batch:
+        if fast_attr is not None:
+            raw = getattr(infos, fast_attr)
+            col = [None] * n if raw is None else [v or None for v in raw]
+            args_column = ArgsColumns(n, {0: col})
+        else:
+            args_column = gateway_rule_manager.parse_params_batch(route_id, infos)
+    elif fast_attr is not None:
+        # Tuple-free fast-attr column straight off the info objects.
+        args_column = ArgsColumns(
+            n, {0: [getattr(info, fast_attr) or None for info in infos]}
+        )
     else:
-        args_column = [
-            gateway_rule_manager.parse_params(route_id, info) for info in infos
-        ]
+        # Generic rules: transpose the infos (only the columns the
+        # route's strategies read) and run the columnar parser — same
+        # ArgsColumns path and parse semantics as the batch form.
+        need = {
+            f
+            for r in rules
+            if r.param_item is not None
+            and (f := _STRATEGY_FIELD.get(r.param_item.parse_strategy))
+        }
+        args_column = gateway_rule_manager.parse_params_batch(
+            route_id, GatewayRequestBatch.from_infos(infos, fields=need)
+        )
     return eng.submit_bulk(
         route_id,
-        len(infos),
+        n,
         ts=ts,
         entry_type=C.EntryType.IN,
         args_column=args_column,

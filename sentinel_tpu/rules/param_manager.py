@@ -46,6 +46,11 @@ class ParamFlowRuleManager(RuleManager[ParamFlowRule]):
         return bool(self._rules or self._gateway_rules)
 
     def _apply(self, rules: List[ParamFlowRule], engine) -> None:
+        # engine.set_param_rules builds a FRESH ParamIndex: every
+        # value→prow interning (and the host-ingest resolved-value
+        # cache riding it) is invalidated here, exactly like the
+        # reference clearing ParameterMetric on reload — a reload must
+        # never serve stale prow mappings to in-flight traffic.
         by_res: Dict[str, List[ParamFlowRule]] = {}
         for r in list(rules) + self._gateway_rules:
             if r.is_valid():

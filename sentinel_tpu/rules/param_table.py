@@ -41,12 +41,53 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.models.rules import ParamFlowRule
+from sentinel_tpu.utils.config import config
 from sentinel_tpu.utils.record_log import record_log
 
 PARAM_NEVER = -(2**30)  # "no state yet" sentinel for last_add/latest
+
+# Cache-miss marker for the resolved-value fast path (identity compare
+# only — never equal to a real (prow, tc, cost) triple).
+_MISS = object()
+_NO_TRIP = (0, 0, 0)
+
+
+class ArgsColumns:
+    """Columnar args for ``Engine.submit_bulk``: one value column per
+    ``param_idx``, equivalent to a length-``n`` column of args tuples
+    ``t`` with ``t[idx] = by_idx[idx][j]`` — but with no per-request
+    tuple allocation (the gateway fast-attr path hands its client-IP /
+    host column straight through). A ``param_idx`` absent from
+    ``by_idx`` means "no value for that rule" (the rule passes), like a
+    too-short args tuple."""
+
+    __slots__ = ("n", "by_idx")
+
+    def __init__(self, n: int, by_idx: Dict[int, Sequence[object]]) -> None:
+        self.n = int(n)
+        for idx, col in by_idx.items():
+            if len(col) != self.n:
+                raise ValueError(
+                    f"ArgsColumns: column for param_idx {idx} has length"
+                    f" {len(col)} != n={self.n}"
+                )
+        self.by_idx = by_idx
+
+    def __len__(self) -> int:
+        return self.n
+
+
+def _extract_arg(args: object, idx: int) -> object:
+    """One entry's value for ``param_idx`` from its args (tuple/list) —
+    a bare scalar arg behaves like a 1-tuple, matching the old
+    normalization ``a if isinstance(a, (list, tuple)) else (a,)``."""
+    if isinstance(args, (list, tuple)):
+        return args[idx] if idx < len(args) else None
+    return args if idx == 0 else None
 
 
 class ParamDynState(NamedTuple):
@@ -332,6 +373,18 @@ class ParamIndex:
             self.by_resource[res] = lst
         # (gid) -> {value_key -> prow}; LRU by insertion-move.
         self._values: List[Dict[str, int]] = [dict() for _ in self.rules]
+        # Persistent per-rule resolved-value cache: value_key ->
+        # (prow, token_count, cost_ms). Heavy-hitter values resolve to
+        # one dict get per request instead of paying np.unique +
+        # interning on every flush (the host-ingest fast path). Lives
+        # and dies with this ParamIndex, so a param-rule reload (which
+        # rebuilds the index) invalidates it wholesale; an LRU eviction
+        # drops the evicted key (see _intern). Gated by the
+        # sentinel.tpu.host.fastpath config switch.
+        self._resolved: List[Dict[str, Tuple[int, int, int]]] = [
+            dict() for _ in self.rules
+        ]
+        self._use_value_cache = config.get_bool(config.HOST_FASTPATH, True)
         self._hot: List[Dict[str, int]] = [
             {it.object: int(it.count) for it in r.param_flow_item_list} for r in self.rules
         ]
@@ -361,6 +414,9 @@ class ParamIndex:
         if len(vals) >= self._caps[gid]:
             old_key = next(iter(vals))
             old_row = vals.pop(old_key)
+            # The recycled row now means a different value: the
+            # resolved-value cache must never serve the old mapping.
+            self._resolved[gid].pop(old_key, None)
             self.pending_resets.append(old_row)
             row = old_row
         elif self._free_rows:
@@ -398,13 +454,9 @@ class ParamIndex:
                 key = self._value_key(v)
                 if key is None:
                     continue
-                tc = self._hot[gid].get(key, int(r.count))
-                cost = 0
-                if r.control_behavior == C.CONTROL_BEHAVIOR_RATE_LIMITER and tc > 0:
-                    # Math.round(1.0*1000*acquire*durationSec/tokenCount)
-                    # for acquire=1; recomputed host-side per acquire at
-                    # submit if needed (acquire==1 is the API default).
-                    cost = int(1000.0 * r.duration_in_sec / tc + 0.5)
+                # acquire==1 cost (the API default); recomputed
+                # host-side per acquire at submit if needed.
+                tc, cost = self._threshold_and_cost(gid, r, key)
                 out.append(
                     ParamSlotInfo(
                         prow=self._intern(gid, key),
@@ -426,59 +478,192 @@ class ParamIndex:
                     return out
         return out
 
+    def _threshold_and_cost(self, gid: int, r: ParamFlowRule, key: str) -> Tuple[int, int]:
+        """Hot-item-resolved threshold + rate-limiter cost for one
+        value key — the ONE home of the cost formula
+        (Math.round(1.0*1000*durationSec/count) for acquire=1); every
+        resolution path (slots_for, cached, exact) must go through it
+        or the fast path desynchronizes from its differential
+        reference."""
+        tc = self._hot[gid].get(key, int(r.count))
+        cost = (
+            int(1000.0 * r.duration_in_sec / tc + 0.5)
+            if r.control_behavior == C.CONTROL_BEHAVIOR_RATE_LIMITER and tc > 0
+            else 0
+        )
+        return tc, cost
+
+    def _resolve_value(self, gid: int, r: ParamFlowRule, key: str) -> Tuple[int, int, int]:
+        """Intern + threshold/cost resolution for one value key, cached
+        persistently (the per-rule resolved-value cache). tc and cost
+        are static per (rule, key), so a cached triple stays valid
+        until the key's row is LRU-evicted or the index is rebuilt."""
+        tc, cost = self._threshold_and_cost(gid, r, key)
+        trip = (self._intern(gid, key), tc, cost)
+        self._resolved[gid][key] = trip
+        return trip
+
+    def _resolve_value_col(
+        self, gid: int, r: ParamFlowRule, values: Optional[Sequence[object]], n: int
+    ) -> Optional[Tuple["np.ndarray", "np.ndarray", "np.ndarray", "np.ndarray"]]:
+        """Resolve one rule's per-entry value column to
+        ``(valid[n], prow[n], token_count[n], cost_ms[n])``. Returns
+        None when a value is a collection (per-entry expansion doesn't
+        fit fixed columns) — callers fall back to the per-entry path.
+
+        Fast path (config ``sentinel.tpu.host.fastpath``, default on):
+        one dict get per request against the persistent resolved-value
+        cache; misses (and non-string values) resolve once and stay
+        cached. LRU recency is maintained EXACTLY like the exact path:
+        at column end, this column's distinct keys are re-touched in
+        sorted order — the same per-flush per-sorted-unique ordering
+        the np.unique path produces — so the two paths' intern tables
+        evolve identically and eviction picks the same victims
+        (verdict bit-identity holds through eviction pressure, not
+        just below the cap). A column whose misses would cross the cap
+        (the next intern would evict, possibly a key already resolved
+        from the cache in pass 1 — its prow would alias a reset row)
+        restarts wholesale on the exact path — so at the cap, all-hit
+        heavy-hitter columns keep the one-dict-get win and only
+        columns introducing NEW values pay the exact rerun.
+
+        Exact path (fast path off, or a column whose first evicting
+        intern restarts it): np.unique interning per flush — also the
+        differential reference for the smoke test."""
+        if values is None:
+            z = np.zeros(n, dtype=np.int32)
+            return np.zeros(n, dtype=bool), z, z.copy(), z.copy()
+        if self._use_value_cache:
+            rget = self._resolved[gid].get
+            miss = _MISS
+            # Pass 1: interned string values (the hot shape) resolve in
+            # one C-level comprehension of dict gets.
+            trips = [rget(v, miss) if type(v) is str else miss for v in values]
+            # Pass 2: fix misses in place — list.index scans at C speed,
+            # so all-hit columns pay one scan and zero Python-level
+            # iterations here.
+            vals = self._values[gid]
+            cap = self._caps[gid]
+            extra_keys: List[str] = []  # pass-2 keys (non-str forms too)
+            j = 0
+            while True:
+                try:
+                    j = trips.index(miss, j)
+                except ValueError:
+                    break
+                v = values[j]
+                if isinstance(v, (list, tuple, set, frozenset)):
+                    return None  # collection expansion → per-entry path
+                key = self._value_key(v)
+                if key is None:
+                    trips[j] = None
+                else:
+                    trip = rget(key)
+                    if trip is None:
+                        # A key already interned (e.g. via a past exact
+                        # rerun or the per-entry slots_for path) only
+                        # lacks its cache triple — resolving it touches,
+                        # never evicts, so it is safe at the cap too.
+                        if key not in vals and len(vals) >= cap:
+                            # A genuinely NEW key whose intern would
+                            # evict: restart on the exact path BEFORE
+                            # any eviction can happen (misses so far
+                            # only inserted below the cap).
+                            return self._resolve_value_col_exact(
+                                gid, r, values, n
+                            )
+                        trip = self._resolve_value(gid, r, key)
+                    extra_keys.append(key)
+                    trips[j] = trip
+                j += 1
+            # Recency parity with the exact path: touch this column's
+            # distinct keys in SORTED order — the same per-flush
+            # per-sorted-unique sequence np.unique/_intern produces —
+            # so both paths' intern tables evolve identically and
+            # eviction later picks identical victims. Cache-hit string
+            # values ARE their keys; pass-2 resolutions contribute
+            # their computed keys. (Comprehension, not set(values):
+            # the type filter must run before hashing — an unhashable
+            # non-collection value, e.g. a dict, is a legal arg.)
+            touch = {v for v in values if type(v) is str}
+            touch.update(extra_keys)
+            vals_pop = vals.pop
+            for key in sorted(touch):
+                row = vals_pop(key, None)
+                if row is not None:
+                    vals[key] = row
+            valid = np.fromiter((t is not None for t in trips), dtype=bool, count=n)
+            if valid.all():
+                arr = np.array(trips, dtype=np.int32).reshape(n, 3)
+            else:
+                arr = np.array(
+                    [t if t is not None else _NO_TRIP for t in trips],
+                    dtype=np.int32,
+                ).reshape(n, 3)
+            return valid, arr[:, 0], arr[:, 1], arr[:, 2]
+        return self._resolve_value_col_exact(gid, r, values, n)
+
+    def _resolve_value_col_exact(
+        self, gid: int, r: ParamFlowRule, values: Sequence[object], n: int
+    ) -> Optional[Tuple["np.ndarray", "np.ndarray", "np.ndarray", "np.ndarray"]]:
+        """The exact path: per-flush np.unique interning with an LRU
+        touch per distinct value (heavy hitters stay resident under
+        eviction pressure). Also the fastpath-off differential
+        reference."""
+        col: List[Optional[str]] = [None] * n
+        for j, v in enumerate(values):
+            if v is None:
+                continue
+            if isinstance(v, (list, tuple, set, frozenset)):
+                return None
+            col[j] = self._value_key(v)
+        arr_o = np.asarray(col, dtype=object)
+        valid = np.asarray([c is not None for c in col], dtype=bool)
+        prow = np.zeros(n, dtype=np.int32)
+        tc = np.zeros(n, dtype=np.int32)
+        cost = np.zeros(n, dtype=np.int32)
+        if valid.any():
+            uniq, inverse = np.unique(arr_o[valid].astype(str), return_inverse=True)
+            u_prow = np.empty(len(uniq), dtype=np.int32)
+            u_tc = np.empty(len(uniq), dtype=np.int32)
+            u_cost = np.empty(len(uniq), dtype=np.int32)
+            for u, key in enumerate(uniq):
+                u_prow[u] = self._intern(gid, key)
+                u_tc[u], u_cost[u] = self._threshold_and_cost(gid, r, key)
+            prow[valid] = u_prow[inverse]
+            tc[valid] = u_tc[inverse]
+            cost[valid] = u_cost[inverse]
+        return valid, prow, tc, cost
+
     def bulk_cols(
-        self, resource: str, args_column: Sequence[Sequence[object]]
+        self, resource: str, args_column: Sequence
     ) -> Optional[List[Tuple[ParamFlowRule, "np.ndarray", "np.ndarray", "np.ndarray", "np.ndarray"]]]:
         """Columnar ``slots_for`` over a whole bulk group: one
         ``(rule, valid[n], prow[n], token_count[n], cost_ms[n])`` tuple
-        per param rule on the resource. Distinct values resolve (and
-        LRU-intern) ONCE via np.unique; every request's row/threshold
-        is then a vectorized gather — O(distinct) Python instead of
-        O(requests). Returns None when a value is a collection
-        (per-entry expansion doesn't fit fixed columns) — callers fall
-        back to the per-entry path."""
-        import numpy as np
-
+        per param rule on the resource. ``args_column`` is either a
+        sequence of per-entry args tuples, or an :class:`ArgsColumns`
+        (pre-split value columns — no per-request tuple walk at all).
+        Returns None when a value is a collection (per-entry expansion
+        doesn't fit fixed columns) — callers fall back to the per-entry
+        path."""
         rules = self.by_resource.get(resource, ())
         if not rules:
             return []
         n = len(args_column)
+        flat = isinstance(args_column, ArgsColumns)
         out = []
         for gid, r in rules:
             idx = r.param_idx
-            col: List[Optional[str]] = [None] * n
-            for j, args_j in enumerate(args_column):
-                if idx is None or idx >= len(args_j):
-                    continue
-                v = args_j[idx]
-                if isinstance(v, (list, tuple, set, frozenset)):
-                    return None  # collection expansion → per-entry path
-                col[j] = self._value_key(v)
-            arr = np.asarray(col, dtype=object)
-            valid = np.asarray([c is not None for c in col], dtype=bool)
-            prow = np.zeros(n, dtype=np.int32)
-            tc = np.zeros(n, dtype=np.int32)
-            cost = np.zeros(n, dtype=np.int32)
-            if valid.any():
-                uniq, inverse = np.unique(arr[valid].astype(str), return_inverse=True)
-                u_prow = np.empty(len(uniq), dtype=np.int32)
-                u_tc = np.empty(len(uniq), dtype=np.int32)
-                u_cost = np.empty(len(uniq), dtype=np.int32)
-                hot = self._hot[gid]
-                throttled = r.control_behavior == C.CONTROL_BEHAVIOR_RATE_LIMITER
-                for u, key in enumerate(uniq):
-                    u_prow[u] = self._intern(gid, key)
-                    t = hot.get(key, int(r.count))
-                    u_tc[u] = t
-                    u_cost[u] = (
-                        int(1000.0 * r.duration_in_sec / t + 0.5)
-                        if throttled and t > 0
-                        else 0
-                    )
-                prow[valid] = u_prow[inverse]
-                tc[valid] = u_tc[inverse]
-                cost[valid] = u_cost[inverse]
-            out.append((r, valid, prow, tc, cost))
+            if idx is None:
+                values: Optional[Sequence[object]] = None
+            elif flat:
+                values = args_column.by_idx.get(idx)
+            else:
+                values = [_extract_arg(a, idx) for a in args_column]
+            cols = self._resolve_value_col(gid, r, values, n)
+            if cols is None:
+                return None
+            out.append((r,) + cols)
         return out
 
     def take_resets(self) -> List[int]:

@@ -32,7 +32,8 @@ with a device round-trip (lock order: ``_flush_lock`` → ``_lock``).
 from __future__ import annotations
 
 import threading
-from collections import deque
+import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -57,6 +58,7 @@ from sentinel_tpu.rules.flow_table import FlowIndex, FlowRuleDynState
 from sentinel_tpu.models.rules import AuthorityRule, DegradeRule, ParamFlowRule
 from sentinel_tpu.rules.degrade_table import DegradeDynState, DegradeIndex
 from sentinel_tpu.rules.param_table import (
+    ArgsColumns,
     ParamBatch,
     ParamDynState,
     ParamIndex,
@@ -102,15 +104,24 @@ class _PendingFetch:
     lock held (concurrent dispatchers must not stall behind a fetch),
     and re-entrant materialization from a callback is a no-op."""
 
-    __slots__ = ("_engine", "_entries", "_fetch", "_done", "_error", "_lock")
+    __slots__ = (
+        "_engine", "_entries", "_fetch", "_done", "_error", "_lock",
+        "_staging",
+    )
 
-    def __init__(self, engine: "Engine", entries: List["_EntryOp"], fetch) -> None:
+    def __init__(
+        self, engine: "Engine", entries: List["_EntryOp"], fetch,
+        staging: Optional[List[tuple]] = None,
+    ) -> None:
         self._engine = engine
         self._entries = entries
         self._fetch = fetch  # () -> blocked_items; runs the device_get
         self._done = False
         self._error: Optional[BaseException] = None
         self._lock = threading.RLock()
+        # Arena staging buffers held until the fetch completes (the
+        # dispatched computation may read them zero-copy until then).
+        self._staging = staging or []
 
     def materialize(self) -> None:
         """Fetch + verdict fill + post work, exactly once. A failed
@@ -128,6 +139,18 @@ class _PendingFetch:
                 finally:
                     self._fetch = None
                     self._done = True
+                    # Staging returns to the arena only after a
+                    # SUCCESSFUL fetch (which proves the computation
+                    # consumed its possibly-zero-copy inputs); a
+                    # failed/interrupted fetch drops it to GC — the
+                    # computation may still be running.
+                    staging, self._staging = self._staging, []
+                    if (
+                        staging
+                        and self._error is None
+                        and self._engine._arena is not None
+                    ):
+                        self._engine._arena.give_all(staging)
                 entries, self._entries = self._entries, []
                 if self._error is None:
                     # Post-work failures (log IO, release RPCs) surface
@@ -378,6 +401,65 @@ def release_cluster_tokens(tokens: Sequence[Tuple[object, int]]) -> None:
             record_log.warn("[Engine] release of cluster token %d failed", token_id)
 
 
+class _EncodeArena:
+    """Reusable host staging buffers for the chunk encode, keyed by
+    padded shape — ``_run_chunk`` and ``_encode_param`` rebuild ~25
+    pow2-padded numpy arrays per flush, and at steady state the shapes
+    repeat, so fresh-allocation page faults dominate the encode.
+
+    Lifecycle safety: ``jnp.asarray`` may be ZERO-COPY on CPU backends
+    (a 64-byte-aligned numpy buffer becomes the device buffer itself —
+    alignment-dependent, so it cannot be probed away), which means a
+    staging buffer must never be mutated while a dispatched computation
+    might still read it. Buffers therefore return to the pool only
+    AFTER the chunk's device→host result fetch completes SUCCESSFULLY
+    (sync: end of ``_fill_results``; deferred: at ``_PendingFetch``
+    materialization) — ``jax.device_get`` of the results blocks until
+    the computation that read the inputs has finished. A failed or
+    interrupted fetch proves nothing, so its staging is dropped to GC
+    instead of pooled. Until then the next chunk's
+    ``take()`` simply builds fresh buffers (bounded by max_inflight).
+    Returned verdict arrays are always fresh copies, never views of
+    staging or fetch buffers. Bounded to the MAX_KEYS most recent
+    shape keys (and PER_KEY sets each) so a shape change retires old
+    buffers instead of accumulating them. give() may run from a
+    drain thread, hence the lock."""
+
+    MAX_KEYS = 8
+    PER_KEY = 4
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pool: "OrderedDict[tuple, List[tuple]]" = OrderedDict()
+
+    def take(self, key: tuple, build):
+        """Buffers for ``key``: pooled, or freshly built via
+        ``build()``. The caller owns them (and must reset fills — a
+        pooled buffer holds a previous chunk's data) until give()."""
+        with self._lock:
+            sets = self._pool.get(key)
+            if sets:
+                return sets.pop()
+        return build()
+
+    def give(self, key: tuple, bufs: tuple) -> None:
+        """Return buffers once the chunk's results have been fetched
+        (i.e. the computation that may alias them has completed)."""
+        with self._lock:
+            sets = self._pool.get(key)
+            if sets is None:
+                sets = self._pool[key] = []
+            self._pool.move_to_end(key)
+            if len(sets) < self.PER_KEY:
+                sets.append(bufs)
+            while len(self._pool) > self.MAX_KEYS:
+                self._pool.popitem(last=False)
+
+    def give_all(self, staging: List[Tuple[tuple, tuple]]) -> None:
+        for key, bufs in staging:
+            self.give(key, bufs)
+
+
 class Engine:
     """Owns device state + host indexes; thread-safe op submission."""
 
@@ -426,6 +508,17 @@ class Engine:
         # holding _lock (fixed order _flush_lock → _lock).
         self._flush_lock = threading.RLock()
         self.max_batch = config.get_int(config.FLUSH_MAX_BATCH, 131072)
+        # Host-ingest fast path: the encode-buffer arena (None when
+        # sentinel.tpu.host.fastpath is off — every flush then builds
+        # fresh staging arrays, the differential-smoke reference).
+        self._arena: Optional[_EncodeArena] = (
+            _EncodeArena() if config.get_bool(config.HOST_FASTPATH, True) else None
+        )
+        # Host-side breakdown of the most recent flush (diagnostics /
+        # bench attribution): encode_ms is staging-array build time,
+        # kernel_ms is dispatch + device→host fetch. Written under
+        # _flush_lock; readers get a snapshot via last_flush_host_ms.
+        self._flush_timing = {"encode_ms": 0.0, "kernel_ms": 0.0}
         # Deferred fetches from flush_async, oldest first. Lock order:
         # _flush_lock → _pending_lock; nothing under _pending_lock takes
         # another engine lock. RLock: a fetch closure reading a lazy
@@ -1077,10 +1170,9 @@ class Engine:
         assignment is a numpy gather). QPS grade only: THREAD-grade
         needs per-entry exit bookkeeping, cluster-mode needs a token RPC
         per entry, and collection values need per-entry expansion — all
-        three raise toward :meth:`submit_many`."""
-        norm = [
-            a if isinstance(a, (list, tuple)) else (a,) for a in args_column
-        ]
+        three raise toward :meth:`submit_many`. ``args_column`` is
+        either per-entry args tuples or an :class:`ArgsColumns` of
+        pre-split value columns (the tuple-free adapter path)."""
         for _, r in pindex.by_resource.get(resource, ()):
             if r.grade == C.FLOW_GRADE_THREAD:
                 raise ValueError(
@@ -1092,7 +1184,7 @@ class Engine:
                     "submit_bulk: resource has cluster-mode param rules"
                     " (the token-service RPC is per entry) — use submit_many"
                 )
-        cols = pindex.bulk_cols(resource, norm)
+        cols = pindex.bulk_cols(resource, args_column)
         if cols is None:
             raise ValueError(
                 "submit_bulk: collection param values expand per entry —"
@@ -1362,6 +1454,7 @@ class Engine:
         exits: List[_ExitOp],
         pindex: ParamIndex,
         bulk: Sequence[BulkOp] = (),
+        staging: Optional[List[Tuple[tuple, tuple]]] = None,
     ) -> Tuple[Optional[ParamBatch], int]:
         """Encode hot-param slots plus the host-known rounds bound (max
         items per value row, pow2-bucketed; 0 → scan fallback). Bulk
@@ -1391,18 +1484,37 @@ class Engine:
         s = _pad_pow2(max(1, n_items), 8)
         sx = _pad_pow2(max(1, len(exit_rows)), 8)
         q = _pad_pow2(max(1, len(resets)), 8)
-        valid = np.zeros(s, dtype=bool)
-        prow = np.zeros(s, dtype=np.int32)
-        eidx = np.zeros(s, dtype=np.int32)
-        ts = np.zeros(s, dtype=np.int32)
-        acquire = np.ones(s, dtype=np.int32)
-        grade = np.zeros(s, dtype=np.int32)
-        behavior = np.zeros(s, dtype=np.int32)
-        token_count = np.zeros(s, dtype=np.int32)
-        burst = np.zeros(s, dtype=np.int32)
-        duration_ms = np.ones(s, dtype=np.int32)
-        maxq = np.zeros(s, dtype=np.int32)
-        cost_ms = np.zeros(s, dtype=np.int32)
+        pkey = ("p", s, sx, q)
+
+        def _build_p():
+            # One np.empty per unpacked name below, same order — valid,
+            # prow, eidx, ts, acquire, grade, behavior, token_count,
+            # burst, duration_ms, maxq, cost_ms, xr, rs.
+            return (
+                np.empty(s, dtype=bool), np.empty(s, dtype=np.int32),
+                np.empty(s, dtype=np.int32), np.empty(s, dtype=np.int32),
+                np.empty(s, dtype=np.int32), np.empty(s, dtype=np.int32),
+                np.empty(s, dtype=np.int32), np.empty(s, dtype=np.int32),
+                np.empty(s, dtype=np.int32), np.empty(s, dtype=np.int32),
+                np.empty(s, dtype=np.int32), np.empty(s, dtype=np.int32),
+                np.empty(sx, dtype=np.int32), np.empty(q, dtype=np.int32),
+            )
+
+        pbufs = self._arena.take(pkey, _build_p) if self._arena else _build_p()
+        (valid, prow, eidx, ts, acquire, grade, behavior, token_count,
+         burst, duration_ms, maxq, cost_ms, xr, rs) = pbufs
+        valid.fill(False)
+        prow.fill(0)
+        eidx.fill(0)
+        ts.fill(0)
+        acquire.fill(1)
+        grade.fill(0)
+        behavior.fill(0)
+        token_count.fill(0)
+        burst.fill(0)
+        duration_ms.fill(1)
+        maxq.fill(0)
+        cost_ms.fill(0)
         for a, (i, t, acq, ps) in enumerate(items):
             valid[a] = True
             prow[a] = ps.prow
@@ -1437,11 +1549,11 @@ class Engine:
             maxq[sl] = int(r.max_queueing_time_ms)
             cost_ms[sl] = pc.cost_ms[m]
             a += cnt
-        xr = np.full(sx, -1, dtype=np.int32)
+        xr.fill(-1)
         xr[: len(exit_rows)] = exit_rows
-        rs = np.full(q, -1, dtype=np.int32)
+        rs.fill(-1)
         rs[: len(resets)] = resets
-        return ParamBatch(
+        pb = ParamBatch(
             valid=jnp.asarray(valid),
             prow=jnp.asarray(prow),
             eidx=jnp.asarray(eidx),
@@ -1456,10 +1568,16 @@ class Engine:
             cost_ms=jnp.asarray(cost_ms),
             reset_rows=jnp.asarray(rs),
             exit_rows=jnp.asarray(xr),
-        ), self._param_rounds_for(
+        )
+        rounds = self._param_rounds_for(
             prow[:n_items], grade[:n_items], behavior[:n_items],
             ts[:n_items], acquire[:n_items],
         )
+        # Pool return is deferred to the caller's post-fetch give_all —
+        # the ParamBatch may alias these buffers zero-copy.
+        if self._arena is not None and staging is not None:
+            staging.append((pkey, pbufs))
+        return pb, rounds
 
     @staticmethod
     def _param_rounds_for(prow, grade, behavior, ts, acquire) -> int:
@@ -1576,6 +1694,16 @@ class Engine:
         so no separate drain step is needed."""
         self.stop_auto_flush()
         self.flush()
+
+    @property
+    def last_flush_host_ms(self) -> Dict[str, float]:
+        """Host-side breakdown of the most recent flush:
+        ``encode_ms`` (staging-array build, incl. shaping/param
+        encode) and ``kernel_ms`` (dispatch + device→host fetch; a
+        ``flush_async`` flush counts dispatch only until its fetch
+        materializes). Diagnostics for bench attribution — a snapshot
+        copy, safe to hold across later flushes."""
+        return dict(self._flush_timing)
 
     def flush(self) -> List[_EntryOp]:
         """Encode + run the kernel for all pending ops; fills verdicts.
@@ -1714,7 +1842,12 @@ class Engine:
             self._bulk_pending_n = 0
             self._bulk_exit_pending_n = 0
             if not entries and not exits and not bulk_e and not bulk_x:
+                # An empty flush keeps the previous breakdown — a
+                # flush-on-size inside submit followed by an explicit
+                # no-op flush() must not zero the numbers just taken.
                 return out
+            # Fresh host-side breakdown for this flush (chunks accumulate).
+            self._flush_timing = {"encode_ms": 0.0, "kernel_ms": 0.0}
             self._ensure_capacity()
             findex = self.flow_index
             dindex = self.degrade_index
@@ -1938,6 +2071,7 @@ class Engine:
                         g.custom_veto_mask = np.isin(g.acquire, vetoed_vals)
         # Pow2 padding is shard-divisible on any power-of-two mesh once
         # raised to at least n_shards (enable_mesh enforces pow2).
+        t_enc0 = time.perf_counter()
         n_bulk = sum(g.n for g in bulk)
         m_bulk = sum(g.n for g in bulk_exits)
         n = max(_pad_pow2(len(entries) + n_bulk, 8), self._n_shards)
@@ -1961,16 +2095,35 @@ class Engine:
             1,
         )
 
-        e_valid = np.zeros(n, dtype=bool)
-        e_ts = np.zeros(n, dtype=np.int32)
-        e_acquire = np.ones(n, dtype=np.int32)
-        e_rows = np.full((n, 4), -1, dtype=np.int32)
-        e_gid = np.full((n, k), -1, dtype=np.int32)
-        e_crow = np.full((n, k), -1, dtype=np.int32)
-        e_prio = np.zeros(n, dtype=bool)
-        e_auth = np.ones(n, dtype=bool)
-        e_cluster = np.ones(n, dtype=bool)
-        e_dgid = np.full((n, kd), -1, dtype=np.int32)
+        # Entry staging buffers ride the arena (reused across flushes
+        # for repeated (n, k, kd) shapes — the steady state); pooled
+        # buffers hold the previous chunk's data, so every field is
+        # reset to its encode default here, exactly what the fresh
+        # np.zeros/np.full builds used to produce.
+        ekey = ("e", n, k, kd)
+
+        def _build_e():
+            return (
+                np.empty(n, dtype=bool), np.empty(n, dtype=np.int32),
+                np.empty(n, dtype=np.int32), np.empty((n, 4), dtype=np.int32),
+                np.empty((n, k), dtype=np.int32), np.empty((n, k), dtype=np.int32),
+                np.empty(n, dtype=bool), np.empty(n, dtype=bool),
+                np.empty(n, dtype=bool), np.empty((n, kd), dtype=np.int32),
+            )
+
+        ebufs = self._arena.take(ekey, _build_e) if self._arena else _build_e()
+        (e_valid, e_ts, e_acquire, e_rows, e_gid, e_crow, e_prio, e_auth,
+         e_cluster, e_dgid) = ebufs
+        e_valid.fill(False)
+        e_ts.fill(0)
+        e_acquire.fill(1)
+        e_rows.fill(-1)
+        e_gid.fill(-1)
+        e_crow.fill(-1)
+        e_prio.fill(False)
+        e_auth.fill(True)
+        e_cluster.fill(True)
+        e_dgid.fill(-1)
         ne = len(entries)
         if ne:
             # Flat fields fill via one C-level assignment per column
@@ -2009,14 +2162,26 @@ class Engine:
                 e_auth[sl] = g.auth_ok
             off_b += g.n
 
-        x_valid = np.zeros(m, dtype=bool)
-        x_ts = np.zeros(m, dtype=np.int32)
-        x_count = np.zeros(m, dtype=np.int32)
-        x_rows = np.full((m, 4), -1, dtype=np.int32)
-        x_rt = np.zeros(m, dtype=np.int32)
-        x_err = np.zeros(m, dtype=np.int32)
-        x_thr = np.zeros(m, dtype=np.int32)
-        x_dgid = np.full((m, kd), -1, dtype=np.int32)
+        xkey = ("x", m, kd)
+
+        def _build_x():
+            return (
+                np.empty(m, dtype=bool), np.empty(m, dtype=np.int32),
+                np.empty(m, dtype=np.int32), np.empty((m, 4), dtype=np.int32),
+                np.empty(m, dtype=np.int32), np.empty(m, dtype=np.int32),
+                np.empty(m, dtype=np.int32), np.empty((m, kd), dtype=np.int32),
+            )
+
+        xbufs = self._arena.take(xkey, _build_x) if self._arena else _build_x()
+        x_valid, x_ts, x_count, x_rows, x_rt, x_err, x_thr, x_dgid = xbufs
+        x_valid.fill(False)
+        x_ts.fill(0)
+        x_count.fill(0)
+        x_rows.fill(-1)
+        x_rt.fill(0)
+        x_err.fill(0)
+        x_thr.fill(0)
+        x_dgid.fill(-1)
         nx = len(exits)
         if nx:
             x_valid[:nx] = True
@@ -2064,10 +2229,17 @@ class Engine:
             x_thr=jnp.asarray(x_thr),
             x_dgid=jnp.asarray(x_dgid),
         )
+        # Staging buffers go back to the arena only after this chunk's
+        # results are fetched — jnp.asarray may have zero-copied them
+        # into the dispatched computation (see _EncodeArena).
+        staging: List[Tuple[tuple, tuple]] = []
+        if self._arena is not None:
+            staging.append((ekey, ebufs))
+            staging.append((xkey, xbufs))
 
         sysdev = self._system_device()
         shaping, sh_rounds = self._encode_shaping(entries, bulk, k, findex)
-        param, p_rounds = self._encode_param(entries, exits, pindex, bulk)
+        param, p_rounds = self._encode_param(entries, exits, pindex, bulk, staging)
         occ_ms = config.occupy_timeout_ms
         common = (
             self.stats,
@@ -2094,6 +2266,8 @@ class Engine:
             # change) cannot hit a stale-constant entry.
             win_key=_ncfg.SECOND_CFG,
         )
+        t_disp0 = time.perf_counter()
+        self._flush_timing["encode_ms"] += (t_disp0 - t_enc0) * 1e3
         if self._sharded_fns is not None:
             # Mesh mode: one global batch sharded over the chips;
             # shaping/param item batches (global coordinates) ride
@@ -2112,6 +2286,7 @@ class Engine:
         else:
             out = flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms, **flags)
         self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn, result = out
+        self._flush_timing["kernel_ms"] += (time.perf_counter() - t_disp0) * 1e3
 
         # Opt-in breaker state-change observers: capture THIS chunk's
         # post-flush state (tagged with epoch+seq — dispatches are
@@ -2149,14 +2324,28 @@ class Engine:
 
         if defer:
             rec = _PendingFetch(
-                self, entries, lambda: _fetch_and_fill(result)
+                self, entries, lambda: _fetch_and_fill(result),
+                staging=staging,
             )
             for op in entries:
                 op._pending = rec
             for g in bulk:
                 g._pending = rec
             return rec
-        return _fetch_and_fill(result)
+        t_fetch0 = time.perf_counter()
+        try:
+            res = _fetch_and_fill(result)
+        finally:
+            self._flush_timing["kernel_ms"] += (
+                time.perf_counter() - t_fetch0
+            ) * 1e3
+        # Results fetched → the computation has consumed its (possibly
+        # zero-copy) inputs; staging is reusable. ONLY on success: a
+        # failed/interrupted fetch proves nothing about the dispatched
+        # computation, so its staging is dropped to GC, never pooled.
+        if self._arena is not None:
+            self._arena.give_all(staging)
+        return res
 
     def _reset_breaker_mirror(self) -> None:
         """Fresh all-CLOSED mirror + a new epoch: deferred fetches

@@ -59,6 +59,12 @@ class SentinelConfig:
     # max borrowable wait for prioritized entries, < interval.
     OCCUPY_TIMEOUT_MS = "csp.sentinel.statistic.occupy.timeout"
     INITIAL_ROWS = "sentinel.tpu.rows.initial"
+    # Host-ingest fast path: persistent param-value intern cache +
+    # reusable encode-buffer arena. On by default; the off position
+    # exists for differential testing (the with/without smoke test) and
+    # as an escape hatch — both paths must produce bit-identical
+    # verdicts.
+    HOST_FASTPATH = "sentinel.tpu.host.fastpath"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -74,6 +80,7 @@ class SentinelConfig:
         FLUSH_MAX_INFLIGHT: "2",
         INITIAL_ROWS: "1024",
         OCCUPY_TIMEOUT_MS: "500",
+        HOST_FASTPATH: "true",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

@@ -1,0 +1,370 @@
+"""Host-ingest fast path — differential guarantees.
+
+The persistent param-value intern cache, the encode-buffer arena, and
+the columnar gateway batch are pure host-side optimizations: none of
+them may ever change an admission verdict. These tests pin that —
+against the sequential oracle (``testing/oracle.py``), against the
+exact (fast-path-off) resolution path, and against buffer aliasing
+across consecutive flushes (including the deferred-fetch
+``flush_async`` path).
+"""
+
+import numpy as np
+import pytest
+
+
+def _param_setup(engine, resource, count, manual_clock=None):
+    import sentinel_tpu as st
+    from sentinel_tpu.models.rules import ParamFlowRule
+
+    engine.set_flow_rules([st.FlowRule(resource, count=1e9)])
+    engine.set_param_rules(
+        {resource: [ParamFlowRule(resource, param_idx=0, count=count)]}
+    )
+
+
+def _oracle_admit(values, t, count):
+    """Expected per-request admissions: one OracleParamBucket per
+    distinct value, requests checked in submission order."""
+    from sentinel_tpu.testing.oracle import OracleParamBucket
+
+    buckets = {}
+    out = []
+    for v in values:
+        b = buckets.get(v)
+        if b is None:
+            b = buckets[v] = OracleParamBucket(count, 0, 1000)
+        out.append(b.check(t))
+    return out
+
+
+class TestInternCacheInvalidation:
+    def test_reload_drops_stale_prows_and_matches_cold_engine(
+        self, manual_clock, engine
+    ):
+        """Mid-traffic param-rule reload: the rebuilt index must drop
+        every cached value→prow mapping, and post-reload verdicts must
+        equal a cold engine's (differential vs the sequential oracle —
+        the reference rebuilds ParameterMetric on reload, so budgets
+        restart)."""
+        count = 3
+        _param_setup(engine, "rr", count)
+        manual_clock.set_ms(1000)
+        values = [f"hh-{i % 4}" for i in range(24)]
+        g1 = engine.submit_bulk(
+            "rr", 24, ts=np.full(24, 1000, dtype=np.int32),
+            args_column=[(v,) for v in values],
+        )
+        engine.flush()
+        assert g1.admitted.tolist() == _oracle_admit(values, 1000, count)
+        old_index = engine.param_index
+        assert any(old_index._resolved)  # cache warmed by the traffic
+
+        # Reload (identical rules): a fresh ParamIndex — the intern
+        # cache must die with the old one, budgets restart cold.
+        _param_setup(engine, "rr", count)
+        assert engine.param_index is not old_index
+        assert all(not d for d in engine.param_index._resolved)
+        assert all(not d for d in engine.param_index._values)
+
+        manual_clock.set_ms(1100)
+        g2 = engine.submit_bulk(
+            "rr", 24, ts=np.full(24, 1100, dtype=np.int32),
+            args_column=[(v,) for v in values],
+        )
+        engine.flush()
+        # Cold oracle: the same per-value budget is available again.
+        assert g2.admitted.tolist() == _oracle_admit(values, 1100, count)
+
+    def test_lru_eviction_drops_resolved_entry(self, manual_clock, engine):
+        """An LRU eviction recycles a row for a different value — the
+        resolved-value cache must not keep serving the old mapping.
+        At the cap, resolution reverts to the exact touch-per-value
+        path, so a heavy hitter that keeps appearing is never evicted
+        by a churn of cold one-off values."""
+        from sentinel_tpu.models.rules import ParamFlowRule
+        import sentinel_tpu as st
+
+        engine.set_flow_rules([st.FlowRule("ev", count=1e9)])
+        engine.set_param_rules(
+            {"ev": [ParamFlowRule("ev", param_idx=0, count=5)]}
+        )
+        pindex = engine.param_index
+        # Shrink the cap so eviction is reachable.
+        pindex._caps[0] = 4
+        manual_clock.set_ms(1000)
+        cols = [("hot",)] + [(f"v{i}",) for i in range(3)]
+        engine.submit_bulk("ev", 4, ts=np.full(4, 1000, dtype=np.int32),
+                           args_column=cols)
+        engine.flush()
+        assert set(pindex._resolved[0]) == {"hot", "v0", "v1", "v2"}
+        # At the cap: cold churn alongside the hot value, several
+        # flushes — the exact path's per-flush LRU touch must keep
+        # "hot" resident while the one-off values evict each other.
+        for i in range(3, 9):
+            engine.submit_bulk(
+                "ev", 2, ts=np.full(2, 1000, dtype=np.int32),
+                args_column=[("hot",), (f"v{i}",)],
+            )
+            engine.flush()
+            assert "hot" in pindex._values[0]
+        # Evicted keys are gone from BOTH maps (no stale prow service).
+        assert "v0" not in pindex._values[0]
+        assert "v0" not in pindex._resolved[0]
+
+    def test_cap_crossing_column_matches_exact_path(self, manual_clock, engine):
+        """A column whose misses cross the intern cap mid-flush must
+        not evict a key already resolved from the cache in that same
+        flush (its cached prow would alias a reset, reassigned row) —
+        the fast path restarts the column on the exact path instead.
+        Differential vs a fastpath-off engine with the same cap."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import ParamFlowRule
+        from sentinel_tpu.runtime.engine import Engine
+        from sentinel_tpu.utils.config import config
+
+        flow = [st.FlowRule("cx", count=1e9)]
+        param = {"cx": [ParamFlowRule("cx", param_idx=0, count=3)]}
+        engine.set_flow_rules(flow)
+        engine.set_param_rules(param)
+        prev = config.get(config.HOST_FASTPATH)
+        config.set(config.HOST_FASTPATH, "false")
+        try:
+            ref = Engine(clock=manual_clock)
+            ref.set_flow_rules(flow)
+            ref.set_param_rules(param)
+        finally:
+            config.set(config.HOST_FASTPATH, prev if prev is not None else "true")
+        engine.param_index._caps[0] = 4
+        ref.param_index._caps[0] = 4
+        streams = [
+            (1000, ["hot", "v1", "v2"]),      # warm: 3 of 4 rows used
+            (1050, ["hot"]),                  # pure cache hit — recency
+                                              # must still advance like
+                                              # the exact path's touch
+            (1100, ["n1", "n2"]),             # crosses the cap WITHOUT
+                                              # hot in the column
+            (1200, ["hot"] * 5),              # hot budget must be continuous
+            (1300, ["hot", "n3", "n4"]),      # crossing column WITH hot
+            (1400, ["hot"] * 5),
+        ]
+        for t, vals in streams:
+            manual_clock.set_ms(t)
+            n = len(vals)
+            ts = np.full(n, t, dtype=np.int32)
+            col = [(v,) for v in vals]
+            gf = engine.submit_bulk("cx", n, ts=ts, args_column=col)
+            gs = ref.submit_bulk("cx", n, ts=ts, args_column=col)
+            engine.flush()
+            ref.flush()
+            assert gf.admitted.tolist() == gs.admitted.tolist(), (t, vals)
+        assert "hot" in engine.param_index._values[0]
+
+
+class TestArenaAliasing:
+    def _assert_no_pool_alias(self, engine, *arrays):
+        arena = engine._arena
+        if arena is None:
+            return
+        for sets in arena._pool.values():
+            for bufs in sets:
+                for buf in bufs:
+                    for a in arrays:
+                        assert not np.shares_memory(a, buf)
+
+    def test_consecutive_flush_results_do_not_share_memory(
+        self, manual_clock, engine
+    ):
+        import sentinel_tpu as st
+
+        engine.set_flow_rules([st.FlowRule("ar", count=4)])
+        manual_clock.set_ms(1000)
+        g1 = engine.submit_bulk("ar", 8, ts=np.full(8, 1000, dtype=np.int32))
+        engine.flush()
+        a1, r1, w1 = g1.admitted, g1.reason, g1.wait_ms
+        snap = (a1.tolist(), r1.tolist(), w1.tolist())
+        # Same shape key → the arena reuses the staging buffers.
+        g2 = engine.submit_bulk("ar", 8, ts=np.full(8, 1000, dtype=np.int32))
+        engine.flush()
+        for x, y in ((g1.admitted, g2.admitted), (g1.reason, g2.reason),
+                     (g1.wait_ms, g2.wait_ms)):
+            assert not np.shares_memory(x, y)
+        self._assert_no_pool_alias(engine, g1.admitted, g2.admitted,
+                                   g1.reason, g2.reason, g1.wait_ms, g2.wait_ms)
+        # g1's verdicts survive g2's flush bit-for-bit.
+        assert (g1.admitted.tolist(), g1.reason.tolist(),
+                g1.wait_ms.tolist()) == snap
+        assert g1.admitted_count == 4
+        assert g2.admitted_count == 0  # window budget spent by g1
+
+    def test_flush_async_deferred_fetch_does_not_alias(
+        self, manual_clock, engine
+    ):
+        """Two arena-sharing flush_async dispatches: the deferred
+        fetches must fill verdict arrays that share no memory with each
+        other or with the live staging buffers."""
+        import sentinel_tpu as st
+
+        engine.set_flow_rules([st.FlowRule("aa", count=6)])
+        manual_clock.set_ms(1000)
+        g1 = engine.submit_bulk("aa", 8, ts=np.full(8, 1000, dtype=np.int32))
+        engine.flush_async()
+        g2 = engine.submit_bulk("aa", 8, ts=np.full(8, 1000, dtype=np.int32))
+        engine.flush_async()
+        engine.drain()
+        assert not np.shares_memory(g1.admitted, g2.admitted)
+        assert not np.shares_memory(g1.reason, g2.reason)
+        self._assert_no_pool_alias(engine, g1.admitted, g2.admitted)
+        assert g1.admitted_count == 6
+        assert g2.admitted_count == 0
+
+    def test_mixed_singles_and_param_shapes_reuse_safely(
+        self, manual_clock, engine
+    ):
+        """Param staging buffers are arena-pooled too: back-to-back
+        hot-param flushes at one shape must keep earlier verdicts
+        intact."""
+        _param_setup(engine, "pm", 2)
+        manual_clock.set_ms(1000)
+        col = [("a",), ("a",), ("a",), ("b",)]
+        g1 = engine.submit_bulk("pm", 4, ts=np.full(4, 1000, dtype=np.int32),
+                                args_column=col)
+        engine.flush()
+        snap = g1.admitted.tolist()
+        g2 = engine.submit_bulk("pm", 4, ts=np.full(4, 1000, dtype=np.int32),
+                                args_column=col)
+        engine.flush()
+        assert g1.admitted.tolist() == snap == [True, True, False, True]
+        # "a" spent its budget in g1; "b" has one token left.
+        assert g2.admitted.tolist() == [False, False, False, True]
+
+
+class TestFastPathDifferentialSmoke:
+    def test_with_and_without_fast_path_identical_verdicts(
+        self, manual_clock, engine
+    ):
+        """The config toggle differential: random heavy-hitter gateway
+        batches through the fast path (intern cache + arena, default)
+        and through the exact path (sentinel.tpu.host.fastpath=false)
+        must produce bit-identical verdict arrays — including across a
+        param-rule reload."""
+        import sentinel_tpu as st
+        from sentinel_tpu.adapters.gateway import (
+            GatewayFlowRule,
+            GatewayParamFlowItem,
+            GatewayRequestBatch,
+            PARAM_PARSE_STRATEGY_CLIENT_IP,
+            gateway_rule_manager,
+            gateway_submit_bulk,
+        )
+        from sentinel_tpu.rules.param_manager import param_flow_rule_manager
+        from sentinel_tpu.runtime.engine import Engine
+        from sentinel_tpu.utils.config import config
+
+        route = "smoke_route"
+        gateway_rule_manager.load_rules([
+            GatewayFlowRule(
+                route, count=3,
+                param_item=GatewayParamFlowItem(
+                    parse_strategy=PARAM_PARSE_STRATEGY_CLIENT_IP),
+            ),
+        ])
+        engine.set_flow_rules([st.FlowRule(route, count=1e9)])
+        prev = config.get(config.HOST_FASTPATH)
+        config.set(config.HOST_FASTPATH, "false")
+        try:
+            slow = Engine(clock=manual_clock)
+            assert slow._arena is None
+            slow.set_flow_rules([st.FlowRule(route, count=1e9)])
+            slow.set_param_rules(dict(param_flow_rule_manager.by_resource))
+            assert not slow.param_index._use_value_cache
+        finally:
+            config.set(config.HOST_FASTPATH, prev if prev is not None else "true")
+        assert engine.param_index._use_value_cache
+        assert engine._arena is not None
+
+        rng = np.random.default_rng(7)
+        t = 1000
+        for round_no in range(4):
+            manual_clock.set_ms(t)
+            n = int(rng.integers(16, 64))
+            # Heavy-hitter mix: a few hot IPs plus a random long tail.
+            hot = [f"10.0.0.{h}" for h in range(3)]
+            ips = [
+                hot[int(rng.integers(0, 3))]
+                if rng.random() < 0.8
+                else f"10.9.{int(rng.integers(0, 256))}.{int(rng.integers(0, 256))}"
+                for _ in range(n)
+            ]
+            batch = GatewayRequestBatch(n=n, client_ip=ips)
+            ts = np.full(n, t, dtype=np.int32)
+            gf = gateway_submit_bulk(route, batch, engine=engine, ts=ts)
+            gs = gateway_submit_bulk(route, batch, engine=slow, ts=ts)
+            engine.flush()
+            slow.flush()
+            assert gf.admitted.tolist() == gs.admitted.tolist(), (
+                f"fast/exact divergence in round {round_no}"
+            )
+            assert gf.reason.tolist() == gs.reason.tolist()
+            if round_no == 1:
+                # Reload mid-traffic: both engines must invalidate
+                # their intern caches identically.
+                engine.set_param_rules(dict(param_flow_rule_manager.by_resource))
+                slow.set_param_rules(dict(param_flow_rule_manager.by_resource))
+            t += int(rng.integers(50, 400))
+
+
+class TestArgsColumns:
+    def test_validation(self):
+        from sentinel_tpu.rules.param_table import ArgsColumns
+
+        with pytest.raises(ValueError, match="length"):
+            ArgsColumns(3, {0: ["a", "b"]})
+        assert len(ArgsColumns(2, {0: ["a", "b"]})) == 2
+
+    def test_engine_parity_with_tuple_column(self, manual_clock, engine):
+        """submit_bulk(args_column=ArgsColumns) decides exactly like
+        the same values as per-entry tuples."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import ParamFlowRule
+        from sentinel_tpu.rules.param_table import ArgsColumns
+        from sentinel_tpu.runtime.engine import Engine
+
+        flow = [st.FlowRule("ac", count=1e9)]
+        param = {"ac": [ParamFlowRule("ac", param_idx=0, count=2)]}
+        engine.set_flow_rules(flow)
+        engine.set_param_rules(param)
+        ref = Engine(clock=manual_clock)
+        ref.set_flow_rules(flow)
+        ref.set_param_rules(param)
+        manual_clock.set_ms(1000)
+        values = [f"k{i % 3}" for i in range(12)] + [None]
+        n = len(values)
+        ts = np.full(n, 1000, dtype=np.int32)
+        g_flat = engine.submit_bulk(
+            "ac", n, ts=ts, args_column=ArgsColumns(n, {0: values})
+        )
+        engine.flush()
+        g_tup = ref.submit_bulk(
+            "ac", n, ts=ts, args_column=[(v,) for v in values]
+        )
+        ref.flush()
+        assert g_flat.admitted.tolist() == g_tup.admitted.tolist()
+        assert g_flat.admitted.tolist()[-1]  # None value → rule passes
+
+    def test_missing_idx_means_no_value(self, manual_clock, engine):
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import ParamFlowRule
+        from sentinel_tpu.rules.param_table import ArgsColumns
+
+        engine.set_flow_rules([st.FlowRule("mi", count=1e9)])
+        engine.set_param_rules(
+            {"mi": [ParamFlowRule("mi", param_idx=1, count=1)]}
+        )
+        manual_clock.set_ms(1000)
+        g = engine.submit_bulk(
+            "mi", 4, ts=np.full(4, 1000, dtype=np.int32),
+            args_column=ArgsColumns(4, {0: ["a", "a", "a", "a"]}),
+        )
+        engine.flush()
+        assert g.admitted.all()  # no value for param_idx 1 → passes
