@@ -368,6 +368,7 @@ def gateway_submit_bulk(
     *,
     engine=None,
     ts=None,
+    flush: bool = False,
 ):
     """Columnar gateway admission — the adapter fast path onto
     :meth:`Engine.submit_bulk`.
@@ -393,6 +394,13 @@ def gateway_submit_bulk(
     pass-through); ``op.admitted`` is the per-request verdict array
     after ``flush()``. Callers account completions with
     ``submit_exit_bulk`` like any bulk group.
+
+    ``flush=True`` flushes the engine before returning — with the
+    engine's flush pipeline enabled (``sentinel.tpu.host.pipeline.
+    depth`` > 0) that dispatch is pipelined: the adapter's next window
+    parses and encodes while this window's kernel runs, and the
+    returned group's verdicts materialize lazily on first access
+    (``op.admitted``), exactly like any pipelined flush.
     """
     from sentinel_tpu.rules.param_table import ArgsColumns
 
@@ -435,10 +443,17 @@ def gateway_submit_bulk(
         args_column = gateway_rule_manager.parse_params_batch(
             route_id, GatewayRequestBatch.from_infos(infos, fields=need)
         )
-    return eng.submit_bulk(
+    op = eng.submit_bulk(
         route_id,
         n,
         ts=ts,
         entry_type=C.EntryType.IN,
         args_column=args_column,
     )
+    # Skip the flush when nothing is pending (flush-on-size inside
+    # submit_bulk already dispatched this window): at pipeline depth >
+    # 0 an EMPTY flush settles the whole in-flight queue, which would
+    # silently de-pipeline exactly the max_batch-sized windows.
+    if flush and eng.has_pending():
+        eng.flush()
+    return op

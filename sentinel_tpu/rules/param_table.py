@@ -50,6 +50,13 @@ from sentinel_tpu.utils.record_log import record_log
 
 PARAM_NEVER = -(2**30)  # "no state yet" sentinel for last_add/latest
 
+# Closed-form rank path: max (row, ts) sub-segments per value row before
+# the selector falls back to rounds/scan. Each sub-segment costs one
+# vectorized pass in the unrolled segment loop, so this bounds compile
+# size; realistic gateway batches straddle at most a window edge or two
+# (2-3 distinct timestamps per hot value).
+PARAM_CLOSED_MAX_SEGMENTS = 8
+
 # Cache-miss marker for the resolved-value fast path (identity compare
 # only — never equal to a real (prow, tc, cost) triple).
 _MISS = object()
@@ -233,10 +240,13 @@ def run_param(
     in this batch — picks the vectorized rounds path (round *r*
     resolves every row's *r*-th item in parallel, each item chaining
     from its predecessor in the sorted order); 0 falls back to the
-    sequential ``lax.scan``; −1 selects the closed-form rank path,
-    ONLY valid when the host verified every item is QPS-grade DEFAULT
-    at one ts with one acquire ≥ 1 (Engine._param_rounds_for owns that
-    predicate — run_param does not re-validate).
+    sequential ``lax.scan``; ``rounds <= -1`` selects the closed-form
+    rank path with ``-rounds`` timestamp sub-segments per row (−1 =
+    single-ts batches, −S = mixed-ts batches with at most S distinct
+    timestamps per value row), ONLY valid when the host verified every
+    item is QPS-grade DEFAULT with one acquire ≥ 1
+    (Engine._param_rounds_for owns that predicate — run_param does not
+    re-validate).
     """
     s = pb.valid.shape[0]
     pr = dyn.tokens.shape[0]
@@ -274,54 +284,90 @@ def run_param(
     ones = jnp.ones((1,), dtype=bool)
     new_grp = jnp.concatenate([ones, row_s[1:] != row_s[:-1]])
 
-    if rounds == -1:
+    if rounds <= -1:
         # Closed-form heavy-hitter path (host-selected when EVERY item
-        # in the batch is QPS-grade DEFAULT behavior at ONE timestamp
-        # with ONE acquire value — the columnar-adapter shape): under
-        # those conditions the per-item greedy recurrence equals rank
-        # math. With a single ts per segment the refill window can open
-        # at most once (the first grant pins last_add to ts), so the
-        # per-value budget for the whole batch is
+        # in the batch is QPS-grade DEFAULT behavior with ONE acquire
+        # value — the columnar-adapter shape): under those conditions
+        # the per-item greedy recurrence equals rank math. Within one
+        # (row, ts) sub-segment the refill window can open at most once
+        # (the first grant pins last_add to ts), so the sub-segment's
+        # budget is
         #     avail = never   ? max_count
         #           : refill  ? min(tokens + to_add, max_count)
         #           : tokens
         # and with uniform acquire the greedy admit set is exactly the
         # first floor(avail/acq) items — any per-value multiplicity in
         # O(sort), no 16-round unroll, no sequential scan.
+        #
+        # Mixed-timestamp batches (``nseg = -rounds > 1``): segment
+        # boundaries fall at ts changes within a row (the sort is
+        # (row, ts, arrival)); the unrolled loop below resolves every
+        # row's *i*-th sub-segment in parallel and applies each
+        # sub-segment's refill + spend to the row state BETWEEN
+        # iterations — rank math per sub-segment, recurrence only
+        # across the (host-bounded, ≤ PARAM_CLOSED_MAX_SEGMENTS)
+        # sub-segments. A rejected sub-segment (avail < acquire)
+        # commits nothing, exactly like the per-item CAS-failure path.
+        nseg = -rounds
         (valid_x, ts_x, acq_x, _g, _b, tc_x, burst_x, dur_x, _mq, _c,
          _thr) = items
         idx = jnp.arange(s, dtype=jnp.int32)
-        seg_start = jax.lax.cummax(jnp.where(new_grp, idx, 0))
-        seg_rank = idx - seg_start
+        new_sub = new_grp | jnp.concatenate([ones, ts_s[1:] != ts_s[:-1]])
+        sub_start = jax.lax.cummax(jnp.where(new_sub, idx, 0))
+        sub_rank = idx - sub_start
+        # Sub-segment index within the row: running count of sub-starts
+        # (inclusive, restarting per row) minus one — the segment
+        # exclusive-cumsum recovered via a running max over row-start
+        # snapshots (same construction as flush.segment_excl_cumsum,
+        # not imported: rules must not depend on runtime).
+        sub_flag = new_sub.astype(jnp.int32)
+        excl = jnp.cumsum(sub_flag) - sub_flag
+        sub_idx = (
+            excl - jax.lax.cummax(jnp.where(new_grp, excl, 0)) + sub_flag - 1
+        )
+        last_of_sub = jnp.concatenate([new_sub[1:], ones])
 
         max_count = tc_x + burst_x
-        never = seg_last == PARAM_NEVER
-        pass_time = ts_x - seg_last
-        refill = pass_time > dur_x
-        to_add = (pass_time * tc_x) // dur_x
-        avail = jnp.where(
-            never,
-            max_count,
-            jnp.where(refill, jnp.minimum(seg_tokens + to_add, max_count),
-                      seg_tokens),
-        )
         gate = (tc_x > 0) & (acq_x <= max_count)
-        cap = jnp.where(gate, avail // jnp.maximum(acq_x, 1), 0)
-        ok_s = (valid_x & gate & (seg_rank < cap)) | ~valid_x
 
-        # Per-item "state if the segment ended here" — the existing
-        # seg-end write-back picks the last item's version.
-        granted_here = jnp.minimum(seg_rank + 1, cap)
-        tok_here = jnp.where(
-            granted_here > 0, avail - granted_here * acq_x, seg_tokens
-        )
-        last_here = jnp.where(
-            (granted_here > 0) & (never | refill), ts_x, seg_last
-        )
-        sc = _seg_end_rows(row_s, row_c, valid_s, pr)
+        # Row state lives in full [PR] columns across the unroll: each
+        # iteration gathers the current state, decides one sub-segment
+        # per row, and scatters the sub-segment-end state back (rows
+        # with fewer sub-segments are untouched). After the last
+        # iteration these columns ARE the new dyn state — no separate
+        # seg-end write-back.
+        row_tokens = dyn.tokens
+        row_last = dyn.last_add
+        ok_s = ~valid_s
+        for seg_i in range(nseg):
+            in_seg = valid_s & (sub_idx == seg_i)
+            cur_tokens = row_tokens[row_c]
+            cur_last = row_last[row_c]
+            never = cur_last == PARAM_NEVER
+            pass_time = ts_x - cur_last
+            refill = pass_time > dur_x
+            to_add = (pass_time * tc_x) // dur_x
+            avail = jnp.where(
+                never,
+                max_count,
+                jnp.where(refill, jnp.minimum(cur_tokens + to_add, max_count),
+                          cur_tokens),
+            )
+            cap = jnp.where(gate, avail // jnp.maximum(acq_x, 1), 0)
+            ok_s = jnp.where(in_seg, gate & (sub_rank < cap), ok_s)
+            granted_here = jnp.minimum(sub_rank + 1, cap)
+            tok_here = jnp.where(
+                granted_here > 0, avail - granted_here * acq_x, cur_tokens
+            )
+            last_here = jnp.where(
+                (granted_here > 0) & (never | refill), ts_x, cur_last
+            )
+            sc = jnp.where(in_seg & last_of_sub, row_c, jnp.int32(pr))
+            row_tokens = row_tokens.at[sc].set(tok_here, mode="drop")
+            row_last = row_last.at[sc].set(last_here, mode="drop")
         new_dyn = ParamDynState(
-            tokens=dyn.tokens.at[sc].set(tok_here, mode="drop"),
-            last_add=dyn.last_add.at[sc].set(last_here, mode="drop"),
+            tokens=row_tokens,
+            last_add=row_last,
             latest=dyn.latest,
             threads=dyn.threads,
         )

@@ -58,6 +58,7 @@ from sentinel_tpu.rules.flow_table import FlowIndex, FlowRuleDynState
 from sentinel_tpu.models.rules import AuthorityRule, DegradeRule, ParamFlowRule
 from sentinel_tpu.rules.degrade_table import DegradeDynState, DegradeIndex
 from sentinel_tpu.rules.param_table import (
+    PARAM_CLOSED_MAX_SEGMENTS,
     ArgsColumns,
     ParamBatch,
     ParamDynState,
@@ -93,11 +94,15 @@ class Verdict(NamedTuple):
 
 class _PendingFetch:
     """A dispatched flush whose device→host fetch was deferred
-    (``Engine.flush_async``). ``wait()`` materializes this record —
-    and, FIFO, every older one — filling the chunk's verdicts and
-    running its post work (block log, cluster-token releases). The
-    fetch closure holds its own index/result references, so rule
-    reloads after dispatch cannot skew attribution.
+    (``Engine.flush_async`` / the depth-K pipelined ``flush()``).
+    ``wait()`` materializes this record — and, FIFO, every older one —
+    filling the chunk's verdicts and running its post work (block log,
+    cluster-token releases). The record holds its own device-array
+    references and fill closure (with their own index snapshots), so
+    rule reloads after dispatch cannot skew attribution — and so a
+    drain can batch MANY records' device arrays into one coalesced
+    ``jax.device_get`` (``Engine._drain_pending``) instead of paying a
+    round-trip per record.
 
     Each record has its own RLock: the blocking device round-trip and
     any user callbacks in post work run WITHOUT the engine's deque
@@ -105,17 +110,18 @@ class _PendingFetch:
     and re-entrant materialization from a callback is a no-op."""
 
     __slots__ = (
-        "_engine", "_entries", "_fetch", "_done", "_error", "_lock",
-        "_staging",
+        "_engine", "_entries", "_refs", "_fill", "_done", "_error",
+        "_lock", "_staging",
     )
 
     def __init__(
-        self, engine: "Engine", entries: List["_EntryOp"], fetch,
-        staging: Optional[List[tuple]] = None,
+        self, engine: "Engine", entries: List["_EntryOp"], refs: tuple,
+        fill, staging: Optional[List[tuple]] = None,
     ) -> None:
         self._engine = engine
         self._entries = entries
-        self._fetch = fetch  # () -> blocked_items; runs the device_get
+        self._refs = refs  # device arrays awaiting their host fetch
+        self._fill = fill  # (fetched tuple) -> blocked_items
         self._done = False
         self._error: Optional[BaseException] = None
         self._lock = threading.RLock()
@@ -123,8 +129,10 @@ class _PendingFetch:
         # dispatched computation may read them zero-copy until then).
         self._staging = staging or []
 
-    def materialize(self) -> None:
-        """Fetch + verdict fill + post work, exactly once. A failed
+    def materialize(self, got: Optional[tuple] = None) -> None:
+        """Fetch + verdict fill + post work, exactly once. ``got`` is
+        an already-fetched result tuple from a coalesced batch
+        device_get (None → this record fetches its own). A failed
         fetch is stored and re-raised to EVERY caller — a device
         failure must never read as 'nothing admitted'. References to
         the chunk (closure, result buffers, op lists) are dropped as
@@ -133,11 +141,18 @@ class _PendingFetch:
             if not self._done:
                 items: Optional[List[tuple]] = None
                 try:
-                    items = self._fetch()
+                    if got is None:
+                        t0 = time.perf_counter()
+                        got = jax.device_get(self._refs)
+                        self._engine._note_drain_ms(
+                            (time.perf_counter() - t0) * 1e3
+                        )
+                    items = self._fill(got)
                 except BaseException as exc:
                     self._error = exc
                 finally:
-                    self._fetch = None
+                    self._refs = None
+                    self._fill = None
                     self._done = True
                     # Staging returns to the arena only after a
                     # SUCCESSFUL fetch (which proves the computation
@@ -420,17 +435,38 @@ class _EncodeArena:
     instead of pooled. Until then the next chunk's
     ``take()`` simply builds fresh buffers (bounded by max_inflight).
     Returned verdict arrays are always fresh copies, never views of
-    staging or fetch buffers. Bounded to the MAX_KEYS most recent
-    shape keys (and PER_KEY sets each) so a shape change retires old
-    buffers instead of accumulating them. give() may run from a
-    drain thread, hence the lock."""
+    staging or fetch buffers. Bounded to the ``max_keys`` most recent
+    shape keys (and ``per_key`` sets each; both config-driven —
+    sentinel.tpu.host.arena.*) so a shape change retires old buffers
+    instead of accumulating them. ``ensure_per_key`` raises the
+    per-key bound to at least the flush-pipeline depth + 1: every
+    in-flight flush pins one staging set per shape key until its fetch
+    lands, so an undersized pool would make deep pipelines silently
+    fall back to fresh allocations. give() may run from a drain
+    thread, hence the lock."""
 
-    MAX_KEYS = 8
-    PER_KEY = 4
-
-    def __init__(self) -> None:
+    def __init__(
+        self, max_keys: Optional[int] = None, per_key: Optional[int] = None
+    ) -> None:
         self._lock = threading.Lock()
         self._pool: "OrderedDict[tuple, List[tuple]]" = OrderedDict()
+        self.max_keys = max(
+            1,
+            max_keys
+            if max_keys is not None
+            else config.get_int(config.ARENA_MAX_KEYS, 8),
+        )
+        self.per_key = max(
+            1,
+            per_key
+            if per_key is not None
+            else config.get_int(config.ARENA_PER_KEY, 4),
+        )
+
+    def ensure_per_key(self, n: int) -> None:
+        """Raise the per-key bound (never shrinks — pooled sets stay)."""
+        with self._lock:
+            self.per_key = max(self.per_key, int(n))
 
     def take(self, key: tuple, build):
         """Buffers for ``key``: pooled, or freshly built via
@@ -450,9 +486,9 @@ class _EncodeArena:
             if sets is None:
                 sets = self._pool[key] = []
             self._pool.move_to_end(key)
-            if len(sets) < self.PER_KEY:
+            if len(sets) < self.per_key:
                 sets.append(bufs)
-            while len(self._pool) > self.MAX_KEYS:
+            while len(self._pool) > self.max_keys:
                 self._pool.popitem(last=False)
 
     def give_all(self, staging: List[Tuple[tuple, tuple]]) -> None:
@@ -516,16 +552,33 @@ class Engine:
         )
         # Host-side breakdown of the most recent flush (diagnostics /
         # bench attribution): encode_ms is staging-array build time,
-        # kernel_ms is dispatch + device→host fetch. Written under
-        # _flush_lock; readers get a snapshot via last_flush_host_ms.
-        self._flush_timing = {"encode_ms": 0.0, "kernel_ms": 0.0}
-        # Deferred fetches from flush_async, oldest first. Lock order:
-        # _flush_lock → _pending_lock; nothing under _pending_lock takes
-        # another engine lock. RLock: a fetch closure reading a lazy
-        # property of its own chunk must not self-deadlock.
+        # dispatch_ms the kernel dispatch alone, kernel_ms dispatch +
+        # device→host fetch, drain_ms the coalesced fetches of earlier
+        # in-flight flushes that landed while this breakdown was
+        # current. Swaps/increments under _timing_lock; readers get a
+        # snapshot via last_flush_host_ms.
+        self._timing_lock = threading.Lock()
+        self._flush_timing = {
+            "encode_ms": 0.0, "dispatch_ms": 0.0, "kernel_ms": 0.0,
+            "drain_ms": 0.0,
+        }
+        # Deferred fetches from flush_async / the pipelined flush,
+        # oldest first. Lock order: _flush_lock → _pending_lock;
+        # nothing under _pending_lock takes another engine lock. RLock:
+        # a fetch closure reading a lazy property of its own chunk must
+        # not self-deadlock.
         self._pending_fetches: "deque[_PendingFetch]" = deque()
         self._pending_lock = threading.RLock()
-        self.max_inflight = config.get_int(config.FLUSH_MAX_INFLIGHT, 2)
+        self._max_inflight = config.get_int(config.FLUSH_MAX_INFLIGHT, 2)
+        # Depth-K flush pipeline (sentinel.tpu.host.pipeline.depth):
+        # flush() keeps up to this many dispatched-but-unfetched
+        # flushes in flight; 0 = fully synchronous (the differential
+        # oracle). Occupancy counters sample the post-trim in-flight
+        # depth once per dispatching flush (see pipeline_stats).
+        self._pipeline_depth = max(0, config.get_int(config.PIPELINE_DEPTH, 0))
+        self._pipe_dispatches = 0
+        self._pipe_inflight_sum = 0
+        self._resize_arena()
         # Global on/off switch (Constants.ON, flipped by the setSwitch
         # command): when off, entries pass through unchecked + unrecorded.
         self.enabled = True
@@ -1581,22 +1634,36 @@ class Engine:
 
     @staticmethod
     def _param_rounds_for(prow, grade, behavior, ts, acquire) -> int:
-        """Host-known param execution mode: −1 selects the closed-form
-        rank path (every item QPS-grade DEFAULT at one ts with one
-        acquire — any per-value multiplicity in O(sort)); otherwise the
-        pow2 rounds bound, with 0 = the sequential-scan fallback."""
+        """Host-known param execution mode: a negative value selects
+        the closed-form rank path (every item QPS-grade DEFAULT with
+        one acquire — any per-value multiplicity in O(sort)); −1 for
+        single-ts batches, −S for mixed-timestamp batches with at most
+        S (pow2-bucketed, ≤ PARAM_CLOSED_MAX_SEGMENTS) distinct
+        timestamps per value row — realistic gateway windows straddling
+        a window edge. Otherwise the pow2 rounds bound, with 0 = the
+        sequential-scan fallback."""
         n = prow.shape[0]
         if (
             n > 0
             and (grade == C.FLOW_GRADE_QPS).all()
             and (behavior == C.CONTROL_BEHAVIOR_DEFAULT).all()
-            and ts.min() == ts.max()
             and acquire.min() == acquire.max()
             # acquire<1 admits unconditionally in the recurrence
             # (tokens − 0 ≥ 0); the rank math has no such case.
             and acquire.min() >= 1
         ):
-            return -1
+            if ts.min() == ts.max():
+                return -1
+            # Max distinct timestamps per value row: unique (row, ts)
+            # pairs grouped by row. One combined int64 key keeps this a
+            # single O(n log n) pass, same cost class as _rounds_bucket.
+            key = (prow.astype(np.int64) << 32) | (
+                ts.astype(np.int64) & 0xFFFFFFFF
+            )
+            pairs = np.unique(key)
+            segs = int(np.unique(pairs >> 32, return_counts=True)[1].max())
+            if segs <= PARAM_CLOSED_MAX_SEGMENTS:
+                return -(1 << (segs - 1).bit_length()) if segs > 1 else -1
         return _rounds_bucket(prow)
 
     def start_auto_flush(self, interval_ms: Optional[float] = None) -> None:
@@ -1652,12 +1719,7 @@ class Engine:
                 iv if failures == 0 else min(1.0, iv * 2**failures)
             ):
                 try:
-                    with self._lock:
-                        pending = bool(
-                            self._entries or self._exits
-                            or self._bulk_entries or self._bulk_exits
-                        )
-                    if pending:
+                    if self.has_pending():
                         self.flush()
                     failures = 0
                 except Exception:
@@ -1690,23 +1752,117 @@ class Engine:
         and non-destructive — the engine stays usable afterwards (the
         reference has no analog; its counters live for the JVM's
         lifetime, while an embedded library needs an orderly stop).
-        flush() itself settles earlier flush_async dispatches first,
-        so no separate drain step is needed."""
+        A synchronous flush() settles earlier flush_async dispatches
+        itself; the trailing drain() covers the pipelined flush (depth
+        > 0), which deliberately leaves up to ``pipeline_depth``
+        dispatches in flight."""
         self.stop_auto_flush()
         self.flush()
+        self.drain()
 
     @property
     def last_flush_host_ms(self) -> Dict[str, float]:
         """Host-side breakdown of the most recent flush:
         ``encode_ms`` (staging-array build, incl. shaping/param
-        encode) and ``kernel_ms`` (dispatch + device→host fetch; a
-        ``flush_async`` flush counts dispatch only until its fetch
-        materializes). Diagnostics for bench attribution — a snapshot
-        copy, safe to hold across later flushes."""
-        return dict(self._flush_timing)
+        encode), ``dispatch_ms`` (the kernel dispatch alone — the
+        host-blocking cost of a pipelined flush), ``kernel_ms``
+        (dispatch + device→host fetch; a deferred flush counts
+        dispatch only until its fetch materializes) and ``drain_ms``
+        (coalesced in-flight fetches that landed while this breakdown
+        was current — they may belong to earlier dispatches).
+        Diagnostics for bench attribution — a snapshot copy, safe to
+        hold across later flushes."""
+        with self._timing_lock:
+            return dict(self._flush_timing)
+
+    def _note_drain_ms(self, ms: float) -> None:
+        """Accumulate deferred-fetch time into the current breakdown.
+        Runs from drain/materialize threads outside the flush lock; a
+        drain landing just after a new flush swapped the dict counts
+        toward the new breakdown — benign for diagnostics."""
+        with self._timing_lock:
+            self._flush_timing["drain_ms"] = (
+                self._flush_timing.get("drain_ms", 0.0) + ms
+            )
+
+    @property
+    def pipeline_depth(self) -> int:
+        """Max dispatched-but-unfetched flushes ``flush()`` keeps in
+        flight (sentinel.tpu.host.pipeline.depth). 0 = synchronous.
+        Counted in dispatched chunks — one per flush unless a backlog
+        beyond ``max_batch`` splits a flush (see _flush_pipelined)."""
+        return self._pipeline_depth
+
+    @pipeline_depth.setter
+    def pipeline_depth(self, depth: int) -> None:
+        self._pipeline_depth = max(0, int(depth))
+        self._resize_arena()
+
+    @property
+    def max_inflight(self) -> int:
+        """Max flush_async dispatches in flight before the oldest fetch
+        is forced (sentinel.tpu.flush.max.inflight). Like
+        pipeline_depth, raising it re-sizes the arena — every in-flight
+        flush pins a staging set per shape key."""
+        return self._max_inflight
+
+    @max_inflight.setter
+    def max_inflight(self, n: int) -> None:
+        self._max_inflight = max(0, int(n))
+        self._resize_arena()
+
+    def _resize_arena(self) -> None:
+        """The ONE home of the arena sizing rule: every in-flight flush
+        (pipelined or flush_async) pins one staging set per shape key,
+        so the pool must cover the deeper of the two bounds plus the
+        flush being encoded."""
+        if self._arena is not None:
+            self._arena.ensure_per_key(
+                max(self._pipeline_depth, self._max_inflight) + 1
+            )
+
+    def pipeline_stats(self, reset: bool = False) -> Dict[str, float]:
+        """Flush-pipeline occupancy counters: ``dispatches``
+        (dispatching deferred flushes since the last reset) and
+        ``mean_inflight`` (average in-flight queue depth sampled once
+        per dispatching flush AFTER its queue trim — the depth that
+        actually overlaps the next flush's host work; a saturated
+        depth-K pipeline samples exactly K). Occupancy relative to a
+        target depth K is ``mean_inflight / K`` (0..1)."""
+        with self._pending_lock:
+            n = self._pipe_dispatches
+            mean = (self._pipe_inflight_sum / n) if n else 0.0
+            if reset:
+                self._pipe_dispatches = 0
+                self._pipe_inflight_sum = 0
+        return {"dispatches": float(n), "mean_inflight": mean}
+
+    def has_pending(self) -> bool:
+        """True when ops are queued for the next flush (submission
+        buffers non-empty). Callers that flush opportunistically — the
+        auto-flusher, adapters with ``flush=True`` — use this to skip
+        an empty flush: at pipeline depth > 0 an empty flush settles
+        the WHOLE in-flight queue (the trailing-flush contract), which
+        would silently de-pipeline a window whose flush-on-size
+        already dispatched it."""
+        with self._lock:
+            return bool(
+                self._entries or self._exits
+                or self._bulk_entries or self._bulk_exits
+            )
 
     def flush(self) -> List[_EntryOp]:
         """Encode + run the kernel for all pending ops; fills verdicts.
+
+        With ``pipeline_depth == 0`` (default) the flush is fully
+        synchronous: earlier deferred dispatches settle first, then
+        this flush's device→host fetch completes before returning.
+        With ``pipeline_depth > 0`` the flush is PIPELINED: it
+        dispatches without fetching and only settles the in-flight
+        queue down to at most ``pipeline_depth`` outstanding flushes
+        (see :meth:`_flush_pipelined`) — observable semantics are
+        unchanged because verdicts materialize lazily (FIFO) on first
+        access.
 
         The submission lock is held only to swap the pending buffers and
         snapshot the rule indexes; encoding, kernel dispatch and the
@@ -1717,7 +1873,10 @@ class Engine:
         already filled (the other flush cannot release the lock before
         filling them).
         """
-        # Earlier flush_async dispatches materialize first (FIFO), so
+        depth = self._pipeline_depth
+        if depth > 0:
+            return self._flush_pipelined(depth)
+        # Earlier deferred dispatches materialize first (FIFO), so
         # "after flush() every previously submitted op has a verdict"
         # keeps holding in pipelined use.
         self.drain()
@@ -1727,6 +1886,60 @@ class Engine:
                 self._flush_locked(drained)
         finally:
             self._post_flush(drained)
+        return drained[0]
+
+    def _flush_pipelined(self, depth: int) -> List[_EntryOp]:
+        """The depth-K flush: encode + dispatch the pending ops WITHOUT
+        waiting for device results, then settle the in-flight queue
+        FIFO down to at most ``depth`` dispatched-but-unfetched
+        flushes. Host encode of flush N+1 thus overlaps device
+        execution of flush N, and device state chains donation-safely
+        from one flush into the next with no host round-trip in
+        between (the kernel outputs of flush N — stats/dyn states —
+        are the inputs of flush N+1 directly). Verdicts of a
+        still-in-flight flush materialize lazily on first access, at
+        the queue trim of a later flush, or at ``drain()`` — always
+        oldest-first, and via one coalesced device fetch per drain.
+        Arena staging buffers of every in-flight flush stay pinned
+        until its fetch lands (the zero-copy ``jnp.asarray`` hazard
+        spans the whole queue — see _EncodeArena).
+
+        An EMPTY flush (nothing new dispatched) settles the queue
+        completely instead: a trailing flush() after a burst must not
+        strand the last ``depth`` flushes' post work (block-log
+        records, cluster-token releases) until close()/reset() or the
+        next traffic — fire-and-forget callers never read verdicts.
+
+        The depth bound counts _PendingFetch records, i.e. dispatched
+        chunks — one per flush except when a backlog exceeds
+        ``max_batch`` and one flush splits into several chunks, in
+        which case the trim settles the flush's own earliest chunks
+        (degrading toward sync for exactly those oversized windows)."""
+        return self._dispatch_deferred(keep_dispatched=depth, keep_empty=0)
+
+    def _dispatch_deferred(
+        self, keep_dispatched: int, keep_empty: int
+    ) -> List[_EntryOp]:
+        """Shared deferred-dispatch body of :meth:`flush_async` and
+        :meth:`_flush_pipelined`: encode + dispatch without fetching,
+        then trim the in-flight queue to ``keep_dispatched`` (or
+        ``keep_empty`` when this call dispatched nothing) and record
+        one occupancy sample per dispatching flush."""
+        drained: Tuple[List[_EntryOp], List[tuple]] = ([], [])
+        try:
+            with self._flush_lock:
+                dispatched = self._flush_locked(drained, defer=True)
+        except BaseException:
+            # Still bound the queue, but never let a drain error mask
+            # the dispatch failure being raised.
+            try:
+                self._drain_pending(keep=keep_dispatched)
+            except BaseException:
+                pass
+            raise
+        self._drain_pending(keep=keep_dispatched if dispatched else keep_empty)
+        if dispatched:
+            self._sample_occupancy()
         return drained[0]
 
     def flush_async(self) -> List[_EntryOp]:
@@ -1746,20 +1959,18 @@ class Engine:
         writes and cluster-token releases for a chunk ride with its
         materialization.
         """
-        drained: Tuple[List[_EntryOp], List[tuple]] = ([], [])
-        try:
-            with self._flush_lock:
-                self._flush_locked(drained, defer=True)
-        except BaseException:
-            # Still bound the queue, but never let a drain error mask
-            # the dispatch failure being raised.
-            try:
-                self._drain_pending(keep=self.max_inflight)
-            except BaseException:
-                pass
-            raise
-        self._drain_pending(keep=self.max_inflight)
-        return drained[0]
+        return self._dispatch_deferred(
+            keep_dispatched=self._max_inflight, keep_empty=self._max_inflight
+        )
+
+    def _sample_occupancy(self) -> None:
+        """One occupancy sample per dispatching flush, AFTER the queue
+        trim: the in-flight depth that actually overlaps the next
+        flush's host work. At steady state a fully-occupied pipeline
+        samples exactly ``pipeline_depth`` (occupancy 1.0)."""
+        with self._pending_lock:
+            self._pipe_dispatches += 1
+            self._pipe_inflight_sum += len(self._pending_fetches)
 
     def drain(self) -> None:
         """Materialize every outstanding flush_async fetch (device→host)
@@ -1772,30 +1983,69 @@ class Engine:
     ) -> None:
         """Materialize queued async fetches oldest-first: through
         ``upto`` (inclusive) when given, else until at most ``keep``
-        remain. The deque lock is held only for queue ops; each fetch
-        (a blocking device round-trip) and its post work run outside
-        it on the record's own lock, so concurrent dispatchers never
-        stall behind a fetch. The first failure is re-raised after the
-        drain finishes (later records still materialize — one wedged
-        fetch must not strand the queue)."""
+        remain. The records to settle are popped in one scoop under
+        the deque lock, their device results fetched with ONE
+        coalesced ``jax.device_get`` (each separate fetch costs a full
+        round-trip on remote-tunnel backends), and each record's
+        verdict fill + post work then runs outside the deque lock on
+        the record's own lock, so concurrent dispatchers never stall
+        behind a fetch. A failed batch fetch falls back to per-record
+        fetches so errors attribute to the records that actually
+        failed; the first failure is re-raised after the drain
+        finishes (later records still materialize — one wedged fetch
+        must not strand the queue)."""
         first_err: Optional[BaseException] = None
         while True:
+            recs: List[_PendingFetch] = []
             with self._pending_lock:
-                if upto is not None and (
-                    upto._done or upto not in self._pending_fetches
-                ):
-                    break
-                if upto is None and len(self._pending_fetches) <= keep:
-                    break
-                if not self._pending_fetches:
-                    break
-                rec = self._pending_fetches.popleft()
-            try:
-                rec.materialize()
-            except BaseException as exc:
-                if first_err is None:
-                    first_err = exc
-            if rec is upto:
+                if upto is not None:
+                    if not upto._done and upto in self._pending_fetches:
+                        while self._pending_fetches:
+                            rec = self._pending_fetches.popleft()
+                            recs.append(rec)
+                            if rec is upto:
+                                break
+                else:
+                    while len(self._pending_fetches) > keep:
+                        recs.append(self._pending_fetches.popleft())
+            if not recs:
+                break
+            # Snapshot each record's device refs (skipping records a
+            # concurrent caller already materialized OR is busy
+            # materializing — blocking here would stall the whole
+            # coalesced fetch behind that record's device round-trip
+            # and post-work callbacks; materialize(None) below waits
+            # on exactly the busy ones after the batch fetch) and
+            # fetch them all in one batched device_get.
+            batch_refs: List[Optional[tuple]] = []
+            for rec in recs:
+                if rec._lock.acquire(blocking=False):
+                    try:
+                        batch_refs.append(None if rec._done else rec._refs)
+                    finally:
+                        rec._lock.release()
+                else:
+                    batch_refs.append(None)
+            fetched = None
+            to_fetch = [r for r in batch_refs if r is not None]
+            if to_fetch:
+                try:
+                    t0 = time.perf_counter()
+                    fetched = jax.device_get(to_fetch)
+                    self._note_drain_ms((time.perf_counter() - t0) * 1e3)
+                except BaseException:
+                    # Per-record fallback below attributes the failure
+                    # to the record(s) that actually caused it.
+                    fetched = None
+            it = iter(fetched) if fetched is not None else None
+            for rec, refs in zip(recs, batch_refs):
+                got = next(it) if (it is not None and refs is not None) else None
+                try:
+                    rec.materialize(got)
+                except BaseException as exc:
+                    if first_err is None:
+                        first_err = exc
+            if upto is not None and recs[-1] is upto:
                 break
         if upto is not None:
             # Another thread may have popped it mid-drain: block on the
@@ -1812,14 +2062,18 @@ class Engine:
         self,
         out: Optional[Tuple[List[_EntryOp], List[tuple]]] = None,
         defer: bool = False,
-    ) -> Tuple[List[_EntryOp], List[tuple]]:
-        """Drain + process pending ops. ``out`` (entries, blocked_items)
+    ) -> int:
+        """Drain + process pending ops; returns the number of chunks
+        THIS call dispatched (0 = the flush was empty — callers must
+        not infer that from shared counters, which concurrent flushes
+        also advance). ``out`` (entries, blocked_items)
         is filled IN PLACE chunk by chunk so the caller's finally still
         delivers completed chunks' block-log records and token releases
         if a later chunk's kernel raises. With ``defer``, each chunk's
         device→host fetch is queued as a _PendingFetch instead (out[1]
         stays empty; post work rides with materialization)."""
         out = out if out is not None else ([], [])
+        n_chunks = [0]
 
         def _chunk(entries_c, exits_c, bulk_c, bulk_x_c, findex, dindex,
                    pindex, auth_rules) -> None:
@@ -1828,6 +2082,7 @@ class Engine:
                 auth_rules, defer=defer,
             )
             out[0].extend(entries_c)
+            n_chunks[0] += 1
             if defer:
                 with self._pending_lock:
                     self._pending_fetches.append(res)
@@ -1845,9 +2100,13 @@ class Engine:
                 # An empty flush keeps the previous breakdown — a
                 # flush-on-size inside submit followed by an explicit
                 # no-op flush() must not zero the numbers just taken.
-                return out
+                return 0
             # Fresh host-side breakdown for this flush (chunks accumulate).
-            self._flush_timing = {"encode_ms": 0.0, "kernel_ms": 0.0}
+            with self._timing_lock:
+                self._flush_timing = {
+                    "encode_ms": 0.0, "dispatch_ms": 0.0,
+                    "kernel_ms": 0.0, "drain_ms": 0.0,
+                }
             self._ensure_capacity()
             findex = self.flow_index
             dindex = self.degrade_index
@@ -1950,7 +2209,7 @@ class Engine:
             # before ALL admissions, exactly like the unbatched path.
             _chunk(entries, exits, bulk_e, bulk_x, findex, dindex, pindex,
                    auth_rules)
-            return out
+            return n_chunks[0]
         # Oversized backlog: singles chunks, then packed bulk chunks.
         # Exits in a later chunk are not visible to earlier chunks'
         # admissions — the same caveat the singles chunk split already
@@ -1993,7 +2252,7 @@ class Engine:
                 pindex,
                 auth_rules,
             )
-        return out
+        return n_chunks[0]
 
     def _post_flush(self, drained: Tuple[List[_EntryOp], List[tuple]]) -> None:
         """Work that must happen after a flush but OUTSIDE the flush
@@ -2267,7 +2526,8 @@ class Engine:
             win_key=_ncfg.SECOND_CFG,
         )
         t_disp0 = time.perf_counter()
-        self._flush_timing["encode_ms"] += (t_disp0 - t_enc0) * 1e3
+        with self._timing_lock:
+            self._flush_timing["encode_ms"] += (t_disp0 - t_enc0) * 1e3
         if self._sharded_fns is not None:
             # Mesh mode: one global batch sharded over the chips;
             # shaping/param item batches (global coordinates) ride
@@ -2286,7 +2546,10 @@ class Engine:
         else:
             out = flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms, **flags)
         self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn, result = out
-        self._flush_timing["kernel_ms"] += (time.perf_counter() - t_disp0) * 1e3
+        dispatch_ms = (time.perf_counter() - t_disp0) * 1e3
+        with self._timing_lock:
+            self._flush_timing["dispatch_ms"] += dispatch_ms
+            self._flush_timing["kernel_ms"] += dispatch_ms
 
         # Opt-in breaker state-change observers: capture THIS chunk's
         # post-flush state (tagged with epoch+seq — dispatches are
@@ -2300,8 +2563,19 @@ class Engine:
 
         if breaker_events.has_observers():
             self._breaker_seq += 1
+            # Deferred fetches must NOT hold the live dyn-state buffer:
+            # the next flush donates degrade_dyn into its kernel, which
+            # deletes the array before the deferred device_get runs
+            # ("Array has been deleted"). A copy breaks the aliasing;
+            # the sync path fetches before the next dispatch, so it can
+            # keep the zero-copy reference.
+            state_snap = (
+                jnp.copy(self.degrade_dyn.state)
+                if defer
+                else self.degrade_dyn.state
+            )
             breaker_snap = (self._breaker_epoch, self._breaker_seq,
-                            self.degrade_dyn.state)
+                            state_snap)
         else:
             breaker_snap = None
             with self._breaker_mirror_lock:
@@ -2316,17 +2590,15 @@ class Engine:
                 # drop them.
                 self._breaker_applied_seq = self._breaker_seq
 
-        def _fetch_and_fill(res):
+        def _fill(got):
             return self._fill_results(
-                res, entries, exits, bulk, bulk_exits, findex, dindex,
+                got, entries, exits, bulk, bulk_exits, findex, dindex,
                 auth_rules, k, kd, breaker_snap=breaker_snap,
             )
 
+        refs = self._result_refs(result, breaker_snap)
         if defer:
-            rec = _PendingFetch(
-                self, entries, lambda: _fetch_and_fill(result),
-                staging=staging,
-            )
+            rec = _PendingFetch(self, entries, refs, _fill, staging=staging)
             for op in entries:
                 op._pending = rec
             for g in bulk:
@@ -2334,11 +2606,12 @@ class Engine:
             return rec
         t_fetch0 = time.perf_counter()
         try:
-            res = _fetch_and_fill(result)
+            res = _fill(jax.device_get(refs))
         finally:
-            self._flush_timing["kernel_ms"] += (
-                time.perf_counter() - t_fetch0
-            ) * 1e3
+            with self._timing_lock:
+                self._flush_timing["kernel_ms"] += (
+                    time.perf_counter() - t_fetch0
+                ) * 1e3
         # Results fetched → the computation has consumed its (possibly
         # zero-copy) inputs; staging is reusable. ONLY on success: a
         # failed/interrupted fetch proves nothing about the dispatched
@@ -2383,9 +2656,28 @@ class Engine:
         if fire:
             breaker_events.fire_transitions(prev, new_state, dindex)
 
+    @staticmethod
+    def _result_refs(result, breaker_snap) -> tuple:
+        """The device arrays one chunk's verdict fill consumes — kept
+        as a tuple so a drain can batch MANY chunks' refs into one
+        coalesced ``jax.device_get`` (each separate fetch costs a full
+        round-trip on remote-tunnel backends). The breaker state rides
+        the same fetch when observers are registered."""
+        refs = (
+            result.admitted,
+            result.reason,
+            result.slot_ok,
+            result.wait_ms,
+            result.sys_type,
+            result.dslot_ok,
+        )
+        if breaker_snap is not None:
+            refs = refs + (breaker_snap[2],)
+        return refs
+
     def _fill_results(
         self,
-        result,
+        got,
         entries: List[_EntryOp],
         exits: List[_ExitOp],
         bulk: List[BulkOp],
@@ -2397,24 +2689,11 @@ class Engine:
         kd: int,
         breaker_snap=None,
     ) -> List[tuple]:
-        """Device→host fetch + verdict fill for one dispatched chunk;
-        returns the chunk's blocked-verdict block-log items. Runs
-        either synchronously at the end of _run_chunk or deferred from
-        a _PendingFetch materialization."""
-        # One batched device->host fetch (each separate fetch costs a
-        # full round-trip on remote-tunnel backends). The breaker state
-        # rides the same fetch when observers are registered.
-        fetch = (
-            result.admitted,
-            result.reason,
-            result.slot_ok,
-            result.wait_ms,
-            result.sys_type,
-            result.dslot_ok,
-        )
-        if breaker_snap is not None:
-            fetch = fetch + (breaker_snap[2],)
-        got = jax.device_get(fetch)
+        """Verdict fill for one dispatched chunk from its ALREADY
+        FETCHED result tuple (``got`` = the host values of
+        :meth:`_result_refs`); returns the chunk's blocked-verdict
+        block-log items. Runs either synchronously at the end of
+        _run_chunk or deferred from a _PendingFetch materialization."""
         admitted, reason, slot_ok, wait_ms, sys_type, dslot_ok = got[:6]
         if breaker_snap is not None:
             self._apply_breaker_snapshot(

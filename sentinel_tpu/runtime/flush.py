@@ -623,8 +623,10 @@ def flush_entries(
     """Phases 2-3: admission checks and (when ``commit``) accounting.
 
     ``shaping_rounds`` / ``param_rounds`` (static) are the host-known
-    execution modes (−1 = closed-form rank paths with host-verified
-    preconditions, >0 = unrolled rounds, 0 = scan) — the host-known
+    execution modes (negative = closed-form rank paths with
+    host-verified preconditions — for params, −S runs the segmented
+    rank math with up to S timestamp sub-segments per value row;
+    >0 = unrolled rounds, 0 = scan) — the host-known
     max-items-per-rule bounds selecting the vectorized rounds path of
     the serializing scans (rules/shaping.py, rules/param_table.py);
     0 = sequential lax.scan fallback.

@@ -65,6 +65,19 @@ class SentinelConfig:
     # as an escape hatch — both paths must produce bit-identical
     # verdicts.
     HOST_FASTPATH = "sentinel.tpu.host.fastpath"
+    # Depth-K flush pipeline: Engine.flush() keeps up to this many
+    # dispatched-but-unfetched flushes in flight (encode/dispatch of
+    # flush N+1 overlaps device execution of flush N). 0 = the fully
+    # synchronous flush — the differential oracle for the pipelined
+    # path and the default.
+    PIPELINE_DEPTH = "sentinel.tpu.host.pipeline.depth"
+    # Encode-buffer arena bounds: how many recent padded-shape keys are
+    # pooled, and how many buffer sets per key. The per-key bound is
+    # raised automatically to pipeline_depth + 1 (every in-flight flush
+    # pins one staging set per shape key; an undersized pool would
+    # silently fall back to fresh allocations at depth).
+    ARENA_MAX_KEYS = "sentinel.tpu.host.arena.max.keys"
+    ARENA_PER_KEY = "sentinel.tpu.host.arena.per.key"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -81,6 +94,9 @@ class SentinelConfig:
         INITIAL_ROWS: "1024",
         OCCUPY_TIMEOUT_MS: "500",
         HOST_FASTPATH: "true",
+        PIPELINE_DEPTH: "0",
+        ARENA_MAX_KEYS: "8",
+        ARENA_PER_KEY: "4",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

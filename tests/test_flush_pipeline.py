@@ -1,0 +1,376 @@
+"""Depth-K pipelined flush — differential guarantees.
+
+The flush pipeline (``sentinel.tpu.host.pipeline.depth`` > 0) is a pure
+host-side scheduling change: encode/dispatch of flush N+1 overlaps the
+device execution of flush N, verdicts materialize lazily through one
+coalesced device fetch per drain, and device state chains donated from
+flush N into N+1 with no host round-trip. None of that may ever change
+an admission verdict, a stat, or alias a verdict buffer. These tests
+pin the pipelined engine bit-identically against the synchronous
+(depth 0) oracle — including across interleaved rule reloads — and pin
+the FIFO settle + non-aliasing contracts directly.
+"""
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.models import constants as C
+
+
+def _mk_engine(clock, depth):
+    from sentinel_tpu.runtime.engine import Engine
+
+    eng = Engine(clock=clock)
+    eng.pipeline_depth = depth
+    return eng
+
+
+def _load_rules(engines, flow_count=6.0, param_count=3):
+    import sentinel_tpu as st
+    from sentinel_tpu.models.rules import ParamFlowRule
+
+    for eng in engines:
+        eng.set_flow_rules(
+            [
+                st.FlowRule("pp", count=flow_count),
+                st.FlowRule("qq", count=1e9),
+            ]
+        )
+        eng.set_param_rules(
+            {"qq": [ParamFlowRule("qq", param_idx=0, count=param_count)]}
+        )
+
+
+def _run_stream(engines, manual_clock, rng, rounds, reload_at=None):
+    """Drive an identical random op stream through every engine
+    (flushing each per round WITHOUT reading verdicts — reads would
+    force drains and collapse the pipeline); returns the collected
+    (bulk groups, single ops) per engine for end-of-stream comparison.
+    Shapes are kept constant across rounds so the jit cache is shared.
+    """
+    collected = [([], []) for _ in engines]
+    t = 1000
+    for r in range(rounds):
+        manual_clock.set_ms(t)
+        n_pp = 16
+        ts_pp = t + rng.integers(0, 40, n_pp).astype(np.int32)
+        ts_pp.sort()
+        acq_pp = rng.integers(1, 3, n_pp).astype(np.int32)
+        # Heavy-hitter args column with a ts column straddling two
+        # values — the mixed-ts segmented closed-form path end-to-end.
+        n_qq = 12
+        vals = [f"v{int(rng.integers(0, 3))}" for _ in range(n_qq)]
+        ts_qq = np.where(
+            np.arange(n_qq) < rng.integers(1, n_qq),
+            np.int32(t),
+            np.int32(t + 700),
+        ).astype(np.int32)
+        singles = [
+            {
+                "resource": "qq",
+                "ts": int(t + rng.integers(0, 50)),
+                "args": (f"v{int(rng.integers(0, 3))}",),
+            }
+            for _ in range(4)
+        ]
+        for eng, (groups, ops) in zip(engines, collected):
+            g1 = eng.submit_bulk("pp", n_pp, ts=ts_pp, acquire=acq_pp)
+            g2 = eng.submit_bulk(
+                "qq", n_qq, ts=ts_qq, args_column=[(v,) for v in vals]
+            )
+            ops.extend(eng.submit_many([dict(s) for s in singles]))
+            rows = eng.resolve_entry_rows(
+                "pp", C.CONTEXT_DEFAULT_NAME, "", C.EntryType.OUT
+            )
+            eng.submit_exit_bulk(rows, 4, rt=10, ts=np.full(4, t, np.int32))
+            eng.flush()
+            assert len(eng._pending_fetches) <= eng.pipeline_depth
+            groups.extend([g1, g2])
+        if reload_at is not None and r == reload_at:
+            # Reload mid-stream while flushes are in flight: pending
+            # fetches hold their own index snapshots; post-reload ops
+            # resolve against the new tables on every engine alike.
+            _load_rules(engines, flow_count=4.0, param_count=2)
+        t += int(rng.integers(100, 900))
+    for eng in engines:
+        eng.drain()
+    return collected
+
+
+def _assert_streams_identical(engines, collected):
+    oracle_groups, oracle_ops = collected[0]
+    for eng, (groups, ops) in zip(engines[1:], collected[1:]):
+        for go, gp in zip(oracle_groups, groups):
+            assert gp.admitted.tolist() == go.admitted.tolist()
+            assert gp.reason.tolist() == go.reason.tolist()
+            assert gp.wait_ms.tolist() == go.wait_ms.tolist()
+        for oo, op in zip(oracle_ops, ops):
+            assert (op is None) == (oo is None)
+            if op is None:
+                continue
+            vo, vp = oo.verdict, op.verdict
+            assert (vp.admitted, vp.reason, vp.wait_ms) == (
+                vo.admitted, vo.reason, vo.wait_ms,
+            )
+        for res in ("pp", "qq"):
+            assert eng.cluster_node_stats(res) == engines[0].cluster_node_stats(
+                res
+            ), res
+
+
+class TestPipelineParity:
+    @pytest.mark.parametrize("depth", [1, 2])
+    def test_depth_parity_with_reload(self, manual_clock, depth):
+        """Random op streams (flow-limited bulk, mixed-ts hot-param
+        bulk, deferred singles, bulk exits) at pipeline depth {1,2}
+        produce bit-identical verdicts and node stats vs the
+        synchronous depth-0 oracle, across a mid-stream rule reload."""
+        engines = [_mk_engine(manual_clock, d) for d in (0, depth)]
+        _load_rules(engines)
+        rng = np.random.default_rng(depth)
+        collected = _run_stream(
+            engines, manual_clock, rng, rounds=5, reload_at=2
+        )
+        _assert_streams_identical(engines, collected)
+
+    @pytest.mark.slow
+    def test_depth4_soak(self, manual_clock):
+        """Longer stream at depth 4 (queue deeper than max_inflight),
+        two reloads, vs the synchronous oracle."""
+        engines = [_mk_engine(manual_clock, d) for d in (0, 4)]
+        _load_rules(engines)
+        rng = np.random.default_rng(99)
+        t = 1000
+        collected = [([], []) for _ in engines]
+        for phase, reload_at in ((0, 3), (1, 6)):
+            part = _run_stream(
+                engines, manual_clock, rng, rounds=8, reload_at=reload_at
+            )
+            for (g, o), (pg, po) in zip(collected, part):
+                g.extend(pg)
+                o.extend(po)
+        _assert_streams_identical(engines, collected)
+
+
+class TestPipelineMechanics:
+    def test_flush_settles_queue_fifo(self, manual_clock):
+        """A pipelined flush trims the in-flight queue oldest-first:
+        at depth 1, the second flush materializes the first flush's
+        verdicts without any explicit read."""
+        import sentinel_tpu as st
+
+        eng = _mk_engine(manual_clock, 1)
+        eng.set_flow_rules([st.FlowRule("ff", count=8)])
+        manual_clock.set_ms(1000)
+        g1 = eng.submit_bulk("ff", 8, ts=np.full(8, 1000, np.int32))
+        eng.flush()
+        assert g1._admitted is None  # still in flight — lazily filled
+        g2 = eng.submit_bulk("ff", 8, ts=np.full(8, 1000, np.int32))
+        eng.flush()
+        # The queue trim settled g1 (FIFO), g2 is the one in flight.
+        assert g1._admitted is not None
+        assert g2._admitted is None
+        assert g1.admitted_count == 8
+        assert g2.admitted_count == 0  # budget spent by g1; read drains
+        assert len(eng._pending_fetches) == 0
+        # Post-trim occupancy sampling: a saturated depth-1 pipeline
+        # reads exactly 1.0, never depth+1.
+        ps = eng.pipeline_stats()
+        assert ps["dispatches"] == 2.0 and ps["mean_inflight"] == 1.0
+
+    def test_verdict_buffers_do_not_alias_across_inflight(self, manual_clock):
+        """With several flushes in flight sharing arena staging, the
+        materialized verdict arrays must share no memory with each
+        other or with the pooled staging buffers."""
+        import sentinel_tpu as st
+
+        eng = _mk_engine(manual_clock, 3)
+        eng.set_flow_rules([st.FlowRule("al", count=10)])
+        manual_clock.set_ms(1000)
+        groups = []
+        for _ in range(3):
+            groups.append(eng.submit_bulk("al", 8, ts=np.full(8, 1000, np.int32)))
+            eng.flush()
+        assert len(eng._pending_fetches) == 3
+        eng.drain()
+        arrays = [a for g in groups for a in (g.admitted, g.reason, g.wait_ms)]
+        for i, a in enumerate(arrays):
+            for b in arrays[i + 1:]:
+                assert not np.shares_memory(a, b)
+        if eng._arena is not None:
+            for sets in eng._arena._pool.values():
+                for bufs in sets:
+                    for buf in bufs:
+                        for a in arrays:
+                            assert not np.shares_memory(a, buf)
+        # Verdicts survived the later in-flight flushes bit-for-bit
+        # (count=10 budget: 8, then 2, then none).
+        assert groups[0].admitted_count == 8
+        assert groups[1].admitted_count == 2
+        assert groups[2].admitted_count == 0
+
+    def test_arena_sized_to_depth(self, manual_clock):
+        """Raising the pipeline depth raises the arena per-key bound so
+        deep pipelines keep reusing staging instead of silently
+        allocating fresh buffers."""
+        eng = _mk_engine(manual_clock, 0)
+        if eng._arena is None:
+            pytest.skip("fastpath off")
+        base = eng._arena.per_key
+        eng.pipeline_depth = 7
+        assert eng._arena.per_key >= 8 and eng._arena.per_key >= base
+
+    def test_empty_flush_settles_whole_queue(self, manual_clock):
+        """A trailing flush() with nothing new to dispatch settles the
+        in-flight queue completely — fire-and-forget callers must not
+        have post work (block log, token releases) stranded behind the
+        last ``depth`` flushes until the next traffic."""
+        import sentinel_tpu as st
+
+        eng = _mk_engine(manual_clock, 2)
+        eng.set_flow_rules([st.FlowRule("ef", count=4)])
+        manual_clock.set_ms(1000)
+        g = eng.submit_bulk("ef", 8, ts=np.full(8, 1000, np.int32))
+        eng.flush()
+        assert len(eng._pending_fetches) == 1
+        eng.flush()  # empty: drains fully instead of keeping depth
+        assert len(eng._pending_fetches) == 0
+        assert g._admitted is not None and g.admitted_count == 4
+
+    def test_gateway_flush_on_size_keeps_pipeline(self, manual_clock):
+        """gateway_submit_bulk(flush=True) on a window that trips the
+        engine's flush-on-size must not follow up with an EMPTY flush —
+        that would settle the whole queue and silently de-pipeline
+        exactly the max_batch-sized windows."""
+        import sentinel_tpu as st
+        from sentinel_tpu.adapters.gateway import (
+            GatewayFlowRule,
+            GatewayRequestBatch,
+            gateway_rule_manager,
+            gateway_submit_bulk,
+        )
+
+        eng = _mk_engine(manual_clock, 2)
+        eng.max_batch = 8
+        route = "gwp"
+        gateway_rule_manager.load_rules([GatewayFlowRule(route, count=1e9)])
+        eng.set_flow_rules([st.FlowRule(route, count=5)])
+        manual_clock.set_ms(1000)
+        ts = np.full(8, 1000, np.int32)
+        g = gateway_submit_bulk(
+            route, GatewayRequestBatch(n=8), engine=eng, ts=ts, flush=True
+        )
+        # flush-on-size dispatched the window; the in-flight record
+        # must still be queued (not drained by an empty follow-up).
+        assert len(eng._pending_fetches) == 1
+        assert g._admitted is None
+        assert g.admitted_count == 5  # lazy materialization still works
+        eng.close()
+        """With breaker state-change observers registered, the deferred
+        fetch holds a breaker-state snapshot — which must be a COPY:
+        the next flush donates degrade_dyn into its kernel, deleting
+        the live buffer before the deferred device_get runs ('Array
+        has been deleted'). Several pipelined flushes with a breaker
+        tripping must drain cleanly and fire the OPEN transition."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import DegradeRule
+        from sentinel_tpu.rules import breaker_events
+
+        eng = _mk_engine(manual_clock, 2)
+        eng.set_flow_rules([st.FlowRule("bk", count=1e9)])
+        eng.set_degrade_rules(
+            [DegradeRule(resource="bk", grade=1, count=0.1, time_window=5,
+                         min_request_amount=1, stat_interval_ms=1000)]
+        )
+        events = []
+        breaker_events.add_state_change_observer(
+            "t", lambda *a, **kw: events.append(a)
+        )
+        try:
+            rows = eng.resolve_entry_rows(
+                "bk", C.CONTEXT_DEFAULT_NAME, "", C.EntryType.OUT
+            )
+            for i in range(4):
+                t = 1000 + i * 50
+                manual_clock.set_ms(t)
+                eng.submit_bulk("bk", 4, ts=np.full(4, t, np.int32))
+                eng.submit_exit_bulk(
+                    rows, 4, rt=10, err=1, ts=np.full(4, t, np.int32),
+                    resource="bk",
+                )
+                eng.flush()
+            eng.drain()  # must not raise "Array has been deleted"
+            assert events  # the error-ratio breaker opened and fired
+        finally:
+            breaker_events.clear()
+
+    def test_close_settles_pipeline(self, manual_clock):
+        import sentinel_tpu as st
+
+        eng = _mk_engine(manual_clock, 2)
+        eng.set_flow_rules([st.FlowRule("cl", count=4)])
+        manual_clock.set_ms(1000)
+        g = eng.submit_bulk("cl", 8, ts=np.full(8, 1000, np.int32))
+        eng.flush()
+        eng.close()
+        assert len(eng._pending_fetches) == 0
+        assert g._admitted is not None and g.admitted_count == 4
+
+
+class TestMixedTsClosedForm:
+    def test_engine_selects_segmented_mode(self, engine):
+        """Mixed-timestamp QPS DEFAULT uniform-acquire batches select
+        the segmented closed-form (negative rounds beyond −1); too many
+        distinct timestamps per row falls back to rounds/scan."""
+        prow = np.zeros(8, dtype=np.int32)
+        grade = np.full(8, C.FLOW_GRADE_QPS, np.int32)
+        beh = np.full(8, C.CONTROL_BEHAVIOR_DEFAULT, np.int32)
+        acq = np.ones(8, np.int32)
+        two_ts = np.where(np.arange(8) < 4, 1000, 2500).astype(np.int32)
+        assert engine._param_rounds_for(prow, grade, beh, two_ts, acq) == -2
+        prow12 = np.zeros(12, dtype=np.int32)
+        grade12 = np.full(12, C.FLOW_GRADE_QPS, np.int32)
+        beh12 = np.full(12, C.CONTROL_BEHAVIOR_DEFAULT, np.int32)
+        many_ts = (1000 + np.arange(12) * 100).astype(np.int32)
+        assert (
+            engine._param_rounds_for(
+                prow12, grade12, beh12, many_ts, np.ones(12, np.int32)
+            )
+            > 0
+        )  # 12 distinct ts per row > PARAM_CLOSED_MAX_SEGMENTS → rounds/scan
+        # Globally mixed but single-ts per row stays the plain −1 path.
+        rows2 = np.arange(8, dtype=np.int32) % 2
+        per_row_ts = np.where(rows2 == 0, 1000, 2500).astype(np.int32)
+        assert engine._param_rounds_for(rows2, grade, beh, per_row_ts, acq) == -1
+
+    def test_window_edge_bulk_matches_oracle(self, manual_clock, engine):
+        """A bulk group whose ts column straddles a refill boundary:
+        the segmented closed-form grants exactly what the sequential
+        reference (OracleParamBucket) grants per value, per window."""
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import ParamFlowRule
+        from sentinel_tpu.testing.oracle import OracleParamBucket
+
+        count = 3
+        engine.set_flow_rules([st.FlowRule("mx", count=1e9)])
+        engine.set_param_rules(
+            {"mx": [ParamFlowRule("mx", param_idx=0, count=count)]}
+        )
+        manual_clock.set_ms(1000)
+        n = 24
+        vals = [f"k{i % 2}" for i in range(n)]
+        ts = np.where(np.arange(n) < n // 2, 1000, 2400).astype(np.int32)
+        g = engine.submit_bulk(
+            "mx", n, ts=ts, args_column=[(v,) for v in vals]
+        )
+        engine.flush()
+        buckets = {}
+        expect = []
+        for v, t in zip(vals, ts):
+            b = buckets.setdefault(v, OracleParamBucket(count, 0, 1000))
+            expect.append(b.check(int(t)))
+        assert g.admitted.tolist() == expect
+        # Both windows granted: count per value per window.
+        adm = np.asarray(g.admitted)
+        assert int(adm[: n // 2].sum()) == 2 * count
+        assert int(adm[n // 2:].sum()) == 2 * count

@@ -1,9 +1,11 @@
-"""Closed-form heavy-hitter param path (rounds = −1).
+"""Closed-form heavy-hitter param path (rounds ≤ −1).
 
 Pins the rank math against the sequential scan (rounds = 0, the
 reference-semantics recurrence) on identical batches and state: same
 verdicts, same post-state — for any per-value multiplicity, including
-far past the 16-round unroll cap.
+far past the 16-round unroll cap, and (rounds < −1) for
+mixed-timestamp batches resolved by segmented rank math with
+per-segment refill between the (row, ts) sub-segments.
 """
 
 import numpy as np
@@ -31,11 +33,16 @@ def _batch(rng, s, pr, ts_val, acq_val, max_tc=6):
     burst = row_burst[prow]
     dur = row_dur[prow]
     valid = rng.random(s) < 0.9
+    ts = (
+        jnp.asarray(rng.choice(ts_val, s).astype(np.int32))
+        if isinstance(ts_val, np.ndarray)
+        else jnp.full(s, ts_val, dtype=jnp.int32)
+    )
     return ParamBatch(
         valid=jnp.asarray(valid),
         prow=jnp.asarray(prow),
         eidx=jnp.arange(s, dtype=jnp.int32),
-        ts=jnp.full(s, ts_val, dtype=jnp.int32),
+        ts=ts,
         acquire=jnp.full(s, acq_val, dtype=jnp.int32),
         grade=jnp.full(s, C.FLOW_GRADE_QPS, dtype=jnp.int32),
         behavior=jnp.full(s, C.CONTROL_BEHAVIOR_DEFAULT, dtype=jnp.int32),
@@ -83,6 +90,27 @@ class TestClosedFormParity:
         pb = _batch(rng, s, pr, ts_val, acq)
         dyn0 = _rand_state(rng, pr)
         dyn_cf, ok_cf, wait_cf = run_param(dyn0, pb, rounds=-1)
+        dyn_sc, ok_sc, wait_sc = run_param(dyn0, pb, rounds=0)
+        _assert_same(dyn_cf, ok_cf, dyn_sc, ok_sc)
+        assert np.array_equal(np.asarray(wait_cf), np.asarray(wait_sc))
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_mixed_ts_random_batches_match_scan(self, seed):
+        """Segmented rank math (rounds < −1): mixed-timestamp batches
+        with per-segment refill between (row, ts) sub-segments ≡ scan
+        on verdicts AND post-state — across never/refill/steady rows
+        and refill boundaries that open mid-batch."""
+        rng = np.random.default_rng(1000 + seed)
+        s, pr = 512, 9
+        nts = int(rng.integers(2, 6))
+        ts_vals = np.sort(
+            rng.choice(np.arange(500, 6000), nts, replace=False)
+        ).astype(np.int32)
+        acq = int(rng.integers(1, 3))
+        pb = _batch(rng, s, pr, ts_vals, acq)
+        dyn0 = _rand_state(rng, pr)
+        nseg = 1 << (nts - 1).bit_length()
+        dyn_cf, ok_cf, wait_cf = run_param(dyn0, pb, rounds=-nseg)
         dyn_sc, ok_sc, wait_sc = run_param(dyn0, pb, rounds=0)
         _assert_same(dyn_cf, ok_cf, dyn_sc, ok_sc)
         assert np.array_equal(np.asarray(wait_cf), np.asarray(wait_sc))
@@ -158,9 +186,10 @@ class TestClosedFormParity:
         adm = np.asarray(g.admitted)
         assert adm[::2].sum() == 4 and adm[1::2].sum() == 4
 
-    def test_mixed_ts_not_eligible(self, manual_clock, engine):
-        """Mixed timestamps fall back to the rounds/scan path and stay
-        correct (two windows' worth of grants across the ts gap)."""
+    def test_mixed_ts_selects_segmented_mode(self, manual_clock, engine):
+        """Mixed timestamps select the segmented closed-form (−S, one
+        sub-segment per distinct ts) and stay correct (two windows'
+        worth of grants across the ts gap)."""
         import sentinel_tpu as st
         from sentinel_tpu.models.rules import ParamFlowRule
         from sentinel_tpu.models import constants as C2
@@ -171,7 +200,7 @@ class TestClosedFormParity:
         beh = np.array([C2.CONTROL_BEHAVIOR_DEFAULT] * 2, dtype=np.int32)
         assert engine._param_rounds_for(
             np.array([0, 0], dtype=np.int32), np.repeat(grades, 2), beh, ts, acq
-        ) != -1
+        ) == -2
 
         engine.set_flow_rules([st.FlowRule("mx", count=100000)])
         engine.set_param_rules({"mx": [ParamFlowRule("mx", param_idx=0, count=2)]})

@@ -167,6 +167,48 @@ def main() -> None:
     # the sort/seg/stats stages would pin ~2 extra StatsStates of HBM.
     del flush_states, admis_batches
 
+    # --- sync vs pipelined engine flush (depth-K dispatch overlap) ----
+    # The same bulk window through Engine.flush() at pipeline depth 0
+    # (dispatch + fetch per flush) vs depth 2 (fetch deferred, one
+    # coalesced device_get per drain): on a remote-tunnel backend the
+    # gap is the per-flush fetch RTT the pipeline hides. Warm both
+    # depths fully before timing either (probe-order warm-up).
+    try:
+        from sentinel_tpu.models.rules import FlowRule
+        from sentinel_tpu.runtime.engine import Engine
+
+        eng = Engine(initial_rows=4096)
+        eng.set_flow_rules([FlowRule(resource=f"p{i}", count=1e9)
+                            for i in range(64)])
+        pipe_n = min(n, 1 << 14)
+
+        def _window(depth):
+            eng.pipeline_depth = depth
+            for i in range(8):
+                eng.submit_bulk(f"p{i}", pipe_n // 8)
+            eng.flush()
+            eng.drain()
+
+        for depth in (0, 2):  # warm both before timing either
+            _window(depth)
+            _window(depth)
+        for depth in (0, 2):
+            eng.pipeline_depth = depth
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                for i in range(8):
+                    eng.submit_bulk(f"p{i}", pipe_n // 8)
+                eng.flush()
+            eng.drain()
+            report(
+                f"engine_flush_depth{depth}",
+                (time.perf_counter() - t0) / args.iters,
+            )
+        eng.pipeline_depth = 0
+    except Exception as exc:  # engine drift — report, keep probing
+        print(f"[k2probe] engine pipeline stage skipped: {exc}",
+              file=sys.stderr)
+
     # --- isolated sorts over the flat slot array -----------------------
     for k in (1, 2):
         size = n * k
