@@ -464,6 +464,10 @@ def _run_engine_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         "dispatch_ms": round(t_p_dispatch / iters, 3),
         "drain_ms": round(t_p_drain / iters, 3),
         "pipeline_occupancy": round(occupancy, 3),
+        # Flight-recorder view of the whole stage (metrics/telemetry.py):
+        # latency tails + arena hit rate + blocked sketch — the numbers
+        # the /metrics scrape and the telemetry command would serve.
+        "telemetry": eng.telemetry.bench_summary(),
     }
     # Emit the completed measurements NOW: the latency block below
     # compiles one more (1-op, pad-8) kernel shape, and through a

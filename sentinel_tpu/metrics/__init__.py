@@ -12,6 +12,13 @@ vectorized updates over an HBM-resident tensor
 from sentinel_tpu.metrics.block_log import BlockLogger
 from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
 from sentinel_tpu.metrics.extension import MetricExtension, MetricExtensionProvider
+from sentinel_tpu.metrics.histogram import LatencyHistogram
+from sentinel_tpu.metrics.telemetry import (
+    FlushSpan,
+    SpaceSaving,
+    TelemetryBus,
+    spans_to_trace,
+)
 from sentinel_tpu.metrics.metric_array import (
     MetricArrayConfig,
     MetricArrayState,
@@ -25,8 +32,13 @@ from sentinel_tpu.metrics.metric_array import (
 
 __all__ = [
     "BlockLogger",
+    "FlushSpan",
+    "LatencyHistogram",
     "MetricExtension",
     "MetricExtensionProvider",
+    "SpaceSaving",
+    "TelemetryBus",
+    "spans_to_trace",
     "MetricEvent",
     "NUM_EVENTS",
     "MetricArrayConfig",

@@ -180,10 +180,53 @@ class MetricWriter:
 
 
 class MetricSearcher:
-    """Read metric lines back by time range (MetricSearcher.java)."""
+    """Read metric lines back by time range (MetricSearcher.java).
+
+    Uses each file's ``.idx`` second→offset index to seek past batches
+    that end before the requested range — the reference's
+    MetricSearcher does the same offset binary search; without it a
+    range query near "now" re-reads every rolled file from byte 0.
+    Every line is still range-filtered after the seek, so a missing or
+    stale index only costs speed, never correctness.
+    """
 
     def __init__(self, base_dir: Optional[str] = None, app_name: Optional[str] = None) -> None:
         self.writer_view = MetricWriter(base_dir=base_dir, app_name=app_name)
+
+    @staticmethod
+    def _start_offset(path: str, begin_ms: int) -> int:
+        """Byte offset to start scanning ``path`` from: the smallest
+        offset of an index entry whose (second-aligned, last-in-batch)
+        timestamp is >= the second of ``begin_ms`` — any line with
+        ``ts >= begin_ms`` lives in such a batch, because a batch's
+        recorded second is its newest line's second. When every indexed
+        batch ends before the range, the LAST indexed batch's offset is
+        returned rather than skipping the file: a data append whose
+        paired ``.idx`` append failed (disk full, crash between the two
+        writes) leaves un-indexed trailing lines, and those can only
+        live past the last index entry — so the index still skips every
+        earlier batch but never costs correctness. 0 when the index is
+        absent/unusable (full scan)."""
+        begin_sec = begin_ms // 1000 * 1000
+        start = -1
+        last = 0
+        seen = False
+        try:
+            with open(path + ".idx", "r", encoding="utf-8") as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) != 2:
+                        return 0
+                    sec, off = int(parts[0]), int(parts[1])
+                    seen = True
+                    last = max(last, off)
+                    if sec >= begin_sec and (start < 0 or off < start):
+                        start = off
+        except (OSError, ValueError):
+            return 0
+        if not seen:
+            return 0
+        return start if start >= 0 else last
 
     def find(
         self,
@@ -194,8 +237,11 @@ class MetricSearcher:
     ) -> List[MetricNodeLine]:
         out: List[MetricNodeLine] = []
         for path in self.writer_view._list_files():
+            start = self._start_offset(path, begin_ms)
             try:
                 with open(path, "r", encoding="utf-8") as f:
+                    if start:
+                        f.seek(start)
                     for line in f:
                         node = MetricNodeLine.from_line(line)
                         if node is None:
@@ -298,5 +344,25 @@ class MetricTimer:
                         occupied_pass_qps=int(c[MetricEvent.OCCUPIED_PASS]),
                     )
                 )
+        # Engine flight-recorder aggregates ride the same rolled files
+        # under the reserved ``__engine__`` pseudo-resource:
+        # pass=flushes, success=ops flushed, rt=mean host-blocking
+        # flush ms for that second — the dashboard's pull protocol
+        # carries the engine view with zero new machinery.
+        tele = getattr(engine, "telemetry", None)
+        if tele is not None and tele.enabled:
+            for sec, flushes, n_ops, host_ms in tele.drain_second_aggregates(upto):
+                if sec < begin - 1000:
+                    continue  # older than this pull's window: drop
+                out.append(
+                    MetricNodeLine(
+                        timestamp=engine.clock.to_wall(sec),
+                        resource="__engine__",
+                        pass_qps=flushes,
+                        success_qps=n_ops,
+                        rt=(host_ms / flushes) if flushes else 0.0,
+                    )
+                )
+            out.sort(key=lambda n: n.timestamp)
         self._last_written_sec = upto
         return out

@@ -1,0 +1,464 @@
+"""Engine flight recorder: per-flush spans, histograms, blocked sketch.
+
+The reference ships a metric-log/exporter stack that only ever sees
+*per-resource* second aggregates (metric_log.py, block_log.py,
+transport/prometheus.py); the engine internals that PR 2's depth-K
+flush pipeline introduced — whether host encode actually overlaps
+device execution, where a drain stalls, how full the in-flight queue
+runs — were visible only through ``bench.py``'s one-off dicts. This
+module is the first-class telemetry layer:
+
+* a bounded ring-buffer **flight recorder** of structured per-flush
+  spans (:class:`FlushSpan`) — flush id, pipeline depth and in-flight
+  occupancy at dispatch, batch rows, encode/dispatch/settle wall-ms,
+  arena and intern-cache hit/miss deltas, coalesced-fetch fallbacks —
+  recorded by ``Engine._run_chunk`` with near-zero overhead and nothing
+  at all when disabled (``sentinel.tpu.telemetry.enabled``);
+* fixed-bucket **latency histograms** (metrics/histogram.py) for
+  host-blocking flush time, coalesced drain fetches and end-to-end
+  admission (dispatch start → verdicts materialized) — tails, not
+  averages;
+* a host-side **space-saving top-K sketch** of blocked weight per
+  resource, fed by the *on-device* per-flush top-K that the flush
+  kernel folds into its outputs (runtime/flush.py ``sketch_k``) — the
+  data-plane heavy-hitter design (Sivaraman et al., arXiv:1611.04825;
+  Basat et al., arXiv:1710.03155): compute the candidate set where the
+  verdicts are, fetch only the summary on the existing coalesced
+  ``device_get``;
+* per-second engine aggregates drained by the metric-log timer into the
+  rolled ``{app}-metrics.log`` files (resource ``__engine__``), and a
+  Chrome trace-event export (:func:`spans_to_trace`) that
+  ``tools/tracedump.py`` writes for Perfetto.
+
+The bus is engine-scoped (one per :class:`Engine`); the process-global
+engine's bus is therefore the process view. Config keys::
+
+    sentinel.tpu.telemetry.enabled      default true
+    sentinel.tpu.telemetry.ring         span ring capacity, default 4096
+    sentinel.tpu.telemetry.sketch.k     device top-K per flush, default 8
+                                        (0 disables the kernel fold)
+    sentinel.tpu.telemetry.sketch.capacity
+                                        host summary capacity, default 64
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from sentinel_tpu.metrics.histogram import LatencyHistogram
+from sentinel_tpu.utils.config import config
+
+
+@dataclass(slots=True)
+class FlushSpan:
+    """One dispatched flush chunk's structured record. Mutable: the
+    settle fields land later for a pipelined flush (the ring holds the
+    reference, so readers see the update). Timestamps are
+    ``time.perf_counter()`` seconds — monotonic, shared by every span
+    in the process, which is all a trace needs."""
+
+    flush_id: int
+    t0: float  # perf_counter at encode start
+    depth: int  # configured pipeline depth at dispatch
+    inflight: int  # dispatched-but-unfetched flushes ahead of this one
+    n_entries: int = 0  # single entry ops
+    n_exits: int = 0  # single exit/trace ops
+    n_bulk: int = 0  # bulk entry rows
+    n_bulk_exits: int = 0  # bulk exit rows
+    encode_ms: float = 0.0
+    dispatch_ms: float = 0.0
+    settle_t0: float = 0.0  # perf_counter when the result fetch began
+    settle_end: float = 0.0  # perf_counter when verdicts materialized
+    settle_ms: float = 0.0  # device→host fetch duration (own or coalesced share)
+    deferred: bool = False  # dispatched without fetching (pipelined/async)
+    settled: bool = False
+    arena_hits: int = 0
+    arena_misses: int = 0
+    intern_hits: int = 0  # ParamIndex resolved-value cache delta since prev span
+    intern_misses: int = 0
+    fallbacks: int = 0  # coalesced-fetch failures this span rode through
+
+    @property
+    def rows(self) -> int:
+        return self.n_entries + self.n_exits + self.n_bulk + self.n_bulk_exits
+
+    @property
+    def host_ms(self) -> float:
+        """Host-blocking cost of this flush: encode + dispatch, plus
+        the fetch when it was synchronous (a deferred settle overlaps
+        the next flush's host work by design)."""
+        ms = self.encode_ms + self.dispatch_ms
+        if not self.deferred:
+            ms += self.settle_ms
+        return ms
+
+    def as_dict(self) -> dict:
+        return {
+            "flush_id": self.flush_id,
+            "t0": self.t0,
+            "depth": self.depth,
+            "inflight": self.inflight,
+            "rows": self.rows,
+            "n_entries": self.n_entries,
+            "n_exits": self.n_exits,
+            "n_bulk": self.n_bulk,
+            "n_bulk_exits": self.n_bulk_exits,
+            "encode_ms": round(self.encode_ms, 4),
+            "dispatch_ms": round(self.dispatch_ms, 4),
+            "settle_ms": round(self.settle_ms, 4),
+            "deferred": self.deferred,
+            "settled": self.settled,
+            "arena_hits": self.arena_hits,
+            "arena_misses": self.arena_misses,
+            "intern_hits": self.intern_hits,
+            "intern_misses": self.intern_misses,
+            "fallbacks": self.fallbacks,
+        }
+
+
+class SpaceSaving:
+    """Bounded heavy-hitter summary (Metwally et al.'s space-saving, the
+    merge target for the kernel's per-flush top-K). ``counts[key]`` is
+    an overestimate by at most ``error[key]`` — the guarantee the
+    differential test exercises: any key whose true weight exceeds the
+    minimum counter is present."""
+
+    __slots__ = ("capacity", "_counts", "_error", "_lock")
+
+    def __init__(self, capacity: int = 64) -> None:
+        self.capacity = max(1, int(capacity))
+        self._counts: Dict[str, int] = {}
+        self._error: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def offer(self, key: str, weight: int = 1) -> None:
+        if weight <= 0:
+            return
+        with self._lock:
+            c = self._counts.get(key)
+            if c is not None:
+                self._counts[key] = c + weight
+                return
+            if len(self._counts) < self.capacity:
+                self._counts[key] = weight
+                self._error[key] = 0
+                return
+            victim = min(self._counts, key=self._counts.__getitem__)
+            floor = self._counts.pop(victim)
+            self._error.pop(victim, None)
+            self._counts[key] = floor + weight
+            self._error[key] = floor
+
+    def topk(self, k: int = 10) -> List[Tuple[str, int, int]]:
+        """[(key, count, max_overestimate)] sorted by count desc."""
+        with self._lock:
+            items = sorted(
+                self._counts.items(), key=lambda kv: kv[1], reverse=True
+            )[: max(0, int(k))]
+            return [(key, cnt, self._error.get(key, 0)) for key, cnt in items]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._error.clear()
+
+
+class TelemetryBus:
+    """Engine-scoped telemetry: span ring + histograms + counters +
+    blocked-resource sketch + per-second aggregates.
+
+    Hot-path contract: when ``enabled`` is False the engine makes no
+    calls here at all (one attribute read per flush); when True, a
+    flush costs one dataclass build, one deque append and a few
+    histogram records — microseconds against a multi-ms flush."""
+
+    def __init__(
+        self,
+        enabled: Optional[bool] = None,
+        ring: Optional[int] = None,
+        sketch_k: Optional[int] = None,
+        sketch_capacity: Optional[int] = None,
+    ) -> None:
+        self.enabled = (
+            config.get_bool(config.TELEMETRY_ENABLED, True)
+            if enabled is None
+            else bool(enabled)
+        )
+        self.ring_size = max(
+            1,
+            ring
+            if ring is not None
+            else config.get_int(config.TELEMETRY_RING, 4096),
+        )
+        self.sketch_k = max(
+            0,
+            sketch_k
+            if sketch_k is not None
+            else config.get_int(config.TELEMETRY_SKETCH_K, 8),
+        )
+        self._spans: "deque[FlushSpan]" = deque(maxlen=self.ring_size)
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self.hist_flush = LatencyHistogram()
+        self.hist_drain = LatencyHistogram()
+        self.hist_e2e = LatencyHistogram()
+        self.counters: Dict[str, int] = {
+            "flushes": 0,
+            "ops": 0,
+            "deferred_flushes": 0,
+            "coalesced_fallbacks": 0,
+            "arena_hits": 0,
+            "arena_misses": 0,
+        }
+        self.sketch = SpaceSaving(
+            sketch_capacity
+            if sketch_capacity is not None
+            else config.get_int(config.TELEMETRY_SKETCH_CAP, 64)
+        )
+        # Most recent flush's device top-K, already name-resolved:
+        # [(resource, blocked_weight)] — the "what is being throttled
+        # right now" read, no extra host round-trip.
+        self.last_blocked_topk: List[Tuple[str, int]] = []
+        # Engine-clock-relative per-second aggregates for the metric
+        # log: sec -> [flushes, ops, host_ms_sum]. Bounded: the timer
+        # drains it every second; a stopped timer must not leak, so
+        # inserts evict the oldest past _SEC_CAP.
+        self._sec: Dict[int, List[float]] = {}
+        self._SEC_CAP = 600
+
+    # ------------------------------------------------------------------
+    # span lifecycle (engine hot path)
+    # ------------------------------------------------------------------
+    def begin_span(
+        self,
+        t0: float,
+        depth: int,
+        inflight: int,
+        n_entries: int,
+        n_exits: int,
+        n_bulk: int,
+        n_bulk_exits: int,
+        deferred: bool,
+        now_rel_ms: int,
+    ) -> FlushSpan:
+        with self._lock:
+            fid = self._next_id
+            self._next_id += 1
+            span = FlushSpan(
+                flush_id=fid, t0=t0, depth=depth, inflight=inflight,
+                n_entries=n_entries, n_exits=n_exits, n_bulk=n_bulk,
+                n_bulk_exits=n_bulk_exits, deferred=deferred,
+            )
+            self._spans.append(span)
+            self.counters["flushes"] += 1
+            if deferred:
+                self.counters["deferred_flushes"] += 1
+            self.counters["ops"] += span.rows
+            sec = (now_rel_ms // 1000) * 1000
+            agg = self._sec.get(sec)
+            if agg is None:
+                if len(self._sec) >= self._SEC_CAP:
+                    self._sec.pop(min(self._sec), None)
+                agg = self._sec[sec] = [0.0, 0.0, 0.0]
+            agg[0] += 1
+            agg[1] += span.rows
+        return span
+
+    def dispatch_done(self, span: FlushSpan) -> None:
+        """Encode+dispatch times are on the span; record the deferred
+        flush's host-blocking cost now (its settle overlaps later host
+        work by design)."""
+        if span.deferred:
+            self.hist_flush.record(span.encode_ms + span.dispatch_ms)
+            self._add_sec_host_ms(span.encode_ms + span.dispatch_ms)
+
+    def settle(self, span: FlushSpan, settle_t0: float, end: float) -> None:
+        """Verdicts materialized: close the span, record histograms."""
+        span.settle_t0 = settle_t0
+        span.settle_end = end
+        span.settle_ms = max(0.0, (end - settle_t0) * 1e3)
+        span.settled = True
+        if not span.deferred:
+            self.hist_flush.record(span.host_ms)
+            self._add_sec_host_ms(span.host_ms)
+        self.hist_e2e.record(max(0.0, (end - span.t0) * 1e3))
+
+    def _add_sec_host_ms(self, ms: float) -> None:
+        with self._lock:
+            # Attribute to the newest live second — per-second host-ms
+            # is a rate diagnostic, not an exact ledger.
+            if self._sec:
+                self._sec[max(self._sec)][2] += ms
+
+    def note_drain(self, ms: float) -> None:
+        self.hist_drain.record(ms)
+
+    def note_fallback(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["coalesced_fallbacks"] += n
+
+    def note_arena(self, hits: int, misses: int) -> None:
+        with self._lock:
+            self.counters["arena_hits"] += hits
+            self.counters["arena_misses"] += misses
+
+    def fold_blocked_topk(self, pairs: Sequence[Tuple[str, int]]) -> None:
+        """Fold one flush's device top-K (already name-resolved) into
+        the running space-saving summary."""
+        for key, w in pairs:
+            self.sketch.offer(key, w)
+        self.last_blocked_topk = list(pairs)
+
+    # ------------------------------------------------------------------
+    # readers
+    # ------------------------------------------------------------------
+    def spans(self) -> List[FlushSpan]:
+        with self._lock:
+            return list(self._spans)
+
+    def counters_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
+
+    def drain_second_aggregates(self, upto_rel_ms: int) -> List[Tuple[int, int, int, float]]:
+        """Completed engine-clock seconds strictly before
+        ``upto_rel_ms`` (second-aligned), removed from the bus:
+        [(sec_rel_ms, flushes, ops, host_ms_sum)] ascending — the
+        metric-log timer's pull."""
+        out = []
+        with self._lock:
+            for sec in sorted(self._sec):
+                if sec >= upto_rel_ms:
+                    break
+                f, o, ms = self._sec.pop(sec)
+                out.append((sec, int(f), int(o), ms))
+        return out
+
+    def snapshot(self, engine=None) -> dict:
+        """Everything the ``telemetry`` transport command serves."""
+        out = {
+            "enabled": self.enabled,
+            "ring_size": self.ring_size,
+            "spans_recorded": self._next_id,
+            "counters": self.counters_snapshot(),
+            "flush_ms": self.hist_flush.summary(),
+            "drain_ms": self.hist_drain.summary(),
+            "e2e_ms": self.hist_e2e.summary(),
+            "blocked_topk": [
+                {"resource": k, "weight": c, "max_error": e}
+                for k, c, e in self.sketch.topk(self.sketch_k or 10)
+            ],
+            "last_flush_blocked_topk": [
+                {"resource": k, "weight": w} for k, w in self.last_blocked_topk
+            ],
+            "recent_spans": [s.as_dict() for s in self.spans()[-16:]],
+        }
+        if engine is not None:
+            out["pipeline"] = engine.pipeline_stats()
+            out["pipeline_depth"] = engine.pipeline_depth
+            out["last_flush_host_ms"] = engine.last_flush_host_ms
+            pindex = getattr(engine, "param_index", None)
+            if pindex is not None and hasattr(pindex, "cache_stats"):
+                out["param_cache"] = pindex.cache_stats()
+        return out
+
+    def bench_summary(self) -> dict:
+        """Compact summary for bench.py's JSON line."""
+        c = self.counters_snapshot()
+        denom = c["arena_hits"] + c["arena_misses"]
+        return {
+            "flushes": c["flushes"],
+            "ops": c["ops"],
+            "flush_ms_p50": self.hist_flush.percentile(0.5),
+            "flush_ms_p99": self.hist_flush.percentile(0.99),
+            "e2e_ms_p50": self.hist_e2e.percentile(0.5),
+            "e2e_ms_p99": self.hist_e2e.percentile(0.99),
+            "drain_ms_p99": self.hist_drain.percentile(0.99),
+            "arena_hit_rate": round(c["arena_hits"] / denom, 4) if denom else 0.0,
+            "coalesced_fallbacks": c["coalesced_fallbacks"],
+            "blocked_topk": [
+                [k, c_] for k, c_, _ in self.sketch.topk(5)
+            ],
+        }
+
+
+# ----------------------------------------------------------------------
+# Chrome trace-event export (Perfetto-loadable)
+# ----------------------------------------------------------------------
+def spans_to_trace(spans: Sequence[FlushSpan], pid: int = 1) -> dict:
+    """Convert flight-recorder spans to the Chrome trace-event JSON
+    object format (Perfetto loads it directly).
+
+    Layout: every span's ``encode`` and ``dispatch`` slices go on tid 1
+    (``host``) — flush dispatches are serialized under the engine's
+    flush lock, so they never overlap. The dispatch→settle window of a
+    deferred flush (``inflight``: device execution + fetch latency)
+    goes on the first free ``inflight-N`` tid (greedy interval
+    assignment), so a depth-K pipeline shows K parallel tracks whose
+    slices overlap the NEXT flush's encode on the host track — the
+    visual proof that host encode overlaps device execution.
+
+    All ``ts``/``dur`` are µs relative to the earliest span."""
+    spans = list(spans)
+    if not spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    base = min(s.t0 for s in spans)
+
+    def us(t: float) -> float:
+        return (t - base) * 1e6
+
+    events: List[dict] = [
+        {"ph": "M", "pid": pid, "tid": 1, "name": "thread_name",
+         "args": {"name": "host"}},
+    ]
+    # Greedy tid assignment for in-flight windows: slot i is free when
+    # its last end <= the new start (small epsilon for fp jitter).
+    slot_ends: List[float] = []
+    named_slots = set()
+    for s in sorted(spans, key=lambda s: s.t0):
+        enc_start = us(s.t0)
+        enc_dur = s.encode_ms * 1e3
+        disp_start = enc_start + enc_dur
+        disp_dur = s.dispatch_ms * 1e3
+        args = {
+            "flush_id": s.flush_id, "rows": s.rows, "depth": s.depth,
+            "inflight": s.inflight, "deferred": s.deferred,
+        }
+        events.append({
+            "ph": "X", "pid": pid, "tid": 1, "name": "encode",
+            "cat": "flush", "ts": enc_start, "dur": enc_dur, "args": args,
+        })
+        events.append({
+            "ph": "X", "pid": pid, "tid": 1, "name": "dispatch",
+            "cat": "flush", "ts": disp_start, "dur": disp_dur, "args": args,
+        })
+        if s.settled and s.settle_end > s.t0:
+            fly_start = disp_start + disp_dur
+            fly_end = us(s.settle_end)
+            fly_dur = max(fly_end - fly_start, 0.0)
+            slot = None
+            for i, end in enumerate(slot_ends):
+                if end <= fly_start + 1e-3:
+                    slot = i
+                    break
+            if slot is None:
+                slot = len(slot_ends)
+                slot_ends.append(0.0)
+            slot_ends[slot] = fly_start + fly_dur
+            tid = 10 + slot
+            if tid not in named_slots:
+                named_slots.add(tid)
+                events.append({
+                    "ph": "M", "pid": pid, "tid": tid,
+                    "name": "thread_name",
+                    "args": {"name": f"inflight-{slot}"},
+                })
+            events.append({
+                "ph": "X", "pid": pid, "tid": tid, "name": "inflight",
+                "cat": "device", "ts": fly_start, "dur": fly_dur,
+                "args": args,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -431,6 +431,15 @@ class ParamIndex:
             dict() for _ in self.rules
         ]
         self._use_value_cache = config.get_bool(config.HOST_FASTPATH, True)
+        # Telemetry counters for the resolved-value cache (hits/misses
+        # on the bulk fast path) and value-row LRU evictions (any
+        # path). Plain ints — GIL-atomic increments on the submit hot
+        # path; they live and die with this index, so a param-rule
+        # reload (index rebuild) resets them to zero, which the
+        # invalidation test asserts.
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_evictions = 0
         self._hot: List[Dict[str, int]] = [
             {it.object: int(it.count) for it in r.param_flow_item_list} for r in self.rules
         ]
@@ -464,6 +473,7 @@ class ParamIndex:
             # resolved-value cache must never serve the old mapping.
             self._resolved[gid].pop(old_key, None)
             self.pending_resets.append(old_row)
+            self.cache_evictions += 1
             row = old_row
         elif self._free_rows:
             row = self._free_rows.pop()
@@ -585,6 +595,14 @@ class ParamIndex:
             # Pass 1: interned string values (the hot shape) resolve in
             # one C-level comprehension of dict gets.
             trips = [rget(v, miss) if type(v) is str else miss for v in values]
+            # trips.count runs at C speed. Hits/misses accumulate in
+            # locals and commit only when the column COMPLETES on this
+            # path — a bail to the exact path (eviction at the cap) or
+            # the per-entry path (collection value) redoes the work, so
+            # committing early would over-report exactly the
+            # eviction-pressure workloads the counters diagnose.
+            hits = n - trips.count(miss)
+            misses = 0
             # Pass 2: fix misses in place — list.index scans at C speed,
             # so all-hit columns pay one scan and zero Python-level
             # iterations here.
@@ -618,7 +636,10 @@ class ParamIndex:
                             return self._resolve_value_col_exact(
                                 gid, r, values, n
                             )
+                        misses += 1
                         trip = self._resolve_value(gid, r, key)
+                    else:
+                        hits += 1
                     extra_keys.append(key)
                     trips[j] = trip
                 j += 1
@@ -631,6 +652,8 @@ class ParamIndex:
             # their computed keys. (Comprehension, not set(values):
             # the type filter must run before hashing — an unhashable
             # non-collection value, e.g. a dict, is a legal arg.)
+            self.cache_hits += hits
+            self.cache_misses += misses
             touch = {v for v in values if type(v) is str}
             touch.update(extra_keys)
             vals_pop = vals.pop
@@ -711,6 +734,16 @@ class ParamIndex:
                 return None
             out.append((r,) + cols)
         return out
+
+    def cache_stats(self) -> Dict[str, int]:
+        """Intern/resolved-value cache counters for the telemetry bus.
+        ``interned`` is the live value-row population across rules."""
+        return {
+            "hits": self.cache_hits,
+            "misses": self.cache_misses,
+            "evictions": self.cache_evictions,
+            "interned": sum(len(v) for v in self._values),
+        }
 
     def take_resets(self) -> List[int]:
         out, self.pending_resets = self.pending_resets, []

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
@@ -52,6 +53,7 @@ from sentinel_tpu.metrics.nodes import (
     grow_stats,
     make_stats,
 )
+from sentinel_tpu.metrics.telemetry import TelemetryBus
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.models.rules import FlowRule
 from sentinel_tpu.rules.flow_table import FlowIndex, FlowRuleDynState
@@ -111,12 +113,12 @@ class _PendingFetch:
 
     __slots__ = (
         "_engine", "_entries", "_refs", "_fill", "_done", "_error",
-        "_lock", "_staging",
+        "_lock", "_staging", "_span",
     )
 
     def __init__(
         self, engine: "Engine", entries: List["_EntryOp"], refs: tuple,
-        fill, staging: Optional[List[tuple]] = None,
+        fill, staging: Optional[List[tuple]] = None, span=None,
     ) -> None:
         self._engine = engine
         self._entries = entries
@@ -128,6 +130,9 @@ class _PendingFetch:
         # Arena staging buffers held until the fetch completes (the
         # dispatched computation may read them zero-copy until then).
         self._staging = staging or []
+        # Flight-recorder span closed at materialization (None when
+        # telemetry is disabled).
+        self._span = span
 
     def materialize(self, got: Optional[tuple] = None) -> None:
         """Fetch + verdict fill + post work, exactly once. ``got`` is
@@ -140,6 +145,7 @@ class _PendingFetch:
         with self._lock:
             if not self._done:
                 items: Optional[List[tuple]] = None
+                t_fetch0 = time.perf_counter()
                 try:
                     if got is None:
                         t0 = time.perf_counter()
@@ -166,6 +172,15 @@ class _PendingFetch:
                         and self._engine._arena is not None
                     ):
                         self._engine._arena.give_all(staging)
+                span, self._span = self._span, None
+                if span is not None and self._error is None:
+                    # Close the flight-recorder span: for a coalesced
+                    # drain the fetch cost is in the drain histogram —
+                    # settle_t0 here times only this record's own fill
+                    # (plus its own fetch on the fallback path).
+                    self._engine.telemetry.settle(
+                        span, t_fetch0, time.perf_counter()
+                    )
                 entries, self._entries = self._entries, []
                 if self._error is None:
                     # Post-work failures (log IO, release RPCs) surface
@@ -450,6 +465,11 @@ class _EncodeArena:
     ) -> None:
         self._lock = threading.Lock()
         self._pool: "OrderedDict[tuple, List[tuple]]" = OrderedDict()
+        # Running pool hit/miss counters (telemetry): a take() served
+        # from the pool is a hit, a fresh build a miss. Monotonic; the
+        # flight recorder records per-flush deltas.
+        self.hits = 0
+        self.misses = 0
         self.max_keys = max(
             1,
             max_keys
@@ -475,7 +495,9 @@ class _EncodeArena:
         with self._lock:
             sets = self._pool.get(key)
             if sets:
+                self.hits += 1
                 return sets.pop()
+            self.misses += 1
         return build()
 
     def give(self, key: tuple, bufs: tuple) -> None:
@@ -562,6 +584,21 @@ class Engine:
             "encode_ms": 0.0, "dispatch_ms": 0.0, "kernel_ms": 0.0,
             "drain_ms": 0.0,
         }
+        # Engine flight recorder (metrics/telemetry.py): per-flush
+        # spans + histograms + blocked-resource sketch. When disabled,
+        # the hot path pays exactly one bool read per flush and the
+        # kernel sketch fold compiles away (sketch_k=0).
+        self.telemetry = TelemetryBus()
+        self._sketch_k = (
+            self.telemetry.sketch_k if self.telemetry.enabled else 0
+        )
+        # Baseline for per-span intern-cache deltas: (weakref to the
+        # param_index the totals came from, hits, misses) — a reload
+        # swaps the index and resets its counters, so the baseline must
+        # follow the IDENTITY. A weakref (not id()): a freed index's id
+        # can be reused by its replacement, which would keep a stale
+        # baseline; a dead weakref can't lie.
+        self._tele_intern_seen: Tuple[Optional[object], int, int] = (None, 0, 0)
         # Deferred fetches from flush_async / the pipelined flush,
         # oldest first. Lock order: _flush_lock → _pending_lock;
         # nothing under _pending_lock takes another engine lock. RLock:
@@ -1784,6 +1821,8 @@ class Engine:
             self._flush_timing["drain_ms"] = (
                 self._flush_timing.get("drain_ms", 0.0) + ms
             )
+        if self.telemetry.enabled:
+            self.telemetry.note_drain(ms)
 
     @property
     def pipeline_depth(self) -> int:
@@ -2037,6 +2076,16 @@ class Engine:
                     # Per-record fallback below attributes the failure
                     # to the record(s) that actually caused it.
                     fetched = None
+                    if self.telemetry.enabled:
+                        self.telemetry.note_fallback(1)
+                        for rec in recs:
+                            # Local bind: a concurrent materialize()
+                            # (verdict read on another thread) nulls
+                            # rec._span under the record's lock, which
+                            # this thread does not hold.
+                            span = rec._span
+                            if span is not None:
+                                span.fallbacks += 1
             it = iter(fetched) if fetched is not None else None
             for rec, refs in zip(recs, batch_refs):
                 got = next(it) if (it is not None and refs is not None) else None
@@ -2328,6 +2377,14 @@ class Engine:
                             vetoed_vals.append(int(a))
                     if vetoed_vals:
                         g.custom_veto_mask = np.isin(g.acquire, vetoed_vals)
+        # Flight recorder: one span per dispatched chunk. Disabled →
+        # tele is None and the whole block below is a handful of
+        # untaken branches.
+        tele = self.telemetry if self.telemetry.enabled else None
+        if tele is not None and self._arena is not None:
+            arena_h0, arena_m0 = self._arena.hits, self._arena.misses
+        else:
+            arena_h0 = arena_m0 = 0
         # Pow2 padding is shard-divisible on any power-of-two mesh once
         # raised to at least n_shards (enable_mesh enforces pow2).
         t_enc0 = time.perf_counter()
@@ -2467,8 +2524,9 @@ class Engine:
                 x_dgid[sl, j] = dg
             off_x += g.n
 
+        now_host = self.clock.now_ms()
         batch = FlushBatch(
-            now=jnp.int32(self.clock.now_ms()),
+            now=jnp.int32(now_host),
             e_valid=jnp.asarray(e_valid),
             e_ts=jnp.asarray(e_ts),
             e_acquire=jnp.asarray(e_acquire),
@@ -2520,6 +2578,9 @@ class Engine:
             with_exits=bool(exits) or bool(bulk_exits),
             shaping_rounds=sh_rounds,
             param_rounds=p_rounds,
+            # Device-side blocked-resource top-K fold (0 when telemetry
+            # is off — the sketch then compiles away entirely).
+            sketch_k=self._sketch_k,
             # Keys the jit cache on the live window geometry so a
             # retune_second_window with unchanged shapes (interval-only
             # change) cannot hit a stale-constant entry.
@@ -2550,6 +2611,34 @@ class Engine:
         with self._timing_lock:
             self._flush_timing["dispatch_ms"] += dispatch_ms
             self._flush_timing["kernel_ms"] += dispatch_ms
+
+        span = None
+        if tele is not None:
+            with self._pending_lock:
+                inflight = len(self._pending_fetches)
+            span = tele.begin_span(
+                t0=t_enc0, depth=self._pipeline_depth, inflight=inflight,
+                n_entries=len(entries), n_exits=len(exits),
+                n_bulk=n_bulk, n_bulk_exits=m_bulk,
+                deferred=defer, now_rel_ms=now_host,
+            )
+            span.encode_ms = (t_disp0 - t_enc0) * 1e3
+            span.dispatch_ms = dispatch_ms
+            if self._arena is not None:
+                span.arena_hits = self._arena.hits - arena_h0
+                span.arena_misses = self._arena.misses - arena_m0
+                tele.note_arena(span.arena_hits, span.arena_misses)
+            # Intern-cache activity since the previous span (the
+            # resolution itself happens at submit time, so the delta is
+            # attributed to the flush that drains those submissions).
+            ph = getattr(pindex, "cache_hits", 0)
+            pm = getattr(pindex, "cache_misses", 0)
+            seen_ref, h0, m0 = self._tele_intern_seen
+            if seen_ref is None or seen_ref() is not pindex:
+                h0 = m0 = 0  # index rebuilt (reload) — counters reset
+            span.intern_hits = max(0, ph - h0)
+            span.intern_misses = max(0, pm - m0)
+            self._tele_intern_seen = (weakref.ref(pindex), ph, pm)
 
         # Opt-in breaker state-change observers: capture THIS chunk's
         # post-flush state (tagged with epoch+seq — dispatches are
@@ -2590,15 +2679,22 @@ class Engine:
                 # drop them.
                 self._breaker_applied_seq = self._breaker_seq
 
+        has_sketch = result.blk_rows is not None
+
         def _fill(got):
             return self._fill_results(
                 got, entries, exits, bulk, bulk_exits, findex, dindex,
                 auth_rules, k, kd, breaker_snap=breaker_snap,
+                sketch=has_sketch,
             )
 
         refs = self._result_refs(result, breaker_snap)
         if defer:
-            rec = _PendingFetch(self, entries, refs, _fill, staging=staging)
+            if span is not None:
+                tele.dispatch_done(span)
+            rec = _PendingFetch(
+                self, entries, refs, _fill, staging=staging, span=span
+            )
             for op in entries:
                 op._pending = rec
             for g in bulk:
@@ -2618,6 +2714,8 @@ class Engine:
         # computation, so its staging is dropped to GC, never pooled.
         if self._arena is not None:
             self._arena.give_all(staging)
+        if span is not None:
+            tele.settle(span, t_fetch0, time.perf_counter())
         return res
 
     def _reset_breaker_mirror(self) -> None:
@@ -2671,9 +2769,54 @@ class Engine:
             result.sys_type,
             result.dslot_ok,
         )
+        if result.blk_rows is not None:
+            # Telemetry blocked-resource top-K rides the same coalesced
+            # fetch — no extra round-trip for "what is throttled now".
+            refs = refs + (result.blk_rows, result.blk_weight)
         if breaker_snap is not None:
             refs = refs + (breaker_snap[2],)
         return refs
+
+    def _fold_blocked_sketch(self, rows, weights) -> None:
+        """Resolve one fetched device top-K (cluster rows → resource
+        names) and fold it into the telemetry sketch. Weight 0 rows are
+        padding from top_k over an under-full batch."""
+        if not self.telemetry.enabled:
+            return
+        pairs: List[Tuple[str, int]] = []
+        n_keys = len(self.nodes)
+        for row, w in zip(
+            np.asarray(rows).tolist(), np.asarray(weights).tolist()
+        ):
+            if w <= 0 or not (0 <= row < n_keys):
+                continue
+            key = self.nodes.key_of(int(row))
+            # Node keys are "<kind>:<name>" (metrics/nodes.NodeKind).
+            pairs.append((key.partition(":")[2] or key, int(w)))
+        self.telemetry.fold_blocked_topk(pairs)
+
+    def _fold_blocked_recount(
+        self, entries: List[_EntryOp], bulk: Sequence[BulkOp]
+    ) -> None:
+        """Host-side exact recount of one chunk's blocked weight per
+        resource, folded into the telemetry sketch — the fallback for
+        flush paths whose kernel lacks the device top-K fold (the
+        sharded mesh flush). Verdicts must already be filled."""
+        agg: Dict[str, int] = {}
+        for op in entries:
+            v = op._verdict
+            if v is not None and not v.admitted:
+                agg[op.resource] = agg.get(op.resource, 0) + op.acquire
+        for g in bulk:
+            if g._admitted is not None:
+                w = int(g.acquire[~g._admitted].sum())
+                if w:
+                    agg[g.resource] = agg.get(g.resource, 0) + w
+        self.telemetry.fold_blocked_topk(
+            sorted(agg.items(), key=lambda kv: kv[1], reverse=True)[
+                : self._sketch_k
+            ]
+        )
 
     def _fill_results(
         self,
@@ -2688,6 +2831,7 @@ class Engine:
         k: int,
         kd: int,
         breaker_snap=None,
+        sketch: bool = False,
     ) -> List[tuple]:
         """Verdict fill for one dispatched chunk from its ALREADY
         FETCHED result tuple (``got`` = the host values of
@@ -2695,10 +2839,14 @@ class Engine:
         block-log items. Runs either synchronously at the end of
         _run_chunk or deferred from a _PendingFetch materialization."""
         admitted, reason, slot_ok, wait_ms, sys_type, dslot_ok = got[:6]
+        nxt = 6
+        if sketch:
+            self._fold_blocked_sketch(got[6], got[7])
+            nxt = 8
         if breaker_snap is not None:
             self._apply_breaker_snapshot(
                 breaker_snap[0], breaker_snap[1],
-                np.asarray(got[6], dtype=np.int32).reshape(-1), dindex,
+                np.asarray(got[nxt], dtype=np.int32).reshape(-1), dindex,
             )
         for i, op in enumerate(entries):
             blocked_rule = None
@@ -2757,6 +2905,13 @@ class Engine:
             g.wait_ms = np.array(wait_ms[sl])
             g._pending = None  # drop the chunk backref once filled
             off_b += g.n
+
+        if not sketch and self._sketch_k > 0:
+            # Kernel paths without the device fold (the sharded mesh
+            # flush) still feed the sketch: recount blocked weight
+            # host-side from the verdicts just filled — exact, and the
+            # data is already on the host.
+            self._fold_blocked_recount(entries, [g for g, _ in bulk_slices])
 
         # ---- block log + metric-extension callbacks ----
         # LogSlot (order −8000) writing sentinel-block.log, and the

@@ -135,6 +135,13 @@ class FlushResult(NamedTuple):
     occ_slot: jax.Array  # bool [N, K] — the specific slots that borrowed
     # (admission-gated); the sharded borrow budget charges these, not
     # the entry's other slots whose plain check passed
+    # Telemetry sketch fold (static sketch_k > 0 only, else None): the
+    # batch's top-K node rows by blocked acquire weight — computed
+    # where the verdicts are so "what is throttled right now" rides
+    # the existing coalesced device_get instead of a second round-trip
+    # (the data-plane heavy-hitter stance, arXiv:1611.04825).
+    blk_rows: Optional[jax.Array] = None  # int32 [sketch_k] cluster rows
+    blk_weight: Optional[jax.Array] = None  # int32 [sketch_k] blocked acquire sums
 
 
 # System block dimension codes (limit types in SystemBlockException).
@@ -619,8 +626,16 @@ def flush_entries(
     with_degrade: bool = True,
     shaping_rounds: int = 0,
     param_rounds: int = 0,
+    sketch_k: int = 0,
 ) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
     """Phases 2-3: admission checks and (when ``commit``) accounting.
+
+    ``sketch_k`` (static, 0 = off) folds a per-batch top-K
+    blocked-resource summary into the result: blocked acquire weight is
+    scatter-added per cluster-node row and the K heaviest rows ride the
+    verdict fetch (``FlushResult.blk_rows``/``blk_weight``) — exact
+    within the batch; the host merges batches into a space-saving
+    sketch (metrics/telemetry.py).
 
     ``shaping_rounds`` / ``param_rounds`` (static) are the host-known
     execution modes (negative = closed-form rank paths with
@@ -815,6 +830,26 @@ def flush_entries(
             minute_deltas=e_deltas_min,
         )
 
+    blk_rows = blk_weight = None
+    if sketch_k > 0:
+        # Blocked acquire weight per cluster-node row (e_rows[:, 1] is
+        # the resource's ClusterNode — always >= 0 for valid entries).
+        # Dense scatter-add into [n_rows + 1] with the last slot as the
+        # dump row for non-blocked/padding entries, then one top_k:
+        # O(n_rows) work against an already-O(n_rows)-sized state, and
+        # exact within the batch.
+        r_rows = stats.n_rows
+        blocked_w = jnp.where(
+            batch.e_valid & ~admitted, batch.e_acquire, 0
+        ).astype(jnp.int32)
+        crow = jnp.clip(batch.e_rows[:, 1], 0, r_rows - 1)
+        scat = jnp.where(blocked_w > 0, crow, jnp.int32(r_rows))
+        dense = jnp.zeros((r_rows + 1,), dtype=jnp.int32).at[scat].add(blocked_w)
+        blk_weight, blk_rows = jax.lax.top_k(
+            dense[:r_rows], min(sketch_k, r_rows)
+        )
+        blk_rows = blk_rows.astype(jnp.int32)
+
     result = FlushResult(
         admitted=admitted,
         reason=reason,
@@ -825,6 +860,8 @@ def flush_entries(
         flow_live=live2,
         occupied=occupied & admitted,
         occ_slot=occ_slot_nk & (admitted & occupied)[:, None],
+        blk_rows=blk_rows,
+        blk_weight=blk_weight,
     )
     return stats, flow_dyn, ddyn, pdyn, result
 
@@ -847,6 +884,7 @@ def flush_step(
     with_exits: bool = True,
     shaping_rounds: int = 0,
     param_rounds: int = 0,
+    sketch_k: int = 0,
 ) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
     """Pure function: apply one batch.
 
@@ -875,6 +913,7 @@ def flush_step(
         occupy_timeout_ms=occupy_timeout_ms,
         with_occupy=with_occupy, with_system=with_system, with_degrade=with_degrade,
         shaping_rounds=shaping_rounds, param_rounds=param_rounds,
+        sketch_k=sketch_k,
     )
 
 
@@ -890,7 +929,7 @@ def flush_step(
 # silently hit the stale-constant cache entry.
 _STATIC_FLAGS = (
     "occupy_timeout_ms", "with_occupy", "with_system", "with_degrade", "with_exits",
-    "shaping_rounds", "param_rounds", "win_key",
+    "shaping_rounds", "param_rounds", "sketch_k", "win_key",
 )
 
 
@@ -898,7 +937,7 @@ _STATIC_FLAGS = (
 def flush_step_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0, win_key=None,
+    shaping_rounds=0, param_rounds=0, sketch_k=0, win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch,
@@ -906,6 +945,7 @@ def flush_step_jit(
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
         shaping_rounds=shaping_rounds, param_rounds=param_rounds,
+        sketch_k=sketch_k,
     )
 
 
@@ -914,7 +954,7 @@ def flush_step_shaping_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
     occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0, win_key=None,
+    shaping_rounds=0, param_rounds=0, sketch_k=0, win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
@@ -922,6 +962,7 @@ def flush_step_shaping_jit(
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
         shaping_rounds=shaping_rounds, param_rounds=param_rounds,
+        sketch_k=sketch_k,
     )
 
 
@@ -930,7 +971,7 @@ def flush_step_param_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, param,
     occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0, win_key=None,
+    shaping_rounds=0, param_rounds=0, sketch_k=0, win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, None, param,
@@ -938,6 +979,7 @@ def flush_step_param_jit(
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
         shaping_rounds=shaping_rounds, param_rounds=param_rounds,
+        sketch_k=sketch_k,
     )
 
 
@@ -946,7 +988,7 @@ def flush_step_full_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
     occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0, win_key=None,
+    shaping_rounds=0, param_rounds=0, sketch_k=0, win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
@@ -954,4 +996,5 @@ def flush_step_full_jit(
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
         shaping_rounds=shaping_rounds, param_rounds=param_rounds,
+        sketch_k=sketch_k,
     )

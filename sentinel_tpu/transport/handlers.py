@@ -366,3 +366,25 @@ def prometheus_handler(req: CommandRequest) -> CommandResponse:
     return CommandResponse(
         True, render_metrics(_engine()), "text/plain; version=0.0.4; charset=utf-8"
     )
+
+
+@command_mapping(
+    "telemetry",
+    "engine flight recorder snapshot: spans, histograms, blocked sketch"
+    " [?spans=N for the last N ring spans]",
+)
+def telemetry_handler(req: CommandRequest) -> CommandResponse:
+    """The engine-internals view the per-resource commands cannot give:
+    flush/drain/e2e latency histograms, pipeline occupancy, arena and
+    intern-cache hit rates, coalesced-fetch fallbacks, and the
+    blocked-resource heavy-hitter sketch (metrics/telemetry.py)."""
+    engine = _engine()
+    tele = engine.telemetry
+    out = tele.snapshot(engine)
+    try:
+        n_spans = int(req.params.get("spans", "0"))
+    except ValueError:
+        return CommandResponse.of_failure("invalid spans count")
+    if n_spans > 0:
+        out["spans"] = [s.as_dict() for s in tele.spans()[-n_spans:]]
+    return CommandResponse.of_json(out)

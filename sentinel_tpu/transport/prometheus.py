@@ -5,6 +5,14 @@ the reference exports per-resource metric beans over JMX; the Python-
 native analog is a ``/metrics`` endpoint on the command center in the
 Prometheus exposition format (text/plain; version=0.0.4), scraping the
 same per-resource statistics the dashboard pulls.
+
+Beyond the reference's per-resource view, the scrape also exposes the
+engine internals the flight recorder collects (metrics/telemetry.py):
+``sentinel_engine_*`` counters, ``_bucket`` histogram series for
+flush/drain/end-to-end admission latency, the flush-pipeline occupancy
+gauge, the per-stage host breakdown of the most recent flush
+(``Engine.last_flush_host_ms`` — previously reachable only from
+bench.py), and the blocked-resource heavy-hitter sketch.
 """
 
 from __future__ import annotations
@@ -61,4 +69,110 @@ def render_metrics(engine) -> str:
     out.append(f"# HELP {_PREFIX}_resources Known protected resources")
     out.append(f"# TYPE {_PREFIX}_resources gauge")
     out.append(f"{_PREFIX}_resources {len(rows)}")
+    out.extend(engine_telemetry_lines(engine))
     return "\n".join(out) + "\n"
+
+
+def _counter(name: str, help_text: str, value) -> List[str]:
+    return [
+        f"# HELP {name} {help_text}",
+        f"# TYPE {name} counter",
+        f"{name} {value}",
+    ]
+
+
+def _gauge(name: str, help_text: str, value) -> List[str]:
+    return [
+        f"# HELP {name} {help_text}",
+        f"# TYPE {name} gauge",
+        f"{name} {value}",
+    ]
+
+
+def engine_telemetry_lines(engine) -> List[str]:
+    """The ``sentinel_engine_*`` family: flight-recorder counters,
+    latency histogram series, pipeline occupancy, last-flush host
+    breakdown, intern-cache counters and the blocked-resource sketch.
+    Rendered even when telemetry is disabled (zeros) so dashboards keep
+    their series."""
+    p = f"{_PREFIX}_engine"
+    tele = engine.telemetry
+    c = tele.counters_snapshot()
+    out: List[str] = []
+    out += _counter(f"{p}_flushes_total", "Dispatched flush chunks", c["flushes"])
+    out += _counter(f"{p}_ops_total", "Ops (entries+exits, incl. bulk rows) flushed", c["ops"])
+    out += _counter(
+        f"{p}_deferred_flushes_total",
+        "Flush chunks dispatched without an inline fetch (pipelined/async)",
+        c["deferred_flushes"],
+    )
+    out += _counter(
+        f"{p}_coalesced_fallback_total",
+        "Coalesced drain fetches that fell back to per-record fetches",
+        c["coalesced_fallbacks"],
+    )
+    out += _counter(f"{p}_arena_hits_total", "Encode-arena staging pool hits", c["arena_hits"])
+    out += _counter(f"{p}_arena_misses_total", "Encode-arena staging pool misses (fresh builds)", c["arena_misses"])
+
+    # Histograms: host-blocking flush time, coalesced drain fetches,
+    # end-to-end admission (dispatch start -> verdicts materialized).
+    out += tele.hist_flush.prometheus_lines(
+        f"{p}_flush_duration_ms", "Host-blocking flush duration, ms"
+    )
+    out += tele.hist_drain.prometheus_lines(
+        f"{p}_drain_duration_ms", "Coalesced device->host drain fetch duration, ms"
+    )
+    out += tele.hist_e2e.prometheus_lines(
+        f"{p}_e2e_duration_ms",
+        "End-to-end admission: encode start to verdicts materialized, ms",
+    )
+
+    # Flush pipeline occupancy (Engine.pipeline_stats — previously a
+    # bench.py dead end): mean in-flight depth per dispatching flush,
+    # and the 0..1 occupancy against the configured depth.
+    ps = engine.pipeline_stats()
+    depth = engine.pipeline_depth
+    occupancy = (ps["mean_inflight"] / depth) if depth > 0 else 0.0
+    out += _gauge(f"{p}_pipeline_depth", "Configured flush pipeline depth", depth)
+    out += _counter(
+        f"{p}_pipeline_dispatches_total",
+        "Dispatching deferred flushes since the last stats reset",
+        int(ps["dispatches"]),
+    )
+    out += _gauge(
+        f"{p}_pipeline_mean_inflight",
+        "Mean in-flight queue depth sampled per dispatching flush",
+        round(ps["mean_inflight"], 6),
+    )
+    out += _gauge(
+        f"{p}_pipeline_occupancy",
+        "Pipeline occupancy: mean in-flight depth / configured depth (0..1)",
+        round(occupancy, 6),
+    )
+
+    # Per-stage host breakdown of the most recent flush
+    # (Engine.last_flush_host_ms, wired off the bench-only path).
+    lf = engine.last_flush_host_ms
+    for stage in ("encode_ms", "dispatch_ms", "kernel_ms", "drain_ms"):
+        out += _gauge(
+            f"{p}_last_flush_{stage}",
+            f"Most recent flush host breakdown: {stage}",
+            round(lf.get(stage, 0.0), 6),
+        )
+
+    # ParamIndex intern-cache counters (host-ingest fast path).
+    pindex = getattr(engine, "param_index", None)
+    if pindex is not None and hasattr(pindex, "cache_stats"):
+        cs = pindex.cache_stats()
+        out += _counter(f"{p}_param_cache_hits_total", "Param resolved-value cache hits", cs["hits"])
+        out += _counter(f"{p}_param_cache_misses_total", "Param resolved-value cache misses", cs["misses"])
+        out += _counter(f"{p}_param_cache_evictions_total", "Param value-row LRU evictions", cs["evictions"])
+
+    # Blocked-resource heavy-hitter sketch (space-saving over the
+    # kernel's per-flush top-K): weight = blocked acquire sum.
+    name = f"{p}_blocked_weight"
+    out.append(f"# HELP {name} Blocked acquire weight per resource (space-saving sketch)")
+    out.append(f"# TYPE {name} gauge")
+    for key, cnt, _err in tele.sketch.topk(tele.sketch_k or 10):
+        out.append(f'{name}{{resource="{_escape_label(key)}"}} {cnt}')
+    return out

@@ -78,6 +78,19 @@ class SentinelConfig:
     # silently fall back to fresh allocations at depth).
     ARENA_MAX_KEYS = "sentinel.tpu.host.arena.max.keys"
     ARENA_PER_KEY = "sentinel.tpu.host.arena.per.key"
+    # Engine flight recorder (metrics/telemetry.py): per-flush spans,
+    # latency histograms and the blocked-resource sketch. Enabled by
+    # default — the off position compiles the kernel sketch fold away
+    # and skips every span record (the ≤2% overhead contract is
+    # enforced by the telemetry bench test).
+    TELEMETRY_ENABLED = "sentinel.tpu.telemetry.enabled"
+    TELEMETRY_RING = "sentinel.tpu.telemetry.ring"
+    # Device-side top-K blocked-resource candidates folded into each
+    # flush's kernel outputs (0 disables the fold entirely).
+    TELEMETRY_SKETCH_K = "sentinel.tpu.telemetry.sketch.k"
+    # Host-side space-saving summary capacity the per-flush top-Ks
+    # merge into.
+    TELEMETRY_SKETCH_CAP = "sentinel.tpu.telemetry.sketch.capacity"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -97,6 +110,10 @@ class SentinelConfig:
         PIPELINE_DEPTH: "0",
         ARENA_MAX_KEYS: "8",
         ARENA_PER_KEY: "4",
+        TELEMETRY_ENABLED: "true",
+        TELEMETRY_RING: "4096",
+        TELEMETRY_SKETCH_K: "8",
+        TELEMETRY_SKETCH_CAP: "64",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

@@ -1,0 +1,135 @@
+"""MetricSearcher's ``.idx`` second→offset seek across ROLLED files.
+
+The pre-existing tests only exercised a single live file; ISSUE 3 adds
+coverage for the rolled case: every rolled file carries its own
+``.idx``, the searcher must seek within each file (a late begin lands
+at the last relevant batch, never past un-indexed trailing lines), and
+stay exactly equal to a full linear scan (the index is an accelerator,
+never a filter)."""
+
+import os
+
+from sentinel_tpu.metrics.metric_log import (
+    MetricNodeLine,
+    MetricSearcher,
+    MetricWriter,
+)
+
+
+def _line(sec_ms: int, resource: str = "r", qps: int = 1) -> MetricNodeLine:
+    return MetricNodeLine(timestamp=sec_ms, resource=resource, pass_qps=qps)
+
+
+def _write_rolled(tmp_path, n_batches: int = 6, per_batch: int = 3):
+    """Tiny single_file_size → every batch rolls to a new file; returns
+    (writer, all_lines). Batch b covers seconds [b*per_batch,
+    (b+1)*per_batch) at wall second granularity."""
+    w = MetricWriter(
+        base_dir=str(tmp_path),
+        app_name="roll",
+        single_file_size=1,  # roll on every write after the first byte
+        total_file_count=100,  # keep everything
+    )
+    all_lines = []
+    for b in range(n_batches):
+        batch = [
+            _line((b * per_batch + i) * 1000, qps=b * 10 + i)
+            for i in range(per_batch)
+        ]
+        w.write(batch[-1].timestamp, batch)
+        all_lines += batch
+    return w, all_lines
+
+
+class TestRolledIdxSearch:
+    def test_rolled_files_each_have_idx(self, tmp_path):
+        w, _ = _write_rolled(tmp_path)
+        files = w._list_files()
+        assert len(files) == 6  # one batch per file at size 1
+        for f in files:
+            assert os.path.exists(f + ".idx")
+
+    def test_full_range_equals_linear_scan(self, tmp_path):
+        _, all_lines = _write_rolled(tmp_path)
+        s = MetricSearcher(base_dir=str(tmp_path), app_name="roll")
+        got = s.find(0, 2**61)
+        assert sorted(l.timestamp for l in got) == [
+            l.timestamp for l in all_lines
+        ]
+        assert {(l.timestamp, l.pass_qps) for l in got} == {
+            (l.timestamp, l.pass_qps) for l in all_lines
+        }
+
+    def test_late_range_spans_rolled_files(self, tmp_path):
+        _, all_lines = _write_rolled(tmp_path)
+        begin = 8 * 1000  # mid batch 2; batches 3..5 entirely inside
+        end = 14 * 1000
+        s = MetricSearcher(base_dir=str(tmp_path), app_name="roll")
+        got = s.find(begin, end)
+        want = [l for l in all_lines if begin <= l.timestamp <= end]
+        assert sorted(l.timestamp for l in got) == [l.timestamp for l in want]
+
+    def test_late_begin_seeks_to_last_batch(self, tmp_path):
+        """A begin past every indexed second seeks to the LAST batch's
+        offset (not past EOF, and never a whole-file skip — un-indexed
+        trailing lines from a failed .idx append must stay reachable)."""
+        w = MetricWriter(
+            base_dir=str(tmp_path), app_name="late",
+            single_file_size=1 << 30, total_file_count=10,
+        )
+        for b in range(3):
+            w.write(b * 1000, [_line(b * 1000, qps=b)])
+        (path,) = w._list_files()
+        off = MetricSearcher._start_offset(path, 10_000)
+        assert 0 < off < os.path.getsize(path)
+        # And a range starting at 0 scans every file from byte 0.
+        assert MetricSearcher._start_offset(path, 0) == 0
+
+    def test_unindexed_trailing_lines_still_found(self, tmp_path):
+        """Data append succeeded but the paired .idx append failed: the
+        trailing lines are past the last index entry and must still be
+        returned for a late range."""
+        w = MetricWriter(
+            base_dir=str(tmp_path), app_name="tail",
+            single_file_size=1 << 30, total_file_count=10,
+        )
+        for b in range(3):
+            w.write(b * 1000, [_line(b * 1000, qps=b)])
+        (path,) = w._list_files()
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(_line(50_000, qps=99).to_line() + "\n")  # no .idx entry
+        s = MetricSearcher(base_dir=str(tmp_path), app_name="tail")
+        got = s.find(40_000, 2**61)
+        assert [l.timestamp for l in got] == [50_000]
+
+    def test_seek_offset_within_multi_batch_file(self, tmp_path):
+        """One large file, many indexed batches: a late ``begin`` seeks
+        past the early batches' bytes but still returns every in-range
+        line."""
+        w = MetricWriter(
+            base_dir=str(tmp_path), app_name="one",
+            single_file_size=1 << 30, total_file_count=10,
+        )
+        all_lines = []
+        for b in range(8):
+            batch = [_line((b * 2 + i) * 1000, qps=b) for i in range(2)]
+            w.write(batch[-1].timestamp, batch)
+            all_lines += batch
+        (path,) = w._list_files()
+        begin = 9 * 1000
+        off = MetricSearcher._start_offset(path, begin)
+        assert off > 0  # actually seeks, not a full scan
+        s = MetricSearcher(base_dir=str(tmp_path), app_name="one")
+        got = s.find(begin, 2**61)
+        want = [l for l in all_lines if l.timestamp >= begin]
+        assert sorted(l.timestamp for l in got) == [l.timestamp for l in want]
+
+    def test_missing_or_corrupt_idx_degrades_to_full_scan(self, tmp_path):
+        _, all_lines = _write_rolled(tmp_path, n_batches=3)
+        s = MetricSearcher(base_dir=str(tmp_path), app_name="roll")
+        for f in s.writer_view._list_files():
+            if os.path.exists(f + ".idx"):
+                with open(f + ".idx", "w") as fh:
+                    fh.write("not an index\n")
+        got = s.find(0, 2**61)
+        assert len(got) == len(all_lines)  # correctness survives

@@ -1,0 +1,410 @@
+"""Engine flight recorder: spans, histograms, blocked sketch, exports.
+
+Covers metrics/telemetry.py + metrics/histogram.py, the kernel's
+device-side top-K blocked-resource fold (runtime/flush.py sketch_k),
+the Prometheus ``sentinel_engine_*`` family, the ``telemetry``
+transport command, ParamIndex intern-cache counters, and the
+metric-log ``__engine__`` roll-in."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.metrics.histogram import LatencyHistogram
+from sentinel_tpu.metrics.metric_log import MetricTimer, MetricWriter
+from sentinel_tpu.metrics.telemetry import SpaceSaving, TelemetryBus
+from sentinel_tpu.models.rules import ParamFlowRule
+from sentinel_tpu.utils.config import config
+
+
+class TestLatencyHistogram:
+    def test_pow2_bucket_placement(self):
+        h = LatencyHistogram(base_ms=1.0, n_buckets=4)  # bounds 1,2,4,8
+        for ms, want in [(0.0, 0), (1.0, 0), (1.5, 1), (2.0, 1), (2.5, 2),
+                         (4.0, 2), (7.9, 3), (8.0, 3), (8.1, 4), (1e9, 4)]:
+            h2 = LatencyHistogram(base_ms=1.0, n_buckets=4)
+            h2.record(ms)
+            counts, _ = h2.snapshot_counts()
+            assert counts[want] == 1, (ms, want, counts)
+        assert h.count == 0
+
+    def test_record_many_matches_record(self):
+        vals = [0.01, 0.5, 1.7, 3.3, 100.0, 1e6, 0.0]
+        a = LatencyHistogram()
+        b = LatencyHistogram()
+        for v in vals:
+            a.record(v)
+        b.record_many(vals)
+        ca, sa = a.snapshot_counts()
+        cb, sb = b.snapshot_counts()
+        assert (ca == cb).all() and sa == pytest.approx(sb)
+
+    def test_merge_and_percentile(self):
+        a = LatencyHistogram(base_ms=1.0, n_buckets=8)
+        b = LatencyHistogram(base_ms=1.0, n_buckets=8)
+        for _ in range(99):
+            a.record(1.0)  # bucket 0
+        b.record(100.0)  # bucket 7
+        a.merge(b)
+        assert a.count == 100
+        assert a.percentile(0.5) == 1.0
+        assert a.percentile(0.995) == 128.0  # the tail observation's bound
+        with pytest.raises(ValueError):
+            a.merge(LatencyHistogram(base_ms=2.0, n_buckets=8))
+
+    def test_prometheus_lines_cumulative(self):
+        h = LatencyHistogram(base_ms=1.0, n_buckets=3)  # bounds 1,2,4
+        for v in (0.5, 1.5, 3.0, 99.0):
+            h.record(v)
+        lines = h.prometheus_lines("x_ms", "help")
+        assert "# TYPE x_ms histogram" in lines
+        buckets = [l for l in lines if l.startswith("x_ms_bucket")]
+        counts = [int(l.rsplit(" ", 1)[1]) for l in buckets]
+        assert counts == [1, 2, 3, 4]  # cumulative, +Inf last
+        assert 'le="+Inf"' in buckets[-1]
+        assert any(l.startswith("x_ms_count") and l.endswith("4") for l in lines)
+
+
+class TestSpaceSaving:
+    def test_exact_below_capacity(self):
+        s = SpaceSaving(capacity=8)
+        for i in range(5):
+            s.offer(f"k{i}", i + 1)
+        s.offer("k4", 10)
+        top = dict((k, c) for k, c, _ in s.topk(8))
+        assert top["k4"] == 15 and top["k0"] == 1
+        assert all(e == 0 for _, _, e in s.topk(8))
+
+    def test_eviction_overestimates_bounded(self):
+        s = SpaceSaving(capacity=2)
+        s.offer("a", 100)
+        s.offer("b", 1)
+        s.offer("c", 50)  # evicts b (floor 1): count 51, error 1
+        top = {k: (c, e) for k, c, e in s.topk(3)}
+        assert "b" not in top
+        assert top["c"] == (51, 1)
+        assert top["a"] == (100, 0)
+
+
+class TestFlightRecorder:
+    def test_spans_per_flush_and_counters(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("fr", count=1e9)])
+        engine.submit_many([{"resource": "fr"} for _ in range(10)])
+        engine.flush()
+        spans = engine.telemetry.spans()
+        assert spans, "flush must record a span"
+        s = spans[-1]
+        assert s.n_entries == 10 and s.rows == 10
+        assert s.settled and not s.deferred
+        assert s.encode_ms >= 0.0 and s.dispatch_ms >= 0.0
+        c = engine.telemetry.counters_snapshot()
+        assert c["flushes"] >= 1 and c["ops"] >= 10
+        assert engine.telemetry.hist_flush.count >= 1
+        assert engine.telemetry.hist_e2e.count >= 1
+
+    def test_ring_is_bounded(self, manual_clock, engine):
+        engine.telemetry = TelemetryBus(ring=4)
+        st.flow_rule_manager.load_rules([st.FlowRule("rb", count=1e9)])
+        for _ in range(7):
+            engine.submit_entry("rb")
+            engine.flush()
+        tele = engine.telemetry
+        assert len(tele.spans()) == 4
+        assert tele.counters_snapshot()["flushes"] == 7
+
+    def test_pipelined_spans_settle_lazily(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("pp", count=1e9)])
+        engine.pipeline_depth = 2
+        try:
+            for _ in range(5):
+                engine.submit_bulk("pp", 16)
+                engine.flush()
+            tele = engine.telemetry
+            deferred = [s for s in tele.spans() if s.deferred]
+            assert deferred, "depth-2 flushes must record deferred spans"
+            assert any(not s.settled for s in deferred), (
+                "a depth-2 pipeline keeps unsettled spans in flight"
+            )
+            engine.drain()
+            assert all(s.settled for s in tele.spans())
+            # Every span's occupancy sample is within the depth bound.
+            assert all(0 <= s.inflight <= 2 for s in deferred)
+            assert tele.counters_snapshot()["deferred_flushes"] >= 5
+        finally:
+            engine.pipeline_depth = 0
+
+    def test_disabled_records_nothing(self, manual_clock):
+        from sentinel_tpu.runtime.engine import Engine
+
+        config.set(config.TELEMETRY_ENABLED, "false")
+        try:
+            eng = Engine()
+            assert not eng.telemetry.enabled
+            assert eng._sketch_k == 0  # kernel sketch fold compiled away
+            eng.set_flow_rules([st.FlowRule("off", count=1)])
+            for _ in range(3):
+                eng.submit_entry("off")
+                eng.flush()
+            assert eng.telemetry.spans() == []
+            assert eng.telemetry.counters_snapshot()["flushes"] == 0
+            assert eng.telemetry.sketch.topk(5) == []
+        finally:
+            config.set(config.TELEMETRY_ENABLED, "true")
+
+    def test_arena_counter_deltas(self, manual_clock, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("ar", count=1e9)])
+        for _ in range(3):
+            engine.submit_many([{"resource": "ar"} for _ in range(8)])
+            engine.flush()
+        spans = [s for s in engine.telemetry.spans() if s.n_entries == 8]
+        # Steady state: the repeated shape is served from the pool.
+        assert spans[-1].arena_hits > 0
+        c = engine.telemetry.counters_snapshot()
+        assert c["arena_hits"] + c["arena_misses"] > 0
+
+
+class TestBlockedSketch:
+    def test_topk_matches_exact_recount(self, manual_clock, engine):
+        """Differential: the device sketch must equal a host-side exact
+        recount of blocked acquire weight per resource."""
+        st.flow_rule_manager.load_rules(
+            [
+                st.FlowRule("s_hot", count=1),
+                st.FlowRule("s_warm", count=3),
+                st.FlowRule("s_free", count=1e9),
+            ]
+        )
+        manual_clock.set_ms(100)
+        reqs = (
+            [{"resource": "s_hot", "ts": 100} for _ in range(6)]
+            + [{"resource": "s_warm", "ts": 100} for _ in range(5)]
+            + [{"resource": "s_free", "ts": 100} for _ in range(4)]
+        )
+        ops = engine.submit_many(reqs)
+        engine.flush()
+        exact = {}
+        for op, req in zip(ops, reqs):
+            v = op.verdict
+            assert v is not None
+            if not v.admitted:
+                exact[req["resource"]] = exact.get(req["resource"], 0) + 1
+        assert exact, "test must actually block something"
+        got = dict(engine.telemetry.last_blocked_topk)
+        assert got == exact
+        # The running sketch agrees too (single flush, no merging yet).
+        sk = {k: c for k, c, _ in engine.telemetry.sketch.topk(8)}
+        for k, w in exact.items():
+            assert sk[k] == w
+
+    def test_host_recount_fallback_matches_device_fold(self, manual_clock, engine):
+        """Flush paths without the kernel fold (the sharded mesh flush)
+        feed the sketch via a host-side recount of the filled verdicts
+        — it must agree with what the device fold produced for the same
+        chunk."""
+        st.flow_rule_manager.load_rules([st.FlowRule("hr", count=2)])
+        manual_clock.set_ms(100)
+        ops = engine.submit_many(
+            [{"resource": "hr", "ts": 100} for _ in range(6)]
+        )
+        engine.flush()
+        device_topk = list(engine.telemetry.last_blocked_topk)
+        assert device_topk  # the kernel fold saw the blocks
+        engine.telemetry.last_blocked_topk = []
+        engine._fold_blocked_recount([op for op in ops if op is not None], [])
+        assert engine.telemetry.last_blocked_topk == device_topk
+
+    def test_mesh_flush_feeds_sketch(self, manual_clock, engine):
+        """The sharded path has no device fold; the host recount must
+        still populate the sketch (skipped where this environment's
+        jax lacks shard_map, like the other mesh tests)."""
+        try:
+            from jax import shard_map  # noqa: F401
+        except ImportError:
+            pytest.skip("jax.shard_map unavailable")
+        st.flow_rule_manager.load_rules([st.FlowRule("ms", count=4)])
+        engine.enable_mesh(8)
+        try:
+            manual_clock.set_ms(100)
+            g = engine.submit_bulk("ms", 64, ts=100)
+            engine.flush()
+            blocked = int((~g.admitted).sum())
+            assert blocked > 0
+            assert dict(engine.telemetry.last_blocked_topk)["ms"] == blocked
+        finally:
+            engine.disable_mesh()
+
+    def test_bulk_acquire_weights(self, manual_clock, engine):
+        """Weighted recount through the bulk path: blocked weight is the
+        acquire sum, not the op count."""
+        st.flow_rule_manager.load_rules([st.FlowRule("s_bulk", count=5)])
+        manual_clock.set_ms(100)
+        acquire = np.array([2, 2, 2, 3, 4], dtype=np.int32)
+        g = engine.submit_bulk("s_bulk", 5, ts=100, acquire=acquire)
+        engine.flush()
+        blocked_w = int(acquire[~g.admitted].sum())
+        assert blocked_w > 0
+        assert dict(engine.telemetry.last_blocked_topk)["s_bulk"] == blocked_w
+
+
+class TestInternCacheCounters:
+    def _param_engine(self, engine):
+        st.flow_rule_manager.load_rules([st.FlowRule("ic", count=1e9)])
+        engine.set_param_rules(
+            {"ic": [ParamFlowRule("ic", param_idx=0, count=1e9)]}
+        )
+
+    def test_bulk_hits_misses_and_reload_reset(self, manual_clock, engine):
+        self._param_engine(engine)
+        col = [f"ip{i % 4}" for i in range(16)]
+        engine.submit_bulk("ic", 16, ts=100, args_column=[(v,) for v in col])
+        engine.flush()
+        stats1 = engine.param_index.cache_stats()
+        assert stats1["misses"] == 4  # 4 distinct values resolve once
+        engine.submit_bulk("ic", 16, ts=200, args_column=[(v,) for v in col])
+        engine.flush()
+        stats2 = engine.param_index.cache_stats()
+        assert stats2["hits"] >= 16  # second window: all values cached
+        assert stats2["misses"] == 4
+        # Span attribution: the flush that drained the submissions
+        # carries the intern delta.
+        span = engine.telemetry.spans()[-1]
+        assert span.intern_hits >= 16
+        # Reload invalidates the cache wholesale — counters reset.
+        self._param_engine(engine)
+        stats3 = engine.param_index.cache_stats()
+        assert stats3 == {"hits": 0, "misses": 0, "evictions": 0, "interned": 0}
+        # Telemetry snapshot surfaces the live (post-reload) counters.
+        snap = engine.telemetry.snapshot(engine)
+        assert snap["param_cache"]["hits"] == 0
+
+
+class TestExports:
+    def test_prometheus_engine_series(self, manual_clock, engine):
+        from sentinel_tpu.transport.prometheus import render_metrics
+
+        st.flow_rule_manager.load_rules([st.FlowRule("pm", count=1)])
+        manual_clock.set_ms(50)
+        for _ in range(3):
+            st.try_entry("pm")
+        text = render_metrics(engine)
+        for needle in (
+            "sentinel_engine_flush_duration_ms_bucket",
+            "sentinel_engine_drain_duration_ms_bucket",
+            "sentinel_engine_e2e_duration_ms_bucket",
+            "sentinel_engine_pipeline_occupancy",
+            "sentinel_engine_pipeline_mean_inflight",
+            "sentinel_engine_last_flush_encode_ms",
+            "sentinel_engine_last_flush_dispatch_ms",
+            "sentinel_engine_flushes_total",
+            "sentinel_engine_coalesced_fallback_total",
+            "sentinel_engine_param_cache_hits_total",
+        ):
+            assert needle in text, needle
+        assert 'sentinel_engine_blocked_weight{resource="pm"}' in text
+        # The flush histogram actually accumulated observations.
+        count_line = [
+            l for l in text.splitlines()
+            if l.startswith("sentinel_engine_flush_duration_ms_count")
+        ][0]
+        assert int(count_line.rsplit(" ", 1)[1]) >= 1
+
+    def test_pipeline_occupancy_gauge(self, manual_clock, engine):
+        from sentinel_tpu.transport.prometheus import engine_telemetry_lines
+
+        st.flow_rule_manager.load_rules([st.FlowRule("po", count=1e9)])
+        engine.pipeline_depth = 2
+        try:
+            engine.pipeline_stats(reset=True)
+            for _ in range(8):
+                engine.submit_bulk("po", 8)
+                engine.flush()
+            lines = engine_telemetry_lines(engine)
+        finally:
+            engine.pipeline_depth = 0
+            engine.drain()
+        occ = [
+            float(l.rsplit(" ", 1)[1])
+            for l in lines
+            if l.startswith("sentinel_engine_pipeline_occupancy ")
+        ][0]
+        assert 0.0 < occ <= 1.0
+
+    def test_telemetry_command(self, manual_clock, engine):
+        from sentinel_tpu.transport import handlers
+        from sentinel_tpu.transport.command_center import CommandRequest
+
+        st.flow_rule_manager.load_rules([st.FlowRule("tc", count=1)])
+        for _ in range(3):
+            st.try_entry("tc")
+        resp = handlers.telemetry_handler(
+            CommandRequest(path="telemetry", params={"spans": "2"}, body="")
+        )
+        assert resp.success
+        d = json.loads(resp.result)
+        assert d["enabled"] is True
+        assert d["counters"]["flushes"] >= 3
+        assert d["flush_ms"]["count"] >= 3
+        assert len(d["spans"]) == 2
+        assert {"resource": "tc", "weight": 1} in d["last_flush_blocked_topk"]
+        assert d["pipeline_depth"] == 0
+        bad = handlers.telemetry_handler(
+            CommandRequest(path="telemetry", params={"spans": "x"}, body="")
+        )
+        assert not bad.success
+
+    def test_metric_log_engine_rollin(self, manual_clock, engine, tmp_path):
+        st.flow_rule_manager.load_rules([st.FlowRule("ml", count=1e9)])
+        for sec in range(2):
+            for i in range(4):
+                manual_clock.set_ms(sec * 1000 + i * 10)
+                with st.entry("ml"):
+                    pass
+        manual_clock.set_ms(2500)
+        timer = MetricTimer(
+            engine, writer=MetricWriter(base_dir=str(tmp_path), app_name="tele")
+        )
+        lines = timer.run_once()
+        eng_lines = [l for l in lines if l.resource == "__engine__"]
+        assert len(eng_lines) == 2  # seconds 0 and 1
+        # entry() flushes per call: >= 4 flushes and >= 4 ops per second
+        # (exits flush too).
+        assert all(l.pass_qps >= 4 for l in eng_lines)
+        assert all(l.success_qps >= 4 for l in eng_lines)
+        # Sorted into the per-second stream, parseable from disk.
+        ts = [l.timestamp for l in lines]
+        assert ts == sorted(ts)
+
+
+@pytest.mark.slow
+class TestOverhead:
+    def test_enabled_within_2pct_of_disabled(self, manual_clock):
+        """Recorder overhead contract: the telemetry-enabled engine
+        stays within 2% of telemetry-disabled on the deferred-mode
+        loop (median of repeats; slow tier — wall-clock sensitive)."""
+        from sentinel_tpu.runtime.engine import Engine
+
+        def run(enabled: bool) -> float:
+            config.set(config.TELEMETRY_ENABLED, "true" if enabled else "false")
+            try:
+                eng = Engine()
+                eng.set_flow_rules([st.FlowRule("ov", count=1e9)])
+                reqs = [{"resource": "ov", "ts": 100} for _ in range(2048)]
+                eng.submit_many(reqs)
+                eng.flush()  # warm-up/compile
+                best = float("inf")
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    for _ in range(10):
+                        eng.submit_many(reqs)
+                        eng.flush()
+                    best = min(best, time.perf_counter() - t0)
+                return best
+            finally:
+                config.set(config.TELEMETRY_ENABLED, "true")
+
+        t_off = run(False)
+        t_on = run(True)
+        assert t_on <= t_off * 1.02 + 0.01, (t_on, t_off)
