@@ -65,6 +65,11 @@ from sentinel_tpu.rules.degrade_manager import degrade_rule_manager
 from sentinel_tpu.rules.system_manager import system_rule_manager
 from sentinel_tpu.rules.authority_manager import authority_rule_manager
 from sentinel_tpu.rules.param_manager import param_flow_rule_manager
+from sentinel_tpu.metrics.admission_trace import (
+    TraceContext,
+    inject_trace_headers,
+    parse_traceparent,
+)
 from sentinel_tpu.metrics.window_properties import (
     interval_property,
     sample_count_property,
@@ -100,6 +105,9 @@ __all__ = [
     "AuthorityRule",
     "ParamFlowRule",
     "BulkOp",
+    "TraceContext",
+    "inject_trace_headers",
+    "parse_traceparent",
     "constants",
     "flow_rule_manager",
     "degrade_rule_manager",

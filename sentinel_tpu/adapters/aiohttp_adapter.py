@@ -25,7 +25,9 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from sentinel_tpu.core import api
+from sentinel_tpu.core.context import ContextUtil
 from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.metrics.admission_trace import parse_traceparent
 from sentinel_tpu.models import constants as C
 
 BLOCK_BODY = "Blocked by Sentinel (flow limiting)"
@@ -54,27 +56,40 @@ def sentinel_middleware(
             resources.append(total_resource)
         resources.append(extract(request))
         origin = parse_origin(request)
+        # Inbound W3C trace context, ambient through the handler and
+        # any guarded outbound calls it makes.
+        token = ContextUtil.set_trace(
+            parse_traceparent(
+                request.headers.get("traceparent"),
+                request.headers.get("tracestate", ""),
+            )
+        )
         entries = []
         try:
-            for res in resources:
-                entries.append(
-                    api.entry_async(res, entry_type=C.EntryType.IN, origin=origin)
-                )
-        except BlockError:
-            for en in reversed(entries):
-                en.exit()
-            return web.Response(status=block_status, text=block_body)
-        try:
-            return await handler(request)
-        except web.HTTPException:
-            raise  # normal aiohttp control flow, not a fault
-        except BaseException as e:
-            for en in entries:
-                en.set_error(e)
-            raise
+            try:
+                for res in resources:
+                    entries.append(
+                        api.entry_async(
+                            res, entry_type=C.EntryType.IN, origin=origin
+                        )
+                    )
+            except BlockError:
+                for en in reversed(entries):
+                    en.exit()
+                return web.Response(status=block_status, text=block_body)
+            try:
+                return await handler(request)
+            except web.HTTPException:
+                raise  # normal aiohttp control flow, not a fault
+            except BaseException as e:
+                for en in entries:
+                    en.set_error(e)
+                raise
+            finally:
+                for en in reversed(entries):
+                    en.exit()
         finally:
-            for en in reversed(entries):
-                en.exit()
+            ContextUtil.reset_trace(token)
 
     return _middleware
 
@@ -142,7 +157,10 @@ class SentinelClientSession:
         await self._session.close()
 
     async def _request(self, method: str, url, **kwargs):
-        from sentinel_tpu.adapters.client import guard_call_async
+        from sentinel_tpu.adapters.client import (
+            _with_trace_headers,
+            guard_call_async,
+        )
 
         resource = self._extract(method, url)
         return await guard_call_async(
@@ -151,7 +169,7 @@ class SentinelClientSession:
             method,
             url,
             fallback=self._fallback,
-            **kwargs,
+            **_with_trace_headers(kwargs),
         )
 
     def request(self, method, url, **kwargs) -> _GuardedRequestCtx:

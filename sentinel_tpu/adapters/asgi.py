@@ -12,10 +12,23 @@ from typing import Callable, Optional
 from sentinel_tpu.core import api
 from sentinel_tpu.core.context import ContextUtil
 from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.metrics.admission_trace import parse_traceparent
 from sentinel_tpu.models import constants as C
 
 DEFAULT_BLOCK_BODY = b"Blocked by Sentinel (flow limiting)"
 WEB_CONTEXT_NAME = "sentinel_web_context"
+
+
+def _scope_trace(scope):
+    """Inbound W3C trace context from the ASGI header list (keys are
+    lower-cased bytes per the ASGI spec)."""
+    tp, ts = None, ""
+    for k, v in scope.get("headers") or ():
+        if k == b"traceparent":
+            tp = v.decode("latin-1")
+        elif k == b"tracestate":
+            ts = v.decode("latin-1")
+    return parse_traceparent(tp, ts)
 
 
 class SentinelASGIMiddleware:
@@ -40,6 +53,9 @@ class SentinelASGIMiddleware:
             return
         resource = self.resource_extractor(scope)
         origin = self.origin_parser(scope)
+        # Inbound trace identity rides the context into every entry's
+        # admission record and out through guarded downstream clients.
+        trace_token = ContextUtil.set_trace(_scope_trace(scope))
         ctx = ContextUtil.enter(WEB_CONTEXT_NAME, origin)
         entries = []
         try:
@@ -67,3 +83,4 @@ class SentinelASGIMiddleware:
             for en in reversed(entries):
                 en.exit()
             ContextUtil.exit()
+            ContextUtil.reset_trace(trace_token)

@@ -12,9 +12,25 @@ from typing import Callable, Optional, TypeVar
 
 from sentinel_tpu.core import api
 from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.metrics.admission_trace import inject_trace_headers
 from sentinel_tpu.models import constants as C
 
 T = TypeVar("T")
+
+
+def _with_trace_headers(kwargs: dict) -> dict:
+    """Outbound W3C propagation for kwargs-style clients: when a trace
+    is ambient, return kwargs with a COPY of ``headers`` carrying a
+    child ``traceparent`` (the caller's mapping is never mutated);
+    otherwise return kwargs unchanged."""
+    hdrs: dict = {}
+    if inject_trace_headers(hdrs) is None:
+        return kwargs
+    merged = dict(kwargs.get("headers") or {})
+    merged.update(hdrs)
+    out = dict(kwargs)
+    out["headers"] = merged
+    return out
 
 
 def guard_call(resource: str, fn: Callable[..., T], *args, fallback=None, **kwargs) -> T:
@@ -54,7 +70,7 @@ class GuardedClient:
         resource = self._extract(method, url)
         return guard_call(
             resource, self._client.request, method, url, *args,
-            fallback=self._fallback, **kwargs,
+            fallback=self._fallback, **_with_trace_headers(kwargs),
         )
 
     def get(self, url: str, **kwargs):
@@ -134,7 +150,7 @@ class GuardedAsyncClient:
         resource = self._extract(method, str(url))
         return await guard_call_async(
             resource, self._client.request, method, url, *args,
-            fallback=self._fallback, **kwargs,
+            fallback=self._fallback, **_with_trace_headers(kwargs),
         )
 
     async def get(self, url: str, **kwargs):

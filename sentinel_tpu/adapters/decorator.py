@@ -31,15 +31,45 @@ def sentinel_resource(
     default_fallback: Optional[Callable] = None,
     exceptions_to_ignore: Tuple[type, ...] = (),
     param_args: bool = False,
+    traceparent_extractor: Optional[Callable] = None,
 ):
     """Decorate a callable as a protected resource.
 
     ``param_args=True`` forwards the call's positional arguments to
     hot-parameter rules (SphU.entry(..., args)).
+
+    ``traceparent_extractor(*args, **kwargs)`` — when given, called
+    with the invocation arguments and expected to return the inbound
+    W3C ``traceparent`` header string (or None): the decorator's
+    inbound parse for message-consumer / task-queue shapes where the
+    carrier is an argument (a message envelope, a job payload) rather
+    than an HTTP request. The parsed context is ambient for the whole
+    call, so the admission record and any guarded outbound hop carry
+    the producer's trace id.
     """
 
     def deco(fn: Callable) -> Callable:
         name = resource or f"{fn.__module__}:{fn.__qualname__}"
+
+        def trace_token(args, kwargs):
+            """set_trace token for this call, or None when no
+            extractor is configured (zero ambient writes then)."""
+            if traceparent_extractor is None:
+                return None
+            from sentinel_tpu.core.context import ContextUtil
+            from sentinel_tpu.metrics.admission_trace import parse_traceparent
+
+            try:
+                header = traceparent_extractor(*args, **kwargs)
+            except Exception:
+                header = None  # a broken extractor must not fail the call
+            return ContextUtil.set_trace(parse_traceparent(header))
+
+        def trace_reset(token):
+            if token is not None:
+                from sentinel_tpu.core.context import ContextUtil
+
+                ContextUtil.reset_trace(token)
 
         def handle_block(e: BlockError, args, kwargs):
             if block_handler is not None:
@@ -60,6 +90,43 @@ def sentinel_resource(
 
             @functools.wraps(fn)
             async def async_wrapper(*args, **kwargs):
+                token = trace_token(args, kwargs)
+                try:
+                    try:
+                        entry = api.entry(
+                            name,
+                            entry_type=entry_type,
+                            args=args if param_args else (),
+                        )
+                    except BlockError as e:
+                        return handle_block(e, args, kwargs)
+                    try:
+                        result = await fn(*args, **kwargs)
+                    except BlockError:
+                        # A nested guarded call blocked: pass it through
+                        # untraced, but the OUTER entry still completes
+                        # (a leaked entry pins its thread slot forever).
+                        entry.exit()
+                        raise
+                    except BaseException as e:
+                        # Per-decorator ignores gate here (the annotation
+                        # check, AbstractSentinelAspectSupport.java:44-53);
+                        # the global Tracer filters apply inside set_error.
+                        if not isinstance(e, exceptions_to_ignore):
+                            entry.set_error(e)
+                        entry.exit()
+                        return handle_fallback(e, args, kwargs)
+                    entry.exit()
+                    return result
+                finally:
+                    trace_reset(token)
+
+            return async_wrapper
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            token = trace_token(args, kwargs)
+            try:
                 try:
                     entry = api.entry(
                         name,
@@ -69,43 +136,21 @@ def sentinel_resource(
                 except BlockError as e:
                     return handle_block(e, args, kwargs)
                 try:
-                    result = await fn(*args, **kwargs)
+                    result = fn(*args, **kwargs)
                 except BlockError:
+                    # See async_wrapper: the outer entry must exit even
+                    # when a nested guarded call blocked.
+                    entry.exit()
                     raise
                 except BaseException as e:
-                    # Per-decorator ignores gate here (the annotation
-                    # check, AbstractSentinelAspectSupport.java:44-53);
-                    # the global Tracer filters apply inside set_error.
                     if not isinstance(e, exceptions_to_ignore):
                         entry.set_error(e)
                     entry.exit()
                     return handle_fallback(e, args, kwargs)
                 entry.exit()
                 return result
-
-            return async_wrapper
-
-        @functools.wraps(fn)
-        def wrapper(*args, **kwargs):
-            try:
-                entry = api.entry(
-                    name,
-                    entry_type=entry_type,
-                    args=args if param_args else (),
-                )
-            except BlockError as e:
-                return handle_block(e, args, kwargs)
-            try:
-                result = fn(*args, **kwargs)
-            except BlockError:
-                raise
-            except BaseException as e:
-                if not isinstance(e, exceptions_to_ignore):
-                    entry.set_error(e)
-                entry.exit()
-                return handle_fallback(e, args, kwargs)
-            entry.exit()
-            return result
+            finally:
+                trace_reset(token)
 
         return wrapper
 

@@ -23,7 +23,9 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from sentinel_tpu.core import api
+from sentinel_tpu.core.context import ContextUtil
 from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.metrics.admission_trace import parse_traceparent
 from sentinel_tpu.models import constants as C
 
 BLOCK_DETAIL = "Blocked by Sentinel (flow limiting)"
@@ -45,17 +47,32 @@ def sentinel_guard(
         path = getattr(route, "path", None) or request.url.path
         res = resource or f"{request.method}:{path}"
         origin = origin_parser(request) if origin_parser else ""
+        # Inbound W3C trace context, ambient through the handler (the
+        # dependency's contextvars scope spans the endpoint call).
+        token = ContextUtil.set_trace(
+            parse_traceparent(
+                request.headers.get("traceparent"),
+                request.headers.get("tracestate", ""),
+            )
+        )
         try:
-            entry = api.entry_async(res, entry_type=C.EntryType.IN, origin=origin)
-        except BlockError:
-            raise HTTPException(status_code=block_status, detail=BLOCK_DETAIL)
-        try:
-            yield entry
-        except BaseException as e:
-            entry.set_error(e)
-            raise
+            try:
+                entry = api.entry_async(
+                    res, entry_type=C.EntryType.IN, origin=origin
+                )
+            except BlockError:
+                raise HTTPException(
+                    status_code=block_status, detail=BLOCK_DETAIL
+                )
+            try:
+                yield entry
+            except BaseException as e:
+                entry.set_error(e)
+                raise
+            finally:
+                entry.exit()
         finally:
-            entry.exit()
+            ContextUtil.reset_trace(token)
 
     # FastAPI resolves the Request parameter by annotation; attach it
     # lazily so importing this module works without fastapi installed.

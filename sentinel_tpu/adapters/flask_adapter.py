@@ -23,11 +23,14 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from sentinel_tpu.core import api
+from sentinel_tpu.core.context import ContextUtil
 from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.metrics.admission_trace import parse_traceparent
 from sentinel_tpu.models import constants as C
 
 BLOCK_BODY = "Blocked by Sentinel (flow limiting)"
 _ENTRIES_KEY = "_sentinel_entries"
+_TRACE_TOKEN_KEY = "_sentinel_trace_token"
 
 
 class SentinelFlask:
@@ -71,6 +74,16 @@ class SentinelFlask:
                 resources.append(ext.total_resource)
             resources.append(ext._resource(request))
             origin = ext.origin_parser(request)
+            # Inbound W3C trace context: ambient for the whole request
+            # (handler + guarded outbound calls); the token is reset at
+            # teardown so a reused worker thread never leaks identity.
+            token = ContextUtil.set_trace(
+                parse_traceparent(
+                    request.headers.get("traceparent"),
+                    request.headers.get("tracestate", ""),
+                )
+            )
+            setattr(g, _TRACE_TOKEN_KEY, token)
             entries = []
             try:
                 for res in resources:
@@ -88,6 +101,10 @@ class SentinelFlask:
 
         @app.teardown_request
         def _sentinel_exit(exc):
+            token = getattr(g, _TRACE_TOKEN_KEY, None)
+            if token is not None:
+                setattr(g, _TRACE_TOKEN_KEY, None)
+                ContextUtil.reset_trace(token)
             entries = getattr(g, _ENTRIES_KEY, None)
             if not entries:
                 return

@@ -342,8 +342,19 @@ gateway_api_definition_manager = GatewayApiDefinitionManager()
 def gateway_entry(route_id: str, info: GatewayRequestInfo):
     """Enter the route resource (+ any matching custom-API resources)
     with the extracted gateway params; the GatewayFlowSlot equivalent.
-    Raises ParamFlowBlockError/BlockError when limited."""
+    Raises ParamFlowBlockError/BlockError when limited. An inbound
+    W3C ``traceparent`` in ``info.headers`` becomes the ambient trace
+    identity for the admissions and the proxied call."""
+    from sentinel_tpu.core.context import ContextUtil
+    from sentinel_tpu.metrics.admission_trace import parse_traceparent
+
     resources = [route_id] + gateway_api_definition_manager.matching_apis(info.path)
+    trace_token = ContextUtil.set_trace(
+        parse_traceparent(
+            info.headers.get("traceparent"),
+            info.headers.get("tracestate", ""),
+        )
+    )
     entries = []
     try:
         for res in resources:
@@ -360,6 +371,7 @@ def gateway_entry(route_id: str, info: GatewayRequestInfo):
     finally:
         for en in reversed(entries):
             en.exit()
+        ContextUtil.reset_trace(trace_token)
 
 
 def gateway_submit_bulk(

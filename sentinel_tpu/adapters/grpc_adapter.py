@@ -3,6 +3,12 @@
 Reference: sentinel-grpc-adapter's SentinelGrpcServerInterceptor /
 SentinelGrpcClientInterceptor. Gated on grpcio being installed (it is
 not a framework dependency).
+
+W3C trace context rides gRPC metadata under the standard lowercase
+``traceparent`` / ``tracestate`` keys (the gRPC transport for W3C
+trace-context): the server interceptor parses them so the admission —
+and any guarded outbound call the handler makes — carries the caller's
+trace identity; the client interceptor injects a child span outbound.
 """
 
 from __future__ import annotations
@@ -10,7 +16,14 @@ from __future__ import annotations
 from typing import Optional
 
 from sentinel_tpu.core import api
+from sentinel_tpu.core.context import ContextUtil
 from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.metrics.admission_trace import (
+    TRACEPARENT_HEADER,
+    TRACESTATE_HEADER,
+    inject_trace_headers,
+    parse_traceparent,
+)
 from sentinel_tpu.models import constants as C
 
 try:  # pragma: no cover - exercised only when grpcio is present
@@ -24,6 +37,31 @@ def _require_grpc():
         raise ImportError("grpcio is not installed; gRPC adapters unavailable")
 
 
+def trace_from_metadata(metadata) -> Optional[object]:
+    """Inbound W3C trace context from a gRPC metadata sequence of
+    (key, value) pairs (keys are lowercase on the wire). Shared by the
+    server interceptor and directly testable without grpcio."""
+    tp, ts = None, ""
+    for k, v in metadata or ():
+        if k == TRACEPARENT_HEADER:
+            tp = v if isinstance(v, str) else v.decode("latin-1")
+        elif k == TRACESTATE_HEADER:
+            ts = v if isinstance(v, str) else v.decode("latin-1")
+    return parse_traceparent(tp, ts)
+
+
+def metadata_with_trace(metadata) -> list:
+    """Outbound injection: the given metadata (or ()) plus a child
+    ``traceparent``/``tracestate`` of the ambient trace; unchanged
+    when no trace is ambient. Shared by the client interceptor and
+    directly testable without grpcio."""
+    md = list(metadata or ())
+    hdrs: dict = {}
+    if inject_trace_headers(hdrs) is not None:
+        md.extend(hdrs.items())
+    return md
+
+
 if grpc is not None:
 
     class SentinelServerInterceptor(grpc.ServerInterceptor):  # pragma: no cover
@@ -31,6 +69,10 @@ if grpc is not None:
 
         def intercept_service(self, continuation, handler_call_details):
             resource = handler_call_details.method
+            tc = trace_from_metadata(
+                getattr(handler_call_details, "invocation_metadata", ())
+            )
+            token = ContextUtil.set_trace(tc)
             try:
                 entry = api.entry(resource, entry_type=C.EntryType.IN)
             except BlockError:
@@ -40,6 +82,8 @@ if grpc is not None:
                     )
 
                 return grpc.unary_unary_rpc_method_handler(abort)
+            finally:
+                ContextUtil.reset_trace(token)
             handler = continuation(handler_call_details)
             if handler is None or not handler.unary_unary:
                 entry.exit()
@@ -48,6 +92,10 @@ if grpc is not None:
             inner = handler.unary_unary
 
             def wrapped(request, context):
+                # The handler may run on another thread: re-establish
+                # the caller's trace identity around it so guarded
+                # outbound calls propagate it.
+                tok = ContextUtil.set_trace(tc)
                 try:
                     return inner(request, context)
                 except BaseException as e:
@@ -55,6 +103,7 @@ if grpc is not None:
                     raise
                 finally:
                     entry.exit()
+                    ContextUtil.reset_trace(tok)
 
             return grpc.unary_unary_rpc_method_handler(
                 wrapped,
@@ -62,16 +111,37 @@ if grpc is not None:
                 response_serializer=handler.response_serializer,
             )
 
+    class _TracedClientCallDetails(
+        grpc.ClientCallDetails
+    ):  # pragma: no cover
+        """ClientCallDetails copy with replaced metadata (the grpc API
+        gives no mutation surface)."""
+
+        def __init__(self, base, metadata) -> None:
+            self.method = base.method
+            self.timeout = getattr(base, "timeout", None)
+            self.metadata = metadata
+            self.credentials = getattr(base, "credentials", None)
+            self.wait_for_ready = getattr(base, "wait_for_ready", None)
+            self.compression = getattr(base, "compression", None)
+
     class SentinelClientInterceptor(
         grpc.UnaryUnaryClientInterceptor
     ):  # pragma: no cover
-        """Outbound RPCs enter an OUT resource; blocks raise before the wire."""
+        """Outbound RPCs enter an OUT resource; blocks raise before the
+        wire; the ambient trace is injected as a child span."""
 
         def intercept_unary_unary(self, continuation, client_call_details, request):
             resource = client_call_details.method
             entry = api.entry(resource, entry_type=C.EntryType.OUT)
+            details = _TracedClientCallDetails(
+                client_call_details,
+                metadata_with_trace(
+                    getattr(client_call_details, "metadata", None)
+                ),
+            )
             try:
-                result = continuation(client_call_details, request)
+                result = continuation(details, request)
                 return result
             except BaseException as e:
                 entry.set_error(e)

@@ -25,6 +25,7 @@ from typing import Callable, Optional
 
 from sentinel_tpu.core import api
 from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.metrics.admission_trace import inject_trace_headers
 from sentinel_tpu.models import constants as C
 
 try:  # gated: requests is an optional dependency
@@ -73,6 +74,11 @@ class SentinelHTTPAdapter(_HTTPAdapter):
             if self._block_response_factory is not None:
                 return self._block_response_factory(request, e)
             raise
+        # Outbound W3C propagation: the ambient trace (set by whichever
+        # inbound adapter admitted this request) crosses the hop as a
+        # child span, so a downstream block stays attributable to the
+        # original caller. No ambient trace -> headers untouched.
+        inject_trace_headers(request.headers)
         try:
             resp = super().send(request, **kwargs)
         except BaseException as e:

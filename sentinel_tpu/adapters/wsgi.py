@@ -13,6 +13,7 @@ from typing import Callable, Iterable, Optional
 from sentinel_tpu.core import api
 from sentinel_tpu.core.context import ContextUtil
 from sentinel_tpu.core.errors import BlockError
+from sentinel_tpu.metrics.admission_trace import parse_traceparent
 from sentinel_tpu.models import constants as C
 
 DEFAULT_BLOCK_BODY = b"Blocked by Sentinel (flow limiting)"
@@ -46,6 +47,13 @@ class SentinelWSGIMiddleware:
     def __call__(self, environ: dict, start_response):
         resource = self.resource_extractor(environ)
         origin = self.origin_parser(environ)
+        # CGI spelling of the W3C headers: traceparent -> HTTP_TRACEPARENT.
+        trace_token = ContextUtil.set_trace(
+            parse_traceparent(
+                environ.get("HTTP_TRACEPARENT"),
+                environ.get("HTTP_TRACESTATE", ""),
+            )
+        )
         ctx = ContextUtil.enter(WEB_CONTEXT_NAME, origin)
         entries = []
         try:
@@ -66,6 +74,7 @@ class SentinelWSGIMiddleware:
             for en in reversed(entries):
                 en.exit()
             ContextUtil.exit()
+            ContextUtil.reset_trace(trace_token)
 
     def _blocked(self, environ, start_response, e: BlockError) -> Iterable[bytes]:
         if self.block_handler is not None:

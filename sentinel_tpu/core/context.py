@@ -25,7 +25,10 @@ from sentinel_tpu.models import constants as C
 class Context:
     """One invocation chain: (name, origin) plus the current entry stack."""
 
-    __slots__ = ("name", "origin", "entry_stack", "async_mode", "auto", "_is_null")
+    __slots__ = (
+        "name", "origin", "entry_stack", "async_mode", "auto", "_is_null",
+        "trace",
+    )
 
     def __init__(self, name: str, origin: str = "", *, is_null: bool = False) -> None:
         self.name = name
@@ -37,6 +40,13 @@ class Context:
         # clean-up for the default context, CtEntry.java:60-110).
         self.auto = False
         self._is_null = is_null
+        # W3C trace identity riding this invocation chain (an
+        # admission_trace.TraceContext, kept untyped here so core stays
+        # import-light). Set by adapters via ContextUtil.set_trace;
+        # carried with the Context object across threads
+        # (run_on_context / replace_context) and, via the contextvar
+        # below, into asyncio tasks.
+        self.trace: Optional[object] = None
 
     @property
     def is_null(self) -> bool:
@@ -53,6 +63,14 @@ class Context:
 
 _current: contextvars.ContextVar[Optional[Context]] = contextvars.ContextVar(
     "sentinel_tpu_context", default=None
+)
+
+# Ambient trace identity for code running OUTSIDE a named context (the
+# entry_async-style adapters): contextvars copy into asyncio tasks, and
+# a Context created while a trace is ambient captures it onto itself so
+# cross-thread hand-off (run_on_context) carries it too.
+_trace: contextvars.ContextVar[Optional[object]] = contextvars.ContextVar(
+    "sentinel_tpu_trace", default=None
 )
 
 
@@ -79,6 +97,7 @@ class ContextUtil:
             row = engine.nodes.entrance_row(name)
             ctx = Context(name, origin, is_null=row is None)
             ctx.auto = name == C.CONTEXT_DEFAULT_NAME
+            ctx.trace = _trace.get()
             _current.set(ctx)
         return ctx
 
@@ -111,6 +130,39 @@ class ContextUtil:
             return fn(*args, **kwargs)
         finally:
             ContextUtil.replace_context(old)
+
+    # --- W3C trace-context carrier (metrics/admission_trace.py) ---
+    @staticmethod
+    def set_trace(tc):
+        """Make ``tc`` (a TraceContext, or None) the ambient trace
+        identity; also stamps the current Context, if any, so the
+        trace survives a cross-thread Context hand-off. Returns an
+        opaque token for :meth:`reset_trace` (adapters reset in their
+        finally so identities never leak across requests on a reused
+        worker thread). The token remembers the stamped Context's
+        PRIOR trace, so nested set/reset pairs (a decorator inside an
+        adapter) restore rather than strip it."""
+        ctx = _current.get()
+        prev = ctx.trace if ctx is not None else None
+        if ctx is not None:
+            ctx.trace = tc
+        return (_trace.set(tc), ctx, prev)
+
+    @staticmethod
+    def get_trace():
+        """The ambient trace identity: the current Context's, else the
+        bare contextvar's (entry_async-style callers), else None."""
+        ctx = _current.get()
+        if ctx is not None and ctx.trace is not None:
+            return ctx.trace
+        return _trace.get()
+
+    @staticmethod
+    def reset_trace(token) -> None:
+        var_token, ctx, prev = token
+        if ctx is not None:
+            ctx.trace = prev
+        _trace.reset(var_token)
 
 
 def context_enter(name: str, origin: str = "") -> Context:

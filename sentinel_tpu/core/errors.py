@@ -107,7 +107,30 @@ _ERROR_BY_CODE = {
     BLOCK_SYSTEM: SystemBlockError,
     BLOCK_AUTHORITY: AuthorityBlockError,
     BLOCK_PARAM: ParamFlowBlockError,
+    BLOCK_CUSTOM: CustomBlockError,
 }
+
+# The ONE home of the block-code → exception-name mapping (the
+# reference logs e.getClass().getSimpleName() — LogSlot.java:24).
+# Shared by the engine's block-log items, metrics/block_log.py's
+# code-keyed logging, and the admission tracer's reason names, with a
+# parity test pinning it against the BLOCK_* codes so a new code can't
+# silently log as an unknown name.
+BLOCK_EXC_NAMES = {
+    BLOCK_FLOW: "FlowException",
+    BLOCK_DEGRADE: "DegradeException",
+    BLOCK_SYSTEM: "SystemBlockException",
+    BLOCK_AUTHORITY: "AuthorityException",
+    BLOCK_PARAM: "ParamFlowException",
+    BLOCK_CUSTOM: "CustomBlockException",
+}
+
+
+def exc_name_for_code(code: int) -> str:
+    """The logged exception name for a verdict reason code
+    ("BlockException" for anything unmapped, like the reference's
+    bare BlockException)."""
+    return BLOCK_EXC_NAMES.get(int(code), "BlockException")
 
 
 def error_for_code(code: int, resource: str) -> BlockError:

@@ -9,6 +9,13 @@ vectorized updates over an HBM-resident tensor
 ``counts[rows, buckets, events]``.
 """
 
+from sentinel_tpu.metrics.admission_trace import (
+    AdmissionRecord,
+    AdmissionTracer,
+    TraceContext,
+    inject_trace_headers,
+    parse_traceparent,
+)
 from sentinel_tpu.metrics.block_log import BlockLogger
 from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
 from sentinel_tpu.metrics.extension import MetricExtension, MetricExtensionProvider
@@ -31,6 +38,11 @@ from sentinel_tpu.metrics.metric_array import (
 )
 
 __all__ = [
+    "AdmissionRecord",
+    "AdmissionTracer",
+    "TraceContext",
+    "inject_trace_headers",
+    "parse_traceparent",
     "BlockLogger",
     "FlushSpan",
     "LatencyHistogram",

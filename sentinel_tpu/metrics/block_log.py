@@ -99,6 +99,27 @@ class BlockLogger:
             [(resource, exception_name, rule_limit_app, origin, count)], now_wall_ms
         )
 
+    def log_blocked(
+        self,
+        resource: str,
+        reason_code: int,
+        rule_limit_app: str = "default",
+        origin: str = "",
+        count: int = 1,
+        now_wall_ms: Optional[int] = None,
+    ) -> None:
+        """Log a blocked verdict by its REASON CODE — the name comes
+        from the one shared mapping (core/errors.BLOCK_EXC_NAMES), so a
+        caller holding a verdict tensor's reason never spells the
+        exception name by hand (and a new BLOCK_* code can't silently
+        log under a divergent name)."""
+        from sentinel_tpu.core.errors import exc_name_for_code
+
+        self.log(
+            resource, exc_name_for_code(reason_code), rule_limit_app,
+            origin, count, now_wall_ms,
+        )
+
     def log_batch(
         self,
         items: Iterable[Tuple],

@@ -65,6 +65,12 @@ class LatencyHistogram:
         b = (math.ceil(v) - 1).bit_length()
         return b if b < self.n_buckets else self.n_buckets
 
+    def bucket_of(self, ms: float) -> int:
+        """Public bucket index for ``ms`` (the exemplar keying used by
+        the admission tracer — exemplars must land on the same
+        ``_bucket`` series their latency was counted in)."""
+        return self._bucket_of(max(ms, 0.0))
+
     def record(self, ms: float) -> None:
         if ms < 0.0:
             ms = 0.0
@@ -146,12 +152,20 @@ class LatencyHistogram:
 
     # ------------------------------------------------------------------
     def prometheus_lines(
-        self, name: str, help_text: str, labels: str = ""
+        self,
+        name: str,
+        help_text: str,
+        labels: str = "",
+        exemplars: Optional[dict] = None,
     ) -> List[str]:
         """Render as a Prometheus histogram family: cumulative
         ``_bucket`` series with ``le`` upper bounds, then ``_sum`` and
         ``_count``. ``labels`` is a pre-rendered ``k="v"`` list (no
-        braces) merged with the ``le`` label."""
+        braces) merged with the ``le`` label. ``exemplars`` maps a
+        bucket index (``bucket_of``; ``n_buckets`` = the +Inf bucket)
+        to ``(trace_id, value_ms)`` — rendered as an OpenMetrics
+        exemplar (``# {trace_id="…"} value``) on that bucket line, the
+        metrics→trace pivot Grafana/Prometheus follow natively."""
         counts, total_ms = self.snapshot_counts()
         cum = np.cumsum(counts)
         out = [f"# HELP {name} {help_text}", f"# TYPE {name} histogram"]
@@ -160,9 +174,19 @@ class LatencyHistogram:
         def lbl(le: str) -> str:
             return "{" + labels + sep + f'le="{le}"' + "}"
 
+        def exm(i: int) -> str:
+            ex = exemplars.get(i) if exemplars else None
+            if ex is None:
+                return ""
+            tid, value = ex
+            return f' # {{trace_id="{tid}"}} {round(float(value), 6)}'
+
         for i in range(self.n_buckets):
-            out.append(f"{name}_bucket{lbl(repr(float(self.bounds_ms[i])))} {int(cum[i])}")
-        out.append(f"{name}_bucket{lbl('+Inf')} {int(cum[-1])}")
+            out.append(
+                f"{name}_bucket{lbl(repr(float(self.bounds_ms[i])))}"
+                f" {int(cum[i])}{exm(i)}"
+            )
+        out.append(f"{name}_bucket{lbl('+Inf')} {int(cum[-1])}{exm(self.n_buckets)}")
         brace = ("{" + labels + "}") if labels else ""
         out.append(f"{name}_sum{brace} {total_ms}")
         out.append(f"{name}_count{brace} {int(cum[-1])}")

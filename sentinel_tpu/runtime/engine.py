@@ -53,6 +53,7 @@ from sentinel_tpu.metrics.nodes import (
     grow_stats,
     make_stats,
 )
+from sentinel_tpu.metrics.admission_trace import AdmissionTracer
 from sentinel_tpu.metrics.telemetry import TelemetryBus
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.models.rules import FlowRule
@@ -227,6 +228,10 @@ class _EntryOp:
     origin: str = ""
     args: Tuple[object, ...] = ()
     src: Optional[Tuple[object, object, object]] = None  # (findex, dindex, pindex)
+    # Admission-trace stamp (admission_trace.TraceTag) — None when the
+    # tracer is disabled or the op predates it; consumed (and nulled)
+    # when the verdict fill records the admission.
+    trace: Optional[object] = field(default=None, repr=False, compare=False)
 
     @property
     def param_thread_rows(self) -> List[int]:
@@ -315,6 +320,9 @@ class BulkOp:
     _reason: Optional[np.ndarray] = field(default=None, repr=False)
     _wait_ms: Optional[np.ndarray] = field(default=None, repr=False)
     _pending: Optional[_PendingFetch] = field(default=None, repr=False, compare=False)
+    # Group-level admission-trace stamp (bounded per-row records land
+    # at verdict fill — see AdmissionTracer.record_bulk).
+    trace: Optional[object] = field(default=None, repr=False, compare=False)
 
     def _materialize(self) -> None:
         if self._admitted is None and self._pending is not None:
@@ -381,18 +389,6 @@ class _ExitOp:
     p_rows: List[int] = field(default_factory=list)  # param thread rows to release
     resource: Optional[str] = None  # for d_gid re-resolution after a reload
     src_dindex: Optional[object] = None
-
-
-# Block-log exception names per verdict reason (the reference logs
-# e.getClass().getSimpleName() — LogSlot.java:24).
-_BLOCK_EXC_NAMES = {
-    E.BLOCK_FLOW: "FlowException",
-    E.BLOCK_DEGRADE: "DegradeException",
-    E.BLOCK_SYSTEM: "SystemBlockException",
-    E.BLOCK_AUTHORITY: "AuthorityException",
-    E.BLOCK_PARAM: "ParamFlowException",
-    E.BLOCK_CUSTOM: "CustomBlockException",
-}
 
 
 def _rounds_bucket(keys: np.ndarray) -> int:
@@ -592,6 +588,10 @@ class Engine:
         self._sketch_k = (
             self.telemetry.sketch_k if self.telemetry.enabled else 0
         )
+        # Admission tracer (metrics/admission_trace.py): sampled
+        # per-request verdict provenance. Disabled = one bool read per
+        # submit and one None check per op at fill.
+        self.admission_trace = AdmissionTracer()
         # Baseline for per-span intern-cache deltas: (weakref to the
         # param_index the totals came from, hits, misses) — a reload
         # swaps the index and resets its counters, so the baseline must
@@ -890,6 +890,11 @@ class Engine:
             )
         if op is None:
             return None
+        # Trace tag OUTSIDE the lock: the stamp (RNG draw, clock read,
+        # contextvar get) doesn't depend on the index snapshot, and the
+        # submit path's critical section is the throughput ceiling.
+        if self.admission_trace.enabled:
+            op.trace = self.admission_trace.make_tag()
         # Cluster-mode rules consult the token service OUTSIDE the engine
         # lock (it may be a network RPC — FlowRuleChecker.passClusterCheck
         # crossing to the token server, FlowRuleChecker.java:168-230).
@@ -1015,6 +1020,15 @@ class Engine:
                 if len(self._entries) >= self.max_batch:
                     over = True
                     break
+        # Trace tags OUTSIDE the lock (see submit_entry) and BEFORE the
+        # flush-on-size below, so the flush's verdict fill consumes
+        # them. A concurrent flush racing this window may fill first
+        # and miss the tag — best-effort sampling, never a wrong record.
+        tracer = self.admission_trace
+        if tracer.enabled:
+            for op in out:
+                if op is not None:
+                    op.trace = tracer.make_tag()
         if over:
             self.flush()  # flush-on-size, same as submit_entry
         # Remainder (cluster-needing request onward, or post-flush):
@@ -1373,6 +1387,10 @@ class Engine:
             self._bulk_entries.append(op)
             self._bulk_pending_n += n
             over = len(self._entries) + self._bulk_pending_n >= self.max_batch
+        # One group-level trace tag, stamped outside the lock (see
+        # submit_entry) and before the flush-on-size consumes it.
+        if self.admission_trace.enabled:
+            op.trace = self.admission_trace.make_tag()
         if over:
             self.flush()
         return op
@@ -2680,12 +2698,15 @@ class Engine:
                 self._breaker_applied_seq = self._breaker_seq
 
         has_sketch = result.blk_rows is not None
+        # Admission-trace flush linkage: the deciding flush-span seq
+        # (TelemetryBus ids) — -1 when the flight recorder is off.
+        flush_seq = span.flush_id if span is not None else -1
 
         def _fill(got):
             return self._fill_results(
                 got, entries, exits, bulk, bulk_exits, findex, dindex,
                 auth_rules, k, kd, breaker_snap=breaker_snap,
-                sketch=has_sketch,
+                sketch=has_sketch, flush_seq=flush_seq,
             )
 
         refs = self._result_refs(result, breaker_snap)
@@ -2832,6 +2853,7 @@ class Engine:
         kd: int,
         breaker_snap=None,
         sketch: bool = False,
+        flush_seq: int = -1,
     ) -> List[tuple]:
         """Verdict fill for one dispatched chunk from its ALREADY
         FETCHED result tuple (``got`` = the host values of
@@ -2848,6 +2870,11 @@ class Engine:
                 breaker_snap[0], breaker_snap[1],
                 np.asarray(got[nxt], dtype=np.int32).reshape(-1), dindex,
             )
+        # One verdict-materialization timestamp for every admission in
+        # the chunk (they all settle together; per-op clocks would add
+        # a syscall per row for no attribution gain).
+        tracer = self.admission_trace
+        trace_end = time.perf_counter()
         for i, op in enumerate(entries):
             blocked_rule = None
             limit_type = ""
@@ -2892,6 +2919,12 @@ class Engine:
                 slot_name=slot_name,
             )
             op._pending = None  # drop the chunk backref once filled
+            if op.trace is not None:
+                tracer.record_admission(
+                    op.trace, op.resource, op.origin, op.context_name,
+                    bool(admitted[i]), r, flush_seq, trace_end,
+                )
+                op.trace = None
         off_b = len(entries)
         bulk_slices: List[Tuple[BulkOp, slice]] = []
         for g in bulk:
@@ -2904,6 +2937,12 @@ class Engine:
             g.reason = reasons
             g.wait_ms = np.array(wait_ms[sl])
             g._pending = None  # drop the chunk backref once filled
+            if g.trace is not None:
+                tracer.record_bulk(
+                    g.trace, g.resource, g.origin, g.context_name,
+                    g._admitted, reasons, flush_seq, trace_end,
+                )
+                g.trace = None
             off_b += g.n
 
         if not sketch and self._sketch_k > 0:
@@ -2929,7 +2968,7 @@ class Engine:
                 if exts:
                     MetricExtensionProvider.on_pass(op.resource, op.acquire, op.args)
             else:
-                exc_name = _BLOCK_EXC_NAMES.get(v.reason, "BlockException")
+                exc_name = E.exc_name_for_code(v.reason)
                 limit_app = getattr(v.blocked_rule, "limit_app", None) or "default"
                 blocked_items.append(
                     (op.resource, exc_name, limit_app, op.origin, op.acquire)
@@ -2981,7 +3020,7 @@ class Engine:
 
             if blocked.any():
                 for r in np.unique(g.reason[blocked]):
-                    exc_name = _BLOCK_EXC_NAMES.get(int(r), "BlockException")
+                    exc_name = E.exc_name_for_code(int(r))
                     for la, cnt in _bulk_block_items(int(r)):
                         blocked_items.append((g.resource, exc_name, la, g.origin, cnt))
                     if exts:

@@ -57,6 +57,29 @@ def _managers():
     }
 
 
+# Upper bound on ?spans= / ?n= style list params: a command response
+# is one JSON blob — an absurd count must clamp, not OOM the center.
+_MAX_LIST_PARAM = 65536
+
+
+def _count_param(req: CommandRequest, name: str, default: int = 0):
+    """Validated non-negative bounded int query param, shared by the
+    ``telemetry`` (?spans=) and ``traces`` (?n=) commands: returns
+    ``(value, None)`` or ``(None, failure_response)``. Negative values
+    are rejected — ``int("-5")`` parses fine but would silently slice
+    a ring from the wrong end."""
+    raw = req.params.get(name)
+    if raw is None:
+        return default, None
+    try:
+        v = int(raw)
+    except ValueError:
+        return None, CommandResponse.of_failure(f"invalid {name} count")
+    if v < 0:
+        return None, CommandResponse.of_failure(f"invalid {name} count")
+    return min(v, _MAX_LIST_PARAM), None
+
+
 def _camel(obj: dict) -> dict:
     def cc(k: str) -> str:
         parts = k.split("_")
@@ -359,12 +382,27 @@ def cluster_client_modify_config_handler(req: CommandRequest) -> CommandResponse
     return CommandResponse.of_success("success")
 
 
-@command_mapping("metrics", "Prometheus text-format metrics (JMX exporter analog)")
+@command_mapping(
+    "metrics",
+    "Prometheus text-format metrics (JMX exporter analog);"
+    " ?format=openmetrics adds admission-trace exemplars",
+)
 def prometheus_handler(req: CommandRequest) -> CommandResponse:
-    from sentinel_tpu.transport.prometheus import render_metrics
+    from sentinel_tpu.transport.prometheus import (
+        OPENMETRICS_CONTENT_TYPE,
+        render_metrics,
+    )
 
+    # Exemplars are only legal in the OpenMetrics dialect — the classic
+    # 0.0.4 text parser rejects a mid-line '#', failing the whole
+    # scrape — so the format (and content type) switch together.
+    om = req.params.get("format", "").lower() == "openmetrics"
     return CommandResponse(
-        True, render_metrics(_engine()), "text/plain; version=0.0.4; charset=utf-8"
+        True,
+        render_metrics(_engine(), openmetrics=om),
+        OPENMETRICS_CONTENT_TYPE
+        if om
+        else "text/plain; version=0.0.4; charset=utf-8",
     )
 
 
@@ -380,11 +418,50 @@ def telemetry_handler(req: CommandRequest) -> CommandResponse:
     blocked-resource heavy-hitter sketch (metrics/telemetry.py)."""
     engine = _engine()
     tele = engine.telemetry
+    n_spans, err = _count_param(req, "spans")
+    if err is not None:
+        return err
     out = tele.snapshot(engine)
-    try:
-        n_spans = int(req.params.get("spans", "0"))
-    except ValueError:
-        return CommandResponse.of_failure("invalid spans count")
     if n_spans > 0:
         out["spans"] = [s.as_dict() for s in tele.spans()[-n_spans:]]
+    return CommandResponse.of_json(out)
+
+
+@command_mapping(
+    "traces",
+    "sampled admission trace records: [?n=N][&resource=][&reason=code|name]",
+)
+def traces_handler(req: CommandRequest) -> CommandResponse:
+    """Per-request verdict provenance (metrics/admission_trace.py):
+    who was blocked, by which rule family, decided in which flush span,
+    carrying which W3C trace id — the request-level complement of the
+    ``telemetry`` command's engine view. ``reason`` accepts the numeric
+    code or the shared exception-name spelling
+    (core/errors.BLOCK_EXC_NAMES, e.g. ``FlowException``)."""
+    from sentinel_tpu.core.errors import BLOCK_EXC_NAMES
+
+    engine = _engine()
+    tracer = engine.admission_trace
+    n, err = _count_param(req, "n")
+    if err is not None:
+        return err
+    resource = req.params.get("resource")
+    reason_raw = req.params.get("reason")
+    reason = None
+    if reason_raw is not None:
+        by_name = {v: k for k, v in BLOCK_EXC_NAMES.items()}
+        if reason_raw in by_name:
+            reason = by_name[reason_raw]
+        else:
+            try:
+                reason = int(reason_raw)
+            except ValueError:
+                return CommandResponse.of_failure(
+                    f"invalid reason: {reason_raw}"
+                )
+    out = tracer.snapshot()
+    out["records"] = [
+        r.as_dict()
+        for r in tracer.records(n=n or None, resource=resource, reason=reason)
+    ]
     return CommandResponse.of_json(out)

@@ -42,8 +42,21 @@ def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
-def render_metrics(engine) -> str:
-    """All resources' stats in the Prometheus exposition format."""
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+
+
+def render_metrics(engine, openmetrics: bool = False) -> str:
+    """All resources' stats in the Prometheus exposition format.
+
+    ``openmetrics=True`` renders the OpenMetrics dialect: admission
+    exemplars (``# {trace_id="…"} value``) on the e2e latency buckets
+    and a trailing ``# EOF``. Exemplars are ONLY legal there — the
+    classic ``text/plain; version=0.0.4`` parser rejects a mid-line
+    ``#``, which would fail the entire scrape — so the default
+    (classic) rendering omits them and the handler switches the
+    content type along with the format."""
     engine.flush()
     resources = engine.nodes.resources()
     all_rows = [row for _, row in resources] + [engine.nodes.entry_node_row]
@@ -69,14 +82,21 @@ def render_metrics(engine) -> str:
     out.append(f"# HELP {_PREFIX}_resources Known protected resources")
     out.append(f"# TYPE {_PREFIX}_resources gauge")
     out.append(f"{_PREFIX}_resources {len(rows)}")
-    out.extend(engine_telemetry_lines(engine))
+    out.extend(engine_telemetry_lines(engine, openmetrics=openmetrics))
+    if openmetrics:
+        out.append("# EOF")
     return "\n".join(out) + "\n"
 
 
-def _counter(name: str, help_text: str, value) -> List[str]:
+def _counter(name: str, help_text: str, value, openmetrics: bool = False) -> List[str]:
+    # OpenMetrics 1.0 names a counter FAMILY without the _total suffix
+    # (the sample keeps it); the classic format metadata uses the full
+    # sample name. Emitting the classic shape under the OM content
+    # type makes strict OM parsers reject the whole scrape.
+    family = name[:-len("_total")] if openmetrics and name.endswith("_total") else name
     return [
-        f"# HELP {name} {help_text}",
-        f"# TYPE {name} counter",
+        f"# HELP {family} {help_text}",
+        f"# TYPE {family} counter",
         f"{name} {value}",
     ]
 
@@ -89,30 +109,34 @@ def _gauge(name: str, help_text: str, value) -> List[str]:
     ]
 
 
-def engine_telemetry_lines(engine) -> List[str]:
+def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
     """The ``sentinel_engine_*`` family: flight-recorder counters,
     latency histogram series, pipeline occupancy, last-flush host
     breakdown, intern-cache counters and the blocked-resource sketch.
     Rendered even when telemetry is disabled (zeros) so dashboards keep
-    their series."""
+    their series. ``openmetrics`` gates the admission exemplars (legal
+    only in that dialect — see :func:`render_metrics`)."""
     p = f"{_PREFIX}_engine"
     tele = engine.telemetry
     c = tele.counters_snapshot()
     out: List[str] = []
-    out += _counter(f"{p}_flushes_total", "Dispatched flush chunks", c["flushes"])
-    out += _counter(f"{p}_ops_total", "Ops (entries+exits, incl. bulk rows) flushed", c["ops"])
-    out += _counter(
+
+    def ctr(name: str, help_text: str, value) -> List[str]:
+        return _counter(name, help_text, value, openmetrics)
+    out += ctr(f"{p}_flushes_total", "Dispatched flush chunks", c["flushes"])
+    out += ctr(f"{p}_ops_total", "Ops (entries+exits, incl. bulk rows) flushed", c["ops"])
+    out += ctr(
         f"{p}_deferred_flushes_total",
         "Flush chunks dispatched without an inline fetch (pipelined/async)",
         c["deferred_flushes"],
     )
-    out += _counter(
+    out += ctr(
         f"{p}_coalesced_fallback_total",
         "Coalesced drain fetches that fell back to per-record fetches",
         c["coalesced_fallbacks"],
     )
-    out += _counter(f"{p}_arena_hits_total", "Encode-arena staging pool hits", c["arena_hits"])
-    out += _counter(f"{p}_arena_misses_total", "Encode-arena staging pool misses (fresh builds)", c["arena_misses"])
+    out += ctr(f"{p}_arena_hits_total", "Encode-arena staging pool hits", c["arena_hits"])
+    out += ctr(f"{p}_arena_misses_total", "Encode-arena staging pool misses (fresh builds)", c["arena_misses"])
 
     # Histograms: host-blocking flush time, coalesced drain fetches,
     # end-to-end admission (dispatch start -> verdicts materialized).
@@ -126,6 +150,37 @@ def engine_telemetry_lines(engine) -> List[str]:
         f"{p}_e2e_duration_ms",
         "End-to-end admission: encode start to verdicts materialized, ms",
     )
+    # Sampled per-ADMISSION latency (enqueue -> verdict), the tracer's
+    # histogram: its buckets carry the OpenMetrics exemplars — counts
+    # and exemplars measure the SAME quantity, so an exemplar never
+    # lands on an empty bucket (per-flush e2e above is a different
+    # quantity under deferred submission and stays exemplar-free).
+    tracer = getattr(engine, "admission_trace", None)
+    if tracer is not None:
+        out += tracer.hist_latency.prometheus_lines(
+            f"{p}_admission_latency_ms",
+            "Sampled admission enqueue->verdict latency, ms",
+            exemplars=tracer.exemplars() if openmetrics else None,
+        )
+
+    # Admission-tracer counters (metrics/admission_trace.py).
+    if tracer is not None:
+        tc = tracer.counters_snapshot()
+        out += ctr(
+            f"{p}_trace_records_total",
+            "Admission trace records written to the ring",
+            tc["recorded"],
+        )
+        out += ctr(
+            f"{p}_trace_head_sampled_total",
+            "Records selected by the head sampling decision",
+            tc["head_sampled"],
+        )
+        out += ctr(
+            f"{p}_trace_blocked_sampled_total",
+            "Records selected by the always-sample-blocked mode only",
+            tc["blocked_sampled"],
+        )
 
     # Flush pipeline occupancy (Engine.pipeline_stats — previously a
     # bench.py dead end): mean in-flight depth per dispatching flush,
@@ -134,7 +189,7 @@ def engine_telemetry_lines(engine) -> List[str]:
     depth = engine.pipeline_depth
     occupancy = (ps["mean_inflight"] / depth) if depth > 0 else 0.0
     out += _gauge(f"{p}_pipeline_depth", "Configured flush pipeline depth", depth)
-    out += _counter(
+    out += ctr(
         f"{p}_pipeline_dispatches_total",
         "Dispatching deferred flushes since the last stats reset",
         int(ps["dispatches"]),
@@ -164,9 +219,9 @@ def engine_telemetry_lines(engine) -> List[str]:
     pindex = getattr(engine, "param_index", None)
     if pindex is not None and hasattr(pindex, "cache_stats"):
         cs = pindex.cache_stats()
-        out += _counter(f"{p}_param_cache_hits_total", "Param resolved-value cache hits", cs["hits"])
-        out += _counter(f"{p}_param_cache_misses_total", "Param resolved-value cache misses", cs["misses"])
-        out += _counter(f"{p}_param_cache_evictions_total", "Param value-row LRU evictions", cs["evictions"])
+        out += ctr(f"{p}_param_cache_hits_total", "Param resolved-value cache hits", cs["hits"])
+        out += ctr(f"{p}_param_cache_misses_total", "Param resolved-value cache misses", cs["misses"])
+        out += ctr(f"{p}_param_cache_evictions_total", "Param value-row LRU evictions", cs["evictions"])
 
     # Blocked-resource heavy-hitter sketch (space-saving over the
     # kernel's per-flush top-K): weight = blocked acquire sum.

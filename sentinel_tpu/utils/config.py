@@ -91,6 +91,22 @@ class SentinelConfig:
     # Host-side space-saving summary capacity the per-flush top-Ks
     # merge into.
     TELEMETRY_SKETCH_CAP = "sentinel.tpu.telemetry.sketch.capacity"
+    # Admission tracing (metrics/admission_trace.py): bounded sampled
+    # ring of per-admission verdict-provenance records with W3C
+    # trace-context propagation. Enabled by default — disabled costs
+    # one bool read per submit.
+    TRACE_ENABLED = "sentinel.tpu.trace.enabled"
+    TRACE_RING = "sentinel.tpu.trace.ring"
+    # Head-based probabilistic sample rate (0..1) for admissions with
+    # no inbound trace decision; an inbound traceparent's sampled flag
+    # is honored as-is.
+    TRACE_SAMPLE_RATE = "sentinel.tpu.trace.sample.rate"
+    # Always record blocked admissions regardless of the head decision
+    # (the "why was THIS call 429'd" mode).
+    TRACE_SAMPLE_BLOCKED = "sentinel.tpu.trace.sample.blocked"
+    # Per bulk group, at most this many rows recorded per class
+    # (blocked / head-sampled) — keeps tracing bounded at bulk sizes.
+    TRACE_BULK_CAP = "sentinel.tpu.trace.bulk.cap"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -114,6 +130,11 @@ class SentinelConfig:
         TELEMETRY_RING: "4096",
         TELEMETRY_SKETCH_K: "8",
         TELEMETRY_SKETCH_CAP: "64",
+        TRACE_ENABLED: "true",
+        TRACE_RING: "2048",
+        TRACE_SAMPLE_RATE: "0.01",
+        TRACE_SAMPLE_BLOCKED: "true",
+        TRACE_BULK_CAP: "4",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

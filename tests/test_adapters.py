@@ -96,6 +96,41 @@ class TestDecorator:
         assert get_user("a") == "limited"
         assert get_user("b") == "user:b"
 
+    def test_nested_block_exits_outer_entry(self, manual_clock, engine):
+        """A nested guarded call blocking must not leak the OUTER
+        entry's thread slot — the BlockError passthrough still exits."""
+
+        @sentinel_resource("outer-res")
+        def outer():
+            with st.entry("inner-res"):
+                return "in"
+
+        st.flow_rule_manager.load_rules(
+            [st.FlowRule("outer-res", count=1e9),
+             st.FlowRule("inner-res", count=0)]
+        )
+        for _ in range(3):
+            with pytest.raises(st.FlowBlockError):
+                outer()
+        stats = engine.cluster_node_stats("outer-res")
+        assert stats["cur_thread_num"] == 0
+
+    def test_nested_block_exits_outer_entry_async(self, manual_clock, engine):
+        @sentinel_resource("aouter-res")
+        async def outer():
+            with st.entry("ainner-res"):
+                return "in"
+
+        st.flow_rule_manager.load_rules(
+            [st.FlowRule("aouter-res", count=1e9),
+             st.FlowRule("ainner-res", count=0)]
+        )
+        for _ in range(2):
+            with pytest.raises(st.FlowBlockError):
+                asyncio.run(outer())
+        stats = engine.cluster_node_stats("aouter-res")
+        assert stats["cur_thread_num"] == 0
+
 
 def wsgi_call(app, path="/x", method="GET"):
     environ = {"PATH_INFO": path, "REQUEST_METHOD": method, "REMOTE_ADDR": "1.1.1.1"}

@@ -1,0 +1,745 @@
+"""Admission tracing (metrics/admission_trace.py): W3C trace-context
+parse/propagate/inject, the sampled per-admission provenance ring, its
+differential parity against settled verdicts at pipeline depths {0, 2},
+the ``traces`` transport command (+ the shared validated-int fix for
+``telemetry ?spans=``), and OpenMetrics exemplars on the e2e latency
+buckets."""
+
+import asyncio
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.core.context import ContextUtil
+from sentinel_tpu.metrics.admission_trace import (
+    AdmissionTracer,
+    TraceContext,
+    inject_trace_headers,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
+from sentinel_tpu.utils.config import config
+
+TP = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+
+
+class TestTraceparent:
+    def test_parse_roundtrip(self):
+        tc = parse_traceparent(TP, "vendor=x")
+        assert tc is not None
+        assert tc.trace_id == "0af7651916cd43dd8448eb211c80319c"
+        assert tc.span_id == "b7ad6b7169203331"
+        assert tc.sampled is True
+        assert tc.tracestate == "vendor=x"
+        assert tc.to_traceparent() == TP
+
+    def test_unsampled_flag(self):
+        tc = parse_traceparent(TP[:-2] + "00")
+        assert tc is not None and tc.sampled is False
+        assert tc.to_traceparent().endswith("-00")
+
+    def test_child_keeps_trace_id_fresh_span(self):
+        tc = parse_traceparent(TP)
+        child = tc.child()
+        assert child.trace_id == tc.trace_id
+        assert child.span_id != tc.span_id
+        assert len(child.span_id) == 16
+        assert child.sampled == tc.sampled
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            None,
+            "",
+            "garbage",
+            "00-abc-def-01",  # short fields
+            "00-" + "0" * 32 + "-b7ad6b7169203331-01",  # zero trace id
+            "00-0af7651916cd43dd8448eb211c80319c-" + "0" * 16 + "-01",
+            "ff-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01",
+            "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01-extra",
+            "0x-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01",
+            "00-0af7651916cd43dd8448eb211c80319X-b7ad6b7169203331-01",
+        ],
+    )
+    def test_invalid_rejected(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_future_version_accepted_with_extra_fields(self):
+        # W3C forward compatibility: parse the four base fields.
+        tc = parse_traceparent(
+            "cc-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01-future"
+        )
+        assert tc is not None and tc.sampled
+
+    def test_id_generators_shape(self):
+        assert len(new_trace_id()) == 32 and int(new_trace_id(), 16) > 0
+        assert len(new_span_id()) == 16 and int(new_span_id(), 16) > 0
+
+
+class TestContextCarrier:
+    def test_ambient_set_get_reset(self):
+        tc = parse_traceparent(TP)
+        assert ContextUtil.get_trace() is None
+        token = ContextUtil.set_trace(tc)
+        try:
+            assert ContextUtil.get_trace() is tc
+        finally:
+            ContextUtil.reset_trace(token)
+        assert ContextUtil.get_trace() is None
+
+    def test_context_object_carries_trace_across_threads(self, engine):
+        """run_on_context hand-off: the Context OBJECT carries the
+        trace, so a worker thread resuming the context sees it."""
+        tc = parse_traceparent(TP)
+        token = ContextUtil.set_trace(tc)
+        ctx = ContextUtil.enter("trace_ctx_thread", "o")
+        seen = []
+        try:
+            t = threading.Thread(
+                target=lambda: ContextUtil.run_on_context(
+                    ctx, lambda: seen.append(ContextUtil.get_trace())
+                )
+            )
+            t.start()
+            t.join()
+        finally:
+            ContextUtil.exit()
+            ContextUtil.reset_trace(token)
+        assert seen == [tc]
+
+    def test_asyncio_tasks_inherit_trace(self):
+        tc = parse_traceparent(TP)
+
+        async def drive():
+            token = ContextUtil.set_trace(tc)
+            try:
+                return await asyncio.gather(
+                    *(_child() for _ in range(3))
+                )
+            finally:
+                ContextUtil.reset_trace(token)
+
+        async def _child():
+            await asyncio.sleep(0)
+            return ContextUtil.get_trace()
+
+        assert asyncio.run(drive()) == [tc, tc, tc]
+
+    def test_nested_set_reset_restores_context_trace(self, engine):
+        """A nested set/reset pair (decorator inside an adapter) must
+        RESTORE the Context's prior trace, not strip it — the Context
+        object is the cross-thread carrier."""
+        outer = parse_traceparent(TP)
+        tok_outer = ContextUtil.set_trace(outer)
+        ctx = ContextUtil.enter("nested_trace_ctx", "")
+        try:
+            tok_inner = ContextUtil.set_trace(None)  # extractor found none
+            assert ContextUtil.get_trace() is None
+            ContextUtil.reset_trace(tok_inner)
+            assert ctx.trace is outer  # restored on the OBJECT
+            assert ContextUtil.get_trace() is outer
+        finally:
+            ContextUtil.exit()
+            ContextUtil.reset_trace(tok_outer)
+
+    def test_inject_no_ambient_is_noop(self):
+        hdrs = {}
+        assert inject_trace_headers(hdrs) is None
+        assert hdrs == {}
+
+    def test_inject_creates_child(self):
+        token = ContextUtil.set_trace(parse_traceparent(TP, "v=1"))
+        try:
+            hdrs = {}
+            child = inject_trace_headers(hdrs)
+        finally:
+            ContextUtil.reset_trace(token)
+        assert child is not None
+        out = parse_traceparent(hdrs["traceparent"], hdrs.get("tracestate", ""))
+        assert out.trace_id == "0af7651916cd43dd8448eb211c80319c"
+        assert out.span_id != "b7ad6b7169203331"
+        assert hdrs["tracestate"] == "v=1"
+
+
+class TestSamplingModes:
+    def _drive(self, engine, tracer, n=6, count=2.0):
+        engine.admission_trace = tracer
+        st.flow_rule_manager.load_rules([st.FlowRule("sm", count=count)])
+        ops = engine.submit_many(
+            [{"resource": "sm", "ts": 100} for _ in range(n)]
+        )
+        engine.flush()
+        return ops
+
+    def test_rate_zero_records_only_blocked(self, manual_clock, engine):
+        ops = self._drive(engine, AdmissionTracer(sample_rate=0.0))
+        blocked = sum(1 for op in ops if not op.verdict.admitted)
+        recs = engine.admission_trace.records()
+        assert blocked > 0
+        assert len(recs) == blocked
+        assert all(not r.admitted and not r.head_sampled for r in recs)
+        assert all(r.reason_name == "FlowException" for r in recs)
+
+    def test_rate_one_records_everything(self, manual_clock, engine):
+        ops = self._drive(engine, AdmissionTracer(sample_rate=1.0), n=5)
+        recs = engine.admission_trace.records()
+        assert len(recs) == 5
+        assert sum(r.admitted for r in recs) == sum(
+            1 for op in ops if op.verdict.admitted
+        )
+
+    def test_blocked_mode_off_rate_zero_records_nothing(
+        self, manual_clock, engine
+    ):
+        self._drive(
+            engine, AdmissionTracer(sample_rate=0.0, sample_blocked=False)
+        )
+        assert engine.admission_trace.records() == []
+        assert engine.admission_trace.counters_snapshot()["skipped"] > 0
+
+    def test_disabled_tags_nothing(self, manual_clock, engine):
+        engine.admission_trace = AdmissionTracer(enabled=False)
+        st.flow_rule_manager.load_rules([st.FlowRule("dis", count=0)])
+        op = engine.submit_entry("dis")
+        assert op.trace is None  # one bool read, no tag allocation
+        engine.flush()
+        assert engine.admission_trace.records() == []
+
+    def test_inbound_sampled_flag_is_the_head_decision(
+        self, manual_clock, engine
+    ):
+        engine.admission_trace = AdmissionTracer(sample_rate=0.0)
+        st.flow_rule_manager.load_rules([st.FlowRule("hd", count=1e9)])
+        token = ContextUtil.set_trace(parse_traceparent(TP))  # flag 01
+        try:
+            engine.submit_entry("hd")
+            engine.flush()
+        finally:
+            ContextUtil.reset_trace(token)
+        recs = engine.admission_trace.records()
+        assert len(recs) == 1 and recs[0].admitted and recs[0].head_sampled
+        assert recs[0].trace_id == "0af7651916cd43dd8448eb211c80319c"
+        assert recs[0].parent_span_id == "b7ad6b7169203331"
+        # Flag 00 -> admitted traffic not recorded even at rate 1.
+        engine.admission_trace = AdmissionTracer(sample_rate=1.0)
+        token = ContextUtil.set_trace(parse_traceparent(TP[:-2] + "00"))
+        try:
+            engine.submit_entry("hd")
+            engine.flush()
+        finally:
+            ContextUtil.reset_trace(token)
+        assert engine.admission_trace.records() == []
+
+    def test_ring_bounded(self, manual_clock, engine):
+        engine.admission_trace = AdmissionTracer(sample_rate=1.0, ring=4)
+        st.flow_rule_manager.load_rules([st.FlowRule("rb", count=1e9)])
+        engine.submit_many([{"resource": "rb", "ts": 1} for _ in range(9)])
+        engine.flush()
+        assert len(engine.admission_trace.records()) == 4
+        assert engine.admission_trace.counters_snapshot()["recorded"] == 9
+
+
+class TestDifferentialParity:
+    """Acceptance: for every sampled blocked admission, the recorded
+    (reason, resource, flush seq) matches a recount from the settled
+    verdicts — at pipeline depths 0 AND 2, where verdicts materialize
+    only at a later flush's drain."""
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_records_match_settled_verdicts(self, manual_clock, depth):
+        from sentinel_tpu.runtime.engine import Engine
+
+        eng = Engine(clock=manual_clock)
+        eng.admission_trace = AdmissionTracer(sample_rate=1.0)
+        eng.pipeline_depth = depth
+        eng.set_flow_rules(
+            [st.FlowRule("hot", count=2), st.FlowRule("free", count=1e9)]
+        )
+        batches = []
+        for b in range(4):
+            t = 1000 + b * 1000  # fresh window per batch
+            manual_clock.set_ms(t)
+            reqs = [{"resource": "hot", "ts": t} for _ in range(4)] + [
+                {"resource": "free", "ts": t} for _ in range(2)
+            ]
+            ops = eng.submit_many(reqs)
+            eng.flush()
+            batches.append(ops)
+        eng.drain()
+        recs = eng.admission_trace.records()
+        assert len(recs) == sum(len(b) for b in batches)
+        # Batch b's records all carry the SAME deciding flush seq, in
+        # dispatch order, and that seq names a telemetry span whose row
+        # count matches the batch.
+        spans = {s.flush_id: s for s in eng.telemetry.spans()}
+        by_seq = {}
+        for r in recs:
+            by_seq.setdefault(r.flush_seq, []).append(r)
+        assert len(by_seq) == len(batches)
+        for seq_group, ops in zip(
+            (by_seq[s] for s in sorted(by_seq)), batches
+        ):
+            seq = seq_group[0].flush_seq
+            assert seq >= 0 and all(r.flush_seq == seq for r in seq_group)
+            assert spans[seq].n_entries == len(ops)
+            assert spans[seq].settled
+            # Exact recount parity: multiset of (resource, reason,
+            # admitted) from the settled verdicts == the records'.
+            want = sorted(
+                (op.resource, op.verdict.reason, op.verdict.admitted)
+                for op in ops
+            )
+            got = sorted((r.resource, r.reason, r.admitted) for r in seq_group)
+            assert got == want
+            blocked = [r for r in seq_group if not r.admitted]
+            assert blocked, "flow rule must block part of every batch"
+            assert all(
+                r.reason == E.BLOCK_FLOW and r.reason_name == "FlowException"
+                and r.resource == "hot"
+                for r in blocked
+            )
+        eng.close()
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_bulk_blocked_records_bounded_and_exact(self, manual_clock, depth):
+        from sentinel_tpu.runtime.engine import Engine
+
+        eng = Engine(clock=manual_clock)
+        eng.admission_trace = AdmissionTracer(sample_rate=0.0, bulk_cap=3)
+        eng.pipeline_depth = depth
+        eng.set_flow_rules([st.FlowRule("bk", count=4)])
+        manual_clock.set_ms(1000)
+        g = eng.submit_bulk("bk", 16, ts=np.full(16, 1000, np.int32))
+        eng.flush()
+        eng.drain()
+        blocked_total = int((~g.admitted).sum())
+        recs = eng.admission_trace.records()
+        assert blocked_total > 3
+        assert len(recs) == 3  # bounded by bulk_cap
+        assert all(
+            not r.admitted and r.resource == "bk"
+            and r.reason == E.BLOCK_FLOW for r in recs
+        )
+        # Recount parity: every recorded reason exists in the group's
+        # settled reason column.
+        assert all(int(r.reason) in set(g.reason.tolist()) for r in recs)
+        eng.close()
+
+
+class TestAdapterRoundTrips:
+    """Acceptance: traceparent round-trips inbound parse → context →
+    outbound inject through ASGI, WSGI, gRPC and gateway."""
+
+    def _assert_roundtrip(self, captured_headers, recs):
+        out = parse_traceparent(captured_headers["traceparent"])
+        assert out is not None
+        assert out.trace_id == "0af7651916cd43dd8448eb211c80319c"
+        assert out.span_id != "b7ad6b7169203331"  # child span, not echo
+        assert recs, "inbound sampled flag must force a record"
+        assert all(
+            r.trace_id == "0af7651916cd43dd8448eb211c80319c" for r in recs
+        )
+        assert any(r.parent_span_id == "b7ad6b7169203331" for r in recs)
+
+    def test_asgi_roundtrip(self, manual_clock, engine):
+        from sentinel_tpu.adapters import SentinelASGIMiddleware
+
+        engine.admission_trace = AdmissionTracer(sample_rate=0.0)
+        st.flow_rule_manager.load_rules([st.FlowRule("GET:/a", count=1e9)])
+        captured = {}
+
+        async def app(scope, receive, send):
+            inject_trace_headers(captured)
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": []})
+            await send({"type": "http.response.body", "body": b"ok"})
+
+        mw = SentinelASGIMiddleware(app, total_resource=None)
+        scope = {
+            "type": "http", "method": "GET", "path": "/a",
+            "headers": [(b"traceparent", TP.encode()),
+                        (b"tracestate", b"v=1")],
+        }
+        sent = []
+
+        async def send(msg):
+            sent.append(msg)
+
+        asyncio.run(mw(scope, None, send))
+        assert sent[0]["status"] == 200
+        self._assert_roundtrip(captured, engine.admission_trace.records())
+        assert ContextUtil.get_trace() is None  # token reset after request
+
+    def test_wsgi_roundtrip(self, manual_clock, engine):
+        from sentinel_tpu.adapters import SentinelWSGIMiddleware
+
+        engine.admission_trace = AdmissionTracer(sample_rate=0.0)
+        st.flow_rule_manager.load_rules([st.FlowRule("GET:/w", count=1e9)])
+        captured = {}
+
+        def app(environ, start_response):
+            inject_trace_headers(captured)
+            start_response("200 OK", [])
+            return [b"ok"]
+
+        mw = SentinelWSGIMiddleware(app, total_resource=None)
+        environ = {
+            "PATH_INFO": "/w", "REQUEST_METHOD": "GET",
+            "HTTP_TRACEPARENT": TP, "HTTP_TRACESTATE": "v=1",
+        }
+        statuses = []
+        body = mw(environ, lambda s, h: statuses.append(s))
+        assert statuses == ["200 OK"] and body == [b"ok"]
+        self._assert_roundtrip(captured, engine.admission_trace.records())
+        assert ContextUtil.get_trace() is None
+
+    def test_grpc_roundtrip(self, manual_clock, engine):
+        from sentinel_tpu.adapters.grpc_adapter import (
+            metadata_with_trace,
+            trace_from_metadata,
+        )
+
+        engine.admission_trace = AdmissionTracer(sample_rate=0.0)
+        st.flow_rule_manager.load_rules([st.FlowRule("/Svc/M", count=1e9)])
+        md = (("traceparent", TP), ("tracestate", "v=1"), ("other", "x"))
+        tc = trace_from_metadata(md)
+        assert tc is not None and tc.tracestate == "v=1"
+        from sentinel_tpu.models import constants as C
+
+        token = ContextUtil.set_trace(tc)
+        try:
+            with st.entry("/Svc/M", entry_type=C.EntryType.IN):
+                out_md = metadata_with_trace((("k", "v"),))
+        finally:
+            ContextUtil.reset_trace(token)
+        captured = dict(out_md)
+        assert captured["k"] == "v"
+        self._assert_roundtrip(captured, engine.admission_trace.records())
+
+    def test_grpc_server_interceptor_parses_inbound(
+        self, manual_clock, engine
+    ):
+        grpc = pytest.importorskip("grpc")
+        from sentinel_tpu.adapters.grpc_adapter import (
+            SentinelServerInterceptor,
+        )
+
+        engine.admission_trace = AdmissionTracer(sample_rate=0.0)
+        st.flow_rule_manager.load_rules([st.FlowRule("/S/ok", count=1e9)])
+
+        class Details:
+            method = "/S/ok"
+            invocation_metadata = (("traceparent", TP),)
+
+        # continuation -> None handler: the interceptor admits, exits
+        # the entry, and passes the handler through.
+        out = SentinelServerInterceptor().intercept_service(
+            lambda d: None, Details()
+        )
+        assert out is None
+        recs = engine.admission_trace.records()
+        assert recs and recs[0].trace_id == TP.split("-")[1]
+
+    def test_gateway_roundtrip(self, manual_clock, engine):
+        from sentinel_tpu.adapters.gateway import (
+            GatewayFlowRule,
+            GatewayRequestInfo,
+            gateway_entry,
+            gateway_rule_manager,
+        )
+
+        engine.admission_trace = AdmissionTracer(sample_rate=0.0)
+        gateway_rule_manager.load_rules(
+            [GatewayFlowRule(resource="route_t", count=1e9)]
+        )
+        try:
+            info = GatewayRequestInfo(
+                path="/x", client_ip="1.2.3.4",
+                headers={"traceparent": TP, "tracestate": "v=1"},
+            )
+            captured = {}
+            with gateway_entry("route_t", info):
+                inject_trace_headers(captured)
+            self._assert_roundtrip(
+                captured, engine.admission_trace.records()
+            )
+            assert ContextUtil.get_trace() is None
+        finally:
+            gateway_rule_manager.load_rules([])
+
+    def test_decorator_traceparent_extractor(self, manual_clock, engine):
+        from sentinel_tpu.adapters import sentinel_resource
+
+        engine.admission_trace = AdmissionTracer(sample_rate=0.0)
+        st.flow_rule_manager.load_rules([st.FlowRule("deco_t", count=1e9)])
+        captured = {}
+
+        @sentinel_resource(
+            "deco_t",
+            traceparent_extractor=lambda msg: msg.get("traceparent"),
+        )
+        def consume(msg):
+            inject_trace_headers(captured)
+            return "done"
+
+        assert consume({"traceparent": TP}) == "done"
+        self._assert_roundtrip(captured, engine.admission_trace.records())
+        assert ContextUtil.get_trace() is None
+
+    def test_requests_adapter_injects_outbound(
+        self, manual_clock, engine
+    ):
+        """Real hop: ambient trace -> SentinelHTTPAdapter writes a
+        child traceparent on the wire (local HTTP server echoes it)."""
+        requests = pytest.importorskip("requests")
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from sentinel_tpu.adapters import SentinelHTTPAdapter
+
+        class Echo(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = (self.headers.get("traceparent") or "").encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Echo)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/d"
+            st.flow_rule_manager.load_rules(
+                [st.FlowRule(f"GET:{url}", count=1e9)]
+            )
+            s = requests.Session()
+            s.mount("http://", SentinelHTTPAdapter())
+            token = ContextUtil.set_trace(parse_traceparent(TP))
+            try:
+                echoed = s.get(url).text
+            finally:
+                ContextUtil.reset_trace(token)
+            out = parse_traceparent(echoed)
+            assert out is not None
+            assert out.trace_id == TP.split("-")[1]
+            assert out.span_id != TP.split("-")[2]
+            # Untraced call: nothing injected.
+            assert s.get(url).text == ""
+        finally:
+            srv.shutdown()
+
+    def test_guarded_client_injects_kwargs_headers(
+        self, manual_clock, engine
+    ):
+        from sentinel_tpu.adapters import GuardedClient
+
+        seen = {}
+
+        class Stub:
+            def request(self, method, url, **kw):
+                seen.update(kw.get("headers") or {})
+                return "ok"
+
+        st.flow_rule_manager.load_rules([st.FlowRule("GET:u", count=1e9)])
+        token = ContextUtil.set_trace(parse_traceparent(TP))
+        try:
+            caller_headers = {"x": "1"}
+            assert GuardedClient(Stub()).get("u", headers=caller_headers) == "ok"
+        finally:
+            ContextUtil.reset_trace(token)
+        assert seen["x"] == "1"
+        assert parse_traceparent(seen["traceparent"]).trace_id == TP.split("-")[1]
+        assert "traceparent" not in caller_headers  # caller's dict untouched
+
+
+class TestTransportExports:
+    def test_traces_command_filters_and_validation(self, manual_clock, engine):
+        from sentinel_tpu.transport import handlers
+        from sentinel_tpu.transport.command_center import CommandRequest
+
+        engine.admission_trace = AdmissionTracer(sample_rate=1.0)
+        st.flow_rule_manager.load_rules(
+            [st.FlowRule("ta", count=1), st.FlowRule("tb", count=1e9)]
+        )
+        manual_clock.set_ms(100)
+        engine.submit_many(
+            [{"resource": "ta", "ts": 100} for _ in range(3)]
+            + [{"resource": "tb", "ts": 100} for _ in range(2)]
+        )
+        engine.flush()
+
+        def call(params):
+            return handlers.traces_handler(
+                CommandRequest(path="traces", params=params, body="")
+            )
+
+        resp = call({})
+        assert resp.success
+        d = json.loads(resp.result)
+        assert d["enabled"] and d["sample_rate"] == 1.0
+        assert len(d["records"]) == 5
+        # resource filter
+        d = json.loads(call({"resource": "ta"}).result)
+        assert {r["resource"] for r in d["records"]} == {"ta"}
+        # reason filter by shared name and by code
+        d = json.loads(call({"reason": "FlowException"}).result)
+        assert len(d["records"]) == 2
+        assert all(not r["admitted"] for r in d["records"])
+        d2 = json.loads(call({"reason": str(E.BLOCK_FLOW)}).result)
+        assert d2["records"] == d["records"]
+        # n cap
+        d = json.loads(call({"n": "2"}).result)
+        assert len(d["records"]) == 2
+        # validation: negative and garbage rejected
+        assert not call({"n": "-3"}).success
+        assert not call({"n": "x"}).success
+        assert not call({"reason": "NopeException"}).success
+
+    def test_telemetry_spans_negative_rejected(self, manual_clock, engine):
+        """Satellite regression: ?spans=-5 used to int() fine and slice
+        the ring from the wrong end — now it fails validation."""
+        from sentinel_tpu.transport import handlers
+        from sentinel_tpu.transport.command_center import CommandRequest
+
+        st.flow_rule_manager.load_rules([st.FlowRule("tn", count=1e9)])
+        st.try_entry("tn")
+        bad = handlers.telemetry_handler(
+            CommandRequest(path="telemetry", params={"spans": "-5"}, body="")
+        )
+        assert not bad.success
+        ok = handlers.telemetry_handler(
+            CommandRequest(path="telemetry", params={"spans": "1"}, body="")
+        )
+        assert ok.success and len(json.loads(ok.result)["spans"]) == 1
+
+    def test_prometheus_e2e_exemplars_openmetrics_only(
+        self, manual_clock, engine
+    ):
+        from sentinel_tpu.transport import handlers
+        from sentinel_tpu.transport.command_center import CommandRequest
+        from sentinel_tpu.transport.prometheus import render_metrics
+
+        engine.admission_trace = AdmissionTracer(sample_rate=1.0)
+        st.flow_rule_manager.load_rules([st.FlowRule("ex", count=1)])
+        manual_clock.set_ms(50)
+        for _ in range(3):
+            st.try_entry("ex")
+        text = render_metrics(engine, openmetrics=True)
+        ex_lines = [
+            l for l in text.splitlines()
+            if l.startswith("sentinel_engine_admission_latency_ms_bucket")
+            and '# {trace_id="' in l
+        ]
+        assert ex_lines, "admission latency buckets must carry exemplars"
+        assert text.rstrip().endswith("# EOF")
+        # Exemplars land on buckets that actually hold observations —
+        # counts and exemplar values measure the same quantity.
+        for l in ex_lines:
+            assert int(l.split("} ", 1)[1].split(" ", 1)[0]) > 0
+        # OpenMetrics counter families drop the _total suffix in
+        # metadata while samples keep it (strict OM parsers reject the
+        # classic shape under the OM content type).
+        assert "# TYPE sentinel_engine_flushes counter" in text
+        assert "\nsentinel_engine_flushes_total " in text
+        assert "# TYPE sentinel_engine_flushes_total counter" not in text
+        # The exemplar's trace id is a recorded one.
+        known = {r.trace_id for r in engine.admission_trace.records()}
+        tid = ex_lines[0].split('trace_id="')[1].split('"')[0]
+        assert tid in known
+        # Tracer counters exported.
+        assert "sentinel_engine_trace_records_total" in text
+        assert "sentinel_engine_trace_blocked_sampled_total" in text
+        # The CLASSIC format must stay exemplar-free — the 0.0.4 text
+        # parser rejects a mid-line '#', which would fail the whole
+        # scrape — and the handler switches the content type with the
+        # format.
+        classic = render_metrics(engine)
+        assert '# {trace_id="' not in classic
+        assert "# EOF" not in classic
+        assert "# TYPE sentinel_engine_flushes_total counter" in classic
+        resp = handlers.prometheus_handler(
+            CommandRequest(path="metrics", params={}, body="")
+        )
+        assert resp.content_type.startswith("text/plain; version=0.0.4")
+        assert '# {trace_id="' not in resp.result
+        resp_om = handlers.prometheus_handler(
+            CommandRequest(
+                path="metrics", params={"format": "openmetrics"}, body=""
+            )
+        )
+        assert resp_om.content_type.startswith("application/openmetrics-text")
+        assert '# {trace_id="' in resp_om.result
+
+    def test_exemplar_bucket_matches_latency(self):
+        tr = AdmissionTracer(sample_rate=1.0)
+        from sentinel_tpu.metrics.admission_trace import TraceTag
+
+        t0 = time.perf_counter()
+        rec = tr.record_admission(
+            TraceTag(None, True, t0), "r", "", "ctx", True, 0, 7,
+            t0 + 0.004,  # ~4 ms
+        )
+        from sentinel_tpu.metrics.histogram import LatencyHistogram
+
+        want_bucket = LatencyHistogram().bucket_of(rec.latency_ms)
+        assert tr.exemplars() == {
+            want_bucket: (rec.trace_id, rec.latency_ms)
+        }
+
+
+@pytest.mark.slow
+class TestOverhead:
+    def test_tracing_disabled_within_1pct(self, manual_clock):
+        """Acceptance: tracing disabled costs <=1% vs the default-on
+        tracer on the bench adapter stage's shape (gateway bulk loop) —
+        i.e. the feature's always-on price at default sampling is
+        within noise of its off position (median-of-repeats)."""
+        from sentinel_tpu.adapters.gateway import (
+            GatewayFlowRule,
+            GatewayParamFlowItem,
+            GatewayRequestBatch,
+            gateway_rule_manager,
+            gateway_submit_bulk,
+        )
+        from sentinel_tpu.runtime.engine import Engine
+
+        n = 2048
+        ips = [f"10.0.{i % 16}.{i % 251}" for i in range(n)]
+
+        def run(enabled: bool) -> float:
+            eng = Engine(clock=manual_clock)
+            eng.admission_trace = AdmissionTracer(enabled=enabled)
+            gateway_rule_manager.load_rules(
+                [GatewayFlowRule(resource="ovr", count=1e9,
+                                 param_item=GatewayParamFlowItem())]
+            )
+            batch = GatewayRequestBatch(n=n, client_ip=ips)
+            gateway_submit_bulk("ovr", batch, engine=eng, ts=100, flush=True)
+            eng.flush()  # warm-up/compile
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(10):
+                    gateway_submit_bulk(
+                        "ovr", batch, engine=eng, ts=100, flush=True
+                    )
+                best = min(best, time.perf_counter() - t0)
+            eng.close()
+            return best
+
+        try:
+            t_on = run(True)
+            t_off = run(False)
+        finally:
+            gateway_rule_manager.load_rules([])
+        assert t_off <= t_on * 1.01 + 0.01, (t_off, t_on)
+        assert t_on <= t_off * 1.01 + 0.01, (t_on, t_off)

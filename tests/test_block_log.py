@@ -149,3 +149,64 @@ class TestMetricExtension:
         e = st.entry("b")  # must not raise
         e.exit()
         assert ("pass", "b", 1) in rec.events
+
+
+class TestReasonNameParity:
+    """ISSUE 4 satellite: block-log exception names and BLOCK_* reason
+    codes share ONE mapping (core/errors.BLOCK_EXC_NAMES) — a new code
+    added without a name (or a name spelled differently somewhere)
+    fails here instead of silently logging as an unknown exception."""
+
+    def test_every_block_code_has_a_distinct_name(self):
+        from sentinel_tpu.core import errors as E
+
+        codes = {
+            name: val
+            for name, val in vars(E).items()
+            if name.startswith("BLOCK_") and isinstance(val, int)
+        }
+        assert codes, "reason codes must exist"
+        for name, code in codes.items():
+            assert code in E.BLOCK_EXC_NAMES, f"{name} has no exception name"
+        names = list(E.BLOCK_EXC_NAMES.values())
+        assert len(set(names)) == len(names), "names must be distinct"
+        # And the mapping has no orphans: every named code is a BLOCK_*.
+        assert set(E.BLOCK_EXC_NAMES) == set(codes.values())
+
+    def test_every_block_code_builds_a_typed_error(self):
+        """error_for_verdict must return a SUBCLASS for every code —
+        a bare BlockError means a code was added without its error
+        class wiring."""
+        from sentinel_tpu.core import errors as E
+
+        for code in E.BLOCK_EXC_NAMES:
+            err = E.error_for_verdict(code, "r")
+            assert type(err) is not E.BlockError, code
+
+    def test_log_blocked_writes_the_shared_name(self, block_env, manual_clock):
+        from sentinel_tpu.core import errors as E
+
+        engine = block_env
+        manual_clock.set_ms(100)
+        for code, want in E.BLOCK_EXC_NAMES.items():
+            engine.block_log.log_blocked("res", code)
+        engine.block_log.log_blocked("res", 99)  # unknown -> base name
+        engine.block_log.flush()
+        names = {k[1] for _, k, _ in engine.block_log.read_entries()}
+        assert names == set(E.BLOCK_EXC_NAMES.values()) | {"BlockException"}
+
+    def test_engine_blocked_verdicts_log_mapped_names(
+        self, block_env, manual_clock
+    ):
+        """End to end: a flow-blocked flush writes exactly the shared
+        mapping's spelling (the engine path no longer owns a private
+        name table)."""
+        from sentinel_tpu.core import errors as E
+
+        engine = block_env
+        st.flow_rule_manager.load_rules([st.FlowRule("pw", count=0)])
+        manual_clock.set_ms(100)
+        assert st.try_entry("pw") is None
+        engine.block_log.flush()
+        names = {k[1] for _, k, _ in engine.block_log.read_entries()}
+        assert names == {E.BLOCK_EXC_NAMES[E.BLOCK_FLOW]}

@@ -1,0 +1,68 @@
+"""tools/config_audit.py: every sentinel.tpu.* key referenced anywhere
+in sentinel_tpu/ must be declared in utils/config.py DEFAULTS (ISSUE 4
+CI satellite — the sentinel.tpu.trace.* family lands with this guard
+in place)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import config_audit  # noqa: E402
+
+_PKG_ROOT = os.path.join(os.path.dirname(__file__), "..", "sentinel_tpu")
+
+
+class TestConfigAudit:
+    def test_tree_is_clean(self):
+        missing, refs = config_audit.audit(_PKG_ROOT)
+        assert missing == [], f"undeclared config keys referenced: {missing}"
+        assert refs, "the scan must actually find key references"
+
+    def test_new_trace_family_is_covered(self):
+        """The guard actually sees this PR's keys — if the scan regex
+        or walk broke, this catches it before a real miss slips by."""
+        _missing, refs = config_audit.audit(_PKG_ROOT)
+        assert any(k.startswith("sentinel.tpu.trace.") for k in refs)
+        assert any(k.startswith("sentinel.tpu.telemetry.") for k in refs)
+
+    def test_detects_undeclared_key(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            'X = config.get_bool("sentinel.tpu.notakey.enabled", True)\n'
+        )
+        missing, refs = config_audit.audit(str(tmp_path))
+        assert missing == ["sentinel.tpu.notakey.enabled"]
+        assert refs["sentinel.tpu.notakey.enabled"]
+
+    def test_family_prefix_mention_passes(self, tmp_path):
+        """Docstring family mentions (``sentinel.tpu.host.arena.*``)
+        resolve as prefixes of declared keys, not as misses."""
+        (tmp_path / "mod.py").write_text(
+            '"""Tune via sentinel.tpu.host.arena.* keys."""\n'
+        )
+        missing, _refs = config_audit.audit(str(tmp_path))
+        assert missing == []
+
+    def test_rejects_negative_style_garbage(self, tmp_path):
+        """A trailing dot / wildcard never widens the match into a
+        false pass for a genuinely undeclared full key."""
+        (tmp_path / "mod.py").write_text(
+            'Y = config.get("sentinel.tpu.host.arena.bogus")\n'
+        )
+        missing, _refs = config_audit.audit(str(tmp_path))
+        assert missing == ["sentinel.tpu.host.arena.bogus"]
+
+    def test_cli_exit_status(self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text(
+            'K = "sentinel.tpu.flush.max.batch"\n'
+        )
+        old = sys.argv
+        try:
+            sys.argv = ["config_audit.py", "--root", str(tmp_path)]
+            assert config_audit.main() == 0
+            (tmp_path / "bad.py").write_text('K = "sentinel.tpu.zzz"\n')
+            assert config_audit.main() == 1
+            out = capsys.readouterr().out
+            assert "sentinel.tpu.zzz" in out
+        finally:
+            sys.argv = old

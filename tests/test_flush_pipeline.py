@@ -317,6 +317,125 @@ class TestPipelineMechanics:
         assert g._admitted is not None and g.admitted_count == 4
 
 
+class TestBreakerNetEdgeDepth2:
+    """rules/breaker_events.py net-edge semantics under the depth-2
+    pipeline (ISSUE 4 satellite): a transition DISPATCHED in flush i is
+    observed only when flush i's record materializes — at the queue
+    trim of flush i+2 (depth 2 keeps two in flight) or at drain — and
+    fires exactly once, never replayed by later drains. Previously only
+    exercised at depth 0 (tests/test_degrade.py)."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_registry(self):
+        from sentinel_tpu.rules import breaker_events
+
+        breaker_events.clear()
+        yield
+        breaker_events.clear()
+
+    def _mk(self, manual_clock):
+        import sentinel_tpu as st
+        from sentinel_tpu.models.rules import DegradeRule
+
+        eng = _mk_engine(manual_clock, 2)
+        eng.set_flow_rules([st.FlowRule("ne", count=1e9)])
+        eng.set_degrade_rules(
+            [DegradeRule(resource="ne", grade=C.DEGRADE_GRADE_EXCEPTION_RATIO,
+                         count=0.2, time_window=2, min_request_amount=1,
+                         stat_interval_ms=1000)]
+        )
+        return eng
+
+    def test_trip_observed_at_drain_of_later_flush_exactly_once(
+        self, manual_clock
+    ):
+        from sentinel_tpu.rules import breaker_events
+        from sentinel_tpu.rules.degrade_table import CLOSED, OPEN
+
+        eng = self._mk(manual_clock)
+        events = []
+        breaker_events.add_state_change_observer(
+            "t", lambda prev, new, rule, res: events.append((prev, new, res))
+        )
+        rows = eng.resolve_entry_rows(
+            "ne", C.CONTEXT_DEFAULT_NAME, "", C.EntryType.OUT
+        )
+        # Flush i: entries + all-error exits trip the breaker on
+        # device. Dispatched without fetching — NOT yet observed.
+        manual_clock.set_ms(1000)
+        eng.submit_bulk("ne", 4, ts=np.full(4, 1000, np.int32))
+        eng.submit_exit_bulk(
+            rows, 4, rt=5, err=1, ts=np.full(4, 1000, np.int32), resource="ne"
+        )
+        eng.flush()
+        assert events == [], "transition still in flight after flush i"
+        # Flush i+1: dispatches; queue holds (i, i+1) = depth 2 — the
+        # trim settles nothing, so the transition stays unobserved.
+        manual_clock.set_ms(1100)
+        eng.submit_bulk("ne", 1, ts=np.full(1, 1100, np.int32))
+        eng.flush()
+        assert events == [], "depth-2 queue not yet over depth"
+        # Flush i+2's trim materializes flush i's record: the
+        # CLOSED->OPEN net edge fires HERE, at the drain of a later
+        # flush, exactly once.
+        manual_clock.set_ms(1200)
+        eng.submit_bulk("ne", 1, ts=np.full(1, 1200, np.int32))
+        eng.flush()
+        assert events == [(CLOSED, OPEN, "ne")]
+        # Draining the remaining in-flight records replays nothing:
+        # their snapshots show the same OPEN state (newest-wins mirror).
+        eng.drain()
+        assert events == [(CLOSED, OPEN, "ne")]
+        eng.close()
+
+    def test_full_cycle_matches_depth0_sequence(self, manual_clock):
+        """Differential against the depth-0 oracle: the same op stream
+        produces the same observed transition SEQUENCE at depth 2 —
+        only the observation time moves (to the drain)."""
+        from sentinel_tpu.rules import breaker_events
+        from sentinel_tpu.rules.degrade_table import CLOSED, HALF_OPEN, OPEN
+
+        sequences = {}
+        for depth in (0, 2):
+            breaker_events.clear()
+            eng = self._mk(manual_clock)
+            eng.pipeline_depth = depth
+            events = []
+            breaker_events.add_state_change_observer(
+                "t", lambda prev, new, rule, res: events.append((prev, new))
+            )
+            rows = eng.resolve_entry_rows(
+                "ne", C.CONTEXT_DEFAULT_NAME, "", C.EntryType.OUT
+            )
+            # Trip.
+            manual_clock.set_ms(1000)
+            eng.submit_bulk("ne", 4, ts=np.full(4, 1000, np.int32))
+            eng.submit_exit_bulk(
+                rows, 4, rt=5, err=1, ts=np.full(4, 1000, np.int32),
+                resource="ne",
+            )
+            eng.flush()
+            # Past the retry window: a probe admission (OPEN->HALF_OPEN
+            # on device in this flush), its success exit in the next
+            # flush closes the breaker (HALF_OPEN->CLOSED).
+            manual_clock.set_ms(4000)
+            eng.submit_bulk("ne", 1, ts=np.full(1, 4000, np.int32))
+            eng.flush()
+            eng.submit_exit_bulk(
+                rows, 1, rt=5, err=0, ts=np.full(1, 4050, np.int32),
+                resource="ne",
+            )
+            manual_clock.set_ms(4100)
+            eng.flush()
+            eng.drain()
+            sequences[depth] = list(events)
+            eng.close()
+        assert sequences[0] == sequences[2], sequences
+        assert sequences[0] == [
+            (CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED)
+        ]
+
+
 class TestMixedTsClosedForm:
     def test_engine_selects_segmented_mode(self, engine):
         """Mixed-timestamp QPS DEFAULT uniform-acquire batches select

@@ -35,9 +35,13 @@ class TestTraceFormat:
         events = loaded["traceEvents"]
         assert events, "demo run must emit events"
         for e in events:
-            assert e["ph"] in ("X", "M")
+            assert e["ph"] in ("X", "M", "s", "f")
             assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
-            if e["ph"] == "X":
+            if e["ph"] == "X" and e.get("cat") == "admission":
+                # Request track: one slice per sampled admission.
+                assert e["ts"] >= 0.0 and e["dur"] > 0.0
+                assert "trace_id" in e["args"] and "flush_seq" in e["args"]
+            elif e["ph"] == "X":
                 assert e["name"] in ("encode", "dispatch", "inflight")
                 assert e["ts"] >= 0.0 and e["dur"] >= 0.0
                 assert "flush_id" in e["args"]
@@ -52,6 +56,56 @@ class TestTraceFormat:
             for (s0, e0), (s1, _e1) in zip(spans, spans[1:]):
                 # 1 µs grace for float rounding at shared boundaries.
                 assert s1 >= e0 - 1e-3, (tid, (s0, e0), s1)
+
+    def test_request_flow_events_link_to_deciding_flush(self, depth2_trace):
+        """Acceptance: the dump contains request→flush flow arrows in
+        the shape Perfetto accepts — matched s/f pairs (same cat, name,
+        id), the start on a request track inside its admission slice,
+        the finish on the host track inside the DECIDING flush's
+        dispatch slice, and s.ts <= f.ts."""
+        events = depth2_trace[0]["traceEvents"]
+        starts = {e["id"]: e for e in events if e["ph"] == "s"}
+        finishes = {e["id"]: e for e in events if e["ph"] == "f"}
+        assert starts, "demo must emit flow arrows"
+        assert set(starts) == set(finishes)
+        slices = [e for e in events if e["ph"] == "X"]
+
+        def enclosing(tid, ts):
+            return [
+                e for e in slices
+                if e["tid"] == tid and e["ts"] - 1e-3 <= ts <= e["ts"] + e["dur"] + 1e-3
+            ]
+
+        for fid, s in starts.items():
+            f = finishes[fid]
+            assert s["cat"] == f["cat"] == "admission"
+            assert s["name"] == f["name"] == "decide"
+            assert f["bp"] == "e"
+            assert s["ts"] <= f["ts"]
+            req = [e for e in enclosing(s["tid"], s["ts"])
+                   if e.get("cat") == "admission"]
+            assert req, ("flow start must sit inside a request slice", s)
+            disp = [e for e in enclosing(f["tid"], f["ts"])
+                    if e.get("name") == "dispatch"]
+            assert disp, ("flow finish must sit inside a dispatch slice", f)
+            # And it is the DECIDING flush's dispatch slice.
+            assert any(
+                d["args"]["flush_id"] == req[0]["args"]["flush_seq"]
+                for d in disp
+            )
+
+    def test_blocked_and_admitted_records_present(self, depth2_trace):
+        """The demo's tight flow rule blocks part of every window: the
+        request track must carry both verdicts, blocked ones named by
+        the shared reason mapping."""
+        reqs = [
+            e for e in depth2_trace[0]["traceEvents"]
+            if e["ph"] == "X" and e.get("cat") == "admission"
+        ]
+        blocked = [e for e in reqs if not e["args"]["admitted"]]
+        admitted = [e for e in reqs if e["args"]["admitted"]]
+        assert blocked and admitted
+        assert all(e["args"]["reason_name"] == "FlowException" for e in blocked)
 
     def test_depth2_inflight_overlaps_next_encode(self, depth2_trace):
         """The pipelining proof: for most flushes N, the in-flight

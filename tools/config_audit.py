@@ -1,0 +1,106 @@
+"""Audit ``sentinel.tpu.*`` config keys against utils/config.py.
+
+Every ``sentinel.tpu.*`` key referenced anywhere under ``sentinel_tpu/``
+(code, docstrings, comments — a key mentioned in prose is a key an
+operator will try to set) must be declared in
+``SentinelConfig.DEFAULTS``. A key that is a strict PREFIX of declared
+keys (a family mention like ``sentinel.tpu.host.arena`` standing for
+``…arena.max.keys`` / ``…arena.per.key``, usually written with a
+trailing ``.*``) also passes.
+
+This is the guard that lets a new key family (like this PR's
+``sentinel.tpu.trace.*``) land safely: referencing a key the config
+registry doesn't declare fails CI instead of silently reading the
+hard-coded fallback default forever.
+
+Usage::
+
+    python tools/config_audit.py [--root sentinel_tpu]
+
+Exit status 0 when clean; 1 with a per-key report otherwise. The
+programmatic surface (``audit()``) is what tests/test_config_audit.py
+asserts on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+# A key: sentinel.tpu. followed by dot-separated lowercase segments.
+# The trailing segment must be a word (so a family wildcard
+# "sentinel.tpu.trace.*" matches up to "sentinel.tpu.trace").
+_KEY_RE = re.compile(r"sentinel\.tpu\.[a-z0-9]+(?:\.[a-z0-9]+)*")
+
+
+def declared_keys() -> Set[str]:
+    """Keys registered in SentinelConfig.DEFAULTS (the layered-config
+    single source of truth)."""
+    from sentinel_tpu.utils.config import SentinelConfig
+
+    return set(SentinelConfig.DEFAULTS)
+
+
+def referenced_keys(root: str) -> Dict[str, List[str]]:
+    """Every sentinel.tpu.* key string appearing in ``root``'s .py
+    files -> the ``path:line`` locations that mention it."""
+    refs: Dict[str, List[str]] = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    lines = f.readlines()
+            except OSError:
+                continue
+            for ln, line in enumerate(lines, 1):
+                for m in _KEY_RE.finditer(line):
+                    refs.setdefault(m.group(0), []).append(f"{path}:{ln}")
+    return refs
+
+
+def audit(root: str = "sentinel_tpu") -> Tuple[List[str], Dict[str, List[str]]]:
+    """Returns ``(missing_keys_sorted, refs)`` — a referenced key is
+    missing unless it is declared, or is a strict prefix of a declared
+    key (a family mention)."""
+    declared = declared_keys()
+    refs = referenced_keys(root)
+    missing = [
+        key
+        for key in refs
+        if key not in declared
+        and not any(d.startswith(key + ".") for d in declared)
+    ]
+    return sorted(missing), refs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default="sentinel_tpu")
+    args = ap.parse_args()
+    missing, refs = audit(args.root)
+    n_refs = sum(len(v) for v in refs.values())
+    if not missing:
+        print(
+            f"config audit OK: {len(refs)} distinct sentinel.tpu.* keys "
+            f"({n_refs} mentions) all declared in utils/config.py"
+        )
+        return 0
+    print("config audit FAILED — referenced but not declared in "
+          "SentinelConfig.DEFAULTS:")
+    for key in missing:
+        locs = refs[key]
+        shown = ", ".join(locs[:3]) + (" …" if len(locs) > 3 else "")
+        print(f"  {key}  ({shown})")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,7 +12,11 @@ Track layout (see ``spans_to_trace``): ``host`` carries every flush's
 they never overlap); each deferred flush's dispatch→settle window is an
 ``inflight`` slice on its own ``inflight-N`` track — at depth K you see
 up to K parallel inflight tracks whose slices straddle the next
-flushes' encode slices on the host track.
+flushes' encode slices on the host track. A ``requests`` track carries
+one slice per sampled admission (metrics/admission_trace.py) spanning
+enqueue→verdict, with a Perfetto flow arrow into the flush span that
+DECIDED it — hover a 429'd request, read its W3C trace id, follow the
+arrow into the deciding flush.
 
 Usage::
 
@@ -35,11 +39,13 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
 def trace_dict(engine) -> dict:
-    """The engine's current flight-recorder contents as a Chrome
-    trace-event JSON object."""
+    """The engine's current flight-recorder contents (flush spans +
+    sampled admission records) as a Chrome trace-event JSON object."""
     from sentinel_tpu.metrics.telemetry import spans_to_trace
 
-    return spans_to_trace(engine.telemetry.spans())
+    return spans_to_trace(
+        engine.telemetry.spans(), records=engine.admission_trace.records()
+    )
 
 
 def dump(engine, path: str) -> dict:
@@ -54,12 +60,17 @@ def dump(engine, path: str) -> dict:
 def run_demo(depth: int = 2, flushes: int = 24, rows: int = 512) -> "object":
     """Synthetic pipelined workload on a fresh engine: one bulk group
     per flush at the requested pipeline depth, drained at the end, so
-    the dump shows a saturated depth-K pipeline. Returns the engine."""
+    the dump shows a saturated depth-K pipeline. The flow rule is
+    tight enough to block part of every window and the tracer samples
+    at 100%, so the ``requests`` track carries blocked AND admitted
+    admissions with flow arrows. Returns the engine."""
+    from sentinel_tpu.metrics.admission_trace import AdmissionTracer
     from sentinel_tpu.models.rules import FlowRule
     from sentinel_tpu.runtime.engine import Engine
 
     eng = Engine(initial_rows=1024)
-    eng.set_flow_rules([FlowRule(resource="demo", count=1e9)])
+    eng.admission_trace = AdmissionTracer(sample_rate=1.0)
+    eng.set_flow_rules([FlowRule(resource="demo", count=rows * 4)])
     # Warm-up: interning + kernel compile outside the recorded window.
     eng.submit_bulk("demo", rows)
     eng.flush()
@@ -90,10 +101,11 @@ def main() -> None:
     n_inflight = sum(
         1 for e in trace["traceEvents"] if e.get("name") == "inflight"
     )
+    n_flows = sum(1 for e in trace["traceEvents"] if e.get("ph") == "s")
     print(
         f"wrote {args.out}: {len(trace['traceEvents'])} events "
-        f"({n_inflight} inflight spans, depth {args.depth}) — load it at "
-        "https://ui.perfetto.dev"
+        f"({n_inflight} inflight spans, {n_flows} request flow arrows, "
+        f"depth {args.depth}) — load it at https://ui.perfetto.dev"
     )
 
 
