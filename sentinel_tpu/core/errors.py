@@ -85,6 +85,11 @@ BLOCK_DEGRADE = 2
 BLOCK_SYSTEM = 3
 BLOCK_AUTHORITY = 4
 BLOCK_PARAM = 5
+# Fail-closed admission while the engine is DEGRADED (device lost, the
+# resource's failover policy says shed rather than pass) — see
+# runtime/failover.py. Not a rule verdict: the distinct code keeps
+# degraded blocks tellable from device blocks in logs and traces.
+BLOCK_FAILOVER = 7
 # Host-side custom slot veto (never appears in device tensors; the
 # engine attributes it when a registered ProcessorSlot blocked the op).
 BLOCK_CUSTOM = 6
@@ -101,6 +106,12 @@ class CustomBlockError(BlockError):
     def __str__(self) -> str:
         return f"CustomBlockError(resource={self.resource!r}, slot={self.slot_name!r})"
 
+
+class FailoverBlockError(BlockError):
+    """Fail-closed degraded admission: the device is lost and the
+    resource's ``sentinel.tpu.failover.policy`` says shed load."""
+
+
 _ERROR_BY_CODE = {
     BLOCK_FLOW: FlowBlockError,
     BLOCK_DEGRADE: DegradeBlockError,
@@ -108,6 +119,7 @@ _ERROR_BY_CODE = {
     BLOCK_AUTHORITY: AuthorityBlockError,
     BLOCK_PARAM: ParamFlowBlockError,
     BLOCK_CUSTOM: CustomBlockError,
+    BLOCK_FAILOVER: FailoverBlockError,
 }
 
 # The ONE home of the block-code → exception-name mapping (the
@@ -123,6 +135,7 @@ BLOCK_EXC_NAMES = {
     BLOCK_AUTHORITY: "AuthorityException",
     BLOCK_PARAM: "ParamFlowException",
     BLOCK_CUSTOM: "CustomBlockException",
+    BLOCK_FAILOVER: "FailoverException",
 }
 
 

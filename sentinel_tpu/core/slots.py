@@ -122,6 +122,34 @@ class SlotChainRegistry:
         return None
 
     @classmethod
+    def check_bulk_entry(cls, g) -> None:
+        """Entry checks for one bulk group, run once per DISTINCT
+        acquire value (the only per-entry field a slot can see on the
+        bulk path), vetoing exactly the matching entries by setting
+        ``g.custom_veto`` / ``g.custom_veto_mask`` in place. The ONE
+        home of the bulk veto rule — shared by the device path
+        (engine._run_chunk) and the degraded fallback fill
+        (failover.fill_degraded), which must never diverge. No-op if
+        the group was already checked."""
+        import numpy as np
+
+        if g.custom_veto is not None or g.custom_veto_mask is not None:
+            return
+        vetoed_vals = []
+        for a in np.unique(g.acquire):
+            veto = cls.check_entry(
+                SlotEntryContext(
+                    g.resource, g.context_name, g.origin, int(a), False, (),
+                )
+            )
+            if veto is not None:
+                if g.custom_veto is None:
+                    g.custom_veto = veto
+                vetoed_vals.append(int(a))
+        if vetoed_vals:
+            g.custom_veto_mask = np.isin(g.acquire, vetoed_vals)
+
+    @classmethod
     def on_exit(cls, resource: str, rt_ms: int, count: int, err: int) -> None:
         for slot in cls.slots():
             try:

@@ -62,7 +62,8 @@ class ApolloDataSource(LongPollPushDataSource[str, T]):
     ) -> None:
         if not namespace_name or not rule_key:
             raise ValueError("namespace_name and rule_key are required")
-        super().__init__(converter, MAX_BODY_BYTES)
+        super().__init__(converter, MAX_BODY_BYTES,
+                 retry_base_s=reconnect_interval_sec)
         self.namespace = namespace_name
         self.rule_key = rule_key
         self.default_rule_value = default_rule_value
@@ -150,13 +151,17 @@ class ApolloDataSource(LongPollPushDataSource[str, T]):
         self.on_update(self.read_source())
 
     def _on_poll_error(self, e: Exception) -> None:
+        # The base watch loop applies the shared capped-exponential
+        # backoff after this hook returns; the catch-up read runs in
+        # _after_backoff, once the gap has passed.
         record_log.warn(f"[ApolloDataSource] poll failed ({e}); backing off")
-        if not self._stop.wait(self.reconnect_interval):
-            # Catch-up read: a change during the outage must not wait
-            # for the next notification.
-            try:
-                self.on_update(self.read_source())
-            except Exception:
-                record_log.error(
-                    "[ApolloDataSource] catch-up read failed", exc_info=True
-                )
+
+    def _after_backoff(self) -> None:
+        # Catch-up read after the gap: a change during the outage must
+        # not wait for the next notification.
+        try:
+            self.on_update(self.read_source())
+        except Exception:
+            record_log.error(
+                "[ApolloDataSource] catch-up read failed", exc_info=True
+            )

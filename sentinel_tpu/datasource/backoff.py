@@ -1,0 +1,66 @@
+"""Shared capped-exponential-backoff-with-jitter for datasource retry
+loops.
+
+Before this helper only ``zookeeper_source`` backed off on poll errors;
+the HTTP long-poll, etcd watch and redis subscriber loops re-polled at
+a fixed cadence and could hammer a dying config server at full rate for
+as long as the outage lasted. Every source now shares one stance:
+
+* delay grows ``base × factor^n`` per consecutive failure, capped;
+* jitter REDUCES each delay by up to ``jitter`` fraction (decorrelated
+  retries across a fleet without ever exceeding the cap — and without
+  slowing tests that assert an upper bound);
+* one success resets the streak to the base delay.
+
+The RNG is injectable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+class Backoff:
+    """Capped exponential backoff with subtractive jitter.
+
+    Not thread-safe by design: each retry loop owns one instance and
+    calls it from its single watcher thread.
+    """
+
+    def __init__(
+        self,
+        base_s: float,
+        cap_s: float = 30.0,
+        factor: float = 2.0,
+        jitter: float = 0.25,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        self.base = max(float(base_s), 0.001)
+        self.cap = max(float(cap_s), self.base)
+        self.factor = max(float(factor), 1.0)
+        self.jitter = min(max(float(jitter), 0.0), 1.0)
+        self._rng = rng if rng is not None else random.Random()
+        self._failures = 0
+
+    @property
+    def failures(self) -> int:
+        """Consecutive failures so far (0 after a reset)."""
+        return self._failures
+
+    def next_delay(self) -> float:
+        """The delay before the upcoming retry; advances the streak.
+        The exponent is clamped once the undithered delay reaches the
+        cap — an unbounded ``factor ** n`` would overflow to an
+        OverflowError after ~1024 consecutive failures (a ~7 h outage
+        at the capped cadence) and kill the watcher thread for good."""
+        raw = self.base * self.factor ** self._failures
+        d = min(self.cap, raw)
+        if raw < self.cap:
+            self._failures += 1
+        if self.jitter > 0.0:
+            d *= 1.0 - self.jitter * self._rng.random()
+        return d
+
+    def reset(self) -> None:
+        self._failures = 0

@@ -44,6 +44,25 @@ def read_capped(resp, max_bytes: int = DEFAULT_MAX_BODY_BYTES) -> bytes:
     return data
 
 
+def join_clean(thread, timeout: float, name: str) -> bool:
+    """Join a watcher thread on close; returns True when it actually
+    stopped. A ``join(timeout=…)`` that expires leaks a LIVE daemon
+    thread — every source logs that loudly and flips its
+    ``closed_dirty`` flag instead of pretending the shutdown was
+    clean (a stuck thread can still touch sockets, callbacks and the
+    rule property after "close")."""
+    if thread is None:
+        return True
+    thread.join(timeout=timeout)
+    if thread.is_alive():
+        record_log.warn(
+            "[%s] watcher thread did not stop within %.1fs; a live "
+            "thread leaked (closed_dirty=True)", name, timeout,
+        )
+        return False
+    return True
+
+
 def json_converter(rule_cls: type) -> Converter[str, List]:
     """Raw JSON string -> list of rules of ``rule_cls`` (accepts the
     reference's camelCase field names; see models.rules.rules_from_json)."""

@@ -53,7 +53,8 @@ class ConsulDataSource(LongPollPushDataSource[str, T], WritableDataSource[str]):
         reconnect_interval_sec: float = 2.0,
         token: Optional[str] = None,
     ) -> None:
-        super().__init__(converter, MAX_BODY_BYTES)
+        super().__init__(converter, MAX_BODY_BYTES,
+                 retry_base_s=reconnect_interval_sec)
         self.key = key.lstrip("/")
         self.endpoint = endpoint.rstrip("/")
         self.wait_sec = wait_sec
@@ -146,9 +147,9 @@ class ConsulDataSource(LongPollPushDataSource[str, T], WritableDataSource[str]):
             conn.close()
 
     def _on_poll_error(self, e: Exception) -> None:
+        # The base watch loop backs off (capped exponential) after this
+        # hook returns.
         record_log.warn(
-            "[ConsulDataSource] blocking query failed (%s); retrying in %.1fs",
-            e, self.reconnect_interval,
+            "[ConsulDataSource] blocking query failed (%s); backing off", e,
         )
         self._index = 0  # full re-read after the gap — updates never lost
-        self._stop.wait(self.reconnect_interval)

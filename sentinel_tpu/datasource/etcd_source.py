@@ -89,6 +89,10 @@ class EtcdDataSource(PushDataSource[str, T], WritableDataSource[str]):
         self.endpoint = endpoint.rstrip("/")
         self.timeout = timeout_sec
         self.reconnect_interval = reconnect_interval_sec
+        from sentinel_tpu.datasource.backoff import Backoff
+
+        self._backoff = Backoff(reconnect_interval_sec)
+        self.closed_dirty = False
         self.api_prefix = api_prefix
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -184,33 +188,53 @@ class EtcdDataSource(PushDataSource[str, T], WritableDataSource[str]):
 
     def _watch_loop(self) -> None:
         while not self._stop.is_set():
+            failed = False
             try:
                 self._watch_once()
             except Exception as e:
                 if self._stop.is_set():
                     return
+                failed = True
                 record_log.warn(
-                    "[EtcdDataSource] watch lost (%s); retrying in %.1fs",
-                    e, self.reconnect_interval,
+                    "[EtcdDataSource] watch lost (%s); backing off", e,
                 )
             if self._stop.is_set():
                 return
-            # Between streams the revision cursor may be stale
-            # (compaction, cap trip, gateway restart): re-read the key
-            # so updates during the gap are never lost.
-            try:
-                self.on_update(self.read_source())
-            except Exception as e:
-                # record_log.warn has no exc_info kwarg — passing it
-                # would TypeError inside this handler and kill the
-                # watcher thread for good.
-                record_log.warn("[EtcdDataSource] catch-up read failed: %s", e)
-            self._stop.wait(self.reconnect_interval)
+            # Shared capped-exponential backoff on error streaks; a
+            # clean stream close reconnects at the base cadence. On a
+            # failed stream the catch-up read runs AFTER the gap — an
+            # immediate re-read would double the request volume against
+            # the very server whose failure triggered the backoff (the
+            # same rule as longpoll._after_backoff).
+            if failed:
+                if self._stop.wait(self._backoff.next_delay()):
+                    return
+                self._catch_up()
+            else:
+                self._backoff.reset()
+                self._catch_up()
+                if self._stop.wait(self._backoff.next_delay()):
+                    return
+
+    def _catch_up(self) -> None:
+        # Between streams the revision cursor may be stale
+        # (compaction, cap trip, gateway restart): re-read the key
+        # so updates during the gap are never lost.
+        try:
+            self.on_update(self.read_source())
+        except Exception as e:
+            # record_log.warn has no exc_info kwarg — passing it
+            # would TypeError inside this handler and kill the
+            # watcher thread for good.
+            record_log.warn("[EtcdDataSource] catch-up read failed: %s", e)
 
     def close(self) -> None:
+        from sentinel_tpu.datasource.base import join_clean
+
         self._stop.set()
         resp = self._watch_resp
         if resp is not None:
             _kill_stream(resp)  # unblocks the reader thread
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        self.closed_dirty = getattr(self, "closed_dirty", False) or not join_clean(
+            self._thread, 5, type(self).__name__
+        )

@@ -106,6 +106,10 @@ class HttpLongPollDataSource(PushDataSource[str, T]):
         self.wait = wait
         self.timeout = timeout_sec
         self.retry_interval = retry_interval_sec
+        from sentinel_tpu.datasource.backoff import Backoff
+
+        self._backoff = Backoff(retry_interval_sec)
+        self.closed_dirty = False
         self.headers = dict(headers or {})
         self._index: Optional[str] = None
         self._stop = threading.Event()
@@ -145,6 +149,7 @@ class HttpLongPollDataSource(PushDataSource[str, T]):
         while not self._stop.is_set():
             try:
                 body = self._request(blocking=True)
+                self._backoff.reset()
                 if body is not None and not self._stop.is_set():
                     self.on_update(body)
                 if self._index is None:
@@ -157,14 +162,23 @@ class HttpLongPollDataSource(PushDataSource[str, T]):
                 if self._stop.is_set():
                     return
                 record_log.warn(
-                    "[HttpLongPoll] poll failed (%s); retrying in %.1fs",
-                    e, self.retry_interval,
+                    "[HttpLongPoll] poll failed (%s); backing off", e,
                 )
-                self._stop.wait(self.retry_interval)
+                # Shared capped-exponential backoff: consecutive
+                # failures must not hammer a dying config server at a
+                # fixed cadence.
+                self._stop.wait(self._backoff.next_delay())
 
     def close(self) -> None:
+        from sentinel_tpu.datasource.base import join_clean
+
         self._stop.set()
-        # The in-flight blocking request ends on its own wait timeout;
-        # the daemon thread then exits (join bounded for tidy shutdown).
-        if self._thread is not None:
-            self._thread.join(timeout=1)
+        # The in-flight blocking request ends on its own wait timeout —
+        # urllib gives us nothing to kill it with (that limitation is
+        # why longpoll.py exists), so a close during a held poll
+        # legitimately reports closed_dirty: the watcher IS still alive
+        # past this join, for up to the server hold. It exits on its
+        # own once the request returns.
+        self.closed_dirty = self.closed_dirty or not join_clean(
+            self._thread, 1, type(self).__name__
+        )

@@ -17,7 +17,8 @@ import threading
 from typing import Callable, Optional, Tuple
 from urllib.parse import urlsplit
 
-from sentinel_tpu.datasource.base import Converter, PushDataSource, S, T
+from sentinel_tpu.datasource.backoff import Backoff
+from sentinel_tpu.datasource.base import Converter, PushDataSource, S, T, join_clean
 from sentinel_tpu.utils.record_log import record_log
 
 
@@ -76,7 +77,8 @@ class LongPollPushDataSource(PushDataSource[S, T]):
 
     _thread_name = "sentinel-longpoll-watcher"
 
-    def __init__(self, converter: Converter[S, T], max_body_bytes: int) -> None:
+    def __init__(self, converter: Converter[S, T], max_body_bytes: int,
+                 retry_base_s: float = 2.0) -> None:
         super().__init__(converter)
         self._max_body_bytes = max_body_bytes
         self._stop = threading.Event()
@@ -85,6 +87,14 @@ class LongPollPushDataSource(PushDataSource[S, T]):
         # response blocks), killed on close to unblock the watcher
         # instantly.
         self._poll_conn: Optional[http.client.HTTPConnection] = None
+        # Shared retry stance: consecutive poll errors back off
+        # exponentially (capped, jittered) instead of hammering a dying
+        # server at a fixed cadence; subclasses pass their reconnect
+        # interval as retry_base_s.
+        self._backoff = Backoff(retry_base_s)
+        # close() could not join the watcher thread — a live thread
+        # leaked past shutdown.
+        self.closed_dirty = False
 
     def _set_poll_conn(self, conn) -> None:
         self._poll_conn = conn
@@ -112,10 +122,20 @@ class LongPollPushDataSource(PushDataSource[S, T]):
         while not self._stop.is_set():
             try:
                 self._poll_once()
+                self._backoff.reset()
             except Exception as e:
                 if self._stop.is_set():
                     return
                 self._on_poll_error(e)
+                # Capped exponential backoff with jitter between error
+                # retries (the subclass hook above only logs); a
+                # success resets the streak. The catch-up hook runs
+                # AFTER the gap — an immediate re-read would double
+                # the request volume against the very server whose
+                # failure triggered the backoff.
+                if self._stop.wait(self._backoff.next_delay()):
+                    return
+                self._after_backoff()
 
     def _poll_once(self) -> None:
         raise NotImplementedError
@@ -123,8 +143,14 @@ class LongPollPushDataSource(PushDataSource[S, T]):
     def _on_poll_error(self, e: Exception) -> None:
         raise NotImplementedError
 
+    def _after_backoff(self) -> None:
+        """Post-gap catch-up hook (default no-op): subclasses whose
+        push channel can silently drop updates during an outage re-read
+        the source here, once the backoff delay has passed."""
+
     def close(self) -> None:
         self._stop.set()
         kill_conn(self._poll_conn)  # unblocks the in-flight poll now
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        self.closed_dirty = self.closed_dirty or not join_clean(
+            self._thread, 5, type(self).__name__
+        )

@@ -61,7 +61,8 @@ class NacosDataSource(LongPollPushDataSource[str, T], WritableDataSource[str]):
         reconnect_interval_sec: float = 2.0,
         context_path: str = "/nacos",
     ) -> None:
-        super().__init__(converter, MAX_BODY_BYTES)
+        super().__init__(converter, MAX_BODY_BYTES,
+                 retry_base_s=reconnect_interval_sec)
         self.data_id = data_id
         self.group = group
         self.endpoint = endpoint.rstrip("/")
@@ -149,13 +150,15 @@ class NacosDataSource(LongPollPushDataSource[str, T], WritableDataSource[str]):
             self.on_update(self.read_source())
 
     def _on_poll_error(self, e: Exception) -> None:
+        # The base watch loop backs off (capped exponential) after this
+        # hook returns; the catch-up read runs in _after_backoff.
         record_log.warn(
-            "[NacosDataSource] long poll failed (%s); retrying in %.1fs",
-            e, self.reconnect_interval,
+            "[NacosDataSource] long poll failed (%s); backing off", e,
         )
-        self._stop.wait(self.reconnect_interval)
-        # After the gap, catch up with a plain read so an update
-        # during the outage is never silently lost.
+
+    def _after_backoff(self) -> None:
+        # Catch up with a plain read after the gap so an update during
+        # the outage is never silently lost.
         try:
             self.on_update(self.read_source())
         except Exception as e2:

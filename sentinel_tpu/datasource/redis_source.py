@@ -154,6 +154,10 @@ class RedisDataSource(PushDataSource[S, T]):
         self.password = password
         self.db = db
         self.reconnect_interval = reconnect_interval_sec
+        from sentinel_tpu.datasource.backoff import Backoff
+
+        self._backoff = Backoff(reconnect_interval_sec)
+        self.closed_dirty = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._sub_conn: Optional[RespConnection] = None
@@ -199,6 +203,7 @@ class RedisDataSource(PushDataSource[S, T]):
                 # (pub/sub has no replay) — both at startup (between the
                 # initial GET and here) and across reconnects: re-read
                 # the key after EVERY subscribe ack to catch up.
+                self._backoff.reset()
                 self.on_update(self.read_source())
                 while not self._stop.is_set():
                     msg = conn.read_reply()
@@ -213,19 +218,22 @@ class RedisDataSource(PushDataSource[S, T]):
                 if self._stop.is_set():
                     return
                 record_log.warn(
-                    "[RedisDataSource] subscriber lost (%s); retrying in %.1fs",
-                    e, self.reconnect_interval,
+                    "[RedisDataSource] subscriber lost (%s); backing off", e,
                 )
-                self._stop.wait(self.reconnect_interval)
+                # Shared capped-exponential backoff across reconnects.
+                self._stop.wait(self._backoff.next_delay())
             finally:
                 if self._sub_conn is not None:
                     self._sub_conn.close()
                     self._sub_conn = None
 
     def close(self) -> None:
+        from sentinel_tpu.datasource.base import join_clean
+
         self._stop.set()
         conn = self._sub_conn  # snapshot: the subscriber thread may
         if conn is not None:   # clear the attribute concurrently
             conn.close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        self.closed_dirty = self.closed_dirty or not join_clean(
+            self._thread, 5, type(self).__name__
+        )

@@ -382,6 +382,10 @@ class ZookeeperDataSource(PushDataSource[str, T], WritableDataSource[str]):
         self.host, self.port = self._servers[0]
         self.session_timeout_ms = session_timeout_ms
         self.reconnect_interval = reconnect_interval_sec
+        from sentinel_tpu.datasource.backoff import Backoff
+
+        self._backoff = Backoff(reconnect_interval_sec)
+        self.closed_dirty = False
         self.request_timeout = request_timeout_sec
         self.auth = list(auth or [])
         self._stop = threading.Event()
@@ -408,9 +412,13 @@ class ZookeeperDataSource(PushDataSource[str, T], WritableDataSource[str]):
             conn.close()
         # Join-on-close, like the long-poll sources: after close()
         # returns, no session thread is still reconnecting or pushing.
+        from sentinel_tpu.datasource.base import join_clean
+
         t = self._thread
         if t is not None and t is not threading.current_thread():
-            t.join(timeout=5.0)
+            self.closed_dirty = self.closed_dirty or not join_clean(
+                t, 5.0, type(self).__name__
+            )
 
     # -- datasource surface --
     def read_source(self) -> Optional[str]:
@@ -573,17 +581,17 @@ class ZookeeperDataSource(PushDataSource[str, T], WritableDataSource[str]):
         self._wake.set()
 
     def _session_loop(self) -> None:
-        backoff = self.reconnect_interval
+        # Shared capped-exponential backoff (datasource/backoff.py) —
+        # this loop's hand-rolled doubling predated the helper.
         while not self._stop.is_set():
             try:
                 conn = self._connect()
             except (OSError, ZkError) as exc:
                 record_log.warn(f"[ZookeeperDataSource] connect failed: {exc}")
-                if self._stop.wait(backoff):
+                if self._stop.wait(self._backoff.next_delay()):
                     return
-                backoff = min(backoff * 2, 30.0)
                 continue
-            backoff = self.reconnect_interval
+            self._backoff.reset()
             with self._conn_lock:
                 if self._stop.is_set():
                     conn.close()
@@ -613,7 +621,7 @@ class ZookeeperDataSource(PushDataSource[str, T], WritableDataSource[str]):
                     if self._conn is conn:
                         self._conn = None
                 conn.close()
-            if self._stop.wait(self.reconnect_interval):
+            if self._stop.wait(self._backoff.next_delay()):
                 return
 
     def _refresh(self, conn: _ZkConn) -> None:

@@ -185,6 +185,10 @@ class AdmissionRecord:
     t0: float  # perf_counter at enqueue (tracedump timeline)
     latency_ms: float  # enqueue -> verdict materialized
     head_sampled: bool  # False = recorded by the always-blocked mode
+    # Verdict provenance: decided by the host fallback admitter while
+    # the engine was DEGRADED (reason BLOCK_FAILOVER for policy sheds;
+    # degraded ADMITS keep reason PASS but carry this mark).
+    degraded: bool = False
 
     def as_dict(self) -> dict:
         return {
@@ -200,6 +204,7 @@ class AdmissionRecord:
             "flush_seq": self.flush_seq,
             "latency_ms": round(self.latency_ms, 4),
             "head_sampled": self.head_sampled,
+            "degraded": self.degraded,
         }
 
 
@@ -290,6 +295,7 @@ class AdmissionTracer:
         reason: int,
         flush_seq: int,
         end_pc: float,
+        degraded: bool = False,
     ) -> Optional[AdmissionRecord]:
         """Record one settled admission if the tag (or the blocked
         override) selects it; returns the record or None."""
@@ -311,6 +317,7 @@ class AdmissionTracer:
             t0=tag.t0,
             latency_ms=max(0.0, (end_pc - tag.t0) * 1e3),
             head_sampled=tag.sampled,
+            degraded=degraded,
         )
         self.hist_latency.record(rec.latency_ms)
         bucket = self.hist_latency.bucket_of(rec.latency_ms)
@@ -334,6 +341,7 @@ class AdmissionTracer:
         reasons,
         flush_seq: int,
         end_pc: float,
+        degraded: bool = False,
     ) -> None:
         """Bounded per-row records for one bulk group: up to
         ``bulk_cap`` blocked rows (always-blocked mode) plus, when the
@@ -360,6 +368,7 @@ class AdmissionTracer:
             self.record_admission(
                 tag, resource, origin, context_name,
                 bool(adm[i]), int(reasons[i]), flush_seq, end_pc,
+                degraded=degraded,
             )
 
     # ------------------------------------------------------------------

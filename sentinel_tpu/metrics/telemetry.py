@@ -80,6 +80,9 @@ class FlushSpan:
     intern_hits: int = 0  # ParamIndex resolved-value cache delta since prev span
     intern_misses: int = 0
     fallbacks: int = 0  # coalesced-fetch failures this span rode through
+    # Failover quarantined this flush: its device results were lost and
+    # its verdicts came from the host fallback (runtime/failover.py).
+    quarantined: bool = False
 
     @property
     def rows(self) -> int:
@@ -116,6 +119,7 @@ class FlushSpan:
             "intern_hits": self.intern_hits,
             "intern_misses": self.intern_misses,
             "fallbacks": self.fallbacks,
+            "quarantined": self.quarantined,
         }
 
 
@@ -212,7 +216,21 @@ class TelemetryBus:
             "coalesced_fallbacks": 0,
             "arena_hits": 0,
             "arena_misses": 0,
+            # Failure domain (runtime/failover.py): host-fallback
+            # verdicts served while DEGRADED, health transitions, and
+            # recovery probe flushes.
+            "degraded_admits": 0,
+            "degraded_blocks": 0,
+            "health_transitions": 0,
+            "probe_flushes": 0,
         }
+        # Bounded ring of health transitions (now_ms is engine-clock
+        # relative ms): the flight-recorder view of the failover state
+        # machine — the authoritative copy (with counters) lives on
+        # FailoverManager; this one rides telemetry snapshots.
+        self.health_events: "deque[Tuple[int, str, str, str]]" = deque(
+            maxlen=64
+        )
         self.sketch = SpaceSaving(
             sketch_capacity
             if sketch_capacity is not None
@@ -305,6 +323,24 @@ class TelemetryBus:
             self.counters["arena_hits"] += hits
             self.counters["arena_misses"] += misses
 
+    def note_health(self, frm: str, to: str, reason: str,
+                    now_ms: int = 0) -> None:
+        """One failover state transition (span-mark analog: spans that
+        settle as quarantined carry the per-flush view; this is the
+        engine-level event stream)."""
+        with self._lock:
+            self.counters["health_transitions"] += 1
+            self.health_events.append((now_ms, frm, to, reason))
+
+    def note_degraded(self, admits: int, blocks: int) -> None:
+        with self._lock:
+            self.counters["degraded_admits"] += admits
+            self.counters["degraded_blocks"] += blocks
+
+    def note_probe(self) -> None:
+        with self._lock:
+            self.counters["probe_flushes"] += 1
+
     def fold_blocked_topk(self, pairs: Sequence[Tuple[str, int]]) -> None:
         """Fold one flush's device top-K (already name-resolved) into
         the running space-saving summary."""
@@ -355,6 +391,10 @@ class TelemetryBus:
                 {"resource": k, "weight": w} for k, w in self.last_blocked_topk
             ],
             "recent_spans": [s.as_dict() for s in self.spans()[-16:]],
+            "health_events": [
+                {"now_ms": ms, "from": f, "to": t, "reason": r}
+                for ms, f, t, r in list(self.health_events)
+            ],
         }
         if engine is not None:
             out["pipeline"] = engine.pipeline_stats()

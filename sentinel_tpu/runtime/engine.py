@@ -93,6 +93,10 @@ class Verdict(NamedTuple):
     blocked_rule: Optional[object]  # the rule bean that blocked, if attributable
     limit_type: str = ""  # system block dimension (qps/thread/rt/load/cpu)
     slot_name: str = ""  # custom slot that vetoed (reason BLOCK_CUSTOM)
+    # Verdict provenance: True when the decision came from the host
+    # fallback admitter while the engine was DEGRADED (device lost) —
+    # never from the device path (runtime/failover.py).
+    degraded: bool = False
 
 
 class _PendingFetch:
@@ -113,16 +117,26 @@ class _PendingFetch:
     and re-entrant materialization from a callback is a no-op."""
 
     __slots__ = (
-        "_engine", "_entries", "_refs", "_fill", "_done", "_error",
-        "_lock", "_staging", "_span",
+        "_engine", "_entries", "_bulk", "_exits", "_bulk_exits", "_refs",
+        "_fill", "_done", "_error", "_lock", "_staging", "_span", "_seq",
     )
 
     def __init__(
         self, engine: "Engine", entries: List["_EntryOp"], refs: tuple,
         fill, staging: Optional[List[tuple]] = None, span=None,
+        bulk: Optional[List["BulkOp"]] = None, seq: int = -1,
+        exits: Optional[list] = None, bulk_exits: Optional[list] = None,
     ) -> None:
         self._engine = engine
         self._entries = entries
+        # Bulk groups and exits of the chunk — the fill closure owns
+        # their normal processing; kept here so a failover quarantine
+        # can fill verdicts from policy AND record the exits' device-
+        # gauge releases (replayed at restore) without the device
+        # results.
+        self._bulk = bulk or []
+        self._exits = exits or []
+        self._bulk_exits = bulk_exits or []
         self._refs = refs  # device arrays awaiting their host fetch
         self._fill = fill  # (fetched tuple) -> blocked_items
         self._done = False
@@ -134,45 +148,63 @@ class _PendingFetch:
         # Flight-recorder span closed at materialization (None when
         # telemetry is disabled).
         self._span = span
+        # Engine flush sequence number of the dispatched chunk — the
+        # fault injector's key and the watchdog's attribution.
+        self._seq = seq
 
     def materialize(self, got: Optional[tuple] = None) -> None:
         """Fetch + verdict fill + post work, exactly once. ``got`` is
         an already-fetched result tuple from a coalesced batch
         device_get (None → this record fetches its own). A failed
         fetch is stored and re-raised to EVERY caller — a device
-        failure must never read as 'nothing admitted'. References to
-        the chunk (closure, result buffers, op lists) are dropped as
-        soon as they are consumed."""
+        failure must never read as 'nothing admitted' — UNLESS failover
+        is armed: then the record is quarantined and its ops get policy
+        verdicts from the host fallback instead (degraded provenance;
+        runtime/failover.py). References to the chunk (closure, result
+        buffers, op lists) are dropped as soon as they are consumed."""
         with self._lock:
             if not self._done:
+                fo = self._engine.failover
+                if got is None and fo.armed and fo.degraded:
+                    # The engine degraded while this record waited:
+                    # don't touch the (possibly wedged) device again.
+                    self._quarantine_locked(fo)
+                    return
                 items: Optional[List[tuple]] = None
                 t_fetch0 = time.perf_counter()
                 try:
                     if got is None:
                         t0 = time.perf_counter()
-                        got = jax.device_get(self._refs)
+                        got = self._engine._fetch_refs(
+                            self._refs, (self._seq,)
+                        )
                         self._engine._note_drain_ms(
                             (time.perf_counter() - t0) * 1e3
                         )
                     items = self._fill(got)
                 except BaseException as exc:
+                    if fo.armed:
+                        fo.trip("fetch", exc, self._seq)
+                        self._quarantine_locked(fo)
+                        return
                     self._error = exc
                 finally:
-                    self._refs = None
-                    self._fill = None
-                    self._done = True
-                    # Staging returns to the arena only after a
-                    # SUCCESSFUL fetch (which proves the computation
-                    # consumed its possibly-zero-copy inputs); a
-                    # failed/interrupted fetch drops it to GC — the
-                    # computation may still be running.
-                    staging, self._staging = self._staging, []
-                    if (
-                        staging
-                        and self._error is None
-                        and self._engine._arena is not None
-                    ):
-                        self._engine._arena.give_all(staging)
+                    if not self._done:
+                        self._refs = None
+                        self._fill = None
+                        self._done = True
+                        # Staging returns to the arena only after a
+                        # SUCCESSFUL fetch (which proves the computation
+                        # consumed its possibly-zero-copy inputs); a
+                        # failed/interrupted fetch drops it to GC — the
+                        # computation may still be running.
+                        staging, self._staging = self._staging, []
+                        if (
+                            staging
+                            and self._error is None
+                            and self._engine._arena is not None
+                        ):
+                            self._engine._arena.give_all(staging)
                 span, self._span = self._span, None
                 if span is not None and self._error is None:
                     # Close the flight-recorder span: for a coalesced
@@ -183,6 +215,9 @@ class _PendingFetch:
                         span, t_fetch0, time.perf_counter()
                     )
                 entries, self._entries = self._entries, []
+                self._bulk = []
+                self._exits = []
+                self._bulk_exits = []
                 if self._error is None:
                     # Post-work failures (log IO, release RPCs) surface
                     # to this materializer only: the verdicts ARE
@@ -190,6 +225,42 @@ class _PendingFetch:
                     self._engine._post_flush((entries, items or []))
             if self._error is not None:
                 raise self._error
+
+    def _quarantine_locked(self, fo) -> None:
+        """Fill this record's ops from the fallback policy instead of
+        the lost device results. Caller holds ``self._lock`` and has
+        verified ``not self._done``. Staging is dropped to GC — the
+        dispatched computation may still be running (or wedged) and
+        could read the buffers zero-copy."""
+        entries, self._entries = self._entries, []
+        bulk, self._bulk = self._bulk, []
+        exits, self._exits = self._exits, []
+        bulk_exits, self._bulk_exits = self._bulk_exits, []
+        self._refs = None
+        self._fill = None
+        self._staging = []
+        self._done = True
+        fo.note_quarantined()
+        span, self._span = self._span, None
+        if span is not None:
+            span.quarantined = True
+            self._engine.telemetry.settle(
+                span, time.perf_counter(), time.perf_counter()
+            )
+        # Custom slot checks already ran when this chunk dispatched —
+        # never re-run user hooks on quarantine. Exits ride along so
+        # their thread-gauge releases are recorded for the restore
+        # replay (the chunk postdates any stored checkpoint).
+        items = fo.fill_degraded(entries, exits, bulk, bulk_exits,
+                                 run_custom_slots=False)
+        self._engine._post_flush((entries, items))
+
+    def quarantine(self) -> None:
+        """Public quarantine entry (engine._quarantine_pending): fill
+        from policy unless already materialized."""
+        with self._lock:
+            if not self._done:
+                self._quarantine_locked(self._engine.failover)
 
     def wait(self) -> None:
         self._engine._drain_pending(upto=self)
@@ -619,6 +690,22 @@ class Engine:
         # Global on/off switch (Constants.ON, flipped by the setSwitch
         # command): when off, entries pass through unchecked + unrecorded.
         self.enabled = True
+        # Monotonic flush sequence number: one per dispatched chunk and
+        # per failover probe flush — the fault injector's key and the
+        # checkpoint cadence counter. Advanced under _flush_lock only.
+        self._flush_seq = 0
+        # Deterministic fault injector (testing/faults.FaultInjector);
+        # None in production — every hook is a single attribute read.
+        self.faults = None
+        # Device-failure domain (runtime/failover.py): health state
+        # machine, flush watchdog, host-fallback admission, checkpoint/
+        # restore. Disarmed by default — one attribute read per hook.
+        from sentinel_tpu.runtime.failover import FailoverManager
+
+        self.failover = FailoverManager(self)
+        # True when a close()/stop could not join a worker thread in
+        # time — the shutdown LOOKED clean but leaked a live thread.
+        self.closed_dirty = False
         # Sharded (multi-chip) mode — see enable_mesh().
         self.mesh = None
         self._sharded_fns: Optional[Dict[Tuple[bool, bool], object]] = None
@@ -1495,21 +1582,44 @@ class Engine:
             f"rebase offset {offset} not aligned to window grids"
         )
 
+        self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn = (
+            self._shift_states(
+                self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn,
+                offset,
+            )
+        )
+        for op in self._entries:
+            op.ts = max(op.ts - offset, 0)
+        for op in self._exits:
+            op.ts = max(op.ts - offset, 0)
+        for g in self._bulk_entries:
+            np.maximum(g.ts - offset, 0, out=g.ts)
+        for g in self._bulk_exits:
+            np.maximum(g.ts - offset, 0, out=g.ts)
+
+    def _shift_states(self, stats, flow_dyn, degrade_dyn, param_dyn, offset):
+        """Shift every absolute-ms tensor in one state family set by
+        ``offset`` — the single home of the ``shift_ws`` timestamp
+        machinery, shared by the ~22-day epoch rebase
+        (:meth:`_apply_rebase`) and the failover checkpoint restore
+        (runtime/failover.py re-bases a checkpoint captured before a
+        rebase into the current epoch)."""
+
         def shift_ws(ws, floor):
             return jnp.maximum(ws - jnp.int32(offset), jnp.int32(floor))
 
-        self.stats = self.stats._replace(
-            second=self.stats.second._replace(
-                window_start=shift_ws(self.stats.second.window_start, _ncfg.SECOND_CFG.empty_ws)
+        stats = stats._replace(
+            second=stats.second._replace(
+                window_start=shift_ws(stats.second.window_start, _ncfg.SECOND_CFG.empty_ws)
             ),
-            minute=self.stats.minute._replace(
-                window_start=shift_ws(self.stats.minute.window_start, MINUTE_CFG.empty_ws)
+            minute=stats.minute._replace(
+                window_start=shift_ws(stats.minute.window_start, MINUTE_CFG.empty_ws)
             ),
-            future_ws=shift_ws(self.stats.future_ws, _ncfg.SECOND_CFG.empty_ws),
+            future_ws=shift_ws(stats.future_ws, _ncfg.SECOND_CFG.empty_ws),
         )
-        self.flow_dyn = self.flow_dyn._replace(
-            latest_passed_time=shift_ws(self.flow_dyn.latest_passed_time, -(10**9)),
-            last_filled_time=shift_ws(self.flow_dyn.last_filled_time, -(10**9)),
+        flow_dyn = flow_dyn._replace(
+            latest_passed_time=shift_ws(flow_dyn.latest_passed_time, -(10**9)),
+            last_filled_time=shift_ws(flow_dyn.last_filled_time, -(10**9)),
         )
         # Breakers: an OPEN breaker's retry deadline and the current
         # window anchor are absolute ms and must shift too — otherwise a
@@ -1523,30 +1633,23 @@ class Engine:
         # days — counts are kept, never lost.
         ws_floor = -(10**9)
         iv = jnp.maximum(self.degrade_index.device.interval_ms, 1)
-        ws_shifted = shift_ws(self.degrade_dyn.ws, ws_floor)
+        ws_shifted = shift_ws(degrade_dyn.ws, ws_floor)
         ws_aligned = jnp.where(
             ws_shifted > jnp.int32(ws_floor), ws_shifted - ws_shifted % iv, ws_shifted
         )
-        self.degrade_dyn = self.degrade_dyn._replace(
-            next_retry=shift_ws(self.degrade_dyn.next_retry, ws_floor),
+        degrade_dyn = degrade_dyn._replace(
+            next_retry=shift_ws(degrade_dyn.next_retry, ws_floor),
             ws=ws_aligned,
         )
         # Hot-param token buckets / pacers (PARAM_NEVER marks "no state
         # yet" and must stay put).
         from sentinel_tpu.rules.param_table import PARAM_NEVER
 
-        self.param_dyn = self.param_dyn._replace(
-            last_add=shift_ws(self.param_dyn.last_add, PARAM_NEVER),
-            latest=shift_ws(self.param_dyn.latest, PARAM_NEVER),
+        param_dyn = param_dyn._replace(
+            last_add=shift_ws(param_dyn.last_add, PARAM_NEVER),
+            latest=shift_ws(param_dyn.latest, PARAM_NEVER),
         )
-        for op in self._entries:
-            op.ts = max(op.ts - offset, 0)
-        for op in self._exits:
-            op.ts = max(op.ts - offset, 0)
-        for g in self._bulk_entries:
-            np.maximum(g.ts - offset, 0, out=g.ts)
-        for g in self._bulk_exits:
-            np.maximum(g.ts - offset, 0, out=g.ts)
+        return stats, flow_dyn, degrade_dyn, param_dyn
 
     def _ensure_capacity(self) -> None:
         need = len(self.nodes)
@@ -1792,14 +1895,27 @@ class Engine:
         self._auto_flush_thread = t
         t.start()
 
-    def stop_auto_flush(self) -> None:
+    def stop_auto_flush(self, join_timeout_s: float = 5.0) -> None:
         with self._lock:
             t, stop = self._auto_flush_thread, self._auto_flush_stop
             self._auto_flush_thread = None
             self._auto_flush_stop = None
         if t is not None and stop is not None:
             stop.set()
-            t.join(timeout=5)
+            t.join(timeout=join_timeout_s)
+            if t.is_alive():
+                # The flusher is stuck (most likely inside a wedged
+                # device call). Pretending the shutdown was clean hides
+                # a leaked live thread — warn and mark the engine dirty
+                # so operators/tests can assert on it.
+                from sentinel_tpu.utils.record_log import record_log
+
+                self.closed_dirty = True
+                record_log.warn(
+                    "[Engine] auto-flush thread did not stop within "
+                    "%.1fs; a live thread leaked (closed_dirty=True)",
+                    join_timeout_s,
+                )
 
     def close(self) -> None:
         """Graceful quiesce: stop the auto-flusher, decide anything
@@ -1814,6 +1930,7 @@ class Engine:
         self.stop_auto_flush()
         self.flush()
         self.drain()
+        self.failover.close()
 
     @property
     def last_flush_host_ms(self) -> Dict[str, float]:
@@ -1908,6 +2025,82 @@ class Engine:
                 or self._bulk_entries or self._bulk_exits
             )
 
+    def _next_flush_seq(self) -> int:
+        """Advance the monotonic flush sequence (caller holds
+        ``_flush_lock`` — dispatches and probes are serialized on it)."""
+        self._flush_seq += 1
+        return self._flush_seq
+
+    @property
+    def flush_seq(self) -> int:
+        """The last assigned flush sequence number (one per dispatched
+        chunk and per failover probe flush) — what the fault injector
+        keys on."""
+        return self._flush_seq
+
+    def _fetch_refs(self, refs, seqs: Sequence[int]):
+        """The ONE chokepoint for device→host result fetches: the
+        deterministic fault injector fires here (keyed by flush seq),
+        and with failover armed the blocking ``jax.device_get`` runs on
+        a watchdog waiter thread bounded by
+        ``sentinel.tpu.failover.fetch.timeout.ms`` — a wedged fetch
+        raises :class:`~sentinel_tpu.runtime.failover.DeviceFetchTimeout`
+        instead of stranding the caller (and everyone behind the flush
+        lock) forever."""
+        faults = self.faults
+        fo = self.failover
+        if fo.armed:
+            def _run():
+                if faults is not None:
+                    faults.on_fetch(seqs)
+                return jax.device_get(refs)
+
+            return fo.watched(_run, "device fetch", seqs)
+        if faults is not None:
+            faults.on_fetch(seqs)
+        return jax.device_get(refs)
+
+    def _quarantine_pending(self) -> None:
+        """Quarantine the whole in-flight queue (failover trip): every
+        dispatched-but-unfetched record's ops get policy verdicts from
+        the host fallback instead of a device fetch that would fail —
+        or hang — again."""
+        while True:
+            with self._pending_lock:
+                if not self._pending_fetches:
+                    return
+                rec = self._pending_fetches.popleft()
+            rec.quarantine()
+
+    def _flush_degraded(self) -> List[_EntryOp]:
+        """The DEGRADED flush: swap the pending buffers and fill every
+        verdict from the host fallback admitter — no device contact at
+        all. Serialized on the flush lock like a real flush, so a
+        concurrent recovery can't interleave — and rechecked under the
+        lock: a recovery that completed while this caller queued means
+        these ops deserve real device verdicts, not stale policy
+        fills."""
+        fo = self.failover
+        drained: Optional[Tuple[List[_EntryOp], List[tuple]]] = None
+        with self._flush_lock:
+            if not fo.healthy:
+                with self._lock:
+                    entries, self._entries = self._entries, []
+                    exits, self._exits = self._exits, []
+                    bulk_e, self._bulk_entries = self._bulk_entries, []
+                    bulk_x, self._bulk_exits = self._bulk_exits, []
+                    self._bulk_pending_n = 0
+                    self._bulk_exit_pending_n = 0
+                if not entries and not exits and not bulk_e and not bulk_x:
+                    return []
+                items = fo.fill_degraded(entries, exits, bulk_e, bulk_x)
+                drained = (entries, items)
+        if drained is None:
+            # Recovered while we queued behind the flush lock.
+            return self.flush()
+        self._post_flush(drained)  # block-log IO outside the flush lock
+        return drained[0]
+
     def flush(self) -> List[_EntryOp]:
         """Encode + run the kernel for all pending ops; fills verdicts.
 
@@ -1921,6 +2114,12 @@ class Engine:
         unchanged because verdicts materialize lazily (FIFO) on first
         access.
 
+        With failover armed and the engine DEGRADED, the flush never
+        touches the device: verdicts come from the host fallback
+        admitter, and an automatic recovery attempt (restore + probe
+        flushes) runs first when the retry gap has elapsed
+        (runtime/failover.py).
+
         The submission lock is held only to swap the pending buffers and
         snapshot the rule indexes; encoding, kernel dispatch and the
         device→host fetch happen outside it, so other threads keep
@@ -1930,6 +2129,12 @@ class Engine:
         already filled (the other flush cannot release the lock before
         filling them).
         """
+        fo = self.failover
+        if fo.armed and not fo.healthy:
+            if fo.recovery_due(self.clock.now_ms()):
+                fo.try_recover()
+            if not fo.healthy:
+                return self._flush_degraded()
         depth = self._pipeline_depth
         if depth > 0:
             return self._flush_pipelined(depth)
@@ -1937,6 +2142,9 @@ class Engine:
         # "after flush() every previously submitted op has a verdict"
         # keeps holding in pipelined use.
         self.drain()
+        if fo.armed and not fo.healthy:
+            # The drain tripped failover: serve the new ops from policy.
+            return self._flush_degraded()
         drained: Tuple[List[_EntryOp], List[tuple]] = ([], [])
         try:
             with self._flush_lock:
@@ -2016,6 +2224,11 @@ class Engine:
         writes and cluster-token releases for a chunk ride with its
         materialization.
         """
+        fo = self.failover
+        if fo.armed and not fo.healthy:
+            # Degraded: no device dispatch to defer — policy verdicts
+            # fill synchronously (recovery attempts stay on flush()).
+            return self._flush_degraded()
         return self._dispatch_deferred(
             keep_dispatched=self._max_inflight, keep_empty=self._max_inflight
         )
@@ -2075,10 +2288,13 @@ class Engine:
             # on exactly the busy ones after the batch fetch) and
             # fetch them all in one batched device_get.
             batch_refs: List[Optional[tuple]] = []
+            batch_seqs: List[int] = []
             for rec in recs:
                 if rec._lock.acquire(blocking=False):
                     try:
                         batch_refs.append(None if rec._done else rec._refs)
+                        if not rec._done:
+                            batch_seqs.append(rec._seq)
                     finally:
                         rec._lock.release()
                 else:
@@ -2088,13 +2304,21 @@ class Engine:
             if to_fetch:
                 try:
                     t0 = time.perf_counter()
-                    fetched = jax.device_get(to_fetch)
+                    fetched = self._fetch_refs(to_fetch, batch_seqs)
                     self._note_drain_ms((time.perf_counter() - t0) * 1e3)
-                except BaseException:
-                    # Per-record fallback below attributes the failure
-                    # to the record(s) that actually caused it.
+                except BaseException as exc:
                     fetched = None
-                    if self.telemetry.enabled:
+                    fo = self.failover
+                    if fo.armed:
+                        # Device fault/timeout with failover armed: go
+                        # DEGRADED now — materialize(None) below then
+                        # quarantines each record (policy verdicts, no
+                        # per-record re-fetch of a dead device).
+                        fo.trip("fetch", exc, batch_seqs)
+                    elif self.telemetry.enabled:
+                        # Per-record fallback below attributes the
+                        # failure to the record(s) that actually
+                        # caused it.
                         self.telemetry.note_fallback(1)
                         for rec in recs:
                             # Local bind: a concurrent materialize()
@@ -2151,8 +2375,12 @@ class Engine:
             out[0].extend(entries_c)
             n_chunks[0] += 1
             if defer:
-                with self._pending_lock:
-                    self._pending_fetches.append(res)
+                # A faulted chunk fills from policy inside _run_chunk
+                # (its post work already ran) and returns None — only
+                # real dispatches enqueue a pending fetch.
+                if isinstance(res, _PendingFetch):
+                    with self._pending_lock:
+                        self._pending_fetches.append(res)
             else:
                 out[1].extend(res)
         with self._lock:
@@ -2361,6 +2589,14 @@ class Engine:
         Bulk groups (``bulk`` / ``bulk_exits``) occupy contiguous row
         ranges after the singles and are encoded with numpy slicing —
         no per-entry Python work anywhere on their path."""
+        fo = self.failover
+        if fo.armed and not fo.healthy:
+            # An earlier chunk of this same flush tripped failover:
+            # don't touch the device again — fill from policy (custom
+            # slot checks have not run for this chunk yet).
+            return self._degraded_chunk(fo, entries, exits, bulk,
+                                        bulk_exits, defer,
+                                        run_custom_slots=True)
         # ---- custom processor slots (SPI-assembled chain head) ----
         # A registered slot's veto blocks the entry before every device
         # stage — accounted like a first-slot BlockException (the block
@@ -2380,21 +2616,7 @@ class Engine:
                         )
                     )
             for g in bulk:
-                if g.custom_veto is None and g.custom_veto_mask is None:
-                    vetoed_vals = []
-                    for a in np.unique(g.acquire):
-                        veto = SlotChainRegistry.check_entry(
-                            SlotEntryContext(
-                                g.resource, g.context_name, g.origin,
-                                int(a), False, (),
-                            )
-                        )
-                        if veto is not None:
-                            if g.custom_veto is None:
-                                g.custom_veto = veto
-                            vetoed_vals.append(int(a))
-                    if vetoed_vals:
-                        g.custom_veto_mask = np.isin(g.acquire, vetoed_vals)
+                SlotChainRegistry.check_bulk_entry(g)
         # Flight recorder: one span per dispatched chunk. Disabled →
         # tele is None and the whole block below is a handful of
         # untaken branches.
@@ -2607,23 +2829,50 @@ class Engine:
         t_disp0 = time.perf_counter()
         with self._timing_lock:
             self._flush_timing["encode_ms"] += (t_disp0 - t_enc0) * 1e3
-        if self._sharded_fns is not None:
-            # Mesh mode: one global batch sharded over the chips;
-            # shaping/param item batches (global coordinates) ride
-            # replicated into the globally-ordered scans.
-            fn = self._sharded_fn_for(
-                shaping is not None, param is not None, sh_rounds, p_rounds
-            )
-            extra = tuple(b for b in (shaping, param) if b is not None)
-            out = fn(*common, *extra)
-        elif shaping is None and param is None:
-            out = flush_step_jit(*common, occupy_timeout_ms=occ_ms, **flags)
-        elif param is None:
-            out = flush_step_shaping_jit(*common, shaping, occupy_timeout_ms=occ_ms, **flags)
-        elif shaping is None:
-            out = flush_step_param_jit(*common, param, occupy_timeout_ms=occ_ms, **flags)
-        else:
-            out = flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms, **flags)
+        # One flush sequence number per dispatched chunk — the fault
+        # injector's key and the checkpoint cadence counter.
+        seq = self._next_flush_seq()
+
+        def _dispatch():
+            if self.faults is not None:
+                self.faults.on_dispatch(seq)
+            if self._sharded_fns is not None:
+                # Mesh mode: one global batch sharded over the chips;
+                # shaping/param item batches (global coordinates) ride
+                # replicated into the globally-ordered scans.
+                fn = self._sharded_fn_for(
+                    shaping is not None, param is not None, sh_rounds, p_rounds
+                )
+                extra = tuple(b for b in (shaping, param) if b is not None)
+                return fn(*common, *extra)
+            if shaping is None and param is None:
+                return flush_step_jit(*common, occupy_timeout_ms=occ_ms, **flags)
+            if param is None:
+                return flush_step_shaping_jit(*common, shaping, occupy_timeout_ms=occ_ms, **flags)
+            if shaping is None:
+                return flush_step_param_jit(*common, param, occupy_timeout_ms=occ_ms, **flags)
+            return flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms, **flags)
+
+        try:
+            if fo.armed:
+                # Watchdog-bounded dispatch: a wedged compile/dispatch
+                # trips failover instead of stranding every submitter.
+                out = fo.watched(_dispatch, "kernel dispatch", (seq,))
+            else:
+                out = _dispatch()
+        except BaseException as exc:
+            if not fo.armed:
+                raise
+            # The dispatch faulted: the device states may or may not
+            # have been consumed (donation) — either way the chain is
+            # unrecoverable without a restore. Quarantine + fill this
+            # chunk from policy; staging drops to GC (the computation
+            # may still read it zero-copy if it did start).
+            fo.trip("dispatch", exc, seq)
+            return self._degraded_chunk(fo, entries, exits, bulk,
+                                        bulk_exits, defer,
+                                        run_custom_slots=False,
+                                        quarantined=True)
         self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn, result = out
         dispatch_ms = (time.perf_counter() - t_disp0) * 1e3
         with self._timing_lock:
@@ -2702,7 +2951,26 @@ class Engine:
         # (TelemetryBus ids) — -1 when the flight recorder is off.
         flush_seq = span.flush_id if span is not None else -1
 
+        # Host checkpoint (failover): every N flushes the fresh device
+        # states ride the SAME coalesced result fetch to the host as
+        # the last-good restore point — no extra round-trip. A deferred
+        # chunk's states must be copied: the next flush donates them
+        # into its kernel, which deletes the arrays before the deferred
+        # fetch runs (same hazard as breaker_snap above).
+        ckpt_meta = None
+        if fo.armed and fo.checkpoint_due(seq):
+            states = (self.stats, self.flow_dyn, self.degrade_dyn,
+                      self.param_dyn)
+            if defer:
+                states = jax.tree_util.tree_map(jnp.copy, states)
+            ckpt_meta = fo.begin_checkpoint(
+                seq, now_host, findex, dindex, pindex
+            )
+
         def _fill(got):
+            if ckpt_meta is not None:
+                fo.store_checkpoint(ckpt_meta, got[-1])
+                got = got[:-1]
             return self._fill_results(
                 got, entries, exits, bulk, bulk_exits, findex, dindex,
                 auth_rules, k, kd, breaker_snap=breaker_snap,
@@ -2710,11 +2978,14 @@ class Engine:
             )
 
         refs = self._result_refs(result, breaker_snap)
+        if ckpt_meta is not None:
+            refs = refs + (states,)
         if defer:
             if span is not None:
                 tele.dispatch_done(span)
             rec = _PendingFetch(
-                self, entries, refs, _fill, staging=staging, span=span
+                self, entries, refs, _fill, staging=staging, span=span,
+                bulk=bulk, seq=seq, exits=exits, bulk_exits=bulk_exits,
             )
             for op in entries:
                 op._pending = rec
@@ -2722,8 +2993,23 @@ class Engine:
                 g._pending = rec
             return rec
         t_fetch0 = time.perf_counter()
+        faulted = False
         try:
-            res = _fill(jax.device_get(refs))
+            try:
+                res = _fill(self._fetch_refs(refs, (seq,)))
+            except BaseException as exc:
+                if not fo.armed:
+                    raise
+                # Fetch fault/timeout on the synchronous path: the
+                # verdicts are lost — quarantine the older in-flight
+                # queue and fill this chunk from policy. Callers never
+                # see the raw device exception.
+                faulted = True
+                fo.trip("fetch", exc, seq)
+                res = self._degraded_chunk(
+                    fo, entries, exits, bulk, bulk_exits, defer,
+                    span=span, run_custom_slots=False, quarantined=True,
+                )
         finally:
             with self._timing_lock:
                 self._flush_timing["kernel_ms"] += (
@@ -2733,11 +3019,40 @@ class Engine:
         # zero-copy) inputs; staging is reusable. ONLY on success: a
         # failed/interrupted fetch proves nothing about the dispatched
         # computation, so its staging is dropped to GC, never pooled.
-        if self._arena is not None:
-            self._arena.give_all(staging)
-        if span is not None:
-            tele.settle(span, t_fetch0, time.perf_counter())
+        if not faulted:
+            if self._arena is not None:
+                self._arena.give_all(staging)
+            if span is not None:
+                tele.settle(span, t_fetch0, time.perf_counter())
         return res
+
+    def _degraded_chunk(
+        self, fo, entries, exits, bulk, bulk_exits, defer, span=None,
+        run_custom_slots=True, quarantined=False,
+    ) -> Optional[List[tuple]]:
+        """Fill one chunk's verdicts from the host fallback (device
+        fault mid-flush, or the engine degraded before this chunk
+        dispatched). Synchronous chunks return their block-log items
+        for the caller's normal _post_flush; deferred chunks have no
+        materialization to ride, so post work runs here and None is
+        returned (nothing to enqueue). ``run_custom_slots=False`` when
+        the chunk already ran the custom slot checks before faulting;
+        ``quarantined=True`` when the chunk's own device results were
+        LOST to the fault (counted — chunks merely served while
+        already degraded are not)."""
+        if quarantined:
+            fo.note_quarantined()
+        if span is not None:
+            span.quarantined = True
+            self.telemetry.settle(
+                span, time.perf_counter(), time.perf_counter()
+            )
+        items = fo.fill_degraded(entries, exits, bulk, bulk_exits,
+                                 run_custom_slots=run_custom_slots)
+        if defer:
+            self._post_flush((entries, items))
+            return None
+        return items
 
     def _reset_breaker_mirror(self) -> None:
         """Fresh all-CLOSED mirror + a new epoch: deferred fetches
@@ -3263,6 +3578,7 @@ class Engine:
             record_log.error(
                 "[Engine] settling pre-reset async flushes failed", exc_info=True
             )
+        self.failover.reset()
         with self._flush_lock, self._lock:
             self._entries.clear()
             self._exits.clear()

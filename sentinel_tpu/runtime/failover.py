@@ -1,0 +1,1137 @@
+"""Device-failure domain: health state machine, host-fallback admission,
+flush watchdog, checkpoint/restore.
+
+Sentinel's whole point is that the protected system keeps answering when
+a dependency misbehaves — and the reference already encodes the pattern
+one layer up: cluster-mode rules fall back to local checking when the
+token server fails (``cc.fallback_to_local_when_fail``, mirrored in
+engine.py's ``_apply_cluster_checks``). This module is the same stance
+applied to the engine's own most critical dependency, the device.
+Keeping admission on-device is the perf thesis (data-plane admission à
+la *Heavy-Hitter Detection Entirely in the Data Plane*, arXiv
+1611.04825) — so losing the device must degrade admission QUALITY,
+never availability.
+
+Four pieces:
+
+* a **health state machine** ``HEALTHY → DEGRADED → RECOVERING →
+  HEALTHY``: any dispatch fault, fetch fault or watchdog timeout trips
+  the engine DEGRADED, quarantines the in-flight flush queue (every
+  affected op gets a policy verdict instead of a re-raised device
+  exception), and routes subsequent flushes to the host fallback;
+* a **flush watchdog**: with failover armed, kernel dispatch and the
+  device→host fetch run on a waiter thread bounded by
+  ``sentinel.tpu.failover.fetch.timeout.ms`` — a wedged
+  ``jax.device_get`` times out and trips failover instead of stranding
+  the pipeline (and every submitter behind the flush lock) forever;
+* a :class:`HostFallbackAdmitter` serving admission while DEGRADED from
+  the already-compiled rule tables: host token buckets for QPS flow
+  rules, live concurrency counters for THREAD rules, last-known breaker
+  states (the engine's host mirror) for degrade rules, per-value token
+  buckets for QPS hot-param rules — under a per-resource
+  fail-open/fail-closed policy (``sentinel.tpu.failover.policy``,
+  default fail-open like the reference's pass-on-fallback). Degraded
+  verdicts carry distinct provenance (``Verdict.degraded``, reason
+  ``BLOCK_FAILOVER`` for policy sheds, ``degraded`` marks on admission
+  -trace records) so tracing and metrics can tell degraded admits from
+  device admits;
+* **checkpoint/restore**: every N flushes
+  (``sentinel.tpu.failover.checkpoint.every``) the engine's device
+  states ride the existing coalesced result fetch to the host as the
+  last-good checkpoint; RECOVERING re-entry restores it (re-based
+  through the same ``shift_ws`` timestamp machinery the ~22-day epoch
+  rebase uses) and requires K consecutive successful probe flushes
+  (``sentinel.tpu.failover.probe.flushes``) before going HEALTHY.
+
+Everything is deterministic under ``testing/faults.FaultInjector``:
+each transition above is unit-testable without a flaky device.
+
+Config keys (all declared in utils/config.py)::
+
+    sentinel.tpu.failover.enabled            default false (opt-in)
+    sentinel.tpu.failover.fetch.timeout.ms   watchdog bound, default 5000
+    sentinel.tpu.failover.policy             "open" | "closed" |
+                                             "open,resA=closed,..."
+    sentinel.tpu.failover.checkpoint.every   flushes per checkpoint (0 off)
+    sentinel.tpu.failover.probe.flushes      K successes before HEALTHY
+    sentinel.tpu.failover.retry.ms           min gap between auto recovery
+                                             attempts (engine clock)
+
+What the fallback approximates vs the device path: QPS windows restart
+full (burst of one window allowed at degrade entry), THREAD gauges
+restart at zero (pre-fault in-flight entries are not visible), breaker
+states are frozen at the last observed mirror, shaping/occupy/system
+checks and per-origin rows are not enforced, and statistics for the
+degraded window are lost. Documented in ARCHITECTURE.md §"Failure
+domains & degraded admission".
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.metrics import nodes as _ncfg
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.rules.degrade_table import OPEN as _BREAKER_OPEN
+from sentinel_tpu.utils.config import config
+from sentinel_tpu.utils.record_log import record_log
+
+HEALTHY = "HEALTHY"
+DEGRADED = "DEGRADED"
+RECOVERING = "RECOVERING"
+
+# Prometheus gauge encoding of the state (transport/prometheus.py).
+HEALTH_GAUGE = {HEALTHY: 0, DEGRADED: 1, RECOVERING: 2}
+
+
+class DeviceFetchTimeout(RuntimeError):
+    """The flush watchdog's verdict: a dispatch or device→host fetch
+    exceeded ``sentinel.tpu.failover.fetch.timeout.ms``."""
+
+
+@dataclass(slots=True)
+class HealthEvent:
+    """One state transition, for the ``health`` command / telemetry."""
+
+    now_ms: int
+    frm: str
+    to: str
+    reason: str
+
+    def as_dict(self) -> dict:
+        return {
+            "now_ms": self.now_ms, "from": self.frm, "to": self.to,
+            "reason": self.reason,
+        }
+
+
+@dataclass(slots=True)
+class Checkpoint:
+    """One host-resident snapshot of the engine's device states.
+
+    ``states`` is the fetched host pytree ``(stats, flow_dyn,
+    degrade_dyn, param_dyn)``; the index weakrefs gate which components
+    are still restorable — a rule reload swaps an index AND its dyn
+    state shape, so a stale component restores as a fresh dyn state
+    instead (the reference rebuilds fresh breakers per load anyway)."""
+
+    seq: int
+    now_ms: int
+    epoch_wall_ms: int
+    win_key: object  # SECOND_CFG at capture (a retune invalidates stats)
+    findex_ref: object
+    dindex_ref: object
+    pindex_ref: object
+    states: Optional[tuple] = None  # filled at fetch time
+
+
+class _TokenBucket:
+    """Host token bucket approximating one QPS window: capacity =
+    threshold per window, continuous refill at threshold/window. Starts
+    full — degrade entry grants one window's burst, the same stance as
+    a restarted reference node."""
+
+    __slots__ = ("cap", "rate_ms", "tokens", "last_ms")
+
+    def __init__(self, cap: float, window_ms: float, now_ms: int) -> None:
+        self.cap = float(cap)
+        self.rate_ms = self.cap / max(window_ms, 1.0)
+        self.tokens = self.cap
+        self.last_ms = now_ms
+
+    def _refill(self, now_ms: int) -> None:
+        if now_ms > self.last_ms:
+            self.tokens = min(
+                self.cap, self.tokens + (now_ms - self.last_ms) * self.rate_ms
+            )
+            self.last_ms = now_ms
+
+    def try_take(self, now_ms: int, n: float) -> bool:
+        self._refill(now_ms)
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+    def available(self, now_ms: int) -> float:
+        self._refill(now_ms)
+        return self.tokens
+
+    def consume(self, n: float) -> None:
+        self.tokens = max(0.0, self.tokens - n)
+
+
+class HostFallbackAdmitter:
+    """Serves admission from host state while the engine is DEGRADED.
+
+    Stage order matches the device path's ATTRIBUTION order (custom
+    veto → authority → param → flow → degrade — ``_fill_results`` also
+    reports a custom veto ahead of the shared authority channel); an op
+    blocked by an earlier stage does not consume later stages' tokens.
+    All state here is scoped to ONE degraded window — ``begin()``
+    resets it, so recovery retires every approximation along with the
+    window."""
+
+    def __init__(self, engine) -> None:
+        self._engine = engine
+        self._lock = threading.Lock()
+        # id(rule) -> (rule, bucket): the rule ref pins the object so a
+        # freed rule's id cannot be reused under the same key.
+        self._buckets: Dict[int, Tuple[object, _TokenBucket]] = {}
+        # prow -> (slot rule, bucket) for QPS hot-param values.
+        self._pbuckets: Dict[int, Tuple[object, _TokenBucket]] = {}
+        # resource -> live concurrency admitted by THIS fallback window.
+        self._threads: Dict[str, int] = {}
+        # Device-gauge deltas observed while DEGRADED: node row →
+        # count. ``_exit_rows`` are releases the device never saw (a
+        # restored gauge would stay pinned without replaying them);
+        # ``_admit_rows`` are THREAD admissions the fallback made (in
+        # flight through recovery — their post-recovery exits would
+        # drive an unseeded gauge negative, permanently under-enforcing
+        # the limit). Recovery applies the NET per row: exits of
+        # fallback-admitted entries cancel their own admits exactly.
+        self._exit_rows: Dict[int, int] = {}
+        self._exit_prows: Dict[int, int] = {}
+        self._admit_rows: Dict[int, int] = {}
+        self._admit_prows: Dict[int, int] = {}
+        self._policy_default = "open"
+        self._policy_by_resource: Dict[str, str] = {}
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def begin(self, now_ms: int) -> None:
+        """Enter a degraded window: fresh buckets/counters, re-read the
+        policy (it is runtime-settable)."""
+        with self._lock:
+            self._buckets.clear()
+            self._pbuckets.clear()
+            self._threads.clear()
+            self._exit_rows.clear()
+            self._exit_prows.clear()
+            self._admit_rows.clear()
+            self._admit_prows.clear()
+            self._parse_policy(config.get(config.FAILOVER_POLICY) or "open")
+
+    def _parse_policy(self, raw: str) -> None:
+        """``"open"`` / ``"closed"`` / ``"open,resA=closed,resB=open"``
+        — the first ``=``-less segment is the default; unknown modes
+        fall back to open (never make a config typo an outage)."""
+        default = "open"
+        by_res: Dict[str, str] = {}
+        for seg in str(raw).split(","):
+            seg = seg.strip()
+            if not seg:
+                continue
+            if "=" in seg:
+                res, _, mode = seg.partition("=")
+                by_res[res.strip()] = (
+                    "closed" if mode.strip().lower() == "closed" else "open"
+                )
+            else:
+                default = "closed" if seg.lower() == "closed" else "open"
+        self._policy_default = default
+        self._policy_by_resource = by_res
+
+    def policy_for(self, resource: str) -> str:
+        with self._lock:
+            return self._policy_by_resource.get(resource, self._policy_default)
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def _bucket_for(self, rule, now_ms: int) -> _TokenBucket:
+        key = id(rule)
+        ent = self._buckets.get(key)
+        if ent is None or ent[0] is not rule:
+            ent = (rule, _TokenBucket(float(rule.count), 1000.0, now_ms))
+            self._buckets[key] = ent
+        return ent[1]
+
+    def _pbucket_for(self, ps, now_ms: int) -> _TokenBucket:
+        ent = self._pbuckets.get(ps.prow)
+        if ent is None:
+            cap = float(ps.token_count + getattr(ps, "burst", 0))
+            window = max(float(ps.duration_ms), 1.0)
+            ent = (ps.rule, _TokenBucket(cap, window, now_ms))
+            self._pbuckets[ps.prow] = ent
+        return ent[1]
+
+    def _breaker_open(self, d_gids: Sequence[int]) -> bool:
+        """Last-known breaker verdict from the engine's host mirror
+        (kept by the breaker-event machinery). An invalid mirror —
+        never observed, or shape-stale after a reload — fails open."""
+        if not d_gids:
+            return False
+        eng = self._engine
+        with eng._breaker_mirror_lock:
+            if not eng._breaker_mirror_valid:
+                return False
+            mirror = eng._breaker_state_host
+            for dg in d_gids:
+                if 0 <= dg < mirror.shape[0] and mirror[dg] == _BREAKER_OPEN:
+                    return True
+        return False
+
+    @staticmethod
+    def _rule_of(src_index, gid: int):
+        try:
+            return src_index.rule_of_gid(gid)
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------------
+    # single-op admission
+    # ------------------------------------------------------------------
+    def admit(self, op, now_ms: int):
+        """Policy verdict for one op — always returns a Verdict with
+        ``degraded=True`` provenance; never raises."""
+        from sentinel_tpu.runtime.engine import Verdict
+
+        def blocked(reason, rule=None, slot_name=""):
+            return Verdict(
+                admitted=False, reason=reason, wait_ms=0, blocked_rule=rule,
+                slot_name=slot_name, degraded=True,
+            )
+
+        if self.policy_for(op.resource) == "closed":
+            return blocked(E.BLOCK_FAILOVER)
+        if op.custom_veto is not None:
+            slot, veto = op.custom_veto
+            return blocked(
+                E.BLOCK_CUSTOM,
+                veto if veto is not True else None,
+                getattr(slot, "name", "") or type(slot).__name__,
+            )
+        if not op.auth_ok:
+            return blocked(
+                E.BLOCK_AUTHORITY,
+                self._engine.authority_rules.get(op.resource),
+            )
+        if op.cluster_blocked_rule is not None:
+            # The token server's verdict predates the device fault and
+            # stays binding (same attribution as the device fill).
+            rule = op.cluster_blocked_rule
+            reason = (
+                E.BLOCK_PARAM
+                if type(rule).__name__ == "ParamFlowRule"
+                else E.BLOCK_FLOW
+            )
+            return blocked(reason, rule)
+        findex = op.src[0] if op.src is not None else self._engine.flow_index
+        with self._lock:
+            thr_prows = []
+            for ps in op.p_slots:
+                if ps.grade != C.FLOW_GRADE_QPS:
+                    # THREAD-grade param gauges: not approximated (the
+                    # value passes), but the device gauge would have
+                    # counted +1 per admitted entry — remember the row
+                    # for the recovery seed, exactly like _admit_rows
+                    # (this entry's on-device exit may land after the
+                    # gauge is restored).
+                    if ps.prow >= 0:
+                        thr_prows.append(ps.prow)
+                    continue
+                if ps.rule is None:
+                    continue
+                if not self._pbucket_for(ps, now_ms).try_take(
+                    now_ms, op.acquire
+                ):
+                    return blocked(E.BLOCK_PARAM, ps.rule)
+            thread_rules = []
+            for gid, _crow in op.slots:
+                rule = self._rule_of(findex, gid)
+                if rule is None:
+                    continue
+                if rule.grade == C.FLOW_GRADE_THREAD:
+                    thread_rules.append(rule)
+                    cur = self._threads.get(op.resource, 0)
+                    if cur + 1 > int(rule.count):
+                        return blocked(E.BLOCK_FLOW, rule)
+                else:
+                    if not self._bucket_for(rule, now_ms).try_take(
+                        now_ms, op.acquire
+                    ):
+                        return blocked(E.BLOCK_FLOW, rule)
+            if self._breaker_open(op.d_gids):
+                dindex = (
+                    op.src[1] if op.src is not None else self._engine.degrade_index
+                )
+                rule = self._rule_of(dindex, op.d_gids[0]) if op.d_gids else None
+                return blocked(E.BLOCK_DEGRADE, rule)
+            if thread_rules:
+                # The device gauge counts +1 per admitted entry
+                # (acquire weights QPS only) — mirror that exactly,
+                # and remember the rows for the recovery seed (this
+                # entry's exit may land after the gauge is restored).
+                self._threads[op.resource] = self._threads.get(op.resource, 0) + 1
+                for r in op.rows:
+                    if r >= 0:
+                        self._admit_rows[r] = self._admit_rows.get(r, 0) + 1
+            for r in thr_prows:
+                self._admit_prows[r] = self._admit_prows.get(r, 0) + 1
+        return Verdict(
+            admitted=True, reason=E.PASS, wait_ms=0, blocked_rule=None,
+            degraded=True,
+        )
+
+    # ------------------------------------------------------------------
+    # bulk admission (vectorized)
+    # ------------------------------------------------------------------
+    def admit_bulk(self, g, now_ms: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Array verdicts for one bulk group: numpy prefix math against
+        the same buckets/counters the singles path consumes (QPS-grade
+        hot-param columns pass — bulk rejects THREAD/cluster param
+        rules at submit, and per-value buckets per row would be the
+        per-row Python work the bulk path exists to avoid)."""
+        n = g.n
+        admitted = np.ones(n, dtype=bool)
+        reason = np.full(n, E.PASS, dtype=np.int32)
+
+        def block(mask: np.ndarray, code: int) -> None:
+            sel = admitted & mask
+            admitted[sel] = False
+            reason[sel] = code
+
+        if self.policy_for(g.resource) == "closed":
+            block(np.ones(n, dtype=bool), E.BLOCK_FAILOVER)
+            return admitted, reason
+        if g.custom_veto_mask is not None:
+            block(np.asarray(g.custom_veto_mask, dtype=bool), E.BLOCK_CUSTOM)
+        if not g.auth_ok:
+            block(np.ones(n, dtype=bool), E.BLOCK_AUTHORITY)
+        findex = g.src[0] if g.src is not None else self._engine.flow_index
+        acquire = np.asarray(g.acquire, dtype=np.int64)
+        with self._lock:
+            thread_rule = None
+            for gid, _crow in g.slots:
+                rule = self._rule_of(findex, gid)
+                if rule is None:
+                    continue
+                if rule.grade == C.FLOW_GRADE_THREAD:
+                    thread_rule = rule
+                    cur = self._threads.get(g.resource, 0)
+                    headroom = max(0, int(rule.count) - cur)
+                    # +1 thread per admitted entry: the first `headroom`
+                    # still-live rows pass, the rest block.
+                    live_rank = np.cumsum(admitted)
+                    block(live_rank > headroom, E.BLOCK_FLOW)
+                else:
+                    bucket = self._bucket_for(rule, now_ms)
+                    avail = bucket.available(now_ms)
+                    cum = np.cumsum(np.where(admitted, acquire, 0))
+                    block(cum > avail, E.BLOCK_FLOW)
+                    bucket.consume(int(np.where(admitted, acquire, 0).sum()))
+            if self._breaker_open(g.d_gids):
+                block(np.ones(n, dtype=bool), E.BLOCK_DEGRADE)
+            if thread_rule is not None:
+                n_adm = int(admitted.sum())
+                self._threads[g.resource] = (
+                    self._threads.get(g.resource, 0) + n_adm
+                )
+                for r in g.rows:
+                    if r >= 0:
+                        self._admit_rows[r] = (
+                            self._admit_rows.get(r, 0) + n_adm
+                        )
+        return admitted, reason
+
+    def on_exit(self, resource: str, n: int = 1) -> None:
+        """Thread release for exits settled while DEGRADED. Clamped at
+        zero: exits of entries admitted on-device before the fault were
+        never counted here."""
+        with self._lock:
+            cur = self._threads.get(resource)
+            if cur is not None:
+                self._threads[resource] = max(0, cur - n)
+
+    def note_device_exit(self, rows, p_rows=(), n: int = 1) -> None:
+        """Record the DEVICE-gauge releases one degraded exit would
+        have scattered (all four node rows, plus param thread rows) —
+        the device never sees these, so recovery replays them into the
+        restored checkpoint's gauges."""
+        with self._lock:
+            for r in rows:
+                if r is not None and r >= 0:
+                    self._exit_rows[r] = self._exit_rows.get(r, 0) + n
+            for r in p_rows:
+                if r >= 0:
+                    self._exit_prows[r] = self._exit_prows.get(r, 0) + n
+
+    def peek_gauge_deltas(
+        self,
+    ) -> Tuple[Dict[int, int], Dict[int, int], Dict[int, int], Dict[int, int]]:
+        """Non-destructive ``(exit_rows, exit_prows, admit_rows,
+        admit_prows)`` snapshot: a restore that later FAILS its probes
+        must not lose the deltas for the next attempt — they clear only
+        once a recovery fully succeeds (:meth:`clear_gauge_deltas`)."""
+        with self._lock:
+            return (
+                dict(self._exit_rows),
+                dict(self._exit_prows),
+                dict(self._admit_rows),
+                dict(self._admit_prows),
+            )
+
+    def clear_gauge_deltas(self) -> None:
+        with self._lock:
+            self._exit_rows.clear()
+            self._exit_prows.clear()
+            self._admit_rows.clear()
+            self._admit_prows.clear()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "policy_default": self._policy_default,
+                "policy_overrides": dict(self._policy_by_resource),
+                "qps_buckets": len(self._buckets),
+                "param_buckets": len(self._pbuckets),
+                "live_threads": dict(self._threads),
+            }
+
+
+class _Waiter:
+    """One persistent watchdog waiter thread: the engine submits a
+    device call to it and waits with a timeout, so a wedged
+    ``device_get`` strands THIS thread, never the submitter. A
+    timed-out waiter is marked lost and abandoned (the call cannot be
+    cancelled); the manager replaces it. Persistent rather than
+    per-call: a thread spawn per flush costs milliseconds on small
+    flushes. One waiter serves ONE call at a time — concurrent watched
+    calls each take their own waiter from the manager's pool, so one
+    slow call's queueing can never count against another's timeout."""
+
+    __slots__ = ("lost", "_jobs", "_thread")
+
+    def __init__(self, name: str) -> None:
+        import queue
+
+        self.lost = False
+        self._jobs: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            fn, box, done = job
+            try:
+                box["v"] = fn()
+            except BaseException as exc:
+                box["e"] = exc
+            finally:
+                done.set()
+            if self.lost:
+                # The submitter timed out and abandoned us, but the
+                # call DID finish — exit instead of parking forever on
+                # an empty queue no one will ever feed again.
+                return
+
+    def submit(self, fn) -> Tuple[dict, threading.Event]:
+        box: dict = {}
+        done = threading.Event()
+        self._jobs.put((fn, box, done))
+        return box, done
+
+    def stop(self) -> None:
+        self._jobs.put(None)
+
+
+class FailoverManager:
+    """Engine-scoped failure-domain coordinator (one per Engine).
+
+    When disarmed (``sentinel.tpu.failover.enabled`` false, the
+    default) every engine hook is a single attribute read — the hot
+    path pays nothing and semantics are exactly the pre-failover
+    engine's (device errors re-raise to callers)."""
+
+    def __init__(self, engine) -> None:
+        self._engine = engine
+        self.armed = config.get_bool(config.FAILOVER_ENABLED, False)
+        self.fetch_timeout_ms = config.get_int(
+            config.FAILOVER_FETCH_TIMEOUT_MS, 5000
+        )
+        self.checkpoint_every = max(
+            0, config.get_int(config.FAILOVER_CHECKPOINT_EVERY, 8)
+        )
+        self.probe_k = max(1, config.get_int(config.FAILOVER_PROBE_FLUSHES, 3))
+        self.retry_ms = max(0, config.get_int(config.FAILOVER_RETRY_MS, 1000))
+        self._lock = threading.RLock()
+        self.state = HEALTHY
+        self.state_since_ms = 0
+        self._last_attempt_ms: Optional[int] = None
+        self._ckpt: Optional[Checkpoint] = None
+        self.fallback = HostFallbackAdmitter(engine)
+        self.counters: Dict[str, int] = {
+            "trips": 0,
+            "transitions": 0,
+            "quarantined_records": 0,
+            "degraded_admits": 0,
+            "degraded_blocks": 0,
+            "checkpoints": 0,
+            "restores": 0,
+            "probe_flushes": 0,
+            "fetch_timeouts": 0,
+            "recoveries": 0,
+        }
+        self.events: "deque[HealthEvent]" = deque(maxlen=64)
+        self.last_fault = ""
+        # Pool of idle watchdog waiters (see _Waiter): each watched
+        # call takes its own, so concurrent calls never queue behind
+        # each other (queueing delay counting against another caller's
+        # timeout would false-trip the engine DEGRADED). Timed-out
+        # waiters are abandoned; overflow returns are stopped.
+        self._idle_waiters: List[_Waiter] = []
+        self._waiter_lock = threading.Lock()
+        # Bumped per restore attempt (and again when one times out):
+        # an abandoned restore's install is gated on holding the
+        # current generation — see _restore_locked.
+        self._restore_gen = 0
+
+    # ------------------------------------------------------------------
+    # state machine
+    # ------------------------------------------------------------------
+    @property
+    def healthy(self) -> bool:
+        return self.state == HEALTHY
+
+    @property
+    def degraded(self) -> bool:
+        return self.state == DEGRADED
+
+    def _set_state_locked(self, to: str, reason: str) -> None:
+        frm = self.state
+        if frm == to:
+            return
+        now = self._engine.clock.now_ms()
+        self.state = to
+        self.state_since_ms = now
+        self.counters["transitions"] += 1
+        self.events.append(HealthEvent(now, frm, to, reason))
+        tele = self._engine.telemetry
+        if tele.enabled:
+            tele.note_health(frm, to, reason, now_ms=now)
+
+    def trip(self, where: str, exc: BaseException, seq: object = -1) -> None:
+        """A device fault (dispatch/fetch failure or watchdog timeout):
+        transition to DEGRADED and quarantine the in-flight queue.
+        Idempotent — later faults while already DEGRADED only update
+        ``last_fault``."""
+        eng = self._engine
+        with self._lock:
+            first = self.state != DEGRADED
+            self.last_fault = f"{where}@{seq}: {type(exc).__name__}: {exc}"
+            if isinstance(exc, DeviceFetchTimeout):
+                self.counters["fetch_timeouts"] += 1
+            if first:
+                self.counters["trips"] += 1
+                self._set_state_locked(DEGRADED, self.last_fault)
+                self.fallback.begin(eng.clock.now_ms())
+                # Auto-recovery waits retry.ms from the trip; an
+                # explicit try_recover() is always allowed.
+                self._last_attempt_ms = eng.clock.now_ms()
+        if first:
+            record_log.error("[Failover] engine DEGRADED (%s)", self.last_fault)
+            eng._quarantine_pending()
+
+    def recovery_due(self, now_ms: int) -> bool:
+        if self._engine.mesh is not None:
+            return False  # see try_recover's mesh gate
+        with self._lock:
+            if self.state != DEGRADED:
+                return False
+            last = self._last_attempt_ms
+            return last is None or now_ms - last >= self.retry_ms
+
+    def try_recover(self) -> bool:
+        """DEGRADED → RECOVERING → (restore + K probe flushes) →
+        HEALTHY; any restore/probe fault falls back to DEGRADED.
+        Serialized with real flushes on the engine's flush lock."""
+        eng = self._engine
+        if eng.mesh is not None:
+            # Restore + probe are single-chip: installing unsharded
+            # states under a live mesh (or probing past one) would hand
+            # the sharded kernels wrong inputs. Stay DEGRADED with an
+            # actionable reason; the host fallback keeps serving.
+            with self._lock:
+                if self.state == HEALTHY:
+                    return True
+                self.last_fault = (
+                    "recovery unsupported while mesh mode is enabled — "
+                    "disable_mesh() first, then try_recover()"
+                )
+            record_log.warn("[Failover] %s", self.last_fault)
+            return False
+        with eng._flush_lock:
+            with self._lock:
+                if self.state == HEALTHY:
+                    return True
+                self._set_state_locked(RECOVERING, "recovery attempt")
+                self._last_attempt_ms = eng.clock.now_ms()
+            try:
+                self._restore_locked()
+                for _ in range(self.probe_k):
+                    self._probe_locked()
+            except BaseException as exc:
+                with self._lock:
+                    self.last_fault = (
+                        f"recovery: {type(exc).__name__}: {exc}"
+                    )
+                    self._set_state_locked(DEGRADED, self.last_fault)
+                record_log.warn(
+                    "[Failover] recovery failed (%s); staying DEGRADED",
+                    self.last_fault,
+                )
+                return False
+            self.fallback.clear_gauge_deltas()
+            with self._lock:
+                self.counters["recoveries"] += 1
+                self._set_state_locked(HEALTHY, "recovered")
+        record_log.info("[Failover] engine HEALTHY again")
+        return True
+
+    # ------------------------------------------------------------------
+    # watchdog
+    # ------------------------------------------------------------------
+    # A kernel DISPATCH includes first-use XLA compilation, which
+    # legitimately takes many seconds cold — a dispatch bound tied
+    # directly to the fetch timeout would false-trip on every new jit
+    # signature. Dispatch therefore gets this floor under its bound.
+    DISPATCH_TIMEOUT_FLOOR_MS = 60_000
+
+    def watched(self, fn, what: str, seqs: Sequence[int],
+                timeout_ms: Optional[int] = None):
+        """Run ``fn`` on the persistent watchdog waiter thread bounded
+        by the fetch timeout. A wedged device call cannot be cancelled,
+        only abandoned: on timeout the waiter is marked lost (it parks
+        on the dead call forever, daemonic) and the next watched call
+        lazily starts a replacement."""
+        if timeout_ms is None:
+            timeout_ms = self.fetch_timeout_ms
+            if "dispatch" in what:
+                timeout_ms = max(timeout_ms, self.DISPATCH_TIMEOUT_FLOOR_MS)
+        with self._waiter_lock:
+            w = self._idle_waiters.pop() if self._idle_waiters else None
+        if w is None or w.lost:
+            w = _Waiter("sentinel-failover-waiter")
+        box, done = w.submit(fn)
+        try:
+            if not done.wait(timeout_ms / 1000.0):
+                w.lost = True
+                # Also queue the stop sentinel: if the wedged call
+                # finishes after this flag but before its own lost
+                # check, the sentinel still unparks the thread — no
+                # waiter may block forever on a queue nobody feeds.
+                w.stop()
+                raise DeviceFetchTimeout(
+                    f"{what} exceeded {timeout_ms} ms"
+                    f" (flush seqs {list(seqs)})"
+                )
+        finally:
+            if not w.lost:
+                with self._waiter_lock:
+                    if len(self._idle_waiters) < 4:
+                        self._idle_waiters.append(w)
+                        w = None
+                if w is not None:
+                    w.stop()  # pool full: retire rather than leak
+        if "e" in box:
+            raise box["e"]
+        return box["v"]
+
+    # ------------------------------------------------------------------
+    # degraded fill (the one home of policy-verdict assembly)
+    # ------------------------------------------------------------------
+    def fill_degraded(
+        self, entries, exits=(), bulk=(), bulk_exits=(),
+        run_custom_slots: bool = True,
+    ) -> List[tuple]:
+        """Fill every op's verdict from the fallback admitter; returns
+        the block-log items. Used by the degraded flush path, the
+        chunk-level fault handler and quarantined record fills.
+        ``run_custom_slots=False`` for ops whose chunk already ran the
+        custom ProcessorSlot checks before the fault — re-running a
+        user slot would double its side effects (check_entry returns
+        None for a pass, so custom_veto-is-None can't tell 'passed'
+        from 'not checked')."""
+        from sentinel_tpu.core.slots import SlotChainRegistry, SlotEntryContext
+
+        eng = self._engine
+        now = eng.clock.now_ms()
+        fb = self.fallback
+        tracer = eng.admission_trace
+        end_pc = time.perf_counter()
+        items: List[tuple] = []
+        n_admit = 0
+        n_block = 0
+        slots_active = run_custom_slots and bool(SlotChainRegistry.slots())
+        for op in entries:
+            if slots_active and op.custom_veto is None:
+                op.custom_veto = SlotChainRegistry.check_entry(
+                    SlotEntryContext(
+                        op.resource, op.context_name, op.origin,
+                        op.acquire, op.prio, op.args,
+                    )
+                )
+            v = fb.admit(op, now)
+            op.verdict = v
+            op._pending = None
+            if v.admitted:
+                n_admit += 1
+            else:
+                n_block += 1
+                limit_app = (
+                    getattr(v.blocked_rule, "limit_app", None) or "default"
+                )
+                items.append((
+                    op.resource, E.exc_name_for_code(v.reason), limit_app,
+                    op.origin, op.acquire,
+                ))
+            if op.trace is not None:
+                tracer.record_admission(
+                    op.trace, op.resource, op.origin, op.context_name,
+                    v.admitted, v.reason, -1, end_pc, degraded=True,
+                )
+                op.trace = None
+        for g in bulk:
+            if slots_active:
+                # Same shared per-distinct-acquire check as the device
+                # bulk path — a registered slot's veto must keep
+                # applying to bulk traffic while DEGRADED.
+                SlotChainRegistry.check_bulk_entry(g)
+            adm, rsn = fb.admit_bulk(g, now)
+            g.admitted = adm
+            g.reason = rsn
+            g.wait_ms = np.zeros(g.n, dtype=np.int32)
+            g._pending = None
+            blocked = ~adm
+            n_admit += int(adm.sum())
+            n_block += int(blocked.sum())
+            if blocked.any():
+                for r in np.unique(rsn[blocked]):
+                    cnt = int(
+                        np.asarray(g.acquire)[blocked & (rsn == r)].sum()
+                    )
+                    items.append((
+                        g.resource, E.exc_name_for_code(int(r)), "default",
+                        g.origin, cnt,
+                    ))
+            if g.trace is not None:
+                tracer.record_bulk(
+                    g.trace, g.resource, g.origin, g.context_name,
+                    adm, rsn, -1, end_pc, degraded=True,
+                )
+                g.trace = None
+        for x in exits:
+            if x.thr < 0:
+                fb.note_device_exit(x.rows, getattr(x, "p_rows", ()) or (), 1)
+                if x.resource is not None:
+                    fb.on_exit(x.resource, 1)
+        for gx in bulk_exits:
+            if gx.thr < 0:
+                fb.note_device_exit(gx.rows, (), gx.n)
+                if gx.resource is not None:
+                    fb.on_exit(gx.resource, gx.n)
+        with self._lock:
+            self.counters["degraded_admits"] += n_admit
+            self.counters["degraded_blocks"] += n_block
+        tele = eng.telemetry
+        if tele.enabled and (n_admit or n_block):
+            tele.note_degraded(n_admit, n_block)
+        return items
+
+    def note_quarantined(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["quarantined_records"] += n
+
+    # ------------------------------------------------------------------
+    # checkpoint / restore
+    # ------------------------------------------------------------------
+    def checkpoint_due(self, seq: int) -> bool:
+        return (
+            self.checkpoint_every > 0
+            and seq % self.checkpoint_every == 0
+            # Sharded device states restore as single-chip arrays;
+            # skip checkpoints under a mesh rather than restore wrong.
+            and self._engine.mesh is None
+        )
+
+    def begin_checkpoint(self, seq, now_ms, findex, dindex, pindex) -> Checkpoint:
+        """Metadata for a checkpoint whose state arrays ride the
+        chunk's coalesced device fetch (engine._run_chunk)."""
+        return Checkpoint(
+            seq=seq,
+            now_ms=now_ms,
+            epoch_wall_ms=self._engine.clock.epoch_wall_ms,
+            win_key=_ncfg.SECOND_CFG,
+            findex_ref=weakref.ref(findex),
+            dindex_ref=weakref.ref(dindex),
+            pindex_ref=weakref.ref(pindex),
+        )
+
+    def store_checkpoint(self, meta: Checkpoint, host_states: tuple) -> None:
+        meta.states = host_states
+        with self._lock:
+            # Out-of-order materialization of two in-flight checkpointed
+            # chunks must never replace a newer checkpoint with an
+            # older one (seqs are dispatch-ordered).
+            if self._ckpt is None or self._ckpt.seq <= meta.seq:
+                self._ckpt = meta
+            self.counters["checkpoints"] += 1
+
+    def _restore_locked(self) -> None:
+        """Re-seed the engine's device states from the last good
+        checkpoint; the body runs on the watchdog waiter — restore
+        does host→device transfers and scatter math against the very
+        device that just faulted, and an unbounded wedge here would
+        hold the flush lock (and every submitter) forever. Caller
+        holds the flush lock.
+
+        A timed-out restore cannot be cancelled, only abandoned — the
+        generation token makes the zombie's eventual completion a
+        no-op (its install check in ``_restore_body`` fails) instead
+        of overwriting whatever world is live by then."""
+        with self._lock:
+            self._restore_gen += 1
+            gen = self._restore_gen
+        try:
+            self.watched(
+                lambda: self._restore_body(gen), "restore dispatch", ()
+            )
+        except BaseException:
+            with self._lock:
+                self._restore_gen += 1
+            raise
+
+    def _restore_body(self, gen: int) -> None:
+        """Fresh states when no checkpoint exists or a component went
+        stale; re-based through the shared ``shift_ws`` machinery if
+        the clock epoch moved since capture."""
+        from sentinel_tpu.metrics.nodes import make_stats
+        from sentinel_tpu.rules.param_table import make_param_state
+
+        eng = self._engine
+        if eng.faults is not None:
+            eng.faults.on_restore()
+
+        def to_dev(tree):
+            # COPY, never jnp.asarray: on CPU asarray can be zero-copy,
+            # making the device buffer alias the checkpoint's retained
+            # numpy arrays — the next flush donates the state and XLA
+            # may rewrite that memory in place, corrupting the stored
+            # checkpoint for any later restore (same hazard class as
+            # the encode-arena's staging rule).
+            return jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True), tree
+            )
+        ck = self._ckpt
+        with self._lock:
+            self.counters["restores"] += 1
+        with eng._lock:
+            fresh_stats = ck is None or ck.win_key != _ncfg.SECOND_CFG
+            if fresh_stats:
+                stats = make_stats(eng.stats.n_rows)
+            else:
+                stats = to_dev(ck.states[0])
+            if ck is not None and ck.findex_ref() is eng.flow_index:
+                flow_dyn = to_dev(ck.states[1])
+            else:
+                flow_dyn = eng.flow_index.make_dyn_state()
+            if ck is not None and ck.dindex_ref() is eng.degrade_index:
+                degrade_dyn = to_dev(ck.states[2])
+                restored_breakers = np.asarray(
+                    ck.states[2].state, dtype=np.int32
+                ).reshape(-1)
+            else:
+                degrade_dyn = eng.degrade_index.make_dyn_state()
+                restored_breakers = None
+            if ck is not None and ck.pindex_ref() is eng.param_index:
+                param_dyn = to_dev(ck.states[3])
+            else:
+                param_dyn = make_param_state(8)
+            offset = (
+                eng.clock.epoch_wall_ms - ck.epoch_wall_ms
+                if ck is not None
+                else 0
+            )
+            if offset > 0:
+                # The clock epoch re-anchored between capture and now:
+                # run the restored states through the same timestamp
+                # shift the live rebase applies (engine._shift_states).
+                stats, flow_dyn, degrade_dyn, param_dyn = eng._shift_states(
+                    stats, flow_dyn, degrade_dyn, param_dyn, offset
+                )
+            # Replay the degraded window's NET thread-gauge deltas: a
+            # gauge has no time decay, so exits the device never saw
+            # must be subtracted (or the restored budget stays pinned
+            # forever) AND fallback-admitted entries still in flight
+            # must be added (or their post-recovery exits drive the
+            # gauge negative, permanently under-enforcing the limit) —
+            # an entry admitted and exited while degraded cancels
+            # itself. Clamped at 0 against residual mismatch. Peeked,
+            # not drained: a failed probe must not lose the deltas for
+            # the next attempt (try_recover clears them on success).
+            # Residual approximation: exits of chunks that settled
+            # cleanly between the checkpoint and the fault are still
+            # lost — bounded by the checkpoint cadence.
+            (
+                rel_rows, rel_prows, adm_rows, adm_prows,
+            ) = self.fallback.peek_gauge_deltas()
+            net_rows = {
+                r: adm_rows.get(r, 0) - rel_rows.get(r, 0)
+                for r in set(adm_rows) | set(rel_rows)
+            }
+            net_rows = {r: d for r, d in net_rows.items() if d != 0}
+            if net_rows:
+                rows = jnp.asarray(list(net_rows), dtype=jnp.int32)
+                cnt = jnp.asarray(
+                    [net_rows[r] for r in net_rows], dtype=jnp.int32
+                )
+                threads = stats.threads.at[rows].add(cnt, mode="drop")
+                stats = stats._replace(threads=jnp.maximum(threads, 0))
+            # Param thread rows get the same NET treatment as the node
+            # gauges above: fallback admits seed (+), degraded-window
+            # exits replay (−), an entry admitted and exited while
+            # degraded cancels itself. Only meaningful while the live
+            # param index is the checkpoint's — after a reload the rows
+            # name different (rule, value) pairs.
+            if ck is not None and ck.pindex_ref() is eng.param_index:
+                net_prows = {
+                    r: adm_prows.get(r, 0) - rel_prows.get(r, 0)
+                    for r in set(adm_prows) | set(rel_prows)
+                }
+                net_prows = {r: d for r, d in net_prows.items() if d != 0}
+                if net_prows:
+                    rows = jnp.asarray(list(net_prows), dtype=jnp.int32)
+                    cnt = jnp.asarray(
+                        [net_prows[r] for r in net_prows], dtype=jnp.int32
+                    )
+                    pthreads = param_dyn.threads.at[rows].add(cnt, mode="drop")
+                    param_dyn = param_dyn._replace(
+                        threads=jnp.maximum(pthreads, 0)
+                    )
+            if gen != self._restore_gen:
+                # The watchdog abandoned THIS restore (timeout) and the
+                # engine moved on — a newer restore may have installed a
+                # newer world, or post-recovery flushes are already
+                # chaining live state. Installing now would silently
+                # replace live states with stale ones and resize tables
+                # under a concurrent flush; become a no-op instead.
+                # (Plain int read: the GIL makes it atomic, and taking
+                # self._lock under eng._lock would order locks against
+                # other paths.)
+                return
+            eng.stats = stats
+            eng.flow_dyn = flow_dyn
+            eng.degrade_dyn = degrade_dyn
+            eng.param_dyn = param_dyn
+            # Resync the breaker host mirror to the restored world so
+            # observers (and a later degraded window) never diff
+            # against pre-fault state.
+            eng._reset_breaker_mirror()
+            if restored_breakers is not None and restored_breakers.shape == (
+                eng._breaker_state_host.shape[0],
+            ):
+                with eng._breaker_mirror_lock:
+                    eng._breaker_state_host = restored_breakers
+            eng._ensure_capacity()
+
+    def _probe_locked(self) -> None:
+        """One probe no-op flush: full dispatch → execute → fetch
+        round-trip through the real kernel with an all-invalid batch;
+        raises on any fault (watchdog-bounded). Caller holds the flush
+        lock."""
+        from sentinel_tpu.runtime.flush import flush_step_jit, make_probe_batch
+
+        eng = self._engine
+        seq = eng._next_flush_seq()
+        if eng.faults is not None:
+            eng.faults.on_dispatch(seq)
+        batch = make_probe_batch(eng.clock.now_ms())
+        out = self.watched(
+            lambda: flush_step_jit(
+                eng.stats,
+                eng.flow_index.device,
+                eng.flow_dyn,
+                eng.degrade_index.device,
+                eng.degrade_dyn,
+                eng.param_dyn,
+                eng._system_device(),
+                batch,
+                occupy_timeout_ms=config.occupy_timeout_ms,
+                with_occupy=False,
+                with_system=False,
+                with_degrade=False,
+                with_exits=False,
+                sketch_k=0,
+                win_key=_ncfg.SECOND_CFG,
+            ),
+            "probe dispatch",
+            (seq,),
+        )
+        eng.stats, eng.flow_dyn, eng.degrade_dyn, eng.param_dyn, result = out
+        eng._fetch_refs((result.admitted,), (seq,))
+        with self._lock:
+            self.counters["probe_flushes"] += 1
+        tele = eng.telemetry
+        if tele.enabled:
+            tele.note_probe()
+
+    # ------------------------------------------------------------------
+    # readers
+    # ------------------------------------------------------------------
+    def reset(self) -> None:
+        """Back to HEALTHY with no checkpoint (engine reset)."""
+        with self._lock:
+            self._set_state_locked(HEALTHY, "engine reset")
+            self._ckpt = None
+            self._last_attempt_ms = None
+
+    def close(self) -> None:
+        """Retire the idle watchdog waiter pool (engine shutdown) —
+        without this every armed engine leaks up to 4 parked daemon
+        threads for the process's lifetime. Non-destructive: a later
+        watched call lazily starts fresh waiters, so the engine stays
+        usable (matching Engine.close's contract)."""
+        with self._waiter_lock:
+            waiters, self._idle_waiters = self._idle_waiters, []
+        for w in waiters:
+            w.stop()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            ck = self._ckpt
+            return {
+                "enabled": self.armed,
+                "state": self.state,
+                "state_since_ms": self.state_since_ms,
+                "policy": config.get(config.FAILOVER_POLICY) or "open",
+                "fetch_timeout_ms": self.fetch_timeout_ms,
+                "checkpoint_every": self.checkpoint_every,
+                "probe_flushes": self.probe_k,
+                "retry_ms": self.retry_ms,
+                "last_fault": self.last_fault,
+                "counters": dict(self.counters),
+                "checkpoint": (
+                    {"seq": ck.seq, "now_ms": ck.now_ms}
+                    if ck is not None and ck.states is not None
+                    else None
+                ),
+                "events": [e.as_dict() for e in self.events],
+                "fallback": self.fallback.snapshot(),
+            }

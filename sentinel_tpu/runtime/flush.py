@@ -160,6 +160,39 @@ SYS_TYPE_NAMES = {
 }
 
 
+def make_probe_batch(now: int, n: int = 8, m: int = 8, k: int = 1,
+                     kd: int = 1) -> FlushBatch:
+    """An all-invalid batch for failover probe flushes (RECOVERING →
+    HEALTHY re-entry, runtime/failover.py): every entry/exit slot is
+    masked out, so the kernel exercises the full dispatch → execute →
+    fetch round-trip — the thing a probe must prove works again —
+    while admission state passes through untouched (only the
+    time-based matured-borrow sweep runs, exactly as any flush at this
+    ``now`` would). Shapes default to the smallest pow2-padded chunk
+    so repeated probes share one jit cache entry."""
+    return FlushBatch(
+        now=jnp.int32(now),
+        e_valid=jnp.zeros((n,), dtype=bool),
+        e_ts=jnp.zeros((n,), dtype=jnp.int32),
+        e_acquire=jnp.ones((n,), dtype=jnp.int32),
+        e_rows=jnp.full((n, 4), -1, dtype=jnp.int32),
+        e_rule_gid=jnp.full((n, k), -1, dtype=jnp.int32),
+        e_check_row=jnp.full((n, k), -1, dtype=jnp.int32),
+        e_prio=jnp.zeros((n,), dtype=bool),
+        e_auth_ok=jnp.ones((n,), dtype=bool),
+        e_cluster_ok=jnp.ones((n,), dtype=bool),
+        e_dgid=jnp.full((n, kd), -1, dtype=jnp.int32),
+        x_valid=jnp.zeros((m,), dtype=bool),
+        x_ts=jnp.zeros((m,), dtype=jnp.int32),
+        x_count=jnp.zeros((m,), dtype=jnp.int32),
+        x_rows=jnp.full((m, 4), -1, dtype=jnp.int32),
+        x_rt=jnp.zeros((m,), dtype=jnp.int32),
+        x_err=jnp.zeros((m,), dtype=jnp.int32),
+        x_thr=jnp.zeros((m,), dtype=jnp.int32),
+        x_dgid=jnp.full((m, kd), -1, dtype=jnp.int32),
+    )
+
+
 def _exclusive_cumsum(x: jax.Array) -> jax.Array:
     return jnp.cumsum(x) - x
 

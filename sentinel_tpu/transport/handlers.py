@@ -428,6 +428,22 @@ def telemetry_handler(req: CommandRequest) -> CommandResponse:
 
 
 @command_mapping(
+    "health",
+    "engine failure-domain state: health machine, degraded counters,"
+    " checkpoint age, fallback policy",
+)
+def health_handler(req: CommandRequest) -> CommandResponse:
+    """The failover view (runtime/failover.py): current health state
+    (HEALTHY/DEGRADED/RECOVERING), the last fault, transition events,
+    degraded-admission counters, checkpoint seq/age and the effective
+    per-resource fail-open/fail-closed policy."""
+    engine = _engine()
+    out = engine.failover.snapshot()
+    out["flush_seq"] = engine.flush_seq
+    return CommandResponse.of_json(out)
+
+
+@command_mapping(
     "traces",
     "sampled admission trace records: [?n=N][&resource=][&reason=code|name]",
 )

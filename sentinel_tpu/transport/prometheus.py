@@ -223,6 +223,55 @@ def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
         out += ctr(f"{p}_param_cache_misses_total", "Param resolved-value cache misses", cs["misses"])
         out += ctr(f"{p}_param_cache_evictions_total", "Param value-row LRU evictions", cs["evictions"])
 
+    # Failure domain (runtime/failover.py): health state gauge plus
+    # degraded-admission counters — the scrape-side view that tells
+    # degraded admits from device admits.
+    fo = getattr(engine, "failover", None)
+    if fo is not None:
+        from sentinel_tpu.runtime.failover import HEALTH_GAUGE
+
+        out += _gauge(
+            f"{p}_health",
+            "Engine health state (0 HEALTHY, 1 DEGRADED, 2 RECOVERING)",
+            HEALTH_GAUGE.get(fo.state, 0),
+        )
+        out += _gauge(
+            f"{p}_failover_enabled",
+            "Device-failure domain armed (sentinel.tpu.failover.enabled)",
+            1 if fo.armed else 0,
+        )
+        fc = dict(fo.counters)
+        out += ctr(
+            f"{p}_degraded_admits_total",
+            "Admissions decided by the host fallback while DEGRADED",
+            fc.get("degraded_admits", 0),
+        )
+        out += ctr(
+            f"{p}_degraded_blocks_total",
+            "Blocks decided by the host fallback while DEGRADED (incl. fail-closed sheds)",
+            fc.get("degraded_blocks", 0),
+        )
+        out += ctr(
+            f"{p}_quarantined_flushes_total",
+            "In-flight flushes quarantined on a device fault",
+            fc.get("quarantined_records", 0),
+        )
+        out += ctr(
+            f"{p}_failover_trips_total",
+            "HEALTHY->DEGRADED transitions (device faults/timeouts)",
+            fc.get("trips", 0),
+        )
+        out += ctr(
+            f"{p}_failover_checkpoints_total",
+            "Host checkpoints captured (riding the coalesced fetch)",
+            fc.get("checkpoints", 0),
+        )
+        out += ctr(
+            f"{p}_failover_probe_flushes_total",
+            "Recovery probe no-op flushes executed",
+            fc.get("probe_flushes", 0),
+        )
+
     # Blocked-resource heavy-hitter sketch (space-saving over the
     # kernel's per-flush top-K): weight = blocked acquire sum.
     name = f"{p}_blocked_weight"

@@ -107,6 +107,29 @@ class SentinelConfig:
     # Per bulk group, at most this many rows recorded per class
     # (blocked / head-sampled) — keeps tracing bounded at bulk sizes.
     TRACE_BULK_CAP = "sentinel.tpu.trace.bulk.cap"
+    # Device-failure domain (runtime/failover.py): health state
+    # machine + flush watchdog + host-fallback admission + checkpoint/
+    # restore. Opt-in — disabled costs one attribute read per flush and
+    # device errors re-raise to callers exactly as before.
+    FAILOVER_ENABLED = "sentinel.tpu.failover.enabled"
+    # Watchdog bound on kernel dispatch and the device->host fetch: a
+    # wedged jax.device_get times out (on a waiter thread) and trips
+    # the engine DEGRADED instead of stranding submitters forever.
+    FAILOVER_FETCH_TIMEOUT_MS = "sentinel.tpu.failover.fetch.timeout.ms"
+    # Per-resource fail-open/fail-closed while DEGRADED: "open" |
+    # "closed" | "open,resA=closed,..." (first '='-less segment is the
+    # default). Default open, like the reference's pass-on-fallback.
+    FAILOVER_POLICY = "sentinel.tpu.failover.policy"
+    # Host checkpoint cadence in flushes (rides the coalesced result
+    # fetch; 0 disables checkpoints — recovery then restores fresh
+    # states).
+    FAILOVER_CHECKPOINT_EVERY = "sentinel.tpu.failover.checkpoint.every"
+    # Consecutive successful probe no-op flushes required before a
+    # RECOVERING engine goes HEALTHY.
+    FAILOVER_PROBE_FLUSHES = "sentinel.tpu.failover.probe.flushes"
+    # Min gap (engine clock) between automatic recovery attempts from
+    # the flush path; explicit try_recover() ignores it.
+    FAILOVER_RETRY_MS = "sentinel.tpu.failover.retry.ms"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -135,6 +158,12 @@ class SentinelConfig:
         TRACE_SAMPLE_RATE: "0.01",
         TRACE_SAMPLE_BLOCKED: "true",
         TRACE_BULK_CAP: "4",
+        FAILOVER_ENABLED: "false",
+        FAILOVER_FETCH_TIMEOUT_MS: "5000",
+        FAILOVER_POLICY: "open",
+        FAILOVER_CHECKPOINT_EVERY: "8",
+        FAILOVER_PROBE_FLUSHES: "3",
+        FAILOVER_RETRY_MS: "1000",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

@@ -1,0 +1,152 @@
+"""Shared capped-exponential-backoff-with-jitter (datasource/backoff.py)
+and its wiring into the poll-error retry loops — before this helper
+only the zookeeper source backed off; the rest re-polled at a fixed
+cadence and could hammer a dying config server."""
+
+import random
+import threading
+import time
+
+import pytest
+
+
+class TestBackoffUnit:
+    def test_growth_cap_and_reset(self):
+        from sentinel_tpu.datasource.backoff import Backoff
+
+        b = Backoff(1.0, cap_s=8.0, factor=2.0, jitter=0.0)
+        assert [b.next_delay() for _ in range(5)] == [1.0, 2.0, 4.0, 8.0, 8.0]
+        # The exponent clamps at the cap (an unbounded factor**n would
+        # OverflowError after ~1024 failures and kill the watcher).
+        assert b.failures == 3
+        b.reset()
+        assert b.failures == 0
+        assert b.next_delay() == 1.0
+
+    def test_no_overflow_after_thousands_of_failures(self):
+        from sentinel_tpu.datasource.backoff import Backoff
+
+        b = Backoff(1.0, cap_s=30.0, factor=2.0, jitter=0.0)
+        for _ in range(5000):
+            d = b.next_delay()
+        assert d == 30.0
+
+    def test_jitter_reduces_never_exceeds(self):
+        from sentinel_tpu.datasource.backoff import Backoff
+
+        rng = random.Random(42)
+        b = Backoff(1.0, cap_s=30.0, factor=2.0, jitter=0.5, rng=rng)
+        raw = [1.0, 2.0, 4.0, 8.0, 16.0, 30.0, 30.0]
+        for expect in raw:
+            d = b.next_delay()
+            # Subtractive jitter: never above the undithered delay,
+            # never below half of it (jitter=0.5).
+            assert expect * 0.5 <= d <= expect
+
+    def test_deterministic_with_seeded_rng(self):
+        from sentinel_tpu.datasource.backoff import Backoff
+
+        a = Backoff(0.5, rng=random.Random(7))
+        b = Backoff(0.5, rng=random.Random(7))
+        assert [a.next_delay() for _ in range(6)] == [
+            b.next_delay() for _ in range(6)
+        ]
+
+    def test_pathological_params_clamped(self):
+        from sentinel_tpu.datasource.backoff import Backoff
+
+        b = Backoff(-1.0, cap_s=0.0, factor=0.5, jitter=2.0)
+        d = b.next_delay()
+        assert 0.0 <= d <= b.cap
+        assert b.factor >= 1.0 and b.base > 0.0
+
+
+class TestSourcesShareTheHelper:
+    def test_every_network_source_owns_a_backoff(self):
+        """The unify satellite: http long-poll, the long-poll base
+        (apollo/consul/nacos), etcd, redis and zookeeper all retry
+        through datasource.backoff.Backoff."""
+        from sentinel_tpu.datasource.backoff import Backoff
+        from sentinel_tpu.datasource.etcd_source import EtcdDataSource
+        from sentinel_tpu.datasource.http_source import HttpLongPollDataSource
+        from sentinel_tpu.datasource.redis_source import RedisDataSource
+        from sentinel_tpu.datasource.zookeeper_source import ZookeeperDataSource
+        from sentinel_tpu.datasource.base import json_converter
+        import sentinel_tpu as st
+
+        conv = json_converter(st.FlowRule)
+        sources = [
+            HttpLongPollDataSource(conv, "http://127.0.0.1:1/x",
+                                   retry_interval_sec=0.25),
+            EtcdDataSource(conv, "k", reconnect_interval_sec=0.25),
+            RedisDataSource(conv, rule_key="k", channel="c",
+                            reconnect_interval_sec=0.25),
+            ZookeeperDataSource(conv, path="/p",
+                                server_addr="127.0.0.1:1",
+                                reconnect_interval_sec=0.25),
+        ]
+        for src in sources:
+            assert isinstance(src._backoff, Backoff), type(src).__name__
+            assert src._backoff.base == 0.25
+            assert src.closed_dirty is False
+
+    def test_longpoll_base_backs_off_between_poll_errors(self):
+        """Consecutive _poll_once failures wait Backoff delays (growing),
+        and a success resets the streak — observed via an injected
+        deterministic rng with zero jitter."""
+        from sentinel_tpu.datasource.backoff import Backoff
+        from sentinel_tpu.datasource.longpoll import LongPollPushDataSource
+
+        polls = []
+        stop_after = threading.Event()
+
+        class FlakySource(LongPollPushDataSource):
+            _thread_name = "flaky-test-watcher"
+
+            def __init__(self):
+                super().__init__(lambda raw: [], 1024)
+                self._backoff = Backoff(0.01, cap_s=0.04, factor=2.0,
+                                        jitter=0.0)
+
+            def read_source(self):
+                return None
+
+            def _poll_once(self):
+                polls.append(time.monotonic())
+                if len(polls) >= 5:
+                    stop_after.set()
+                    self._stop.set()
+                    return
+                raise RuntimeError("flaky")
+
+            def _on_poll_error(self, e):
+                pass  # the base loop owns the wait now
+
+        src = FlakySource()
+        src._thread = threading.Thread(target=src._watch_loop, daemon=True)
+        src._thread.start()
+        assert stop_after.wait(5.0)
+        src._thread.join(timeout=1)
+        assert len(polls) == 5
+        gaps = [b - a for a, b in zip(polls, polls[1:])]
+        # Exponential growth: 0.01, 0.02, 0.04 (cap), 0.04 — each gap
+        # at least the undithered delay (scheduling only adds).
+        for gap, want in zip(gaps, [0.01, 0.02, 0.04, 0.04]):
+            assert gap >= want * 0.9, (gaps,)
+        # And strictly growing until the cap.
+        assert gaps[1] > gaps[0]
+
+    def test_http_source_resets_streak_on_success(self):
+        from sentinel_tpu.datasource.base import json_converter
+        from sentinel_tpu.datasource.http_source import HttpLongPollDataSource
+        import sentinel_tpu as st
+
+        src = HttpLongPollDataSource(
+            json_converter(st.FlowRule), "http://127.0.0.1:1/x",
+            retry_interval_sec=0.05,
+        )
+        src._backoff.next_delay()
+        src._backoff.next_delay()
+        assert src._backoff.failures == 2
+        src._backoff.reset()
+        assert src._backoff.failures == 0
