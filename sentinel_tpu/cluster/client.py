@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 
 from sentinel_tpu.cluster import protocol
 from sentinel_tpu.cluster.token_service import TokenResult, TokenService
+from sentinel_tpu.datasource.backoff import Backoff
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.utils.record_log import record_log
 
@@ -48,7 +49,19 @@ class ClusterTokenClient(TokenService):
         self._xid = itertools.count(1)
         self._reader: Optional[threading.Thread] = None
         self._stopped = threading.Event()
-        self._last_reconnect = 0.0
+        # Shared datasource backoff stance (datasource/backoff.py):
+        # consecutive connect failures space retries out capped-
+        # exponentially with subtractive jitter instead of hammering a
+        # dying token server at the fixed cadence forever; one
+        # successful connect resets the streak to the base interval.
+        self._backoff = Backoff(
+            base_s=reconnect_interval_sec,
+            cap_s=max(30.0, reconnect_interval_sec),
+        )
+        # Guards the gate AND the Backoff (not thread-safe by design):
+        # request threads race through _maybe_reconnect.
+        self._reconnect_lock = threading.Lock()
+        self._next_reconnect = 0.0
 
     # ------------------------------------------------------------------
     def start(self) -> "ClusterTokenClient":
@@ -116,11 +129,25 @@ class ClusterTokenClient(TokenService):
             self._pending.clear()
 
     def _maybe_reconnect(self) -> bool:
-        now = time.monotonic()
-        if now - self._last_reconnect < self.reconnect_interval:
-            return False
-        self._last_reconnect = now
-        return self._connect()
+        # Close the gate for the whole attempt BEFORE dialing: _connect
+        # can block for a full TCP timeout, and during it every other
+        # request thread must fail fast (return False) rather than
+        # queue up behind the dial or hammer the dying server with its
+        # own. The successful dialer re-stamps the gate and resets the
+        # failure streak the pre-charged next_delay() advanced.
+        with self._reconnect_lock:
+            now = time.monotonic()
+            if now < self._next_reconnect:
+                return False
+            self._next_reconnect = now + self._backoff.next_delay()
+        ok = self._connect()
+        if ok:
+            with self._reconnect_lock:
+                self._backoff.reset()
+                self._next_reconnect = (
+                    time.monotonic() + self.reconnect_interval
+                )
+        return ok
 
     def _read_loop(self) -> None:
         sock = self._sock

@@ -174,11 +174,18 @@ class Entry:
         pass_through: bool = False,
         param_rows: Sequence[int] = (),
         cluster_tokens: Sequence = (),
+        verdict: Optional[Verdict] = None,
     ) -> None:
         self.resource = resource
         self.rows = rows
         self.context = context
         self.create_ts = create_ts
+        # The admitting verdict (None for pass-through entries): lets
+        # callers read provenance — ``entry.verdict.speculative`` marks
+        # a fast-tier admit the device settles later,
+        # ``entry.verdict.degraded`` a host-fallback admit while the
+        # device was lost (runtime/speculative.py, runtime/failover.py).
+        self.verdict = verdict
         # Wall-clock anchor: RT must survive an epoch rebase of the
         # relative device clock (Engine._maybe_rebase).
         self.create_wall = get_engine().clock.to_wall(create_ts)
@@ -231,6 +238,15 @@ class Entry:
                 err=err,
                 resource=self.resource,
                 param_rows=self.param_rows,
+                # The mirror-release gate wants "was this admit charged
+                # to the host mirror": degraded fills (speculative=False,
+                # degraded=True) charge the persistent mirror's THREAD
+                # counter just like speculative admits do.
+                speculative=(
+                    (self.verdict.speculative or self.verdict.degraded)
+                    if self.verdict is not None
+                    else None
+                ),
             )
         if self.cluster_tokens:
             from sentinel_tpu.runtime.engine import release_cluster_tokens
@@ -310,6 +326,7 @@ def _do_entry(
         acquire,
         param_rows=op.param_thread_rows,
         cluster_tokens=op.cluster_tokens,
+        verdict=verdict,
     )
     if with_context:
         ctx.entry_stack.append(e)

@@ -130,10 +130,16 @@ class SlotChainRegistry:
         home of the bulk veto rule — shared by the device path
         (engine._run_chunk) and the degraded fallback fill
         (failover.fill_degraded), which must never diverge. No-op if
-        the group was already checked."""
+        the group was already checked (``custom_checked`` — a vetoless
+        pass leaves both veto fields None, so the fields alone can't
+        make this run-once)."""
         import numpy as np
 
-        if g.custom_veto is not None or g.custom_veto_mask is not None:
+        if (
+            g.custom_checked
+            or g.custom_veto is not None
+            or g.custom_veto_mask is not None
+        ):
             return
         vetoed_vals = []
         for a in np.unique(g.acquire):
@@ -148,6 +154,7 @@ class SlotChainRegistry:
                 vetoed_vals.append(int(a))
         if vetoed_vals:
             g.custom_veto_mask = np.isin(g.acquire, vetoed_vals)
+        g.custom_checked = True
 
     @classmethod
     def on_exit(cls, resource: str, rt_ms: int, count: int, err: int) -> None:

@@ -189,6 +189,14 @@ class AdmissionRecord:
     # the engine was DEGRADED (reason BLOCK_FAILOVER for policy sheds;
     # degraded ADMITS keep reason PASS but carry this mark).
     degraded: bool = False
+    # Tier provenance: "device" (settled on-device, the default),
+    # "degraded" (host fallback, device lost), or "speculative" (host
+    # fast tier — runtime/speculative.py; the speculative→settled
+    # story: ``flush_seq`` names the settling flush and
+    # ``settled_match`` whether the device agreed; None = never
+    # settled, e.g. quarantined by a device fault).
+    provenance: str = "device"
+    settled_match: Optional[bool] = None
 
     def as_dict(self) -> dict:
         return {
@@ -205,6 +213,8 @@ class AdmissionRecord:
             "latency_ms": round(self.latency_ms, 4),
             "head_sampled": self.head_sampled,
             "degraded": self.degraded,
+            "provenance": self.provenance,
+            "settled_match": self.settled_match,
         }
 
 
@@ -296,12 +306,16 @@ class AdmissionTracer:
         flush_seq: int,
         end_pc: float,
         degraded: bool = False,
+        provenance: str = "",
+        settled_match: Optional[bool] = None,
     ) -> Optional[AdmissionRecord]:
         """Record one settled admission if the tag (or the blocked
         override) selects it; returns the record or None."""
         if not (tag.sampled or (not admitted and self.sample_blocked)):
             self._skipped += 1
             return None
+        if not provenance:
+            provenance = "degraded" if degraded else "device"
         parent = tag.parent
         rec = AdmissionRecord(
             trace_id=parent.trace_id if parent is not None else new_trace_id(),
@@ -318,6 +332,8 @@ class AdmissionTracer:
             latency_ms=max(0.0, (end_pc - tag.t0) * 1e3),
             head_sampled=tag.sampled,
             degraded=degraded,
+            provenance=provenance,
+            settled_match=settled_match,
         )
         self.hist_latency.record(rec.latency_ms)
         bucket = self.hist_latency.bucket_of(rec.latency_ms)
@@ -342,6 +358,8 @@ class AdmissionTracer:
         flush_seq: int,
         end_pc: float,
         degraded: bool = False,
+        provenance: str = "",
+        settled_match: Optional[bool] = None,
     ) -> None:
         """Bounded per-row records for one bulk group: up to
         ``bulk_cap`` blocked rows (always-blocked mode) plus, when the
@@ -368,7 +386,8 @@ class AdmissionTracer:
             self.record_admission(
                 tag, resource, origin, context_name,
                 bool(adm[i]), int(reasons[i]), flush_seq, end_pc,
-                degraded=degraded,
+                degraded=degraded, provenance=provenance,
+                settled_match=settled_match,
             )
 
     # ------------------------------------------------------------------

@@ -209,6 +209,10 @@ class TelemetryBus:
         self.hist_flush = LatencyHistogram()
         self.hist_drain = LatencyHistogram()
         self.hist_e2e = LatencyHistogram()
+        # Per-drift-window over-admit counts of the speculative tier —
+        # the distribution the differential bound is stated over (a
+        # count histogram riding the same pow2-bucket machinery).
+        self.hist_spec_drift = LatencyHistogram()
         self.counters: Dict[str, int] = {
             "flushes": 0,
             "ops": 0,
@@ -223,6 +227,16 @@ class TelemetryBus:
             "degraded_blocks": 0,
             "health_transitions": 0,
             "probe_flushes": 0,
+            # Speculative tier (runtime/speculative.py): fast-path
+            # verdicts served, declines (device-only semantics or the
+            # drift valve), reconciliation mismatches by direction, and
+            # valve suspensions.
+            "spec_admits": 0,
+            "spec_blocks": 0,
+            "spec_declined": 0,
+            "spec_over_admits": 0,
+            "spec_under_admits": 0,
+            "spec_suspensions": 0,
         }
         # Bounded ring of health transitions (now_ms is engine-clock
         # relative ms): the flight-recorder view of the failover state
@@ -341,6 +355,34 @@ class TelemetryBus:
         with self._lock:
             self.counters["probe_flushes"] += 1
 
+    # ------------------------------------------------------------------
+    # speculative tier (runtime/speculative.py)
+    # ------------------------------------------------------------------
+    def note_speculative(self, admits: int, blocks: int) -> None:
+        with self._lock:
+            self.counters["spec_admits"] += admits
+            self.counters["spec_blocks"] += blocks
+
+    def note_spec_declined(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["spec_declined"] += n
+
+    def note_spec_drift(self, over: int, under: int) -> None:
+        with self._lock:
+            self.counters["spec_over_admits"] += over
+            self.counters["spec_under_admits"] += under
+
+    def note_spec_window(self, net_over: int) -> None:
+        """One closed drift window: its NET excess-admit count joins
+        the drift histogram (the bound is per window, so the histogram
+        is per window too — raw per-direction mismatches ride the
+        counters only)."""
+        self.hist_spec_drift.record(float(net_over))
+
+    def note_spec_suspended(self) -> None:
+        with self._lock:
+            self.counters["spec_suspensions"] += 1
+
     def fold_blocked_topk(self, pairs: Sequence[Tuple[str, int]]) -> None:
         """Fold one flush's device top-K (already name-resolved) into
         the running space-saving summary."""
@@ -383,6 +425,7 @@ class TelemetryBus:
             "flush_ms": self.hist_flush.summary(),
             "drain_ms": self.hist_drain.summary(),
             "e2e_ms": self.hist_e2e.summary(),
+            "spec_drift_per_window": self.hist_spec_drift.summary(),
             "blocked_topk": [
                 {"resource": k, "weight": c, "max_error": e}
                 for k, c, e in self.sketch.topk(self.sketch_k or 10)
@@ -400,6 +443,9 @@ class TelemetryBus:
             out["pipeline"] = engine.pipeline_stats()
             out["pipeline_depth"] = engine.pipeline_depth
             out["last_flush_host_ms"] = engine.last_flush_host_ms
+            spec = getattr(engine, "speculative", None)
+            if spec is not None and spec.enabled:
+                out["speculative"] = spec.snapshot()
             pindex = getattr(engine, "param_index", None)
             if pindex is not None and hasattr(pindex, "cache_stats"):
                 out["param_cache"] = pindex.cache_stats()

@@ -8,6 +8,8 @@ cluster-global limits are combined with ``psum``/``pmax`` inside the
 step (see :mod:`sentinel_tpu.parallel.ici`).
 """
 
+from typing import Optional
+
 from sentinel_tpu.parallel.mesh import make_mesh
 from sentinel_tpu.parallel.ici import (
     merge_window_across,
@@ -17,6 +19,31 @@ from sentinel_tpu.parallel.ici import (
     batch_partition_specs,
 )
 
+
+def mesh_unavailable_reason(n_devices: int = 2) -> Optional[str]:
+    """Why the sharded flush path cannot run in this environment, or
+    None when it can. The sharded kernels are written against the
+    stable ``jax.shard_map`` / ``jax.lax.axis_size`` API surface; on an
+    older jax (or with too few devices) the capability is absent and
+    callers — tests above all — should skip with this reason instead
+    of failing on an ImportError deep inside a kernel trace."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        return (
+            f"jax {jax.__version__} has no stable jax.shard_map "
+            "(the sharded kernels require it)"
+        )
+    if not hasattr(jax.lax, "axis_size"):
+        return f"jax {jax.__version__} lacks jax.lax.axis_size"
+    if len(jax.devices()) < n_devices:
+        return (
+            f"needs a {n_devices}-device mesh, environment has "
+            f"{len(jax.devices())}"
+        )
+    return None
+
+
 __all__ = [
     "make_mesh",
     "merge_window_across",
@@ -24,4 +51,5 @@ __all__ = [
     "cluster_allocate",
     "make_sharded_flush",
     "batch_partition_specs",
+    "mesh_unavailable_reason",
 ]

@@ -135,6 +135,19 @@ class DegradeIndex:
         return None
 
 
+def mirror_any_open(mirror, gids) -> bool:
+    """Host-mirror hook: True when any of ``gids`` is OPEN in the
+    engine's host breaker mirror array — the one read shared by the
+    degraded fallback and the speculative tier (the mirror itself is
+    kept by the engine's breaker-event machinery, which the speculative
+    tier rides on every flush)."""
+    n = mirror.shape[0]
+    for dg in gids:
+        if 0 <= dg < n and mirror[dg] == OPEN:
+            return True
+    return False
+
+
 def trip_condition(
     grade: jax.Array,  # int32 — per-element grade (gathered or full table)
     threshold: jax.Array,  # float32

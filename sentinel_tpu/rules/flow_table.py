@@ -302,3 +302,24 @@ class FlowIndex:
         if 0 <= gid < len(self.rules):
             return self.rules[gid].rule
         return None
+
+    def mirror_info(self, gid: int):
+        """Host-mirror compilation hook (runtime/speculative.py /
+        runtime/failover.py): ``(rule, grade, capacity, window_ms)``
+        for one gid, or None. Compiled lazily once per index — the
+        speculative tier consults this per admitted op, so the grade
+        test and threshold float() must not be re-derived from the rule
+        bean every time. QPS thresholds are per 1 s, the reference's
+        windowed count."""
+        cache = getattr(self, "_mirror_cache", None)
+        if cache is None:
+            cache = self._mirror_cache = {}
+        hit = cache.get(gid)
+        if hit is None:
+            rule = self.rule_of_gid(gid)
+            if rule is None:
+                return None
+            hit = cache[gid] = (
+                rule, rule.grade, float(rule.count), 1000.0,
+            )
+        return hit

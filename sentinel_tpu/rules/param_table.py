@@ -154,6 +154,17 @@ class ParamSlotInfo:
     rule: Optional[ParamFlowRule] = None  # for block attribution
     value_key: str = ""  # interned value string (cluster RPC payload)
 
+    def mirror_bucket(self) -> Tuple[float, float]:
+        """Host-mirror compilation hook: ``(capacity, window_ms)`` of
+        the token bucket approximating this value row's device budget
+        (token_count + burst over duration_ms) — the ONE home of that
+        mapping, shared by the degraded fallback and the speculative
+        tier (runtime/failover.py, runtime/speculative.py)."""
+        return (
+            float(self.token_count + self.burst),
+            max(float(self.duration_ms), 1.0),
+        )
+
 
 def _transition(tokens, last, latest, thr_used, x):
     """One param slot's check + state update, vector-friendly (used by

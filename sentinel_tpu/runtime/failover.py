@@ -178,12 +178,27 @@ class HostFallbackAdmitter:
     veto → authority → param → flow → degrade — ``_fill_results`` also
     reports a custom veto ahead of the shared authority channel); an op
     blocked by an earlier stage does not consume later stages' tokens.
-    All state here is scoped to ONE degraded window — ``begin()``
-    resets it, so recovery retires every approximation along with the
-    window."""
 
-    def __init__(self, engine) -> None:
+    Two lifecycles (PR 6): in the original, non-``persistent`` mode all
+    state is scoped to ONE degraded window — ``begin()`` resets it, so
+    recovery retires every approximation along with the window. In
+    ``persistent`` mode (the mirror core of the speculative tier,
+    runtime/speculative.py) the buckets/counters run continuously under
+    HEALTHY and are reconciled against device truth at every drain;
+    ``begin()`` then keeps them — a device trip is a zero-transition
+    event — and only resets the degraded-window delta ledgers + re-reads
+    the policy. The gauge-delta ledgers (``_exit_rows`` &c.) track ONLY
+    ops the device never saw, so recording is gated on
+    ``_track_deltas`` — true exactly between a trip and a successful
+    recovery."""
+
+    def __init__(self, engine, persistent: bool = False) -> None:
         self._engine = engine
+        self.persistent = persistent
+        # Delta recording is scoped to degraded windows: a persistent
+        # mirror serves admits the device WILL settle while HEALTHY —
+        # those must not be replayed into a restored checkpoint.
+        self._track_deltas = not persistent
         self._lock = threading.Lock()
         # id(rule) -> (rule, bucket): the rule ref pins the object so a
         # freed rule's id cannot be reused under the same key.
@@ -211,8 +226,36 @@ class HostFallbackAdmitter:
     # lifecycle
     # ------------------------------------------------------------------
     def begin(self, now_ms: int) -> None:
-        """Enter a degraded window: fresh buckets/counters, re-read the
+        """Enter a degraded window: fresh buckets/counters (UNLESS
+        persistent — the speculative mirror carries its continuously-
+        reconciled state straight into the degraded window, the
+        zero-transition contract), fresh delta ledgers, re-read the
         policy (it is runtime-settable)."""
+        with self._lock:
+            if not self.persistent:
+                self._buckets.clear()
+                self._pbuckets.clear()
+                self._threads.clear()
+            self._exit_rows.clear()
+            self._exit_prows.clear()
+            self._admit_rows.clear()
+            self._admit_prows.clear()
+            self._track_deltas = True
+            self._parse_policy(config.get(config.FAILOVER_POLICY) or "open")
+
+    def end_degraded(self) -> None:
+        """Recovery succeeded: stop delta tracking (persistent mirrors
+        keep serving the speculative tier; non-persistent admitters
+        simply go idle until the next ``begin``)."""
+        with self._lock:
+            if self.persistent:
+                self._track_deltas = False
+
+    def reset_world(self) -> None:
+        """Fresh mirror world: buckets, counters, and delta ledgers all
+        cleared, delta tracking back to its construction-time stance.
+        The full-reset analog of a non-persistent ``begin()`` — owned
+        here so 'what constitutes a fresh world' has one home."""
         with self._lock:
             self._buckets.clear()
             self._pbuckets.clear()
@@ -221,7 +264,7 @@ class HostFallbackAdmitter:
             self._exit_prows.clear()
             self._admit_rows.clear()
             self._admit_prows.clear()
-            self._parse_policy(config.get(config.FAILOVER_POLICY) or "open")
+            self._track_deltas = not self.persistent
 
     def _parse_policy(self, raw: str) -> None:
         """``"open"`` / ``"closed"`` / ``"open,resA=closed,resB=open"``
@@ -250,19 +293,27 @@ class HostFallbackAdmitter:
     # ------------------------------------------------------------------
     # helpers
     # ------------------------------------------------------------------
-    def _bucket_for(self, rule, now_ms: int) -> _TokenBucket:
+    def _bucket_for(
+        self, rule, now_ms: int, cap: Optional[float] = None,
+        window_ms: float = 1000.0,
+    ) -> _TokenBucket:
         key = id(rule)
         ent = self._buckets.get(key)
         if ent is None or ent[0] is not rule:
-            ent = (rule, _TokenBucket(float(rule.count), 1000.0, now_ms))
+            ent = (
+                rule,
+                _TokenBucket(
+                    float(rule.count) if cap is None else cap,
+                    window_ms, now_ms,
+                ),
+            )
             self._buckets[key] = ent
         return ent[1]
 
     def _pbucket_for(self, ps, now_ms: int) -> _TokenBucket:
         ent = self._pbuckets.get(ps.prow)
         if ent is None:
-            cap = float(ps.token_count + getattr(ps, "burst", 0))
-            window = max(float(ps.duration_ms), 1.0)
+            cap, window = ps.mirror_bucket()
             ent = (ps.rule, _TokenBucket(cap, window, now_ms))
             self._pbuckets[ps.prow] = ent
         return ent[1]
@@ -273,15 +324,13 @@ class HostFallbackAdmitter:
         never observed, or shape-stale after a reload — fails open."""
         if not d_gids:
             return False
+        from sentinel_tpu.rules.degrade_table import mirror_any_open
+
         eng = self._engine
         with eng._breaker_mirror_lock:
             if not eng._breaker_mirror_valid:
                 return False
-            mirror = eng._breaker_state_host
-            for dg in d_gids:
-                if 0 <= dg < mirror.shape[0] and mirror[dg] == _BREAKER_OPEN:
-                    return True
-        return False
+            return mirror_any_open(eng._breaker_state_host, d_gids)
 
     @staticmethod
     def _rule_of(src_index, gid: int):
@@ -293,18 +342,24 @@ class HostFallbackAdmitter:
     # ------------------------------------------------------------------
     # single-op admission
     # ------------------------------------------------------------------
-    def admit(self, op, now_ms: int):
-        """Policy verdict for one op — always returns a Verdict with
-        ``degraded=True`` provenance; never raises."""
+    def admit(self, op, now_ms: int, apply_policy: bool = True,
+              degraded: bool = True, speculative: bool = False):
+        """Host verdict for one op; never raises. Provenance is the
+        caller's: the degraded fill marks ``degraded=True`` (the PR 5
+        contract), the speculative tier marks ``speculative=True`` and
+        ``degraded`` only while the engine actually is. The fail-open/
+        closed policy is a DEGRADED concept — the healthy speculative
+        tier passes ``apply_policy=False``."""
         from sentinel_tpu.runtime.engine import Verdict
 
         def blocked(reason, rule=None, slot_name=""):
             return Verdict(
                 admitted=False, reason=reason, wait_ms=0, blocked_rule=rule,
-                slot_name=slot_name, degraded=True,
+                slot_name=slot_name, degraded=degraded,
+                speculative=speculative,
             )
 
-        if self.policy_for(op.resource) == "closed":
+        if apply_policy and self.policy_for(op.resource) == "closed":
             return blocked(E.BLOCK_FAILOVER)
         if op.custom_veto is not None:
             slot, veto = op.custom_veto
@@ -350,18 +405,19 @@ class HostFallbackAdmitter:
                     return blocked(E.BLOCK_PARAM, ps.rule)
             thread_rules = []
             for gid, _crow in op.slots:
-                rule = self._rule_of(findex, gid)
-                if rule is None:
+                info = findex.mirror_info(gid)
+                if info is None:
                     continue
-                if rule.grade == C.FLOW_GRADE_THREAD:
+                rule, grade, cap, window_ms = info
+                if grade == C.FLOW_GRADE_THREAD:
                     thread_rules.append(rule)
                     cur = self._threads.get(op.resource, 0)
-                    if cur + 1 > int(rule.count):
+                    if cur + 1 > int(cap):
                         return blocked(E.BLOCK_FLOW, rule)
                 else:
-                    if not self._bucket_for(rule, now_ms).try_take(
-                        now_ms, op.acquire
-                    ):
+                    if not self._bucket_for(
+                        rule, now_ms, cap, window_ms
+                    ).try_take(now_ms, op.acquire):
                         return blocked(E.BLOCK_FLOW, rule)
             if self._breaker_open(op.d_gids):
                 dindex = (
@@ -374,21 +430,35 @@ class HostFallbackAdmitter:
                 # (acquire weights QPS only) — mirror that exactly,
                 # and remember the rows for the recovery seed (this
                 # entry's exit may land after the gauge is restored).
+                # Delta recording only while degraded: a persistent
+                # mirror's healthy admits settle on-device normally.
                 self._threads[op.resource] = self._threads.get(op.resource, 0) + 1
-                for r in op.rows:
-                    if r >= 0:
-                        self._admit_rows[r] = self._admit_rows.get(r, 0) + 1
-            for r in thr_prows:
-                self._admit_prows[r] = self._admit_prows.get(r, 0) + 1
+                # Speculative ops must NOT record here even while
+                # degraded: they still ride the flush, so their admit
+                # deltas are recorded exactly once at fill time
+                # (note_unsettled_admit) — and if recovery lands before
+                # the fill, the device settles the chunk itself and no
+                # replay delta is owed at all. Recording at both points
+                # double-counts and pins the restored gauge.
+                if self._track_deltas and not speculative:
+                    for r in op.rows:
+                        if r >= 0:
+                            self._admit_rows[r] = self._admit_rows.get(r, 0) + 1
+            if self._track_deltas and not speculative:
+                for r in thr_prows:
+                    self._admit_prows[r] = self._admit_prows.get(r, 0) + 1
         return Verdict(
             admitted=True, reason=E.PASS, wait_ms=0, blocked_rule=None,
-            degraded=True,
+            degraded=degraded, speculative=speculative,
         )
 
     # ------------------------------------------------------------------
     # bulk admission (vectorized)
     # ------------------------------------------------------------------
-    def admit_bulk(self, g, now_ms: int) -> Tuple[np.ndarray, np.ndarray]:
+    def admit_bulk(
+        self, g, now_ms: int, apply_policy: bool = True,
+        speculative: bool = False,
+    ) -> Tuple[np.ndarray, np.ndarray]:
         """Array verdicts for one bulk group: numpy prefix math against
         the same buckets/counters the singles path consumes (QPS-grade
         hot-param columns pass — bulk rejects THREAD/cluster param
@@ -403,7 +473,7 @@ class HostFallbackAdmitter:
             admitted[sel] = False
             reason[sel] = code
 
-        if self.policy_for(g.resource) == "closed":
+        if apply_policy and self.policy_for(g.resource) == "closed":
             block(np.ones(n, dtype=bool), E.BLOCK_FAILOVER)
             return admitted, reason
         if g.custom_veto_mask is not None:
@@ -415,19 +485,20 @@ class HostFallbackAdmitter:
         with self._lock:
             thread_rule = None
             for gid, _crow in g.slots:
-                rule = self._rule_of(findex, gid)
-                if rule is None:
+                info = findex.mirror_info(gid)
+                if info is None:
                     continue
-                if rule.grade == C.FLOW_GRADE_THREAD:
+                rule, grade, cap, window_ms = info
+                if grade == C.FLOW_GRADE_THREAD:
                     thread_rule = rule
                     cur = self._threads.get(g.resource, 0)
-                    headroom = max(0, int(rule.count) - cur)
+                    headroom = max(0, int(cap) - cur)
                     # +1 thread per admitted entry: the first `headroom`
                     # still-live rows pass, the rest block.
                     live_rank = np.cumsum(admitted)
                     block(live_rank > headroom, E.BLOCK_FLOW)
                 else:
-                    bucket = self._bucket_for(rule, now_ms)
+                    bucket = self._bucket_for(rule, now_ms, cap, window_ms)
                     avail = bucket.available(now_ms)
                     cum = np.cumsum(np.where(admitted, acquire, 0))
                     block(cum > avail, E.BLOCK_FLOW)
@@ -439,11 +510,15 @@ class HostFallbackAdmitter:
                 self._threads[g.resource] = (
                     self._threads.get(g.resource, 0) + n_adm
                 )
-                for r in g.rows:
-                    if r >= 0:
-                        self._admit_rows[r] = (
-                            self._admit_rows.get(r, 0) + n_adm
-                        )
+                # Same single-recording-point rule as admit():
+                # speculative groups record at fill time
+                # (note_unsettled_admit_bulk), never here.
+                if self._track_deltas and not speculative:
+                    for r in g.rows:
+                        if r >= 0:
+                            self._admit_rows[r] = (
+                                self._admit_rows.get(r, 0) + n_adm
+                            )
         return admitted, reason
 
     def on_exit(self, resource: str, n: int = 1) -> None:
@@ -467,6 +542,95 @@ class HostFallbackAdmitter:
             for r in p_rows:
                 if r >= 0:
                     self._exit_prows[r] = self._exit_prows.get(r, 0) + n
+
+    def note_unsettled_admit(self, op) -> None:
+        """A speculative-admitted entry whose chunk the device never
+        applied (quarantined, or filled while DEGRADED with its verdict
+        already served): record its THREAD-gauge admit deltas for the
+        restore replay, exactly as :meth:`admit` would have — WITHOUT
+        re-running admission (the caller already holds a verdict and
+        the mirror's live counter already counted it at admit time)."""
+        if not self._track_deltas:
+            return
+        findex = op.src[0] if op.src is not None else self._engine.flow_index
+        thread = any(
+            (info := findex.mirror_info(gid)) is not None
+            and info[1] == C.FLOW_GRADE_THREAD
+            for gid, _crow in op.slots
+        )
+        with self._lock:
+            if not self._track_deltas:
+                return
+            if thread:
+                for r in op.rows:
+                    if r >= 0:
+                        self._admit_rows[r] = self._admit_rows.get(r, 0) + 1
+            for ps in op.p_slots:
+                if ps.grade != C.FLOW_GRADE_QPS and ps.prow >= 0:
+                    self._admit_prows[ps.prow] = (
+                        self._admit_prows.get(ps.prow, 0) + 1
+                    )
+
+    def note_unsettled_admit_bulk(self, g, n_adm: int) -> None:
+        """Bulk analog of :meth:`note_unsettled_admit`: ``n_adm``
+        speculative-admitted rows of a group the device never applied."""
+        if not self._track_deltas or n_adm <= 0:
+            return
+        findex = g.src[0] if g.src is not None else self._engine.flow_index
+        if any(
+            (info := findex.mirror_info(gid)) is not None
+            and info[1] == C.FLOW_GRADE_THREAD
+            for gid, _crow in g.slots
+        ):
+            self.note_unsettled_admit_rows(g.rows, n_adm)
+
+    def note_unsettled_admit_rows(self, rows, n: int = 1) -> None:
+        """Raw-row variant of :meth:`note_unsettled_admit` for the
+        speculative tier's +thread gauge-compensation ops caught in a
+        degraded window (the device never saw the +n either)."""
+        if not self._track_deltas:
+            return
+        with self._lock:
+            for r in rows:
+                if r is not None and r >= 0:
+                    self._admit_rows[r] = self._admit_rows.get(r, 0) + n
+
+    # ------------------------------------------------------------------
+    # reconciliation clamps (speculative tier)
+    # ------------------------------------------------------------------
+    def drain_bucket(self, rule) -> bool:
+        """Settlement said this rule's mirror was too generous (a
+        speculative admit the device blocked): empty the bucket so the
+        mirror stops admitting until refill — the clamp that bounds an
+        over-admit streak to one detection lag per window."""
+        with self._lock:
+            ent = self._buckets.get(id(rule))
+            if ent is not None and ent[0] is rule:
+                b = ent[1]
+                b.consume(b.tokens)
+                return True
+        return False
+
+    def drain_pbucket(self, prow: int) -> bool:
+        """Per-value clamp, same contract as :meth:`drain_bucket`."""
+        with self._lock:
+            ent = self._pbuckets.get(prow)
+            if ent is not None:
+                b = ent[1]
+                b.consume(b.tokens)
+                return True
+        return False
+
+    def invalidate_rule_mirrors(self) -> None:
+        """A rule reload swapped the indexes: every bucket keys a rule
+        object (or a prow) of the OLD world — retire them so the next
+        admit compiles fresh mirrors against the new tables (the device
+        dyn states are rebuilt on reload too, so a fresh full bucket is
+        the faithful mirror of the fresh device window). Live THREAD
+        counters persist like the device's stats gauge does."""
+        with self._lock:
+            self._buckets.clear()
+            self._pbuckets.clear()
 
     def peek_gauge_deltas(
         self,
@@ -687,6 +851,7 @@ class FailoverManager:
                 self._restore_locked()
                 for _ in range(self.probe_k):
                     self._probe_locked()
+                self._reanchor_checkpoint()
             except BaseException as exc:
                 with self._lock:
                     self.last_fault = (
@@ -699,11 +864,42 @@ class FailoverManager:
                 )
                 return False
             self.fallback.clear_gauge_deltas()
+            self.fallback.end_degraded()
             with self._lock:
                 self.counters["recoveries"] += 1
                 self._set_state_locked(HEALTHY, "recovered")
         record_log.info("[Failover] engine HEALTHY again")
         return True
+
+    def _reanchor_checkpoint(self) -> None:
+        """Replace the stored checkpoint with the just-installed world.
+
+        The restore replayed the degraded window's NET gauge deltas
+        into the states it installed — but the stored checkpoint still
+        holds the PRE-replay world. If a second fault hits before any
+        clean drain stores a fresh checkpoint, restoring that stale
+        world again would resurrect gauge entries whose exits were
+        already replayed and (on success) cleared from the ledger —
+        leaking the THREAD gauge by exactly the replayed net, forever.
+
+        Runs INSIDE try_recover's fault handling, after the probes and
+        BEFORE clear_gauge_deltas: a fault here falls back to DEGRADED
+        with the old (checkpoint, ledger) pair intact — the two are
+        only ever replaced/cleared together. Caller holds the flush
+        lock; the device just round-tripped the probes, so one more
+        watched fetch is the expected-healthy case."""
+        eng = self._engine
+        meta = self.begin_checkpoint(
+            eng.flush_seq, eng.clock.now_ms(),
+            eng.flow_index, eng.degrade_index, eng.param_index,
+        )
+        states = self.watched(
+            lambda: jax.device_get(
+                (eng.stats, eng.flow_dyn, eng.degrade_dyn, eng.param_dyn)
+            ),
+            "checkpoint re-anchor fetch", (),
+        )
+        self.store_checkpoint(meta, states)
 
     # ------------------------------------------------------------------
     # watchdog
@@ -781,13 +977,46 @@ class FailoverManager:
         n_block = 0
         slots_active = run_custom_slots and bool(SlotChainRegistry.slots())
         for op in entries:
-            if slots_active and op.custom_veto is None:
+            v0 = op._verdict
+            if v0 is not None and v0.speculative:
+                # The speculative tier already served this op's verdict
+                # at submit time from the SAME (persistent) mirror —
+                # keep it (the caller may have acted on it) and do only
+                # the bookkeeping its settlement would have done: the
+                # device never applied this chunk, so an admitted
+                # THREAD entry's gauge deltas must join the restore
+                # replay.
+                op._pending = None
+                if v0.admitted:
+                    n_admit += 1
+                    fb.note_unsettled_admit(op)
+                else:
+                    n_block += 1
+                    limit_app = (
+                        getattr(v0.blocked_rule, "limit_app", None)
+                        or "default"
+                    )
+                    items.append((
+                        op.resource, E.exc_name_for_code(v0.reason),
+                        limit_app, op.origin, op.acquire,
+                    ))
+                if op.trace is not None:
+                    tracer.record_admission(
+                        op.trace, op.resource, op.origin, op.context_name,
+                        v0.admitted, v0.reason, -1,
+                        op.spec_end_pc or end_pc,
+                        degraded=v0.degraded, provenance="speculative",
+                    )
+                    op.trace = None
+                continue
+            if slots_active and not op.custom_checked:
                 op.custom_veto = SlotChainRegistry.check_entry(
                     SlotEntryContext(
                         op.resource, op.context_name, op.origin,
                         op.acquire, op.prio, op.args,
                     )
                 )
+                op.custom_checked = True
             v = fb.admit(op, now)
             op.verdict = v
             op._pending = None
@@ -809,6 +1038,33 @@ class FailoverManager:
                 )
                 op.trace = None
         for g in bulk:
+            if g.spec_admitted is not None and g._admitted is not None:
+                # Bulk analog of the kept speculative verdict above.
+                g._pending = None
+                adm = g._admitted
+                rsn = g._reason
+                n_adm = int(adm.sum())
+                blocked = ~adm
+                n_admit += n_adm
+                n_block += int(blocked.sum())
+                fb.note_unsettled_admit_bulk(g, n_adm)
+                if blocked.any():
+                    for r in np.unique(rsn[blocked]):
+                        cnt = int(
+                            np.asarray(g.acquire)[blocked & (rsn == r)].sum()
+                        )
+                        items.append((
+                            g.resource, E.exc_name_for_code(int(r)),
+                            "default", g.origin, cnt,
+                        ))
+                if g.trace is not None:
+                    tracer.record_bulk(
+                        g.trace, g.resource, g.origin, g.context_name,
+                        adm, rsn, -1, end_pc, degraded=g.spec_degraded,
+                        provenance="speculative",
+                    )
+                    g.trace = None
+                continue
             if slots_active:
                 # Same shared per-distinct-acquire check as the device
                 # bulk path — a registered slot's veto must keep
@@ -839,13 +1095,23 @@ class FailoverManager:
                 g.trace = None
         for x in exits:
             if x.thr < 0:
-                fb.note_device_exit(x.rows, getattr(x, "p_rows", ()) or (), 1)
-                if x.resource is not None:
+                fb.note_device_exit(
+                    x.rows, getattr(x, "p_rows", ()) or (), -x.thr
+                )
+                if x.resource is not None and not fb.persistent:
+                    # Persistent mirrors already released at
+                    # submit_exit time (Engine routes exits to the
+                    # speculative tier synchronously).
                     fb.on_exit(x.resource, 1)
+            elif x.thr > 0:
+                # A speculative +thread gauge-compensation op caught in
+                # a degraded window: the device never saw the +n, so it
+                # joins the restore replay as an unsettled admit.
+                fb.note_unsettled_admit_rows(x.rows, x.thr)
         for gx in bulk_exits:
             if gx.thr < 0:
                 fb.note_device_exit(gx.rows, (), gx.n)
-                if gx.resource is not None:
+                if gx.resource is not None and not fb.persistent:
                     fb.on_exit(gx.resource, gx.n)
         with self._lock:
             self.counters["degraded_admits"] += n_admit
@@ -1101,6 +1367,8 @@ class FailoverManager:
             self._set_state_locked(HEALTHY, "engine reset")
             self._ckpt = None
             self._last_attempt_ms = None
+        self.fallback.clear_gauge_deltas()
+        self.fallback.end_degraded()
 
     def close(self) -> None:
         """Retire the idle watchdog waiter pool (engine shutdown) —

@@ -625,9 +625,17 @@ def apply_exit_phase(
         RT=jnp.repeat(batch.x_rt, 4),
         EXCEPTION=jnp.repeat(batch.x_err, 4),
     )
-    # min-RT tracked only for true exits (thread delta < 0), not traces.
+    # min-RT tracked only for true exits (thread delta < 0) that carry
+    # completions — not traces, and not the speculative tier's
+    # thread-gauge compensation ops (count=0, thr=±n), whose rt=0 must
+    # not write a bogus sample into the window minimum
+    # (runtime/speculative.py reconciliation).
     x_thr_f = jnp.repeat(batch.x_thr, 4)
-    x_rt_sample = jnp.where(x_thr_f < 0, jnp.repeat(batch.x_rt, 4), _I32_MAX)
+    x_rt_sample = jnp.where(
+        (x_thr_f < 0) & (jnp.repeat(batch.x_count, 4) > 0),
+        jnp.repeat(batch.x_rt, 4),
+        _I32_MAX,
+    )
     stats = apply_updates(stats, x_rows_f, x_ts_f, x_deltas, x_rt_sample, x_thr_f, x_mask)
 
     # ---- phase 1b: breaker completions (DegradeSlot.exit:67-90) ----

@@ -1,0 +1,493 @@
+"""Two-tier speculative admission: host admits, device settles,
+reconciliation bounds the drift.
+
+PR 5 proved the host can serve policy-faithful verdicts from compiled
+rule mirrors (~50k ops/s singles, ~32M rows/s bulk) — but only when the
+device was already lost. A failover path that never runs in production
+is a failover path that rots, and the latency physics point the same
+way: sync-mode admission is ~2.5 ms/entry on CPU and the TPU dispatch
+floor is ~0.3-0.4 ms/flush (PERF_NOTES), so a per-request caller will
+never get a microsecond verdict from a device round-trip. This module
+promotes the host mirror from a failure mode to the always-on **fast
+tier** of a two-tier design — the data-plane split (HashPipe, arXiv
+1611.04825; data-plane heavy hitters, arXiv 1902.06993): approximate
+decisions on the fast path, exact settlement off the critical path —
+and the reference's ``cc.fallback_to_local_when_fail`` cluster stance
+turned into a latency hierarchy:
+
+* **fast tier (host)** — ``SphU.entry``-style singles and bulk groups
+  get an immediate verdict from the persistent
+  :class:`~sentinel_tpu.runtime.failover.HostFallbackAdmitter` mirror
+  (QPS token buckets, live THREAD counters, the breaker host mirror,
+  per-value param buckets), tagged ``Verdict.speculative``;
+* **settling plane (device)** — the very same op still rides the flush
+  pipeline unmodified; the kernel re-decides it against authoritative
+  device state, which therefore keeps evolving exactly as the depth-0
+  oracle would;
+* **reconciliation (each drain)** — the settled device verdict is
+  diffed against the speculative one: an over-admit (host passed,
+  device blocked) drains the offending mirror bucket so the streak is
+  clamped; every mismatch emits a ±1 thread-gauge compensation op so
+  the device concurrency gauge tracks the callers that are ACTUALLY
+  running (a speculatively-admitted caller will exit; a
+  speculatively-blocked one never will); per-window over/under-admit
+  counts land in the TelemetryBus drift histogram and
+  ``sentinel_engine_speculative_*`` counters.
+
+Divergence is bounded twice over: structurally (the mirror consumes the
+same thresholds the kernel enforces, and clamps on every observed
+over-admit) and by an explicit valve —
+``sentinel.tpu.speculative.overadmit.max`` observed over-admits within
+one drift window suspend speculation (ops fall back to the synchronous
+device path) until the window rolls. tests/test_speculative.py pins the
+resulting max over-admit per window against the depth-0 oracle at
+pipeline depths {0,1,2}, across injected device faults and recovery.
+
+Because the mirror is persistent and continuously reconciled, a device
+failure is a **zero-transition event**: the watchdog trip merely stops
+reconciliation (settlement has no device to settle on) while the same
+buckets keep serving; recovery restarts reconciliation with no
+cold-start burst in either direction. ``FailoverManager.fallback`` IS
+this tier's mirror when the tier is enabled.
+
+Known approximations (deliberate, measured, documented in
+ARCHITECTURE.md §"Speculative admission & settlement"): ops needing
+device-only semantics — prioritized (occupy) entries, shaping pacers,
+system protection — are DECLINED by the fast tier and served
+synchronously from the device; device pass/block statistics count the
+kernel's own re-decisions, which differ from caller-visible verdicts by
+exactly the measured drift; under-admit compensation exits carry rt=0.
+
+Config keys (all declared in utils/config.py)::
+
+    sentinel.tpu.speculative.enabled          default false (opt-in)
+    sentinel.tpu.speculative.flush.batch      pending ops per async
+                                              settle dispatch
+    sentinel.tpu.speculative.overadmit.max    per-window suspension
+                                              valve (0 = off)
+    sentinel.tpu.speculative.drift.window.ms  drift accounting window
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.runtime.failover import HostFallbackAdmitter
+from sentinel_tpu.utils.config import config
+
+# AdmissionRecord.provenance values (metrics/admission_trace.py).
+PROVENANCE_DEVICE = "device"
+PROVENANCE_DEGRADED = "degraded"
+PROVENANCE_SPECULATIVE = "speculative"
+
+
+class SpeculativeAdmitter:
+    """Engine-scoped speculative fast tier (one per Engine).
+
+    Disabled (the default) every engine hook is a single attribute
+    read. Enabled, the single-entry path costs one mirror admit (~20 µs
+    on the CPU box) plus one pending-count check; settlement and
+    reconciliation ride the existing flush/drain machinery."""
+
+    def __init__(self, engine) -> None:
+        self._engine = engine
+        self.enabled = config.get_bool(config.SPECULATIVE_ENABLED, False)
+        self.flush_batch = max(
+            1, config.get_int(config.SPECULATIVE_FLUSH_BATCH, 64)
+        )
+        self.overadmit_max = max(
+            0, config.get_int(config.SPECULATIVE_OVERADMIT_MAX, 64)
+        )
+        self.window_ms = max(
+            1, config.get_int(config.SPECULATIVE_WINDOW_MS, 1000)
+        )
+        # The persistent mirror: the same compiled-host-mirror admitter
+        # PR 5 built for DEGRADED windows, run continuously. When the
+        # tier is enabled the engine aliases FailoverManager.fallback
+        # to this instance so HEALTHY and DEGRADED share ONE
+        # continuously-reconciled world.
+        self.mirror = HostFallbackAdmitter(engine, persistent=True)
+        self._lock = threading.Lock()
+        # Current drift window (engine-clock aligned) and its counts.
+        self._win_start = -1
+        self._win_over = 0
+        self._win_under = 0
+        self._suspended = False
+        self._max_window_net = 0
+        self.counters: Dict[str, int] = {
+            "spec_admits": 0,
+            "spec_blocks": 0,
+            "spec_declined": 0,
+            "reconciled": 0,
+            "over_admits": 0,
+            "under_admits": 0,
+            "comp_plus": 0,
+            "comp_minus": 0,
+            "bucket_clamps": 0,
+            "suspensions": 0,
+            "windows": 0,
+        }
+
+    # ------------------------------------------------------------------
+    # admission fast path
+    # ------------------------------------------------------------------
+    def _declinable(self, op) -> bool:
+        """Ops whose semantics only the device implements: prioritized
+        (occupy) entries, shaping-governed slots, and anything while
+        system protection is configured. Declined ops take the
+        synchronous device path — correctness over latency."""
+        return bool(op.prio) or self._declinable_slots(op.src, op.slots)
+
+    def _declinable_slots(self, src, slots) -> bool:
+        """The slot-level device-only checks shared by singles and bulk
+        (bulk groups can't be prio — submit_bulk rejects occupy): one
+        home, so a future device-only semantic can't silently apply to
+        only one path."""
+        eng = self._engine
+        if eng.system_config is not None:
+            return True
+        findex = src[0] if src is not None else eng.flow_index
+        sg = findex.shaping_gids
+        return bool(sg) and any(gid in sg for gid, _crow in slots)
+
+    def _decline(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["spec_declined"] += n
+        tele = self._engine.telemetry
+        if tele.enabled:
+            tele.note_spec_declined(n)
+
+    def try_admit(self, op, now_ms: int):
+        """Immediate host verdict for one submitted entry op, or None
+        when the tier declines (caller falls back to the device path).
+        Fills ``op.verdict`` so readers never block on the pending
+        fetch; the settled device verdict reconciles against it at
+        drain without replacing it (the caller acted on THIS one)."""
+        eng = self._engine
+        fo = eng.failover
+        degraded = fo.armed and not fo.healthy
+        with self._lock:
+            self._roll_window_locked(now_ms)
+            suspended = self._suspended
+        # Suspension only matters while HEALTHY: degraded has no better
+        # tier to fall back to — the mirror keeps serving
+        # (fill_degraded would consult the very same state anyway).
+        # Declinable ops always take the device path.
+        if (suspended and not degraded) or self._declinable(op):
+            self._decline()
+            return None
+        # Custom processor slots run at admission time on this tier —
+        # custom_checked marks the op so the chunk encode never re-runs
+        # the user hook (check_entry returns None for a PASS, so the
+        # veto field alone can't tell "passed" from "not checked").
+        from sentinel_tpu.core.slots import SlotChainRegistry, SlotEntryContext
+
+        if SlotChainRegistry.slots() and not op.custom_checked:
+            op.custom_veto = SlotChainRegistry.check_entry(
+                SlotEntryContext(
+                    op.resource, op.context_name, op.origin,
+                    op.acquire, op.prio, op.args,
+                )
+            )
+            op.custom_checked = True
+        v = self.mirror.admit(
+            op, now_ms, apply_policy=degraded, degraded=degraded,
+            speculative=True,
+        )
+        op.verdict = v
+        op.spec_end_pc = time.perf_counter()
+        with self._lock:
+            if v.admitted:
+                self.counters["spec_admits"] += 1
+            else:
+                self.counters["spec_blocks"] += 1
+        tele = eng.telemetry
+        if tele.enabled:
+            tele.note_speculative(int(v.admitted), int(not v.admitted))
+        return v
+
+    def try_admit_bulk(self, g, now_ms: int) -> bool:
+        """Immediate array verdicts for one bulk group; False when the
+        tier declines. The speculative arrays are kept on the group
+        (``spec_admitted``) for the drain-time reconcile AND installed
+        as the caller-visible results."""
+        eng = self._engine
+        fo = eng.failover
+        degraded = fo.armed and not fo.healthy
+        with self._lock:
+            self._roll_window_locked(now_ms)
+            suspended = self._suspended
+        if (suspended and not degraded) or self._declinable_slots(g.src, g.slots):
+            self._decline(g.n)
+            return False
+        from sentinel_tpu.core.slots import SlotChainRegistry
+
+        if SlotChainRegistry.slots() and g.custom_veto_mask is None:
+            SlotChainRegistry.check_bulk_entry(g)
+        adm, rsn = self.mirror.admit_bulk(
+            g, now_ms, apply_policy=degraded, speculative=True
+        )
+        g.spec_admitted = adm.copy()
+        g.spec_degraded = degraded
+        g.admitted = adm
+        g.reason = rsn
+        g.wait_ms = np.zeros(g.n, dtype=np.int32)
+        n_adm = int(adm.sum())
+        with self._lock:
+            self.counters["spec_admits"] += n_adm
+            self.counters["spec_blocks"] += g.n - n_adm
+        tele = eng.telemetry
+        if tele.enabled:
+            tele.note_speculative(n_adm, g.n - n_adm)
+        return True
+
+    # ------------------------------------------------------------------
+    # reconciliation (drain/settle path)
+    # ------------------------------------------------------------------
+    def _fold_window_locked(self) -> None:
+        """Close the open drift window; caller holds ``self._lock``.
+        The window's over-admit count lands in the telemetry drift
+        histogram and the running max the differential test reads."""
+        if self._win_start < 0:
+            return
+        # The bound is stated over NET excess admissions: an
+        # over-admit and an under-admit in the same window cancel
+        # in aggregate load (continuous-refill vs window-prefix
+        # ordering makes element-wise mismatches structural even
+        # when both planes admit exactly the threshold). The raw
+        # per-direction counts stay on the counters.
+        net = max(0, self._win_over - self._win_under)
+        self.counters["windows"] += 1
+        if net > self._max_window_net:
+            self._max_window_net = net
+        tele = self._engine.telemetry
+        if tele.enabled:
+            tele.note_spec_window(net)
+        self._win_start = -1
+        self._win_over = 0
+        self._win_under = 0
+        self._suspended = False
+
+    def _roll_window_locked(self, now_ms: int) -> None:
+        """Advance the drift window; caller holds ``self._lock``."""
+        start = now_ms - now_ms % self.window_ms
+        if start == self._win_start:
+            return
+        self._fold_window_locked()
+        self._win_start = start
+
+    def flush_window(self) -> None:
+        """Fold the open drift window without waiting for later traffic
+        to roll it — Engine.close() calls this so a final-window burst
+        still reaches the histogram and the running max instead of
+        sitting in a never-closed window forever."""
+        with self._lock:
+            self._fold_window_locked()
+
+    def _note_mismatch_locked(self, over: int, under: int) -> None:
+        self._win_over += over
+        self._win_under += under
+        self.counters["over_admits"] += over
+        self.counters["under_admits"] += under
+        if (
+            self.overadmit_max > 0
+            and self._win_over - self._win_under >= self.overadmit_max
+            and not self._suspended
+        ):
+            # The divergence valve: stop speculating until the window
+            # rolls; ops meanwhile take the synchronous device path, so
+            # per-window over-admit is hard-bounded at the valve plus
+            # the already-in-flight detection lag.
+            self._suspended = True
+            self.counters["suspensions"] += 1
+            tele = self._engine.telemetry
+            if tele.enabled:
+                tele.note_spec_suspended()
+
+    def _clamp_for(self, op, settled) -> None:
+        """Drain the mirror state that over-admitted ``op``."""
+        rule = settled.blocked_rule
+        clamped = False
+        if settled.reason == E.BLOCK_FLOW and rule is not None:
+            clamped = self.mirror.drain_bucket(rule)
+        elif settled.reason == E.BLOCK_PARAM:
+            for ps in op.p_slots:
+                if ps.grade == C.FLOW_GRADE_QPS and ps.prow >= 0:
+                    clamped = self.mirror.drain_pbucket(ps.prow) or clamped
+        # BLOCK_DEGRADE needs no clamp: the breaker mirror rides every
+        # flush while the tier is on, so the next admit reads the flip.
+        if clamped:
+            with self._lock:
+                self.counters["bucket_clamps"] += 1
+
+    def reconcile_entry(self, op, spec_v, settled) -> bool:
+        """Diff one op's speculative verdict against its settled device
+        verdict; returns the match flag (trace provenance). Mismatches
+        clamp mirrors and emit thread-gauge compensation: a
+        speculatively-admitted caller IS running and will exit (+1 now,
+        its −1 comes later); a speculatively-blocked one never ran, so
+        the device's +1 must come back out (−1, no exit will follow)."""
+        eng = self._engine
+        now = eng.clock.now_ms()
+        match = bool(spec_v.admitted) == bool(settled.admitted)
+        with self._lock:
+            self._roll_window_locked(now)
+            self.counters["reconciled"] += 1
+            if not match:
+                if spec_v.admitted:
+                    self._note_mismatch_locked(1, 0)
+                else:
+                    self._note_mismatch_locked(0, 1)
+        if not match:
+            if spec_v.admitted:
+                self._clamp_for(op, settled)
+                eng._submit_gauge_comp(op.rows, +1)
+                with self._lock:
+                    self.counters["comp_plus"] += 1
+            else:
+                eng._submit_gauge_comp(op.rows, -1)
+                with self._lock:
+                    self.counters["comp_minus"] += 1
+            tele = eng.telemetry
+            if tele.enabled:
+                tele.note_spec_drift(
+                    int(spec_v.admitted), int(not spec_v.admitted)
+                )
+        return match
+
+    def reconcile_bulk(
+        self, g, dev_admitted: np.ndarray, dev_reason: np.ndarray,
+        dev_slot_ok: Optional[np.ndarray] = None,
+    ) -> None:
+        """Vectorized bulk reconcile: mismatch counts, bucket clamps
+        (QPS flow rules on over-admits with a flow block settled;
+        per-value buckets where the settled reason is BLOCK_PARAM), and
+        one ±n thread-gauge compensation per direction. ``dev_slot_ok``
+        is the device's per-row × per-slot pass matrix (columns aligned
+        with ``g.slots``) — it narrows the flow-rule clamp to buckets
+        the device actually found violated; without it every QPS rule
+        on the group's slots would be drained for one over-admit,
+        falsely blocking traffic both planes would admit."""
+        spec = g.spec_admitted
+        if spec is None:
+            return
+        eng = self._engine
+        now = eng.clock.now_ms()
+        over_m = spec & ~dev_admitted
+        under_m = ~spec & dev_admitted
+        over = int(over_m.sum())
+        under = int(under_m.sum())
+        with self._lock:
+            self._roll_window_locked(now)
+            self.counters["reconciled"] += g.n
+            if over or under:
+                self._note_mismatch_locked(over, under)
+        if over:
+            findex = g.src[0] if g.src is not None else eng.flow_index
+            flow_m = over_m & (dev_reason == E.BLOCK_FLOW)
+            if flow_m.any():
+                bad_slot = None
+                if dev_slot_ok is not None:
+                    bad_slot = (~dev_slot_ok[flow_m]).any(axis=0)
+                clamped = False
+                for j, (gid, _crow) in enumerate(g.slots):
+                    if bad_slot is not None and (
+                        j >= bad_slot.shape[0] or not bad_slot[j]
+                    ):
+                        continue
+                    info = findex.mirror_info(gid)
+                    if info is not None and info[1] == C.FLOW_GRADE_QPS:
+                        clamped = self.mirror.drain_bucket(info[0]) or clamped
+                if clamped:
+                    with self._lock:
+                        self.counters["bucket_clamps"] += 1
+            if (dev_reason[over_m] == E.BLOCK_PARAM).any():
+                for pc in g.p_cols:
+                    rows = np.unique(
+                        pc.prow[over_m & pc.valid
+                                & (dev_reason == E.BLOCK_PARAM)]
+                    )
+                    for prow in rows.tolist():
+                        if prow >= 0:
+                            self.mirror.drain_pbucket(int(prow))
+            eng._submit_gauge_comp(g.rows, over)
+            with self._lock:
+                self.counters["comp_plus"] += over
+        if under:
+            eng._submit_gauge_comp(g.rows, -under)
+            with self._lock:
+                self.counters["comp_minus"] += under
+        if over or under:
+            tele = eng.telemetry
+            if tele.enabled:
+                tele.note_spec_drift(over, under)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def on_rules_reloaded(self) -> None:
+        """A rule reload swapped indexes AND rebuilt device dyn states:
+        retire the rule-keyed mirrors so fresh buckets mirror the fresh
+        device windows."""
+        if self.enabled:
+            self.mirror.invalidate_rule_mirrors()
+
+    def on_exit(self, resource: str, n: int = 1) -> None:
+        """Synchronous host release at submit_exit time — the live
+        THREAD counter must track real concurrency, not settle lag."""
+        self.mirror.on_exit(resource, n)
+
+    def reset(self) -> None:
+        """Engine reset: fresh mirror world + drift accounting."""
+        self.mirror.reset_world()
+        with self._lock:
+            self._win_start = -1
+            self._win_over = 0
+            self._win_under = 0
+            self._suspended = False
+            self._max_window_net = 0
+            for k in self.counters:
+                self.counters[k] = 0
+
+    # ------------------------------------------------------------------
+    # readers
+    # ------------------------------------------------------------------
+    @property
+    def suspended(self) -> bool:
+        with self._lock:
+            return self._suspended
+
+    @property
+    def max_over_admit_window(self) -> int:
+        """Worst per-window NET over-admit, INCLUDING the still-open
+        window — readers (the Prometheus gauge, the differential/chaos
+        assertions) must see a final-window burst even when no later
+        event ever rolls the window closed."""
+        with self._lock:
+            return self._max_over_admit_locked()
+
+    def _max_over_admit_locked(self) -> int:
+        live = max(0, self._win_over - self._win_under)
+        return max(self._max_window_net, live)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "enabled": self.enabled,
+                "flush_batch": self.flush_batch,
+                "overadmit_max": self.overadmit_max,
+                "window_ms": self.window_ms,
+                "suspended": self._suspended,
+                "window_over": self._win_over,
+                "window_under": self._win_under,
+                "max_over_admit_window": self._max_over_admit_locked(),
+                "counters": dict(self.counters),
+            }
+        out["mirror"] = self.mirror.snapshot()
+        return out

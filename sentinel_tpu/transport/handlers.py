@@ -444,6 +444,24 @@ def health_handler(req: CommandRequest) -> CommandResponse:
 
 
 @command_mapping(
+    "speculative",
+    "speculative admission tier: fast-path counters, drift windows,"
+    " valve state, mirror snapshot",
+)
+def speculative_handler(req: CommandRequest) -> CommandResponse:
+    """The two-tier admission view (runtime/speculative.py): how many
+    verdicts the host fast tier served, how far it drifted from device
+    settlement per window (over/under-admits, bucket clamps, gauge
+    compensations), whether the drift valve is currently suspending
+    speculation, and the live mirror state."""
+    engine = _engine()
+    out = engine.speculative.snapshot()
+    out["health"] = engine.failover.state
+    out["flush_seq"] = engine.flush_seq
+    return CommandResponse.of_json(out)
+
+
+@command_mapping(
     "traces",
     "sampled admission trace records: [?n=N][&resource=][&reason=code|name]",
 )

@@ -272,6 +272,63 @@ def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
             fc.get("probe_flushes", 0),
         )
 
+    # Speculative tier (runtime/speculative.py): fast-path verdict
+    # counters, reconciliation drift by direction, the per-window drift
+    # histogram the differential bound is stated over, and the valve
+    # state.
+    spec = getattr(engine, "speculative", None)
+    if spec is not None:
+        sc = dict(spec.counters)
+        out += _gauge(
+            f"{p}_speculative_enabled",
+            "Speculative admission tier armed (sentinel.tpu.speculative.enabled)",
+            1 if spec.enabled else 0,
+        )
+        out += ctr(
+            f"{p}_speculative_admits_total",
+            "Admissions served by the speculative host tier",
+            sc.get("spec_admits", 0),
+        )
+        out += ctr(
+            f"{p}_speculative_blocks_total",
+            "Blocks served by the speculative host tier",
+            sc.get("spec_blocks", 0),
+        )
+        out += ctr(
+            f"{p}_speculative_declined_total",
+            "Ops the speculative tier declined to the device path",
+            sc.get("spec_declined", 0),
+        )
+        out += ctr(
+            f"{p}_speculative_over_admits_total",
+            "Speculative admits the device settlement blocked",
+            sc.get("over_admits", 0),
+        )
+        out += ctr(
+            f"{p}_speculative_under_admits_total",
+            "Speculative blocks the device settlement admitted",
+            sc.get("under_admits", 0),
+        )
+        out += ctr(
+            f"{p}_speculative_suspensions_total",
+            "Drift-valve suspensions (overadmit.max reached in a window)",
+            sc.get("suspensions", 0),
+        )
+        out += _gauge(
+            f"{p}_speculative_suspended",
+            "Speculation currently suspended by the drift valve (0/1)",
+            1 if spec.suspended else 0,
+        )
+        out += _gauge(
+            f"{p}_speculative_max_over_admit_window",
+            "Max over-admits observed in any single drift window",
+            spec.max_over_admit_window,
+        )
+        out += tele.hist_spec_drift.prometheus_lines(
+            f"{p}_speculative_drift_per_window",
+            "Over-admits per closed drift window (speculative vs settled)",
+        )
+
     # Blocked-resource heavy-hitter sketch (space-saving over the
     # kernel's per-flush top-K): weight = blocked acquire sum.
     name = f"{p}_blocked_weight"

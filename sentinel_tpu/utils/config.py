@@ -130,6 +130,24 @@ class SentinelConfig:
     # Min gap (engine clock) between automatic recovery attempts from
     # the flush path; explicit try_recover() ignores it.
     FAILOVER_RETRY_MS = "sentinel.tpu.failover.retry.ms"
+    # Speculative admission tier (runtime/speculative.py): host mirrors
+    # serve the immediate verdict for single entries and bulk groups,
+    # the device flush settles authoritatively, and reconciliation at
+    # each drain bounds the drift. Opt-in — disabled costs one bool
+    # read per entry_sync/submit_bulk.
+    SPECULATIVE_ENABLED = "sentinel.tpu.speculative.enabled"
+    # Pending-op count at which a speculative entry_sync/submit triggers
+    # an async settle dispatch (bounds reconciliation lag without a
+    # blocking flush on the admission path).
+    SPECULATIVE_FLUSH_BATCH = "sentinel.tpu.speculative.flush.batch"
+    # Per-window observed over-admits (speculative admit, device block)
+    # after which the tier stops speculating until the window rolls —
+    # the divergence safety valve the differential test pins (0 = no
+    # enforcement, drift is still measured).
+    SPECULATIVE_OVERADMIT_MAX = "sentinel.tpu.speculative.overadmit.max"
+    # Drift accounting window (engine clock) for the per-window
+    # over/under-admit counters and the drift histogram.
+    SPECULATIVE_WINDOW_MS = "sentinel.tpu.speculative.drift.window.ms"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -164,6 +182,10 @@ class SentinelConfig:
         FAILOVER_CHECKPOINT_EVERY: "8",
         FAILOVER_PROBE_FLUSHES: "3",
         FAILOVER_RETRY_MS: "1000",
+        SPECULATIVE_ENABLED: "false",
+        SPECULATIVE_FLUSH_BATCH: "64",
+        SPECULATIVE_OVERADMIT_MAX: "64",
+        SPECULATIVE_WINDOW_MS: "1000",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

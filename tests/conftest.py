@@ -73,3 +73,20 @@ def engine(manual_clock):
     from sentinel_tpu.core import api
 
     return api.get_engine()
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-skip ``mesh``-marked tests when the sharded flush
+    capability is absent (parallel.mesh_unavailable_reason: older jax
+    without stable jax.shard_map, or too few devices): a capability
+    the environment lacks is a skip with a reason, not a wall of
+    ImportError failures hiding real regressions."""
+    from sentinel_tpu.parallel import mesh_unavailable_reason
+
+    reason = mesh_unavailable_reason(8)
+    if not reason:
+        return
+    skip = pytest.mark.skip(reason=reason)
+    for item in items:
+        if "mesh" in item.keywords:
+            item.add_marker(skip)

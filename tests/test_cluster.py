@@ -182,6 +182,7 @@ class TestEngineIntegration:
 
 
 class TestIciAllocation:
+    @pytest.mark.mesh
     def test_cluster_allocate_conserves_capacity(self):
         import jax
         import jax.numpy as jnp

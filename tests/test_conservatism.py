@@ -115,6 +115,7 @@ def test_overblock_rate_single_chip(manual_clock, engine):
     _assert_rate(adm_b, adm_o, checked, "single-chip")
 
 
+@pytest.mark.mesh
 def test_overblock_rate_mesh(manual_clock, engine):
     """The mesh engine vs the sequential single-chip reference: the
     sharded budget split must not add measurable conservatism on top of

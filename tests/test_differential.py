@@ -270,6 +270,7 @@ def test_random_sequential_stream_matches_oracle(seed, manual_clock, engine):
         assert stats["cur_thread_num"] == m.node.cur_thread_num, res
 
 
+@pytest.mark.mesh
 def test_random_sequential_stream_matches_oracle_on_mesh(manual_clock, engine):
     """The same differential harness against the SHARDED engine: a
     sequential stream on the 8-device mesh must still match the oracle

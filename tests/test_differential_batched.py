@@ -158,6 +158,7 @@ def test_random_batched_streams_match_oracle():
     _run_worker("single", timeout_s=1800)
 
 
+@pytest.mark.mesh
 def test_random_batched_streams_match_oracle_on_mesh():
     """Two random mesh streams, fresh process."""
     _run_worker("mesh", timeout_s=1800)

@@ -252,6 +252,7 @@ def test_flush_async_on_empty_engine_is_noop():
 
 
 @pytest.mark.slow
+@pytest.mark.mesh
 def test_flush_async_on_mesh_conserves_budget():
     """Deferred fetch over the sharded (multi-chip) kernel: budgets
     still conserved across chips, lazily materialized."""

@@ -147,6 +147,7 @@ class TestBulkEntries:
         engine.flush()
         assert g2.admitted_count == 0
 
+    @pytest.mark.mesh
     def test_bulk_on_mesh(self, manual_clock, engine):
         import sentinel_tpu as st
 

@@ -15,6 +15,7 @@ def mesh_engine(manual_clock, engine):
     return engine
 
 
+@pytest.mark.mesh
 class TestEngineMesh:
     def test_budget_conserved_through_engine_api(self, mesh_engine):
         """128 same-window entries against count=20 admit exactly 20 —
@@ -370,6 +371,12 @@ class TestEngineMesh:
                 o.verdict.admitted for o, r in zip(gm, reqs) if r["origin"] == origin
             )
             assert adm_o <= 10
+
+class TestMeshLifecycle:
+    """Capability-independent mesh API edges: enable/disable plumbing
+    that never builds a sharded kernel, so these run (and must keep
+    passing) even where ``jax.shard_map`` is absent — deliberately NOT
+    ``mesh``-marked."""
 
     def test_non_pow2_mesh_rejected(self, manual_clock, engine):
         with pytest.raises(ValueError, match="power of two"):

@@ -403,6 +403,45 @@ class TestDegradedAdmission:
         op2, v2 = victim.entry_sync("t")
         assert v2.admitted, "quarantined exit's release must be replayed"
 
+    def test_second_recovery_uses_reanchored_checkpoint(self, manual_clock):
+        """Back-to-back faults with no clean flush in between: the
+        first recovery replays op1's exit into the installed gauge and
+        clears the ledger, so the stored checkpoint must be re-anchored
+        to that post-replay world — restoring the stale pre-replay
+        checkpoint again would resurrect the already-released slot and
+        pin the THREAD gauge forever."""
+        victim = _mk_engine(manual_clock, enabled=True, ckpt_every=1,
+                            probes=1)
+        victim.set_flow_rules([
+            st.FlowRule("x", count=1e9),
+            st.FlowRule("t", grade=C.FLOW_GRADE_THREAD, count=1),
+        ])
+        inj = _inject(victim)
+        manual_clock.set_ms(1000)
+        op1 = victim.submit_entry("t")
+        victim.flush()  # op1 holds the single slot; checkpointed
+        assert op1.verdict.admitted
+        # The exit lands in a faulted window: device never sees it,
+        # only the replay ledger does.
+        inj.fail_fetch(victim.flush_seq + 1)
+        victim.submit_exit(op1.rows, rt=1, resource="t")
+        victim.flush()
+        assert victim.failover.state == "DEGRADED"
+        assert victim.failover.try_recover(), victim.failover.last_fault
+        # Second fault BEFORE any clean flush stores a new checkpoint
+        # (trip via a different resource so no fallback THREAD admit
+        # on "t" offsets the picture).
+        inj.fail_fetch(victim.flush_seq + 1)
+        victim.submit_entry("x")
+        victim.flush()
+        assert victim.failover.state == "DEGRADED"
+        assert victim.failover.try_recover(), victim.failover.last_fault
+        manual_clock.set_ms(1100)
+        op2, v2 = victim.entry_sync("t")
+        assert v2.admitted, (
+            "second restore must see the re-anchored post-replay gauge"
+        )
+
     def test_fallback_thread_admit_seeds_restored_gauge(self, manual_clock):
         """A THREAD entry admitted by the fallback and still in flight
         at recovery must be seeded into the restored gauge: its
@@ -670,6 +709,92 @@ class TestChaosSoak:
         victim.drain()
         assert all(op.verdict is not None and not op.verdict.degraded
                    for op in ops)
+
+    def test_speculative_chaos_interleaved_faults_soak(self, manual_clock):
+        """PR 6 chaos coverage: with the speculative tier ON and
+        failover armed, dispatch/fetch faults injected mid-
+        reconciliation (between speculative admits and their settles,
+        at every health state) must never surface a raw exception,
+        never push any drift window past the pinned bound, and never
+        leak THREAD gauge entries — after quiesce the device
+        concurrency gauge and the mirror's live counter are both
+        exactly zero."""
+        overadmit_max = 16
+        flush_every = 6
+        config.set(config.SPECULATIVE_ENABLED, "true")
+        config.set(config.SPECULATIVE_FLUSH_BATCH, "10000")
+        config.set(config.SPECULATIVE_OVERADMIT_MAX, str(overadmit_max))
+        config.set(config.SPECULATIVE_WINDOW_MS, "1000")
+        victim = _mk_engine(manual_clock, enabled=True, ckpt_every=1,
+                            probes=1, retry_ms=10**9, depth=1)
+        victim.set_flow_rules([
+            st.FlowRule("q", count=5),
+            st.FlowRule("t", grade=C.FLOW_GRADE_THREAD, count=3),
+        ])
+        inj = _inject(victim)
+        rng = np.random.default_rng(23)
+        live = []  # admitted THREAD entries not yet exited
+        n_since_flush = 0
+        t = 1000
+        for r in range(30):
+            manual_clock.set_ms(t)
+            if rng.random() < 0.35:
+                seq = victim.flush_seq + int(rng.integers(1, 3))
+                if rng.random() < 0.5:
+                    inj.fail_fetch(seq)
+                else:
+                    inj.fail_dispatch(seq)
+            for _ in range(int(rng.integers(2, 7))):
+                _op, v = victim.entry_sync("q")
+                assert v is not None
+                n_since_flush += 1
+            for _ in range(int(rng.integers(1, 4))):
+                op, v = victim.entry_sync("t")
+                assert v is not None
+                if v.admitted:
+                    live.append((op, v))
+                n_since_flush += 1
+            # Exits of a random prefix of the live set interleave with
+            # the faults — the reconciliation-mid-fault surface.
+            n_exit = int(rng.integers(0, len(live) + 1))
+            for op, v in live[:n_exit]:
+                victim.submit_exit(op.rows, rt=1, resource="t",
+                                   speculative=v.speculative)
+            live = live[n_exit:]
+            if n_since_flush >= flush_every or rng.random() < 0.5:
+                victim.flush()  # must never raise
+                n_since_flush = 0
+            if victim.failover.state == "DEGRADED" and rng.random() < 0.5:
+                inj.clear()
+                assert victim.failover.try_recover(), (
+                    victim.failover.last_fault
+                )
+            t += int(rng.integers(100, 500))
+        # Quiesce: stop faults, recover, drain everything, exit the
+        # stragglers, and give the compensation ops a settle flush.
+        inj.clear()
+        if victim.failover.state != "HEALTHY":
+            assert victim.failover.try_recover(), victim.failover.last_fault
+        for op, v in live:
+            victim.submit_exit(op.rows, rt=1, resource="t",
+                               speculative=v.speculative)
+        victim.flush()
+        victim.drain()
+        victim.flush()
+        victim.drain()
+        # Pinned drift bound: the valve halts speculation at
+        # overadmit_max observed over-admits per window; verdicts
+        # already in flight can still settle as over-admits, bounded by
+        # the flush cadence times the pipeline depth + 1.
+        lag = flush_every * 2
+        assert (
+            victim.speculative.max_over_admit_window <= overadmit_max + lag
+        ), victim.speculative.snapshot()
+        # No THREAD gauge leak: device gauge and host mirror both zero.
+        stats = victim.cluster_node_stats("t")
+        assert stats["cur_thread_num"] == 0, stats
+        mirror_threads = victim.speculative.mirror.snapshot()["live_threads"]
+        assert mirror_threads.get("t", 0) == 0, mirror_threads
 
     def test_failover_overhead_guard(self, manual_clock):
         """Armed-but-healthy overhead stays bounded (the disarmed

@@ -98,6 +98,7 @@ def _sharded_fixture(n_devices=8, n_rules=4, n_rows=16, per_chip=16, count=20.0,
     return jitted, state, batch
 
 
+@pytest.mark.mesh
 class TestClusterBudgetConservation:
     def test_8x16_entries_count20_admit_exactly_20(self):
         from sentinel_tpu.metrics.events import MetricEvent
@@ -135,6 +136,7 @@ class TestClusterBudgetConservation:
         assert int(np.asarray(result.admitted).sum()) == 128
 
 
+@pytest.mark.mesh
 class TestThreadGradeConservation:
     def test_thread_grade_counts_entries_not_acquire(self):
         """THREAD grade spends 1 budget unit per entry (the gauge rises
@@ -173,6 +175,7 @@ class TestThreadGradeConservation:
         assert int(np.asarray(result.admitted).sum()) == 0
 
 
+@pytest.mark.mesh
 class TestBudgetWithBreaker:
     def test_half_open_probe_stays_within_grant(self):
         """Budget is allocated at the flow level, so a breaker in
@@ -222,6 +225,7 @@ class TestBudgetWithBreaker:
         )
 
 
+@pytest.mark.mesh
 def test_dryrun_multichip_8():
     from __graft_entry__ import dryrun_multichip
 

@@ -394,6 +394,7 @@ class TestOccupyMesh:
     chips borrow at most maxCount − waiting in total, and the merged
     future slab holds exactly the granted tokens."""
 
+    @pytest.mark.mesh
     def test_borrow_conserved_across_mesh(self):
         from sentinel_tpu.metrics.nodes import SECOND_CFG, make_stats
         from sentinel_tpu.models.rules import FlowRule

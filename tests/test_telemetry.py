@@ -216,14 +216,10 @@ class TestBlockedSketch:
         engine._fold_blocked_recount([op for op in ops if op is not None], [])
         assert engine.telemetry.last_blocked_topk == device_topk
 
+    @pytest.mark.mesh
     def test_mesh_flush_feeds_sketch(self, manual_clock, engine):
         """The sharded path has no device fold; the host recount must
-        still populate the sketch (skipped where this environment's
-        jax lacks shard_map, like the other mesh tests)."""
-        try:
-            from jax import shard_map  # noqa: F401
-        except ImportError:
-            pytest.skip("jax.shard_map unavailable")
+        still populate the sketch."""
         st.flow_rule_manager.load_rules([st.FlowRule("ms", count=4)])
         engine.enable_mesh(8)
         try:

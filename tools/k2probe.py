@@ -209,6 +209,43 @@ def main() -> None:
         print(f"[k2probe] engine pipeline stage skipped: {exc}",
               file=sys.stderr)
 
+    # --- speculative single-entry admission (host fast tier) ----------
+    # entry_sync with the speculative tier on: the verdict comes from
+    # the host mirror, no device round-trip on the timed path (the
+    # settle flush runs between timed batches). p50/p99 wall per entry
+    # — the sub-100 µs per-request story vs engine_flush_depth0's
+    # multi-ms device round-trip.
+    try:
+        from sentinel_tpu.models.rules import FlowRule
+        from sentinel_tpu.runtime.engine import Engine
+        from sentinel_tpu.utils.config import config as _cfg
+
+        _cfg.set(_cfg.SPECULATIVE_ENABLED, "true")
+        _cfg.set(_cfg.SPECULATIVE_FLUSH_BATCH, "100000")
+        try:
+            seng = Engine(initial_rows=1024)
+            seng.set_flow_rules(
+                [FlowRule(resource=f"s{i}", count=1e9) for i in range(8)]
+            )
+            for i in range(64):
+                seng.entry_sync(f"s{i % 8}")
+            seng.flush()  # warm settle shape
+            lats = []
+            for r in range(args.iters):
+                for i in range(512):
+                    t0 = time.perf_counter()
+                    seng.entry_sync(f"s{i % 8}")
+                    lats.append(time.perf_counter() - t0)
+                seng.flush()  # settle + reconcile between timed batches
+            seng.drain()
+            lats.sort()
+            report("spec_entry_p50", lats[len(lats) // 2])
+            report("spec_entry_p99", lats[int(len(lats) * 0.99)])
+        finally:
+            _cfg.set(_cfg.SPECULATIVE_ENABLED, "false")
+    except Exception as exc:
+        print(f"[k2probe] speculative stage skipped: {exc}", file=sys.stderr)
+
     # --- isolated sorts over the flat slot array -----------------------
     for k in (1, 2):
         size = n * k
