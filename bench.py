@@ -536,11 +536,54 @@ def _run_speculative_stage(n_rules: int, n_ops: int, iters: int) -> dict:
     lat.sort()
     p50 = lat[len(lat) // 2] * 1e6
     p99 = lat[int(len(lat) * 0.99)] * 1e6
+
+    # --- system-gate column (PR 7): the same timed loop with a system
+    # rule configured — a wide-open QPS threshold, so the number is the
+    # host gate's OVERHEAD on the fast path, not blocking behavior.
+    from sentinel_tpu.models import constants as _C
+    from sentinel_tpu.rules.system_manager import SystemConfig
+
+    eng.start_auto_flush()
+    eng.set_system_config(SystemConfig(qps=float(n_ops) * 100.0))
+    lat_sys: list[float] = []
+    for name in names:
+        ta = time.perf_counter()
+        eng.entry_sync(name, entry_type=_C.EntryType.IN)
+        lat_sys.append(time.perf_counter() - ta)
+    eng.stop_auto_flush()
+    eng.flush()
+    eng.drain()
+    eng.set_system_config(None)
+    lat_sys.sort()
+    sys_p50 = lat_sys[len(lat_sys) // 2] * 1e6
+    sys_p99 = lat_sys[int(len(lat_sys) * 0.99)] * 1e6
+
+    # --- shed column (PR 7): verdict latency of the ingest valve's
+    # BLOCK_SHED fast path (runtime/ingest.py) — the "fast distinct
+    # verdict under saturation" number.
+    from sentinel_tpu.runtime.ingest import IngestValve
+
+    config.set(config.INGEST_DEADLINE_MS, "1")
+    eng.ingest = IngestValve(eng)
+    eng.ingest.force_latency_ms(1000.0)  # everything sheds
+    lat_shed: list[float] = []
+    for name in names[: max(1, min(len(names), 4096))]:
+        ta = time.perf_counter()
+        _op, v = eng.entry_sync(name)
+        lat_shed.append(time.perf_counter() - ta)
+    config.set(config.INGEST_DEADLINE_MS, "0")
+    shed_total = eng.ingest.counters["shed_entries"]
+    eng.ingest = IngestValve(eng)
+    lat_shed.sort()
+    shed_p50 = lat_shed[len(lat_shed) // 2] * 1e6
+    shed_p99 = lat_shed[int(len(lat_shed) * 0.99)] * 1e6
+
     snap = eng.speculative.snapshot()
     c = snap["counters"]
     _log(
         f"speculative stage done: p50 {p50:.1f} µs p99 {p99:.1f} µs "
-        f"({n_ops / dt:,.0f} ops/s incl. settles; "
+        f"(system-gated p50 {sys_p50:.1f} µs, shed p50 {shed_p50:.1f} µs; "
+        f"{n_ops / dt:,.0f} ops/s incl. settles; "
         f"over {c['over_admits']} under {c['under_admits']} "
         f"across {c['windows']} windows, max/window "
         f"{snap['max_over_admit_window']})"
@@ -555,6 +598,13 @@ def _run_speculative_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         "spec_windows": c["windows"],
         "spec_max_over_admit_window": snap["max_over_admit_window"],
         "spec_declined": c["spec_declined"],
+        "spec_shaped": c["spec_shaped"],
+        "spec_system_blocks": c["spec_system_blocks"],
+        "spec_entry_sys_p50_us": round(sys_p50, 2),
+        "spec_entry_sys_p99_us": round(sys_p99, 2),
+        "shed_entry_p50_us": round(shed_p50, 2),
+        "shed_entry_p99_us": round(shed_p99, 2),
+        "shed_total": shed_total,
     }
 
 
@@ -642,6 +692,11 @@ def _run_stage(n_rules: int, n_entries: int, iters: int) -> dict:
         "unit": "entries/sec",
         "vs_baseline": round(vs, 4),
         "platform": jax.default_backend(),
+        # Hardware-truth header: the BENCH trajectory must be able to
+        # tell CPU liveness runs from real TPU numbers without reading
+        # the log (round-3 lesson, hardened here).
+        "device_kind": jax.devices()[0].device_kind,
+        "jax_version": jax.__version__,
         "n_rules": n_rules,
         "n_entries": n_entries,
         "flush_ms": round(dt * 1e3, 4),

@@ -93,6 +93,12 @@ BLOCK_FAILOVER = 7
 # Host-side custom slot veto (never appears in device tensors; the
 # engine attributes it when a registered ProcessorSlot blocked the op).
 BLOCK_CUSTOM = 6
+# Engine ingest self-protection (runtime/ingest.py): the op was SHED at
+# submit time — pending queues at their bound or the estimated verdict
+# latency past the configured deadline. Never a rule verdict and never
+# enqueued: the distinct code keeps load-shedding tellable from policy
+# blocks in logs, traces and metrics.
+BLOCK_SHED = 8
 
 
 class CustomBlockError(BlockError):
@@ -112,6 +118,15 @@ class FailoverBlockError(BlockError):
     resource's ``sentinel.tpu.failover.policy`` says shed load."""
 
 
+class IngestShedError(BlockError):
+    """The engine's ingest valve shed this op at submit time
+    (``sentinel.tpu.ingest.*`` — queue bound hit or verdict deadline
+    unmeetable). Retry-able by design: shedding is overload control,
+    not a policy decision about the caller."""
+
+    block_type = "IngestShed"
+
+
 _ERROR_BY_CODE = {
     BLOCK_FLOW: FlowBlockError,
     BLOCK_DEGRADE: DegradeBlockError,
@@ -120,6 +135,7 @@ _ERROR_BY_CODE = {
     BLOCK_PARAM: ParamFlowBlockError,
     BLOCK_CUSTOM: CustomBlockError,
     BLOCK_FAILOVER: FailoverBlockError,
+    BLOCK_SHED: IngestShedError,
 }
 
 # The ONE home of the block-code → exception-name mapping (the
@@ -136,6 +152,7 @@ BLOCK_EXC_NAMES = {
     BLOCK_PARAM: "ParamFlowException",
     BLOCK_CUSTOM: "CustomBlockException",
     BLOCK_FAILOVER: "FailoverException",
+    BLOCK_SHED: "IngestShedException",
 }
 
 

@@ -237,6 +237,12 @@ class TelemetryBus:
             "spec_over_admits": 0,
             "spec_under_admits": 0,
             "spec_suspensions": 0,
+            # Fast-tier coverage extensions (PR 7): shaped ops served
+            # host-side and host system-gate blocks.
+            "spec_shaped": 0,
+            "spec_system_blocks": 0,
+            # Ingest valve (runtime/ingest.py): ops shed at submit.
+            "ingest_shed": 0,
         }
         # Bounded ring of health transitions (now_ms is engine-clock
         # relative ms): the flight-recorder view of the failover state
@@ -383,6 +389,18 @@ class TelemetryBus:
         with self._lock:
             self.counters["spec_suspensions"] += 1
 
+    def note_spec_shaped(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["spec_shaped"] += n
+
+    def note_spec_system_block(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["spec_system_blocks"] += n
+
+    def note_ingest_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["ingest_shed"] += n
+
     def fold_blocked_topk(self, pairs: Sequence[Tuple[str, int]]) -> None:
         """Fold one flush's device top-K (already name-resolved) into
         the running space-saving summary."""
@@ -446,6 +464,9 @@ class TelemetryBus:
             spec = getattr(engine, "speculative", None)
             if spec is not None and spec.enabled:
                 out["speculative"] = spec.snapshot()
+            valve = getattr(engine, "ingest", None)
+            if valve is not None and valve.armed:
+                out["ingest"] = valve.snapshot()
             pindex = getattr(engine, "param_index", None)
             if pindex is not None and hasattr(pindex, "cache_stats"):
                 out["param_cache"] = pindex.cache_stats()

@@ -68,6 +68,42 @@ class FlowRuleDynState(NamedTuple):
     last_filled_time: jax.Array  # int32 [NR]
 
 
+def _cost1_ms(count: float) -> int:
+    """The acquire==1 rate-limiter cost: Java Math.round(1.0/count*1000)
+    in float64 (Math.round is floor(x+0.5), not round-half-even; int()
+    truncates = floor for positives). ONE home shared by the device
+    table build and the host shaping mirror — the acquire==1 pacer's
+    bit-exact cross-plane parity depends on the two reading the same
+    integer."""
+    return int(1.0 / count * 1000 + 0.5)
+
+
+def _warmup_constants(r: FlowRule, cold_factor: int) -> Tuple[int, int, float, int]:
+    """Guava SmoothWarmingUp-derived constants, computed exactly as the
+    reference does (WarmUpController.construct, reference: controller/
+    WarmUpController.java:84-107):
+
+    *   warningToken = (int)(warmupSec * count) / (coldFactor-1)
+        [int cast of the product, then INTEGER division]
+    *   maxToken = warningToken + (int)(2*warmupSec*count/(1+coldFactor))
+    *   slope = (coldFactor - 1) / count / (maxToken - warningToken)
+    *   refill gate: passQps < (int)count / coldFactor
+        ((int) binds to count; then integer division).
+
+    The ONE home for both the device table build and the host shaping
+    mirror (mirror_shaping_info) — the same constants on both planes is
+    what makes the mirror's warm-up ramp faithful."""
+    cf = cold_factor
+    warning = int(r.warm_up_period_sec * r.count) // (cf - 1)
+    max_tok = warning + int(2 * r.warm_up_period_sec * r.count / (1.0 + cf))
+    slope = (
+        (cf - 1.0) / r.count / (max_tok - warning)
+        if r.count > 0 and max_tok > warning
+        else 0.0
+    )
+    return warning, max_tok, slope, int(r.count) // cf
+
+
 @dataclass
 class CompiledFlowRule:
     gid: int
@@ -151,35 +187,18 @@ class FlowIndex:
             if r.control_behavior != C.CONTROL_BEHAVIOR_DEFAULT:
                 self.has_shaping = True
             if r.count > 0:
-                # Java Math.round(1.0 * 1 / count * 1000) in float64
-                # (Math.round is floor(x + 0.5), not round-half-even;
-                # int() truncates = floor for positives).
-                cost1[cr.gid] = int(1.0 / r.count * 1000 + 0.5)
+                cost1[cr.gid] = _cost1_ms(r.count)
             if r.control_behavior in (
                 C.CONTROL_BEHAVIOR_WARM_UP,
                 C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER,
             ):
-                # Guava SmoothWarmingUp-derived constants, computed exactly
-                # as the reference does (WarmUpController.construct,
-                # reference: controller/WarmUpController.java:84-107):
-                #   warningToken = (int)(warmupSec * count) / (coldFactor-1)
-                #     [int cast of the product, then INTEGER division]
-                #   maxToken = warningToken + (int)(2*warmupSec*count/(1+coldFactor))
-                #   slope = (coldFactor - 1) / count / (maxToken - warningToken)
-                cf = self.cold_factor
-                warning = int(r.warm_up_period_sec * r.count) // (cf - 1)
-                max_tok = warning + int(2 * r.warm_up_period_sec * r.count / (1.0 + cf))
-                slope = (
-                    (cf - 1.0) / r.count / (max_tok - warning)
-                    if r.count > 0 and max_tok > warning
-                    else 0.0
+                warning, max_tok, slope, refill = _warmup_constants(
+                    r, self.cold_factor
                 )
                 w_warn[cr.gid] = warning
                 w_max[cr.gid] = max_tok
                 w_slope[cr.gid] = slope
-                # coolDownTokens refill gate: passQps < (int)count / coldFactor
-                # ((int) binds to count; then integer division).
-                w_refill[cr.gid] = int(r.count) // cf
+                w_refill[cr.gid] = refill
         return FlowTableDevice(
             grade=jnp.array(grade, dtype=jnp.int32),
             count=jnp.array(count, dtype=jnp.float32),
@@ -321,5 +340,41 @@ class FlowIndex:
                 return None
             hit = cache[gid] = (
                 rule, rule.grade, float(rule.count), 1000.0,
+            )
+        return hit
+
+    def mirror_shaping_info(self, gid: int):
+        """Host-mirror compilation hook for shaping-governed rules
+        (runtime/speculative.py via failover.HostFallbackAdmitter):
+        ``(rule, behavior, count, max_queueing_time_ms, cost1_ms,
+        warning_token, max_token, slope, refill_threshold)`` for one
+        gid, or None for non-shaping/unknown gids. ``cost1_ms`` is the
+        same host-precomputed exact int the device table carries — the
+        acquire==1 pacer cost is therefore bit-identical on both
+        planes. Cached once per index, like :meth:`mirror_info`."""
+        cache = getattr(self, "_shaping_mirror_cache", None)
+        if cache is None:
+            cache = self._shaping_mirror_cache = {}
+        hit = cache.get(gid)
+        if hit is None:
+            if gid not in self.shaping_gids:
+                return None
+            rule = self.rule_of_gid(gid)
+            if rule is None:
+                return None
+            cost1 = _cost1_ms(rule.count) if rule.count > 0 else 0
+            warning = max_tok = refill = 0
+            slope = 0.0
+            if rule.control_behavior in (
+                C.CONTROL_BEHAVIOR_WARM_UP,
+                C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER,
+            ):
+                warning, max_tok, slope, refill = _warmup_constants(
+                    rule, self.cold_factor
+                )
+            hit = cache[gid] = (
+                rule, rule.control_behavior, float(rule.count),
+                int(rule.max_queueing_time_ms), cost1,
+                float(warning), float(max_tok), float(slope), float(refill),
             )
         return hit

@@ -43,10 +43,12 @@ FlowIndex.make_dyn_state.
 
 from __future__ import annotations
 
+import math
 from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.rules.flow_table import FlowRuleDynState, FlowTableDevice
@@ -309,3 +311,154 @@ def run_shaping(
     ok_out = jnp.ones((s,), dtype=bool).at[p_s].set(ok_s)
     wait_out = jnp.zeros((s,), dtype=jnp.int32).at[p_s].set(wait_s)
     return new_dyn, ok_out, wait_out
+
+
+# ----------------------------------------------------------------------
+# Host mirror of the shaping controllers (speculative fast tier)
+# ----------------------------------------------------------------------
+# The speculative tier (runtime/speculative.py) serves shaped resources
+# from a persistent host mirror instead of declining them to the sync
+# device path; the mirror's per-op decision lives HERE, next to the
+# kernel recurrence it mirrors, so the two transition functions can
+# only drift in one reviewed place. State (one mutable record per rule)
+# lives on failover.HostFallbackAdmitter; these are pure-ish functions
+# over that record. The device settles the very same ops and the mirror
+# re-anchors to the settled ``latestPassedTime`` at every drain.
+
+
+def mirror_pacer_cost(acquire: int, count: float, cost1_ms: int) -> int:
+    """Host twin of :func:`_pacer_cost` — ONE cost formula. The
+    ubiquitous acquire==1 case returns the host-precomputed exact int
+    ``cost1_ms`` (bit-exact with the kernel, which reads the same
+    column); generic acquire replicates the kernel's float32 math so a
+    boundary-rounding divergence cannot admit on one plane and block on
+    the other."""
+    if acquire == 1:
+        return int(cost1_ms)
+    acq = np.float32(acquire)
+    cnt = np.float32(max(float(count), 1e-9))
+    return int(np.floor(np.float32(np.float32(acq / cnt) * np.float32(1000.0))
+                        + np.float32(0.5)))
+
+
+def mirror_shaping_decide(st, info, ts: int, acquire: int) -> Tuple[bool, int]:
+    """One host decision + state update for a shaping-governed slot,
+    mirroring :func:`_transition` step for step (syncToken refill,
+    warm-up warning line, pacer cost/queueing). ``st`` is the mutable
+    per-rule mirror record (failover._HostShaping: ``latest`` /
+    ``stored`` / ``lastfill`` plus its pass counters); ``info`` is
+    FlowIndex.mirror_shaping_info's static tuple. Returns
+    ``(ok, wait_ms)``; state advances exactly when the kernel's would
+    (a pacer grant advances ``latest`` even if a sibling slot later
+    vetoes the entry — the caller sequences the stages to match)."""
+    (_rule, behavior, count, maxq_ms, cost1_ms,
+     warn, maxtok, slope, refill_thr) = info
+
+    is_wu = behavior in (
+        C.CONTROL_BEHAVIOR_WARM_UP, C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER
+    )
+    if is_wu:
+        # --- syncToken (once per second; consumes prev-second pass),
+        # in float32 elementwise like the kernel — a float64 floor can
+        # land one token lower than the f32 one at product boundaries,
+        # flipping the cold/warm classification between planes ---
+        sec = ts - ts % 1000
+        if sec > st.lastfill:
+            prevq = float(st.pass_prev)
+            refill_ok = st.stored < warn or (
+                st.stored > warn and prevq < refill_thr
+            )
+            if refill_ok:
+                elapsed = np.float32(sec - st.lastfill)
+                refilled = np.floor(np.float32(
+                    np.float32(st.stored)
+                    + np.float32(np.float32(elapsed * np.float32(count))
+                                 / np.float32(1000.0))
+                ))
+                st.stored = float(min(refilled, np.float32(maxtok)))
+            st.stored = float(np.maximum(
+                np.float32(st.stored) - np.float32(prevq), np.float32(0.0)
+            ))
+            st.lastfill = sec
+
+    # --- warm-up admitted-QPS above the warning line (float32, like
+    # the kernel — a float64 warning line could round the boundary
+    # differently) ---
+    above = np.float32(max(st.stored - warn, 0.0))
+    inv = np.float32(
+        above * np.float32(slope)
+        + np.float32(1.0) / np.float32(max(float(count), 1e-9))
+    )
+    warning_qps = float(np.nextafter(np.float32(np.float32(1.0) / inv),
+                                     np.float32(np.inf)))
+    cold = st.stored >= warn
+
+    if behavior == C.CONTROL_BEHAVIOR_WARM_UP:
+        # passQps = floor(windowed pass / interval_sec), same rolling
+        # LeapArray validity as the kernel's window_sums input.
+        from sentinel_tpu.metrics import nodes as _ncfg
+
+        interval_sec = _ncfg.SECOND_CFG.interval_ms / 1000.0
+        passq = float(math.floor(st.passq(ts) / interval_sec))
+        limit = warning_qps if cold else float(count)
+        return passq + acquire <= limit, 0
+
+    # --- pacer behaviors (RATE_LIMITER / WARM_UP_RATE_LIMITER) ---
+    if acquire <= 0:
+        return True, 0  # acquire<=0 always passes, no state change
+    if count <= 0:
+        return False, 0  # pacer_ok requires count > 0
+    cost = mirror_pacer_cost(acquire, count, cost1_ms)
+    if behavior == C.CONTROL_BEHAVIOR_WARM_UP_RATE_LIMITER and cold:
+        cost = int(np.floor(
+            np.float32(np.float32(np.float32(acquire)
+                                  / np.float32(warning_qps))
+                       * np.float32(1000.0))
+            + np.float32(0.5)
+        ))
+    expected = st.latest + cost
+    if expected <= ts:
+        st.latest = ts  # immediate grant pins latest to NOW, not +=cost
+        return True, 0
+    wait = expected - ts
+    if wait <= maxq_ms:
+        st.latest += cost
+        return True, int(wait)
+    return False, 0
+
+
+def mirror_pacer_bulk(
+    latest0: int, count: float, maxq_ms: int, cost: int, ts: int,
+    ranks: "np.ndarray",
+) -> Tuple["np.ndarray", "np.ndarray", int]:
+    """Closed-form host pacer for one bulk group's RATE_LIMITER slot —
+    the host twin of the kernel's ``rounds == -1`` rank path (same
+    preconditions: ONE timestamp, ONE acquire >= 1 per row, plain
+    RATE_LIMITER; the speculative tier's predicate owns that check).
+    ``ranks`` is the 1-indexed grant rank of each still-live row.
+    Returns ``(ok, wait_ms, latest')``."""
+    n = ranks.shape[0]
+    if count <= 0:
+        return (np.zeros(n, dtype=bool), np.zeros(n, dtype=np.int64),
+                latest0)
+    big = 1 << 30
+    imm0 = latest0 + cost <= ts
+    if cost > 0:
+        g_imm = 1 + maxq_ms // cost
+        g_queue = max((ts + maxq_ms - latest0) // cost, 0)
+    else:
+        g_imm = big
+        g_queue = big if latest0 - ts <= maxq_ms else 0
+    cap = g_imm if imm0 else g_queue
+    ok = ranks <= cap
+    if imm0:
+        wait = (ranks.astype(np.int64) - 1) * cost
+    else:
+        wait = latest0 + ranks.astype(np.int64) * cost - ts
+    wait = np.where(ok & (wait > 0), wait, 0)
+    granted = int(min(int(ranks.max(initial=0)), cap))
+    if granted > 0:
+        latest = ts + (granted - 1) * cost if imm0 else latest0 + granted * cost
+    else:
+        latest = latest0
+    return ok, wait, int(latest)

@@ -752,6 +752,12 @@ class Engine:
         self.speculative = SpeculativeAdmitter(self)
         if self.speculative.enabled:
             self.failover.fallback = self.speculative.mirror
+        # Ingest self-protection valve (runtime/ingest.py): bounded
+        # pending queues + deadline-aware shedding. Disarmed by default
+        # — one attribute read per submit.
+        from sentinel_tpu.runtime.ingest import IngestValve
+
+        self.ingest = IngestValve(self)
         # True when a close()/stop could not join a worker thread in
         # time — the shutdown LOOKED clean but leaked a live thread.
         self.closed_dirty = False
@@ -954,14 +960,15 @@ class Engine:
                 cur_load=jnp.float32(-1.0),
                 cur_cpu=jnp.float32(-1.0),
             )
+        cur_load, cur_cpu = system_sampler.read()
         return SystemDevice(
             qps=jnp.float32(thr(cfg.qps)),
             max_thread=jnp.float32(thr(cfg.max_thread)),
             max_rt=jnp.float32(thr(cfg.max_rt)),
             load_threshold=jnp.float32(cfg.highest_system_load),
             cpu_threshold=jnp.float32(cfg.highest_cpu_usage),
-            cur_load=jnp.float32(system_sampler.load),
-            cur_cpu=jnp.float32(system_sampler.cpu),
+            cur_load=jnp.float32(cur_load),
+            cur_cpu=jnp.float32(cur_cpu),
         )
 
     # ------------------------------------------------------------------
@@ -1017,6 +1024,12 @@ class Engine:
         place and the drain reconciles instead of racing it."""
         if not self.enabled:
             return None
+        if self.ingest.armed:
+            cause = self.ingest.check_entry(1)
+            if cause is not None:
+                return self._shed_entry(
+                    resource, context_name, origin, acquire, cause
+                )
         # Slot resolution happens here against the current tables; if a
         # rule reload swaps any index before this op flushes, the flush
         # re-resolves it against the snapshot it will actually be
@@ -1061,6 +1074,65 @@ class Engine:
         if over:
             self.flush()  # flush-on-size: the pending buffer is bounded
         return op
+
+    def _shed_entry(
+        self, resource: str, context_name: str, origin: str, acquire: int,
+        cause: str,
+    ) -> _EntryOp:
+        """Build a never-enqueued op carrying a fast BLOCK_SHED verdict
+        (runtime/ingest.py tripped at submit): the caller sees the same
+        op/verdict surface as any blocked entry, with full provenance —
+        a trace record (``provenance="shed"``), a block-log row under
+        IngestShedException, nothing on the device and nothing queued.
+        Exits/traces are never shed, so no gauge ever charges."""
+        op = _EntryOp(
+            resource=resource, ts=self.clock.now_ms(), acquire=acquire,
+            rows=(-1, -1, -1, -1), slots=[],
+            context_name=context_name, origin=origin,
+        )
+        op.verdict = Verdict(
+            admitted=False, reason=E.BLOCK_SHED, wait_ms=0,
+            blocked_rule=None, limit_type=cause,
+        )
+        tracer = self.admission_trace
+        if tracer.enabled:
+            tracer.record_admission(
+                tracer.make_tag(), resource, origin, context_name,
+                False, E.BLOCK_SHED, -1, time.perf_counter(),
+                provenance="shed",
+            )
+        self.block_log.log_blocked(
+            resource, E.BLOCK_SHED, origin=origin, count=acquire
+        )
+        return op
+
+    def _shed_bulk(
+        self, resource: str, n: int, context_name: str, origin: str,
+        acquire, cause: str,
+    ) -> BulkOp:
+        """Bulk analog of :meth:`_shed_entry`: dense all-shed arrays,
+        never enqueued."""
+        acq_col = self._bulk_col(acquire, n, 1)
+        g = BulkOp(
+            resource=resource, n=n,
+            ts=np.full(n, self.clock.now_ms(), dtype=np.int32),
+            acquire=acq_col, rows=(-1, -1, -1, -1), slots=[], d_gids=[],
+            auth_ok=True, context_name=context_name, origin=origin,
+        )
+        g.admitted = np.zeros(n, dtype=bool)
+        g.reason = np.full(n, E.BLOCK_SHED, dtype=np.int32)
+        g.wait_ms = np.zeros(n, dtype=np.int32)
+        tracer = self.admission_trace
+        if tracer.enabled:
+            tracer.record_bulk(
+                tracer.make_tag(), resource, origin, context_name,
+                g._admitted, g._reason, -1, time.perf_counter(),
+                provenance="shed",
+            )
+        self.block_log.log_blocked(
+            resource, E.BLOCK_SHED, origin=origin, count=int(acq_col.sum())
+        )
+        return g
 
     def _resolve_entry_locked(
         self, findex, dindex, pindex, resource, context_name, origin,
@@ -1125,6 +1197,25 @@ class Engine:
         """
         if not self.enabled:
             return [None] * len(requests)
+        if self.ingest.armed:
+            # Whole-batch shed only when the queue is ALREADY saturated
+            # or the deadline is unmeetable — a large batch on an idle
+            # engine must not shed (flush-on-size drains the queue
+            # mid-batch, so only the live depth matters); the fast loop
+            # below breaks out at the bound and the per-op fallback
+            # path sheds exactly the overflow.
+            cause = self.ingest.check_entry(1)
+            if cause is not None:
+                return [
+                    self._shed_entry(
+                        req.get("resource", ""),
+                        req.get("context_name", C.CONTEXT_DEFAULT_NAME),
+                        req.get("origin", ""),
+                        req.get("acquire", 1),
+                        cause,
+                    )
+                    for req in requests
+                ]
         out: List[Optional[_EntryOp]] = []
         resume_at = 0
         over = False
@@ -1163,6 +1254,17 @@ class Engine:
                     # Token-service RPCs happen outside the lock: the
                     # resolved op is DISCARDED (it holds no state) and
                     # this request re-resolves through submit_entry.
+                    resume_at = i
+                    break
+                if (
+                    self.ingest.armed
+                    and self.ingest.max_pending
+                    and len(self._entries) + 1 > self.ingest.max_pending
+                ):
+                    # Ingest bound hit mid-batch: the resolved op is
+                    # discarded (it holds no state) and the remainder
+                    # routes through submit_entry, whose valve sheds
+                    # per op.
                     resume_at = i
                     break
                 self._entries.append(op)
@@ -1396,9 +1498,15 @@ class Engine:
             # concurrency must track real callers, not settle lag.
             # Entries known to be device-decided (speculative=False)
             # were never counted by the mirror, so they don't release
-            # it either; the counter clamps at zero regardless.
+            # it either; the counter clamps at zero regardless. The
+            # rows/rt/count ride along for the host system gate's
+            # global concurrency + RT window (inbound rows only).
             if resource is not None and speculative is not False:
-                spec.on_exit(resource, 1)
+                # op.rt, not the caller's raw rt: the device clamps at
+                # statistic_max_rt, and the host RT window must see the
+                # same sample or one outlier rt blows the avg-RT gate.
+                spec.on_exit(resource, 1, rows=rows, rt=op.rt, count=count,
+                             now_ms=op.ts)
             self._spec_maybe_settle()
         if over:
             self.flush()
@@ -1507,6 +1615,12 @@ class Engine:
             raise ValueError(
                 f"submit_bulk: n={n} exceeds max_batch={self.max_batch}; split the group"
             )
+        if self.ingest.armed:
+            cause = self.ingest.check_bulk(n)
+            if cause is not None:
+                return self._shed_bulk(
+                    resource, n, context_name, origin, acquire, cause
+                )
         with self._lock:
             findex = self.flow_index
             dindex = self.degrade_index
@@ -1632,7 +1746,11 @@ class Engine:
             # (the counter clamps at zero for device-decided groups
             # whose admits were never mirror-charged).
             if resource is not None and speculative is not False:
-                spec.on_exit(resource, n)
+                spec.on_exit(
+                    resource, n, rows=rows, rt=int(op.rt.sum()),
+                    count=int(op.count.sum()), now_ms=now,
+                    min_rt=int(op.rt.min()),
+                )
             self._spec_maybe_settle()
         if over:
             self.flush()
@@ -2089,6 +2207,8 @@ class Engine:
             )
         if self.telemetry.enabled:
             self.telemetry.note_drain(ms)
+        if self.ingest.armed:
+            self.ingest.note_settle_ms(ms)
 
     @property
     def pipeline_depth(self) -> int:
@@ -3083,6 +3203,35 @@ class Engine:
                 # drop them.
                 self._breaker_applied_seq = self._breaker_seq
 
+        # Speculative shaping-mirror reconcile: the settled pacer /
+        # warm-up dyn columns ride the SAME coalesced fetch whenever
+        # the tier serves shaped resources — the host mirror re-anchors
+        # to device truth at every drain for free. Deferred chunks copy
+        # (the next flush's shaping kernel donates flow_dyn, deleting
+        # the arrays before a deferred fetch runs — the breaker_snap
+        # hazard); the sync path fetches before the next dispatch.
+        spec_tier = self.speculative
+        if (
+            spec_tier.enabled
+            and spec_tier.mirror.shaping_enabled
+            and findex.shaping_gids
+            and self.mesh is None
+        ):
+            fd = self.flow_dyn
+            if defer:
+                shaping_snap = (
+                    jnp.copy(fd.latest_passed_time),
+                    jnp.copy(fd.stored_tokens),
+                    jnp.copy(fd.last_filled_time),
+                )
+            else:
+                shaping_snap = (
+                    fd.latest_passed_time, fd.stored_tokens,
+                    fd.last_filled_time,
+                )
+        else:
+            shaping_snap = None
+
         has_sketch = result.blk_rows is not None
         # Admission-trace flush linkage: the deciding flush-span seq
         # (TelemetryBus ids) — -1 when the flight recorder is off.
@@ -3112,9 +3261,10 @@ class Engine:
                 got, entries, exits, bulk, bulk_exits, findex, dindex,
                 auth_rules, k, kd, breaker_snap=breaker_snap,
                 sketch=has_sketch, flush_seq=flush_seq,
+                shaping_snap=shaping_snap is not None,
             )
 
-        refs = self._result_refs(result, breaker_snap)
+        refs = self._result_refs(result, breaker_snap, shaping_snap)
         if ckpt_meta is not None:
             refs = refs + (states,)
         if defer:
@@ -3161,6 +3311,11 @@ class Engine:
                 self._arena.give_all(staging)
             if span is not None:
                 tele.settle(span, t_fetch0, time.perf_counter())
+            if self.ingest.armed:
+                # Settle-latency signal for the ingest deadline valve.
+                self.ingest.note_settle_ms(
+                    (time.perf_counter() - t_fetch0) * 1e3
+                )
         return res
 
     def _degraded_chunk(
@@ -3228,12 +3383,13 @@ class Engine:
             breaker_events.fire_transitions(prev, new_state, dindex)
 
     @staticmethod
-    def _result_refs(result, breaker_snap) -> tuple:
+    def _result_refs(result, breaker_snap, shaping_snap=None) -> tuple:
         """The device arrays one chunk's verdict fill consumes — kept
         as a tuple so a drain can batch MANY chunks' refs into one
         coalesced ``jax.device_get`` (each separate fetch costs a full
         round-trip on remote-tunnel backends). The breaker state rides
-        the same fetch when observers are registered."""
+        the same fetch when observers are registered; the shaping dyn
+        columns ride it when the speculative shaping mirror is on."""
         refs = (
             result.admitted,
             result.reason,
@@ -3248,6 +3404,8 @@ class Engine:
             refs = refs + (result.blk_rows, result.blk_weight)
         if breaker_snap is not None:
             refs = refs + (breaker_snap[2],)
+        if shaping_snap is not None:
+            refs = refs + shaping_snap
         return refs
 
     def _fold_blocked_sketch(self, rows, weights) -> None:
@@ -3306,6 +3464,7 @@ class Engine:
         breaker_snap=None,
         sketch: bool = False,
         flush_seq: int = -1,
+        shaping_snap: bool = False,
     ) -> List[tuple]:
         """Verdict fill for one dispatched chunk from its ALREADY
         FETCHED result tuple (``got`` = the host values of
@@ -3322,6 +3481,17 @@ class Engine:
                 breaker_snap[0], breaker_snap[1],
                 np.asarray(got[nxt], dtype=np.int32).reshape(-1), dindex,
             )
+            nxt += 1
+        if shaping_snap:
+            # Settled shaping dyn columns: re-anchor the host pacer /
+            # warm-up mirrors to device truth (the per-drain
+            # reconciliation contract of the shaping fast tier).
+            self.speculative.reconcile_shaping(
+                findex,
+                np.asarray(got[nxt]), np.asarray(got[nxt + 1]),
+                np.asarray(got[nxt + 2]),
+            )
+            nxt += 3
         # One verdict-materialization timestamp for every admission in
         # the chunk (they all settle together; per-op clocks would add
         # a syscall per row for no attribution gain).
@@ -3416,6 +3586,7 @@ class Engine:
                     np.array(admitted[sl]),
                     np.array(reason[sl], dtype=np.int32),
                     dev_slot_ok=np.asarray(slot_ok[sl]),
+                    dev_sys_type=np.asarray(sys_type[sl]),
                 )
                 g._pending = None
                 if g.trace is not None:
@@ -3688,6 +3859,11 @@ class Engine:
         # than racing it. A non-speculative _verdict here means the
         # tier declined and a flush-on-size settled the op on-device.
         v = op._verdict
+        if v is not None and v.reason == E.BLOCK_SHED:
+            # The ingest valve shed it at submit: nothing is queued,
+            # nothing to flush — the fast distinct verdict IS the
+            # contract (runtime/ingest.py).
+            return op, v
         if v is not None and v.speculative:
             self._spec_maybe_settle()
             return op, v
@@ -3825,6 +4001,7 @@ class Engine:
             )
         self.failover.reset()
         self.speculative.reset()
+        self.ingest.reset()
         with self._flush_lock, self._lock:
             self._entries.clear()
             self._exits.clear()

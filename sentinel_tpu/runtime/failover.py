@@ -171,6 +171,143 @@ class _TokenBucket:
         self.tokens = max(0.0, self.tokens - n)
 
 
+class _HostShaping:
+    """Host mirror of ONE shaping-governed rule's controller state —
+    the mutable record rules/shaping.mirror_shaping_decide evolves
+    (``latest`` ≙ latestPassedTime, ``stored``/``lastfill`` ≙ the
+    warm-up ramp) plus pass counters approximating the check node's
+    windowed pass: ``passq(ts)`` is a true LeapArray-style rolling
+    window at the live SECOND_CFG bucket width (a bucket is valid while
+    ``ts - ws <= interval``, exactly metric_array._valid_mask), and
+    ``pass_prev`` is the ALIGNED previous-1s bucket (the minute-array
+    read previousPassQps consumes). The device counts the whole node,
+    the mirror counts its own admits through this rule —
+    reconciliation adopts the settled device columns at every drain."""
+
+    __slots__ = (
+        "rule", "info", "latest", "stored", "lastfill",
+        "win", "pass_sec", "pass_cur", "pass_prev",
+    )
+
+    def __init__(self, rule, info) -> None:
+        self.rule = rule
+        self.info = info  # FlowIndex.mirror_shaping_info tuple
+        # Same inits as FlowIndex.make_dyn_state: "infinitely past".
+        self.latest = -(10**9)
+        self.stored = 0.0
+        self.lastfill = -(10**9)
+        self.win: "deque[list]" = deque()  # [bucket_ws, count] rolling
+        self.pass_sec: Optional[int] = None
+        self.pass_cur = 0
+        self.pass_prev = 0
+
+    def roll_pass(self, ts: int) -> None:
+        """Advance the aligned per-second pass buckets to ``ts``'s
+        second — ``pass_prev`` mirrors previousPassQps (the exact
+        previous 1s bucket; a gap leaves it 0, like the minute-array
+        read)."""
+        sec = ts - ts % 1000
+        if self.pass_sec is None:
+            self.pass_sec = sec
+            return
+        if sec > self.pass_sec:
+            self.pass_prev = self.pass_cur if sec - self.pass_sec == 1000 else 0
+            self.pass_cur = 0
+            self.pass_sec = sec
+
+    def note_pass(self, ts: int, n: int) -> None:
+        self.roll_pass(ts)
+        self.pass_cur += n
+        # The rolling window feeds only the warm-up line's passQps;
+        # pacer-only rules never read it, so never grow it (it would
+        # otherwise accumulate one bucket per window_len forever).
+        if self.info[1] == C.CONTROL_BEHAVIOR_RATE_LIMITER:
+            return
+        self._trim_win(ts)
+        wlen = _ncfg.SECOND_CFG.window_len_ms
+        ws = ts - ts % wlen
+        if self.win and self.win[-1][0] == ws:
+            self.win[-1][1] += n
+        else:
+            self.win.append([ws, n])
+
+    def _trim_win(self, ts: int) -> None:
+        interval = _ncfg.SECOND_CFG.interval_ms
+        while self.win and ts - self.win[0][0] > interval:
+            self.win.popleft()
+
+    def passq(self, ts: int) -> int:
+        """Windowed pass sum at ``ts`` — LeapArray validity (strict
+        ``ts - ws > interval`` deprecates a bucket)."""
+        self._trim_win(ts)
+        return sum(c for _ws, c in self.win)
+
+
+class _HostSystem:
+    """Host mirror of the global system-protection inputs
+    (SystemRuleManager.checkSystem against Constants.ENTRY_NODE): a
+    token bucket for the global inbound QPS threshold, a live inbound
+    concurrency counter, and per-second success/RT windows feeding the
+    avg-RT and BBR checks. Load/CPU read the same
+    utils/system_status.sampler the device path samples. All
+    approximations are the PR-5 bucket stance (windows restart at the
+    gate's first use; reconciliation clamps the QPS bucket on observed
+    over-admits)."""
+
+    __slots__ = (
+        "bucket", "qps_cap", "threads", "sec",
+        "succ_cur", "succ_prev", "rt_cur", "rt_prev",
+        "minrt_cur", "minrt_prev",
+    )
+
+    def __init__(self) -> None:
+        self.bucket: Optional[_TokenBucket] = None
+        self.qps_cap = -1.0
+        self.threads = 0
+        self.sec: Optional[int] = None
+        self.succ_cur = 0
+        self.succ_prev = 0
+        self.rt_cur = 0
+        self.rt_prev = 0
+        self.minrt_cur = _ncfg.SECOND_CFG.max_rt
+        self.minrt_prev = _ncfg.SECOND_CFG.max_rt
+
+    def roll(self, now_ms: int) -> None:
+        sec = now_ms - now_ms % 1000
+        if self.sec is None:
+            self.sec = sec
+            return
+        if sec > self.sec:
+            gap1 = sec - self.sec == 1000
+            self.succ_prev = self.succ_cur if gap1 else 0
+            self.rt_prev = self.rt_cur if gap1 else 0
+            self.minrt_prev = (
+                self.minrt_cur if gap1 else _ncfg.SECOND_CFG.max_rt
+            )
+            self.succ_cur = 0
+            self.rt_cur = 0
+            self.minrt_cur = _ncfg.SECOND_CFG.max_rt
+            self.sec = sec
+
+    def note_complete(
+        self, now_ms: int, rt: int, count: int,
+        min_rt: Optional[int] = None,
+    ) -> None:
+        """``rt`` is the group's RT SUM (the avg-RT window input);
+        ``min_rt`` the group's per-exit minimum — a bulk group's sum
+        must not pose as one sample or the BBR minRt inflates by the
+        group size."""
+        self.roll(now_ms)
+        self.succ_cur += count
+        self.rt_cur += rt
+        sample = rt if min_rt is None else min_rt
+        if count > 0 and sample < self.minrt_cur:
+            self.minrt_cur = sample
+
+    def release(self, n: int) -> None:
+        self.threads = max(0, self.threads - n)
+
+
 class HostFallbackAdmitter:
     """Serves admission from host state while the engine is DEGRADED.
 
@@ -207,6 +344,17 @@ class HostFallbackAdmitter:
         self._pbuckets: Dict[int, Tuple[object, _TokenBucket]] = {}
         # resource -> live concurrency admitted by THIS fallback window.
         self._threads: Dict[str, int] = {}
+        # gid -> host shaping-controller mirror (rules/shaping.py
+        # mirror_shaping_decide state); _shaping_src pins the FlowIndex
+        # the gids belong to, so a drain's reconcile against a
+        # different (reloaded) index snapshot is a no-op instead of
+        # adopting another rule's columns.
+        self.shaping_enabled = config.get_bool(config.SPECULATIVE_SHAPING, True)
+        self._shaping: Dict[int, _HostShaping] = {}
+        self._shaping_src: Optional[object] = None
+        # Host system-protection gate (consulted when
+        # engine.system_config is set; lazily built).
+        self._sys: Optional[_HostSystem] = None
         # Device-gauge deltas observed while DEGRADED: node row →
         # count. ``_exit_rows`` are releases the device never saw (a
         # restored gauge would stay pinned without replaying them);
@@ -236,6 +384,8 @@ class HostFallbackAdmitter:
                 self._buckets.clear()
                 self._pbuckets.clear()
                 self._threads.clear()
+                self._shaping.clear()
+                self._sys = None
             self._exit_rows.clear()
             self._exit_prows.clear()
             self._admit_rows.clear()
@@ -260,6 +410,8 @@ class HostFallbackAdmitter:
             self._buckets.clear()
             self._pbuckets.clear()
             self._threads.clear()
+            self._shaping.clear()
+            self._sys = None
             self._exit_rows.clear()
             self._exit_prows.clear()
             self._admit_rows.clear()
@@ -318,6 +470,149 @@ class HostFallbackAdmitter:
             self._pbuckets[ps.prow] = ent
         return ent[1]
 
+    def _shaping_for(self, findex, gid: int) -> Optional[_HostShaping]:
+        """The host shaping-controller mirror for one gid; caller
+        holds ``self._lock``. Keyed per FlowIndex — a different index's
+        gids name different rules, so the table resets on first touch
+        after a swap (invalidate_rule_mirrors also clears it)."""
+        if self._shaping_src is not findex:
+            self._shaping.clear()
+            self._shaping_src = findex
+        st = self._shaping.get(gid)
+        if st is None:
+            info = findex.mirror_shaping_info(gid)
+            if info is None:
+                return None
+            st = self._shaping[gid] = _HostShaping(info[0], info)
+        return st
+
+    def _shaping_admit_locked(self, findex, op) -> Tuple[bool, int, object]:
+        """Decide the op's shaping-governed slots on the host mirror:
+        ``(ok, wait_ms, blocking_rule)``. Every shaping slot's state
+        advances like the kernel's would (no early exit — the device
+        advances each pacer independently, and a grant sticks even when
+        a sibling slot later vetoes the entry)."""
+        from sentinel_tpu.rules.shaping import mirror_shaping_decide
+
+        sg = findex.shaping_gids
+        ok_all, wait_all, bad_rule = True, 0, None
+        for gid, _crow in op.slots:
+            if gid not in sg:
+                continue
+            st = self._shaping_for(findex, gid)
+            if st is None:
+                continue
+            st.roll_pass(op.ts)
+            ok, wait = mirror_shaping_decide(st, st.info, op.ts, op.acquire)
+            if not ok and ok_all:
+                ok_all, bad_rule = False, st.rule
+            if wait > wait_all:
+                wait_all = wait
+        return ok_all, wait_all, bad_rule
+
+    def _shaping_note_pass_locked(self, findex, op) -> None:
+        """Count one ADMITTED entry's acquire into its shaping rules'
+        per-second pass mirrors (the warm-up line's passQps input —
+        only finally-admitted traffic counts toward the node's pass
+        window on the device)."""
+        sg = findex.shaping_gids
+        for gid, _crow in op.slots:
+            if gid in sg:
+                st = self._shaping.get(gid)
+                if st is not None:
+                    st.note_pass(op.ts, op.acquire)
+
+    def reconcile_shaping(self, findex, latest, stored, lastfill) -> None:
+        """Adopt the settled device shaping columns at a drain
+        (runtime/speculative.py rides them on the coalesced fetch):
+        ``latestPassedTime`` advances monotonically (the mirror may be
+        legitimately AHEAD by its in-flight speculative grants — those
+        ops are still riding toward the device, so regressing to the
+        device value would re-grant their pacing slots); the warm-up
+        ramp adopts the device pair whenever the device's sync is at
+        least as recent. A reconcile against a superseded index
+        snapshot is a no-op (gids would name the wrong rules)."""
+        with self._lock:
+            if self._shaping_src is not findex:
+                return
+            n = latest.shape[0]
+            for gid, st in self._shaping.items():
+                if gid >= n:
+                    continue
+                dl = int(latest[gid])
+                if dl > st.latest:
+                    st.latest = dl
+                df = int(lastfill[gid])
+                if df >= st.lastfill:
+                    st.lastfill = df
+                    st.stored = float(stored[gid])
+
+    def _sys_state_locked(self, cfg, now_ms: int) -> _HostSystem:
+        """The host system gate's state, (re)built lazily; caller holds
+        ``self._lock``. The QPS bucket rebuilds when the effective
+        threshold changes (a reload narrowed/widened the rule)."""
+        s = self._sys
+        if s is None:
+            s = self._sys = _HostSystem()
+        if cfg.qps >= 0 and (s.bucket is None or s.qps_cap != cfg.qps):
+            # qps is a PER-SECOND rate on both planes (the kernel
+            # divides its interval pass sum by interval_sec before
+            # comparing) — so the bucket refills per 1000 ms even when
+            # the window geometry is retuned to another interval.
+            s.bucket = _TokenBucket(float(cfg.qps), 1000.0, now_ms)
+            s.qps_cap = cfg.qps
+        return s
+
+    def _sys_check_locked(
+        self, s: _HostSystem, cfg, now_ms: int, acquire: int
+    ) -> Optional[str]:
+        """First violated system dimension ("qps"/"thread"/"rt"/
+        "load"/"cpu") or None — the reference's checkSystem order
+        (SystemRuleManager.java:298-353), which the kernel's
+        reverse-iteration sys_type assignment reproduces. Nothing is
+        consumed here; the QPS charge and thread acquire land only on
+        the op's FINAL admit (the device's pass stats count admitted
+        entries only)."""
+        if cfg.qps >= 0 and s.bucket is not None:
+            if s.bucket.available(now_ms) < acquire:
+                return "qps"
+        if cfg.max_thread >= 0 and s.threads > cfg.max_thread:
+            return "thread"
+        s.roll(now_ms)
+        return self._sys_check_scalar_locked(s, cfg)
+
+    def _sys_check_scalar_locked(self, s: _HostSystem, cfg) -> Optional[str]:
+        """The snapshot dimensions (rt / load / cpu) shared by the
+        singles and bulk gates; caller holds ``self._lock`` and has
+        rolled ``s`` to the current second."""
+        from sentinel_tpu.utils.system_status import sampler
+
+        if cfg.max_rt >= 0:
+            succ = s.succ_cur + s.succ_prev
+            if succ > 0 and (s.rt_cur + s.rt_prev) / succ > cfg.max_rt:
+                return "rt"
+        cur_load, cur_cpu = sampler.read()
+        if cfg.highest_system_load >= 0 and cur_load > cfg.highest_system_load:
+            # BBR (checkBbr): under high load, block unless
+            # curThread <= maxSuccessQps * minRt / 1000 (or <= 1).
+            max_sq = float(max(s.succ_cur, s.succ_prev))
+            min_rt = float(min(s.minrt_cur, s.minrt_prev))
+            if s.threads > 1 and s.threads > max_sq * min_rt / 1000.0:
+                return "load"
+        if cfg.highest_cpu_usage >= 0 and cur_cpu > cfg.highest_cpu_usage:
+            return "cpu"
+        return None
+
+    def drain_sys_bucket(self) -> bool:
+        """Settlement observed a system-QPS over-admit: empty the
+        gate's bucket (the clamp contract of :meth:`drain_bucket`)."""
+        with self._lock:
+            s = self._sys
+            if s is not None and s.bucket is not None:
+                s.bucket.consume(s.bucket.tokens)
+                return True
+        return False
+
     def _breaker_open(self, d_gids: Sequence[int]) -> bool:
         """Last-known breaker verdict from the engine's host mirror
         (kept by the breaker-event machinery). An invalid mirror —
@@ -352,11 +647,11 @@ class HostFallbackAdmitter:
         tier passes ``apply_policy=False``."""
         from sentinel_tpu.runtime.engine import Verdict
 
-        def blocked(reason, rule=None, slot_name=""):
+        def blocked(reason, rule=None, slot_name="", limit_type=""):
             return Verdict(
                 admitted=False, reason=reason, wait_ms=0, blocked_rule=rule,
-                slot_name=slot_name, degraded=degraded,
-                speculative=speculative,
+                limit_type=limit_type, slot_name=slot_name,
+                degraded=degraded, speculative=speculative,
             )
 
         if apply_policy and self.policy_for(op.resource) == "closed":
@@ -384,7 +679,19 @@ class HostFallbackAdmitter:
             )
             return blocked(reason, rule)
         findex = op.src[0] if op.src is not None else self._engine.flow_index
+        sys_cfg = self._engine.system_config
+        is_in = op.rows is not None and op.rows[3] >= 0
         with self._lock:
+            # --- system protection (SystemSlot order: after authority,
+            # before param/flow — only inbound entries are checked) ---
+            sys_state = None
+            if sys_cfg is not None and is_in:
+                sys_state = self._sys_state_locked(sys_cfg, now_ms)
+                dim = self._sys_check_locked(
+                    sys_state, sys_cfg, now_ms, op.acquire
+                )
+                if dim is not None:
+                    return blocked(E.BLOCK_SYSTEM, limit_type=dim)
             thr_prows = []
             for ps in op.p_slots:
                 if ps.grade != C.FLOW_GRADE_QPS:
@@ -403,8 +710,25 @@ class HostFallbackAdmitter:
                     now_ms, op.acquire
                 ):
                     return blocked(E.BLOCK_PARAM, ps.rule)
+            # --- shaping controllers (pacer / warm-up ramp) on the
+            # host mirror, BEFORE the plain buckets: a shaping block
+            # must not consume bucket tokens (the device's blocked
+            # entries never count toward window pass), while shaping
+            # state itself advances regardless of sibling-slot
+            # verdicts, exactly like the kernel's scan ---
+            sg = findex.shaping_gids
+            has_shaping = bool(sg) and any(g in sg for g, _ in op.slots)
+            wait_ms = 0
+            if has_shaping and self.shaping_enabled:
+                sh_ok, wait_ms, sh_rule = self._shaping_admit_locked(
+                    findex, op
+                )
+                if not sh_ok:
+                    return blocked(E.BLOCK_FLOW, sh_rule)
             thread_rules = []
             for gid, _crow in op.slots:
+                if sg and gid in sg and self.shaping_enabled:
+                    continue  # decided by the shaping mirror above
                 info = findex.mirror_info(gid)
                 if info is None:
                     continue
@@ -447,26 +771,66 @@ class HostFallbackAdmitter:
             if self._track_deltas and not speculative:
                 for r in thr_prows:
                     self._admit_prows[r] = self._admit_prows.get(r, 0) + 1
+            # Final admit: charge the system gate (the device's global
+            # QPS/thread stats count admitted entries only) and the
+            # shaping rules' pass mirrors.
+            if sys_state is not None:
+                if sys_state.bucket is not None:
+                    sys_state.bucket.consume(op.acquire)
+                sys_state.threads += 1
+            if has_shaping and self.shaping_enabled:
+                self._shaping_note_pass_locked(findex, op)
         return Verdict(
-            admitted=True, reason=E.PASS, wait_ms=0, blocked_rule=None,
+            admitted=True, reason=E.PASS, wait_ms=wait_ms, blocked_rule=None,
             degraded=degraded, speculative=speculative,
         )
 
     # ------------------------------------------------------------------
     # bulk admission (vectorized)
     # ------------------------------------------------------------------
+    def bulk_shaping_servable(self, g, findex) -> bool:
+        """The bulk closed-form preconditions — the same predicate as
+        Engine._shaping_rounds_for's ``-1`` path: every shaping slot a
+        plain RATE_LIMITER, ONE distinct ts, ONE acquire >= 1. The
+        speculative tier declines non-servable shaped groups to the
+        device; the degraded fill (no device to decline to) falls back
+        to the PR-5 plain-bucket stance for them."""
+        sg = findex.shaping_gids
+        if not sg or not any(gid in sg for gid, _crow in g.slots):
+            return True
+        ts = np.asarray(g.ts)
+        acq = np.asarray(g.acquire)
+        if ts.size and int(ts.min()) != int(ts.max()):
+            return False
+        if acq.size and (
+            int(acq.min()) != int(acq.max()) or int(acq.min()) < 1
+        ):
+            return False
+        for gid, _crow in g.slots:
+            if gid in sg:
+                info = findex.mirror_shaping_info(gid)
+                if info is None or info[1] != C.CONTROL_BEHAVIOR_RATE_LIMITER:
+                    return False
+        return True
+
     def admit_bulk(
         self, g, now_ms: int, apply_policy: bool = True,
         speculative: bool = False,
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        """Array verdicts for one bulk group: numpy prefix math against
-        the same buckets/counters the singles path consumes (QPS-grade
-        hot-param columns pass — bulk rejects THREAD/cluster param
-        rules at submit, and per-value buckets per row would be the
-        per-row Python work the bulk path exists to avoid)."""
+        shaping_servable: Optional[bool] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Array verdicts ``(admitted, reason, wait_ms)`` for one bulk
+        group: numpy prefix math against the same buckets/counters the
+        singles path consumes (QPS-grade hot-param columns pass — bulk
+        rejects THREAD/cluster param rules at submit, and per-value
+        buckets per row would be the per-row Python work the bulk path
+        exists to avoid). Shaping slots run the closed-form host pacer
+        when :meth:`bulk_shaping_servable` holds (exact rank math, the
+        kernel's ``rounds == -1`` twin); otherwise they degrade to the
+        plain-bucket stance."""
         n = g.n
         admitted = np.ones(n, dtype=bool)
         reason = np.full(n, E.PASS, dtype=np.int32)
+        wait = np.zeros(n, dtype=np.int32)
 
         def block(mask: np.ndarray, code: int) -> None:
             sel = admitted & mask
@@ -475,16 +839,85 @@ class HostFallbackAdmitter:
 
         if apply_policy and self.policy_for(g.resource) == "closed":
             block(np.ones(n, dtype=bool), E.BLOCK_FAILOVER)
-            return admitted, reason
+            return admitted, reason, wait
         if g.custom_veto_mask is not None:
             block(np.asarray(g.custom_veto_mask, dtype=bool), E.BLOCK_CUSTOM)
         if not g.auth_ok:
             block(np.ones(n, dtype=bool), E.BLOCK_AUTHORITY)
         findex = g.src[0] if g.src is not None else self._engine.flow_index
         acquire = np.asarray(g.acquire, dtype=np.int64)
+        sys_cfg = self._engine.system_config
+        is_in = g.rows is not None and g.rows[3] >= 0
         with self._lock:
+            # --- system protection (inbound groups only; QPS/thread
+            # are per-row prefix math, RT/load/cpu scalar snapshots) ---
+            sys_state = None
+            if sys_cfg is not None and is_in:
+                sys_state = self._sys_state_locked(sys_cfg, now_ms)
+                if sys_cfg.qps >= 0 and sys_state.bucket is not None:
+                    avail = sys_state.bucket.available(now_ms)
+                    cum = np.cumsum(np.where(admitted, acquire, 0))
+                    block(cum > avail, E.BLOCK_SYSTEM)
+                if sys_cfg.max_thread >= 0:
+                    adm_i = admitted.astype(np.int64)
+                    excl = np.cumsum(adm_i) - adm_i
+                    block(
+                        excl + sys_state.threads > sys_cfg.max_thread,
+                        E.BLOCK_SYSTEM,
+                    )
+                sys_state.roll(now_ms)
+                dim = self._sys_check_scalar_locked(sys_state, sys_cfg)
+                if dim is not None:
+                    block(np.ones(n, dtype=bool), E.BLOCK_SYSTEM)
+            # --- shaping slots (closed-form pacer; before the plain
+            # buckets so a pacer block consumes no bucket tokens) ---
+            sg = findex.shaping_gids
+            shaped_gids = (
+                [gid for gid, _crow in g.slots if gid in sg] if sg else []
+            )
+            shaping_as_bucket = False
+            if shaped_gids:
+                # ``shaping_servable`` lets the speculative tier pass
+                # its already-computed predicate verdict instead of
+                # re-scanning the group's ts/acquire columns here.
+                if shaping_servable is None:
+                    shaping_servable = self.bulk_shaping_servable(g, findex)
+                if self.shaping_enabled and shaping_servable:
+                    from sentinel_tpu.rules.shaping import (
+                        mirror_pacer_bulk,
+                        mirror_pacer_cost,
+                    )
+
+                    ts0 = int(np.asarray(g.ts)[0]) if n else now_ms
+                    acq0 = int(acquire[0]) if n else 1
+                    for gid in shaped_gids:
+                        st = self._shaping_for(findex, gid)
+                        if st is None:
+                            continue
+                        count, maxq = st.info[2], st.info[3]
+                        cost = mirror_pacer_cost(acq0, count, st.info[4])
+                        # Ranks over still-admitted rows == the
+                        # kernel's shaping_live gating: the device scan
+                        # also excludes custom/auth/system-blocked rows
+                        # (live), and bucket/breaker blocks land AFTER
+                        # the shaping stage on both planes.
+                        ranks = np.cumsum(admitted.astype(np.int64))
+                        ok, w, latest = mirror_pacer_bulk(
+                            st.latest, count, maxq, cost, ts0, ranks
+                        )
+                        st.latest = latest
+                        np.maximum(
+                            wait,
+                            np.where(admitted & ok, w, 0).astype(np.int32),
+                            out=wait,
+                        )
+                        block(~ok, E.BLOCK_FLOW)
+                else:
+                    shaping_as_bucket = True
             thread_rule = None
             for gid, _crow in g.slots:
+                if shaped_gids and gid in shaped_gids and not shaping_as_bucket:
+                    continue  # decided by the closed-form pacer above
                 info = findex.mirror_info(gid)
                 if info is None:
                     continue
@@ -505,8 +938,8 @@ class HostFallbackAdmitter:
                     bucket.consume(int(np.where(admitted, acquire, 0).sum()))
             if self._breaker_open(g.d_gids):
                 block(np.ones(n, dtype=bool), E.BLOCK_DEGRADE)
+            n_adm = int(admitted.sum())
             if thread_rule is not None:
-                n_adm = int(admitted.sum())
                 self._threads[g.resource] = (
                     self._threads.get(g.resource, 0) + n_adm
                 )
@@ -519,16 +952,43 @@ class HostFallbackAdmitter:
                             self._admit_rows[r] = (
                                 self._admit_rows.get(r, 0) + n_adm
                             )
-        return admitted, reason
+            if sys_state is not None and n_adm:
+                if sys_state.bucket is not None:
+                    sys_state.bucket.consume(
+                        int(np.where(admitted, acquire, 0).sum())
+                    )
+                sys_state.threads += n_adm
+        return admitted, reason, wait
 
-    def on_exit(self, resource: str, n: int = 1) -> None:
-        """Thread release for exits settled while DEGRADED. Clamped at
+    def on_exit(
+        self, resource: str, n: int = 1, rows=None, rt: int = 0,
+        count: int = 0, now_ms: Optional[int] = None,
+        min_rt: Optional[int] = None,
+    ) -> None:
+        """Thread release for exits settled while DEGRADED (and, on a
+        persistent mirror, synchronously at submit_exit). Clamped at
         zero: exits of entries admitted on-device before the fault were
-        never counted here."""
+        never counted here. ``rows``/``rt``/``count`` feed the host
+        system gate when present: an inbound entry's exit (rows[3] >= 0
+        — the global entry-node row) releases the global concurrency
+        mirror and lands its completion in the per-second RT window
+        (``rt`` = the group RT SUM, ``min_rt`` = its per-exit minimum —
+        None means single exit, rt is its own sample)."""
         with self._lock:
             cur = self._threads.get(resource)
             if cur is not None:
                 self._threads[resource] = max(0, cur - n)
+            s = self._sys
+            if (
+                s is not None
+                and rows is not None
+                and len(rows) > 3
+                and rows[3] is not None
+                and rows[3] >= 0
+            ):
+                s.release(n)
+                if count > 0 and now_ms is not None:
+                    s.note_complete(now_ms, rt, count, min_rt=min_rt)
 
     def note_device_exit(self, rows, p_rows=(), n: int = 1) -> None:
         """Record the DEVICE-gauge releases one degraded exit would
@@ -631,6 +1091,8 @@ class HostFallbackAdmitter:
         with self._lock:
             self._buckets.clear()
             self._pbuckets.clear()
+            self._shaping.clear()
+            self._shaping_src = None
 
     def peek_gauge_deltas(
         self,
@@ -656,12 +1118,27 @@ class HostFallbackAdmitter:
 
     def snapshot(self) -> dict:
         with self._lock:
+            s = self._sys
             return {
                 "policy_default": self._policy_default,
                 "policy_overrides": dict(self._policy_by_resource),
                 "qps_buckets": len(self._buckets),
                 "param_buckets": len(self._pbuckets),
                 "live_threads": dict(self._threads),
+                "shaping_enabled": self.shaping_enabled,
+                "shaping_mirrors": len(self._shaping),
+                "system_gate": (
+                    None
+                    if s is None
+                    else {
+                        "threads": s.threads,
+                        "qps_tokens": (
+                            round(s.bucket.tokens, 2)
+                            if s.bucket is not None
+                            else None
+                        ),
+                    }
+                ),
             }
 
 
@@ -1070,10 +1547,10 @@ class FailoverManager:
                 # bulk path — a registered slot's veto must keep
                 # applying to bulk traffic while DEGRADED.
                 SlotChainRegistry.check_bulk_entry(g)
-            adm, rsn = fb.admit_bulk(g, now)
+            adm, rsn, wait = fb.admit_bulk(g, now)
             g.admitted = adm
             g.reason = rsn
-            g.wait_ms = np.zeros(g.n, dtype=np.int32)
+            g.wait_ms = wait
             g._pending = None
             blocked = ~adm
             n_admit += int(adm.sum())
@@ -1102,7 +1579,8 @@ class FailoverManager:
                     # Persistent mirrors already released at
                     # submit_exit time (Engine routes exits to the
                     # speculative tier synchronously).
-                    fb.on_exit(x.resource, 1)
+                    fb.on_exit(x.resource, 1, rows=x.rows, rt=x.rt,
+                               count=x.count, now_ms=now)
             elif x.thr > 0:
                 # A speculative +thread gauge-compensation op caught in
                 # a degraded window: the device never saw the +n, so it
@@ -1112,7 +1590,10 @@ class FailoverManager:
             if gx.thr < 0:
                 fb.note_device_exit(gx.rows, (), gx.n)
                 if gx.resource is not None and not fb.persistent:
-                    fb.on_exit(gx.resource, gx.n)
+                    fb.on_exit(gx.resource, gx.n, rows=gx.rows,
+                               rt=int(gx.rt.sum()),
+                               count=int(gx.count.sum()), now_ms=now,
+                               min_rt=int(gx.rt.min()))
         with self._lock:
             self.counters["degraded_admits"] += n_admit
             self.counters["degraded_blocks"] += n_block

@@ -50,13 +50,27 @@ buckets keep serving; recovery restarts reconciliation with no
 cold-start burst in either direction. ``FailoverManager.fallback`` IS
 this tier's mirror when the tier is enabled.
 
+Coverage (the PR-7 self-protection milestone): shaped resources are
+served from a host mirror of the RateLimiter pacer / WarmUp token ramp
+(rules/shaping.py ``mirror_shaping_decide``, state on the persistent
+HostFallbackAdmitter, re-anchored to the settled device
+``latestPassedTime`` at every drain), and a configured system rule
+narrows the tier through a host-side global gate (QPS/thread/RT/
+load/CPU against the same SystemStatusSampler) instead of zeroing it.
+Only prioritized (occupy) entries remain device-only — their
+future-window borrow semantics live in the kernel's slab math.
+
 Known approximations (deliberate, measured, documented in
-ARCHITECTURE.md §"Speculative admission & settlement"): ops needing
-device-only semantics — prioritized (occupy) entries, shaping pacers,
-system protection — are DECLINED by the fast tier and served
-synchronously from the device; device pass/block statistics count the
-kernel's own re-decisions, which differ from caller-visible verdicts by
-exactly the measured drift; under-admit compensation exits carry rt=0.
+ARCHITECTURE.md §"Speculative admission & settlement" and §"Fast-tier
+coverage matrix"): bulk groups whose shaping slots are not plain
+single-ts RATE_LIMITERs decline to the device; device pass/block
+statistics count the kernel's own re-decisions, which differ from
+caller-visible verdicts by exactly the measured drift; under-admit
+compensation exits carry rt=0. Drift accounting attributes every
+mismatch to the op's SUBMIT-ts window (a large settle no longer folds
+several arrival windows' drift into one accounting window); the
+suspension valve stays on the live observation clock — it is a streak
+breaker, not an accounting ledger.
 
 Config keys (all declared in utils/config.py)::
 
@@ -66,6 +80,9 @@ Config keys (all declared in utils/config.py)::
     sentinel.tpu.speculative.overadmit.max    per-window suspension
                                               valve (0 = off)
     sentinel.tpu.speculative.drift.window.ms  drift accounting window
+    sentinel.tpu.speculative.shaping.enabled  host shaping mirror
+                                              (default on; off =
+                                              decline shaped ops)
 """
 
 from __future__ import annotations
@@ -114,16 +131,28 @@ class SpeculativeAdmitter:
         # continuously-reconciled world.
         self.mirror = HostFallbackAdmitter(engine, persistent=True)
         self._lock = threading.Lock()
-        # Current drift window (engine-clock aligned) and its counts.
+        # Valve window (LIVE observation clock): a streak of observed
+        # over-admits within one window suspends speculation. Separate
+        # from the accounting windows below, which attribute drift to
+        # each op's SUBMIT-ts window.
         self._win_start = -1
         self._win_over = 0
         self._win_under = 0
         self._suspended = False
+        # Drift ACCOUNTING windows keyed by submit-ts window start
+        # (insertion-ordered); folded into the histogram/max once they
+        # are ≥ 2 windows behind the newest seen, so a late settle
+        # still lands in its arrival window instead of smearing into
+        # the fold's window.
+        self._attr: Dict[int, list] = {}
+        self._attr_newest = -1
         self._max_window_net = 0
         self.counters: Dict[str, int] = {
             "spec_admits": 0,
             "spec_blocks": 0,
             "spec_declined": 0,
+            "spec_shaped": 0,
+            "spec_system_blocks": 0,
             "reconciled": 0,
             "over_admits": 0,
             "under_admits": 0,
@@ -139,9 +168,12 @@ class SpeculativeAdmitter:
     # ------------------------------------------------------------------
     def _declinable(self, op) -> bool:
         """Ops whose semantics only the device implements: prioritized
-        (occupy) entries, shaping-governed slots, and anything while
-        system protection is configured. Declined ops take the
-        synchronous device path — correctness over latency."""
+        (occupy) entries — their future-window borrow math lives in the
+        kernel's slab. Shaping and system protection are host-servable
+        since PR 7 (the pacer/ramp mirror and the host system gate);
+        shaped slots decline only when the mirror is configured off.
+        Declined ops take the synchronous device path — correctness
+        over latency."""
         return bool(op.prio) or self._declinable_slots(op.src, op.slots)
 
     def _declinable_slots(self, src, slots) -> bool:
@@ -149,9 +181,18 @@ class SpeculativeAdmitter:
         (bulk groups can't be prio — submit_bulk rejects occupy): one
         home, so a future device-only semantic can't silently apply to
         only one path."""
+        if self.mirror.shaping_enabled:
+            return False
         eng = self._engine
-        if eng.system_config is not None:
-            return True
+        findex = src[0] if src is not None else eng.flow_index
+        sg = findex.shaping_gids
+        return bool(sg) and any(gid in sg for gid, _crow in slots)
+
+    def _shaped_slots(self, src, slots) -> bool:
+        """Does the op touch any shaping-governed rule? (Counter fuel
+        for the coverage story; cheap — the common no-shaping index has
+        an empty gid set.)"""
+        eng = self._engine
         findex = src[0] if src is not None else eng.flow_index
         sg = findex.shaping_gids
         return bool(sg) and any(gid in sg for gid, _crow in slots)
@@ -196,20 +237,30 @@ class SpeculativeAdmitter:
                 )
             )
             op.custom_checked = True
+        shaped = self._shaped_slots(op.src, op.slots)
         v = self.mirror.admit(
             op, now_ms, apply_policy=degraded, degraded=degraded,
             speculative=True,
         )
         op.verdict = v
         op.spec_end_pc = time.perf_counter()
+        sys_block = not v.admitted and v.reason == E.BLOCK_SYSTEM
         with self._lock:
             if v.admitted:
                 self.counters["spec_admits"] += 1
             else:
                 self.counters["spec_blocks"] += 1
+            if shaped:
+                self.counters["spec_shaped"] += 1
+            if sys_block:
+                self.counters["spec_system_blocks"] += 1
         tele = eng.telemetry
         if tele.enabled:
             tele.note_speculative(int(v.admitted), int(not v.admitted))
+            if shaped:
+                tele.note_spec_shaped(1)
+            if sys_block:
+                tele.note_spec_system_block(1)
         return v
 
     def try_admit_bulk(self, g, now_ms: int) -> bool:
@@ -223,78 +274,131 @@ class SpeculativeAdmitter:
         with self._lock:
             self._roll_window_locked(now_ms)
             suspended = self._suspended
-        if (suspended and not degraded) or self._declinable_slots(g.src, g.slots):
+        shaped = self._shaped_slots(g.src, g.slots)
+        servable = True
+        if shaped:
+            servable = self._bulk_shaping_servable(g)
+        if (
+            (suspended and not degraded)
+            or self._declinable_slots(g.src, g.slots)
+            or (shaped and not degraded and not servable)
+        ):
+            # Shaped groups outside the closed-form preconditions (mixed
+            # ts, non-uniform acquire, warm-up behaviors) decline to the
+            # device's general scan — EXCEPT while degraded, where there
+            # is no device to decline to (the mirror then serves its
+            # documented plain-bucket stance for them).
             self._decline(g.n)
             return False
         from sentinel_tpu.core.slots import SlotChainRegistry
 
         if SlotChainRegistry.slots() and g.custom_veto_mask is None:
             SlotChainRegistry.check_bulk_entry(g)
-        adm, rsn = self.mirror.admit_bulk(
-            g, now_ms, apply_policy=degraded, speculative=True
+        adm, rsn, wait = self.mirror.admit_bulk(
+            g, now_ms, apply_policy=degraded, speculative=True,
+            shaping_servable=servable,
         )
         g.spec_admitted = adm.copy()
         g.spec_degraded = degraded
         g.admitted = adm
         g.reason = rsn
-        g.wait_ms = np.zeros(g.n, dtype=np.int32)
+        g.wait_ms = wait
         n_adm = int(adm.sum())
+        n_sys = int((~adm & (rsn == E.BLOCK_SYSTEM)).sum())
         with self._lock:
             self.counters["spec_admits"] += n_adm
             self.counters["spec_blocks"] += g.n - n_adm
+            if shaped:
+                self.counters["spec_shaped"] += g.n
+            if n_sys:
+                self.counters["spec_system_blocks"] += n_sys
         tele = eng.telemetry
         if tele.enabled:
             tele.note_speculative(n_adm, g.n - n_adm)
+            if shaped:
+                tele.note_spec_shaped(g.n)
+            if n_sys:
+                tele.note_spec_system_block(n_sys)
         return True
+
+    def _bulk_shaping_servable(self, g) -> bool:
+        findex = g.src[0] if g.src is not None else self._engine.flow_index
+        return self.mirror.bulk_shaping_servable(g, findex)
 
     # ------------------------------------------------------------------
     # reconciliation (drain/settle path)
     # ------------------------------------------------------------------
-    def _fold_window_locked(self) -> None:
-        """Close the open drift window; caller holds ``self._lock``.
-        The window's over-admit count lands in the telemetry drift
-        histogram and the running max the differential test reads."""
-        if self._win_start < 0:
-            return
-        # The bound is stated over NET excess admissions: an
-        # over-admit and an under-admit in the same window cancel
-        # in aggregate load (continuous-refill vs window-prefix
-        # ordering makes element-wise mismatches structural even
-        # when both planes admit exactly the threshold). The raw
-        # per-direction counts stay on the counters.
-        net = max(0, self._win_over - self._win_under)
+    def _fold_attr_locked(self, start: int, bucket: list) -> None:
+        """Close one submit-ts accounting window; caller holds
+        ``self._lock`` and has already removed it from ``_attr``. The
+        bound is stated over NET excess admissions: an over-admit and
+        an under-admit in the same window cancel in aggregate load
+        (continuous-refill vs window-prefix ordering makes element-wise
+        mismatches structural even when both planes admit exactly the
+        threshold). The raw per-direction counts stay on the
+        counters."""
+        net = max(0, bucket[0] - bucket[1])
         self.counters["windows"] += 1
         if net > self._max_window_net:
             self._max_window_net = net
         tele = self._engine.telemetry
         if tele.enabled:
             tele.note_spec_window(net)
-        self._win_start = -1
+
+    def _touch_attr_locked(self, ts: int) -> None:
+        """Open the accounting window ``ts`` falls in (so zero-drift
+        windows still reach the histogram's denominator) and fold
+        windows ≥ 2 windows stale — late settles within that horizon
+        attribute to their ARRIVAL window; beyond it, a mismatch
+        reopens its window and that window folds again (a split fold
+        counts twice in ``windows`` and may understate the per-window
+        max by the split — bounded, and far rarer than the settle-lag
+        smearing this replaces)."""
+        start = ts - ts % self.window_ms
+        if start > self._attr_newest:
+            self._attr_newest = start
+            horizon = start - 2 * self.window_ms
+            for s in [s for s in self._attr if s <= horizon]:
+                self._fold_attr_locked(s, self._attr.pop(s))
+        if start not in self._attr:
+            self._attr[start] = [0, 0]
+
+    def _roll_window_locked(self, now_ms: int) -> None:
+        """Advance the valve window (live observation clock) and the
+        accounting horizon; caller holds ``self._lock``."""
+        self._touch_attr_locked(now_ms)
+        start = now_ms - now_ms % self.window_ms
+        if start == self._win_start:
+            return
+        self._win_start = start
         self._win_over = 0
         self._win_under = 0
         self._suspended = False
 
-    def _roll_window_locked(self, now_ms: int) -> None:
-        """Advance the drift window; caller holds ``self._lock``."""
-        start = now_ms - now_ms % self.window_ms
-        if start == self._win_start:
-            return
-        self._fold_window_locked()
-        self._win_start = start
-
     def flush_window(self) -> None:
-        """Fold the open drift window without waiting for later traffic
-        to roll it — Engine.close() calls this so a final-window burst
-        still reaches the histogram and the running max instead of
-        sitting in a never-closed window forever."""
+        """Fold every open accounting window without waiting for later
+        traffic to roll the horizon — Engine.close() calls this so a
+        final-window burst still reaches the histogram and the running
+        max instead of sitting in a never-closed window forever."""
         with self._lock:
-            self._fold_window_locked()
+            for s in list(self._attr):
+                self._fold_attr_locked(s, self._attr.pop(s))
 
-    def _note_mismatch_locked(self, over: int, under: int) -> None:
+    def _note_mismatch_locked(self, ts: int, over: int, under: int) -> None:
+        """One reconciliation mismatch: the valve counts it in the LIVE
+        window (streak detection must react now, whenever the op
+        arrived); the accounting attributes it to the op's submit-ts
+        window."""
         self._win_over += over
         self._win_under += under
         self.counters["over_admits"] += over
         self.counters["under_admits"] += under
+        start = ts - ts % self.window_ms
+        bucket = self._attr.get(start)
+        if bucket is None:
+            bucket = self._attr[start] = [0, 0]
+        bucket[0] += over
+        bucket[1] += under
         if (
             self.overadmit_max > 0
             and self._win_over - self._win_under >= self.overadmit_max
@@ -320,8 +424,19 @@ class SpeculativeAdmitter:
             for ps in op.p_slots:
                 if ps.grade == C.FLOW_GRADE_QPS and ps.prow >= 0:
                     clamped = self.mirror.drain_pbucket(ps.prow) or clamped
+        elif settled.reason == E.BLOCK_SYSTEM and settled.limit_type == "qps":
+            # The host system gate was too generous on the global QPS
+            # dimension (the only consumable one) — draining on OTHER
+            # dimensions would pin the qps bucket empty for mismatches
+            # it never caused; thread drift is handled by the ±1 gauge
+            # compensation, load/cpu read the same sampler on both
+            # planes.
+            clamped = self.mirror.drain_sys_bucket()
         # BLOCK_DEGRADE needs no clamp: the breaker mirror rides every
         # flush while the tier is on, so the next admit reads the flip.
+        # Shaping (pacer) over-admits need no drain either: the settled
+        # latestPassedTime re-anchors the mirror at this same drain
+        # (reconcile_shaping).
         if clamped:
             with self._lock:
                 self.counters["bucket_clamps"] += 1
@@ -340,10 +455,13 @@ class SpeculativeAdmitter:
             self._roll_window_locked(now)
             self.counters["reconciled"] += 1
             if not match:
+                # Attributed to the op's SUBMIT ts: a large settle must
+                # not fold several arrival windows' drift into one
+                # accounting window.
                 if spec_v.admitted:
-                    self._note_mismatch_locked(1, 0)
+                    self._note_mismatch_locked(op.ts, 1, 0)
                 else:
-                    self._note_mismatch_locked(0, 1)
+                    self._note_mismatch_locked(op.ts, 0, 1)
         if not match:
             if spec_v.admitted:
                 self._clamp_for(op, settled)
@@ -364,6 +482,7 @@ class SpeculativeAdmitter:
     def reconcile_bulk(
         self, g, dev_admitted: np.ndarray, dev_reason: np.ndarray,
         dev_slot_ok: Optional[np.ndarray] = None,
+        dev_sys_type: Optional[np.ndarray] = None,
     ) -> None:
         """Vectorized bulk reconcile: mismatch counts, bucket clamps
         (QPS flow rules on over-admits with a flow block settled;
@@ -387,7 +506,17 @@ class SpeculativeAdmitter:
             self._roll_window_locked(now)
             self.counters["reconciled"] += g.n
             if over or under:
-                self._note_mismatch_locked(over, under)
+                # Per-row submit-ts attribution (rows of one group may
+                # span windows when the caller stamped a ts column).
+                ts = np.asarray(g.ts)
+                starts = ts - ts % self.window_ms
+                for s in np.unique(starts[over_m | under_m]):
+                    sel = starts == s
+                    self._note_mismatch_locked(
+                        int(s),
+                        int(over_m[sel].sum()),
+                        int(under_m[sel].sum()),
+                    )
         if over:
             findex = g.src[0] if g.src is not None else eng.flow_index
             flow_m = over_m & (dev_reason == E.BLOCK_FLOW)
@@ -416,6 +545,18 @@ class SpeculativeAdmitter:
                     for prow in rows.tolist():
                         if prow >= 0:
                             self.mirror.drain_pbucket(int(prow))
+            sys_over = over_m & (dev_reason == E.BLOCK_SYSTEM)
+            if sys_over.any():
+                # Same dimension gate as the singles clamp: only a
+                # settled QPS-dimension block empties the host bucket.
+                from sentinel_tpu.runtime.flush import SYS_QPS
+
+                if (
+                    dev_sys_type is None
+                    or (dev_sys_type[sys_over] == SYS_QPS).any()
+                ) and self.mirror.drain_sys_bucket():
+                    with self._lock:
+                        self.counters["bucket_clamps"] += 1
             eng._submit_gauge_comp(g.rows, over)
             with self._lock:
                 self.counters["comp_plus"] += over
@@ -438,10 +579,25 @@ class SpeculativeAdmitter:
         if self.enabled:
             self.mirror.invalidate_rule_mirrors()
 
-    def on_exit(self, resource: str, n: int = 1) -> None:
+    def on_exit(
+        self, resource: str, n: int = 1, rows=None, rt: int = 0,
+        count: int = 0, now_ms: Optional[int] = None,
+        min_rt: Optional[int] = None,
+    ) -> None:
         """Synchronous host release at submit_exit time — the live
-        THREAD counter must track real concurrency, not settle lag."""
-        self.mirror.on_exit(resource, n)
+        THREAD counter (and the system gate's global concurrency/RT
+        window, when ``rows`` marks an inbound entry) must track real
+        concurrency, not settle lag."""
+        self.mirror.on_exit(
+            resource, n, rows=rows, rt=rt, count=count, now_ms=now_ms,
+            min_rt=min_rt,
+        )
+
+    def reconcile_shaping(self, findex, latest, stored, lastfill) -> None:
+        """A drain fetched the settled shaping dyn columns (they ride
+        the coalesced device_get whenever the index has shaping rules):
+        re-anchor the host pacer/ramp mirrors to device truth."""
+        self.mirror.reconcile_shaping(findex, latest, stored, lastfill)
 
     def reset(self) -> None:
         """Engine reset: fresh mirror world + drift accounting."""
@@ -451,6 +607,8 @@ class SpeculativeAdmitter:
             self._win_over = 0
             self._win_under = 0
             self._suspended = False
+            self._attr.clear()
+            self._attr_newest = -1
             self._max_window_net = 0
             for k in self.counters:
                 self.counters[k] = 0
@@ -473,7 +631,9 @@ class SpeculativeAdmitter:
             return self._max_over_admit_locked()
 
     def _max_over_admit_locked(self) -> int:
-        live = max(0, self._win_over - self._win_under)
+        live = max(
+            (max(0, b[0] - b[1]) for b in self._attr.values()), default=0
+        )
         return max(self._max_window_net, live)
 
     def snapshot(self) -> dict:
@@ -486,6 +646,7 @@ class SpeculativeAdmitter:
                 "suspended": self._suspended,
                 "window_over": self._win_over,
                 "window_under": self._win_under,
+                "open_attr_windows": len(self._attr),
                 "max_over_admit_window": self._max_over_admit_locked(),
                 "counters": dict(self.counters),
             }

@@ -328,6 +328,47 @@ def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
             f"{p}_speculative_drift_per_window",
             "Over-admits per closed drift window (speculative vs settled)",
         )
+        out += ctr(
+            f"{p}_speculative_shaped_total",
+            "Shaped (pacer/warm-up) ops served by the host mirror",
+            sc.get("spec_shaped", 0),
+        )
+        out += ctr(
+            f"{p}_speculative_system_blocks_total",
+            "Host system-gate blocks served by the speculative tier",
+            sc.get("spec_system_blocks", 0),
+        )
+
+    # Ingest self-protection valve (runtime/ingest.py).
+    valve = getattr(engine, "ingest", None)
+    if valve is not None:
+        ic = dict(valve.counters)
+        out += _gauge(
+            f"{p}_ingest_armed",
+            "Ingest shed valve armed (any sentinel.tpu.ingest.* bound set)",
+            1 if valve.armed else 0,
+        )
+        out += ctr(
+            f"{p}_ingest_shed_total",
+            "Ops shed at submit by the ingest valve (entries + bulk rows)",
+            ic.get("shed_entries", 0) + ic.get("shed_rows", 0),
+        )
+        out += ctr(
+            f"{p}_ingest_shed_queue_total",
+            "Sheds caused by a pending-queue bound",
+            ic.get("shed_queue", 0),
+        )
+        out += ctr(
+            f"{p}_ingest_shed_deadline_total",
+            "Sheds caused by the verdict-deadline estimate",
+            ic.get("shed_deadline", 0),
+        )
+        if valve.armed:
+            out += _gauge(
+                f"{p}_ingest_estimate_ms",
+                "Estimated verdict latency for an op queued now",
+                round(valve.estimate_ms(), 3),
+            )
 
     # Blocked-resource heavy-hitter sketch (space-saving over the
     # kernel's per-flush top-K): weight = blocked acquire sum.

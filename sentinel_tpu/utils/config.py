@@ -148,6 +148,23 @@ class SentinelConfig:
     # Drift accounting window (engine clock) for the per-window
     # over/under-admit counters and the drift histogram.
     SPECULATIVE_WINDOW_MS = "sentinel.tpu.speculative.drift.window.ms"
+    # Host mirror of the shaping controllers (RateLimiter pacer /
+    # WarmUp token ramp): shaped resources get immediate speculative
+    # verdicts with exact pacing waits instead of declining to the
+    # sync device path. On by default when the tier is on; the off
+    # position restores the PR-6 decline-to-device stance.
+    SPECULATIVE_SHAPING = "sentinel.tpu.speculative.shaping.enabled"
+    # Engine ingest self-protection (runtime/ingest.py): bounded
+    # pending-op/bulk queues with a deadline-aware shedding valve.
+    # Under saturation callers get a fast BLOCK_SHED verdict instead of
+    # unbounded queue growth or indefinite blocking. All three keys
+    # default 0 = disarmed (one attribute read per submit).
+    INGEST_MAX_PENDING = "sentinel.tpu.ingest.max.pending"
+    INGEST_MAX_PENDING_BULK = "sentinel.tpu.ingest.max.pending.bulk"
+    # Shed when the estimated verdict latency (settle-latency EWMA x
+    # (in-flight flushes + 1), the PR-3 flight-recorder signals)
+    # exceeds this deadline.
+    INGEST_DEADLINE_MS = "sentinel.tpu.ingest.deadline.ms"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -186,6 +203,10 @@ class SentinelConfig:
         SPECULATIVE_FLUSH_BATCH: "64",
         SPECULATIVE_OVERADMIT_MAX: "64",
         SPECULATIVE_WINDOW_MS: "1000",
+        SPECULATIVE_SHAPING: "true",
+        INGEST_MAX_PENDING: "0",
+        INGEST_MAX_PENDING_BULK: "0",
+        INGEST_DEADLINE_MS: "0",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

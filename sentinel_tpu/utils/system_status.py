@@ -98,6 +98,15 @@ class SystemStatusSampler:
         with self._lock:
             return self._cpu
 
+    def read(self) -> Tuple[float, float]:
+        """Atomic ``(load, cpu)`` pair under ONE lock acquisition —
+        the kernel's SystemDevice build and the host system gate
+        (runtime/failover.py) both consume the pair; two separate
+        property reads could tear across a sample and gate the two
+        planes on different instants."""
+        with self._lock:
+            return self._load, self._cpu
+
     # Test hook: force values (the reference's tests mock the MXBean).
     def force(self, load: float, cpu: float) -> None:
         with self._lock:

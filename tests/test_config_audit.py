@@ -1,7 +1,9 @@
 """tools/config_audit.py: every sentinel.tpu.* key referenced anywhere
 in sentinel_tpu/ must be declared in utils/config.py DEFAULTS (ISSUE 4
 CI satellite — the sentinel.tpu.trace.* family lands with this guard
-in place)."""
+in place), and every DECLARED key must appear in docs/ARCHITECTURE.md
+(ISSUE 7 satellite — catches the sentinel.tpu.ingest.* /
+speculative.shaping.* families and any future doc drift)."""
 
 import os
 import sys
@@ -11,6 +13,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 import config_audit  # noqa: E402
 
 _PKG_ROOT = os.path.join(os.path.dirname(__file__), "..", "sentinel_tpu")
+_DOC = os.path.join(
+    os.path.dirname(__file__), "..", "docs", "ARCHITECTURE.md"
+)
 
 
 class TestConfigAudit:
@@ -58,7 +63,9 @@ class TestConfigAudit:
         )
         old = sys.argv
         try:
-            sys.argv = ["config_audit.py", "--root", str(tmp_path)]
+            sys.argv = [
+                "config_audit.py", "--root", str(tmp_path), "--doc", _DOC,
+            ]
             assert config_audit.main() == 0
             (tmp_path / "bad.py").write_text('K = "sentinel.tpu.zzz"\n')
             assert config_audit.main() == 1
@@ -66,3 +73,31 @@ class TestConfigAudit:
             assert "sentinel.tpu.zzz" in out
         finally:
             sys.argv = old
+
+
+class TestDocCoverage:
+    def test_every_declared_key_is_documented(self):
+        undocumented = config_audit.audit_docs(_DOC)
+        assert undocumented == [], (
+            f"declared keys missing from ARCHITECTURE.md: {undocumented}"
+        )
+
+    def test_detects_undocumented_key(self, tmp_path):
+        """A doc that only mentions some keys reports the rest — and a
+        family mention covers its members."""
+        doc = tmp_path / "ARCH.md"
+        doc.write_text(
+            "All `sentinel.tpu.ingest.*` keys plus "
+            "`sentinel.tpu.flush.max.batch` are documented here.\n"
+        )
+        undocumented = config_audit.audit_docs(str(doc))
+        # The ingest family is covered by its prefix mention; the
+        # explicit key is covered; everything else reports.
+        assert "sentinel.tpu.ingest.max.pending" not in undocumented
+        assert "sentinel.tpu.ingest.deadline.ms" not in undocumented
+        assert "sentinel.tpu.flush.max.batch" not in undocumented
+        assert "sentinel.tpu.speculative.enabled" in undocumented
+
+    def test_missing_doc_reports_everything(self, tmp_path):
+        undocumented = config_audit.audit_docs(str(tmp_path / "nope.md"))
+        assert "sentinel.tpu.flush.max.batch" in undocumented

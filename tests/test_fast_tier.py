@@ -1,0 +1,297 @@
+"""PR-7 fast-tier coverage: host shaping mirror + host system gate.
+
+The acceptance differentials: (1) host pacer verdicts AND wait-ms
+bit-match the device shaping oracle for acquire==1 at pipeline depths
+{0, 2}, including arrivals spanning token re-fill seconds; (2) with a
+system rule loaded the speculative tier keeps serving — spec_declined
+stays 0 for non-prio ops (it used to be 100%) and the host gate's
+verdicts match the device system check dimension for dimension.
+"""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.rules.system_manager import SystemConfig
+from sentinel_tpu.utils.clock import ManualClock
+from sentinel_tpu.utils.config import config
+from sentinel_tpu.utils.system_status import sampler
+
+
+@pytest.fixture(autouse=True)
+def _config_sandbox():
+    with config._lock:
+        saved = dict(config._runtime)
+    yield
+    with config._lock:
+        config._runtime.clear()
+        config._runtime.update(saved)
+
+
+def _mk_engine(clock, spec=True, depth=0, flush_batch=10000):
+    from sentinel_tpu.runtime.engine import Engine
+
+    config.set(config.SPECULATIVE_ENABLED, "true" if spec else "false")
+    config.set(config.SPECULATIVE_FLUSH_BATCH, str(flush_batch))
+    config.set(config.SPECULATIVE_OVERADMIT_MAX, "0")
+    eng = Engine(clock=clock)
+    eng.pipeline_depth = depth
+    return eng
+
+
+class TestPacerParity:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_rate_limiter_exact_parity_acquire1(self, depth):
+        """Randomized multi-second arrivals against a RateLimiter rule:
+        every host verdict AND pacing wait bit-matches the depth-0
+        device oracle (the shared cost1_ms formula + identical
+        latestPassedTime recurrence), with zero reconciliation drift."""
+        clock = ManualClock(start_ms=0)
+        spec_e = _mk_engine(clock, spec=True, depth=depth)
+        oracle = _mk_engine(clock, spec=False, depth=0)
+        for eng in (spec_e, oracle):
+            eng.set_flow_rules([st.FlowRule(
+                "rl", count=10.0,
+                control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                max_queueing_time_ms=400,
+            )])
+        rng = np.random.default_rng(7)
+        offs = np.sort(rng.integers(0, 3000, 60))
+        got, want = [], []
+        for i, off in enumerate(offs):
+            clock.set_ms(1000 + int(off))
+            _, v = spec_e.entry_sync("rl")
+            assert v.speculative, "shaped ops must be host-served now"
+            got.append((v.admitted, v.wait_ms))
+            _, ov = oracle.entry_sync("rl")
+            want.append((ov.admitted, ov.wait_ms))
+            if i % 7 == 6:
+                spec_e.flush()
+        spec_e.flush()
+        spec_e.drain()
+        assert got == want
+        c = spec_e.speculative.counters
+        assert c["spec_declined"] == 0
+        assert c["over_admits"] == 0 and c["under_admits"] == 0
+        assert c["spec_shaped"] == len(offs)
+
+    def test_rate_limiter_bulk_closed_form_parity(self):
+        """A single-ts uniform-acquire bulk group on a shaped resource
+        is host-served via the closed-form rank math and matches the
+        device oracle exactly (verdicts and waits)."""
+        clock = ManualClock(start_ms=0)
+        spec_e = _mk_engine(clock, spec=True)
+        oracle = _mk_engine(clock, spec=False)
+        for eng in (spec_e, oracle):
+            eng.set_flow_rules([st.FlowRule(
+                "blk", count=20.0,
+                control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER,
+                max_queueing_time_ms=300,
+            )])
+        clock.set_ms(1000)
+        now = clock.now_ms()
+        g = spec_e.submit_bulk("blk", 16, ts=now)
+        assert g.speculative and g.admitted is not None
+        og = oracle.submit_bulk("blk", 16, ts=now)
+        oracle.flush()
+        assert list(g.admitted) == list(og.admitted)
+        assert list(g.wait_ms) == list(og.wait_ms)
+        spec_e.flush()
+        spec_e.drain()
+        c = spec_e.speculative.counters
+        assert c["over_admits"] == 0 and c["under_admits"] == 0
+        # Mixed-ts bulk groups stay device-decided (outside the
+        # closed-form preconditions).
+        ts_col = np.full(8, now, dtype=np.int64)
+        ts_col[4:] += 200
+        g2 = spec_e.submit_bulk("blk", 8, ts=ts_col)
+        assert not g2.speculative
+        spec_e.flush()
+        spec_e.drain()
+
+    def test_warm_up_ramp_parity_across_refill_seconds(self):
+        """WarmUp ramp on the host mirror: burst arrivals in the first
+        half of each second (so the rolling device window aligns with
+        the mirror's per-second pass counters) across 4 token re-fill
+        seconds — verdicts match the oracle exactly, and the ramp
+        actually gates (some blocked, some admitted)."""
+        clock = ManualClock(start_ms=0)
+        spec_e = _mk_engine(clock, spec=True)
+        oracle = _mk_engine(clock, spec=False)
+        for eng in (spec_e, oracle):
+            eng.set_flow_rules([st.FlowRule(
+                "wu", count=10.0,
+                control_behavior=C.CONTROL_BEHAVIOR_WARM_UP,
+                warm_up_period_sec=10,
+            )])
+        got, want = [], []
+        for sec in range(4):
+            for k in range(12):
+                clock.set_ms(1000 + sec * 1000 + k * 30)
+                _, v = spec_e.entry_sync("wu")
+                assert v.speculative
+                spec_e.flush()
+                spec_e.drain()  # settle per op: pass windows align
+                _, ov = oracle.entry_sync("wu")
+                got.append(v.admitted)
+                want.append(ov.admitted)
+        assert got == want
+        assert any(want) and not all(want), "the ramp must actually gate"
+        c = spec_e.speculative.counters
+        assert c["over_admits"] == 0 and c["under_admits"] == 0
+
+    def test_warm_up_batched_settle_drift_bounded(self):
+        """Batched settles de-align the pass windows (the device
+        charges in-batch candidates conservatively); the mirror's drift
+        stays small and the reconcile re-anchors the ramp columns."""
+        clock = ManualClock(start_ms=0)
+        spec_e = _mk_engine(clock, spec=True)
+        oracle = _mk_engine(clock, spec=False)
+        for eng in (spec_e, oracle):
+            eng.set_flow_rules([st.FlowRule(
+                "wub", count=10.0,
+                control_behavior=C.CONTROL_BEHAVIOR_WARM_UP,
+                warm_up_period_sec=10,
+            )])
+        spec_admits = oracle_admits = 0
+        for sec in range(4):
+            for k in range(12):
+                clock.set_ms(1000 + sec * 1000 + k * 30)
+                _, v = spec_e.entry_sync("wub")
+                spec_admits += int(v.admitted)
+                _, ov = oracle.entry_sync("wub")
+                oracle_admits += int(ov.admitted)
+            spec_e.flush()  # one settle per second's burst
+            spec_e.drain()
+        assert abs(spec_admits - oracle_admits) <= 4, (
+            spec_admits, oracle_admits,
+        )
+
+
+class TestHostSystemGate:
+    def test_system_qps_narrows_not_zeroes(self):
+        """The acceptance criterion: a configured system rule narrows
+        the tier's verdicts instead of zeroing it — spec_declined stays
+        0 and the QPS dimension matches the device oracle."""
+        clock = ManualClock(start_ms=0)
+        spec_e = _mk_engine(clock, spec=True)
+        oracle = _mk_engine(clock, spec=False)
+        for eng in (spec_e, oracle):
+            eng.set_flow_rules([st.FlowRule("svc", count=100.0)])
+            eng.set_system_config(SystemConfig(qps=5.0))
+        clock.set_ms(1000)
+        got, want = [], []
+        last = None
+        for _ in range(8):
+            _, v = spec_e.entry_sync("svc", entry_type=C.EntryType.IN)
+            assert v.speculative, "system rule must not zero the tier"
+            got.append(v.admitted)
+            last = v
+            _, ov = oracle.entry_sync("svc", entry_type=C.EntryType.IN)
+            want.append(ov.admitted)
+        assert got == want == [True] * 5 + [False] * 3
+        assert last.reason == E.BLOCK_SYSTEM and last.limit_type == "qps"
+        c = spec_e.speculative.counters
+        assert c["spec_declined"] == 0
+        assert c["spec_system_blocks"] == 3
+        # Outbound traffic bypasses the gate, like the device check.
+        _, v = spec_e.entry_sync("svc")
+        assert v.admitted and v.speculative
+        spec_e.flush()
+        spec_e.drain()
+        assert spec_e.speculative.counters["over_admits"] == 0
+
+    def test_system_thread_gate_with_exits(self):
+        """max_thread on the host gate: strict > on the PRE-increment
+        global gauge (entries 1-3 pass with max_thread=2, the 4th
+        blocks), exits release it synchronously."""
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True)
+        eng.set_flow_rules([st.FlowRule("t", count=100.0)])
+        eng.set_system_config(SystemConfig(max_thread=2))
+        clock.set_ms(1000)
+        ops = []
+        for _ in range(3):
+            op, v = eng.entry_sync("t", entry_type=C.EntryType.IN)
+            assert v.admitted and v.speculative
+            ops.append(op)
+        _, v4 = eng.entry_sync("t", entry_type=C.EntryType.IN)
+        assert not v4.admitted
+        assert v4.reason == E.BLOCK_SYSTEM and v4.limit_type == "thread"
+        for op in ops:
+            eng.submit_exit(op.rows, rt=1, resource="t", speculative=True)
+        _, v5 = eng.entry_sync("t", entry_type=C.EntryType.IN)
+        assert v5.admitted, "exits must release the host gauge"
+        eng.flush()
+        eng.drain()
+
+    def test_system_cpu_gate_reads_the_sampler(self):
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True)
+        eng.set_flow_rules([st.FlowRule("c", count=100.0)])
+        eng.set_system_config(SystemConfig(highest_cpu_usage=0.5))
+        sampler.force(load=-1.0, cpu=0.9)
+        try:
+            clock.set_ms(1000)
+            _, v = eng.entry_sync("c", entry_type=C.EntryType.IN)
+            assert not v.admitted and v.speculative
+            assert v.reason == E.BLOCK_SYSTEM and v.limit_type == "cpu"
+            sampler.force(load=-1.0, cpu=0.1)
+            _, v2 = eng.entry_sync("c", entry_type=C.EntryType.IN)
+            assert v2.admitted
+        finally:
+            sampler.force(load=-1.0, cpu=-1.0)
+        eng.flush()
+        eng.drain()
+
+    def test_degraded_admission_honors_system_gate(self):
+        """The host gate guards DEGRADED admission too: with the
+        device lost, a system QPS rule keeps narrowing the fallback's
+        verdicts (PR 5 ignored system rules entirely while degraded)."""
+        from sentinel_tpu.testing.faults import FaultInjector
+
+        config.set(config.FAILOVER_ENABLED, "true")
+        config.set(config.FAILOVER_RETRY_MS, "100000")
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True)
+        eng.set_flow_rules([st.FlowRule("d", count=100.0)])
+        eng.set_system_config(SystemConfig(qps=3.0))
+        inj = FaultInjector().install(eng)
+        clock.set_ms(1000)
+        inj.fail_fetch(eng.flush_seq + 1)
+        eng.submit_entry("d")
+        eng.flush()
+        assert eng.failover.state == "DEGRADED"
+        clock.set_ms(2500)  # fresh second: bucket refilled
+        verdicts = [
+            eng.entry_sync("d", entry_type=C.EntryType.IN)[1]
+            for _ in range(5)
+        ]
+        assert all(v.degraded for v in verdicts)
+        admitted = [v.admitted for v in verdicts]
+        assert sum(admitted) <= 4, admitted  # ~qps + refill slack
+        blocked = [v for v in verdicts if not v.admitted]
+        assert blocked and all(
+            v.reason == E.BLOCK_SYSTEM for v in blocked
+        )
+
+    def test_bulk_system_gate_matches_oracle(self):
+        clock = ManualClock(start_ms=0)
+        spec_e = _mk_engine(clock, spec=True)
+        oracle = _mk_engine(clock, spec=False)
+        for eng in (spec_e, oracle):
+            eng.set_flow_rules([st.FlowRule("b", count=100.0)])
+            eng.set_system_config(SystemConfig(qps=5.0))
+        clock.set_ms(1000)
+        now = clock.now_ms()
+        g = spec_e.submit_bulk("b", 8, ts=now, entry_type=C.EntryType.IN)
+        assert g.speculative
+        og = oracle.submit_bulk("b", 8, ts=now, entry_type=C.EntryType.IN)
+        oracle.flush()
+        assert list(g.admitted) == list(og.admitted)
+        assert (g.reason[~g.admitted] == E.BLOCK_SYSTEM).all()
+        spec_e.flush()
+        spec_e.drain()
+        assert spec_e.speculative.counters["over_admits"] == 0

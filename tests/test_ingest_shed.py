@@ -1,0 +1,244 @@
+"""Engine ingest self-protection (runtime/ingest.py) — the shed valve.
+
+The acceptance saturation test: with settlement stalled, pending
+queues stay within the configured bound, callers receive fast distinct
+BLOCK_SHED verdicts (never indefinite blocking, never unbounded queue
+growth), and after recovery everything drains with thread gauges
+exactly 0. Plus provenance coverage: trace records, block-log rows,
+telemetry/Prometheus counters.
+"""
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.utils.clock import ManualClock
+from sentinel_tpu.utils.config import config
+
+
+@pytest.fixture(autouse=True)
+def _config_sandbox():
+    with config._lock:
+        saved = dict(config._runtime)
+    yield
+    with config._lock:
+        config._runtime.clear()
+        config._runtime.update(saved)
+
+
+def _mk_engine(clock, spec=False, max_pending=0, max_pending_bulk=0,
+               deadline_ms=0):
+    from sentinel_tpu.runtime.engine import Engine
+
+    config.set(config.SPECULATIVE_ENABLED, "true" if spec else "false")
+    config.set(config.SPECULATIVE_FLUSH_BATCH, "100000")
+    config.set(config.INGEST_MAX_PENDING, str(max_pending))
+    config.set(config.INGEST_MAX_PENDING_BULK, str(max_pending_bulk))
+    config.set(config.INGEST_DEADLINE_MS, str(deadline_ms))
+    return Engine(clock=clock)
+
+
+class TestQueueBound:
+    def test_saturation_sheds_and_recovers_with_zero_gauges(self):
+        """The acceptance test: settlement stalled (nothing flushes),
+        the entry queue saturates at the bound, every further caller
+        gets BLOCK_SHED immediately, and after the stall lifts the
+        backlog settles + exits drain both gauges to exactly 0."""
+        bound = 16
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True, max_pending=bound)
+        eng.set_flow_rules(
+            [st.FlowRule("t", grade=C.FLOW_GRADE_THREAD, count=100)]
+        )
+        clock.set_ms(1000)
+        live, shed = [], 0
+        for _ in range(100):
+            op, v = eng.entry_sync("t")
+            assert v is not None
+            if v.reason == E.BLOCK_SHED:
+                shed += 1
+                assert not v.admitted
+            elif v.admitted:
+                live.append(op)
+            # The hard bound: the pending queue never exceeds it.
+            assert len(eng._entries) <= bound
+        assert shed == 100 - bound, shed
+        assert len(live) == bound
+        assert eng.ingest.counters["shed_entries"] == shed
+        assert eng.ingest.counters["shed_queue"] == shed
+        # Stall lifts: settle the backlog, exit every live caller.
+        eng.flush()
+        eng.drain()
+        for op in live:
+            eng.submit_exit(op.rows, rt=1, resource="t", speculative=True)
+        eng.flush()
+        eng.drain()
+        stats = eng.cluster_node_stats("t")
+        assert stats["cur_thread_num"] == 0, "device gauge must be 0"
+        mirror = eng.speculative.mirror.snapshot()["live_threads"]
+        assert mirror.get("t", 0) == 0, "mirror gauge must be 0"
+        # Queue drained: admission resumes without shedding.
+        _, v = eng.entry_sync("t")
+        assert v.reason != E.BLOCK_SHED and v.admitted
+        eng.flush()
+        eng.drain()
+
+    def test_bulk_bound(self):
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, max_pending_bulk=64)
+        eng.set_flow_rules([st.FlowRule("b", count=1e9)])
+        clock.set_ms(1000)
+        g1 = eng.submit_bulk("b", 48)
+        assert g1 is not None
+        # 48 + 32 > 64: the group sheds whole (dense arrays, no queue).
+        g2 = eng.submit_bulk("b", 32)
+        assert (g2.reason == E.BLOCK_SHED).all()
+        assert g2.admitted_count == 0
+        assert eng.ingest.counters["shed_rows"] == 32
+        eng.flush()
+        eng.drain()
+        assert g1.admitted_count == 48
+        # Drained: the next group admits.
+        g3 = eng.submit_bulk("b", 32)
+        assert (g3.reason != E.BLOCK_SHED).all() if g3.reason is not None else True
+        eng.flush()
+        eng.drain()
+        assert g3.admitted_count == 32
+
+    def test_exits_are_never_shed(self):
+        """Completions must drain even under a saturated entry queue —
+        shedding them would leak the thread gauge forever."""
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True, max_pending=2)
+        eng.set_flow_rules(
+            [st.FlowRule("x", grade=C.FLOW_GRADE_THREAD, count=10)]
+        )
+        clock.set_ms(1000)
+        ops = []
+        for _ in range(4):
+            op, v = eng.entry_sync("x")
+            if v.admitted and v.reason != E.BLOCK_SHED:
+                ops.append(op)
+        assert len(ops) == 2
+        for op in ops:
+            eng.submit_exit(op.rows, rt=1, resource="x", speculative=True)
+        assert len(eng._exits) == 2, "exits must enqueue regardless"
+        eng.flush()
+        eng.drain()
+        assert eng.cluster_node_stats("x")["cur_thread_num"] == 0
+
+    def test_submit_many_sheds_only_the_overflow(self):
+        """A batch on an idle engine admits up to the bound and sheds
+        exactly the overflow — the per-op path would behave the same,
+        so batch submission must not over-shed (flush-on-size drains
+        the queue mid-batch; only live depth matters)."""
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, max_pending=4)
+        eng.set_flow_rules([st.FlowRule("m", count=1e9)])
+        clock.set_ms(1000)
+        ops = eng.submit_many([{"resource": "m"} for _ in range(8)])
+        shed = [op for op in ops
+                if op._verdict is not None
+                and op._verdict.reason == E.BLOCK_SHED]
+        assert len(shed) == 4 and len(eng._entries) == 4
+        eng.flush()
+        eng.drain()
+        assert all(
+            op.verdict is not None and op.verdict.admitted
+            for op in ops if op not in shed
+        )
+        # Saturated queue: the whole batch sheds immediately.
+        for _ in range(4):
+            eng.submit_entry("m")
+        ops2 = eng.submit_many([{"resource": "m"} for _ in range(3)])
+        assert all(
+            op._verdict is not None
+            and op._verdict.reason == E.BLOCK_SHED
+            for op in ops2
+        )
+        eng.flush()
+        eng.drain()
+
+
+class TestDeadline:
+    def test_deadline_shed_and_recovery(self):
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, deadline_ms=50)
+        eng.set_flow_rules([st.FlowRule("d", count=1e9)])
+        clock.set_ms(1000)
+        eng.ingest.force_latency_ms(200.0)
+        op, v = eng.entry_sync("d")
+        assert v.reason == E.BLOCK_SHED and v.limit_type == "deadline"
+        assert eng.ingest.counters["shed_deadline"] == 1
+        eng.ingest.force_latency_ms(None)
+        _, v2 = eng.entry_sync("d")
+        assert v2.reason != E.BLOCK_SHED and v2.admitted
+        eng.flush()
+        eng.drain()
+
+    def test_settle_latency_feeds_the_ewma(self):
+        """Real flushes feed the estimate — the valve reads the PR-3
+        flight-recorder signal, not a synthetic knob."""
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, deadline_ms=100000)  # armed, huge
+        eng.set_flow_rules([st.FlowRule("e", count=1e9)])
+        clock.set_ms(1000)
+        for _ in range(4):
+            eng.submit_entry("e")
+        eng.flush()
+        eng.drain()
+        assert eng.ingest.snapshot()["settle_ewma_ms"] > 0.0
+
+
+class TestProvenance:
+    def test_trace_and_blocklog_and_prometheus(self):
+        config.set(config.TRACE_SAMPLE_RATE, "1.0")
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, max_pending=1)
+        eng.set_flow_rules([st.FlowRule("p", count=1e9)])
+        clock.set_ms(1000)
+        eng.submit_entry("p")      # fills the queue
+        op, v = eng.entry_sync("p")  # shed
+        assert v.reason == E.BLOCK_SHED
+        recs = [
+            r for r in eng.admission_trace.records(resource="p")
+            if r.provenance == "shed"
+        ]
+        assert recs and not recs[0].admitted
+        assert recs[0].reason_name == "IngestShedException"
+        eng.block_log.flush()
+        names = {k[1] for _, k, _ in eng.block_log.read_entries()}
+        assert "IngestShedException" in names
+        assert eng.telemetry.counters_snapshot()["ingest_shed"] == 1
+        from sentinel_tpu.transport.prometheus import engine_telemetry_lines
+
+        text = "\n".join(engine_telemetry_lines(eng))
+        assert "sentinel_engine_ingest_shed_total 1" in text
+        assert "sentinel_engine_ingest_armed 1" in text
+        eng.flush()
+        eng.drain()
+
+    def test_api_entry_raises_ingest_shed_error(self, manual_clock):
+        config.set(config.INGEST_MAX_PENDING, "1")
+        from sentinel_tpu.core import api
+
+        eng = api.reset(clock=manual_clock)
+        st.flow_rule_manager.load_rules([st.FlowRule("api", count=1e9)])
+        manual_clock.set_ms(1000)
+        eng.submit_entry("api")  # fills the queue
+        with pytest.raises(E.IngestShedError):
+            st.entry("api")
+        eng.flush()
+        eng.drain()
+
+    def test_disarmed_is_free_and_unchanged(self):
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock)
+        assert not eng.ingest.armed
+        eng.set_flow_rules([st.FlowRule("z", count=5)])
+        clock.set_ms(1000)
+        vs = [eng.entry_sync("z")[1].admitted for _ in range(7)]
+        assert vs == [True] * 5 + [False] * 2
+        assert eng.ingest.counters["shed_entries"] == 0

@@ -99,16 +99,33 @@ class TestFastPath:
         ])
         clock.set_ms(1000)
         # Prioritized entries have occupy semantics only the device
-        # implements.
+        # implements — the one remaining device-only class (PR 7).
         _, v = eng.entry_sync("plain", prio=True)
         assert not v.speculative
-        # Shaping-governed resources pace on-device.
+        # Shaping-governed resources are HOST-served since PR 7 (the
+        # pacer mirror) — no decline, immediate verdict.
         _, v = eng.entry_sync("shaped")
-        assert not v.speculative
-        assert eng.speculative.counters["spec_declined"] >= 2
+        assert v.speculative
+        assert eng.speculative.counters["spec_declined"] >= 1
+        assert eng.speculative.counters["spec_shaped"] >= 1
         # Plain traffic stays speculative.
         _, v = eng.entry_sync("plain")
         assert v.speculative
+
+    def test_shaping_mirror_off_restores_decline(self):
+        """sentinel.tpu.speculative.shaping.enabled=false restores the
+        PR-6 stance: shaped resources decline to the sync device path."""
+        config.set(config.SPECULATIVE_SHAPING, "false")
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True)
+        eng.set_flow_rules([
+            st.FlowRule("shaped", count=100,
+                        control_behavior=C.CONTROL_BEHAVIOR_RATE_LIMITER),
+        ])
+        clock.set_ms(1000)
+        _, v = eng.entry_sync("shaped")
+        assert not v.speculative
+        assert eng.speculative.counters["spec_declined"] >= 1
 
     def test_bulk_immediate_and_reconciled(self):
         clock = ManualClock(start_ms=0)

@@ -1,25 +1,33 @@
-"""Audit ``sentinel.tpu.*`` config keys against utils/config.py.
+"""Audit ``sentinel.tpu.*`` config keys against utils/config.py + docs.
 
-Every ``sentinel.tpu.*`` key referenced anywhere under ``sentinel_tpu/``
-(code, docstrings, comments — a key mentioned in prose is a key an
-operator will try to set) must be declared in
-``SentinelConfig.DEFAULTS``. A key that is a strict PREFIX of declared
-keys (a family mention like ``sentinel.tpu.host.arena`` standing for
-``…arena.max.keys`` / ``…arena.per.key``, usually written with a
-trailing ``.*``) also passes.
+Two checks:
 
-This is the guard that lets a new key family (like this PR's
-``sentinel.tpu.trace.*``) land safely: referencing a key the config
-registry doesn't declare fails CI instead of silently reading the
-hard-coded fallback default forever.
+* **declaration** — every ``sentinel.tpu.*`` key referenced anywhere
+  under ``sentinel_tpu/`` (code, docstrings, comments — a key mentioned
+  in prose is a key an operator will try to set) must be declared in
+  ``SentinelConfig.DEFAULTS``. A key that is a strict PREFIX of
+  declared keys (a family mention like ``sentinel.tpu.host.arena``
+  standing for ``…arena.max.keys`` / ``…arena.per.key``, usually
+  written with a trailing ``.*``) also passes.
+* **documentation** — every DECLARED ``sentinel.tpu.*`` key must appear
+  in ``docs/ARCHITECTURE.md``, either spelled out or covered by a
+  family mention (``sentinel.tpu.ingest.*`` covers every
+  ``sentinel.tpu.ingest.…`` key). A key an operator cannot find in the
+  architecture doc is a key that drifts.
+
+This is the guard that lets a new key family (like
+``sentinel.tpu.ingest.*`` / ``sentinel.tpu.speculative.shaping.*``)
+land safely: referencing a key the config registry doesn't declare —
+or declaring one the docs never mention — fails CI instead of rotting
+silently.
 
 Usage::
 
-    python tools/config_audit.py [--root sentinel_tpu]
+    python tools/config_audit.py [--root sentinel_tpu] [--doc docs/ARCHITECTURE.md]
 
 Exit status 0 when clean; 1 with a per-key report otherwise. The
-programmatic surface (``audit()``) is what tests/test_config_audit.py
-asserts on.
+programmatic surface (``audit()`` / ``audit_docs()``) is what
+tests/test_config_audit.py asserts on.
 """
 
 from __future__ import annotations
@@ -81,24 +89,58 @@ def audit(root: str = "sentinel_tpu") -> Tuple[List[str], Dict[str, List[str]]]:
     return sorted(missing), refs
 
 
+def audit_docs(doc_path: str = "docs/ARCHITECTURE.md") -> List[str]:
+    """Declared ``sentinel.tpu.*`` keys NOT mentioned (directly or via
+    a family prefix like ``sentinel.tpu.ingest.*``) in the architecture
+    doc — sorted; empty when clean. A missing/unreadable doc reports
+    every key (a deleted doc must not read as 'all documented')."""
+    try:
+        with open(doc_path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        text = ""
+    mentioned = set(_KEY_RE.findall(text))
+    undocumented = []
+    for key in declared_keys():
+        if not key.startswith("sentinel.tpu."):
+            continue
+        if key in mentioned:
+            continue
+        # A family mention covers its members: "sentinel.tpu.ingest.*"
+        # is captured as "sentinel.tpu.ingest" by the key regex.
+        if any(key.startswith(m + ".") for m in mentioned):
+            continue
+        undocumented.append(key)
+    return sorted(undocumented)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default="sentinel_tpu")
+    ap.add_argument("--doc", default="docs/ARCHITECTURE.md")
     args = ap.parse_args()
     missing, refs = audit(args.root)
+    undocumented = audit_docs(args.doc)
     n_refs = sum(len(v) for v in refs.values())
-    if not missing:
+    if not missing and not undocumented:
         print(
             f"config audit OK: {len(refs)} distinct sentinel.tpu.* keys "
-            f"({n_refs} mentions) all declared in utils/config.py"
+            f"({n_refs} mentions) all declared in utils/config.py and "
+            f"documented in {args.doc}"
         )
         return 0
-    print("config audit FAILED — referenced but not declared in "
-          "SentinelConfig.DEFAULTS:")
-    for key in missing:
-        locs = refs[key]
-        shown = ", ".join(locs[:3]) + (" …" if len(locs) > 3 else "")
-        print(f"  {key}  ({shown})")
+    if missing:
+        print("config audit FAILED — referenced but not declared in "
+              "SentinelConfig.DEFAULTS:")
+        for key in missing:
+            locs = refs[key]
+            shown = ", ".join(locs[:3]) + (" …" if len(locs) > 3 else "")
+            print(f"  {key}  ({shown})")
+    if undocumented:
+        print(f"config audit FAILED — declared but not documented in "
+              f"{args.doc}:")
+        for key in undocumented:
+            print(f"  {key}")
     return 1
 
 

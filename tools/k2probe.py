@@ -241,8 +241,47 @@ def main() -> None:
             lats.sort()
             report("spec_entry_p50", lats[len(lats) // 2])
             report("spec_entry_p99", lats[int(len(lats) * 0.99)])
+
+            # System gate overhead (PR 7): the same host fast path with
+            # a wide-open system rule configured — the delta vs
+            # spec_entry_* is the gate's per-entry cost.
+            from sentinel_tpu.models import constants as _C
+            from sentinel_tpu.rules.system_manager import SystemConfig
+
+            seng.set_system_config(SystemConfig(qps=1e12))
+            lats = []
+            for r in range(args.iters):
+                for i in range(512):
+                    t0 = time.perf_counter()
+                    seng.entry_sync(f"s{i % 8}", entry_type=_C.EntryType.IN)
+                    lats.append(time.perf_counter() - t0)
+                seng.flush()
+            seng.drain()
+            seng.set_system_config(None)
+            lats.sort()
+            report("spec_entry_sys_p50", lats[len(lats) // 2])
+            report("spec_entry_sys_p99", lats[int(len(lats) * 0.99)])
+
+            # Ingest shed fast path (PR 7): verdict latency when the
+            # valve sheds — the under-saturation floor.
+            from sentinel_tpu.runtime.ingest import IngestValve
+
+            _cfg.set(_cfg.INGEST_DEADLINE_MS, "1")
+            seng.ingest = IngestValve(seng)
+            seng.ingest.force_latency_ms(1000.0)
+            lats = []
+            for i in range(2048):
+                t0 = time.perf_counter()
+                seng.entry_sync(f"s{i % 8}")
+                lats.append(time.perf_counter() - t0)
+            _cfg.set(_cfg.INGEST_DEADLINE_MS, "0")
+            seng.ingest = IngestValve(seng)
+            lats.sort()
+            report("shed_entry_p50", lats[len(lats) // 2])
+            report("shed_entry_p99", lats[int(len(lats) * 0.99)])
         finally:
             _cfg.set(_cfg.SPECULATIVE_ENABLED, "false")
+            _cfg.set(_cfg.INGEST_DEADLINE_MS, "0")
     except Exception as exc:
         print(f"[k2probe] speculative stage skipped: {exc}", file=sys.stderr)
 
