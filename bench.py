@@ -805,6 +805,12 @@ def main() -> None:
     ap.add_argument("--probe-attempts", type=int,
                     default=int(os.environ.get("SENTINEL_BENCH_PROBE_ATTEMPTS", 5)))
     ap.add_argument("--platform", default=None, help="skip the probe and force a platform")
+    ap.add_argument(
+        "--gate", action="store_true",
+        help="after the run, compare against the newest committed "
+             "BENCH_*.json with the same device_kind+jax_version "
+             "(tools/benchgate.py) and exit non-zero on regression",
+    )
     ap.add_argument("--run-stage", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--kind", default="kernel", help=argparse.SUPPRESS)
     ap.add_argument("--rules", type=int, default=0, help=argparse.SUPPRESS)
@@ -951,6 +957,8 @@ def main() -> None:
                 "error": "no ladder stage completed (backend unavailable or budget exhausted)",
             }
         )
+        if args.gate:
+            sys.exit(1)  # nothing measured: the gate must not read as green
         return
     if best.get("platform") == "cpu" and (
         probe_fell_back or requested_platform != "cpu"
@@ -961,6 +969,23 @@ def main() -> None:
         # the probe passed and the stages then died/landed on CPU.
         best["evidence"] = "weak: cpu fallback, tpu unreachable after retries"
     _emit(best)
+    if args.gate:
+        # Regression gate against the committed BENCH trajectory
+        # (tools/benchgate.py): report on stderr — the one-JSON-line
+        # stdout contract above must survive a gated run.
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools")
+        )
+        import contextlib
+
+        import benchgate
+
+        with contextlib.redirect_stdout(sys.stderr):
+            rc = benchgate.gate(
+                best, os.path.dirname(os.path.abspath(__file__))
+            )
+        if rc:
+            sys.exit(rc)
 
 
 if __name__ == "__main__":

@@ -39,6 +39,14 @@ class MachineInfo:
     port: int
     hostname: str = ""
     version: str = ""
+    # Admission-plane health fields from the enriched heartbeat
+    # (transport/heartbeat.py); empty/zero for seed-era senders.
+    health: str = ""
+    spec_enabled: int = 0
+    spec_suspended: int = 0
+    ingest_armed: int = 0
+    shed_total: int = 0
+    shedding: int = 0
     last_heartbeat_ms: float = field(default_factory=lambda: time.time() * 1000)
 
     @property
@@ -62,6 +70,9 @@ class AppManagement:
             if existing is not None:
                 existing.last_heartbeat_ms = time.time() * 1000
                 existing.version = info.version or existing.version
+                for f in ("health", "spec_enabled", "spec_suspended",
+                          "ingest_armed", "shed_total", "shedding"):
+                    setattr(existing, f, getattr(info, f))
             else:
                 self._machines[info.key] = info
 
@@ -340,6 +351,12 @@ class DashboardServer:
     # ---- request handling ----
     def _handle(self, path: str, params: Dict[str, str]) -> Tuple[int, str]:
         if path == "/registry/machine":
+            def _i(key: str) -> int:
+                try:
+                    return int(params.get(key, 0) or 0)
+                except ValueError:
+                    return 0  # enrichment fields degrade, never 400
+
             try:
                 info = MachineInfo(
                     app=params.get("app", "unknown"),
@@ -347,6 +364,12 @@ class DashboardServer:
                     port=int(params.get("port", 0)),
                     hostname=params.get("hostname", ""),
                     version=params.get("version", params.get("v", "")),
+                    health=params.get("health", ""),
+                    spec_enabled=_i("spec_enabled"),
+                    spec_suspended=_i("spec_suspended"),
+                    ingest_armed=_i("ingest_armed"),
+                    shed_total=_i("shed_total"),
+                    shedding=_i("shedding"),
                 )
             except ValueError:
                 return 400, json.dumps({"code": -1, "msg": "bad port"})
@@ -356,7 +379,29 @@ class DashboardServer:
             return 200, json.dumps(
                 {
                     app: [
-                        {"ip": m.ip, "port": m.port, "healthy": m.is_healthy()}
+                        {
+                            "ip": m.ip,
+                            "port": m.port,
+                            "hostname": m.hostname,
+                            "version": m.version,
+                            "healthy": m.is_healthy(),
+                            "stale": not m.is_healthy(),
+                            "health": m.health,
+                            "spec_enabled": m.spec_enabled,
+                            "spec_suspended": m.spec_suspended,
+                            "ingest_armed": m.ingest_armed,
+                            "shed_total": m.shed_total,
+                            "shedding": m.shedding,
+                            "last_heartbeat_ms": int(m.last_heartbeat_ms),
+                            # Server-computed age: the console must not
+                            # mix its own clock with the dashboard's
+                            # (skew would corrupt the "Ns ago" column).
+                            "heartbeat_age_ms": max(
+                                0,
+                                int(time.time() * 1000
+                                    - m.last_heartbeat_ms),
+                            ),
+                        }
                         for m in machines
                     ]
                     for app, machines in self.apps.apps().items()

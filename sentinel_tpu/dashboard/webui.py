@@ -55,6 +55,8 @@ CONSOLE_HTML = """<!DOCTYPE html>
     padding:8px; font-weight:600; cursor:pointer; }
   #loginerr { color:var(--bad); font-size:12px; min-height:14px; }
   td.mode-server { color:var(--accent); } td.mode-client { color:var(--ok); }
+  tr.stale td { opacity:.45; }
+  td.warn { color:#e0a95d; }
   #cluster button { background:var(--panel); color:var(--fg); border:1px solid var(--line);
     border-radius:6px; padding:4px 10px; cursor:pointer; }
   #cluster button:hover { border-color:var(--accent); }
@@ -73,9 +75,15 @@ CONSOLE_HTML = """<!DOCTYPE html>
 <main>
   <nav><h2>Applications</h2><div id="apps" class="empty">loading…</div></nav>
   <section>
+    <h2>Machines</h2>
+    <table id="machines"><thead><tr>
+      <th>machine</th><th>version</th><th>health</th><th>speculative</th>
+      <th>shed</th><th>heartbeat</th>
+    </tr></thead><tbody></tbody></table>
     <h2>Real-time metrics <span id="appname"></span></h2>
     <table id="metrics"><thead><tr>
-      <th>resource</th><th>pass/s</th><th>block/s</th><th>rt ms</th>
+      <th>resource</th><th>pass/s</th><th>block/s</th><th>spec/s</th>
+      <th>shed/s</th><th>drift</th><th>rt ms</th>
       <th>threads</th><th>trend (60s)</th>
     </tr></thead><tbody></tbody></table>
     <h2>Cluster</h2>
@@ -121,12 +129,45 @@ async function doLogin(ev) {
 const esc = (s) => String(s).replace(/[&<>"']/g,
   c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
 
+// Enriched-heartbeat machine table (health / speculative tier / shed
+// valve, stale machines dimmed + flagged). Numbers coerced, strings
+// escaped — heartbeat fields arrive from the auth-exempt registry.
+function renderMachines(ms) {
+  const body = $('machines').tBodies[0];
+  const num = (v) => (Number.isFinite(+v) ? +v : 0);
+  body.innerHTML = (ms || []).map(m => {
+    const stale = !m.healthy;
+    const health = m.health || '';
+    // An empty health string means the machine never reported the
+    // enrichment fields (seed-era sender / engine not constructed):
+    // render UNKNOWN ("—"), never a confident-looking default.
+    const reported = !!health;
+    const spec = !reported ? '—'
+      : m.spec_enabled ? (m.spec_suspended ? 'suspended' : 'on') : 'off';
+    const shed = !reported ? '—'
+      : `${num(m.shed_total)}${m.shedding ? ' (shedding)' : ''}` +
+        `${m.ingest_armed ? '' : ' (disarmed)'}`;
+    const hcls = stale || health === 'DEGRADED' ? 'block'
+      : health === 'RECOVERING' ? 'warn' : reported ? 'pass' : '';
+    const hb = m.heartbeat_age_ms != null
+      ? Math.round(num(m.heartbeat_age_ms) / 1000) + 's ago'  // server-computed: immune to browser clock skew
+      : '—';
+    return `<tr class="${stale ? 'stale' : ''}">` +
+      `<td>${esc(m.ip)}:${num(m.port)}</td><td>${esc(m.version || '')}</td>` +
+      `<td class="${hcls}">${esc(health || '—')}${stale ? ' (stale)' : ''}</td>` +
+      `<td class="${m.spec_suspended ? 'warn' : ''}">${spec}</td>` +
+      `<td class="${m.shedding ? 'block' : ''}">${shed}</td>` +
+      `<td>${hb}</td></tr>`;
+  }).join('') || '<tr><td colspan="6" class="empty">no machines</td></tr>';
+}
+
 async function refreshApps() {
   try {
     const apps = await fetchJson('/apps');
     const names = Object.keys(apps);
     const el = $('apps');
-    if (!names.length) { el.className = 'empty'; el.textContent = 'no apps registered'; return; }
+    if (!names.length) { el.className = 'empty'; el.textContent = 'no apps registered';
+      renderMachines([]); return; }
     el.className = '';
     if (!app || !names.includes(app)) app = names[0];
     el.innerHTML = names.map((n, i) => {
@@ -137,6 +178,7 @@ async function refreshApps() {
     el.querySelectorAll('button').forEach(b =>
       b.addEventListener('click', () => selectApp(names[+b.dataset.i])));
     $('appname').textContent = '— ' + app;
+    renderMachines(apps[app]);
     if (!rulesLoaded) { rulesLoaded = true; loadRules(); }
   } catch (e) { $('status').textContent = 'apps: ' + e; }
 }
@@ -216,14 +258,19 @@ async function refreshMetrics() {
     const body = $('metrics').tBodies[0];
     const rows = Object.keys(latest).sort().map(r => {
       const n = latest[r];
+      const drift = n.drift ?? 0;
       return `<tr><td class="res">${esc(r)}</td><td class="pass">${n.pass_qps}</td>` +
-        `<td class="block">${n.block_qps}</td><td>${(n.rt ?? 0).toFixed(1)}</td>` +
+        `<td class="block">${n.block_qps}</td>` +
+        `<td>${n.speculative_qps ?? 0}</td>` +
+        `<td class="${(n.shed_qps ?? 0) > 0 ? 'block' : ''}">${n.shed_qps ?? 0}</td>` +
+        `<td class="${drift !== 0 ? 'warn' : ''}">${drift}</td>` +
+        `<td>${(n.rt ?? 0).toFixed(1)}</td>` +
         `<td>${n.concurrency ?? 0}</td>` +
         `<td><svg class="spark" width="120" height="20">` +
         spark(hist[r], 'pass', 'var(--ok)') + spark(hist[r], 'block', 'var(--bad)') +
         `</svg></td></tr>`;
     });
-    body.innerHTML = rows.join('') || '<tr><td colspan="6" class="empty">no traffic yet</td></tr>';
+    body.innerHTML = rows.join('') || '<tr><td colspan="9" class="empty">no traffic yet</td></tr>';
     $('status').textContent = 'updated ' + new Date().toLocaleTimeString();
   } catch (e) { $('status').textContent = 'metrics: ' + e; }
 }

@@ -20,6 +20,7 @@ from sentinel_tpu.metrics.block_log import BlockLogger
 from sentinel_tpu.metrics.events import MetricEvent, NUM_EVENTS
 from sentinel_tpu.metrics.extension import MetricExtension, MetricExtensionProvider
 from sentinel_tpu.metrics.histogram import LatencyHistogram
+from sentinel_tpu.metrics.provenance import OTHER_RESOURCE, ResourceProvenance
 from sentinel_tpu.metrics.telemetry import (
     FlushSpan,
     SpaceSaving,
@@ -48,6 +49,8 @@ __all__ = [
     "LatencyHistogram",
     "MetricExtension",
     "MetricExtensionProvider",
+    "OTHER_RESOURCE",
+    "ResourceProvenance",
     "SpaceSaving",
     "TelemetryBus",
     "spans_to_trace",

@@ -12,6 +12,23 @@ carry over::
 
     timestamp|yyyy-MM-dd HH:mm:ss|resource|passQps|blockQps|successQps|
     exceptionQps|rt|occupiedPassQps|concurrency|classification
+
+**Line-format versioning rule**: the seed format above is version 1
+(11 fields, no version tag). Later versions append a numeric version
+tag as field 12 followed by that version's extra columns, and NEVER
+reorder or remove the seed fields — so a v1 parser keeps reading v2
+files (it stops at field 11) and this reader parses v1 files (missing
+tail = zeros). Version 2 (this PR) appends the two-tier admission
+provenance columns::
+
+    …|classification|2|speculativeQps|degradedQps|shedQps|drift
+
+``speculative``/``degraded``/``shed`` are acquire-weighted per-second
+serves by verdict provenance (not disjoint: a speculative serve while
+DEGRADED counts in both); ``drift`` is the signed per-resource net
+over-admit of the speculative tier, attributed — like every column
+since PR 8 — to each op's **submit-ts second**, so depth-K pipelining
+cannot smear one arrival second across its drain seconds.
 """
 
 from __future__ import annotations
@@ -43,8 +60,17 @@ class MetricNodeLine:
     occupied_pass_qps: int = 0
     concurrency: int = 0
     classification: int = 0
+    # v2 provenance columns (see module doc): acquire-weighted serves
+    # by verdict provenance, plus signed net speculative over-admit.
+    speculative_qps: int = 0
+    degraded_qps: int = 0
+    shed_qps: int = 0
+    drift: int = 0
 
     SEPARATOR = "|"
+    # Written format version; readers accept any ≤ this (missing tail
+    # columns parse as zeros) per the versioning rule in the module doc.
+    FORMAT_VERSION = 2
 
     def to_line(self) -> str:
         ts_str = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(self.timestamp / 1000))
@@ -63,6 +89,11 @@ class MetricNodeLine:
                 self.occupied_pass_qps,
                 self.concurrency,
                 self.classification,
+                self.FORMAT_VERSION,
+                self.speculative_qps,
+                self.degraded_qps,
+                self.shed_qps,
+                self.drift,
             )
         )
 
@@ -72,7 +103,7 @@ class MetricNodeLine:
         if len(parts) < 11:
             return None
         try:
-            return cls(
+            node = cls(
                 timestamp=int(parts[0]),
                 resource=parts[2],
                 pass_qps=int(parts[3]),
@@ -86,6 +117,24 @@ class MetricNodeLine:
             )
         except ValueError:
             return None
+        # Versioned extension tail: a malformed/unknown tail degrades to
+        # the seed view of the line, never to a dropped line. All four
+        # columns parse before any assigns — a mid-tail corruption must
+        # not leave a half-applied hybrid of the two views.
+        if len(parts) >= 16:
+            try:
+                if int(parts[11]) >= 2:
+                    spec, degr, shed, drift = (
+                        int(parts[12]), int(parts[13]), int(parts[14]),
+                        int(parts[15]),
+                    )
+                    node.speculative_qps = spec
+                    node.degraded_qps = degr
+                    node.shed_qps = shed
+                    node.drift = drift
+            except ValueError:
+                pass
+        return node
 
 
 class MetricWriter:
@@ -301,6 +350,15 @@ class MetricTimer:
     def collect(self) -> List[MetricNodeLine]:
         engine = self.engine
         engine.flush()
+        # Settle every dispatched-but-unfetched flush before reading:
+        # window updates land at each op's SUBMIT ts, so once the
+        # pipeline is drained a completed second's buckets are final —
+        # without this, depth-K pipelining leaves the newest second's
+        # in-flight ops invisible to exactly one pull and their counts
+        # are then lost behind _last_written_sec (QPS smeared/dropped
+        # across seconds). One coalesced fetch per pull, off the hot
+        # path.
+        engine.drain()
         now_rel = engine.clock.now_ms()
         # Complete seconds only (the current second is still filling).
         upto = now_rel // 1000 * 1000
@@ -363,6 +421,27 @@ class MetricTimer:
                         rt=(host_ms / flushes) if flushes else 0.0,
                     )
                 )
-            out.sort(key=lambda n: n.timestamp)
+        # Two-tier provenance columns (metrics/provenance.py), keyed by
+        # submit-ts second like the device buckets above: merge into
+        # the matching (second, resource) line, or create a fresh line
+        # for pairs the device never saw (shed ops are never encoded,
+        # so a shed-only second would otherwise vanish entirely).
+        prov = getattr(engine, "resource_metrics", None)
+        if prov is not None and prov.enabled:
+            by_key = {(ln.timestamp, ln.resource): ln for ln in out}
+            for sec, res, spec, degr, shed, drift in prov.drain_seconds(upto):
+                if sec < begin - 1000:
+                    continue
+                wall = engine.clock.to_wall(sec)
+                ln = by_key.get((wall, res))
+                if ln is None:
+                    ln = MetricNodeLine(timestamp=wall, resource=res)
+                    by_key[(wall, res)] = ln
+                    out.append(ln)
+                ln.speculative_qps = spec
+                ln.degraded_qps = degr
+                ln.shed_qps = shed
+                ln.drift = drift
+        out.sort(key=lambda n: (n.timestamp, n.resource))
         self._last_written_sec = upto
         return out

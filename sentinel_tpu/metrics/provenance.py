@@ -1,0 +1,266 @@
+"""Per-resource admission-provenance accumulator for the metric plane.
+
+PRs 5-7 gave the engine a two-tier admission plane — speculative host
+verdicts, degraded host-fallback windows, reconciliation drift, ingest
+shedding — but every one of those signals lands only in engine-scoped
+telemetry counters (metrics/telemetry.py). The fleet artifact Sentinel
+is named for, the per-second per-resource MetricNode line, stayed blind
+to all of it: a dashboard could tell you *that* the tier over-admitted,
+never *which resource* it over-admitted on.
+
+This module is the host-side (second, resource) ledger those signals
+fold into:
+
+* ``speculative`` — ops whose caller-visible verdict came from the
+  speculative host tier (admits AND blocks: serves, acquire-weighted to
+  match the device PASS/BLOCK columns);
+* ``degraded``    — ops served by the host fallback with degraded
+  provenance (device lost). NOT disjoint from ``speculative``: a
+  speculative serve while DEGRADED carries both marks, exactly like
+  ``Verdict.speculative`` composing with ``Verdict.degraded``;
+* ``shed``        — ops the ingest valve turned away at submit
+  (BLOCK_SHED; these never reach the device, so without this column
+  they would vanish from the per-resource view entirely);
+* ``drift``       — NET over-admit (over − under reconciliation
+  mismatches, signed) attributed per resource.
+
+Every event is attributed to the op's **submit-ts second** (PR-7's
+drift-window attribution rule, applied to the whole ledger): a depth-K
+pipelined settle must not smear one arrival second's provenance across
+the seconds its drains happen to land in. The metric-log timer drains
+completed seconds into :class:`~sentinel_tpu.metrics.metric_log.
+MetricNodeLine` v2 columns; cumulative per-resource totals feed the
+bounded ``sentinel_resource_*`` Prometheus export
+(transport/prometheus.py).
+
+Cardinality is bounded twice: the ledger itself folds resources past
+``sentinel.tpu.metrics.resource.capacity`` into the ``__other__`` row
+(space never grows past capacity × seconds-retained), and the
+Prometheus exporter additionally restricts labels to the PR-3 blocked
+top-K sketch plus configured resources (PAPERS.md 1902.06993: bound the
+export, not the traffic).
+
+Write cadence: the admission fast path itself NEVER writes the ledger.
+Single speculative serves are accumulated chunk-locally at settle time
+(`Engine._fill_results` → :meth:`ResourceProvenance.note_serves_batch`,
+one locked call per chunk) or, while the device is lost, noted in
+``fill_degraded``'s kept-speculative branch; bulk groups note once per
+group (:meth:`note_col`, already columnar); sheds/degraded fills note
+on their own off-hot paths. Attribution is by submit ts regardless of
+when the write happens, and the metric timer drains the flush pipeline
+before each pull, so settle-time writing is invisible to the
+per-second lines.
+
+Disabled (``sentinel.tpu.metrics.resource.enabled=false``) the engine
+pays exactly one bool read per call site — the same contract as the
+TelemetryBus.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from sentinel_tpu.utils.config import config
+
+# The fold row for resources past the cardinality cap. Double
+# underscores like the reference's __total_inbound_traffic__ pseudo
+# resource, so no user resource name can collide with it.
+OTHER_RESOURCE = "__other__"
+
+# Column order of the internal per-(second, resource) cells.
+_SPEC, _DEGRADED, _SHED, _OVER, _UNDER = range(5)
+
+
+class ResourceProvenance:
+    """Engine-scoped (one per Engine) submit-ts-second × resource
+    provenance ledger; see module doc. All methods are thread-safe and
+    the lock is a leaf (call sites may hold engine or tier locks)."""
+
+    # Seconds retained before the oldest is evicted — the metric timer
+    # drains every second; a stopped timer must not leak (same bound as
+    # TelemetryBus._SEC_CAP).
+    SEC_CAP = 600
+
+    def __init__(self, enabled=None, capacity=None) -> None:
+        self.enabled = (
+            config.get_bool(config.RESOURCE_METRICS_ENABLED, True)
+            if enabled is None
+            else bool(enabled)
+        )
+        self.capacity = max(
+            8,
+            capacity
+            if capacity is not None
+            else config.get_int(config.RESOURCE_METRICS_CAP, 256),
+        )
+        self._lock = threading.Lock()
+        # sec(rel ms, second-aligned) -> resource -> [spec, degraded,
+        # shed, over, under]
+        self._sec: Dict[int, Dict[str, List[int]]] = {}
+        # Cumulative per-resource totals (Prometheus export), same cell
+        # layout, folded to OTHER_RESOURCE past capacity.
+        self._totals: Dict[str, List[int]] = {}
+
+    # ------------------------------------------------------------------
+    # writers (engine / speculative tier / failover call sites — each
+    # gated on ``self.enabled`` by the caller)
+    # ------------------------------------------------------------------
+    def _cell(self, table: Dict[str, List[int]], resource: str) -> List[int]:
+        cell = table.get(resource)
+        if cell is None:
+            # One slot is reserved for the fold row, so a table never
+            # exceeds `capacity` entries including __other__.
+            if resource != OTHER_RESOURCE and len(table) >= self.capacity - 1:
+                return self._cell(table, OTHER_RESOURCE)
+            cell = table[resource] = [0, 0, 0, 0, 0]
+        return cell
+
+    def _cells_locked(self, ts_rel_ms: int, resource: str):
+        """(per-second cell, totals cell) for one event's key — fetched
+        once per note; this is the speculative fast path's ledger cost."""
+        sec = int(ts_rel_ms) // 1000 * 1000
+        table = self._sec.get(sec)
+        if table is None:
+            if len(self._sec) >= self.SEC_CAP:
+                self._sec.pop(min(self._sec), None)
+            table = self._sec[sec] = {}
+        return self._cell(table, resource), self._cell(self._totals, resource)
+
+    def note(
+        self,
+        ts_rel_ms: int,
+        resource: str,
+        spec: int = 0,
+        degraded: int = 0,
+        shed: int = 0,
+        over: int = 0,
+        under: int = 0,
+    ) -> None:
+        """One op's provenance events at its submit ts (engine-clock
+        relative ms). Weights follow the device PASS/BLOCK convention:
+        acquire-weighted serves/sheds, per-op mismatch weights."""
+        with self._lock:
+            cell, tot = self._cells_locked(ts_rel_ms, resource)
+            for col, n in (
+                (_SPEC, spec), (_DEGRADED, degraded), (_SHED, shed),
+                (_OVER, over), (_UNDER, under),
+            ):
+                if n:
+                    cell[col] += n
+                    tot[col] += n
+
+    def note_serves_batch(self, acc: Dict[Tuple[int, str], list]) -> None:
+        """One settled chunk's speculative serve notes in one locked
+        pass: ``{(submit-sec rel ms, resource): [spec_n, degraded_n]}``
+        — the singles fast path pays ZERO ledger cost at admission
+        time; `Engine._fill_results` accumulates into a plain local
+        dict per chunk and hands it over here (one call per chunk, so
+        the per-op share is dict-add cheap; the ≤2% metric-plane guard
+        in tests/test_metric_plane.py is stated over exactly this)."""
+        with self._lock:
+            for (sec, resource), (n, d) in acc.items():
+                cell, tot = self._cells_locked(sec, resource)
+                cell[_SPEC] += n
+                tot[_SPEC] += n
+                if d:
+                    cell[_DEGRADED] += d
+                    tot[_DEGRADED] += d
+
+    def note_col(
+        self,
+        resource: str,
+        ts_col,
+        weights=None,
+        spec: bool = False,
+        degraded: bool = False,
+        shed: bool = False,
+        over: bool = False,
+        under: bool = False,
+    ) -> None:
+        """Columnar writer for bulk groups: ``ts_col`` (int ms, one per
+        event row) is grouped by submit second host-side; ``weights``
+        (same length; default all-1) is summed per second. The flag set
+        selects which columns receive the per-second sums."""
+        ts = np.asarray(ts_col)
+        if ts.size == 0:
+            return
+        secs = (ts.astype(np.int64) // 1000) * 1000
+        w = (
+            np.ones(ts.shape[0], dtype=np.int64)
+            if weights is None
+            else np.asarray(weights, dtype=np.int64)
+        )
+        uniq, inv = np.unique(secs, return_inverse=True)
+        sums = np.bincount(inv, weights=w.astype(np.float64)).astype(np.int64)
+        cols = [
+            c
+            for c, on in (
+                (_SPEC, spec), (_DEGRADED, degraded), (_SHED, shed),
+                (_OVER, over), (_UNDER, under),
+            )
+            if on
+        ]
+        with self._lock:
+            for s, n in zip(uniq.tolist(), sums.tolist()):
+                if not n:
+                    continue
+                cell, tot = self._cells_locked(int(s), resource)
+                for c in cols:
+                    cell[c] += int(n)
+                    tot[c] += int(n)
+
+    # ------------------------------------------------------------------
+    # readers
+    # ------------------------------------------------------------------
+    def drain_seconds(
+        self, upto_rel_ms: int
+    ) -> List[Tuple[int, str, int, int, int, int]]:
+        """Completed engine-clock seconds strictly before
+        ``upto_rel_ms`` (second-aligned), removed from the ledger:
+        ``[(sec_rel_ms, resource, speculative, degraded, shed, drift)]``
+        ascending by (second, resource) — the metric-log timer's pull.
+        ``drift`` is signed net over-admit (over − under)."""
+        out: List[Tuple[int, str, int, int, int, int]] = []
+        with self._lock:
+            for sec in sorted(self._sec):
+                if sec >= upto_rel_ms:
+                    break
+                table = self._sec.pop(sec)
+                for resource in sorted(table):
+                    c = table[resource]
+                    if not any(c):
+                        continue
+                    out.append(
+                        (sec, resource, c[_SPEC], c[_DEGRADED], c[_SHED],
+                         c[_OVER] - c[_UNDER])
+                    )
+        return out
+
+    def totals(self) -> Dict[str, Tuple[int, int, int, int]]:
+        """Cumulative ``resource -> (speculative, degraded, shed,
+        drift)`` — the Prometheus exporter's read (drift signed)."""
+        with self._lock:
+            return {
+                r: (c[_SPEC], c[_DEGRADED], c[_SHED], c[_OVER] - c[_UNDER])
+                for r, c in self._totals.items()
+            }
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            open_secs = len(self._sec)
+            tracked = len(self._totals)
+        return {
+            "enabled": self.enabled,
+            "capacity": self.capacity,
+            "open_seconds": open_secs,
+            "tracked_resources": tracked,
+            "totals": self.totals(),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sec.clear()
+            self._totals.clear()

@@ -467,6 +467,9 @@ class TelemetryBus:
             valve = getattr(engine, "ingest", None)
             if valve is not None and valve.armed:
                 out["ingest"] = valve.snapshot()
+            rm = getattr(engine, "resource_metrics", None)
+            if rm is not None and rm.enabled:
+                out["resource_metrics"] = rm.snapshot()
             pindex = getattr(engine, "param_index", None)
             if pindex is not None and hasattr(pindex, "cache_stats"):
                 out["param_cache"] = pindex.cache_stats()

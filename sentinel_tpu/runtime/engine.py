@@ -758,6 +758,14 @@ class Engine:
         from sentinel_tpu.runtime.ingest import IngestValve
 
         self.ingest = IngestValve(self)
+        # Per-resource provenance ledger (metrics/provenance.py):
+        # (submit-ts second, resource) speculative/degraded/shed/drift
+        # counts drained by the metric-log timer into MetricNodeLine v2
+        # columns and exported as the bounded sentinel_resource_*
+        # Prometheus families. Disabled = one bool read per call site.
+        from sentinel_tpu.metrics.provenance import ResourceProvenance
+
+        self.resource_metrics = ResourceProvenance()
         # True when a close()/stop could not join a worker thread in
         # time — the shutdown LOOKED clean but leaked a live thread.
         self.closed_dirty = False
@@ -1104,6 +1112,8 @@ class Engine:
         self.block_log.log_blocked(
             resource, E.BLOCK_SHED, origin=origin, count=acquire
         )
+        if self.resource_metrics.enabled:
+            self.resource_metrics.note(op.ts, resource, shed=acquire)
         return op
 
     def _shed_bulk(
@@ -1132,6 +1142,10 @@ class Engine:
         self.block_log.log_blocked(
             resource, E.BLOCK_SHED, origin=origin, count=int(acq_col.sum())
         )
+        if self.resource_metrics.enabled:
+            self.resource_metrics.note(
+                int(g.ts[0]), resource, shed=int(acq_col.sum())
+            )
         return g
 
     def _resolve_entry_locked(
@@ -3498,6 +3512,13 @@ class Engine:
         tracer = self.admission_trace
         trace_end = time.perf_counter()
         spec_tier = self.speculative if self.speculative.enabled else None
+        # Chunk-local accumulator for the per-resource ledger's single
+        # speculative serve notes — flushed in ONE locked call below
+        # (metrics/provenance.py write-cadence contract).
+        serve_acc: Optional[Dict[Tuple[int, str], list]] = (
+            {} if spec_tier is not None and self.resource_metrics.enabled
+            else None
+        )
         for i, op in enumerate(entries):
             blocked_rule = None
             limit_type = ""
@@ -3552,6 +3573,14 @@ class Engine:
                 # caller-visible one; the device verdict reconciles the
                 # mirrors (bucket clamps, gauge compensation, drift
                 # accounting) and stamps the trace provenance.
+                if serve_acc is not None:
+                    key = (op.ts // 1000 * 1000, op.resource)
+                    ent = serve_acc.get(key)
+                    if ent is None:
+                        ent = serve_acc[key] = [0, 0]
+                    ent[0] += op.acquire
+                    if spec_v.degraded:
+                        ent[1] += op.acquire
                 match = spec_tier.reconcile_entry(op, spec_v, sv)
                 op._pending = None
                 if op.trace is not None:
@@ -3572,6 +3601,8 @@ class Engine:
                     bool(admitted[i]), r, flush_seq, trace_end,
                 )
                 op.trace = None
+        if serve_acc:
+            self.resource_metrics.note_serves_batch(serve_acc)
         off_b = len(entries)
         bulk_slices: List[Tuple[BulkOp, slice]] = []
         for g in bulk:
@@ -4002,6 +4033,7 @@ class Engine:
         self.failover.reset()
         self.speculative.reset()
         self.ingest.reset()
+        self.resource_metrics.reset()
         with self._flush_lock, self._lock:
             self._entries.clear()
             self._exits.clear()

@@ -1464,6 +1464,14 @@ class FailoverManager:
                 # THREAD entry's gauge deltas must join the restore
                 # replay.
                 op._pending = None
+                if eng.resource_metrics.enabled:
+                    # The device never settles this chunk, so the serve
+                    # note that normally lands at _fill_results lands
+                    # here (serve-time degraded mark rides v0).
+                    eng.resource_metrics.note(
+                        op.ts, op.resource, spec=op.acquire,
+                        degraded=op.acquire if v0.degraded else 0,
+                    )
                 if v0.admitted:
                     n_admit += 1
                     fb.note_unsettled_admit(op)
@@ -1497,6 +1505,13 @@ class FailoverManager:
             v = fb.admit(op, now)
             op.verdict = v
             op._pending = None
+            if eng.resource_metrics.enabled:
+                # Per-resource degraded serve at the op's SUBMIT ts
+                # (speculative-kept verdicts above were already noted —
+                # with both marks — at serve time).
+                eng.resource_metrics.note(
+                    op.ts, op.resource, degraded=op.acquire
+                )
             if v.admitted:
                 n_admit += 1
             else:
@@ -1552,6 +1567,10 @@ class FailoverManager:
             g.reason = rsn
             g.wait_ms = wait
             g._pending = None
+            if eng.resource_metrics.enabled:
+                eng.resource_metrics.note_col(
+                    g.resource, g.ts, weights=g.acquire, degraded=True
+                )
             blocked = ~adm
             n_admit += int(adm.sum())
             n_block += int(blocked.sum())

@@ -261,6 +261,11 @@ class SpeculativeAdmitter:
                 tele.note_spec_shaped(1)
             if sys_block:
                 tele.note_spec_system_block(1)
+        # NO per-resource ledger write here: the serve note lands at
+        # settle (Engine._fill_results batches the chunk's serves into
+        # one note_serves_batch call) or in fill_degraded's kept-
+        # speculative branch while the device is lost — the admission
+        # fast path stays ledger-free (metrics/provenance.py).
         return v
 
     def try_admit_bulk(self, g, now_ms: int) -> bool:
@@ -319,6 +324,11 @@ class SpeculativeAdmitter:
                 tele.note_spec_shaped(g.n)
             if n_sys:
                 tele.note_spec_system_block(n_sys)
+        rm = eng.resource_metrics
+        if rm.enabled:
+            # Columnar serve note grouped by each row's submit second.
+            rm.note_col(g.resource, g.ts, weights=g.acquire, spec=True,
+                        degraded=degraded)
         return True
 
     def _bulk_shaping_servable(self, g) -> bool:
@@ -477,6 +487,15 @@ class SpeculativeAdmitter:
                 tele.note_spec_drift(
                     int(spec_v.admitted), int(not spec_v.admitted)
                 )
+            rm = eng.resource_metrics
+            if rm.enabled:
+                # Per-resource drift at the op's submit ts — the same
+                # attribution rule as the accounting windows above.
+                rm.note(
+                    op.ts, op.resource,
+                    over=int(spec_v.admitted),
+                    under=int(not spec_v.admitted),
+                )
         return match
 
     def reconcile_bulk(
@@ -568,6 +587,13 @@ class SpeculativeAdmitter:
             tele = eng.telemetry
             if tele.enabled:
                 tele.note_spec_drift(over, under)
+            rm = eng.resource_metrics
+            if rm.enabled:
+                ts = np.asarray(g.ts)
+                if over:
+                    rm.note_col(g.resource, ts[over_m], over=True)
+                if under:
+                    rm.note_col(g.resource, ts[under_m], under=True)
 
     # ------------------------------------------------------------------
     # lifecycle

@@ -5,6 +5,23 @@ Reference: SimpleHttpHeartbeatSender posts to the dashboard's
 (sentinel-transport-simple-http/.../heartbeat/
 SimpleHttpHeartbeatSender.java:36-65); the dashboard feeds these into
 its machine discovery (SimpleMachineDiscovery).
+
+Beyond the reference's app/ip/port/version tuple, the heartbeat
+carries the machine's admission-plane health so the dashboard's
+machine table shows fleet state at a glance without a command-API
+round-trip per machine:
+
+* ``health``     — the failover state machine (HEALTHY / DEGRADED /
+  RECOVERING; runtime/failover.py);
+* ``spec_enabled`` / ``spec_suspended`` — speculative fast tier armed,
+  and whether the drift valve currently has it suspended
+  (runtime/speculative.py);
+* ``ingest_armed`` / ``shed_total`` / ``shedding`` — ingest valve
+  state, cumulative shed count, and whether sheds happened since the
+  previous heartbeat (runtime/ingest.py).
+
+The fields ride the same GET query; a dashboard that ignores them
+(the seed dashboard did) keeps working unchanged.
 """
 
 from __future__ import annotations
@@ -39,33 +56,82 @@ class HeartbeatSender:
         command_port: int,
         app_name: Optional[str] = None,
         interval_sec: float = 10.0,
+        engine=None,
     ) -> None:
         self.dashboard_addr = dashboard_addr
         self.command_port = command_port
         self.app_name = app_name or config.app_name
         self.interval = interval_sec
+        # The engine whose health this heartbeat reports. None (the
+        # seed signature) falls back to the process-global engine IF
+        # one already exists — a heartbeat must never be the thing
+        # that constructs the engine.
+        self._engine = engine
+        # Cumulative shed count as of the last DELIVERED heartbeat:
+        # "shedding" means sheds happened since the dashboard last
+        # heard from us. The baseline advances only on a successful
+        # send (heartbeat_once), so a failed POST can't swallow a
+        # shedding episode's edge.
+        self._last_shed_total = 0
+        self._pending_shed_total = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def heartbeat_once(self) -> bool:
-        params = urllib.parse.urlencode(
-            {
-                "app": self.app_name,
-                "app_type": config.get_int(config.APP_TYPE, 0),
-                "version": __version__,
-                "v": __version__,
-                "hostname": socket.gethostname(),
-                "ip": local_ip(),
-                "port": self.command_port,
-                "pid": 0,
-            }
+    def _health_params(self) -> dict:
+        """The admission-plane health fields (empty dict when no
+        engine exists yet — the heartbeat never constructs one)."""
+        engine = self._engine
+        if engine is None:
+            from sentinel_tpu.core.api import peek_engine
+
+            engine = peek_engine()
+        if engine is None:
+            return {}
+        spec = engine.speculative
+        valve = engine.ingest
+        shed_total = (
+            valve.counters["shed_entries"] + valve.counters["shed_rows"]
         )
+        if shed_total < self._last_shed_total:
+            # The counters went backwards — Engine.reset() zeroed the
+            # valve. Re-anchor, or the edge detector stays blind until
+            # cumulative sheds re-exceed the pre-reset baseline.
+            self._last_shed_total = 0
+        shedding = shed_total > self._last_shed_total
+        self._pending_shed_total = shed_total
+        return {
+            "health": engine.failover.state,
+            "spec_enabled": int(spec.enabled),
+            "spec_suspended": int(spec.enabled and spec.suspended),
+            "ingest_armed": int(valve.armed),
+            "shed_total": shed_total,
+            "shedding": int(shedding),
+        }
+
+    def heartbeat_once(self) -> bool:
+        fields = {
+            "app": self.app_name,
+            "app_type": config.get_int(config.APP_TYPE, 0),
+            "version": __version__,
+            "v": __version__,
+            "hostname": socket.gethostname(),
+            "ip": local_ip(),
+            "port": self.command_port,
+            "pid": 0,
+        }
+        fields.update(self._health_params())
+        params = urllib.parse.urlencode(fields)
         url = f"http://{self.dashboard_addr}/registry/machine?{params}"
         try:
             with urllib.request.urlopen(url, timeout=3) as resp:
-                return 200 <= resp.status < 300
+                ok = 200 <= resp.status < 300
         except OSError:
             return False
+        if ok:
+            # The dashboard has seen this interval's shedding flag:
+            # only now does the edge detector's baseline advance.
+            self._last_shed_total = self._pending_shed_total
+        return ok
 
     def start(self) -> "HeartbeatSender":
         if self._thread is None:

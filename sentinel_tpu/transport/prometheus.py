@@ -377,4 +377,74 @@ def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
     out.append(f"# TYPE {name} gauge")
     for key, cnt, _err in tele.sketch.topk(tele.sketch_k or 10):
         out.append(f'{name}{{resource="{_escape_label(key)}"}} {cnt}')
+    out += resource_provenance_lines(engine, openmetrics=openmetrics)
+    return out
+
+
+def _configured_resources(engine) -> set:
+    """Resources an operator explicitly configured a rule for — these
+    always deserve their own label row (the operator asked about them
+    by name)."""
+    out = set()
+    for idx_attr in ("flow_index", "degrade_index", "param_index"):
+        idx = getattr(engine, idx_attr, None)
+        out.update(getattr(idx, "by_resource", {}) or {})
+    out.update(getattr(engine, "authority_rules", {}) or {})
+    return out
+
+
+def resource_provenance_lines(engine, openmetrics: bool = False) -> List[str]:
+    """The ``sentinel_resource_*`` family: per-resource two-tier
+    admission provenance (metrics/provenance.py totals) with BOUNDED
+    label cardinality — label rows are granted only to configured
+    resources (rule-bearing) and the blocked-weight top-K sketch's
+    current heavy hitters; every other resource folds into one
+    ``resource="__other__"`` row — the same collision-proof fold label
+    the metric-log plane uses, so the row has ONE identity across both
+    exports and no user resource name can shadow it (PAPERS.md
+    1902.06993: bound the export with the sketch, not one series per
+    key). Empty when the ledger is disabled
+    (``sentinel.tpu.metrics.resource.enabled=false``)."""
+    rm = getattr(engine, "resource_metrics", None)
+    if rm is None or not rm.enabled:
+        return []
+    from sentinel_tpu.metrics.provenance import OTHER_RESOURCE
+
+    tele = engine.telemetry
+    allowed = _configured_resources(engine)
+    allowed.update(k for k, _c, _e in tele.sketch.topk(tele.sketch_k or 10))
+    totals = rm.totals()
+    folded: Dict[str, List[int]] = {}
+    for res, cells in totals.items():
+        key = (
+            res if (res in allowed and res != OTHER_RESOURCE)
+            else OTHER_RESOURCE
+        )
+        agg = folded.setdefault(key, [0, 0, 0, 0])
+        for i, v in enumerate(cells):
+            agg[i] += v
+    out: List[str] = []
+    fams = [
+        ("speculative_total", 0, "counter",
+         "Acquire-weighted verdicts served by the speculative host tier"),
+        ("degraded_total", 1, "counter",
+         "Acquire-weighted verdicts served by the host fallback while DEGRADED"),
+        ("shed_total", 2, "counter",
+         "Acquire-weighted ops shed at submit by the ingest valve"),
+        ("drift", 3, "gauge",
+         "Net speculative over-admit (over minus under reconciliation mismatches)"),
+    ]
+    for suffix, col, kind, help_text in fams:
+        name = f"{_PREFIX}_resource_{suffix}"
+        family = (
+            name[: -len("_total")]
+            if openmetrics and kind == "counter" and name.endswith("_total")
+            else name
+        )
+        out.append(f"# HELP {family} {help_text}")
+        out.append(f"# TYPE {family} {kind}")
+        for res in sorted(folded):
+            out.append(
+                f'{name}{{resource="{_escape_label(res)}"}} {folded[res][col]}'
+            )
     return out

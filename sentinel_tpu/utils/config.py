@@ -165,6 +165,16 @@ class SentinelConfig:
     # (in-flight flushes + 1), the PR-3 flight-recorder signals)
     # exceeds this deadline.
     INGEST_DEADLINE_MS = "sentinel.tpu.ingest.deadline.ms"
+    # Per-resource provenance metric plane (metrics/provenance.py):
+    # (second, resource) speculative/degraded/shed/drift ledger drained
+    # into MetricNodeLine v2 columns and the bounded
+    # sentinel_resource_* Prometheus export. Enabled by default —
+    # disabled costs one bool read per call site.
+    RESOURCE_METRICS_ENABLED = "sentinel.tpu.metrics.resource.enabled"
+    # Cardinality bound of the ledger: resources past this fold into
+    # the __other__ row (the export is additionally bounded by the
+    # blocked top-K sketch + configured resources).
+    RESOURCE_METRICS_CAP = "sentinel.tpu.metrics.resource.capacity"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -207,6 +217,8 @@ class SentinelConfig:
         INGEST_MAX_PENDING: "0",
         INGEST_MAX_PENDING_BULK: "0",
         INGEST_DEADLINE_MS: "0",
+        RESOURCE_METRICS_ENABLED: "true",
+        RESOURCE_METRICS_CAP: "256",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

@@ -63,8 +63,11 @@ class TestConfigAudit:
         )
         old = sys.argv
         try:
+            # --no-metrics keeps this a pure key audit (the metric
+            # audit builds an Engine; its CLI path is covered below).
             sys.argv = [
                 "config_audit.py", "--root", str(tmp_path), "--doc", _DOC,
+                "--no-metrics",
             ]
             assert config_audit.main() == 0
             (tmp_path / "bad.py").write_text('K = "sentinel.tpu.zzz"\n')
@@ -101,3 +104,74 @@ class TestDocCoverage:
     def test_missing_doc_reports_everything(self, tmp_path):
         undocumented = config_audit.audit_docs(str(tmp_path / "nope.md"))
         assert "sentinel.tpu.flush.max.batch" in undocumented
+
+
+class TestMetricsAudit:
+    """ISSUE 8 satellite: every Prometheus family the exporter emits
+    and every TelemetryBus counter key must appear verbatim in
+    ARCHITECTURE.md."""
+
+    def test_repo_doc_is_clean(self):
+        bad_fams, bad_ctrs = config_audit.audit_metrics(_DOC)
+        assert bad_fams == [], f"undocumented families: {bad_fams}"
+        assert bad_ctrs == [], f"undocumented counters: {bad_ctrs}"
+
+    def test_live_introspection_sees_this_prs_families(self):
+        fams = config_audit.prometheus_families()
+        # Seed gauges, flight-recorder counters, histogram families,
+        # and the PR-8 bounded per-resource export are all visible to
+        # the introspection — a broken render path can't silently
+        # shrink the audited surface.
+        for f in (
+            "sentinel_pass_qps",
+            "sentinel_engine_flushes_total",
+            "sentinel_engine_flush_duration_ms",
+            "sentinel_resource_speculative_total",
+            "sentinel_resource_drift",
+        ):
+            assert f in fams, f
+        ctrs = config_audit.telemetry_counter_keys()
+        assert {"flushes", "ingest_shed", "spec_admits"} <= ctrs
+
+    def test_detects_undocumented_family_and_counter(self, tmp_path):
+        doc = tmp_path / "ARCH.md"
+        doc.write_text("Only `sentinel_engine_flushes_total` and "
+                       "`flushes` are documented here.\n")
+        bad_fams, bad_ctrs = config_audit.audit_metrics(
+            str(doc),
+            families={"sentinel_engine_flushes_total",
+                      "sentinel_engine_nope_total"},
+            counters={"flushes", "nope_counter"},
+        )
+        assert bad_fams == ["sentinel_engine_nope_total"]
+        assert bad_ctrs == ["nope_counter"]
+
+    def test_missing_doc_reports_everything(self, tmp_path):
+        bad_fams, bad_ctrs = config_audit.audit_metrics(
+            str(tmp_path / "nope.md"),
+            families={"sentinel_x"}, counters={"c1"},
+        )
+        assert bad_fams == ["sentinel_x"] and bad_ctrs == ["c1"]
+
+    def test_cli_includes_metric_audit(self, tmp_path, capsys):
+        """The CLI runs the metric audit by default and reports a doc
+        that dropped a family."""
+        doc = tmp_path / "ARCH.md"
+        # Every declared key documented via family mentions so ONLY the
+        # metric audit can fail here.
+        from sentinel_tpu.utils.config import SentinelConfig
+
+        doc.write_text(
+            " ".join(f"`{k}`" for k in SentinelConfig.DEFAULTS) + "\n"
+        )
+        old = sys.argv
+        try:
+            sys.argv = [
+                "config_audit.py", "--root", str(tmp_path), "--doc",
+                str(doc),
+            ]
+            assert config_audit.main() == 1
+            out = capsys.readouterr().out
+            assert "Prometheus families" in out
+        finally:
+            sys.argv = old

@@ -1,0 +1,584 @@
+"""Fleet-visible two-tier admission metric plane (PR 8).
+
+The tentpole contracts:
+
+* MetricNodeLine v2 — versioned line format whose reader still parses
+  seed-format files, round-trips through MetricWriter/MetricSearcher
+  across a roll boundary mixing both formats;
+* per-resource conservation differential — metric-log
+  ``pass+block(+shed)`` equals engine verdict counts per resource at
+  pipeline depths {0, 2} with the speculative tier on and off, and the
+  speculative column reconciles exactly (serves == settled matches +
+  drift mismatches);
+* submit-ts attribution — a depth-K pipeline's in-flight ops land in
+  their arrival second, finalized at the pull;
+* the dashboard ``/metric`` aggregation returns the provenance
+  columns, the enriched heartbeat flows into ``/apps`` + the machine
+  table, and the bounded ``sentinel_resource_*`` Prometheus export
+  folds unconfigured resources into ``other``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.metrics.metric_log import (
+    MetricNodeLine,
+    MetricSearcher,
+    MetricTimer,
+    MetricWriter,
+)
+from sentinel_tpu.utils.clock import ManualClock
+from sentinel_tpu.utils.config import config
+
+
+@pytest.fixture(autouse=True)
+def _config_sandbox():
+    with config._lock:
+        saved = dict(config._runtime)
+    yield
+    with config._lock:
+        config._runtime.clear()
+        config._runtime.update(saved)
+
+
+def _mk_engine(clock, spec=False, depth=0, deadline_ms=0, resource_metrics=True):
+    from sentinel_tpu.runtime.engine import Engine
+
+    config.set(config.SPECULATIVE_ENABLED, "true" if spec else "false")
+    # No auto settle dispatch: the tests drive flush/drain explicitly.
+    config.set(config.SPECULATIVE_FLUSH_BATCH, "100000")
+    config.set(config.PIPELINE_DEPTH, str(depth))
+    config.set(config.INGEST_DEADLINE_MS, str(deadline_ms))
+    config.set(
+        config.RESOURCE_METRICS_ENABLED,
+        "true" if resource_metrics else "false",
+    )
+    return Engine(clock=clock)
+
+
+def _timer(eng, tmp_path, app="plane"):
+    return MetricTimer(
+        eng, writer=MetricWriter(base_dir=str(tmp_path), app_name=app)
+    )
+
+
+SEED_LINE = "1000|1970-01-01 00:00:01|res|7|3|6|1|2.5|0|4|0"
+
+
+class TestLineFormat:
+    def test_v2_roundtrip(self):
+        ln = MetricNodeLine(
+            timestamp=5000, resource="r|a", pass_qps=9, block_qps=2,
+            success_qps=8, exception_qps=1, rt=3.25, occupied_pass_qps=1,
+            concurrency=4, classification=0, speculative_qps=11,
+            degraded_qps=5, shed_qps=2, drift=-3,
+        )
+        text = ln.to_line()
+        assert text.split("|")[11] == "2"  # the version tag field
+        back = MetricNodeLine.from_line(text)
+        assert back is not None
+        assert back.resource == "r_a"  # separator sanitized, as seed
+        assert (back.speculative_qps, back.degraded_qps,
+                back.shed_qps, back.drift) == (11, 5, 2, -3)
+        assert (back.pass_qps, back.block_qps, back.concurrency) == (9, 2, 4)
+
+    def test_seed_format_still_parses(self):
+        back = MetricNodeLine.from_line(SEED_LINE)
+        assert back is not None
+        assert (back.pass_qps, back.block_qps, back.concurrency) == (7, 3, 4)
+        assert (back.speculative_qps, back.degraded_qps,
+                back.shed_qps, back.drift) == (0, 0, 0, 0)
+
+    def test_seed_reader_view_of_v2_line(self):
+        """A v1 parser reads fields [0..10] — the v2 writer must keep
+        them byte-identical in position."""
+        ln = MetricNodeLine(
+            timestamp=1000, resource="res", pass_qps=7, block_qps=3,
+            success_qps=6, exception_qps=1, rt=2.5, occupied_pass_qps=0,
+            concurrency=4, speculative_qps=99, shed_qps=9,
+        )
+        assert ln.to_line().split("|")[:11] == SEED_LINE.split("|")
+
+    def test_malformed_tail_degrades_to_seed_view(self):
+        bad = SEED_LINE + "|vX|1|2|3|4"
+        back = MetricNodeLine.from_line(bad)
+        assert back is not None and back.pass_qps == 7
+        assert back.speculative_qps == 0 and back.drift == 0
+
+    def test_mid_tail_corruption_degrades_atomically(self):
+        """A valid tag with a corrupted later column must yield the
+        pure seed view — never a half-applied hybrid where some v2
+        fields stuck before the parse failed."""
+        bad = SEED_LINE + "|2|9|x|11|-3"
+        back = MetricNodeLine.from_line(bad)
+        assert back is not None and back.pass_qps == 7
+        assert (back.speculative_qps, back.degraded_qps,
+                back.shed_qps, back.drift) == (0, 0, 0, 0)
+
+    def test_future_version_tail_parses_v2_prefix(self):
+        """Versioning rule: a v3 line (extra columns appended after
+        v2's) still yields the v2 columns to this reader."""
+        v3 = SEED_LINE + "|3|11|5|2|-3|42|43"
+        back = MetricNodeLine.from_line(v3)
+        assert (back.speculative_qps, back.degraded_qps,
+                back.shed_qps, back.drift) == (11, 5, 2, -3)
+
+
+class TestSearcherMixedRoll:
+    def test_roundtrip_across_roll_boundary_mixing_formats(self, tmp_path):
+        """A rolled file set where file .1 is seed-era (11-field lines
+        + its .idx) and file .2 is written by the v2 writer: one
+        find() call parses both, seed lines with zero provenance."""
+        base = tmp_path / "mix-metrics.log.1"
+        seed_lines = [
+            f"{1000 + i * 1000}|1970-01-01 00:00:01|old|{i + 1}|0|1|0|1.0|0|0|0"
+            for i in range(3)
+        ]
+        base.write_text("\n".join(seed_lines) + "\n")
+        (tmp_path / "mix-metrics.log.1.idx").write_text("3000 0\n")
+        # single_file_size=1: the next write() rolls to .2.
+        writer = MetricWriter(
+            base_dir=str(tmp_path), app_name="mix", single_file_size=1
+        )
+        v2 = [
+            MetricNodeLine(
+                timestamp=4000 + i * 1000, resource="new", pass_qps=5,
+                block_qps=1, speculative_qps=4, degraded_qps=1,
+                shed_qps=2, drift=1,
+            )
+            for i in range(2)
+        ]
+        writer.write(5000, v2)
+        files = writer._list_files()
+        assert len(files) == 2 and files[-1].endswith(".2")
+
+        found = MetricSearcher(base_dir=str(tmp_path), app_name="mix").find(
+            0, 10_000
+        )
+        by_res = {}
+        for ln in found:
+            by_res.setdefault(ln.resource, []).append(ln)
+        assert len(by_res["old"]) == 3 and len(by_res["new"]) == 2
+        assert all(l.speculative_qps == 0 for l in by_res["old"])
+        assert all(
+            (l.speculative_qps, l.shed_qps, l.drift) == (4, 2, 1)
+            for l in by_res["new"]
+        )
+        # Range query starting past the seed file still uses the idx
+        # seek path and returns only the v2 lines.
+        tail = MetricSearcher(base_dir=str(tmp_path), app_name="mix").find(
+            4000, 10_000
+        )
+        assert {l.resource for l in tail} == {"new"}
+
+
+class TestConservation:
+    @pytest.mark.parametrize("depth", [0, 2])
+    @pytest.mark.parametrize("spec", [False, True])
+    def test_per_resource_conservation(self, depth, spec, tmp_path):
+        """pass+block per (resource) across the metric-log lines equals
+        the engine's verdict count per resource — every op counted
+        exactly once regardless of which tier served it — and the
+        speculative/drift columns reconcile exactly against the tier's
+        own counters."""
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=spec, depth=depth)
+        eng.set_flow_rules(
+            [st.FlowRule("ra", count=5), st.FlowRule("rb", count=1e9)]
+        )
+        counts = {}
+        serves = 0
+        for sec in (1, 2):
+            for i in range(12):
+                clock.set_ms(sec * 1000 + i * 10)
+                res = "ra" if i % 2 == 0 else "rb"
+                op, v = eng.entry_sync(res)
+                assert op is not None and v is not None
+                counts[res] = counts.get(res, 0) + 1
+                serves += int(v.speculative)
+            eng.flush()
+        eng.flush()
+        eng.drain()
+        clock.set_ms(3100)
+        lines = _timer(eng, tmp_path).collect()
+        per_res = {}
+        for ln in lines:
+            if ln.resource.startswith("__"):
+                continue
+            agg = per_res.setdefault(ln.resource, [0, 0, 0])
+            agg[0] += ln.pass_qps + ln.block_qps
+            agg[1] += ln.speculative_qps
+            agg[2] += ln.drift
+        for res, n in counts.items():
+            assert per_res[res][0] == n, (res, per_res)
+        c = eng.speculative.counters
+        total_spec = sum(v[1] for v in per_res.values())
+        total_drift = sum(v[2] for v in per_res.values())
+        if spec:
+            assert serves == counts["ra"] + counts["rb"]
+            assert total_spec == c["spec_admits"] + c["spec_blocks"] == serves
+            # Every serve settled (flush+drain above): serves ==
+            # settled matches + mismatches, and the drift column nets
+            # the mismatch directions exactly.
+            assert c["reconciled"] == serves
+            assert total_drift == c["over_admits"] - c["under_admits"]
+        else:
+            assert serves == 0 and total_spec == 0 and total_drift == 0
+
+    @pytest.mark.parametrize("spec", [False, True])
+    def test_shed_column_closes_the_ledger(self, spec, tmp_path):
+        """Shed ops never reach the device; pass+block+shed still
+        equals the submitted op count per resource, and a shed-only
+        resource gets its own line."""
+        clock = ManualClock(start_ms=0)
+        # Deadline far above any real CPU settle latency: only the
+        # forced estimate below can trip the valve.
+        eng = _mk_engine(clock, spec=spec, deadline_ms=100_000)
+        eng.set_flow_rules([st.FlowRule("rs", count=1e9)])
+        clock.set_ms(1000)
+        for _ in range(4):
+            _op, v = eng.entry_sync("rs")
+            assert v.admitted
+        eng.flush()
+        eng.drain()
+        eng.ingest.force_latency_ms(1e9)  # every further op sheds
+        shed = 0
+        for i in range(6):
+            clock.set_ms(1100 + i * 10)
+            _op, v = eng.entry_sync("rs")
+            assert v.reason == E.BLOCK_SHED and not v.admitted
+            shed += 1
+        _op, v = eng.entry_sync("shed-only")
+        assert v.reason == E.BLOCK_SHED
+        eng.ingest.force_latency_ms(None)
+        eng.flush()
+        eng.drain()
+        clock.set_ms(2100)
+        lines = _timer(eng, tmp_path).collect()
+        by_res = {}
+        for ln in lines:
+            if ln.resource.startswith("__"):
+                continue
+            agg = by_res.setdefault(ln.resource, [0, 0])
+            agg[0] += ln.pass_qps + ln.block_qps
+            agg[1] += ln.shed_qps
+        assert by_res["rs"][0] + by_res["rs"][1] == 4 + shed
+        assert by_res["rs"][1] == shed
+        # The shed-only resource never touched the device, yet it is
+        # visible per resource.
+        assert by_res["shed-only"] == [0, 1]
+        assert eng.ingest.counters["shed_entries"] == shed + 1
+
+    def test_bulk_serves_and_sheds_attribute_by_row_ts(self, tmp_path):
+        """Bulk groups: speculative serves split across each row's
+        submit second; a shed group notes its rows too."""
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True)
+        eng.set_flow_rules([st.FlowRule("rb", count=1e9)])
+        clock.set_ms(1000)
+        ts = np.array([1000] * 4 + [2000] * 6, dtype=np.int32)
+        g = eng.submit_bulk("rb", 10, ts=ts)
+        assert g is not None and g.speculative
+        eng.flush()
+        eng.drain()
+        clock.set_ms(3100)
+        lines = _timer(eng, tmp_path).collect()
+        spec_by_sec = {
+            ln.timestamp: ln.speculative_qps
+            for ln in lines
+            if ln.resource == "rb"
+        }
+        wall = eng.clock.to_wall
+        assert spec_by_sec[wall(1000)] == 4
+        assert spec_by_sec[wall(2000)] == 6
+
+
+class TestSubmitTsAttribution:
+    def test_depth2_inflight_ops_finalize_in_their_arrival_second(
+        self, tmp_path
+    ):
+        """With depth-2 pipelining and NO explicit drain, the pull
+        itself settles the in-flight flushes: the arrival second's line
+        carries the full count + provenance, exactly once."""
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True, depth=2)
+        eng.set_flow_rules([st.FlowRule("rp", count=1e9)])
+        clock.set_ms(1500)
+        for _ in range(8):
+            eng.entry_sync("rp")
+        eng.flush()  # dispatched, deliberately left in flight
+        clock.set_ms(2100)
+        timer = _timer(eng, tmp_path)
+        lines = [l for l in timer.collect() if l.resource == "rp"]
+        assert len(lines) == 1
+        ln = lines[0]
+        assert ln.timestamp == eng.clock.to_wall(1000)
+        assert ln.pass_qps + ln.block_qps == 8
+        assert ln.speculative_qps == 8
+        # Finalized: a second pull re-reads nothing for that second.
+        clock.set_ms(3100)
+        again = [l for l in timer.collect() if l.resource == "rp"]
+        assert again == []
+
+
+class TestDashboardFlow:
+    def test_metric_endpoint_returns_provenance_columns(self):
+        from sentinel_tpu.dashboard.app import DashboardServer
+
+        import time as _time
+
+        now = int(_time.time() * 1000) // 1000 * 1000
+        ds = DashboardServer()
+        ds.repo.save_all(
+            "app-x",
+            [MetricNodeLine(
+                timestamp=now, resource="r1", pass_qps=5, block_qps=1,
+                speculative_qps=6, degraded_qps=2, shed_qps=3, drift=-1,
+            )],
+        )
+        code, body = ds._handle(
+            "/metric", {"app": "app-x", "identity": "r1"}
+        )
+        assert code == 200
+        rows = json.loads(body)
+        assert rows and rows[0]["speculative_qps"] == 6
+        assert rows[0]["degraded_qps"] == 2
+        assert rows[0]["shed_qps"] == 3
+        assert rows[0]["drift"] == -1
+
+    def test_apps_renders_enriched_heartbeat_and_flags_stale(self):
+        from sentinel_tpu.dashboard.app import DashboardServer
+
+        ds = DashboardServer()
+        code, _ = ds._handle(
+            "/registry/machine",
+            {"app": "hb", "ip": "10.0.0.1", "port": "8719",
+             "health": "DEGRADED", "spec_enabled": "1",
+             "spec_suspended": "1", "ingest_armed": "1",
+             "shed_total": "42", "shedding": "1"},
+        )
+        assert code == 200
+        # Seed-era heartbeat (no enrichment fields) registers too.
+        code, _ = ds._handle(
+            "/registry/machine",
+            {"app": "hb", "ip": "10.0.0.2", "port": "8719"},
+        )
+        assert code == 200
+        # Junk enrichment values degrade to 0, never 400.
+        code, _ = ds._handle(
+            "/registry/machine",
+            {"app": "hb", "ip": "10.0.0.3", "port": "8719",
+             "shed_total": "notanumber"},
+        )
+        assert code == 200
+        _, body = ds._handle("/apps", {})
+        machines = {m["ip"]: m for m in json.loads(body)["hb"]}
+        m1 = machines["10.0.0.1"]
+        assert m1["health"] == "DEGRADED" and m1["spec_suspended"] == 1
+        assert m1["shed_total"] == 42 and m1["shedding"] == 1
+        assert m1["stale"] is False and m1["healthy"] is True
+        assert machines["10.0.0.2"]["health"] == ""
+        assert machines["10.0.0.3"]["shed_total"] == 0
+        # Stale heartbeat → flagged.
+        for info in ds.apps._machines.values():
+            if info.ip == "10.0.0.1":
+                info.last_heartbeat_ms -= 120_000
+        _, body = ds._handle("/apps", {})
+        machines = {m["ip"]: m for m in json.loads(body)["hb"]}
+        assert machines["10.0.0.1"]["stale"] is True
+        assert machines["10.0.0.2"]["stale"] is False
+
+    def test_heartbeat_health_params_and_end_to_end(self):
+        from sentinel_tpu.dashboard.app import DashboardServer
+        from sentinel_tpu.transport.heartbeat import HeartbeatSender
+
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True)
+        sender = HeartbeatSender("127.0.0.1:9", 1234, app_name="hb-e2e",
+                                 engine=eng)  # port 9: refused fast
+        p = sender._health_params()
+        assert p["health"] == "HEALTHY"
+        assert p["spec_enabled"] == 1 and p["spec_suspended"] == 0
+        assert p["ingest_armed"] == 0 and p["shedding"] == 0
+        # Sheds since the last DELIVERED heartbeat flip `shedding`.
+        eng.ingest.counters["shed_entries"] += 3
+        p = sender._health_params()
+        assert p["shed_total"] == 3 and p["shedding"] == 1
+        # An undelivered heartbeat must NOT clear the edge: the
+        # unreachable-dashboard send fails, and the flag persists.
+        assert sender.heartbeat_once() is False
+        p = sender._health_params()
+        assert p["shedding"] == 1
+        # End-to-end over HTTP into the dashboard registry — a
+        # DELIVERED heartbeat commits the baseline and clears the edge.
+        ds = DashboardServer(port=0).start()
+        try:
+            sender.dashboard_addr = f"127.0.0.1:{ds.port}"
+            assert sender.heartbeat_once() is True
+            _, body = ds._handle("/apps", {})
+            (m,) = json.loads(body)["hb-e2e"]
+            assert m["health"] == "HEALTHY" and m["spec_enabled"] == 1
+            assert m["shed_total"] == 3
+            assert m["heartbeat_age_ms"] >= 0
+            assert sender._health_params()["shedding"] == 0
+            # Engine.reset() zeroes the valve counters: the edge
+            # detector must re-anchor, not stay blind until cumulative
+            # sheds re-exceed the pre-reset baseline.
+            eng.ingest.reset()
+            eng.ingest.counters["shed_entries"] += 1
+            p = sender._health_params()
+            assert p["shed_total"] == 1 and p["shedding"] == 1
+        finally:
+            ds.stop()
+
+    def test_webui_renders_machine_table_and_provenance_columns(self):
+        from sentinel_tpu.dashboard.webui import CONSOLE_HTML
+
+        for needle in (
+            'id="machines"', "renderMachines", "spec_suspended",
+            "shed_total", "shedding", "stale", "speculative_qps",
+            "shed_qps", "drift", "heartbeat_age_ms",
+        ):
+            assert needle in CONSOLE_HTML, needle
+
+
+class TestPrometheusResourceExport:
+    def test_bounded_labels_fold_unconfigured_into_other(self):
+        from sentinel_tpu.transport.prometheus import render_metrics
+
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True)
+        eng.set_flow_rules([st.FlowRule("ra", count=1e9)])
+        clock.set_ms(1000)
+        for _ in range(3):
+            eng.entry_sync("ra")
+        for _ in range(2):
+            eng.entry_sync("zz-unconfigured")
+        eng.flush()
+        eng.drain()
+        text = render_metrics(eng)
+        assert 'sentinel_resource_speculative_total{resource="ra"} 3' in text
+        # No rules, not a blocked heavy hitter: folded into the
+        # collision-proof "__other__" row within the sentinel_resource_*
+        # families (the seed per-resource QPS gauges are a different,
+        # unbounded-by-design family).
+        assert 'sentinel_resource_speculative_total{resource="zz-unconfigured"}' not in text
+        assert 'sentinel_resource_speculative_total{resource="__other__"} 2' in text
+        for fam in ("sentinel_resource_degraded_total",
+                    "sentinel_resource_shed_total",
+                    "sentinel_resource_drift"):
+            assert f"# TYPE {fam}" in text
+
+    def test_disabled_ledger_emits_nothing_and_skips_noting(self):
+        from sentinel_tpu.transport.prometheus import render_metrics
+
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True, resource_metrics=False)
+        eng.set_flow_rules([st.FlowRule("rd", count=1e9)])
+        clock.set_ms(1000)
+        eng.entry_sync("rd")
+        eng.flush()
+        eng.drain()
+        assert eng.resource_metrics.enabled is False
+        assert eng.resource_metrics.totals() == {}
+        assert "sentinel_resource_" not in render_metrics(eng)
+
+
+class TestLedgerUnit:
+    def test_cardinality_folds_into_other_row(self):
+        from sentinel_tpu.metrics.provenance import (
+            OTHER_RESOURCE,
+            ResourceProvenance,
+        )
+
+        rm = ResourceProvenance(enabled=True, capacity=8)
+        for i in range(20):
+            rm.note(1000, f"r{i}", shed=1)
+        totals = rm.totals()
+        assert len(totals) <= 8
+        assert totals[OTHER_RESOURCE][2] == 20 - (8 - 1)
+        assert sum(t[2] for t in totals.values()) == 20
+
+    def test_drain_is_destructive_and_sorted(self):
+        from sentinel_tpu.metrics.provenance import ResourceProvenance
+
+        rm = ResourceProvenance(enabled=True, capacity=64)
+        rm.note(2500, "b", spec=2, over=3, under=1)
+        rm.note(1500, "a", degraded=4)
+        rm.note(3500, "c", shed=5)  # not yet complete at upto=3000
+        rows = rm.drain_seconds(3000)
+        assert rows == [
+            (1000, "a", 0, 4, 0, 0),
+            (2000, "b", 2, 0, 0, 2),
+        ]
+        assert rm.drain_seconds(3000) == []
+        assert rm.drain_seconds(10_000) == [(3000, "c", 0, 0, 5, 0)]
+
+    def test_note_col_groups_by_second_with_weights(self):
+        from sentinel_tpu.metrics.provenance import ResourceProvenance
+
+        rm = ResourceProvenance(enabled=True, capacity=64)
+        ts = np.array([1000, 1900, 2000, 2100], dtype=np.int32)
+        w = np.array([1, 2, 3, 4], dtype=np.int32)
+        rm.note_col("r", ts, weights=w, spec=True, degraded=True)
+        rows = rm.drain_seconds(10_000)
+        assert rows == [
+            (1000, "r", 3, 3, 0, 0),
+            (2000, "r", 7, 7, 0, 0),
+        ]
+
+
+@pytest.mark.slow
+class TestOverhead:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_ledger_share_within_2pct(self, depth):
+        """The ≤2% metric-plane budget, asserted on the PROFILED share
+        of the admission loop rather than a wall-clock A/B: on the
+        timeshared 1-core box, back-to-back wall-clock runs of
+        IDENTICAL code swing ±10%+ (PERF_NOTES PR-8), so an A/B band
+        at a 2% effect size is pure noise — a guard that cries wolf
+        gets deleted. cProfile attributes the ledger's actual
+        cumulative time (note/note_col and everything under them)
+        against the loop total, which is stable run to run
+        (measured share: ~0.6%)."""
+        import cProfile
+        import pstats
+
+        clock = ManualClock(start_ms=0)
+        eng = _mk_engine(clock, spec=True, depth=depth)
+        # A blocking rule too, so serve AND drift note paths profile.
+        eng.set_flow_rules(
+            [st.FlowRule("ov", count=500), st.FlowRule("ov2", count=1e9)]
+        )
+        clock.set_ms(1000)
+        for _ in range(64):
+            eng.entry_sync("ov")
+        eng.flush()
+        eng.drain()  # compile + warm
+        pr = cProfile.Profile()
+        pr.enable()
+        for _ in range(10):
+            for i in range(256):
+                eng.entry_sync("ov" if i % 2 else "ov2")
+            eng.flush()
+        pr.disable()
+        eng.drain()
+        stats = pstats.Stats(pr)
+        total = stats.total_tt
+        # Top-level ledger entry points only: their CUMULATIVE time
+        # already includes the cell plumbing beneath them (summing
+        # every provenance.py frame would double-count it).
+        ledger = sum(
+            ct
+            for (path, _ln, fn), (_cc, _nc, _tt, ct, _callers)
+            in stats.stats.items()
+            if path.endswith("metrics/provenance.py")
+            and fn in ("note", "note_serves_batch", "note_col")
+        )
+        assert eng.resource_metrics.totals(), "ledger actually exercised"
+        share = ledger / total
+        assert share <= 0.02, f"ledger share {share:.4f} of loop at depth {depth}"

@@ -1,0 +1,277 @@
+"""Bench regression gate: compare a fresh bench.py JSON against the
+latest committed ``BENCH_*.json`` trajectory point.
+
+ROADMAP's "hardware truth" item: the committed ``BENCH_*`` files are a
+perf trajectory, and a trajectory without a gate is a scrapbook — a
+regression lands silently and the next session inherits it as the new
+normal. This tool turns the trajectory into a gate:
+
+* the **baseline** is the newest committed ``BENCH_*.json`` whose
+  ``device_kind`` AND ``jax_version`` match the fresh run's (PR-7's
+  hardware-truth header). No comparable baseline — different silicon,
+  different jax, or a pre-header record — is a **SKIP with a reason**,
+  never a fake pass/fail: comparing a TPU run against CPU liveness
+  numbers is exactly the mistake the header exists to prevent;
+* each stage metric is compared only when its stage **context**
+  (ladder rung sizes: n_rules/n_entries etc.) matches — a budget-
+  truncated ladder must not read as a slowdown;
+* every metric carries its own **tolerance band** (throughput is a lot
+  steadier than a p99 on a busy 1-core box), scaled globally by
+  ``--tolerance-scale``. A metric worse than baseline by more than its
+  band is a regression → exit 1 with a per-metric report.
+
+Committed baselines may be the raw bench JSON or the driver wrapper
+``{"parsed": {...}}`` shape — both load.
+
+Usage::
+
+    python bench.py --gate                    # bench + gate in one go
+    python bench.py > fresh.json
+    python tools/benchgate.py --fresh fresh.json [--repo-root .]
+                              [--baseline BENCH_r05.json]
+                              [--tolerance-scale 1.0]
+
+Exit status: 0 pass or skip-with-reason, 1 regression (or a fresh
+record that is itself an error), 2 usage error. The programmatic
+surface (``load_record`` / ``find_baseline`` / ``compare`` / ``gate``)
+is what tests/test_benchgate.py asserts on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# Direction per metric: "higher" = higher is better (throughput),
+# "lower" = lower is better (latency). The band is the tolerated
+# RELATIVE regression (0.60 = 60% worse than baseline still passes).
+#
+# Band sizing is empirical, from back-to-back CPU runs on the
+# timeshared 1-core dev box (PR 8): throughputs swung up to 1.8x,
+# mean sync latency 2.7x, percentile latencies 5x — pure tenancy
+# noise, zero code change between the runs. The bands therefore catch
+# ORDER-OF-MAGNITUDE regressions, which is the only gating a CPU
+# liveness box honestly supports; on steady hardware (a real TPU run)
+# tighten with ``--tolerance-scale 0.2``-ish. Too-loose-but-honest
+# beats tight-but-flaky: a gate that cries wolf gets deleted.
+STAGE_METRICS: Dict[str, Tuple[str, float]] = {
+    "value": ("higher", 0.60),
+    "flush_ms": ("lower", 2.00),
+    "mixed_checks_per_sec": ("higher", 0.60),
+    "mixed_flush_ms": ("lower", 2.00),
+    "engine_ops_per_sec": ("higher", 0.60),
+    "engine_bulk_ops_per_sec": ("higher", 0.60),
+    "engine_adapter_ops_per_sec": ("higher", 0.60),
+    "engine_pipelined_ops_per_sec": ("higher", 0.60),
+    "engine_sync_latency_ms": ("lower", 2.00),
+    "spec_ops_per_sec": ("higher", 0.60),
+    "spec_entry_p50_us": ("lower", 2.00),
+    "spec_entry_p99_us": ("lower", 5.00),
+    "spec_entry_sys_p50_us": ("lower", 2.00),
+    "spec_entry_sys_p99_us": ("lower", 5.00),
+    "shed_entry_p50_us": ("lower", 2.00),
+    "shed_entry_p99_us": ("lower", 5.00),
+}
+
+# Stage-context keys: a group's metrics are comparable only when every
+# context key present in EITHER record matches (a missing stage on one
+# side skips the group, a different rung size skips it too).
+STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
+    (("n_rules", "n_entries"), ("value", "flush_ms")),
+    (("mixed_n_rules", "mixed_n_entries"),
+     ("mixed_checks_per_sec", "mixed_flush_ms")),
+    (("engine_n_rules", "engine_n_ops"),
+     ("engine_ops_per_sec", "engine_bulk_ops_per_sec",
+      "engine_adapter_ops_per_sec", "engine_pipelined_ops_per_sec",
+      "engine_sync_latency_ms")),
+    ((), ("spec_ops_per_sec", "spec_entry_p50_us", "spec_entry_p99_us",
+          "spec_entry_sys_p50_us", "spec_entry_sys_p99_us",
+          "shed_entry_p50_us", "shed_entry_p99_us")),
+]
+
+
+def load_record(path_or_obj) -> Optional[dict]:
+    """A bench record from a path (or an already-loaded object):
+    unwraps the driver's ``{"parsed": {...}}`` wrapper shape; None when
+    unreadable/not a dict."""
+    obj = path_or_obj
+    if isinstance(obj, str):
+        try:
+            with open(obj, "r", encoding="utf-8") as f:
+                obj = json.load(f)
+        except (OSError, ValueError):
+            return None
+    if not isinstance(obj, dict):
+        return None
+    parsed = obj.get("parsed")
+    if isinstance(parsed, dict):
+        obj = parsed
+    return obj
+
+
+def find_baseline(
+    repo_root: str, device_kind, jax_version
+) -> Tuple[Optional[str], Optional[dict], str]:
+    """Newest committed BENCH_*.json matching the fresh run's hardware
+    header: ``(path, record, reason)`` — path/record None when nothing
+    comparable exists, with the reason spelled out."""
+    if not device_kind or not jax_version:
+        return None, None, "fresh record lacks device_kind/jax_version"
+    paths = sorted(glob.glob(os.path.join(repo_root, "BENCH_*.json")))
+    seen = 0
+    for path in reversed(paths):
+        rec = load_record(path)
+        if rec is None or "error" in rec:
+            continue
+        seen += 1
+        if (
+            rec.get("device_kind") == device_kind
+            and rec.get("jax_version") == jax_version
+        ):
+            return path, rec, ""
+    if not paths:
+        return None, None, f"no BENCH_*.json baselines under {repo_root}"
+    return (
+        None, None,
+        f"no baseline among {seen} readable BENCH_*.json matches "
+        f"device_kind={device_kind!r} jax_version={jax_version!r} "
+        "(pre-header records never match)",
+    )
+
+
+def compare(
+    fresh: dict, baseline: dict, tolerance_scale: float = 1.0
+) -> Tuple[List[str], List[str], List[str]]:
+    """``(regressions, compared, skipped)`` message lists. A metric is
+    compared when both records carry it numerically and its stage
+    context matches; regression means worse than baseline by more than
+    ``band × tolerance_scale``."""
+    regressions: List[str] = []
+    compared: List[str] = []
+    skipped: List[str] = []
+    for ctx_keys, metrics in STAGE_CONTEXT:
+        ctx_mismatch = None
+        for k in ctx_keys:
+            if k in fresh or k in baseline:
+                if fresh.get(k) != baseline.get(k):
+                    ctx_mismatch = (
+                        f"{k}: fresh={fresh.get(k)} vs "
+                        f"baseline={baseline.get(k)}"
+                    )
+                    break
+        for m in metrics:
+            f, b = fresh.get(m), baseline.get(m)
+            if not isinstance(f, (int, float)) or not isinstance(b, (int, float)):
+                continue  # stage absent on one side: silently not comparable
+            if ctx_mismatch is not None:
+                skipped.append(f"{m}: stage context differs ({ctx_mismatch})")
+                continue
+            if b <= 0:
+                skipped.append(f"{m}: baseline value {b} not comparable")
+                continue
+            direction, band = STAGE_METRICS[m]
+            band = band * tolerance_scale
+            ratio = f / b
+            if direction == "higher":
+                bad = ratio < 1.0 - band
+                word = "dropped"
+            else:
+                bad = ratio > 1.0 + band
+                word = "rose"
+            line = (
+                f"{m}: {word if bad else 'ok'} {b:g} -> {f:g} "
+                f"({ratio:.3f}x, band ±{band:.0%})"
+            )
+            (regressions if bad else compared).append(line)
+    return regressions, compared, skipped
+
+
+def gate(
+    fresh: dict,
+    repo_root: str,
+    baseline_path: Optional[str] = None,
+    tolerance_scale: float = 1.0,
+) -> int:
+    """Run the gate and print the report; returns the exit status."""
+    if not isinstance(fresh, dict) or "error" in fresh:
+        print(f"benchgate FAILED: fresh record is an error record: "
+              f"{fresh.get('error') if isinstance(fresh, dict) else fresh!r}")
+        return 1
+    if baseline_path is not None:
+        baseline = load_record(baseline_path)
+        if baseline is None:
+            print(f"benchgate usage error: cannot load {baseline_path}")
+            return 2
+        # An explicit baseline still honors the hardware-truth header.
+        if (
+            baseline.get("device_kind") != fresh.get("device_kind")
+            or baseline.get("jax_version") != fresh.get("jax_version")
+        ):
+            print(
+                "benchgate SKIP: explicit baseline "
+                f"{os.path.basename(baseline_path)} has device_kind="
+                f"{baseline.get('device_kind')!r}/jax="
+                f"{baseline.get('jax_version')!r}, fresh has "
+                f"{fresh.get('device_kind')!r}/{fresh.get('jax_version')!r}"
+            )
+            return 0
+    else:
+        baseline_path, baseline, reason = find_baseline(
+            repo_root, fresh.get("device_kind"), fresh.get("jax_version")
+        )
+        if baseline is None:
+            print(f"benchgate SKIP: {reason}")
+            return 0
+    regressions, compared, skipped = compare(fresh, baseline, tolerance_scale)
+    base_name = os.path.basename(baseline_path)
+    for line in skipped:
+        print(f"  skip {line}")
+    for line in compared:
+        print(f"  {line}")
+    if regressions:
+        print(f"benchgate FAILED vs {base_name}:")
+        for line in regressions:
+            print(f"  REGRESSION {line}")
+        return 1
+    if not compared:
+        print(f"benchgate SKIP: no comparable stage metrics vs {base_name}")
+        return 0
+    print(
+        f"benchgate OK vs {base_name}: {len(compared)} metrics within "
+        f"band ({len(skipped)} skipped)"
+    )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fresh", required=True,
+                    help="fresh bench JSON path, or - for stdin")
+    ap.add_argument("--repo-root",
+                    default=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))))
+    ap.add_argument("--baseline", default=None,
+                    help="explicit baseline path (default: newest "
+                         "matching BENCH_*.json)")
+    ap.add_argument("--tolerance-scale", type=float, default=1.0)
+    args = ap.parse_args()
+    if args.fresh == "-":
+        try:
+            fresh = load_record(json.load(sys.stdin))
+        except ValueError:
+            fresh = None
+    else:
+        fresh = load_record(args.fresh)
+    if fresh is None:
+        print(f"benchgate usage error: cannot load fresh record "
+              f"{args.fresh}")
+        return 2
+    return gate(fresh, args.repo_root, args.baseline, args.tolerance_scale)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,6 @@
 """Audit ``sentinel.tpu.*`` config keys against utils/config.py + docs.
 
-Two checks:
+Three checks:
 
 * **declaration** — every ``sentinel.tpu.*`` key referenced anywhere
   under ``sentinel_tpu/`` (code, docstrings, comments — a key mentioned
@@ -14,6 +14,13 @@ Two checks:
   family mention (``sentinel.tpu.ingest.*`` covers every
   ``sentinel.tpu.ingest.…`` key). A key an operator cannot find in the
   architecture doc is a key that drifts.
+* **metrics** (``audit_metrics``) — every Prometheus metric FAMILY the
+  exporter emits (read from a live ``render_metrics`` against a fresh
+  default engine, so a family added anywhere in the render path is
+  caught) and every ``TelemetryBus`` counter key must appear VERBATIM
+  in ``docs/ARCHITECTURE.md``. The PR-7 config-key rule applied to the
+  metric plane: an alert an operator cannot look up is an alert that
+  gets ignored.
 
 This is the guard that lets a new key family (like
 ``sentinel.tpu.ingest.*`` / ``sentinel.tpu.speculative.shaping.*``)
@@ -36,7 +43,7 @@ import argparse
 import os
 import re
 import sys
-from typing import Dict, List, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
@@ -114,19 +121,85 @@ def audit_docs(doc_path: str = "docs/ARCHITECTURE.md") -> List[str]:
     return sorted(undocumented)
 
 
+def prometheus_families() -> Set[str]:
+    """Every metric family the Prometheus exporter emits, read off the
+    ``# TYPE`` metadata of a live render against a fresh default
+    engine — introspection, not source-grepping, so a family built in
+    any helper (histogram buckets, the bounded resource export, a
+    future module) cannot dodge the audit."""
+    from sentinel_tpu.runtime.engine import Engine
+    from sentinel_tpu.transport.prometheus import render_metrics
+
+    text = render_metrics(Engine())
+    return {
+        line.split()[2]
+        for line in text.splitlines()
+        if line.startswith("# TYPE ")
+    }
+
+
+def telemetry_counter_keys() -> Set[str]:
+    """The TelemetryBus counter-key registry (metrics/telemetry.py)."""
+    from sentinel_tpu.metrics.telemetry import TelemetryBus
+
+    return set(TelemetryBus(enabled=False).counters)
+
+
+def audit_metrics(
+    doc_path: str = "docs/ARCHITECTURE.md",
+    families: Optional[Set[str]] = None,
+    counters: Optional[Set[str]] = None,
+) -> Tuple[List[str], List[str]]:
+    """``(undocumented_families, undocumented_counters)`` — Prometheus
+    families / TelemetryBus counter keys missing VERBATIM from the
+    doc; both sorted, both empty when clean. A missing/unreadable doc
+    reports everything (a deleted doc must not read as 'all
+    documented'). ``families``/``counters`` injection is the test
+    seam; production callers omit them."""
+    try:
+        with open(doc_path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        text = ""
+    if families is None:
+        families = prometheus_families()
+    if counters is None:
+        counters = telemetry_counter_keys()
+    # Verbatim word-boundary matches: "spec_admits" must not be
+    # satisfied by "spec_admits_total" prose about a different thing —
+    # but suffix-extended mentions DO document the base family for
+    # Prometheus names (…_total in the doc covers the sample name).
+    words = set(re.findall(r"[A-Za-z0-9_]+", text))
+    missing_fams = sorted(f for f in families if f not in words)
+    missing_ctrs = sorted(c for c in counters if c not in words)
+    return missing_fams, missing_ctrs
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default="sentinel_tpu")
     ap.add_argument("--doc", default="docs/ARCHITECTURE.md")
+    ap.add_argument(
+        "--no-metrics", action="store_true",
+        help="skip the metric-plane audit (it builds an Engine, which "
+             "needs a working jax backend)",
+    )
     args = ap.parse_args()
     missing, refs = audit(args.root)
     undocumented = audit_docs(args.doc)
+    bad_fams: List[str] = []
+    bad_ctrs: List[str] = []
+    if not args.no_metrics:
+        bad_fams, bad_ctrs = audit_metrics(args.doc)
     n_refs = sum(len(v) for v in refs.values())
-    if not missing and not undocumented:
+    if not missing and not undocumented and not bad_fams and not bad_ctrs:
         print(
             f"config audit OK: {len(refs)} distinct sentinel.tpu.* keys "
             f"({n_refs} mentions) all declared in utils/config.py and "
             f"documented in {args.doc}"
+            + ("" if args.no_metrics
+               else "; every Prometheus family and telemetry counter "
+                    "documented")
         )
         return 0
     if missing:
@@ -141,6 +214,16 @@ def main() -> int:
               f"{args.doc}:")
         for key in undocumented:
             print(f"  {key}")
+    if bad_fams:
+        print(f"config audit FAILED — Prometheus families emitted but "
+              f"not documented in {args.doc}:")
+        for name in bad_fams:
+            print(f"  {name}")
+    if bad_ctrs:
+        print(f"config audit FAILED — TelemetryBus counters not "
+              f"documented in {args.doc}:")
+        for name in bad_ctrs:
+            print(f"  {name}")
     return 1
 
 
