@@ -246,12 +246,12 @@ def _run_mixed_stage(n_rules: int, n_entries: int, iters: int) -> dict:
         stats, dev, dyn, dindex.device, dindex.make_dyn_state(), pdyn, sysdev,
         batch, sb, pb, shaping_rounds=sh_rounds, param_rounds=p_rounds,
     )
-    stats, dyn, ddyn, pdyn, result = out
+    stats, dyn, ddyn, pdyn, _sk, result = out
     jax.block_until_ready(result.admitted)
     _log(f"mixed: compile+first-run {time.perf_counter() - t0:.1f}s; timing {iters} iters")
     t0 = time.perf_counter()
     for _ in range(iters):
-        stats, dyn, ddyn, pdyn, result = flush_step_full_jit(
+        stats, dyn, ddyn, pdyn, _sk, result = flush_step_full_jit(
             stats, dev, dyn, dindex.device, ddyn, pdyn, sysdev, batch, sb, pb,
             shaping_rounds=sh_rounds, param_rounds=p_rounds,
         )
@@ -608,6 +608,109 @@ def _run_speculative_stage(n_rules: int, n_ops: int, iters: int) -> dict:
     }
 
 
+def _run_sketch_stage(n_rules: int, n_ops: int, iters: int) -> dict:
+    """Sketch tier (runtime/sketch.py): engine flush throughput over a
+    high-cardinality param-value stream with the tier ON (cold values
+    pass via the fixed-size device sketch) vs OFF (today: every value
+    interns a dense row, LRU churning) — the update-cost A/B — plus a
+    promotion-storm latency (wall ms until 16 simultaneous hot keys all
+    hold exact dense rows) and the candidate-table occupancy."""
+    from sentinel_tpu.models.rules import ParamFlowRule
+    from sentinel_tpu.runtime.engine import Engine
+    from sentinel_tpu.utils.config import config
+
+    n_ops, iters = max(64, n_ops), max(1, iters)
+    _log(f"sketch stage ops={n_ops}")
+    rule = ParamFlowRule(
+        resource="api", param_idx=0, count=1e9, sketch_mode=True
+    )
+
+    def _stream(eng) -> float:
+        """Flush ``iters`` batches of n_ops distinct-per-batch values;
+        returns ops/sec."""
+        uid = [0]
+
+        def batch():
+            col = [(f"v{uid[0] + j}",) for j in range(n_ops)]
+            uid[0] += n_ops
+            return col
+
+        eng.submit_bulk("api", n=n_ops, args_column=batch())
+        eng.flush()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            eng.submit_bulk("api", n=n_ops, args_column=batch())
+            eng.flush()
+        eng.drain()
+        return n_ops * iters / (time.perf_counter() - t0)
+
+    try:
+        config.set(config.SKETCH_ENABLED, "false")
+        eng_off = Engine()
+        eng_off.set_param_rules({"api": [rule]})
+        off_ops = _stream(eng_off)
+        eng_off.close()
+
+        config.set(config.SKETCH_ENABLED, "true")
+        config.set(config.SKETCH_PROMOTE_QPS, "50")
+        config.set(config.SKETCH_WINDOW_MS, "1000")
+        eng_on = Engine()
+        eng_on.set_param_rules({"api": [rule]})
+        on_ops = _stream(eng_on)
+
+        # Promotion storm: 16 hot keys appear at once; wall time until
+        # every one holds an exact dense row (bounded-flushes contract).
+        hot = [f"hot{i}" for i in range(16)]
+        t0 = time.perf_counter()
+        storm_flushes = 0
+        storm_ms = None
+        for step in range(40):
+            col = [(h,) for h in hot for _ in range(16)]
+            eng_on.submit_bulk("api", n=len(col), args_column=col)
+            eng_on.flush()
+            eng_on.drain()
+            storm_flushes += 1
+            promoted = eng_on.sketch.promoted_values.get("api", frozenset())
+            if all(h in promoted for h in hot):
+                storm_ms = (time.perf_counter() - t0) * 1e3
+                break
+            time.sleep(0.06)  # real clock: let decay windows roll
+        occupancy = eng_on.sketch.occupancy
+        promoted_n = eng_on.sketch.promoted_count
+        eng_on.close()
+    finally:
+        for key in (config.SKETCH_ENABLED, config.SKETCH_PROMOTE_QPS,
+                    config.SKETCH_WINDOW_MS):
+            config.set(key, config.DEFAULTS[key])
+
+    import jax
+
+    _log(
+        f"sketch stage done: on {on_ops:,.0f} ops/s vs off {off_ops:,.0f}"
+        f" ops/s; storm "
+        + (f"{storm_ms:.0f} ms" if storm_ms is not None else "INCOMPLETE")
+        + f" / {storm_flushes} flushes, promoted {promoted_n},"
+        f" occupancy {occupancy:.2f}"
+    )
+    out = {
+        "sketch_n_ops": n_ops,
+        "sketch_ops_per_sec_on": round(on_ops, 1),
+        "sketch_ops_per_sec_off": round(off_ops, 1),
+        "sketch_promote_storm_flushes": storm_flushes,
+        "sketch_promoted": promoted_n,
+        "sketch_occupancy": round(occupancy, 4),
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "jax_version": jax.__version__,
+    }
+    if storm_ms is not None:
+        # An incomplete storm (promotion never converged — noisy box
+        # or a regression) OMITS the metric rather than recording a
+        # bogus 0.0 a later benchgate baseline would gate against.
+        out["sketch_promote_storm_ms"] = round(storm_ms, 1)
+    return out
+
+
 def _run_stage(n_rules: int, n_entries: int, iters: int) -> dict:
     """Child-process body: build state, compile, time. Prints one JSON
     line with the stage result (including the platform ACTUALLY used)."""
@@ -666,7 +769,7 @@ def _run_stage(n_rules: int, n_entries: int, iters: int) -> dict:
     )
     _log("compiling + warm-up")
     t0 = time.perf_counter()
-    stats, dyn, ddyn, pdyn, result = flush_step_jit(
+    stats, dyn, ddyn, pdyn, _sk, result = flush_step_jit(
         stats, dev, dyn, ddev, ddyn, pdyn, sysdev, batch, **flags
     )
     jax.block_until_ready(result.admitted)
@@ -674,7 +777,7 @@ def _run_stage(n_rules: int, n_entries: int, iters: int) -> dict:
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        stats, dyn, ddyn, pdyn, result = flush_step_jit(
+        stats, dyn, ddyn, pdyn, _sk, result = flush_step_jit(
             stats, dev, dyn, ddev, ddyn, pdyn, sysdev, batch, **flags
         )
     jax.block_until_ready(result.admitted)
@@ -713,6 +816,7 @@ def _child_main(args) -> None:
         "mixed": _run_mixed_stage,
         "engine": _run_engine_stage,
         "speculative": _run_speculative_stage,
+        "sketch": _run_sketch_stage,
     }[args.kind]
     print(json.dumps(fn(args.rules, args.entries, args.iters)), flush=True)
 
@@ -938,7 +1042,12 @@ def main() -> None:
             _log(f"skipping engine stage: {remaining:.0f}s left gives timeout "
                  f"{engine_t:.0f}s < {min_engine:.0f}s floor")
         remaining = deadline - time.monotonic()
-        spec_t = min(remaining - 10, 300.0)
+        # Reserve the sketch stage's floor like the engine stage
+        # reserves the speculative's.
+        min_sketch = 40.0 if run_platform == "cpu" else 240.0
+        spec_t = min(remaining - 10 - min_sketch, 300.0)
+        if spec_t < min_spec:
+            spec_t = min(remaining - 10, 300.0)
         if spec_t >= min_spec:
             spec = spawn(64, 4096, 3, run_platform, spec_t, kind="speculative")
             if spec:
@@ -946,6 +1055,15 @@ def main() -> None:
         else:
             _log(f"skipping speculative stage: {remaining:.0f}s left gives "
                  f"timeout {spec_t:.0f}s < {min_spec:.0f}s floor")
+        remaining = deadline - time.monotonic()
+        sketch_t = min(remaining - 10, 300.0)
+        if sketch_t >= min_sketch:
+            sketch = spawn(64, 8192, 3, run_platform, sketch_t, kind="sketch")
+            if sketch:
+                best.update(sketch)
+        else:
+            _log(f"skipping sketch stage: {remaining:.0f}s left gives "
+                 f"timeout {sketch_t:.0f}s < {min_sketch:.0f}s floor")
 
     if best is None:
         _emit(

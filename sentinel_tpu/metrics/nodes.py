@@ -323,6 +323,23 @@ class NodeRegistry:
             self._n_contexts += 1
             return self._alloc(key)
 
+    def promote_cluster_row(self, resource: str) -> int:
+        """Cluster-row allocation that IGNORES the resource cap — the
+        sketch tier's promotion grant (runtime/sketch.py): an over-cap
+        resource that proved itself a heavy hitter deserves the dense
+        row the first-come-first-served cap refused it. Rows are never
+        released, so the TIER budgets cumulative grants (8x its
+        ``promote.max`` — see ``SketchTier._cap_grants``); a churn of
+        distinct over-cap heavy hitters cannot regrow unbounded
+        per-key state through this door."""
+        key = NodeKind.CLUSTER + ":" + resource
+        with self._lock:
+            row = self._rows.get(key)
+            if row is not None:
+                return row
+            self._n_resources += 1
+            return self._alloc(key)
+
     def lookup_cluster_row(self, resource: str) -> Optional[int]:
         with self._lock:
             return self._rows.get(NodeKind.CLUSTER + ":" + resource)

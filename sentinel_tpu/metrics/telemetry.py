@@ -20,7 +20,7 @@ module is the first-class telemetry layer:
   averages;
 * a host-side **space-saving top-K sketch** of blocked weight per
   resource, fed by the *on-device* per-flush top-K that the flush
-  kernel folds into its outputs (runtime/flush.py ``sketch_k``) — the
+  kernel folds into its outputs (runtime/flush.py ``blk_topk``) — the
   data-plane heavy-hitter design (Sivaraman et al., arXiv:1611.04825;
   Basat et al., arXiv:1710.03155): compute the candidate set where the
   verdicts are, fetch only the summary on the existing coalesced
@@ -35,10 +35,23 @@ engine's bus is therefore the process view. Config keys::
 
     sentinel.tpu.telemetry.enabled      default true
     sentinel.tpu.telemetry.ring         span ring capacity, default 4096
-    sentinel.tpu.telemetry.sketch.k     device top-K per flush, default 8
-                                        (0 disables the kernel fold)
-    sentinel.tpu.telemetry.sketch.capacity
-                                        host summary capacity, default 64
+    sentinel.tpu.telemetry.blocked.topk.k
+                                        device blocked top-K per flush,
+                                        default 8 (0 disables the fold);
+                                        falls back to the historical
+                                        sentinel.tpu.telemetry.sketch.k
+    sentinel.tpu.telemetry.blocked.topk.capacity
+                                        host summary capacity, default
+                                        64; falls back to
+                                        sentinel.tpu.telemetry.sketch.capacity
+    sentinel.tpu.telemetry.topk.export  rows the exports list when the
+                                        fold is off, default 10
+
+The ``blocked.*`` spelling landed with the statistics sketch tier
+(runtime/sketch.py, ``sentinel.tpu.sketch.*``) so the PR-3
+blocked-weight top-K and the count-min statistics tier stay
+distinguishable in code, config, and docs; ``TelemetryBus.sketch`` /
+``sketch_k`` remain as deprecated read aliases.
 """
 
 from __future__ import annotations
@@ -197,12 +210,17 @@ class TelemetryBus:
             if ring is not None
             else config.get_int(config.TELEMETRY_RING, 4096),
         )
-        self.sketch_k = max(
-            0,
-            sketch_k
-            if sketch_k is not None
-            else config.get_int(config.TELEMETRY_SKETCH_K, 8),
-        )
+        # Blocked-weight top-K fold size (PR 3) — NOT the statistics
+        # sketch tier (sentinel.tpu.sketch.*, runtime/sketch.py). The
+        # ``blocked.topk.k`` spelling is preferred; the historical
+        # ``telemetry.sketch.k`` key is the fallback when unset.
+        if sketch_k is not None:
+            k = sketch_k
+        else:
+            k = config.get_int(config.TELEMETRY_BLOCKED_TOPK_K, -1)
+            if k < 0:
+                k = config.get_int(config.TELEMETRY_SKETCH_K, 8)
+        self.blocked_topk_k = max(0, k)
         self._spans: "deque[FlushSpan]" = deque(maxlen=self.ring_size)
         self._lock = threading.Lock()
         self._next_id = 0
@@ -243,6 +261,13 @@ class TelemetryBus:
             "spec_system_blocks": 0,
             # Ingest valve (runtime/ingest.py): ops shed at submit.
             "ingest_shed": 0,
+            # Statistics sketch tier (runtime/sketch.py): distinct keys
+            # folded per chunk, heavy-hitter promotions/demotions, and
+            # DEGRADED host-mirror folds.
+            "sketch_keys": 0,
+            "sketch_promotions": 0,
+            "sketch_demotions": 0,
+            "sketch_host_folds": 0,
         }
         # Bounded ring of health transitions (now_ms is engine-clock
         # relative ms): the flight-recorder view of the failover state
@@ -251,11 +276,13 @@ class TelemetryBus:
         self.health_events: "deque[Tuple[int, str, str, str]]" = deque(
             maxlen=64
         )
-        self.sketch = SpaceSaving(
-            sketch_capacity
-            if sketch_capacity is not None
-            else config.get_int(config.TELEMETRY_SKETCH_CAP, 64)
-        )
+        if sketch_capacity is not None:
+            cap = sketch_capacity
+        else:
+            cap = config.get_int(config.TELEMETRY_BLOCKED_TOPK_CAP, -1)
+            if cap < 0:
+                cap = config.get_int(config.TELEMETRY_SKETCH_CAP, 64)
+        self.blocked_sketch = SpaceSaving(cap)
         # Most recent flush's device top-K, already name-resolved:
         # [(resource, blocked_weight)] — the "what is being throttled
         # right now" read, no extra host round-trip.
@@ -266,6 +293,31 @@ class TelemetryBus:
         # inserts evict the oldest past _SEC_CAP.
         self._sec: Dict[int, List[float]] = {}
         self._SEC_CAP = 600
+
+    # ------------------------------------------------------------------
+    # naming-compat aliases (PR-3 callers): ``sketch``/``sketch_k``
+    # predate the statistics sketch tier — the blocked-weight fold now
+    # lives under its own name so the two planes stay distinguishable.
+    # ------------------------------------------------------------------
+    @property
+    def sketch(self) -> SpaceSaving:
+        """Deprecated alias of :attr:`blocked_sketch`."""
+        return self.blocked_sketch
+
+    @property
+    def sketch_k(self) -> int:
+        """Deprecated alias of :attr:`blocked_topk_k`."""
+        return self.blocked_topk_k
+
+    @property
+    def export_topk_k(self) -> int:
+        """How many blocked-top-K rows the exports list — the ONE home
+        of the former hand-rolled ``sketch_k or 10`` (Prometheus, the
+        ``telemetry`` command, and the sketch tier's candidate listing
+        all read this)."""
+        return self.blocked_topk_k or config.get_int(
+            config.TELEMETRY_TOPK_EXPORT, 10
+        )
 
     # ------------------------------------------------------------------
     # span lifecycle (engine hot path)
@@ -401,11 +453,30 @@ class TelemetryBus:
         with self._lock:
             self.counters["ingest_shed"] += n
 
+    # ------------------------------------------------------------------
+    # statistics sketch tier (runtime/sketch.py)
+    # ------------------------------------------------------------------
+    def note_sketch_keys(self, n: int) -> None:
+        with self._lock:
+            self.counters["sketch_keys"] += n
+
+    def note_sketch_promotion(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["sketch_promotions"] += n
+
+    def note_sketch_demotion(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["sketch_demotions"] += n
+
+    def note_sketch_host_fold(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["sketch_host_folds"] += n
+
     def fold_blocked_topk(self, pairs: Sequence[Tuple[str, int]]) -> None:
         """Fold one flush's device top-K (already name-resolved) into
         the running space-saving summary."""
         for key, w in pairs:
-            self.sketch.offer(key, w)
+            self.blocked_sketch.offer(key, w)
         self.last_blocked_topk = list(pairs)
 
     # ------------------------------------------------------------------
@@ -446,7 +517,7 @@ class TelemetryBus:
             "spec_drift_per_window": self.hist_spec_drift.summary(),
             "blocked_topk": [
                 {"resource": k, "weight": c, "max_error": e}
-                for k, c, e in self.sketch.topk(self.sketch_k or 10)
+                for k, c, e in self.blocked_sketch.topk(self.export_topk_k)
             ],
             "last_flush_blocked_topk": [
                 {"resource": k, "weight": w} for k, w in self.last_blocked_topk
@@ -473,6 +544,9 @@ class TelemetryBus:
             pindex = getattr(engine, "param_index", None)
             if pindex is not None and hasattr(pindex, "cache_stats"):
                 out["param_cache"] = pindex.cache_stats()
+            tier = getattr(engine, "sketch", None)
+            if tier is not None and tier.armed:
+                out["sketch_tier"] = tier.snapshot()
         return out
 
     def bench_summary(self) -> dict:
@@ -490,7 +564,7 @@ class TelemetryBus:
             "arena_hit_rate": round(c["arena_hits"] / denom, 4) if denom else 0.0,
             "coalesced_fallbacks": c["coalesced_fallbacks"],
             "blocked_topk": [
-                [k, c_] for k, c_, _ in self.sketch.topk(5)
+                [k, c_] for k, c_, _ in self.blocked_sketch.topk(5)
             ],
         }
 

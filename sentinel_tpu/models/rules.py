@@ -73,6 +73,11 @@ class FlowRule(AbstractRule):
     max_queueing_time_ms: int = 500
     cluster_mode: bool = False
     cluster_config: Optional[ClusterFlowConfig] = None
+    # True only on rules the sketch tier synthesized for a promoted
+    # unconfigured resource (runtime/sketch.py). A user rule reload
+    # never carries it, so the tier can tell its own synthetics apart
+    # when rebuilding the rule set on promotion/demotion.
+    from_sketch: bool = False
 
     def is_valid(self) -> bool:
         # Reference: FlowRuleUtil.isValidRule — non-null resource, count >= 0,
@@ -177,6 +182,13 @@ class ParamFlowRule(AbstractRule):
     burst_count: int = 0
     duration_in_sec: int = 1
     param_flow_item_list: Tuple[ParamFlowItem, ...] = field(default_factory=tuple)
+    # Sketch-native mode (runtime/sketch.py): cold values are tracked
+    # only by the fixed-size device sketch and PASS without a dense
+    # row; sketch-detected heavy hitters are promoted into exact dense
+    # rows (threshold = this rule's count, hot items still override)
+    # and demoted back on decay. With the sketch tier disabled the
+    # flag is ignored and the rule dense-tracks every value as before.
+    sketch_mode: bool = False
     cluster_mode: bool = False
     # ParamFlowClusterConfig (reference: ParamFlowClusterConfig.java:30-49)
     # shares ClusterFlowConfig's shape: flowId, thresholdType,

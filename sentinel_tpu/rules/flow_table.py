@@ -317,6 +317,18 @@ class FlowIndex:
     def get_rules(self) -> List[FlowRule]:
         return [cr.rule for cr in self.rules]
 
+    def user_rules(self) -> List[FlowRule]:
+        """Rules excluding sketch-tier synthetics (``from_sketch``) —
+        the base a promotion/demotion rebuild layers its synthetic
+        dense guards on top of (runtime/sketch.py). A user reload
+        through the rule manager never carries synthetics, so the tier
+        re-asserts live promotions on its next controller pass."""
+        return [
+            cr.rule
+            for cr in self.rules
+            if not getattr(cr.rule, "from_sketch", False)
+        ]
+
     def rule_of_gid(self, gid: int) -> Optional[FlowRule]:
         if 0 <= gid < len(self.rules):
             return self.rules[gid].rule

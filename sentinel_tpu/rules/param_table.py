@@ -416,9 +416,22 @@ def run_param(
 
 
 class ParamIndex:
-    """Host-side compiled hot-param rules + per-rule value interning."""
+    """Host-side compiled hot-param rules + per-rule value interning.
 
-    def __init__(self, by_resource: Dict[str, List[ParamFlowRule]]) -> None:
+    ``sketch_tier`` (runtime/sketch.SketchTier, optional) activates
+    sketch-native resolution for rules with ``sketch_mode=True``: cold
+    values get NO dense row (they pass; the fixed-size device sketch
+    tracks them), and only values in the tier's promoted set intern
+    into exact rows — the promotion target the sketch controller
+    drives through this index's existing LRU row-recycle machinery.
+    Without a tier (or with it disarmed) sketch-mode rules dense-track
+    every value exactly like before."""
+
+    def __init__(
+        self,
+        by_resource: Dict[str, List[ParamFlowRule]],
+        sketch_tier=None,
+    ) -> None:
         self.by_resource: Dict[str, List[Tuple[int, ParamFlowRule]]] = {}
         self.rules: List[ParamFlowRule] = []
         for res, rs in by_resource.items():
@@ -428,6 +441,30 @@ class ParamIndex:
                 self.rules.append(r)
                 lst.append((gid, r))
             self.by_resource[res] = lst
+        self._sketch_tier = sketch_tier
+        self.sketch_gids = {
+            gid
+            for gid, r in (
+                (g, r) for lst in self.by_resource.values() for g, r in lst
+            )
+            if getattr(r, "sketch_mode", False)
+        }
+        # resource -> sorted distinct param_idx of its sketch-mode
+        # rules: the key-extraction map the tier's encode walks.
+        self.sketch_idx_by_resource: Dict[str, Tuple[int, ...]] = {}
+        if sketch_tier is not None and getattr(sketch_tier, "armed", False):
+            for res, lst in self.by_resource.items():
+                idxs = sorted(
+                    {
+                        r.param_idx
+                        for _g, r in lst
+                        if getattr(r, "sketch_mode", False)
+                        and r.param_idx is not None
+                    }
+                )
+                if idxs:
+                    self.sketch_idx_by_resource[res] = tuple(idxs)
+        self._sketch_filtering = bool(self.sketch_idx_by_resource)
         # (gid) -> {value_key -> prow}; LRU by insertion-move.
         self._values: List[Dict[str, int]] = [dict() for _ in self.rules]
         # Persistent per-rule resolved-value cache: value_key ->
@@ -513,6 +550,14 @@ class ParamIndex:
         for gid, r in self.by_resource.get(resource, ()):
             if r.param_idx is None or r.param_idx >= len(args):
                 continue
+            promoted = None
+            if self._sketch_filtering and gid in self.sketch_gids:
+                # Sketch-native rule: only promoted heavy hitters get a
+                # dense slot; cold values pass here and are tracked by
+                # the device sketch instead (runtime/sketch.py).
+                promoted = self._sketch_tier.promoted_values.get(resource)
+                if not promoted:
+                    continue
             value = args[r.param_idx]
             values = (
                 list(value) if isinstance(value, (list, tuple, set, frozenset)) else [value]
@@ -520,6 +565,8 @@ class ParamIndex:
             for v in values:
                 key = self._value_key(v)
                 if key is None:
+                    continue
+                if promoted is not None and key not in promoted:
                     continue
                 # acquire==1 cost (the API default); recomputed
                 # host-side per acquire at submit if needed.
@@ -600,6 +647,8 @@ class ParamIndex:
         if values is None:
             z = np.zeros(n, dtype=np.int32)
             return np.zeros(n, dtype=bool), z, z.copy(), z.copy()
+        if self._sketch_filtering and gid in self.sketch_gids:
+            return self._resolve_value_col_sketch(gid, r, values, n)
         if self._use_value_cache:
             rget = self._resolved[gid].get
             miss = _MISS
@@ -682,6 +731,55 @@ class ParamIndex:
                 ).reshape(n, 3)
             return valid, arr[:, 0], arr[:, 1], arr[:, 2]
         return self._resolve_value_col_exact(gid, r, values, n)
+
+    def _resolve_value_col_sketch(
+        self, gid: int, r: ParamFlowRule, values: Sequence[object], n: int
+    ) -> Optional[Tuple["np.ndarray", "np.ndarray", "np.ndarray", "np.ndarray"]]:
+        """Sketch-native column resolve: only values in the tier's
+        promoted set intern into dense rows; every other value is
+        invalid (the rule passes it — the sketch tracks it instead).
+        The 100k-distinct-cold-keys case is a single dict read: with
+        nothing promoted, NO per-value work happens at all — that is
+        the O(1) contract this tier exists for."""
+        promoted = self._sketch_tier.promoted_values.get(r.resource)
+        valid = np.zeros(n, dtype=bool)
+        z = np.zeros(n, dtype=np.int32)
+        if values is None or not promoted:
+            return valid, z, z.copy(), z.copy()
+        prow = np.zeros(n, dtype=np.int32)
+        tc = np.zeros(n, dtype=np.int32)
+        cost = np.zeros(n, dtype=np.int32)
+        rget = self._resolved[gid].get
+        for j, v in enumerate(values):
+            if v is None:
+                continue
+            if isinstance(v, (list, tuple, set, frozenset)):
+                return None  # collection expansion → per-entry path
+            key = v if type(v) is str else self._value_key(v)
+            if key is None or key not in promoted:
+                continue
+            trip = rget(key)
+            if trip is None:
+                trip = self._resolve_value(gid, r, key)
+            valid[j] = True
+            prow[j], tc[j], cost[j] = trip
+        return valid, prow, tc, cost
+
+    def release_value(self, resource: str, key: str) -> None:
+        """Sketch-tier demotion: drop a promoted value's dense row and
+        queue its device-state reset — the inverse of the promotion
+        intern, reusing the same recycle plumbing as LRU eviction. A
+        later re-promotion re-interns fresh (first-seen bucket state),
+        so promote → demote → promote never resurrects stale tokens."""
+        for gid, _r in self.by_resource.get(resource, ()):
+            if gid not in self.sketch_gids:
+                continue
+            row = self._values[gid].pop(key, None)
+            if row is None:
+                continue
+            self._resolved[gid].pop(key, None)
+            self.pending_resets.append(row)
+            self._free_rows.append(row)
 
     def _resolve_value_col_exact(
         self, gid: int, r: ParamFlowRule, values: Sequence[object], n: int

@@ -80,6 +80,7 @@ from sentinel_tpu.runtime.flush import (
     flush_step_param_jit,
     flush_step_shaping_jit,
 )
+from sentinel_tpu.runtime.sketch import SketchBatch
 from sentinel_tpu.utils.system_status import sampler as system_sampler
 from sentinel_tpu.utils.clock import Clock, SystemClock, default_clock
 from sentinel_tpu.utils.config import config
@@ -689,12 +690,12 @@ class Engine:
             "drain_ms": 0.0,
         }
         # Engine flight recorder (metrics/telemetry.py): per-flush
-        # spans + histograms + blocked-resource sketch. When disabled,
+        # spans + histograms + blocked-resource top-K. When disabled,
         # the hot path pays exactly one bool read per flush and the
-        # kernel sketch fold compiles away (sketch_k=0).
+        # kernel blocked-weight fold compiles away (blk_topk=0).
         self.telemetry = TelemetryBus()
-        self._sketch_k = (
-            self.telemetry.sketch_k if self.telemetry.enabled else 0
+        self._blk_topk_k = (
+            self.telemetry.blocked_topk_k if self.telemetry.enabled else 0
         )
         # Admission tracer (metrics/admission_trace.py): sampled
         # per-request verdict provenance. Disabled = one bool read per
@@ -766,6 +767,15 @@ class Engine:
         from sentinel_tpu.metrics.provenance import ResourceProvenance
 
         self.resource_metrics = ResourceProvenance()
+        # Statistics sketch tier (runtime/sketch.py): fixed-size
+        # on-device count-min + candidate table over EVERY key the
+        # engine sees, with heavy-hitter promotion to exact dense rows.
+        # Disarmed by default — one attribute read per call site; armed,
+        # the fold is threaded through the flush kernel and the
+        # candidate table rides the coalesced drain fetch.
+        from sentinel_tpu.runtime.sketch import SketchTier
+
+        self.sketch = SketchTier(self)
         # True when a close()/stop could not join a worker thread in
         # time — the shutdown LOOKED clean but leaked a live thread.
         self.closed_dirty = False
@@ -920,7 +930,7 @@ class Engine:
             with self._flush_lock:
                 self._flush_locked(drained)
                 with self._lock:
-                    pindex = ParamIndex(by_resource)
+                    pindex = ParamIndex(by_resource, sketch_tier=self.sketch)
                     self.param_index = pindex
                     self.param_dyn = make_param_state(8)
                 self.speculative.on_rules_reloaded()
@@ -1054,6 +1064,12 @@ class Engine:
                 ts, tuple(args),
             )
         if op is None:
+            # Over-cap pass-through: the ONE key class the encode path
+            # never sees — the sketch tier tracks it anyway (O(1)
+            # device state), and a promotion later grants the dense
+            # row the cap refused (runtime/sketch.py).
+            if self.sketch.armed:
+                self.sketch.note_unrouted(resource, acquire)
             return None
         # Trace tag OUTSIDE the lock: the stamp (RNG draw, clock read,
         # contextvar get) doesn't depend on the index snapshot, and the
@@ -1256,6 +1272,10 @@ class Engine:
                     tuple(req.get("args", ())),
                 )
                 if op is None:
+                    if self.sketch.armed:
+                        self.sketch.note_unrouted(
+                            req["resource"], req.get("acquire", 1)
+                        )
                     out.append(None)
                     resume_at = i + 1
                     continue
@@ -1640,6 +1660,9 @@ class Engine:
             dindex = self.degrade_index
             rows = self.resolve_entry_rows(resource, context_name, origin, entry_type)
             if rows is None:
+                if self.sketch.armed:
+                    acq = self._bulk_col(acquire, n, 1)
+                    self.sketch.note_unrouted(resource, int(acq.sum()))
                 return None
             slots = findex.resolve_slots(resource, context_name, origin, self.nodes)
             if findex.cluster_gids and any(
@@ -1855,6 +1878,8 @@ class Engine:
             np.maximum(g.ts - offset, 0, out=g.ts)
         for g in self._bulk_exits:
             np.maximum(g.ts - offset, 0, out=g.ts)
+        if self.sketch.armed:
+            self.sketch.on_rebase(offset)
 
     def _shift_states(self, stats, flow_dyn, degrade_dyn, param_dyn, offset):
         """Shift every absolute-ms tensor in one state family set by
@@ -2358,6 +2383,15 @@ class Engine:
                     self._bulk_exit_pending_n = 0
                 if not entries and not exits and not bulk_e and not bulk_x:
                     return []
+                if self.sketch.armed:
+                    # Device sketch unreachable: the key stream folds
+                    # into the tier's host space-saving mirror so the
+                    # controller keeps seeing heavy hitters while
+                    # DEGRADED (runtime/sketch.py).
+                    self.sketch.fold_host_chunk(
+                        entries, bulk_e, self.flow_index, self.param_index,
+                        self.clock.now_ms(),
+                    )
                 items = fo.fill_degraded(entries, exits, bulk_e, bulk_x)
                 drained = (entries, items)
         if drained is None:
@@ -2400,6 +2434,12 @@ class Engine:
                 fo.try_recover()
             if not fo.healthy:
                 return self._flush_degraded()
+        if self.sketch.armed and self.sketch.pending_actions:
+            # Queued sketch promotions/demotions (flow-rule rebuilds,
+            # param row releases) land at flush entry, OUTSIDE the
+            # flush lock — "promoted within a bounded number of
+            # flushes" is this line (runtime/sketch.py).
+            self.sketch.apply_actions()
         depth = self._pipeline_depth
         if depth > 0:
             return self._flush_pipelined(depth)
@@ -2494,6 +2534,8 @@ class Engine:
             # Degraded: no device dispatch to defer — policy verdicts
             # fill synchronously (recovery attempts stay on flush()).
             return self._flush_degraded()
+        if self.sketch.armed and self.sketch.pending_actions:
+            self.sketch.apply_actions()
         return self._dispatch_deferred(
             keep_dispatched=self._max_inflight, keep_empty=self._max_inflight
         )
@@ -3063,6 +3105,19 @@ class Engine:
         sysdev = self._system_device()
         shaping, sh_rounds = self._encode_shaping(entries, bulk, k, findex)
         param, p_rounds = self._encode_param(entries, exits, pindex, bulk, staging)
+        # Statistics sketch tier (runtime/sketch.py): aggregate this
+        # chunk's key-id stream and schedule the once-per-window decay
+        # — the fold itself runs inside the kernel, chained on the
+        # donated SketchState exactly like the stats windows.
+        tier = self.sketch
+        sk_batch = None
+        sk_decay = False
+        if tier.armed and self.mesh is None:
+            sk_ids, sk_w = tier.encode_chunk(entries, bulk, findex, pindex)
+            sk_decay = tier.decay_due(now_host)
+            sk_batch = SketchBatch(
+                ids=jnp.asarray(sk_ids), w=jnp.asarray(sk_w)
+            )
         occ_ms = config.occupy_timeout_ms
         common = (
             self.stats,
@@ -3085,8 +3140,9 @@ class Engine:
             shaping_rounds=sh_rounds,
             param_rounds=p_rounds,
             # Device-side blocked-resource top-K fold (0 when telemetry
-            # is off — the sketch then compiles away entirely).
-            sketch_k=self._sketch_k,
+            # is off — the fold then compiles away entirely).
+            blk_topk=self._blk_topk_k,
+            sketch_decay=sk_decay,
             # Keys the jit cache on the live window geometry so a
             # retune_second_window with unchanged shapes (interval-only
             # change) cannot hit a stale-constant entry.
@@ -3105,19 +3161,24 @@ class Engine:
             if self._sharded_fns is not None:
                 # Mesh mode: one global batch sharded over the chips;
                 # shaping/param item batches (global coordinates) ride
-                # replicated into the globally-ordered scans.
+                # replicated into the globally-ordered scans. The
+                # sketch tier stays single-chip for now (sk_batch is
+                # None on the mesh path) — the sharded kernels return
+                # the 5-tuple shape and None rides through.
                 fn = self._sharded_fn_for(
                     shaping is not None, param is not None, sh_rounds, p_rounds
                 )
                 extra = tuple(b for b in (shaping, param) if b is not None)
-                return fn(*common, *extra)
+                st, fdyn, ddyn2, pdyn2, res = fn(*common, *extra)
+                return st, fdyn, ddyn2, pdyn2, None, res
+            skw = dict(skstate=tier.dev_state, sk=sk_batch) if sk_batch is not None else {}
             if shaping is None and param is None:
-                return flush_step_jit(*common, occupy_timeout_ms=occ_ms, **flags)
+                return flush_step_jit(*common, occupy_timeout_ms=occ_ms, **skw, **flags)
             if param is None:
-                return flush_step_shaping_jit(*common, shaping, occupy_timeout_ms=occ_ms, **flags)
+                return flush_step_shaping_jit(*common, shaping, occupy_timeout_ms=occ_ms, **skw, **flags)
             if shaping is None:
-                return flush_step_param_jit(*common, param, occupy_timeout_ms=occ_ms, **flags)
-            return flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms, **flags)
+                return flush_step_param_jit(*common, param, occupy_timeout_ms=occ_ms, **skw, **flags)
+            return flush_step_full_jit(*common, shaping, param, occupy_timeout_ms=occ_ms, **skw, **flags)
 
         try:
             if fo.armed:
@@ -3139,7 +3200,14 @@ class Engine:
                                         bulk_exits, defer,
                                         run_custom_slots=False,
                                         quarantined=True)
-        self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn, result = out
+        (
+            self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn,
+            new_skstate, result,
+        ) = out
+        if new_skstate is not None:
+            # The donated sketch chain advances under the flush lock,
+            # exactly like the other dyn states.
+            tier.dev_state = new_skstate
         dispatch_ms = (time.perf_counter() - t_disp0) * 1e3
         with self._timing_lock:
             self._flush_timing["dispatch_ms"] += dispatch_ms
@@ -3246,7 +3314,22 @@ class Engine:
         else:
             shaping_snap = None
 
-        has_sketch = result.blk_rows is not None
+        # Sketch candidate table: rides the same coalesced fetch. A
+        # deferred chunk must copy — the next flush donates the sketch
+        # state into its kernel, deleting the arrays before a deferred
+        # fetch runs (the breaker_snap hazard).
+        if new_skstate is not None:
+            if defer:
+                sk_snap = (
+                    jnp.copy(new_skstate.cand_ids),
+                    jnp.copy(new_skstate.cand_cnt),
+                )
+            else:
+                sk_snap = (new_skstate.cand_ids, new_skstate.cand_cnt)
+        else:
+            sk_snap = None
+
+        has_blk = result.blk_rows is not None
         # Admission-trace flush linkage: the deciding flush-span seq
         # (TelemetryBus ids) — -1 when the flight recorder is off.
         flush_seq = span.flush_id if span is not None else -1
@@ -3274,11 +3357,12 @@ class Engine:
             return self._fill_results(
                 got, entries, exits, bulk, bulk_exits, findex, dindex,
                 auth_rules, k, kd, breaker_snap=breaker_snap,
-                sketch=has_sketch, flush_seq=flush_seq,
+                blk_topk=has_blk, flush_seq=flush_seq,
                 shaping_snap=shaping_snap is not None,
+                sketch_snap=sk_snap is not None,
             )
 
-        refs = self._result_refs(result, breaker_snap, shaping_snap)
+        refs = self._result_refs(result, breaker_snap, shaping_snap, sk_snap)
         if ckpt_meta is not None:
             refs = refs + (states,)
         if defer:
@@ -3397,13 +3481,14 @@ class Engine:
             breaker_events.fire_transitions(prev, new_state, dindex)
 
     @staticmethod
-    def _result_refs(result, breaker_snap, shaping_snap=None) -> tuple:
+    def _result_refs(result, breaker_snap, shaping_snap=None, sk_snap=None) -> tuple:
         """The device arrays one chunk's verdict fill consumes — kept
         as a tuple so a drain can batch MANY chunks' refs into one
         coalesced ``jax.device_get`` (each separate fetch costs a full
         round-trip on remote-tunnel backends). The breaker state rides
         the same fetch when observers are registered; the shaping dyn
-        columns ride it when the speculative shaping mirror is on."""
+        columns ride it when the speculative shaping mirror is on; the
+        sketch candidate table rides it when the sketch tier is armed."""
         refs = (
             result.admitted,
             result.reason,
@@ -3420,6 +3505,8 @@ class Engine:
             refs = refs + (breaker_snap[2],)
         if shaping_snap is not None:
             refs = refs + shaping_snap
+        if sk_snap is not None:
+            refs = refs + sk_snap
         return refs
 
     def _fold_blocked_sketch(self, rows, weights) -> None:
@@ -3459,7 +3546,7 @@ class Engine:
                     agg[g.resource] = agg.get(g.resource, 0) + w
         self.telemetry.fold_blocked_topk(
             sorted(agg.items(), key=lambda kv: kv[1], reverse=True)[
-                : self._sketch_k
+                : self._blk_topk_k
             ]
         )
 
@@ -3476,9 +3563,10 @@ class Engine:
         k: int,
         kd: int,
         breaker_snap=None,
-        sketch: bool = False,
+        blk_topk: bool = False,
         flush_seq: int = -1,
         shaping_snap: bool = False,
+        sketch_snap: bool = False,
     ) -> List[tuple]:
         """Verdict fill for one dispatched chunk from its ALREADY
         FETCHED result tuple (``got`` = the host values of
@@ -3487,7 +3575,7 @@ class Engine:
         _run_chunk or deferred from a _PendingFetch materialization."""
         admitted, reason, slot_ok, wait_ms, sys_type, dslot_ok = got[:6]
         nxt = 6
-        if sketch:
+        if blk_topk:
             self._fold_blocked_sketch(got[6], got[7])
             nxt = 8
         if breaker_snap is not None:
@@ -3506,6 +3594,15 @@ class Engine:
                 np.asarray(got[nxt + 2]),
             )
             nxt += 3
+        if sketch_snap:
+            # Settled sketch candidate table: the promotion/demotion
+            # controller evaluates at every drain (runtime/sketch.py).
+            self.sketch.on_drain(
+                np.asarray(got[nxt], dtype=np.int32),
+                np.asarray(got[nxt + 1], dtype=np.int32),
+                self.clock.now_ms(),
+            )
+            nxt += 2
         # One verdict-materialization timestamp for every admission in
         # the chunk (they all settle together; per-op clocks would add
         # a syscall per row for no attribution gain).
@@ -3645,11 +3742,11 @@ class Engine:
                 g.trace = None
             off_b += g.n
 
-        if not sketch and self._sketch_k > 0:
+        if not blk_topk and self._blk_topk_k > 0:
             # Kernel paths without the device fold (the sharded mesh
-            # flush) still feed the sketch: recount blocked weight
-            # host-side from the verdicts just filled — exact, and the
-            # data is already on the host.
+            # flush) still feed the blocked top-K: recount blocked
+            # weight host-side from the verdicts just filled — exact,
+            # and the data is already on the host.
             self._fold_blocked_recount(entries, [g for g, _ in bulk_slices])
 
         # ---- block log + metric-extension callbacks ----
@@ -4034,6 +4131,7 @@ class Engine:
         self.speculative.reset()
         self.ingest.reset()
         self.resource_metrics.reset()
+        self.sketch.reset()
         with self._flush_lock, self._lock:
             self._entries.clear()
             self._exits.clear()

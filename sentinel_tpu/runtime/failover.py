@@ -1806,6 +1806,12 @@ class FailoverManager:
             eng.flow_dyn = flow_dyn
             eng.degrade_dyn = degrade_dyn
             eng.param_dyn = param_dyn
+            # The sketch tier's donated chain may have died with the
+            # faulted dispatch (checkpoints don't carry it — it is
+            # approximate by contract): restore starts it fresh and
+            # counts re-accumulate within a decay window. Promotion
+            # state is host-side and survives untouched.
+            eng.sketch.reset_device_state()
             # Resync the breaker host mirror to the restored world so
             # observers (and a later degraded window) never diff
             # against pre-fault state.
@@ -1844,13 +1850,13 @@ class FailoverManager:
                 with_system=False,
                 with_degrade=False,
                 with_exits=False,
-                sketch_k=0,
+                blk_topk=0,
                 win_key=_ncfg.SECOND_CFG,
             ),
             "probe dispatch",
             (seq,),
         )
-        eng.stats, eng.flow_dyn, eng.degrade_dyn, eng.param_dyn, result = out
+        eng.stats, eng.flow_dyn, eng.degrade_dyn, eng.param_dyn, _sk, result = out
         eng._fetch_refs((result.admitted,), (seq,))
         with self._lock:
             self.counters["probe_flushes"] += 1

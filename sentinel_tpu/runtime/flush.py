@@ -67,6 +67,7 @@ from sentinel_tpu.rules.degrade_table import (
 from sentinel_tpu.rules.flow_table import FlowRuleDynState, FlowTableDevice
 from sentinel_tpu.rules.param_table import ParamBatch, ParamDynState, run_param
 from sentinel_tpu.rules.shaping import ShapingBatch, run_shaping
+from sentinel_tpu.runtime.sketch import SketchBatch, SketchState, sketch_fold
 
 # Plain int, not jnp.int32: creating a device array at import time would
 # commit the JAX backend before callers can pick a platform (see
@@ -135,13 +136,15 @@ class FlushResult(NamedTuple):
     occ_slot: jax.Array  # bool [N, K] — the specific slots that borrowed
     # (admission-gated); the sharded borrow budget charges these, not
     # the entry's other slots whose plain check passed
-    # Telemetry sketch fold (static sketch_k > 0 only, else None): the
-    # batch's top-K node rows by blocked acquire weight — computed
-    # where the verdicts are so "what is throttled right now" rides
-    # the existing coalesced device_get instead of a second round-trip
-    # (the data-plane heavy-hitter stance, arXiv:1611.04825).
-    blk_rows: Optional[jax.Array] = None  # int32 [sketch_k] cluster rows
-    blk_weight: Optional[jax.Array] = None  # int32 [sketch_k] blocked acquire sums
+    # Telemetry blocked-weight top-K fold (static blk_topk > 0 only,
+    # else None — NOT the statistics sketch tier, which lives in
+    # runtime/sketch.py): the batch's top-K node rows by blocked
+    # acquire weight — computed where the verdicts are so "what is
+    # throttled right now" rides the existing coalesced device_get
+    # instead of a second round-trip (the data-plane heavy-hitter
+    # stance, arXiv:1611.04825).
+    blk_rows: Optional[jax.Array] = None  # int32 [blk_topk] cluster rows
+    blk_weight: Optional[jax.Array] = None  # int32 [blk_topk] blocked acquire sums
 
 
 # System block dimension codes (limit types in SystemBlockException).
@@ -667,16 +670,18 @@ def flush_entries(
     with_degrade: bool = True,
     shaping_rounds: int = 0,
     param_rounds: int = 0,
-    sketch_k: int = 0,
+    blk_topk: int = 0,
 ) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
     """Phases 2-3: admission checks and (when ``commit``) accounting.
 
-    ``sketch_k`` (static, 0 = off) folds a per-batch top-K
+    ``blk_topk`` (static, 0 = off) folds a per-batch top-K
     blocked-resource summary into the result: blocked acquire weight is
     scatter-added per cluster-node row and the K heaviest rows ride the
     verdict fetch (``FlushResult.blk_rows``/``blk_weight``) — exact
     within the batch; the host merges batches into a space-saving
-    sketch (metrics/telemetry.py).
+    summary (metrics/telemetry.py). Distinct from the statistics
+    sketch tier's count-min fold, which ``flush_step`` threads
+    separately (runtime/sketch.py).
 
     ``shaping_rounds`` / ``param_rounds`` (static) are the host-known
     execution modes (negative = closed-form rank paths with
@@ -872,7 +877,7 @@ def flush_entries(
         )
 
     blk_rows = blk_weight = None
-    if sketch_k > 0:
+    if blk_topk > 0:
         # Blocked acquire weight per cluster-node row (e_rows[:, 1] is
         # the resource's ClusterNode — always >= 0 for valid entries).
         # Dense scatter-add into [n_rows + 1] with the last slot as the
@@ -887,7 +892,7 @@ def flush_entries(
         scat = jnp.where(blocked_w > 0, crow, jnp.int32(r_rows))
         dense = jnp.zeros((r_rows + 1,), dtype=jnp.int32).at[scat].add(blocked_w)
         blk_weight, blk_rows = jax.lax.top_k(
-            dense[:r_rows], min(sketch_k, r_rows)
+            dense[:r_rows], min(blk_topk, r_rows)
         )
         blk_rows = blk_rows.astype(jnp.int32)
 
@@ -918,6 +923,8 @@ def flush_step(
     batch: FlushBatch,
     shaping: Optional[ShapingBatch] = None,
     param: Optional[ParamBatch] = None,
+    skstate: Optional[SketchState] = None,
+    sk: Optional[SketchBatch] = None,
     occupy_timeout_ms: int = 500,
     with_occupy: bool = True,
     with_system: bool = True,
@@ -925,8 +932,12 @@ def flush_step(
     with_exits: bool = True,
     shaping_rounds: int = 0,
     param_rounds: int = 0,
-    sketch_k: int = 0,
-) -> Tuple[StatsState, FlowRuleDynState, DegradeDynState, ParamDynState, FlushResult]:
+    blk_topk: int = 0,
+    sketch_decay: bool = False,
+) -> Tuple[
+    StatsState, FlowRuleDynState, DegradeDynState, ParamDynState,
+    Optional[SketchState], FlushResult,
+]:
     """Pure function: apply one batch.
 
     Check order matches the slot chain (DefaultSlotChainBuilder order:
@@ -942,6 +953,14 @@ def flush_step(
     kernel. ``materialize_matured`` stays unconditional: the future
     slab may hold borrows committed by a *previous* (prioritized)
     flush.
+
+    ``skstate``/``sk`` thread the statistics sketch tier through the
+    kernel (runtime/sketch.py): count-min + candidate-table updates
+    over the chunk's key-id stream, chained flush-to-flush with the
+    same donated-state discipline as ``stats``. ``sketch_decay``
+    (static) carries the once-per-window halving. With ``skstate``
+    None the fold never traces — disabled is compile-identical to
+    before the tier existed.
     """
     from sentinel_tpu.metrics.nodes import materialize_matured
 
@@ -949,13 +968,16 @@ def flush_step(
     stats, ddyn = apply_exit_phase(
         stats, ddev, ddyn, batch, with_exits=with_exits, with_degrade=with_degrade
     )
-    return flush_entries(
+    stats, flow_dyn, ddyn, pdyn, result = flush_entries(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
         occupy_timeout_ms=occupy_timeout_ms,
         with_occupy=with_occupy, with_system=with_system, with_degrade=with_degrade,
         shaping_rounds=shaping_rounds, param_rounds=param_rounds,
-        sketch_k=sketch_k,
+        blk_topk=blk_topk,
     )
+    if skstate is not None and sk is not None:
+        skstate = sketch_fold(skstate, sk, decay=sketch_decay)
+    return stats, flow_dyn, ddyn, pdyn, skstate, result
 
 
 # Four jit variants keyed by which optional batches are present; the
@@ -967,75 +989,100 @@ def flush_step(
 # trace time, so a live window retune (SampleCountProperty /
 # IntervalProperty parity) must key the jit cache on it — an
 # interval-only change keeps every tensor shape and would otherwise
-# silently hit the stale-constant cache entry.
+# silently hit the stale-constant cache entry. ``skstate``/``sk``
+# (keyword-only, default None) thread the statistics sketch tier;
+# ``skstate`` is donated by NAME so the count-min chain reuses its
+# buffers flush-to-flush exactly like ``stats`` (a None skstate has no
+# buffers — the donation is a no-op and the fold compiles away).
 _STATIC_FLAGS = (
     "occupy_timeout_ms", "with_occupy", "with_system", "with_degrade", "with_exits",
-    "shaping_rounds", "param_rounds", "sketch_k", "win_key",
+    "shaping_rounds", "param_rounds", "blk_topk", "sketch_decay", "win_key",
 )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 4, 5), static_argnames=_STATIC_FLAGS)
+@functools.partial(
+    jax.jit, donate_argnums=(0, 4, 5), donate_argnames=("skstate",),
+    static_argnames=_STATIC_FLAGS,
+)
 def flush_step_jit(
-    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, occupy_timeout_ms=500,
+    stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch,
+    skstate=None, sk=None, occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0, sketch_k=0, win_key=None,
+    shaping_rounds=0, param_rounds=0, blk_topk=0, sketch_decay=False,
+    win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch,
+        skstate=skstate, sk=sk,
         occupy_timeout_ms=occupy_timeout_ms,
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
         shaping_rounds=shaping_rounds, param_rounds=param_rounds,
-        sketch_k=sketch_k,
+        blk_topk=blk_topk, sketch_decay=sketch_decay,
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5), static_argnames=_STATIC_FLAGS)
+@functools.partial(
+    jax.jit, donate_argnums=(0, 2, 4, 5), donate_argnames=("skstate",),
+    static_argnames=_STATIC_FLAGS,
+)
 def flush_step_shaping_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
-    occupy_timeout_ms=500,
+    skstate=None, sk=None, occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0, sketch_k=0, win_key=None,
+    shaping_rounds=0, param_rounds=0, blk_topk=0, sketch_decay=False,
+    win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping,
+        skstate=skstate, sk=sk,
         occupy_timeout_ms=occupy_timeout_ms,
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
         shaping_rounds=shaping_rounds, param_rounds=param_rounds,
-        sketch_k=sketch_k,
+        blk_topk=blk_topk, sketch_decay=sketch_decay,
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 4, 5), static_argnames=_STATIC_FLAGS)
+@functools.partial(
+    jax.jit, donate_argnums=(0, 4, 5), donate_argnames=("skstate",),
+    static_argnames=_STATIC_FLAGS,
+)
 def flush_step_param_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, param,
-    occupy_timeout_ms=500,
+    skstate=None, sk=None, occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0, sketch_k=0, win_key=None,
+    shaping_rounds=0, param_rounds=0, blk_topk=0, sketch_decay=False,
+    win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, None, param,
+        skstate=skstate, sk=sk,
         occupy_timeout_ms=occupy_timeout_ms,
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
         shaping_rounds=shaping_rounds, param_rounds=param_rounds,
-        sketch_k=sketch_k,
+        blk_topk=blk_topk, sketch_decay=sketch_decay,
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2, 4, 5), static_argnames=_STATIC_FLAGS)
+@functools.partial(
+    jax.jit, donate_argnums=(0, 2, 4, 5), donate_argnames=("skstate",),
+    static_argnames=_STATIC_FLAGS,
+)
 def flush_step_full_jit(
     stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
-    occupy_timeout_ms=500,
+    skstate=None, sk=None, occupy_timeout_ms=500,
     with_occupy=True, with_system=True, with_degrade=True, with_exits=True,
-    shaping_rounds=0, param_rounds=0, sketch_k=0, win_key=None,
+    shaping_rounds=0, param_rounds=0, blk_topk=0, sketch_decay=False,
+    win_key=None,
 ):
     return flush_step(
         stats, flow_dev, flow_dyn, ddev, ddyn, pdyn, sysdev, batch, shaping, param,
+        skstate=skstate, sk=sk,
         occupy_timeout_ms=occupy_timeout_ms,
         with_occupy=with_occupy, with_system=with_system,
         with_degrade=with_degrade, with_exits=with_exits,
         shaping_rounds=shaping_rounds, param_rounds=param_rounds,
-        sketch_k=sketch_k,
+        blk_topk=blk_topk, sketch_decay=sketch_decay,
     )

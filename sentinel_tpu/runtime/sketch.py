@@ -1,0 +1,811 @@
+"""Sketch tier: on-device count-min/candidate statistics for unbounded
+resource cardinality, with heavy-hitter promotion to exact dense rows.
+
+Every rule today costs a dense per-row slice of device state, which
+caps how many resources / param values one chip can guard. The
+data-plane heavy-hitter literature keeps the long tail entirely in the
+pipeline with a fixed-size multi-stage sketch and exports only the
+summary (HashPipe, Sivaraman et al., arXiv:1611.04825; bounded-export
+heavy hitters, arXiv:1902.06993). This module is that stance for the
+admission engine:
+
+* **Device plane** (:class:`SketchState` + :func:`sketch_fold`): a
+  count-min array (``depth`` hash rows x ``width`` counters of per-key
+  acquire volume) plus a fixed-size candidate table (the batched
+  space-saving analog: the K heaviest keys by count-min estimate). The
+  fold runs INSIDE the flush kernel — hash-scatter adds over the
+  batch's interned key ids, chained flush-to-flush with the same
+  donated-state discipline as ``StatsState`` — and the candidate table
+  rides the existing one-coalesced-``device_get``-per-drain. Device
+  memory is ``depth*width + 2*candidates`` int32s: O(1) in the key
+  cardinality. Counts halve once per ``sentinel.tpu.sketch.window.ms``
+  (the decay window), so a key's steady-state count converges to
+  ~2x its per-window volume.
+
+* **Host plane** (:class:`SketchTier`): encodes each chunk's key
+  stream (unconfigured-resource keys, sketch-mode param values, and
+  over-cap resources that today get NO protection at all), resolves
+  drained candidate ids back to names through a bounded LRU map, and
+  runs the **promotion/demotion controller**: a candidate whose
+  estimate crosses the promotion threshold is moved into an exact
+  dense row — param values via the existing :class:`ParamIndex`
+  intern/LRU row machinery, unconfigured resources via a synthetic
+  ``from_sketch`` flow rule — and demoted back to sketch-only after
+  ``demote.windows`` consecutive cold windows. Hot keys therefore get
+  exact admission automatically, without a per-key rule.
+
+* **Failover**: while the engine is DEGRADED the device sketch is
+  unreachable, so degraded flushes fold the same key stream into a
+  host space-saving mirror and the controller keeps evaluating from
+  it — the tier degrades gracefully instead of going blind. A
+  checkpoint restore resets the device sketch fresh (the tier is
+  approximate by contract; counts re-accumulate within a window).
+
+Key ids are stable 31-bit CRC32 hashes of the key string — no host
+dict is needed to FEED the sketch (truly unbounded cardinality), only
+the bounded id->name LRU to DECODE the candidate table. An id
+collision merges two keys, which only ever over-estimates — the same
+direction as the count-min bound.
+
+Config (all under ``sentinel.tpu.sketch.*``; see utils/config.py):
+``enabled``, ``depth``, ``width``, ``candidates``, ``window.ms``,
+``promote.qps``, ``resource.qps``, ``promote.max``,
+``demote.windows``, ``names.capacity``.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from collections import OrderedDict
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sentinel_tpu.utils.config import config
+from sentinel_tpu.utils.numeric import pad_pow2 as _pad_pow2
+
+_I32_MAX = 2**31 - 1
+
+# Per-depth-row hash seeds (odd constants; depth is clamped to <= 8).
+_SEEDS = (
+    0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F,
+    0x165667B1, 0xD3A2646D, 0xFD7046C5, 0xB55A4F09,
+)
+
+# Promotion fires at PROMOTE_FACTOR x (threshold qps x window);
+# demotion arms below DEMOTE_FACTOR of the same. With per-window
+# halving a sustained rate q converges to count 2*q*window, so 1.5x
+# promotes a key at >= the threshold rate within ~2 windows and a key
+# at >= 1.5x the rate within one, while 0.75x (~0.4x the rate at
+# steady state) gives hysteresis against flapping.
+PROMOTE_FACTOR = 1.5
+DEMOTE_FACTOR = 0.75
+
+# Key-kind prefixes (one byte, never part of a user name).
+_KIND_RESOURCE = "\x01"
+_KIND_VALUE = "\x02"
+_SEP = "\x1f"
+
+
+class SketchState(NamedTuple):
+    """Device-resident sketch tier state (donated flush-to-flush)."""
+
+    cm: "object"  # int32 [depth, width] count-min counters
+    cand_ids: "object"  # int32 [C] candidate key ids (-1 empty)
+    cand_cnt: "object"  # int32 [C] candidate count-min estimates
+
+
+class SketchBatch(NamedTuple):
+    """One chunk's aggregated key stream ([S] each, -1 id = padding)."""
+
+    ids: "object"  # int32 [S] 31-bit key ids
+    w: "object"  # int32 [S] acquire weight per id (host-aggregated)
+
+
+def make_sketch_state(depth: int, width: int, candidates: int) -> SketchState:
+    import jax.numpy as jnp
+
+    return SketchState(
+        cm=jnp.zeros((depth, width), dtype=jnp.int32),
+        cand_ids=jnp.full((candidates,), -1, dtype=jnp.int32),
+        cand_cnt=jnp.zeros((candidates,), dtype=jnp.int32),
+    )
+
+
+def key_id(key: str) -> int:
+    """Stable 31-bit id of a key string (the host's hash; feeding the
+    sketch needs no dict at all)."""
+    return zlib.crc32(key.encode("utf-8", "surrogatepass")) & 0x7FFFFFFF
+
+
+def _hash_np(ids: np.ndarray, d: int, width: int) -> np.ndarray:
+    """Numpy twin of the kernel hash — MUST mirror the jnp version in
+    :func:`sketch_fold` bit-for-bit (uint32 wraparound); the host twin
+    is what the error-bound tests and :func:`cm_estimate` query with."""
+    h = (ids.astype(np.uint64) ^ np.uint64(_SEEDS[d])) * np.uint64(2654435761)
+    h = h & np.uint64(0xFFFFFFFF)
+    h = h ^ (h >> np.uint64(15))
+    return (h & np.uint64(width - 1)).astype(np.int64)
+
+
+def cm_estimate(cm: np.ndarray, ids: np.ndarray) -> np.ndarray:
+    """Host-side count-min point query over a fetched ``cm`` array:
+    min over depth rows of the hashed cells — always >= the true count
+    (every cell only ever receives non-negative adds)."""
+    d, w = cm.shape
+    ids = np.asarray(ids, dtype=np.int64)
+    est = np.full(ids.shape, _I32_MAX, dtype=np.int64)
+    for di in range(d):
+        est = np.minimum(est, cm[di][_hash_np(ids, di, w)])
+    return est
+
+
+def sketch_fold(st: SketchState, sk: SketchBatch, decay: bool = False) -> SketchState:
+    """The kernel-side fold (traced inside ``flush_step``): count-min
+    scatter-adds over the batch's key ids, then a batched space-saving
+    merge of the candidate table — existing candidates touched this
+    batch adopt their fresh count-min estimate, untouched ones keep
+    their (possibly decayed) counts, and the table is re-topped over
+    the union. ``decay`` (static) halves every counter first — the
+    once-per-window aging the host schedules via
+    :meth:`SketchTier.decay_due`."""
+    import jax
+    import jax.numpy as jnp
+
+    d, w = st.cm.shape
+    c = st.cand_ids.shape[0]
+    n = sk.ids.shape[0]
+    valid = sk.ids >= 0
+    cm = st.cm
+    cand_ids = st.cand_ids
+    cand_cnt = st.cand_cnt
+    if decay:
+        cm = cm >> 1
+        cand_cnt = cand_cnt >> 1
+    wgt = jnp.where(valid, sk.w, 0).astype(jnp.int32)
+
+    uids = sk.ids.astype(jnp.uint32)
+    est = jnp.full((n,), _I32_MAX, dtype=jnp.int32)
+    for di in range(d):
+        h = (uids ^ jnp.uint32(_SEEDS[di])) * jnp.uint32(2654435761)
+        h = h ^ (h >> 15)
+        idx = (h & jnp.uint32(w - 1)).astype(jnp.int32)
+        scat = jnp.where(valid, idx, jnp.int32(w))
+        row = cm[di].at[scat].add(wgt, mode="drop")
+        cm = cm.at[di].set(row)
+        # Post-update estimate: includes history + this batch, so a
+        # first-ever key's estimate is at least its batch weight (the
+        # space-saving insertion count).
+        est = jnp.minimum(est, row[idx])
+
+    # Batch-distinct heads: the host aggregates per id before encode,
+    # but padding and (rare) duplicate rows still dedupe here.
+    key = jnp.where(valid, sk.ids, jnp.int32(_I32_MAX))
+    ids_s, est_s = jax.lax.sort((key, est), num_keys=1)
+    ones = jnp.ones((1,), dtype=bool)
+    head = jnp.concatenate([ones, ids_s[1:] != ids_s[:-1]]) & (
+        ids_s < _I32_MAX
+    )
+    uniq_ids = jnp.where(head, ids_s, jnp.int32(-1))
+    uniq_cnt = jnp.where(head, est_s, jnp.int32(-1))
+
+    # Candidates touched this batch are superseded by their fresh
+    # estimate row; empty slots never compete.
+    dup = (cand_ids[:, None] == uniq_ids[None, :]) & (uniq_ids >= 0)[None, :]
+    keep_cnt = jnp.where(
+        dup.any(axis=1) | (cand_ids < 0), jnp.int32(-1), cand_cnt
+    )
+    m_ids = jnp.concatenate([cand_ids, uniq_ids])
+    m_cnt = jnp.concatenate([keep_cnt, uniq_cnt])
+    top_cnt, top_pos = jax.lax.top_k(m_cnt, c)
+    new_ids = jnp.where(top_cnt >= 0, m_ids[top_pos], jnp.int32(-1))
+    new_cnt = jnp.maximum(top_cnt, 0)
+    return SketchState(cm=cm, cand_ids=new_ids, cand_cnt=new_cnt)
+
+
+class _HostSpaceSaving:
+    """Tiny host space-saving summary — the DEGRADED mirror of the
+    device candidate table (the device sketch is unreachable while the
+    engine serves from the host fallback). Supports the same per-window
+    decay so its counts stay comparable to the promotion thresholds."""
+
+    __slots__ = ("capacity", "counts")
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = max(1, int(capacity))
+        self.counts: Dict[str, int] = {}
+
+    def offer(self, key: str, w: int) -> None:
+        if w <= 0:
+            return
+        c = self.counts.get(key)
+        if c is not None:
+            self.counts[key] = c + w
+            return
+        if len(self.counts) < self.capacity:
+            self.counts[key] = w
+            return
+        victim = min(self.counts, key=self.counts.__getitem__)
+        floor = self.counts.pop(victim)
+        self.counts[key] = floor + w
+
+    def decay(self) -> None:
+        for k in list(self.counts):
+            v = self.counts[k] >> 1
+            if v <= 0:
+                del self.counts[k]
+            else:
+                self.counts[k] = v
+
+    def clear(self) -> None:
+        self.counts.clear()
+
+
+class SketchTier:
+    """Host controller of the sketch tier (engine-scoped).
+
+    Hot-path contract: ``armed`` False (the default) costs one
+    attribute read per call site; the device fold is then never
+    compiled and no key stream is ever collected."""
+
+    def __init__(self, engine) -> None:
+        self._engine = engine
+        self.enabled = config.get_bool(config.SKETCH_ENABLED, False)
+        self.depth = min(max(config.get_int(config.SKETCH_DEPTH, 4), 1), 8)
+        self.width = _pad_pow2(max(config.get_int(config.SKETCH_WIDTH, 2048), 8))
+        self.candidates = max(config.get_int(config.SKETCH_CANDIDATES, 64), 1)
+        self.window_ms = max(config.get_int(config.SKETCH_WINDOW_MS, 1000), 1)
+        self.promote_qps = config.get_float(config.SKETCH_PROMOTE_QPS, 0.0)
+        self.resource_qps = config.get_float(config.SKETCH_RESOURCE_QPS, 0.0)
+        self.promote_max = max(config.get_int(config.SKETCH_PROMOTE_MAX, 64), 0)
+        self.demote_windows = max(
+            config.get_int(config.SKETCH_DEMOTE_WINDOWS, 3), 1
+        )
+        self.names_cap = max(
+            config.get_int(config.SKETCH_NAMES_CAP, 65536), self.candidates
+        )
+        self._lock = threading.Lock()
+        # id -> key name, bounded LRU (ids are hashes; eviction only
+        # ever loses the ABILITY to decode a candidate, never device
+        # state — an undecodable candidate is skipped until re-seen).
+        self._names: "OrderedDict[int, str]" = OrderedDict()
+        # Exact host counters for the current candidate ids (bounded
+        # by the candidate count): the estimated-vs-exact error gauge.
+        # id -> [count, tracking_since_window].
+        self._exact: Dict[int, List[int]] = {}
+        self._pending_unrouted: List[Tuple[str, int]] = []
+        self._last_wid: Optional[int] = None
+        # Published promotion state. ``promoted_values`` is read
+        # LOCK-FREE by ParamIndex on the submit hot path — mutations
+        # swap in a fresh dict of frozensets, never edit in place.
+        self.promoted_values: Dict[str, frozenset] = {}
+        self._promoted_vals: Dict[str, set] = {}
+        self._promoted_res: Dict[str, object] = {}  # resource -> FlowRule
+        # key -> [low_windows, last_window_counted] demotion bookkeeping.
+        self._low: Dict[str, List[int]] = {}
+        self._actions: List[tuple] = []
+        # Resources ever granted node rows PAST the registry cap
+        # (promote_cluster_row): registry rows are never released, so
+        # without a cumulative budget a slow churn of distinct over-cap
+        # heavy hitters would regrow exactly the per-key dense state
+        # the cap bounds. Re-promoting a previously granted resource
+        # reuses its row (free); NEW grants stop at 8x promote.max.
+        self._cap_grants: set = set()
+        # Last drained candidate view: [(id, key|None, count)].
+        self._last_candidates: List[Tuple[int, Optional[str], int]] = []
+        self.est_error_ratio = 0.0
+        self.occupancy = 0.0
+        self.host_mirror = _HostSpaceSaving(self.candidates)
+        self.dev_state: Optional[SketchState] = (
+            make_sketch_state(self.depth, self.width, self.candidates)
+            if self.enabled
+            else None
+        )
+
+    # ------------------------------------------------------------------
+    # hot-path surface
+    # ------------------------------------------------------------------
+    @property
+    def armed(self) -> bool:
+        return self.enabled
+
+    @property
+    def pending_actions(self) -> bool:
+        return bool(self._actions)
+
+    @property
+    def promoted_count(self) -> int:
+        return sum(len(s) for s in self._promoted_vals.values()) + len(
+            self._promoted_res
+        )
+
+    def note_unrouted(self, resource: str, acquire: int) -> None:
+        """An over-cap resource's entry passed through WITHOUT an op —
+        the one key class that never reaches the encode path. Buffered
+        and drained into the next chunk's key stream. With resource
+        promotion disarmed the buffer would only ever be discarded, so
+        the submit hot path pays nothing."""
+        if self.resource_qps <= 0:
+            return
+        with self._lock:
+            self._pending_unrouted.append((resource, int(acquire)))
+            # Bound the buffer: a flood of distinct over-cap names with
+            # no flush in sight must not grow without limit.
+            if len(self._pending_unrouted) > 65536:
+                del self._pending_unrouted[:32768]
+
+    def decay_due(self, now_ms: int) -> bool:
+        """True exactly once per decay window (consumed by the chunk
+        that will carry the halving fold); the host exact mirror halves
+        in the same breath so the error gauge stays comparable."""
+        wid = now_ms // self.window_ms
+        with self._lock:
+            if self._last_wid is None:
+                self._last_wid = wid
+                return False
+            if wid <= self._last_wid:
+                return False
+            self._last_wid = wid
+            for ent in self._exact.values():
+                ent[0] >>= 1
+            self.host_mirror.decay()
+            return True
+
+    # ------------------------------------------------------------------
+    # key-stream encode
+    # ------------------------------------------------------------------
+    def _collect(self, entries, bulk, findex, pindex) -> Dict[int, int]:
+        """Aggregate one chunk's key stream into {id: weight}; updates
+        the id->name LRU and the exact mirror as a side effect."""
+        from sentinel_tpu.rules.param_table import ParamIndex
+
+        agg: Dict[int, int] = {}
+        with self._lock:
+            pend, self._pending_unrouted = self._pending_unrouted, []
+            names = self._names
+            exact = self._exact
+
+            def note(key: str, w: int) -> None:
+                if w <= 0:
+                    return
+                i = key_id(key)
+                agg[i] = agg.get(i, 0) + w
+                if i in names:
+                    names.move_to_end(i)
+                else:
+                    names[i] = key
+                    while len(names) > self.names_cap:
+                        names.popitem(last=False)
+                ent = exact.get(i)
+                if ent is not None:
+                    ent[0] += w
+
+            track_res = self.resource_qps > 0
+            res_memo: Dict[str, bool] = {}
+
+            def tracked(resource: str) -> bool:
+                # "Unconfigured" = no rule of any kind names it; a
+                # promoted resource keeps being tracked so demotion can
+                # see it go cold.
+                hit = res_memo.get(resource)
+                if hit is None:
+                    hit = res_memo[resource] = (
+                        resource in self._promoted_res
+                        or (
+                            resource not in findex.by_resource
+                            and resource not in pindex.by_resource
+                        )
+                    )
+                return hit
+
+            for resource, acq in pend:
+                if track_res:
+                    note(_KIND_RESOURCE + resource, acq)
+            sk_idx = getattr(pindex, "sketch_idx_by_resource", None) or {}
+            for op in entries:
+                if track_res and tracked(op.resource):
+                    note(_KIND_RESOURCE + op.resource, op.acquire)
+                idxs = sk_idx.get(op.resource)
+                if idxs and op.args:
+                    for pi in idxs:
+                        if pi >= len(op.args):
+                            continue
+                        v = op.args[pi]
+                        vals = (
+                            v
+                            if isinstance(v, (list, tuple, set, frozenset))
+                            else (v,)
+                        )
+                        for vv in vals:
+                            k = ParamIndex._value_key(vv)
+                            if k is not None:
+                                note(
+                                    _KIND_VALUE + op.resource + _SEP + k,
+                                    op.acquire,
+                                )
+            for g in bulk:
+                if track_res and tracked(g.resource):
+                    note(_KIND_RESOURCE + g.resource, int(g.acquire.sum()))
+                idxs = sk_idx.get(g.resource)
+                if idxs and g.args_column is not None:
+                    for pi in idxs:
+                        self._note_bulk_column(g, pi, note)
+        return agg
+
+    @staticmethod
+    def _extract_column(g, pi: int):
+        from sentinel_tpu.rules.param_table import ArgsColumns, _extract_arg
+
+        col = g.args_column
+        if isinstance(col, ArgsColumns):
+            return col.by_idx.get(pi)
+        return [_extract_arg(a, pi) for a in col]
+
+    def _note_bulk_column(self, g, pi: int, note) -> None:
+        from sentinel_tpu.rules.param_table import ParamIndex
+
+        col = self._extract_column(g, pi)
+        if col is None:
+            return
+        keys: List[str] = []
+        rows: List[int] = []
+        for j, v in enumerate(col):
+            if v is None:
+                continue
+            if isinstance(v, (list, tuple, set, frozenset)):
+                for vv in v:
+                    k = ParamIndex._value_key(vv)
+                    if k is not None:
+                        note(
+                            _KIND_VALUE + g.resource + _SEP + k,
+                            int(g.acquire[j]),
+                        )
+                continue
+            k = v if type(v) is str else ParamIndex._value_key(v)
+            if k is not None:
+                keys.append(k)
+                rows.append(j)
+        if not keys:
+            return
+        uniq, inv = np.unique(np.asarray(keys, dtype=object), return_inverse=True)
+        wsum = np.bincount(
+            inv, weights=g.acquire[np.asarray(rows, dtype=np.intp)]
+        )
+        prefix = _KIND_VALUE + g.resource + _SEP
+        for k, wv in zip(uniq.tolist(), wsum.tolist()):
+            note(prefix + k, int(wv))
+
+    def encode_chunk(
+        self, entries, bulk, findex, pindex
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One chunk's aggregated (ids, weights) columns, pow2-padded
+        (-1 id = padding) — the :class:`SketchBatch` payload."""
+        agg = self._collect(entries, bulk, findex, pindex)
+        tele = self._engine.telemetry
+        if tele.enabled and agg:
+            tele.note_sketch_keys(len(agg))
+        s = _pad_pow2(max(len(agg), 1), 8)
+        ids = np.full(s, -1, dtype=np.int32)
+        w = np.zeros(s, dtype=np.int32)
+        if agg:
+            ids[: len(agg)] = np.fromiter(agg.keys(), dtype=np.int32, count=len(agg))
+            w[: len(agg)] = np.fromiter(
+                agg.values(), dtype=np.int64, count=len(agg)
+            ).clip(0, _I32_MAX)
+        return ids, w
+
+    # ------------------------------------------------------------------
+    # drain + controller
+    # ------------------------------------------------------------------
+    def on_drain(
+        self, cand_ids: np.ndarray, cand_cnt: np.ndarray, now_ms: int
+    ) -> None:
+        """Consume one drained candidate table: refresh the error gauge
+        and occupancy, then run the promotion/demotion evaluation."""
+        by_key: Dict[str, int] = {}
+        with self._lock:
+            wid = now_ms // self.window_ms
+            cand: List[Tuple[int, Optional[str], int]] = []
+            new_exact: Dict[int, List[int]] = {}
+            errs: List[float] = []
+            for i, c in zip(cand_ids.tolist(), cand_cnt.tolist()):
+                if i < 0 or c <= 0:
+                    continue
+                key = self._names.get(i)
+                cand.append((i, key, c))
+                if key is not None:
+                    by_key[key] = c
+                ent = self._exact.get(i)
+                if ent is None:
+                    # Start exact tracking now; the gauge compares only
+                    # ids tracked for a full window (pre-tracking mass
+                    # decays out of the estimate at the same rate).
+                    new_exact[i] = [0, wid]
+                else:
+                    new_exact[i] = ent
+                    if ent[0] > 0 and ent[1] < wid:
+                        errs.append(max(0, c - ent[0]) / ent[0])
+            self._exact = new_exact
+            self._last_candidates = cand
+            self.est_error_ratio = float(np.mean(errs)) if errs else 0.0
+            self.occupancy = len(cand) / float(self.candidates)
+        self._evaluate(by_key, now_ms)
+
+    def fold_host_chunk(self, entries, bulk, findex, pindex, now_ms) -> None:
+        """DEGRADED flush: the device sketch is unreachable, so the
+        chunk's key stream folds into the host space-saving mirror and
+        the controller evaluates from it — graceful degradation, not
+        blindness. Decay stays on the same window clock."""
+        agg = self._collect(entries, bulk, findex, pindex)
+        self.decay_due(now_ms)
+        with self._lock:
+            for i, w in agg.items():
+                key = self._names.get(i)
+                if key is not None:
+                    self.host_mirror.offer(key, w)
+            by_key = dict(self.host_mirror.counts)
+            self.occupancy = len(by_key) / float(self.candidates)
+        tele = self._engine.telemetry
+        if tele.enabled:
+            if agg:
+                tele.note_sketch_keys(len(agg))
+            tele.note_sketch_host_fold()
+        self._evaluate(by_key, now_ms)
+
+    def _evaluate(self, by_key: Dict[str, int], now_ms: int) -> None:
+        """The promotion/demotion state machine over one candidate
+        view. Value promotions take effect immediately (lock-free
+        published-set swap); flow-rule installs/removals queue as
+        actions applied at the next flush entry (a rule rebuild must
+        not run from inside a drain)."""
+        win_s = self.window_ms / 1000.0
+        wid = now_ms // self.window_ms
+        promos = 0
+        demos = 0
+        with self._lock:
+            # Re-assert synthetics a user rule reload wiped: promoted
+            # state is the tier's, not the rule file's.
+            if self._promoted_res:
+                findex = self._engine.flow_index
+                if any(
+                    res not in findex.by_resource
+                    for res in self._promoted_res
+                ):
+                    self._actions.append(("flow", None))
+            # --- promotions ---
+            for key, cnt in by_key.items():
+                kind = key[:1]
+                if kind == _KIND_VALUE and self.promote_qps > 0:
+                    resource, _, vkey = key[1:].partition(_SEP)
+                    if vkey in self._promoted_vals.get(resource, ()):
+                        continue
+                    if (
+                        cnt >= PROMOTE_FACTOR * self.promote_qps * win_s
+                        and self.promoted_count < self.promote_max
+                    ):
+                        self._promoted_vals.setdefault(resource, set()).add(vkey)
+                        self._publish_promoted_locked()
+                        self._low.pop(key, None)
+                        promos += 1
+                elif kind == _KIND_RESOURCE and self.resource_qps > 0:
+                    resource = key[1:]
+                    if resource in self._promoted_res:
+                        continue
+                    if resource in self._engine.flow_index.by_resource:
+                        # A user rule appeared since the key was noted
+                        # (e.g. an over-cap resource the operator then
+                        # configured) — never stack a synthetic on it.
+                        continue
+                    if (
+                        cnt >= PROMOTE_FACTOR * self.resource_qps * win_s
+                        and self.promoted_count < self.promote_max
+                    ):
+                        from sentinel_tpu.models.rules import FlowRule
+
+                        rule = FlowRule(
+                            resource=resource,
+                            count=float(self.resource_qps),
+                            from_sketch=True,
+                        )
+                        self._promoted_res[resource] = rule
+                        self._actions.append(("flow", None))
+                        self._low.pop(key, None)
+                        promos += 1
+            # --- demotions (hysteresis over consecutive cold windows) ---
+            for resource, vals in list(self._promoted_vals.items()):
+                for vkey in list(vals):
+                    key = _KIND_VALUE + resource + _SEP + vkey
+                    if self._cold_locked(
+                        key, by_key.get(key, 0),
+                        DEMOTE_FACTOR * self.promote_qps * win_s, wid,
+                    ):
+                        vals.discard(vkey)
+                        if not vals:
+                            del self._promoted_vals[resource]
+                        self._publish_promoted_locked()
+                        self._actions.append(("param_release", resource, vkey))
+                        demos += 1
+            for resource in list(self._promoted_res):
+                key = _KIND_RESOURCE + resource
+                if self._cold_locked(
+                    key, by_key.get(key, 0),
+                    DEMOTE_FACTOR * self.resource_qps * win_s, wid,
+                ):
+                    del self._promoted_res[resource]
+                    self._actions.append(("flow", None))
+                    demos += 1
+        tele = self._engine.telemetry
+        if tele.enabled:
+            if promos:
+                tele.note_sketch_promotion(promos)
+            if demos:
+                tele.note_sketch_demotion(demos)
+
+    def _cold_locked(
+        self, key: str, cnt: int, floor: float, wid: int
+    ) -> bool:
+        """One demotion-bookkeeping step: counts at most one cold
+        window per window id; clears the streak on any warm sighting."""
+        if cnt >= floor and floor > 0:
+            self._low.pop(key, None)
+            return False
+        ent = self._low.get(key)
+        if ent is None:
+            self._low[key] = [1, wid]
+            return self.demote_windows <= 1
+        if wid > ent[1]:
+            ent[0] += 1
+            ent[1] = wid
+        if ent[0] >= self.demote_windows:
+            del self._low[key]
+            return True
+        return False
+
+    def _publish_promoted_locked(self) -> None:
+        self.promoted_values = {
+            r: frozenset(v) for r, v in self._promoted_vals.items() if v
+        }
+
+    # ------------------------------------------------------------------
+    # deferred actions (flow-rule rebuilds, param row releases)
+    # ------------------------------------------------------------------
+    def apply_actions(self) -> None:
+        """Apply queued controller actions. Called from the flush entry
+        points OUTSIDE the flush lock (a promotion's rule rebuild
+        drains pending ops through ``set_flow_rules`` like any reload).
+        """
+        with self._lock:
+            actions, self._actions = self._actions, []
+            synth = list(self._promoted_res.items())
+        if not actions:
+            return
+        eng = self._engine
+        releases = [a for a in actions if a[0] == "param_release"]
+        if releases:
+            with eng._lock:
+                for _, resource, vkey in releases:
+                    release = getattr(eng.param_index, "release_value", None)
+                    if release is not None:
+                        release(resource, vkey)
+        if any(a[0] == "flow" for a in actions):
+            keep = []
+            for resource, rule in synth:
+                if eng.nodes.lookup_cluster_row(resource) is None:
+                    # A promoted over-cap resource needs node rows the
+                    # cap refused at submit time — the promotion IS the
+                    # grant. Registry rows are permanent, so new grants
+                    # draw on a cumulative budget (see _cap_grants);
+                    # past it the promotion is dropped rather than
+                    # regrowing unbounded per-key device state.
+                    with self._lock:
+                        if (
+                            resource not in self._cap_grants
+                            and len(self._cap_grants)
+                            >= 8 * max(self.promote_max, 1)
+                        ):
+                            self._promoted_res.pop(resource, None)
+                            continue
+                        self._cap_grants.add(resource)
+                    eng.nodes.promote_cluster_row(resource)
+                keep.append(rule)
+            base = eng.flow_index.user_rules()
+            eng.set_flow_rules(base + keep)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def reset_device_state(self) -> None:
+        """Fresh device sketch (failover restore: the restored world
+        predates the sketch's donated chain — counts re-accumulate
+        within a window; promotion state is host-side and survives)."""
+        if self.enabled:
+            self.dev_state = make_sketch_state(
+                self.depth, self.width, self.candidates
+            )
+
+    def on_rebase(self, offset_ms: int) -> None:
+        """Engine epoch rebase: keep the decay clock monotonic."""
+        with self._lock:
+            if self._last_wid is not None:
+                self._last_wid = max(
+                    0, self._last_wid - offset_ms // self.window_ms
+                )
+
+    def reset(self) -> None:
+        with self._lock:
+            self._names.clear()
+            self._exact.clear()
+            self._pending_unrouted = []
+            self._last_wid = None
+            self._promoted_vals = {}
+            self.promoted_values = {}
+            self._promoted_res = {}
+            self._low = {}
+            self._actions = []
+            self._cap_grants = set()
+            self._last_candidates = []
+            self.est_error_ratio = 0.0
+            self.occupancy = 0.0
+            self.host_mirror.clear()
+        self.reset_device_state()
+
+    # ------------------------------------------------------------------
+    # readers
+    # ------------------------------------------------------------------
+    def candidates_snapshot(self, k: Optional[int] = None) -> List[dict]:
+        """Decoded view of the last drained candidate table (export K
+        from the unified telemetry top-K default when unset)."""
+        if k is None:
+            k = self._engine.telemetry.export_topk_k
+        with self._lock:
+            cand = sorted(
+                self._last_candidates, key=lambda t: t[2], reverse=True
+            )[: max(0, int(k))]
+            out = []
+            for i, key, cnt in cand:
+                kind = "unresolved"
+                name = None
+                if key is not None:
+                    if key[:1] == _KIND_RESOURCE:
+                        kind, name = "resource", key[1:]
+                    elif key[:1] == _KIND_VALUE:
+                        resource, _, vkey = key[1:].partition(_SEP)
+                        kind, name = "value", f"{resource}|{vkey}"
+                out.append(
+                    {"id": i, "kind": kind, "key": name, "estimate": cnt}
+                )
+            return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            promoted_vals = {
+                r: sorted(v) for r, v in self._promoted_vals.items()
+            }
+            promoted_res = sorted(self._promoted_res)
+            host_top = sorted(
+                self.host_mirror.counts.items(),
+                key=lambda kv: kv[1],
+                reverse=True,
+            )[:16]
+        return {
+            "enabled": self.enabled,
+            "depth": self.depth,
+            "width": self.width,
+            "candidates": self.candidates,
+            "window_ms": self.window_ms,
+            "promote_qps": self.promote_qps,
+            "resource_qps": self.resource_qps,
+            "promote_max": self.promote_max,
+            "demote_windows": self.demote_windows,
+            "occupancy": round(self.occupancy, 4),
+            "est_error_ratio": round(self.est_error_ratio, 6),
+            "promoted_count": self.promoted_count,
+            "promoted_values": promoted_vals,
+            "promoted_resources": promoted_res,
+            "candidates_topk": self.candidates_snapshot(),
+            "host_mirror_topk": [
+                {"key": k[1:].replace(_SEP, "|"), "estimate": v}
+                for k, v in host_top
+            ],
+        }

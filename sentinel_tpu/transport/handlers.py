@@ -462,6 +462,25 @@ def speculative_handler(req: CommandRequest) -> CommandResponse:
 
 
 @command_mapping(
+    "sketch",
+    "statistics sketch tier: candidate heavy hitters, promoted keys,"
+    " occupancy, estimate-error gauge",
+)
+def sketch_handler(req: CommandRequest) -> CommandResponse:
+    """The unbounded-cardinality view (runtime/sketch.py): what the
+    fixed-size on-device count-min/candidate tier currently believes
+    the heavy hitters are, which keys hold promoted exact dense rows,
+    how full the candidate table runs, and how far the estimates sit
+    above the exact host counters — the long-tail complement of the
+    per-resource commands, which can only describe keys that HAVE
+    dense rows."""
+    engine = _engine()
+    out = engine.sketch.snapshot()
+    out["flush_seq"] = engine.flush_seq
+    return CommandResponse.of_json(out)
+
+
+@command_mapping(
     "traces",
     "sampled admission trace records: [?n=N][&resource=][&reason=code|name]",
 )

@@ -370,13 +370,62 @@ def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
                 round(valve.estimate_ms(), 3),
             )
 
-    # Blocked-resource heavy-hitter sketch (space-saving over the
-    # kernel's per-flush top-K): weight = blocked acquire sum.
+    # Blocked-resource heavy-hitter summary (space-saving over the
+    # kernel's per-flush top-K): weight = blocked acquire sum. Export
+    # K comes from the ONE config-backed home (TelemetryBus.
+    # export_topk_k) shared with the `telemetry` command and the
+    # sketch tier's candidate listing.
     name = f"{p}_blocked_weight"
-    out.append(f"# HELP {name} Blocked acquire weight per resource (space-saving sketch)")
+    out.append(f"# HELP {name} Blocked acquire weight per resource (space-saving summary)")
     out.append(f"# TYPE {name} gauge")
-    for key, cnt, _err in tele.sketch.topk(tele.sketch_k or 10):
+    for key, cnt, _err in tele.blocked_sketch.topk(tele.export_topk_k):
         out.append(f'{name}{{resource="{_escape_label(key)}"}} {cnt}')
+
+    # Statistics sketch tier (runtime/sketch.py): occupancy, promotion
+    # flow, and the estimated-vs-exact error gauge. Rendered even when
+    # disarmed (zeros) so dashboards keep their series.
+    tier = getattr(engine, "sketch", None)
+    if tier is not None:
+        out += _gauge(
+            f"{p}_sketch_enabled",
+            "Statistics sketch tier armed (sentinel.tpu.sketch.enabled)",
+            1 if tier.armed else 0,
+        )
+        out += ctr(
+            f"{p}_sketch_keys_total",
+            "Distinct keys folded into the device sketch (per-chunk sum)",
+            c.get("sketch_keys", 0),
+        )
+        out += ctr(
+            f"{p}_sketch_promotions_total",
+            "Heavy-hitter keys promoted to exact dense rows",
+            c.get("sketch_promotions", 0),
+        )
+        out += ctr(
+            f"{p}_sketch_demotions_total",
+            "Promoted keys demoted back to sketch-only on decay",
+            c.get("sketch_demotions", 0),
+        )
+        out += ctr(
+            f"{p}_sketch_host_folds_total",
+            "DEGRADED chunks folded into the host space-saving mirror",
+            c.get("sketch_host_folds", 0),
+        )
+        out += _gauge(
+            f"{p}_sketch_promoted",
+            "Keys currently promoted (values + resources)",
+            tier.promoted_count,
+        )
+        out += _gauge(
+            f"{p}_sketch_occupancy",
+            "Candidate-table slots in use / capacity (0..1)",
+            round(tier.occupancy, 4),
+        )
+        out += _gauge(
+            f"{p}_sketch_est_error_ratio",
+            "Mean relative overestimate of candidate counts vs exact host counters",
+            round(tier.est_error_ratio, 6),
+        )
     out += resource_provenance_lines(engine, openmetrics=openmetrics)
     return out
 
@@ -412,7 +461,9 @@ def resource_provenance_lines(engine, openmetrics: bool = False) -> List[str]:
 
     tele = engine.telemetry
     allowed = _configured_resources(engine)
-    allowed.update(k for k, _c, _e in tele.sketch.topk(tele.sketch_k or 10))
+    allowed.update(
+        k for k, _c, _e in tele.blocked_sketch.topk(tele.export_topk_k)
+    )
     totals = rm.totals()
     folded: Dict[str, List[int]] = {}
     for res, cells in totals.items():

@@ -86,11 +86,55 @@ class SentinelConfig:
     TELEMETRY_ENABLED = "sentinel.tpu.telemetry.enabled"
     TELEMETRY_RING = "sentinel.tpu.telemetry.ring"
     # Device-side top-K blocked-resource candidates folded into each
-    # flush's kernel outputs (0 disables the fold entirely).
+    # flush's kernel outputs (0 disables the fold entirely). The
+    # ``blocked.topk`` spelling is preferred since the statistics
+    # sketch tier (sentinel.tpu.sketch.*) landed; the historical
+    # ``telemetry.sketch.*`` keys stay as accepted fallbacks (read when
+    # the new key is unset) so existing property files keep working.
+    TELEMETRY_BLOCKED_TOPK_K = "sentinel.tpu.telemetry.blocked.topk.k"
     TELEMETRY_SKETCH_K = "sentinel.tpu.telemetry.sketch.k"
     # Host-side space-saving summary capacity the per-flush top-Ks
-    # merge into.
+    # merge into (same preferred/fallback pairing as above).
+    TELEMETRY_BLOCKED_TOPK_CAP = "sentinel.tpu.telemetry.blocked.topk.capacity"
     TELEMETRY_SKETCH_CAP = "sentinel.tpu.telemetry.sketch.capacity"
+    # How many blocked-top-K rows the exports list (Prometheus
+    # sentinel_engine_blocked_weight, the `telemetry` command, the
+    # sketch tier's candidate listing) when the device fold is off —
+    # the ONE home of the former hand-rolled `sketch_k or 10`.
+    TELEMETRY_TOPK_EXPORT = "sentinel.tpu.telemetry.topk.export"
+    # Statistics sketch tier (runtime/sketch.py): fixed-size on-device
+    # count-min + candidate table tracking EVERY key the engine sees
+    # (unconfigured/cold resources, high-cardinality param values) with
+    # heavy-hitter promotion into exact dense rows. Opt-in — disabled
+    # costs one attribute read per submit/flush and the kernel fold is
+    # never compiled.
+    SKETCH_ENABLED = "sentinel.tpu.sketch.enabled"
+    # Count-min geometry: depth hash rows x width counters (width is
+    # rounded up to a power of two). Device memory is depth*width*4
+    # bytes — O(1) in the key cardinality.
+    SKETCH_DEPTH = "sentinel.tpu.sketch.depth"
+    SKETCH_WIDTH = "sentinel.tpu.sketch.width"
+    # Device candidate-table slots (the space-saving-style heavy-hitter
+    # set that rides the coalesced drain fetch).
+    SKETCH_CANDIDATES = "sentinel.tpu.sketch.candidates"
+    # Decay window: counts halve once per window (engine clock), so a
+    # key's steady-state count converges to ~2x its per-window volume.
+    SKETCH_WINDOW_MS = "sentinel.tpu.sketch.window.ms"
+    # Promotion threshold for sketch-mode param VALUES (estimated
+    # acquire/sec; 0 disarms value promotion).
+    SKETCH_PROMOTE_QPS = "sentinel.tpu.sketch.promote.qps"
+    # Default dense-rule QPS for promoted unconfigured RESOURCES (the
+    # synthetic flow rule's count; 0 disarms resource promotion).
+    SKETCH_RESOURCE_QPS = "sentinel.tpu.sketch.resource.qps"
+    # Max promoted keys (values + resources) held at once.
+    SKETCH_PROMOTE_MAX = "sentinel.tpu.sketch.promote.max"
+    # Consecutive decay windows a promoted key must stay below the
+    # demotion threshold before it falls back to sketch-only.
+    SKETCH_DEMOTE_WINDOWS = "sentinel.tpu.sketch.demote.windows"
+    # Bound on the host id->name map resolving drained candidate ids
+    # back to key names (LRU; ids are stable hashes so eviction never
+    # corrupts device state).
+    SKETCH_NAMES_CAP = "sentinel.tpu.sketch.names.capacity"
     # Admission tracing (metrics/admission_trace.py): bounded sampled
     # ring of per-admission verdict-provenance records with W3C
     # trace-context propagation. Enabled by default — disabled costs
@@ -198,6 +242,21 @@ class SentinelConfig:
         TELEMETRY_RING: "4096",
         TELEMETRY_SKETCH_K: "8",
         TELEMETRY_SKETCH_CAP: "64",
+        # -1 = unset: fall back to the historical telemetry.sketch.*
+        # spelling above.
+        TELEMETRY_BLOCKED_TOPK_K: "-1",
+        TELEMETRY_BLOCKED_TOPK_CAP: "-1",
+        TELEMETRY_TOPK_EXPORT: "10",
+        SKETCH_ENABLED: "false",
+        SKETCH_DEPTH: "4",
+        SKETCH_WIDTH: "2048",
+        SKETCH_CANDIDATES: "64",
+        SKETCH_WINDOW_MS: "1000",
+        SKETCH_PROMOTE_QPS: "0",
+        SKETCH_RESOURCE_QPS: "0",
+        SKETCH_PROMOTE_MAX: "64",
+        SKETCH_DEMOTE_WINDOWS: "3",
+        SKETCH_NAMES_CAP: "65536",
         TRACE_ENABLED: "true",
         TRACE_RING: "2048",
         TRACE_SAMPLE_RATE: "0.01",

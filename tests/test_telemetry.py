@@ -143,7 +143,7 @@ class TestFlightRecorder:
         try:
             eng = Engine()
             assert not eng.telemetry.enabled
-            assert eng._sketch_k == 0  # kernel sketch fold compiled away
+            assert eng._blk_topk_k == 0  # kernel top-K fold compiled away
             eng.set_flow_rules([st.FlowRule("off", count=1)])
             for _ in range(3):
                 eng.submit_entry("off")

@@ -75,6 +75,11 @@ STAGE_METRICS: Dict[str, Tuple[str, float]] = {
     "spec_entry_sys_p99_us": ("lower", 5.00),
     "shed_entry_p50_us": ("lower", 2.00),
     "shed_entry_p99_us": ("lower", 5.00),
+    "sketch_ops_per_sec_on": ("higher", 0.60),
+    "sketch_ops_per_sec_off": ("higher", 0.60),
+    # Storm latency includes real decay-window waits, so box noise is
+    # a smaller share — but keep the same latency-class band.
+    "sketch_promote_storm_ms": ("lower", 2.00),
 }
 
 # Stage-context keys: a group's metrics are comparable only when every
@@ -91,6 +96,9 @@ STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
     ((), ("spec_ops_per_sec", "spec_entry_p50_us", "spec_entry_p99_us",
           "spec_entry_sys_p50_us", "spec_entry_sys_p99_us",
           "shed_entry_p50_us", "shed_entry_p99_us")),
+    (("sketch_n_ops",),
+     ("sketch_ops_per_sec_on", "sketch_ops_per_sec_off",
+      "sketch_promote_storm_ms")),
 ]
 
 

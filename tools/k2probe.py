@@ -10,6 +10,7 @@ probe times each suspect in isolation so the cliff can be attributed:
   flush   flush_step_jit (flags off), k=1 vs k=2
   stats   the metric-array batched window update alone
   seg     the segment cumsum/cummax rank math alone
+  sketch  the sketch-tier count-min/candidate fold alone (2 widths)
 
 Run: python tools/k2probe.py [--platform cpu] [--n 131072]
 Each stage prints one line; a final JSON summary goes to stdout.
@@ -127,7 +128,7 @@ def main() -> None:
             s["st"], dev, s["dyn"], dindex.device, s["ddyn"], s["pdyn"],
             sysdev, s["batch"], **flags
         )
-        s["st"], s["dyn"], s["ddyn"], s["pdyn"], res = out
+        s["st"], s["dyn"], s["ddyn"], s["pdyn"], _sk, res = out
         return res
 
     for k in (1, 2):
@@ -284,6 +285,32 @@ def main() -> None:
             _cfg.set(_cfg.INGEST_DEADLINE_MS, "0")
     except Exception as exc:
         print(f"[k2probe] speculative stage skipped: {exc}", file=sys.stderr)
+
+    # --- sketch-tier fold in isolation (runtime/sketch.py) -------------
+    # The count-min + candidate merge over a pow2 key batch, jitted
+    # standalone at two widths — the marginal device cost one armed
+    # flush pays on top of the main kernel.
+    try:
+        from sentinel_tpu.runtime.sketch import (
+            SketchBatch, make_sketch_state, sketch_fold,
+        )
+
+        sk_n = min(8192, n)
+        ids = jnp.asarray(
+            rng.integers(0, 2**31 - 1, sk_n).astype(np.int32)
+        )
+        w = jnp.ones((sk_n,), dtype=jnp.int32)
+        for width in (2048, 16384):
+            st = make_sketch_state(4, width, 64)
+            fold = jax.jit(lambda s, i, ww: sketch_fold(
+                s, SketchBatch(ids=i, w=ww)
+            ))
+            report(
+                f"sketch_fold_w{width}",
+                _time(fold, st, ids, w, iters=args.iters),
+            )
+    except Exception as exc:
+        print(f"[k2probe] sketch stage skipped: {exc}", file=sys.stderr)
 
     # --- isolated sorts over the flat slot array -----------------------
     for k in (1, 2):
