@@ -625,9 +625,14 @@ def _run_sketch_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         resource="api", param_idx=0, count=1e9, sketch_mode=True
     )
 
-    def _stream(eng) -> float:
+    def _stream(eng, warm_decay: bool = False) -> float:
         """Flush ``iters`` batches of n_ops distinct-per-batch values;
-        returns ops/sec."""
+        returns ops/sec. ``warm_decay`` warms BOTH decay-flag kernel
+        variants before timing (sleep past one decay window, flush
+        again): the decay=True variant otherwise compiles INSIDE the
+        timed loop and a ~1 s one-time XLA compile swamps the 3-iter
+        measurement — BENCH_r07's ON number was exactly that artifact.
+        """
         uid = [0]
 
         def batch():
@@ -636,7 +641,11 @@ def _run_sketch_stage(n_rules: int, n_ops: int, iters: int) -> dict:
             return col
 
         eng.submit_bulk("api", n=n_ops, args_column=batch())
-        eng.flush()  # compile + warm
+        eng.flush()  # compile + warm (decay=False variant)
+        if warm_decay:
+            time.sleep(1.05)  # roll one real decay window
+            eng.submit_bulk("api", n=n_ops, args_column=batch())
+            eng.flush()  # compile + warm (decay=True variant)
         t0 = time.perf_counter()
         for _ in range(iters):
             eng.submit_bulk("api", n=n_ops, args_column=batch())
@@ -656,7 +665,7 @@ def _run_sketch_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         config.set(config.SKETCH_WINDOW_MS, "1000")
         eng_on = Engine()
         eng_on.set_param_rules({"api": [rule]})
-        on_ops = _stream(eng_on)
+        on_ops = _stream(eng_on, warm_decay=True)
 
         # Promotion storm: 16 hot keys appear at once; wall time until
         # every one holds an exact dense row (bounded-flushes contract).
@@ -708,6 +717,331 @@ def _run_sketch_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         # or a regression) OMITS the metric rather than recording a
         # bogus 0.0 a later benchgate baseline would gate against.
         out["sketch_promote_storm_ms"] = round(storm_ms, 1)
+    return out
+
+
+def _run_adapters_stage(n_rules: int, n_ops: int, iters: int) -> dict:
+    """Adapter matrix (runtime/window.py): per-adapter ops/s with the
+    batch window OFF (today's per-request submit+flush) vs ON (columnar
+    windows), p50/p99 request latency in both modes, plus two same-run
+    references: ``gateway_bulk`` (gateway_submit_bulk + columnar exit
+    accounting — the columnar ceiling) and ``spine`` (the window
+    machinery batch-driven: join + group + columnar submit + fan-out +
+    bulk exits, no per-request concurrency harness — the adapter-edge
+    cost the ≥0.8x-of-bulk acceptance bounds; the per-adapter
+    concurrency numbers additionally pay driver + GIL cost, which is
+    the 1-core box's tax, not the spine's).
+
+    Adapters whose framework is not installed (flask/fastapi) are
+    skipped with a log line — their metrics are simply absent and the
+    gate treats them as not comparable."""
+    import asyncio
+    import threading
+
+    import numpy as np  # noqa: F401
+
+    from sentinel_tpu.core import api
+    from sentinel_tpu.models import constants as KC
+    from sentinel_tpu.models.rules import FlowRule
+    from sentinel_tpu.rules.flow_manager import flow_rule_manager
+    from sentinel_tpu.utils.config import config
+
+    n_ops, iters = max(256, n_ops), max(1, iters)
+    _log(f"adapters stage ops={n_ops}")
+    out: dict = {"adapters_n_ops": n_ops}
+
+    RES = "GET:/bench"
+    OFF_OPS = max(128, n_ops // 8)  # off mode is ~one flush per request
+
+    def _reset(window: bool):
+        config.set(config.INGEST_BATCH_WINDOW_MS, "2" if window else "0")
+        config.set(config.INGEST_BATCH_MAX, "256")
+        eng = api.reset()
+        flow_rule_manager.load_rules(
+            [FlowRule(RES, count=1e9), FlowRule("route", count=1e9)]
+        )
+        return eng
+
+    def _pcts(lat):
+        lat.sort()
+        return (
+            lat[len(lat) // 2] * 1e6,
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e6,
+        )
+
+    # ---- same-run gateway-bulk reference (with exit accounting) ----
+    from sentinel_tpu.adapters.gateway import (
+        GatewayRequestBatch,
+        gateway_submit_bulk,
+    )
+
+    eng = _reset(window=False)
+    nb = 256
+    batch = GatewayRequestBatch(n=nb, client_ip=["1.2.3.4"] * nb)
+
+    def _bulk_once():
+        op = gateway_submit_bulk("route", batch, flush=True)
+        if op is not None:
+            adm = op.admitted
+            eng.submit_exit_bulk(
+                op.rows, max(1, int(adm.sum())), rt=1, resource="route"
+            )
+
+    for _ in range(8):
+        _bulk_once()
+    eng.flush()
+    eng.drain()
+    rounds = max(1, n_ops // nb)
+    bulk_best = 0.0
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            _bulk_once()
+        eng.flush()
+        eng.drain()
+        bulk_best = max(bulk_best, rounds * nb / (time.perf_counter() - t0))
+    out["adapters_gateway_bulk_ops_per_sec"] = round(bulk_best, 1)
+    _log(f"adapters: gateway-bulk {bulk_best:,.0f} ops/s")
+
+    # ---- the spine, batch-driven (window machinery cost per request) ----
+    from sentinel_tpu.runtime.window import WindowRequest
+
+    eng = _reset(window=True)
+    w = eng.ingest_window
+
+    def _spine_round(total):
+        reqs = []
+        now = eng.clock.now_ms()
+        for _ in range(total):
+            r = WindowRequest(
+                RES, KC.CONTEXT_DEFAULT_NAME, "", 1, KC.EntryType.IN, (),
+                now, None,
+            )
+            w.join(r)
+            reqs.append(r)
+        for r in reqs:
+            if r.verdict is None and r.error is None:
+                r.event.wait(60)
+        for r in reqs:
+            v = r.verdict
+            if v is not None and v.admitted:
+                w.note_exit(r.rows, RES, 1, 1, 0, bool(v.speculative))
+
+    for _ in range(3):
+        _spine_round(n_ops // 2)  # warm every window-size pad bucket
+    spine_best = 0.0
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _spine_round(n_ops)
+        spine_best = max(spine_best, n_ops / (time.perf_counter() - t0))
+    eng.flush()
+    eng.drain()
+    out["adapters_spine_on_ops_per_sec"] = round(spine_best, 1)
+    out["adapters_spine_vs_bulk"] = round(spine_best / max(bulk_best, 1e-9), 4)
+    _log(
+        f"adapters: spine {spine_best:,.0f} ops/s "
+        f"({out['adapters_spine_vs_bulk']:.2f}x of bulk)"
+    )
+
+    # ---- per-adapter drivers ----
+    def _sync_driver(call, total, threads=64):
+        lat: list = []
+        lock = threading.Lock()
+        per = max(1, total // threads)
+
+        def worker():
+            mine = []
+            for _ in range(per):
+                t0 = time.perf_counter()
+                call()
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                lat.extend(mine)
+
+        ths = [threading.Thread(target=worker) for _ in range(threads)]
+        t0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        dt = time.perf_counter() - t0
+        return per * threads / dt, lat
+
+    def _async_driver(acall, total, conc=256):
+        lat: list = []
+
+        async def _main():
+            sem = asyncio.Semaphore(conc)
+
+            async def one():
+                async with sem:
+                    t0 = time.perf_counter()
+                    await acall()
+                    lat.append(time.perf_counter() - t0)
+
+            await asyncio.gather(*[one() for _ in range(total)])
+
+        t0 = time.perf_counter()
+        asyncio.run(_main())
+        return total / (time.perf_counter() - t0), lat
+
+    def _measure(name, driver, call, on_total, off_total):
+        nonlocal eng
+        for window in (False, True):
+            eng = _reset(window)
+            total = on_total if window else off_total
+            if window:
+                # Four full warm rounds: this driver's window sizes
+                # set the padded kernel shapes (entry × exit pad-bucket
+                # PAIRS each compile once), and an XLA compile inside a
+                # timed round would swamp it (the r07 lesson).
+                for _ in range(4):
+                    driver(call, total)
+            else:
+                driver(call, max(128, total // 4))
+            best, best_lat = 0.0, []
+            # Window-on gets extra rounds: a ragged TAIL window whose
+            # padded shape was never warmed costs a ~1.6 s XLA compile
+            # in whichever round first sees it — best-of over more
+            # rounds makes one clean round near-certain.
+            for _ in range(iters + (4 if window else 0)):
+                ops, lat = driver(call, total)
+                if ops > best:
+                    best, best_lat = ops, lat
+            eng.flush()
+            eng.drain()
+            mode = "on" if window else "off"
+            p50, p99 = _pcts(best_lat)
+            out[f"adapters_{name}_{mode}_ops_per_sec"] = round(best, 1)
+            out[f"adapters_{name}_{mode}_p50_us"] = round(p50, 1)
+            out[f"adapters_{name}_{mode}_p99_us"] = round(p99, 1)
+            _log(
+                f"adapters: {name} window-{mode} {best:,.0f} ops/s "
+                f"p50 {p50:,.0f}us p99 {p99:,.0f}us"
+            )
+
+    # WSGI (stands in for Flask's WSGI mount when flask is absent).
+    from sentinel_tpu.adapters import (
+        SentinelASGIMiddleware,
+        SentinelWSGIMiddleware,
+    )
+
+    def _wsgi_inner(environ, start_response):
+        start_response("200 OK", [])
+        return [b"ok"]
+
+    wapp = SentinelWSGIMiddleware(_wsgi_inner, total_resource=None)
+
+    def _wsgi_call():
+        environ = {"PATH_INFO": "/bench", "REQUEST_METHOD": "GET"}
+        b"".join(wapp(environ, lambda s, h: None))
+
+    _measure("wsgi", _sync_driver, _wsgi_call, n_ops, OFF_OPS)
+
+    # ASGI (stands in for FastAPI's app-wide mount when absent).
+    async def _asgi_inner(scope, receive, send):
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": []})
+        await send({"type": "http.response.body", "body": b"ok"})
+
+    aapp = SentinelASGIMiddleware(_asgi_inner, total_resource=None)
+    _scope = {"type": "http", "method": "GET", "path": "/bench"}
+
+    async def _recv():
+        return {"type": "http.request"}
+
+    async def _send(msg):
+        pass
+
+    async def _asgi_call():
+        await aapp(_scope, _recv, _send)
+
+    _measure("asgi", _async_driver, _asgi_call, n_ops, OFF_OPS)
+
+    # aiohttp middleware (gated on the framework being importable).
+    try:
+        from aiohttp.test_utils import make_mocked_request
+
+        from sentinel_tpu.adapters.aiohttp_adapter import sentinel_middleware
+
+        mw = sentinel_middleware()
+
+        async def _handler(request):
+            from aiohttp import web
+
+            return web.Response(text="ok")
+
+        # One shared mocked request: building one costs ~2 ms — that
+        # would be the driver benching aiohttp's test kit, not the
+        # adapter. The middleware only READS it (method/path/headers).
+        _aio_req = make_mocked_request("GET", "/bench")
+
+        async def _aio_call():
+            await mw(_aio_req, _handler)
+
+        _measure("aiohttp", _async_driver, _aio_call, n_ops, OFF_OPS)
+    except ImportError:
+        _log("adapters: aiohttp not installed — skipped")
+
+    # gRPC server interceptor (no sockets: fake call details, real
+    # grpc handler objects).
+    try:
+        import grpc  # noqa: F401
+
+        from sentinel_tpu.adapters.grpc_adapter import (
+            SentinelServerInterceptor,
+        )
+
+        class _Details:
+            method = "/svc/Bench"
+            invocation_metadata = ()
+
+        interceptor = SentinelServerInterceptor()
+
+        def _continuation(details):
+            import grpc as _g
+
+            return _g.unary_unary_rpc_method_handler(lambda req, ctx: "ok")
+
+        class _Ctx:
+            def abort(self, code, details):
+                raise RuntimeError("aborted")
+
+        def _grpc_call():
+            handler = interceptor.intercept_service(_continuation, _Details())
+            if handler is not None and handler.unary_unary is not None:
+                try:
+                    handler.unary_unary(None, _Ctx())
+                except RuntimeError:
+                    pass  # blocked → abort; still one admission decided
+
+        _measure("grpc", _sync_driver, _grpc_call, n_ops, OFF_OPS)
+    except ImportError:
+        _log("adapters: grpcio not installed — skipped")
+
+    # Flask / FastAPI ride the same spine through their own hooks; when
+    # installed they get first-class rows, otherwise the WSGI/ASGI rows
+    # above are their stand-ins (identical windowed entry path).
+    for name, mod in (("flask", "flask"), ("fastapi", "fastapi")):
+        try:
+            __import__(mod)
+        except ImportError:
+            _log(f"adapters: {mod} not installed — skipped "
+                 f"({'wsgi' if name == 'flask' else 'asgi'} row is the "
+                 "stand-in; same windowed entry path)")
+
+    import jax
+
+    api.reset()
+    for key in (config.INGEST_BATCH_WINDOW_MS, config.INGEST_BATCH_MAX):
+        config.set(key, config.DEFAULTS[key])
+    out.update(
+        {
+            "platform": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+            "jax_version": jax.__version__,
+        }
+    )
     return out
 
 
@@ -817,6 +1151,7 @@ def _child_main(args) -> None:
         "engine": _run_engine_stage,
         "speculative": _run_speculative_stage,
         "sketch": _run_sketch_stage,
+        "adapters": _run_adapters_stage,
     }[args.kind]
     print(json.dumps(fn(args.rules, args.entries, args.iters)), flush=True)
 
@@ -1056,7 +1391,12 @@ def main() -> None:
             _log(f"skipping speculative stage: {remaining:.0f}s left gives "
                  f"timeout {spec_t:.0f}s < {min_spec:.0f}s floor")
         remaining = deadline - time.monotonic()
-        sketch_t = min(remaining - 10, 300.0)
+        # Reserve the adapters stage's floor like the speculative stage
+        # reserves the sketch's.
+        min_adapters = 90.0 if run_platform == "cpu" else 240.0
+        sketch_t = min(remaining - 10 - min_adapters, 300.0)
+        if sketch_t < min_sketch:
+            sketch_t = min(remaining - 10, 300.0)
         if sketch_t >= min_sketch:
             sketch = spawn(64, 8192, 3, run_platform, sketch_t, kind="sketch")
             if sketch:
@@ -1064,6 +1404,17 @@ def main() -> None:
         else:
             _log(f"skipping sketch stage: {remaining:.0f}s left gives "
                  f"timeout {sketch_t:.0f}s < {min_sketch:.0f}s floor")
+        remaining = deadline - time.monotonic()
+        adapters_t = min(remaining - 10, 300.0)
+        if adapters_t >= min_adapters:
+            adapters = spawn(
+                64, 2048, 3, run_platform, adapters_t, kind="adapters"
+            )
+            if adapters:
+                best.update(adapters)
+        else:
+            _log(f"skipping adapters stage: {remaining:.0f}s left gives "
+                 f"timeout {adapters_t:.0f}s < {min_adapters:.0f}s floor")
 
     if best is None:
         _emit(

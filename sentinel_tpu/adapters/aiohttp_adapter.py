@@ -68,8 +68,11 @@ def sentinel_middleware(
         try:
             try:
                 for res in resources:
+                    # Windowed columnar admission (runtime/window.py)
+                    # when armed — awaited so the loop stays free while
+                    # the window assembles; entry_async otherwise.
                     entries.append(
-                        api.entry_async(
+                        await api.entry_windowed_async(
                             res, entry_type=C.EntryType.IN, origin=origin
                         )
                     )

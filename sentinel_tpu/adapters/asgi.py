@@ -3,6 +3,13 @@
 Reference: sentinel-spring-webflux-adapter / sentinel-reactor-adapter —
 the reactive pipeline wraps each exchange in an entry and maps blocks
 to a 429 response.
+
+Admissions ride the columnar ingest spine: with the adapter-edge batch
+window armed (``sentinel.tpu.ingest.batch.window.ms`` > 0) concurrent
+exchanges coalesce into one columnar ``submit_bulk`` flush — awaited,
+so the event loop stays free while the window assembles — with
+per-request verdict fan-out; window off is exactly the per-request
+path.
 """
 
 from __future__ import annotations
@@ -61,8 +68,17 @@ class SentinelASGIMiddleware:
         try:
             try:
                 if self.total_resource:
-                    entries.append(api.entry(self.total_resource, entry_type=C.EntryType.IN))
-                entries.append(api.entry(resource, entry_type=C.EntryType.IN))
+                    entries.append(
+                        await api.entry_windowed_async(
+                            self.total_resource, entry_type=C.EntryType.IN,
+                            detached=False,
+                        )
+                    )
+                entries.append(
+                    await api.entry_windowed_async(
+                        resource, entry_type=C.EntryType.IN, detached=False
+                    )
+                )
             except BlockError:
                 await send(
                     {

@@ -57,7 +57,10 @@ def sentinel_guard(
         )
         try:
             try:
-                entry = api.entry_async(
+                # Windowed columnar admission (runtime/window.py) when
+                # armed — awaited so the loop stays free while the
+                # window assembles; per-request entry_async otherwise.
+                entry = await api.entry_windowed_async(
                     res, entry_type=C.EntryType.IN, origin=origin
                 )
             except BlockError:

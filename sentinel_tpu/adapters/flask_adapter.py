@@ -87,9 +87,12 @@ class SentinelFlask:
             entries = []
             try:
                 for res in resources:
+                    # Windowed columnar admission (runtime/window.py)
+                    # when armed; per-request entry_async otherwise.
                     entries.append(
-                        api.entry_async(
-                            res, entry_type=C.EntryType.IN, origin=origin
+                        api.entry_windowed(
+                            res, entry_type=C.EntryType.IN, origin=origin,
+                            detached=True,
                         )
                     )
             except BlockError:

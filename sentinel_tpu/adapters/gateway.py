@@ -359,7 +359,13 @@ def gateway_entry(route_id: str, info: GatewayRequestInfo):
     try:
         for res in resources:
             args = gateway_rule_manager.parse_params(res, info)
-            entries.append(api.entry(res, entry_type=C.EntryType.IN, args=args))
+            # Windowed columnar admission (runtime/window.py) when the
+            # adapter-edge batch window is armed: the extracted param
+            # tuple rides the window's ArgsColumns; per-request
+            # api.entry otherwise.
+            entries.append(
+                api.entry_windowed(res, entry_type=C.EntryType.IN, args=args)
+            )
         yield entries
     except BaseException as e:
         from sentinel_tpu.core.errors import BlockError
@@ -380,6 +386,7 @@ def gateway_submit_bulk(
     *,
     engine=None,
     ts=None,
+    acquire=1,
     flush: bool = False,
 ):
     """Columnar gateway admission — the adapter fast path onto
@@ -459,6 +466,7 @@ def gateway_submit_bulk(
         route_id,
         n,
         ts=ts,
+        acquire=acquire,
         entry_type=C.EntryType.IN,
         args_column=args_column,
     )

@@ -74,7 +74,12 @@ if grpc is not None:
             )
             token = ContextUtil.set_trace(tc)
             try:
-                entry = api.entry(resource, entry_type=C.EntryType.IN)
+                # Windowed columnar admission (runtime/window.py) when
+                # armed (gRPC worker threads coalesce); per-request
+                # entry otherwise.
+                entry = api.entry_windowed(
+                    resource, entry_type=C.EntryType.IN
+                )
             except BlockError:
                 def abort(request, context):
                     context.abort(

@@ -4,6 +4,12 @@ Reference: sentinel-web-servlet's CommonFilter + the spring-webmvc
 interceptor: each request enters the web context with a parsed origin,
 then a total-inbound resource plus the per-URL resource; blocks render a
 429 page (configurable); business errors are traced on exit.
+
+Admissions ride the columnar ingest spine: with the adapter-edge batch
+window armed (``sentinel.tpu.ingest.batch.window.ms`` > 0) concurrent
+requests coalesce into one columnar ``submit_bulk`` flush with
+per-request verdict fan-out (``api.entry_windowed``); window off is
+exactly the per-request path.
 """
 
 from __future__ import annotations
@@ -59,8 +65,14 @@ class SentinelWSGIMiddleware:
         try:
             try:
                 if self.total_resource:
-                    entries.append(api.entry(self.total_resource, entry_type=C.EntryType.IN))
-                entries.append(api.entry(resource, entry_type=C.EntryType.IN))
+                    entries.append(
+                        api.entry_windowed(
+                            self.total_resource, entry_type=C.EntryType.IN
+                        )
+                    )
+                entries.append(
+                    api.entry_windowed(resource, entry_type=C.EntryType.IN)
+                )
             except BlockError as e:
                 return self._blocked(environ, start_response, e)
             try:

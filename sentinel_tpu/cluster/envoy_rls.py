@@ -209,6 +209,21 @@ def decode_rate_limit_response(raw: bytes) -> Tuple[int, List[Tuple[int, Optiona
 # Rules (EnvoyRlsRule + EnvoySentinelRuleConverter)
 # ---------------------------------------------------------------------------
 
+# Bulk-endpoint surface (ShouldRateLimitBulk): each loaded domain also
+# registers a gateway route resource carrying one exact-match
+# hot-param rule per descriptor, so a batched payload rides the
+# columnar gateway_submit_bulk spine instead of one token RPC per
+# descriptor. The synthetic URL-param field can never collide with a
+# descriptor key on the wire (descriptor entries live in their own
+# message field, not in url params). NOTE: gateway_rule_manager.
+# load_rules is a whole-table replace — an application that loads its
+# own gateway rules DIRECTLY (not through this manager) after RLS
+# rules are registered must call envoy_rls_rule_manager.load_rules
+# again to re-register the rls:* routes.
+BULK_RESOURCE_PREFIX = "rls:"
+BULK_PARAM_FIELD = "__rls__"
+
+
 @dataclass(frozen=True)
 class RlsDescriptor:
     """One limited descriptor: ordered key/value resources + the
@@ -260,16 +275,54 @@ def to_flow_rules(rule: EnvoyRlsRule) -> List[FlowRule]:
     return out
 
 
+def to_gateway_rules(rule: EnvoyRlsRule) -> List[object]:
+    """The bulk-endpoint twin of :func:`to_flow_rules`: one exact-match
+    hot-param gateway rule per descriptor on the domain's
+    ``rls:<domain>`` route resource (1-second interval, like the
+    cluster conversion's 1-bucket sampling). A descriptor with no rule
+    produces a key no pattern matches — the request passes, matching
+    the per-request endpoint's no-rule stance."""
+    from sentinel_tpu.adapters.gateway import (
+        GatewayFlowRule,
+        GatewayParamFlowItem,
+        PARAM_MATCH_STRATEGY_EXACT,
+        PARAM_PARSE_STRATEGY_URL_PARAM,
+    )
+
+    out = []
+    for d in rule.descriptors:
+        key = generate_key(rule.domain, d.resources)
+        out.append(
+            GatewayFlowRule(
+                resource=BULK_RESOURCE_PREFIX + rule.domain,
+                count=float(d.count),
+                interval_sec=1,
+                param_item=GatewayParamFlowItem(
+                    parse_strategy=PARAM_PARSE_STRATEGY_URL_PARAM,
+                    field_name=BULK_PARAM_FIELD,
+                    pattern=key,
+                    match_strategy=PARAM_MATCH_STRATEGY_EXACT,
+                ),
+            )
+        )
+    return out
+
+
 class EnvoyRlsRuleManager:
     """Namespace-per-domain rule registry feeding the shared cluster
     flow rule manager (≙ EnvoyRlsRuleDataSourceService applying
-    converted rules under the domain namespace)."""
+    converted rules under the domain namespace) AND the gateway rule
+    manager (the ``rls:<domain>`` resources behind the bulk endpoint).
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._by_domain: Dict[str, EnvoyRlsRule] = {}
         # Precomputed hot-path lookup: (domain, resources) -> flow_id.
         self._flow_ids: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], int] = {}
+        # Descriptor counts for the bulk endpoint's requests_per_unit
+        # column: key (generate_key) -> count.
+        self._counts: Dict[str, float] = {}
 
     def load_rules(self, rules: Sequence[EnvoyRlsRule]) -> None:
         from sentinel_tpu.cluster.flow_rules import cluster_flow_rule_manager
@@ -284,18 +337,60 @@ class EnvoyRlsRuleManager:
                 for r in rules
                 for d in r.descriptors
             }
+            self._counts = {
+                generate_key(r.domain, d.resources): float(d.count)
+                for r in rules
+                for d in r.descriptors
+            }
             for r in rules:
                 cluster_flow_rule_manager.load_rules(r.domain, to_flow_rules(r))
             # Dropped domains must stop being enforced: an operator
             # deleting a rule expects its flow_id to stop rate-limiting.
             for domain in old_domains - set(self._by_domain):
                 cluster_flow_rule_manager.load_rules(domain, [])
+            # Under self._lock: the gateway-table swap is a
+            # read-modify-write, so two concurrent load_rules/clear
+            # calls interleaving outside the lock could install one
+            # call's rls:* rules against the other's _counts/_flow_ids
+            # (the gateway manager never calls back in, so holding the
+            # lock is safe).
+            self._reload_gateway_rules(rules)
+
+    @staticmethod
+    def _reload_gateway_rules(rules: Sequence[EnvoyRlsRule]) -> None:
+        """Swap the ``rls:*`` gateway rules behind the bulk endpoint,
+        preserving every user gateway rule (the manager's load is a
+        whole-table replace). Outside ``self._lock`` — the gateway
+        manager never calls back in."""
+        from sentinel_tpu.adapters.gateway import gateway_rule_manager
+
+        keep = [
+            g
+            for g in gateway_rule_manager.get_rules()
+            if not g.resource.startswith(BULK_RESOURCE_PREFIX)
+        ]
+        fresh = [g for r in rules for g in to_gateway_rules(r)]
+        gateway_rule_manager.load_rules(keep + fresh)
 
     def flow_id_for(self, domain: str, entries: Sequence[Tuple[str, str]]) -> Optional[int]:
         """The flow id of the rule matching this descriptor exactly, or
         None (no rule → the request passes)."""
         with self._lock:
             return self._flow_ids.get((domain, tuple(entries)))
+
+    def count_for_key(self, key: str) -> Optional[float]:
+        """The configured per-second count of the descriptor rule whose
+        generated key is ``key`` (the bulk endpoint's rpu column), or
+        None when no rule matches."""
+        with self._lock:
+            return self._counts.get(key)
+
+    def has_domain(self, domain: str) -> bool:
+        """Whether any rule is loaded for ``domain`` — the bulk
+        endpoint's gate against creating engine state for
+        attacker-chosen domain strings."""
+        with self._lock:
+            return domain in self._by_domain
 
     def clear(self) -> None:
         from sentinel_tpu.cluster.flow_rules import cluster_flow_rule_manager
@@ -305,6 +400,8 @@ class EnvoyRlsRuleManager:
                 cluster_flow_rule_manager.load_rules(domain, [])
             self._by_domain.clear()
             self._flow_ids.clear()
+            self._counts.clear()
+            self._reload_gateway_rules(())
 
 
 envoy_rls_rule_manager = EnvoyRlsRuleManager()
@@ -316,6 +413,11 @@ envoy_rls_rule_manager = EnvoyRlsRuleManager()
 
 SERVICE_NAME = "envoy.service.ratelimit.v2.RateLimitService"
 METHOD = "ShouldRateLimit"
+# Bulk admission method (same request/response schema): the
+# descriptors of ONE RateLimitRequest are treated as a batch of
+# independent admissions and ride the columnar engine path
+# (gateway_submit_bulk) — one flush decides the whole payload.
+METHOD_BULK = "ShouldRateLimitBulk"
 
 
 class EnvoyRlsService:
@@ -377,6 +479,93 @@ class EnvoyRlsService:
         overall = CODE_OVER_LIMIT if blocked else CODE_OK
         return encode_rate_limit_response(overall, statuses)
 
+    def should_rate_limit_bulk(
+        self, raw_request: bytes, context=None, engine=None
+    ) -> bytes:
+        """The batched admission path: every descriptor in the request
+        is one admission, the whole payload rides ONE columnar
+        ``gateway_submit_bulk`` flush against the ``rls:<domain>``
+        route (the exact-match hot-param rules
+        :func:`to_gateway_rules` registered), and per-descriptor
+        verdicts come back as one response. An Envoy fleet pointing a
+        batching filter here admits in bulk at engine throughput
+        instead of one token round-trip per descriptor.
+
+        Enforcement state note: this path meters on the RLS server's
+        OWN engine (every Envoy shares it, so the limit is still
+        fleet-global); the per-request ``ShouldRateLimit`` meters on
+        the cluster token service. The two books are separate — pick
+        one endpoint per domain."""
+        try:
+            domain, descriptors, hits = decode_rate_limit_request(raw_request)
+        except (ValueError, IndexError):
+            if context is not None:
+                import grpc
+
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT, "malformed RateLimitRequest"
+                )
+            raise ValueError("malformed RateLimitRequest")
+        if not descriptors:
+            return encode_rate_limit_response(CODE_OK, [])
+        if not envoy_rls_rule_manager.has_domain(domain):
+            # Unknown domain → every descriptor passes WITHOUT touching
+            # the engine: submitting "rls:<domain>" for an arbitrary
+            # wire-supplied string would let an attacker allocate node
+            # rows/stats per distinct domain until the resource cap
+            # (the per-request endpoint likewise answers no-rule
+            # descriptors without engine state).
+            return encode_rate_limit_response(
+                CODE_OK, [(CODE_OK, None, 0) for _ in descriptors]
+            )
+        from sentinel_tpu.adapters.gateway import (
+            GatewayRequestBatch,
+            gateway_submit_bulk,
+        )
+
+        acquire = hits if hits > 0 else 1  # absent → 1
+        keys = [generate_key(domain, entries) for entries in descriptors]
+        batch = GatewayRequestBatch(
+            n=len(keys),
+            url_params=[{BULK_PARAM_FIELD: k} for k in keys],
+        )
+        op = gateway_submit_bulk(
+            BULK_RESOURCE_PREFIX + domain, batch, engine=engine,
+            acquire=acquire, flush=True,
+        )
+        statuses = []
+        if op is None:
+            # Over the resource cap / engine switch off: pass-through,
+            # like the per-request endpoint's no-rule answer.
+            statuses = [(CODE_OK, None, 0) for _ in keys]
+            return encode_rate_limit_response(CODE_OK, statuses)
+        adm = op.admitted
+        n_adm = int(adm.sum())
+        if n_adm:
+            # An RLS check is an instantaneous decision: the admitted
+            # rows complete immediately (releases the group's gauges;
+            # QPS accounting keeps the admits).
+            from sentinel_tpu.core import api as _api
+
+            eng = engine if engine is not None else _api.get_engine()
+            # count=acquire: the admission charged hits_addend passes
+            # per row, so the completion must record the same weight or
+            # success counters under-report vs pass counters.
+            eng.submit_exit_bulk(op.rows, n_adm, rt=0, count=acquire,
+                                 resource=op.resource,
+                                 speculative=op.speculative)
+        blocked = False
+        for i, key in enumerate(keys):
+            rpu = envoy_rls_rule_manager.count_for_key(key)
+            ok = bool(adm[i])
+            blocked = blocked or not ok
+            statuses.append(
+                (CODE_OK if ok else CODE_OVER_LIMIT,
+                 int(rpu) if rpu is not None else None, 0)
+            )
+        overall = CODE_OVER_LIMIT if blocked else CODE_OK
+        return encode_rate_limit_response(overall, statuses)
+
 
 class SentinelRlsGrpcServer:
     """A grpc.Server exposing the RLS service (generic handler — no
@@ -395,7 +584,14 @@ class SentinelRlsGrpcServer:
                     lambda req, ctx: self.service.should_rate_limit(req, ctx),
                     request_deserializer=None,  # raw bytes in
                     response_serializer=None,  # raw bytes out
-                )
+                ),
+                METHOD_BULK: grpc.unary_unary_rpc_method_handler(
+                    lambda req, ctx: self.service.should_rate_limit_bulk(
+                        req, ctx
+                    ),
+                    request_deserializer=None,
+                    response_serializer=None,
+                ),
             },
         )
         self._server.add_generic_rpc_handlers((handler,))

@@ -199,6 +199,10 @@ class Entry:
         self.block_error: Optional[E.BlockError] = None
         self.pass_through = pass_through
         self._exited = False
+        # Windowed entries (runtime/window.py) may batch their exit
+        # columnar through the window instead of a single submit_exit;
+        # None = the normal per-request exit.
+        self._exit_sink = None
 
     def set_error(self, e: BaseException) -> None:
         """Tracer.traceEntry (Tracer.java:103-116): the ONE choke point
@@ -231,23 +235,38 @@ class Entry:
             err = 0
             if self.error is not None and not isinstance(self.error, E.BlockError):
                 err = count if count is not None else self.acquire
-            engine.submit_exit(
-                self.rows,
-                rt=rt,
-                count=count if count is not None else self.acquire,
-                err=err,
-                resource=self.resource,
-                param_rows=self.param_rows,
-                # The mirror-release gate wants "was this admit charged
-                # to the host mirror": degraded fills (speculative=False,
-                # degraded=True) charge the persistent mirror's THREAD
-                # counter just like speculative admits do.
-                speculative=(
-                    (self.verdict.speculative or self.verdict.degraded)
-                    if self.verdict is not None
-                    else None
-                ),
+            # The mirror-release gate wants "was this admit charged
+            # to the host mirror": degraded fills (speculative=False,
+            # degraded=True) charge the persistent mirror's THREAD
+            # counter just like speculative admits do.
+            spec = (
+                (self.verdict.speculative or self.verdict.degraded)
+                if self.verdict is not None
+                else None
             )
+            sink = self._exit_sink
+            if (
+                sink is not None
+                and not self.param_rows
+                and not self.cluster_tokens
+            ):
+                # Windowed entry: the completion batches columnar with
+                # the other window exits (runtime/window.py note_exit).
+                sink(
+                    self.rows, self.resource, rt,
+                    count if count is not None else self.acquire, err,
+                    spec if spec is not None else False,
+                )
+            else:
+                engine.submit_exit(
+                    self.rows,
+                    rt=rt,
+                    count=count if count is not None else self.acquire,
+                    err=err,
+                    resource=self.resource,
+                    param_rows=self.param_rows,
+                    speculative=spec,
+                )
         if self.cluster_tokens:
             from sentinel_tpu.runtime.engine import release_cluster_tokens
 
@@ -397,6 +416,160 @@ def entry_async(
     if e is None:
         assert verdict is not None
         raise _block_error(verdict, resource)
+    return e
+
+
+# ---------------------------------------------------------------------------
+# Batch-window admission (runtime/window.py) — the adapter-edge spine.
+# ---------------------------------------------------------------------------
+
+def _window_join(engine, resource, entry_type, count, origin, args):
+    """Shared head of the windowed entry paths: context bookkeeping,
+    shed-before-assembly, the caller-thread trace stamp, and the window
+    join. Returns ``(req, ctx)``; raises the shed BlockError before the
+    request ever occupies a window slot."""
+    from sentinel_tpu.runtime.window import WindowRequest
+
+    ctx = ContextUtil.get_context()
+    if ctx is None:
+        ctx = ContextUtil.true_enter(C.CONTEXT_DEFAULT_NAME, origin or "")
+    eff_origin = origin if origin is not None else ctx.origin
+    context_name = ctx.name if not ctx.is_null else C.CONTEXT_DEFAULT_NAME
+    if engine.ingest.armed:
+        # Shed BEFORE window assembly: a shed request never occupies a
+        # window slot, and queued window contents already count toward
+        # the bulk bound (IngestValve.check_bulk).
+        cause = engine.ingest.check_bulk(1)
+        if cause is not None:
+            op = engine._shed_entry(
+                resource, context_name, eff_origin, count, cause
+            )
+            if ctx.auto and not ctx.entry_stack:
+                ContextUtil.exit()
+            raise _block_error(op.verdict, resource)
+    tracer = engine.admission_trace
+    # The trace tag is stamped HERE, on the request thread/task, where
+    # the inbound traceparent is ambient — the window flusher thread
+    # has no request identity.
+    tag = tracer.make_tag() if tracer.enabled else None
+    req = WindowRequest(
+        resource, context_name, eff_origin, count, entry_type,
+        tuple(args), engine.clock.now_ms(), tag,
+    )
+    return req, ctx
+
+
+def _window_entry_tail(
+    engine, req, ctx, resource, count, with_context: bool
+) -> Entry:
+    """Shared tail: the fanned-out verdict becomes an Entry or a
+    BlockError, with the exact context-stack bookkeeping of
+    :func:`_do_entry`. The rate-limiter wait (``verdict.wait_ms``) is
+    the CALLER's to pay — the async path awaits it before calling here.
+    """
+    if req.error is not None:
+        raise req.error
+    v = req.verdict
+    assert v is not None
+    if req.pass_through:
+        e = Entry(resource, (-1, -1, -1, -1), ctx if with_context else None,
+                  req.ts, count, pass_through=True)
+        if with_context:
+            ctx.entry_stack.append(e)
+        elif ctx.auto and not ctx.entry_stack:
+            ContextUtil.exit()
+        return e
+    if not v.admitted:
+        if ctx.auto and not ctx.entry_stack:
+            ContextUtil.exit()
+        raise _block_error(v, resource)
+    e = Entry(
+        resource, req.rows, ctx if with_context else None, req.ts, count,
+        param_rows=req.param_rows, cluster_tokens=req.cluster_tokens,
+        verdict=v,
+    )
+    if req.bulk_exit:
+        e._exit_sink = engine.ingest_window.note_exit
+    if with_context:
+        ctx.entry_stack.append(e)
+    elif ctx.auto and not ctx.entry_stack:
+        ContextUtil.exit()
+    return e
+
+
+def entry_windowed(
+    resource: str,
+    entry_type: C.EntryType = C.EntryType.OUT,
+    count: int = 1,
+    origin: Optional[str] = None,
+    args: Sequence[object] = (),
+    detached: bool = False,
+) -> Entry:
+    """:func:`entry` (or, ``detached=True``, :func:`entry_async`) that
+    rides the adapter-edge batch window when armed
+    (``sentinel.tpu.ingest.batch.window.ms`` > 0): the admission
+    coalesces with concurrent requests into one columnar
+    ``submit_bulk`` flush and the per-request verdict fans back out —
+    same Entry/BlockError surface, bit-identical verdicts. Window off
+    (the default) is exactly the per-request call."""
+    engine = get_engine()
+    w = engine.ingest_window
+    if not w.armed:
+        if detached:
+            return entry_async(resource, entry_type, count, origin, args)
+        return entry(resource, entry_type, count, origin, args=args)
+    req, ctx = _window_join(engine, resource, entry_type, count, origin, args)
+    w.join(req)
+    req.event.wait()
+    e = _window_entry_tail(engine, req, ctx, resource, count,
+                           with_context=not detached)
+    if req.verdict is not None and req.verdict.wait_ms > 0:
+        # Rate-limiter queued pass: the wait surfaces after the batched
+        # decision, exactly like the per-request path.
+        engine.clock.sleep_ms(req.verdict.wait_ms)
+    return e
+
+
+async def entry_windowed_async(
+    resource: str,
+    entry_type: C.EntryType = C.EntryType.OUT,
+    count: int = 1,
+    origin: Optional[str] = None,
+    args: Sequence[object] = (),
+    detached: bool = True,
+) -> Entry:
+    """The awaitable form of :func:`entry_windowed` for async adapters:
+    the event loop stays free while the window assembles and flushes
+    (the fan-out wakes the task via its loop). Window off falls back to
+    the blocking per-request call — today's async-adapter behavior."""
+    import asyncio
+
+    engine = get_engine()
+    w = engine.ingest_window
+    if not w.armed:
+        if detached:
+            return entry_async(resource, entry_type, count, origin, args)
+        return entry(resource, entry_type, count, origin, args=args)
+    req, ctx = _window_join(engine, resource, entry_type, count, origin, args)
+    w.join(req, loop=asyncio.get_running_loop())
+    try:
+        await req.future
+    except asyncio.CancelledError:
+        # Client disconnect / task cancellation while the window was
+        # deciding: if the slot ends up (or already is) admitted, the
+        # window auto-exits it — otherwise the concurrency gauge would
+        # leak one unit per disconnect (the pre-window sync path had
+        # no suspension point, so this hazard is window-specific).
+        req.abandoned = True
+        if req.verdict is not None:
+            w.release_abandoned(req)
+        if ctx.auto and not ctx.entry_stack:
+            ContextUtil.exit()
+        raise
+    e = _window_entry_tail(engine, req, ctx, resource, count,
+                           with_context=not detached)
+    if req.verdict is not None and req.verdict.wait_ms > 0:
+        await asyncio.sleep(req.verdict.wait_ms / 1e3)
     return e
 
 

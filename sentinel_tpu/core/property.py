@@ -77,6 +77,14 @@ class DynamicSentinelProperty(SentinelProperty[T]):
                 listener.config_update(value)
             return True
 
+    def reset_value(self) -> None:
+        """Forget the cached value WITHOUT notifying listeners: after
+        an imperative clear (api.reset), a datasource re-push of the
+        previously loaded config must fire again instead of being
+        silently deduped as equal."""
+        with self._lock:
+            self._value = None
+
 
 class NoOpSentinelProperty(SentinelProperty[T]):
     """Reference: NoOpSentinelProperty.java."""

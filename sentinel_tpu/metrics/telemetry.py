@@ -261,6 +261,10 @@ class TelemetryBus:
             "spec_system_blocks": 0,
             # Ingest valve (runtime/ingest.py): ops shed at submit.
             "ingest_shed": 0,
+            # Adapter-edge batch window (runtime/window.py): requests
+            # coalesced into columnar windows, and windows flushed.
+            "ingest_window_reqs": 0,
+            "ingest_window_flushes": 0,
             # Statistics sketch tier (runtime/sketch.py): distinct keys
             # folded per chunk, heavy-hitter promotions/demotions, and
             # DEGRADED host-mirror folds.
@@ -452,6 +456,13 @@ class TelemetryBus:
     def note_ingest_shed(self, n: int = 1) -> None:
         with self._lock:
             self.counters["ingest_shed"] += n
+
+    def note_window(self, reqs: int) -> None:
+        """One adapter-edge batch window flushed with ``reqs`` coalesced
+        requests (runtime/window.py)."""
+        with self._lock:
+            self.counters["ingest_window_reqs"] += reqs
+            self.counters["ingest_window_flushes"] += 1
 
     # ------------------------------------------------------------------
     # statistics sketch tier (runtime/sketch.py)

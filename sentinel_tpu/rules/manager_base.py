@@ -59,7 +59,26 @@ class RuleManager(Generic[R]):
             return bool(self._rules)
 
     def clear(self) -> None:
-        self.load_rules([])
+        """Imperative reset (api.reset / tests) — deliberately NOT a
+        ``load_rules([])``: the property dedups equal values, so a
+        clear while the stored list is already empty would never fire
+        ``_apply`` — yet _apply must still run, because it also pushes
+        manager-held derived state (e.g. the gateway-converted param
+        rules) into the CURRENT engine, which api.reset has just
+        replaced with a fresh one. The property's cached value resets
+        too, so a later datasource re-push of the same config is not
+        silently deduped either."""
+        reset = getattr(self._property, "reset_value", None)
+        if reset is not None:
+            reset()
+            self._on_update([])
+        elif not self._property.update_value(None):
+            # Custom property without reset_value: update_value(None)
+            # clears the cache AND fires _on_update through the
+            # listener; when the cache was already None (deduped), the
+            # apply still must run — it re-pushes manager-held derived
+            # state into the current engine.
+            self._on_update([])
 
     def re_apply(self, engine) -> None:
         """Push the stored rules into the given engine if they haven't

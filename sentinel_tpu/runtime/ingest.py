@@ -129,11 +129,16 @@ class IngestValve:
         return None
 
     def check_bulk(self, rows: int) -> Optional[str]:
-        """Shed cause for one incoming bulk group of ``rows`` rows."""
+        """Shed cause for one incoming bulk group of ``rows`` rows.
+        Requests queued in the adapter-edge batch window (runtime/
+        window.py) count toward the bound: they are bulk rows the
+        engine has committed to but not yet submitted, so ignoring
+        them would let the window defeat the cap."""
         eng = self._engine
         if (
             self.max_pending_bulk
-            and eng._bulk_pending_n + rows > self.max_pending_bulk
+            and eng._bulk_pending_n + eng.ingest_window.pending_n + rows
+            > self.max_pending_bulk
         ):
             self._note_shed(0, rows, "queue")
             return "queue"

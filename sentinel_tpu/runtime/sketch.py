@@ -55,6 +55,7 @@ Config (all under ``sentinel.tpu.sketch.*``; see utils/config.py):
 
 from __future__ import annotations
 
+import sys
 import threading
 import zlib
 from collections import OrderedDict
@@ -117,6 +118,45 @@ def key_id(key: str) -> int:
     """Stable 31-bit id of a key string (the host's hash; feeding the
     sketch needs no dict at all)."""
     return zlib.crc32(key.encode("utf-8", "surrogatepass")) & 0x7FFFFFFF
+
+
+def _build_crc_table() -> np.ndarray:
+    t = np.arange(256, dtype=np.uint32)
+    for _ in range(8):
+        t = np.where(t & 1, np.uint32(0xEDB88320) ^ (t >> 1), t >> 1)
+    return t
+
+
+_CRC_TABLE = _build_crc_table()
+
+
+def crc32_batch(chunks: Sequence[bytes], init: int = 0) -> np.ndarray:
+    """Vectorized ``zlib.crc32`` over many byte strings: the ragged
+    batch is packed into a padded byte matrix and the table-driven CRC
+    runs one numpy pass per byte COLUMN (max key length passes total)
+    instead of one Python call per string. ``init`` is a running
+    zlib.crc32 value — the precomputed state of a shared key PREFIX, so
+    per-key work covers only the key's tail. Bit-identical to
+    ``[zlib.crc32(c, init) for c in chunks]`` (differential-tested)."""
+    n = len(chunks)
+    state = np.full(n, (init ^ 0xFFFFFFFF) & 0xFFFFFFFF, dtype=np.uint32)
+    if n == 0:
+        return state
+    lens = np.fromiter(map(len, chunks), dtype=np.int64, count=n)
+    maxlen = int(lens.max())
+    if maxlen:
+        buf = np.frombuffer(b"".join(chunks), dtype=np.uint8)
+        mat = np.zeros((n, maxlen), dtype=np.uint8)
+        starts = np.cumsum(lens) - lens
+        rows = np.repeat(np.arange(n), lens)
+        mat[rows, np.arange(len(buf)) - np.repeat(starts, lens)] = buf
+        tbl = _CRC_TABLE
+        for j in range(maxlen):
+            nxt = tbl[(state ^ mat[:, j]) & np.uint32(0xFF)] ^ (
+                state >> np.uint32(8)
+            )
+            state = np.where(lens > j, nxt, state)
+    return state ^ np.uint32(0xFFFFFFFF)
 
 
 def _hash_np(ids: np.ndarray, d: int, width: int) -> np.ndarray:
@@ -270,6 +310,14 @@ class SketchTier:
         # ever loses the ABILITY to decode a candidate, never device
         # state — an undecodable candidate is skipped until re-seen).
         self._names: "OrderedDict[int, str]" = OrderedDict()
+        # Bounded id-memo for the columnar key path: interned key
+        # PREFIX (kind byte + resource + separator) -> (prefix CRC
+        # state, {tail -> id}). A repeated key costs one dict read; a
+        # fresh batch of misses costs one vectorized crc32_batch pass
+        # over the TAILS only. Cleared whole on overflow — it is a pure
+        # cache over the stable CRC ids.
+        self._id_memo: Dict[str, Tuple[int, Dict[str, int]]] = {}
+        self._id_memo_n = 0
         # Exact host counters for the current candidate ids (bounded
         # by the candidate count): the estimated-vs-exact error gauge.
         # id -> [count, tracking_since_window].
@@ -353,34 +401,73 @@ class SketchTier:
             return True
 
     # ------------------------------------------------------------------
-    # key-stream encode
+    # key-stream encode (the columnar host key path: PR-9's named
+    # follow-up — one numpy pass per batch, not a Python loop per key)
     # ------------------------------------------------------------------
-    def _collect(self, entries, bulk, findex, pindex) -> Dict[int, int]:
-        """Aggregate one chunk's key stream into {id: weight}; updates
-        the id->name LRU and the exact mirror as a side effect."""
+    def _ids_for_locked(self, prefix: str, tails: List[str]) -> np.ndarray:
+        """31-bit key ids of ``prefix + tail`` for each tail. Memo hits
+        are one dict read; misses run ONE vectorized CRC pass over the
+        miss tails, seeded with the prefix's precomputed CRC state (the
+        prefix bytes are never re-hashed, the full key string is never
+        built). Caller holds ``self._lock``."""
+        ent = self._id_memo.get(prefix)
+        if ent is None:
+            ent = self._id_memo[sys.intern(prefix)] = (
+                zlib.crc32(prefix.encode("utf-8", "surrogatepass")), {}
+            )
+        pc, memo = ent
+        out = np.empty(len(tails), dtype=np.int64)
+        miss_j: List[int] = []
+        miss_t: List[str] = []
+        for j, t in enumerate(tails):
+            i = memo.get(t)
+            if i is None:
+                miss_j.append(j)
+                miss_t.append(t)
+            else:
+                out[j] = i
+        if miss_t:
+            ids = (
+                crc32_batch(
+                    [t.encode("utf-8", "surrogatepass") for t in miss_t],
+                    init=pc,
+                )
+                & np.uint32(0x7FFFFFFF)
+            ).astype(np.int64)
+            out[miss_j] = ids
+            for t, i in zip(miss_t, ids.tolist()):
+                memo[t] = i
+            self._id_memo_n += len(miss_t)
+            if self._id_memo_n > self.names_cap:
+                # Pure cache over stable CRC ids: dropping it whole is
+                # correct and keeps the bound one int comparison.
+                self._id_memo = {}
+                self._id_memo_n = 0
+        return out
+
+    def _collect(
+        self, entries, bulk, findex, pindex
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One chunk's key stream, aggregated into parallel sorted
+        ``(ids, weights)`` int64 columns; updates the id->name LRU and
+        the exact mirror as a side effect. Bulk args columns are
+        reduced with np.unique/bincount and hashed via the memoized
+        columnar CRC — per-key Python survives only on the (small)
+        singles path and for collection-valued args."""
         from sentinel_tpu.rules.param_table import ParamIndex
 
-        agg: Dict[int, int] = {}
+        # prefix -> (tails, weights): the per-chunk key stream grouped
+        # by shared prefix so each group hashes in one columnar pass.
+        groups: Dict[str, Tuple[List[str], List[int]]] = {}
+
+        def grp(prefix: str) -> Tuple[List[str], List[int]]:
+            g = groups.get(prefix)
+            if g is None:
+                g = groups[prefix] = ([], [])
+            return g
+
         with self._lock:
             pend, self._pending_unrouted = self._pending_unrouted, []
-            names = self._names
-            exact = self._exact
-
-            def note(key: str, w: int) -> None:
-                if w <= 0:
-                    return
-                i = key_id(key)
-                agg[i] = agg.get(i, 0) + w
-                if i in names:
-                    names.move_to_end(i)
-                else:
-                    names[i] = key
-                    while len(names) > self.names_cap:
-                        names.popitem(last=False)
-                ent = exact.get(i)
-                if ent is not None:
-                    ent[0] += w
-
             track_res = self.resource_qps > 0
             res_memo: Dict[str, bool] = {}
 
@@ -399,15 +486,21 @@ class SketchTier:
                     )
                 return hit
 
-            for resource, acq in pend:
-                if track_res:
-                    note(_KIND_RESOURCE + resource, acq)
+            if track_res:
+                rt, rw = grp(_KIND_RESOURCE)
+                for resource, acq in pend:
+                    if acq > 0:
+                        rt.append(resource)
+                        rw.append(acq)
             sk_idx = getattr(pindex, "sketch_idx_by_resource", None) or {}
             for op in entries:
-                if track_res and tracked(op.resource):
-                    note(_KIND_RESOURCE + op.resource, op.acquire)
+                if track_res and tracked(op.resource) and op.acquire > 0:
+                    rt, rw = grp(_KIND_RESOURCE)
+                    rt.append(op.resource)
+                    rw.append(op.acquire)
                 idxs = sk_idx.get(op.resource)
                 if idxs and op.args:
+                    vt, vw = grp(_KIND_VALUE + op.resource + _SEP)
                     for pi in idxs:
                         if pi >= len(op.args):
                             continue
@@ -419,19 +512,56 @@ class SketchTier:
                         )
                         for vv in vals:
                             k = ParamIndex._value_key(vv)
-                            if k is not None:
-                                note(
-                                    _KIND_VALUE + op.resource + _SEP + k,
-                                    op.acquire,
-                                )
+                            if k is not None and op.acquire > 0:
+                                vt.append(k)
+                                vw.append(op.acquire)
             for g in bulk:
                 if track_res and tracked(g.resource):
-                    note(_KIND_RESOURCE + g.resource, int(g.acquire.sum()))
+                    acq = int(g.acquire.sum())
+                    if acq > 0:
+                        rt, rw = grp(_KIND_RESOURCE)
+                        rt.append(g.resource)
+                        rw.append(acq)
                 idxs = sk_idx.get(g.resource)
                 if idxs and g.args_column is not None:
+                    vt, vw = grp(_KIND_VALUE + g.resource + _SEP)
                     for pi in idxs:
-                        self._note_bulk_column(g, pi, note)
-        return agg
+                        self._bulk_column_keys(g, pi, vt, vw)
+            # -- columnar ids per prefix group, then one aggregation --
+            id_cols: List[np.ndarray] = []
+            w_cols: List[np.ndarray] = []
+            names = self._names
+            for prefix, (tails, weights) in groups.items():
+                if not tails:
+                    continue
+                ids = self._ids_for_locked(prefix, tails)
+                id_cols.append(ids)
+                w_cols.append(np.asarray(weights, dtype=np.int64))
+                for i, t in zip(ids.tolist(), tails):
+                    if i in names:
+                        names.move_to_end(i)
+                    else:
+                        names[i] = prefix + t
+            while len(names) > self.names_cap:
+                names.popitem(last=False)
+            if not id_cols:
+                return (
+                    np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
+                )
+            all_ids = np.concatenate(id_cols)
+            all_w = np.concatenate(w_cols)
+            uids, inv = np.unique(all_ids, return_inverse=True)
+            wsum = np.bincount(inv, weights=all_w).astype(np.int64)
+            keep = wsum > 0
+            uids, wsum = uids[keep], wsum[keep]
+            if self._exact:
+                # Inverted update: O(candidates) searchsorted probes
+                # into the chunk's sorted ids, not a dict op per key.
+                pos = np.searchsorted(uids, list(self._exact))
+                for (i, ent), p in zip(self._exact.items(), pos.tolist()):
+                    if p < len(uids) and uids[p] == i:
+                        ent[0] += int(wsum[p])
+        return uids, wsum
 
     @staticmethod
     def _extract_column(g, pi: int):
@@ -442,57 +572,73 @@ class SketchTier:
             return col.by_idx.get(pi)
         return [_extract_arg(a, pi) for a in col]
 
-    def _note_bulk_column(self, g, pi: int, note) -> None:
+    def _bulk_column_keys(
+        self, g, pi: int, tails: List[str], weights: List[int]
+    ) -> None:
+        """Reduce one bulk args column to (tail, weight) pairs appended
+        to the group's columns: np.unique over the raw values +
+        bincount of acquire — per-row Python only on the fallback
+        (mixed/unorderable types or collection values)."""
         from sentinel_tpu.rules.param_table import ParamIndex
 
         col = self._extract_column(g, pi)
         if col is None:
             return
-        keys: List[str] = []
-        rows: List[int] = []
-        for j, v in enumerate(col):
-            if v is None:
-                continue
+        arr = np.asarray(col, dtype=object)
+        valid = arr != None  # noqa: E711 — elementwise None mask
+        if not valid.any():
+            return
+        try:
+            uniq, inv = np.unique(arr[valid], return_inverse=True)
+        except TypeError:
+            # Mixed/unorderable value types (str vs int, collections):
+            # the original per-row walk, preserved for exactness.
+            for j, v in enumerate(col):
+                if v is None:
+                    continue
+                if isinstance(v, (list, tuple, set, frozenset)):
+                    for vv in v:
+                        k = ParamIndex._value_key(vv)
+                        if k is not None:
+                            tails.append(k)
+                            weights.append(int(g.acquire[j]))
+                    continue
+                k = v if type(v) is str else ParamIndex._value_key(v)
+                if k is not None:
+                    tails.append(k)
+                    weights.append(int(g.acquire[j]))
+            return
+        wsum = np.bincount(inv, weights=g.acquire[valid])
+        for v, wv in zip(uniq.tolist(), wsum.tolist()):
             if isinstance(v, (list, tuple, set, frozenset)):
+                # A uniform column of tuples sorts fine — expand each.
                 for vv in v:
                     k = ParamIndex._value_key(vv)
                     if k is not None:
-                        note(
-                            _KIND_VALUE + g.resource + _SEP + k,
-                            int(g.acquire[j]),
-                        )
+                        tails.append(k)
+                        weights.append(int(wv))
                 continue
             k = v if type(v) is str else ParamIndex._value_key(v)
             if k is not None:
-                keys.append(k)
-                rows.append(j)
-        if not keys:
-            return
-        uniq, inv = np.unique(np.asarray(keys, dtype=object), return_inverse=True)
-        wsum = np.bincount(
-            inv, weights=g.acquire[np.asarray(rows, dtype=np.intp)]
-        )
-        prefix = _KIND_VALUE + g.resource + _SEP
-        for k, wv in zip(uniq.tolist(), wsum.tolist()):
-            note(prefix + k, int(wv))
+                tails.append(k)
+                weights.append(int(wv))
 
     def encode_chunk(
         self, entries, bulk, findex, pindex
     ) -> Tuple[np.ndarray, np.ndarray]:
         """One chunk's aggregated (ids, weights) columns, pow2-padded
         (-1 id = padding) — the :class:`SketchBatch` payload."""
-        agg = self._collect(entries, bulk, findex, pindex)
+        uids, wsum = self._collect(entries, bulk, findex, pindex)
+        n = len(uids)
         tele = self._engine.telemetry
-        if tele.enabled and agg:
-            tele.note_sketch_keys(len(agg))
-        s = _pad_pow2(max(len(agg), 1), 8)
+        if tele.enabled and n:
+            tele.note_sketch_keys(n)
+        s = _pad_pow2(max(n, 1), 8)
         ids = np.full(s, -1, dtype=np.int32)
         w = np.zeros(s, dtype=np.int32)
-        if agg:
-            ids[: len(agg)] = np.fromiter(agg.keys(), dtype=np.int32, count=len(agg))
-            w[: len(agg)] = np.fromiter(
-                agg.values(), dtype=np.int64, count=len(agg)
-            ).clip(0, _I32_MAX)
+        if n:
+            ids[:n] = uids.astype(np.int32)
+            w[:n] = wsum.clip(0, _I32_MAX).astype(np.int32)
         return ids, w
 
     # ------------------------------------------------------------------
@@ -537,10 +683,10 @@ class SketchTier:
         chunk's key stream folds into the host space-saving mirror and
         the controller evaluates from it — graceful degradation, not
         blindness. Decay stays on the same window clock."""
-        agg = self._collect(entries, bulk, findex, pindex)
+        uids, wsum = self._collect(entries, bulk, findex, pindex)
         self.decay_due(now_ms)
         with self._lock:
-            for i, w in agg.items():
+            for i, w in zip(uids.tolist(), wsum.tolist()):
                 key = self._names.get(i)
                 if key is not None:
                     self.host_mirror.offer(key, w)
@@ -548,8 +694,8 @@ class SketchTier:
             self.occupancy = len(by_key) / float(self.candidates)
         tele = self._engine.telemetry
         if tele.enabled:
-            if agg:
-                tele.note_sketch_keys(len(agg))
+            if len(uids):
+                tele.note_sketch_keys(len(uids))
             tele.note_sketch_host_fold()
         self._evaluate(by_key, now_ms)
 
@@ -735,6 +881,8 @@ class SketchTier:
     def reset(self) -> None:
         with self._lock:
             self._names.clear()
+            self._id_memo = {}
+            self._id_memo_n = 0
             self._exact.clear()
             self._pending_unrouted = []
             self._last_wid = None

@@ -205,6 +205,15 @@ class SentinelConfig:
     # default 0 = disarmed (one attribute read per submit).
     INGEST_MAX_PENDING = "sentinel.tpu.ingest.max.pending"
     INGEST_MAX_PENDING_BULK = "sentinel.tpu.ingest.max.pending.bulk"
+    # Adapter-edge batch window (runtime/window.py): concurrent
+    # in-flight requests from the per-request adapters (WSGI/ASGI/
+    # Flask/FastAPI/aiohttp/gRPC/gateway_entry) coalesce for up to
+    # window.ms into ONE columnar submit_bulk ride per resource group,
+    # with per-request verdict fan-out. 0 (the default) = off: every
+    # adapter keeps today's per-request submit+flush behavior.
+    INGEST_BATCH_WINDOW_MS = "sentinel.tpu.ingest.batch.window.ms"
+    # Max requests one window coalesces before it flushes early.
+    INGEST_BATCH_MAX = "sentinel.tpu.ingest.batch.max"
     # Shed when the estimated verdict latency (settle-latency EWMA x
     # (in-flight flushes + 1), the PR-3 flight-recorder signals)
     # exceeds this deadline.
@@ -276,6 +285,8 @@ class SentinelConfig:
         INGEST_MAX_PENDING: "0",
         INGEST_MAX_PENDING_BULK: "0",
         INGEST_DEADLINE_MS: "0",
+        INGEST_BATCH_WINDOW_MS: "0",
+        INGEST_BATCH_MAX: "256",
         RESOURCE_METRICS_ENABLED: "true",
         RESOURCE_METRICS_CAP: "256",
     }

@@ -191,3 +191,117 @@ class TestRlsMalformedRequests:
         raw = encode_rate_limit_request("d", [[("k", "v")]], 1)
         overall, statuses = decode_rate_limit_response(svc.should_rate_limit(raw))
         assert overall in (1, 2) and len(statuses) == 1
+
+
+class TestShouldRateLimitBulk:
+    """The batched endpoint: one RateLimitRequest's descriptors admit
+    as ONE columnar gateway_submit_bulk ride (per-descriptor verdicts,
+    engine-metered)."""
+
+    def test_mixed_pass_block_batch(self, rls_rules, manual_clock, engine):
+        from sentinel_tpu.cluster.envoy_rls import EnvoyRlsService
+
+        manual_clock.set_ms(1000)
+        svc = EnvoyRlsService()
+        descs = (
+            [[("destination", "svcA")]] * 5  # count=3: 3 pass, 2 block
+            + [[("destination", "svcB"), ("method", "POST")]]  # count=1
+            + [[("destination", "nobody")]]  # no rule -> passes
+        )
+        raw = svc.should_rate_limit_bulk(
+            encode_rate_limit_request("mesh", descs)
+        )
+        overall, statuses = decode_rate_limit_response(raw)
+        assert overall == CODE_OVER_LIMIT
+        codes = [s[0] for s in statuses]
+        assert codes[:5] == [CODE_OK] * 3 + [CODE_OVER_LIMIT] * 2
+        assert codes[5] == CODE_OK and codes[6] == CODE_OK
+        # rpu column: the matched descriptor's configured count; None
+        # (absent) for the no-rule descriptor.
+        assert statuses[0][1] == 3 and statuses[5][1] == 1
+        assert statuses[6][1] is None
+        # Instantaneous decisions: the group's gauges drain immediately.
+        engine.flush()
+        engine.drain()
+        assert (
+            engine.cluster_node_stats("rls:mesh")["cur_thread_num"] == 0
+        )
+
+    def test_bulk_independent_of_token_service_book(
+        self, rls_rules, manual_clock, engine
+    ):
+        """The bulk endpoint meters on the engine: a fresh second's
+        budget admits again (per-second gateway param rules)."""
+        from sentinel_tpu.cluster.envoy_rls import EnvoyRlsService
+
+        manual_clock.set_ms(1000)
+        svc = EnvoyRlsService()
+        desc = [[("destination", "svcA")]] * 3
+        raw = svc.should_rate_limit_bulk(encode_rate_limit_request("mesh", desc))
+        overall, _ = decode_rate_limit_response(raw)
+        assert overall == CODE_OK
+        manual_clock.set_ms(3000)  # next window: budget refreshed
+        raw = svc.should_rate_limit_bulk(encode_rate_limit_request("mesh", desc))
+        overall, _ = decode_rate_limit_response(raw)
+        assert overall == CODE_OK
+        engine.flush()
+        engine.drain()
+
+    def test_empty_and_malformed(self, rls_rules, manual_clock, engine):
+        from sentinel_tpu.cluster.envoy_rls import EnvoyRlsService
+
+        svc = EnvoyRlsService()
+        overall, statuses = decode_rate_limit_response(
+            svc.should_rate_limit_bulk(encode_rate_limit_request("mesh", []))
+        )
+        assert overall == CODE_OK and statuses == []
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            svc.should_rate_limit_bulk(b"\x80\x80")
+
+    def test_grpc_method_registered(self, rls_rules, manual_clock, engine):
+        import grpc
+
+        manual_clock.set_ms(1000)
+        server = SentinelRlsGrpcServer(
+            port=0, token_service=DefaultTokenService(clock=ManualClock(0))
+        ).start()
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{server.port}") as ch:
+                method = ch.unary_unary(
+                    "/envoy.service.ratelimit.v2.RateLimitService/"
+                    "ShouldRateLimitBulk",
+                    request_serializer=None,
+                    response_deserializer=None,
+                )
+                raw = method(
+                    encode_rate_limit_request(
+                        "mesh", [[("destination", "svcA")]] * 4
+                    )
+                )
+                overall, statuses = decode_rate_limit_response(raw)
+                assert overall == CODE_OVER_LIMIT
+                assert [s[0] for s in statuses] == [CODE_OK] * 3 + [
+                    CODE_OVER_LIMIT
+                ]
+        finally:
+            server.stop()
+
+    def test_unknown_domain_never_touches_the_engine(
+        self, rls_rules, manual_clock, engine
+    ):
+        """Arbitrary wire-supplied domains must not allocate engine
+        resources — every descriptor passes statelessly."""
+        from sentinel_tpu.cluster.envoy_rls import EnvoyRlsService
+
+        manual_clock.set_ms(1000)
+        svc = EnvoyRlsService()
+        for i in range(8):
+            raw = svc.should_rate_limit_bulk(
+                encode_rate_limit_request(f"attacker-{i}", [[("k", "v")]])
+            )
+            overall, statuses = decode_rate_limit_response(raw)
+            assert overall == CODE_OK and statuses == [(CODE_OK, None, 0)]
+        resources = {r for r, _ in engine.nodes.resources()}
+        assert not any(r.startswith("rls:attacker") for r in resources)

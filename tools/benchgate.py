@@ -80,6 +80,36 @@ STAGE_METRICS: Dict[str, Tuple[str, float]] = {
     # Storm latency includes real decay-window waits, so box noise is
     # a smaller share — but keep the same latency-class band.
     "sketch_promote_storm_ms": ("lower", 2.00),
+    # Adapter batch-window matrix (bench `adapters` stage). The spine
+    # ratio is a RATIO of two same-run numbers, so box noise largely
+    # cancels — it gets a tighter band than raw throughputs.
+    "adapters_gateway_bulk_ops_per_sec": ("higher", 0.60),
+    "adapters_spine_on_ops_per_sec": ("higher", 0.60),
+    "adapters_spine_vs_bulk": ("higher", 0.30),
+    "adapters_wsgi_on_ops_per_sec": ("higher", 0.60),
+    "adapters_wsgi_off_ops_per_sec": ("higher", 0.60),
+    "adapters_wsgi_on_p50_us": ("lower", 2.00),
+    "adapters_wsgi_on_p99_us": ("lower", 5.00),
+    "adapters_asgi_on_ops_per_sec": ("higher", 0.60),
+    "adapters_asgi_off_ops_per_sec": ("higher", 0.60),
+    "adapters_asgi_on_p50_us": ("lower", 2.00),
+    "adapters_asgi_on_p99_us": ("lower", 5.00),
+    "adapters_aiohttp_on_ops_per_sec": ("higher", 0.60),
+    "adapters_aiohttp_off_ops_per_sec": ("higher", 0.60),
+    "adapters_aiohttp_on_p50_us": ("lower", 2.00),
+    "adapters_aiohttp_on_p99_us": ("lower", 5.00),
+    "adapters_grpc_on_ops_per_sec": ("higher", 0.60),
+    "adapters_grpc_off_ops_per_sec": ("higher", 0.60),
+    "adapters_grpc_on_p50_us": ("lower", 2.00),
+    "adapters_grpc_on_p99_us": ("lower", 5.00),
+    "adapters_flask_on_ops_per_sec": ("higher", 0.60),
+    "adapters_flask_off_ops_per_sec": ("higher", 0.60),
+    "adapters_flask_on_p50_us": ("lower", 2.00),
+    "adapters_flask_on_p99_us": ("lower", 5.00),
+    "adapters_fastapi_on_ops_per_sec": ("higher", 0.60),
+    "adapters_fastapi_off_ops_per_sec": ("higher", 0.60),
+    "adapters_fastapi_on_p50_us": ("lower", 2.00),
+    "adapters_fastapi_on_p99_us": ("lower", 5.00),
 }
 
 # Stage-context keys: a group's metrics are comparable only when every
@@ -99,6 +129,10 @@ STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
     (("sketch_n_ops",),
      ("sketch_ops_per_sec_on", "sketch_ops_per_sec_off",
       "sketch_promote_storm_ms")),
+    (("adapters_n_ops",),
+     tuple(
+         m for m in STAGE_METRICS if m.startswith("adapters_")
+     )),
 ]
 
 
