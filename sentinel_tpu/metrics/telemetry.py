@@ -96,6 +96,13 @@ class FlushSpan:
     # Failover quarantined this flush: its device results were lost and
     # its verdicts came from the host fallback (runtime/failover.py).
     quarantined: bool = False
+    # Autotune param-path cost attribution (runtime/autotune.py): the
+    # shape bucket and path (PATH_CLOSED/PATH_SCAN) the cost memo
+    # picked for this chunk's param batch — None/0 when autotune is off
+    # or the chunk carried no eligible param batch. Internal to the
+    # tuner; deliberately NOT part of as_dict().
+    param_bucket: Optional[tuple] = None
+    param_path: int = 0
 
     @property
     def rows(self) -> int:
@@ -272,6 +279,14 @@ class TelemetryBus:
             "sketch_promotions": 0,
             "sketch_demotions": 0,
             "sketch_host_folds": 0,
+            # Param admission path selection (Engine._encode_param):
+            # batches routed to the closed-form rank path vs the
+            # rounds/scan family — one count per encoded param batch.
+            "param_closed_form": 0,
+            "param_scan": 0,
+            # Self-tuning control plane (runtime/autotune.py): applied
+            # knob changes (depth / window retunes).
+            "autotune_decisions": 0,
         }
         # Bounded ring of health transitions (now_ms is engine-clock
         # relative ms): the flight-recorder view of the failover state
@@ -483,6 +498,18 @@ class TelemetryBus:
         with self._lock:
             self.counters["sketch_host_folds"] += n
 
+    def note_param_path(self, closed: bool) -> None:
+        """One encoded param batch routed to the closed-form rank path
+        (``closed``) or the rounds/scan family."""
+        with self._lock:
+            self.counters[
+                "param_closed_form" if closed else "param_scan"
+            ] += 1
+
+    def note_autotune_decision(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["autotune_decisions"] += n
+
     def fold_blocked_topk(self, pairs: Sequence[Tuple[str, int]]) -> None:
         """Fold one flush's device top-K (already name-resolved) into
         the running space-saving summary."""
@@ -558,6 +585,9 @@ class TelemetryBus:
             tier = getattr(engine, "sketch", None)
             if tier is not None and tier.armed:
                 out["sketch_tier"] = tier.snapshot()
+            at = getattr(engine, "autotune", None)
+            if at is not None and at.enabled:
+                out["autotune"] = at.snapshot()
         return out
 
     def bench_summary(self) -> dict:

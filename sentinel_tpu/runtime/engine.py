@@ -785,6 +785,16 @@ class Engine:
         from sentinel_tpu.runtime.sketch import SketchTier
 
         self.sketch = SketchTier(self)
+        # Self-tuning control plane (runtime/autotune.py): closes the
+        # telemetry loop on pipeline depth, the batch window, and the
+        # closed-form-vs-scan param path. Disabled by default — one
+        # attribute read per drain tick and per param-path pick;
+        # enabled decisions run off the hot path on the drain tick.
+        # Constructed AFTER telemetry/window/valve: it samples all
+        # three.
+        from sentinel_tpu.runtime.autotune import AutoTuner
+
+        self.autotune = AutoTuner(self)
         # True when a close()/stop could not join a worker thread in
         # time — the shutdown LOOKED clean but leaked a live thread.
         self.closed_dirty = False
@@ -2077,6 +2087,22 @@ class Engine:
             prow[:n_items], grade[:n_items], behavior[:n_items],
             ts[:n_items], acquire[:n_items],
         )
+        if n_items:
+            at = self.autotune
+            if rounds <= -1 and at.param_active:
+                # Closed-form-ELIGIBLE batch: the autotuner's shape-
+                # bucketed cost memo arbitrates closed-form vs the
+                # rounds/scan family (eligibility above is correctness;
+                # this is purely a cost call). The scan-side rounds
+                # bound is only computed when the memo actually picks
+                # it.
+                rounds = at.pick_param_rounds(
+                    n_items, -rounds, rounds,
+                    lambda: _rounds_bucket(prow[:n_items]),
+                )
+            tele = self.telemetry
+            if tele.enabled:
+                tele.note_param_path(rounds <= -1)
         # Pool return is deferred to the caller's post-fetch give_all —
         # the ParamBatch may alias these buffers zero-copy.
         if self._arena is not None and staging is not None:
@@ -2273,6 +2299,23 @@ class Engine:
     def pipeline_depth(self, depth: int) -> None:
         self._pipeline_depth = max(0, int(depth))
         self._resize_arena()
+
+    def set_depth(self, depth: int, drain: bool = True) -> None:
+        """Runtime-safe pipeline-depth change (what the autotuner
+        uses). RAISING the bound is always safe — the setter re-sizes
+        the arena and the next flush simply trims less. LOWERING it
+        with in-flight flushes outstanding must settle the excess
+        FIRST: every dispatched-but-unfetched flush pins arena staging
+        and a FIFO settle slot sized for the OLD bound, so shrinking
+        the bound under them would leave the queue deeper than the
+        depth contract (and the occupancy accounting) promises until
+        some later flush happens to trim it. ``drain=True`` (default)
+        drains the queue down to the new bound before the shrink; the
+        bare property setter remains the raise/startup path."""
+        depth = max(0, int(depth))
+        if drain and depth < self._pipeline_depth:
+            self._drain_pending(keep=depth)
+        self.pipeline_depth = depth
 
     @property
     def max_inflight(self) -> int:
@@ -2673,6 +2716,14 @@ class Engine:
                     first_err = exc
         if first_err is not None:
             raise first_err
+        # Self-tuning control plane (runtime/autotune.py): the decision
+        # tick rides the drain path — once per settled queue, off the
+        # submit hot path, rate-limited inside maybe_tick. getattr: the
+        # constructor itself never drains, but belt over suspenders for
+        # subclasses that might.
+        at = getattr(self, "autotune", None)
+        if at is not None and at.enabled:
+            at.maybe_tick(self.clock.now_ms())
 
     def _flush_locked(
         self,
@@ -3123,6 +3174,13 @@ class Engine:
         sysdev = self._system_device()
         shaping, sh_rounds = self._encode_shaping(entries, bulk, k, findex)
         param, p_rounds = self._encode_param(entries, exits, pindex, bulk, staging)
+        # Param-path cost attribution: consume the pick _encode_param
+        # made for THIS chunk immediately (flushes serialize on the
+        # flush lock) — consuming here, before any fault-path early
+        # return, means a pick can never leak onto a later chunk's
+        # span. It lands on the span below once telemetry creates it.
+        at = self.autotune
+        param_pick = at.take_pending_pick() if at.enabled else None
         # Statistics sketch tier (runtime/sketch.py): aggregate this
         # chunk's key-id stream and schedule the once-per-window decay
         # — the fold itself runs inside the kernel, chained on the
@@ -3258,6 +3316,11 @@ class Engine:
             span.intern_hits = max(0, ph - h0)
             span.intern_misses = max(0, pm - m0)
             self._tele_intern_seen = (weakref.ref(pindex), ph, pm)
+
+        if span is not None and param_pick is not None:
+            # The autotuner folds the settled span's dispatch+settle
+            # cost into its memo at the next tick.
+            span.param_bucket, span.param_path = param_pick
 
         # Opt-in breaker state-change observers: capture THIS chunk's
         # post-flush state (tagged with epoch+seq — dispatches are

@@ -151,6 +151,12 @@ class BatchWindow:
         # bounded by sentinel.tpu.ingest.max.pending.bulk.
         self.pending_n = 0
         self.counters: Dict[str, int] = {"reqs": 0, "flushes": 0}
+        # Dispatch->fan-out latency EWMA (ms): the extra wait a request
+        # pays beyond the assembly window itself — the latency-pressure
+        # signal the autotuner's window controller reads
+        # (runtime/autotune.py). Single writer (the flusher thread);
+        # float reads are atomic under the GIL.
+        self.fanout_ms = 0.0
 
     # ------------------------------------------------------------------
     # join (request threads / tasks)
@@ -200,7 +206,7 @@ class BatchWindow:
         # dispatched, so the device works on N while the host encodes
         # N+1 (bounded by the engine's pipeline depth; empty-backlog
         # windows fan out immediately, so idle latency never pays).
-        inflight: List[Tuple[_OpenWindow, list]] = []
+        inflight: List[Tuple[_OpenWindow, list, float]] = []
         while True:
             stop = False
             with self._cond:
@@ -232,7 +238,8 @@ class BatchWindow:
                         self._cond.wait()
                 backlog = bool(self._ready)
             if w is not None:
-                inflight.append((w, self._dispatch_window(w)))
+                t0 = time.monotonic()
+                inflight.append((w, self._dispatch_window(w), t0))
             else:
                 self._drain_exits_guarded()
             max_defer = (
@@ -240,8 +247,13 @@ class BatchWindow:
                 else 0
             )
             while len(inflight) > max_defer:
-                wf, settled = inflight.pop(0)
+                wf, settled, t0 = inflight.pop(0)
                 self._fan_out_window(wf, settled)
+                ms = (time.monotonic() - t0) * 1e3
+                self.fanout_ms = (
+                    ms if self.fanout_ms == 0.0
+                    else self.fanout_ms + 0.25 * (ms - self.fanout_ms)
+                )
             if stop:
                 return
 
@@ -559,6 +571,26 @@ class BatchWindow:
     # ------------------------------------------------------------------
     # lifecycle / readers
     # ------------------------------------------------------------------
+    def retune(
+        self,
+        window_ms: Optional[float] = None,
+        batch_max: Optional[int] = None,
+    ) -> None:
+        """Runtime window-geometry change (the autotuner's apply hook).
+        ``window_ms`` takes effect from the NEXT window — the currently
+        assembling window keeps the deadline it promised its joined
+        requests. ``batch_max`` applies immediately (join() reads it
+        live for the early-flush check); a raise lets the assembling
+        window keep filling, a cut flushes it at the next join — both
+        bounded by the unchanged deadline either way. A zero/negative
+        ``window_ms`` is refused: arming/disarming the window is a
+        config decision, not a tuning one."""
+        with self._cond:
+            if window_ms is not None and window_ms > 0.0:
+                self.window_ms = float(window_ms)
+            if batch_max is not None and batch_max >= 1:
+                self.batch_max = int(batch_max)
+
     def close(self, join_timeout_s: float = 5.0) -> None:
         """Flush anything assembling and stop the flusher. Waiters of
         the final window are served, not stranded."""
@@ -584,6 +616,7 @@ class BatchWindow:
             "pending": self.pending_n,
             "reqs": self.counters["reqs"],
             "flushes": self.counters["flushes"],
+            "fanout_ms": round(self.fanout_ms, 3),
         }
 
 

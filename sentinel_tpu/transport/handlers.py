@@ -481,6 +481,23 @@ def sketch_handler(req: CommandRequest) -> CommandResponse:
 
 
 @command_mapping(
+    "autotune",
+    "self-tuning control plane: chosen depth/window, decision log,"
+    " param-path cost memo",
+)
+def autotune_handler(req: CommandRequest) -> CommandResponse:
+    """The closed-loop tuning view (runtime/autotune.py): what the
+    controller currently holds the pipeline depth and batch window at,
+    the bounded decision log (knob, from->to, reason — the convergence
+    trajectory), and the shape-bucketed closed-form-vs-scan cost memo
+    with per-path sample counts and cost EWMAs."""
+    engine = _engine()
+    out = engine.autotune.snapshot()
+    out["flush_seq"] = engine.flush_seq
+    return CommandResponse.of_json(out)
+
+
+@command_mapping(
     "traces",
     "sampled admission trace records: [?n=N][&resource=][&reason=code|name]",
 )

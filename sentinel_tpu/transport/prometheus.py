@@ -426,6 +426,53 @@ def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
             "Mean relative overestimate of candidate counts vs exact host counters",
             round(tier.est_error_ratio, 6),
         )
+    # Param admission path selection (Engine._encode_param): batches
+    # routed to the closed-form rank path vs the rounds/scan family —
+    # the pick the self-tuning cost memo arbitrates when enabled.
+    out += ctr(
+        f"{p}_param_closed_form_total",
+        "Param batches routed to the closed-form rank path",
+        c.get("param_closed_form", 0),
+    )
+    out += ctr(
+        f"{p}_param_scan_total",
+        "Param batches routed to the rounds/scan family",
+        c.get("param_scan", 0),
+    )
+
+    # Self-tuning control plane (runtime/autotune.py): whether the
+    # loop is closed, what it currently holds the knobs at, and how
+    # often it moves them. Rendered even when disabled (zeros/current
+    # static values) so dashboards keep their series.
+    at = getattr(engine, "autotune", None)
+    if at is not None:
+        out += _gauge(
+            f"{p}_autotune_enabled",
+            "Self-tuning control plane armed (sentinel.tpu.autotune.enabled)",
+            1 if at.enabled else 0,
+        )
+        out += ctr(
+            f"{p}_autotune_decisions_total",
+            "Applied autotune knob changes (depth / window retunes)",
+            c.get("autotune_decisions", 0),
+        )
+        out += _gauge(
+            f"{p}_autotune_depth",
+            "Pipeline depth currently in effect (autotune-chosen when armed)",
+            engine.pipeline_depth,
+        )
+        w = getattr(engine, "ingest_window", None)
+        if w is not None:
+            out += _gauge(
+                f"{p}_autotune_window_ms",
+                "Batch-window length currently in effect, ms",
+                round(w.window_ms, 3),
+            )
+            out += _gauge(
+                f"{p}_autotune_window_batch_max",
+                "Batch-window early-flush bound currently in effect",
+                w.batch_max,
+            )
     out += resource_provenance_lines(engine, openmetrics=openmetrics)
     return out
 

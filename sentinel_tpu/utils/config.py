@@ -218,6 +218,48 @@ class SentinelConfig:
     # (in-flight flushes + 1), the PR-3 flight-recorder signals)
     # exceeds this deadline.
     INGEST_DEADLINE_MS = "sentinel.tpu.ingest.deadline.ms"
+    # Self-tuning control plane (runtime/autotune.py): an engine-scoped
+    # controller driven once per drain tick that AIMD-adjusts the flush
+    # pipeline depth, retunes the adapter batch window, and picks the
+    # closed-form vs scan param path from a shape-bucketed cost memo.
+    # Default off = bit-identical static-config behavior (one attribute
+    # read per drain).
+    AUTOTUNE_ENABLED = "sentinel.tpu.autotune.enabled"
+    # Decision cadence (engine clock) and per-knob cooldown after a
+    # change (hysteresis against oscillation).
+    AUTOTUNE_INTERVAL_MS = "sentinel.tpu.autotune.interval.ms"
+    AUTOTUNE_COOLDOWN_MS = "sentinel.tpu.autotune.cooldown.ms"
+    # Upper bound the depth controller may raise
+    # sentinel.tpu.host.pipeline.depth to (never exceeded).
+    AUTOTUNE_DEPTH_MAX = "sentinel.tpu.autotune.depth.max"
+    # Min settled flush spans per tick before any decision is taken —
+    # a thin sample must hold, not steer.
+    AUTOTUNE_MIN_FLUSHES = "sentinel.tpu.autotune.min.flushes"
+    # Occupancy dead band: raise depth only at >= high, lower only at
+    # <= low for idle.ticks consecutive ticks. The gap between the two
+    # is the hysteresis band that prevents K <-> K+1 flapping.
+    AUTOTUNE_OCC_HIGH = "sentinel.tpu.autotune.occupancy.high"
+    AUTOTUNE_OCC_LOW = "sentinel.tpu.autotune.occupancy.low"
+    AUTOTUNE_IDLE_TICKS = "sentinel.tpu.autotune.idle.ticks"
+    # Device-wait fractions (relative to host encode+dispatch work per
+    # tick): raise depth only when unhidden device wait exceeds
+    # raise.frac (there is something to hide); treat device wait beyond
+    # stall.frac as a drain stall and step depth back down.
+    AUTOTUNE_RAISE_FRAC = "sentinel.tpu.autotune.raise.frac"
+    AUTOTUNE_STALL_FRAC = "sentinel.tpu.autotune.stall.frac"
+    # Batch-window bounds the window controller may grow
+    # sentinel.tpu.ingest.batch.{window.ms,max} to.
+    AUTOTUNE_WINDOW_MS_MAX = "sentinel.tpu.autotune.window.ms.max"
+    AUTOTUNE_WINDOW_BATCH_MAX = "sentinel.tpu.autotune.window.batch.max"
+    # Closed-form vs scan param-path cost memo: enabled, exploration
+    # samples per (shape bucket, path) before committing, and the
+    # relative margin a path must win by before the pick switches.
+    AUTOTUNE_PARAM_PATH = "sentinel.tpu.autotune.param.path"
+    AUTOTUNE_PARAM_EXPLORE = "sentinel.tpu.autotune.param.explore"
+    AUTOTUNE_PARAM_MARGIN = "sentinel.tpu.autotune.param.margin"
+    # Bounded decision-log ring (the trajectory the bench stage and the
+    # `autotune` command report).
+    AUTOTUNE_LOG = "sentinel.tpu.autotune.log"
     # Per-resource provenance metric plane (metrics/provenance.py):
     # (second, resource) speculative/degraded/shed/drift ledger drained
     # into MetricNodeLine v2 columns and the bounded
@@ -289,6 +331,22 @@ class SentinelConfig:
         INGEST_BATCH_MAX: "256",
         RESOURCE_METRICS_ENABLED: "true",
         RESOURCE_METRICS_CAP: "256",
+        AUTOTUNE_ENABLED: "false",
+        AUTOTUNE_INTERVAL_MS: "250",
+        AUTOTUNE_COOLDOWN_MS: "1000",
+        AUTOTUNE_DEPTH_MAX: "4",
+        AUTOTUNE_MIN_FLUSHES: "8",
+        AUTOTUNE_OCC_HIGH: "0.85",
+        AUTOTUNE_OCC_LOW: "0.2",
+        AUTOTUNE_IDLE_TICKS: "3",
+        AUTOTUNE_RAISE_FRAC: "0.1",
+        AUTOTUNE_STALL_FRAC: "2.0",
+        AUTOTUNE_WINDOW_MS_MAX: "20",
+        AUTOTUNE_WINDOW_BATCH_MAX: "4096",
+        AUTOTUNE_PARAM_PATH: "true",
+        AUTOTUNE_PARAM_EXPLORE: "3",
+        AUTOTUNE_PARAM_MARGIN: "0.15",
+        AUTOTUNE_LOG: "256",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

@@ -1,0 +1,581 @@
+"""Self-tuning control plane (runtime/autotune.py) — contracts.
+
+* The decision functions are PURE functions of a sampled snapshot:
+  synthetic-snapshot unit tests pin every rule in the ARCHITECTURE
+  signal->decision table (raise/lower/hold, hysteresis, dead bands).
+* Closed-loop convergence is structural: a steady synthetic workload
+  settles MONOTONICALLY to a fixed depth and never oscillates.
+* Runtime depth changes are SAFE: ``Engine.set_depth`` lowering drains
+  the excess in-flight flushes first, and a 2->0->2 mid-stream flip is
+  bit-identical to the depth-0 oracle.
+* Path-selection accounting: every encoded param batch increments
+  exactly one of the ``param_closed_form``/``param_scan`` telemetry
+  counters, and a mixed-ts batch past ``PARAM_CLOSED_MAX_SEGMENTS``
+  routes to scan (the eligibility rule autotune must never override).
+* Autotune OFF (the default) is verdict- and behavior-parity; ON is
+  verdict-parity (it may only move schedule knobs, never verdicts).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import ParamFlowRule
+from sentinel_tpu.runtime.autotune import (
+    PATH_CLOSED,
+    PATH_SCAN,
+    ParamPathMemo,
+    PathStats,
+    TuneLimits,
+    TuneSnapshot,
+    decide_depth,
+    decide_window,
+    pick_path,
+)
+from sentinel_tpu.utils.config import config
+
+
+@pytest.fixture(autouse=True)
+def _config_sandbox():
+    with config._lock:
+        saved = dict(config._runtime)
+    yield
+    with config._lock:
+        config._runtime.clear()
+        config._runtime.update(saved)
+
+
+LIM = TuneLimits()  # the documented defaults
+
+
+def _snap(**kw):
+    base = dict(
+        now_ms=1000, depth=0, flushes=16, mean_inflight=0.0,
+        encode_ms=16.0, dispatch_ms=4.0, settle_ms=0.0, drain_ms=0.0,
+        shed=0,
+    )
+    base.update(kw)
+    return TuneSnapshot(**base)
+
+
+# ----------------------------------------------------------------------
+# pure depth decisions
+# ----------------------------------------------------------------------
+class TestDecideDepth:
+    def test_insufficient_samples_holds(self):
+        d, reason, _ = decide_depth(_snap(flushes=2, settle_ms=100.0), LIM)
+        assert (d, reason) == (0, "insufficient-samples")
+
+    def test_raise_from_zero_on_device_wait(self):
+        # Sync settles (device wait) worth hiding -> 0 -> 1.
+        d, reason, _ = decide_depth(_snap(settle_ms=10.0), LIM)
+        assert (d, reason) == (1, "hide-device-wait")
+
+    def test_no_raise_without_device_wait(self):
+        # Pure host-bound at depth 0: nothing to overlap.
+        d, reason, _ = decide_depth(_snap(settle_ms=0.5), LIM)
+        assert (d, reason) == (0, "steady")
+
+    def test_raise_requires_occupancy_at_depth(self):
+        # Unhidden drain wait but a half-empty pipeline: hold.
+        s = _snap(depth=1, mean_inflight=0.5, drain_ms=10.0)
+        d, reason, _ = decide_depth(s, LIM)
+        assert (d, reason) == (1, "steady")
+        # Occupied pipeline with the same wait: raise.
+        s = _snap(depth=1, mean_inflight=0.95, drain_ms=10.0)
+        d, reason, _ = decide_depth(s, LIM)
+        assert (d, reason) == (2, "hide-device-wait")
+
+    def test_depth_max_bound(self):
+        s = _snap(depth=4, mean_inflight=4.0, drain_ms=10.0)
+        d, reason, _ = decide_depth(s, LIM)
+        assert (d, reason) == (4, "at-max")
+
+    def test_drain_stall_steps_down(self):
+        # Device fell behind by more than stall.frac x host work.
+        s = _snap(depth=3, mean_inflight=3.0, drain_ms=100.0)
+        d, reason, _ = decide_depth(s, LIM)
+        assert (d, reason) == (2, "drain-stall")
+
+    def test_stall_floor_is_depth_one(self):
+        # Device-bound at depth 1: stall lowering never de-pipelines
+        # completely (any overlap still beats none).
+        s = _snap(depth=1, mean_inflight=1.0, drain_ms=100.0)
+        d, reason, _ = decide_depth(s, LIM)
+        assert d == 1
+
+    def test_shed_pressure_halves(self):
+        s = _snap(depth=4, mean_inflight=4.0, shed=5)
+        d, reason, _ = decide_depth(s, LIM)
+        assert (d, reason) == (2, "ingest-pressure")
+
+    def test_underutilized_needs_consecutive_ticks(self):
+        s = _snap(depth=2, mean_inflight=0.1)
+        streak = 0
+        for i in range(LIM.idle_ticks - 1):
+            d, reason, streak = decide_depth(s, LIM, streak)
+            assert (d, reason) == (2, "underutilized-wait")
+        d, reason, streak = decide_depth(s, LIM, streak)
+        assert (d, reason, streak) == (1, "underutilized", 0)
+
+    def test_busy_tick_resets_idle_streak(self):
+        s_idle = _snap(depth=2, mean_inflight=0.1)
+        _, _, streak = decide_depth(s_idle, LIM, 0)
+        assert streak == 1
+        s_busy = _snap(depth=2, mean_inflight=1.9)
+        _, _, streak = decide_depth(s_busy, LIM, streak)
+        assert streak == 0
+
+
+class TestConvergence:
+    """Closed-loop synthetic steady workloads: the depth trajectory is
+    monotone to a fixed point and never leaves it — the 'decision log
+    shows monotone settle' acceptance, deterministically."""
+
+    @staticmethod
+    def _steady(depth, host_ms=1.0, dev_ms=3.0, n=16):
+        """Model: per flush the host does host_ms of encode work and
+        the device dev_ms of compute; a depth-K pipeline hides K x
+        host_ms of it, the rest shows up as drain wait."""
+        unhidden = max(0.0, dev_ms - depth * host_ms)
+        return _snap(
+            depth=depth, flushes=n,
+            encode_ms=host_ms * n * 0.8, dispatch_ms=host_ms * n * 0.2,
+            settle_ms=unhidden * n if depth == 0 else 0.0,
+            drain_ms=unhidden * n if depth > 0 else 0.0,
+            mean_inflight=float(depth),  # steady pipeline runs full
+        )
+
+    @pytest.mark.parametrize("dev_ms,expect", [(3.0, 3), (0.05, 0), (10.0, 1)])
+    def test_monotone_settle_no_oscillation(self, dev_ms, expect):
+        # dev=3x host: settles at 3 (wait fully hidden). dev ~ 0:
+        # stays at 0. dev >> host (device-bound): settles at 1 — the
+        # stall ceiling blocks raises past the first overlap step.
+        d, streak = 0, 0
+        traj = [d]
+        for _ in range(30):
+            nd, _reason, streak = decide_depth(self._steady(d, dev_ms=dev_ms), LIM, streak)
+            traj.append(nd)
+            d = nd
+        assert d == expect, traj
+        # Monotone: never decreases, and once it repeats it stays.
+        assert all(b >= a for a, b in zip(traj, traj[1:])), traj
+        fixed = traj.index(d)
+        assert all(v == d for v in traj[fixed:]), traj
+
+
+# ----------------------------------------------------------------------
+# pure window decisions
+# ----------------------------------------------------------------------
+class TestDecideWindow:
+    @staticmethod
+    def _wsnap(**kw):
+        base = dict(
+            window_armed=True, window_reqs=400, window_flushes=10,
+            window_ms=2.0, window_batch_max=64, window_fanout_ms=1.0,
+        )
+        base.update(kw)
+        return _snap(**base)
+
+    def test_inactive_without_window(self):
+        ms, bm, reason = decide_window(self._wsnap(window_armed=False), LIM)
+        assert reason == "inactive"
+
+    def test_full_windows_grow_batch_max(self):
+        s = self._wsnap(window_reqs=640, window_flushes=10)  # fill 1.0
+        ms, bm, reason = decide_window(s, LIM)
+        assert (ms, bm, reason) == (2.0, 128, "windows-capping")
+
+    def test_batch_max_capped(self):
+        s = self._wsnap(
+            window_reqs=40960, window_flushes=10, window_batch_max=4096
+        )
+        ms, bm, reason = decide_window(s, LIM)
+        assert (bm, reason) == (4096, "steady")
+
+    def test_fanout_pressure_shrinks_window(self):
+        s = self._wsnap(window_reqs=300, window_fanout_ms=20.0)
+        ms, bm, reason = decide_window(s, LIM)
+        assert (ms, reason) == (1.0, "fanout-latency")
+
+    def test_window_floor(self):
+        lim = TuneLimits(window_ms_min=1.5)
+        s = self._wsnap(window_ms=2.0, window_reqs=300, window_fanout_ms=50.0)
+        ms, _bm, _ = decide_window(s, lim)
+        assert ms == 1.5
+
+    def test_sparse_windows_widen(self):
+        s = self._wsnap(window_reqs=100, window_flushes=10,
+                        window_fanout_ms=0.5)  # fill 0.16, fan-out cheap
+        ms, bm, reason = decide_window(s, LIM)
+        assert (ms, reason) == (3.0, "coalesce-more")
+
+    def test_widen_capped_and_dead_band(self):
+        lim = TuneLimits(window_ms_max=2.5)
+        s = self._wsnap(window_reqs=100, window_flushes=10,
+                        window_fanout_ms=0.5)
+        ms, _bm, _ = decide_window(s, lim)
+        assert ms == 2.5
+        # Between the widen bound (fanout <= window) and the shrink
+        # bound (fanout > 4x window): hold.
+        s = self._wsnap(window_reqs=100, window_fanout_ms=5.0)
+        ms, bm, reason = decide_window(s, LIM)
+        assert (ms, bm, reason) == (2.0, 64, "steady")
+
+
+# ----------------------------------------------------------------------
+# param-path cost memo
+# ----------------------------------------------------------------------
+class TestPathMemo:
+    def test_explores_then_commits_to_cheaper(self):
+        memo = ParamPathMemo(explore=2, margin=0.15)
+        b = ParamPathMemo.bucket_of(12, 2)
+        assert b == (16, 2)
+        picks = []
+        for _ in range(4):
+            path, _ = memo.pick(b)
+            picks.append(path)
+            memo.note(b, path, 1.0 if path == PATH_CLOSED else 5.0)
+        assert picks == [PATH_CLOSED, PATH_CLOSED, PATH_SCAN, PATH_SCAN]
+        # Exploration left `current` on the last explored path (scan);
+        # the first cost-based pick switches to the cheaper closed form
+        # and every later pick holds there.
+        path, reason = memo.pick(b)
+        assert (path, reason) == (PATH_CLOSED, "cost-switch")
+        path, reason = memo.pick(b)
+        assert (path, reason) == (PATH_CLOSED, "cost-hold")
+
+    def test_margin_hysteresis_blocks_marginal_flips(self):
+        closed = PathStats(n=5, ewma_ms=1.0)
+        scan = PathStats(n=5, ewma_ms=0.95)  # only 5% better
+        path, reason = pick_path(closed, scan, PATH_CLOSED, 3, 0.15)
+        assert (path, reason) == (PATH_CLOSED, "cost-hold")
+        scan_fast = PathStats(n=5, ewma_ms=0.5)  # 50% better: switch
+        path, reason = pick_path(closed, scan_fast, PATH_CLOSED, 3, 0.15)
+        assert (path, reason) == (PATH_SCAN, "cost-switch")
+        # And the switch is sticky the other way round too.
+        path, reason = pick_path(closed, scan_fast, PATH_SCAN, 3, 0.15)
+        assert (path, reason) == (PATH_SCAN, "cost-hold")
+
+    def test_seed_skips_exploration(self):
+        memo = ParamPathMemo(explore=3, margin=0.15)
+        b = ParamPathMemo.bucket_of(100, 1)
+        memo.seed(b, closed_ms=5.0, scan_ms=1.0)
+        path, reason = memo.pick(b)
+        assert (path, reason) == (PATH_SCAN, "cost-switch")
+
+
+# ----------------------------------------------------------------------
+# runtime depth safety (Engine.set_depth) — satellite 1
+# ----------------------------------------------------------------------
+def _mk_engine(clock, depth=0):
+    from sentinel_tpu.runtime.engine import Engine
+
+    eng = Engine(clock=clock)
+    eng.pipeline_depth = depth
+    return eng
+
+
+def _load_rules(engines):
+    for eng in engines:
+        eng.set_flow_rules(
+            [st.FlowRule("pp", count=6.0), st.FlowRule("qq", count=1e9)]
+        )
+        eng.set_param_rules(
+            {"qq": [ParamFlowRule("qq", param_idx=0, count=3)]}
+        )
+
+
+class TestSetDepthRuntime:
+    def test_flip_2_0_2_matches_depth0_oracle(self, manual_clock):
+        """Mid-stream depth flips 2->0->2: lowering drains the excess
+        in-flight flushes synchronously (the FIFO settle + arena
+        contracts), and the whole stream stays bit-identical to the
+        always-depth-0 oracle."""
+        engines = [_mk_engine(manual_clock, 0), _mk_engine(manual_clock, 2)]
+        _load_rules(engines)
+        rng = np.random.default_rng(12)
+        collected = [[] for _ in engines]
+        t = 1000
+        for r in range(8):
+            manual_clock.set_ms(t)
+            n_pp = 16
+            ts_pp = np.sort(t + rng.integers(0, 40, n_pp).astype(np.int32))
+            acq = rng.integers(1, 3, n_pp).astype(np.int32)
+            n_qq = 12
+            vals = [f"v{int(rng.integers(0, 3))}" for _ in range(n_qq)]
+            ts_qq = np.where(
+                np.arange(n_qq) < rng.integers(1, n_qq),
+                np.int32(t), np.int32(t + 700),
+            ).astype(np.int32)
+            for eng, coll in zip(engines, collected):
+                g1 = eng.submit_bulk("pp", n_pp, ts=ts_pp, acquire=acq)
+                g2 = eng.submit_bulk(
+                    "qq", n_qq, ts=ts_qq, args_column=[(v,) for v in vals]
+                )
+                eng.flush()
+                assert len(eng._pending_fetches) <= eng.pipeline_depth
+                coll.extend([g1, g2])
+            if r == 2:
+                engines[1].set_depth(0)
+                # The shrink drained every in-flight flush BEFORE the
+                # bound moved — nothing outstanding above the new depth.
+                assert len(engines[1]._pending_fetches) == 0
+                assert engines[1].pipeline_depth == 0
+            elif r == 4:
+                engines[1].set_depth(2)
+                assert engines[1].pipeline_depth == 2
+            t += int(rng.integers(100, 900))
+        for eng in engines:
+            eng.drain()
+        for go, gp in zip(collected[0], collected[1]):
+            assert gp.admitted.tolist() == go.admitted.tolist()
+            assert gp.reason.tolist() == go.reason.tolist()
+            assert gp.wait_ms.tolist() == go.wait_ms.tolist()
+        for res in ("pp", "qq"):
+            assert engines[1].cluster_node_stats(res) == engines[
+                0
+            ].cluster_node_stats(res), res
+        for eng in engines:
+            eng.close()
+
+    def test_set_depth_raise_resizes_arena(self, manual_clock):
+        eng = _mk_engine(manual_clock, 0)
+        eng.set_depth(3)
+        assert eng.pipeline_depth == 3
+        assert eng._arena.per_key >= 4  # depth + 1
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# path-selection counters — satellite 2
+# ----------------------------------------------------------------------
+class TestParamPathCounters:
+    def _setup(self, engine):
+        engine.set_flow_rules([st.FlowRule("mx", count=1e9)])
+        engine.set_param_rules(
+            {"mx": [ParamFlowRule("mx", param_idx=0, count=3)]}
+        )
+
+    def test_past_max_segments_routes_to_scan_and_counts(
+        self, manual_clock, engine
+    ):
+        from sentinel_tpu.rules.param_table import PARAM_CLOSED_MAX_SEGMENTS
+
+        self._setup(engine)
+        manual_clock.set_ms(1000)
+        n = 12
+        assert n > PARAM_CLOSED_MAX_SEGMENTS
+        ts = (1000 + np.arange(n) * 100).astype(np.int32)  # 12 distinct ts
+        engine.submit_bulk("mx", n, ts=ts, args_column=[("k",)] * n)
+        c0 = engine.telemetry.counters_snapshot()
+        engine.flush()
+        engine.drain()
+        c1 = engine.telemetry.counters_snapshot()
+        assert c1["param_scan"] == c0["param_scan"] + 1
+        assert c1["param_closed_form"] == c0["param_closed_form"]
+
+    def test_uniform_batch_counts_closed_form(self, manual_clock, engine):
+        self._setup(engine)
+        manual_clock.set_ms(1000)
+        engine.submit_bulk(
+            "mx", 8, ts=np.full(8, 1000, np.int32),
+            args_column=[("k",)] * 8,
+        )
+        c0 = engine.telemetry.counters_snapshot()
+        engine.flush()
+        engine.drain()
+        c1 = engine.telemetry.counters_snapshot()
+        assert c1["param_closed_form"] == c0["param_closed_form"] + 1
+        assert c1["param_scan"] == c0["param_scan"]
+
+
+# ----------------------------------------------------------------------
+# controller integration
+# ----------------------------------------------------------------------
+class TestAutoTunerIntegration:
+    def test_disabled_by_default(self, manual_clock):
+        eng = _mk_engine(manual_clock)
+        assert eng.autotune.enabled is False
+        assert eng.autotune.param_active is False
+        eng.set_flow_rules([st.FlowRule("d", count=10.0)])
+        for _ in range(3):
+            eng.submit_entry("d")
+            eng.flush()
+        eng.drain()
+        snap = eng.autotune.snapshot()
+        assert snap["counters"]["ticks"] == 0
+        assert snap["decisions"] == []
+        assert eng.telemetry.counters_snapshot()["autotune_decisions"] == 0
+        eng.close()
+
+    def test_enabled_is_verdict_parity(self, manual_clock):
+        """Autotune may move schedule knobs (depth, window, path) but
+        NEVER a verdict: the same stream through a tuned engine and a
+        static one is bit-identical."""
+        config.set(config.AUTOTUNE_ENABLED, "false")
+        static = _mk_engine(manual_clock, 0)
+        config.set(config.AUTOTUNE_ENABLED, "true")
+        config.set(config.AUTOTUNE_INTERVAL_MS, "1")
+        config.set(config.AUTOTUNE_COOLDOWN_MS, "1")
+        config.set(config.AUTOTUNE_MIN_FLUSHES, "1")
+        config.set(config.AUTOTUNE_PARAM_EXPLORE, "1")
+        tuned = _mk_engine(manual_clock, 0)
+        assert tuned.autotune.enabled
+        engines = [static, tuned]
+        _load_rules(engines)
+        rng = np.random.default_rng(7)
+        collected = [[] for _ in engines]
+        t = 1000
+        for _ in range(10):
+            manual_clock.set_ms(t)
+            n_qq = 12
+            vals = [f"v{int(rng.integers(0, 3))}" for _ in range(n_qq)]
+            ts_qq = np.where(
+                np.arange(n_qq) < rng.integers(1, n_qq),
+                np.int32(t), np.int32(t + 700),
+            ).astype(np.int32)
+            ts_pp = np.sort(t + rng.integers(0, 40, 16).astype(np.int32))
+            for eng, coll in zip(engines, collected):
+                g1 = eng.submit_bulk("pp", 16, ts=ts_pp)
+                g2 = eng.submit_bulk(
+                    "qq", n_qq, ts=ts_qq, args_column=[(v,) for v in vals]
+                )
+                eng.flush()
+                coll.extend([g1, g2])
+            t += int(rng.integers(100, 900))
+        for eng in engines:
+            eng.drain()
+        assert tuned.autotune.counters["ticks"] > 0
+        for go, gp in zip(collected[0], collected[1]):
+            assert gp.admitted.tolist() == go.admitted.tolist()
+            assert gp.reason.tolist() == go.reason.tolist()
+            assert gp.wait_ms.tolist() == go.wait_ms.tolist()
+        for res in ("pp", "qq"):
+            assert tuned.cluster_node_stats(res) == static.cluster_node_stats(
+                res
+            ), res
+        for eng in engines:
+            eng.close()
+
+    def test_apply_depth_moves_engine_and_logs(self, manual_clock):
+        config.set(config.AUTOTUNE_ENABLED, "true")
+        eng = _mk_engine(manual_clock, 0)
+        at = eng.autotune
+        snap = _snap(now_ms=5000, depth=0, settle_ms=30.0, encode_ms=10.0)
+        at._apply_depth(snap)
+        assert eng.pipeline_depth == 1
+        dec = list(at.decisions)[-1]
+        assert dec["knob"] == "depth" and (dec["from"], dec["to"]) == (0, 1)
+        assert dec["reason"] == "hide-device-wait"
+        assert eng.telemetry.counters_snapshot()["autotune_decisions"] == 1
+        # Cooldown: an immediate second apply holds even though the
+        # snapshot still argues for a raise.
+        at._apply_depth(_snap(now_ms=5001, depth=1, mean_inflight=1.0,
+                              drain_ms=30.0, encode_ms=10.0))
+        assert eng.pipeline_depth == 1
+        # Past the cooldown it moves again (drain wait inside the
+        # stall ceiling, pipeline occupied).
+        at._apply_depth(_snap(now_ms=5000 + at.cooldown_ms, depth=1,
+                              mean_inflight=1.0, drain_ms=15.0,
+                              encode_ms=10.0))
+        assert eng.pipeline_depth == 2
+        eng.close()
+
+    def test_blind_without_telemetry(self, manual_clock):
+        config.set(config.AUTOTUNE_ENABLED, "true")
+        config.set(config.TELEMETRY_ENABLED, "false")
+        eng = _mk_engine(manual_clock)
+        assert eng.autotune.blind is True
+        assert eng.autotune.param_active is False
+        eng.autotune.maybe_tick(10_000)
+        assert eng.autotune.counters["ticks"] == 0
+        assert eng.autotune.snapshot()["blind"] is True
+        eng.close()
+
+    def test_window_retune_applies(self, manual_clock):
+        eng = _mk_engine(manual_clock)
+        w = eng.ingest_window
+        w.retune(window_ms=4.0, batch_max=512)
+        assert (w.window_ms, w.batch_max) == (4.0, 512)
+        w.retune(window_ms=0.0)  # refused: arming is config, not tuning
+        assert w.window_ms == 4.0
+        eng.close()
+
+    def test_autotune_command_and_prometheus(self, manual_clock, engine):
+        from sentinel_tpu.transport import handlers
+        from sentinel_tpu.transport.command_center import CommandRequest
+        from sentinel_tpu.transport.prometheus import render_metrics
+
+        resp = handlers.autotune_handler(
+            CommandRequest(path="autotune", params={}, body="")
+        )
+        assert resp.success
+        d = json.loads(resp.result)
+        assert d["enabled"] is False
+        assert "decisions" in d and "param_memo" in d
+        text = render_metrics(engine)
+        for fam in (
+            "sentinel_engine_autotune_enabled",
+            "sentinel_engine_autotune_decisions_total",
+            "sentinel_engine_autotune_depth",
+            "sentinel_engine_autotune_window_ms",
+            "sentinel_engine_autotune_window_batch_max",
+            "sentinel_engine_param_closed_form_total",
+            "sentinel_engine_param_scan_total",
+        ):
+            assert fam in text, fam
+
+    def test_tick_does_not_reset_pipeline_stats(self, manual_clock):
+        """Regression: the sampler reads pipeline stats via private
+        delta baselines — NOT pipeline_stats(reset=True), which would
+        turn the exported sentinel_engine_pipeline_dispatches_total
+        into a perpetually-resetting counter whenever autotune is on."""
+        config.set(config.AUTOTUNE_ENABLED, "true")
+        config.set(config.AUTOTUNE_INTERVAL_MS, "1")
+        config.set(config.AUTOTUNE_MIN_FLUSHES, "1")
+        config.set(config.AUTOTUNE_DEPTH_MAX, "2")
+        eng = _mk_engine(manual_clock, 2)
+        eng.set_flow_rules([st.FlowRule("ps", count=1e9)])
+        t = 1000
+        for _ in range(6):
+            manual_clock.set_ms(t)
+            eng.submit_bulk("ps", 32, ts=np.full(32, t, np.int32))
+            eng.flush()
+            t += 500
+        eng.drain()
+        assert eng.autotune.counters["ticks"] > 1
+        # The shared accumulator kept every dispatch across all ticks.
+        assert eng.pipeline_stats()["dispatches"] >= 6
+        eng.close()
+
+    def test_enabled_tick_converges_on_live_engine(self, manual_clock):
+        """Live closed loop: a tuned engine driving real flushes takes
+        depth decisions off the drain tick and the decision log is a
+        monotone settle (no knob ever reverses under the steady
+        stream)."""
+        config.set(config.AUTOTUNE_ENABLED, "true")
+        config.set(config.AUTOTUNE_INTERVAL_MS, "1")
+        config.set(config.AUTOTUNE_COOLDOWN_MS, "1")
+        config.set(config.AUTOTUNE_MIN_FLUSHES, "2")
+        config.set(config.AUTOTUNE_DEPTH_MAX, "2")
+        eng = _mk_engine(manual_clock, 0)
+        eng.set_flow_rules([st.FlowRule("cv", count=1e9)])
+        t = 1000
+        for _ in range(30):
+            manual_clock.set_ms(t)
+            eng.submit_bulk("cv", 64, ts=np.full(64, t, np.int32))
+            eng.flush()
+            t += 500
+        eng.drain()
+        depths = [d["to"] for d in eng.autotune.decisions
+                  if d["knob"] == "depth"]
+        assert eng.autotune.counters["ticks"] > 0
+        # Monotone settle: depth never decreases under the steady
+        # stream (raises only, bounded by depth.max).
+        assert all(b >= a for a, b in zip(depths, depths[1:])), depths
+        assert eng.pipeline_depth <= 2
+        eng.close()

@@ -110,6 +110,12 @@ STAGE_METRICS: Dict[str, Tuple[str, float]] = {
     "adapters_fastapi_off_ops_per_sec": ("higher", 0.60),
     "adapters_fastapi_on_p50_us": ("lower", 2.00),
     "adapters_fastapi_on_p99_us": ("lower", 5.00),
+    # Self-tuning stage (bench `autotune`). The vs-static ratio is a
+    # RATIO of two same-run numbers (box noise largely cancels), so it
+    # gets the tighter ratio-class band like adapters_spine_vs_bulk.
+    "autotune_static_best_ops_per_sec": ("higher", 0.60),
+    "autotune_steady_ops_per_sec": ("higher", 0.60),
+    "autotune_vs_static_best": ("higher", 0.30),
 }
 
 # Stage-context keys: a group's metrics are comparable only when every
@@ -133,6 +139,9 @@ STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
      tuple(
          m for m in STAGE_METRICS if m.startswith("adapters_")
      )),
+    (("autotune_n_ops",),
+     ("autotune_static_best_ops_per_sec", "autotune_steady_ops_per_sec",
+      "autotune_vs_static_best")),
 ]
 
 

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# One entry point for the repo's full check matrix — the guard against
+# the three existing audits silently drifting apart (a session that
+# runs tier-1 but forgets the metrics audit, or greens the config audit
+# while the bench gate regresses).
+#
+# Runs, in order, failing fast:
+#   1. tier-1 tests        (pytest -m 'not slow', the ROADMAP verify)
+#   2. config audit        (tools/config_audit.py: key declaration +
+#                           --doc documentation + the metrics audit —
+#                           every Prometheus family / telemetry counter
+#                           documented in docs/ARCHITECTURE.md)
+#   3. bench gate          (bench.py --gate vs the newest committed
+#                           BENCH_*.json for this hardware)
+#
+# Usage:
+#   tools/ci_check.sh                 # everything
+#   CI_CHECK_SKIP_BENCH=1 tools/ci_check.sh   # audits + tests only
+#                                     (the bench takes minutes; the
+#                                     gate still runs in CI / pre-PR)
+#   SENTINEL_BENCH_BUDGET_S=300 tools/ci_check.sh   # shorter bench
+#
+# Exit status: first failing step's status; 0 when everything is green.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ci_check 1/3: tier-1 tests =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+
+echo "== ci_check 2/3: config + doc + metrics audit =="
+JAX_PLATFORMS=cpu python tools/config_audit.py \
+    --root sentinel_tpu --doc docs/ARCHITECTURE.md
+
+if [ "${CI_CHECK_SKIP_BENCH:-0}" = "1" ]; then
+    echo "== ci_check 3/3: bench gate SKIPPED (CI_CHECK_SKIP_BENCH=1) =="
+else
+    echo "== ci_check 3/3: bench gate =="
+    JAX_PLATFORMS=cpu python bench.py --gate >/dev/null
+fi
+
+echo "ci_check: all green"
