@@ -1166,6 +1166,196 @@ def _run_autotune_stage(n_rules: int, n_ops: int, iters: int) -> dict:
     }
 
 
+def _ipc_bench_worker(
+    channel, wid, resources, rows_total, group, go_warm, go_timed, out_q
+):
+    """One bench worker process: attach, signal ready, run one full
+    WARM quota round (interning, frame shapes, the engine-side settle
+    compiles), then the timed round — so the measured span is steady-
+    state transport, not XLA compiles. Top-level so the spawn child can
+    import it by name."""
+    from sentinel_tpu.ipc.worker import IngestClient
+
+    cli = IngestClient(channel, wid)
+
+    def _round() -> int:
+        admitted = 0
+        done = 0
+        i = 0
+        while done < rows_total:
+            n = min(group, rows_total - done)
+            a, _r, _w, _f = cli.bulk(resources[i % len(resources)], n)
+            admitted += int(a.sum())
+            done += n
+            i += 1
+        return admitted
+
+    try:
+        out_q.put(("ready", wid, 0))
+        go_warm.wait(timeout=120)
+        _round()
+        out_q.put(("warm", wid, 0))
+        go_timed.wait(timeout=300)
+        admitted = _round()
+        out_q.put(("done", wid, admitted))
+    finally:
+        cli.close()
+
+
+def _run_ipc_stage(n_rules: int, n_ops: int, iters: int) -> dict:
+    """Multi-process ingest plane (sentinel_tpu/ipc): N-worker vs
+    in-process A/B. The same bulk workload is pushed (a) by N real
+    worker processes through the shared-memory rings and (b) by an
+    in-process driver straight into submit_bulk — the delta is the
+    plane's frame + ring cost, the ratio is the scale-out story's
+    baseline number. Plus the single-entry shared-memory round-trip
+    percentiles from an in-process client (frame encode -> ring ->
+    plane decode -> columnar submit -> verdict frame)."""
+    import jax
+
+    from sentinel_tpu.ipc.plane import IngestPlane
+    from sentinel_tpu.ipc.worker import IngestClient
+    from sentinel_tpu.models.rules import FlowRule
+    from sentinel_tpu.runtime.engine import Engine
+    from sentinel_tpu.utils.config import config
+
+    n_rules = max(1, min(n_rules, 64))
+    n_ops = max(512, n_ops)
+    # One bulk call = one frame: keep the group inside the slot's
+    # entry-frame budget so a call never splits into two round trips.
+    group = 224
+    n_workers = 2
+    resources = [f"r{i}" for i in range(n_rules)]
+    _log(f"ipc stage rules={n_rules} ops={n_ops} workers={n_workers}")
+
+    config.set(config.SPECULATIVE_ENABLED, "true")
+    config.set(config.SPECULATIVE_FLUSH_BATCH, "4096")
+    # No mid-measure reaps: the workers do not exit their admissions
+    # (the rule is wide open), and a dead-worker sweep firing between
+    # phases would run exit-bulk compiles inside the timed spans.
+    config.set(config.IPC_WORKER_DEAD_MS, "120000")
+    try:
+        eng = Engine(initial_rows=max(1024, n_rules * 2))
+        eng.set_flow_rules(
+            [FlowRule(resource=r, count=1e9) for r in resources]
+        )
+
+        # --- in-process baseline: the same bulk cadence, no plane.
+        def _inproc(total: int) -> float:
+            t0 = time.perf_counter()
+            done = 0
+            i = 0
+            while done < total:
+                n = min(group, total - done)
+                eng.submit_bulk(resources[i % n_rules], n)
+                done += n
+                i += 1
+            eng.flush()
+            eng.drain()
+            return total / (time.perf_counter() - t0)
+
+        _inproc(group * 4)  # warm: compile + interning
+        inproc_ops = max(_inproc(n_ops), _inproc(n_ops))
+
+        # --- the plane + N spawned workers, quota split evenly; one
+        # full warm round before the timed one (see _ipc_bench_worker).
+        plane = IngestPlane(eng)
+        ctx = plane.spawn_context()
+        go_warm = ctx.Event()
+        go_timed = ctx.Event()
+        out_q = ctx.Queue()
+        quota = n_ops // n_workers
+        procs = [
+            ctx.Process(
+                target=_ipc_bench_worker,
+                args=(plane.channel(w), w, resources, quota, group,
+                      go_warm, go_timed, out_q),
+                daemon=True,
+            )
+            for w in range(n_workers)
+        ]
+        for p in procs:
+            p.start()
+        workers_ops = 0.0
+        admitted = 0
+        try:
+            def _await(tag, timeout):
+                seen = 0
+                total = 0
+                while seen < n_workers:
+                    msg = out_q.get(timeout=timeout)
+                    if msg[0] == tag:
+                        seen += 1
+                        total += msg[2]
+                return total
+
+            _await("ready", 120)
+            go_warm.set()
+            _await("warm", 300)
+            t0 = time.perf_counter()
+            go_timed.set()
+            admitted = _await("done", 300)
+            workers_ops = quota * n_workers / (time.perf_counter() - t0)
+        finally:
+            for p in procs:
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.terminate()
+
+        # --- single-entry shared-memory round trip (in-process client).
+        cli = IngestClient(plane.channel(n_workers), n_workers)
+        for i in range(64):
+            cli.entry(resources[i % n_rules])
+        lats = []
+        for i in range(1024):
+            t0 = time.perf_counter()
+            cli.entry(resources[i % n_rules])
+            lats.append(time.perf_counter() - t0)
+        eng.flush()
+        lats.sort()
+        p50 = lats[len(lats) // 2] * 1e6
+        p99 = lats[int(len(lats) * 0.99)] * 1e6
+        plane_counters = dict(plane.snapshot()["counters"])
+        cli_counters = dict(cli.counters)
+        cli.close()
+        plane.close()
+        eng.close()
+    finally:
+        for key in (
+            config.SPECULATIVE_ENABLED, config.SPECULATIVE_FLUSH_BATCH,
+            config.IPC_WORKER_DEAD_MS,
+        ):
+            config.set(key, config.DEFAULTS[key])
+
+    ratio = workers_ops / inproc_ops if inproc_ops > 0 else 0.0
+    _log(
+        f"ipc stage done: {n_workers} workers {workers_ops:,.0f} ops/s vs "
+        f"in-process {inproc_ops:,.0f} ({ratio:.2f}x); entry rt p50 "
+        f"{p50:.0f} µs p99 {p99:.0f} µs; admitted {admitted}; "
+        f"client policy_served={cli_counters.get('policy_served', 0)} "
+        f"sheds={cli_counters.get('sheds', 0)}"
+    )
+    return {
+        "ipc_n_ops": n_ops,
+        "ipc_n_workers": n_workers,
+        "ipc_workers_ops_per_sec": round(workers_ops, 1),
+        "ipc_inproc_ops_per_sec": round(inproc_ops, 1),
+        "ipc_vs_inproc": round(ratio, 4),
+        "ipc_entry_p50_us": round(p50, 1),
+        "ipc_entry_p99_us": round(p99, 1),
+        "ipc_frames": plane_counters.get("frames", 0),
+        "ipc_admitted": admitted,
+        # Honesty columns: a policy-served latency sample would mean
+        # the measured number was the DEAD-ENGINE fallback, not the
+        # ring round trip.
+        "ipc_client_policy_served": cli_counters.get("policy_served", 0),
+        "ipc_client_sheds": cli_counters.get("sheds", 0),
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "jax_version": jax.__version__,
+    }
+
+
 def _run_stage(n_rules: int, n_entries: int, iters: int) -> dict:
     """Child-process body: build state, compile, time. Prints one JSON
     line with the stage result (including the platform ACTUALLY used)."""
@@ -1274,6 +1464,7 @@ def _child_main(args) -> None:
         "sketch": _run_sketch_stage,
         "adapters": _run_adapters_stage,
         "autotune": _run_autotune_stage,
+        "ipc": _run_ipc_stage,
     }[args.kind]
     print(json.dumps(fn(args.rules, args.entries, args.iters)), flush=True)
 
@@ -1543,7 +1734,12 @@ def main() -> None:
             _log(f"skipping adapters stage: {remaining:.0f}s left gives "
                  f"timeout {adapters_t:.0f}s < {min_adapters:.0f}s floor")
         remaining = deadline - time.monotonic()
-        autotune_t = min(remaining - 10, 300.0)
+        # Reserve the ipc stage's floor like the adapters stage
+        # reserves the autotune's.
+        min_ipc = 60.0 if run_platform == "cpu" else 240.0
+        autotune_t = min(remaining - 10 - min_ipc, 300.0)
+        if autotune_t < min_autotune:
+            autotune_t = min(remaining - 10, 300.0)
         if autotune_t >= min_autotune:
             att = spawn(
                 64, 8192, 3, run_platform, autotune_t, kind="autotune"
@@ -1553,6 +1749,15 @@ def main() -> None:
         else:
             _log(f"skipping autotune stage: {remaining:.0f}s left gives "
                  f"timeout {autotune_t:.0f}s < {min_autotune:.0f}s floor")
+        remaining = deadline - time.monotonic()
+        ipc_t = min(remaining - 10, 300.0)
+        if ipc_t >= min_ipc:
+            ipc = spawn(8, 16384, 3, run_platform, ipc_t, kind="ipc")
+            if ipc:
+                best.update(ipc)
+        else:
+            _log(f"skipping ipc stage: {remaining:.0f}s left gives "
+                 f"timeout {ipc_t:.0f}s < {min_ipc:.0f}s floor")
 
     if best is None:
         _emit(

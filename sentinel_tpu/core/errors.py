@@ -99,6 +99,13 @@ BLOCK_CUSTOM = 6
 # enqueued: the distinct code keeps load-shedding tellable from policy
 # blocks in logs, traces and metrics.
 BLOCK_SHED = 8
+# Sketch-tier cold-key admission ceiling (runtime/sketch.py,
+# sentinel.tpu.sketch.cold.qps): an UNPROMOTED sketch-tracked resource
+# whose count-min estimated rate exceeds the configured ceiling. Never
+# a dense-rule verdict and never enqueued — the estimate-based block is
+# approximate by contract, so it must stay tellable from exact
+# FlowException blocks in logs, traces and metrics.
+BLOCK_SKETCH = 9
 
 
 class CustomBlockError(BlockError):
@@ -127,6 +134,14 @@ class IngestShedError(BlockError):
     block_type = "IngestShed"
 
 
+class SketchColdBlockError(BlockError):
+    """Blocked by the sketch tier's cold-key admission ceiling: the
+    resource has no dense rule (and no promotion), but its count-min
+    estimated rate exceeds ``sentinel.tpu.sketch.cold.qps``."""
+
+    block_type = "SketchCold"
+
+
 _ERROR_BY_CODE = {
     BLOCK_FLOW: FlowBlockError,
     BLOCK_DEGRADE: DegradeBlockError,
@@ -136,6 +151,7 @@ _ERROR_BY_CODE = {
     BLOCK_CUSTOM: CustomBlockError,
     BLOCK_FAILOVER: FailoverBlockError,
     BLOCK_SHED: IngestShedError,
+    BLOCK_SKETCH: SketchColdBlockError,
 }
 
 # The ONE home of the block-code → exception-name mapping (the
@@ -153,6 +169,7 @@ BLOCK_EXC_NAMES = {
     BLOCK_CUSTOM: "CustomBlockException",
     BLOCK_FAILOVER: "FailoverException",
     BLOCK_SHED: "IngestShedException",
+    BLOCK_SKETCH: "SketchColdException",
 }
 
 

@@ -1,0 +1,762 @@
+"""IngestPlane: the engine-process drainer of the multi-process plane.
+
+One plane per engine. It owns every shared-memory segment (control
+header, MPSC request ring, one SPSC response ring per worker slot) and
+a drainer thread that:
+
+* pops request frames, decodes the columns, and rides admissions
+  through the SAME columnar spine the batch window uses — grouped
+  ``submit_bulk`` with per-request ts/acquire columns, per-request
+  ``submit_entry`` fallback for the rule classes bulk declines
+  (cluster mode, THREAD-grade param rules, collection values) — so
+  worker-path verdicts are bit-identical to the in-process oracle;
+* fans speculative fast-tier verdicts back WITHOUT waiting for the
+  settling flush (``entry_windowed`` parity: the device settles on the
+  tier's own cadence);
+* reconstructs each row's packed W3C traceparent and records
+  per-request admission traces (PR-4 identity survives the process
+  boundary);
+* keeps the **live-admission ledger** per worker: every admitted
+  THREAD-charged row is recorded so a dead worker's heartbeat (stale
+  past ``sentinel.tpu.ipc.worker.dead.ms``) triggers an auto-exit of
+  exactly its live admissions — device and mirror THREAD gauges return
+  to exactly 0, the plane's analog of the batch window's
+  abandoned-entry release;
+* publishes the engine heartbeat + health word and the per-resource
+  fail-open/closed failover-policy snapshot into the control header —
+  what workers serve from when this process dies;
+* folds worker-side ring-full shed counts into the engine's
+  IngestValve accounting (cause ``ring``) so shedding stays one
+  fleet-visible number.
+
+Nothing here touches the engine submit hot path: a disabled plane is
+never constructed, and an enabled one costs the engine exactly the
+work the frames carry.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.ipc import frames as fr
+from sentinel_tpu.ipc.ring import (
+    HEALTH_CLOSED,
+    HEALTH_DEGRADED,
+    HEALTH_HEALTHY,
+    ControlBlock,
+    ShmRing,
+)
+from sentinel_tpu.ipc.worker import PlaneChannel
+from sentinel_tpu.utils.config import config
+
+
+class _WorkerState:
+    """Engine-side per-worker-slot state: the intern decode table and
+    the live-admission ledger."""
+
+    __slots__ = (
+        "names", "live", "last_epoch", "last_seen", "shed_seen", "attached",
+    )
+
+    def __init__(self) -> None:
+        self.names: Dict[int, str] = {}
+        # (rows, resource, speculative, acquire) -> live admitted count.
+        self.live: Dict[Tuple[tuple, str, bool, int], int] = {}
+        self.last_epoch = 0
+        self.last_seen = 0.0
+        self.shed_seen = 0
+        self.attached = False
+
+
+class IngestPlane:
+    """Engine-scoped multi-process ingest plane (see module doc)."""
+
+    def __init__(self, engine, start: bool = True) -> None:
+        self._engine = engine
+        self.workers_max = max(1, config.get_int(config.IPC_WORKERS_MAX, 8))
+        self.ring_slots = config.get_int(config.IPC_RING_SLOTS, 1024)
+        self.slot_bytes = max(
+            1024, config.get_int(config.IPC_SLOT_BYTES, 16384)
+        )
+        self.resp_slots = config.get_int(config.IPC_RESP_SLOTS, 1024)
+        self.worker_dead_ms = max(
+            1, config.get_int(config.IPC_WORKER_DEAD_MS, 1000)
+        )
+        self.heartbeat_ms = max(1, config.get_int(config.IPC_HEARTBEAT_MS, 100))
+        self.poll_us = max(10, config.get_int(config.IPC_POLL_US, 200))
+        self._mp = multiprocessing.get_context("spawn")
+        self._req_lock = self._mp.Lock()
+        self.control = ControlBlock(None, self.workers_max, create=True)
+        self.request = ShmRing(
+            None, self.ring_slots, self.slot_bytes, create=True,
+            lock=self._req_lock,
+        )
+        # Response rings allocate LAZILY at channel() time: eagerly
+        # mapping workers_max rings would hold ~workers_max x
+        # resp_slots x slot_bytes of /dev/shm (~134 MB at defaults)
+        # for worker slots that may never attach.
+        self.responses: List[Optional[ShmRing]] = [
+            None for _ in range(self.workers_max)
+        ]
+        self._workers: List[_WorkerState] = [
+            _WorkerState() for _ in range(self.workers_max)
+        ]
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "frames": 0, "requests": 0, "bulk_rows": 0, "exits": 0,
+            "worker_sheds": 0, "decode_drops": 0, "worker_deaths": 0,
+            "auto_exits": 0, "responses_dropped": 0, "stalled_skips": 0,
+        }
+        self._policy_published: Optional[str] = None
+        self._last_sweep = 0.0
+        # World generation: bumped by on_engine_reset so a decision
+        # batch that STARTED before a reset cannot insert ledger
+        # entries for the dead world after the ledgers were dropped
+        # (a later reap would release them against fresh gauges).
+        self._world = 0
+        self._stop = threading.Event()
+        self.closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._ctrl: Optional[threading.Thread] = None
+        # The intern generation starts at 1 so a worker attaching to a
+        # RESTARTED plane under recycled shm names can never alias
+        # generation 0 reads from the zeroed header.
+        self.control.bump_intern_gen()
+        engine.ipc_plane = self
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    # attach surface
+    # ------------------------------------------------------------------
+    def channel(self, worker_id: int) -> PlaneChannel:
+        if not (0 <= worker_id < self.workers_max):
+            raise ValueError(f"worker_id {worker_id} out of range")
+        with self._lock:
+            if self.responses[worker_id] is None:
+                self.responses[worker_id] = ShmRing(
+                    None, self.resp_slots, self.slot_bytes, create=True
+                )
+            resp_name = self.responses[worker_id].name
+        return PlaneChannel(
+            control_name=self.control.name,
+            request_name=self.request.name,
+            response_name=resp_name,
+            ring_slots=self.ring_slots,
+            slot_bytes=self.slot_bytes,
+            resp_slots=self.resp_slots,
+            workers_max=self.workers_max,
+            request_lock=self._req_lock,
+        )
+
+    def spawn_context(self):
+        """The plane's (spawn) multiprocessing context — workers must
+        be descendants of this process for the claim lock to travel."""
+        return self._mp
+
+    # ------------------------------------------------------------------
+    # drainer
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._publish_control(force=True)
+        self._thread = threading.Thread(
+            target=self._run, name="sentinel-ipc-plane", daemon=True
+        )
+        self._thread.start()
+        # Control-plane duties on their OWN thread: the drainer blocks
+        # inside engine flushes (a first-compile runs for seconds), and
+        # a heartbeat that rides the drain loop would starve exactly
+        # then — workers would declare a merely-busy engine dead.
+        self._ctrl = threading.Thread(
+            target=self._control_loop, name="sentinel-ipc-control",
+            daemon=True,
+        )
+        self._ctrl.start()
+
+    def _run(self) -> None:
+        idle_s = self.poll_us / 1e6
+        delay = idle_s
+        while not self._stop.is_set():
+            try:
+                worked = self._drain_once()
+            except Exception:
+                from sentinel_tpu.utils.record_log import record_log
+
+                record_log.error("[IngestPlane] drain failed", exc_info=True)
+                worked = False
+            if worked:
+                delay = idle_s
+            else:
+                time.sleep(delay)
+                delay = min(delay * 2, 0.002)
+
+    def _control_loop(self) -> None:
+        """Heartbeat + policy publishing ONLY: this thread must never
+        block on engine work (a reap's flush can compile for seconds,
+        and a starved heartbeat reads as engine death to every worker).
+        The worker death sweep runs on the drainer, which is allowed to
+        be busy."""
+        while not self._stop.wait(self.heartbeat_ms / 1e3):
+            try:
+                self._publish_control()
+            except Exception:
+                from sentinel_tpu.utils.record_log import record_log
+
+                record_log.error(
+                    "[IngestPlane] control tick failed", exc_info=True
+                )
+
+    def _drain_once(self) -> bool:
+        """One drainer iteration; True when any frame was processed."""
+        now = time.monotonic()
+        if (now - self._last_sweep) * 1e3 >= self.heartbeat_ms:
+            self._last_sweep = now
+            self._check_workers(now)
+        payloads = self.request.pop_all(limit=128)
+        if not payloads:
+            if self.request.maybe_skip_stalled(self.worker_dead_ms / 1e3):
+                self.counters["stalled_skips"] += 1
+                return True
+            return False
+        eng = self._engine
+        tele = eng.telemetry
+        groups: Dict[tuple, list] = {}
+        exits: List[tuple] = []
+        responses: Dict[int, list] = {}
+        n_rows = 0
+        for payload in payloads:
+            try:
+                f = fr.decode_frame(payload)
+            except (ValueError, fr.struct.error):
+                self.counters["decode_drops"] += 1
+                continue
+            if not (0 <= f.worker_id < self.workers_max):
+                self.counters["decode_drops"] += 1
+                continue
+            ws = self._workers[f.worker_id]
+            ws.attached = True
+            for iid, raw in f.interns:
+                ws.names[iid] = raw.decode("utf-8", "surrogatepass")
+            self._fold_sheds(f.worker_id, f.shed_count)
+            self.counters["frames"] += 1
+            if f.kind in (fr.KIND_ENTRY, fr.KIND_BULK):
+                n_rows += f.n
+                self._collect_entries(f, ws, groups, responses)
+            elif f.kind == fr.KIND_EXIT:
+                self._collect_exits(f, ws, exits)
+        if n_rows:
+            self.counters["requests"] += n_rows
+            if tele.enabled:
+                tele.note_ipc_frames(len(payloads), n_rows)
+        self._apply_exits(exits)
+        if groups:
+            self._decide_groups(groups, responses)
+        self._send_responses(responses)
+        return True
+
+    # -- decode helpers -------------------------------------------------
+    def _name(self, ws: _WorkerState, iid: int) -> Optional[str]:
+        if iid == 0:
+            return ""
+        return ws.names.get(iid)
+
+    def _collect_entries(self, f, ws, groups, responses) -> None:
+        from sentinel_tpu.models import constants as C
+
+        cols = f.columns
+        seqs = cols["seq"]
+        ts = cols["ts"]
+        acq = cols["acquire"]
+        etype = cols["entry_type"]
+        rid = cols["resource_id"]
+        cid = cols["context_id"]
+        oid = cols["origin_id"]
+        aoff = cols["args_off"]
+        alen = cols["args_len"]
+        out = responses.setdefault(f.worker_id, [])
+        now_ms = self._engine.clock.now_ms()
+        for i in range(f.n):
+            res = self._name(ws, int(rid[i]))
+            ctx = self._name(ws, int(cid[i]))
+            org = self._name(ws, int(oid[i]))
+            if res is None or ctx is None or org is None:
+                # Undecodable id (a skipped frame lost the intern): a
+                # distinct fast shed, never a guess at a resource.
+                out.append((int(seqs[i]), 0, E.BLOCK_SHED, 0, 0))
+                self.counters["decode_drops"] += 1
+                continue
+            t = int(ts[i])
+            if t < 0:
+                t = now_ms
+            args = ()
+            if alen[i]:
+                try:
+                    args = fr.decode_args(
+                        f.varbytes[int(aoff[i]) : int(aoff[i]) + int(alen[i])]
+                    )
+                except (ValueError, IndexError, fr.struct.error):
+                    out.append((int(seqs[i]), 0, E.BLOCK_SHED, 0, 0))
+                    self.counters["decode_drops"] += 1
+                    continue
+            et = int(etype[i])
+            if et not in (0, 1):  # EntryType.IN / EntryType.OUT
+                # Malformed wire value: the same per-row fast shed as
+                # an undecodable id — one bad row must never abort the
+                # rest of the drained batch.
+                out.append((int(seqs[i]), 0, E.BLOCK_SHED, 0, 0))
+                self.counters["decode_drops"] += 1
+                continue
+            trace = f.traces[i * 26 : (i + 1) * 26]
+            key = (res, ctx or C.CONTEXT_DEFAULT_NAME, org,
+                   C.EntryType(et))
+            groups.setdefault(key, []).append(
+                (f.worker_id, int(seqs[i]), t, int(acq[i]), args, trace)
+            )
+
+    def _collect_exits(self, f, ws, exits) -> None:
+        cols = f.columns
+        now_ms = self._engine.clock.now_ms()
+        for i in range(f.n):
+            res = self._name(ws, int(cols["resource_id"][i]))
+            ctx = self._name(ws, int(cols["context_id"][i]))
+            org = self._name(ws, int(cols["origin_id"][i]))
+            if res is None or ctx is None or org is None:
+                self.counters["decode_drops"] += 1
+                continue
+            et = int(cols["entry_type"][i])
+            if et not in (0, 1):
+                self.counters["decode_drops"] += 1
+                continue
+            t = int(cols["ts"][i])
+            exits.append(
+                (
+                    f.worker_id, res, ctx, org, et,
+                    now_ms if t < 0 else t,
+                    int(cols["rt"][i]), int(cols["count"][i]),
+                    int(cols["err"][i]), int(cols["spec"][i]),
+                )
+            )
+
+    # -- exits ----------------------------------------------------------
+    def _apply_exits(self, exits: List[tuple]) -> None:
+        """Grouped columnar exits: one submit_exit_bulk per
+        (rows, resource, speculative) — completions NEVER shed, and the
+        per-worker live ledger releases its matching admissions."""
+        if not exits:
+            return
+        from sentinel_tpu.models import constants as C
+
+        eng = self._engine
+        by_key: Dict[tuple, list] = {}
+        # One engine-lock resolve per distinct identity, not per row —
+        # exits repeat identities heavily by construction, and the
+        # engine lock is every submitting thread's critical section.
+        rows_memo: Dict[tuple, object] = {}
+        for (wid, res, ctx, org, et, ts, rt, count, err, spec) in exits:
+            ident = (res, ctx or C.CONTEXT_DEFAULT_NAME, org, int(et))
+            if ident in rows_memo:
+                rows = rows_memo[ident]
+            else:
+                rows = rows_memo[ident] = self._rows_for(
+                    ident[0], ident[1], ident[2], C.EntryType(ident[3])
+                )
+            if rows is None:
+                continue  # pass-through admissions charge no gauge
+            spec_b = spec != 2  # unknown(0)/speculative(1) release mirror
+            by_key.setdefault((rows, res, spec_b), []).append(
+                (wid, ts, rt, count, err)
+            )
+        for (rows, res, spec_b), items in by_key.items():
+            n = len(items)
+            eng.submit_exit_bulk(
+                rows, n,
+                ts=np.fromiter((i[1] for i in items), np.int64, n),
+                rt=np.fromiter((i[2] for i in items), np.int64, n),
+                count=np.fromiter((i[3] for i in items), np.int64, n),
+                err=np.fromiter((i[4] for i in items), np.int64, n),
+                resource=res,
+                speculative=spec_b,
+            )
+            self.counters["exits"] += n
+            with self._lock:
+                for (wid, _ts, _rt, count, _err) in items:
+                    live = self._workers[wid].live
+                    # The exit's spec flag may disagree with the
+                    # admit-time ledger key (a worker's default
+                    # speculative=None reads as mirror-release True
+                    # while a spec-off admit was recorded False) — try
+                    # the exact key, then the flipped flag, so a
+                    # completed admission NEVER stays ledger-live for a
+                    # spurious dead-worker release later.
+                    for k in (
+                        (rows, res, spec_b, count),
+                        (rows, res, not spec_b, count),
+                    ):
+                        cur = live.get(k, 0)
+                        if cur > 0:
+                            if cur > 1:
+                                live[k] = cur - 1
+                            else:
+                                live.pop(k, None)
+                            break
+
+    def _rows_for(self, res, ctx, org, etype):
+        eng = self._engine
+        with eng._lock:
+            return eng.resolve_entry_rows(res, ctx, org, etype)
+
+    # -- admissions -----------------------------------------------------
+    def _decide_groups(self, groups: Dict[tuple, list], responses) -> None:
+        """The batch window's dispatch shape, frame-fed: one columnar
+        submit_bulk per (resource, ctx, origin, entry_type) group with
+        per-request ts/acquire columns; rule classes bulk declines fall
+        back to per-request submit_entry on the same flush."""
+        eng = self._engine
+        with self._lock:
+            world = self._world
+        settled: List[tuple] = []
+        all_spec = True
+        for (res, ctx, org, etype), reqs in groups.items():
+            n = len(reqs)
+            ts_col = np.fromiter((r[2] for r in reqs), np.int32, n)
+            acq_col = np.fromiter((r[3] for r in reqs), np.int32, n)
+            args_col = None
+            if any(r[4] for r in reqs):
+                args_col = [r[4] for r in reqs]
+            try:
+                op = eng.submit_bulk(
+                    res, n, ts=ts_col, acquire=acq_col, context_name=ctx,
+                    origin=org, entry_type=etype, args_column=args_col,
+                )
+                is_bulk = True
+                if op is not None:
+                    # Per-request trace identity (the group-level tag
+                    # would record bounded group rows at fill).
+                    op.trace = None
+                    spec = op.spec_admitted is not None
+                else:
+                    spec = True  # pass-through: nothing to settle
+            except ValueError:
+                op = [
+                    eng.submit_entry(
+                        res, ctx, org, int(acq_col[i]), etype,
+                        ts=int(ts_col[i]), args=reqs[i][4],
+                    )
+                    for i in range(n)
+                ]
+                is_bulk = False
+                spec = False
+            settled.append(((res, ctx, org, etype), reqs, op, is_bulk))
+            all_spec = all_spec and spec
+        if all_spec and eng.speculative.enabled:
+            eng._spec_maybe_settle()
+        elif eng.has_pending():
+            eng.flush()
+        for key, reqs, op, is_bulk in settled:
+            if is_bulk:
+                self._fan_out_bulk(key, reqs, op, responses, world)
+            else:
+                self._fan_out_entries(key, reqs, op, responses, world)
+
+    def _fan_out_bulk(self, key, reqs, op, responses, world) -> None:
+        res, ctx, org, _etype = key
+        if op is None:
+            for (wid, seq, _ts, _acq, _args, trace) in reqs:
+                responses.setdefault(wid, []).append(
+                    (seq, 1, E.PASS, 0, 0)
+                )
+                self._record_trace(trace, res, org, ctx, True, E.PASS, -1, "")
+            return
+        # A never-enqueued group (valve shed, cold-ceiling block) was
+        # already trace-recorded by the engine's own record_bulk at
+        # submit — the plane must not record the same rows again.
+        recorded_at_submit = op.src is None
+        flush_seq = -1
+        pend = op._pending
+        if pend is not None:
+            flush_seq = pend._seq
+        spec = op.spec_admitted is not None
+        adm = op.admitted  # materializes a pending fetch if needed
+        adm_l = adm.tolist()
+        rsn_l = op.reason.tolist()
+        wait_l = op.wait_ms.tolist()
+        degraded = bool(op.spec_degraded) if spec else False
+        fl = (fr.F_SPECULATIVE if spec else 0) | (
+            fr.F_DEGRADED if degraded else 0
+        )
+        rows = op.rows
+        with self._lock:
+            ledger_live = self._world == world
+            for i, (wid, seq, _ts, acq, _args, trace) in enumerate(reqs):
+                responses.setdefault(wid, []).append(
+                    (seq, 1 if adm_l[i] else 0, rsn_l[i], wait_l[i], fl)
+                )
+                if adm_l[i] and ledger_live:
+                    live = self._workers[wid].live
+                    k = (rows, res, spec or degraded, acq)
+                    live[k] = live.get(k, 0) + 1
+        if recorded_at_submit:
+            return
+        prov = "speculative" if spec else ""
+        for i, (_wid, _seq, _ts, _acq, _args, trace) in enumerate(reqs):
+            self._record_trace(
+                trace, res, org, ctx, bool(adm_l[i]), int(rsn_l[i]),
+                flush_seq, prov, degraded=degraded,
+            )
+
+    def _fan_out_entries(self, key, reqs, ops, responses, world) -> None:
+        res, ctx, org, _etype = key
+        verdicts = [op.verdict if op is not None else None for op in ops]
+        with self._lock:
+            ledger_live = self._world == world
+            for (wid, seq, _ts, acq, _args, _trace), op, v in zip(
+                reqs, ops, verdicts
+            ):
+                if op is None:
+                    responses.setdefault(wid, []).append(
+                        (seq, 1, E.PASS, 0, 0)
+                    )
+                    continue
+                fl = (fr.F_SPECULATIVE if v.speculative else 0) | (
+                    fr.F_DEGRADED if v.degraded else 0
+                )
+                responses.setdefault(wid, []).append(
+                    (seq, 1 if v.admitted else 0, v.reason, v.wait_ms, fl)
+                )
+                if v.admitted and ledger_live:
+                    live = self._workers[wid].live
+                    k = (op.rows, res, v.speculative or v.degraded, acq)
+                    live[k] = live.get(k, 0) + 1
+        # Singles carry the engine's own trace records (submit_entry
+        # stamped op.trace on the plane thread) — same stance as the
+        # batch window's fallback path.
+
+    def _record_trace(
+        self, trace: bytes, res, org, ctx, admitted, reason, flush_seq,
+        provenance, degraded: bool = False,
+    ) -> None:
+        tracer = self._engine.admission_trace
+        if not tracer.enabled:
+            return
+        from sentinel_tpu.metrics.admission_trace import TraceContext, TraceTag
+
+        unpacked = fr.unpack_trace(trace)
+        if unpacked is not None:
+            tid, sid, sampled = unpacked
+            tag = TraceTag(
+                TraceContext(tid, sid, sampled), sampled, time.perf_counter()
+            )
+        else:
+            tag = tracer.make_tag()
+        tracer.record_admission(
+            tag, res, org, ctx, admitted, reason, flush_seq,
+            time.perf_counter(), degraded=degraded, provenance=provenance,
+        )
+
+    # -- responses ------------------------------------------------------
+    def _send_responses(self, responses: Dict[int, list]) -> None:
+        for wid, rows in responses.items():
+            if not rows:
+                continue
+            ring = self.responses[wid]
+            if ring is None:
+                # Frames from a worker slot that never took a channel
+                # from THIS plane object (stale attach): nowhere to
+                # answer — the callers' waits fall to the policy path.
+                self.counters["responses_dropped"] += len(rows)
+                continue
+            n = len(rows)
+            seqs = np.fromiter((r[0] for r in rows), np.uint64, n)
+            adm = np.fromiter((r[1] for r in rows), np.uint8, n)
+            rsn = np.fromiter((r[2] for r in rows), np.int16, n)
+            wms = np.fromiter((r[3] for r in rows), np.int32, n)
+            fl = np.fromiter((r[4] for r in rows), np.uint8, n)
+            cap = max(1, (self.slot_bytes - 64) // 16)
+            for lo in range(0, n, cap):
+                hi = min(n, lo + cap)
+                payload = fr.encode_verdicts(
+                    wid, seqs[lo:hi], adm[lo:hi], rsn[lo:hi], wms[lo:hi],
+                    fl[lo:hi],
+                )
+                deadline = time.monotonic() + 0.25
+                while not ring.try_push(payload):
+                    if time.monotonic() > deadline:
+                        self.counters["responses_dropped"] += hi - lo
+                        break
+                    time.sleep(0.0002)
+
+    # -- control-plane duties -------------------------------------------
+    def _publish_control(self, force: bool = False) -> None:
+        from sentinel_tpu.runtime.failover import HEALTHY, parse_policy
+
+        eng = self._engine
+        health = HEALTH_HEALTHY
+        fo = eng.failover
+        if fo.armed and fo.state != HEALTHY:
+            health = HEALTH_DEGRADED
+        if self.closed:
+            health = HEALTH_CLOSED
+        self.control.beat_engine(health)
+        raw = config.get(config.FAILOVER_POLICY) or "open"
+        if force or raw != self._policy_published:
+            default, overrides = parse_policy(raw)
+            self.control.publish_policy(default, overrides)
+            self._policy_published = raw
+
+    def _fold_sheds(self, wid: int, cumulative: int) -> None:
+        ws = self._workers[wid]
+        delta = (cumulative - ws.shed_seen) & 0xFFFFFFFF
+        if 0 < delta < (1 << 31):
+            ws.shed_seen = cumulative
+            self.counters["worker_sheds"] += delta
+            eng = self._engine
+            eng.ingest.note_ipc_shed(delta)
+            if eng.telemetry.enabled:
+                eng.telemetry.note_ipc_shed(delta)
+
+    def _check_workers(self, now: float) -> None:
+        """Heartbeat sweep: a worker whose epoch stopped advancing for
+        ``worker.dead.ms`` is dead — auto-exit its live admissions so
+        the device AND mirror THREAD gauges return to exactly 0."""
+        for wid in range(self.workers_max):
+            ws = self._workers[wid]
+            try:
+                epoch, _wall, pid, shed = self.control.worker_view(wid)
+            except (ValueError, TypeError):
+                continue
+            if pid == 0 and not ws.attached:
+                continue
+            if pid != 0:
+                self._fold_sheds(wid, shed)
+            if epoch != ws.last_epoch:
+                ws.last_epoch = epoch
+                ws.last_seen = now
+                if pid != 0:
+                    ws.attached = True
+                continue
+            if not ws.attached:
+                continue
+            if (now - ws.last_seen) * 1e3 >= self.worker_dead_ms:
+                self._reap_worker(wid, ws)
+
+    def _reap_worker(self, wid: int, ws: _WorkerState) -> None:
+        with self._lock:
+            live, ws.live = ws.live, {}
+            ws.attached = False
+            ws.last_epoch = 0
+            # The control slot is about to zero: a replacement worker
+            # on this id restarts its cumulative shed count from 0, so
+            # the fold baseline must follow or its first sheds read as
+            # a giant (ignored) wraparound delta.
+            ws.shed_seen = 0
+        self.control.clear_worker(wid)
+        self.counters["worker_deaths"] += 1
+        eng = self._engine
+        n_released = 0
+        for (rows, res, spec_b, acq), n in live.items():
+            if n <= 0:
+                continue
+            # Chunked to max_batch: submit_exit_bulk refuses oversized
+            # groups, and an aborted release loop would leak every
+            # remaining key's gauge charge forever (the ledger was
+            # already swapped out).
+            for lo in range(0, n, eng.max_batch):
+                eng.submit_exit_bulk(
+                    rows, min(eng.max_batch, n - lo), rt=0, count=acq,
+                    err=0, resource=res, speculative=spec_b,
+                )
+            n_released += n
+        if n_released:
+            self.counters["auto_exits"] += n_released
+            eng.flush()
+        if eng.telemetry.enabled:
+            eng.telemetry.note_ipc_worker_death(n_released)
+
+    def on_engine_reset(self) -> None:
+        """Engine.reset() hook: the engine just rebuilt its node rows
+        and zeroed every gauge, so the per-worker live-admission
+        ledgers reference a dead world — releasing them later would
+        drive fresh gauges negative. Drop the ledgers (the reset
+        already zeroed what they tracked) and bump the intern
+        generation so workers re-intern against the fresh plane state."""
+        with self._lock:
+            self._world += 1
+            for ws in self._workers:
+                ws.live = {}
+                ws.names = {}
+        self.control.bump_intern_gen()
+
+    # ------------------------------------------------------------------
+    # readers / lifecycle
+    # ------------------------------------------------------------------
+    def live_workers(self) -> int:
+        return sum(1 for ws in self._workers if ws.attached)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self.counters)
+            live = [
+                {
+                    "worker_id": wid,
+                    "attached": ws.attached,
+                    "live_admissions": sum(ws.live.values()),
+                    "interned": len(ws.names),
+                }
+                for wid, ws in enumerate(self._workers)
+                if ws.attached or ws.names
+            ]
+        return {
+            "enabled": True,
+            "closed": self.closed,
+            "workers_max": self.workers_max,
+            "live_workers": self.live_workers(),
+            "ring_slots": self.request.slots,
+            "slot_bytes": self.slot_bytes,
+            "ring_occupancy": round(self.request.occupancy(), 4),
+            "intern_gen": self.control.intern_gen(),
+            "counters": counters,
+            "workers": live,
+        }
+
+    def close(self, join_timeout_s: float = 5.0) -> None:
+        """Stop serving: publish CLOSED (workers fail over to the
+        policy snapshot), drain what is already in the ring, stop the
+        drainer, release every worker's live admissions, and unlink the
+        segments."""
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self.control.set_health(HEALTH_CLOSED)
+            self.control.beat_engine(HEALTH_CLOSED)
+        except (ValueError, TypeError):
+            pass
+        self._stop.set()
+        for t in (self._thread, self._ctrl):
+            if t is not None:
+                t.join(join_timeout_s)
+                if t.is_alive():
+                    self._engine.closed_dirty = True
+        self._thread = None
+        self._ctrl = None
+        # Final sweep: live admissions from still-attached workers are
+        # released like a death — the engine is leaving, its gauges
+        # must not stay charged by callers it can no longer hear.
+        for wid, ws in enumerate(self._workers):
+            if ws.attached and ws.live:
+                self._reap_worker(wid, ws)
+        if self._engine.ipc_plane is self:
+            self._engine.ipc_plane = None
+        self.request.destroy()
+        for r in self.responses:
+            if r is not None:
+                r.destroy()
+        self.control.destroy()

@@ -1,0 +1,645 @@
+"""IngestClient: the worker-process side of the multi-process plane.
+
+A worker process speaks the same entry/exit/bulk surface the adapters
+speak, but every decision is made by the ONE engine process: requests
+encode into columnar frames on the shared-memory MPSC request ring,
+verdicts come back on this worker's SPSC response ring. The client
+holds no device state and takes no engine locks — it is pure encode +
+wait, safe to call from many threads of a GIL-bound server process.
+
+Failure stances (the worker half of the plane's failure matrix):
+
+* **ring full** → a local ``BLOCK_SHED`` verdict with cause
+  ``ipc_ring`` — never a stall. The shed count is published through
+  the control header so the engine's IngestValve accounting sees it
+  (backpressure stays observable fleet-side even though the decision
+  was made here).
+* **engine dead** (health word CLOSED, heartbeat stale past
+  ``sentinel.tpu.ipc.engine.dead.ms``, or a verdict wait past
+  ``...timeout.ms``) → verdicts come from the per-resource
+  fail-open/closed failover policy snapshot the plane published into
+  the control header, marked ``degraded`` — the same stance the
+  in-process engine takes when the DEVICE dies (runtime/failover.py).
+* **exits are never shed and never policy-served**: a completion is
+  how gauges drain, so the client retries a full ring briefly and only
+  drops a completion once the engine is provably gone (a dead engine
+  has no gauges left to leak).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from struct import error as struct_error
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.ipc import frames as fr
+from sentinel_tpu.ipc.ring import (
+    HEALTH_CLOSED,
+    ControlBlock,
+    ShmRing,
+    _wall_ms,
+)
+from sentinel_tpu.utils.config import config
+
+
+@dataclass
+class PlaneChannel:
+    """Everything a worker needs to attach: shared-memory segment names
+    + geometry + the producer claim lock. Picklable through
+    ``multiprocessing`` process spawning (the lock travels via mp's own
+    reduction, so workers must be descendants of the plane's
+    process)."""
+
+    control_name: str
+    request_name: str
+    response_name: str  # THIS worker slot's SPSC response ring
+    ring_slots: int
+    slot_bytes: int
+    resp_slots: int
+    workers_max: int
+    request_lock: object = field(repr=False, default=None)
+
+
+class _Waiter:
+    __slots__ = ("event", "verdicts", "need")
+
+    def __init__(self, need: int) -> None:
+        self.event = threading.Event()
+        self.verdicts: Dict[int, tuple] = {}
+        self.need = need
+
+
+class IngestClient:
+    """One worker's connection to the plane (one per process; its
+    methods are thread-safe)."""
+
+    def __init__(
+        self,
+        channel: PlaneChannel,
+        worker_id: int,
+        heartbeat: bool = True,
+    ) -> None:
+        if not (0 <= worker_id < channel.workers_max):
+            raise ValueError(
+                f"worker_id {worker_id} out of range 0..{channel.workers_max - 1}"
+            )
+        self.worker_id = int(worker_id)
+        self.channel = channel
+        self.control = ControlBlock(
+            channel.control_name, channel.workers_max
+        )
+        self.request = ShmRing(
+            channel.request_name, channel.ring_slots, channel.slot_bytes,
+            lock=channel.request_lock,
+        )
+        self.response = ShmRing(
+            channel.response_name, channel.resp_slots, channel.slot_bytes,
+        )
+        self.heartbeat_ms = max(1, config.get_int(config.IPC_HEARTBEAT_MS, 100))
+        self.engine_dead_ms = max(
+            1, config.get_int(config.IPC_ENGINE_DEAD_MS, 1000)
+        )
+        self.timeout_ms = max(1, config.get_int(config.IPC_TIMEOUT_MS, 5000))
+        self._lock = threading.Lock()
+        self._seq = 0
+        # Per-connection intern table: each string crosses the boundary
+        # exactly once per intern generation. _fresh buffers the
+        # (id, bytes) records the NEXT frame must carry.
+        self._intern: Dict[str, int] = {}
+        self._fresh: List[Tuple[int, bytes]] = []
+        self._intern_gen = self.control.intern_gen()
+        self._next_id = 1
+        self._waiters: Dict[int, _Waiter] = {}
+        self._shed_total = 0
+        self.counters: Dict[str, int] = {
+            "entries": 0, "bulk_rows": 0, "exits": 0, "exits_dropped": 0,
+            "sheds": 0, "policy_served": 0, "frames": 0,
+        }
+        self._stop = threading.Event()
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"ipc-reader-{worker_id}", daemon=True
+        )
+        self._reader.start()
+        self._beat: Optional[threading.Thread] = None
+        if heartbeat:
+            self._beat = threading.Thread(
+                target=self._beat_loop, name=f"ipc-beat-{worker_id}",
+                daemon=True,
+            )
+            self._beat.start()
+        self.control.beat_worker(self.worker_id, os.getpid())
+
+    # ------------------------------------------------------------------
+    # interning
+    # ------------------------------------------------------------------
+    def _intern_rollback_locked(self, interns: List[Tuple[int, bytes]]) -> None:
+        """A frame carrying fresh intern records failed to push: FORGET
+        those strings instead of re-queuing the records. A re-queued
+        backlog grows without bound under sustained shed (and can push
+        every future frame past the slot size — a permanent 100%-shed
+        wedge); forgetting just means the string re-interns under a
+        NEW id on its next use, which costs one duplicate crossing and
+        nothing else (ids are monotonic, never reused for a different
+        string, so the plane-side table stays consistent)."""
+        for _iid, raw in interns:
+            self._intern.pop(raw.decode("utf-8", "surrogatepass"), None)
+
+    def _push_locked(self, encode) -> bool:
+        """Encode + push one frame under the client lock. ``encode``
+        is called with the intern-record list to carry. When the
+        combined payload would exceed the slot (long fresh names past
+        the FRAME_RESERVE budget), the interns ship FIRST as a
+        zero-row preamble frame — otherwise an over-slot payload would
+        read as permanent phantom ring backpressure. A push failure
+        rolls the fresh interns back (see _intern_rollback_locked);
+        intern records alone exceeding a slot raise ValueError (a
+        config/caller mismatch, never backpressure)."""
+        interns, self._fresh = self._fresh, []
+        payload = encode(interns)
+        if len(payload) > self.channel.slot_bytes and interns:
+            pre = fr.encode_entries(
+                self.worker_id, [], interns, self._intern_gen,
+                self._shed_total,
+            )
+            if len(pre) > self.channel.slot_bytes:
+                self._intern_rollback_locked(interns)
+                raise ValueError(
+                    "intern records exceed the frame budget — raise "
+                    "sentinel.tpu.ipc.slot.bytes or shorten the names"
+                )
+            if not self.request.try_push(pre):
+                self._intern_rollback_locked(interns)
+                return False
+            interns = []
+            payload = encode([])
+        if self.request.try_push(payload):
+            return True
+        self._intern_rollback_locked(interns)
+        return False
+
+    def _intern_locked(self, s: str) -> int:
+        gen = self.control.intern_gen()
+        if gen != self._intern_gen:
+            # Plane restarted / table invalidated: every string crosses
+            # again under the new generation.
+            self._intern.clear()
+            self._fresh = []
+            self._intern_gen = gen
+        i = self._intern.get(s)
+        if i is None:
+            i = self._next_id
+            self._next_id += 1
+            self._intern[s] = i
+            self._fresh.append((i, s.encode("utf-8", "surrogatepass")))
+        return i
+
+    # ------------------------------------------------------------------
+    # engine liveness + policy fallback
+    # ------------------------------------------------------------------
+    def engine_alive(self) -> bool:
+        _epoch, health, _gen, wall = self.control.engine_view()
+        if health == HEALTH_CLOSED:
+            return False
+        if wall == 0:
+            return False  # plane never heartbeat — not serving
+        return (_wall_ms() - wall) <= self.engine_dead_ms
+
+    def _policy_verdict(self, resource: str) -> fr.IpcVerdict:
+        default, overrides = self.control.read_policy()
+        mode = overrides.get(resource, default)
+        self.counters["policy_served"] += 1
+        if mode == "closed":
+            return fr.IpcVerdict(
+                False, E.BLOCK_FAILOVER, 0, degraded=True
+            )
+        return fr.IpcVerdict(True, E.PASS, 0, degraded=True)
+
+    def _shed_verdict(self, n: int = 1) -> fr.IpcVerdict:
+        with self._lock:
+            self._shed_total += n
+            self.counters["sheds"] += n
+            # Cumulative count in our control slot (the plane folds the
+            # delta into the engine's valve accounting even when no
+            # frame ever gets through). Under the lock: the slot write
+            # is a read-modify-write, and two shedding threads must not
+            # lose an update.
+            try:
+                self.control.note_worker_shed(self.worker_id, n)
+            except (ValueError, TypeError):
+                pass
+        return fr.IpcVerdict(False, E.BLOCK_SHED, 0, limit_type="ipc_ring")
+
+    # ------------------------------------------------------------------
+    # the API surface
+    # ------------------------------------------------------------------
+    def entry(
+        self,
+        resource: str,
+        context_name: str = "",
+        origin: str = "",
+        acquire: int = 1,
+        entry_type: int = 1,  # models.constants.EntryType.OUT — the engine API default
+        args: Sequence[object] = (),
+        ts: Optional[int] = None,
+        trace=None,
+        timeout_ms: Optional[int] = None,
+    ) -> fr.IpcVerdict:
+        """One blocking admission through the plane. ``trace`` is an
+        object with ``trace_id``/``span_id``/``sampled`` (e.g. a
+        TraceContext); None reads the ambient contextvar so adapter
+        code keeps working unchanged inside a worker."""
+        _check_entry_type(entry_type)
+        if not self.engine_alive():
+            return self._policy_verdict(resource)
+        if trace is None:
+            trace = _ambient_trace()
+        packed = (
+            fr.pack_trace(trace.trace_id, trace.span_id, trace.sampled)
+            if trace is not None
+            else fr.EMPTY_TRACE
+        )
+        args_blob = fr.encode_args(args)
+        if (
+            fr.ENTRY_ROW_BYTES + len(args_blob)
+            > self.channel.slot_bytes - fr.FRAME_RESERVE
+        ):
+            # A row that can never fit a slot is a config/caller
+            # mismatch, not backpressure — it must not read as a shed.
+            raise ValueError(
+                "entry: encoded args exceed the frame budget — raise "
+                "sentinel.tpu.ipc.slot.bytes or shrink the args"
+            )
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            row = fr.EntryRow(
+                seq=seq,
+                resource_id=self._intern_locked(resource),
+                context_id=self._intern_locked(context_name),
+                origin_id=self._intern_locked(origin),
+                entry_type=int(entry_type),
+                acquire=int(acquire),
+                ts=-1 if ts is None else int(ts),
+                trace=packed,
+                args=args_blob,
+            )
+            w = _Waiter(1)
+            self._waiters[seq] = w
+            ok = self._push_locked(
+                lambda interns: fr.encode_entries(
+                    self.worker_id, [row], interns, self._intern_gen,
+                    self._shed_total,
+                )
+            )
+            if not ok:
+                del self._waiters[seq]
+        if not ok:
+            return self._shed_verdict()
+        self.counters["entries"] += 1
+        self.counters["frames"] += 1
+        return self._await_one(w, seq, resource, timeout_ms)
+
+    def bulk(
+        self,
+        resource: str,
+        n: int,
+        ts=None,
+        acquire=1,
+        context_name: str = "",
+        origin: str = "",
+        entry_type: int = 1,  # EntryType.OUT, like the engine API
+        args_column: Optional[Sequence] = None,
+        timeout_ms: Optional[int] = None,
+    ):
+        """One pre-grouped columnar group (the worker-side
+        ``submit_bulk``): returns dense ``(admitted, reason, wait_ms,
+        flags)`` arrays of length n. Groups larger than one slot's
+        frame budget split transparently — by BYTES, not rows: args
+        payloads count toward the slot budget, so an args-heavy group
+        just splits into more frames instead of building one the ring
+        can never accept (which would read as phantom ring
+        backpressure). A single row whose args alone exceed the budget
+        raises ValueError — that is a config/caller mismatch, not
+        backpressure."""
+        if n < 1:
+            raise ValueError("bulk: n must be >= 1")
+        _check_entry_type(entry_type)
+        if not self.engine_alive():
+            v = self._policy_verdict(resource)
+            return _dense(n, v)
+        ts_col = np.broadcast_to(
+            np.asarray(-1 if ts is None else ts, dtype=np.int64), (n,)
+        )
+        acq_col = np.broadcast_to(
+            np.asarray(acquire, dtype=np.int32), (n,)
+        )
+        budget = self.channel.slot_bytes - fr.FRAME_RESERVE
+        args_blobs: Optional[List[bytes]] = None
+        if args_column is not None:
+            args_blobs = [fr.encode_args(a) for a in args_column]
+        # Greedy byte-budget chunking: [lo, hi) windows whose encoded
+        # rows fit one slot.
+        chunks: List[tuple] = []
+        lo = 0
+        size = 0
+        for j in range(n):
+            row_bytes = fr.ENTRY_ROW_BYTES + (
+                len(args_blobs[j]) if args_blobs is not None else 0
+            )
+            if row_bytes > budget:
+                raise ValueError(
+                    f"bulk: row {j}'s encoded args ({row_bytes}B) exceed "
+                    f"the frame budget ({budget}B) — raise "
+                    "sentinel.tpu.ipc.slot.bytes or shrink the args"
+                )
+            if size + row_bytes > budget and j > lo:
+                chunks.append((lo, j))
+                lo = j
+                size = 0
+            size += row_bytes
+        chunks.append((lo, n))
+        out_a = np.zeros(n, dtype=bool)
+        out_r = np.zeros(n, dtype=np.int16)
+        out_w = np.zeros(n, dtype=np.int32)
+        out_f = np.zeros(n, dtype=np.uint8)
+        for lo, hi in chunks:
+            m = hi - lo
+            with self._lock:
+                base = self._seq
+                self._seq += m
+                rid = self._intern_locked(resource)
+                cid = self._intern_locked(context_name)
+                oid = self._intern_locked(origin)
+                rows = [
+                    fr.EntryRow(
+                        seq=base + j,
+                        resource_id=rid, context_id=cid, origin_id=oid,
+                        entry_type=int(entry_type),
+                        acquire=int(acq_col[lo + j]),
+                        ts=int(ts_col[lo + j]),
+                        trace=fr.EMPTY_TRACE,
+                        args=(
+                            args_blobs[lo + j]
+                            if args_blobs is not None else b""
+                        ),
+                    )
+                    for j in range(m)
+                ]
+                w = _Waiter(m)
+                for j in range(m):
+                    self._waiters[base + j] = w
+                ok = self._push_locked(
+                    lambda interns: fr.encode_entries(
+                        self.worker_id, rows, interns, self._intern_gen,
+                        self._shed_total, kind=fr.KIND_BULK,
+                    )
+                )
+                if not ok:
+                    for j in range(m):
+                        del self._waiters[base + j]
+            if not ok:
+                sv = self._shed_verdict(m)
+                out_a[lo:hi] = sv.admitted
+                out_r[lo:hi] = sv.reason
+                continue
+            self.counters["bulk_rows"] += m
+            self.counters["frames"] += 1
+            got = self._await_many(w, range(base, base + m), resource,
+                                   timeout_ms)
+            for j, (adm, rsn, wms, fl) in enumerate(got):
+                out_a[lo + j] = adm
+                out_r[lo + j] = rsn
+                out_w[lo + j] = wms
+                out_f[lo + j] = fl
+        return out_a, out_r, out_w, out_f
+
+    def exit(
+        self,
+        resource: str,
+        context_name: str = "",
+        origin: str = "",
+        entry_type: int = 1,  # EntryType.OUT, like the engine API
+        rt: int = 0,
+        count: int = 1,
+        err: int = 0,
+        ts: Optional[int] = None,
+        speculative: Optional[bool] = None,
+    ) -> bool:
+        """One completion. Never shed: retries a full ring with a short
+        backoff, dropping only once the engine is gone (False).
+
+        The (resource, context, origin, entry_type) identity MUST
+        match the entry's — it is how the engine-side plane resolves
+        the node rows to release and how the live-admission ledger
+        pairs the completion with its admit (a mismatched identity
+        releases the wrong rows AND leaves the ledger entry live for a
+        spurious dead-worker release later). The in-process API has
+        the same contract, just structural: there the caller passes
+        the entry's ``rows`` tuple back.
+
+        One bounded exception to "never dropped while the engine
+        lives": a ring that stays full past ``timeout.ms`` with a
+        still-heartbeating engine means the DRAINER is wedged (the
+        control thread beats independently) — the completion is then
+        dropped and counted in ``exits_dropped`` rather than pinning
+        this caller thread forever; the dead-worker reap releases the
+        admission once this worker eventually exits."""
+        _check_entry_type(entry_type)
+        deadline = time.monotonic() + self.timeout_ms / 1e3
+        delay = 0.0002
+        while True:
+            # (Re)build under the lock on EVERY attempt: a failed push
+            # rolled its fresh interns back, so a retried payload must
+            # re-intern (carrying stale ids the plane never learned
+            # would decode-drop the completion).
+            with self._lock:
+                seq = self._seq
+                self._seq += 1
+                row = fr.ExitRow(
+                    seq=seq,
+                    resource_id=self._intern_locked(resource),
+                    context_id=self._intern_locked(context_name),
+                    origin_id=self._intern_locked(origin),
+                    entry_type=int(entry_type),
+                    ts=-1 if ts is None else int(ts),
+                    rt=int(rt), count=int(count), err=int(err),
+                    spec=(
+                        0 if speculative is None
+                        else (1 if speculative else 2)
+                    ),
+                )
+                ok = self._push_locked(
+                    lambda interns: fr.encode_exits(
+                        self.worker_id, [row], interns, self._intern_gen,
+                        self._shed_total,
+                    )
+                )
+            if ok:
+                self.counters["exits"] += 1
+                return True
+            if not self.engine_alive() or time.monotonic() > deadline:
+                with self._lock:
+                    self.counters["exits_dropped"] += 1
+                return False
+            time.sleep(delay)
+            delay = min(delay * 2, 0.005)
+
+    # ------------------------------------------------------------------
+    # waiting
+    # ------------------------------------------------------------------
+    def _await_one(
+        self, w: _Waiter, seq: int, resource: str,
+        timeout_ms: Optional[int],
+    ) -> fr.IpcVerdict:
+        t = (timeout_ms or self.timeout_ms) / 1e3
+        deadline = time.monotonic() + t
+        while True:
+            if w.event.wait(timeout=0.05):
+                v = w.verdicts.get(seq)
+                if v is not None:
+                    return _to_verdict(v)
+                w.event.clear()
+            if time.monotonic() > deadline or not self.engine_alive():
+                with self._lock:
+                    self._waiters.pop(seq, None)
+                return self._policy_verdict(resource)
+
+    def _await_many(
+        self, w: _Waiter, seqs, resource: str, timeout_ms: Optional[int]
+    ) -> List[tuple]:
+        t = (timeout_ms or self.timeout_ms) / 1e3
+        deadline = time.monotonic() + t
+        while True:
+            if w.event.wait(timeout=0.05):
+                if len(w.verdicts) >= w.need:
+                    break
+                w.event.clear()
+            if time.monotonic() > deadline or not self.engine_alive():
+                break
+        with self._lock:
+            for s in seqs:
+                self._waiters.pop(s, None)
+        out = []
+        pol = None
+        for s in seqs:
+            v = w.verdicts.get(s)
+            if v is None:
+                if pol is None:
+                    p = self._policy_verdict(resource)
+                    pol = (
+                        1 if p.admitted else 0, p.reason, 0,
+                        fr.F_DEGRADED,
+                    )
+                v = pol
+            out.append(v)
+        return out
+
+    def _read_loop(self) -> None:
+        while not self._stop.is_set():
+            payloads = self.response.pop_all(limit=64)
+            if not payloads:
+                time.sleep(0.0002)
+                continue
+            for p in payloads:
+                try:
+                    f = fr.decode_frame(p)
+                except (ValueError, struct_error):
+                    continue
+                if f.kind != fr.KIND_VERDICT:
+                    continue
+                seqs = f.columns["seq"].tolist()
+                adm = f.columns["admitted"].tolist()
+                rsn = f.columns["reason"].tolist()
+                wms = f.columns["wait_ms"].tolist()
+                fl = f.columns["flags"].tolist()
+                with self._lock:
+                    hit: Dict[_Waiter, bool] = {}
+                    for i, s in enumerate(seqs):
+                        w = self._waiters.pop(s, None)
+                        if w is None:
+                            continue
+                        w.verdicts[s] = (adm[i], rsn[i], wms[i], fl[i])
+                        hit[w] = True
+                for w in hit:
+                    w.event.set()
+
+    def _beat_loop(self) -> None:
+        pid = os.getpid()
+        while not self._stop.wait(self.heartbeat_ms / 1e3):
+            try:
+                self.control.beat_worker(self.worker_id, pid)
+            except (ValueError, TypeError):
+                return
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def close(self, clear_slot: bool = True) -> None:
+        self._stop.set()
+        self._reader.join(timeout=2.0)
+        if self._beat is not None:
+            self._beat.join(timeout=2.0)
+        if clear_slot:
+            try:
+                self.control.clear_worker(self.worker_id)
+            except (ValueError, TypeError):
+                pass
+        self.request.close()
+        self.response.close()
+        self.control.close()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "worker_id": self.worker_id,
+                "engine_alive": self.engine_alive(),
+                "counters": dict(self.counters),
+                "interned": len(self._intern),
+                "pending_waits": len(self._waiters),
+            }
+
+
+def _check_entry_type(entry_type) -> None:
+    # Validate at the API edge: the wire carries a bare int8, and the
+    # plane per-row-sheds anything it cannot map back to an EntryType
+    # — failing HERE turns a silent shed into the caller's bug report.
+    if int(entry_type) not in (0, 1):
+        raise ValueError(
+            f"entry_type must be 0 (IN) or 1 (OUT), got {entry_type!r}"
+        )
+
+
+def _ambient_trace():
+    from sentinel_tpu.core.context import ContextUtil
+
+    return ContextUtil.get_trace()
+
+
+def _to_verdict(v: tuple) -> fr.IpcVerdict:
+    adm, rsn, wms, fl = v
+    return fr.IpcVerdict(
+        admitted=bool(adm),
+        reason=int(rsn),
+        wait_ms=int(wms),
+        limit_type="ipc_ring" if rsn == E.BLOCK_SHED else "",
+        degraded=bool(fl & fr.F_DEGRADED),
+        speculative=bool(fl & fr.F_SPECULATIVE),
+    )
+
+
+def _dense(n: int, v: fr.IpcVerdict):
+    fl = (fr.F_SPECULATIVE if v.speculative else 0) | (
+        fr.F_DEGRADED if v.degraded else 0
+    )
+    return (
+        np.full(n, v.admitted, dtype=bool),
+        np.full(n, v.reason, dtype=np.int16),
+        np.full(n, v.wait_ms, dtype=np.int32),
+        np.full(n, fl, dtype=np.uint8),
+    )

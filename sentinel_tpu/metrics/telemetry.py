@@ -287,6 +287,19 @@ class TelemetryBus:
             # Self-tuning control plane (runtime/autotune.py): applied
             # knob changes (depth / window retunes).
             "autotune_decisions": 0,
+            # Sketch-tier cold-key admission ceiling
+            # (sentinel.tpu.sketch.cold.qps): submits blocked from the
+            # host count-min twin's estimate.
+            "sketch_cold_blocks": 0,
+            # Multi-process ingest plane (sentinel_tpu/ipc): request
+            # frames drained / rows carried, worker-side ring-full
+            # sheds folded in, dead-worker reaps with their auto-exited
+            # live admissions.
+            "ipc_frames": 0,
+            "ipc_requests": 0,
+            "ipc_sheds": 0,
+            "ipc_worker_deaths": 0,
+            "ipc_auto_exits": 0,
         }
         # Bounded ring of health transitions (now_ms is engine-clock
         # relative ms): the flight-recorder view of the failover state
@@ -509,6 +522,27 @@ class TelemetryBus:
     def note_autotune_decision(self, n: int = 1) -> None:
         with self._lock:
             self.counters["autotune_decisions"] += n
+
+    def note_sketch_cold_block(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["sketch_cold_blocks"] += n
+
+    # ------------------------------------------------------------------
+    # multi-process ingest plane (sentinel_tpu/ipc)
+    # ------------------------------------------------------------------
+    def note_ipc_frames(self, frames: int, rows: int) -> None:
+        with self._lock:
+            self.counters["ipc_frames"] += frames
+            self.counters["ipc_requests"] += rows
+
+    def note_ipc_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["ipc_sheds"] += n
+
+    def note_ipc_worker_death(self, released: int) -> None:
+        with self._lock:
+            self.counters["ipc_worker_deaths"] += 1
+            self.counters["ipc_auto_exits"] += released
 
     def fold_blocked_topk(self, pairs: Sequence[Tuple[str, int]]) -> None:
         """Fold one flush's device top-K (already name-resolved) into

@@ -388,6 +388,18 @@ class AutoTuner:
             explore=config.get_int(config.AUTOTUNE_PARAM_EXPLORE, 3),
             margin=config.get_float(config.AUTOTUNE_PARAM_MARGIN, 0.15),
         )
+        # Measured per-shape closed-vs-scan timings from a k2probe run
+        # (sentinel.tpu.autotune.param.seed.file): the memo starts
+        # COMMITTED to the measured winner per bucket instead of paying
+        # the explore phase live. A missing/bad file is logged and
+        # ignored — seeding is an optimization, never a correctness
+        # dependency.
+        self.seeded_buckets = 0
+        seed_path = (
+            config.get(config.AUTOTUNE_PARAM_SEED_FILE) or ""
+        ).strip()
+        if seed_path and self.param_active:
+            self.seeded_buckets = self._load_seed(seed_path)
         self.decisions: "deque[dict]" = deque(
             maxlen=max(16, config.get_int(config.AUTOTUNE_LOG, 256))
         )
@@ -421,6 +433,48 @@ class AutoTuner:
             "depth_lowers": 0,
             "window_retunes": 0,
         }
+
+    def _load_seed(self, path: str) -> int:
+        """Load a ``tools/k2probe.py --seed-out`` file into the memo.
+        Format: ``{"buckets": [{"rows_bucket", "segments", "closed_ms",
+        "scan_ms"}, ...]}`` (a bare list of the same entries is also
+        accepted). Returns the number of buckets seeded."""
+        import json
+
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as exc:
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log.error(
+                "[AutoTuner] param seed file %s unreadable: %s", path, exc
+            )
+            return 0
+        entries = data.get("buckets", []) if isinstance(data, dict) else data
+        if not isinstance(entries, list):
+            # Valid JSON, wrong shape (a scalar / object root): the
+            # "bad file is ignored" contract covers this too — a seed
+            # file must never be able to fail engine construction.
+            from sentinel_tpu.utils.record_log import record_log
+
+            record_log.error(
+                "[AutoTuner] param seed file %s has no bucket list", path
+            )
+            return 0
+        n = 0
+        for e in entries:
+            try:
+                bucket = (int(e["rows_bucket"]), int(e["segments"]))
+                closed = float(e["closed_ms"])
+                scan = float(e["scan_ms"])
+            except (TypeError, KeyError, ValueError, AttributeError):
+                continue
+            if closed < 0 or scan < 0:
+                continue
+            self.memo.seed(bucket, closed, scan)
+            n += 1
+        return n
 
     # ------------------------------------------------------------------
     # param-path pick (engine._encode_param; under the flush lock)
@@ -629,6 +683,7 @@ class AutoTuner:
             "window_ms": eng.ingest_window.window_ms,
             "window_batch_max": eng.ingest_window.batch_max,
             "param_path": self.param_active,
+            "param_seed_buckets": self.seeded_buckets,
             "counters": counters,
             "decisions": decisions,
             "param_memo": self.memo.snapshot(),

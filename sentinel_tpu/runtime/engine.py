@@ -795,6 +795,11 @@ class Engine:
         from sentinel_tpu.runtime.autotune import AutoTuner
 
         self.autotune = AutoTuner(self)
+        # Param-path measurement seam: None (always) in production;
+        # "closed"/"scan" pins closed-form-eligible batches to one path
+        # (tools/k2probe.py --seed-out times both arms per shape; the
+        # scan/counted tests pin attribution with it).
+        self.param_force_path: Optional[str] = None
         # True when a close()/stop could not join a worker thread in
         # time — the shutdown LOOKED clean but leaked a live thread.
         self.closed_dirty = False
@@ -807,6 +812,15 @@ class Engine:
         from sentinel_tpu.metrics.block_log import BlockLogger
 
         self.block_log = BlockLogger(clock=self.clock)
+        # Multi-process ingest plane (sentinel_tpu/ipc): N worker
+        # processes feed this engine through shared-memory rings.
+        # Disarmed (the default) this attribute is the ENTIRE footprint
+        # — no shared memory, no thread, nothing on any hot path.
+        self.ipc_plane = None
+        if config.get_bool(config.IPC_ENABLED, False):
+            from sentinel_tpu.ipc.plane import IngestPlane
+
+            IngestPlane(self)  # registers itself as self.ipc_plane
 
     # ------------------------------------------------------------------
     # multi-chip mode
@@ -1082,14 +1096,28 @@ class Engine:
                 resource, context_name, origin, acquire, entry_type, prio,
                 ts, tuple(args),
             )
+        sk = self.sketch
         if op is None:
             # Over-cap pass-through: the ONE key class the encode path
             # never sees — the sketch tier tracks it anyway (O(1)
             # device state), and a promotion later grants the dense
             # row the cap refused (runtime/sketch.py).
-            if self.sketch.armed:
-                self.sketch.note_unrouted(resource, acquire)
+            if sk.armed:
+                if sk.cold_armed and sk.cold_blocked(
+                    resource, findex, self.param_index
+                ):
+                    return self._blocked_cold(
+                        resource, context_name, origin, acquire
+                    )
+                sk.note_unrouted(resource, acquire)
             return None
+        if sk.cold_armed and sk.cold_blocked(
+            resource, findex, self.param_index
+        ):
+            # Routed but unconfigured (no rule of any kind): the cold
+            # ceiling is its ONLY protection — blocked ops are never
+            # enqueued, exactly like a valve shed.
+            return self._blocked_cold(resource, context_name, origin, acquire)
         # Trace tag OUTSIDE the lock: the stamp (RNG draw, clock read,
         # contextvar get) doesn't depend on the index snapshot, and the
         # submit path's critical section is the throughput ceiling.
@@ -1118,45 +1146,73 @@ class Engine:
             self.flush()  # flush-on-size: the pending buffer is bounded
         return op
 
-    def _shed_entry(
+    def _refused_entry(
         self, resource: str, context_name: str, origin: str, acquire: int,
-        cause: str,
+        reason: int, limit_type: str, provenance: str,
+        count_shed: bool,
     ) -> _EntryOp:
-        """Build a never-enqueued op carrying a fast BLOCK_SHED verdict
-        (runtime/ingest.py tripped at submit): the caller sees the same
-        op/verdict surface as any blocked entry, with full provenance —
-        a trace record (``provenance="shed"``), a block-log row under
-        IngestShedException, nothing on the device and nothing queued.
-        Exits/traces are never shed, so no gauge ever charges."""
+        """The ONE home of the never-enqueued refused-entry contract
+        (valve sheds AND sketch cold-ceiling blocks): the caller sees
+        the same op/verdict surface as any blocked entry, with full
+        provenance — a trace record, a block-log row under the
+        reason's exception name, nothing on the device and nothing
+        queued, so no gauge ever charges. ``count_shed`` routes the
+        refusal into the per-resource provenance ledger's shed column
+        (the valve's refusals are load shedding; the cold ceiling's
+        are policy and stay out of that column)."""
         op = _EntryOp(
             resource=resource, ts=self.clock.now_ms(), acquire=acquire,
             rows=(-1, -1, -1, -1), slots=[],
             context_name=context_name, origin=origin,
         )
         op.verdict = Verdict(
-            admitted=False, reason=E.BLOCK_SHED, wait_ms=0,
-            blocked_rule=None, limit_type=cause,
+            admitted=False, reason=reason, wait_ms=0,
+            blocked_rule=None, limit_type=limit_type,
         )
         tracer = self.admission_trace
         if tracer.enabled:
             tracer.record_admission(
                 tracer.make_tag(), resource, origin, context_name,
-                False, E.BLOCK_SHED, -1, time.perf_counter(),
-                provenance="shed",
+                False, reason, -1, time.perf_counter(),
+                provenance=provenance,
             )
         self.block_log.log_blocked(
-            resource, E.BLOCK_SHED, origin=origin, count=acquire
+            resource, reason, origin=origin, count=acquire
         )
-        if self.resource_metrics.enabled:
+        if count_shed and self.resource_metrics.enabled:
             self.resource_metrics.note(op.ts, resource, shed=acquire)
         return op
 
-    def _shed_bulk(
+    def _shed_entry(
+        self, resource: str, context_name: str, origin: str, acquire: int,
+        cause: str,
+    ) -> _EntryOp:
+        """Never-enqueued BLOCK_SHED verdict (runtime/ingest.py tripped
+        at submit). Exits/traces are never shed."""
+        return self._refused_entry(
+            resource, context_name, origin, acquire,
+            reason=E.BLOCK_SHED, limit_type=cause, provenance="shed",
+            count_shed=True,
+        )
+
+    def _blocked_cold(
+        self, resource: str, context_name: str, origin: str, acquire: int
+    ) -> _EntryOp:
+        """Never-enqueued sketch cold-ceiling verdict (runtime/
+        sketch.py ``cold_blocked``; counting happened there)."""
+        return self._refused_entry(
+            resource, context_name, origin, acquire,
+            reason=E.BLOCK_SKETCH, limit_type="cold",
+            provenance="sketch_cold", count_shed=False,
+        )
+
+    def _refused_bulk(
         self, resource: str, n: int, context_name: str, origin: str,
-        acquire, cause: str,
+        acquire, reason: int, provenance: str, count_shed: bool,
     ) -> BulkOp:
-        """Bulk analog of :meth:`_shed_entry`: dense all-shed arrays,
-        never enqueued."""
+        """Bulk analog of :meth:`_refused_entry`: dense all-refused
+        arrays, never enqueued (array verdicts carry no limit_type —
+        the reason code is the whole attribution, as before)."""
         acq_col = self._bulk_col(acquire, n, 1)
         g = BulkOp(
             resource=resource, n=n,
@@ -1165,23 +1221,41 @@ class Engine:
             auth_ok=True, context_name=context_name, origin=origin,
         )
         g.admitted = np.zeros(n, dtype=bool)
-        g.reason = np.full(n, E.BLOCK_SHED, dtype=np.int32)
+        g.reason = np.full(n, reason, dtype=np.int32)
         g.wait_ms = np.zeros(n, dtype=np.int32)
         tracer = self.admission_trace
         if tracer.enabled:
             tracer.record_bulk(
                 tracer.make_tag(), resource, origin, context_name,
                 g._admitted, g._reason, -1, time.perf_counter(),
-                provenance="shed",
+                provenance=provenance,
             )
         self.block_log.log_blocked(
-            resource, E.BLOCK_SHED, origin=origin, count=int(acq_col.sum())
+            resource, reason, origin=origin, count=int(acq_col.sum())
         )
-        if self.resource_metrics.enabled:
+        if count_shed and self.resource_metrics.enabled:
             self.resource_metrics.note(
                 int(g.ts[0]), resource, shed=int(acq_col.sum())
             )
         return g
+
+    def _blocked_cold_bulk(
+        self, resource: str, n: int, context_name: str, origin: str, acquire
+    ) -> BulkOp:
+        return self._refused_bulk(
+            resource, n, context_name, origin, acquire,
+            reason=E.BLOCK_SKETCH, provenance="sketch_cold",
+            count_shed=False,
+        )
+
+    def _shed_bulk(
+        self, resource: str, n: int, context_name: str, origin: str,
+        acquire, cause: str,
+    ) -> BulkOp:
+        return self._refused_bulk(
+            resource, n, context_name, origin, acquire,
+            reason=E.BLOCK_SHED, provenance="shed", count_shed=True,
+        )
 
     def _resolve_entry_locked(
         self, findex, dindex, pindex, resource, context_name, origin,
@@ -1265,6 +1339,13 @@ class Engine:
                     )
                     for req in requests
                 ]
+        if self.sketch.cold_armed:
+            # The cold-key ceiling must see every resource, and its
+            # estimate read takes the sketch lock — route the batch
+            # through the per-op path (the ceiling is an opt-in
+            # approximate mode; the lock-amortized fast loop stays the
+            # default).
+            return [self.submit_entry(**req) for req in requests]
         out: List[Optional[_EntryOp]] = []
         resume_at = 0
         over = False
@@ -1674,6 +1755,16 @@ class Engine:
                 return self._shed_bulk(
                     resource, n, context_name, origin, acquire, cause
                 )
+        sk = self.sketch
+        if sk.cold_armed and sk.cold_blocked(
+            resource, self.flow_index, self.param_index, n=n
+        ):
+            # Cold-key ceiling (runtime/sketch.py): covers both the
+            # over-cap and the routed-but-unconfigured group classes
+            # before any state is touched.
+            return self._blocked_cold_bulk(
+                resource, n, context_name, origin, acquire
+            )
         with self._lock:
             findex = self.flow_index
             dindex = self.degrade_index
@@ -2088,15 +2179,23 @@ class Engine:
             ts[:n_items], acquire[:n_items],
         )
         if n_items:
-            at = self.autotune
-            if rounds <= -1 and at.param_active:
+            if self.param_force_path is not None:
+                # Measurement seam (tools/k2probe.py --seed-out, path-
+                # pinning tests): "scan" substitutes the rounds bound
+                # the memo's scan arm would have computed for an
+                # ELIGIBLE batch; "closed" keeps the closed-form pick.
+                # Ineligible batches (rounds > -1 already) stay on
+                # their correctness-mandated scan either way.
+                if self.param_force_path == "scan" and rounds <= -1:
+                    rounds = _rounds_bucket(prow[:n_items])
+            elif rounds <= -1 and self.autotune.param_active:
                 # Closed-form-ELIGIBLE batch: the autotuner's shape-
                 # bucketed cost memo arbitrates closed-form vs the
                 # rounds/scan family (eligibility above is correctness;
                 # this is purely a cost call). The scan-side rounds
                 # bound is only computed when the memo actually picks
                 # it.
-                rounds = at.pick_param_rounds(
+                rounds = self.autotune.pick_param_rounds(
                     n_items, -rounds, rounds,
                     lambda: _rounds_bucket(prow[:n_items]),
                 )
@@ -2246,7 +2345,13 @@ class Engine:
         itself; the trailing drain() covers the pipelined flush (depth
         > 0), which deliberately leaves up to ``pipeline_depth``
         dispatches in flight."""
-        # The window first: its flusher thread calls flush() itself,
+        # The ipc plane first: its drainer submits into this engine,
+        # and closing it publishes the CLOSED health word so worker
+        # processes fail over to the policy snapshot instead of
+        # stranding on their verdict waits.
+        if self.ipc_plane is not None:
+            self.ipc_plane.close()
+        # The window next: its flusher thread calls flush() itself,
         # and its final window's waiters must be served, not stranded.
         self.ingest_window.close()
         self.stop_auto_flush()
@@ -4213,6 +4318,11 @@ class Engine:
         self.ingest.reset()
         self.resource_metrics.reset()
         self.sketch.reset()
+        if self.ipc_plane is not None:
+            # The plane's live-admission ledgers reference the node
+            # rows this reset is about to rebuild — drop them (and
+            # re-intern) rather than release stale rows later.
+            self.ipc_plane.on_engine_reset()
         with self._flush_lock, self._lock:
             self._entries.clear()
             self._exits.clear()

@@ -94,6 +94,28 @@ RECOVERING = "RECOVERING"
 HEALTH_GAUGE = {HEALTHY: 0, DEGRADED: 1, RECOVERING: 2}
 
 
+def parse_policy(raw: str) -> Tuple[str, Dict[str, str]]:
+    """``"open"`` / ``"closed"`` / ``"open,resA=closed,resB=open"`` —
+    the first ``=``-less segment is the default; unknown modes fall
+    back to open (never make a config typo an outage). The ONE home of
+    the ``sentinel.tpu.failover.policy`` format, shared by the host
+    fallback admitter and the ipc plane's control-header snapshot."""
+    default = "open"
+    by_res: Dict[str, str] = {}
+    for seg in str(raw).split(","):
+        seg = seg.strip()
+        if not seg:
+            continue
+        if "=" in seg:
+            res, _, mode = seg.partition("=")
+            by_res[res.strip()] = (
+                "closed" if mode.strip().lower() == "closed" else "open"
+            )
+        else:
+            default = "closed" if seg.lower() == "closed" else "open"
+    return default, by_res
+
+
 class DeviceFetchTimeout(RuntimeError):
     """The flush watchdog's verdict: a dispatch or device→host fetch
     exceeded ``sentinel.tpu.failover.fetch.timeout.ms``."""
@@ -419,24 +441,7 @@ class HostFallbackAdmitter:
             self._track_deltas = not self.persistent
 
     def _parse_policy(self, raw: str) -> None:
-        """``"open"`` / ``"closed"`` / ``"open,resA=closed,resB=open"``
-        — the first ``=``-less segment is the default; unknown modes
-        fall back to open (never make a config typo an outage)."""
-        default = "open"
-        by_res: Dict[str, str] = {}
-        for seg in str(raw).split(","):
-            seg = seg.strip()
-            if not seg:
-                continue
-            if "=" in seg:
-                res, _, mode = seg.partition("=")
-                by_res[res.strip()] = (
-                    "closed" if mode.strip().lower() == "closed" else "open"
-                )
-            else:
-                default = "closed" if seg.lower() == "closed" else "open"
-        self._policy_default = default
-        self._policy_by_resource = by_res
+        self._policy_default, self._policy_by_resource = parse_policy(raw)
 
     def policy_for(self, resource: str) -> str:
         with self._lock:

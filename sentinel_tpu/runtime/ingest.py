@@ -79,6 +79,11 @@ class IngestValve:
             "shed_rows": 0,
             "shed_queue": 0,
             "shed_deadline": 0,
+            # Worker-side ring-full sheds from the multi-process plane
+            # (sentinel_tpu/ipc): the decision is local to the worker,
+            # but it is load shedding of THIS engine's ingest, so it
+            # lands in the same accounting (cause "ring").
+            "shed_ring": 0,
         }
 
     # ------------------------------------------------------------------
@@ -146,6 +151,15 @@ class IngestValve:
             self._note_shed(0, rows, "deadline")
             return "deadline"
         return None
+
+    def note_ipc_shed(self, n: int) -> None:
+        """Fold ``n`` worker-side ring-full sheds (cause ``ring``) into
+        the valve's accounting — reported by the ipc plane, which reads
+        the workers' cumulative counts out of the control header. Not
+        gated on ``armed``: the plane's ring bound is its own valve."""
+        with self._lock:
+            self.counters["shed_entries"] += n
+            self.counters["shed_ring"] += n
 
     def _note_shed(self, entries: int, rows: int, cause: str) -> None:
         with self._lock:

@@ -82,6 +82,13 @@ _SEEDS = (
 # steady state) gives hysteresis against flapping.
 PROMOTE_FACTOR = 1.5
 DEMOTE_FACTOR = 0.75
+# Cold-key admission ceiling: a sustained ADMITTED rate q converges to
+# estimate 2*q*window under per-window halving, so blocking at
+# 2x (qps x window) caps the admitted rate at ~the configured ceiling —
+# blocked traffic is never counted, so the estimate decays back under
+# the ceiling when demand does (duty-cycling toward qps, the same
+# approximate stance as the count-min bound itself).
+COLD_ADMIT_FACTOR = 2.0
 
 # Key-kind prefixes (one byte, never part of a user name).
 _KIND_RESOURCE = "\x01"
@@ -305,6 +312,24 @@ class SketchTier:
         self.names_cap = max(
             config.get_int(config.SKETCH_NAMES_CAP, 65536), self.candidates
         )
+        # Cold-key admission ceiling (sentinel.tpu.sketch.cold.qps):
+        # 0 (the default) = today's cold-pass behavior. Armed, the tier
+        # keeps a HOST count-min twin (same hash family, same decay
+        # clock) fed from the same _collect key stream, and the engine
+        # consults it at submit for unpromoted, unconfigured resources
+        # — the gap HashPipe-style promotion leaves open (a key can
+        # burn the full budget while staying under every promotion
+        # threshold). The twin is host-side by design, so the ceiling
+        # stays enforced while DEGRADED (fold_host_chunk runs the same
+        # _collect).
+        self.cold_qps = max(0.0, config.get_float(config.SKETCH_COLD_QPS, 0.0))
+        self.cold_armed = self.enabled and self.cold_qps > 0
+        self._host_cm: Optional[np.ndarray] = (
+            np.zeros((self.depth, self.width), dtype=np.int64)
+            if self.cold_armed
+            else None
+        )
+        self.cold_blocks = 0
         self._lock = threading.Lock()
         # id -> key name, bounded LRU (ids are hashes; eviction only
         # ever loses the ABILITY to decode a candidate, never device
@@ -372,9 +397,9 @@ class SketchTier:
         """An over-cap resource's entry passed through WITHOUT an op —
         the one key class that never reaches the encode path. Buffered
         and drained into the next chunk's key stream. With resource
-        promotion disarmed the buffer would only ever be discarded, so
-        the submit hot path pays nothing."""
-        if self.resource_qps <= 0:
+        promotion AND the cold ceiling disarmed the buffer would only
+        ever be discarded, so the submit hot path pays nothing."""
+        if self.resource_qps <= 0 and not self.cold_armed:
             return
         with self._lock:
             self._pending_unrouted.append((resource, int(acquire)))
@@ -382,6 +407,49 @@ class SketchTier:
             # no flush in sight must not grow without limit.
             if len(self._pending_unrouted) > 65536:
                 del self._pending_unrouted[:32768]
+
+    def cold_blocked(
+        self, resource: str, findex, pindex, n: int = 1
+    ) -> bool:
+        """Submit-time cold-key admission ceiling (the admit-by-
+        estimate HashPipe leaves open): True blocks the submit. Applies
+        ONLY to unpromoted resources with no user rule of any kind — a
+        promoted key has an exact dense row, a configured key has its
+        own rules, and both classes must never pay (or be affected by)
+        the approximate path. Blocked traffic is never fed back into
+        the sketch, so the estimate decays toward the ceiling and the
+        admitted rate duty-cycles at ~``cold.qps``."""
+        eng = self._engine
+        if (
+            resource in self._promoted_res
+            or resource in findex.by_resource
+            or resource in pindex.by_resource
+            # "No user rule of ANY kind" means degrade and authority
+            # rules exempt too — an operator who configured a breaker
+            # (and nothing else) on a resource has claimed it, and the
+            # approximate path must never throttle a claimed resource.
+            or resource in eng.degrade_index.by_resource
+            or resource in eng.authority_rules
+        ):
+            return False
+        win_s = self.window_ms / 1000.0
+        ceiling = COLD_ADMIT_FACTOR * self.cold_qps * win_s
+        with self._lock:
+            cm = self._host_cm
+            if cm is None:
+                return False
+            kid = self._ids_for_locked(_KIND_RESOURCE, [resource])
+            est = int(cm_estimate(cm, kid)[0])
+            if est < ceiling:
+                return False
+            # Row-weighted (a blocked bulk group counts its n rows):
+            # the counter reads as "admissions refused", comparable to
+            # the valve's shed accounting.
+            self.cold_blocks += n
+        tele = self._engine.telemetry
+        if tele.enabled:
+            tele.note_sketch_cold_block(n)
+        return True
 
     def decay_due(self, now_ms: int) -> bool:
         """True exactly once per decay window (consumed by the chunk
@@ -398,6 +466,8 @@ class SketchTier:
             for ent in self._exact.values():
                 ent[0] >>= 1
             self.host_mirror.decay()
+            if self._host_cm is not None:
+                self._host_cm >>= 1
             return True
 
     # ------------------------------------------------------------------
@@ -468,7 +538,7 @@ class SketchTier:
 
         with self._lock:
             pend, self._pending_unrouted = self._pending_unrouted, []
-            track_res = self.resource_qps > 0
+            track_res = self.resource_qps > 0 or self.cold_armed
             res_memo: Dict[str, bool] = {}
 
             def tracked(resource: str) -> bool:
@@ -561,6 +631,19 @@ class SketchTier:
                 for (i, ent), p in zip(self._exact.items(), pos.tolist()):
                     if p < len(uids) and uids[p] == i:
                         ent[0] += int(wsum[p])
+            if self._host_cm is not None and len(uids):
+                # Cold-ceiling twin: the same hash family the device
+                # fold uses, fed from the same aggregated key stream —
+                # one np.add.at pass per depth row. Runs on BOTH the
+                # healthy encode and the DEGRADED host fold, which is
+                # what keeps the ceiling enforced while the device is
+                # lost.
+                for di in range(self.depth):
+                    np.add.at(
+                        self._host_cm[di],
+                        _hash_np(uids, di, self.width),
+                        wsum,
+                    )
         return uids, wsum
 
     @staticmethod
@@ -896,6 +979,9 @@ class SketchTier:
             self.est_error_ratio = 0.0
             self.occupancy = 0.0
             self.host_mirror.clear()
+            if self._host_cm is not None:
+                self._host_cm[:] = 0
+            self.cold_blocks = 0
         self.reset_device_state()
 
     # ------------------------------------------------------------------
@@ -946,6 +1032,8 @@ class SketchTier:
             "resource_qps": self.resource_qps,
             "promote_max": self.promote_max,
             "demote_windows": self.demote_windows,
+            "cold_qps": self.cold_qps,
+            "cold_blocks": self.cold_blocks,
             "occupancy": round(self.occupancy, 4),
             "est_error_ratio": round(self.est_error_ratio, 6),
             "promoted_count": self.promoted_count,

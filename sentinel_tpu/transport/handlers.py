@@ -498,6 +498,29 @@ def autotune_handler(req: CommandRequest) -> CommandResponse:
 
 
 @command_mapping(
+    "ipc",
+    "multi-process ingest plane: ring occupancy, live workers, frame"
+    " counters, intern generation",
+)
+def ipc_handler(req: CommandRequest) -> CommandResponse:
+    """The scale-out front-end view (sentinel_tpu/ipc): whether the
+    shared-memory plane is serving, how full the request ring runs,
+    which worker slots are attached (with their live-admission ledger
+    sizes), and the frame/shed/death counters — the one place that
+    tells 'the engine is slow' from 'a worker died and its gauges were
+    auto-exited'."""
+    engine = _engine()
+    plane = getattr(engine, "ipc_plane", None)
+    if plane is None:
+        return CommandResponse.of_json(
+            {"enabled": False, "flush_seq": engine.flush_seq}
+        )
+    out = plane.snapshot()
+    out["flush_seq"] = engine.flush_seq
+    return CommandResponse.of_json(out)
+
+
+@command_mapping(
     "traces",
     "sampled admission trace records: [?n=N][&resource=][&reason=code|name]",
 )

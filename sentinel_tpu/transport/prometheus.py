@@ -426,6 +426,58 @@ def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
             "Mean relative overestimate of candidate counts vs exact host counters",
             round(tier.est_error_ratio, 6),
         )
+        out += ctr(
+            f"{p}_sketch_cold_blocks_total",
+            "Submits blocked by the cold-key admission ceiling "
+            "(sentinel.tpu.sketch.cold.qps, count-min estimate)",
+            c.get("sketch_cold_blocks", 0),
+        )
+
+    # Multi-process ingest plane (sentinel_tpu/ipc): ring/worker/frame
+    # counters plus the live ring-occupancy and worker gauges. Rendered
+    # even when the plane is down (zeros) so dashboards keep their
+    # series across restarts.
+    plane = getattr(engine, "ipc_plane", None)
+    out += _gauge(
+        f"{p}_ipc_enabled",
+        "Multi-process ingest plane running (sentinel.tpu.ipc.enabled)",
+        1 if (plane is not None and not plane.closed) else 0,
+    )
+    out += _gauge(
+        f"{p}_ipc_workers",
+        "Worker processes currently attached to the ingest plane",
+        plane.live_workers() if plane is not None else 0,
+    )
+    out += _gauge(
+        f"{p}_ipc_ring_occupancy",
+        "Request-ring slots in use / capacity (0..1)",
+        round(plane.request.occupancy(), 4) if plane is not None else 0.0,
+    )
+    out += ctr(
+        f"{p}_ipc_frames_total",
+        "Request frames drained from the shared-memory ring",
+        c.get("ipc_frames", 0),
+    )
+    out += ctr(
+        f"{p}_ipc_requests_total",
+        "Admission rows carried by drained request frames",
+        c.get("ipc_requests", 0),
+    )
+    out += ctr(
+        f"{p}_ipc_sheds_total",
+        "Worker-side ring-full sheds folded into the valve accounting",
+        c.get("ipc_sheds", 0),
+    )
+    out += ctr(
+        f"{p}_ipc_worker_deaths_total",
+        "Workers declared dead on a stale heartbeat (live admissions auto-exited)",
+        c.get("ipc_worker_deaths", 0),
+    )
+    out += ctr(
+        f"{p}_ipc_auto_exits_total",
+        "Live admissions auto-exited for dead workers (gauges returned to 0)",
+        c.get("ipc_auto_exits", 0),
+    )
     # Param admission path selection (Engine._encode_param): batches
     # routed to the closed-form rank path vs the rounds/scan family —
     # the pick the self-tuning cost memo arbitrates when enabled.

@@ -260,6 +260,54 @@ class SentinelConfig:
     # Bounded decision-log ring (the trajectory the bench stage and the
     # `autotune` command report).
     AUTOTUNE_LOG = "sentinel.tpu.autotune.log"
+    # Pre-measured closed-vs-scan param-path timings for the cost memo
+    # (tools/k2probe.py --seed-out emits the file): when set, the memo
+    # starts COMMITTED to the measured winner per shape bucket instead
+    # of exploring each path live. Empty (the default) = explore.
+    AUTOTUNE_PARAM_SEED_FILE = "sentinel.tpu.autotune.param.seed.file"
+    # Sketch-tier cold-key admission ceiling (runtime/sketch.py):
+    # estimated QPS above which an UNPROMOTED sketch-tracked resource
+    # (unconfigured or over-cap — today's zero-protection classes) is
+    # blocked from the host count-min twin's estimate, closing the gap
+    # HashPipe-style heavy-hitter promotion leaves open (a key can burn
+    # the full promotion budget's worth of traffic while staying just
+    # under every promotion threshold). 0 (the default) = today's
+    # cold-pass behavior. The twin is host-side, so the ceiling stays
+    # enforced while DEGRADED.
+    SKETCH_COLD_QPS = "sentinel.tpu.sketch.cold.qps"
+    # Multi-process ingest plane (sentinel_tpu/ipc/): N worker
+    # processes encode admissions into a shared-memory MPSC request
+    # ring and one engine process drains it onto the columnar
+    # submit_bulk spine, fanning verdict frames back through per-worker
+    # SPSC response rings. Disabled (the default) = the plane is never
+    # constructed, no shared memory exists, and the engine pays at most
+    # one attribute read on any hot path.
+    IPC_ENABLED = "sentinel.tpu.ipc.enabled"
+    # Request-ring geometry: slot count (rounded up to a power of two)
+    # and fixed payload bytes per slot (one frame per slot; a frame
+    # that cannot fit splits at encode time).
+    IPC_RING_SLOTS = "sentinel.tpu.ipc.ring.slots"
+    IPC_SLOT_BYTES = "sentinel.tpu.ipc.slot.bytes"
+    # Per-worker response-ring slot count (same slot.bytes).
+    IPC_RESP_SLOTS = "sentinel.tpu.ipc.response.slots"
+    # Worker-slot table size in the control header (max workers that
+    # can attach to one plane).
+    IPC_WORKERS_MAX = "sentinel.tpu.ipc.workers.max"
+    # Worker heartbeat bump cadence, and how stale a worker's heartbeat
+    # epoch may go before the plane declares it dead and auto-exits its
+    # live THREAD admissions (gauges return to exactly 0).
+    IPC_HEARTBEAT_MS = "sentinel.tpu.ipc.heartbeat.ms"
+    IPC_WORKER_DEAD_MS = "sentinel.tpu.ipc.worker.dead.ms"
+    # How stale the ENGINE heartbeat may go before a worker stops
+    # waiting and serves verdicts from the fail-open/closed failover
+    # policy snapshot published in the control header.
+    IPC_ENGINE_DEAD_MS = "sentinel.tpu.ipc.engine.dead.ms"
+    # Max time a worker blocks on one verdict before consulting the
+    # engine-death path above (bounds a wedged-but-heartbeating engine).
+    IPC_TIMEOUT_MS = "sentinel.tpu.ipc.timeout.ms"
+    # Drainer idle poll floor, microseconds (the plane backs off toward
+    # this when the request ring runs empty).
+    IPC_POLL_US = "sentinel.tpu.ipc.poll.us"
     # Per-resource provenance metric plane (metrics/provenance.py):
     # (second, resource) speculative/degraded/shed/drift ledger drained
     # into MetricNodeLine v2 columns and the bounded
@@ -308,6 +356,7 @@ class SentinelConfig:
         SKETCH_PROMOTE_MAX: "64",
         SKETCH_DEMOTE_WINDOWS: "3",
         SKETCH_NAMES_CAP: "65536",
+        SKETCH_COLD_QPS: "0",
         TRACE_ENABLED: "true",
         TRACE_RING: "2048",
         TRACE_SAMPLE_RATE: "0.01",
@@ -346,7 +395,18 @@ class SentinelConfig:
         AUTOTUNE_PARAM_PATH: "true",
         AUTOTUNE_PARAM_EXPLORE: "3",
         AUTOTUNE_PARAM_MARGIN: "0.15",
+        AUTOTUNE_PARAM_SEED_FILE: "",
         AUTOTUNE_LOG: "256",
+        IPC_ENABLED: "false",
+        IPC_RING_SLOTS: "1024",
+        IPC_SLOT_BYTES: "16384",
+        IPC_RESP_SLOTS: "1024",
+        IPC_WORKERS_MAX: "8",
+        IPC_HEARTBEAT_MS: "100",
+        IPC_WORKER_DEAD_MS: "1000",
+        IPC_ENGINE_DEAD_MS: "1000",
+        IPC_TIMEOUT_MS: "5000",
+        IPC_POLL_US: "200",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

@@ -22,9 +22,43 @@ from sentinel_tpu.utils.backend import force_cpu
 force_cpu(8)
 
 import gc  # noqa: E402
+import signal  # noqa: E402
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# Hard wall-clock bound for one `mp`-marked test: generous against the
+# 1-core box's spawn+import cost (each worker process re-imports jax),
+# but finite — a wedged worker handshake must fail THIS test, never
+# hang the whole tier.
+MP_TEST_TIMEOUT_S = 240
+
+
+@pytest.fixture(autouse=True)
+def _mp_watchdog(request):
+    """SIGALRM watchdog for tests that spawn real worker processes
+    (the ``mp`` marker): the multi-process ingest plane blocks on
+    cross-process handshakes (ready queues, verdict waits), and a hung
+    worker would otherwise wedge tier-1 forever. The alarm raises in
+    the test thread; test helpers terminate their children in
+    ``finally`` blocks."""
+    if "mp" not in request.keywords:
+        yield
+        return
+
+    def _expired(signum, frame):
+        raise TimeoutError(
+            f"mp test exceeded {MP_TEST_TIMEOUT_S}s watchdog "
+            "(hung worker process?)"
+        )
+
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(MP_TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 # Long single-process runs accumulate XLA:CPU/LLVM JIT state until the
 # native compiler eventually segfaults (observed twice deep into the

@@ -1,0 +1,118 @@
+"""Spawn targets for the multi-process ingest-plane tests.
+
+``multiprocessing`` spawn children import these by module name (the
+parent's ``sys.path`` travels in the spawn preparation data), so every
+function here must stay top-level and self-importing. Workers touch
+only the IngestClient surface — no engine, no device."""
+
+from __future__ import annotations
+
+import time
+
+
+def run_script(channel, wid, script, q):
+    """Run a scripted request sequence and report every verdict back.
+
+    Steps: ``{"kind": "entry"|"bulk"|"exit"|"sleep", ...}``; results
+    land on ``q`` as ``("done", wid, [per-step tuples])``."""
+    from sentinel_tpu.ipc.worker import IngestClient
+
+    cli = IngestClient(channel, wid)
+    out = []
+    try:
+        for step in script:
+            kind = step["kind"]
+            if kind == "entry":
+                v = cli.entry(
+                    step["resource"],
+                    origin=step.get("origin", ""),
+                    acquire=step.get("acquire", 1),
+                    entry_type=step.get("entry_type", 1),
+                    args=tuple(step.get("args", ())),
+                    ts=step.get("ts"),
+                    timeout_ms=step.get("timeout_ms"),
+                )
+                out.append(
+                    ("entry", v.admitted, v.reason, v.wait_ms,
+                     v.speculative, v.degraded)
+                )
+            elif kind == "bulk":
+                a, r, w, f = cli.bulk(
+                    step["resource"], step["n"],
+                    ts=step.get("ts"), acquire=step.get("acquire", 1),
+                    args_column=step.get("args_column"),
+                )
+                out.append(
+                    ("bulk", a.tolist(), r.tolist(), w.tolist(), f.tolist())
+                )
+            elif kind == "exit":
+                cli.exit(
+                    step["resource"],
+                    rt=step.get("rt", 0), count=step.get("count", 1),
+                    err=step.get("err", 0),
+                    speculative=step.get("speculative"),
+                )
+                out.append(("exit",))
+            elif kind == "sleep":
+                time.sleep(step["s"])
+        q.put(("done", wid, out))
+    finally:
+        cli.close()
+
+
+def admit_and_hang(channel, wid, resource, n, q):
+    """Admit ``n`` entries (charging THREAD gauges), report, then hang
+    forever WITHOUT exiting them — the parent kills this process to
+    simulate a crashed worker; the plane's heartbeat sweep must
+    auto-exit the admissions."""
+    from sentinel_tpu.ipc.worker import IngestClient
+
+    cli = IngestClient(channel, wid)
+    admitted = 0
+    for _ in range(n):
+        # Generous verdict timeout: on the contended 1-core CI box a
+        # first-compile flush can exceed the 5 s default, and a policy
+        # fallback here would admit WITHOUT charging the gauges the
+        # test is about to assert on.
+        v = cli.entry(resource, timeout_ms=120000)
+        if v.admitted and not v.degraded:
+            admitted += 1
+    q.put(("admitted", wid, admitted))
+    while True:
+        time.sleep(1.0)
+
+
+def entry_with_trace(channel, wid, resource, traceparent, q):
+    """One traced admission: the inbound W3C context is set ambient in
+    THIS process (the adapter's position) and must survive the frame
+    boundary into the engine's admission-trace records."""
+    from sentinel_tpu.core.context import ContextUtil
+    from sentinel_tpu.ipc.worker import IngestClient
+    from sentinel_tpu.metrics.admission_trace import parse_traceparent
+
+    ContextUtil.set_trace(parse_traceparent(traceparent))
+    cli = IngestClient(channel, wid)
+    try:
+        v = cli.entry(resource)
+        q.put(("done", wid, (v.admitted, int(v.reason))))
+    finally:
+        cli.close()
+
+
+def entries_until_dead(channel, wid, resource, q, max_n=2000):
+    """Loop blocking entries until the engine reads dead (policy-served
+    verdict), then report how the worker experienced the death."""
+    from sentinel_tpu.ipc.worker import IngestClient
+
+    cli = IngestClient(channel, wid)
+    served = []
+    try:
+        for _ in range(max_n):
+            v = cli.entry(resource, timeout_ms=2000)
+            served.append((v.admitted, int(v.reason), v.degraded))
+            if v.degraded:
+                break
+            time.sleep(0.01)
+        q.put(("done", wid, served))
+    finally:
+        cli.close()

@@ -579,3 +579,104 @@ class TestAutoTunerIntegration:
         assert all(b >= a for a, b in zip(depths, depths[1:])), depths
         assert eng.pipeline_depth <= 2
         eng.close()
+
+
+# ----------------------------------------------------------------------
+# param-path seed file (ISSUE 13 satellite)
+# ----------------------------------------------------------------------
+class TestParamSeedFile:
+    """sentinel.tpu.autotune.param.seed.file: k2probe-measured
+    closed-vs-scan timings load at engine start, so the memo starts
+    COMMITTED instead of exploring."""
+
+    def _seed_file(self, tmp_path, buckets):
+        p = tmp_path / "seed.json"
+        p.write_text(json.dumps(
+            {"format": "sentinel-param-seed-v1", "buckets": buckets}
+        ))
+        return str(p)
+
+    def test_seeded_memo_starts_committed(self, manual_clock, tmp_path):
+        path = self._seed_file(tmp_path, [
+            {"rows_bucket": 256, "segments": 1,
+             "closed_ms": 1.0, "scan_ms": 5.0},   # closed wins
+            {"rows_bucket": 1024, "segments": 2,
+             "closed_ms": 9.0, "scan_ms": 2.0},   # scan wins
+        ])
+        config.set(config.AUTOTUNE_ENABLED, "true")
+        config.set(config.AUTOTUNE_PARAM_SEED_FILE, path)
+        eng = _mk_engine(manual_clock)
+        try:
+            at = eng.autotune
+            assert at.seeded_buckets == 2
+            # No explore phase: the first pick is already the measured
+            # winner, with commit (not explore-*) reasoning.
+            path_pick, reason = at.memo.pick((256, 1))
+            assert path_pick == PATH_CLOSED and reason == "cost-hold"
+            path_pick, reason = at.memo.pick((1024, 2))
+            assert path_pick == PATH_SCAN and reason == "cost-switch"
+            # And the commit sticks (hysteresis holds it).
+            path_pick, reason = at.memo.pick((1024, 2))
+            assert path_pick == PATH_SCAN and reason == "cost-hold"
+            # UNSEEDED buckets still explore normally.
+            _, reason = at.memo.pick((64, 1))
+            assert reason.startswith("explore")
+            assert at.snapshot()["param_seed_buckets"] == 2
+        finally:
+            eng.close()
+
+    def test_bad_or_missing_file_is_ignored(self, manual_clock, tmp_path):
+        config.set(config.AUTOTUNE_ENABLED, "true")
+        config.set(config.AUTOTUNE_PARAM_SEED_FILE,
+                   str(tmp_path / "nope.json"))
+        eng = _mk_engine(manual_clock)
+        try:
+            assert eng.autotune.seeded_buckets == 0
+        finally:
+            eng.close()
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        config.set(config.AUTOTUNE_PARAM_SEED_FILE, str(bad))
+        eng = _mk_engine(manual_clock)
+        try:
+            assert eng.autotune.seeded_buckets == 0
+        finally:
+            eng.close()
+        # Malformed entries are skipped, valid ones load.
+        mixed = self._seed_file(tmp_path, [
+            {"rows_bucket": 8, "segments": 1, "closed_ms": 1.0,
+             "scan_ms": 2.0},
+            {"rows_bucket": "x"}, {"closed_ms": -1},
+        ])
+        config.set(config.AUTOTUNE_PARAM_SEED_FILE, mixed)
+        eng = _mk_engine(manual_clock)
+        try:
+            assert eng.autotune.seeded_buckets == 1
+        finally:
+            eng.close()
+
+    def test_force_path_seam_pins_attribution(self, manual_clock):
+        """The k2probe measurement seam: param_force_path='scan' routes
+        a closed-form-ELIGIBLE batch to the scan family (and counts
+        it), 'closed' keeps the rank path."""
+        eng = _mk_engine(manual_clock)
+        try:
+            eng.set_param_rules(
+                {"mx": [ParamFlowRule("mx", param_idx=0, count=3)]}
+            )
+            manual_clock.set_ms(1000)
+            for force, key in (("scan", "param_scan"),
+                               ("closed", "param_closed_form")):
+                eng.param_force_path = force
+                eng.submit_bulk(
+                    "mx", 8, ts=np.full(8, 1000, np.int32),
+                    args_column=[("k",)] * 8,
+                )
+                c0 = eng.telemetry.counters_snapshot()
+                eng.flush()
+                eng.drain()
+                c1 = eng.telemetry.counters_snapshot()
+                assert c1[key] == c0[key] + 1, force
+            eng.param_force_path = None
+        finally:
+            eng.close()

@@ -1,0 +1,722 @@
+"""Multi-process ingest plane (sentinel_tpu/ipc).
+
+The acceptance surface: worker-path verdicts are bit-identical to the
+in-process ``submit_bulk`` oracle at pipeline depths {0, 2} (flow +
+param + speculative on/off); per-request W3C traceparent identity
+survives the process boundary; ring-full is a bounded local
+``BLOCK_SHED`` (cause ``ipc_ring``) that still lands in the engine's
+valve accounting; worker-kill chaos leaves device AND mirror THREAD
+gauges exactly 0 after quiesce; engine death serves workers from the
+policy snapshot; disabled is parity (no plane, no shared memory).
+
+Real-process tests carry the ``mp`` marker — conftest arms a SIGALRM
+watchdog so a hung worker can never wedge tier-1 — and terminate their
+children in ``finally`` blocks.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.ipc import frames as fr
+from sentinel_tpu.ipc.plane import IngestPlane
+from sentinel_tpu.ipc.ring import ControlBlock, ShmRing
+from sentinel_tpu.ipc.worker import IngestClient
+from sentinel_tpu.models.rules import FlowRule, ParamFlowRule
+from sentinel_tpu.runtime.engine import Engine
+from sentinel_tpu.utils.config import config
+
+import ipc_procs
+
+
+@pytest.fixture(autouse=True)
+def _config_sandbox():
+    with config._lock:
+        saved = dict(config._runtime)
+    yield
+    with config._lock:
+        config._runtime.clear()
+        config._runtime.update(saved)
+
+
+def _engine(manual_clock=None, **cfg) -> Engine:
+    for k, v in cfg.items():
+        config.set(k, v)
+    return Engine(clock=manual_clock, initial_rows=256)
+
+
+def _rules(eng: Engine) -> None:
+    eng.set_flow_rules([FlowRule(resource="flow-res", count=3)])
+    eng.set_param_rules(
+        {"param-res": [ParamFlowRule(resource="param-res", param_idx=0,
+                                     count=2)]}
+    )
+
+
+def _wait_for(pred, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# transport units
+# ---------------------------------------------------------------------------
+class TestRing:
+    def test_roundtrip_and_wraparound(self):
+        ring = ShmRing(None, 4, 128, create=True)
+        try:
+            for lap in range(5):  # > slots: exercises seq recycling
+                for i in range(3):
+                    assert ring.try_push(f"p{lap}-{i}".encode())
+                got = [p.decode() for p in ring.pop_all()]
+                assert got == [f"p{lap}-{i}" for i in range(3)]
+        finally:
+            ring.destroy()
+
+    def test_full_returns_false_and_occupancy(self):
+        ring = ShmRing(None, 4, 64, create=True)
+        try:
+            for i in range(4):
+                assert ring.try_push(b"x")
+            assert not ring.try_push(b"overflow")
+            assert ring.occupancy() == 1.0
+            assert ring.pop_all()
+            assert ring.try_push(b"again")
+        finally:
+            ring.destroy()
+
+    def test_oversized_payload_refused(self):
+        ring = ShmRing(None, 2, 16, create=True)
+        try:
+            assert not ring.try_push(b"x" * 17)
+        finally:
+            ring.destroy()
+
+    def test_skip_stalled_claims(self):
+        """A claimed-but-never-published slot (producer died mid-write)
+        is stepped over after the stall age; published frames behind it
+        survive."""
+        ring = ShmRing(None, 4, 64, create=True)
+        try:
+            # Simulate a dead producer: claim advances head, no publish.
+            pos = ring._claim()
+            assert pos is not None
+            assert ring.try_push(b"alive")
+            assert ring.try_pop() is None  # blocked behind the corpse
+            assert not ring.maybe_skip_stalled(0.05)  # first observation
+            time.sleep(0.08)
+            assert ring.maybe_skip_stalled(0.05)
+            assert ring.try_pop() == b"alive"
+        finally:
+            ring.destroy()
+
+    def test_control_block_policy_seqlock(self):
+        ctrl = ControlBlock(None, 4, create=True)
+        try:
+            assert ctrl.read_policy() == ("open", {})  # never published
+            assert ctrl.publish_policy("closed", {"a": "open"})
+            assert ctrl.read_policy() == ("closed", {"a": "open"})
+            # Oversized override sets drop largest-name-last, default kept.
+            big = {f"r{'x' * i}": "closed" for i in range(200)}
+            assert not ctrl.publish_policy("open", big)
+            default, overrides = ctrl.read_policy()
+            assert default == "open" and len(overrides) < len(big)
+        finally:
+            ctrl.destroy()
+
+
+class TestFrames:
+    def test_args_codec_roundtrip(self):
+        cases = [
+            (), (None,), (True, False), (42, -(1 << 40)), (3.5,),
+            ("ip-1", ""), (b"\x00\xff",), (("a", 1, None), "tail"),
+            ("unicode-☃",),
+        ]
+        for args in cases:
+            assert fr.decode_args(fr.encode_args(args)) == args
+
+    def test_entry_frame_roundtrip(self):
+        rows = [
+            fr.EntryRow(
+                seq=100 + i, resource_id=1, context_id=2, origin_id=3,
+                entry_type=1, acquire=i + 1, ts=5000 + i,
+                trace=fr.pack_trace("ab" * 16, "cd" * 8, True),
+                args=fr.encode_args((f"v{i}",)),
+            )
+            for i in range(4)
+        ]
+        payload = fr.encode_entries(
+            3, rows, [(1, b"res"), (2, b"ctx")], intern_gen=7, shed_count=9
+        )
+        f = fr.decode_frame(payload)
+        assert f.kind == fr.KIND_ENTRY and f.worker_id == 3 and f.n == 4
+        assert f.intern_gen == 7 and f.shed_count == 9
+        assert f.interns == [(1, b"res"), (2, b"ctx")]
+        assert f.columns["ts"].tolist() == [5000, 5001, 5002, 5003]
+        assert f.columns["acquire"].tolist() == [1, 2, 3, 4]
+        tid, sid, sampled = fr.unpack_trace(f.traces[0:26])
+        assert (tid, sid, sampled) == ("ab" * 16, "cd" * 8, True)
+        for i in range(4):
+            lo = int(f.columns["args_off"][i])
+            ln = int(f.columns["args_len"][i])
+            assert fr.decode_args(f.varbytes[lo : lo + ln]) == (f"v{i}",)
+
+    def test_exit_and_verdict_frames(self):
+        rows = [fr.ExitRow(1, 4, 0, 0, 0, 777, 12, 2, 1, 1)]
+        f = fr.decode_frame(fr.encode_exits(2, rows, [], 1, 0))
+        assert f.kind == fr.KIND_EXIT and f.n == 1
+        assert f.columns["rt"].tolist() == [12]
+        assert f.columns["spec"].tolist() == [1]
+        v = fr.decode_frame(
+            fr.encode_verdicts(
+                2, np.array([9], np.uint64), np.array([1], np.uint8),
+                np.array([0], np.int16), np.array([3], np.int32),
+                np.array([fr.F_SPECULATIVE], np.uint8),
+            )
+        )
+        assert v.kind == fr.KIND_VERDICT
+        assert v.columns["seq"].tolist() == [9]
+        assert v.columns["wait_ms"].tolist() == [3]
+
+    def test_untraced_row_packs_empty(self):
+        assert fr.unpack_trace(fr.EMPTY_TRACE) is None
+        assert fr.unpack_trace(fr.pack_trace("zz", "bad", True)) is None
+
+
+# ---------------------------------------------------------------------------
+# differential parity vs the in-process submit_bulk oracle
+# ---------------------------------------------------------------------------
+def _oracle_decide(eng: Engine, res, n, ts_list, args_list):
+    """EXACTLY the plane's group semantics, in-process: one columnar
+    submit_bulk (per-request fallback on ValueError), speculative
+    verdicts answered without waiting for settle, else a flush."""
+    ts_col = np.asarray(ts_list, dtype=np.int32)
+    args_col = None
+    if any(args_list):
+        args_col = list(args_list)
+    try:
+        op = eng.submit_bulk(res, n, ts=ts_col, args_column=args_col)
+        if op is None:
+            return [(True, E.PASS, 0)] * n
+        if op.spec_admitted is not None:
+            eng._spec_maybe_settle()
+        else:
+            eng.flush()
+        return list(
+            zip(
+                op.admitted.tolist(), op.reason.tolist(),
+                op.wait_ms.tolist(),
+            )
+        )
+    except ValueError:
+        ops = [
+            eng.submit_entry(res, ts=ts_list[i], args=args_list[i])
+            for i in range(n)
+        ]
+        eng.flush()
+        return [
+            (op.verdict.admitted, op.verdict.reason, op.verdict.wait_ms)
+            for op in ops
+        ]
+
+
+def _stream():
+    """The scripted request stream: flow-rule singles, param values
+    (incl. repeats that must block at count=2), and bulk groups —
+    explicit ts so both sides are deterministic."""
+    reqs = []
+    for i in range(6):
+        reqs.append(("entry", "flow-res", 1000, ()))
+    for i in range(7):
+        reqs.append(("entry", "param-res", 1000, (f"ip{i % 2}",)))
+    reqs.append(("bulk", "flow-res", 2200, 5))
+    reqs.append(("bulk", "unknown-res", 2200, 3))
+    return reqs
+
+
+class TestPlaneParity:
+    """Worker-path verdicts bit-identical to the in-process oracle.
+    The client here lives in-process — the ENTIRE frame/ring/plane
+    path still runs (shared memory is process-agnostic); the process
+    boundary itself is covered by the mp-marked spot check below."""
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    @pytest.mark.parametrize("spec", [False, True])
+    def test_bit_identical(self, manual_clock, depth, spec):
+        config.set(config.PIPELINE_DEPTH, str(depth))
+        config.set(config.SPECULATIVE_ENABLED, "true" if spec else "false")
+        manual_clock.set_ms(1000)
+        oracle = _engine(manual_clock)
+        _rules(oracle)
+        eng = _engine(manual_clock)
+        _rules(eng)
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            want = []
+            got = []
+            for req in _stream():
+                if req[0] == "entry":
+                    _, res, ts, args = req
+                    want.extend(_oracle_decide(oracle, res, 1, [ts], [args]))
+                    v = cli.entry(res, ts=ts, args=args, timeout_ms=30000)
+                    got.append((v.admitted, v.reason, v.wait_ms))
+                else:
+                    _, res, ts, n = req
+                    want.extend(
+                        _oracle_decide(oracle, res, n, [ts] * n, [()] * n)
+                    )
+                    a, r, w, _f = cli.bulk(res, n, ts=ts, timeout_ms=30000)
+                    got.extend(zip(a.tolist(), r.tolist(), w.tolist()))
+            assert got == want, f"depth={depth} spec={spec}"
+            oracle.flush()
+            oracle.drain()
+            eng.flush()
+            eng.drain()
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+            oracle.close()
+
+    def test_speculative_flag_carried(self, manual_clock):
+        config.set(config.SPECULATIVE_ENABLED, "true")
+        manual_clock.set_ms(1000)
+        eng = _engine(manual_clock)
+        _rules(eng)
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            v = cli.entry("flow-res", ts=1000, timeout_ms=30000)
+            assert v.admitted and v.speculative and not v.degraded
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# ring-full shed bounds + valve accounting
+# ---------------------------------------------------------------------------
+class TestRingFullShed:
+    def test_shed_bounded_and_valve_accounted(self, manual_clock):
+        config.set(config.IPC_RING_SLOTS, "2")
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="flow-res", count=1e9)])
+        plane = IngestPlane(eng, start=False)  # beats only when started
+        plane._publish_control(force=True)  # engine reads alive
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            # Fill the 2-slot ring: nobody drains, each wait times out
+            # into the policy path (NOT a shed — the frame is queued).
+            for _ in range(2):
+                v = cli.entry("flow-res", ts=1000, timeout_ms=50)
+                assert v.degraded  # policy-served wait timeout
+            assert plane.request.occupancy() == 1.0
+            # The bound: every further submit is a FAST local shed with
+            # the distinct cause, and the ring never grows.
+            for _ in range(5):
+                v = cli.entry("flow-res", ts=1000, timeout_ms=50)
+                assert not v.admitted
+                assert v.reason == E.BLOCK_SHED
+                assert v.limit_type == "ipc_ring"
+            assert cli.counters["sheds"] == 5
+            assert plane.request.occupancy() == 1.0
+            # Start the plane: queued frames drain, and the workers'
+            # cumulative shed counts fold into the engine's valve
+            # accounting (cause "ring") via the control header.
+            plane.start()
+            _wait_for(
+                lambda: eng.ingest.counters["shed_ring"] >= 5,
+                what="shed_ring fold",
+            )
+            assert eng.ingest.counters["shed_entries"] >= 5
+            assert plane.snapshot()["counters"]["worker_sheds"] >= 5
+            assert eng.telemetry.counters_snapshot()["ipc_sheds"] >= 5
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# engine death -> policy snapshot; disabled parity; intern protocol
+# ---------------------------------------------------------------------------
+class TestEngineDeathPolicy:
+    def test_closed_plane_serves_policy(self, manual_clock):
+        config.set(config.FAILOVER_POLICY, "open,shut-res=closed")
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="flow-res", count=1e9)])
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            v = cli.entry("flow-res", ts=1000, timeout_ms=30000)
+            assert v.admitted and not v.degraded
+            plane.close()
+            v = cli.entry("flow-res", ts=1000)
+            assert v.admitted and v.degraded and v.reason == E.PASS
+            v = cli.entry("shut-res", ts=1000)
+            assert not v.admitted and v.degraded
+            assert v.reason == E.BLOCK_FAILOVER
+            a, r, _w, f = cli.bulk("shut-res", 3)
+            assert not a.any()
+            assert r.tolist() == [E.BLOCK_FAILOVER] * 3
+            assert all(fl & fr.F_DEGRADED for fl in f.tolist())
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+    def test_disabled_is_parity(self, manual_clock):
+        eng = _engine(manual_clock)
+        try:
+            assert eng.ipc_plane is None  # default off: no plane, no shm
+        finally:
+            eng.close()
+
+    def test_config_enabled_autostarts(self):
+        config.set(config.IPC_ENABLED, "true")
+        eng = _engine()
+        try:
+            assert eng.ipc_plane is not None
+            assert eng.ipc_plane.snapshot()["enabled"]
+        finally:
+            eng.close()
+            # BEFORE any api.reset teardown can construct the next
+            # global engine: a lingering "true" would auto-start (and
+            # leak) a plane on it.
+            config.set(config.IPC_ENABLED, "false")
+        assert eng.ipc_plane is None  # close() tears the plane down
+
+
+class TestLedgerPairing:
+    def test_spec_off_exit_clears_ledger_no_reap_double_release(
+        self, manual_clock
+    ):
+        """Regression (review): with the speculative tier OFF the
+        admit-time ledger key carries spec=False while a worker's
+        default exit reads as mirror-release True — the decrement must
+        still pair them, or the dead-worker reap double-releases and
+        drives the gauge negative."""
+        eng = _engine(manual_clock)  # speculative defaults OFF
+        eng.set_flow_rules([FlowRule(resource="pair-res", count=1e9)])
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            for _ in range(3):
+                assert cli.entry(
+                    "pair-res", ts=1000, timeout_ms=30000
+                ).admitted
+            for _ in range(3):
+                assert cli.exit("pair-res")  # default speculative=None
+            _wait_for(
+                lambda: plane.snapshot()["counters"]["exits"] >= 3,
+                what="exits drained",
+            )
+            with plane._lock:
+                assert not plane._workers[0].live, "ledger must be empty"
+            # A reap now must release NOTHING.
+            plane._reap_worker(0, plane._workers[0])
+            assert plane.snapshot()["counters"]["auto_exits"] == 0
+            eng.flush()
+            eng.drain()
+            stats = eng.cluster_node_stats("pair-res")
+            assert stats["cur_thread_num"] == 0, stats
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+
+class TestInternProtocol:
+    def test_string_crosses_once_and_gen_bump_reinterns(self, manual_clock):
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="flow-res", count=1e9)])
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            for _ in range(3):
+                assert cli.entry(
+                    "flow-res", ts=1000, timeout_ms=30000
+                ).admitted
+            with cli._lock:
+                interned = dict(cli._intern)
+            assert "flow-res" in interned
+            snap = plane.snapshot()
+            assert snap["workers"][0]["interned"] >= 1
+            # Generation bump (plane restart surrogate): the client's
+            # table invalidates and the next frame re-interns.
+            plane.control.bump_intern_gen()
+            assert cli.entry("flow-res", ts=1000, timeout_ms=30000).admitted
+            with cli._lock:
+                assert cli._intern_gen == plane.control.intern_gen()
+                assert "flow-res" in cli._intern
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# real worker processes (the mp tier)
+# ---------------------------------------------------------------------------
+def _spawn(plane, target, wid, *args):
+    ctx = plane.spawn_context()
+    q = ctx.Queue()
+    p = ctx.Process(
+        target=target, args=(plane.channel(wid), wid, *args, q), daemon=True
+    )
+    p.start()
+    return p, q
+
+
+def _q_get(q, timeout_s=120):
+    return q.get(timeout=timeout_s)
+
+
+def _reap_proc(p):
+    if p is None:
+        return
+    p.join(timeout=5)
+    if p.is_alive():
+        p.terminate()
+        p.join(timeout=5)
+
+
+@pytest.mark.mp
+class TestMultiProcess:
+    def test_parity_across_process_boundary(self, manual_clock):
+        """The mp spot check of TestPlaneParity: the SAME stream from a
+        real spawned worker produces the same verdicts as the oracle
+        (depth 2, speculative on — the production shape)."""
+        config.set(config.PIPELINE_DEPTH, "2")
+        config.set(config.SPECULATIVE_ENABLED, "true")
+        manual_clock.set_ms(1000)
+        oracle = _engine(manual_clock)
+        _rules(oracle)
+        eng = _engine(manual_clock)
+        _rules(eng)
+        plane = IngestPlane(eng)
+        script = []
+        want = []
+        for req in _stream():
+            if req[0] == "entry":
+                _, res, ts, args = req
+                script.append(
+                    {"kind": "entry", "resource": res, "ts": ts,
+                     "args": list(args), "timeout_ms": 60000}
+                )
+                want.append(
+                    ("entry",) + _oracle_decide(oracle, res, 1, [ts], [args])[0]
+                )
+            else:
+                _, res, ts, n = req
+                script.append(
+                    {"kind": "bulk", "resource": res, "n": n, "ts": ts}
+                )
+                vs = _oracle_decide(oracle, res, n, [ts] * n, [()] * n)
+                want.append(
+                    ("bulk", [v[0] for v in vs], [v[1] for v in vs],
+                     [v[2] for v in vs])
+                )
+        p = None
+        try:
+            p, q = _spawn(plane, ipc_procs.run_script, 0, script)
+            tag, wid, out = _q_get(q)
+            assert tag == "done" and wid == 0
+            got = [
+                ("entry", s[1], s[2], s[3]) if s[0] == "entry"
+                else ("bulk", s[1], s[2], s[3])
+                for s in out
+            ]
+            assert got == want
+        finally:
+            _reap_proc(p)
+            plane.close()
+            eng.close()
+            oracle.close()
+
+    def test_traceparent_identity_across_boundary(self, manual_clock):
+        """PR-4 identity survives the frame: the record in the ENGINE
+        process carries the worker's inbound trace id and parent span."""
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="flow-res", count=1e9)])
+        plane = IngestPlane(eng)
+        tid = "a1" * 16
+        sid = "b2" * 8
+        traceparent = f"00-{tid}-{sid}-01"
+        p = None
+        try:
+            p, q = _spawn(
+                plane, ipc_procs.entry_with_trace, 0, "flow-res", traceparent
+            )
+            tag, _wid, (admitted, _reason) = _q_get(q)
+            assert tag == "done" and admitted
+            _wait_for(
+                lambda: any(
+                    r.trace_id == tid and r.parent_span_id == sid
+                    for r in eng.admission_trace.records()
+                ),
+                what="trace record with inbound identity",
+            )
+            rec = next(
+                r for r in eng.admission_trace.records()
+                if r.trace_id == tid
+            )
+            assert rec.resource == "flow-res"
+            assert rec.head_sampled  # inbound sampled flag honored
+        finally:
+            _reap_proc(p)
+            plane.close()
+            eng.close()
+
+    def test_worker_kill_gauges_exactly_zero(self):
+        """kill -9 a worker holding live admissions: the heartbeat
+        sweep auto-exits them and BOTH the device and mirror THREAD
+        gauges read exactly 0 after quiesce."""
+        config.set(config.SPECULATIVE_ENABLED, "true")
+        config.set(config.IPC_HEARTBEAT_MS, "50")
+        config.set(config.IPC_WORKER_DEAD_MS, "400")
+        eng = _engine()  # real clock: heartbeat staleness is wall time
+        eng.set_flow_rules([FlowRule(resource="kill-res", count=1e9)])
+        plane = IngestPlane(eng)
+        n = 5
+        p = None
+        try:
+            p, q = _spawn(plane, ipc_procs.admit_and_hang, 0, "kill-res", n)
+            tag, _wid, admitted = _q_get(q)
+            assert tag == "admitted" and admitted == n
+            eng.flush()
+            eng.drain()
+            stats = eng.cluster_node_stats("kill-res")
+            assert stats["cur_thread_num"] == n  # charged while alive
+            os.kill(p.pid, signal.SIGKILL)  # no exits, no cleanup
+            _wait_for(
+                lambda: plane.snapshot()["counters"]["worker_deaths"] >= 1,
+                timeout_s=30,
+                what="worker death sweep",
+            )
+            assert plane.snapshot()["counters"]["auto_exits"] == n
+            eng.flush()
+            eng.drain()
+            stats = eng.cluster_node_stats("kill-res")
+            assert stats["cur_thread_num"] == 0, "device gauge must be 0"
+            mirror = eng.speculative.mirror.snapshot()["live_threads"]
+            assert mirror.get("kill-res", 0) == 0, "mirror gauge must be 0"
+            assert eng.telemetry.counters_snapshot()["ipc_worker_deaths"] == 1
+        finally:
+            _reap_proc(p)
+            plane.close()
+            eng.close()
+
+    def test_engine_close_fails_over_and_quiesces(self):
+        """Engine death mid-stream: the worker's NEXT verdict comes
+        from the policy snapshot (degraded), and the closing engine's
+        final sweep leaves its gauges exactly 0."""
+        config.set(config.SPECULATIVE_ENABLED, "true")
+        eng = _engine()
+        eng.set_flow_rules([FlowRule(resource="die-res", count=1e9)])
+        plane = IngestPlane(eng)
+        p = None
+        try:
+            p, q = _spawn(plane, ipc_procs.entries_until_dead, 0, "die-res")
+            # Let it serve a few live verdicts first.
+            _wait_for(
+                lambda: plane.snapshot()["counters"]["requests"] >= 3,
+                what="live traffic",
+            )
+            plane.close()
+            tag, _wid, served = _q_get(q)
+            assert tag == "done"
+            assert served, "worker observed no verdicts"
+            live = [s for s in served if not s[2]]
+            assert live and all(s[0] for s in live)
+            # The death was observed as a policy-served verdict.
+            assert served[-1][2] is True
+            assert served[-1][0] is True  # fail-open default
+            eng.flush()
+            eng.drain()
+            stats = eng.cluster_node_stats("die-res")
+            assert stats["cur_thread_num"] == 0
+            mirror = eng.speculative.mirror.snapshot()["live_threads"]
+            assert mirror.get("die-res", 0) == 0
+        finally:
+            _reap_proc(p)
+            plane.close()
+            eng.close()
+
+
+class TestFrameBudget:
+    def test_args_heavy_bulk_splits_by_bytes_not_rows(self, manual_clock):
+        """Regression (review): frame sizing must count args BYTES — an
+        args-heavy group on an EMPTY ring previously built one
+        oversized frame the ring could never accept and shed every row
+        as phantom 'ring full' backpressure."""
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="argsy", count=1e9)])
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            big = "v" * 120
+            a, r, _w, _f = cli.bulk(
+                "argsy", 200, ts=1000, args_column=[(big,)] * 200,
+                timeout_ms=60000,
+            )
+            assert a.all(), r[~a]
+            assert cli.counters["sheds"] == 0
+            # A single row that cannot fit ANY slot is the caller's
+            # bug, not backpressure.
+            with pytest.raises(ValueError):
+                cli.bulk("argsy", 1, args_column=[("x" * 40000,)])
+            with pytest.raises(ValueError):
+                cli.entry("argsy", args=("x" * 40000,))
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+    def test_closed_plane_scrape_degrades(self, manual_clock):
+        """Regression (review): a metrics scrape racing plane.close()
+        must degrade to zeros, not fail the render."""
+        from sentinel_tpu.transport.prometheus import render_metrics
+
+        eng = _engine(manual_clock)
+        plane = IngestPlane(eng)
+        plane.close()
+        assert plane.request.occupancy() == 0.0
+        assert plane.control.intern_gen() == 0
+        out = render_metrics(eng)
+        assert "sentinel_engine_ipc_enabled 0" in out
+        eng.close()
+
+    def test_long_names_ship_via_intern_preamble(self, manual_clock):
+        """Regression (review): fresh intern records past the frame
+        reserve ship as a zero-row preamble frame instead of building
+        an over-slot payload that reads as permanent ring
+        backpressure."""
+        config.set(config.IPC_SLOT_BYTES, "2048")
+        eng = _engine(manual_clock)
+        long_res = "r" + "x" * 1400  # intern record alone > reserve
+        eng.set_flow_rules([FlowRule(resource=long_res, count=1e9)])
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            v = cli.entry(long_res, ts=1000, timeout_ms=60000)
+            assert v.admitted and cli.counters["sheds"] == 0
+            # And a name no slot can ever carry is the caller's bug.
+            with pytest.raises(ValueError):
+                cli.entry("r" + "y" * 4000, ts=1000)
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()

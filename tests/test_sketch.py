@@ -638,3 +638,179 @@ class TestColumnarKeyPath:
         finally:
             for k in (config.SKETCH_ENABLED, config.SKETCH_PROMOTE_QPS):
                 config.set(k, config.DEFAULTS[k])
+
+
+@pytest.fixture()
+def cold_config():
+    """Arm the cold-key admission ceiling alone: promotion disarmed, so
+    every decision comes from the count-min estimate (ISSUE 13
+    satellite — the admit-by-estimate gap HashPipe leaves open)."""
+    config.set(config.SKETCH_ENABLED, "true")
+    config.set(config.SKETCH_WINDOW_MS, "1000")
+    config.set(config.SKETCH_COLD_QPS, "10")
+    try:
+        yield
+    finally:
+        for key in (
+            config.SKETCH_ENABLED, config.SKETCH_WINDOW_MS,
+            config.SKETCH_COLD_QPS,
+        ):
+            config.set(key, config.DEFAULTS[key])
+
+
+class TestColdKeyCeiling:
+    """sentinel.tpu.sketch.cold.qps: estimated-QPS ceiling on
+    unpromoted, unconfigured resources. Ceiling at qps=10, window 1 s
+    -> the twin estimate blocks at >= 2 * 10 * 1 = 20."""
+
+    def _hot(self, eng, clk, n=64):
+        g = eng.submit_bulk("coldhot", n=n)
+        eng.flush()
+        eng.drain()
+        return g
+
+    def test_hot_cold_key_blocked_then_decays_back(self, cold_config):
+        from sentinel_tpu.core import errors as E
+
+        clk = ManualClock(1000)
+        eng = Engine(clock=clk)
+        # First batch: the twin has never seen the key — passes (and
+        # feeds the estimate past the ceiling).
+        g = self._hot(eng, clk)
+        assert g is not None and g.admitted.all()
+        # Now every submit is blocked at the door: bulk, single, and
+        # the deferred batch path all route through the ceiling.
+        g2 = eng.submit_bulk("coldhot", n=8)
+        assert not g2.admitted.any()
+        assert g2.reason.tolist() == [E.BLOCK_SKETCH] * 8
+        op = eng.submit_entry("coldhot")
+        assert op.verdict.reason == E.BLOCK_SKETCH
+        assert op.verdict.limit_type == "cold"
+        many = eng.submit_many([{"resource": "coldhot"}] * 3)
+        assert all(o.verdict.reason == E.BLOCK_SKETCH for o in many)
+        assert eng.sketch.cold_blocks >= 12
+        c = eng.telemetry.counters_snapshot()
+        assert c["sketch_cold_blocks"] == eng.sketch.cold_blocks
+        # Nothing was enqueued for the blocked traffic.
+        assert not eng.has_pending()
+        # Blocked traffic is NOT counted, so per-window halving decays
+        # the estimate back under the ceiling and admission resumes
+        # (the duty-cycle that approximates the ceiling rate).
+        for _ in range(3):
+            clk.advance(1100)
+            eng.submit_bulk("other", n=1)
+            eng.flush()
+            eng.drain()
+        g3 = eng.submit_bulk("coldhot", n=4)
+        assert g3 is not None, "ceiling must lift after decay"
+        eng.flush()
+        eng.drain()
+        assert g3.admitted.all()
+        eng.close()
+
+    def test_configured_and_promoted_resources_exempt(self, cold_config):
+        clk = ManualClock(1000)
+        eng = Engine(clock=clk)
+        eng.set_flow_rules([FlowRule(resource="ruled", count=1e9)])
+        for _ in range(3):
+            g = eng.submit_bulk("ruled", n=64)
+            eng.flush()
+            eng.drain()
+            assert g.admitted.all()  # user rules exempt at any volume
+        # A tier-promoted resource is exempt too: the exact dense row
+        # owns it from the promotion on.
+        eng.sketch._promoted_res["promoted"] = FlowRule(
+            resource="promoted", count=1e9, from_sketch=True
+        )
+        assert not eng.sketch.cold_blocked(
+            "promoted", eng.flow_index, eng.param_index
+        )
+        eng.close()
+
+    def test_over_cap_class_is_covered(self, cold_config):
+        from sentinel_tpu.core import errors as E
+
+        clk = ManualClock(1000)
+        eng = Engine(clock=clk)
+        eng.nodes.max_resources = 1
+        eng.submit_bulk("takes-cap", n=1)
+        # Over the cap: pass-through while cold...
+        assert eng.submit_bulk("capped", n=64) is None
+        eng.flush()
+        eng.drain()
+        # ...but once the estimate crosses the ceiling, the formerly
+        # zero-protection class gets blocked verdicts.
+        g = eng.submit_bulk("capped", n=8)
+        assert g is not None and not g.admitted.any()
+        assert g.reason.tolist() == [E.BLOCK_SKETCH] * 8
+        op = eng.submit_entry("capped")
+        assert op.verdict.reason == E.BLOCK_SKETCH
+        eng.close()
+
+    def test_enforced_while_degraded_from_host_twin(self, cold_config):
+        """DEGRADED keeps the ceiling: the twin is fed by the SAME
+        _collect the host fold runs, so losing the device loses
+        nothing."""
+        from sentinel_tpu.core import errors as E
+        from sentinel_tpu.testing.faults import FaultInjector
+
+        config.set(config.FAILOVER_ENABLED, "true")
+        try:
+            clk = ManualClock(1000)
+            eng = Engine(clock=clk)
+            eng.submit_bulk("warm", n=1)
+            eng.flush()
+            faults = FaultInjector().install(eng)
+            faults.fail_fetch(eng.flush_seq + 1)
+            eng.submit_bulk("warm", n=1)
+            eng.flush()  # trips DEGRADED
+            assert not eng.failover.healthy
+            g = eng.submit_bulk("degraded-hot", n=64)
+            eng.flush()  # host fold feeds the twin
+            assert g.admitted.all()
+            g2 = eng.submit_bulk("degraded-hot", n=8)
+            assert not g2.admitted.any()
+            assert g2.reason.tolist() == [E.BLOCK_SKETCH] * 8
+            eng.close()
+        finally:
+            config.set(
+                config.FAILOVER_ENABLED,
+                config.DEFAULTS[config.FAILOVER_ENABLED],
+            )
+
+    def test_default_off_is_cold_pass(self):
+        config.set(config.SKETCH_ENABLED, "true")
+        try:
+            clk = ManualClock(1000)
+            eng = Engine(clock=clk)
+            assert not eng.sketch.cold_armed  # cold.qps default 0
+            for _ in range(4):
+                g = eng.submit_bulk("anything", n=256)
+                eng.flush()
+                eng.drain()
+                assert g.admitted.all()  # today's cold-pass behavior
+            assert eng.sketch.cold_blocks == 0
+            eng.close()
+        finally:
+            config.set(config.SKETCH_ENABLED,
+                       config.DEFAULTS[config.SKETCH_ENABLED])
+
+    def test_degrade_only_resource_exempt(self, cold_config):
+        """Regression (review): 'no user rule of any kind' includes
+        degrade rules — a breaker-guarded resource must never be
+        throttled by the approximate cold path."""
+        from sentinel_tpu.models.rules import DegradeRule
+
+        clk = ManualClock(1000)
+        eng = Engine(clock=clk)
+        eng.set_degrade_rules(
+            [DegradeRule(resource="breakered", count=1e9,
+                         time_window=1)]
+        )
+        for _ in range(3):
+            g = eng.submit_bulk("breakered", n=64)
+            eng.flush()
+            eng.drain()
+            assert g.admitted.all()
+        assert eng.sketch.cold_blocks == 0
+        eng.close()

@@ -116,6 +116,16 @@ STAGE_METRICS: Dict[str, Tuple[str, float]] = {
     "autotune_static_best_ops_per_sec": ("higher", 0.60),
     "autotune_steady_ops_per_sec": ("higher", 0.60),
     "autotune_vs_static_best": ("higher", 0.30),
+    # Multi-process ingest plane (bench `ipc` stage). The vs-inproc
+    # ratio is a RATIO of two same-run numbers (box noise largely
+    # cancels) — tighter band like the other ratio metrics; on the
+    # 1-core box it measures transport overhead (3 processes share one
+    # CPU), on real hardware it is the scale-out headline.
+    "ipc_workers_ops_per_sec": ("higher", 0.60),
+    "ipc_inproc_ops_per_sec": ("higher", 0.60),
+    "ipc_vs_inproc": ("higher", 0.30),
+    "ipc_entry_p50_us": ("lower", 2.00),
+    "ipc_entry_p99_us": ("lower", 5.00),
 }
 
 # Stage-context keys: a group's metrics are comparable only when every
@@ -142,6 +152,9 @@ STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
     (("autotune_n_ops",),
      ("autotune_static_best_ops_per_sec", "autotune_steady_ops_per_sec",
       "autotune_vs_static_best")),
+    (("ipc_n_ops", "ipc_n_workers"),
+     ("ipc_workers_ops_per_sec", "ipc_inproc_ops_per_sec",
+      "ipc_vs_inproc", "ipc_entry_p50_us", "ipc_entry_p99_us")),
 ]
 
 

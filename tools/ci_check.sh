@@ -35,8 +35,14 @@ JAX_PLATFORMS=cpu python tools/config_audit.py \
 
 if [ "${CI_CHECK_SKIP_BENCH:-0}" = "1" ]; then
     echo "== ci_check 3/3: bench gate SKIPPED (CI_CHECK_SKIP_BENCH=1) =="
+    # The ipc stage still smokes even when the full bench is skipped:
+    # it exercises real spawned worker processes + shared-memory rings,
+    # a surface tier-1's in-process tests cannot fully cover.
+    echo "== ci_check 3b: ipc stage smoke =="
+    JAX_PLATFORMS=cpu python bench.py --run-stage --kind ipc \
+        --rules 4 --entries 1024 --iters 1 --child-platform cpu >/dev/null
 else
-    echo "== ci_check 3/3: bench gate =="
+    echo "== ci_check 3/3: bench gate (incl. ipc stage) =="
     JAX_PLATFORMS=cpu python bench.py --gate >/dev/null
 fi
 

@@ -50,6 +50,13 @@ def main() -> None:
     ap.add_argument("--n", type=int, default=131072)
     ap.add_argument("--rules", type=int, default=1 << 20)
     ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument(
+        "--seed-out", default=None, metavar="FILE",
+        help="measure per-shape closed-vs-scan param-path flush timings"
+             " and write a sentinel.tpu.autotune.param.seed.file JSON"
+             " (the ParamPathMemo then starts committed instead of"
+             " exploring)",
+    )
     args = ap.parse_args()
     if args.platform:
         import jax
@@ -285,6 +292,115 @@ def main() -> None:
             _cfg.set(_cfg.INGEST_DEADLINE_MS, "0")
     except Exception as exc:
         print(f"[k2probe] speculative stage skipped: {exc}", file=sys.stderr)
+
+    # --- param path closed-vs-scan shape sweep (--seed-out) ------------
+    # Times the SAME closed-form-eligible param batch through both
+    # arms of the autotuner's cost memo (engine.param_force_path pins
+    # the pick) at the memo's own bucket axes — (pow2 rows, ts
+    # segments) — and emits the seed file ParamPathMemo.seed() loads at
+    # engine start (sentinel.tpu.autotune.param.seed.file).
+    try:
+        from sentinel_tpu.models.rules import ParamFlowRule
+        from sentinel_tpu.runtime.autotune import ParamPathMemo
+        from sentinel_tpu.runtime.engine import Engine
+
+        peng = Engine(initial_rows=1024)
+        peng.set_param_rules(
+            {"pp": [ParamFlowRule(resource="pp", param_idx=0, count=1e9)]}
+        )
+        seed_buckets = []
+        shapes = [(256, 1), (256, 2), (2048, 1), (2048, 2), (2048, 4)]
+
+        def _param_flush(n_items: int, nseg: int) -> None:
+            base = peng.clock.now_ms()
+            ts_col = np.asarray(
+                [base + (i % nseg) for i in range(n_items)], dtype=np.int64
+            )
+            peng.submit_bulk(
+                "pp", n_items, ts=ts_col - base,
+                args_column=[(f"v{i % 64}",) for i in range(n_items)],
+            )
+            peng.flush()
+            peng.drain()
+
+        for n_items, nseg in shapes:
+            timings = {}
+            for path in ("closed", "scan"):
+                peng.param_force_path = path
+                _param_flush(n_items, nseg)  # warm/compile this arm
+                t0 = time.perf_counter()
+                for _ in range(args.iters):
+                    _param_flush(n_items, nseg)
+                timings[path] = (
+                    (time.perf_counter() - t0) / args.iters * 1e3
+                )
+            peng.param_force_path = None
+            bucket = ParamPathMemo.bucket_of(n_items, nseg)
+            seed_buckets.append(
+                {
+                    "rows_bucket": bucket[0],
+                    "segments": bucket[1],
+                    "closed_ms": round(timings["closed"], 4),
+                    "scan_ms": round(timings["scan"], 4),
+                }
+            )
+            report(f"param_closed_n{n_items}_s{nseg}", timings["closed"] / 1e3)
+            report(f"param_scan_n{n_items}_s{nseg}", timings["scan"] / 1e3)
+        peng.close()
+        if args.seed_out:
+            seed = {
+                "format": "sentinel-param-seed-v1",
+                "platform": results["platform"],
+                "jax_version": jax.__version__,
+                "buckets": seed_buckets,
+            }
+            with open(args.seed_out, "w", encoding="utf-8") as f:
+                json.dump(seed, f, indent=1)
+            print(f"[k2probe] seed file written: {args.seed_out}",
+                  file=sys.stderr, flush=True)
+    except Exception as exc:
+        print(f"[k2probe] param-path stage skipped: {exc}", file=sys.stderr)
+
+    # --- ipc plane round trip (sentinel_tpu/ipc) -----------------------
+    # One in-process worker client against a live plane: entry()
+    # shared-memory round-trip latency (frame encode -> ring -> plane
+    # decode -> columnar submit -> verdict frame back), the per-request
+    # cost a GIL-bound front-end worker pays to ride the one engine.
+    try:
+        from sentinel_tpu.ipc.plane import IngestPlane
+        from sentinel_tpu.ipc.worker import IngestClient
+        from sentinel_tpu.models.rules import FlowRule
+        from sentinel_tpu.runtime.engine import Engine
+        from sentinel_tpu.utils.config import config as _cfg
+
+        _cfg.set(_cfg.SPECULATIVE_ENABLED, "true")
+        _cfg.set(_cfg.SPECULATIVE_FLUSH_BATCH, "100000")
+        try:
+            ieng = Engine(initial_rows=1024)
+            ieng.set_flow_rules(
+                [FlowRule(resource=f"i{i}", count=1e9) for i in range(8)]
+            )
+            plane = IngestPlane(ieng)
+            cli = IngestClient(plane.channel(0), 0)
+            for i in range(64):  # warm the settle shape + intern tables
+                cli.entry(f"i{i % 8}")
+            lats = []
+            for _ in range(args.iters):
+                for i in range(256):
+                    t0 = time.perf_counter()
+                    cli.entry(f"i{i % 8}")
+                    lats.append(time.perf_counter() - t0)
+                ieng.flush()
+            lats.sort()
+            report("ipc_entry_p50", lats[len(lats) // 2])
+            report("ipc_entry_p99", lats[int(len(lats) * 0.99)])
+            cli.close()
+            plane.close()
+            ieng.close()
+        finally:
+            _cfg.set(_cfg.SPECULATIVE_ENABLED, "false")
+    except Exception as exc:
+        print(f"[k2probe] ipc stage skipped: {exc}", file=sys.stderr)
 
     # --- sketch-tier fold in isolation (runtime/sketch.py) -------------
     # The count-min + candidate merge over a pow2 key batch, jitted
