@@ -9,7 +9,9 @@ window armed (``sentinel.tpu.ingest.batch.window.ms`` > 0) concurrent
 exchanges coalesce into one columnar ``submit_bulk`` flush — awaited,
 so the event loop stays free while the window assembles — with
 per-request verdict fan-out; window off is exactly the per-request
-path.
+path. In ipc worker mode (``sentinel.tpu.ipc.worker.mode``) the same
+awaits ride the process's IngestClient to the engine process (in the
+loop's default executor), middleware unchanged.
 """
 
 from __future__ import annotations

@@ -9,7 +9,10 @@ Admissions ride the columnar ingest spine: with the adapter-edge batch
 window armed (``sentinel.tpu.ingest.batch.window.ms`` > 0) concurrent
 requests coalesce into one columnar ``submit_bulk`` flush with
 per-request verdict fan-out (``api.entry_windowed``); window off is
-exactly the per-request path.
+exactly the per-request path. In ipc worker mode
+(``sentinel.tpu.ipc.worker.mode``) the same calls ride the process's
+IngestClient to the engine process instead — this middleware is
+unchanged either way (see sentinel_tpu/ipc/worker_mode.py).
 """
 
 from __future__ import annotations

@@ -31,6 +31,20 @@ from sentinel_tpu.utils.clock import Clock
 
 _engine: Optional[Engine] = None
 _engine_lock = threading.RLock()
+# Worker-mode client (sentinel.tpu.ipc.worker.mode, PR 14): when this
+# process is attached as an ingest worker, the entry surface routes
+# through its IngestClient instead of a local engine — no Engine is
+# ever constructed here. None (the default) costs one read per call;
+# installed/cleared by sentinel_tpu.ipc.worker_mode.attach/detach.
+_worker_client = None
+# (client, worker_mode.client_entry) as ONE tuple, bound at
+# set_worker_client(): the hot paths read a single reference — atomic
+# under the GIL — so a concurrent detach can never tear the pair
+# (client observed non-None, then the callable read as None), and the
+# per-call import-machinery overhead is gone. _worker_client stays as
+# the separate boolean-ish check other modules read (context.true_enter,
+# tests).
+_worker_hook = None
 # The engine under construction, visible only to re-entrant calls from
 # the initializing thread (the RLock blocks everyone else). ``_engine``
 # is published only once fully initialized, so the lock-free fast path
@@ -109,6 +123,20 @@ def set_engine(engine: Optional[Engine]) -> Optional[Engine]:
         prev = _engine
         _engine = engine
         return prev
+
+
+def set_worker_client(cli) -> None:
+    """Install/clear the ipc worker-mode client hook (see
+    sentinel_tpu.ipc.worker_mode — not a public API)."""
+    global _worker_client, _worker_hook
+    if cli is not None:
+        from sentinel_tpu.ipc.worker_mode import client_entry
+
+        _worker_hook = (cli, client_entry)
+        _worker_client = cli
+    else:
+        _worker_client = None
+        _worker_hook = None
 
 
 def reset(clock: Optional[Clock] = None) -> Engine:
@@ -368,7 +396,17 @@ def entry(
 
     ``args`` are the invocation arguments checked by hot-parameter rules
     (SphU.entry(name, type, count, args...) in the reference).
+
+    In ipc worker mode (``sentinel.tpu.ipc.worker.mode``) the admission
+    rides this process's IngestClient to the engine process instead —
+    same Entry/BlockError surface, no local engine.
     """
+    hook = _worker_hook
+    if hook is not None:
+        return hook[1](
+            hook[0], resource, entry_type, count, origin, args,
+            with_context=True, prio=prio,
+        )
     e, verdict = _do_entry(
         resource, entry_type, count, origin, prio, with_context=True, args=args
     )
@@ -396,6 +434,15 @@ def try_entry(
     args: Sequence[object] = (),
 ) -> Optional[Entry]:
     """SphO.entry: boolean-style variant — Entry on pass, None on block."""
+    hook = _worker_hook
+    if hook is not None:
+        try:
+            return hook[1](
+                hook[0], resource, entry_type, count, origin, args,
+                with_context=True,
+            )
+        except E.BlockError:
+            return None
     e, _ = _do_entry(
         resource, entry_type, count, origin, False, with_context=True, args=args
     )
@@ -410,6 +457,12 @@ def entry_async(
     args: Sequence[object] = (),
 ) -> Entry:
     """SphU.asyncEntry: not pushed on the ambient stack; exit from anywhere."""
+    hook = _worker_hook
+    if hook is not None:
+        return hook[1](
+            hook[0], resource, entry_type, count, origin, args,
+            with_context=False,
+        )
     e, verdict = _do_entry(
         resource, entry_type, count, origin, False, with_context=False, args=args
     )
@@ -511,7 +564,18 @@ def entry_windowed(
     coalesces with concurrent requests into one columnar
     ``submit_bulk`` flush and the per-request verdict fans back out —
     same Entry/BlockError surface, bit-identical verdicts. Window off
-    (the default) is exactly the per-request call."""
+    (the default) is exactly the per-request call.
+
+    In ipc worker mode the call routes through this process's
+    IngestClient — whose own micro-window
+    (``sentinel.tpu.ipc.client.window.*``) is the worker-side
+    coalescing tier — so adapters keep one code path either way."""
+    hook = _worker_hook
+    if hook is not None:
+        return hook[1](
+            hook[0], resource, entry_type, count, origin, args,
+            with_context=not detached,
+        )
     engine = get_engine()
     w = engine.ingest_window
     if not w.armed:
@@ -541,9 +605,26 @@ async def entry_windowed_async(
     """The awaitable form of :func:`entry_windowed` for async adapters:
     the event loop stays free while the window assembles and flushes
     (the fan-out wakes the task via its loop). Window off falls back to
-    the blocking per-request call — today's async-adapter behavior."""
+    the blocking per-request call — today's async-adapter behavior.
+
+    In ipc worker mode the blocking client call runs in the loop's
+    default executor so the event loop stays free while the client's
+    micro-window assembles and the verdict frame returns."""
     import asyncio
 
+    hook = _worker_hook
+    if hook is not None:
+        # asyncio.to_thread, NOT run_in_executor: to_thread copies the
+        # calling task's contextvars into the pool thread, so (a) the
+        # adapter's ambient traceparent reaches the client's frame
+        # instead of silently shipping EMPTY_TRACE, and (b) the auto
+        # Context client_entry installs lands in the discarded snapshot
+        # — a reused executor thread never sees another request's stale
+        # context/entry_stack.
+        return await asyncio.to_thread(
+            hook[1], hook[0], resource, entry_type, count,
+            origin, args, with_context=not detached,
+        )
     engine = get_engine()
     w = engine.ingest_window
     if not w.armed:
@@ -571,6 +652,50 @@ async def entry_windowed_async(
     if req.verdict is not None and req.verdict.wait_ms > 0:
         await asyncio.sleep(req.verdict.wait_ms / 1e3)
     return e
+
+
+def run_workers(target, n: int = 2, args: Sequence[object] = (),
+                engine: Optional[Engine] = None):
+    """One-line gunicorn-style N-process worker deployment
+    (``sentinel_tpu/ipc`` worker mode): ensure the multi-process ingest
+    plane on the (global) engine, spawn ``n`` worker processes, and run
+    ``target(worker_id, *args)`` in each with the whole ``api.entry``
+    surface — and therefore all six adapters — routed through that
+    process's IngestClient. ``target`` must be a top-level (picklable)
+    callable; the parent's runtime ``sentinel.tpu.ipc.*`` config is
+    replayed into each child so client-window / wakeup / timeout
+    settings apply fleet-wide. Returns a
+    :class:`~sentinel_tpu.ipc.worker_mode.WorkerSet` (``join()``,
+    ``stop()``, ``alive()``)."""
+    from sentinel_tpu.ipc.plane import IngestPlane
+    from sentinel_tpu.ipc import worker_mode
+    from sentinel_tpu.utils.config import config
+
+    eng = engine if engine is not None else get_engine()
+    plane = eng.ipc_plane
+    if plane is None:
+        plane = IngestPlane(eng)
+    if n > plane.workers_max:
+        raise ValueError(
+            f"run_workers: n={n} exceeds sentinel.tpu.ipc.workers.max="
+            f"{plane.workers_max}"
+        )
+    # Allocate ids from the plane, don't assume 0..n-1: a second
+    # run_workers on the same engine (scale-up, restart-before-reap)
+    # must never put two clients on one response ring.
+    ids = plane.claim_worker_slots(n)
+    overrides = config.runtime_snapshot("sentinel.tpu.ipc.")
+    ctx = plane.spawn_context()
+    procs = []
+    for w in ids:
+        p = ctx.Process(
+            target=worker_mode.worker_main,
+            args=(plane.channel(w), w, overrides, target, tuple(args)),
+            daemon=True,
+        )
+        p.start()
+        procs.append(p)
+    return worker_mode.WorkerSet(procs, plane)
 
 
 # Tracer exception filters (Tracer.java:33-34, 129-186): BlockError is

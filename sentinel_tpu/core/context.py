@@ -88,12 +88,36 @@ class ContextUtil:
         return ContextUtil.true_enter(name, origin)
 
     @staticmethod
+    def detached_enter(name: str, origin: str) -> Context:
+        """Engine-free twin of :meth:`true_enter` for ipc worker mode:
+        the node registry lives in the engine process, so no entrance
+        row is resolved here — and, critically, no Engine is ever
+        constructed in the worker (``true_enter`` lazily builds one via
+        ``get_engine()``). The wire carries the context NAME; the plane
+        resolves entrance rows engine-side at decode."""
+        ctx = _current.get()
+        if ctx is None:
+            ctx = Context(name, origin, is_null=False)
+            ctx.auto = name == C.CONTEXT_DEFAULT_NAME
+            ctx.trace = _trace.get()
+            _current.set(ctx)
+        return ctx
+
+    @staticmethod
     def true_enter(name: str, origin: str) -> Context:
         ctx = _current.get()
         if ctx is None:
-            from sentinel_tpu.core.api import get_engine
+            from sentinel_tpu.core import api
 
-            engine = get_engine()
+            if api._worker_client is not None:
+                # ipc worker mode: the node registry lives in the
+                # engine process — resolving the entrance row here
+                # would lazily construct a full Engine (device memory,
+                # flush threads, possibly a second IngestPlane) inside
+                # the worker. The context NAME crosses the wire; the
+                # plane allocates the entrance row engine-side.
+                return ContextUtil.detached_enter(name, origin)
+            engine = api.get_engine()
             row = engine.nodes.entrance_row(name)
             ctx = Context(name, origin, is_null=row is None)
             ctx.auto = name == C.CONTEXT_DEFAULT_NAME

@@ -27,9 +27,16 @@ Modules:
 * :mod:`~sentinel_tpu.ipc.worker` — :class:`IngestClient`, the
   entry/exit/bulk API workers speak. The client holds no device state
   and does no jax work — a worker process only ever touches numpy and
-  shared memory.
+  shared memory. Its micro-window
+  (``sentinel.tpu.ipc.client.window.*``) coalesces concurrent calls
+  into one frame per bounded window.
 * :mod:`~sentinel_tpu.ipc.plane` — :class:`IngestPlane`, the
   engine-side drainer.
+* :mod:`~sentinel_tpu.ipc.worker_mode` — worker mode
+  (``sentinel.tpu.ipc.worker.mode``): route a whole process's
+  ``api.entry`` surface (and therefore every adapter) through its
+  client; ``api.run_workers`` / ``tools/ipc_launch.py`` make an
+  N-process deployment one line.
 
 Config lives under ``sentinel.tpu.ipc.*`` (utils/config.py); the plane
 is **off by default** — never constructed, no shared memory, at most

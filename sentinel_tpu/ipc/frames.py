@@ -416,6 +416,9 @@ def encode_verdicts(
 # seq 8 + ts 8 + acquire 4 + entry_type 1 + resource 4 + context 4 +
 # origin 4 + trace 26 + args_off 4 + args_len 4.
 ENTRY_ROW_BYTES = 67
+# Per-row bytes of an EXIT frame: seq 8 + ts 8 + resource 4 +
+# context 4 + origin 4 + entry_type 1 + rt 4 + count 4 + err 4 + spec 1.
+EXIT_ROW_BYTES = 42
 # Header + intern-blob reserve per frame (a fresh connection's intern
 # records ride the same slot).
 FRAME_RESERVE = 512

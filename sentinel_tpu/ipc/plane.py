@@ -51,6 +51,7 @@ from sentinel_tpu.ipc.ring import (
     HEALTH_HEALTHY,
     ControlBlock,
     ShmRing,
+    resolve_spin_us,
 )
 from sentinel_tpu.ipc.worker import PlaneChannel
 from sentinel_tpu.utils.config import config
@@ -90,12 +91,27 @@ class IngestPlane:
         )
         self.heartbeat_ms = max(1, config.get_int(config.IPC_HEARTBEAT_MS, 100))
         self.poll_us = max(10, config.get_int(config.IPC_POLL_US, 200))
+        # Adaptive wakeups (sentinel.tpu.ipc.wakeup=adaptive): the
+        # drainer spins briefly then parks on a doorbell semaphore a
+        # publishing producer rings; "sleep" (the default) keeps the
+        # PR-13 sleep-poll backoff exactly (no semaphores exist).
+        wake = (config.get(config.IPC_WAKEUP) or "sleep").strip().lower()
+        self.adaptive_wakeup = wake == "adaptive"
+        self.spin_s = resolve_spin_us(
+            config.get_int(config.IPC_WAKEUP_SPIN_US, -1)
+        ) / 1e6
+        self.park_s = max(
+            1, config.get_int(config.IPC_WAKEUP_PARK_MS, 5)
+        ) / 1e3
         self._mp = multiprocessing.get_context("spawn")
         self._req_lock = self._mp.Lock()
+        self._req_doorbell = (
+            self._mp.Semaphore(0) if self.adaptive_wakeup else None
+        )
         self.control = ControlBlock(None, self.workers_max, create=True)
         self.request = ShmRing(
             None, self.ring_slots, self.slot_bytes, create=True,
-            lock=self._req_lock,
+            lock=self._req_lock, doorbell=self._req_doorbell,
         )
         # Response rings allocate LAZILY at channel() time: eagerly
         # mapping workers_max rings would hold ~workers_max x
@@ -104,14 +120,18 @@ class IngestPlane:
         self.responses: List[Optional[ShmRing]] = [
             None for _ in range(self.workers_max)
         ]
+        self._resp_doorbells: List[Optional[object]] = [
+            None for _ in range(self.workers_max)
+        ]
         self._workers: List[_WorkerState] = [
             _WorkerState() for _ in range(self.workers_max)
         ]
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = {
             "frames": 0, "requests": 0, "bulk_rows": 0, "exits": 0,
-            "worker_sheds": 0, "decode_drops": 0, "worker_deaths": 0,
-            "auto_exits": 0, "responses_dropped": 0, "stalled_skips": 0,
+            "exits_unpaired": 0, "worker_sheds": 0, "decode_drops": 0,
+            "worker_deaths": 0, "auto_exits": 0, "responses_dropped": 0,
+            "stalled_skips": 0,
         }
         self._policy_published: Optional[str] = None
         self._last_sweep = 0.0
@@ -120,6 +140,10 @@ class IngestPlane:
         # entries for the dead world after the ledgers were dropped
         # (a later reap would release them against fresh gauges).
         self._world = 0
+        # Worker ids handed out by claim_worker_slots but not yet seen
+        # attached — keeps a second run_workers from reusing a slot
+        # whose child is still booting.
+        self._claimed: set = set()
         self._stop = threading.Event()
         self.closed = False
         self._thread: Optional[threading.Thread] = None
@@ -135,15 +159,53 @@ class IngestPlane:
     # ------------------------------------------------------------------
     # attach surface
     # ------------------------------------------------------------------
+    def claim_worker_slots(self, n: int) -> List[int]:
+        """Reserve ``n`` free worker ids for a spawner (the
+        ``api.run_workers`` allocation): a slot is free when no live
+        worker is attached, its control slot is clear, and no earlier
+        claim is still pending attach. Without this, a second
+        run_workers on the same engine would reuse ids 0..n-1 — two
+        clients on one response ring race its tail pointer and each
+        steals half the other's verdicts."""
+        out: List[int] = []
+        with self._lock:
+            for wid in range(self.workers_max):
+                if len(out) == n:
+                    break
+                ws = self._workers[wid]
+                if ws.attached or wid in self._claimed:
+                    continue
+                try:
+                    _epoch, _wall, pid, _shed = self.control.worker_view(wid)
+                except (ValueError, TypeError):
+                    continue
+                if pid != 0:
+                    continue
+                out.append(wid)
+            if len(out) < n:
+                raise ValueError(
+                    f"claim_worker_slots: only {len(out)} of {n} worker "
+                    f"slots free (workers.max={self.workers_max}; stopped "
+                    "workers free their slots at the dead-worker sweep)"
+                )
+            self._claimed.update(out)
+        return out
+
     def channel(self, worker_id: int) -> PlaneChannel:
         if not (0 <= worker_id < self.workers_max):
             raise ValueError(f"worker_id {worker_id} out of range")
         with self._lock:
             if self.responses[worker_id] is None:
+                bell = (
+                    self._mp.Semaphore(0) if self.adaptive_wakeup else None
+                )
+                self._resp_doorbells[worker_id] = bell
                 self.responses[worker_id] = ShmRing(
-                    None, self.resp_slots, self.slot_bytes, create=True
+                    None, self.resp_slots, self.slot_bytes, create=True,
+                    doorbell=bell,
                 )
             resp_name = self.responses[worker_id].name
+            resp_bell = self._resp_doorbells[worker_id]
         return PlaneChannel(
             control_name=self.control.name,
             request_name=self.request.name,
@@ -153,6 +215,8 @@ class IngestPlane:
             resp_slots=self.resp_slots,
             workers_max=self.workers_max,
             request_lock=self._req_lock,
+            request_doorbell=self._req_doorbell,
+            response_doorbell=resp_bell,
         )
 
     def spawn_context(self):
@@ -184,6 +248,11 @@ class IngestPlane:
     def _run(self) -> None:
         idle_s = self.poll_us / 1e6
         delay = idle_s
+        park = 0.0005
+        # The park timeout is additionally capped by the heartbeat
+        # cadence: the worker-death sweep rides this loop and must keep
+        # its clock even when the doorbell never rings.
+        park_cap = min(self.park_s, max(0.001, self.heartbeat_ms / 1e3))
         while not self._stop.is_set():
             try:
                 worked = self._drain_once()
@@ -194,6 +263,13 @@ class IngestPlane:
                 worked = False
             if worked:
                 delay = idle_s
+                park = 0.0005
+            elif self.adaptive_wakeup:
+                # Spin-then-park: bounded spin keeps the hot round trip
+                # off the scheduler; the park (exponentially growing
+                # timeout, producer-rung doorbell) bounds idle burn.
+                self.request.wait_readable(self.spin_s, park)
+                park = min(park * 2, park_cap)
             else:
                 time.sleep(delay)
                 delay = min(delay * 2, 0.002)
@@ -243,6 +319,7 @@ class IngestPlane:
                 continue
             ws = self._workers[f.worker_id]
             ws.attached = True
+            self._claimed.discard(f.worker_id)
             for iid, raw in f.interns:
                 ws.names[iid] = raw.decode("utf-8", "surrogatepass")
             self._fold_sheds(f.worker_id, f.shed_count)
@@ -349,7 +426,18 @@ class IngestPlane:
     def _apply_exits(self, exits: List[tuple]) -> None:
         """Grouped columnar exits: one submit_exit_bulk per
         (rows, resource, speculative) — completions NEVER shed, and the
-        per-worker live ledger releases its matching admissions."""
+        per-worker live ledger gates which completions apply at all.
+
+        Pairing comes FIRST: an exit that finds no live ledger
+        admission is dropped (counted in ``exits_unpaired``), because
+        each of its causes means the engine-side gauge was never (or no
+        longer) charged — a policy-served caller whose entry never
+        reached the engine (transient engine-dead read at the client),
+        a dead-worker reap that already auto-exited the admission, or a
+        post-reset completion from the dead world. Applying any of
+        those would double-release and drive THREAD gauges negative;
+        the reap remains the backstop for the complementary case
+        (admission without a completion)."""
         if not exits:
             return
         from sentinel_tpu.models import constants as C
@@ -359,7 +447,10 @@ class IngestPlane:
         # One engine-lock resolve per distinct identity, not per row —
         # exits repeat identities heavily by construction, and the
         # engine lock is every submitting thread's critical section.
+        # Rows resolve OUTSIDE the plane lock (_rows_for nests the
+        # engine lock), then one plane-lock pass pairs the whole batch.
         rows_memo: Dict[tuple, object] = {}
+        resolved: List[tuple] = []
         for (wid, res, ctx, org, et, ts, rt, count, err, spec) in exits:
             ident = (res, ctx or C.CONTEXT_DEFAULT_NAME, org, int(et))
             if ident in rows_memo:
@@ -370,10 +461,63 @@ class IngestPlane:
                 )
             if rows is None:
                 continue  # pass-through admissions charge no gauge
-            spec_b = spec != 2  # unknown(0)/speculative(1) release mirror
-            by_key.setdefault((rows, res, spec_b), []).append(
-                (wid, ts, rt, count, err)
-            )
+            # spec: unknown(0)/speculative(1) release mirror
+            resolved.append((wid, rows, res, spec != 2, ts, rt, count, err))
+        unpaired = 0
+        with self._lock:
+            for (wid, rows, res, spec_b, ts, rt, count, err) in resolved:
+                live = self._workers[wid].live
+                # The exit's spec flag may disagree with the admit-time
+                # ledger key (a worker's default speculative=None reads
+                # as mirror-release True while a spec-off admit was
+                # recorded False) — try the exact key, then the flipped
+                # flag, and RELEASE with the admit-time flag (the
+                # mirror was charged, or not, at admit).
+                paired = False
+                for k in (
+                    (rows, res, spec_b, count),
+                    (rows, res, not spec_b, count),
+                ):
+                    cur = live.get(k, 0)
+                    if cur > 0:
+                        if cur > 1:
+                            live[k] = cur - 1
+                        else:
+                            live.pop(k, None)
+                        spec_b = k[2]
+                        paired = True
+                        break
+                if not paired:
+                    # Partial-count completion: Entry.exit(count) may
+                    # release fewer (or more) than the admit acquired —
+                    # in-process parity applies the EXIT's count. Pair
+                    # with any live admission of the same (rows,
+                    # resource), preferring the exit's spec flag, and
+                    # forget that admission so the reap cannot
+                    # re-release it; the acquire/count difference stays
+                    # charged, exactly like the in-process gauge.
+                    cand = None
+                    for k in live:
+                        if k[0] == rows and k[1] == res:
+                            cand = k
+                            if k[2] == spec_b:
+                                break
+                    if cand is not None:
+                        cur = live[cand]
+                        if cur > 1:
+                            live[cand] = cur - 1
+                        else:
+                            live.pop(cand, None)
+                        spec_b = cand[2]
+                        paired = True
+                if not paired:
+                    unpaired += 1
+                    continue
+                by_key.setdefault((rows, res, spec_b), []).append(
+                    (wid, ts, rt, count, err)
+                )
+        if unpaired:
+            self.counters["exits_unpaired"] += unpaired
         for (rows, res, spec_b), items in by_key.items():
             n = len(items)
             eng.submit_exit_bulk(
@@ -386,27 +530,6 @@ class IngestPlane:
                 speculative=spec_b,
             )
             self.counters["exits"] += n
-            with self._lock:
-                for (wid, _ts, _rt, count, _err) in items:
-                    live = self._workers[wid].live
-                    # The exit's spec flag may disagree with the
-                    # admit-time ledger key (a worker's default
-                    # speculative=None reads as mirror-release True
-                    # while a spec-off admit was recorded False) — try
-                    # the exact key, then the flipped flag, so a
-                    # completed admission NEVER stays ledger-live for a
-                    # spurious dead-worker release later.
-                    for k in (
-                        (rows, res, spec_b, count),
-                        (rows, res, not spec_b, count),
-                    ):
-                        cur = live.get(k, 0)
-                        if cur > 0:
-                            if cur > 1:
-                                live[k] = cur - 1
-                            else:
-                                live.pop(k, None)
-                            break
 
     def _rows_for(self, res, ctx, org, etype):
         eng = self._engine
@@ -641,6 +764,7 @@ class IngestPlane:
                 ws.last_seen = now
                 if pid != 0:
                     ws.attached = True
+                    self._claimed.discard(wid)
                 continue
             if not ws.attached:
                 continue
@@ -651,6 +775,7 @@ class IngestPlane:
         with self._lock:
             live, ws.live = ws.live, {}
             ws.attached = False
+            self._claimed.discard(wid)
             ws.last_epoch = 0
             # The control slot is about to zero: a replacement worker
             # on this id restarts its cumulative shed count from 0, so
@@ -721,6 +846,7 @@ class IngestPlane:
             "ring_slots": self.request.slots,
             "slot_bytes": self.slot_bytes,
             "ring_occupancy": round(self.request.occupancy(), 4),
+            "wakeup": "adaptive" if self.adaptive_wakeup else "sleep",
             "intern_gen": self.control.intern_gen(),
             "counters": counters,
             "workers": live,

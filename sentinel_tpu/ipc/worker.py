@@ -44,6 +44,7 @@ from sentinel_tpu.ipc.ring import (
     ControlBlock,
     ShmRing,
     _wall_ms,
+    resolve_spin_us,
 )
 from sentinel_tpu.utils.config import config
 
@@ -64,6 +65,10 @@ class PlaneChannel:
     resp_slots: int
     workers_max: int
     request_lock: object = field(repr=False, default=None)
+    # Adaptive-wakeup doorbells (multiprocessing.Semaphore, travel like
+    # the claim lock): None in "sleep" wakeup mode.
+    request_doorbell: object = field(repr=False, default=None)
+    response_doorbell: object = field(repr=False, default=None)
 
 
 class _Waiter:
@@ -73,6 +78,32 @@ class _Waiter:
         self.event = threading.Event()
         self.verdicts: Dict[int, tuple] = {}
         self.need = need
+
+
+def _byte_chunks(sizes: Sequence[int], budget: int, what: str) -> List[Tuple[int, int]]:
+    """Greedy byte-budget chunking shared by ``bulk()`` and the
+    micro-window flusher: ``[lo, hi)`` windows whose encoded rows fit
+    one slot's frame budget. A single row over the budget is a
+    config/caller mismatch, not backpressure — ValueError, never a
+    shed."""
+    chunks: List[Tuple[int, int]] = []
+    lo = 0
+    size = 0
+    for j, rb in enumerate(sizes):
+        if rb > budget:
+            raise ValueError(
+                f"{what}: row {j}'s encoded args ({rb}B) exceed the "
+                f"frame budget ({budget}B) — raise "
+                "sentinel.tpu.ipc.slot.bytes or shrink the args"
+            )
+        if size + rb > budget and j > lo:
+            chunks.append((lo, j))
+            lo = j
+            size = 0
+        size += rb
+    if sizes:
+        chunks.append((lo, len(sizes)))
+    return chunks
 
 
 class IngestClient:
@@ -96,16 +127,31 @@ class IngestClient:
         )
         self.request = ShmRing(
             channel.request_name, channel.ring_slots, channel.slot_bytes,
-            lock=channel.request_lock,
+            lock=channel.request_lock, doorbell=channel.request_doorbell,
         )
         self.response = ShmRing(
             channel.response_name, channel.resp_slots, channel.slot_bytes,
+            doorbell=channel.response_doorbell,
         )
         self.heartbeat_ms = max(1, config.get_int(config.IPC_HEARTBEAT_MS, 100))
         self.engine_dead_ms = max(
             1, config.get_int(config.IPC_ENGINE_DEAD_MS, 1000)
         )
         self.timeout_ms = max(1, config.get_int(config.IPC_TIMEOUT_MS, 5000))
+        # Adaptive wakeup (sentinel.tpu.ipc.wakeup=adaptive): the
+        # reader spins briefly then parks on the response-ring doorbell
+        # instead of the fixed 200 µs sleep-poll. Only meaningful when
+        # the plane shipped a doorbell in the channel.
+        wake = (config.get(config.IPC_WAKEUP) or "sleep").strip().lower()
+        self.adaptive_wakeup = (
+            wake == "adaptive" and channel.response_doorbell is not None
+        )
+        self._spin_s = resolve_spin_us(
+            config.get_int(config.IPC_WAKEUP_SPIN_US, -1)
+        ) / 1e6
+        self._park_s = max(
+            1, config.get_int(config.IPC_WAKEUP_PARK_MS, 5)
+        ) / 1e3
         self._lock = threading.Lock()
         self._seq = 0
         # Per-connection intern table: each string crosses the boundary
@@ -120,8 +166,42 @@ class IngestClient:
         self.counters: Dict[str, int] = {
             "entries": 0, "bulk_rows": 0, "exits": 0, "exits_dropped": 0,
             "sheds": 0, "policy_served": 0, "frames": 0,
+            "window_flushes": 0,
         }
         self._stop = threading.Event()
+        # Micro-window (sentinel.tpu.ipc.client.window.{ms,max}):
+        # concurrent entry/bulk/exit calls coalesce into one columnar
+        # frame per bounded window — the client-side twin of
+        # runtime/window.py's BatchWindow. Off (window.ms=0, the
+        # default) keeps PR-13 per-call framing exactly: no flusher
+        # thread, no buffered state, the armed check is one bool read.
+        self.window_ms = max(
+            0.0, config.get_float(config.IPC_CLIENT_WINDOW_MS, 0.0)
+        )
+        self.window_max = max(
+            1, config.get_int(config.IPC_CLIENT_WINDOW_MAX, 256)
+        )
+        self.window_armed = self.window_ms > 0.0
+        self._win_cond = threading.Condition(self._lock)
+        self._win_rows: List[fr.EntryRow] = []
+        # Buffered completions as IDENTITY tuples, not encoded rows:
+        # exits retry across failed pushes, and a retried payload must
+        # re-intern (a failed push rolls its fresh interns back — see
+        # exit()'s per-call loop, which rebuilds for the same reason).
+        self._win_exits: List[tuple] = []
+        # Seqs of windowed rows that came through bulk(): the flusher
+        # counts pushed rows into entries vs bulk_rows at flush time,
+        # and the waiter may already be gone (caller timeout) by then.
+        self._win_bulk: set = set()
+        self._win_deadline: Optional[float] = None
+        self._win_exit_stall: Optional[float] = None
+        self._win_thread: Optional[threading.Thread] = None
+        if self.window_armed:
+            self._win_thread = threading.Thread(
+                target=self._win_loop, name=f"ipc-window-{worker_id}",
+                daemon=True,
+            )
+            self._win_thread.start()
         self._reader = threading.Thread(
             target=self._read_loop, name=f"ipc-reader-{worker_id}", daemon=True
         )
@@ -161,7 +241,15 @@ class IngestClient:
         intern records alone exceeding a slot raise ValueError (a
         config/caller mismatch, never backpressure)."""
         interns, self._fresh = self._fresh, []
-        payload = encode(interns)
+        try:
+            payload = encode(interns)
+        except BaseException:
+            # An encode failure (e.g. a count/rt outside int32) must
+            # leave the intern table consistent: these records were
+            # detached from _fresh but never shipped — forget them or
+            # every later frame referencing the ids decode-drops.
+            self._intern_rollback_locked(interns)
+            raise
         if len(payload) > self.channel.slot_bytes and interns:
             pre = fr.encode_entries(
                 self.worker_id, [], interns, self._intern_gen,
@@ -176,9 +264,11 @@ class IngestClient:
             if not self.request.try_push(pre):
                 self._intern_rollback_locked(interns)
                 return False
+            self.counters["frames"] += 1
             interns = []
             payload = encode([])
         if self.request.try_push(payload):
+            self.counters["frames"] += 1
             return True
         self._intern_rollback_locked(interns)
         return False
@@ -236,6 +326,232 @@ class IngestClient:
         return fr.IpcVerdict(False, E.BLOCK_SHED, 0, limit_type="ipc_ring")
 
     # ------------------------------------------------------------------
+    # micro-window (sentinel.tpu.ipc.client.window.*)
+    # ------------------------------------------------------------------
+    def _win_join_locked(self, rows=(), exits=()) -> None:
+        """Join the assembling micro-window (caller holds the client
+        lock). The flusher wakes at the window deadline or when the
+        row count reaches ``window.max`` — one ring claim + publish
+        then answers for the whole window."""
+        self._win_rows.extend(rows)
+        self._win_exits.extend(exits)
+        if self._win_deadline is None:
+            self._win_deadline = time.monotonic() + self.window_ms / 1e3
+            self._win_cond.notify_all()
+        elif len(self._win_rows) >= self.window_max:
+            self._win_cond.notify_all()
+
+    def _win_due_locked(self) -> bool:
+        if not self._win_rows and not self._win_exits:
+            return False
+        if len(self._win_rows) >= self.window_max:
+            return True
+        d = self._win_deadline
+        return d is not None and time.monotonic() >= d
+
+    def _win_loop(self) -> None:
+        while True:
+            with self._win_cond:
+                while not self._stop.is_set() and not self._win_due_locked():
+                    if self._win_rows or self._win_exits:
+                        left = (
+                            (self._win_deadline or time.monotonic())
+                            - time.monotonic()
+                        )
+                        self._win_cond.wait(left if left > 0 else 0.0005)
+                    else:
+                        self._win_cond.wait(0.05)
+                rows, self._win_rows = self._win_rows, []
+                self._win_deadline = None
+                try:
+                    self._win_flush_locked(rows)
+                except BaseException:
+                    # Last-resort guard (the per-chunk and per-exit
+                    # guards inside make this unreachable on known
+                    # paths): a dead flusher strands every future
+                    # windowed caller and leaks gauges forever — shed
+                    # whatever is still unanswered instead. A row whose
+                    # frame DID push before the failure keeps no waiter
+                    # after this shed; its late verdict is tolerated
+                    # (the reader pops waiters with a None default).
+                    from sentinel_tpu.utils.record_log import record_log
+
+                    record_log.error(
+                        "[ipc] micro-window flush failed — shedding "
+                        "the window", exc_info=True,
+                    )
+                    try:
+                        self._win_shed_locked(rows)
+                        if self._win_exits:
+                            self.counters["exits_dropped"] += len(
+                                self._win_exits
+                            )
+                            self._win_exits = []
+                    except BaseException:
+                        pass
+                if (
+                    self._stop.is_set()
+                    and not self._win_rows
+                    and not self._win_exits
+                ):
+                    return
+
+    def _win_flush_locked(self, rows: List[fr.EntryRow]) -> None:
+        """Encode + push one window: the entry rows in greedy
+        byte-budget chunks (per-row over-budget was refused at the API
+        edge, so every chunk fits a slot), then the buffered exits.
+        Caller holds the client lock."""
+        budget = self.channel.slot_bytes - fr.FRAME_RESERVE
+        chunks = _byte_chunks(
+            [fr.ENTRY_ROW_BYTES + len(r.args) for r in rows], budget,
+            "window",
+        )
+        for ci, (clo, chi) in enumerate(chunks):
+            sub = rows[clo:chi]
+            try:
+                ok = self._push_locked(
+                    lambda interns, sub=sub: fr.encode_entries(
+                        self.worker_id, sub, interns, self._intern_gen,
+                        self._shed_total,
+                    )
+                )
+            except Exception:
+                # An encode failure must not kill the flusher thread —
+                # that would strand every future windowed caller and
+                # leak the engine-side gauges permanently (the worker
+                # keeps heartbeating, so the dead-worker reap never
+                # fires). Shed this chunk and the rest of the window
+                # (the per-call twin of an unanswerable call).
+                from sentinel_tpu.utils.record_log import record_log
+
+                record_log.error(
+                    "[ipc] micro-window encode failed — shedding the "
+                    "window's remaining chunks", exc_info=True,
+                )
+                for (slo, shi) in chunks[ci:]:
+                    self._win_shed_locked(rows[slo:shi])
+                break
+            if ok:
+                self.counters["window_flushes"] += 1
+                # Per-call parity for the amortization counters: an
+                # entry/bulk row counts only once its frame actually
+                # pushed (a shed window must not read as served
+                # entries in frames-per-entry).
+                for r in sub:
+                    if r.seq in self._win_bulk:
+                        self._win_bulk.discard(r.seq)
+                        self.counters["bulk_rows"] += 1
+                    else:
+                        self.counters["entries"] += 1
+                continue
+            # Ring full: this chunk AND every later chunk of the window
+            # shed (per-call parity — a failed push is a local
+            # BLOCK_SHED, never a stall; later chunks may reference
+            # intern ids the failed push just rolled back, so pushing
+            # them anyway would decode-drop at the plane). A dead
+            # engine instead leaves the waiters to their own policy
+            # fallback in _await_one — but the bookkeeping set must
+            # still forget the rows, or every engine-dead window with
+            # bulk rows grows it forever.
+            if self.engine_alive():
+                for (slo, shi) in chunks[ci:]:
+                    self._win_shed_locked(rows[slo:shi])
+            else:
+                for (slo, shi) in chunks[ci:]:
+                    for r in rows[slo:shi]:
+                        self._win_bulk.discard(r.seq)
+            break
+        self._win_drain_exits_locked()
+
+    def _win_shed_locked(self, sub: List[fr.EntryRow]) -> None:
+        """Fan a shed verdict out to a failed chunk's waiters (caller
+        holds the client lock; the inline twin of _shed_verdict)."""
+        n = len(sub)
+        self._shed_total += n
+        self.counters["sheds"] += n
+        try:
+            self.control.note_worker_shed(self.worker_id, n)
+        except (ValueError, TypeError):
+            pass
+        hit: Dict[_Waiter, bool] = {}
+        for r in sub:
+            self._win_bulk.discard(r.seq)
+            w = self._waiters.pop(r.seq, None)
+            if w is None:
+                continue
+            w.verdicts[r.seq] = (0, E.BLOCK_SHED, 0, 0)
+            hit[w] = True
+        for w in hit:
+            w.event.set()
+
+    def _win_drain_exits_locked(self) -> None:
+        """Buffered completions → KIND_EXIT frames. Exits never shed:
+        a full ring re-buffers them for the next window tick, bounded
+        by the stall clock — dropped (and counted) only once the
+        engine is gone or the stall outlives ``timeout.ms``, exactly
+        the per-call exit() stance."""
+        cap = max(1, (self.channel.slot_bytes - fr.FRAME_RESERVE)
+                  // fr.EXIT_ROW_BYTES)
+        while self._win_exits:
+            chunk = self._win_exits[: cap]
+            # (Re)intern per attempt: a failed push rolled its fresh
+            # interns back, so a retried payload must carry fresh
+            # records (stale ids decode-drop at the plane).
+            rows = []
+            for (res, ctx, org, et, ts, rt, count, err, spec) in chunk:
+                seq = self._seq
+                self._seq += 1
+                rows.append(fr.ExitRow(
+                    seq=seq,
+                    resource_id=self._intern_locked(res),
+                    context_id=self._intern_locked(ctx),
+                    origin_id=self._intern_locked(org),
+                    entry_type=et, ts=ts, rt=rt, count=count, err=err,
+                    spec=spec,
+                ))
+            try:
+                ok = self._push_locked(
+                    lambda interns, rows=rows: fr.encode_exits(
+                        self.worker_id, rows, interns, self._intern_gen,
+                        self._shed_total,
+                    )
+                )
+            except Exception:
+                # An unencodable completion (e.g. a count outside
+                # int32) must not kill the flusher: drop the chunk,
+                # counted, and keep draining the rest.
+                from sentinel_tpu.utils.record_log import record_log
+
+                record_log.error(
+                    "[ipc] micro-window exit encode failed — dropping "
+                    "the chunk", exc_info=True,
+                )
+                self.counters["exits_dropped"] += len(chunk)
+                del self._win_exits[: len(chunk)]
+                self._win_exit_stall = None
+                continue
+            if ok:
+                del self._win_exits[: len(chunk)]
+                self.counters["exits"] += len(chunk)
+                self._win_exit_stall = None
+                continue
+            now = time.monotonic()
+            if self._win_exit_stall is None:
+                self._win_exit_stall = now
+            if (
+                not self.engine_alive()
+                or (now - self._win_exit_stall) > self.timeout_ms / 1e3
+                or self._stop.is_set()
+            ):
+                self.counters["exits_dropped"] += len(self._win_exits)
+                self._win_exits = []
+                self._win_exit_stall = None
+            elif self._win_deadline is None:
+                # Schedule a retry tick even if no new joins arrive.
+                self._win_deadline = now + max(self.window_ms, 1.0) / 1e3
+            break
+
+    # ------------------------------------------------------------------
     # the API surface
     # ------------------------------------------------------------------
     def entry(
@@ -291,18 +607,28 @@ class IngestClient:
             )
             w = _Waiter(1)
             self._waiters[seq] = w
-            ok = self._push_locked(
-                lambda interns: fr.encode_entries(
-                    self.worker_id, [row], interns, self._intern_gen,
-                    self._shed_total,
+            if self.window_armed:
+                # Micro-window: the flusher ships one frame for every
+                # call that lands inside the window (shed/policy
+                # outcomes fan back through the same waiter).
+                self._win_join_locked(rows=[row])
+                ok = True
+            else:
+                ok = self._push_locked(
+                    lambda interns: fr.encode_entries(
+                        self.worker_id, [row], interns, self._intern_gen,
+                        self._shed_total,
+                    )
                 )
-            )
-            if not ok:
-                del self._waiters[seq]
+                if not ok:
+                    del self._waiters[seq]
         if not ok:
             return self._shed_verdict()
-        self.counters["entries"] += 1
-        self.counters["frames"] += 1
+        if not self.window_armed:
+            # Windowed entries count at flush time instead, once their
+            # frame actually pushes — a later window shed must not
+            # have pre-counted the row.
+            self.counters["entries"] += 1
         return self._await_one(w, seq, resource, timeout_ms)
 
     def bulk(
@@ -343,31 +669,57 @@ class IngestClient:
         args_blobs: Optional[List[bytes]] = None
         if args_column is not None:
             args_blobs = [fr.encode_args(a) for a in args_column]
-        # Greedy byte-budget chunking: [lo, hi) windows whose encoded
-        # rows fit one slot.
-        chunks: List[tuple] = []
-        lo = 0
-        size = 0
-        for j in range(n):
-            row_bytes = fr.ENTRY_ROW_BYTES + (
-                len(args_blobs[j]) if args_blobs is not None else 0
-            )
-            if row_bytes > budget:
-                raise ValueError(
-                    f"bulk: row {j}'s encoded args ({row_bytes}B) exceed "
-                    f"the frame budget ({budget}B) — raise "
-                    "sentinel.tpu.ipc.slot.bytes or shrink the args"
-                )
-            if size + row_bytes > budget and j > lo:
-                chunks.append((lo, j))
-                lo = j
-                size = 0
-            size += row_bytes
-        chunks.append((lo, n))
+        chunks = _byte_chunks(
+            [
+                fr.ENTRY_ROW_BYTES
+                + (len(args_blobs[j]) if args_blobs is not None else 0)
+                for j in range(n)
+            ],
+            budget, "bulk",
+        )
         out_a = np.zeros(n, dtype=bool)
         out_r = np.zeros(n, dtype=np.int16)
         out_w = np.zeros(n, dtype=np.int32)
         out_f = np.zeros(n, dtype=np.uint8)
+        if self.window_armed:
+            # Micro-window ride: the whole group joins the assembling
+            # window (the flusher re-chunks by bytes across EVERYTHING
+            # in the window); per-row budget was validated above.
+            with self._lock:
+                base = self._seq
+                self._seq += n
+                rid = self._intern_locked(resource)
+                cid = self._intern_locked(context_name)
+                oid = self._intern_locked(origin)
+                rows = [
+                    fr.EntryRow(
+                        seq=base + j,
+                        resource_id=rid, context_id=cid, origin_id=oid,
+                        entry_type=int(entry_type),
+                        acquire=int(acq_col[j]),
+                        ts=int(ts_col[j]),
+                        trace=fr.EMPTY_TRACE,
+                        args=(
+                            args_blobs[j] if args_blobs is not None else b""
+                        ),
+                    )
+                    for j in range(n)
+                ]
+                w = _Waiter(n)
+                for j in range(n):
+                    self._waiters[base + j] = w
+                self._win_bulk.update(range(base, base + n))
+                self._win_join_locked(rows=rows)
+            # bulk_rows counts at flush time (see _win_flush_locked) —
+            # per-call parity: a shed window never counts.
+            got = self._await_many(w, range(base, base + n), resource,
+                                   timeout_ms)
+            for j, (adm, rsn, wms, fl) in enumerate(got):
+                out_a[j] = adm
+                out_r[j] = rsn
+                out_w[j] = wms
+                out_f[j] = fl
+            return out_a, out_r, out_w, out_f
         for lo, hi in chunks:
             m = hi - lo
             with self._lock:
@@ -409,7 +761,6 @@ class IngestClient:
                 out_r[lo:hi] = sv.reason
                 continue
             self.counters["bulk_rows"] += m
-            self.counters["frames"] += 1
             got = self._await_many(w, range(base, base + m), resource,
                                    timeout_ms)
             for j, (adm, rsn, wms, fl) in enumerate(got):
@@ -449,8 +800,22 @@ class IngestClient:
         control thread beats independently) — the completion is then
         dropped and counted in ``exits_dropped`` rather than pinning
         this caller thread forever; the dead-worker reap releases the
-        admission once this worker eventually exits."""
+        admission once this worker eventually exits.
+
+        With the micro-window armed the completion instead buffers for
+        the next window flush and this returns True immediately (=
+        accepted for delivery; the flusher applies the same bounded
+        retry-then-drop stance on the caller's behalf)."""
         _check_entry_type(entry_type)
+        if self.window_armed:
+            with self._lock:
+                self._win_join_locked(exits=[(
+                    resource, context_name, origin, int(entry_type),
+                    -1 if ts is None else int(ts),
+                    int(rt), int(count), int(err),
+                    0 if speculative is None else (1 if speculative else 2),
+                )])
+            return True
         deadline = time.monotonic() + self.timeout_ms / 1e3
         delay = 0.0002
         while True:
@@ -541,11 +906,20 @@ class IngestClient:
         return out
 
     def _read_loop(self) -> None:
+        park = 0.0005
         while not self._stop.is_set():
             payloads = self.response.pop_all(limit=64)
             if not payloads:
-                time.sleep(0.0002)
+                if self.adaptive_wakeup:
+                    # Spin-then-park: the verdict frame usually lands
+                    # within the spin; the park (doorbell-ended, timeout
+                    # growing to the cap) bounds idle burn.
+                    if not self.response.wait_readable(self._spin_s, park):
+                        park = min(park * 2, self._park_s)
+                else:
+                    time.sleep(0.0002)
                 continue
+            park = 0.0005
             for p in payloads:
                 try:
                     f = fr.decode_frame(p)
@@ -582,6 +956,13 @@ class IngestClient:
     # ------------------------------------------------------------------
     def close(self, clear_slot: bool = True) -> None:
         self._stop.set()
+        if self._win_thread is not None:
+            # Wake the flusher so the final window (buffered rows and
+            # completions) ships before the rings close.
+            with self._win_cond:
+                self._win_cond.notify_all()
+            self._win_thread.join(timeout=2.0)
+            self._win_thread = None
         self._reader.join(timeout=2.0)
         if self._beat is not None:
             self._beat.join(timeout=2.0)
@@ -602,6 +983,11 @@ class IngestClient:
                 "counters": dict(self.counters),
                 "interned": len(self._intern),
                 "pending_waits": len(self._waiters),
+                "window_armed": self.window_armed,
+                "window_ms": self.window_ms,
+                "window_max": self.window_max,
+                "window_pending": len(self._win_rows) + len(self._win_exits),
+                "adaptive_wakeup": self.adaptive_wakeup,
             }
 
 

@@ -1118,6 +1118,20 @@ class Engine:
             # ceiling is its ONLY protection — blocked ops are never
             # enqueued, exactly like a valve shed.
             return self._blocked_cold(resource, context_name, origin, acquire)
+        if (
+            sk.cold_armed
+            and op.args
+            and self.param_index.sketch_idx_by_resource
+            and sk.cold_value_blocked(resource, self.param_index, op.args)
+        ):
+            # VALUE-grade ceiling: an unpromoted sketch-mode value over
+            # its admit-by-estimate ceiling blocks the op — the only
+            # protection a cold value has before promotion grants it a
+            # dense row (runtime/sketch.py cold_value_blocked).
+            return self._blocked_cold(
+                resource, context_name, origin, acquire,
+                limit_type="cold_value",
+            )
         # Trace tag OUTSIDE the lock: the stamp (RNG draw, clock read,
         # contextvar get) doesn't depend on the index snapshot, and the
         # submit path's critical section is the throughput ceiling.
@@ -1196,13 +1210,16 @@ class Engine:
         )
 
     def _blocked_cold(
-        self, resource: str, context_name: str, origin: str, acquire: int
+        self, resource: str, context_name: str, origin: str, acquire: int,
+        limit_type: str = "cold",
     ) -> _EntryOp:
         """Never-enqueued sketch cold-ceiling verdict (runtime/
-        sketch.py ``cold_blocked``; counting happened there)."""
+        sketch.py ``cold_blocked``/``cold_value_blocked``; counting
+        happened there). ``limit_type`` distinguishes the resource
+        ceiling ("cold") from the value ceiling ("cold_value")."""
         return self._refused_entry(
             resource, context_name, origin, acquire,
-            reason=E.BLOCK_SKETCH, limit_type="cold",
+            reason=E.BLOCK_SKETCH, limit_type=limit_type,
             provenance="sketch_cold", count_shed=False,
         )
 
@@ -1765,6 +1782,33 @@ class Engine:
             return self._blocked_cold_bulk(
                 resource, n, context_name, origin, acquire
             )
+        if (
+            sk.cold_armed
+            and args_column is not None
+            and self.param_index.sketch_idx_by_resource
+        ):
+            # VALUE-grade cold ceiling over the group's args column: a
+            # fully-blocked group refuses dense (never enqueued); a
+            # PARTIALLY blocked group needs per-row verdicts, which is
+            # per-entry routing — decline like the other bulk-refusing
+            # rule classes so the columnar spine's ValueError fallback
+            # re-routes through submit_entry on the same flush.
+            vmask = sk.cold_value_mask(
+                resource, self.param_index, args_column, n
+            )
+            if vmask is not None:
+                if bool(vmask.all()):
+                    # Row-weighted, matching cold_blocked's bulk count.
+                    sk.note_cold_value_rows(n)
+                    return self._refused_bulk(
+                        resource, n, context_name, origin, acquire,
+                        reason=E.BLOCK_SKETCH, provenance="sketch_cold",
+                        count_shed=False,
+                    )
+                raise ValueError(
+                    "submit_bulk: sketch cold-value ceiling needs "
+                    "per-entry verdicts on this group — use submit_many"
+                )
         with self._lock:
             findex = self.flow_index
             dindex = self.degrade_index

@@ -330,6 +330,10 @@ class SketchTier:
             else None
         )
         self.cold_blocks = 0
+        # The VALUE-grade share of cold_blocks: unpromoted cold values
+        # of sketch_mode param rules refused by the same ceiling
+        # (cold_value_blocked / cold_value_mask below).
+        self.cold_value_blocks = 0
         self._lock = threading.Lock()
         # id -> key name, bounded LRU (ids are hashes; eviction only
         # ever loses the ABILITY to decode a candidate, never device
@@ -450,6 +454,153 @@ class SketchTier:
         if tele.enabled:
             tele.note_sketch_cold_block(n)
         return True
+
+    def _value_keys(self, idxs, args) -> List[str]:
+        """The sketch-mode value keys one op's args contribute —
+        exactly the keys _collect feeds the host twin (collections
+        expand, None drops)."""
+        from sentinel_tpu.rules.param_table import ParamIndex
+
+        keys: List[str] = []
+        for pi in idxs:
+            if pi >= len(args):
+                continue
+            v = args[pi]
+            vals = (
+                v
+                if isinstance(v, (list, tuple, set, frozenset))
+                else (v,)
+            )
+            for vv in vals:
+                k = ParamIndex._value_key(vv)
+                if k is not None:
+                    keys.append(k)
+        return keys
+
+    def cold_value_blocked(
+        self, resource: str, pindex, args, n: int = 1
+    ) -> bool:
+        """The VALUE-grade cold ceiling (the second half of the
+        admit-by-estimate gap): ``sketch_mode`` rules give cold values
+        NO dense row — an unpromoted value passes unthrottled however
+        hot it runs, right up until promotion. Armed, any unpromoted
+        value of a sketch-mode rule whose host count-min estimate is at
+        the ceiling blocks the submit (``BLOCK_SKETCH``, limit_type
+        ``cold_value``). Promoted values are exempt (they have exact
+        dense rows); blocked traffic never feeds back, so the estimate
+        decays and the admitted rate duty-cycles at ~``cold.qps`` per
+        value. The twin is host-side — enforced while DEGRADED too."""
+        idxs = pindex.sketch_idx_by_resource.get(resource)
+        if not idxs or not args:
+            return False
+        promoted = self.promoted_values.get(resource) or frozenset()
+        keys = [
+            k for k in self._value_keys(idxs, args) if k not in promoted
+        ]
+        if not keys:
+            return False
+        win_s = self.window_ms / 1000.0
+        ceiling = COLD_ADMIT_FACTOR * self.cold_qps * win_s
+        blocked = False
+        with self._lock:
+            cm = self._host_cm
+            if cm is None:
+                return False
+            kids = self._ids_for_locked(
+                _KIND_VALUE + resource + _SEP, keys
+            )
+            if bool((cm_estimate(cm, kids) >= ceiling).any()):
+                self.cold_blocks += n
+                self.cold_value_blocks += n
+                blocked = True
+        if blocked:
+            tele = self._engine.telemetry
+            if tele.enabled:
+                tele.note_sketch_cold_block(n)
+        return blocked
+
+    def cold_value_mask(
+        self, resource: str, pindex, args_column, n: int
+    ) -> Optional[np.ndarray]:
+        """Per-row bool mask of a bulk group's value-ceiling blocks
+        (True = the row carries an over-ceiling unpromoted value), or
+        None when no sketch-mode rule / no value applies. Counting is
+        the CALLER's job: a fully-blocked group counts here-equivalent
+        rows via note_cold_value_rows; a partial group re-routes
+        per-op (submit_bulk raises ValueError → the columnar spine's
+        per-request fallback), where cold_value_blocked counts."""
+        idxs = pindex.sketch_idx_by_resource.get(resource)
+        if not idxs or args_column is None:
+            return None
+        from sentinel_tpu.rules.param_table import (
+            ArgsColumns,
+            ParamIndex,
+            _extract_arg,
+        )
+
+        # Gather each row's unpromoted keys FIRST, then estimate every
+        # distinct key in one vectorized pass — a per-(row, value)
+        # cm_estimate would hold the sketch lock for thousands of tiny
+        # numpy calls on a large group, serializing the submit hot
+        # path. Same spirit row-side: _value_key is bound once and the
+        # collection expansion inlined, instead of a per-(row, value)
+        # _value_keys call (which re-imports and re-dispatches every
+        # invocation on this same hot path).
+        value_key = ParamIndex._value_key
+        promoted = self.promoted_values.get(resource) or frozenset()
+        row_keys: List[List[str]] = [[] for _ in range(n)]
+        uniq: Dict[str, None] = {}
+        for pi in idxs:
+            if isinstance(args_column, ArgsColumns):
+                col = args_column.by_idx.get(pi)
+            else:
+                col = [_extract_arg(a, pi) for a in args_column]
+            if col is None:
+                continue
+            for j, v in enumerate(col):
+                if v is None:
+                    continue
+                vals = (
+                    v
+                    if isinstance(v, (list, tuple, set, frozenset))
+                    else (v,)
+                )
+                for vv in vals:
+                    k = value_key(vv)
+                    if k is None or k in promoted:
+                        continue
+                    row_keys[j].append(k)
+                    uniq[k] = None
+        if not uniq:
+            return None
+        keys = list(uniq)
+        win_s = self.window_ms / 1000.0
+        ceiling = COLD_ADMIT_FACTOR * self.cold_qps * win_s
+        with self._lock:
+            cm = self._host_cm
+            if cm is None:
+                return None
+            kids = self._ids_for_locked(_KIND_VALUE + resource + _SEP, keys)
+            over = cm_estimate(cm, kids) >= ceiling
+        hot = {k for k, o in zip(keys, over.tolist()) if o}
+        if not hot:
+            return None
+        mask = np.fromiter(
+            (any(k in hot for k in rk) for rk in row_keys), bool, n
+        )
+        if not mask.any():
+            return None
+        return mask
+
+    def note_cold_value_rows(self, n: int) -> None:
+        """Row-weighted counting for a fully-blocked bulk group (the
+        mask itself never counts — see cold_value_mask)."""
+        with self._lock:
+            self.cold_blocks += n
+            self.cold_value_blocks += n
+        tele = self._engine.telemetry
+        if tele.enabled:
+            tele.note_sketch_cold_block(n)
 
     def decay_due(self, now_ms: int) -> bool:
         """True exactly once per decay window (consumed by the chunk
@@ -982,6 +1133,7 @@ class SketchTier:
             if self._host_cm is not None:
                 self._host_cm[:] = 0
             self.cold_blocks = 0
+            self.cold_value_blocks = 0
         self.reset_device_state()
 
     # ------------------------------------------------------------------
@@ -1034,6 +1186,7 @@ class SketchTier:
             "demote_windows": self.demote_windows,
             "cold_qps": self.cold_qps,
             "cold_blocks": self.cold_blocks,
+            "cold_value_blocks": self.cold_value_blocks,
             "occupancy": round(self.occupancy, 4),
             "est_error_ratio": round(self.est_error_ratio, 6),
             "promoted_count": self.promoted_count,

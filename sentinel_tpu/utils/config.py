@@ -306,8 +306,37 @@ class SentinelConfig:
     # engine-death path above (bounds a wedged-but-heartbeating engine).
     IPC_TIMEOUT_MS = "sentinel.tpu.ipc.timeout.ms"
     # Drainer idle poll floor, microseconds (the plane backs off toward
-    # this when the request ring runs empty).
+    # this when the request ring runs empty; "sleep" wakeup mode only).
     IPC_POLL_US = "sentinel.tpu.ipc.poll.us"
+    # Worker-side micro-window (ipc/worker.py): concurrent
+    # entry/bulk/exit calls on one IngestClient coalesce into ONE
+    # columnar frame per bounded window — the client-side twin of the
+    # adapter batch window (runtime/window.py) — amortizing ring
+    # claims, intern lookups, publishes and wakeups under concurrency.
+    # window.ms 0 (the default) keeps per-call framing exactly;
+    # window.max caps rows per window (flush-on-size).
+    IPC_CLIENT_WINDOW_MS = "sentinel.tpu.ipc.client.window.ms"
+    IPC_CLIENT_WINDOW_MAX = "sentinel.tpu.ipc.client.window.max"
+    # Ring wakeup strategy for the plane drainer and the worker reader
+    # threads: "sleep" (the default — fixed sleep-poll backoff) or
+    # "adaptive" (bounded spin for spin.us, then park on a
+    # shared-memory doorbell semaphore with an exponentially growing
+    # timeout capped at park.ms — cuts the round-trip floor without
+    # burning a core when idle; the producer rings the doorbell only
+    # when the consumer is parked). spin.us -1 (the default) auto-picks
+    # 0 on <=2-core hosts (spinning steals the core the OTHER side of
+    # the pipe needs — measured 2x WORSE than pure park on the 1-core
+    # box) and 50 on larger hosts where a published frame usually lands
+    # within the spin.
+    IPC_WAKEUP = "sentinel.tpu.ipc.wakeup"
+    IPC_WAKEUP_SPIN_US = "sentinel.tpu.ipc.wakeup.spin.us"
+    IPC_WAKEUP_PARK_MS = "sentinel.tpu.ipc.wakeup.park.ms"
+    # Worker mode (ipc/worker_mode.py): route this process's api.entry
+    # surface — entry/try_entry/entry_async/entry_windowed(_async), and
+    # therefore every adapter — through its attached IngestClient
+    # instead of a local engine, making a gunicorn-style N-process
+    # deployment one line (api.run_workers / tools/ipc_launch.py).
+    IPC_WORKER_MODE = "sentinel.tpu.ipc.worker.mode"
     # Per-resource provenance metric plane (metrics/provenance.py):
     # (second, resource) speculative/degraded/shed/drift ledger drained
     # into MetricNodeLine v2 columns and the bounded
@@ -407,6 +436,12 @@ class SentinelConfig:
         IPC_ENGINE_DEAD_MS: "1000",
         IPC_TIMEOUT_MS: "5000",
         IPC_POLL_US: "200",
+        IPC_CLIENT_WINDOW_MS: "0",
+        IPC_CLIENT_WINDOW_MAX: "256",
+        IPC_WAKEUP: "sleep",
+        IPC_WAKEUP_SPIN_US: "-1",
+        IPC_WAKEUP_PARK_MS: "5",
+        IPC_WORKER_MODE: "false",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:
@@ -463,6 +498,17 @@ class SentinelConfig:
         with self._lock:
             if self.get(key) is None:
                 self._runtime[key] = str(value)
+
+    def runtime_snapshot(self, prefix: str = "") -> Dict[str, str]:
+        """Copy of the runtime-set keys (``config.set``) under a prefix
+        — what a spawned worker process replays so it sees this
+        process's runtime config (spawn children start from defaults +
+        env, not from the parent's runtime layer)."""
+        with self._lock:
+            return {
+                k: v for k, v in self._runtime.items()
+                if k.startswith(prefix)
+            }
 
     def get_int(self, key: str, default: int = 0) -> int:
         v = self.get(key)

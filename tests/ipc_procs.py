@@ -116,3 +116,132 @@ def entries_until_dead(channel, wid, resource, q, max_n=2000):
         q.put(("done", wid, served))
     finally:
         cli.close()
+
+
+def run_script_cfg(channel, wid, cfg, script, q):
+    """run_script with a config replay first — spawn children start
+    from defaults, so micro-window / wakeup modes under test must ship
+    in (the launcher's run_workers does the same for real deployments).
+    """
+    from sentinel_tpu.utils.config import config
+
+    for k, v in (cfg or {}).items():
+        config.set(k, v)
+    run_script(channel, wid, script, q)
+
+
+def worker_mode_serve(channel, wid, cfg, paths, q):
+    """Worker-mode end-to-end: THIS process arms
+    sentinel.tpu.ipc.worker.mode, attaches, and serves real adapter
+    requests — the WSGI middleware and the ASGI middleware — whose
+    admissions all ride the IngestClient to the engine process.
+    ``paths`` is [(path, traceparent|None)]; reports
+    [("wsgi"|"asgi", path, status)] per request."""
+    import asyncio
+
+    from sentinel_tpu.utils.config import config
+
+    for k, v in (cfg or {}).items():
+        config.set(k, v)
+    config.set(config.IPC_WORKER_MODE, "true")
+    from sentinel_tpu.ipc import worker_mode
+
+    worker_mode.attach(channel, wid)
+    try:
+        from sentinel_tpu.adapters.asgi import SentinelASGIMiddleware
+        from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
+
+        results = []
+
+        def ok_app(environ, start_response):
+            start_response("200 OK", [])
+            return [b"ok"]
+
+        wsgi = SentinelWSGIMiddleware(ok_app, total_resource=None)
+        for path, tp in paths:
+            statuses = []
+            environ = {"PATH_INFO": path, "REQUEST_METHOD": "GET"}
+            if tp:
+                environ["HTTP_TRACEPARENT"] = tp
+            list(wsgi(environ, lambda s, h: statuses.append(s)))
+            results.append(("wsgi", path, statuses[0]))
+
+        async def asgi_ok(scope, receive, send):
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": []})
+            await send({"type": "http.response.body", "body": b"ok"})
+
+        asgi = SentinelASGIMiddleware(asgi_ok, total_resource=None)
+
+        async def drive(path, tp):
+            sent = []
+
+            async def send(msg):
+                sent.append(msg)
+
+            async def receive():
+                return {"type": "http.request"}
+
+            headers = [(b"traceparent", tp.encode())] if tp else []
+            await asgi(
+                {"type": "http", "method": "GET", "path": path,
+                 "headers": headers},
+                receive, send,
+            )
+            return sent[0]["status"]
+
+        for path, tp in paths:
+            status = asyncio.run(drive(path, tp))
+            results.append(("asgi", path, status))
+        # The worker-mode contract: serving every request above must
+        # never have lazily constructed an Engine in THIS process (no
+        # device memory, no flush threads — and, with ipc.enabled
+        # replayed, no second IngestPlane).
+        from sentinel_tpu.core import api
+
+        q.put(("done", wid, results, api.peek_engine() is None))
+    finally:
+        worker_mode.detach()
+
+
+def worker_mode_admit_and_hang(channel, wid, resource_path, n, q):
+    """Worker-mode kill -9 target: hold ``n`` admitted WSGI requests
+    open (the app never returns, so their entries never exit) — the
+    parent kills this process mid-serve and asserts the plane drains
+    device AND mirror THREAD gauges to exactly 0."""
+    import threading
+    import time as _time
+
+    from sentinel_tpu.utils.config import config
+
+    config.set(config.IPC_WORKER_MODE, "true")
+    from sentinel_tpu.ipc import worker_mode
+
+    worker_mode.attach(channel, wid)
+    from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
+
+    hold = threading.Event()
+    admitted = []
+
+    def hang_app(environ, start_response):
+        start_response("200 OK", [])
+        admitted.append(1)
+        hold.wait()  # never set — entries stay live until kill -9
+        return [b"ok"]
+
+    mw = SentinelWSGIMiddleware(hang_app, total_resource=None)
+
+    def call():
+        try:
+            list(mw({"PATH_INFO": resource_path, "REQUEST_METHOD": "GET"},
+                    lambda s, h: None))
+        except BaseException:
+            pass
+
+    for _ in range(n):
+        threading.Thread(target=call, daemon=True).start()
+    while len(admitted) < n:
+        _time.sleep(0.05)
+    q.put(("admitted", wid, len(admitted)))
+    while True:
+        _time.sleep(1.0)

@@ -202,3 +202,92 @@ class TestGate:
             "every gated metric must belong to exactly one stage-context "
             "group"
         )
+
+
+class TestHostIdentityToken:
+    """The measured host-speed token (ISSUE 14 satellite): same
+    device_kind+jax_version on a different-speed box must SKIP with a
+    reason, never gate red — the r09→r10 re-anchor hole."""
+
+    def _write(self, tmp_path, name, rec):
+        (tmp_path / name).write_text(json.dumps(rec))
+
+    def test_same_token_matches(self, tmp_path):
+        rec = _rec(host_cpu_count=1, host_spin_ms=10.0)
+        self._write(tmp_path, "BENCH_r01.json", rec)
+        path, found, reason = benchgate.find_baseline(
+            str(tmp_path), "cpu", "0.4.37", fresh=rec
+        )
+        assert found is not None and reason == ""
+
+    def test_spin_mismatch_skips_with_reason(self, tmp_path):
+        self._write(
+            tmp_path, "BENCH_r01.json",
+            _rec(host_cpu_count=1, host_spin_ms=10.0),
+        )
+        fresh = _rec(host_cpu_count=1, host_spin_ms=52.0)  # ~5x slower box
+        path, found, reason = benchgate.find_baseline(
+            str(tmp_path), "cpu", "0.4.37", fresh=fresh
+        )
+        assert found is None
+        assert "host-identity token" in reason
+        assert benchgate.gate(fresh, str(tmp_path)) == 0  # SKIP, not red
+
+    def test_cpu_count_mismatch_skips(self, tmp_path):
+        self._write(
+            tmp_path, "BENCH_r01.json",
+            _rec(host_cpu_count=8, host_spin_ms=10.0),
+        )
+        fresh = _rec(host_cpu_count=1, host_spin_ms=10.0)
+        _path, found, reason = benchgate.find_baseline(
+            str(tmp_path), "cpu", "0.4.37", fresh=fresh
+        )
+        assert found is None and "cpu count" in reason
+
+    def test_pre_token_baseline_still_matches(self, tmp_path):
+        """Records predating the token (r10 and earlier) keep matching
+        on the hardware header alone — the token narrows going
+        forward, it does not orphan the committed trajectory."""
+        self._write(tmp_path, "BENCH_r01.json", _rec())  # no token
+        fresh = _rec(host_cpu_count=1, host_spin_ms=52.0)
+        _path, found, reason = benchgate.find_baseline(
+            str(tmp_path), "cpu", "0.4.37", fresh=fresh
+        )
+        assert found is not None and reason == ""
+
+    def test_explicit_baseline_honors_token(self, tmp_path, capsys):
+        base = tmp_path / "BENCH_base.json"
+        base.write_text(
+            json.dumps(_rec(host_cpu_count=1, host_spin_ms=10.0))
+        )
+        fresh = _rec(host_cpu_count=1, host_spin_ms=52.0)
+        rc = benchgate.gate(
+            fresh, str(tmp_path), baseline_path=str(base)
+        )
+        assert rc == 0
+        assert "different box" in capsys.readouterr().out
+
+    def test_within_band_noise_still_matches(self, tmp_path):
+        rec = _rec(host_cpu_count=1, host_spin_ms=10.0)
+        self._write(tmp_path, "BENCH_r01.json", rec)
+        fresh = _rec(host_cpu_count=1, host_spin_ms=18.0)  # 1.8x: noise
+        _path, found, reason = benchgate.find_baseline(
+            str(tmp_path), "cpu", "0.4.37", fresh=fresh
+        )
+        assert found is not None
+
+    def test_no_fallback_to_pre_token_behind_a_mismatch(self, tmp_path):
+        """Once a NEWER same-header baseline's token says 'different
+        box', older token-less records must not re-open the cross-box
+        comparison — the scan refuses them too."""
+        self._write(tmp_path, "BENCH_r01.json", _rec())  # pre-token
+        self._write(
+            tmp_path, "BENCH_r02.json",
+            _rec(host_cpu_count=1, host_spin_ms=10.0),
+        )
+        fresh = _rec(host_cpu_count=1, host_spin_ms=52.0)
+        _path, found, reason = benchgate.find_baseline(
+            str(tmp_path), "cpu", "0.4.37", fresh=fresh
+        )
+        assert found is None
+        assert "pre-token record behind a token mismatch" in reason

@@ -1,0 +1,719 @@
+"""IPC fast path (ISSUE 14): worker-side micro-windows, adaptive ring
+wakeups, and worker mode.
+
+The acceptance surface: micro-window verdicts are bit-identical to the
+per-call frames AND the in-process oracle at pipeline depths {0, 2}
+(flow + param, speculative on/off), in-process and across a real spawn
+boundary; window off preserves PR-13 per-call framing exactly;
+concurrent callers coalesce (frames-per-entry amortization); adaptive
+spin-then-park wakeups keep verdict parity and burn bounded CPU when
+idle; worker mode serves real adapters (WSGI + ASGI) from a spawned
+process with verdict parity, trace identity, and kill -9 leaving
+device AND mirror THREAD gauges exactly 0.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.ipc.plane import IngestPlane
+from sentinel_tpu.ipc.ring import ShmRing
+from sentinel_tpu.ipc.worker import IngestClient
+from sentinel_tpu.models.rules import FlowRule
+from sentinel_tpu.utils.config import config
+
+import ipc_procs
+from test_ipc_plane import (  # noqa: F401 (shared ipc test helpers)
+    _engine,
+    _oracle_decide,
+    _reap_proc,
+    _rules,
+    _spawn,
+    _stream,
+    _q_get,
+    _wait_for,
+)
+
+
+@pytest.fixture(autouse=True)
+def _config_sandbox():
+    with config._lock:
+        saved = dict(config._runtime)
+    yield
+    with config._lock:
+        config._runtime.clear()
+        config._runtime.update(saved)
+
+
+# ---------------------------------------------------------------------------
+# micro-window: differential pinning + framing
+# ---------------------------------------------------------------------------
+class TestMicroWindowParity:
+    """The armed micro-window is bit-identical to the in-process
+    oracle (and therefore to the per-call framing PR-13 pinned against
+    the same oracle) at depths {0,2} x speculative on/off, flow +
+    param rules."""
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    @pytest.mark.parametrize("spec", [False, True])
+    def test_bit_identical(self, manual_clock, depth, spec):
+        config.set(config.PIPELINE_DEPTH, str(depth))
+        config.set(config.SPECULATIVE_ENABLED, "true" if spec else "false")
+        config.set(config.IPC_CLIENT_WINDOW_MS, "2")
+        manual_clock.set_ms(1000)
+        oracle = _engine(manual_clock)
+        _rules(oracle)
+        eng = _engine(manual_clock)
+        _rules(eng)
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            assert cli.window_armed
+            want = []
+            got = []
+            for req in _stream():
+                if req[0] == "entry":
+                    _, res, ts, args = req
+                    want.extend(_oracle_decide(oracle, res, 1, [ts], [args]))
+                    v = cli.entry(res, ts=ts, args=args, timeout_ms=30000)
+                    got.append((v.admitted, v.reason, v.wait_ms))
+                else:
+                    _, res, ts, n = req
+                    want.extend(
+                        _oracle_decide(oracle, res, n, [ts] * n, [()] * n)
+                    )
+                    a, r, w, _f = cli.bulk(res, n, ts=ts, timeout_ms=30000)
+                    got.extend(zip(a.tolist(), r.tolist(), w.tolist()))
+            assert got == want, f"depth={depth} spec={spec}"
+            oracle.flush()
+            oracle.drain()
+            eng.flush()
+            eng.drain()
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+            oracle.close()
+
+    def test_window_off_preserves_percall_framing(self, manual_clock):
+        """window.ms=0 (the default) IS PR-13: no flusher thread, one
+        frame per call."""
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="r", count=1e9)])
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            assert not cli.window_armed
+            assert cli._win_thread is None
+            for _ in range(5):
+                assert cli.entry("r", ts=1000, timeout_ms=30000).admitted
+            assert cli.counters["frames"] == 5
+            assert cli.counters["window_flushes"] == 0
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+    def test_concurrent_callers_coalesce(self, manual_clock):
+        """Concurrency 8: one frame carries many callers' rows — the
+        amortization the bench pins at >=4x; the deterministic floor
+        asserted here is 2x."""
+        config.set(config.IPC_CLIENT_WINDOW_MS, "3")
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="c", count=1e9)])
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            f0 = cli.counters["frames"]
+
+            def worker():
+                for _ in range(10):
+                    assert cli.entry("c", ts=1000, timeout_ms=30000).admitted
+
+            ts = [threading.Thread(target=worker) for _ in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            frames = cli.counters["frames"] - f0
+            assert cli.counters["entries"] == 80
+            assert frames * 2 <= 80, f"no amortization: {frames} frames"
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+    def test_windowed_exits_coalesce_and_release_gauges(self, manual_clock):
+        config.set(config.IPC_CLIENT_WINDOW_MS, "2")
+        config.set(config.SPECULATIVE_ENABLED, "true")
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="g", count=1e9)])
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            for _ in range(5):
+                assert cli.entry("g", ts=1000, timeout_ms=30000).admitted
+            for _ in range(5):
+                assert cli.exit("g")
+            _wait_for(
+                lambda: plane.snapshot()["counters"]["exits"] >= 5,
+                what="windowed exits drained",
+            )
+            assert cli.counters["exits"] == 5
+            eng.flush()
+            eng.drain()
+            assert eng.cluster_node_stats("g")["cur_thread_num"] == 0
+            mirror = eng.speculative.mirror.snapshot()["live_threads"]
+            assert mirror.get("g", 0) == 0
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+    def test_ring_full_sheds_whole_window(self, manual_clock):
+        """A failed window push fans BLOCK_SHED (cause ipc_ring) to
+        every caller in the window — per-call parity, never a stall."""
+        config.set(config.IPC_RING_SLOTS, "2")
+        config.set(config.IPC_CLIENT_WINDOW_MS, "1")
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="s", count=1e9)])
+        plane = IngestPlane(eng, start=False)
+        plane._publish_control(force=True)  # engine reads alive
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            # Fill the 2-slot ring (waits time out into the policy
+            # path — those frames are queued, not shed).
+            for _ in range(2):
+                v = cli.entry("s", ts=1000, timeout_ms=80)
+                assert v.degraded
+            for _ in range(4):
+                v = cli.entry("s", ts=1000, timeout_ms=80)
+                assert not v.admitted
+                assert v.reason == E.BLOCK_SHED
+                assert v.limit_type == "ipc_ring"
+            assert cli.counters["sheds"] == 4
+            # Per-call parity for the amortization ratio: entries
+            # count on push success only — the 2 queued frames, never
+            # the 4 shed rows (pre-counting them would understate
+            # frames-per-entry exactly under the ring pressure the
+            # window claims to help).
+            assert cli.counters["entries"] == 2
+            # The fold still reaches the engine's valve accounting.
+            plane.start()
+            _wait_for(
+                lambda: eng.ingest.counters["shed_ring"] >= 4,
+                what="shed_ring fold",
+            )
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+    def test_unpaired_exit_never_applies(self, manual_clock):
+        """An exit with no live ledger admission — a policy-served
+        caller whose entry never reached the engine (transient
+        engine-dead read at the client), or a dead-worker reap that
+        already auto-exited it — is dropped and counted, never applied:
+        applying it double-releases and drives THREAD gauges negative
+        (reproduced on a loaded box where the first compile outlives
+        the client timeout)."""
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="u", count=1e9)])
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            assert cli.exit("u", rt=5)  # no admission ever made
+            _wait_for(
+                lambda: plane.snapshot()["counters"]["exits_unpaired"] >= 1,
+                what="unpaired exit dropped",
+            )
+            eng.flush()
+            eng.drain()
+            assert eng.cluster_node_stats("u")["cur_thread_num"] == 0
+            # A real admit/completion pair still applies.
+            assert cli.entry("u", ts=1000, timeout_ms=30000).admitted
+            assert cli.exit("u")
+            _wait_for(
+                lambda: plane.snapshot()["counters"]["exits"] >= 1,
+                what="paired exit applied",
+            )
+            eng.flush()
+            eng.drain()
+            assert eng.cluster_node_stats("u")["cur_thread_num"] == 0
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+    def test_partial_count_exit_releases_exit_count(self, manual_clock):
+        """Entry.exit(count) releasing fewer than acquired keeps
+        in-process parity: the exit's count releases NOW (the ledger
+        pairing falls back to any-count for the same rows+resource
+        instead of dropping it as unpaired), and the paired admission
+        is forgotten so the dead-worker reap cannot re-release it."""
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="pc", count=1e9)])
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            v = cli.entry("pc", acquire=2, ts=1000, timeout_ms=30000)
+            assert v.admitted
+            assert cli.exit("pc", count=1)
+            _wait_for(
+                lambda: plane.snapshot()["counters"]["exits"] >= 1,
+                what="partial-count exit applied",
+            )
+            assert plane.snapshot()["counters"]["exits_unpaired"] == 0
+            eng.flush()
+            eng.drain()
+            # The THREAD gauge is per-op: one entry, one completion —
+            # exactly 0 afterward (never negative), and the ledger
+            # forgot the admission so the reap cannot re-release it.
+            assert eng.cluster_node_stats("pc")["cur_thread_num"] == 0
+            assert plane.snapshot()["workers"][0]["live_admissions"] == 0
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+    def test_claim_worker_slots_never_reuses_live_ids(self, manual_clock):
+        """run_workers allocates ids through the plane: a second fleet
+        on the same engine must never put two clients on one response
+        ring (they would race its tail pointer and each steal half the
+        other's verdicts)."""
+        eng = _engine(manual_clock)
+        plane = IngestPlane(eng)
+        try:
+            a = plane.claim_worker_slots(2)
+            b = plane.claim_worker_slots(2)
+            assert len(set(a) | set(b)) == 4, (a, b)
+            with pytest.raises(ValueError):
+                plane.claim_worker_slots(plane.workers_max)
+        finally:
+            plane.close()
+            eng.close()
+
+    def test_flusher_survives_unencodable_exit(self, manual_clock):
+        """An exit the codec cannot encode (count outside int32) is
+        dropped and counted — it must NOT kill the flusher thread,
+        which would strand every future windowed caller while the
+        heartbeat keeps the dead-worker reap away (gauges leak
+        forever). The PR-11 batch-window hardening, client-side."""
+        config.set(config.IPC_CLIENT_WINDOW_MS, "1")
+        eng = _engine(manual_clock)
+        eng.set_flow_rules([FlowRule(resource="x", count=1e9)])
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            assert cli.entry("x", ts=1000, timeout_ms=30000).admitted
+            assert cli.exit("x", count=2 ** 40)  # buffered; encode fails
+            _wait_for(
+                lambda: cli.counters["exits_dropped"] >= 1,
+                what="unencodable exit dropped",
+            )
+            # The flusher survived: later windowed traffic still serves
+            # and later exits still drain.
+            assert cli.entry("x", ts=1000, timeout_ms=30000).admitted
+            assert cli.exit("x")
+            _wait_for(
+                lambda: cli.counters["exits"] >= 1,
+                what="later exit drained",
+            )
+            assert cli._win_thread.is_alive()
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+    @pytest.mark.mp
+    def test_parity_across_spawn_boundary(self, manual_clock):
+        """The armed micro-window + adaptive doorbells across a REAL
+        process boundary (production shape: depth 2, speculative on;
+        the doorbell semaphores must travel the spawn like the claim
+        lock does)."""
+        config.set(config.PIPELINE_DEPTH, "2")
+        config.set(config.SPECULATIVE_ENABLED, "true")
+        config.set(config.IPC_WAKEUP, "adaptive")
+        manual_clock.set_ms(1000)
+        oracle = _engine(manual_clock)
+        _rules(oracle)
+        eng = _engine(manual_clock)
+        _rules(eng)
+        plane = IngestPlane(eng)
+        script = []
+        want = []
+        for req in _stream():
+            if req[0] == "entry":
+                _, res, ts, args = req
+                script.append(
+                    {"kind": "entry", "resource": res, "ts": ts,
+                     "args": list(args), "timeout_ms": 60000}
+                )
+                want.append(
+                    ("entry",)
+                    + _oracle_decide(oracle, res, 1, [ts], [args])[0]
+                )
+            else:
+                _, res, ts, n = req
+                script.append(
+                    {"kind": "bulk", "resource": res, "n": n, "ts": ts}
+                )
+                vs = _oracle_decide(oracle, res, n, [ts] * n, [()] * n)
+                want.append(
+                    ("bulk", [v[0] for v in vs], [v[1] for v in vs],
+                     [v[2] for v in vs])
+                )
+        cfg = {
+            config.IPC_CLIENT_WINDOW_MS: "2",
+            config.IPC_WAKEUP: "adaptive",
+        }
+        p = None
+        try:
+            assert plane.adaptive_wakeup
+            p, q = _spawn(plane, ipc_procs.run_script_cfg, 0, cfg, script)
+            tag, wid, out = _q_get(q)
+            assert tag == "done" and wid == 0
+            got = [
+                ("entry", s[1], s[2], s[3]) if s[0] == "entry"
+                else ("bulk", s[1], s[2], s[3])
+                for s in out
+            ]
+            assert got == want
+        finally:
+            _reap_proc(p)
+            plane.close()
+            eng.close()
+            oracle.close()
+
+
+# ---------------------------------------------------------------------------
+# adaptive wakeups
+# ---------------------------------------------------------------------------
+class TestAdaptiveWakeup:
+    def test_doorbell_wakes_parked_consumer(self):
+        """Ring unit: a producer's publish rings the doorbell of a
+        parked consumer promptly (no 200 µs sleep quantum, no lost
+        wakeup)."""
+        import multiprocessing
+
+        ctx = multiprocessing.get_context("spawn")
+        bell = ctx.Semaphore(0)
+        ring = ShmRing(None, 8, 64, create=True, doorbell=bell)
+        try:
+            woke = {}
+
+            def consumer():
+                t0 = time.monotonic()
+                ok = ring.wait_readable(0.0, 5.0)
+                woke["dt"] = time.monotonic() - t0
+                woke["ok"] = ok
+
+            t = threading.Thread(target=consumer)
+            t.start()
+            time.sleep(0.05)  # let it park
+            assert ring.try_push(b"x")
+            t.join(timeout=10)
+            assert woke["ok"]
+            assert woke["dt"] < 1.0
+            assert ring.try_pop() == b"x"
+            # Set-flag/publish race: payload published BEFORE the park
+            # is seen without any doorbell.
+            assert ring.try_push(b"y")
+            assert ring.wait_readable(0.0, 0.001)
+        finally:
+            ring.destroy()
+
+    def test_parity_with_adaptive_wakeups(self, manual_clock):
+        """Wakeup strategy changes latency, never verdicts."""
+        config.set(config.IPC_WAKEUP, "adaptive")
+        config.set(config.SPECULATIVE_ENABLED, "true")
+        manual_clock.set_ms(1000)
+        oracle = _engine(manual_clock)
+        _rules(oracle)
+        eng = _engine(manual_clock)
+        _rules(eng)
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            assert plane.adaptive_wakeup and cli.adaptive_wakeup
+            want = []
+            got = []
+            for req in _stream():
+                if req[0] == "entry":
+                    _, res, ts, args = req
+                    want.extend(_oracle_decide(oracle, res, 1, [ts], [args]))
+                    v = cli.entry(res, ts=ts, args=args, timeout_ms=30000)
+                    got.append((v.admitted, v.reason, v.wait_ms))
+                else:
+                    _, res, ts, n = req
+                    want.extend(
+                        _oracle_decide(oracle, res, n, [ts] * n, [()] * n)
+                    )
+                    a, r, w, _f = cli.bulk(res, n, ts=ts, timeout_ms=30000)
+                    got.extend(zip(a.tolist(), r.tolist(), w.tolist()))
+            assert got == want
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+            oracle.close()
+
+    def test_idle_cpu_burn_bounded(self, manual_clock):
+        """The spin-then-park wait must not burn a core when idle: an
+        armed adaptive plane + client sitting idle for 1 s consume a
+        bounded fraction of one CPU (a spinning drainer would read
+        ~1.0 on this 1-core box; parked waits read near 0)."""
+        config.set(config.IPC_WAKEUP, "adaptive")
+        eng = _engine(manual_clock)
+        plane = IngestPlane(eng)
+        cli = IngestClient(plane.channel(0), 0, heartbeat=False)
+        try:
+            # One round trip so every thread is warm, then idle.
+            cli.entry("warm", ts=1000, timeout_ms=30000)
+            time.sleep(0.1)
+            cpu0 = time.process_time()
+            t0 = time.monotonic()
+            time.sleep(1.0)
+            wall = time.monotonic() - t0
+            cpu = time.process_time() - cpu0
+            assert cpu < 0.5 * wall, (
+                f"idle adaptive wait burned {cpu:.3f}s CPU over "
+                f"{wall:.3f}s wall"
+            )
+        finally:
+            cli.close()
+            plane.close()
+            eng.close()
+
+
+# ---------------------------------------------------------------------------
+# worker mode (in-process half; the mp half is below)
+# ---------------------------------------------------------------------------
+class TestWorkerModeInProcess:
+    def test_api_surface_routes_through_client(self, manual_clock):
+        from sentinel_tpu.core import api
+        from sentinel_tpu.ipc import worker_mode
+
+        config.set(config.IPC_WORKER_MODE, "true")
+        config.set(config.SPECULATIVE_ENABLED, "true")
+        eng = _engine(manual_clock)
+        eng.set_flow_rules(
+            [
+                FlowRule(resource="open", count=1e9),
+                FlowRule(resource="closed", count=0),
+            ]
+        )
+        plane = IngestPlane(eng)
+        cli = worker_mode.attach(plane.channel(0), 0)
+        try:
+            assert worker_mode.current() is cli
+            e = api.entry("open")
+            assert e.verdict.admitted and e.verdict.speculative
+            e.exit()
+            with pytest.raises(E.BlockError):
+                api.entry("closed")
+            assert api.try_entry("closed") is None
+            # Prio (occupy) semantics cannot cross the wire — refused
+            # loudly, never silently downgraded to a normal admission.
+            with pytest.raises(ValueError):
+                api.entry("open", prio=True)
+            e2 = api.entry_windowed("open")
+            e2.exit()
+            e3 = api.entry_async("open")
+            e3.exit()
+            _wait_for(
+                lambda: plane.snapshot()["counters"]["exits"] >= 3,
+                what="worker-mode exits",
+            )
+            eng.flush()
+            eng.drain()
+            assert eng.cluster_node_stats("open")["cur_thread_num"] == 0
+            mirror = eng.speculative.mirror.snapshot()["live_threads"]
+            assert mirror.get("open", 0) == 0
+        finally:
+            worker_mode.detach()
+            plane.close()
+            eng.close()
+        # Detach restores the normal engine-backed path.
+        assert worker_mode.current() is None
+        from sentinel_tpu.core.api import _worker_client
+
+        assert _worker_client is None
+
+    def test_worker_mode_off_is_parity(self, manual_clock):
+        """Config key off: attach() creates a plain client and never
+        installs the hook."""
+        from sentinel_tpu.core import api
+        from sentinel_tpu.ipc import worker_mode
+
+        eng = _engine(manual_clock)
+        plane = IngestPlane(eng)
+        cli = worker_mode.attach(plane.channel(0), 0)  # mode defaults off
+        try:
+            assert worker_mode.current() is None
+            assert api._worker_client is None
+            cli.close()
+        finally:
+            worker_mode.detach()
+            plane.close()
+            eng.close()
+
+
+def _oracle_statuses(paths, depth):
+    """The in-process oracle: the SAME middleware stack served by a
+    local engine (api-global), same rules — what the worker-mode
+    verdicts must match."""
+    import asyncio
+
+    from sentinel_tpu.adapters.asgi import SentinelASGIMiddleware
+    from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
+    from sentinel_tpu.core import api
+    from sentinel_tpu.runtime.engine import Engine
+
+    config.set(config.PIPELINE_DEPTH, str(depth))
+    oracle = Engine(initial_rows=256)
+    oracle.set_flow_rules(
+        [
+            FlowRule(resource="GET:/open", count=1e9),
+            FlowRule(resource="GET:/closed", count=0),
+        ]
+    )
+    prev = api.set_engine(oracle)
+    try:
+        out = []
+
+        def ok_app(environ, start_response):
+            start_response("200 OK", [])
+            return [b"ok"]
+
+        wsgi = SentinelWSGIMiddleware(ok_app, total_resource=None)
+        for path, _tp in paths:
+            statuses = []
+            list(wsgi({"PATH_INFO": path, "REQUEST_METHOD": "GET"},
+                      lambda s, h: statuses.append(s)))
+            out.append(("wsgi", path, statuses[0]))
+
+        async def asgi_ok(scope, receive, send):
+            await send({"type": "http.response.start", "status": 200,
+                        "headers": []})
+            await send({"type": "http.response.body", "body": b"ok"})
+
+        asgi = SentinelASGIMiddleware(asgi_ok, total_resource=None)
+
+        async def drive(path):
+            sent = []
+
+            async def send(msg):
+                sent.append(msg)
+
+            async def receive():
+                return {"type": "http.request"}
+
+            await asgi({"type": "http", "method": "GET", "path": path,
+                        "headers": []}, receive, send)
+            return sent[0]["status"]
+
+        for path, _tp in paths:
+            out.append(("asgi", path, asyncio.run(drive(path))))
+        return out
+    finally:
+        api.set_engine(prev)
+        oracle.close()
+
+
+@pytest.mark.mp
+class TestWorkerModeMP:
+    """The worker-mode satellite: a REAL spawned worker serving real
+    adapters end-to-end."""
+
+    PATHS = [("/open", None), ("/closed", None), ("/free", None),
+             ("/open", "00-" + "a7" * 16 + "-" + "c3" * 8 + "-01")]
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_adapter_verdict_parity_and_trace_identity(
+        self, manual_clock, depth
+    ):
+        config.set(config.PIPELINE_DEPTH, str(depth))
+        config.set(config.SPECULATIVE_ENABLED, "true")
+        want = _oracle_statuses(self.PATHS, depth)
+        eng = _engine(manual_clock)
+        eng.set_flow_rules(
+            [
+                FlowRule(resource="GET:/open", count=1e9),
+                FlowRule(resource="GET:/closed", count=0),
+            ]
+        )
+        plane = IngestPlane(eng)
+        p = None
+        try:
+            p, q = _spawn(
+                plane, ipc_procs.worker_mode_serve, 0, {}, self.PATHS
+            )
+            tag, _wid, got, engine_free = _q_get(q)
+            assert tag == "done"
+            assert got == want, f"depth={depth}"
+            # 'No Engine ever constructed in the worker' is a pinned
+            # contract, not prose: a lazy get_engine() (e.g. via
+            # context true_enter) would build device state — and a
+            # second IngestPlane — inside every worker.
+            assert engine_free, "worker lazily constructed an Engine"
+            # PR-4 identity: the traced request's inbound trace id
+            # reaches the ENGINE process's admission records — from
+            # the WSGI request AND the ASGI one (the async path runs
+            # the client call in a pool thread; losing the calling
+            # task's contextvars there ships EMPTY_TRACE).
+            tid = "a7" * 16
+            _wait_for(
+                lambda: sum(
+                    1
+                    for r in eng.admission_trace.records()
+                    if r.trace_id == tid and r.parent_span_id == "c3" * 8
+                ) >= 2,
+                what="worker-mode trace identity (wsgi + asgi)",
+            )
+        finally:
+            _reap_proc(p)
+            plane.close()
+            eng.close()
+
+    def test_kill9_mid_serve_drains_gauges_to_zero(self):
+        config.set(config.SPECULATIVE_ENABLED, "true")
+        config.set(config.IPC_HEARTBEAT_MS, "50")
+        config.set(config.IPC_WORKER_DEAD_MS, "400")
+        eng = _engine()  # real clock: heartbeat staleness is wall time
+        eng.set_flow_rules([FlowRule(resource="GET:/hang", count=1e9)])
+        plane = IngestPlane(eng)
+        n = 4
+        p = None
+        try:
+            p, q = _spawn(
+                plane, ipc_procs.worker_mode_admit_and_hang, 0, "/hang", n
+            )
+            tag, _wid, admitted = _q_get(q)
+            assert tag == "admitted" and admitted == n
+            eng.flush()
+            eng.drain()
+            assert eng.cluster_node_stats("GET:/hang")["cur_thread_num"] == n
+            os.kill(p.pid, signal.SIGKILL)  # mid-serve, no exits
+            _wait_for(
+                lambda: plane.snapshot()["counters"]["worker_deaths"] >= 1,
+                timeout_s=30,
+                what="worker death sweep",
+            )
+            assert plane.snapshot()["counters"]["auto_exits"] == n
+            eng.flush()
+            eng.drain()
+            stats = eng.cluster_node_stats("GET:/hang")
+            assert stats["cur_thread_num"] == 0, "device gauge must be 0"
+            mirror = eng.speculative.mirror.snapshot()["live_threads"]
+            assert mirror.get("GET:/hang", 0) == 0, "mirror gauge must be 0"
+        finally:
+            _reap_proc(p)
+            plane.close()
+            eng.close()

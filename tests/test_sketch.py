@@ -814,3 +814,167 @@ class TestColdKeyCeiling:
             assert g.admitted.all()
         assert eng.sketch.cold_blocks == 0
         eng.close()
+
+
+class TestColdValueCeiling:
+    """sentinel.tpu.sketch.cold.qps extended to sketch-mode param
+    VALUES (ISSUE 14 satellite): an unpromoted cold value of a
+    sketch_mode rule — which has NO dense row and previously passed
+    unthrottled at any volume — blocks at the same admit-by-estimate
+    ceiling, from the same host count-min twin (so DEGRADED keeps it),
+    with default 0 = parity."""
+
+    def _engine(self, clk):
+        eng = Engine(clock=clk)
+        eng.set_param_rules(
+            {
+                "api": [
+                    ParamFlowRule(
+                        resource="api", param_idx=0, count=1e9,
+                        sketch_mode=True,
+                    )
+                ]
+            }
+        )
+        return eng
+
+    def _heat(self, eng, value, n=64):
+        g = eng.submit_bulk("api", n=n, args_column=[(value,)] * n)
+        eng.flush()
+        eng.drain()
+        return g
+
+    def test_hot_cold_value_blocked_other_values_pass(self, cold_config):
+        from sentinel_tpu.core import errors as E
+
+        clk = ManualClock(1000)
+        eng = self._engine(clk)
+        # First batch passes (cold twin empty) and feeds the estimate
+        # past the ceiling (2 * 10 qps * 1 s = 20).
+        g = self._heat(eng, "hot-ip")
+        assert g is not None and g.admitted.all()
+        # Singles on the hot value now refuse at the door with the
+        # distinct value-grade attribution; nothing is enqueued.
+        op = eng.submit_entry("api", args=("hot-ip",))
+        assert op.verdict.reason == E.BLOCK_SKETCH
+        assert op.verdict.limit_type == "cold_value"
+        assert not eng.has_pending()
+        # A DIFFERENT cold value on the same rule is untouched.
+        op2 = eng.submit_entry("api", args=("cold-ip",))
+        assert op2.verdict is None or op2.verdict.reason != E.BLOCK_SKETCH
+        eng.flush()
+        eng.drain()
+        assert eng.sketch.cold_value_blocks >= 1
+        c = eng.telemetry.counters_snapshot()
+        assert c["sketch_cold_blocks"] == eng.sketch.cold_blocks
+        eng.close()
+
+    def test_bulk_full_block_dense_and_partial_declines(self, cold_config):
+        from sentinel_tpu.core import errors as E
+
+        clk = ManualClock(1000)
+        eng = self._engine(clk)
+        assert self._heat(eng, "hot-ip").admitted.all()
+        # Uniform hot-value group: refused dense, never enqueued.
+        g = eng.submit_bulk("api", n=6, args_column=[("hot-ip",)] * 6)
+        assert not g.admitted.any()
+        assert g.reason.tolist() == [E.BLOCK_SKETCH] * 6
+        assert not eng.has_pending()
+        # Mixed group: per-row verdicts need per-entry routing — the
+        # same decline contract as the other bulk-refusing rule
+        # classes (the columnar spine falls back to submit_entry).
+        with pytest.raises(ValueError):
+            eng.submit_bulk(
+                "api", n=2, args_column=[("hot-ip",), ("cold-ip",)]
+            )
+        # The submit_many routing enforces per-op: hot blocked, cold
+        # passes, on the same call.
+        ops = eng.submit_many(
+            [
+                {"resource": "api", "args": ("hot-ip",)},
+                {"resource": "api", "args": ("cold-ip",)},
+            ]
+        )
+        assert ops[0].verdict.reason == E.BLOCK_SKETCH
+        assert ops[1]._verdict is None  # enqueued, not refused
+        eng.flush()
+        eng.drain()
+        assert ops[1].verdict.admitted
+        eng.close()
+
+    def test_promoted_value_exempt_and_decay_lifts(self, cold_config):
+        clk = ManualClock(1000)
+        eng = self._engine(clk)
+        assert self._heat(eng, "hot-ip").admitted.all()
+        # Promotion grants the exact dense row: the approximate
+        # ceiling must never touch a promoted value.
+        eng.sketch.promoted_values = {"api": frozenset({"hot-ip"})}
+        op = eng.submit_entry("api", args=("hot-ip",))
+        assert op._verdict is None  # enqueued normally
+        eng.flush()
+        eng.drain()
+        assert op.verdict.admitted
+        eng.sketch.promoted_values = {}
+        op = eng.submit_entry("api", args=("hot-ip",))
+        assert op.verdict is not None and not op.verdict.admitted
+        # Blocked traffic never feeds back: halving decay lifts the
+        # ceiling again (the per-value duty cycle).
+        for _ in range(3):
+            clk.advance(1100)
+            eng.submit_bulk("other", n=1)
+            eng.flush()
+            eng.drain()
+        g = eng.submit_bulk("api", n=4, args_column=[("hot-ip",)] * 4)
+        assert g is not None
+        eng.flush()
+        eng.drain()
+        assert g.admitted.all()
+        eng.close()
+
+    def test_enforced_while_degraded(self, cold_config):
+        from sentinel_tpu.core import errors as E
+        from sentinel_tpu.testing.faults import FaultInjector
+
+        config.set(config.FAILOVER_ENABLED, "true")
+        try:
+            clk = ManualClock(1000)
+            eng = self._engine(clk)
+            eng.submit_bulk("warm", n=1)
+            eng.flush()
+            faults = FaultInjector().install(eng)
+            faults.fail_fetch(eng.flush_seq + 1)
+            eng.submit_bulk("warm", n=1)
+            eng.flush()  # trips DEGRADED
+            assert not eng.failover.healthy
+            g = self._heat(eng, "deg-ip")  # host fold feeds the twin
+            assert g.admitted.all()
+            g2 = eng.submit_bulk(
+                "api", n=8, args_column=[("deg-ip",)] * 8
+            )
+            assert not g2.admitted.any()
+            assert g2.reason.tolist() == [E.BLOCK_SKETCH] * 8
+            eng.close()
+        finally:
+            config.set(
+                config.FAILOVER_ENABLED,
+                config.DEFAULTS[config.FAILOVER_ENABLED],
+            )
+
+    def test_default_zero_is_parity(self):
+        config.set(config.SKETCH_ENABLED, "true")
+        try:
+            clk = ManualClock(1000)
+            eng = self._engine(clk)
+            assert not eng.sketch.cold_armed
+            for _ in range(4):
+                g = eng.submit_bulk(
+                    "api", n=128, args_column=[("v",)] * 128
+                )
+                eng.flush()
+                eng.drain()
+                assert g.admitted.all()
+            assert eng.sketch.cold_value_blocks == 0
+            eng.close()
+        finally:
+            config.set(config.SKETCH_ENABLED,
+                       config.DEFAULTS[config.SKETCH_ENABLED])

@@ -126,7 +126,30 @@ STAGE_METRICS: Dict[str, Tuple[str, float]] = {
     "ipc_vs_inproc": ("higher", 0.30),
     "ipc_entry_p50_us": ("lower", 2.00),
     "ipc_entry_p99_us": ("lower", 5.00),
+    # IPC fast path (PR 14): the adaptive-wakeup A/B and the worker
+    # concurrency sweep. Speedup/amortization are same-run RATIOS
+    # (box noise cancels) — tighter bands; frames-per-entry is a pure
+    # protocol count, the steadiest metric in the file.
+    "ipc_entry_adaptive_p50_us": ("lower", 2.00),
+    "ipc_entry_adaptive_p99_us": ("lower", 5.00),
+    "ipc_wakeup_speedup": ("higher", 0.30),
+    "ipc_percall_w1_ops_per_sec": ("higher", 0.60),
+    "ipc_percall_w2_ops_per_sec": ("higher", 0.60),
+    "ipc_percall_w4_ops_per_sec": ("higher", 0.60),
+    "ipc_window_w1_ops_per_sec": ("higher", 0.60),
+    "ipc_window_w2_ops_per_sec": ("higher", 0.60),
+    "ipc_window_w4_ops_per_sec": ("higher", 0.60),
+    "ipc_frames_per_entry_window": ("lower", 0.50),
+    "ipc_window_amortization": ("higher", 0.30),
 }
+
+# Host-identity token (PR 14): device_kind + jax_version cannot tell
+# two different-speed VMs apart (the r09→r10 re-anchor hole). When
+# BOTH records carry the measured host token (bench._host_identity),
+# the cpu count must match and the spin calibration must agree within
+# this ratio band for the baseline to be comparable; records predating
+# the token keep matching on the hardware header alone.
+HOST_SPIN_BAND = 2.5
 
 # Stage-context keys: a group's metrics are comparable only when every
 # context key present in EITHER record matches (a missing stage on one
@@ -154,7 +177,17 @@ STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
       "autotune_vs_static_best")),
     (("ipc_n_ops", "ipc_n_workers"),
      ("ipc_workers_ops_per_sec", "ipc_inproc_ops_per_sec",
-      "ipc_vs_inproc", "ipc_entry_p50_us", "ipc_entry_p99_us")),
+      "ipc_vs_inproc", "ipc_entry_p50_us", "ipc_entry_p99_us",
+      "ipc_entry_adaptive_p50_us", "ipc_entry_adaptive_p99_us",
+      "ipc_wakeup_speedup")),
+    # The sweep carries its own rung key so a truncated/smoke run
+    # never reads as a slowdown (and pre-PR-14 baselines, which lack
+    # both the key and the metrics, simply don't compare here).
+    (("ipc_sweep_quota",),
+     ("ipc_percall_w1_ops_per_sec", "ipc_percall_w2_ops_per_sec",
+      "ipc_percall_w4_ops_per_sec", "ipc_window_w1_ops_per_sec",
+      "ipc_window_w2_ops_per_sec", "ipc_window_w4_ops_per_sec",
+      "ipc_frames_per_entry_window", "ipc_window_amortization")),
 ]
 
 
@@ -177,16 +210,45 @@ def load_record(path_or_obj) -> Optional[dict]:
     return obj
 
 
+def host_mismatch(fresh: dict, baseline: dict) -> Optional[str]:
+    """A reason string when the two records' measured host-identity
+    tokens (``host_cpu_count`` + ``host_spin_ms``) say DIFFERENT
+    boxes, else None. Records missing the token (pre-PR-14) are never
+    mismatched — the hardware header is then the only identity we
+    have, which is exactly the r09→r10 hole this closes going
+    forward."""
+    f_cpu, b_cpu = fresh.get("host_cpu_count"), baseline.get("host_cpu_count")
+    f_spin, b_spin = fresh.get("host_spin_ms"), baseline.get("host_spin_ms")
+    if not isinstance(f_spin, (int, float)) or not isinstance(
+        b_spin, (int, float)
+    ) or f_spin <= 0 or b_spin <= 0:
+        return None
+    if (
+        isinstance(f_cpu, int) and isinstance(b_cpu, int)
+        and f_cpu > 0 and b_cpu > 0 and f_cpu != b_cpu
+    ):
+        return f"host cpu count differs ({b_cpu} vs {f_cpu})"
+    ratio = f_spin / b_spin
+    if ratio > HOST_SPIN_BAND or ratio < 1.0 / HOST_SPIN_BAND:
+        return (
+            f"host speed token differs ({b_spin:g} ms vs {f_spin:g} ms "
+            f"spin calibration, {ratio:.2f}x, band {HOST_SPIN_BAND:g}x)"
+        )
+    return None
+
+
 def find_baseline(
-    repo_root: str, device_kind, jax_version
+    repo_root: str, device_kind, jax_version, fresh: Optional[dict] = None
 ) -> Tuple[Optional[str], Optional[dict], str]:
     """Newest committed BENCH_*.json matching the fresh run's hardware
-    header: ``(path, record, reason)`` — path/record None when nothing
-    comparable exists, with the reason spelled out."""
+    header AND host-identity token: ``(path, record, reason)`` —
+    path/record None when nothing comparable exists, with the reason
+    spelled out."""
     if not device_kind or not jax_version:
         return None, None, "fresh record lacks device_kind/jax_version"
     paths = sorted(glob.glob(os.path.join(repo_root, "BENCH_*.json")))
     seen = 0
+    host_skipped: List[str] = []
     for path in reversed(paths):
         rec = load_record(path)
         if rec is None or "error" in rec:
@@ -196,9 +258,33 @@ def find_baseline(
             rec.get("device_kind") == device_kind
             and rec.get("jax_version") == jax_version
         ):
+            why = host_mismatch(fresh or {}, rec)
+            if why is not None:
+                host_skipped.append(f"{os.path.basename(path)}: {why}")
+                continue
+            if host_skipped and not isinstance(
+                rec.get("host_spin_ms"), (int, float)
+            ):
+                # A NEWER same-header baseline's token already said
+                # "different box" — falling back to an older token-less
+                # record would re-open exactly the cross-box comparison
+                # the token refuses (the pre-token record carries no
+                # evidence it came from this box either).
+                host_skipped.append(
+                    f"{os.path.basename(path)}: pre-token record behind "
+                    "a token mismatch"
+                )
+                continue
             return path, rec, ""
     if not paths:
         return None, None, f"no BENCH_*.json baselines under {repo_root}"
+    if host_skipped:
+        return (
+            None, None,
+            "hardware header matches but the measured host-identity "
+            "token does not — cross-box comparison refused ("
+            + "; ".join(host_skipped) + ")",
+        )
     return (
         None, None,
         f"no baseline among {seen} readable BENCH_*.json matches "
@@ -270,7 +356,8 @@ def gate(
         if baseline is None:
             print(f"benchgate usage error: cannot load {baseline_path}")
             return 2
-        # An explicit baseline still honors the hardware-truth header.
+        # An explicit baseline still honors the hardware-truth header
+        # and the measured host-identity token.
         if (
             baseline.get("device_kind") != fresh.get("device_kind")
             or baseline.get("jax_version") != fresh.get("jax_version")
@@ -283,9 +370,18 @@ def gate(
                 f"{fresh.get('device_kind')!r}/{fresh.get('jax_version')!r}"
             )
             return 0
+        host_why = host_mismatch(fresh, baseline)
+        if host_why is not None:
+            print(
+                "benchgate SKIP: explicit baseline "
+                f"{os.path.basename(baseline_path)} is a different box — "
+                f"{host_why}"
+            )
+            return 0
     else:
         baseline_path, baseline, reason = find_baseline(
-            repo_root, fresh.get("device_kind"), fresh.get("jax_version")
+            repo_root, fresh.get("device_kind"), fresh.get("jax_version"),
+            fresh=fresh,
         )
         if baseline is None:
             print(f"benchgate SKIP: {reason}")

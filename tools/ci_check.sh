@@ -33,11 +33,19 @@ echo "== ci_check 2/3: config + doc + metrics audit =="
 JAX_PLATFORMS=cpu python tools/config_audit.py \
     --root sentinel_tpu --doc docs/ARCHITECTURE.md
 
+# Worker-mode smoke (always, cheap): spawned workers serve a real WSGI
+# adapter entirely through the rings — spawn → attach → adapter →
+# engine → verdict → exit release, the surface tier-1's in-process
+# tests cannot fully cover.
+echo "== ci_check 2b: ipc worker-mode smoke =="
+JAX_PLATFORMS=cpu python tools/ipc_launch.py --smoke >/dev/null
+
 if [ "${CI_CHECK_SKIP_BENCH:-0}" = "1" ]; then
     echo "== ci_check 3/3: bench gate SKIPPED (CI_CHECK_SKIP_BENCH=1) =="
     # The ipc stage still smokes even when the full bench is skipped:
-    # it exercises real spawned worker processes + shared-memory rings,
-    # a surface tier-1's in-process tests cannot fully cover.
+    # it exercises real spawned worker processes + shared-memory rings
+    # (incl. the micro-window/per-call sweep and the adaptive-wakeup
+    # A/B at smoke quotas).
     echo "== ci_check 3b: ipc stage smoke =="
     JAX_PLATFORMS=cpu python bench.py --run-stage --kind ipc \
         --rules 4 --entries 1024 --iters 1 --child-platform cpu >/dev/null

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""One-line N-process worker deployment for the multi-process ingest
+plane (sentinel_tpu/ipc): the CLI face of ``api.run_workers``.
+
+The parent process owns the engine (and the plane); each worker process
+runs in ipc worker mode (``sentinel.tpu.ipc.worker.mode``) — the whole
+``api.entry`` surface, and therefore every adapter, rides its
+IngestClient to the engine through the shared-memory rings. Serving a
+WSGI app from N processes is one line::
+
+    python tools/ipc_launch.py myservice:app --workers 4 --port 8080
+
+Worker ``i`` binds ``port + i`` (put nginx/envoy in front, exactly like
+gunicorn's ``--workers``). ``--client-window-ms`` arms the worker-side
+micro-window, ``--wakeup adaptive`` the spin-then-park ring waits; both
+replay into the children automatically.
+
+``--smoke`` runs the self-test used by tools/ci_check.sh: two spawned
+workers serve a built-in WSGI app in-process (no sockets), the parent
+asserts the requests were admitted by the engine and exits 0 — the
+whole worker-mode path (spawn → attach → adapter → rings → engine →
+verdict → exit release) in a few seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_app(spec: str):
+    mod, _, attr = spec.partition(":")
+    m = importlib.import_module(mod)
+    return getattr(m, attr or "app")
+
+
+def _demo_app(environ, start_response):
+    start_response("200 OK", [("Content-Type", "text/plain")])
+    return [b"ok\n"]
+
+
+def serve_wsgi(worker_id: int, spec: str, port: int, wrap: bool) -> None:
+    """Worker target: serve the WSGI app on ``port + worker_id``.
+    Top-level so multiprocessing spawn children import it by name."""
+    from wsgiref.simple_server import make_server
+
+    from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
+
+    app = _demo_app if spec == "-" else _load_app(spec)
+    if wrap:
+        app = SentinelWSGIMiddleware(app)
+    srv = make_server("127.0.0.1", port + worker_id, app)
+    print(f"[ipc_launch] worker {worker_id} serving on "
+          f"http://127.0.0.1:{port + worker_id}", flush=True)
+    srv.serve_forever()
+
+
+def smoke_worker(worker_id: int, n_requests: int, q) -> None:
+    """Smoke target: drive the built-in app through the WSGI adapter
+    in-process (no sockets) and report the statuses."""
+    from sentinel_tpu.adapters.wsgi import SentinelWSGIMiddleware
+
+    app = SentinelWSGIMiddleware(_demo_app, total_resource="web-total")
+    statuses = []
+
+    def start_response(status, headers):
+        statuses.append(status)
+
+    for i in range(n_requests):
+        environ = {"PATH_INFO": f"/smoke/{i % 4}", "REQUEST_METHOD": "GET"}
+        body = b"".join(app(environ, start_response))
+        assert body == b"ok\n", body
+    q.put((worker_id, statuses))
+
+
+def _smoke(n_workers: int = 2, n_requests: int = 8) -> int:
+    from sentinel_tpu.core import api
+    from sentinel_tpu.models.rules import FlowRule
+    from sentinel_tpu.rules.flow_manager import flow_rule_manager
+    from sentinel_tpu.utils.config import config
+
+    # The smoke pins the TRANSPORT path — generous liveness thresholds
+    # so a loaded box (first compiles take seconds, heartbeat threads
+    # starve) doesn't fake engine/worker death and pass the run through
+    # the policy fallback instead. run_workers replays these into the
+    # children.
+    config.set(config.IPC_ENGINE_DEAD_MS, "60000")
+    config.set(config.IPC_WORKER_DEAD_MS, "60000")
+    config.set(config.IPC_TIMEOUT_MS, "120000")
+    eng = api.get_engine()
+    flow_rule_manager.load_rules(
+        [FlowRule(resource="web-total", count=1e9)]
+    )
+    plane = None
+    try:
+        q = None
+        ws = None
+        # run_workers builds the plane; grab its spawn context for the
+        # result queue AFTER so the queue comes from the same context.
+        from sentinel_tpu.ipc.plane import IngestPlane
+
+        plane = eng.ipc_plane or IngestPlane(eng)
+        q = plane.spawn_context().Queue()
+        ws = api.run_workers(
+            smoke_worker, n=n_workers, args=(n_requests, q), engine=eng
+        )
+        seen = 0
+        while seen < n_workers:
+            wid, statuses = q.get(timeout=180)
+            assert len(statuses) == n_requests, statuses
+            assert all(s == "200 OK" for s in statuses), statuses
+            seen += 1
+        ws.join(timeout=30)
+        # Poll, don't snapshot-and-assert: on a loaded box the drainer
+        # can still be inside a first-compile flush with the whole run
+        # queued in the ring (policy-served callers don't wait for it),
+        # and the gauge drain for policy-served admissions rides the
+        # dead-worker reap after the workers exit.
+        import time
+
+        want = n_workers * n_requests
+        served = 0
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            served = plane.snapshot()["counters"]["requests"]
+            if served >= want:
+                break
+            time.sleep(0.25)
+        assert served >= want, plane.snapshot()
+        stats = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            eng.flush()
+            eng.drain()
+            stats = eng.cluster_node_stats("web-total")
+            if stats["cur_thread_num"] == 0:
+                break
+            time.sleep(0.25)
+        assert stats is not None and stats["cur_thread_num"] == 0, stats
+        print(f"[ipc_launch] smoke OK: {n_workers} workers x "
+              f"{n_requests} requests, {served} plane requests, "
+              f"gauges drained to 0")
+        return 0
+    finally:
+        if plane is not None:
+            plane.close()
+        eng.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("app", nargs="?", default="-",
+                    help="WSGI app as module:attr ('-' = built-in demo app)")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--no-wrap", action="store_true",
+                    help="app is already Sentinel-wrapped")
+    ap.add_argument("--client-window-ms", type=float, default=None,
+                    help="arm the worker-side micro-window")
+    ap.add_argument("--wakeup", choices=("sleep", "adaptive"), default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the ci_check worker-mode self-test and exit")
+    args = ap.parse_args()
+
+    from sentinel_tpu.utils.config import config
+
+    if args.client_window_ms is not None:
+        config.set(config.IPC_CLIENT_WINDOW_MS, str(args.client_window_ms))
+    if args.wakeup is not None:
+        config.set(config.IPC_WAKEUP, args.wakeup)
+    if args.smoke:
+        return _smoke(n_workers=min(2, max(1, args.workers)))
+
+    from sentinel_tpu.core import api
+
+    eng = api.get_engine()
+    ws = api.run_workers(
+        serve_wsgi, n=args.workers,
+        args=(args.app, args.port, not args.no_wrap), engine=eng,
+    )
+    print(f"[ipc_launch] {len(ws)} workers up (ports {args.port}.."
+          f"{args.port + args.workers - 1}); Ctrl-C stops", flush=True)
+    try:
+        ws.join()
+    except KeyboardInterrupt:
+        print("[ipc_launch] stopping workers", flush=True)
+        ws.stop()
+    finally:
+        eng.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

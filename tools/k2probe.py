@@ -396,6 +396,32 @@ def main() -> None:
             report("ipc_entry_p99", lats[int(len(lats) * 0.99)])
             cli.close()
             plane.close()
+
+            # Adaptive wakeups (spin-then-park ring waits) on the same
+            # engine: the round-trip floor without the two sleep-poll
+            # wake quanta. Fresh plane — doorbells exist only when it
+            # is built under wakeup=adaptive.
+            _cfg.set(_cfg.IPC_WAKEUP, "adaptive")
+            try:
+                plane = IngestPlane(ieng)
+                cli = IngestClient(plane.channel(0), 0)
+                for i in range(64):
+                    cli.entry(f"i{i % 8}")
+                lats = []
+                for _ in range(args.iters):
+                    for i in range(256):
+                        t0 = time.perf_counter()
+                        cli.entry(f"i{i % 8}")
+                        lats.append(time.perf_counter() - t0)
+                    ieng.flush()
+                lats.sort()
+                report("ipc_entry_adaptive_p50", lats[len(lats) // 2])
+                report("ipc_entry_adaptive_p99",
+                       lats[int(len(lats) * 0.99)])
+                cli.close()
+                plane.close()
+            finally:
+                _cfg.set(_cfg.IPC_WAKEUP, "sleep")
             ieng.close()
         finally:
             _cfg.set(_cfg.SPECULATIVE_ENABLED, "false")
