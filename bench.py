@@ -1268,6 +1268,14 @@ def _ipc_sweep_worker(
         cli.close()
 
 
+def _bench_restart_setup(engine) -> None:
+    """Supervised-engine setup for the restart-outage measurement
+    (top-level so multiprocessing spawn children import it by name)."""
+    from sentinel_tpu.models.rules import FlowRule
+
+    engine.set_flow_rules([FlowRule(resource="r0", count=1e9)])
+
+
 def _run_ipc_stage(n_rules: int, n_ops: int, iters: int) -> dict:
     """Multi-process ingest plane (sentinel_tpu/ipc): N-worker vs
     in-process A/B. The same bulk workload is pushed (a) by N real
@@ -1503,6 +1511,59 @@ def _run_ipc_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         cli2.close()
         plane2.close()
         eng.close()
+
+        # --- engine hot-restart outage (PR 15): supervised engine on
+        # named rings, kill -9 the engine child, time until the probing
+        # client is served device-backed verdicts again (includes the
+        # dead-ms detection window, the restart backoff, the child's
+        # cold boot and the durable warm restore). Failure omits the
+        # column instead of poisoning the gate with a fake number.
+        import os as _os
+        import tempfile as _tempfile
+
+        restart_cols: dict = {}
+        ckpt = _os.path.join(
+            "/dev/shm" if _os.path.isdir("/dev/shm")
+            else _tempfile.gettempdir(),
+            f"stpu-bench-ckpt-{_os.getpid()}.bin",
+        )
+        try:
+            config.set(config.IPC_WAKEUP, config.DEFAULTS[config.IPC_WAKEUP])
+            config.set(config.IPC_HEARTBEAT_MS, "50")
+            config.set(config.IPC_ENGINE_DEAD_MS, "2000")
+            config.set(config.SUPERVISE_BACKOFF_MS, "200")
+            config.set(config.FAILOVER_ENABLED, "true")
+            config.set(config.FAILOVER_CHECKPOINT_EVERY, "2")
+            config.set(config.FAILOVER_CKPT_PATH, ckpt)
+            from sentinel_tpu.ipc.supervise import measure_restart_outage
+
+            out = measure_restart_outage(
+                _bench_restart_setup, "r0", timeout_s=240
+            )
+            restart_cols = {
+                "ipc_restart_outage_ms": round(out["outage_ms"], 1),
+                "ipc_restart_reconnects": out["reconnects"],
+                "ipc_restarts": out["restarts"],
+            }
+            _log(
+                f"ipc restart outage {out['outage_ms']:.0f} ms "
+                f"({out['restarts']} restart, {out['reconnects']} "
+                "reconnect)"
+            )
+        except Exception as e:
+            _log(f"ipc restart measurement failed ({e}) — column omitted")
+        finally:
+            try:
+                _os.unlink(ckpt)
+            except OSError:
+                pass
+            for key in (
+                config.IPC_HEARTBEAT_MS, config.IPC_ENGINE_DEAD_MS,
+                config.SUPERVISE_BACKOFF_MS, config.FAILOVER_ENABLED,
+                config.FAILOVER_CHECKPOINT_EVERY, config.FAILOVER_CKPT_PATH,
+                config.IPC_SHM_PREFIX,
+            ):
+                config.set(key, config.DEFAULTS[key])
     finally:
         for key in (
             config.SPECULATIVE_ENABLED, config.SPECULATIVE_FLUSH_BATCH,
@@ -1542,6 +1603,7 @@ def _run_ipc_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         "ipc_client_policy_served": cli_counters.get("policy_served", 0),
         "ipc_client_sheds": cli_counters.get("sheds", 0),
         "ipc_adaptive_policy_served": cli2_policy,
+        **restart_cols,
         "platform": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "jax_version": jax.__version__,

@@ -698,6 +698,35 @@ def run_workers(target, n: int = 2, args: Sequence[object] = (),
     return worker_mode.WorkerSet(procs, plane)
 
 
+def run_engine_supervised(
+    setup=None,
+    setup_args: Sequence[object] = (),
+    n_workers: int = 0,
+    prefix: Optional[str] = None,
+):
+    """Run the ENGINE in a supervised child process on named
+    shared-memory rings (``sentinel_tpu/ipc/supervise.py``): a crashed
+    engine is restarted on the shared Backoff and re-attaches to the
+    EXISTING rings — workers keep their mappings, detect the
+    engine-boot epoch bump, re-assert their live-admission ledgers and
+    resume device-backed verdicts; with
+    ``sentinel.tpu.failover.checkpoint.path`` set (and failover
+    enabled) the new engine warm-starts from the durable checkpoint.
+
+    ``setup`` (top-level picklable, called as ``setup(engine,
+    *setup_args)`` in the child) loads rules; ``n_workers`` sizes the
+    pre-created response rings. Returns an
+    :class:`~sentinel_tpu.ipc.supervise.EngineSupervisor`
+    (``spawn_worker()``, ``kill_engine()``, ``restarts``, ``stop()``).
+    This process must NOT also host an engine on the same plane."""
+    from sentinel_tpu.ipc.supervise import EngineSupervisor
+
+    return EngineSupervisor(
+        setup=setup, setup_args=setup_args, n_workers=n_workers,
+        prefix=prefix,
+    )
+
+
 # Tracer exception filters (Tracer.java:33-34, 129-186): BlockError is
 # never traced; a predicate, when set, decides alone; otherwise
 # ignore-classes take precedence over trace-classes, and a set

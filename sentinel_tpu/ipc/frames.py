@@ -43,6 +43,14 @@ KIND_ENTRY = 1
 KIND_EXIT = 2
 KIND_BULK = 3
 KIND_VERDICT = 4
+# Worker reconnect (PR 15): after an engine hot-restart (control
+# header's boot epoch bumped) a worker re-asserts its live-admission
+# ledger so the NEW engine can rebuild per-worker ledgers and charge
+# the THREAD gauges its world never saw admitted.
+KIND_REASSERT = 5
+
+# Frame-header flag bits.
+F_FRAME_RECONNECT = 1  # first frame of one reconnect re-assertion
 
 # Frame header: kind u8, flags u8, worker u16, n u32, base_seq u64,
 # intern_gen u32, shed u32, n_interns u32, varbytes u32 -> 28 bytes.
@@ -228,6 +236,20 @@ class ExitRow(NamedTuple):
     spec: int  # 0 unknown, 1 speculative, 2 device-decided
 
 
+class ReassertRow(NamedTuple):
+    """One live-admission ledger line re-asserted after an engine
+    hot-restart: ``count`` admissions of ``acquire`` each, still live
+    in this worker (their exits will arrive later and must pair)."""
+
+    resource_id: int
+    context_id: int
+    origin_id: int
+    entry_type: int
+    spec: int  # 1 = mirror-charged (speculative/degraded admit)
+    acquire: int
+    count: int
+
+
 def encode_entries(
     worker_id: int,
     rows: Sequence[EntryRow],
@@ -318,6 +340,43 @@ def encode_exits(
     )
 
 
+def encode_reasserts(
+    worker_id: int,
+    rows: Sequence[ReassertRow],
+    interns: Sequence[Tuple[int, bytes]],
+    intern_gen: int,
+    shed_count: int,
+    head: bool = False,
+) -> bytes:
+    """REASSERT frame bytes; ``head`` marks the FIRST frame of one
+    reconnect sequence (the plane counts reconnect events off it, not
+    off every chunk)."""
+    n = len(rows)
+    rid = np.fromiter((r.resource_id for r in rows), np.int32, n)
+    cid = np.fromiter((r.context_id for r in rows), np.int32, n)
+    oid = np.fromiter((r.origin_id for r in rows), np.int32, n)
+    etype = np.fromiter((r.entry_type for r in rows), np.int8, n)
+    spec = np.fromiter((r.spec for r in rows), np.int8, n)
+    acq = np.fromiter((r.acquire for r in rows), np.int32, n)
+    count = np.fromiter((r.count for r in rows), np.int32, n)
+    intern_parts: List[bytes] = []
+    for iid, raw in interns:
+        intern_parts.append(_INTERN_HDR.pack(iid, len(raw)))
+        intern_parts.append(raw)
+    hdr = _HDR.pack(
+        KIND_REASSERT, F_FRAME_RECONNECT if head else 0, worker_id, n, 0,
+        intern_gen & 0xFFFFFFFF, shed_count & 0xFFFFFFFF,
+        len(interns), 0,
+    )
+    return b"".join(
+        (
+            hdr, b"".join(intern_parts),
+            rid.tobytes(), cid.tobytes(), oid.tobytes(), etype.tobytes(),
+            spec.tobytes(), acq.tobytes(), count.tobytes(),
+        )
+    )
+
+
 class DecodedFrame(NamedTuple):
     kind: int
     worker_id: int
@@ -328,6 +387,7 @@ class DecodedFrame(NamedTuple):
     columns: Dict[str, np.ndarray]
     traces: bytes  # ENTRY/BULK: n * 26 bytes ("" otherwise)
     varbytes: bytes
+    flags: int = 0
 
 
 def decode_frame(payload: bytes) -> DecodedFrame:
@@ -381,10 +441,19 @@ def decode_frame(payload: bytes) -> DecodedFrame:
         columns["reason"] = col(np.int16)
         columns["wait_ms"] = col(np.int32)
         columns["flags"] = col(np.uint8)
+    elif kind == KIND_REASSERT:
+        columns["resource_id"] = col(np.int32)
+        columns["context_id"] = col(np.int32)
+        columns["origin_id"] = col(np.int32)
+        columns["entry_type"] = col(np.int8)
+        columns["spec"] = col(np.int8)
+        columns["acquire"] = col(np.int32)
+        columns["count"] = col(np.int32)
     else:
         raise ValueError(f"unknown frame kind {kind}")
     return DecodedFrame(
-        kind, worker_id, n, gen, shed, interns, columns, traces, varbytes
+        kind, worker_id, n, gen, shed, interns, columns, traces, varbytes,
+        _flags,
     )
 
 
@@ -419,6 +488,9 @@ ENTRY_ROW_BYTES = 67
 # Per-row bytes of an EXIT frame: seq 8 + ts 8 + resource 4 +
 # context 4 + origin 4 + entry_type 1 + rt 4 + count 4 + err 4 + spec 1.
 EXIT_ROW_BYTES = 42
+# Per-row bytes of a REASSERT frame: resource 4 + context 4 + origin 4
+# + entry_type 1 + spec 1 + acquire 4 + count 4.
+REASSERT_ROW_BYTES = 22
 # Header + intern-blob reserve per frame (a fresh connection's intern
 # records ride the same slot).
 FRAME_RESERVE = 512

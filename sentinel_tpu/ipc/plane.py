@@ -78,7 +78,7 @@ class _WorkerState:
 class IngestPlane:
     """Engine-scoped multi-process ingest plane (see module doc)."""
 
-    def __init__(self, engine, start: bool = True) -> None:
+    def __init__(self, engine, start: bool = True, handles=None) -> None:
         self._engine = engine
         self.workers_max = max(1, config.get_int(config.IPC_WORKERS_MAX, 8))
         self.ring_slots = config.get_int(config.IPC_RING_SLOTS, 1024)
@@ -104,15 +104,62 @@ class IngestPlane:
             1, config.get_int(config.IPC_WAKEUP_PARK_MS, 5)
         ) / 1e3
         self._mp = multiprocessing.get_context("spawn")
-        self._req_lock = self._mp.Lock()
-        self._req_doorbell = (
-            self._mp.Semaphore(0) if self.adaptive_wakeup else None
-        )
-        self.control = ControlBlock(None, self.workers_max, create=True)
-        self.request = ShmRing(
-            None, self.ring_slots, self.slot_bytes, create=True,
-            lock=self._req_lock, doorbell=self._req_doorbell,
-        )
+        # Named segments (sentinel.tpu.ipc.shm.prefix / supervisor
+        # handles): a deterministic prefix lets a RESTARTED engine
+        # process re-attach to the EXISTING rings — workers keep their
+        # mappings, nothing re-spawns. "" (the default) keeps the
+        # anonymous PR-13/14 segments exactly. The producer claim lock
+        # and doorbells cannot live in shared memory; in supervised
+        # mode they come from the SUPERVISOR's handles (so they outlive
+        # any one engine process), otherwise this plane creates its own
+        # — an unsupervised re-attach then must not add NEW producers
+        # through channel() while old workers still hold the old lock.
+        if handles is not None:
+            self.prefix = (handles.prefix or "").strip()
+            self._req_lock = handles.request_lock
+            self._req_doorbell = (
+                handles.request_doorbell if self.adaptive_wakeup else None
+            )
+            self._handle_bells = list(handles.response_doorbells or [])
+        else:
+            self.prefix = (config.get(config.IPC_SHM_PREFIX) or "").strip()
+            self._req_lock = self._mp.Lock()
+            self._req_doorbell = (
+                self._mp.Semaphore(0) if self.adaptive_wakeup else None
+            )
+            self._handle_bells = None
+        self.attached = False
+        # Who unlinks the named segments at close: a handles-mode
+        # (supervised) plane NEVER does — the rings must outlive this
+        # engine process for the next one to re-attach warm; the
+        # SUPERVISOR unlinks at final shutdown
+        # (supervise.unlink_segments). A prefix-without-handles plane
+        # owns them like the anonymous case.
+        self._own_segments = handles is None
+        if self.prefix:
+            ctl_name = f"{self.prefix}-ctl"
+            try:
+                self.control = ControlBlock(ctl_name, self.workers_max)
+                self.attached = True
+            except FileNotFoundError:
+                try:
+                    self.control = ControlBlock(
+                        ctl_name, self.workers_max, create=True
+                    )
+                except FileExistsError:
+                    self.control = ControlBlock(ctl_name, self.workers_max)
+                    self.attached = True
+            self.control._owner = self._own_segments
+            self.request = self._attach_or_create_ring(
+                f"{self.prefix}-req", self.ring_slots,
+                lock=self._req_lock, doorbell=self._req_doorbell,
+            )
+        else:
+            self.control = ControlBlock(None, self.workers_max, create=True)
+            self.request = ShmRing(
+                None, self.ring_slots, self.slot_bytes, create=True,
+                lock=self._req_lock, doorbell=self._req_doorbell,
+            )
         # Response rings allocate LAZILY at channel() time: eagerly
         # mapping workers_max rings would hold ~workers_max x
         # resp_slots x slot_bytes of /dev/shm (~134 MB at defaults)
@@ -131,7 +178,8 @@ class IngestPlane:
             "frames": 0, "requests": 0, "bulk_rows": 0, "exits": 0,
             "exits_unpaired": 0, "worker_sheds": 0, "decode_drops": 0,
             "worker_deaths": 0, "auto_exits": 0, "responses_dropped": 0,
-            "stalled_skips": 0,
+            "stalled_skips": 0, "worker_reconnects": 0, "reasserts": 0,
+            "stale_frames": 0,
         }
         self._policy_published: Optional[str] = None
         self._last_sweep = 0.0
@@ -152,9 +200,48 @@ class IngestPlane:
         # RESTARTED plane under recycled shm names can never alias
         # generation 0 reads from the zeroed header.
         self.control.bump_intern_gen()
+        # Hot-restart generation: one bump per plane attach/create —
+        # workers react to the change with the reconnect protocol
+        # (re-intern, ledger re-assert, buffered-exit replay).
+        self.engine_epoch = self.control.bump_engine_boot()
+        # Frames still in a re-attached ring belong to the DEAD world:
+        # their callers were policy-served long ago and their intern ids
+        # mean nothing here — drop anything below the post-attach
+        # generation instead of guessing (fresh planes never gate).
+        self._min_gen = self.control.intern_gen() if self.attached else 0
+        if self.attached:
+            # Shed-fold baselines: the control slots carry each worker's
+            # CUMULATIVE shed count from the old world — folding from 0
+            # would recount every old shed into the new engine's valve.
+            for wid in range(self.workers_max):
+                try:
+                    _e, _w, pid, shed = self.control.worker_view(wid)
+                except (ValueError, TypeError):
+                    continue
+                if pid != 0:
+                    self._workers[wid].shed_seen = shed
         engine.ipc_plane = self
         if start:
             self.start()
+
+    def _attach_or_create_ring(self, name, slots, lock=None, doorbell=None):
+        try:
+            ring = ShmRing(
+                name, slots, self.slot_bytes, lock=lock, doorbell=doorbell
+            )
+        except FileNotFoundError:
+            try:
+                ring = ShmRing(
+                    name, slots, self.slot_bytes, create=True, lock=lock,
+                    doorbell=doorbell,
+                )
+            except FileExistsError:
+                ring = ShmRing(
+                    name, slots, self.slot_bytes, lock=lock,
+                    doorbell=doorbell,
+                )
+        ring._owner = self._own_segments
+        return ring
 
     # ------------------------------------------------------------------
     # attach surface
@@ -191,19 +278,39 @@ class IngestPlane:
             self._claimed.update(out)
         return out
 
+    def _ensure_response_locked(self, worker_id: int):
+        """The worker's SPSC response ring, created (or, in named mode,
+        re-attached after a hot-restart) lazily; caller holds
+        ``self._lock``."""
+        if self.responses[worker_id] is not None:
+            return self.responses[worker_id]
+        bell = None
+        if self.adaptive_wakeup:
+            if self._handle_bells is not None and worker_id < len(
+                self._handle_bells
+            ):
+                bell = self._handle_bells[worker_id]
+            else:
+                bell = self._mp.Semaphore(0)
+        self._resp_doorbells[worker_id] = bell
+        if self.prefix:
+            ring = self._attach_or_create_ring(
+                f"{self.prefix}-resp{worker_id}", self.resp_slots,
+                doorbell=bell,
+            )
+        else:
+            ring = ShmRing(
+                None, self.resp_slots, self.slot_bytes, create=True,
+                doorbell=bell,
+            )
+        self.responses[worker_id] = ring
+        return ring
+
     def channel(self, worker_id: int) -> PlaneChannel:
         if not (0 <= worker_id < self.workers_max):
             raise ValueError(f"worker_id {worker_id} out of range")
         with self._lock:
-            if self.responses[worker_id] is None:
-                bell = (
-                    self._mp.Semaphore(0) if self.adaptive_wakeup else None
-                )
-                self._resp_doorbells[worker_id] = bell
-                self.responses[worker_id] = ShmRing(
-                    None, self.resp_slots, self.slot_bytes, create=True,
-                    doorbell=bell,
-                )
+            self._ensure_response_locked(worker_id)
             resp_name = self.responses[worker_id].name
             resp_bell = self._resp_doorbells[worker_id]
         return PlaneChannel(
@@ -318,6 +425,19 @@ class IngestPlane:
                 self.counters["decode_drops"] += 1
                 continue
             ws = self._workers[f.worker_id]
+            if self._min_gen and f.intern_gen < self._min_gen:
+                # Dead-world backlog: a frame pushed before THIS plane
+                # attached (its engine died with it undrained). The
+                # callers were policy-served long ago and the intern ids
+                # belong to a table that died with the old process —
+                # answer any still-parked waiter with a fast shed
+                # rather than admitting ghosts into the new world.
+                self.counters["stale_frames"] += 1
+                if f.kind in (fr.KIND_ENTRY, fr.KIND_BULK):
+                    out = responses.setdefault(f.worker_id, [])
+                    for s in f.columns["seq"].tolist():
+                        out.append((int(s), 0, E.BLOCK_SHED, 0, 0))
+                continue
             ws.attached = True
             self._claimed.discard(f.worker_id)
             for iid, raw in f.interns:
@@ -329,6 +449,8 @@ class IngestPlane:
                 self._collect_entries(f, ws, groups, responses)
             elif f.kind == fr.KIND_EXIT:
                 self._collect_exits(f, ws, exits)
+            elif f.kind == fr.KIND_REASSERT:
+                self._apply_reasserts(f, ws)
         if n_rows:
             self.counters["requests"] += n_rows
             if tele.enabled:
@@ -531,6 +653,55 @@ class IngestPlane:
             )
             self.counters["exits"] += n
 
+    def _apply_reasserts(self, f, ws: _WorkerState) -> None:
+        """Worker reconnect after an engine hot-restart: rebuild this
+        worker's live-admission ledger from its re-assertion and charge
+        what the NEW world never saw admitted — +1 device THREAD gauge
+        per live admission (the restore installs gauges at zero; see
+        failover.restore_durable) and the persistent mirror's live
+        counter for mirror-charged admits. The worker replays its
+        buffered dead-window completions BEHIND this frame on the same
+        FIFO ring, so they pair against exactly these ledger lines."""
+        from sentinel_tpu.models import constants as C
+
+        eng = self._engine
+        if f.flags & fr.F_FRAME_RECONNECT:
+            self.counters["worker_reconnects"] += 1
+            if eng.telemetry.enabled:
+                eng.telemetry.note_ipc_reconnect()
+        cols = f.columns
+        charged = 0
+        for i in range(f.n):
+            res = self._name(ws, int(cols["resource_id"][i]))
+            ctx = self._name(ws, int(cols["context_id"][i]))
+            org = self._name(ws, int(cols["origin_id"][i]))
+            et = int(cols["entry_type"][i])
+            cnt = int(cols["count"][i])
+            acq = int(cols["acquire"][i])
+            if res is None or ctx is None or org is None or cnt <= 0:
+                self.counters["decode_drops"] += 1
+                continue
+            if et not in (0, 1):
+                self.counters["decode_drops"] += 1
+                continue
+            rows = self._rows_for(
+                res, ctx or C.CONTEXT_DEFAULT_NAME, org, C.EntryType(et)
+            )
+            if rows is None:
+                continue  # pass-through admissions charge no gauge
+            spec_b = int(cols["spec"][i]) == 1
+            with self._lock:
+                k = (rows, res, spec_b, acq)
+                live = ws.live
+                live[k] = live.get(k, 0) + cnt
+            eng._submit_gauge_comp(rows, cnt)
+            if spec_b and eng.speculative.enabled:
+                eng.failover.fallback.assert_live(res, cnt)
+            charged += cnt
+        if charged:
+            self.counters["reasserts"] += charged
+            eng.flush()
+
     def _rows_for(self, res, ctx, org, etype):
         eng = self._engine
         with eng._lock:
@@ -690,6 +861,15 @@ class IngestPlane:
             if not rows:
                 continue
             ring = self.responses[wid]
+            if ring is None and self.prefix:
+                # Named mode: the worker attached through a PREVIOUS
+                # plane's channel, but the ring name is deterministic —
+                # re-attach and keep answering (the hot-restart case).
+                try:
+                    with self._lock:
+                        ring = self._ensure_response_locked(wid)
+                except (OSError, ValueError):
+                    ring = None
             if ring is None:
                 # Frames from a worker slot that never took a channel
                 # from THIS plane object (stale attach): nowhere to
@@ -848,9 +1028,36 @@ class IngestPlane:
             "ring_occupancy": round(self.request.occupancy(), 4),
             "wakeup": "adaptive" if self.adaptive_wakeup else "sleep",
             "intern_gen": self.control.intern_gen(),
+            "engine_epoch": self.engine_epoch,
+            "shm_prefix": self.prefix,
+            "reattached": self.attached,
             "counters": counters,
             "workers": live,
         }
+
+    def abandon(self) -> None:
+        """Chaos/test hook: die like ``kill -9`` would — stop the
+        threads and drop the shm mappings WITHOUT publishing CLOSED,
+        reaping workers, or unlinking the segments. Workers observe a
+        stale heartbeat (policy-served verdicts), the segments persist,
+        and a new plane on the same prefix re-attaches warm. Never part
+        of a graceful path — ``close()`` is."""
+        if self.closed:
+            return
+        self.closed = True
+        self._stop.set()
+        for t in (self._thread, self._ctrl):
+            if t is not None:
+                t.join(5.0)
+        self._thread = None
+        self._ctrl = None
+        if self._engine.ipc_plane is self:
+            self._engine.ipc_plane = None
+        self.request.close()
+        for r in self.responses:
+            if r is not None:
+                r.close()
+        self.control.close()
 
     def close(self, join_timeout_s: float = 5.0) -> None:
         """Stop serving: publish CLOSED (workers fail over to the

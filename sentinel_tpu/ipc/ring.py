@@ -56,9 +56,12 @@ from typing import Dict, Optional, Tuple
 # ---------------------------------------------------------------------------
 # Ring header: head (u64, producer claim position), tail (u64, consumer
 # publish — occupancy reads only), parked (u64, consumer park flag for
-# the adaptive-wakeup doorbell), then padding to one cache line.
+# the adaptive-wakeup doorbell), geometry (u32 slots + u32 slot_bytes,
+# written at create so a hot-restart attach can VALIDATE instead of
+# trusting its own config), then padding to one cache line.
 _RING_HDR = 64
 _PARKED_OFF = 16
+_GEOM_OFF = 24
 # Slot header: seq (u64), payload length (u32), pad (u32).
 _SLOT_HDR = 16
 
@@ -117,8 +120,30 @@ class ShmRing:
         self._owner = create
         if create:
             self._buf[:size] = b"\x00" * size
+            _U32.pack_into(self._buf, _GEOM_OFF, self.slots)
+            _U32.pack_into(self._buf, _GEOM_OFF + 4, self.slot_bytes)
             for i in range(self.slots):
                 self._seq_write(i, i)
+        else:
+            # Geometry validation on attach (engine hot-restart: a new
+            # process re-attaching with a DIFFERENT configured geometry
+            # would mis-stride every slot — corrupt silently, so fail
+            # loudly instead). Zero = pre-geometry segment; trust the
+            # caller like PR-13/14 did.
+            g_slots = _U32.unpack_from(self._buf, _GEOM_OFF)[0]
+            g_bytes = _U32.unpack_from(self._buf, _GEOM_OFF + 4)[0]
+            if g_slots and (g_slots, g_bytes) != (self.slots, self.slot_bytes):
+                name = self.name
+                self._buf = None  # release the view before close()
+                try:
+                    self.shm.close()
+                except (OSError, BufferError):
+                    pass
+                raise ValueError(
+                    f"ring geometry mismatch: segment {name} has "
+                    f"{g_slots}x{g_bytes}B slots, attach asked "
+                    f"{self.slots}x{self.slot_bytes}B"
+                )
         # Consumer-local read position (the consumer is the only reader
         # of its own ring, so this needs no shared state beyond `tail`).
         self._rpos = self._tail_read()
@@ -348,7 +373,12 @@ class ShmRing:
 #       local string->id dict; workers re-intern on their next frame)
 #   32  u64 engine wall-clock ms at the last heartbeat (staleness ruler
 #       for workers — epoch deltas alone need a shared cadence)
-#   40  .. reserved to 64
+#   40  u64 engine BOOT epoch: bumped once per plane attach/create —
+#       the hot-restart generation word. A worker that sees it change
+#       re-interns, re-asserts its live-admission ledger and replays
+#       buffered completions (ipc/worker.py reconnect protocol).
+#   48  u32 workers_max at create (attach validates geometry)
+#   52  .. reserved to 64
 #   64  worker slots: WORKERS_MAX x 32 bytes
 #       [u64 heartbeat epoch, u64 wall ms, u32 pid, u32 shed count,
 #        u64 reserved]
@@ -403,13 +433,25 @@ class ControlBlock:
             self.shm.buf[:size] = b"\x00" * size
             _U32.pack_into(self.shm.buf, 0, _MAGIC)
             _U32.pack_into(self.shm.buf, 4, _VERSION)
+            _U32.pack_into(self.shm.buf, 48, self.workers_max)
         else:
             self.shm = shared_memory.SharedMemory(name=name)
             magic = _U32.unpack_from(self.shm.buf, 0)[0]
-            if magic != _MAGIC:
+            ver = _U32.unpack_from(self.shm.buf, 4)[0]
+            if magic != _MAGIC or ver != _VERSION:
                 self.shm.close()
                 raise ValueError(
-                    f"not an ipc control segment (magic {magic:#x})"
+                    f"not an ipc control segment (magic {magic:#x}, "
+                    f"version {ver})"
+                )
+            wm = _U32.unpack_from(self.shm.buf, 48)[0]
+            if wm and wm != self.workers_max:
+                # Hot-restart attach with a different workers.max would
+                # mis-place every worker slot and the policy blob.
+                self.shm.close()
+                raise ValueError(
+                    f"control geometry mismatch: segment has "
+                    f"workers_max={wm}, attach asked {self.workers_max}"
                 )
         self._buf = self.shm.buf
         self.name = self.shm.name
@@ -429,6 +471,22 @@ class ControlBlock:
         gen = _U64.unpack_from(self._buf, 24)[0] + 1
         _U64.pack_into(self._buf, 24, gen)
         return gen
+
+    def bump_engine_boot(self) -> int:
+        """Advance the hot-restart generation word — called once per
+        plane attach/create; workers react to the CHANGE (reconnect
+        protocol), so the absolute value doubles as a restart count."""
+        boot = _U64.unpack_from(self._buf, 40)[0] + 1
+        _U64.pack_into(self._buf, 40, boot)
+        return boot
+
+    def engine_boot(self) -> int:
+        """Current boot epoch; 0 once the header is released (a worker
+        racing close() must not see a phantom restart)."""
+        try:
+            return _U64.unpack_from(self._buf, 40)[0]
+        except (TypeError, ValueError):
+            return 0
 
     def publish_policy(self, default: str, overrides: Dict[str, str]) -> bool:
         """Seqlock-write the failover-policy snapshot. Overrides that

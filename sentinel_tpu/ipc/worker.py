@@ -166,8 +166,35 @@ class IngestClient:
         self.counters: Dict[str, int] = {
             "entries": 0, "bulk_rows": 0, "exits": 0, "exits_dropped": 0,
             "sheds": 0, "policy_served": 0, "frames": 0,
-            "window_flushes": 0,
+            "window_flushes": 0, "reconnects": 0, "exits_buffered": 0,
         }
+        # Engine hot-restart reconnect (sentinel.tpu.ipc.reconnect.*):
+        # the client keeps its OWN live-admission ledger — one line per
+        # (identity, mirror-charged?, acquire) still running — so that
+        # when the control header's boot epoch bumps (a NEW engine
+        # attached to the same rings) it can re-assert exactly what is
+        # live into the new world, and completions that could not be
+        # delivered during the dead window buffer (bounded) for replay
+        # instead of dropping. Off = PR-14 exactly: no ledger writes,
+        # dead-window completions drop, a returning engine starts cold.
+        self.reconnect_enabled = config.get_bool(config.IPC_RECONNECT, True)
+        self.reconnect_exits_max = max(
+            0, config.get_int(config.IPC_RECONNECT_EXITS_MAX, 4096)
+        )
+        # (resource, context, origin, entry_type, spec_b, acquire) ->
+        # live admitted count (engine-decided admits only — policy
+        # verdicts never reached the engine and must not re-assert).
+        # ``_live_new`` holds admits decided by a NEW engine boot before
+        # our reconnect completed: the new plane ledgered those at
+        # fan-out, so re-asserting them would double-charge the gauges —
+        # they merge into ``_live`` once the reassert lands.
+        self._live: Dict[tuple, int] = {}
+        self._live_new: Dict[tuple, int] = {}
+        self._dead_exits: List[tuple] = []
+        self._boot = self.control.engine_boot()
+        self._reassert_boot: Optional[int] = None
+        self._reassert_rows: List[tuple] = []
+        self._reassert_head = True
         self._stop = threading.Event()
         # Micro-window (sentinel.tpu.ipc.client.window.{ms,max}):
         # concurrent entry/bulk/exit calls coalesce into one columnar
@@ -290,6 +317,179 @@ class IngestClient:
         return i
 
     # ------------------------------------------------------------------
+    # live-admission ledger + reconnect (engine hot-restart)
+    # ------------------------------------------------------------------
+    def _live_note_locked(self, key: tuple) -> None:
+        # An admit decided by a newer engine boot than the one we have
+        # re-asserted to is ALREADY in the new plane's ledger — keep it
+        # out of the next reassert snapshot (merged after reconnect).
+        if self.control.engine_boot() != self._boot:
+            self._live_new[key] = self._live_new.get(key, 0) + 1
+        else:
+            self._live[key] = self._live.get(key, 0) + 1
+
+    @staticmethod
+    def _dec(d: Dict[tuple, int], k: tuple) -> bool:
+        cur = d.get(k, 0)
+        if cur <= 0:
+            return False
+        if cur > 1:
+            d[k] = cur - 1
+        else:
+            d.pop(k, None)
+        return True
+
+    def _live_forget_locked(
+        self, res, ctx, org, et, spec, count
+    ) -> None:
+        """Pair one completion with its ledger line: exact key first,
+        flipped mirror flag next, then any line with the same identity
+        — the client-side twin of the plane's exit pairing (a raw
+        ``speculative=None`` exit reads spec 0 = unknown). The
+        new-world ledger is tried first (most recent admits complete
+        first under typical request lifetimes)."""
+        spec_opts = (
+            (True, False) if spec == 0
+            else ((spec == 1), not (spec == 1))
+        )
+        for d in (self._live_new, self._live):
+            for sb in spec_opts:
+                if self._dec(d, (res, ctx, org, et, sb, count)):
+                    return
+        for d in (self._live_new, self._live):
+            for k in list(d):
+                if k[0] == res and k[1] == ctx and k[2] == org and k[3] == et:
+                    self._dec(d, k)
+                    return
+
+    def _forget_exit_tuple_locked(self, t: tuple) -> None:
+        res, ctx, org, et, _ts, _rt, count, _err, spec = t
+        self._live_forget_locked(res, ctx, org, et, spec, count)
+
+    def _buffer_dead_exits_locked(self, items: List[tuple]) -> None:
+        """Completions that could not reach a DEAD engine buffer for
+        replay after a hot-restart (their ledger lines stay live so the
+        re-assertion still covers them and the replayed exits pair).
+        Bounded: overflow drops oldest, counted — the dead-worker reap
+        remains the gauge backstop for anything dropped."""
+        self._dead_exits.extend(items)
+        self.counters["exits_buffered"] += len(items)
+        over = len(self._dead_exits) - self.reconnect_exits_max
+        if over > 0:
+            dropped = self._dead_exits[:over]
+            del self._dead_exits[:over]
+            self.counters["exits_dropped"] += len(dropped)
+            for t in dropped:
+                self._forget_exit_tuple_locked(t)
+
+    def _maybe_reconnect(self) -> None:
+        """Beat-loop hook: an engine-boot epoch change with a live
+        engine means a NEW engine process attached to our rings —
+        re-intern happens organically (the new plane bumped the intern
+        generation), so the reconnect work is (1) re-assert the live
+        ledger, (2) replay the dead-window completion buffer. Chunks
+        that fail to push retry on the next beat tick; a SECOND restart
+        mid-reassert restarts the sequence from the current ledger."""
+        boot = self.control.engine_boot()
+        if boot == self._boot or not self.engine_alive():
+            return
+        if self._boot == 0:
+            # First-ever observation (attached before the plane's boot
+            # bump landed): nothing was admitted through an older world
+            # — but admits decided BETWEEN the bump and this tick were
+            # routed to _live_new (note-time boot mismatch); fold them
+            # into the main ledger or a LATER restart's reassert
+            # snapshot would miss them.
+            with self._lock:
+                self._boot = boot
+                for k, v in self._live_new.items():
+                    self._live[k] = self._live.get(k, 0) + v
+                self._live_new.clear()
+            return
+        with self._lock:
+            # Refresh the intern generation FIRST: a zero-row head
+            # frame (idle worker) never calls _intern_locked, and a
+            # frame carrying the dead world's generation would be
+            # gen-gated as stale backlog by the new plane — the
+            # reconnect would count client-side but never plane-side.
+            gen = self.control.intern_gen()
+            if gen != self._intern_gen:
+                self._intern.clear()
+                self._fresh = []
+                self._intern_gen = gen
+            if self._reassert_boot != boot:
+                self._reassert_boot = boot
+                self._reassert_rows = [
+                    key + (cnt,) for key, cnt in self._live.items()
+                ]
+                self._reassert_head = True
+            budget = self.channel.slot_bytes - fr.FRAME_RESERVE
+            cap = max(1, budget // fr.REASSERT_ROW_BYTES)
+            while True:
+                chunk = self._reassert_rows[:cap]
+                rows = [
+                    fr.ReassertRow(
+                        resource_id=self._intern_locked(res),
+                        context_id=self._intern_locked(ctx),
+                        origin_id=self._intern_locked(org),
+                        entry_type=et,
+                        spec=1 if spec_b else 0,
+                        acquire=acq,
+                        count=cnt,
+                    )
+                    for (res, ctx, org, et, spec_b, acq, cnt) in chunk
+                ]
+                try:
+                    ok = self._push_locked(
+                        lambda interns, rows=rows: fr.encode_reasserts(
+                            self.worker_id, rows, interns,
+                            self._intern_gen, self._shed_total,
+                            head=self._reassert_head,
+                        )
+                    )
+                except Exception:
+                    from sentinel_tpu.utils.record_log import record_log
+
+                    record_log.error(
+                        "[ipc] reassert encode failed — dropping chunk",
+                        exc_info=True,
+                    )
+                    del self._reassert_rows[: len(chunk)]
+                    continue
+                if not ok:
+                    return  # ring full / engine gone again: next beat
+                self._reassert_head = False
+                del self._reassert_rows[: len(chunk)]
+                if not self._reassert_rows:
+                    break
+            # Ledger re-asserted: adopt the new world, fold the admits
+            # the new engine decided mid-reconnect back into the main
+            # ledger (its plane already carries them), and queue the
+            # buffered completions for replay BEHIND the reassert
+            # (same MPSC ring = FIFO, so they pair at the plane).
+            self._boot = boot
+            self._reassert_boot = None
+            for k, v in self._live_new.items():
+                self._live[k] = self._live.get(k, 0) + v
+            self._live_new.clear()
+            self.counters["reconnects"] += 1
+            replay, self._dead_exits = self._dead_exits, []
+        if replay:
+            if self.window_armed:
+                with self._lock:
+                    self._win_join_locked(exits=replay)
+            else:
+                for t in replay:
+                    (res, ctx, org, et, ts, rt, count, err, spec) = t
+                    self.exit(
+                        res, ctx, org, et, rt=rt, count=count, err=err,
+                        ts=None if ts < 0 else ts,
+                        speculative=(
+                            None if spec == 0 else (spec == 1)
+                        ),
+                    )
+
+    # ------------------------------------------------------------------
     # engine liveness + policy fallback
     # ------------------------------------------------------------------
     def engine_alive(self) -> bool:
@@ -386,6 +586,9 @@ class IngestClient:
                             self.counters["exits_dropped"] += len(
                                 self._win_exits
                             )
+                            if self.reconnect_enabled:
+                                for t in self._win_exits:
+                                    self._forget_exit_tuple_locked(t)
                             self._win_exits = []
                     except BaseException:
                         pass
@@ -492,6 +695,19 @@ class IngestClient:
         the per-call exit() stance."""
         cap = max(1, (self.channel.slot_bytes - fr.FRAME_RESERVE)
                   // fr.EXIT_ROW_BYTES)
+        if (
+            self._win_exits
+            and self.reconnect_enabled
+            and not self._stop.is_set()
+            and not self.engine_alive()
+        ):
+            # Dead engine: frames pushed now would be dead-world backlog
+            # the next plane drops — buffer the window's completions for
+            # post-restart replay instead (see exit()).
+            moved, self._win_exits = self._win_exits, []
+            self._buffer_dead_exits_locked(moved)
+            self._win_exit_stall = None
+            return
         while self._win_exits:
             chunk = self._win_exits[: cap]
             # (Re)intern per attempt: a failed push rolled its fresh
@@ -527,10 +743,16 @@ class IngestClient:
                     "the chunk", exc_info=True,
                 )
                 self.counters["exits_dropped"] += len(chunk)
+                if self.reconnect_enabled:
+                    for t in chunk:
+                        self._forget_exit_tuple_locked(t)
                 del self._win_exits[: len(chunk)]
                 self._win_exit_stall = None
                 continue
             if ok:
+                if self.reconnect_enabled:
+                    for t in chunk:
+                        self._forget_exit_tuple_locked(t)
                 del self._win_exits[: len(chunk)]
                 self.counters["exits"] += len(chunk)
                 self._win_exit_stall = None
@@ -538,11 +760,22 @@ class IngestClient:
             now = time.monotonic()
             if self._win_exit_stall is None:
                 self._win_exit_stall = now
-            if (
-                not self.engine_alive()
+            dead = not self.engine_alive()
+            if dead and self.reconnect_enabled and not self._stop.is_set():
+                # Engine gone: buffer the window's completions for
+                # replay after a hot-restart instead of dropping them
+                # (their ledger lines stay live — see exit()).
+                moved, self._win_exits = self._win_exits, []
+                self._buffer_dead_exits_locked(moved)
+                self._win_exit_stall = None
+            elif (
+                dead
                 or (now - self._win_exit_stall) > self.timeout_ms / 1e3
                 or self._stop.is_set()
             ):
+                if self.reconnect_enabled:
+                    for t in self._win_exits:
+                        self._forget_exit_tuple_locked(t)
                 self.counters["exits_dropped"] += len(self._win_exits)
                 self._win_exits = []
                 self._win_exit_stall = None
@@ -629,7 +862,11 @@ class IngestClient:
             # frame actually pushes — a later window shed must not
             # have pre-counted the row.
             self.counters["entries"] += 1
-        return self._await_one(w, seq, resource, timeout_ms)
+        return self._await_one(
+            w, seq, resource, timeout_ms,
+            live_ident=(resource, context_name, origin, int(entry_type),
+                        int(acquire)),
+        )
 
     def bulk(
         self,
@@ -712,8 +949,12 @@ class IngestClient:
                 self._win_join_locked(rows=rows)
             # bulk_rows counts at flush time (see _win_flush_locked) —
             # per-call parity: a shed window never counts.
-            got = self._await_many(w, range(base, base + n), resource,
-                                   timeout_ms)
+            got = self._await_many(
+                w, range(base, base + n), resource, timeout_ms,
+                live_base=(resource, context_name, origin,
+                           int(entry_type)),
+                acq=acq_col,
+            )
             for j, (adm, rsn, wms, fl) in enumerate(got):
                 out_a[j] = adm
                 out_r[j] = rsn
@@ -761,8 +1002,12 @@ class IngestClient:
                 out_r[lo:hi] = sv.reason
                 continue
             self.counters["bulk_rows"] += m
-            got = self._await_many(w, range(base, base + m), resource,
-                                   timeout_ms)
+            got = self._await_many(
+                w, range(base, base + m), resource, timeout_ms,
+                live_base=(resource, context_name, origin,
+                           int(entry_type)),
+                acq=acq_col[lo:hi],
+            )
             for j, (adm, rsn, wms, fl) in enumerate(got):
                 out_a[lo + j] = adm
                 out_r[lo + j] = rsn
@@ -818,6 +1063,18 @@ class IngestClient:
             return True
         deadline = time.monotonic() + self.timeout_ms / 1e3
         delay = 0.0002
+        spec_wire = 0 if speculative is None else (1 if speculative else 2)
+        if self.reconnect_enabled and not self.engine_alive():
+            # A frame pushed into a DEAD engine's ring is dead-world
+            # backlog the next plane must (and does) drop — buffer the
+            # completion for replay after the hot-restart instead.
+            with self._lock:
+                self._buffer_dead_exits_locked([(
+                    resource, context_name, origin, int(entry_type),
+                    -1 if ts is None else int(ts),
+                    int(rt), int(count), int(err), spec_wire,
+                )])
+            return True
         while True:
             # (Re)build under the lock on EVERY attempt: a failed push
             # rolled its fresh interns back, so a retried payload must
@@ -834,10 +1091,7 @@ class IngestClient:
                     entry_type=int(entry_type),
                     ts=-1 if ts is None else int(ts),
                     rt=int(rt), count=int(count), err=int(err),
-                    spec=(
-                        0 if speculative is None
-                        else (1 if speculative else 2)
-                    ),
+                    spec=spec_wire,
                 )
                 ok = self._push_locked(
                     lambda interns: fr.encode_exits(
@@ -845,12 +1099,37 @@ class IngestClient:
                         self._shed_total,
                     )
                 )
+                if ok and self.reconnect_enabled:
+                    self._live_forget_locked(
+                        resource, context_name, origin, int(entry_type),
+                        spec_wire, int(count),
+                    )
             if ok:
                 self.counters["exits"] += 1
                 return True
-            if not self.engine_alive() or time.monotonic() > deadline:
+            if not self.engine_alive():
+                if self.reconnect_enabled:
+                    # Buffer for replay after a hot-restart — the
+                    # ledger line stays live so the re-assertion covers
+                    # the admission and the replayed exit pairs.
+                    with self._lock:
+                        self._buffer_dead_exits_locked([(
+                            resource, context_name, origin,
+                            int(entry_type), -1 if ts is None else int(ts),
+                            int(rt), int(count), int(err), spec_wire,
+                        )])
+                    return True
                 with self._lock:
                     self.counters["exits_dropped"] += 1
+                return False
+            if time.monotonic() > deadline:
+                with self._lock:
+                    self.counters["exits_dropped"] += 1
+                    if self.reconnect_enabled:
+                        self._live_forget_locked(
+                            resource, context_name, origin,
+                            int(entry_type), spec_wire, int(count),
+                        )
                 return False
             time.sleep(delay)
             delay = min(delay * 2, 0.005)
@@ -860,7 +1139,7 @@ class IngestClient:
     # ------------------------------------------------------------------
     def _await_one(
         self, w: _Waiter, seq: int, resource: str,
-        timeout_ms: Optional[int],
+        timeout_ms: Optional[int], live_ident: Optional[tuple] = None,
     ) -> fr.IpcVerdict:
         t = (timeout_ms or self.timeout_ms) / 1e3
         deadline = time.monotonic() + t
@@ -868,7 +1147,22 @@ class IngestClient:
             if w.event.wait(timeout=0.05):
                 v = w.verdicts.get(seq)
                 if v is not None:
-                    return _to_verdict(v)
+                    out = _to_verdict(v)
+                    if (
+                        self.reconnect_enabled
+                        and out.admitted
+                        and live_ident is not None
+                    ):
+                        # Engine-decided admit: one live ledger line
+                        # until its completion pairs (policy verdicts
+                        # below never reached the engine — no line).
+                        res_, ctx_, org_, et_, acq_ = live_ident
+                        with self._lock:
+                            self._live_note_locked(
+                                (res_, ctx_, org_, et_,
+                                 out.speculative or out.degraded, acq_)
+                            )
+                    return out
                 w.event.clear()
             if time.monotonic() > deadline or not self.engine_alive():
                 with self._lock:
@@ -876,7 +1170,8 @@ class IngestClient:
                 return self._policy_verdict(resource)
 
     def _await_many(
-        self, w: _Waiter, seqs, resource: str, timeout_ms: Optional[int]
+        self, w: _Waiter, seqs, resource: str, timeout_ms: Optional[int],
+        live_base: Optional[tuple] = None, acq=None,
     ) -> List[tuple]:
         t = (timeout_ms or self.timeout_ms) / 1e3
         deadline = time.monotonic() + t
@@ -891,8 +1186,9 @@ class IngestClient:
             for s in seqs:
                 self._waiters.pop(s, None)
         out = []
+        notes: List[tuple] = []
         pol = None
-        for s in seqs:
+        for i, s in enumerate(seqs):
             v = w.verdicts.get(s)
             if v is None:
                 if pol is None:
@@ -902,7 +1198,20 @@ class IngestClient:
                         fr.F_DEGRADED,
                     )
                 v = pol
+            elif (
+                self.reconnect_enabled and live_base is not None and v[0]
+            ):
+                res_, ctx_, org_, et_ = live_base
+                notes.append(
+                    (res_, ctx_, org_, et_,
+                     bool(v[3] & (fr.F_SPECULATIVE | fr.F_DEGRADED)),
+                     int(acq[i]) if acq is not None else 1)
+                )
             out.append(v)
+        if notes:
+            with self._lock:
+                for k in notes:
+                    self._live_note_locked(k)
         return out
 
     def _read_loop(self) -> None:
@@ -950,6 +1259,16 @@ class IngestClient:
                 self.control.beat_worker(self.worker_id, pid)
             except (ValueError, TypeError):
                 return
+            if self.reconnect_enabled:
+                try:
+                    self._maybe_reconnect()
+                except Exception:
+                    from sentinel_tpu.utils.record_log import record_log
+
+                    record_log.error(
+                        "[ipc] reconnect attempt failed — retrying on "
+                        "the next beat", exc_info=True,
+                    )
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -966,6 +1285,12 @@ class IngestClient:
         self._reader.join(timeout=2.0)
         if self._beat is not None:
             self._beat.join(timeout=2.0)
+        with self._lock:
+            if self._dead_exits:
+                # Undeliverable completions die with the client — the
+                # plane's dead-worker reap releases their admissions.
+                self.counters["exits_dropped"] += len(self._dead_exits)
+                self._dead_exits = []
         if clear_slot:
             try:
                 self.control.clear_worker(self.worker_id)
@@ -988,6 +1313,12 @@ class IngestClient:
                 "window_max": self.window_max,
                 "window_pending": len(self._win_rows) + len(self._win_exits),
                 "adaptive_wakeup": self.adaptive_wakeup,
+                "reconnect_enabled": self.reconnect_enabled,
+                "engine_boot": self._boot,
+                "live_admissions": (
+                    sum(self._live.values()) + sum(self._live_new.values())
+                ),
+                "buffered_exits": len(self._dead_exits),
             }
 
 

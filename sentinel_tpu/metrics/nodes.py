@@ -354,6 +354,40 @@ class NodeRegistry:
                 if k.startswith(prefix) and r != self.entry_node_row
             ]
 
+    def keys_snapshot(self) -> List[str]:
+        """Row-ordered key list (what a durable checkpoint carries so a
+        restarted process can rebuild the name→row mapping)."""
+        with self._lock:
+            return list(self._keys)
+
+    def adopt_keys(self, keys: List[str]) -> Dict[int, int]:
+        """Replay another registry's row-ordered key list through the
+        PUBLIC registration paths (caps + call-tree structure apply
+        exactly as live registration would) and return the old-row →
+        new-row mapping for every key that got a row — the durable
+        restore's stats remap (runtime/failover.restore_durable). On a
+        FRESH registry the mapping is the identity; on a registry that
+        already served traffic, rows land wherever the live order put
+        them. Keys refused by the caps are simply absent from the map
+        (their window rows cold-start, same as any over-cap node)."""
+        out: Dict[int, int] = {}
+        for old_row, key in enumerate(keys):
+            kind, _, rest = key.partition(":")
+            row: Optional[int] = None
+            if kind == NodeKind.CLUSTER:
+                row = self.cluster_row(rest)
+            elif kind == NodeKind.ENTRANCE:
+                row = self.entrance_row(rest)
+            elif kind == NodeKind.DEFAULT:
+                res, _, ctx = rest.partition("|")
+                row = self.default_row(res, ctx)
+            elif kind == NodeKind.ORIGIN:
+                res, _, org = rest.partition("|")
+                row = self.origin_row(res, org)
+            if row is not None:
+                out[old_row] = row
+        return out
+
     def entrance_children(self, context: str) -> List[int]:
         with self._lock:
             row = self._rows.get(NodeKind.ENTRANCE + ":" + context)

@@ -300,6 +300,10 @@ class TelemetryBus:
             "ipc_sheds": 0,
             "ipc_worker_deaths": 0,
             "ipc_auto_exits": 0,
+            # Engine hot-restart (PR 15): workers that detected the
+            # boot-epoch bump and re-asserted their live ledgers into
+            # this (new) engine world.
+            "ipc_worker_reconnects": 0,
         }
         # Bounded ring of health transitions (now_ms is engine-clock
         # relative ms): the flight-recorder view of the failover state
@@ -543,6 +547,10 @@ class TelemetryBus:
         with self._lock:
             self.counters["ipc_worker_deaths"] += 1
             self.counters["ipc_auto_exits"] += released
+
+    def note_ipc_reconnect(self) -> None:
+        with self._lock:
+            self.counters["ipc_worker_reconnects"] += 1
 
     def fold_blocked_topk(self, pairs: Sequence[Tuple[str, int]]) -> None:
         """Fold one flush's device top-K (already name-resolved) into

@@ -3572,8 +3572,13 @@ class Engine:
         # fetch runs (same hazard as breaker_snap above).
         ckpt_meta = None
         if fo.armed and fo.checkpoint_due(seq):
+            # The sketch tier joins the checkpoint (PR 15): its keys are
+            # stable CRC ids, so the table restores position-independent
+            # — an engine trip (or a hot-restarted process loading the
+            # durable spill) keeps heavy-hitter protection instead of
+            # silently resetting it.
             states = (self.stats, self.flow_dyn, self.degrade_dyn,
-                      self.param_dyn)
+                      self.param_dyn, new_skstate)
             if defer:
                 states = jax.tree_util.tree_map(jnp.copy, states)
             ckpt_meta = fo.begin_checkpoint(

@@ -116,6 +116,20 @@ def parse_policy(raw: str) -> Tuple[str, Dict[str, str]]:
     return default, by_res
 
 
+def _dead_ref():
+    """A weakref whose referent is already gone — marks a checkpoint
+    component as not-restorable (the durable loader's analog of an
+    index swap killing the live checkpoint's ref)."""
+
+    class _T:
+        pass
+
+    o = _T()
+    r = weakref.ref(o)
+    del o
+    return r
+
+
 class DeviceFetchTimeout(RuntimeError):
     """The flush watchdog's verdict: a dispatch or device→host fetch
     exceeded ``sentinel.tpu.failover.fetch.timeout.ms``."""
@@ -142,10 +156,12 @@ class Checkpoint:
     """One host-resident snapshot of the engine's device states.
 
     ``states`` is the fetched host pytree ``(stats, flow_dyn,
-    degrade_dyn, param_dyn)``; the index weakrefs gate which components
-    are still restorable — a rule reload swaps an index AND its dyn
-    state shape, so a stale component restores as a fresh dyn state
-    instead (the reference rebuilds fresh breakers per load anyway)."""
+    degrade_dyn, param_dyn, sketch)`` — ``sketch`` is the device
+    SketchState or None when the tier is disarmed; the index weakrefs
+    gate which components are still restorable — a rule reload swaps an
+    index AND its dyn state shape, so a stale component restores as a
+    fresh dyn state instead (the reference rebuilds fresh breakers per
+    load anyway)."""
 
     seq: int
     now_ms: int
@@ -422,6 +438,18 @@ class HostFallbackAdmitter:
         with self._lock:
             if self.persistent:
                 self._track_deltas = False
+
+    def assert_live(self, resource: str, n: int) -> None:
+        """Worker-reconnect re-assertion (ipc/plane.py): charge ``n``
+        live admissions to the mirror's THREAD counter. A restarted
+        engine's mirror starts empty, so the workers' re-asserted live
+        sets are what makes the fast tier's concurrency headroom exact
+        in the new world — their eventual exits release through the
+        normal on_exit path."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._threads[resource] = self._threads.get(resource, 0) + n
 
     def reset_world(self) -> None:
         """Fresh mirror world: buckets, counters, and delta ledgers all
@@ -1234,7 +1262,28 @@ class FailoverManager:
             "probe_flushes": 0,
             "fetch_timeouts": 0,
             "recoveries": 0,
+            "durable_writes": 0,
+            "durable_write_errors": 0,
+            "durable_loads": 0,
+            "durable_load_cold": 0,
         }
+        # Durable checkpoint spill (sentinel.tpu.failover.checkpoint.
+        # path): unset (the default) = no writer thread, no file IO,
+        # the in-memory-only PR-5 behavior exactly. Serialization and
+        # file IO happen on a dedicated writer thread — store_checkpoint
+        # runs on the drain path and must never pay the spill cost.
+        self.durable_path = (
+            config.get(config.FAILOVER_CKPT_PATH) or ""
+        ).strip()
+        self.durable_interval_ms = max(
+            0, config.get_int(config.FAILOVER_CKPT_INTERVAL_MS, 1000)
+        )
+        self._durable_pending: Optional[Checkpoint] = None
+        self._durable_event = threading.Event()
+        self._durable_stop = False
+        self._durable_thread: Optional[threading.Thread] = None
+        # (wall_ms, seq, write_ms, bytes) of the last successful spill.
+        self.last_durable: Optional[Tuple[int, int, float, int]] = None
         self.events: "deque[HealthEvent]" = deque(maxlen=64)
         self.last_fault = ""
         # Pool of idle watchdog waiters (see _Waiter): each watched
@@ -1375,9 +1424,11 @@ class FailoverManager:
             eng.flush_seq, eng.clock.now_ms(),
             eng.flow_index, eng.degrade_index, eng.param_index,
         )
+        sk = eng.sketch.dev_state if eng.sketch.armed else None
         states = self.watched(
             lambda: jax.device_get(
-                (eng.stats, eng.flow_dyn, eng.degrade_dyn, eng.param_dyn)
+                (eng.stats, eng.flow_dyn, eng.degrade_dyn, eng.param_dyn,
+                 sk)
             ),
             "checkpoint re-anchor fetch", (),
         )
@@ -1656,6 +1707,10 @@ class FailoverManager:
         )
 
     def store_checkpoint(self, meta: Checkpoint, host_states: tuple) -> None:
+        if len(host_states) == 4:
+            # Callers that predate the sketch component (probe paths,
+            # tests): the sketch slot is simply absent.
+            host_states = host_states + (None,)
         meta.states = host_states
         with self._lock:
             # Out-of-order materialization of two in-flight checkpointed
@@ -1664,6 +1719,312 @@ class FailoverManager:
             if self._ckpt is None or self._ckpt.seq <= meta.seq:
                 self._ckpt = meta
             self.counters["checkpoints"] += 1
+        if self.durable_path:
+            self._durable_schedule(meta)
+
+    # ------------------------------------------------------------------
+    # durable spill (sentinel.tpu.failover.checkpoint.path)
+    # ------------------------------------------------------------------
+    def _durable_schedule(self, meta: Checkpoint) -> None:
+        with self._lock:
+            if (
+                self._durable_pending is None
+                or self._durable_pending.seq <= meta.seq
+            ):
+                self._durable_pending = meta
+            if self._durable_thread is None and not self._durable_stop:
+                self._durable_thread = threading.Thread(
+                    target=self._durable_loop,
+                    name="sentinel-ckpt-writer", daemon=True,
+                )
+                self._durable_thread.start()
+        self._durable_event.set()
+
+    def _durable_loop(self) -> None:
+        while True:
+            self._durable_event.wait()
+            if self._durable_stop:
+                return
+            # Rate limit by wall time: high flush rates keep the
+            # in-memory cadence, the file sees at most one write per
+            # interval (the NEWEST pending checkpoint wins).
+            if self.durable_interval_ms > 0 and self.last_durable:
+                gap = time.time() * 1000 - self.last_durable[0]
+                wait = (self.durable_interval_ms - gap) / 1e3
+                if wait > 0:
+                    time.sleep(wait)
+                    if self._durable_stop:
+                        return
+            self._durable_event.clear()
+            with self._lock:
+                meta, self._durable_pending = self._durable_pending, None
+            if meta is None or meta.states is None:
+                continue
+            try:
+                t0 = time.perf_counter()
+                nbytes = self._durable_spill(meta)
+                with self._lock:
+                    self.counters["durable_writes"] += 1
+                    self.last_durable = (
+                        int(time.time() * 1000), meta.seq,
+                        (time.perf_counter() - t0) * 1e3, nbytes,
+                    )
+            except Exception:
+                with self._lock:
+                    self.counters["durable_write_errors"] += 1
+                record_log.error(
+                    "[Failover] durable checkpoint spill failed",
+                    exc_info=True,
+                )
+
+    def _durable_spill(self, meta: Checkpoint) -> int:
+        """Serialize one checkpoint to the durable file (writer thread).
+        Components whose index weakref died (a reload swapped the
+        index) are omitted — they would restore as fresh states anyway.
+        """
+        from sentinel_tpu.runtime import durable
+
+        eng = self._engine
+        states = meta.states
+        comp_leaves: List = []
+        comps: Dict[str, int] = {}
+        fps: Dict[str, int] = {}
+
+        def put(name: str, tree, ok: bool) -> None:
+            if not ok or tree is None:
+                comps[name] = 0
+                return
+            leaves = jax.tree_util.tree_leaves(tree)
+            comps[name] = len(leaves)
+            comp_leaves.extend(np.asarray(a) for a in leaves)
+
+        findex = meta.findex_ref()
+        dindex = meta.dindex_ref()
+        put("stats", states[0], True)
+        put("flow", states[1], findex is not None)
+        put("degrade", states[2], dindex is not None)
+        # param_dyn rows name dynamically-interned (rule, value) pairs
+        # whose assignment order cannot be reproduced in a fresh
+        # process — per-value buckets restart cold (their windows are
+        # second-scale; documented in ARCHITECTURE.md).
+        put("param", None, False)
+        put("sketch", states[4], states[4] is not None)
+        if findex is not None:
+            fps["flow"] = durable.rules_fingerprint(findex.rules)
+        if dindex is not None:
+            fps["degrade"] = durable.rules_fingerprint(dindex.rules)
+        cur = _ncfg.SECOND_CFG
+        header = {
+            "seq": meta.seq,
+            "now_ms": meta.now_ms,
+            # Stats arrays are padded past the registry (capacity
+            # doubling): the loader needs the captured row count to
+            # rebuild the reference tree for shape validation.
+            "stats_rows": int(np.shape(states[0].threads)[0]),
+            "epoch_wall_ms": meta.epoch_wall_ms,
+            "wall_ms": int(time.time() * 1000),
+            "win": [cur.sample_count, cur.interval_ms, cur.max_rt],
+            "components": comps,
+            "fingerprints": fps,
+            # Row-ordered registry keys AT SPILL TIME: rows are never
+            # reassigned, so a key list captured slightly after the
+            # states still maps every row the states contain.
+            "node_keys": eng.nodes.keys_snapshot(),
+        }
+        return durable.write_checkpoint(
+            self.durable_path, header, comp_leaves
+        )
+
+    def restore_durable(self, path: Optional[str] = None) -> bool:
+        """Warm-start a FRESH engine process from the durable
+        checkpoint file: load + validate, remap the stats rows through
+        the node-registry key list, install via the standard
+        DEGRADED → RECOVERING machinery (restore + probe flushes), and
+        return True when the engine came back HEALTHY. Every validation
+        failure — missing/corrupt/stale file, window-geometry change,
+        rule-fingerprint mismatch — degrades to a cold start with a
+        counted event (``durable_load_cold``), NEVER an exception:
+        a bad optimization file must not take the engine down.
+
+        THREAD gauges restore as ZERO: live concurrency is not a decayed
+        statistic but a set of currently-running callers, and in the
+        new world that set is rebuilt exactly from the workers'
+        ledger re-assertions (ipc/plane.py) — restoring the captured
+        gauges would double-charge every re-asserted admission."""
+        from sentinel_tpu.metrics.nodes import make_stats
+        from sentinel_tpu.runtime import durable
+
+        eng = self._engine
+        p = (path or self.durable_path).strip()
+        if not p or eng.mesh is not None:
+            return False
+        import os as _os
+
+        if not _os.path.exists(p):
+            return False
+        try:
+            header, leaves = durable.read_checkpoint(p)
+        except (durable.DurableCheckpointError, OSError) as e:
+            with self._lock:
+                self.counters["durable_load_cold"] += 1
+            record_log.warn(
+                "[Failover] durable checkpoint unusable (%s) — cold start",
+                e,
+            )
+            return False
+        stale_ms = config.get_int(config.FAILOVER_CKPT_STALE_MS, 0)
+        age = int(time.time() * 1000) - int(header.get("wall_ms", 0))
+        if stale_ms > 0 and age > stale_ms:
+            with self._lock:
+                self.counters["durable_load_cold"] += 1
+            record_log.warn(
+                "[Failover] durable checkpoint stale (%d ms > %d) — cold "
+                "start", age, stale_ms,
+            )
+            return False
+        try:
+            ck = self._build_durable_checkpoint(header, leaves, make_stats)
+        except Exception:
+            with self._lock:
+                self.counters["durable_load_cold"] += 1
+            record_log.error(
+                "[Failover] durable checkpoint rejected — cold start",
+                exc_info=True,
+            )
+            return False
+        with self._lock:
+            self._ckpt = ck
+            self.counters["durable_loads"] += 1
+            if self.state == HEALTHY:
+                self._set_state_locked(DEGRADED, "durable restore")
+                self.fallback.begin(eng.clock.now_ms())
+            self._last_attempt_ms = eng.clock.now_ms()
+        return self.try_recover()
+
+    def _build_durable_checkpoint(self, header, leaves, make_stats) -> Checkpoint:
+        """Validate per component and assemble an installable
+        :class:`Checkpoint` aligned with THIS process's world. Raises on
+        structural surprises (the caller converts to a counted cold
+        start)."""
+        from sentinel_tpu.runtime import durable
+        from sentinel_tpu.runtime.sketch import make_sketch_state
+
+        eng = self._engine
+        comps = header.get("components") or {}
+        fps = header.get("fingerprints") or {}
+        split: Dict[str, List[np.ndarray]] = {}
+        off = 0
+        for name in ("stats", "flow", "degrade", "param", "sketch"):
+            n = int(comps.get(name, 0))
+            split[name] = leaves[off : off + n]
+            off += n
+
+        def rebuild(name: str, ref_tree) -> Optional[object]:
+            """Leaves → the reference tree's structure, gated on exact
+            shape+dtype agreement (a changed rule set changes shapes)."""
+            got = split[name]
+            ref_leaves, treedef = jax.tree_util.tree_flatten(ref_tree)
+            if len(got) != len(ref_leaves):
+                return None
+            for a, r in zip(got, ref_leaves):
+                if tuple(a.shape) != tuple(np.shape(r)) or a.dtype != np.asarray(r).dtype:
+                    return None
+            return jax.tree_util.tree_unflatten(treedef, list(got))
+
+        win = list(header.get("win") or [])
+        cur = _ncfg.SECOND_CFG
+        win_ok = win == [cur.sample_count, cur.interval_ms, cur.max_rt]
+
+        # Stats: remap rows by NAME through the registry key replay —
+        # a fresh process's registration order need not match the dead
+        # one's. THREAD gauges zero (see restore_durable docstring).
+        stats_tree = None
+        node_keys = header.get("node_keys") or []
+        stats_rows = int(header.get("stats_rows", 0))
+        if win_ok and split["stats"] and node_keys and stats_rows >= len(
+            node_keys
+        ):
+            mapping = eng.nodes.adopt_keys(list(node_keys))
+            n_new = max(len(eng.nodes), eng.stats.n_rows)
+            fresh = jax.tree_util.tree_map(
+                lambda a: np.array(a), jax.device_get(make_stats(n_new))
+            )
+            old_tree = rebuild("stats", jax.device_get(
+                make_stats(stats_rows)
+            ))
+            if old_tree is not None and mapping:
+                old_rows = np.fromiter(mapping.keys(), np.int64, len(mapping))
+                new_rows = np.fromiter(
+                    mapping.values(), np.int64, len(mapping)
+                )
+
+                def scatter(fresh_leaf, old_leaf):
+                    out = np.array(fresh_leaf)
+                    out[new_rows] = np.asarray(old_leaf)[old_rows]
+                    return out
+
+                stats_tree = jax.tree_util.tree_map(
+                    scatter, fresh, old_tree
+                )
+                stats_tree = stats_tree._replace(
+                    threads=np.zeros_like(np.asarray(stats_tree.threads))
+                )
+
+        findex = eng.flow_index
+        flow_tree = None
+        if split["flow"] and fps.get("flow") == durable.rules_fingerprint(
+            findex.rules
+        ):
+            flow_tree = rebuild(
+                "flow", jax.device_get(findex.make_dyn_state())
+            )
+        dindex = eng.degrade_index
+        degrade_tree = None
+        if split["degrade"] and fps.get("degrade") == durable.rules_fingerprint(
+            dindex.rules
+        ):
+            degrade_tree = rebuild(
+                "degrade", jax.device_get(dindex.make_dyn_state())
+            )
+        sketch_tree = None
+        tier = eng.sketch
+        if split["sketch"] and tier.armed:
+            sketch_tree = rebuild(
+                "sketch",
+                jax.device_get(make_sketch_state(
+                    tier.depth, tier.width, tier.candidates
+                )),
+            )
+
+        def ref_or_dead(obj, ok: bool):
+            if ok:
+                return weakref.ref(obj)
+            return _dead_ref()
+
+        ck = Checkpoint(
+            seq=int(header.get("seq", 0)),
+            now_ms=int(header.get("now_ms", 0)),
+            epoch_wall_ms=int(header.get("epoch_wall_ms", 0)),
+            win_key=(cur if (win_ok and stats_tree is not None)
+                     else ("durable-win-mismatch",)),
+            findex_ref=ref_or_dead(findex, flow_tree is not None),
+            dindex_ref=ref_or_dead(dindex, degrade_tree is not None),
+            pindex_ref=_dead_ref(),  # per-value rows never survive
+            states=(
+                stats_tree
+                if stats_tree is not None
+                else jax.device_get(make_stats(eng.stats.n_rows)),
+                flow_tree
+                if flow_tree is not None
+                else jax.device_get(findex.make_dyn_state()),
+                degrade_tree
+                if degrade_tree is not None
+                else jax.device_get(dindex.make_dyn_state()),
+                None,
+                sketch_tree,
+            ),
+        )
+        return ck
 
     def _restore_locked(self) -> None:
         """Re-seed the engine's device states from the last good
@@ -1811,12 +2172,28 @@ class FailoverManager:
             eng.flow_dyn = flow_dyn
             eng.degrade_dyn = degrade_dyn
             eng.param_dyn = param_dyn
-            # The sketch tier's donated chain may have died with the
-            # faulted dispatch (checkpoints don't carry it — it is
-            # approximate by contract): restore starts it fresh and
-            # counts re-accumulate within a decay window. Promotion
-            # state is host-side and survives untouched.
-            eng.sketch.reset_device_state()
+            # Sketch tier: the checkpoint CARRIES the device SketchState
+            # (PR 15 — an engine trip used to silently reset it, which
+            # dropped heavy-hitter protection until counts re-accumulated
+            # and let the demotion clock tear down every promoted rule).
+            # Keys are stable CRC ids, so the table is position-
+            # independent: restore verbatim when shapes still match the
+            # live config; promotion state is host-side and survives
+            # untouched, and the restored candidate table keeps the
+            # promoted keys' estimates above the demotion threshold.
+            sk = ck.states[4] if ck is not None and len(ck.states) > 4 else None
+            if (
+                sk is not None
+                and eng.sketch.armed
+                and eng.sketch.dev_state is not None
+                and all(
+                    tuple(np.shape(a)) == tuple(np.shape(b))
+                    for a, b in zip(sk, eng.sketch.dev_state)
+                )
+            ):
+                eng.sketch.dev_state = to_dev(sk)
+            else:
+                eng.sketch.reset_device_state()
             # Resync the breaker host mirror to the restored world so
             # observers (and a later degraded window) never diff
             # against pre-fault state.
@@ -1891,6 +2268,18 @@ class FailoverManager:
             waiters, self._idle_waiters = self._idle_waiters, []
         for w in waiters:
             w.stop()
+        # Stop the durable-checkpoint writer (if one ever started) —
+        # non-destructive like the waiters: a later store_checkpoint
+        # would lazily start a fresh writer.
+        with self._lock:
+            self._durable_stop = True
+            t, self._durable_thread = self._durable_thread, None
+        self._durable_event.set()
+        if t is not None:
+            t.join(timeout=5.0)
+        with self._lock:
+            self._durable_stop = False
+            self._durable_event.clear()
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -1911,6 +2300,29 @@ class FailoverManager:
                     if ck is not None and ck.states is not None
                     else None
                 ),
+                "durable": {
+                    "path": self.durable_path,
+                    "interval_ms": self.durable_interval_ms,
+                    "writes": self.counters["durable_writes"],
+                    "write_errors": self.counters["durable_write_errors"],
+                    "loads": self.counters["durable_loads"],
+                    "load_cold": self.counters["durable_load_cold"],
+                    "last": (
+                        {
+                            "wall_ms": self.last_durable[0],
+                            "seq": self.last_durable[1],
+                            "write_ms": round(self.last_durable[2], 3),
+                            "bytes": self.last_durable[3],
+                            "age_ms": max(
+                                0,
+                                int(time.time() * 1000)
+                                - self.last_durable[0],
+                            ),
+                        }
+                        if self.last_durable
+                        else None
+                    ),
+                },
                 "events": [e.as_dict() for e in self.events],
                 "fallback": self.fallback.snapshot(),
             }

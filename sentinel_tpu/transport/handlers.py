@@ -440,6 +440,10 @@ def health_handler(req: CommandRequest) -> CommandResponse:
     engine = _engine()
     out = engine.failover.snapshot()
     out["flush_seq"] = engine.flush_seq
+    # Hot-restart provenance: which boot of the shared rings this
+    # engine is (1 = first boot; see ipc/supervise.py).
+    plane = getattr(engine, "ipc_plane", None)
+    out["engine_epoch"] = plane.engine_epoch if plane is not None else 1
     return CommandResponse.of_json(out)
 
 

@@ -17,6 +17,7 @@ bench.py), and the blocked-resource heavy-hitter sketch.
 
 from __future__ import annotations
 
+import time as _time
 from typing import Dict, List, Tuple
 
 _GAUGES: List[Tuple[str, str, str]] = [
@@ -477,6 +478,58 @@ def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
         f"{p}_ipc_auto_exits_total",
         "Live admissions auto-exited for dead workers (gauges returned to 0)",
         c.get("ipc_auto_exits", 0),
+    )
+    # Engine supervision & warm hot-restart (ipc/supervise.py): the
+    # boot-epoch word doubles as a restart count — epoch 1 is the first
+    # engine on these rings, every re-attach bumps it.
+    epoch = plane.engine_epoch if plane is not None else 1
+    out += _gauge(
+        f"{p}_epoch",
+        "Engine boot epoch on the current ingest-plane rings "
+        "(bumped once per plane attach; 1 = first boot)",
+        epoch,
+    )
+    out += ctr(
+        f"{p}_restarts_total",
+        "Engine hot-restarts observed on these rings (boot epoch - 1)",
+        max(0, epoch - 1),
+    )
+    out += ctr(
+        f"{p}_ipc_worker_reconnects_total",
+        "Workers that re-asserted their live-admission ledgers after an "
+        "engine hot-restart",
+        c.get("ipc_worker_reconnects", 0),
+    )
+    # Durable checkpoint spill (sentinel.tpu.failover.checkpoint.path):
+    # write flow + freshness of the warm-restart file.
+    fo = engine.failover
+    out += ctr(
+        f"{p}_checkpoint_durable_writes_total",
+        "Durable checkpoint files written (atomic replace)",
+        fo.counters.get("durable_writes", 0),
+    )
+    out += ctr(
+        f"{p}_checkpoint_durable_errors_total",
+        "Durable checkpoint spill failures (in-memory checkpoint unaffected)",
+        fo.counters.get("durable_write_errors", 0),
+    )
+    out += ctr(
+        f"{p}_checkpoint_durable_cold_loads_total",
+        "Durable checkpoint loads that degraded to a cold start "
+        "(missing components, corrupt, or stale file)",
+        fo.counters.get("durable_load_cold", 0),
+    )
+    last = fo.last_durable
+    out += _gauge(
+        f"{p}_checkpoint_durable_age_ms",
+        "Age of the last durable checkpoint write (-1 = never written)",
+        (max(0, int(_time.time() * 1000) - last[0]) if last else -1),
+    )
+    out += _gauge(
+        f"{p}_checkpoint_durable_write_ms",
+        "Serialization + write cost of the last durable spill "
+        "(-1 = never written)",
+        (round(last[2], 3) if last else -1),
     )
     # Param admission path selection (Engine._encode_param): batches
     # routed to the closed-form rank path vs the rounds/scan family —

@@ -174,6 +174,19 @@ class SentinelConfig:
     # Min gap (engine clock) between automatic recovery attempts from
     # the flush path; explicit try_recover() ignores it.
     FAILOVER_RETRY_MS = "sentinel.tpu.failover.retry.ms"
+    # Durable checkpoint spill (runtime/failover.py): when set, every
+    # stored in-memory checkpoint also spills to this file (atomic
+    # rename, versioned header, crc) so a RESTARTED engine process can
+    # warm-start via restore_durable(). "" (the default) = off, the
+    # pre-PR-15 in-memory-only behavior bit for bit.
+    FAILOVER_CKPT_PATH = "sentinel.tpu.failover.checkpoint.path"
+    # Min gap between durable spills (wall ms) — bounds the write cost
+    # at high flush rates without touching the in-memory cadence.
+    FAILOVER_CKPT_INTERVAL_MS = "sentinel.tpu.failover.checkpoint.interval.ms"
+    # Max age (wall ms) a durable checkpoint may have at load; older
+    # files degrade to a cold start (counted, never an exception).
+    # 0 = no age limit (shape/window-geometry validation still applies).
+    FAILOVER_CKPT_STALE_MS = "sentinel.tpu.failover.checkpoint.stale.ms"
     # Speculative admission tier (runtime/speculative.py): host mirrors
     # serve the immediate verdict for single entries and bulk groups,
     # the device flush settles authoritatively, and reconciliation at
@@ -337,6 +350,29 @@ class SentinelConfig:
     # instead of a local engine, making a gunicorn-style N-process
     # deployment one line (api.run_workers / tools/ipc_launch.py).
     IPC_WORKER_MODE = "sentinel.tpu.ipc.worker.mode"
+    # Engine hot-restart (ipc/supervise.py, PR 15). shm.prefix names
+    # the plane's shared-memory segments deterministically
+    # ("<prefix>-ctl" / "-req" / "-resp<N>") so a RESTARTED engine
+    # process re-attaches to the EXISTING rings instead of creating
+    # fresh anonymous ones; "" (the default) keeps the anonymous
+    # PR-13/14 segments exactly.
+    IPC_SHM_PREFIX = "sentinel.tpu.ipc.shm.prefix"
+    # Worker reconnect: when the control header's engine-boot epoch
+    # bumps (a new engine attached to the rings), workers re-intern,
+    # re-assert their live-admission ledgers and replay completions
+    # buffered during the dead window (up to reconnect.exits.max;
+    # overflow drops oldest, counted in exits_dropped). Off restores
+    # the PR-14 stance: engine death permanently drops undeliverable
+    # completions and a returning engine starts with empty ledgers.
+    IPC_RECONNECT = "sentinel.tpu.ipc.reconnect.enabled"
+    IPC_RECONNECT_EXITS_MAX = "sentinel.tpu.ipc.reconnect.exits.max"
+    # Engine supervision (ipc/supervise.py run_engine_supervised /
+    # tools/ipc_launch.py --supervise): restart backoff (shared
+    # datasource Backoff shape: capped exponential) and a restart
+    # budget (0 = unlimited).
+    SUPERVISE_BACKOFF_MS = "sentinel.tpu.supervise.backoff.ms"
+    SUPERVISE_BACKOFF_MAX_MS = "sentinel.tpu.supervise.backoff.max.ms"
+    SUPERVISE_RESTARTS_MAX = "sentinel.tpu.supervise.restarts.max"
     # Per-resource provenance metric plane (metrics/provenance.py):
     # (second, resource) speculative/degraded/shed/drift ledger drained
     # into MetricNodeLine v2 columns and the bounded
@@ -397,6 +433,9 @@ class SentinelConfig:
         FAILOVER_CHECKPOINT_EVERY: "8",
         FAILOVER_PROBE_FLUSHES: "3",
         FAILOVER_RETRY_MS: "1000",
+        FAILOVER_CKPT_PATH: "",
+        FAILOVER_CKPT_INTERVAL_MS: "1000",
+        FAILOVER_CKPT_STALE_MS: "0",
         SPECULATIVE_ENABLED: "false",
         SPECULATIVE_FLUSH_BATCH: "64",
         SPECULATIVE_OVERADMIT_MAX: "64",
@@ -442,6 +481,12 @@ class SentinelConfig:
         IPC_WAKEUP_SPIN_US: "-1",
         IPC_WAKEUP_PARK_MS: "5",
         IPC_WORKER_MODE: "false",
+        IPC_SHM_PREFIX: "",
+        IPC_RECONNECT: "true",
+        IPC_RECONNECT_EXITS_MAX: "4096",
+        SUPERVISE_BACKOFF_MS: "500",
+        SUPERVISE_BACKOFF_MAX_MS: "10000",
+        SUPERVISE_RESTARTS_MAX: "0",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

@@ -204,6 +204,14 @@ def worker_mode_serve(channel, wid, cfg, paths, q):
         worker_mode.detach()
 
 
+def restart_setup(engine):
+    """Supervised-engine setup for the hot-restart chaos test
+    (top-level so multiprocessing spawn children import it by name)."""
+    from sentinel_tpu.models.rules import FlowRule
+
+    engine.set_flow_rules([FlowRule(resource="chaos-res", count=1e9)])
+
+
 def worker_mode_admit_and_hang(channel, wid, resource_path, n, q):
     """Worker-mode kill -9 target: hold ``n`` admitted WSGI requests
     open (the app never returns, so their entries never exit) — the

@@ -1,0 +1,669 @@
+"""Engine supervision & warm hot-restart (PR 15).
+
+The acceptance surface: durable checkpoints spill atomically and load
+back into a FRESH engine (corrupt/stale/mismatched files degrade to a
+COUNTED cold start, never an exception); the PR-5 checkpoint now
+carries the device SketchState so an engine trip no longer silently
+drops heavy-hitter protection; a new engine process re-attaches to the
+EXISTING named shared-memory rings (boot-epoch bump), workers
+re-intern, re-assert their live-admission ledgers and replay buffered
+dead-window completions — device AND mirror THREAD gauges exact in the
+new world and exactly 0 after quiesce, verdict parity vs a never-killed
+oracle (chaos-tested at depths {0, 2}); and the supervisor turns an
+engine ``kill -9`` under load into a bounded-outage blip
+(`mp`-marked, real processes).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.core import errors as E
+from sentinel_tpu.models.rules import FlowRule, ParamFlowRule
+from sentinel_tpu.runtime.engine import Engine
+from sentinel_tpu.utils.clock import ManualClock
+from sentinel_tpu.utils.config import config
+
+
+@pytest.fixture(autouse=True)
+def _config_sandbox():
+    with config._lock:
+        saved = dict(config._runtime)
+    yield
+    with config._lock:
+        config._runtime.clear()
+        config._runtime.update(saved)
+
+
+def _wait_for(pred, timeout_s=30.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# durable file format units (runtime/durable.py)
+# ---------------------------------------------------------------------------
+class TestDurableFile:
+    def test_roundtrip(self, tmp_path):
+        from sentinel_tpu.runtime import durable
+
+        path = str(tmp_path / "ck.bin")
+        leaves = [
+            np.arange(12, dtype=np.int32).reshape(3, 4),
+            np.ones(5, dtype=np.float32),
+        ]
+        n = durable.write_checkpoint(path, {"seq": 7, "wall_ms": 1}, leaves)
+        assert n == os.path.getsize(path)
+        header, got = durable.read_checkpoint(path)
+        assert header["seq"] == 7 and header["version"] == durable.VERSION
+        assert len(got) == 2
+        np.testing.assert_array_equal(got[0], leaves[0])
+        np.testing.assert_array_equal(got[1], leaves[1])
+
+    def test_atomic_replace_keeps_previous_on_overwrite(self, tmp_path):
+        from sentinel_tpu.runtime import durable
+
+        path = str(tmp_path / "ck.bin")
+        durable.write_checkpoint(path, {"seq": 1}, [np.zeros(2)])
+        durable.write_checkpoint(path, {"seq": 2}, [np.ones(2)])
+        header, _ = durable.read_checkpoint(path)
+        assert header["seq"] == 2
+        assert not [
+            f for f in os.listdir(tmp_path) if f.startswith(".ck.bin.tmp")
+        ]
+
+    @pytest.mark.parametrize(
+        "corrupt",
+        ["magic", "truncate", "crc", "header"],
+    )
+    def test_corruption_raises_checkpoint_error(self, tmp_path, corrupt):
+        from sentinel_tpu.runtime import durable
+
+        path = str(tmp_path / "ck.bin")
+        durable.write_checkpoint(path, {"seq": 3}, [np.arange(8)])
+        blob = bytearray(open(path, "rb").read())
+        if corrupt == "magic":
+            blob[0] ^= 0xFF
+        elif corrupt == "truncate":
+            blob = blob[: len(blob) // 2]
+        elif corrupt == "crc":
+            blob[-1] ^= 0xFF
+        elif corrupt == "header":
+            blob[12] ^= 0xFF  # inside the header JSON
+        open(path, "wb").write(bytes(blob))
+        with pytest.raises(durable.DurableCheckpointError):
+            durable.read_checkpoint(path)
+
+
+# ---------------------------------------------------------------------------
+# engine-level durable spill + warm load
+# ---------------------------------------------------------------------------
+def _mk_engine(clock, path="", every=1, stale_ms=0, rules=None, depth=0):
+    config.set(config.FAILOVER_ENABLED, "true")
+    config.set(config.FAILOVER_CHECKPOINT_EVERY, str(every))
+    config.set(config.FAILOVER_CKPT_PATH, path)
+    config.set(config.FAILOVER_CKPT_INTERVAL_MS, "0")
+    config.set(config.FAILOVER_CKPT_STALE_MS, str(stale_ms))
+    eng = Engine(clock=clock)
+    eng.pipeline_depth = depth
+    if rules is not None:
+        eng.set_flow_rules(rules)
+    return eng
+
+
+def _wait_durable_write(eng, min_writes=1):
+    _wait_for(
+        lambda: eng.failover.counters["durable_writes"] >= min_writes,
+        what="durable checkpoint write",
+    )
+
+
+class TestDurableCheckpoint:
+    def test_unset_path_writes_nothing(self, manual_clock):
+        config.set(config.FAILOVER_ENABLED, "true")
+        config.set(config.FAILOVER_CHECKPOINT_EVERY, "1")
+        eng = Engine(clock=manual_clock)
+        eng.set_flow_rules([FlowRule("r", count=5)])
+        manual_clock.set_ms(1000)
+        eng.submit_entry("r")
+        eng.flush()
+        eng.drain()
+        fo = eng.failover
+        assert fo.counters["checkpoints"] >= 1
+        assert fo.counters["durable_writes"] == 0
+        assert fo._durable_thread is None  # no writer thread at all
+        assert fo.snapshot()["durable"]["path"] == ""
+        eng.close()
+
+    def test_warm_restore_qps_window_and_thread_zero(
+        self, manual_clock, tmp_path
+    ):
+        """The warm-start differential: engine A consumes a QPS rule's
+        window and holds live THREAD gauges, spills, dies; engine B
+        restores — the SAME second's window is still consumed (blocked,
+        where a cold engine admits), but the THREAD gauges are ZERO
+        (live concurrency is rebuilt from worker re-assertions, not
+        the checkpoint)."""
+        path = str(tmp_path / "ck.bin")
+        manual_clock.set_ms(5000)
+        a = _mk_engine(manual_clock, path, rules=[FlowRule("r", count=5)])
+        ops = [a.submit_entry("r", ts=5000) for _ in range(8)]
+        a.flush()
+        a.drain()
+        assert sum(1 for op in ops if op.verdict.admitted) == 5
+        # Live THREAD gauge at capture time: 5 admitted, none exited.
+        assert a.cluster_node_stats("r")["cur_thread_num"] == 5
+        _wait_durable_write(a)
+        a.close()
+
+        b = _mk_engine(manual_clock, path, rules=[FlowRule("r", count=5)])
+        assert b.failover.restore_durable() is True
+        assert b.failover.state == "HEALTHY"
+        assert b.failover.counters["durable_loads"] == 1
+        assert b.failover.counters["durable_load_cold"] == 0
+        # THREAD gauges restore as zero by contract.
+        assert b.cluster_node_stats("r")["cur_thread_num"] == 0
+        # The same second's QPS window is already consumed: a cold
+        # engine would admit 5 more here; the warm one blocks them all.
+        ops_b = [b.submit_entry("r", ts=5000) for _ in range(5)]
+        b.flush()
+        b.drain()
+        assert all(not op.verdict.admitted for op in ops_b), [
+            op.verdict for op in ops_b
+        ]
+        b.close()
+
+        cold = _mk_engine(manual_clock, "", rules=[FlowRule("r", count=5)])
+        ops_c = [cold.submit_entry("r", ts=5000) for _ in range(5)]
+        cold.flush()
+        cold.drain()
+        assert all(op.verdict.admitted for op in ops_c)
+        cold.close()
+
+    def test_corrupt_file_cold_start_counted(self, manual_clock, tmp_path):
+        path = str(tmp_path / "ck.bin")
+        with open(path, "wb") as f:
+            f.write(b"this is not a checkpoint")
+        b = _mk_engine(manual_clock, path, rules=[FlowRule("r", count=5)])
+        assert b.failover.restore_durable() is False  # never an exception
+        assert b.failover.counters["durable_load_cold"] == 1
+        assert b.failover.state == "HEALTHY"  # untouched — serving
+        op = b.submit_entry("r", ts=1000)
+        b.flush()
+        b.drain()
+        assert op.verdict.admitted
+        b.close()
+
+    def test_missing_file_is_a_silent_cold_start(self, manual_clock, tmp_path):
+        b = _mk_engine(
+            manual_clock, str(tmp_path / "nope.bin"),
+            rules=[FlowRule("r", count=5)],
+        )
+        assert b.failover.restore_durable() is False
+        assert b.failover.counters["durable_load_cold"] == 0  # not an event
+        b.close()
+
+    def test_stale_file_cold_start_counted(self, manual_clock, tmp_path):
+        path = str(tmp_path / "ck.bin")
+        manual_clock.set_ms(1000)
+        a = _mk_engine(manual_clock, path, rules=[FlowRule("r", count=5)])
+        a.submit_entry("r", ts=1000)
+        a.flush()
+        a.drain()
+        _wait_durable_write(a)
+        a.close()
+        time.sleep(0.05)  # age the file past the 1 ms staleness bound
+        b = _mk_engine(
+            manual_clock, path, stale_ms=1, rules=[FlowRule("r", count=5)]
+        )
+        assert b.failover.restore_durable() is False
+        assert b.failover.counters["durable_load_cold"] == 1
+        b.close()
+
+    def test_window_geometry_mismatch_restores_stats_fresh(
+        self, manual_clock, tmp_path
+    ):
+        """A tampered window-geometry header must NOT install the stats
+        — the same second's window reads fresh (admits) instead of
+        consumed."""
+        from sentinel_tpu.runtime import durable
+
+        path = str(tmp_path / "ck.bin")
+        manual_clock.set_ms(5000)
+        a = _mk_engine(manual_clock, path, rules=[FlowRule("r", count=5)])
+        for _ in range(8):
+            a.submit_entry("r", ts=5000)
+        a.flush()
+        a.drain()
+        _wait_durable_write(a)
+        a.close()
+        header, leaves = durable.read_checkpoint(path)
+        header["win"] = [4, 2000, 4900]  # not the live SECOND_CFG
+        header.pop("version")
+        header.pop("n_leaves")
+        durable.write_checkpoint(path, header, leaves)
+
+        b = _mk_engine(manual_clock, path, rules=[FlowRule("r", count=5)])
+        assert b.failover.restore_durable() is True  # other components fine
+        ops = [b.submit_entry("r", ts=5000) for _ in range(5)]
+        b.flush()
+        b.drain()
+        assert all(op.verdict.admitted for op in ops)  # stats were fresh
+        b.close()
+
+    def test_snapshot_and_health_report_durable(self, manual_clock, tmp_path):
+        path = str(tmp_path / "ck.bin")
+        a = _mk_engine(manual_clock, path, rules=[FlowRule("r", count=5)])
+        a.submit_entry("r", ts=1000)
+        a.flush()
+        a.drain()
+        _wait_durable_write(a)
+        snap = a.failover.snapshot()["durable"]
+        assert snap["writes"] >= 1 and snap["path"] == path
+        assert snap["last"] is not None and snap["last"]["bytes"] > 0
+        assert snap["last"]["age_ms"] >= 0
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# SketchState in the checkpoint (satellite regression)
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def sketch_failover_config():
+    config.set(config.SKETCH_ENABLED, "true")
+    config.set(config.SKETCH_PROMOTE_QPS, "5")
+    config.set(config.SKETCH_WINDOW_MS, "1000")
+    config.set(config.SKETCH_DEMOTE_WINDOWS, "2")
+    config.set(config.FAILOVER_ENABLED, "true")
+    config.set(config.FAILOVER_CHECKPOINT_EVERY, "1")
+    config.set(config.FAILOVER_PROBE_FLUSHES, "2")
+    yield
+
+
+def _drive_until_promoted(eng, clk, hot="HOT", max_windows=6):
+    for step in range(max_windows * 4):
+        col = [(f"cold{step}_{j}",) for j in range(32)] + [(hot,)] * 32
+        eng.submit_bulk("api", n=64, args_column=col)
+        eng.flush()
+        eng.drain()
+        if hot in eng.sketch.promoted_values.get("api", ()):
+            return
+        clk.advance(250)
+    raise AssertionError("HOT never promoted")
+
+
+class TestSketchCheckpointRestore:
+    def test_promoted_key_survives_in_process_restore(
+        self, sketch_failover_config
+    ):
+        """Regression (PR 15): an engine trip used to reset the device
+        sketch — the candidate table lost every count, so the demotion
+        clock tore promoted rules down within demote.windows. The
+        checkpoint now CARRIES SketchState: post-restore the promoted
+        key's rule is intact AND its candidate-table estimate is still
+        there (no re-accumulation window)."""
+        from sentinel_tpu.testing.faults import FaultInjector
+
+        clk = ManualClock()
+        clk.set_ms(1000)
+        eng = Engine(clock=clk)
+        eng.set_param_rules(
+            {"api": [ParamFlowRule(resource="api", param_idx=0, count=3.0,
+                                   sketch_mode=True)]}
+        )
+        inj = FaultInjector().install(eng)
+        _drive_until_promoted(eng, clk)
+        eng.drain()
+        ck = eng.failover._ckpt
+        assert ck is not None and len(ck.states) == 5
+        assert ck.states[4] is not None, "checkpoint must carry the sketch"
+        pre_cand = int(np.asarray(eng.sketch.dev_state.cand_cnt).max())
+        assert pre_cand > 0
+
+        inj.fail_fetch(eng.flush_seq + 1)
+        eng.submit_bulk("api", n=4, args_column=[("HOT",)] * 4)
+        eng.flush()
+        assert eng.failover.state == "DEGRADED"
+        assert eng.failover.try_recover()
+        assert eng.failover.state == "HEALTHY"
+
+        # The rule survives AND the candidate table was restored, not
+        # reset (pre-PR behavior: cand_cnt all zeros here).
+        assert "HOT" in eng.sketch.promoted_values.get("api", ())
+        post_cand = int(np.asarray(eng.sketch.dev_state.cand_cnt).max())
+        assert post_cand > 0, "candidate table must survive the restore"
+        eng.close()
+
+    def test_durable_checkpoint_carries_sketch(
+        self, sketch_failover_config, tmp_path
+    ):
+        """Cross-process: the durable file carries the sketch leaves
+        and a fresh engine restores them (same config shapes)."""
+        path = str(tmp_path / "ck.bin")
+        config.set(config.FAILOVER_CKPT_PATH, path)
+        config.set(config.FAILOVER_CKPT_INTERVAL_MS, "0")
+        clk = ManualClock()
+        clk.set_ms(1000)
+        a = Engine(clock=clk)
+        a.set_param_rules(
+            {"api": [ParamFlowRule(resource="api", param_idx=0, count=3.0,
+                                   sketch_mode=True)]}
+        )
+        _drive_until_promoted(a, clk)
+        a.drain()
+        _wait_durable_write(a)
+        a.close()
+        from sentinel_tpu.runtime import durable
+
+        header, _ = durable.read_checkpoint(path)
+        assert header["components"]["sketch"] > 0
+
+        b = Engine(clock=clk)
+        b.set_param_rules(
+            {"api": [ParamFlowRule(resource="api", param_idx=0, count=3.0,
+                                   sketch_mode=True)]}
+        )
+        assert b.failover.restore_durable() is True
+        assert int(np.asarray(b.sketch.dev_state.cand_cnt).max()) > 0
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# in-process ring re-attach + worker reconnect (the chaos core)
+# ---------------------------------------------------------------------------
+def _reattach_config(depth: int) -> str:
+    prefix = f"stpu-t-{uuid.uuid4().hex[:8]}"
+    config.set(config.IPC_SHM_PREFIX, prefix)
+    config.set(config.IPC_HEARTBEAT_MS, "50")
+    config.set(config.IPC_ENGINE_DEAD_MS, "300")
+    config.set(config.SPECULATIVE_ENABLED, "true")
+    config.set(config.PIPELINE_DEPTH, str(depth))
+    return prefix
+
+
+class TestReattachReassert:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_kill_reattach_reassert_parity_and_gauges(self, depth):
+        """The acceptance chaos core, in-process (real processes are
+        the mp test below): engine A dies holding the client's live
+        THREAD admissions; engine B re-attaches to the SAME rings,
+        the client re-asserts its ledger and replays the buffered
+        dead-window completion; post-restart verdicts on a THREAD rule
+        match a never-killed oracle holding the same live set, and
+        device AND mirror THREAD gauges drain to exactly 0."""
+        from sentinel_tpu.ipc.plane import IngestPlane
+        from sentinel_tpu.ipc.worker import IngestClient
+        from sentinel_tpu.models import constants as C
+
+        _reattach_config(depth)
+        rule = lambda: [  # noqa: E731
+            FlowRule("tr", count=3, grade=C.FLOW_GRADE_THREAD)
+        ]
+        a = Engine(initial_rows=256)
+        a.set_flow_rules(rule())
+        plane_a = IngestPlane(a)
+        cli = IngestClient(plane_a.channel(0), 0)
+        b = plane_b = None
+        try:
+            for _ in range(2):
+                v = cli.entry("tr", timeout_ms=60000)
+                assert v.admitted and not v.degraded
+            a.flush()
+            a.drain()
+            assert a.cluster_node_stats("tr")["cur_thread_num"] == 2
+            # kill -9 surrogate: threads stop, segments persist.
+            plane_a.abandon()
+            a.close()
+            _wait_for(lambda: not cli.engine_alive(), what="engine death")
+            # One completion in the dead window: buffered, not dropped.
+            assert cli.exit("tr")
+            assert cli.snapshot()["buffered_exits"] == 1
+            # And a policy-served verdict marks the outage window.
+            assert cli.entry("tr", timeout_ms=400).degraded
+
+            b = Engine(initial_rows=256)
+            b.set_flow_rules(rule())
+            plane_b = IngestPlane(b)
+            assert plane_b.attached and plane_b.engine_epoch == 2
+            _wait_for(
+                lambda: cli.counters["reconnects"] >= 1
+                and plane_b.snapshot()["counters"]["exits"] >= 1,
+                what="client reconnect + exit replay",
+            )
+            snap = plane_b.snapshot()
+            assert snap["counters"]["worker_reconnects"] == 1
+            assert snap["counters"]["reasserts"] == 2
+            b.flush()
+            b.drain()
+            # 2 re-asserted − 1 replayed completion = exactly 1 live.
+            assert b.cluster_node_stats("tr")["cur_thread_num"] == 1
+            assert (
+                b.speculative.mirror.snapshot()["live_threads"].get("tr", 0)
+                == 1
+            )
+
+            # Oracle differential: a never-killed engine holding the
+            # same ONE live admission sees the same verdict stream.
+            config.set(config.IPC_SHM_PREFIX, "")
+            oracle = Engine(initial_rows=256)
+            oracle.set_flow_rules(rule())
+            o_live = oracle.submit_entry("tr")
+            oracle.flush()
+            oracle.drain()
+            want = []
+            for _ in range(3):
+                op = oracle.submit_entry("tr")
+                oracle.flush()
+                oracle.drain()
+                want.append((op.verdict.admitted, op.verdict.reason))
+            got = []
+            for _ in range(3):
+                v = cli.entry("tr", timeout_ms=60000)
+                got.append((v.admitted, int(v.reason)))
+            assert got == want, (got, want)
+            # With THREAD count=3 and 1 live: admit, admit, block.
+            assert [g[0] for g in got] == [True, True, False]
+
+            # Quiesce: exit everything still live on both sides.
+            for _ in range(3):
+                cli.exit("tr")
+            _wait_for(
+                lambda: plane_b.snapshot()["counters"]["exits"] >= 4,
+                what="exits drained",
+            )
+            b.flush()
+            b.drain()
+            assert b.cluster_node_stats("tr")["cur_thread_num"] == 0
+            assert (
+                b.speculative.mirror.snapshot()["live_threads"].get("tr", 0)
+                == 0
+            )
+            assert cli.snapshot()["live_admissions"] == 0
+            oracle.close()
+        finally:
+            cli.close()
+            for o in (plane_b, b):
+                if o is not None:
+                    o.close()
+
+    def test_idle_client_reconnect_counts_plane_side(self):
+        """Regression (review): an idle client's zero-row head reassert
+        never interned anything, so it used to ship the DEAD world's
+        generation and get gen-gated as a stale frame — the plane's
+        worker_reconnects stayed 0 while the client counted 1."""
+        from sentinel_tpu.ipc.plane import IngestPlane
+        from sentinel_tpu.ipc.worker import IngestClient
+
+        _reattach_config(0)
+        a = Engine(initial_rows=256)
+        plane_a = IngestPlane(a)
+        cli = IngestClient(plane_a.channel(0), 0)
+        b = plane_b = None
+        try:
+            # ONE admission so the client's gen was ever the old one;
+            # exit it so the ledger is empty (zero-row head frame).
+            a.set_flow_rules([FlowRule("r", count=1e9)])
+            assert cli.entry("r", timeout_ms=60000).admitted
+            assert cli.exit("r")
+            _wait_for(
+                lambda: plane_a.snapshot()["counters"]["exits"] >= 1,
+                what="exit drained",
+            )
+            plane_a.abandon()
+            a.close()
+            _wait_for(lambda: not cli.engine_alive(), what="engine death")
+            b = Engine(initial_rows=256)
+            plane_b = IngestPlane(b)
+            _wait_for(
+                lambda: plane_b.snapshot()["counters"]["worker_reconnects"]
+                >= 1,
+                what="plane-side reconnect count",
+            )
+            assert cli.counters["reconnects"] == 1
+            assert plane_b.snapshot()["counters"]["stale_frames"] == 0
+        finally:
+            cli.close()
+            for o in (plane_b, b):
+                if o is not None:
+                    o.close()
+
+    def test_first_boot_observation_merges_new_world_ledger(self):
+        """Regression (review): admits decided between the plane's boot
+        bump and the client's first beat tick land in _live_new; the
+        boot==0 early return must fold them into the main ledger or a
+        LATER restart's reassert would miss them."""
+        from sentinel_tpu.ipc.plane import IngestPlane
+        from sentinel_tpu.ipc.worker import IngestClient
+
+        _reattach_config(0)
+        a = Engine(initial_rows=256)
+        a.set_flow_rules([FlowRule("r", count=1e9)])
+        plane_a = IngestPlane(a)
+        cli = IngestClient(plane_a.channel(0), 0, heartbeat=False)
+        try:
+            # Simulate attach-before-first-boot: force the pre-bump view.
+            with cli._lock:
+                cli._boot = 0
+            v = cli.entry("r", timeout_ms=60000)
+            assert v.admitted
+            with cli._lock:
+                assert sum(cli._live_new.values()) == 1  # routed new-world
+                assert sum(cli._live.values()) == 0
+            cli._maybe_reconnect()  # the beat-tick body
+            with cli._lock:
+                assert cli._boot == plane_a.control.engine_boot()
+                assert sum(cli._live.values()) == 1  # merged
+                assert not cli._live_new
+            assert cli.counters["reconnects"] == 0  # not a restart
+        finally:
+            cli.close()
+            plane_a.close()
+            a.close()
+
+    def test_reconnect_disabled_is_pr14_behavior(self):
+        """`sentinel.tpu.ipc.reconnect.enabled=false`: no ledger, no
+        buffering (dead-window exits drop, counted), no reassert on an
+        epoch bump — the PR-14 stance exactly."""
+        from sentinel_tpu.ipc.plane import IngestPlane
+        from sentinel_tpu.ipc.worker import IngestClient
+
+        _reattach_config(0)
+        config.set(config.IPC_RECONNECT, "false")
+        a = Engine(initial_rows=256)
+        a.set_flow_rules([FlowRule("r", count=1e9)])
+        plane_a = IngestPlane(a)
+        cli = IngestClient(plane_a.channel(0), 0)
+        b = plane_b = None
+        try:
+            assert cli.entry("r", timeout_ms=60000).admitted
+            assert cli.snapshot()["live_admissions"] == 0  # no ledger
+            plane_a.abandon()
+            a.close()
+            _wait_for(lambda: not cli.engine_alive(), what="engine death")
+            # PR-14 stance: the completion pushes into the (still
+            # mapped) ring as dead-world backlog — never buffered for
+            # replay; the NEW plane gen-gates it away.
+            assert cli.exit("r") is True
+            assert cli.snapshot()["buffered_exits"] == 0
+
+            b = Engine(initial_rows=256)
+            b.set_flow_rules([FlowRule("r", count=1e9)])
+            plane_b = IngestPlane(b)
+            _wait_for(lambda: cli.engine_alive(), what="engine up")
+            time.sleep(0.3)  # several beat ticks: no reassert may fire
+            assert cli.counters["reconnects"] == 0
+            assert plane_b.snapshot()["counters"]["worker_reconnects"] == 0
+        finally:
+            cli.close()
+            for o in (plane_b, b):
+                if o is not None:
+                    o.close()
+
+
+# ---------------------------------------------------------------------------
+# supervised kill -9 (real processes)
+# ---------------------------------------------------------------------------
+class TestSupervisorUnits:
+    def test_create_segments_survives_stale_leftovers(self):
+        """Regression (review): a crashed SUPERVISOR leaves its named
+        segments in /dev/shm (destroy never ran, its fleet died with
+        it) — a relaunch with the same fixed prefix must unlink the
+        corpses and recreate, not die with FileExistsError."""
+        import multiprocessing
+
+        from sentinel_tpu.ipc.supervise import (
+            create_segments,
+            destroy_segments,
+            make_handles,
+        )
+
+        ctx = multiprocessing.get_context("spawn")
+        prefix = f"stpu-su-{uuid.uuid4().hex[:8]}"
+        h = make_handles(ctx, prefix, n_workers=1)
+        stale = create_segments(h)
+        for s in stale:
+            s.close()  # the crash: handles gone, segments left behind
+        fresh = create_segments(h)  # must not raise
+        try:
+            assert len(fresh) == len(stale)
+        finally:
+            destroy_segments(fresh)
+
+
+@pytest.mark.mp
+class TestSupervisedChaos:
+    def test_kill9_bounded_outage_and_reconnect(self, tmp_path):
+        """The end-to-end loop with real processes and in-flight
+        micro-windows: supervised engine, client micro-window armed,
+        kill -9 mid-load → the supervisor restarts the engine on the
+        SAME rings, the probing client's policy-served interval is
+        bounded, it reconnects (ledger re-assert) and resumes
+        device-backed verdicts."""
+        import ipc_procs
+        from sentinel_tpu.ipc.supervise import measure_restart_outage
+
+        config.set(config.IPC_HEARTBEAT_MS, "50")
+        config.set(config.IPC_ENGINE_DEAD_MS, "2000")
+        config.set(config.IPC_CLIENT_WINDOW_MS, "0.5")  # in-flight windows
+        config.set(config.SUPERVISE_BACKOFF_MS, "200")
+        config.set(config.FAILOVER_ENABLED, "true")
+        config.set(config.FAILOVER_CHECKPOINT_EVERY, "2")
+        config.set(config.FAILOVER_CKPT_PATH, str(tmp_path / "ck.bin"))
+        out = measure_restart_outage(
+            ipc_procs.restart_setup, "chaos-res", timeout_s=200
+        )
+        assert out["restarts"] >= 1, out
+        assert out["reconnects"] >= 1, out
+        # Bounded outage: the policy-served interval ended (we got a
+        # device-backed verdict again) — the wall-clock bound is the
+        # measurement returning at all; sanity-cap it anyway.
+        assert out["outage_ms"] < 150_000, out

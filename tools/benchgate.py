@@ -133,6 +133,12 @@ STAGE_METRICS: Dict[str, Tuple[str, float]] = {
     "ipc_entry_adaptive_p50_us": ("lower", 2.00),
     "ipc_entry_adaptive_p99_us": ("lower", 5.00),
     "ipc_wakeup_speedup": ("higher", 0.30),
+    # Engine hot-restart outage (supervised kill -9 → device-served
+    # again): dominated by process cold boot (JAX import + first
+    # compile) + dead-ms detection + restart backoff, so it gets the
+    # widest band the gate allows — its job is catching a recovery
+    # that stops converging, not a ±second of import time.
+    "ipc_restart_outage_ms": ("lower", 5.00),
     "ipc_percall_w1_ops_per_sec": ("higher", 0.60),
     "ipc_percall_w2_ops_per_sec": ("higher", 0.60),
     "ipc_percall_w4_ops_per_sec": ("higher", 0.60),
@@ -179,7 +185,7 @@ STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
      ("ipc_workers_ops_per_sec", "ipc_inproc_ops_per_sec",
       "ipc_vs_inproc", "ipc_entry_p50_us", "ipc_entry_p99_us",
       "ipc_entry_adaptive_p50_us", "ipc_entry_adaptive_p99_us",
-      "ipc_wakeup_speedup")),
+      "ipc_wakeup_speedup", "ipc_restart_outage_ms")),
     # The sweep carries its own rung key so a truncated/smoke run
     # never reads as a slowdown (and pre-PR-14 baselines, which lack
     # both the key and the metrics, simply don't compare here).

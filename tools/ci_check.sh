@@ -33,11 +33,12 @@ echo "== ci_check 2/3: config + doc + metrics audit =="
 JAX_PLATFORMS=cpu python tools/config_audit.py \
     --root sentinel_tpu --doc docs/ARCHITECTURE.md
 
-# Worker-mode smoke (always, cheap): spawned workers serve a real WSGI
-# adapter entirely through the rings — spawn → attach → adapter →
-# engine → verdict → exit release, the surface tier-1's in-process
-# tests cannot fully cover.
-echo "== ci_check 2b: ipc worker-mode smoke =="
+# Worker-mode + engine-restart smoke (always): spawned workers serve a
+# real WSGI adapter entirely through the rings, then a SUPERVISED
+# engine is kill -9'd mid-probing and must come back on the same rings
+# (epoch bump → client reconnect → device verdicts again) — the two
+# surfaces tier-1's in-process tests cannot fully cover.
+echo "== ci_check 2b: ipc worker-mode + engine-restart smoke =="
 JAX_PLATFORMS=cpu python tools/ipc_launch.py --smoke >/dev/null
 
 if [ "${CI_CHECK_SKIP_BENCH:-0}" = "1" ]; then

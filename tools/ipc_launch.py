@@ -15,11 +15,21 @@ gunicorn's ``--workers``). ``--client-window-ms`` arms the worker-side
 micro-window, ``--wakeup adaptive`` the spin-then-park ring waits; both
 replay into the children automatically.
 
-``--smoke`` runs the self-test used by tools/ci_check.sh: two spawned
-workers serve a built-in WSGI app in-process (no sockets), the parent
-asserts the requests were admitted by the engine and exits 0 — the
-whole worker-mode path (spawn → attach → adapter → rings → engine →
-verdict → exit release) in a few seconds.
+``--supervise`` runs the ENGINE in a supervised child process on named
+shared-memory rings (sentinel_tpu/ipc/supervise.py): a crashed engine
+restarts on the shared Backoff and re-attaches to the EXISTING rings —
+workers ride out the outage on the failover-policy snapshot, then
+re-assert their live-admission ledgers and resume device-backed
+verdicts. With ``sentinel.tpu.failover.checkpoint.path`` set the new
+engine warm-starts from the durable checkpoint.
+
+``--smoke`` runs the self-test used by tools/ci_check.sh: (1) two
+spawned workers serve a built-in WSGI app in-process (no sockets), the
+parent asserts the requests were admitted by the engine; (2) a
+supervised engine is ``kill -9``'d mid-probing and must come back on
+the same rings with the probing client reconnected — the whole
+engine-restart path (epoch bump → re-intern → ledger re-assert →
+device verdicts again) in one bounded cycle.
 """
 
 from __future__ import annotations
@@ -57,6 +67,14 @@ def serve_wsgi(worker_id: int, spec: str, port: int, wrap: bool) -> None:
     print(f"[ipc_launch] worker {worker_id} serving on "
           f"http://127.0.0.1:{port + worker_id}", flush=True)
     srv.serve_forever()
+
+
+def smoke_engine_setup(engine) -> None:
+    """Supervised-engine setup (top-level so spawn children import it
+    by name): the wide-open rule the smoke probes against."""
+    from sentinel_tpu.models.rules import FlowRule
+
+    engine.set_flow_rules([FlowRule(resource="web-total", count=1e9)])
 
 
 def smoke_worker(worker_id: int, n_requests: int, q) -> None:
@@ -151,6 +169,49 @@ def _smoke(n_workers: int = 2, n_requests: int = 8) -> int:
         eng.close()
 
 
+def _smoke_restart() -> int:
+    """Smoke phase 2: the engine failure-recovery loop end-to-end —
+    supervised engine up, probing client on the rings, ``kill -9`` the
+    engine child, assert the supervisor brings a new engine up on the
+    SAME rings, the client reconnects (ledger re-assert) and resumes
+    device-backed verdicts within a bounded outage."""
+    import os
+    import tempfile
+
+    from sentinel_tpu.ipc.supervise import measure_restart_outage
+    from sentinel_tpu.utils.config import config
+
+    # Snappy-but-safe liveness settings for a loaded CI box: the engine
+    # child pays the full JAX import + first compile on boot.
+    config.set(config.IPC_HEARTBEAT_MS, "50")
+    config.set(config.IPC_ENGINE_DEAD_MS, "2000")
+    config.set(config.IPC_WORKER_DEAD_MS, "60000")
+    config.set(config.SUPERVISE_BACKOFF_MS, "200")
+    config.set(config.FAILOVER_ENABLED, "true")
+    config.set(config.FAILOVER_CHECKPOINT_EVERY, "2")
+    ckpt_dir = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+    ckpt = os.path.join(ckpt_dir, f"stpu-smoke-ckpt-{os.getpid()}.bin")
+    config.set(config.FAILOVER_CKPT_PATH, ckpt)
+    try:
+        out = measure_restart_outage(
+            smoke_engine_setup, "web-total", timeout_s=240
+        )
+        assert out["restarts"] >= 1, out
+        assert out["reconnects"] >= 1, out
+        print(
+            f"[ipc_launch] restart smoke OK: outage "
+            f"{out['outage_ms']:.0f} ms, {out['policy_served']} "
+            f"policy-served probes, {out['restarts']} restart(s), "
+            f"{out['reconnects']} client reconnect(s)"
+        )
+        return 0
+    finally:
+        try:
+            os.unlink(ckpt)
+        except OSError:
+            pass
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("app", nargs="?", default="-",
@@ -162,8 +223,16 @@ def main() -> int:
     ap.add_argument("--client-window-ms", type=float, default=None,
                     help="arm the worker-side micro-window")
     ap.add_argument("--wakeup", choices=("sleep", "adaptive"), default=None)
+    ap.add_argument("--supervise", action="store_true",
+                    help="run the engine in a supervised child process "
+                         "(auto-restart on crash; workers ride out the "
+                         "outage on the policy snapshot and reconnect)")
+    ap.add_argument("--setup", default=None,
+                    help="module:fn loading rules in the supervised "
+                         "engine child (called as fn(engine))")
     ap.add_argument("--smoke", action="store_true",
-                    help="run the ci_check worker-mode self-test and exit")
+                    help="run the ci_check worker-mode + engine-restart "
+                         "self-test and exit")
     args = ap.parse_args()
 
     from sentinel_tpu.utils.config import config
@@ -173,9 +242,50 @@ def main() -> int:
     if args.wakeup is not None:
         config.set(config.IPC_WAKEUP, args.wakeup)
     if args.smoke:
-        return _smoke(n_workers=min(2, max(1, args.workers)))
+        rc = _smoke(n_workers=min(2, max(1, args.workers)))
+        if rc:
+            return rc
+        return _smoke_restart()
 
     from sentinel_tpu.core import api
+
+    if args.supervise:
+        import time
+
+        setup = _load_app(args.setup) if args.setup else None
+        sup = api.run_engine_supervised(setup=setup, n_workers=args.workers)
+        procs = [
+            sup.spawn_worker(
+                serve_wsgi, wid, (args.app, args.port, not args.no_wrap)
+            )
+            for wid in range(args.workers)
+        ]
+        print(f"[ipc_launch] supervised engine up (pid {sup.engine_pid()}), "
+              f"{len(procs)} workers (ports {args.port}.."
+              f"{args.port + args.workers - 1}); Ctrl-C stops", flush=True)
+        seen_restarts = 0
+        try:
+            while True:
+                time.sleep(1.0)
+                if sup.restarts != seen_restarts:
+                    seen_restarts = sup.restarts
+                    print(f"[ipc_launch] engine restarted "
+                          f"(#{seen_restarts}, pid {sup.engine_pid()})",
+                          flush=True)
+                if sup.gave_up:
+                    print("[ipc_launch] supervisor gave up (restart "
+                          "budget spent)", flush=True)
+                    return 1
+        except KeyboardInterrupt:
+            print("[ipc_launch] stopping", flush=True)
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(5.0)
+            sup.stop()
+        return 0
 
     eng = api.get_engine()
     ws = api.run_workers(
