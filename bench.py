@@ -1611,6 +1611,149 @@ def _run_ipc_stage(n_rules: int, n_ops: int, iters: int) -> dict:
     }
 
 
+def _run_cluster_stage(n_rules: int, n_ops: int, iters: int) -> dict:
+    """Batched cluster token plane (cluster/client.py + server.py):
+    frames-per-token-decision and ops/s for the three wire stances
+    against one real TCP token server — (a) per-call (the PR-15
+    default: one frame per decision), (b) client micro-window
+    (concurrent callers coalesce into FLOW_REQUEST_BATCH frames), and
+    (c) micro-window + local quota leases (hot-flow admissions served
+    with ZERO frames in steady state). Honesty columns count FAIL-
+    family fallback serves per mode — a nonzero means that mode's
+    number includes local-stance verdicts, not server verdicts."""
+    import threading as _threading
+
+    import jax
+
+    from sentinel_tpu.cluster import (
+        cluster_flow_rule_manager,
+        cluster_server_config_manager,
+    )
+    from sentinel_tpu.cluster.client import ClusterTokenClient, client_stats
+    from sentinel_tpu.cluster.server import SentinelTokenServer
+    from sentinel_tpu.cluster.token_service import DefaultTokenService
+    from sentinel_tpu.models import constants as C
+    from sentinel_tpu.models.rules import ClusterFlowConfig, FlowRule
+    from sentinel_tpu.utils.config import config
+
+    n_ops = max(256, n_ops)
+    n_threads = 8
+    per_thread = n_ops // n_threads
+    n_ops = per_thread * n_threads
+    flow_id = 42
+    _log(f"cluster stage ops={n_ops} threads={n_threads}")
+
+    # One wide-open rule: the stage measures the WIRE cost of a
+    # decision, not admission math (the differential tests pin that).
+    cluster_flow_rule_manager.clear()
+    cluster_server_config_manager.load_global_flow_config(
+        exceed_count=1.0, max_allowed_qps=1e12
+    )
+    cluster_flow_rule_manager.load_rules(
+        "default",
+        [FlowRule(
+            "r", count=1e9, cluster_mode=True,
+            cluster_config=ClusterFlowConfig(
+                flow_id=flow_id,
+                threshold_type=C.FLOW_THRESHOLD_GLOBAL,
+            ),
+        )],
+    )
+    server = SentinelTokenServer(port=0, service=DefaultTokenService())
+    server.start()
+    out: dict = {"cluster_n_ops": n_ops}
+
+    def drive(mode: str) -> None:
+        if mode == "percall":
+            config.set(config.CLUSTER_CLIENT_WINDOW_MS, "0")
+            config.set(config.CLUSTER_LEASE_ENABLED, "false")
+        elif mode == "window":
+            config.set(config.CLUSTER_CLIENT_WINDOW_MS, "2")
+            config.set(config.CLUSTER_CLIENT_WINDOW_MAX, "64")
+            config.set(config.CLUSTER_LEASE_ENABLED, "false")
+        else:  # lease
+            config.set(config.CLUSTER_CLIENT_WINDOW_MS, "2")
+            config.set(config.CLUSTER_CLIENT_WINDOW_MAX, "64")
+            config.set(config.CLUSTER_LEASE_ENABLED, "true")
+            config.set(config.CLUSTER_LEASE_TTL_MS, "1000")
+        client_stats.reset()
+        client = ClusterTokenClient("127.0.0.1", server.port).start()
+        try:
+            client.request_token(flow_id)  # connect + warm outside the clock
+            client_stats.reset()
+            barrier = _threading.Barrier(n_threads + 1)
+
+            def worker():
+                barrier.wait()
+                for _ in range(per_thread):
+                    client.request_token(flow_id)
+
+            threads = [
+                _threading.Thread(target=worker) for _ in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+        finally:
+            client.stop()
+        snap = client_stats.snapshot()
+        # Frames actually sent: batch frames plus per-call RPCs (the
+        # rpc histogram counts every awaited round trip; batched rows
+        # share one sample per frame, so subtract the double count).
+        frames = (
+            snap["batch_frames"]
+            if snap["batch_frames"]
+            else snap["rpc"]["count"]
+        )
+        fpo = frames / n_ops if n_ops else 0.0
+        out[f"cluster_{mode}_ops_per_sec"] = round(n_ops / dt, 1)
+        out[f"cluster_frames_per_op_{mode}"] = round(fpo, 4)
+        out[f"cluster_{mode}_fallbacks"] = snap["fallbacks"]
+        if mode == "lease":
+            out["cluster_lease_hit_rate"] = round(
+                snap["lease_admits"] / max(1, snap["requests"]), 4
+            )
+        _log(
+            f"cluster {mode}: {n_ops / dt:,.0f} ops/s, "
+            f"{fpo:.3f} frames/op, fallbacks={snap['fallbacks']}"
+        )
+        _emit_partial = dict(out)
+        print(json.dumps(_emit_partial), flush=True)
+
+    try:
+        for mode in ("percall", "window", "lease"):
+            drive(mode)
+    finally:
+        server.stop()
+        cluster_flow_rule_manager.clear()
+        for key in (
+            config.CLUSTER_CLIENT_WINDOW_MS, config.CLUSTER_CLIENT_WINDOW_MAX,
+            config.CLUSTER_LEASE_ENABLED, config.CLUSTER_LEASE_TTL_MS,
+        ):
+            config.set(key, config.DEFAULTS[key])
+
+    amort = (
+        out.get("cluster_frames_per_op_percall", 1.0)
+        / max(1e-9, out.get("cluster_frames_per_op_window", 1.0))
+    )
+    out["cluster_window_amortization"] = round(amort, 3)
+    _log(
+        f"cluster stage done: window amortization {amort:.1f}x, lease "
+        f"hit rate {out.get('cluster_lease_hit_rate', 0.0):.2f}"
+    )
+    out.update({
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "jax_version": jax.__version__,
+        **_host_identity(),
+    })
+    return out
+
+
 def _run_stage(n_rules: int, n_entries: int, iters: int) -> dict:
     """Child-process body: build state, compile, time. Prints one JSON
     line with the stage result (including the platform ACTUALLY used)."""
@@ -1723,6 +1866,7 @@ def _child_main(args) -> None:
         "adapters": _run_adapters_stage,
         "autotune": _run_autotune_stage,
         "ipc": _run_ipc_stage,
+        "cluster": _run_cluster_stage,
     }[args.kind]
     print(json.dumps(fn(args.rules, args.entries, args.iters)), flush=True)
 
@@ -2008,7 +2152,13 @@ def main() -> None:
             _log(f"skipping autotune stage: {remaining:.0f}s left gives "
                  f"timeout {autotune_t:.0f}s < {min_autotune:.0f}s floor")
         remaining = deadline - time.monotonic()
-        ipc_t = min(remaining - 10, 300.0)
+        # Reserve the cluster stage's floor like the autotune stage
+        # reserves the ipc's. The cluster stage is pure host TCP — no
+        # device compile — so its floor is small even on hardware.
+        min_cluster = 45.0
+        ipc_t = min(remaining - 10 - min_cluster, 300.0)
+        if ipc_t < min_ipc:
+            ipc_t = min(remaining - 10, 300.0)
         if ipc_t >= min_ipc:
             ipc = spawn(8, 16384, 3, run_platform, ipc_t, kind="ipc")
             if ipc:
@@ -2016,6 +2166,15 @@ def main() -> None:
         else:
             _log(f"skipping ipc stage: {remaining:.0f}s left gives "
                  f"timeout {ipc_t:.0f}s < {min_ipc:.0f}s floor")
+        remaining = deadline - time.monotonic()
+        cluster_t = min(remaining - 10, 120.0)
+        if cluster_t >= min_cluster:
+            cl = spawn(1, 8192, 1, run_platform, cluster_t, kind="cluster")
+            if cl:
+                best.update(cl)
+        else:
+            _log(f"skipping cluster stage: {remaining:.0f}s left gives "
+                 f"timeout {cluster_t:.0f}s < {min_cluster:.0f}s floor")
 
     if best is None:
         _emit(

@@ -20,8 +20,55 @@ from typing import Dict, List, Optional
 from sentinel_tpu.cluster import protocol
 from sentinel_tpu.cluster.token_service import TokenResult, TokenService
 from sentinel_tpu.datasource.backoff import Backoff
+from sentinel_tpu.metrics.histogram import LatencyHistogram
 from sentinel_tpu.models import constants as C
+from sentinel_tpu.utils.config import SentinelConfig, config
 from sentinel_tpu.utils.record_log import record_log
+
+
+class ClusterClientStats:
+    """Process-wide cluster token client counters + RPC latency
+    histogram. Module-level singleton (not per-client) so the
+    Prometheus render works off a default engine — an engine has no
+    client attached until a cluster rule arrives, but the metric
+    families must exist from the first scrape."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.requests = 0  # token decisions asked of the client
+        self.batch_frames = 0  # batched frames sent
+        self.leases_granted = 0  # leases received from the server
+        self.lease_admits = 0  # admissions served from a local lease
+        self.fallbacks = 0  # FAIL-family serves (caller falls back local)
+        self.rpc_ms = LatencyHistogram()
+
+    def incr(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "requests": self.requests,
+                "batch_frames": self.batch_frames,
+                "leases_granted": self.leases_granted,
+                "lease_admits": self.lease_admits,
+                "fallbacks": self.fallbacks,
+            }
+        out["rpc"] = self.rpc_ms.summary()
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self.requests = 0
+            self.batch_frames = 0
+            self.leases_granted = 0
+            self.lease_admits = 0
+            self.fallbacks = 0
+        self.rpc_ms.reset()
+
+
+client_stats = ClusterClientStats()
 
 
 class ClusterTokenClient(TokenService):
@@ -62,6 +109,25 @@ class ClusterTokenClient(TokenService):
         # request threads race through _maybe_reconnect.
         self._reconnect_lock = threading.Lock()
         self._next_reconnect = 0.0
+        # Client micro-window (sentinel.tpu.cluster.client.window.*):
+        # concurrent per-op request_token callers coalesce into one
+        # FLOW_REQUEST_BATCH frame. The leader flushes after window.ms
+        # (or at window.max rows) and does NOT await the response —
+        # frames pipeline, xid-multiplexed on the reader.
+        self._win_lock = threading.Lock()
+        self._win_rows: list = []  # (flow_id, acquire, prio, waiter)
+        self._win_leader_active = False
+        # Local quota leases: flow_id → [tokens_left, monotonic expiry].
+        # Consumption accumulates in _lease_reports and rides the next
+        # batch frame for server-side reconciliation.
+        self._lease_lock = threading.Lock()
+        self._leases: Dict[int, list] = {}
+        self._lease_reports: Dict[int, int] = {}
+        # Per-connection param-value intern table (value → vid); reset
+        # on every (re)connect because the server's reverse table is
+        # per connection.
+        self._interned: Dict[str, int] = {}
+        self._next_vid = 1
 
     # ------------------------------------------------------------------
     def start(self) -> "ClusterTokenClient":
@@ -122,6 +188,15 @@ class ClusterTokenClient(TokenService):
                 except OSError:
                     pass
                 self._sock = None
+            # The server's vid reverse-table died with the connection.
+            self._interned.clear()
+            self._next_vid = 1
+        # Server death voids local quota: fall back to the per-call
+        # stance immediately, never admit on a lease the server can no
+        # longer account for.
+        with self._lease_lock:
+            self._leases.clear()
+            self._lease_reports.clear()
         # Fail all pending waits.
         with self._pending_lock:
             for p in self._pending.values():
@@ -156,6 +231,19 @@ class ClusterTokenClient(TokenService):
                 payload = protocol.read_frame(sock)
                 if payload is None:
                     break
+                if protocol.peek_msg_type(payload) in (
+                    C.MSG_TYPE_FLOW_BATCH, C.MSG_TYPE_PARAM_FLOW_BATCH
+                ):
+                    xid, _mt, rows, leases = protocol.unpack_batch_response(payload)
+                    with self._pending_lock:
+                        p = self._pending.pop(xid, None)
+                    if isinstance(p, _BatchPending):
+                        p.set_batch(rows)
+                    elif p is not None:
+                        p.set(TokenResult(C.TokenResultStatus.FAIL))
+                    if leases:
+                        self._store_leases(leases)
+                    continue
                 xid, _mt, status, remaining, wait_ms, token_id = protocol.unpack_response(payload)
                 with self._pending_lock:
                     p = self._pending.pop(xid, None)
@@ -176,6 +264,7 @@ class ClusterTokenClient(TokenService):
         pending = _Pending()
         with self._pending_lock:
             self._pending[xid] = pending
+        t0 = time.monotonic()
         try:
             with self._send_lock:
                 if self._sock is None:
@@ -186,18 +275,279 @@ class ClusterTokenClient(TokenService):
                 self._pending.pop(xid, None)
             self._close()
             self._maybe_reconnect()
+            client_stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
         result = pending.wait(self.timeout)
         if result is None:
             with self._pending_lock:
                 self._pending.pop(xid, None)
+            client_stats.incr("fallbacks")
+            return TokenResult(C.TokenResultStatus.FAIL)
+        client_stats.rpc_ms.record((time.monotonic() - t0) * 1e3)
+        if result.status == C.TokenResultStatus.FAIL:
+            client_stats.incr("fallbacks")
+        return result
+
+    # ------------------------------------------------------------------
+    # local quota leases
+    def _store_leases(self, leases) -> None:
+        now = time.monotonic()
+        with self._lease_lock:
+            for flow_id, tokens, valid_ms in leases:
+                if tokens <= 0 or valid_ms <= 0:
+                    continue
+                client_stats.incr("leases_granted")
+                self._leases[flow_id] = [tokens, now + valid_ms / 1000.0]
+
+    def _lease_admit(self, flow_id: int, acquire: int) -> bool:
+        """Zero-RPC admission from a live local lease. Consumption is
+        recorded for the next frame's report rows; the last token
+        drops the lease (back to the RPC stance, which may earn a
+        fresh one)."""
+        if not self._leases:
+            return False
+        now = time.monotonic()
+        with self._lease_lock:
+            lease = self._leases.get(flow_id)
+            if lease is None:
+                return False
+            if now >= lease[1]:
+                del self._leases[flow_id]
+                return False
+            if lease[0] < acquire:
+                return False
+            lease[0] -= acquire
+            if lease[0] <= 0:
+                del self._leases[flow_id]
+            self._lease_reports[flow_id] = (
+                self._lease_reports.get(flow_id, 0) + acquire
+            )
+        client_stats.incr("lease_admits")
+        return True
+
+    def _drain_lease_reports(self) -> list:
+        if not self._lease_reports:
+            return []
+        with self._lease_lock:
+            items = list(self._lease_reports.items())
+            self._lease_reports.clear()
+        return items
+
+    def plane_snapshot(self) -> dict:
+        """Live per-connection state for the ``cluster`` transport
+        command (process-wide counters live in ``client_stats``)."""
+        now = time.monotonic()
+        with self._lease_lock:
+            leases = {
+                str(fid): {
+                    "tokens_left": lease[0],
+                    "valid_ms": max(0, int((lease[1] - now) * 1000)),
+                }
+                for fid, lease in self._leases.items()
+            }
+            unreported = sum(self._lease_reports.values())
+        with self._send_lock:
+            interned_values = len(self._interned)
+        with self._pending_lock:
+            inflight = len(self._pending)
+        return {
+            "connected": self._sock is not None,
+            "server": f"{self.host}:{self.port}",
+            "namespace": self.namespace,
+            "inflight_frames": inflight,
+            "interned_values": interned_values,
+            "leases": leases,
+            "lease_reports_pending": unreported,
+            "window_ms": config.get_int(
+                SentinelConfig.CLUSTER_CLIENT_WINDOW_MS, 0
+            ),
+            "window_max": config.get_int(
+                SentinelConfig.CLUSTER_CLIENT_WINDOW_MAX, 128
+            ),
+        }
+
+    # ------------------------------------------------------------------
+    # batched path
+    def _rpc_flow_batch(self, rows) -> List[TokenResult]:
+        """One FLOW_REQUEST_BATCH round trip for N rows."""
+        if self._sock is None and not self._maybe_reconnect():
+            client_stats.incr("fallbacks", len(rows))
+            return [TokenResult(C.TokenResultStatus.FAIL)] * len(rows)
+        waiters = [_Pending() for _ in rows]
+        xid = next(self._xid)
+        frame = protocol.pack_flow_batch_request(
+            xid, rows, self._drain_lease_reports()
+        )
+        if not self._send_batch_frame(frame, xid, waiters):
+            return [TokenResult(C.TokenResultStatus.FAIL)] * len(rows)
+        return self._await_waiters(waiters)
+
+    def _send_batch_frame(self, frame: bytes, xid: int, waiters) -> bool:
+        pending = _BatchPending(waiters)
+        with self._pending_lock:
+            self._pending[xid] = pending
+        try:
+            with self._send_lock:
+                if self._sock is None:
+                    raise OSError("not connected")
+                self._sock.sendall(frame)
+        except OSError:
+            with self._pending_lock:
+                self._pending.pop(xid, None)
+            client_stats.incr("fallbacks", len(waiters))
+            self._close()
+            self._maybe_reconnect()
+            return False
+        client_stats.incr("batch_frames")
+        return True
+
+    def _await_waiters(self, waiters) -> List[TokenResult]:
+        deadline = time.monotonic() + self.timeout
+        out = []
+        for w in waiters:
+            r = w.wait(max(0.0, deadline - time.monotonic()))
+            if r is None:
+                client_stats.incr("fallbacks")
+                r = TokenResult(C.TokenResultStatus.FAIL)
+            out.append(r)
+        return out
+
+    def request_tokens_batch(self, rows) -> List[TokenResult]:
+        """Batched entry point mirroring
+        DefaultTokenService.request_tokens: [(flow_id, acquire,
+        prioritized)] → one frame (leased rows are served locally and
+        never cross the wire)."""
+        if not rows:
+            return []
+        client_stats.incr("requests", len(rows))
+        out: List[Optional[TokenResult]] = [None] * len(rows)
+        rpc_rows = []
+        rpc_idx = []
+        for i, (flow_id, acquire, prio) in enumerate(rows):
+            if self._lease_admit(flow_id, acquire):
+                out[i] = TokenResult(C.TokenResultStatus.OK)
+            else:
+                rpc_rows.append((flow_id, acquire, prio))
+                rpc_idx.append(i)
+        if rpc_rows:
+            for i, r in zip(rpc_idx, self._rpc_flow_batch(rpc_rows)):
+                out[i] = r
+        return out  # type: ignore[return-value]
+
+    def request_param_tokens_batch(self, rows) -> List[TokenResult]:
+        """[(flow_id, acquire, params)] → one PARAM_FLOW_BATCH frame.
+        Values are interned per connection: interning and the send
+        share the send lock so a frame can never reference a vid an
+        earlier-ordered frame has not announced."""
+        if not rows:
+            return []
+        client_stats.incr("requests", len(rows))
+        if self._sock is None and not self._maybe_reconnect():
+            client_stats.incr("fallbacks", len(rows))
+            return [TokenResult(C.TokenResultStatus.FAIL)] * len(rows)
+        waiters = [_Pending() for _ in rows]
+        xid = next(self._xid)
+        pending = _BatchPending(waiters)
+        with self._pending_lock:
+            self._pending[xid] = pending
+        try:
+            with self._send_lock:
+                if self._sock is None:
+                    raise OSError("not connected")
+                interns = []
+                wire_rows = []
+                for flow_id, acquire, params in rows:
+                    vids = []
+                    for p in params:
+                        s = str(p)
+                        vid = self._interned.get(s)
+                        if vid is None:
+                            vid = self._next_vid
+                            self._next_vid += 1
+                            self._interned[s] = vid
+                            interns.append((vid, s))
+                        vids.append(vid)
+                    wire_rows.append((flow_id, acquire, vids))
+                self._sock.sendall(
+                    protocol.pack_param_batch_request(xid, wire_rows, interns)
+                )
+        except OSError:
+            with self._pending_lock:
+                self._pending.pop(xid, None)
+            client_stats.incr("fallbacks", len(rows))
+            self._close()
+            self._maybe_reconnect()
+            return [TokenResult(C.TokenResultStatus.FAIL)] * len(rows)
+        client_stats.incr("batch_frames")
+        return self._await_waiters(waiters)
+
+    # ------------------------------------------------------------------
+    # client micro-window (per-op callers coalesce into one frame)
+    def _window_request(
+        self, flow_id: int, acquire: int, prioritized: bool, win_ms: int
+    ) -> TokenResult:
+        waiter = _Pending()
+        with self._win_lock:
+            self._win_rows.append((flow_id, acquire, prioritized, waiter))
+            leader = not self._win_leader_active
+            if leader:
+                self._win_leader_active = True
+        if leader:
+            win_max = max(
+                1, config.get_int(SentinelConfig.CLUSTER_CLIENT_WINDOW_MAX, 128)
+            )
+            deadline = time.monotonic() + win_ms / 1000.0
+            while True:
+                with self._win_lock:
+                    full = len(self._win_rows) >= win_max
+                remaining = deadline - time.monotonic()
+                if full or remaining <= 0:
+                    break
+                time.sleep(min(remaining, 0.0005))
+            with self._win_lock:
+                batch, self._win_rows = self._win_rows, []
+                self._win_leader_active = False
+            self._flush_window(batch)
+        result = waiter.wait(self.timeout + win_ms / 1000.0)
+        if result is None:
+            client_stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
         return result
+
+    def _flush_window(self, batch) -> None:
+        if not batch:
+            return
+        if self._sock is None and not self._maybe_reconnect():
+            client_stats.incr("fallbacks", len(batch))
+            for _f, _a, _p, w in batch:
+                w.set(TokenResult(C.TokenResultStatus.FAIL))
+            return
+        xid = next(self._xid)
+        frame = protocol.pack_flow_batch_request(
+            xid,
+            [(f, a, p) for f, a, p, _w in batch],
+            self._drain_lease_reports(),
+        )
+        waiters = [w for _f, _a, _p, w in batch]
+        if not self._send_batch_frame(frame, xid, waiters):
+            for w in waiters:
+                w.set(TokenResult(C.TokenResultStatus.FAIL))
+        # Pipelined: the response resolves the waiters via the reader;
+        # the next window can form and ship before it lands.
 
     def request_token(
         self, flow_id: int, acquire_count: int = 1, prioritized: bool = False
     ) -> TokenResult:
+        client_stats.incr("requests")
+        if self._lease_admit(flow_id, acquire_count):
+            return TokenResult(C.TokenResultStatus.OK)
+        win_ms = config.get_int(SentinelConfig.CLUSTER_CLIENT_WINDOW_MS, 0)
+        if win_ms > 0:
+            return self._window_request(
+                flow_id, acquire_count, prioritized, win_ms
+            )
         if self._sock is None and not self._maybe_reconnect():
+            client_stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
         xid = next(self._xid)
         return self._send_request(
@@ -207,7 +557,9 @@ class ClusterTokenClient(TokenService):
     def request_param_token(
         self, flow_id: int, acquire_count: int, params: List[object]
     ) -> TokenResult:
+        client_stats.incr("requests")
         if self._sock is None and not self._maybe_reconnect():
+            client_stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
         xid = next(self._xid)
         return self._send_request(
@@ -221,7 +573,9 @@ class ClusterTokenClient(TokenService):
         """requestConcurrentToken over the wire; the server derives the
         client address from the connection (the argument is unused here,
         kept for TokenService interface parity)."""
+        client_stats.incr("requests")
         if self._sock is None and not self._maybe_reconnect():
+            client_stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
         xid = next(self._xid)
         return self._send_request(
@@ -250,3 +604,29 @@ class _Pending:
         if not self._event.wait(timeout):
             return None
         return self._result
+
+
+class _BatchPending:
+    """One in-flight batch frame: the response's positional rows fan
+    out to the per-row waiters. Duck-types _Pending.set so _close's
+    fail-all sweep needs no special case."""
+
+    __slots__ = ("waiters", "_t0")
+
+    def __init__(self, waiters) -> None:
+        self.waiters = waiters
+        self._t0 = time.monotonic()
+
+    def set(self, result: TokenResult) -> None:
+        for w in self.waiters:
+            w.set(result)
+
+    def set_batch(self, rows) -> None:
+        client_stats.rpc_ms.record((time.monotonic() - self._t0) * 1e3)
+        if len(rows) != len(self.waiters):
+            # Version-rejected (empty) or malformed response: fail every
+            # waiter — callers map FAIL-family to fallback-to-local.
+            self.set(TokenResult(C.TokenResultStatus.BAD_REQUEST))
+            return
+        for w, (status, remaining, wait_ms) in zip(self.waiters, rows):
+            w.set(TokenResult(C.TokenResultStatus(status), remaining, wait_ms))

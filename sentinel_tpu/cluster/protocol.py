@@ -16,6 +16,27 @@ Request: [u32 xid][u8 type][body]
   CONCURRENT_FLOW_RELEASE body: [i64 token_id]
   PING body:        [] | [u16 len, bytes namespace]
 Response:[u32 xid][u8 type][i8 status][i32 remaining][i32 wait_ms][i64 token_id]
+
+Batched extension (this framework's own — the reference resolves one
+token per round trip). One frame carries a whole admission window:
+
+  FLOW_BATCH request:  [u32 xid][u8 type=16][u8 ver][u16 n]
+                         n × (i64 flow_id, i32 acquire, u8 flags)  # bit0 prioritized
+                       [u16 n_reports] n_reports × (i64 flow_id, i32 consumed)
+  PARAM_FLOW_BATCH:    [u32 xid][u8 type=17][u8 ver]
+                       [u16 n_interns] n_interns × (u32 vid, u16 len, bytes)
+                       [u16 n] n × (i64 flow_id, i32 acquire, u16 nvals, nvals × u32 vid)
+  Batch response:      [u32 xid][u8 type][u8 ver][u16 n]
+                         n × (i8 status, i32 remaining, i32 wait_ms)
+                       [u16 n_leases] n_leases × (i64 flow_id, i32 tokens, i32 valid_ms)
+
+Param values are interned per connection: a value string crosses the
+wire once, later rows reference its u32 vid (the IPC plane's dictionary
+idea). The lease section lets the server grant local quota (n tokens,
+valid valid_ms from receipt) for hot flows; the request-side report rows
+reconcile client-local lease consumption for observability. Both batch
+types carry an explicit version byte so the layout can evolve without a
+new msg type; unknown versions are answered BAD_REQUEST, never parsed.
 """
 
 from __future__ import annotations
@@ -30,6 +51,17 @@ _FLOW_BODY = struct.Struct("<qiB")
 _RELEASE_BODY = struct.Struct("<q")
 _RESP = struct.Struct("<IBbiiq")
 _LEN = struct.Struct("<I")
+
+# Batched extension structs.
+BATCH_VERSION = 1
+_U16 = struct.Struct("<H")
+_BATCH_ROW = struct.Struct("<qiB")  # flow_id, acquire, flags (bit0 prioritized)
+_REPORT_ROW = struct.Struct("<qi")  # flow_id, consumed
+_RESP_ROW = struct.Struct("<bii")  # status, remaining, wait_ms
+_LEASE_ROW = struct.Struct("<qii")  # flow_id, tokens, valid_ms
+_INTERN_HDR = struct.Struct("<IH")  # vid, value byte length
+_PBATCH_ROW = struct.Struct("<qiH")  # flow_id, acquire, nvals
+_VID = struct.Struct("<I")
 
 
 def pack_flow_request(xid: int, flow_id: int, acquire: int, prioritized: bool) -> bytes:
@@ -83,6 +115,108 @@ def pack_response(
     return _LEN.pack(len(payload)) + payload
 
 
+def pack_flow_batch_request(
+    xid: int,
+    rows: List[Tuple[int, int, bool]],
+    reports: List[Tuple[int, int]] = (),
+) -> bytes:
+    """rows: [(flow_id, acquire, prioritized)]; reports: [(flow_id,
+    consumed)] lease-consumption reconciliation rows."""
+    parts = [
+        _REQ_HDR.pack(xid, C.MSG_TYPE_FLOW_BATCH),
+        struct.pack("<BH", BATCH_VERSION, len(rows)),
+    ]
+    for flow_id, acquire, prioritized in rows:
+        parts.append(_BATCH_ROW.pack(flow_id, acquire, 1 if prioritized else 0))
+    parts.append(_U16.pack(len(reports)))
+    for flow_id, consumed in reports:
+        parts.append(_REPORT_ROW.pack(flow_id, consumed))
+    payload = b"".join(parts)
+    return _LEN.pack(len(payload)) + payload
+
+
+def pack_param_batch_request(
+    xid: int,
+    rows: List[Tuple[int, int, List[int]]],
+    interns: List[Tuple[int, str]] = (),
+) -> bytes:
+    """rows: [(flow_id, acquire, [vid, ...])]; interns: [(vid, value)]
+    — value strings this connection has not sent before."""
+    parts = [
+        _REQ_HDR.pack(xid, C.MSG_TYPE_PARAM_FLOW_BATCH),
+        struct.pack("<B", BATCH_VERSION),
+        _U16.pack(len(interns)),
+    ]
+    for vid, value in interns:
+        raw = str(value).encode("utf-8")[:65535]
+        parts.append(_INTERN_HDR.pack(vid, len(raw)))
+        parts.append(raw)
+    parts.append(_U16.pack(len(rows)))
+    for flow_id, acquire, vids in rows:
+        parts.append(_PBATCH_ROW.pack(flow_id, acquire, len(vids)))
+        for vid in vids:
+            parts.append(_VID.pack(vid))
+    payload = b"".join(parts)
+    return _LEN.pack(len(payload)) + payload
+
+
+def pack_batch_response(
+    xid: int,
+    msg_type: int,
+    rows: List[Tuple[int, int, int]],
+    leases: List[Tuple[int, int, int]] = (),
+) -> bytes:
+    """rows: [(status, remaining, wait_ms)] positionally matching the
+    request rows; leases: [(flow_id, tokens, valid_ms)]."""
+    parts = [
+        _REQ_HDR.pack(xid, msg_type),
+        struct.pack("<BH", BATCH_VERSION, len(rows)),
+    ]
+    for status, remaining, wait_ms in rows:
+        parts.append(_RESP_ROW.pack(status, remaining, wait_ms))
+    parts.append(_U16.pack(len(leases)))
+    for flow_id, tokens, valid_ms in leases:
+        parts.append(_LEASE_ROW.pack(flow_id, tokens, valid_ms))
+    payload = b"".join(parts)
+    return _LEN.pack(len(payload)) + payload
+
+
+def peek_msg_type(payload: bytes) -> int:
+    """Message type of a request OR response payload without a full
+    parse — both layouts start [u32 xid][u8 type]. -1 for a frame too
+    short to carry a type (the caller's normal parse then raises the
+    usual struct.error, same as before peeking existed)."""
+    if len(payload) < 5:
+        return -1
+    return payload[4]
+
+
+def unpack_batch_response(
+    payload: bytes,
+) -> Tuple[int, int, List[Tuple[int, int, int]], List[Tuple[int, int, int]]]:
+    """-> (xid, msg_type, [(status, remaining, wait_ms)],
+    [(flow_id, tokens, valid_ms)])."""
+    xid, msg_type = _REQ_HDR.unpack_from(payload, 0)
+    off = _REQ_HDR.size
+    ver, n = struct.unpack_from("<BH", payload, off)
+    off += 3
+    if ver != BATCH_VERSION:
+        raise ValueError(f"unsupported batch response version {ver}")
+    rows = []
+    for _ in range(n):
+        rows.append(_RESP_ROW.unpack_from(payload, off))
+        off += _RESP_ROW.size
+    (n_leases,) = _U16.unpack_from(payload, off)
+    off += 2
+    leases = []
+    for _ in range(n_leases):
+        leases.append(_LEASE_ROW.unpack_from(payload, off))
+        off += _LEASE_ROW.size
+    if off != len(payload):
+        raise ValueError("trailing bytes after batch response")
+    return xid, msg_type, rows, leases
+
+
 class UnknownMsgType(ValueError):
     """Unknown message type in a well-framed request — carries the xid
     so the server can answer BAD_REQUEST instead of dropping the
@@ -102,8 +236,74 @@ _KNOWN_MSG_TYPES = frozenset(
         C.MSG_TYPE_PARAM_FLOW,
         C.MSG_TYPE_CONCURRENT_FLOW_ACQUIRE,
         C.MSG_TYPE_CONCURRENT_FLOW_RELEASE,
+        C.MSG_TYPE_FLOW_BATCH,
+        C.MSG_TYPE_PARAM_FLOW_BATCH,
     )
 )
+
+
+class UnsupportedBatchVersion(ValueError):
+    """Known batch msg type with a version byte this build cannot parse
+    — answered BAD_REQUEST (per-row, so the client's waiters resolve)
+    instead of dropping the connection."""
+
+    def __init__(self, xid: int, msg_type: int, version: int) -> None:
+        super().__init__(f"unsupported batch version {version}")
+        self.xid = xid
+        self.msg_type = msg_type
+        self.version = version
+
+
+def _unpack_flow_batch(xid: int, payload: bytes, off: int) -> tuple:
+    ver, n = struct.unpack_from("<BH", payload, off)
+    off += 3
+    if ver != BATCH_VERSION:
+        raise UnsupportedBatchVersion(xid, C.MSG_TYPE_FLOW_BATCH, ver)
+    rows = []
+    for _ in range(n):
+        flow_id, acquire, flags = _BATCH_ROW.unpack_from(payload, off)
+        off += _BATCH_ROW.size
+        rows.append((flow_id, acquire, bool(flags & 1)))
+    (n_reports,) = _U16.unpack_from(payload, off)
+    off += 2
+    reports = []
+    for _ in range(n_reports):
+        reports.append(_REPORT_ROW.unpack_from(payload, off))
+        off += _REPORT_ROW.size
+    if off != len(payload):
+        raise ValueError("trailing bytes after flow batch")
+    return rows, reports
+
+
+def _unpack_param_batch(xid: int, payload: bytes, off: int) -> tuple:
+    (ver,) = struct.unpack_from("<B", payload, off)
+    off += 1
+    if ver != BATCH_VERSION:
+        raise UnsupportedBatchVersion(xid, C.MSG_TYPE_PARAM_FLOW_BATCH, ver)
+    (n_interns,) = _U16.unpack_from(payload, off)
+    off += 2
+    interns = []
+    for _ in range(n_interns):
+        vid, ln = _INTERN_HDR.unpack_from(payload, off)
+        off += _INTERN_HDR.size
+        if off + ln > len(payload):
+            raise ValueError("truncated intern value")
+        interns.append((vid, payload[off : off + ln].decode("utf-8")))
+        off += ln
+    (n,) = _U16.unpack_from(payload, off)
+    off += 2
+    rows = []
+    for _ in range(n):
+        flow_id, acquire, nvals = _PBATCH_ROW.unpack_from(payload, off)
+        off += _PBATCH_ROW.size
+        vids = []
+        for _ in range(nvals):
+            vids.append(_VID.unpack_from(payload, off)[0])
+            off += _VID.size
+        rows.append((flow_id, acquire, vids))
+    if off != len(payload):
+        raise ValueError("trailing bytes after param batch")
+    return interns, rows
 
 
 def unpack_request(payload: bytes) -> Tuple[int, int, tuple]:
@@ -126,6 +326,10 @@ def unpack_request(payload: bytes) -> Tuple[int, int, tuple]:
     if msg_type == C.MSG_TYPE_CONCURRENT_FLOW_RELEASE:
         (token_id,) = _RELEASE_BODY.unpack_from(payload, off)
         return xid, msg_type, (token_id,)
+    if msg_type == C.MSG_TYPE_FLOW_BATCH:
+        return xid, msg_type, _unpack_flow_batch(xid, payload, off)
+    if msg_type == C.MSG_TYPE_PARAM_FLOW_BATCH:
+        return xid, msg_type, _unpack_param_batch(xid, payload, off)
     flow_id, acquire, prio = _FLOW_BODY.unpack_from(payload, off)
     off += _FLOW_BODY.size
     if msg_type == C.MSG_TYPE_FLOW:

@@ -20,6 +20,7 @@ from typing import Optional
 from sentinel_tpu.cluster import protocol
 from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenService
 from sentinel_tpu.models import constants as C
+from sentinel_tpu.utils.config import SentinelConfig, config
 from sentinel_tpu.utils.record_log import record_log
 
 
@@ -30,6 +31,10 @@ class _Handler(socketserver.BaseRequestHandler):
         client_addr = "%s:%d" % self.client_address[:2]
         server.connections.on_connect(client_addr)
         server._track_socket(self.request, add=True)
+        # Per-connection param-value intern table (vid → value): batch
+        # param rows reference values by id, each value string crosses
+        # the wire once per connection lifetime.
+        interned: dict = {}
         try:
             while True:
                 try:
@@ -51,6 +56,15 @@ class _Handler(socketserver.BaseRequestHandler):
                         protocol.pack_response(
                             e.xid, e.msg_type, int(C.TokenResultStatus.BAD_REQUEST)
                         )
+                    )
+                    continue
+                except protocol.UnsupportedBatchVersion as e:
+                    # Known batch type, future version byte: answer an
+                    # EMPTY batch response (0 rows ≠ requested rows →
+                    # the client fails its waiters) and keep the
+                    # connection for the per-call types.
+                    self.request.sendall(
+                        protocol.pack_batch_response(e.xid, e.msg_type, [])
                     )
                     continue
                 except (ValueError, struct.error):
@@ -81,6 +95,38 @@ class _Handler(socketserver.BaseRequestHandler):
                     resp = protocol.pack_response(
                         xid, msg_type, int(r.status), r.remaining, r.wait_in_ms
                     )
+                elif msg_type == C.MSG_TYPE_FLOW_BATCH:
+                    rows, reports = body
+                    results = server.service.request_tokens(rows)
+                    resp_rows = [
+                        (int(r.status), r.remaining, r.wait_in_ms)
+                        for r in results
+                    ]
+                    if reports:
+                        server._note_lease_reports(reports)
+                    leases = server._maybe_grant_leases(rows, results, reports)
+                    resp = protocol.pack_batch_response(
+                        xid, msg_type, resp_rows, leases
+                    )
+                elif msg_type == C.MSG_TYPE_PARAM_FLOW_BATCH:
+                    new_interns, rows = body
+                    for vid, value in new_interns:
+                        interned[vid] = value
+                    resp_rows = []
+                    for flow_id, acquire, vids in rows:
+                        missing = [v for v in vids if v not in interned]
+                        if missing:
+                            # A vid the connection never interned is a
+                            # codec bug, not a quota verdict.
+                            resp_rows.append(
+                                (int(C.TokenResultStatus.BAD_REQUEST), 0, 0)
+                            )
+                            continue
+                        r = server.service.request_param_token(
+                            flow_id, acquire, [interned[v] for v in vids]
+                        )
+                        resp_rows.append((int(r.status), r.remaining, r.wait_in_ms))
+                    resp = protocol.pack_batch_response(xid, msg_type, resp_rows)
                 elif msg_type == C.MSG_TYPE_CONCURRENT_FLOW_ACQUIRE:
                     flow_id, acquire = body
                     r = server.service.request_concurrent_token(
@@ -199,6 +245,64 @@ class SentinelTokenServer:
             self._conn_count = max(0, self._conn_count + delta)
             if hasattr(self.service, "set_connected_count"):
                 self.service.set_connected_count(max(1, self._conn_count))
+
+    # ------------------------------------------------------------------
+    # local quota leases (sentinel.tpu.cluster.lease.*)
+    def _maybe_grant_leases(self, rows, results, reports=()) -> list:
+        """Attach local-quota leases to a batch response for flows that
+        are hot: ≥ lease.min.batch admitted rows IN THIS FRAME, or a
+        lease-consumption report of ≥ lease.min.batch tokens riding the
+        frame (a flow that just burned through a lease is hot even if
+        its post-exhaustion stragglers form small frames — without this
+        the plane oscillates lease → trickle → lease instead of
+        renewing in steady state). The grant is lease.frac of the
+        flow's post-batch headroom (the last OK row's ``remaining``),
+        capped at lease.max, and DEBITED from the server window up
+        front through the same decision kernel the rows went through —
+        a refused debit means no lease, and an unused remainder is
+        forfeited at expiry, never credited back, so leases can
+        under-admit but never over-admit globally."""
+        if not config.get_bool(SentinelConfig.CLUSTER_LEASE_ENABLED):
+            return []
+        min_batch = max(1, config.get_int(SentinelConfig.CLUSTER_LEASE_MIN_BATCH, 4))
+        frac = config.get_float(SentinelConfig.CLUSTER_LEASE_FRAC, 0.5)
+        cap = max(1, config.get_int(SentinelConfig.CLUSTER_LEASE_MAX, 256))
+        ttl_ms = max(1, config.get_int(SentinelConfig.CLUSTER_LEASE_TTL_MS, 100))
+        reported = {
+            flow_id for flow_id, consumed in reports if consumed >= min_batch
+        }
+        ok_count: dict = {}
+        headroom: dict = {}
+        for (flow_id, _acq, _prio), r in zip(rows, results):
+            if r.status == C.TokenResultStatus.OK:
+                ok_count[flow_id] = ok_count.get(flow_id, 0) + 1
+                headroom[flow_id] = r.remaining
+        leases = []
+        for flow_id, n in ok_count.items():
+            if n < min_batch and flow_id not in reported:
+                continue
+            grant = min(cap, int(headroom.get(flow_id, 0) * frac))
+            if grant < 1:
+                continue
+            debit = self.service.request_token(flow_id, grant)
+            if debit.status == C.TokenResultStatus.OK:
+                leases.append((flow_id, grant, ttl_ms))
+        return leases
+
+    def _note_lease_reports(self, reports) -> None:
+        """Client-side lease consumption reconciled on the next frame:
+        the tokens were debited at grant time, so this only feeds the
+        server's per-flow stat log (dashboards stay honest about
+        lease-served traffic)."""
+        from sentinel_tpu.cluster import stat_log
+
+        items = [
+            ("flow", "leasePass", flow_id, int(consumed))
+            for flow_id, consumed in reports
+            if consumed > 0
+        ]
+        if items:
+            stat_log.log_many(items)
 
     def start(self) -> "SentinelTokenServer":
         if self._server is not None:

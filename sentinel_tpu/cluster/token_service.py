@@ -79,6 +79,18 @@ class TokenService:
     def release_concurrent_token(self, token_id: int) -> TokenResult:
         raise NotImplementedError
 
+    # Batched surface (this framework's extension): the engine's bulk
+    # seam calls these uniformly — the TCP client ships one frame, the
+    # embedded service makes one kernel pass, and any other
+    # implementation gets the per-call loop below.
+    def request_tokens_batch(self, rows) -> List[TokenResult]:
+        """rows: [(flow_id, acquire, prioritized)]."""
+        return [self.request_token(f, a, p) for f, a, p in rows]
+
+    def request_param_tokens_batch(self, rows) -> List[TokenResult]:
+        """rows: [(flow_id, acquire, params)]."""
+        return [self.request_param_token(f, a, ps) for f, a, ps in rows]
+
 
 def _batch_decide(
     state: ma.MetricArrayState,
@@ -280,6 +292,9 @@ class DefaultTokenService(TokenService):
         if stat_items:
             stat_log.log_many(stat_items)
         return [r if r is not None else TokenResult(C.TokenResultStatus.FAIL) for r in out]
+
+    def request_tokens_batch(self, rows) -> List[TokenResult]:
+        return self.request_tokens(rows)
 
     def request_param_token(
         self, flow_id: int, acquire_count: int, params: List[object]

@@ -304,6 +304,11 @@ class TelemetryBus:
             # boot-epoch bump and re-asserted their live ledgers into
             # this (new) engine world.
             "ipc_worker_reconnects": 0,
+            # Cluster token plane (PR 16): milliseconds actually slept
+            # honoring SHOULD_WAIT verdicts (bounded per op batch by
+            # sentinel.tpu.cluster.wait.cap.ms — the pre-cap path slept
+            # per op back-to-back, unbounded).
+            "cluster_wait_ms": 0,
         }
         # Bounded ring of health transitions (now_ms is engine-clock
         # relative ms): the flight-recorder view of the failover state
@@ -526,6 +531,12 @@ class TelemetryBus:
     def note_autotune_decision(self, n: int = 1) -> None:
         with self._lock:
             self.counters["autotune_decisions"] += n
+
+    def note_cluster_wait(self, ms: int) -> None:
+        """Milliseconds actually slept honoring cluster SHOULD_WAIT
+        verdicts (already bounded by the per-op-batch cap)."""
+        with self._lock:
+            self.counters["cluster_wait_ms"] += ms
 
     def note_sketch_cold_block(self, n: int = 1) -> None:
         with self._lock:

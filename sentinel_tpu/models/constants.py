@@ -98,6 +98,10 @@ MSG_TYPE_FLOW = 1
 MSG_TYPE_PARAM_FLOW = 2
 MSG_TYPE_CONCURRENT_FLOW_ACQUIRE = 3
 MSG_TYPE_CONCURRENT_FLOW_RELEASE = 4
+# This framework's batched extension (not in the reference codec): one
+# frame carries a whole admission window's worth of token requests.
+MSG_TYPE_FLOW_BATCH = 16
+MSG_TYPE_PARAM_FLOW_BATCH = 17
 
 FLOW_THRESHOLD_AVG_LOCAL = 0
 FLOW_THRESHOLD_GLOBAL = 1

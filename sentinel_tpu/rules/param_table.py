@@ -506,6 +506,59 @@ class ParamIndex:
     def has_rules(self) -> bool:
         return bool(self.rules)
 
+    def values_snapshot(self) -> dict:
+        """JSON-able capture of the value→row interning state for the
+        durable checkpoint (runtime/durable.py): per-gid value maps in
+        LRU (insertion) order, the free-row pool and the high-water row
+        counter — everything a fresh process needs to make restored
+        ``param_dyn`` rows mean the same (rule, value) pairs again."""
+        return {
+            "values": [list(v.items()) for v in self._values],
+            "free_rows": list(self._free_rows),
+            "next_row": self._next_row,
+        }
+
+    def adopt_values(self, snap) -> bool:
+        """Install a :meth:`values_snapshot` into THIS index. Refuses —
+        returning False, never raising — when the index already
+        interned values (live rows would collide with adopted ones),
+        the snapshot's shape doesn't match the compiled rule count, or
+        any row assignment is inconsistent. Insertion order is
+        preserved, so LRU recycling resumes exactly where the dead
+        process left off."""
+        try:
+            vals = snap["values"]
+            free = [int(r) for r in snap["free_rows"]]
+            nxt = int(snap["next_row"])
+        except (KeyError, TypeError, ValueError):
+            return False
+        if not isinstance(vals, list) or len(vals) != len(self.rules):
+            return False
+        if nxt < 0 or any(not (0 <= r < nxt) for r in free):
+            return False
+        if any(self._values) or self._next_row or self._free_rows:
+            return False
+        seen: set = set(free)
+        if len(seen) != len(free):
+            return False
+        adopted: List[Dict[str, int]] = []
+        for per_gid in vals:
+            d: Dict[str, int] = {}
+            try:
+                for key, row in per_gid:
+                    row = int(row)
+                    if not (0 <= row < nxt) or row in seen:
+                        return False
+                    seen.add(row)
+                    d[str(key)] = row
+            except (TypeError, ValueError):
+                return False
+            adopted.append(d)
+        self._values = adopted
+        self._free_rows = free
+        self._next_row = nxt
+        return True
+
     def _intern(self, gid: int, key: str) -> int:
         vals = self._values[gid]
         row = vals.get(key)

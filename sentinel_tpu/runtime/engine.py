@@ -536,6 +536,25 @@ def release_cluster_tokens(tokens: Sequence[Tuple[object, int]]) -> None:
             record_log.warn("[Engine] release of cluster token %d failed", token_id)
 
 
+# "No argument passed" marker for the cluster-check seams: None is a
+# meaningful service value (no cluster role active), so defaulting
+# cannot use it.
+_SENTINEL = object()
+
+
+def _is_cluster_param_slot(s) -> bool:
+    """A param slot whose admission the cluster token server owns:
+    QPS-grade cluster-mode ParamFlowRule with a flow_id."""
+    r = s.rule
+    return (
+        isinstance(r, ParamFlowRule)
+        and r.cluster_mode
+        and r.grade == C.FLOW_GRADE_QPS
+        and r.cluster_config is not None
+        and r.cluster_config.flow_id is not None
+    )
+
+
 class _EncodeArena:
     """Reusable host staging buffers for the chunk encode, keyed by
     padded shape — ``_run_chunk`` and ``_encode_param`` rebuild ~25
@@ -1366,6 +1385,14 @@ class Engine:
         out: List[Optional[_EntryOp]] = []
         resume_at = 0
         over = False
+        # Cluster deferral (PR 16): from the first cluster-needing op
+        # onward, resolved ops are NOT appended inline — their token
+        # RPCs run outside the lock as ONE batched call, then the tail
+        # appends in request order (preserving _entries order exactly).
+        # Ingest-bounded engines keep the pre-batch per-op remainder:
+        # the valve's per-op shed accounting is load-bearing there.
+        defer_ok = not (self.ingest.armed and self.ingest.max_pending)
+        tail: List[Tuple[_EntryOp, bool]] = []  # (op, needs_cluster)
         with self._lock:
             findex = self.flow_index
             dindex = self.degrade_index
@@ -1396,17 +1423,26 @@ class Engine:
                     out.append(None)
                     resume_at = i + 1
                     continue
-                if (
+                needs_cluster = (
                     cluster_gids
                     and any(gid in cluster_gids for gid, _ in op.slots)
                 ) or any(
                     s.rule is not None and s.rule.cluster_mode for s in op.p_slots
-                ):
+                )
+                if needs_cluster and not defer_ok:
                     # Token-service RPCs happen outside the lock: the
                     # resolved op is DISCARDED (it holds no state) and
                     # this request re-resolves through submit_entry.
                     resume_at = i
                     break
+                if needs_cluster or tail:
+                    out.append(op)
+                    tail.append((op, bool(needs_cluster)))
+                    resume_at = i + 1
+                    if len(self._entries) + len(tail) >= self.max_batch:
+                        over = True
+                        break
+                    continue
                 if (
                     self.ingest.armed
                     and self.ingest.max_pending
@@ -1433,11 +1469,23 @@ class Engine:
             for op in out:
                 if op is not None:
                     op.trace = tracer.make_tag()
+        if tail:
+            # ONE batched token RPC for the whole tail's cluster needs
+            # (outside the lock), then append in request order.
+            pending = [(op, cluster_gids) for op, needs in tail if needs]
+            if pending:
+                self._apply_cluster_checks_bulk(pending)
+            with self._lock:
+                for op, _needs in tail:
+                    self._entries.append(op)
+                if len(self._entries) >= self.max_batch:
+                    over = True
         if over:
             self.flush()  # flush-on-size, same as submit_entry
-        # Remainder (cluster-needing request onward, or post-flush):
-        # the per-op path keeps RPC-outside-lock + flush-on-size
-        # semantics and appends in request order.
+        # Remainder (unknown-kwargs request onward, ingest-bounded
+        # cluster op, or post-flush): the per-op path keeps
+        # RPC-outside-lock + flush-on-size semantics and appends in
+        # request order.
         for req in requests[resume_at:]:
             out.append(self.submit_entry(**req))
         return out
@@ -1461,14 +1509,34 @@ class Engine:
             return getattr(server, "service", server)
         return None
 
-    def _apply_cluster_checks(self, op: _EntryOp, cluster_gids) -> None:
+    def _apply_cluster_checks(
+        self,
+        op: _EntryOp,
+        cluster_gids,
+        service=_SENTINEL,
+        prefetched: Optional[Dict[int, object]] = None,
+        wait: Optional[List[int]] = None,
+    ) -> None:
         """applyTokenResult (FlowRuleChecker.java:207-230): OK → pass
-        (drop the local slot), SHOULD_WAIT → sleep then pass, BLOCKED →
+        (drop the local slot), SHOULD_WAIT → pace then pass, BLOCKED →
         block, anything else → fallback to local checking when the rule
-        allows it, else pass."""
+        allows it, else pass.
+
+        ``prefetched`` (the bulk seam) maps gid → TokenResult already
+        obtained in a batched RPC, so no per-op round trip happens
+        here; THREAD-grade held tokens always acquire per op.
+        ``wait`` is a shared one-cell accumulator of SHOULD_WAIT
+        milliseconds — when None (per-op callers) this op settles its
+        own wait before returning; the bulk driver passes one cell for
+        the whole op batch and settles once, so waits bound by
+        cluster.wait.cap.ms instead of sleeping per op back-to-back."""
         from sentinel_tpu.models import constants as _C
 
-        service = self._cluster_token_service()
+        if service is _SENTINEL:
+            service = self._cluster_token_service()
+        own_wait = wait is None
+        if own_wait:
+            wait = [0]
         kept = []
         decided = set()
         for gid, crow in op.slots:
@@ -1503,16 +1571,19 @@ class Engine:
                 if cc.fallback_to_local_when_fail:
                     kept.append((gid, crow))
                 continue
-            try:
-                result = service.request_token(cc.flow_id, op.acquire, op.prio)
-            except Exception:
-                result = None
+            if prefetched is not None and gid in prefetched:
+                result = prefetched[gid]
+            else:
+                try:
+                    result = service.request_token(cc.flow_id, op.acquire, op.prio)
+                except Exception:
+                    result = None
             status = result.status if result is not None else _C.TokenResultStatus.FAIL
             if status == _C.TokenResultStatus.OK:
                 decided.add(cc.flow_id)
                 continue  # token granted: rule passes
             if status == _C.TokenResultStatus.SHOULD_WAIT:
-                self.clock.sleep_ms(result.wait_in_ms)
+                wait[0] += int(result.wait_in_ms)
                 decided.add(cc.flow_id)
                 continue
             if status == _C.TokenResultStatus.BLOCKED:
@@ -1524,8 +1595,101 @@ class Engine:
                 kept.append((gid, crow))
         op.slots = kept
         op.token_decided_flow_ids = op.token_decided_flow_ids | frozenset(decided)
+        if own_wait:
+            self._settle_cluster_wait(wait)
 
-    def _apply_cluster_param_checks(self, op: _EntryOp) -> None:
+    def _settle_cluster_wait(self, wait: List[int]) -> None:
+        """Pay the accumulated SHOULD_WAIT pacing ONCE, bounded by
+        sentinel.tpu.cluster.wait.cap.ms (overflow is forfeited — a
+        pathological batch must not stall the submit path for the sum
+        of its per-op waits), counted in cluster_wait_ms telemetry."""
+        total = wait[0]
+        if total <= 0:
+            return
+        cap = config.get_int(config.CLUSTER_WAIT_CAP_MS, 1000)
+        slept = min(total, cap) if cap > 0 else total
+        if slept > 0:
+            self.clock.sleep_ms(slept)
+        if self.telemetry.enabled:
+            self.telemetry.note_cluster_wait(slept)
+
+    @staticmethod
+    def _cluster_param_groups(op: _EntryOp) -> Dict[int, Tuple[object, List[str]]]:
+        """flow_id → (rule, values) for the op's cluster-mode QPS param
+        slots (the unit of a request_param_token call)."""
+        groups: Dict[int, Tuple[object, List[str]]] = {}
+        for s in op.p_slots:
+            if _is_cluster_param_slot(s):
+                fid = int(s.rule.cluster_config.flow_id)
+                if fid not in groups:
+                    groups[fid] = (s.rule, [])
+                groups[fid][1].append(s.value_key)
+        return groups
+
+    def _apply_cluster_checks_bulk(self, pending: List[Tuple[_EntryOp, Dict]]) -> None:
+        """The bulk seam: resolve every cluster verdict of an op batch
+        with ONE batched token RPC per frame kind (flow + param),
+        issued OUTSIDE the engine lock, then apply per-op results
+        through the same mapping as the per-op path. SHOULD_WAIT
+        pacing accumulates across the batch and settles once, bounded
+        (the per-op path slept serially per op). THREAD-grade held
+        tokens stay per-op inside _apply_cluster_checks — a held token
+        needs its own token_id lifecycle."""
+        service = self._cluster_token_service()
+        wait = [0]
+        flow_rows: List[Tuple[int, int, bool]] = []
+        flow_refs: List[Tuple[int, int]] = []  # (pending idx, gid)
+        param_rows: List[Tuple[int, int, List[str]]] = []
+        param_refs: List[Tuple[int, int]] = []  # (pending idx, flow_id)
+        if service is not None:
+            for oi, (op, gids) in enumerate(pending):
+                for gid, _crow in op.slots:
+                    rule = gids.get(gid)
+                    if rule is None or rule.grade == C.FLOW_GRADE_THREAD:
+                        continue
+                    flow_rows.append(
+                        (int(rule.cluster_config.flow_id), op.acquire, op.prio)
+                    )
+                    flow_refs.append((oi, gid))
+                for fid, (_rule, values) in self._cluster_param_groups(op).items():
+                    param_rows.append((fid, op.acquire, values))
+                    param_refs.append((oi, fid))
+        flow_pre: List[Dict[int, object]] = [{} for _ in pending]
+        param_pre: List[Dict[int, object]] = [{} for _ in pending]
+        if flow_rows:
+            try:
+                results = service.request_tokens_batch(flow_rows)
+            except Exception:
+                results = [None] * len(flow_rows)
+            for (oi, gid), r in zip(flow_refs, results):
+                flow_pre[oi][gid] = r
+        if param_rows:
+            try:
+                presults = service.request_param_tokens_batch(param_rows)
+            except Exception:
+                presults = [None] * len(param_rows)
+            for (oi, fid), r in zip(param_refs, presults):
+                param_pre[oi][fid] = r
+        for oi, (op, gids) in enumerate(pending):
+            if gids and any(gid in gids for gid, _ in op.slots):
+                self._apply_cluster_checks(
+                    op, gids, service=service,
+                    prefetched=flow_pre[oi], wait=wait,
+                )
+            if op.p_slots and any(
+                s.rule is not None and s.rule.cluster_mode for s in op.p_slots
+            ):
+                self._apply_cluster_param_checks(
+                    op, service=service, prefetched=param_pre[oi]
+                )
+        self._settle_cluster_wait(wait)
+
+    def _apply_cluster_param_checks(
+        self,
+        op: _EntryOp,
+        service=_SENTINEL,
+        prefetched: Optional[Dict[int, object]] = None,
+    ) -> None:
         """Cluster-mode hot-param admission (ParamFlowChecker.passCheck
         cluster branch, ParamFlowChecker.java:46-80): QPS-grade rules
         with ``cluster_mode`` consult the token server per entry with
@@ -1534,29 +1698,15 @@ class Engine:
         ClusterParamFlowChecker.java:40-100); THREAD-grade stays local
         like the reference. OK → drop the local slots (token granted),
         BLOCKED → block the op, FAIL/no-service → fallback to local
-        checking when the rule allows it, else pass."""
+        checking when the rule allows it, else pass. ``prefetched``
+        (the bulk seam) maps flow_id → TokenResult from a batched RPC."""
         from sentinel_tpu.models import constants as _C
 
-        def _is_cluster(s) -> bool:
-            r = s.rule
-            return (
-                isinstance(r, ParamFlowRule)
-                and r.cluster_mode
-                and r.grade == C.FLOW_GRADE_QPS
-                and r.cluster_config is not None
-                and r.cluster_config.flow_id is not None
-            )
-
-        groups: Dict[int, Tuple[object, List[str]]] = {}
-        for s in op.p_slots:
-            if _is_cluster(s):
-                fid = int(s.rule.cluster_config.flow_id)
-                if fid not in groups:
-                    groups[fid] = (s.rule, [])
-                groups[fid][1].append(s.value_key)
+        groups = self._cluster_param_groups(op)
         if not groups:
             return
-        service = self._cluster_token_service()
+        if service is _SENTINEL:
+            service = self._cluster_token_service()
         decided = set()
         fallback_fids = set()
         for fid, (rule, values) in groups.items():
@@ -1567,10 +1717,13 @@ class Engine:
                 else:
                     decided.add(fid)
                 continue
-            try:
-                result = service.request_param_token(fid, op.acquire, values)
-            except Exception:
-                result = None
+            if prefetched is not None and fid in prefetched:
+                result = prefetched[fid]
+            else:
+                try:
+                    result = service.request_param_token(fid, op.acquire, values)
+                except Exception:
+                    result = None
             status = result.status if result is not None else _C.TokenResultStatus.FAIL
             if status == _C.TokenResultStatus.OK:
                 decided.add(fid)
@@ -1586,7 +1739,7 @@ class Engine:
         op.p_slots = [
             s
             for s in op.p_slots
-            if not _is_cluster(s)
+            if not _is_cluster_param_slot(s)
             or int(s.rule.cluster_config.flow_id) in fallback_fids
         ]
         op.token_decided_flow_ids = op.token_decided_flow_ids | frozenset(decided)
@@ -1753,8 +1906,9 @@ class Engine:
 
         Not supported on this path (use :meth:`submit_entry` /
         :meth:`submit_many`): prioritized (occupy) entries, THREAD-grade
-        param rules, and cluster-mode rules (those need a token-service
-        RPC per entry — raises ``ValueError``).
+        param rules, and cluster-mode rules (those need per-entry token
+        verdicts — raises ``ValueError``; submit_many resolves a whole
+        batch's verdicts with one batched token RPC).
         Returns None for pass-through (over the resource cap or the
         global switch off), like :meth:`submit_entry`.
         """
@@ -1823,8 +1977,9 @@ class Engine:
                 gid in findex.cluster_gids for gid, _ in slots
             ):
                 raise ValueError(
-                    "submit_bulk: resource has cluster-mode flow rules (the "
-                    "token-service RPC is per entry) — use submit_many"
+                    "submit_bulk: resource has cluster-mode flow rules "
+                    "(token verdicts are per entry) — use submit_many, "
+                    "which resolves them with one batched token RPC"
                 )
             auth_ok = True
             arule = self.authority_rules.get(resource)

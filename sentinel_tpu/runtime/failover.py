@@ -171,6 +171,10 @@ class Checkpoint:
     dindex_ref: object
     pindex_ref: object
     states: Optional[tuple] = None  # filled at fetch time
+    # ParamIndex.values_snapshot() captured when the states materialize
+    # (durable spills only): the value→row maps that give the spilled
+    # param_dyn rows their meaning in a fresh process.
+    param_values: Optional[dict] = None
 
 
 class _TokenBucket:
@@ -1720,6 +1724,14 @@ class FailoverManager:
                 self._ckpt = meta
             self.counters["checkpoints"] += 1
         if self.durable_path:
+            # Capture the value→row interning maps NOW (not at spill
+            # time): the writer thread runs later, and by then the live
+            # index may have LRU-recycled rows the fetched param_dyn
+            # still describes. Second-scale bucket drift between fetch
+            # and this capture matches the in-memory restore's stance.
+            pindex = meta.pindex_ref()
+            if pindex is not None and meta.states[3] is not None:
+                meta.param_values = pindex.values_snapshot()
             self._durable_schedule(meta)
 
     # ------------------------------------------------------------------
@@ -1804,15 +1816,24 @@ class FailoverManager:
         put("flow", states[1], findex is not None)
         put("degrade", states[2], dindex is not None)
         # param_dyn rows name dynamically-interned (rule, value) pairs
-        # whose assignment order cannot be reproduced in a fresh
-        # process — per-value buckets restart cold (their windows are
-        # second-scale; documented in ARCHITECTURE.md).
-        put("param", None, False)
+        # whose assignment order cannot be reproduced by replaying
+        # traffic in a fresh process — so the checkpoint carries the
+        # value→row maps themselves (Checkpoint.param_values, captured
+        # when the states materialized); the loader re-installs them
+        # into the fresh ParamIndex before trusting the rows.
+        pindex = meta.pindex_ref()
+        put(
+            "param",
+            states[3],
+            pindex is not None and meta.param_values is not None,
+        )
         put("sketch", states[4], states[4] is not None)
         if findex is not None:
             fps["flow"] = durable.rules_fingerprint(findex.rules)
         if dindex is not None:
             fps["degrade"] = durable.rules_fingerprint(dindex.rules)
+        if comps.get("param"):
+            fps["param"] = durable.rules_fingerprint(pindex.rules)
         cur = _ncfg.SECOND_CFG
         header = {
             "seq": meta.seq,
@@ -1831,6 +1852,9 @@ class FailoverManager:
             # states still maps every row the states contain.
             "node_keys": eng.nodes.keys_snapshot(),
         }
+        if comps.get("param"):
+            header["param_values"] = meta.param_values
+            header["param_rows"] = int(np.shape(states[3].tokens)[0])
         return durable.write_checkpoint(
             self.durable_path, header, comp_leaves
         )
@@ -1986,6 +2010,33 @@ class FailoverManager:
             degrade_tree = rebuild(
                 "degrade", jax.device_get(dindex.make_dyn_state())
             )
+        # Param: restorable only when the compiled rules match AND the
+        # fresh index accepts the spilled value→row maps (it must still
+        # be value-free — adopted rows would otherwise collide with
+        # live interning). Any refusal restores param cold, exactly the
+        # pre-snapshot behavior.
+        pindex = eng.param_index
+        param_tree = None
+        pvals = header.get("param_values")
+        prows = int(header.get("param_rows", 0))
+        if (
+            split["param"]
+            and pvals
+            and prows > 0
+            and fps.get("param") == durable.rules_fingerprint(pindex.rules)
+        ):
+            from sentinel_tpu.rules.param_table import make_param_state
+
+            candidate = rebuild(
+                "param", jax.device_get(make_param_state(prows))
+            )
+            if candidate is not None and pindex.adopt_values(pvals):
+                # THREAD gauges zero for the same reason the stats
+                # threads do (see restore_durable docstring): the live
+                # set is rebuilt from worker ledger re-assertions.
+                param_tree = candidate._replace(
+                    threads=np.zeros_like(np.asarray(candidate.threads))
+                )
         sketch_tree = None
         tier = eng.sketch
         if split["sketch"] and tier.armed:
@@ -2009,7 +2060,7 @@ class FailoverManager:
                      else ("durable-win-mismatch",)),
             findex_ref=ref_or_dead(findex, flow_tree is not None),
             dindex_ref=ref_or_dead(dindex, degrade_tree is not None),
-            pindex_ref=_dead_ref(),  # per-value rows never survive
+            pindex_ref=ref_or_dead(pindex, param_tree is not None),
             states=(
                 stats_tree
                 if stats_tree is not None
@@ -2020,7 +2071,7 @@ class FailoverManager:
                 degrade_tree
                 if degrade_tree is not None
                 else jax.device_get(dindex.make_dyn_state()),
-                None,
+                param_tree,
                 sketch_tree,
             ),
         )

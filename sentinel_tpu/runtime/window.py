@@ -407,15 +407,23 @@ class BatchWindow:
         except ValueError:
             # Cluster-mode rules / THREAD-grade param rules / collection
             # values: per-request semantics are load-bearing there
-            # (token RPCs, per-entry expansion) — ride the same flush as
-            # individual ops instead.
-            ops = [
-                eng.submit_entry(
-                    r.resource, r.context_name, r.origin, r.acquire,
-                    r.entry_type, ts=r.ts, args=r.args,
-                )
+            # (per-entry expansion, held concurrency tokens) — ride the
+            # same flush as individual ops instead. submit_many (not a
+            # submit_entry loop) so a QPS-grade cluster group resolves
+            # its token verdicts with ONE batched RPC per window
+            # instead of one round trip per request.
+            ops = eng.submit_many([
+                {
+                    "resource": r.resource,
+                    "context_name": r.context_name,
+                    "origin": r.origin,
+                    "acquire": r.acquire,
+                    "entry_type": r.entry_type,
+                    "ts": r.ts,
+                    "args": r.args,
+                }
                 for r in grp
-            ]
+            ])
             return ops, False
         if op is not None:
             # Per-request trace identity: the group-level tag submit_bulk

@@ -525,6 +525,34 @@ def ipc_handler(req: CommandRequest) -> CommandResponse:
 
 
 @command_mapping(
+    "cluster",
+    "batched cluster token plane: client counters, RPC latency,"
+    " live leases, window config",
+)
+def cluster_handler(req: CommandRequest) -> CommandResponse:
+    """The cluster token path view (cluster/client.py): how many token
+    decisions the client served and by which stance (batched frame,
+    local lease, FAIL fallback), the RPC round-trip summary, and —
+    when a client is live — its connection, intern table, lease table
+    and micro-window configuration. Counters are process-wide (the
+    ``client_stats`` singleton) so the command answers even before a
+    cluster rule ever attached a client."""
+    from sentinel_tpu.cluster.client import client_stats
+    from sentinel_tpu.cluster.state import (
+        ClusterStateManager,
+        TokenClientProvider,
+    )
+
+    engine = _engine()
+    out = {"mode": ClusterStateManager.get_mode(), "stats": client_stats.snapshot()}
+    client = TokenClientProvider.get_client()
+    if client is not None and hasattr(client, "plane_snapshot"):
+        out["client"] = client.plane_snapshot()
+    out["flush_seq"] = engine.flush_seq
+    return CommandResponse.of_json(out)
+
+
+@command_mapping(
     "traces",
     "sampled admission trace records: [?n=N][&resource=][&reason=code|name]",
 )

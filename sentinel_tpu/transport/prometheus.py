@@ -531,6 +531,52 @@ def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
         "(-1 = never written)",
         (round(last[2], 3) if last else -1),
     )
+    # Batched cluster token plane (cluster/client.py): the process-wide
+    # client stats singleton — deliberately NOT per-engine, because an
+    # engine has no cluster client attached until a cluster rule
+    # arrives but the families must exist from the first scrape.
+    from sentinel_tpu.cluster.client import client_stats
+
+    ccs = client_stats.snapshot()
+    out += ctr(
+        f"{_PREFIX}_cluster_requests_total",
+        "Token decisions asked of the cluster client (all paths)",
+        ccs["requests"],
+    )
+    out += ctr(
+        f"{_PREFIX}_cluster_batch_frames_total",
+        "Batched token frames sent (FLOW/PARAM_FLOW_REQUEST_BATCH)",
+        ccs["batch_frames"],
+    )
+    out += ctr(
+        f"{_PREFIX}_cluster_leases_granted_total",
+        "Local quota leases received from the token server",
+        ccs["leases_granted"],
+    )
+    out += ctr(
+        f"{_PREFIX}_cluster_lease_admits_total",
+        "Admissions served from a local lease (zero RPCs)",
+        ccs["lease_admits"],
+    )
+    out += ctr(
+        f"{_PREFIX}_cluster_fallbacks_total",
+        "FAIL-family serves (send/timeout/short frame) — caller falls "
+        "back to the local decision",
+        ccs["fallbacks"],
+    )
+    out += client_stats.rpc_ms.prometheus_lines(
+        f"{_PREFIX}_cluster_rpc_ms",
+        "Cluster token RPC round-trip (frame send to verdict), ms",
+    )
+    # Bounded SHOULD_WAIT pacing actually slept by the engine
+    # (sentinel.tpu.cluster.wait.cap.ms caps each op batch).
+    out += ctr(
+        f"{p}_cluster_wait_ms_total",
+        "Milliseconds slept honoring cluster SHOULD_WAIT verdicts "
+        "(capped per op batch by sentinel.tpu.cluster.wait.cap.ms)",
+        c.get("cluster_wait_ms", 0),
+    )
+
     # Param admission path selection (Engine._encode_param): batches
     # routed to the closed-form rank path vs the rounds/scan family —
     # the pick the self-tuning cost memo arbitrates when enabled.

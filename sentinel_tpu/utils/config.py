@@ -383,6 +383,35 @@ class SentinelConfig:
     # the __other__ row (the export is additionally bounded by the
     # blocked top-K sketch + configured resources).
     RESOURCE_METRICS_CAP = "sentinel.tpu.metrics.resource.capacity"
+    # Batched cluster token plane (cluster/{protocol,client,server}.py).
+    # window.ms > 0 turns on the client-side micro-window: concurrent
+    # per-op token requests coalesce under the client lock into one
+    # FLOW_REQUEST_BATCH frame (flushed after window.ms or at
+    # window.max rows, whichever first), xid-multiplexed on the reader
+    # so windows pipeline without waiting for earlier responses.
+    # window.ms 0 (the default) keeps per-call framing exactly.
+    CLUSTER_CLIENT_WINDOW_MS = "sentinel.tpu.cluster.client.window.ms"
+    CLUSTER_CLIENT_WINDOW_MAX = "sentinel.tpu.cluster.client.window.max"
+    # Local quota leases: with lease.enabled the server may attach a
+    # lease (N tokens, valid lease.ttl.ms from receipt) to a batch
+    # response for a flow that was hot in that frame (≥ lease.min.batch
+    # admitted rows); the grant is lease.frac of the flow's remaining
+    # headroom capped at lease.max tokens, debited from the server
+    # window UP FRONT (never over-admits globally; unused remainder is
+    # forfeited, not credited back). The client then admits that flow
+    # locally with zero RPCs until the lease drains or expires, and
+    # reports consumption on its next batch frame. Off (the default)
+    # grants nothing and the client stance is bit-identical to per-call.
+    CLUSTER_LEASE_ENABLED = "sentinel.tpu.cluster.lease.enabled"
+    CLUSTER_LEASE_MIN_BATCH = "sentinel.tpu.cluster.lease.min.batch"
+    CLUSTER_LEASE_FRAC = "sentinel.tpu.cluster.lease.frac"
+    CLUSTER_LEASE_MAX = "sentinel.tpu.cluster.lease.max"
+    CLUSTER_LEASE_TTL_MS = "sentinel.tpu.cluster.lease.ttl.ms"
+    # Cap on the TOTAL milliseconds one op batch may sleep honoring
+    # SHOULD_WAIT verdicts (prioritized occupy-style pacing); overflow
+    # is forfeited and the op proceeds. The pre-cap behavior slept
+    # per-op back-to-back, unbounded.
+    CLUSTER_WAIT_CAP_MS = "sentinel.tpu.cluster.wait.cap.ms"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -487,6 +516,14 @@ class SentinelConfig:
         SUPERVISE_BACKOFF_MS: "500",
         SUPERVISE_BACKOFF_MAX_MS: "10000",
         SUPERVISE_RESTARTS_MAX: "0",
+        CLUSTER_CLIENT_WINDOW_MS: "0",
+        CLUSTER_CLIENT_WINDOW_MAX: "128",
+        CLUSTER_LEASE_ENABLED: "false",
+        CLUSTER_LEASE_MIN_BATCH: "4",
+        CLUSTER_LEASE_FRAC: "0.5",
+        CLUSTER_LEASE_MAX: "256",
+        CLUSTER_LEASE_TTL_MS: "100",
+        CLUSTER_WAIT_CAP_MS: "1000",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

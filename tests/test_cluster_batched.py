@@ -1,0 +1,383 @@
+"""Batched cluster token plane (PR 16) — differential pins.
+
+The acceptance surface: verdicts produced through the batched frame
+(`FLOW_REQUEST_BATCH` / `PARAM_FLOW_REQUEST_BATCH`, the engine's bulk
+seam, the client micro-window) are BIT-IDENTICAL to the per-call
+oracle in the same request order; server death falls back to the local
+stance; THREAD-grade cluster gauges read exactly 0 after quiesce;
+the lease path admits the same totals as the no-lease path; and with
+every new config key at its default the wire behavior is exactly
+PR-15's (zero batch frames).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+import sentinel_tpu as st
+from sentinel_tpu.cluster import (
+    ClusterStateManager,
+    DefaultTokenService,
+    EmbeddedClusterTokenServerProvider,
+    TokenClientProvider,
+    cluster_flow_rule_manager,
+    cluster_server_config_manager,
+)
+from sentinel_tpu.cluster.client import ClusterTokenClient, client_stats
+from sentinel_tpu.cluster.server import SentinelTokenServer
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.models.rules import ClusterFlowConfig, FlowRule, ParamFlowRule
+from sentinel_tpu.runtime.engine import Engine
+from sentinel_tpu.utils.clock import ManualClock
+from sentinel_tpu.utils.config import SentinelConfig, config
+
+
+def cluster_rule(resource, count, flow_id, fallback=True):
+    return FlowRule(
+        resource,
+        count=count,
+        cluster_mode=True,
+        cluster_config=ClusterFlowConfig(
+            flow_id=flow_id,
+            threshold_type=C.FLOW_THRESHOLD_GLOBAL,
+            fallback_to_local_when_fail=fallback,
+        ),
+    )
+
+
+def concurrent_rule(resource, count, flow_id):
+    return FlowRule(
+        resource,
+        count=count,
+        grade=C.FLOW_GRADE_THREAD,
+        cluster_mode=True,
+        cluster_config=ClusterFlowConfig(
+            flow_id=flow_id,
+            threshold_type=C.FLOW_THRESHOLD_GLOBAL,
+            fallback_to_local_when_fail=False,
+        ),
+    )
+
+
+def cluster_param_rule(resource, count, flow_id, param_idx=0):
+    return ParamFlowRule(
+        resource,
+        count=count,
+        param_idx=param_idx,
+        cluster_mode=True,
+        cluster_config=ClusterFlowConfig(
+            flow_id=flow_id,
+            threshold_type=C.FLOW_THRESHOLD_GLOBAL,
+            fallback_to_local_when_fail=True,
+        ),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _config_sandbox():
+    with config._lock:
+        saved = dict(config._runtime)
+    yield
+    with config._lock:
+        config._runtime.clear()
+        config._runtime.update(saved)
+
+
+@pytest.fixture(autouse=True)
+def _stats_reset():
+    client_stats.reset()
+    yield
+    client_stats.reset()
+
+
+@pytest.fixture()
+def cluster_env():
+    cluster_flow_rule_manager.clear()
+    cluster_server_config_manager.load_global_flow_config(
+        exceed_count=1.0, max_allowed_qps=30000.0
+    )
+    yield
+    cluster_flow_rule_manager.clear()
+    ClusterStateManager.stop()
+    TokenClientProvider.clear()
+    EmbeddedClusterTokenServerProvider.clear()
+
+
+def _embedded_env(clock, rules):
+    """Fresh embedded token service + server registration — each call
+    resets the cluster windows, so a batched run and its per-call
+    oracle start from the identical world."""
+    svc = DefaultTokenService(clock=clock)
+    EmbeddedClusterTokenServerProvider.clear()
+    EmbeddedClusterTokenServerProvider.register(
+        SentinelTokenServer(port=0, service=svc)
+    )
+    ClusterStateManager.set_to_server()
+    cluster_flow_rule_manager.load_rules("default", rules)
+    return svc
+
+
+# ---------------------------------------------------------------------------
+# engine bulk seam vs per-call oracle
+# ---------------------------------------------------------------------------
+class TestEngineDifferential:
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_flow_batched_bit_identical_to_per_call(
+        self, cluster_env, manual_clock, depth
+    ):
+        """submit_many resolves cluster ops with ONE batched RPC; the
+        verdict sequence must equal per-op submit_entry against a fresh
+        identical token world — including interleaved non-cluster ops
+        (order through the deferred tail is load-bearing)."""
+        crule = cluster_rule("cr", 6, flow_id=901)
+        local = FlowRule("plain", count=4)
+        reqs = []
+        for i in range(16):
+            reqs.append({"resource": "cr" if i % 2 == 0 else "plain",
+                         "ts": 1000})
+
+        def run(batched: bool):
+            _embedded_env(manual_clock, [crule])
+            eng = Engine(clock=manual_clock)
+            eng.pipeline_depth = depth
+            eng.set_flow_rules([crule, local])
+            if batched:
+                ops = eng.submit_many([dict(r) for r in reqs])
+            else:
+                ops = [eng.submit_entry(**r) for r in reqs]
+            eng.flush()
+            eng.drain()
+            out = [bool(op.verdict.admitted) for op in ops]
+            eng.close()
+            return out
+
+        batched = run(True)
+        oracle = run(False)
+        assert batched == oracle
+        # Sanity: the cluster budget actually bound the run.
+        assert sum(batched[0::2]) == 6
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_param_batched_bit_identical_to_per_call(
+        self, cluster_env, manual_clock, depth
+    ):
+        """Cluster hot-param verdicts through the bulk seam's one
+        PARAM_FLOW batch equal the per-op oracle, per value."""
+        prule = cluster_param_rule("pp", 2, flow_id=902)
+        values = ["a", "b", "a", "c", "a", "b", "b", "c", "a", "c"]
+
+        def run(batched: bool):
+            _embedded_env(manual_clock, [prule])
+            eng = Engine(clock=manual_clock)
+            eng.pipeline_depth = depth
+            eng.set_param_rules({"pp": [prule]})
+            reqs = [{"resource": "pp", "ts": 1000, "args": (v,)}
+                    for v in values]
+            if batched:
+                ops = eng.submit_many(reqs)
+            else:
+                ops = [eng.submit_entry(**r) for r in reqs]
+            eng.flush()
+            eng.drain()
+            out = [bool(op.verdict.admitted) for op in ops]
+            eng.close()
+            return out
+
+        batched = run(True)
+        oracle = run(False)
+        assert batched == oracle
+        # Per-value budget of 2 actually enforced globally.
+        for v in "abc":
+            assert sum(
+                adm for adm, val in zip(batched, values) if val == v
+            ) == 2
+
+    def test_fallback_to_local_on_server_death(self, cluster_env, manual_clock):
+        """A dead token server turns every batched row into FAIL; with
+        fallback_to_local the LOCAL rule decides — and the client
+        counts the fallbacks honestly."""
+        rule = cluster_rule("fb", 1, flow_id=903, fallback=True)
+        cluster_flow_rule_manager.load_rules("default", [rule])
+        server = SentinelTokenServer(
+            port=0, service=DefaultTokenService(clock=manual_clock)
+        )
+        server.start()
+        client = ClusterTokenClient(
+            "127.0.0.1", server.port, request_timeout_sec=0.5
+        ).start()
+        TokenClientProvider.register(client)
+        ClusterStateManager.set_to_client()
+        server.stop()  # die before any token is asked
+
+        eng = Engine(clock=manual_clock)
+        eng.set_flow_rules([rule])
+        ops = eng.submit_many(
+            [{"resource": "fb", "ts": 1000} for _ in range(3)]
+        )
+        eng.flush()
+        eng.drain()
+        # Local count=1 applies: exactly one admit.
+        assert [bool(op.verdict.admitted) for op in ops].count(True) == 1
+        assert client_stats.snapshot()["fallbacks"] >= 3
+        eng.close()
+        client.stop()
+
+    def test_thread_gauges_zero_after_quiesce(self, cluster_env, manual_clock):
+        """THREAD-grade cluster rules keep the held-token per-op path
+        through submit_many; after every entry exits, the server-side
+        concurrency gauge and held-token cache read exactly 0."""
+        rule = concurrent_rule("cc", 8, flow_id=904)
+        svc = _embedded_env(manual_clock, [rule])
+        eng = Engine(clock=manual_clock)
+        eng.set_flow_rules([rule])
+        ops = eng.submit_many([{"resource": "cc"} for _ in range(5)])
+        eng.flush()
+        assert all(op.verdict.admitted for op in ops)
+        assert svc.concurrent.now_calls(904) == 5
+        for op in ops:
+            eng.submit_exit(op.rows, rt=3, resource="cc",
+                            cluster_tokens=op.cluster_tokens)
+        eng.flush()
+        assert svc.concurrent.now_calls(904) == 0
+        assert svc.concurrent.held_tokens() == 0
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# wire-level differential: batch frame, micro-window, leases, default-off
+# ---------------------------------------------------------------------------
+class TestWireDifferential:
+    def test_batch_frame_bit_identical_to_per_call(self, cluster_env):
+        """One FLOW_REQUEST_BATCH of N rows returns the same status
+        sequence as N per-call frames against a fresh identical
+        server."""
+        rows = [(905, 1, False)] * 9
+
+        def statuses(use_batch: bool):
+            cluster_flow_rule_manager.load_rules(
+                "default", [cluster_rule("r", 5, flow_id=905)]
+            )
+            server = SentinelTokenServer(
+                port=0, service=DefaultTokenService(clock=ManualClock(0))
+            )
+            server.start()
+            try:
+                client = ClusterTokenClient("127.0.0.1", server.port).start()
+                if use_batch:
+                    out = [r.status for r in client.request_tokens_batch(rows)]
+                else:
+                    out = [client.request_token(f, a, p).status
+                           for f, a, p in rows]
+                client.stop()
+                return out
+            finally:
+                server.stop()
+
+        assert statuses(True) == statuses(False)
+
+    def test_default_off_sends_zero_batch_frames(self, cluster_env):
+        """Every new key at its default (window.ms=0, leases off):
+        request_token takes the PR-15 per-call wire path — zero batch
+        frames, zero leases — and the verdicts match the oracle."""
+        cluster_flow_rule_manager.load_rules(
+            "default", [cluster_rule("r", 4, flow_id=906)]
+        )
+        server = SentinelTokenServer(
+            port=0, service=DefaultTokenService(clock=ManualClock(0))
+        )
+        server.start()
+        try:
+            client = ClusterTokenClient("127.0.0.1", server.port).start()
+            oks = [client.request_token(906).ok for _ in range(7)]
+            assert oks == [True] * 4 + [False] * 3
+            snap = client_stats.snapshot()
+            assert snap["batch_frames"] == 0
+            assert snap["leases_granted"] == 0
+            assert snap["lease_admits"] == 0
+            client.stop()
+        finally:
+            server.stop()
+
+    def test_micro_window_coalesces_and_preserves_totals(self, cluster_env):
+        """Concurrent request_token callers under the client window
+        coalesce into shared frames; the admitted TOTAL is exactly the
+        per-call budget (the intra-batch cumsum makes batched charging
+        equal serial charging)."""
+        config.set(SentinelConfig.CLUSTER_CLIENT_WINDOW_MS, "25")
+        cluster_flow_rule_manager.load_rules(
+            "default", [cluster_rule("r", 10, flow_id=907)]
+        )
+        server = SentinelTokenServer(
+            port=0, service=DefaultTokenService(clock=ManualClock(0))
+        )
+        server.start()
+        try:
+            client = ClusterTokenClient("127.0.0.1", server.port).start()
+            n = 16
+            barrier = threading.Barrier(n)
+            oks = []
+            lock = threading.Lock()
+
+            def worker():
+                barrier.wait()
+                r = client.request_token(907)
+                with lock:
+                    oks.append(r.ok)
+
+            threads = [threading.Thread(target=worker) for _ in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert sum(oks) == 10
+            snap = client_stats.snapshot()
+            # Coalescing happened: fewer frames than ops (the count is
+            # scheduler-dependent; the bench gates the ratio).
+            assert 1 <= snap["batch_frames"] < n
+            client.stop()
+        finally:
+            server.stop()
+
+    def test_lease_path_parity_with_no_lease(self, cluster_env):
+        """Leases never change WHAT is admitted in total, only how many
+        RPCs it costs: a hot flow driven to exhaustion admits exactly
+        the budget with leases on (some served with zero RPCs) and with
+        leases off."""
+        def drive(lease_on: bool) -> int:
+            cluster_flow_rule_manager.clear()
+            cluster_server_config_manager.load_global_flow_config(
+                exceed_count=1.0, max_allowed_qps=30000.0
+            )
+            cluster_flow_rule_manager.load_rules(
+                "default", [cluster_rule("r", 40, flow_id=908)]
+            )
+            config.set(
+                SentinelConfig.CLUSTER_LEASE_ENABLED,
+                "true" if lease_on else "false",
+            )
+            config.set(SentinelConfig.CLUSTER_LEASE_TTL_MS, "5000")
+            server = SentinelTokenServer(
+                port=0, service=DefaultTokenService(clock=ManualClock(0))
+            )
+            server.start()
+            try:
+                client = ClusterTokenClient("127.0.0.1", server.port).start()
+                admitted = 0
+                for _ in range(8):  # 8 batches of 8 = 64 asks > 40 budget
+                    for r in client.request_tokens_batch([(908, 1, False)] * 8):
+                        admitted += 1 if r.ok else 0
+                client.stop()
+                return admitted
+            finally:
+                server.stop()
+
+        with_lease = drive(True)
+        lease_admits = client_stats.snapshot()["lease_admits"]
+        client_stats.reset()
+        without_lease = drive(False)
+        assert with_lease == without_lease == 40
+        # The lease path actually served part of the hot flow RPC-free.
+        assert lease_admits > 0
+        assert client_stats.snapshot()["lease_admits"] == 0

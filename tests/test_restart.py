@@ -189,6 +189,70 @@ class TestDurableCheckpoint:
         assert all(op.verdict.admitted for op in ops_c)
         cold.close()
 
+    def test_warm_restore_param_value_rows_survive(
+        self, manual_clock, tmp_path
+    ):
+        """The PR-16 gap: param_dyn rows name dynamically-interned
+        (rule, value) pairs, so earlier checkpoints spilled param as
+        nothing and every hot-param window restarted cold. The spill
+        now carries the ParamIndex value→row maps; a fresh process that
+        adopts them sees the SAME value's window still consumed, while
+        a value the dead process never saw interns fresh and admits."""
+        path = str(tmp_path / "ck.bin")
+        manual_clock.set_ms(5000)
+        prules = {"p": [ParamFlowRule("p", param_idx=0, count=3)]}
+        a = _mk_engine(manual_clock, path, rules=[FlowRule("p", count=1000)])
+        a.set_param_rules(prules)
+        ops = [a.submit_entry("p", ts=5000, args=("hot",)) for _ in range(5)]
+        a.flush()
+        a.drain()
+        assert sum(1 for op in ops if op.verdict.admitted) == 3
+        _wait_durable_write(a)
+        a.close()
+
+        b = _mk_engine(manual_clock, path, rules=[FlowRule("p", count=1000)])
+        b.set_param_rules(prules)
+        assert b.failover.restore_durable() is True
+        assert b.failover.counters["durable_load_cold"] == 0
+        # Same value, same second: window already consumed — a cold
+        # engine would grant 3 more.
+        hot = [b.submit_entry("p", ts=5000, args=("hot",)) for _ in range(3)]
+        # A value the dead process never interned starts fresh.
+        cold = [b.submit_entry("p", ts=5000, args=("new",)) for _ in range(3)]
+        b.flush()
+        b.drain()
+        assert all(not op.verdict.admitted for op in hot), [
+            op.verdict for op in hot
+        ]
+        assert all(op.verdict.admitted for op in cold)
+        b.close()
+
+    def test_param_rule_change_restores_param_cold(
+        self, manual_clock, tmp_path
+    ):
+        """A different compiled param rule set fails the fingerprint:
+        param restores cold (admits again) but the rest of the
+        checkpoint still installs."""
+        path = str(tmp_path / "ck.bin")
+        manual_clock.set_ms(5000)
+        a = _mk_engine(manual_clock, path, rules=[FlowRule("p", count=1000)])
+        a.set_param_rules({"p": [ParamFlowRule("p", param_idx=0, count=3)]})
+        for _ in range(5):
+            a.submit_entry("p", ts=5000, args=("hot",))
+        a.flush()
+        a.drain()
+        _wait_durable_write(a)
+        a.close()
+
+        b = _mk_engine(manual_clock, path, rules=[FlowRule("p", count=1000)])
+        b.set_param_rules({"p": [ParamFlowRule("p", param_idx=0, count=4)]})
+        assert b.failover.restore_durable() is True
+        ops = [b.submit_entry("p", ts=5000, args=("hot",)) for _ in range(4)]
+        b.flush()
+        b.drain()
+        assert all(op.verdict.admitted for op in ops)
+        b.close()
+
     def test_corrupt_file_cold_start_counted(self, manual_clock, tmp_path):
         path = str(tmp_path / "ck.bin")
         with open(path, "wb") as f:

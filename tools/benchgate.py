@@ -147,6 +147,18 @@ STAGE_METRICS: Dict[str, Tuple[str, float]] = {
     "ipc_window_w4_ops_per_sec": ("higher", 0.60),
     "ipc_frames_per_entry_window": ("lower", 0.50),
     "ipc_window_amortization": ("higher", 0.30),
+    # Batched cluster token plane (PR 16, bench `cluster` stage).
+    # Frames-per-op and lease hit rate are protocol COUNTS (steadiest
+    # class in the file); amortization is a same-run ratio. The lease
+    # frames/op band is wide in relative terms because the absolute
+    # number is tiny (~0.004) and one extra renewal frame doubles it.
+    "cluster_percall_ops_per_sec": ("higher", 0.60),
+    "cluster_window_ops_per_sec": ("higher", 0.60),
+    "cluster_lease_ops_per_sec": ("higher", 0.60),
+    "cluster_frames_per_op_window": ("lower", 0.50),
+    "cluster_frames_per_op_lease": ("lower", 2.00),
+    "cluster_lease_hit_rate": ("higher", 0.30),
+    "cluster_window_amortization": ("higher", 0.30),
 }
 
 # Host-identity token (PR 14): device_kind + jax_version cannot tell
@@ -194,6 +206,13 @@ STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
       "ipc_percall_w4_ops_per_sec", "ipc_window_w1_ops_per_sec",
       "ipc_window_w2_ops_per_sec", "ipc_window_w4_ops_per_sec",
       "ipc_frames_per_entry_window", "ipc_window_amortization")),
+    # Batched cluster token plane (PR 16): keyed on its own op count
+    # so smoke runs and pre-PR-16 baselines never compare here.
+    (("cluster_n_ops",),
+     ("cluster_percall_ops_per_sec", "cluster_window_ops_per_sec",
+      "cluster_lease_ops_per_sec", "cluster_frames_per_op_window",
+      "cluster_frames_per_op_lease", "cluster_lease_hit_rate",
+      "cluster_window_amortization")),
 ]
 
 

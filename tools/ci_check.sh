@@ -50,8 +50,14 @@ if [ "${CI_CHECK_SKIP_BENCH:-0}" = "1" ]; then
     echo "== ci_check 3b: ipc stage smoke =="
     JAX_PLATFORMS=cpu python bench.py --run-stage --kind ipc \
         --rules 4 --entries 1024 --iters 1 --child-platform cpu >/dev/null
+    # The cluster stage smokes too: a real TCP token server against the
+    # batched client in all three stances (per-call, micro-window,
+    # window+leases) — the wire plane tier-1 only covers in-process.
+    echo "== ci_check 3c: cluster stage smoke =="
+    JAX_PLATFORMS=cpu python bench.py --run-stage --kind cluster \
+        --rules 1 --entries 1024 --iters 1 --child-platform cpu >/dev/null
 else
-    echo "== ci_check 3/3: bench gate (incl. ipc stage) =="
+    echo "== ci_check 3/3: bench gate (incl. ipc + cluster stages) =="
     JAX_PLATFORMS=cpu python bench.py --gate >/dev/null
 fi
 

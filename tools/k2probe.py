@@ -428,6 +428,88 @@ def main() -> None:
     except Exception as exc:
         print(f"[k2probe] ipc stage skipped: {exc}", file=sys.stderr)
 
+    # --- cluster token plane round trips (sentinel_tpu/cluster) --------
+    # One real TCP server on loopback: the three wire stances a token
+    # decision can take — per-call frame, 8-row batch frame (cost shown
+    # PER DECISION), and a local lease admit (zero frames). The spread
+    # between the three is the whole argument for the batched plane.
+    try:
+        from sentinel_tpu.cluster import (
+            cluster_flow_rule_manager as _cfrm,
+            cluster_server_config_manager as _cscm,
+        )
+        from sentinel_tpu.cluster.client import ClusterTokenClient
+        from sentinel_tpu.cluster.server import SentinelTokenServer
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+        from sentinel_tpu.models import constants as CC
+        from sentinel_tpu.models.rules import ClusterFlowConfig, FlowRule
+        from sentinel_tpu.utils.config import config as _ccfg
+
+        _cfrm.clear()
+        _cscm.load_global_flow_config(exceed_count=1.0, max_allowed_qps=1e12)
+        _cfrm.load_rules(
+            "default",
+            [FlowRule(
+                "k2c", count=1e9, cluster_mode=True,
+                cluster_config=ClusterFlowConfig(
+                    flow_id=77,
+                    threshold_type=CC.FLOW_THRESHOLD_GLOBAL,
+                ),
+            )],
+        )
+        csrv = SentinelTokenServer(port=0, service=DefaultTokenService())
+        csrv.start()
+        try:
+            ccli = ClusterTokenClient("127.0.0.1", csrv.port).start()
+            try:
+                for _ in range(32):  # warm the connection + service row
+                    ccli.request_token(77, 1)
+                n_rt = 256
+                lats = []
+                for _ in range(args.iters):
+                    for _ in range(n_rt):
+                        t0 = time.perf_counter()
+                        ccli.request_token(77, 1)
+                        lats.append(time.perf_counter() - t0)
+                lats.sort()
+                report("cluster_percall_p50", lats[len(lats) // 2])
+                report("cluster_percall_p99", lats[int(len(lats) * 0.99)])
+
+                rows8 = [(77, 1, 0)] * 8
+                lats = []
+                for _ in range(args.iters):
+                    for _ in range(n_rt // 8):
+                        t0 = time.perf_counter()
+                        ccli.request_tokens_batch(rows8)
+                        lats.append((time.perf_counter() - t0) / 8)
+                lats.sort()
+                report("cluster_batch8_per_decision_p50",
+                       lats[len(lats) // 2])
+
+                # Lease admit: plant a lease by hand (the client-side
+                # admit path is what's being timed, not the grant).
+                _ccfg.set(_ccfg.CLUSTER_LEASE_ENABLED, "true")
+                try:
+                    ccli._store_leases([(77, n_rt * args.iters + 64, 60_000)])
+                    lats = []
+                    for _ in range(args.iters):
+                        for _ in range(n_rt):
+                            t0 = time.perf_counter()
+                            ccli.request_token(77, 1)
+                            lats.append(time.perf_counter() - t0)
+                    lats.sort()
+                    report("cluster_lease_admit_p50",
+                           lats[len(lats) // 2])
+                finally:
+                    _ccfg.set(_ccfg.CLUSTER_LEASE_ENABLED, "false")
+            finally:
+                ccli.stop()
+        finally:
+            csrv.stop()
+            _cfrm.clear()
+    except Exception as exc:
+        print(f"[k2probe] cluster stage skipped: {exc}", file=sys.stderr)
+
     # --- sketch-tier fold in isolation (runtime/sketch.py) -------------
     # The count-min + candidate merge over a pow2 key batch, jitted
     # standalone at two widths — the marginal device cost one armed
