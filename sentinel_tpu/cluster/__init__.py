@@ -36,6 +36,11 @@ from sentinel_tpu.cluster.flow_rules import (
     cluster_flow_rule_manager,
     cluster_server_config_manager,
 )
+from sentinel_tpu.cluster.shards import (
+    ShardMap,
+    ShardedTokenClient,
+    shard_of,
+)
 
 __all__ = [
     "ClusterStateManager",
@@ -44,6 +49,9 @@ __all__ = [
     "TokenResult",
     "TokenService",
     "DefaultTokenService",
+    "ShardMap",
+    "ShardedTokenClient",
+    "shard_of",
     "cluster_flow_rule_manager",
     "cluster_server_config_manager",
 ]

@@ -33,7 +33,11 @@ class ClusterClientStats:
     client attached until a cluster rule arrives, but the metric
     families must exist from the first scrape."""
 
-    def __init__(self) -> None:
+    def __init__(self, parent: "ClusterClientStats" = None) -> None:
+        # Per-shard instances chain to the process-wide singleton: every
+        # event counts once globally (dashboards keep their totals) and
+        # once on the owning shard (the per-shard rows/fallback matrix).
+        self._parent = parent
         self._lock = threading.Lock()
         self.requests = 0  # token decisions asked of the client
         self.batch_frames = 0  # batched frames sent
@@ -45,6 +49,13 @@ class ClusterClientStats:
     def incr(self, field: str, n: int = 1) -> None:
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
+        if self._parent is not None:
+            self._parent.incr(field, n)
+
+    def record_rpc_ms(self, ms: float) -> None:
+        self.rpc_ms.record(ms)
+        if self._parent is not None:
+            self._parent.rpc_ms.record(ms)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -79,9 +90,14 @@ class ClusterTokenClient(TokenService):
         request_timeout_sec: float = 2.0,
         reconnect_interval_sec: float = 2.0,
         namespace: str = "default",
+        stats: "ClusterClientStats" = None,
     ) -> None:
         self.host = host
         self.port = port
+        # Counter sink: the process-wide singleton by default; a
+        # sharded plane hands each shard client its own (parent-chained)
+        # instance so per-shard rows stay attributable.
+        self.stats = stats if stats is not None else client_stats
         # Announced to the server in the connect-time ping; the server
         # groups connections per namespace for AVG_LOCAL thresholds
         # (ClusterClientConfigManager's namespace registration +
@@ -191,9 +207,12 @@ class ClusterTokenClient(TokenService):
             # The server's vid reverse-table died with the connection.
             self._interned.clear()
             self._next_vid = 1
-        # Server death voids local quota: fall back to the per-call
-        # stance immediately, never admit on a lease the server can no
-        # longer account for.
+        # Server death voids local quota — but ONLY this connection's:
+        # leases live per client object, one connection each, so a
+        # shard bounce clears exactly the dead shard's leases and
+        # unreported consumption (the sharded plane relies on this
+        # scoping; test_cluster_sharded pins it). Never admit on a
+        # lease the server can no longer account for.
         with self._lease_lock:
             self._leases.clear()
             self._lease_reports.clear()
@@ -275,17 +294,17 @@ class ClusterTokenClient(TokenService):
                 self._pending.pop(xid, None)
             self._close()
             self._maybe_reconnect()
-            client_stats.incr("fallbacks")
+            self.stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
         result = pending.wait(self.timeout)
         if result is None:
             with self._pending_lock:
                 self._pending.pop(xid, None)
-            client_stats.incr("fallbacks")
+            self.stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
-        client_stats.rpc_ms.record((time.monotonic() - t0) * 1e3)
+        self.stats.record_rpc_ms((time.monotonic() - t0) * 1e3)
         if result.status == C.TokenResultStatus.FAIL:
-            client_stats.incr("fallbacks")
+            self.stats.incr("fallbacks")
         return result
 
     # ------------------------------------------------------------------
@@ -296,7 +315,7 @@ class ClusterTokenClient(TokenService):
             for flow_id, tokens, valid_ms in leases:
                 if tokens <= 0 or valid_ms <= 0:
                     continue
-                client_stats.incr("leases_granted")
+                self.stats.incr("leases_granted")
                 self._leases[flow_id] = [tokens, now + valid_ms / 1000.0]
 
     def _lease_admit(self, flow_id: int, acquire: int) -> bool:
@@ -322,7 +341,7 @@ class ClusterTokenClient(TokenService):
             self._lease_reports[flow_id] = (
                 self._lease_reports.get(flow_id, 0) + acquire
             )
-        client_stats.incr("lease_admits")
+        self.stats.incr("lease_admits")
         return True
 
     def _drain_lease_reports(self) -> list:
@@ -371,7 +390,7 @@ class ClusterTokenClient(TokenService):
     def _rpc_flow_batch(self, rows) -> List[TokenResult]:
         """One FLOW_REQUEST_BATCH round trip for N rows."""
         if self._sock is None and not self._maybe_reconnect():
-            client_stats.incr("fallbacks", len(rows))
+            self.stats.incr("fallbacks", len(rows))
             return [TokenResult(C.TokenResultStatus.FAIL)] * len(rows)
         waiters = [_Pending() for _ in rows]
         xid = next(self._xid)
@@ -383,7 +402,7 @@ class ClusterTokenClient(TokenService):
         return self._await_waiters(waiters)
 
     def _send_batch_frame(self, frame: bytes, xid: int, waiters) -> bool:
-        pending = _BatchPending(waiters)
+        pending = _BatchPending(waiters, self.stats)
         with self._pending_lock:
             self._pending[xid] = pending
         try:
@@ -394,11 +413,11 @@ class ClusterTokenClient(TokenService):
         except OSError:
             with self._pending_lock:
                 self._pending.pop(xid, None)
-            client_stats.incr("fallbacks", len(waiters))
+            self.stats.incr("fallbacks", len(waiters))
             self._close()
             self._maybe_reconnect()
             return False
-        client_stats.incr("batch_frames")
+        self.stats.incr("batch_frames")
         return True
 
     def _await_waiters(self, waiters) -> List[TokenResult]:
@@ -407,7 +426,7 @@ class ClusterTokenClient(TokenService):
         for w in waiters:
             r = w.wait(max(0.0, deadline - time.monotonic()))
             if r is None:
-                client_stats.incr("fallbacks")
+                self.stats.incr("fallbacks")
                 r = TokenResult(C.TokenResultStatus.FAIL)
             out.append(r)
         return out
@@ -419,7 +438,7 @@ class ClusterTokenClient(TokenService):
         never cross the wire)."""
         if not rows:
             return []
-        client_stats.incr("requests", len(rows))
+        self.stats.incr("requests", len(rows))
         out: List[Optional[TokenResult]] = [None] * len(rows)
         rpc_rows = []
         rpc_idx = []
@@ -441,13 +460,13 @@ class ClusterTokenClient(TokenService):
         earlier-ordered frame has not announced."""
         if not rows:
             return []
-        client_stats.incr("requests", len(rows))
+        self.stats.incr("requests", len(rows))
         if self._sock is None and not self._maybe_reconnect():
-            client_stats.incr("fallbacks", len(rows))
+            self.stats.incr("fallbacks", len(rows))
             return [TokenResult(C.TokenResultStatus.FAIL)] * len(rows)
         waiters = [_Pending() for _ in rows]
         xid = next(self._xid)
-        pending = _BatchPending(waiters)
+        pending = _BatchPending(waiters, self.stats)
         with self._pending_lock:
             self._pending[xid] = pending
         try:
@@ -474,11 +493,11 @@ class ClusterTokenClient(TokenService):
         except OSError:
             with self._pending_lock:
                 self._pending.pop(xid, None)
-            client_stats.incr("fallbacks", len(rows))
+            self.stats.incr("fallbacks", len(rows))
             self._close()
             self._maybe_reconnect()
             return [TokenResult(C.TokenResultStatus.FAIL)] * len(rows)
-        client_stats.incr("batch_frames")
+        self.stats.incr("batch_frames")
         return self._await_waiters(waiters)
 
     # ------------------------------------------------------------------
@@ -510,7 +529,7 @@ class ClusterTokenClient(TokenService):
             self._flush_window(batch)
         result = waiter.wait(self.timeout + win_ms / 1000.0)
         if result is None:
-            client_stats.incr("fallbacks")
+            self.stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
         return result
 
@@ -518,7 +537,7 @@ class ClusterTokenClient(TokenService):
         if not batch:
             return
         if self._sock is None and not self._maybe_reconnect():
-            client_stats.incr("fallbacks", len(batch))
+            self.stats.incr("fallbacks", len(batch))
             for _f, _a, _p, w in batch:
                 w.set(TokenResult(C.TokenResultStatus.FAIL))
             return
@@ -538,7 +557,7 @@ class ClusterTokenClient(TokenService):
     def request_token(
         self, flow_id: int, acquire_count: int = 1, prioritized: bool = False
     ) -> TokenResult:
-        client_stats.incr("requests")
+        self.stats.incr("requests")
         if self._lease_admit(flow_id, acquire_count):
             return TokenResult(C.TokenResultStatus.OK)
         win_ms = config.get_int(SentinelConfig.CLUSTER_CLIENT_WINDOW_MS, 0)
@@ -547,7 +566,7 @@ class ClusterTokenClient(TokenService):
                 flow_id, acquire_count, prioritized, win_ms
             )
         if self._sock is None and not self._maybe_reconnect():
-            client_stats.incr("fallbacks")
+            self.stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
         xid = next(self._xid)
         return self._send_request(
@@ -557,9 +576,9 @@ class ClusterTokenClient(TokenService):
     def request_param_token(
         self, flow_id: int, acquire_count: int, params: List[object]
     ) -> TokenResult:
-        client_stats.incr("requests")
+        self.stats.incr("requests")
         if self._sock is None and not self._maybe_reconnect():
-            client_stats.incr("fallbacks")
+            self.stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
         xid = next(self._xid)
         return self._send_request(
@@ -573,9 +592,9 @@ class ClusterTokenClient(TokenService):
         """requestConcurrentToken over the wire; the server derives the
         client address from the connection (the argument is unused here,
         kept for TokenService interface parity)."""
-        client_stats.incr("requests")
+        self.stats.incr("requests")
         if self._sock is None and not self._maybe_reconnect():
-            client_stats.incr("fallbacks")
+            self.stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
         xid = next(self._xid)
         return self._send_request(
@@ -611,18 +630,19 @@ class _BatchPending:
     out to the per-row waiters. Duck-types _Pending.set so _close's
     fail-all sweep needs no special case."""
 
-    __slots__ = ("waiters", "_t0")
+    __slots__ = ("waiters", "_t0", "_stats")
 
-    def __init__(self, waiters) -> None:
+    def __init__(self, waiters, stats: "ClusterClientStats" = None) -> None:
         self.waiters = waiters
         self._t0 = time.monotonic()
+        self._stats = stats if stats is not None else client_stats
 
     def set(self, result: TokenResult) -> None:
         for w in self.waiters:
             w.set(result)
 
     def set_batch(self, rows) -> None:
-        client_stats.rpc_ms.record((time.monotonic() - self._t0) * 1e3)
+        self._stats.record_rpc_ms((time.monotonic() - self._t0) * 1e3)
         if len(rows) != len(self.waiters):
             # Version-rejected (empty) or malformed response: fail every
             # waiter — callers map FAIL-family to fallback-to-local.

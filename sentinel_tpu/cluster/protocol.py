@@ -42,6 +42,7 @@ new msg type; unknown versions are answered BAD_REQUEST, never parsed.
 from __future__ import annotations
 
 import struct
+import zlib
 from typing import List, Optional, Tuple
 
 from sentinel_tpu.models import constants as C
@@ -179,6 +180,103 @@ def pack_batch_response(
         parts.append(_LEASE_ROW.pack(flow_id, tokens, valid_ms))
     payload = b"".join(parts)
     return _LEN.pack(len(payload)) + payload
+
+
+# Sketch gossip frames (this framework's own). SKETCH_PUSH carries one
+# engine's LOCAL sketch view; the SKETCH_MERGED answer carries the
+# responder's LOCAL view back (never its merged view — a merged echo
+# would double-count third parties on the next round). One round trip
+# therefore exchanges both directions. Body:
+#
+#   [u32 xid][u8 type][u8 ver]
+#   [u16 origin_len][origin bytes]          # stable engine identity
+#   [i64 window_id][u8 depth][u32 width]
+#   [u32 comp_len][zlib bytes]              # int32 LE [depth × width] CM
+#   [u16 n_cands] n × (u16 key_len, key bytes, i64 count)
+#
+# The version byte rides the same policy as the batch frames: an
+# unsupported version is answered with an EMPTY merged frame (0 depth/
+# width, 0 candidates), never parsed.
+GOSSIP_VERSION = 1
+_GOSSIP_HDR = struct.Struct("<qBI")  # window_id, depth, width
+_U32 = struct.Struct("<I")
+_I64 = struct.Struct("<q")
+
+
+def pack_sketch_frame(
+    xid: int,
+    msg_type: int,
+    origin: str,
+    window_id: int,
+    depth: int,
+    width: int,
+    cm_bytes: bytes,
+    cands: List[Tuple[str, int]] = (),
+) -> bytes:
+    """``cm_bytes``: raw little-endian int32 [depth × width] array (the
+    packer compresses); an empty array (depth=0) is the version-reject /
+    nothing-to-share shape."""
+    raw_origin = origin.encode("utf-8")[:65535]
+    comp = zlib.compress(cm_bytes, 1) if cm_bytes else b""
+    parts = [
+        _REQ_HDR.pack(xid, msg_type),
+        struct.pack("<B", GOSSIP_VERSION),
+        _U16.pack(len(raw_origin)),
+        raw_origin,
+        _GOSSIP_HDR.pack(window_id, depth, width),
+        _U32.pack(len(comp)),
+        comp,
+        _U16.pack(len(cands)),
+    ]
+    for key, count in cands:
+        raw = key.encode("utf-8", "surrogatepass")[:65535]
+        parts.append(_U16.pack(len(raw)))
+        parts.append(raw)
+        parts.append(_I64.pack(count))
+    payload = b"".join(parts)
+    return _LEN.pack(len(payload)) + payload
+
+
+def unpack_sketch_frame(payload: bytes) -> tuple:
+    """-> (xid, msg_type, origin, window_id, depth, width, cm_bytes,
+    [(key, count)]). Raises UnsupportedBatchVersion on a foreign
+    version byte (the caller answers an empty merged frame)."""
+    xid, msg_type = _REQ_HDR.unpack_from(payload, 0)
+    off = _REQ_HDR.size
+    (ver,) = struct.unpack_from("<B", payload, off)
+    off += 1
+    if ver != GOSSIP_VERSION:
+        raise UnsupportedBatchVersion(xid, msg_type, ver)
+    (olen,) = _U16.unpack_from(payload, off)
+    off += 2
+    origin = payload[off : off + olen].decode("utf-8")
+    off += olen
+    window_id, depth, width = _GOSSIP_HDR.unpack_from(payload, off)
+    off += _GOSSIP_HDR.size
+    (clen,) = _U32.unpack_from(payload, off)
+    off += 4
+    if off + clen > len(payload):
+        raise ValueError("truncated gossip sketch body")
+    cm_bytes = zlib.decompress(payload[off : off + clen]) if clen else b""
+    if len(cm_bytes) != depth * width * 4:
+        raise ValueError("gossip sketch size mismatch")
+    off += clen
+    (n_cands,) = _U16.unpack_from(payload, off)
+    off += 2
+    cands = []
+    for _ in range(n_cands):
+        (klen,) = _U16.unpack_from(payload, off)
+        off += 2
+        if off + klen + 8 > len(payload):
+            raise ValueError("truncated gossip candidate")
+        key = payload[off : off + klen].decode("utf-8", "surrogatepass")
+        off += klen
+        (count,) = _I64.unpack_from(payload, off)
+        off += 8
+        cands.append((key, count))
+    if off != len(payload):
+        raise ValueError("trailing bytes after gossip frame")
+    return xid, msg_type, origin, window_id, depth, width, cm_bytes, cands
 
 
 def peek_msg_type(payload: bytes) -> int:

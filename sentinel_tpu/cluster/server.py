@@ -15,6 +15,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Optional
 
 from sentinel_tpu.cluster import protocol
@@ -71,6 +72,8 @@ class _Handler(socketserver.BaseRequestHandler):
                     # Truncated/garbage body: not recoverable mid-stream.
                     record_log.warn("[TokenServer] bad frame dropped")
                     return
+                t_work = time.perf_counter()
+                n_decisions = 1
                 if msg_type == C.MSG_TYPE_PING:
                     # Ping = namespace announcement: bind this
                     # connection to the client's namespace and answer
@@ -97,6 +100,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     )
                 elif msg_type == C.MSG_TYPE_FLOW_BATCH:
                     rows, reports = body
+                    n_decisions = len(rows)
                     results = server.service.request_tokens(rows)
                     resp_rows = [
                         (int(r.status), r.remaining, r.wait_in_ms)
@@ -110,6 +114,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     )
                 elif msg_type == C.MSG_TYPE_PARAM_FLOW_BATCH:
                     new_interns, rows = body
+                    n_decisions = len(rows)
                     for vid, value in new_interns:
                         interned[vid] = value
                     resp_rows = []
@@ -148,6 +153,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     resp = protocol.pack_response(
                         xid, msg_type, int(C.TokenResultStatus.BAD_REQUEST)
                     )
+                server._note_work(n_decisions, time.perf_counter() - t_work)
                 self.request.sendall(resp)
         except (ConnectionError, OSError):
             pass
@@ -204,6 +210,36 @@ class SentinelTokenServer:
         self._stopping = False
         self._epoch = 0
         self._accept_epochs: dict = {}  # id(sock) -> accept-time epoch
+        # Per-server work accounting for the shard-capacity bench: how
+        # many token decisions this server made and the handler seconds
+        # spent making them (decode→dispatch→pack, excluding socket
+        # waits). decisions/busy_s is the per-shard decision rate a
+        # dedicated core could sustain — the honest aggregate-capacity
+        # column on a box where shard threads timeshare one core.
+        self._work_lock = threading.Lock()
+        self.decisions = 0
+        self.frames = 0
+        self.busy_s = 0.0
+
+    def _note_work(self, n_decisions: int, dt_s: float) -> None:
+        with self._work_lock:
+            self.frames += 1
+            self.decisions += n_decisions
+            self.busy_s += dt_s
+
+    def work_stats(self) -> dict:
+        with self._work_lock:
+            return {
+                "frames": self.frames,
+                "decisions": self.decisions,
+                "busy_s": self.busy_s,
+            }
+
+    def reset_work_stats(self) -> None:
+        with self._work_lock:
+            self.frames = 0
+            self.decisions = 0
+            self.busy_s = 0.0
 
     def _stamp_accept(self, sock) -> None:
         with self._lock:

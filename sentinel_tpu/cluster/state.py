@@ -131,16 +131,28 @@ class ClusterClientConfigManager:
 
     @classmethod
     def build_client(cls):
-        """Construct a ClusterTokenClient from the current config, all
+        """Construct the token client the current config calls for, all
         fields read under the lock (a concurrent apply() must not yield
-        a torn host-from-new/port-from-old pair). Returns None when no
-        server address is configured."""
+        a torn host-from-new/port-from-old pair).
+
+        ``sentinel.tpu.cluster.shards`` > 1 with a complete shards.map
+        builds a :class:`ShardedTokenClient` (hash-partitioned token
+        plane); shards = 1 — the default — builds the plain single-
+        server client, byte-identical to the pre-shard wire behavior.
+        Returns None when neither a shard map nor a server address is
+        configured."""
         from sentinel_tpu.cluster.client import ClusterTokenClient
+        from sentinel_tpu.cluster.shards import ShardedTokenClient, ShardMap
 
         with cls._lock:
             host, port = cls.server_host, cls.server_port
             timeout_s = cls.request_timeout_ms / 1000.0
             namespace = cls.namespace
+        shard_map = ShardMap.from_config(default_host=host)
+        if shard_map is not None:
+            return ShardedTokenClient(
+                shard_map, request_timeout_sec=timeout_s, namespace=namespace
+            )
         if not host or port <= 0:
             return None
         return ClusterTokenClient(

@@ -102,6 +102,10 @@ MSG_TYPE_CONCURRENT_FLOW_RELEASE = 4
 # frame carries a whole admission window's worth of token requests.
 MSG_TYPE_FLOW_BATCH = 16
 MSG_TYPE_PARAM_FLOW_BATCH = 17
+# Sketch gossip (this framework's own): engines exchange count-min
+# arrays + candidate tables so heavy hitters are detected fleet-wide.
+MSG_TYPE_SKETCH_PUSH = 18
+MSG_TYPE_SKETCH_MERGED = 19
 
 FLOW_THRESHOLD_AVG_LOCAL = 0
 FLOW_THRESHOLD_GLOBAL = 1

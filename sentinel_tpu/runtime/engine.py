@@ -804,6 +804,13 @@ class Engine:
         from sentinel_tpu.runtime.sketch import SketchTier
 
         self.sketch = SketchTier(self)
+        # Sketch gossip endpoint (cluster/gossip.py): None unless
+        # sketch + gossip are both enabled; armed, a listener folds
+        # peer count-min frames into the tier and the tier's promotion
+        # controller evaluates the fleet view.
+        from sentinel_tpu.cluster.gossip import maybe_build_gossip
+
+        self.gossip = maybe_build_gossip(self.sketch)
         # Self-tuning control plane (runtime/autotune.py): closes the
         # telemetry loop on pipeline depth, the batch window, and the
         # closed-form-vs-scan param path. Disabled by default — one
@@ -2560,6 +2567,8 @@ class Engine:
             # The final drift window has no later traffic to roll it
             # closed — fold it so its drift reaches the histogram.
             self.speculative.flush_window()
+        if self.gossip is not None:
+            self.gossip.stop()
         self.failover.close()
 
     @property

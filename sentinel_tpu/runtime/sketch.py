@@ -324,9 +324,27 @@ class SketchTier:
         # _collect).
         self.cold_qps = max(0.0, config.get_float(config.SKETCH_COLD_QPS, 0.0))
         self.cold_armed = self.enabled and self.cold_qps > 0
+        # Sketch gossip (sentinel.tpu.gossip.enabled): engines exchange
+        # their host count-min twins + candidate tables and the
+        # promotion controller evaluates the MERGED fleet view — a key
+        # hot fleet-wide but under every per-engine threshold promotes
+        # everywhere. Gossip off (the default): no remote state ever
+        # exists and _evaluate sees exactly the local by_key.
+        self.gossip_armed = self.enabled and config.get_bool(
+            config.GOSSIP_ENABLED, False
+        )
+        self.gossip_stale_windows = max(
+            1, config.get_int(config.GOSSIP_STALE_WINDOWS, 4)
+        )
+        # origin -> [int64 cm, {key: count} candidates, local wid at
+        # last merge]. Decayed on the SAME window clock as _host_cm;
+        # a silent origin expires after gossip_stale_windows windows
+        # (a dead peer must not pin its last counts forever).
+        self._remote: Dict[str, list] = {}
+        self.gossip_merges = 0
         self._host_cm: Optional[np.ndarray] = (
             np.zeros((self.depth, self.width), dtype=np.int64)
-            if self.cold_armed
+            if (self.cold_armed or self.gossip_armed)
             else None
         )
         self.cold_blocks = 0
@@ -619,6 +637,13 @@ class SketchTier:
             self.host_mirror.decay()
             if self._host_cm is not None:
                 self._host_cm >>= 1
+            for origin in list(self._remote):
+                ent = self._remote[origin]
+                if wid - ent[2] > self.gossip_stale_windows:
+                    del self._remote[origin]
+                    continue
+                ent[0] >>= 1
+                ent[1] = {k: c >> 1 for k, c in ent[1].items() if c >= 2}
             return True
 
     # ------------------------------------------------------------------
@@ -933,12 +958,120 @@ class SketchTier:
             tele.note_sketch_host_fold()
         self._evaluate(by_key, now_ms)
 
+    # ------------------------------------------------------------------
+    # sketch gossip (fleet-wide heavy hitters)
+    # ------------------------------------------------------------------
+    def gossip_snapshot(self) -> Tuple[int, np.ndarray, List[Tuple[str, int]]]:
+        """One gossip frame's worth of local view: (window_id, int32
+        count-min copy, [(key, count)] candidates). Always the LOCAL
+        arrays — never the merged view — so a peer folding this frame
+        counts this engine's traffic exactly once no matter how many
+        gossip rounds ran."""
+        with self._lock:
+            wid = self._last_wid or 0
+            if self._host_cm is not None:
+                cm = np.clip(self._host_cm, 0, _I32_MAX).astype(np.int32)
+            else:
+                cm = np.zeros((self.depth, self.width), dtype=np.int32)
+            cands = [
+                (key, int(cnt))
+                for _i, key, cnt in self._last_candidates
+                if key is not None and cnt > 0
+            ]
+            if not cands and self.host_mirror.counts:
+                # DEGRADED (or pre-first-drain): the space-saving
+                # mirror is the candidate view — gossip keeps working
+                # exactly where fold_host_chunk does.
+                cands = [
+                    (k, int(v)) for k, v in self.host_mirror.counts.items()
+                ]
+        cands.sort(key=lambda kv: kv[1], reverse=True)
+        return wid, cm, cands[: self.candidates]
+
+    def merge_remote(
+        self,
+        origin: str,
+        window_id: int,
+        cm: np.ndarray,
+        cands: Sequence[Tuple[str, int]],
+    ) -> bool:
+        """Fold one peer frame. Snapshot-REPLACE per origin, never
+        accumulate: each frame carries the peer's full decayed view, so
+        adding successive frames would double-count its traffic. The
+        saturating vector add happens at read time (_fleet_by_key_).
+        Frames with foreign sketch geometry are dropped — hash rows
+        only line up when (depth, width) match. ``window_id`` is the
+        peer's clock, informational only; staleness runs on OUR window
+        clock (clocks across hosts need not agree)."""
+        if not self.gossip_armed:
+            return False
+        arr = np.asarray(cm, dtype=np.int64)
+        if arr.shape != (self.depth, self.width):
+            return False
+        folded = {}
+        for k, c in cands:
+            if int(c) > 0:
+                folded[str(k)] = int(c)
+        with self._lock:
+            self._remote[origin] = [arr.copy(), folded, self._last_wid or 0]
+            self.gossip_merges += 1
+        return True
+
+    def _fleet_by_key(self, by_key: Dict[str, int]) -> Dict[str, int]:
+        """The promotion controller's input under gossip: the fleet
+        view. Saturating vector add of the local + every remote
+        count-min array (same hash family, same decay clock), queried
+        over the union of local candidates and remote candidate keys;
+        each key evaluates at max(local count, fleet estimate), so the
+        merged estimate is never below what any single engine saw. No
+        remotes — or gossip off — returns ``by_key`` untouched, which
+        keeps the non-gossip promotion path bit-identical."""
+        if not self.gossip_armed:
+            return by_key
+        with self._lock:
+            if not self._remote:
+                return by_key
+            fleet = np.zeros((self.depth, self.width), dtype=np.int64)
+            if self._host_cm is not None:
+                fleet += self._host_cm
+            for ent in self._remote.values():
+                fleet += ent[0]
+            # Saturate to the int32 domain the sketch operates in (the
+            # wire is int32; cm_estimate's floor is _I32_MAX anyway).
+            np.clip(fleet, 0, _I32_MAX, out=fleet)
+            keys = set(by_key)
+            for ent in self._remote.values():
+                keys.update(ent[1])
+            key_list = sorted(keys)
+            if not key_list:
+                return by_key
+            ids = np.fromiter(
+                (key_id(k) for k in key_list), dtype=np.int64,
+                count=len(key_list),
+            )
+            ests = cm_estimate(fleet, ids)
+        return {
+            k: max(by_key.get(k, 0), int(e))
+            for k, e in zip(key_list, ests.tolist())
+        }
+
+    def gossip_info(self) -> dict:
+        """Observability row for transport/metrics."""
+        with self._lock:
+            return {
+                "armed": self.gossip_armed,
+                "merges": self.gossip_merges,
+                "remote_origins": sorted(self._remote),
+                "stale_windows": self.gossip_stale_windows,
+            }
+
     def _evaluate(self, by_key: Dict[str, int], now_ms: int) -> None:
         """The promotion/demotion state machine over one candidate
         view. Value promotions take effect immediately (lock-free
         published-set swap); flow-rule installs/removals queue as
         actions applied at the next flush entry (a rule rebuild must
         not run from inside a drain)."""
+        by_key = self._fleet_by_key(by_key)
         win_s = self.window_ms / 1000.0
         wid = now_ms // self.window_ms
         promos = 0
@@ -1111,6 +1244,8 @@ class SketchTier:
                 self._last_wid = max(
                     0, self._last_wid - offset_ms // self.window_ms
                 )
+            for ent in self._remote.values():
+                ent[2] = max(0, ent[2] - offset_ms // self.window_ms)
 
     def reset(self) -> None:
         with self._lock:
@@ -1132,6 +1267,8 @@ class SketchTier:
             self.host_mirror.clear()
             if self._host_cm is not None:
                 self._host_cm[:] = 0
+            self._remote = {}
+            self.gossip_merges = 0
             self.cold_blocks = 0
             self.cold_value_blocks = 0
         self.reset_device_state()
@@ -1192,6 +1329,7 @@ class SketchTier:
             "promoted_count": self.promoted_count,
             "promoted_values": promoted_vals,
             "promoted_resources": promoted_res,
+            "gossip": self.gossip_info(),
             "candidates_topk": self.candidates_snapshot(),
             "host_mirror_topk": [
                 {"key": k[1:].replace(_SEP, "|"), "estimate": v}

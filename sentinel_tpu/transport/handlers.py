@@ -527,17 +527,23 @@ def ipc_handler(req: CommandRequest) -> CommandResponse:
 @command_mapping(
     "cluster",
     "batched cluster token plane: client counters, RPC latency,"
-    " live leases, window config",
+    " live leases, per-shard rows, gossip state, window config",
 )
 def cluster_handler(req: CommandRequest) -> CommandResponse:
-    """The cluster token path view (cluster/client.py): how many token
-    decisions the client served and by which stance (batched frame,
-    local lease, FAIL fallback), the RPC round-trip summary, and —
-    when a client is live — its connection, intern table, lease table
-    and micro-window configuration. Counters are process-wide (the
-    ``client_stats`` singleton) so the command answers even before a
-    cluster rule ever attached a client."""
+    """The cluster token path view (cluster/client.py + shards.py):
+    how many token decisions the client served and by which stance
+    (batched frame, local lease, FAIL fallback), the RPC round-trip
+    summary, and — when a client is live — its connection, intern
+    table, lease table and micro-window configuration. A sharded
+    client's ``plane_snapshot`` carries per-shard rows (connection,
+    leases, honest fallback counters per shard). The ``gossip`` block
+    is this engine's sketch-gossip endpoint: origin, peers, wire
+    counters and how many remote views the tier holds. Counters are
+    process-wide (the ``client_stats``/``gossip_stats`` singletons) so
+    the command answers even before a cluster rule ever attached a
+    client."""
     from sentinel_tpu.cluster.client import client_stats
+    from sentinel_tpu.cluster.gossip import gossip_stats
     from sentinel_tpu.cluster.state import (
         ClusterStateManager,
         TokenClientProvider,
@@ -548,6 +554,15 @@ def cluster_handler(req: CommandRequest) -> CommandResponse:
     client = TokenClientProvider.get_client()
     if client is not None and hasattr(client, "plane_snapshot"):
         out["client"] = client.plane_snapshot()
+    agent = getattr(engine, "gossip", None)
+    if agent is not None:
+        out["gossip"] = agent.snapshot()
+    else:
+        out["gossip"] = {
+            "running": False,
+            "tier": engine.sketch.gossip_info(),
+            "stats": gossip_stats.snapshot(),
+        }
     out["flush_seq"] = engine.flush_seq
     return CommandResponse.of_json(out)
 

@@ -577,6 +577,107 @@ def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
         c.get("cluster_wait_ms", 0),
     )
 
+    # Sharded token plane (cluster/shards.py): per-shard labeled rows
+    # off the live provider client, so a dead shard's fallbacks and a
+    # bounced shard's cleared leases are attributable to THAT shard.
+    # Family headers render even when the plane is unsharded (or no
+    # client is attached) so dashboards keep their series.
+    from sentinel_tpu.cluster.state import TokenClientProvider
+
+    sc = TokenClientProvider.get_client()
+    s_rows = sc.shard_rows() if hasattr(sc, "shard_rows") else []
+    out += _gauge(
+        f"{_PREFIX}_cluster_shard_count",
+        "Token shards behind the sharded client (0 = unsharded plane)",
+        len(s_rows),
+    )
+    out += _gauge(
+        f"{_PREFIX}_cluster_shard_map_version",
+        "Version of the shard map the client currently routes by "
+        "(sentinel.tpu.cluster.shards.map.version; -1 = unsharded)",
+        sc.shard_map.version if hasattr(sc, "shard_map") else -1,
+    )
+    for fam, kind, help_text, col in (
+        ("connected", "gauge",
+         "Shard connection state (1 = TCP connected)", "connected"),
+        ("leases", "gauge",
+         "Live local-quota leases held against this shard", "leases"),
+        ("requests_total", "counter",
+         "Token decisions routed to this shard (all stances)",
+         "requests"),
+        ("batch_frames_total", "counter",
+         "Batched token frames sent to this shard", "batch_frames"),
+        ("lease_admits_total", "counter",
+         "Admissions served from this shard's local leases (zero RPCs)",
+         "lease_admits"),
+        ("fallbacks_total", "counter",
+         "FAIL-family serves on this shard — its flows fell back to "
+         "the local decision", "fallbacks"),
+    ):
+        name = f"{_PREFIX}_cluster_shard_{fam}"
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} {kind}")
+        for r in s_rows:
+            v = int(r[col]) if col != "connected" else int(bool(r[col]))
+            out.append(
+                f'{name}{{shard="{r["shard"]}",'
+                f'server="{_escape_label(r["server"])}"}} {v}'
+            )
+
+    # Sketch gossip plane (cluster/gossip.py + runtime/sketch.py): the
+    # process-wide wire counters plus this engine's fold state — how
+    # many peer views the tier currently holds and how many merges it
+    # folded, the pair that says fleet-wide promotion is actually fed.
+    from sentinel_tpu.cluster.gossip import gossip_stats
+
+    gs = gossip_stats.snapshot()
+    gi = engine.sketch.gossip_info()
+    out += _gauge(
+        f"{p}_gossip_enabled",
+        "Sketch gossip armed on this engine (sentinel.tpu.gossip.enabled "
+        "with the sketch tier on)",
+        1 if gi.get("armed") else 0,
+    )
+    out += _gauge(
+        f"{p}_gossip_remote_origins",
+        "Peer engines whose sketch views this tier currently holds",
+        # gossip_info carries the origin NAMES (the cluster command
+        # shows them); the gauge is their count.
+        len(gi.get("remote_origins") or ()),
+    )
+    out += ctr(
+        f"{p}_gossip_merges_total",
+        "Remote sketch views folded into this tier (snapshot-replace "
+        "per origin)",
+        gi.get("merges", 0),
+    )
+    out += ctr(
+        f"{p}_gossip_rounds_total",
+        "Gossip push rounds driven by this process",
+        gs["rounds"],
+    )
+    out += ctr(
+        f"{p}_gossip_frames_sent_total",
+        "SKETCH_PUSH/SKETCH_MERGED frames sent",
+        gs["frames_sent"],
+    )
+    out += ctr(
+        f"{p}_gossip_frames_received_total",
+        "SKETCH_PUSH/SKETCH_MERGED frames received",
+        gs["frames_received"],
+    )
+    out += ctr(
+        f"{p}_gossip_version_rejects_total",
+        "Foreign-GOSSIP_VERSION frames answered with an empty merged "
+        "frame (mixed-version fleet degrades to per-engine promotion)",
+        gs["version_rejects"],
+    )
+    out += ctr(
+        f"{p}_gossip_errors_total",
+        "Gossip round/peer failures (dead peer, timeout, bad frame)",
+        gs["errors"],
+    )
+
     # Param admission path selection (Engine._encode_param): batches
     # routed to the closed-form rank path vs the rounds/scan family —
     # the pick the self-tuning cost memo arbitrates when enabled.

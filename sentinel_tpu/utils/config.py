@@ -412,6 +412,30 @@ class SentinelConfig:
     # is forfeited and the op proceeds. The pre-cap behavior slept
     # per-op back-to-back, unbounded.
     CLUSTER_WAIT_CAP_MS = "sentinel.tpu.cluster.wait.cap.ms"
+    # Sharded token plane (cluster/shards.py): shards > 1 partitions
+    # token state across N token servers by flow-id hash
+    # (shard = crc32(flow_id) % shards). shards.map is the endpoint
+    # list, CSV "host:port,host:port,..." with at least `shards`
+    # entries; shards.map.version is bumped by the operator on every
+    # map edit — clients compare it per batch and rebuild their
+    # connections when it moves. shards=1 (the default) keeps the
+    # single-server PR-16 client byte-identical.
+    CLUSTER_SHARDS = "sentinel.tpu.cluster.shards"
+    CLUSTER_SHARDS_MAP = "sentinel.tpu.cluster.shards.map"
+    CLUSTER_SHARDS_MAP_VERSION = "sentinel.tpu.cluster.shards.map.version"
+    # Sketch gossip (cluster/gossip.py): engines exchange their host
+    # count-min twin + candidate tables (SKETCH_PUSH/SKETCH_MERGED) so
+    # heavy hitters are detected fleet-wide. enabled arms the host twin
+    # and the fleet-view evaluation; port is this engine's gossip
+    # listener (0 = ephemeral); peers is CSV "host:port,..." of other
+    # engines' listeners; interval.ms > 0 starts a pusher thread (0 =
+    # manual rounds only); stale.windows bounds how many decay windows
+    # a remote snapshot outlives its last push before it is dropped.
+    GOSSIP_ENABLED = "sentinel.tpu.gossip.enabled"
+    GOSSIP_PORT = "sentinel.tpu.gossip.port"
+    GOSSIP_PEERS = "sentinel.tpu.gossip.peers"
+    GOSSIP_INTERVAL_MS = "sentinel.tpu.gossip.interval.ms"
+    GOSSIP_STALE_WINDOWS = "sentinel.tpu.gossip.stale.windows"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -524,6 +548,14 @@ class SentinelConfig:
         CLUSTER_LEASE_MAX: "256",
         CLUSTER_LEASE_TTL_MS: "100",
         CLUSTER_WAIT_CAP_MS: "1000",
+        CLUSTER_SHARDS: "1",
+        CLUSTER_SHARDS_MAP: "",
+        CLUSTER_SHARDS_MAP_VERSION: "0",
+        GOSSIP_ENABLED: "false",
+        GOSSIP_PORT: "0",
+        GOSSIP_PEERS: "",
+        GOSSIP_INTERVAL_MS: "0",
+        GOSSIP_STALE_WINDOWS: "4",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

@@ -1,0 +1,402 @@
+"""Sketch gossip (PR 17) — frame, merge-bound and fleet-promotion pins.
+
+The acceptance surface: the SKETCH_PUSH/SKETCH_MERGED frame pair
+round-trips exactly; merged estimates obey the count-min merge bounds
+(>= every per-engine estimate, == the sum on collision-free keys —
+pinned against a numpy twin); a key spread thin across 3 engines (each
+below the promote threshold, fleet-wide above) promotes ONLY with
+gossip on — gossip off is bit-identical per-engine behavior; remote
+views decay on the local window clock and silent origins expire; and a
+foreign gossip version degrades to an empty merged frame, never a
+connection drop.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.cluster import protocol
+from sentinel_tpu.cluster.gossip import (
+    GossipAgent,
+    gossip_stats,
+    parse_peers,
+)
+from sentinel_tpu.models import constants as C
+from sentinel_tpu.runtime.sketch import (
+    SketchTier,
+    _KIND_VALUE,
+    _SEP,
+    _hash_np,
+    cm_estimate,
+    key_id,
+)
+from sentinel_tpu.utils.config import SentinelConfig, config
+
+
+@pytest.fixture(autouse=True)
+def _config_sandbox():
+    with config._lock:
+        saved = dict(config._runtime)
+    yield
+    with config._lock:
+        config._runtime.clear()
+        config._runtime.update(saved)
+
+
+@pytest.fixture(autouse=True)
+def _gossip_stats_reset():
+    gossip_stats.reset()
+    yield
+    gossip_stats.reset()
+
+
+class _FakeTelemetry:
+    enabled = False
+
+
+class _FakeEngine:
+    telemetry = _FakeTelemetry()
+
+
+def _tier(gossip=True, width=4096, **keys):
+    config.set(SentinelConfig.SKETCH_ENABLED, "true")
+    config.set(SentinelConfig.GOSSIP_ENABLED, "true" if gossip else "false")
+    config.set(SentinelConfig.SKETCH_WIDTH, str(width))
+    for k, v in keys.items():
+        config.set(getattr(SentinelConfig, k), str(v))
+    return SketchTier(_FakeEngine())
+
+
+def _feed(tier, key, count):
+    """Count one key directly into the tier's host twin + mirror (the
+    unit seam _collect feeds in production)."""
+    kid = key_id(key)
+    ids = np.array([kid], dtype=np.int64)
+    for di in range(tier.depth):
+        tier._host_cm[di, _hash_np(ids, di, tier.width)[0]] += count
+    tier.host_mirror.offer(key, count)
+
+
+def _vkey(v):
+    return _KIND_VALUE + "res" + _SEP + v
+
+
+class TestFramePair:
+    def test_roundtrip(self):
+        cm = np.arange(12, dtype="<i4").reshape(3, 4)
+        cands = [("a" * 3, 7), ("k\x1fv", 2 ** 40), ("", 1)]
+        frame = protocol.pack_sketch_frame(
+            42, C.MSG_TYPE_SKETCH_PUSH, "host:1:2", 99, 3, 4,
+            cm.tobytes(), cands,
+        )
+        # Length framing holds.
+        (length,) = struct.unpack_from("<I", frame, 0)
+        assert length == len(frame) - 4
+        out = protocol.unpack_sketch_frame(frame[4:])
+        xid, mt, origin, wid, depth, width, cm_bytes, rcands = out
+        assert (xid, mt, origin, wid, depth, width) == (
+            42, C.MSG_TYPE_SKETCH_PUSH, "host:1:2", 99, 3, 4,
+        )
+        assert np.array_equal(
+            np.frombuffer(cm_bytes, dtype="<i4").reshape(3, 4), cm
+        )
+        assert rcands == cands
+
+    def test_empty_frame_shape(self):
+        frame = protocol.pack_sketch_frame(
+            1, C.MSG_TYPE_SKETCH_MERGED, "o", 0, 0, 0, b""
+        )
+        out = protocol.unpack_sketch_frame(frame[4:])
+        assert out[4] == 0 and out[6] == b"" and out[7] == []
+
+    def test_foreign_version_raises_typed(self):
+        frame = bytearray(
+            protocol.pack_sketch_frame(
+                7, C.MSG_TYPE_SKETCH_PUSH, "o", 0, 0, 0, b""
+            )
+        )
+        frame[4 + 4 + 1] = protocol.GOSSIP_VERSION + 1  # version byte
+        with pytest.raises(protocol.UnsupportedBatchVersion) as ei:
+            protocol.unpack_sketch_frame(bytes(frame[4:]))
+        assert ei.value.xid == 7
+
+    def test_trailing_garbage_raises(self):
+        frame = protocol.pack_sketch_frame(
+            1, C.MSG_TYPE_SKETCH_PUSH, "o", 0, 0, 0, b""
+        )
+        with pytest.raises(ValueError):
+            protocol.unpack_sketch_frame(frame[4:] + b"junk")
+
+
+class TestMergeBounds:
+    def test_merged_estimates_pinned_vs_numpy_twin(self):
+        """On collision-free keys (few keys, wide sketch) the merged
+        estimate equals the vector-sum twin exactly — which implies
+        both count-min merge bounds: >= max(per-engine), <= sum(
+        per-engine)."""
+        a = _tier()
+        b = _tier()
+        keys = [_vkey("k%d" % i) for i in range(8)]
+        counts_a = [3 * i + 1 for i in range(8)]
+        counts_b = [50 - 4 * i for i in range(8)]
+        for k, ca, cb in zip(keys, counts_a, counts_b):
+            _feed(a, k, ca)
+            _feed(b, k, cb)
+        wid, cm_b, cands_b = b.gossip_snapshot()
+        assert a.merge_remote("B", wid, cm_b, cands_b)
+        fleet = a._fleet_by_key({k: c for k, c in zip(keys, counts_a)})
+        ids = np.array([key_id(k) for k in keys], dtype=np.int64)
+        twin = cm_estimate(
+            a._host_cm.astype(np.int64) + cm_b.astype(np.int64), ids
+        )
+        for k, ca, cb, tw in zip(keys, counts_a, counts_b, twin.tolist()):
+            assert fleet[k] == tw == ca + cb
+            assert fleet[k] >= max(ca, cb)
+            assert fleet[k] <= ca + cb
+
+    def test_merge_is_snapshot_replace_not_accumulate(self):
+        """Re-merging the same origin's frame N times must not
+        N-count its traffic (frames carry full decayed views)."""
+        a, b = _tier(), _tier()
+        _feed(b, _vkey("x"), 40)
+        wid, cm_b, cands_b = b.gossip_snapshot()
+        for _ in range(5):
+            assert a.merge_remote("B", wid, cm_b, cands_b)
+        assert a._fleet_by_key({})[_vkey("x")] == 40
+
+    def test_geometry_mismatch_dropped(self):
+        a = _tier()
+        alien = np.ones((a.depth + 1, a.width), dtype=np.int32)
+        assert not a.merge_remote("B", 0, alien, [])
+        assert a._remote == {}
+
+    def test_gossip_off_fleet_view_is_identity(self):
+        t = _tier(gossip=False)
+        assert t._host_cm is None  # not even armed
+        by_key = {_vkey("x"): 3}
+        assert t._fleet_by_key(by_key) is by_key
+        assert not t.merge_remote("B", 0, np.zeros((4, 4096)), [])
+
+
+class TestFleetPromotion:
+    PROMOTE_QPS = 100.0  # threshold = 1.5 * 100 * 1s = 150
+
+    def _tiers(self, gossip):
+        return [
+            _tier(
+                gossip=gossip,
+                SKETCH_PROMOTE_QPS=self.PROMOTE_QPS,
+                SKETCH_WINDOW_MS=1000,
+            )
+            for _ in range(3)
+        ]
+
+    def test_thin_spread_key_promotes_only_with_gossip(self):
+        """THE differential: 60/engine across 3 engines (< 150
+        threshold each, 180 fleet-wide) promotes on EVERY engine with
+        gossip on, on NO engine with gossip off."""
+        key = _vkey("hot")
+
+        def drive(gossip):
+            tiers = self._tiers(gossip)
+            agents = []
+            if gossip:
+                for i, t in enumerate(tiers):
+                    _feed(t, key, 60)
+                    agents.append(
+                        GossipAgent(
+                            t, origin="E%d" % i, port=0, peers=[]
+                        ).start()
+                    )
+                for i, ga in enumerate(agents):
+                    ga.peers = [
+                        ("127.0.0.1", agents[j].port)
+                        for j in range(3) if j != i
+                    ]
+                # Bounded rounds: ONE round per engine suffices for
+                # full pairwise exchange.
+                for ga in agents:
+                    assert ga.run_round() == 2
+            promoted = []
+            for t in tiers:
+                t._evaluate({key: 60}, now_ms=5000)
+                promoted.append("hot" in t.promoted_values.get("res", ()))
+            for ga in agents:
+                ga.stop()
+            return promoted
+
+        assert drive(gossip=True) == [True, True, True]
+        assert drive(gossip=False) == [False, False, False]
+
+    def test_remote_only_key_still_promotes(self):
+        """A key the local engine never saw in ITS candidate table
+        (arrives only via remote candidates) is evaluated — the key
+        universe is local ∪ remote."""
+        tiers = self._tiers(gossip=True)
+        key = _vkey("elsewhere")
+        # Engines 1 and 2 see it at 90 each; engine 0 never does.
+        for t in tiers[1:]:
+            _feed(t, key, 90)
+        agents = [
+            GossipAgent(t, origin="E%d" % i, port=0, peers=[]).start()
+            for i, t in enumerate(tiers)
+        ]
+        agents[0].peers = [
+            ("127.0.0.1", agents[1].port), ("127.0.0.1", agents[2].port)
+        ]
+        assert agents[0].run_round() == 2
+        tiers[0]._evaluate({}, now_ms=5000)
+        assert "elsewhere" in tiers[0].promoted_values.get("res", ())
+        for ga in agents:
+            ga.stop()
+
+
+class TestDecayAndExpiry:
+    def test_remote_views_decay_on_local_clock(self):
+        a, b = _tier(), _tier()
+        _feed(b, _vkey("x"), 64)
+        wid, cm_b, cands_b = b.gossip_snapshot()
+        assert a.merge_remote("B", wid, cm_b, cands_b)
+        a.decay_due(1000)  # arms the clock
+        a.decay_due(2000)  # first real decay: halves local AND remote
+        assert a._remote["B"][0].max() == 32
+        assert a._remote["B"][1][_vkey("x")] == 32
+        assert a._fleet_by_key({})[_vkey("x")] == 32
+
+    def test_stale_origin_expires(self):
+        a, b = _tier(GOSSIP_STALE_WINDOWS=2), _tier()
+        _feed(b, _vkey("x"), 64)
+        a.decay_due(1000)
+        assert a.merge_remote("B", *b.gossip_snapshot())
+        for w in range(2, 6):
+            a.decay_due(w * 1000)
+        assert "B" not in a._remote
+        assert a._fleet_by_key({_vkey("y"): 1}) == {_vkey("y"): 1}
+
+    def test_reset_clears_remote_state(self):
+        a, b = _tier(), _tier()
+        _feed(b, _vkey("x"), 8)
+        a.merge_remote("B", *b.gossip_snapshot())
+        assert a._remote
+        a.reset()
+        assert a._remote == {} and a.gossip_merges == 0
+
+
+class TestAgentWire:
+    def test_one_round_exchanges_both_directions(self):
+        a, b = _tier(), _tier()
+        _feed(a, _vkey("ka"), 11)
+        _feed(b, _vkey("kb"), 22)
+        ga = GossipAgent(a, origin="A", port=0, peers=[]).start()
+        gb = GossipAgent(b, origin="B", port=0, peers=[]).start()
+        ga.peers = [("127.0.0.1", gb.port)]
+        try:
+            assert ga.run_round() == 1
+            # One round trip: B holds A's view AND A holds B's.
+            assert sorted(a._remote) == ["B"]
+            assert sorted(b._remote) == ["A"]
+            assert a._fleet_by_key({})[_vkey("kb")] == 22
+            assert b._fleet_by_key({})[_vkey("ka")] == 11
+            snap = gossip_stats.snapshot()
+            assert snap["merges"] == 2 and snap["errors"] == 0
+        finally:
+            ga.stop()
+            gb.stop()
+
+    def test_dead_peer_costs_one_error_not_a_wedge(self):
+        a = _tier()
+        ga = GossipAgent(
+            a, origin="A", port=0,
+            peers=[("127.0.0.1", 1)],  # nothing listens there
+            timeout_sec=0.3,
+        ).start()
+        try:
+            assert ga.run_round() == 0
+            assert gossip_stats.snapshot()["errors"] == 1
+            assert a._remote == {}
+        finally:
+            ga.stop()
+
+    def test_foreign_version_gets_empty_merged_frame(self):
+        """A pusher speaking a future GOSSIP_VERSION receives an EMPTY
+        merged frame (honest degrade) and the tier stays untouched."""
+        a = _tier()
+        ga = GossipAgent(a, origin="A", port=0, peers=[]).start()
+        try:
+            frame = bytearray(
+                protocol.pack_sketch_frame(
+                    9, C.MSG_TYPE_SKETCH_PUSH, "alien", 0,
+                    a.depth, a.width,
+                    np.ones((a.depth, a.width), dtype="<i4").tobytes(),
+                    [(_vkey("x"), 5)],
+                )
+            )
+            frame[4 + 4 + 1] = protocol.GOSSIP_VERSION + 1
+            with socket.create_connection(("127.0.0.1", ga.port), 2.0) as s:
+                s.sendall(bytes(frame))
+                payload = protocol.read_frame(s)
+            out = protocol.unpack_sketch_frame(payload)
+            assert out[0] == 9  # xid echoed
+            assert out[1] == C.MSG_TYPE_SKETCH_MERGED
+            assert out[4] == 0  # empty: nothing mergeable
+            assert a._remote == {}
+            assert gossip_stats.snapshot()["version_rejects"] == 1
+        finally:
+            ga.stop()
+
+    def test_parse_peers_skips_garbage(self):
+        assert parse_peers("h1:70, h2:71 ,bad,:9,h3:x,") == [
+            ("h1", 70), ("h2", 71)
+        ]
+
+
+class TestEngineIntegration:
+    def test_engine_arms_and_stops_gossip(self, manual_clock):
+        from sentinel_tpu.runtime.engine import Engine
+
+        config.set(SentinelConfig.SKETCH_ENABLED, "true")
+        config.set(SentinelConfig.GOSSIP_ENABLED, "true")
+        eng = Engine(clock=manual_clock)
+        try:
+            assert eng.gossip is not None
+            assert eng.gossip.port > 0
+            assert eng.sketch.gossip_armed
+            assert eng.sketch._host_cm is not None
+        finally:
+            eng.close()
+        assert eng.gossip._server is None  # listener stopped
+
+    def test_engine_default_has_no_gossip(self, manual_clock):
+        from sentinel_tpu.runtime.engine import Engine
+
+        eng = Engine(clock=manual_clock)
+        try:
+            assert eng.gossip is None
+        finally:
+            eng.close()
+
+    def test_prometheus_remote_origins_is_a_count(self, manual_clock):
+        """gossip_info carries origin NAMES; the /metrics gauge must
+        render their COUNT — a held remote view once rendered the
+        Python list repr straight into the exposition line."""
+        from sentinel_tpu.runtime.engine import Engine
+        from sentinel_tpu.transport.prometheus import engine_telemetry_lines
+
+        config.set(SentinelConfig.SKETCH_ENABLED, "true")
+        config.set(SentinelConfig.GOSSIP_ENABLED, "true")
+        eng = Engine(clock=manual_clock)
+        try:
+            tier = eng.sketch
+            cm = np.ones_like(tier._host_cm)
+            assert tier.merge_remote("peerX", 1, cm, [("\x01k", 5)])
+            lines = [
+                ln for ln in engine_telemetry_lines(eng)
+                if ln.startswith("sentinel_engine_gossip_remote_origins ")
+            ]
+            assert lines == ["sentinel_engine_gossip_remote_origins 1"]
+        finally:
+            eng.close()

@@ -159,6 +159,32 @@ STAGE_METRICS: Dict[str, Tuple[str, float]] = {
     "cluster_frames_per_op_lease": ("lower", 2.00),
     "cluster_lease_hit_rate": ("higher", 0.30),
     "cluster_window_amortization": ("higher", 0.30),
+    # Sharded token plane (PR 17, bench `cluster` shard sweep).
+    # Frames-per-op and lease hit rates are protocol COUNTS; the
+    # capacity ratio and parallel-issue fraction are same-run ratios
+    # (box noise cancels) — ratio-class bands. Per-shard capacity is
+    # a busy-clock rate, steadier than wall throughput but still on a
+    # shared box, so it keeps the throughput band.
+    "cluster_shard1_window_ops_per_sec": ("higher", 0.60),
+    "cluster_shard2_window_ops_per_sec": ("higher", 0.60),
+    "cluster_shard4_window_ops_per_sec": ("higher", 0.60),
+    "cluster_shard1_lease_ops_per_sec": ("higher", 0.60),
+    "cluster_shard2_lease_ops_per_sec": ("higher", 0.60),
+    "cluster_shard4_lease_ops_per_sec": ("higher", 0.60),
+    "cluster_shard1_window_frames_per_op": ("lower", 0.50),
+    "cluster_shard2_window_frames_per_op": ("lower", 0.50),
+    "cluster_shard4_window_frames_per_op": ("lower", 0.50),
+    "cluster_shard1_lease_hit_rate": ("higher", 0.30),
+    "cluster_shard2_lease_hit_rate": ("higher", 0.30),
+    "cluster_shard4_lease_hit_rate": ("higher", 0.30),
+    "cluster_shard1_capacity_per_sec": ("higher", 0.60),
+    "cluster_shard2_capacity_per_sec": ("higher", 0.60),
+    "cluster_shard4_capacity_per_sec": ("higher", 0.60),
+    "cluster_shard4_parallel_issue": ("higher", 0.30),
+    "cluster_shard_capacity_ratio_4x": ("higher", 0.30),
+    # Gossip merge cost: one merge_remote + fleet-view query, pure
+    # numpy in-process — latency-class band.
+    "cluster_gossip_merge_ms": ("lower", 2.00),
 }
 
 # Host-identity token (PR 14): device_kind + jax_version cannot tell
@@ -213,6 +239,13 @@ STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
       "cluster_lease_ops_per_sec", "cluster_frames_per_op_window",
       "cluster_frames_per_op_lease", "cluster_lease_hit_rate",
       "cluster_window_amortization")),
+    # Shard sweep (PR 17): keyed on its own rung size so truncated
+    # runs and pre-PR-17 baselines never compare here.
+    (("cluster_shard_ops",),
+     tuple(
+         m for m in STAGE_METRICS
+         if m.startswith("cluster_shard") or m == "cluster_gossip_merge_ms"
+     )),
 ]
 
 

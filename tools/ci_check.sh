@@ -41,6 +41,13 @@ JAX_PLATFORMS=cpu python tools/config_audit.py \
 echo "== ci_check 2b: ipc worker-mode + engine-restart smoke =="
 JAX_PLATFORMS=cpu python tools/ipc_launch.py --smoke >/dev/null
 
+# Sharded token plane smoke (always): two real TCP token shards behind
+# the hash-routing client, one kill/recover cycle — a dead shard must
+# degrade only ITS flows and leave the live shard's leases untouched,
+# the scoping tier-1 covers in-process but not over real sockets.
+echo "== ci_check 2c: sharded token plane smoke =="
+JAX_PLATFORMS=cpu python tools/shard_smoke.py >/dev/null
+
 if [ "${CI_CHECK_SKIP_BENCH:-0}" = "1" ]; then
     echo "== ci_check 3/3: bench gate SKIPPED (CI_CHECK_SKIP_BENCH=1) =="
     # The ipc stage still smokes even when the full bench is skipped:

@@ -510,6 +510,80 @@ def main() -> None:
     except Exception as exc:
         print(f"[k2probe] cluster stage skipped: {exc}", file=sys.stderr)
 
+    # --- sharded token plane round trips (cluster/shards.py) -----------
+    # The fan-out tax in isolation: a 32-row batch through 1/2/4 real
+    # loopback shards, cost shown PER DECISION (routing split + M
+    # concurrent frames + verdict reassembly vs one frame), plus the
+    # pure hash-route cost per row. The single-shard row doubles as
+    # the shards=1-is-PR-16 baseline.
+    try:
+        from sentinel_tpu.cluster import (
+            cluster_flow_rule_manager as _cfrm,
+            cluster_server_config_manager as _cscm,
+        )
+        from sentinel_tpu.cluster.server import SentinelTokenServer
+        from sentinel_tpu.cluster.shards import (
+            ShardMap, ShardedTokenClient, shard_of,
+        )
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+        from sentinel_tpu.models import constants as CC
+        from sentinel_tpu.models.rules import ClusterFlowConfig, FlowRule
+
+        _cfrm.clear()
+        _cscm.load_global_flow_config(exceed_count=1.0, max_allowed_qps=1e12)
+        sh_flows = list(range(900, 932))
+        _cfrm.load_rules(
+            "default",
+            [FlowRule(
+                "k2s%d" % f, count=1e9, cluster_mode=True,
+                cluster_config=ClusterFlowConfig(
+                    flow_id=f, threshold_type=CC.FLOW_THRESHOLD_GLOBAL,
+                ),
+            ) for f in sh_flows],
+        )
+        rows32 = [(sh_flows[i % len(sh_flows)], 1, False) for i in range(32)]
+        for n_sh in (1, 2, 4):
+            srvs = [
+                SentinelTokenServer(
+                    port=0, service=DefaultTokenService()
+                ).start()
+                for _ in range(n_sh)
+            ]
+            scli = ShardedTokenClient(
+                ShardMap(0, [("127.0.0.1", s.port) for s in srvs])
+            ).start()
+            try:
+                for _ in range(8):  # warm every shard connection
+                    scli.request_tokens_batch(rows32)
+                lats = []
+                for _ in range(args.iters):
+                    for _ in range(32):
+                        t0 = time.perf_counter()
+                        scli.request_tokens_batch(rows32)
+                        lats.append((time.perf_counter() - t0) / 32)
+                lats.sort()
+                report(
+                    f"cluster_shard{n_sh}_batch_per_decision_p50",
+                    lats[len(lats) // 2],
+                )
+            finally:
+                scli.stop()
+                for s in srvs:
+                    s.stop()
+        # Pure routing cost: the crc32 hash-partition per row.
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            for f in sh_flows * 8:
+                shard_of(f, 4)
+        report(
+            "cluster_shard_route_per_row",
+            (time.perf_counter() - t0) / (args.iters * len(sh_flows) * 8),
+        )
+        _cfrm.clear()
+    except Exception as exc:
+        print(f"[k2probe] cluster_shard stage skipped: {exc}",
+              file=sys.stderr)
+
     # --- sketch-tier fold in isolation (runtime/sketch.py) -------------
     # The count-min + candidate merge over a pow2 key batch, jitted
     # standalone at two widths — the marginal device cost one armed

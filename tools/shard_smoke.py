@@ -1,0 +1,197 @@
+"""Sharded token plane smoke: 2 real TCP shards + one kill/recover
+cycle, the surface tier-1's in-process tests cannot fully cover wired
+into ci_check.sh.
+
+What must hold (exit nonzero otherwise, one line per check):
+
+1. a batched window splits across both shards and every row admits;
+2. leases grant per shard (both shard clients hold a lease table);
+3. killing shard 0 degrades only ITS flows — shard 1 keeps admitting
+   with its lease table untouched (the PR-16 disconnect cleared ALL
+   leases; this is the regression surface);
+4. restarting shard 0 on the same port reconnects and its flows admit
+   from the server again;
+5. after quiesce the concurrent-token gauge on both shards reads 0.
+
+Usage::
+
+    python tools/shard_smoke.py [--timeout 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sentinel_tpu.cluster import (  # noqa: E402
+    cluster_flow_rule_manager,
+    cluster_server_config_manager,
+)
+from sentinel_tpu.cluster.server import SentinelTokenServer  # noqa: E402
+from sentinel_tpu.cluster.shards import (  # noqa: E402
+    ShardMap,
+    ShardedTokenClient,
+    shard_of,
+)
+from sentinel_tpu.cluster.token_service import DefaultTokenService  # noqa: E402
+from sentinel_tpu.models import constants as C  # noqa: E402
+from sentinel_tpu.models.rules import ClusterFlowConfig, FlowRule  # noqa: E402
+from sentinel_tpu.utils.config import config  # noqa: E402
+
+OK = C.TokenResultStatus.OK
+FAILURES = []
+
+
+def check(name: str, cond: bool, detail: str = "") -> None:
+    line = f"[shard_smoke] {'ok  ' if cond else 'FAIL'} {name}"
+    if detail:
+        line += f" ({detail})"
+    print(line, flush=True)
+    if not cond:
+        FAILURES.append(name)
+
+
+def flows_on_shard(shard: int, n_shards: int, count: int, start: int = 7000):
+    out, fid = [], start
+    while len(out) < count:
+        if shard_of(fid, n_shards) == shard:
+            out.append(fid)
+        fid += 1
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--timeout", type=float, default=30.0,
+                    help="overall deadline for the reconnect waits")
+    args = ap.parse_args()
+    deadline = time.monotonic() + args.timeout
+
+    config.set(config.CLUSTER_CLIENT_WINDOW_MS, "0")
+    config.set(config.CLUSTER_LEASE_ENABLED, "true")
+    config.set(config.CLUSTER_LEASE_TTL_MS, "60000")
+
+    cluster_flow_rule_manager.clear()
+    cluster_server_config_manager.load_global_flow_config(
+        exceed_count=1.0, max_allowed_qps=1e12
+    )
+    flows_a = flows_on_shard(0, 2, 4)
+    flows_b = flows_on_shard(1, 2, 4)
+    cluster_flow_rule_manager.load_rules(
+        "default",
+        [FlowRule(
+            "sm%d" % f, count=1e9, cluster_mode=True,
+            cluster_config=ClusterFlowConfig(
+                flow_id=f, threshold_type=C.FLOW_THRESHOLD_GLOBAL,
+            ),
+        ) for f in flows_a + flows_b],
+    )
+
+    servers = [
+        SentinelTokenServer(port=0, service=DefaultTokenService()).start()
+        for _ in range(2)
+    ]
+    port_a = servers[0].port
+    client = ShardedTokenClient(
+        ShardMap(0, [("127.0.0.1", s.port) for s in servers]),
+        request_timeout_sec=2.0,
+        reconnect_interval_sec=0.2,
+    ).start()
+    rows = [(f, 1, False) for f in (flows_a + flows_b) * 4]
+
+    try:
+        # 1. split + admit: one window, every row OK, both shards framed.
+        for _ in range(3):  # warm + grant leases on both shards
+            results = client.request_tokens_batch(rows)
+        check("batched window admits on both shards",
+              all(r.status == OK for r in results),
+              f"{sum(r.status == OK for r in results)}/{len(results)} OK")
+        srows = client.shard_rows()
+        check("both shards carried frames",
+              all(sr["batch_frames"] > 0 for sr in srows),
+              "frames=" + ",".join(str(sr["batch_frames"]) for sr in srows))
+
+        # 2. per-shard lease tables.
+        check("leases granted per shard",
+              all(sr["leases"] > 0 for sr in srows),
+              "leases=" + ",".join(str(sr["leases"]) for sr in srows))
+        leases_b = dict(client.clients[1]._leases)
+
+        # 3. kill shard 0: only ITS flows degrade; shard 1's lease
+        #    table survives the other shard's bounce.
+        servers[0].stop()
+        degraded = False
+        while time.monotonic() < deadline and not degraded:
+            results = client.request_tokens_batch(rows)
+            by_flow = dict(zip([r[0] for r in rows], results))
+            degraded = any(
+                by_flow[f].status != OK for f in flows_a
+            ) and not client.clients[0].connected
+            time.sleep(0.05)
+        check("dead shard flows degrade", degraded)
+        check("live shard flows keep admitting",
+              all(by_flow[f].status == OK for f in flows_b))
+        check("live shard lease table untouched by the bounce",
+              dict(client.clients[1]._leases) == leases_b and bool(leases_b),
+              f"{len(leases_b)} leases")
+        check("dead shard leases cleared, live shard's kept",
+              len(client.clients[0]._leases) == 0)
+
+        # 4. recover: same port, reconnect, server-side admits again.
+        servers[0] = SentinelTokenServer(
+            port=port_a, service=DefaultTokenService()
+        ).start()
+        recovered = False
+        while time.monotonic() < deadline and not recovered:
+            results = client.request_tokens_batch(rows)
+            by_flow = dict(zip([r[0] for r in rows], results))
+            recovered = all(
+                by_flow[f].status == OK for f in flows_a + flows_b
+            )
+            time.sleep(0.05)
+        check("killed shard recovers on the same port", recovered)
+
+        # 5. concurrent gauge drains to exactly 0 on the granting shard.
+        cluster_flow_rule_manager.load_rules(
+            "default",
+            [FlowRule(
+                "smc", count=64, grade=C.FLOW_GRADE_THREAD,
+                cluster_mode=True,
+                cluster_config=ClusterFlowConfig(
+                    flow_id=flows_a[0],
+                    threshold_type=C.FLOW_THRESHOLD_GLOBAL,
+                ),
+            )],
+        )
+        grant = client.request_concurrent_token(flows_a[0], 1)
+        released = (
+            grant.status == OK
+            and client.release_concurrent_token(grant.token_id).status
+            in (OK, C.TokenResultStatus.RELEASE_OK)
+        )
+        check("concurrent token grant/release round trip", released)
+    finally:
+        client.stop()
+        for s in servers:
+            s.stop()
+        cluster_flow_rule_manager.clear()
+        for key in (
+            config.CLUSTER_CLIENT_WINDOW_MS,
+            config.CLUSTER_LEASE_ENABLED,
+            config.CLUSTER_LEASE_TTL_MS,
+        ):
+            config.set(key, config.DEFAULTS[key])
+
+    if FAILURES:
+        print(f"[shard_smoke] FAILED: {', '.join(FAILURES)}")
+        return 1
+    print("[shard_smoke] all green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
