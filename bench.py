@@ -1390,6 +1390,49 @@ def _run_ipc_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         p50 = lats[len(lats) // 2] * 1e6
         p99 = lats[int(len(lats) * 0.99)] * 1e6
 
+        # --- span-armed decomposition (PR 18): the same round trip
+        # with the fleet span journal recording, decomposing the e2e
+        # verdict wait into client encode+push vs engine drain — plus
+        # the armed/unarmed p50 ratio, the bench-side twin of the
+        # slow-tier ≤2% overhead guard. Armed AFTER the headline
+        # percentiles above, so those stay span-free.
+        from sentinel_tpu.metrics.spans import get_journal as _get_spj
+
+        spj = _get_spj()
+        spans_before = len(spj.spans())
+        spj.enabled = True
+        lats_sp = []
+        try:
+            for i in range(1024):
+                t0 = time.perf_counter()
+                cli.entry(resources[i % n_rules])
+                lats_sp.append(time.perf_counter() - t0)
+        finally:
+            spj.enabled = False
+        eng.flush()
+        lats_sp.sort()
+        sp_p50 = lats_sp[len(lats_sp) // 2] * 1e6
+
+        def _span_pcts(vals):
+            vals = sorted(vals)
+            if not vals:
+                return 0.0, 0.0
+            return (vals[len(vals) // 2], vals[int(len(vals) * 0.99)])
+
+        new_spans = spj.spans()[spans_before:]
+        admits_sp = [s for s in new_spans if s["name"] == "admit"]
+        drains_sp = [s for s in new_spans if s["name"] == "drain"]
+        e2e_p50, e2e_p99 = _span_pcts([s["dur"] for s in admits_sp])
+        push_p50, _ = _span_pcts([s.get("push_ms", 0.0) for s in admits_sp])
+        drain_p50, drain_p99 = _span_pcts([s["dur"] for s in drains_sp])
+        span_overhead = sp_p50 / p50 if p50 > 0 else 0.0
+        _log(
+            f"ipc span decomposition: e2e p50 {e2e_p50 * 1e3:.0f} µs "
+            f"(push {push_p50 * 1e3:.0f} µs, engine drain "
+            f"{drain_p50 * 1e3:.0f} µs), armed/unarmed p50 ratio "
+            f"{span_overhead:.3f}"
+        )
+
         # --- concurrency sweep: 1/2/4 workers x per-call vs
         # micro-window (ISSUE 14). Per-call = PR-13 framing (one frame
         # per entry); window = the client-side micro-window coalescing
@@ -1594,6 +1637,17 @@ def _run_ipc_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         "ipc_entry_adaptive_p50_us": round(ad_p50, 1),
         "ipc_entry_adaptive_p99_us": round(ad_p99, 1),
         "ipc_wakeup_speedup": round(wakeup_speedup, 3),
+        # Span-journal decomposition of the entry round trip (ms -> µs):
+        # e2e = the worker admit span (join -> verdict), push = its
+        # client encode + ring-push leg, drain = the engine-side
+        # dequeue -> decide -> respond span. The overhead ratio is the
+        # armed/unarmed p50 A/B (same client, same run).
+        "ipc_span_e2e_p50_us": round(e2e_p50 * 1e3, 1),
+        "ipc_span_e2e_p99_us": round(e2e_p99 * 1e3, 1),
+        "ipc_span_push_p50_us": round(push_p50 * 1e3, 1),
+        "ipc_span_drain_p50_us": round(drain_p50 * 1e3, 1),
+        "ipc_span_drain_p99_us": round(drain_p99 * 1e3, 1),
+        "ipc_span_overhead": round(span_overhead, 3),
         **sweep,
         "ipc_frames": plane_counters.get("frames", 0),
         "ipc_admitted": admitted,
@@ -1727,6 +1781,58 @@ def _run_cluster_stage(n_rules: int, n_ops: int, iters: int) -> dict:
     try:
         for mode in ("percall", "window", "lease"):
             drive(mode)
+
+        # --- span decomposition (PR 18): one single-threaded per-call
+        # round with the fleet span journal armed — the client rpc
+        # span (send -> response) against the shard's serve span
+        # (decode -> decide -> reply, stamped by the same-process
+        # server). rpc − serve ≈ the wire + reader-dispatch share.
+        # Armed AFTER the headline modes, so their ops/s stay
+        # span-free.
+        from sentinel_tpu.metrics.spans import get_journal as _get_spj
+
+        config.set(config.CLUSTER_CLIENT_WINDOW_MS, "0")
+        config.set(config.CLUSTER_LEASE_ENABLED, "false")
+        spj = _get_spj()
+        spans_before = len(spj.spans())
+        dec_ops = min(1024, n_ops)
+        client = ClusterTokenClient("127.0.0.1", server.port).start()
+        try:
+            client.request_token(flow_id)  # connect outside the spans
+            spj.enabled = True
+            for _ in range(dec_ops):
+                client.request_token(flow_id)
+        finally:
+            spj.enabled = False
+            client.stop()
+
+        def _pcts_ms(vals):
+            vals = sorted(vals)
+            if not vals:
+                return 0.0, 0.0
+            return (vals[len(vals) // 2], vals[int(len(vals) * 0.99)])
+
+        new_spans = spj.spans()[spans_before:]
+        rpc_p50, rpc_p99 = _pcts_ms(
+            [s["dur"] for s in new_spans
+             if s["cat"] == "client" and s["name"] == "rpc"]
+        )
+        srv_p50, srv_p99 = _pcts_ms(
+            [s["dur"] for s in new_spans
+             if s["cat"] == "shard" and s["name"] == "serve"]
+        )
+        out["cluster_rpc_p50_ms"] = round(rpc_p50, 4)
+        out["cluster_rpc_p99_ms"] = round(rpc_p99, 4)
+        out["cluster_serve_p50_ms"] = round(srv_p50, 4)
+        out["cluster_serve_p99_ms"] = round(srv_p99, 4)
+        out["cluster_wire_share"] = round(
+            (rpc_p50 - srv_p50) / rpc_p50, 4
+        ) if rpc_p50 > 0 else 0.0
+        _log(
+            f"cluster span decomposition: rpc p50 {rpc_p50:.3f} ms, "
+            f"serve p50 {srv_p50:.3f} ms "
+            f"(wire share {out['cluster_wire_share']:.2f})"
+        )
     finally:
         server.stop()
         cluster_flow_rule_manager.clear()

@@ -21,6 +21,8 @@ from sentinel_tpu.cluster import protocol
 from sentinel_tpu.cluster.token_service import TokenResult, TokenService
 from sentinel_tpu.datasource.backoff import Backoff
 from sentinel_tpu.metrics.histogram import LatencyHistogram
+from sentinel_tpu.metrics.spans import get_journal
+from sentinel_tpu.metrics.spans import wall_ms as _span_wall_ms
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.utils.config import SentinelConfig, config
 from sentinel_tpu.utils.record_log import record_log
@@ -144,6 +146,10 @@ class ClusterTokenClient(TokenService):
         # per connection.
         self._interned: Dict[str, int] = {}
         self._next_vid = 1
+        # Fleet span journal: per-frame RPC spans keyed by xid, the
+        # client half of the shard's serve spans. Role inherits from
+        # whatever process hosts this client (engine, usually).
+        self._spans = get_journal()
 
     # ------------------------------------------------------------------
     def start(self) -> "ClusterTokenClient":
@@ -302,7 +308,14 @@ class ClusterTokenClient(TokenService):
                 self._pending.pop(xid, None)
             self.stats.incr("fallbacks")
             return TokenResult(C.TokenResultStatus.FAIL)
-        self.stats.record_rpc_ms((time.monotonic() - t0) * 1e3)
+        dt_ms = (time.monotonic() - t0) * 1e3
+        self.stats.record_rpc_ms(dt_ms)
+        if self._spans.enabled:
+            t_v = _span_wall_ms()
+            self._spans.record(
+                "rpc", "client", t_v - dt_ms, dt_ms,
+                xid=xid, port=self.port, rows=1,
+            )
         if result.status == C.TokenResultStatus.FAIL:
             self.stats.incr("fallbacks")
         return result
@@ -397,9 +410,17 @@ class ClusterTokenClient(TokenService):
         frame = protocol.pack_flow_batch_request(
             xid, rows, self._drain_lease_reports()
         )
+        spj = self._spans
+        t_r = _span_wall_ms() if spj.enabled else 0.0
         if not self._send_batch_frame(frame, xid, waiters):
             return [TokenResult(C.TokenResultStatus.FAIL)] * len(rows)
-        return self._await_waiters(waiters)
+        out = self._await_waiters(waiters)
+        if spj.enabled:
+            spj.record(
+                "rpc", "client", t_r, _span_wall_ms() - t_r,
+                xid=xid, port=self.port, rows=len(rows),
+            )
+        return out
 
     def _send_batch_frame(self, frame: bytes, xid: int, waiters) -> bool:
         pending = _BatchPending(waiters, self.stats)
@@ -466,6 +487,8 @@ class ClusterTokenClient(TokenService):
             return [TokenResult(C.TokenResultStatus.FAIL)] * len(rows)
         waiters = [_Pending() for _ in rows]
         xid = next(self._xid)
+        spj = self._spans
+        t_r = _span_wall_ms() if spj.enabled else 0.0
         pending = _BatchPending(waiters, self.stats)
         with self._pending_lock:
             self._pending[xid] = pending
@@ -498,7 +521,13 @@ class ClusterTokenClient(TokenService):
             self._maybe_reconnect()
             return [TokenResult(C.TokenResultStatus.FAIL)] * len(rows)
         self.stats.incr("batch_frames")
-        return self._await_waiters(waiters)
+        out = self._await_waiters(waiters)
+        if spj.enabled:
+            spj.record(
+                "rpc", "client", t_r, _span_wall_ms() - t_r,
+                xid=xid, port=self.port, rows=len(rows),
+            )
+        return out
 
     # ------------------------------------------------------------------
     # client micro-window (per-op callers coalesce into one frame)
@@ -650,3 +679,21 @@ class _BatchPending:
             return
         for w, (status, remaining, wait_ms) in zip(self.waiters, rows):
             w.set(TokenResult(C.TokenResultStatus(status), remaining, wait_ms))
+
+
+def fetch_server_stats(host: str, port: int, timeout_sec: float = 2.0) -> dict:
+    """One-shot ``stats`` wire command against a token shard: its own
+    short-lived socket so introspection never competes with (or, on a
+    version-skewed peer, poisons) a live client's xid-multiplexed
+    reader. Raises OSError/ValueError on connect or codec failure."""
+    with socket.create_connection((host, port), timeout=timeout_sec) as s:
+        s.settimeout(timeout_sec)
+        s.sendall(protocol.pack_stats_request(1))
+        payload = protocol.read_frame(s)
+    if payload is None:
+        raise OSError("stats: connection closed before response")
+    mt = protocol.peek_msg_type(payload)
+    if mt != C.MSG_TYPE_STATS:
+        raise ValueError(f"stats: unexpected response type {mt}")
+    _xid, snap = protocol.unpack_stats_response(payload)
+    return snap

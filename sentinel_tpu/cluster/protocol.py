@@ -336,6 +336,7 @@ _KNOWN_MSG_TYPES = frozenset(
         C.MSG_TYPE_CONCURRENT_FLOW_RELEASE,
         C.MSG_TYPE_FLOW_BATCH,
         C.MSG_TYPE_PARAM_FLOW_BATCH,
+        C.MSG_TYPE_STATS,
     )
 )
 
@@ -428,6 +429,10 @@ def unpack_request(payload: bytes) -> Tuple[int, int, tuple]:
         return xid, msg_type, _unpack_flow_batch(xid, payload, off)
     if msg_type == C.MSG_TYPE_PARAM_FLOW_BATCH:
         return xid, msg_type, _unpack_param_batch(xid, payload, off)
+    if msg_type == C.MSG_TYPE_STATS:
+        if off != len(payload):
+            raise ValueError("trailing bytes after stats request")
+        return xid, msg_type, ()
     flow_id, acquire, prio = _FLOW_BODY.unpack_from(payload, off)
     off += _FLOW_BODY.size
     if msg_type == C.MSG_TYPE_FLOW:
@@ -454,6 +459,44 @@ def unpack_request(payload: bytes) -> Tuple[int, int, tuple]:
 def unpack_response(payload: bytes) -> Tuple[int, int, int, int, int, int]:
     """-> (xid, msg_type, status, remaining, wait_ms, token_id)."""
     return _RESP.unpack(payload)
+
+
+def pack_stats_request(xid: int) -> bytes:
+    payload = _REQ_HDR.pack(xid, C.MSG_TYPE_STATS)
+    return _LEN.pack(len(payload)) + payload
+
+
+def pack_stats_response(xid: int, snapshot: dict) -> bytes:
+    """JSON body behind the standard header: the snapshot is
+    introspective (shapes evolve per release), so a self-describing
+    encoding beats a frozen struct here. A version byte guards the
+    body format like the batch codecs."""
+    import json as _json
+
+    body = _json.dumps(snapshot, separators=(",", ":")).encode("utf-8")
+    payload = (
+        _REQ_HDR.pack(xid, C.MSG_TYPE_STATS)
+        + struct.pack("<B", BATCH_VERSION)
+        + body
+    )
+    return _LEN.pack(len(payload)) + payload
+
+
+def unpack_stats_response(payload: bytes) -> Tuple[int, dict]:
+    """-> (xid, snapshot dict). Raises UnsupportedBatchVersion for a
+    version byte this build cannot parse."""
+    import json as _json
+
+    xid, msg_type = _REQ_HDR.unpack_from(payload, 0)
+    off = _REQ_HDR.size
+    (ver,) = struct.unpack_from("<B", payload, off)
+    off += 1
+    if ver != BATCH_VERSION:
+        raise UnsupportedBatchVersion(xid, C.MSG_TYPE_STATS, ver)
+    obj = _json.loads(payload[off:].decode("utf-8"))
+    if not isinstance(obj, dict):
+        raise ValueError("stats response body is not an object")
+    return xid, obj
 
 
 def read_frame(sock) -> Optional[bytes]:

@@ -20,6 +20,8 @@ from typing import Optional
 
 from sentinel_tpu.cluster import protocol
 from sentinel_tpu.cluster.token_service import DefaultTokenService, TokenService
+from sentinel_tpu.metrics.spans import get_journal
+from sentinel_tpu.metrics.spans import wall_ms as _span_wall_ms
 from sentinel_tpu.models import constants as C
 from sentinel_tpu.utils.config import SentinelConfig, config
 from sentinel_tpu.utils.record_log import record_log
@@ -36,6 +38,7 @@ class _Handler(socketserver.BaseRequestHandler):
         # param rows reference values by id, each value string crosses
         # the wire once per connection lifetime.
         interned: dict = {}
+        spj = server._spans
         try:
             while True:
                 try:
@@ -48,6 +51,9 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
                 if payload is None:
                     return
+                # Span: decode→decide→reply, stamped before the body
+                # parse so codec time is inside the serve span.
+                t_serve = _span_wall_ms() if spj.enabled else 0.0
                 try:
                     xid, msg_type, body = protocol.unpack_request(payload)
                 except protocol.UnknownMsgType as e:
@@ -145,6 +151,14 @@ class _Handler(socketserver.BaseRequestHandler):
                     (token_id,) = body
                     r = server.service.release_concurrent_token(token_id)
                     resp = protocol.pack_response(xid, msg_type, int(r.status))
+                elif msg_type == C.MSG_TYPE_STATS:
+                    # Introspection, not a token decision: the snapshot
+                    # must not inflate the decisions/busy_s capacity
+                    # accounting the bench reads.
+                    n_decisions = 0
+                    resp = protocol.pack_stats_response(
+                        xid, server.stats_snapshot()
+                    )
                 else:
                     # Defensive: unpack raises UnknownMsgType before
                     # dispatch, but a type added to _KNOWN_MSG_TYPES
@@ -155,6 +169,13 @@ class _Handler(socketserver.BaseRequestHandler):
                     )
                 server._note_work(n_decisions, time.perf_counter() - t_work)
                 self.request.sendall(resp)
+                if spj.enabled:
+                    spj.record(
+                        "serve", "shard", t_serve,
+                        _span_wall_ms() - t_serve,
+                        xid=xid, mt=msg_type, rows=n_decisions,
+                        port=server.port,
+                    )
         except (ConnectionError, OSError):
             pass
         finally:
@@ -220,6 +241,11 @@ class SentinelTokenServer:
         self.decisions = 0
         self.frames = 0
         self.busy_s = 0.0
+        self.lease_grants = 0
+        # Fleet span journal: serve spans (decode→decide→reply) keyed
+        # by xid so fleetdump can pair them with the cluster client's
+        # RPC spans.
+        self._spans = get_journal("shard")
 
     def _note_work(self, n_decisions: int, dt_s: float) -> None:
         with self._work_lock:
@@ -233,6 +259,7 @@ class SentinelTokenServer:
                 "frames": self.frames,
                 "decisions": self.decisions,
                 "busy_s": self.busy_s,
+                "lease_grants": self.lease_grants,
             }
 
     def reset_work_stats(self) -> None:
@@ -240,6 +267,22 @@ class SentinelTokenServer:
             self.frames = 0
             self.decisions = 0
             self.busy_s = 0.0
+            self.lease_grants = 0
+
+    def stats_snapshot(self) -> dict:
+        """The ``stats`` wire command's body: work clocks + stat-log
+        counters + connection count — per-shard state readable by any
+        client, not just the bench harness."""
+        from sentinel_tpu.cluster import stat_log
+
+        with self._lock:
+            conns = self._conn_count
+        return {
+            "port": self.port,
+            "connections": conns,
+            "work": self.work_stats(),
+            "stat_log": stat_log.counters_snapshot(),
+        }
 
     def _stamp_accept(self, sock) -> None:
         with self._lock:
@@ -323,6 +366,9 @@ class SentinelTokenServer:
             debit = self.service.request_token(flow_id, grant)
             if debit.status == C.TokenResultStatus.OK:
                 leases.append((flow_id, grant, ttl_ms))
+        if leases:
+            with self._work_lock:
+                self.lease_grants += len(leases)
         return leases
 
     def _note_lease_reports(self, reports) -> None:
@@ -375,5 +421,12 @@ class SentinelTokenServer:
                 pass
             try:
                 s.close()
+            except OSError:
+                pass
+        if self._spans.enabled:
+            # A shard's serve spans must outlive its process for
+            # fleetdump to merge.
+            try:
+                self._spans.spill()
             except OSError:
                 pass

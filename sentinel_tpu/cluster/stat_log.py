@@ -11,7 +11,7 @@ the tag, size-rolled output.
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 from sentinel_tpu.metrics.block_log import BlockLogger
 
@@ -19,6 +19,32 @@ FILE_NAME = "sentinel-cluster.log"
 
 _lock = threading.Lock()
 _logger: Optional[BlockLogger] = None
+
+# In-memory (category, outcome) counters mirroring every line fed to
+# the BlockLogger: the write-only log keeps its reference shape, while
+# the ``stats`` wire command and the cluster-server Prometheus
+# families read these. Guarded by its own lock — counting must never
+# serialize on the logger's I/O.
+_counts_lock = threading.Lock()
+_counts: Dict[Tuple[str, str], int] = {}
+
+
+def _count(category: str, outcome: str, n: int) -> None:
+    key = (category, outcome)
+    with _counts_lock:
+        _counts[key] = _counts.get(key, 0) + n
+
+
+def counters_snapshot() -> Dict[str, int]:
+    """-> {"category.outcome": count} for every line ever logged in
+    this process (since the last reset)."""
+    with _counts_lock:
+        return {f"{c}.{o}": n for (c, o), n in _counts.items()}
+
+
+def reset_counters() -> None:
+    with _counts_lock:
+        _counts.clear()
 
 
 def _get_logger() -> BlockLogger:
@@ -42,12 +68,16 @@ def set_logger(logger: Optional[BlockLogger]) -> None:
 def log(category: str, outcome: str, flow_id: int, count: int = 1) -> None:
     """``log("concurrent", "block", flowId, n)`` ≙
     ClusterServerStatLogUtil.log("concurrent|block|<id>", n)."""
+    _count(category, outcome, count)
     _get_logger().stat(category, outcome, str(int(flow_id)), count=count)
 
 
 def log_many(items) -> None:
     """Batched variant: one lock acquisition for a whole flush's
     decisions — items of (category, outcome, flow_id, count)."""
+    items = list(items)
+    for c, o, _f, n in items:
+        _count(c, o, n)
     _get_logger().log_batch(
         [(c, o, str(int(f)), n) for c, o, f, n in items]
     )

@@ -47,6 +47,12 @@ class MachineInfo:
     ingest_armed: int = 0
     shed_total: int = 0
     shedding: int = 0
+    # Engine lifecycle provenance (PR 18 heartbeat enrichment):
+    # epoch 1 = first boot of the shared rings; restarts = epoch - 1;
+    # workers = currently-attached ingest workers on the mp plane.
+    engine_epoch: int = 0
+    restarts_total: int = 0
+    workers: int = 0
     last_heartbeat_ms: float = field(default_factory=lambda: time.time() * 1000)
 
     @property
@@ -71,7 +77,8 @@ class AppManagement:
                 existing.last_heartbeat_ms = time.time() * 1000
                 existing.version = info.version or existing.version
                 for f in ("health", "spec_enabled", "spec_suspended",
-                          "ingest_armed", "shed_total", "shedding"):
+                          "ingest_armed", "shed_total", "shedding",
+                          "engine_epoch", "restarts_total", "workers"):
                     setattr(existing, f, getattr(info, f))
             else:
                 self._machines[info.key] = info
@@ -370,6 +377,9 @@ class DashboardServer:
                     ingest_armed=_i("ingest_armed"),
                     shed_total=_i("shed_total"),
                     shedding=_i("shedding"),
+                    engine_epoch=_i("engine_epoch"),
+                    restarts_total=_i("restarts_total"),
+                    workers=_i("workers"),
                 )
             except ValueError:
                 return 400, json.dumps({"code": -1, "msg": "bad port"})
@@ -392,6 +402,9 @@ class DashboardServer:
                             "ingest_armed": m.ingest_armed,
                             "shed_total": m.shed_total,
                             "shedding": m.shedding,
+                            "engine_epoch": m.engine_epoch,
+                            "restarts_total": m.restarts_total,
+                            "workers": m.workers,
                             "last_heartbeat_ms": int(m.last_heartbeat_ms),
                             # Server-computed age: the console must not
                             # mix its own clock with the dashboard's
@@ -407,6 +420,30 @@ class DashboardServer:
                     for app, machines in self.apps.apps().items()
                 }
             )
+        if path == "/fleet":
+            # Fleet rollup: one JSON object per app summarising its
+            # machines — the console's /fleet card and any external
+            # poller get the whole fleet's posture in one round-trip
+            # instead of a per-machine scrape. Divergent engine_epoch
+            # across one app's machines means some heartbeats predate
+            # a hot-restart: flagged as stale_epochs.
+            out = {}
+            for app, machines in self.apps.apps().items():
+                max_epoch = max((m.engine_epoch for m in machines), default=0)
+                out[app] = {
+                    "machines": len(machines),
+                    "healthy": sum(1 for m in machines if m.is_healthy()),
+                    "workers": sum(m.workers for m in machines),
+                    "restarts_total": sum(m.restarts_total for m in machines),
+                    "shed_total": sum(m.shed_total for m in machines),
+                    "shedding": sum(1 for m in machines if m.shedding),
+                    "max_epoch": max_epoch,
+                    "stale_epochs": sum(
+                        1 for m in machines
+                        if m.engine_epoch and m.engine_epoch < max_epoch
+                    ),
+                }
+            return 200, json.dumps(out)
         if path == "/metric":
             app = params.get("app", "")
             resource = params.get("identity", "")

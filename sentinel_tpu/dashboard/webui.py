@@ -78,7 +78,7 @@ CONSOLE_HTML = """<!DOCTYPE html>
     <h2>Machines</h2>
     <table id="machines"><thead><tr>
       <th>machine</th><th>version</th><th>health</th><th>speculative</th>
-      <th>shed</th><th>heartbeat</th>
+      <th>shed</th><th>engine</th><th>heartbeat</th>
     </tr></thead><tbody></tbody></table>
     <h2>Real-time metrics <span id="appname"></span></h2>
     <table id="metrics"><thead><tr>
@@ -135,6 +135,10 @@ const esc = (s) => String(s).replace(/[&<>"']/g,
 function renderMachines(ms) {
   const body = $('machines').tBodies[0];
   const num = (v) => (Number.isFinite(+v) ? +v : 0);
+  // The highest engine_epoch any machine of this app reported: a
+  // machine still heartbeating a LOWER epoch predates a hot-restart
+  // (its worker fleet reattached to newer rings) — highlight it.
+  const maxEpoch = Math.max(0, ...(ms || []).map(m => +m.engine_epoch || 0));
   body.innerHTML = (ms || []).map(m => {
     const stale = !m.healthy;
     const health = m.health || '';
@@ -149,6 +153,13 @@ function renderMachines(ms) {
         `${m.ingest_armed ? '' : ' (disarmed)'}`;
     const hcls = stale || health === 'DEGRADED' ? 'block'
       : health === 'RECOVERING' ? 'warn' : reported ? 'pass' : '';
+    const epoch = num(m.engine_epoch);
+    const staleEpoch = epoch > 0 && epoch < maxEpoch;
+    const eng = !epoch ? '—'
+      : `epoch ${epoch}` +
+        `${num(m.restarts_total) ? ` (${num(m.restarts_total)} restarts)` : ''}` +
+        ` · ${num(m.workers)}w${staleEpoch ? ' (stale epoch)' : ''}`;
+    const ecls = staleEpoch ? 'block' : num(m.restarts_total) ? 'warn' : '';
     const hb = m.heartbeat_age_ms != null
       ? Math.round(num(m.heartbeat_age_ms) / 1000) + 's ago'  // server-computed: immune to browser clock skew
       : '—';
@@ -157,8 +168,9 @@ function renderMachines(ms) {
       `<td class="${hcls}">${esc(health || '—')}${stale ? ' (stale)' : ''}</td>` +
       `<td class="${m.spec_suspended ? 'warn' : ''}">${spec}</td>` +
       `<td class="${m.shedding ? 'block' : ''}">${shed}</td>` +
+      `<td class="${ecls}">${eng}</td>` +
       `<td>${hb}</td></tr>`;
-  }).join('') || '<tr><td colspan="6" class="empty">no machines</td></tr>';
+  }).join('') || '<tr><td colspan="7" class="empty">no machines</td></tr>';
 }
 
 async function refreshApps() {

@@ -54,6 +54,8 @@ from sentinel_tpu.ipc.ring import (
     resolve_spin_us,
 )
 from sentinel_tpu.ipc.worker import PlaneChannel
+from sentinel_tpu.metrics.spans import get_journal
+from sentinel_tpu.metrics.spans import wall_ms as _span_wall_ms
 from sentinel_tpu.utils.config import config
 
 
@@ -182,6 +184,9 @@ class IngestPlane:
             "stale_frames": 0,
         }
         self._policy_published: Optional[str] = None
+        # Fleet span journal: per-frame drain spans on the same
+        # wall-ms ruler this plane's control header publishes.
+        self._spans = get_journal("engine")
         self._last_sweep = 0.0
         # World generation: bumped by on_engine_reset so a decision
         # batch that STARTED before a reset cannot insert ledger
@@ -411,6 +416,9 @@ class IngestPlane:
             return False
         eng = self._engine
         tele = eng.telemetry
+        spj = self._spans
+        t_drain = _span_wall_ms() if spj.enabled else 0.0
+        frame_meta: Optional[List[tuple]] = [] if spj.enabled else None
         groups: Dict[tuple, list] = {}
         exits: List[tuple] = []
         responses: Dict[int, list] = {}
@@ -446,6 +454,11 @@ class IngestPlane:
             self.counters["frames"] += 1
             if f.kind in (fr.KIND_ENTRY, fr.KIND_BULK):
                 n_rows += f.n
+                if frame_meta is not None and f.n:
+                    s = f.columns["seq"]
+                    frame_meta.append(
+                        (f.worker_id, int(s[0]), int(s[f.n - 1]), int(f.n))
+                    )
                 self._collect_entries(f, ws, groups, responses)
             elif f.kind == fr.KIND_EXIT:
                 self._collect_exits(f, ws, exits)
@@ -459,6 +472,23 @@ class IngestPlane:
         if groups:
             self._decide_groups(groups, responses)
         self._send_responses(responses)
+        if spj.enabled:
+            # One drain span for the batch plus one per entry/bulk
+            # frame carrying the (wid, seq range) correlation key the
+            # worker's admit spans point at. The frame spans share the
+            # drain interval: dequeue happened at t_drain, the verdict
+            # left with _send_responses.
+            t_end = _span_wall_ms()
+            dur = t_end - t_drain
+            spj.record(
+                "drain", "engine", t_drain, dur,
+                frames=len(payloads), rows=n_rows,
+            )
+            for wid, lo, hi, n in frame_meta or ():
+                spj.record(
+                    "frame", "engine", t_drain, dur,
+                    wid=wid, seq_lo=lo, seq_hi=hi, rows=n,
+                )
         return True
 
     # -- decode helpers -------------------------------------------------
@@ -908,6 +938,12 @@ class IngestPlane:
         if self.closed:
             health = HEALTH_CLOSED
         self.control.beat_engine(health)
+        if self._spans.enabled:
+            # The engine IS the ruler source: its skew to the header
+            # beat is ~0, but noting it keeps the journal meta uniform
+            # across roles.
+            _e, _h, _g, wall = self.control.engine_view()
+            self._spans.note_ruler(wall)
         raw = config.get(config.FAILOVER_POLICY) or "open"
         if force or raw != self._policy_published:
             default, overrides = parse_policy(raw)
@@ -1088,6 +1124,11 @@ class IngestPlane:
                 self._reap_worker(wid, ws)
         if self._engine.ipc_plane is self:
             self._engine.ipc_plane = None
+        if self._spans.enabled:
+            try:
+                self._spans.spill()
+            except OSError:
+                pass
         self.request.destroy()
         for r in self.responses:
             if r is not None:

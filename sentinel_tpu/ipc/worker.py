@@ -46,6 +46,8 @@ from sentinel_tpu.ipc.ring import (
     _wall_ms,
     resolve_spin_us,
 )
+from sentinel_tpu.metrics.spans import get_journal
+from sentinel_tpu.metrics.spans import wall_ms as _span_wall_ms
 from sentinel_tpu.utils.config import config
 
 
@@ -162,6 +164,10 @@ class IngestClient:
         self._intern_gen = self.control.intern_gen()
         self._next_id = 1
         self._waiters: Dict[int, _Waiter] = {}
+        # Fleet span journal (metrics/spans.py): admission spans on
+        # the control header's wall-ms ruler. Disabled (default) is
+        # one bool read per call site.
+        self._spans = get_journal("worker")
         self._shed_total = 0
         self.counters: Dict[str, int] = {
             "entries": 0, "bulk_rows": 0, "exits": 0, "exits_dropped": 0,
@@ -498,6 +504,10 @@ class IngestClient:
             return False
         if wall == 0:
             return False  # plane never heartbeat — not serving
+        if self._spans.enabled:
+            # The header beat IS the shared ruler: remember the latest
+            # one so each journal spill carries this process's skew.
+            self._spans.note_ruler(wall)
         return (_wall_ms() - wall) <= self.engine_dead_ms
 
     def _policy_verdict(self, resource: str) -> fr.IpcVerdict:
@@ -609,6 +619,8 @@ class IngestClient:
             [fr.ENTRY_ROW_BYTES + len(r.args) for r in rows], budget,
             "window",
         )
+        spj = self._spans
+        t_flush = _span_wall_ms() if (spj.enabled and rows) else 0.0
         for ci, (clo, chi) in enumerate(chunks):
             sub = rows[clo:chi]
             try:
@@ -664,6 +676,13 @@ class IngestClient:
                     for r in rows[slo:shi]:
                         self._win_bulk.discard(r.seq)
             break
+        if spj.enabled and rows:
+            spj.record(
+                "win.flush", "worker", t_flush,
+                _span_wall_ms() - t_flush,
+                wid=self.worker_id, rows=len(rows),
+                seq_lo=rows[0].seq, seq_hi=rows[-1].seq,
+            )
         self._win_drain_exits_locked()
 
     def _win_shed_locked(self, sub: List[fr.EntryRow]) -> None:
@@ -813,6 +832,8 @@ class IngestClient:
             if trace is not None
             else fr.EMPTY_TRACE
         )
+        spj = self._spans
+        t_join = _span_wall_ms() if spj.enabled else 0.0
         args_blob = fr.encode_args(args)
         if (
             fr.ENTRY_ROW_BYTES + len(args_blob)
@@ -857,16 +878,32 @@ class IngestClient:
                     del self._waiters[seq]
         if not ok:
             return self._shed_verdict()
+        t_push = _span_wall_ms() if spj.enabled else 0.0
         if not self.window_armed:
             # Windowed entries count at flush time instead, once their
             # frame actually pushes — a later window shed must not
             # have pre-counted the row.
             self.counters["entries"] += 1
-        return self._await_one(
+        out = self._await_one(
             w, seq, resource, timeout_ms,
             live_ident=(resource, context_name, origin, int(entry_type),
                         int(acquire)),
         )
+        if spj.enabled:
+            # One span per admission: t0 at join, `push_ms` when the
+            # frame (or window join) was in the ring, `v` the wall-ms
+            # verdict stamp the alignment test pins against the
+            # engine's frame-drain span.
+            t_v = _span_wall_ms()
+            spj.record(
+                "admit", "worker", t_join, t_v - t_join,
+                wid=self.worker_id, seq=seq,
+                push_ms=round(t_push - t_join, 3),
+                v=round(t_v, 3),
+                win=int(self.window_armed), adm=int(out.admitted),
+                trace=(trace.trace_id if trace is not None else None),
+            )
+        return out
 
     def bulk(
         self,
@@ -961,8 +998,10 @@ class IngestClient:
                 out_w[j] = wms
                 out_f[j] = fl
             return out_a, out_r, out_w, out_f
+        spj = self._spans
         for lo, hi in chunks:
             m = hi - lo
+            t_join = _span_wall_ms() if spj.enabled else 0.0
             with self._lock:
                 base = self._seq
                 self._seq += m
@@ -1013,6 +1052,13 @@ class IngestClient:
                 out_r[lo + j] = rsn
                 out_w[lo + j] = wms
                 out_f[lo + j] = fl
+            if spj.enabled:
+                t_v = _span_wall_ms()
+                spj.record(
+                    "admit.bulk", "worker", t_join, t_v - t_join,
+                    wid=self.worker_id, seq=base, rows=m,
+                    v=round(t_v, 3),
+                )
         return out_a, out_r, out_w, out_f
 
     def exit(
@@ -1216,6 +1262,7 @@ class IngestClient:
 
     def _read_loop(self) -> None:
         park = 0.0005
+        spj = self._spans
         while not self._stop.is_set():
             payloads = self.response.pop_all(limit=64)
             if not payloads:
@@ -1223,7 +1270,21 @@ class IngestClient:
                     # Spin-then-park: the verdict frame usually lands
                     # within the spin; the park (doorbell-ended, timeout
                     # growing to the cap) bounds idle burn.
-                    if not self.response.wait_readable(self._spin_s, park):
+                    if spj.enabled:
+                        t_p = _span_wall_ms()
+                        if self.response.wait_readable(self._spin_s, park):
+                            # Productive wakes only — an idle client
+                            # parking forever must not flood the ring.
+                            spj.record(
+                                "wake", "worker", t_p,
+                                _span_wall_ms() - t_p,
+                                wid=self.worker_id,
+                            )
+                        else:
+                            park = min(park * 2, self._park_s)
+                    elif not self.response.wait_readable(
+                        self._spin_s, park
+                    ):
                         park = min(park * 2, self._park_s)
                 else:
                     time.sleep(0.0002)
@@ -1295,6 +1356,13 @@ class IngestClient:
             try:
                 self.control.clear_worker(self.worker_id)
             except (ValueError, TypeError):
+                pass
+        if self._spans.enabled:
+            # Final journal spill: a worker's spans must survive its
+            # exit for fleetdump to merge.
+            try:
+                self._spans.spill()
+            except OSError:
                 pass
         self.request.close()
         self.response.close()

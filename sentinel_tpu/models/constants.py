@@ -106,6 +106,10 @@ MSG_TYPE_PARAM_FLOW_BATCH = 17
 # arrays + candidate tables so heavy hitters are detected fleet-wide.
 MSG_TYPE_SKETCH_PUSH = 18
 MSG_TYPE_SKETCH_MERGED = 19
+# Shard introspection (this framework's own): one round trip returns
+# the server's work clocks / stat-log counters as a JSON snapshot so
+# per-shard state is readable outside the bench harness.
+MSG_TYPE_STATS = 20
 
 FLOW_THRESHOLD_AVG_LOCAL = 0
 FLOW_THRESHOLD_GLOBAL = 1

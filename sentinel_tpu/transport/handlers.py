@@ -397,13 +397,43 @@ def prometheus_handler(req: CommandRequest) -> CommandResponse:
     # 0.0.4 text parser rejects a mid-line '#', failing the whole
     # scrape — so the format (and content type) switch together.
     om = req.params.get("format", "").lower() == "openmetrics"
-    return CommandResponse(
-        True,
-        render_metrics(_engine(), openmetrics=om),
+    content_type = (
         OPENMETRICS_CONTENT_TYPE
         if om
-        else "text/plain; version=0.0.4; charset=utf-8",
+        else "text/plain; version=0.0.4; charset=utf-8"
     )
+    # Metrics federation: a worker-mode process has NO engine — its
+    # scrape is the sentinel_worker_* families (constructing an engine
+    # here would defeat worker mode's whole point), while an engine
+    # process renders the engine families plus, when a token shard is
+    # embedded in-process, the shard's sentinel_cluster_server_* rows.
+    from sentinel_tpu.ipc import worker_mode
+
+    wcli = worker_mode.current()
+    if wcli is not None:
+        from sentinel_tpu.transport.prometheus import render_worker_metrics
+
+        return CommandResponse(
+            True, render_worker_metrics(wcli, openmetrics=om), content_type
+        )
+    text = render_metrics(_engine(), openmetrics=om)
+    from sentinel_tpu.cluster.state import EmbeddedClusterTokenServerProvider
+
+    srv = EmbeddedClusterTokenServerProvider.get_server()
+    if srv is not None:
+        from sentinel_tpu.transport.prometheus import (
+            cluster_server_metric_lines,
+        )
+
+        extra = "\n".join(
+            cluster_server_metric_lines(srv, openmetrics=om)
+        ) + "\n"
+        if om and text.endswith("# EOF\n"):
+            # The OM terminator must stay last.
+            text = text[: -len("# EOF\n")] + extra + "# EOF\n"
+        else:
+            text += extra
+    return CommandResponse(True, text, content_type)
 
 
 @command_mapping(
@@ -604,4 +634,33 @@ def traces_handler(req: CommandRequest) -> CommandResponse:
         r.as_dict()
         for r in tracer.records(n=n or None, resource=resource, reason=reason)
     ]
+    return CommandResponse.of_json(out)
+
+
+@command_mapping(
+    "spans",
+    "fleet span journal: per-process admission spans"
+    " [?n=N last spans][&cat=worker|engine|client|shard][&spill=1]",
+)
+def spans_handler(req: CommandRequest) -> CommandResponse:
+    """The per-process half of the fleet timeline (metrics/spans.py):
+    journal state plus the last N buffered spans. ``spill=1`` forces a
+    journal-file spill so ``tools/fleetdump.py`` can merge a LIVE
+    process without waiting for its close — the command answers with
+    the spill path."""
+    from sentinel_tpu.metrics.spans import get_journal
+
+    j = get_journal()
+    n, err = _count_param(req, "n")
+    if err is not None:
+        return err
+    out = j.snapshot()
+    cat = req.params.get("cat") or None
+    if n > 0:
+        out["spans"] = j.spans(cat=cat)[-n:]
+    if req.params.get("spill") in ("1", "true"):
+        try:
+            out["spilled_to"] = j.spill()
+        except OSError as e:
+            return CommandResponse.of_failure(f"spill failed: {e}")
     return CommandResponse.of_json(out)

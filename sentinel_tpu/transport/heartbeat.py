@@ -99,6 +99,15 @@ class HeartbeatSender:
             self._last_shed_total = 0
         shedding = shed_total > self._last_shed_total
         self._pending_shed_total = shed_total
+        # Engine lifecycle provenance (PR 15 exposed these in
+        # Prometheus; riding the heartbeat lets the dashboard's
+        # Machines table flag a recently hot-restarted engine without
+        # a scrape round-trip per machine). epoch 1 = first boot of
+        # the shared rings; restarts = epoch - 1, matching the
+        # sentinel_engine_restarts_total definition.
+        plane = getattr(engine, "ipc_plane", None)
+        epoch = plane.engine_epoch if plane is not None else 1
+        workers = plane.live_workers() if plane is not None else 0
         return {
             "health": engine.failover.state,
             "spec_enabled": int(spec.enabled),
@@ -106,6 +115,9 @@ class HeartbeatSender:
             "ingest_armed": int(valve.armed),
             "shed_total": shed_total,
             "shedding": int(shedding),
+            "engine_epoch": epoch,
+            "restarts_total": max(0, epoch - 1),
+            "workers": workers,
         }
 
     def heartbeat_once(self) -> bool:

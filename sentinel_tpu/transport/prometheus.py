@@ -798,3 +798,130 @@ def resource_provenance_lines(engine, openmetrics: bool = False) -> List[str]:
                 f'{name}{{resource="{_escape_label(res)}"}} {folded[res][col]}'
             )
     return out
+
+
+def worker_metric_lines(client=None, openmetrics: bool = False) -> List[str]:
+    """The ``sentinel_worker_*`` family: one worker process's
+    IngestClient counters — admissions, frames-per-entry amortization,
+    shed causes (ring sheds vs policy serves vs dropped completions)
+    and reconnect state. ``client=None`` renders zero-valued families
+    (the metrics-federation twin of the cluster singletons: the
+    families must exist from the first scrape, and the config audit
+    introspects this render without a live plane)."""
+    p = f"{_PREFIX}_worker"
+    snap = client.snapshot() if client is not None else {}
+    c = snap.get("counters", {})
+    out: List[str] = []
+
+    def ctr(name: str, help_text: str, value) -> List[str]:
+        return _counter(name, help_text, value, openmetrics)
+
+    out += ctr(f"{p}_entries_total",
+               "Per-call admissions pushed through the plane", c.get("entries", 0))
+    out += ctr(f"{p}_bulk_rows_total",
+               "Bulk admission rows pushed through the plane", c.get("bulk_rows", 0))
+    out += ctr(f"{p}_exits_total",
+               "Completions delivered to the engine", c.get("exits", 0))
+    out += ctr(f"{p}_exits_dropped_total",
+               "Completions dropped (engine provably gone)", c.get("exits_dropped", 0))
+    out += ctr(f"{p}_sheds_total",
+               "Local BLOCK_SHED verdicts (request ring full)", c.get("sheds", 0))
+    out += ctr(f"{p}_policy_served_total",
+               "Verdicts served from the failover policy snapshot "
+               "(engine dead or verdict timeout)", c.get("policy_served", 0))
+    out += ctr(f"{p}_frames_total",
+               "Request frames pushed onto the shared-memory ring", c.get("frames", 0))
+    out += ctr(f"{p}_window_flushes_total",
+               "Client micro-window flushes", c.get("window_flushes", 0))
+    out += ctr(f"{p}_reconnects_total",
+               "Engine hot-restart reconnects (boot epoch bumps seen)",
+               c.get("reconnects", 0))
+    ops = c.get("entries", 0) + c.get("bulk_rows", 0)
+    out += _gauge(
+        f"{p}_frames_per_entry",
+        "Request frames per admission row (micro-window amortization; "
+        "1.0 = per-call framing)",
+        round(c.get("frames", 0) / ops, 4) if ops else 0.0,
+    )
+    out += _gauge(f"{p}_engine_alive",
+                  "Engine heartbeat fresh from this worker's view (1 = alive)",
+                  int(bool(snap.get("engine_alive", 0))))
+    out += _gauge(f"{p}_live_admissions",
+                  "Admissions this worker holds open (reconnect ledger)",
+                  snap.get("live_admissions", 0))
+    out += _gauge(f"{p}_pending_waits",
+                  "Callers parked waiting for a verdict",
+                  snap.get("pending_waits", 0))
+    out += _gauge(f"{p}_buffered_exits",
+                  "Completions buffered for post-restart replay",
+                  snap.get("buffered_exits", 0))
+    out += _gauge(f"{p}_id", "This process's worker slot id",
+                  snap.get("worker_id", -1))
+    return out
+
+
+def render_worker_metrics(client=None, openmetrics: bool = False) -> str:
+    """Full exposition for a worker-mode process (no engine to
+    render — the worker families ARE its scrape)."""
+    out = worker_metric_lines(client, openmetrics=openmetrics)
+    if openmetrics:
+        out.append("# EOF")
+    return "\n".join(out) + "\n"
+
+
+def cluster_server_metric_lines(server=None, openmetrics: bool = False) -> List[str]:
+    """The ``sentinel_cluster_server_*`` family: a token shard's work
+    clocks (decisions, frames, busy seconds), lease grants, connection
+    count per namespace, and the per-(category,outcome) stat-log rows.
+    ``server=None`` renders zero-valued families for the same
+    first-scrape/audit reasons as the worker render."""
+    p = f"{_PREFIX}_cluster_server"
+    work = server.work_stats() if server is not None else {}
+    out: List[str] = []
+
+    def ctr(name: str, help_text: str, value) -> List[str]:
+        return _counter(name, help_text, value, openmetrics)
+
+    out += ctr(f"{p}_decisions_total",
+               "Token decisions made by this shard", work.get("decisions", 0))
+    out += ctr(f"{p}_frames_total",
+               "Request frames handled (decode->decide->pack)",
+               work.get("frames", 0))
+    out += ctr(f"{p}_busy_seconds_total",
+               "Handler seconds spent deciding (excluding socket waits)",
+               round(work.get("busy_s", 0.0), 6))
+    out += ctr(f"{p}_lease_grants_total",
+               "Local-quota leases granted to clients", work.get("lease_grants", 0))
+    name = f"{p}_connections"
+    out.append(f"# HELP {name} Connected token clients per namespace")
+    out.append(f"# TYPE {name} gauge")
+    groups = server.connections.snapshot() if server is not None else {}
+    for ns, n in sorted(groups.items()):
+        out.append(f'{name}{{namespace="{_escape_label(ns)}"}} {n}')
+    if not groups:
+        out.append(f'{name}{{namespace="default"}} 0')
+    from sentinel_tpu.cluster import stat_log
+
+    name = f"{p}_stat_total"
+    fam = name[:-len("_total")] if openmetrics else name
+    out.append(f"# HELP {fam} Stat-log lines per (category, outcome) "
+               "— the wire twin of sentinel-cluster.log")
+    out.append(f"# TYPE {fam} counter")
+    counts = stat_log.counters_snapshot() if server is not None else {}
+    for key, n in sorted(counts.items()):
+        cat, _, outcome = key.partition(".")
+        out.append(
+            f'{name}{{category="{_escape_label(cat)}",'
+            f'outcome="{_escape_label(outcome)}"}} {n}'
+        )
+    if not counts:
+        out.append(f'{name}{{category="flow",outcome="pass"}} 0')
+    return out
+
+
+def render_cluster_server_metrics(server=None, openmetrics: bool = False) -> str:
+    """Full exposition for a token shard process."""
+    out = cluster_server_metric_lines(server, openmetrics=openmetrics)
+    if openmetrics:
+        out.append("# EOF")
+    return "\n".join(out) + "\n"

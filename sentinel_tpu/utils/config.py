@@ -436,6 +436,22 @@ class SentinelConfig:
     GOSSIP_PEERS = "sentinel.tpu.gossip.peers"
     GOSSIP_INTERVAL_MS = "sentinel.tpu.gossip.interval.ms"
     GOSSIP_STALE_WINDOWS = "sentinel.tpu.gossip.stale.windows"
+    # Fleet span journal (metrics/spans.py): per-process bounded ring
+    # of wall-clock admission spans (worker join->verdict, engine
+    # frame drain, cluster RPC, shard serve) with rolling jsonl spill
+    # for tools/fleetdump.py to merge into one Perfetto timeline.
+    # Off by default — disabled costs one bool read per call site and
+    # verdicts are bit-identical either way.
+    SPANS_ENABLED = "sentinel.tpu.spans.enabled"
+    # Bounded in-memory ring per process (oldest spans drop first).
+    SPANS_RING = "sentinel.tpu.spans.ring"
+    # Journal spill directory ("" = the metric log dir). Files are
+    # named {app}-spans-{role}-{pid}.jsonl, size-rolled to one .1
+    # backup like the metric log.
+    SPANS_DIR = "sentinel.tpu.spans.dir"
+    # Spill to the journal file automatically once this many spans
+    # accumulate since the last spill (0 = only explicit/close spills).
+    SPANS_SPILL_EVERY = "sentinel.tpu.spans.spill.every"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -556,6 +572,10 @@ class SentinelConfig:
         GOSSIP_PEERS: "",
         GOSSIP_INTERVAL_MS: "0",
         GOSSIP_STALE_WINDOWS: "4",
+        SPANS_ENABLED: "false",
+        SPANS_RING: "8192",
+        SPANS_DIR: "",
+        SPANS_SPILL_EVERY: "0",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

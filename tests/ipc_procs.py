@@ -130,6 +130,30 @@ def run_script_cfg(channel, wid, cfg, script, q):
     run_script(channel, wid, script, q)
 
 
+def run_entries_spanned(channel, wid, cfg, resource, n, q):
+    """Span-armed worker leg for the fleet-timeline alignment test:
+    replay ``cfg`` (spans enabled + spill dir travel in it), run ``n``
+    blocking entries, then close — close spills the journal, and the
+    parent loads it with ``load_journal`` to pin worker admit spans
+    against the engine's frame spans on the shared wall-ms ruler."""
+    from sentinel_tpu.utils.config import config
+
+    for k, v in (cfg or {}).items():
+        config.set(k, v)
+    from sentinel_tpu.ipc.worker import IngestClient
+    from sentinel_tpu.metrics.spans import get_journal
+
+    cli = IngestClient(channel, wid)
+    verdicts = []
+    try:
+        for _ in range(n):
+            v = cli.entry(resource, timeout_ms=120000)
+            verdicts.append((v.admitted, int(v.reason), v.degraded))
+        q.put(("done", wid, verdicts, get_journal().spill_path()))
+    finally:
+        cli.close()
+
+
 def worker_mode_serve(channel, wid, cfg, paths, q):
     """Worker-mode end-to-end: THIS process arms
     sentinel.tpu.ipc.worker.mode, attaches, and serves real adapter

@@ -203,3 +203,87 @@ class TestIciAllocation:
         # Greedy by chip index: 5, 3, 2, 0, 0, ...
         assert list(grants) == [5, 3, 2, 0, 0, 0, 0, 0]
         assert (grants <= np.asarray(demands)).all()
+
+
+class TestStatsWire:
+    """The `stats` wire command (MSG_TYPE_STATS): codec roundtrip and
+    fetch_server_stats ↔ stats_snapshot parity against a live shard."""
+
+    def test_request_codec_roundtrip(self):
+        from sentinel_tpu.cluster import protocol
+
+        payload = protocol.pack_stats_request(7)[protocol._LEN.size:]
+        assert protocol.peek_msg_type(payload) == C.MSG_TYPE_STATS
+        assert protocol.unpack_request(payload) == (7, C.MSG_TYPE_STATS, ())
+        with pytest.raises(ValueError, match="trailing bytes"):
+            protocol.unpack_request(payload + b"\x00")
+
+    def test_response_codec_roundtrip(self):
+        from sentinel_tpu.cluster import protocol
+
+        snap = {"port": 7070, "work": {"frames": 3}, "connections": 1}
+        payload = protocol.pack_stats_response(9, snap)[protocol._LEN.size:]
+        assert protocol.unpack_stats_response(payload) == (9, snap)
+
+    def test_response_version_guard(self):
+        import struct as _struct
+
+        from sentinel_tpu.cluster import protocol
+
+        payload = bytearray(
+            protocol.pack_stats_response(9, {})[protocol._LEN.size:]
+        )
+        payload[protocol._REQ_HDR.size] = protocol.BATCH_VERSION + 1
+        with pytest.raises(protocol.UnsupportedBatchVersion) as ei:
+            protocol.unpack_stats_response(bytes(payload))
+        assert ei.value.version == protocol.BATCH_VERSION + 1
+        # Body must be an object, not any JSON value.
+        bad = (
+            protocol._REQ_HDR.pack(9, C.MSG_TYPE_STATS)
+            + _struct.pack("<B", protocol.BATCH_VERSION)
+            + b"[1,2]"
+        )
+        with pytest.raises(ValueError, match="not an object"):
+            protocol.unpack_stats_response(bad)
+
+    def test_fetch_matches_server_snapshot(self, cluster_env):
+        from sentinel_tpu.cluster import stat_log
+        from sentinel_tpu.cluster.client import fetch_server_stats
+
+        stat_log.reset_counters()
+        cluster_flow_rule_manager.load_rules(
+            "default", [cluster_rule("r", 3, flow_id=42)]
+        )
+        server = SentinelTokenServer(
+            port=0, service=DefaultTokenService(clock=ManualClock(0))
+        )
+        server.start()
+        try:
+            client = ClusterTokenClient("127.0.0.1", server.port).start()
+            for _ in range(5):
+                client.request_token(42)  # 3 PASS + 2 BLOCKED
+            client.stop()
+            over = fetch_server_stats("127.0.0.1", server.port)
+            local = server.stats_snapshot()
+            assert over["port"] == server.port == local["port"]
+            # The wire view and the in-process view agree on the work
+            # clocks (the fetch's own socket may still show in
+            # `connections`, so pin work + stat_log, not the transient
+            # connection gauge). The snapshot is taken WHILE serving
+            # the stats frame, so over sees ping + 5 flow frames and
+            # the local read afterwards sees the stats frame too.
+            assert over["work"]["frames"] == 6
+            assert local["work"]["frames"] == 7
+            # The stats frame itself is introspection: decisions must
+            # not have moved between the two views.
+            assert over["work"]["decisions"] == local["work"]["decisions"]
+            assert over["work"]["lease_grants"] == 0
+            assert over["stat_log"] == local["stat_log"]
+        finally:
+            server.stop()
+
+    def test_fetch_connection_refused_raises(self):
+        from sentinel_tpu.cluster.client import fetch_server_stats
+
+        with pytest.raises(OSError):
+            fetch_server_stats("127.0.0.1", 1, timeout_sec=0.5)

@@ -73,6 +73,12 @@ class TestServerRestartUnderLoad:
         rule = cluster_rule("svc", 50, flow_id=700)
         cluster_flow_rule_manager.load_rules("default", [rule])
         service1 = DefaultTokenService(clock=ManualClock(0))
+        # Compile the decision kernel before the 0.5s-timeout wire
+        # traffic: conftest's periodic jax.clear_caches() can land
+        # right before this test, and the ~1s cold compile would make
+        # phase 1's first RPC time out into a local-window grant.
+        # acquire=0 charges nothing, so granted_on_server stays exact.
+        service1.request_tokens([(700, 0, False)])
         server = SentinelTokenServer(port=0, service=service1).start()
         port = server.port
         client = ClusterTokenClient(

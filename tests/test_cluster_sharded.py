@@ -339,6 +339,11 @@ class TestDeadShardScoping:
             [cluster_rule("a", 100, fid0), cluster_rule("b", 100, fid1)],
         )
         servers = _servers(2)
+        # Compile the decision kernel before the 0.5s-timeout wire
+        # traffic: conftest's periodic jax.clear_caches() can land
+        # right before this test, and the ~1s cold compile would eat
+        # the request timeout. acquire=0 charges nothing.
+        servers[0].service.request_tokens([(fid0, 0, False)])
         client = _sharded(
             servers, request_timeout_sec=0.5, reconnect_interval_sec=0.05
         )

@@ -1,0 +1,209 @@
+"""tools/fleetdump.py: merging per-process span journals into one
+Perfetto trace — the golden-merge regression for the fleet timeline.
+
+Fixture journals (hand-built, no processes) pin the exact merge
+contract: one Perfetto process per journal with ``sentinel-<role>``
+naming, one thread per span category, µs timestamp math including the
+per-journal ruler-offset shift, admission flow arrows matched on
+(wid, seq ∈ [seq_lo, seq_hi]) with the traceparent hex as flow id
+when present, rpc arrows matched on (port, xid), and the ``f`` anchor
+clamped forward so residual skew can never make Perfetto drop the
+arrow. The spawned-fleet demo itself runs in ci_check 2d."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import fleetdump  # noqa: E402
+
+from sentinel_tpu.metrics.spans import SpanJournal  # noqa: E402
+
+
+def _worker_journal(off_ms=0.0):
+    spans = [
+        {"name": "admit", "cat": "worker", "t0": 1000.0, "dur": 2.0,
+         "wid": 0, "seq": 5, "push_ms": 0.2, "v": 1001.8, "win": 0,
+         "adm": 1, "trace": "0123456789abcdef0123456789abcdef"},
+        {"name": "admit", "cat": "worker", "t0": 1004.0, "dur": 1.0,
+         "wid": 0, "seq": 6, "push_ms": 0.1, "v": 1004.9, "win": 0,
+         "adm": 1},
+        {"name": "admit.bulk", "cat": "worker", "t0": 1010.0, "dur": 1.5,
+         "wid": 0, "seq": 7, "rows": 4, "v": 1011.4},
+    ]
+    meta = {"meta": 1, "role": "worker", "pid": 42, "app": "test-app"}
+    if off_ms:
+        meta["ruler_off_ms"] = off_ms
+    return {"meta": meta, "spans": spans}
+
+
+def _engine_journal():
+    return {
+        "meta": {"meta": 1, "role": "engine", "pid": 43, "app": "test-app"},
+        "spans": [
+            {"name": "drain", "cat": "engine", "t0": 1001.0, "dur": 0.5,
+             "frames": 2, "rows": 6},
+            {"name": "frame", "cat": "engine", "t0": 1001.0, "dur": 0.5,
+             "wid": 0, "seq_lo": 5, "seq_hi": 6, "rows": 2},
+            {"name": "frame", "cat": "engine", "t0": 1010.5, "dur": 0.3,
+             "wid": 0, "seq_lo": 7, "seq_hi": 7, "rows": 4},
+            # The engine process also hosts the cluster client leg:
+            {"name": "rpc", "cat": "client", "t0": 1002.0, "dur": 1.2,
+             "xid": 9, "port": 7070, "rows": 4},
+            {"name": "rpc", "cat": "client", "t0": 1003.5, "dur": 1.0,
+             "xid": 10, "port": 7070, "rows": 4},
+        ],
+    }
+
+
+def _shard_journal():
+    return {
+        "meta": {"meta": 1, "role": "shard", "pid": 44, "app": "test-app"},
+        "spans": [
+            {"name": "serve", "cat": "shard", "t0": 1002.4, "dur": 0.6,
+             "xid": 9, "mt": 4, "rows": 4, "port": 7070},
+            # xid 11 was never sent by the client above -> no arrow.
+            {"name": "serve", "cat": "shard", "t0": 1009.0, "dur": 0.2,
+             "xid": 11, "mt": 4, "rows": 1, "port": 7070},
+        ],
+    }
+
+
+def _merge(*journals):
+    return fleetdump.merge_journals(list(journals))["traceEvents"]
+
+
+def _by(evs, **kv):
+    return [e for e in evs
+            if all(e.get(k) == v for k, v in kv.items())]
+
+
+class TestMergeJournals:
+    def test_process_and_thread_metadata(self):
+        evs = _merge(_worker_journal(), _engine_journal(), _shard_journal())
+        names = {(e["pid"], e["args"]["name"])
+                 for e in _by(evs, ph="M", name="process_name")}
+        assert names == {(42, "sentinel-worker"), (43, "sentinel-engine"),
+                         (44, "sentinel-shard")}
+        threads = {(e["pid"], e["tid"], e["args"]["name"])
+                   for e in _by(evs, ph="M", name="thread_name")}
+        # One track per category; the engine process hosts TWO (its
+        # own drain/frame track plus the cluster-client leg).
+        assert (42, 1, "worker") in threads
+        assert (43, 2, "engine") in threads and (43, 3, "client") in threads
+        assert (44, 4, "shard") in threads
+
+    def test_slice_timestamp_math_and_ruler_shift(self):
+        # 7.5ms of observed skew: every worker slice lands 7500µs
+        # earlier on the merged (ruler) timeline.
+        evs = _merge(_worker_journal(off_ms=7.5))
+        sl = _by(evs, ph="X", name="admit")
+        assert [e["ts"] for e in sl] == [992500, 996500]
+        assert [e["dur"] for e in sl] == [2000, 1000]
+        # Span payload fields ride into args (minus the slice keys).
+        assert sl[0]["args"]["seq"] == 5 and sl[0]["args"]["adm"] == 1
+        assert "t0" not in sl[0]["args"]
+
+    def test_zero_duration_clamps_to_one_us(self):
+        j = {"meta": {"meta": 1, "role": "w", "pid": 9},
+             "spans": [{"name": "x", "cat": "worker", "t0": 1.0,
+                        "dur": 0.0}]}
+        (sl,) = _by(_merge(j), ph="X")
+        assert sl["dur"] == 1
+
+    def test_admission_arrows_span_worker_to_engine(self):
+        evs = _merge(_worker_journal(), _engine_journal())
+        starts = _by(evs, ph="s", name="admission")
+        finishes = _by(evs, ph="f", name="admission")
+        assert len(starts) == len(finishes) == 3
+        # Traced admission uses the traceparent hex as flow id; the
+        # untraced ones fall back to the (wid, seq) synthetic id.
+        ids = {e["id"] for e in starts}
+        assert ids == {"0123456789abcdef0123456789abcdef",
+                       "adm-0-6", "adm-0-7"}
+        for s in starts:
+            assert s["pid"] == 42
+        for f in finishes:
+            assert f["pid"] == 43 and f["bp"] == "e"
+        # seq 7 rode the admit.bulk span into the second frame.
+        (bulk_f,) = [e for e in finishes if e["id"] == "adm-0-7"]
+        assert bulk_f["ts"] == 1010500
+
+    def test_finish_anchor_clamped_forward(self):
+        # Residual skew put the frame's dequeue stamp BEFORE the
+        # worker's join: the f anchor clamps to the s timestamp so
+        # Perfetto keeps the arrow.
+        w = {"meta": {"meta": 1, "role": "worker", "pid": 1},
+             "spans": [{"name": "admit", "cat": "worker", "t0": 1000.0,
+                        "dur": 1.0, "wid": 0, "seq": 1}]}
+        e = {"meta": {"meta": 1, "role": "engine", "pid": 2},
+             "spans": [{"name": "frame", "cat": "engine", "t0": 999.0,
+                        "dur": 0.5, "wid": 0, "seq_lo": 1,
+                        "seq_hi": 1}]}
+        evs = _merge(w, e)
+        (s,) = _by(evs, ph="s")
+        (f,) = _by(evs, ph="f")
+        assert s["ts"] == 1000000 and f["ts"] == 1000000  # clamped
+
+    def test_no_arrow_without_matching_frame(self):
+        w = _worker_journal()
+        e = _engine_journal()
+        e["spans"] = [sp for sp in e["spans"] if sp["name"] != "frame"]
+        evs = _merge(w, e)
+        assert _by(evs, ph="s", name="admission") == []
+
+    def test_rpc_arrows_match_on_port_and_xid(self):
+        evs = _merge(_engine_journal(), _shard_journal())
+        starts = _by(evs, ph="s", name="rpc")
+        # xid 9 matches; xid 10 has no serve, shard xid 11 no rpc.
+        assert [e["id"] for e in starts] == ["rpc-7070-9"]
+        (f,) = _by(evs, ph="f", name="rpc")
+        assert f["pid"] == 44 and f["ts"] == 1002400
+
+    def test_rpc_port_disambiguates(self):
+        e = _engine_journal()
+        shard = _shard_journal()
+        for sp in shard["spans"]:
+            sp["port"] = 7071  # same xids, different shard
+        evs = _merge(e, shard)
+        assert _by(evs, ph="s", name="rpc") == []
+
+
+class TestMergeFiles:
+    def test_spill_then_merge_roundtrip(self, tmp_path):
+        spj = SpanJournal(role="worker", enabled=True, ring=64,
+                          spill_every=0, base_dir=str(tmp_path))
+        spj.record("admit", "worker", 100.0, 1.0, wid=0, seq=1)
+        path = spj.spill()
+        trace = fleetdump.merge_files([path])
+        evs = trace["traceEvents"]
+        (proc,) = _by(evs, ph="M", name="process_name")
+        assert proc["args"]["name"] == "sentinel-worker"
+        assert proc["pid"] == os.getpid()
+        (sl,) = _by(evs, ph="X")
+        assert sl["name"] == "admit" and sl["dur"] == 1000
+
+
+class TestSmokeChecks:
+    def test_full_fixture_is_green(self):
+        # Distinct pids per journal, the way a real run has them.
+        js = [_worker_journal(), _worker_journal(), _engine_journal(),
+              _shard_journal(), _shard_journal()]
+        for i, j in enumerate(js):
+            j["meta"]["pid"] = 50 + i
+        trace = fleetdump.merge_journals(js)
+        assert fleetdump.smoke_checks(trace) == []
+
+    def test_degenerate_traces_report_failures(self):
+        fails = fleetdump.smoke_checks({"traceEvents": []})
+        assert any("worker" in f for f in fails)
+        assert any("shard" in f for f in fails)
+        assert any("admission" in f for f in fails)
+        # Worker-only merge: tracks missing + no arrows.
+        fails = fleetdump.smoke_checks(
+            fleetdump.merge_journals([_worker_journal()])
+        )
+        assert any("engine" in f for f in fails)
+        assert any(">=5 processes" in f for f in fails)

@@ -53,3 +53,111 @@ class TestMetricsEndpoint:
             assert 'sentinel_pass_qps{resource="api"} 1.0' in body
         finally:
             center.stop()
+
+
+class TestWorkerRender:
+    """sentinel_worker_* federation: zero shape with no client, live
+    values off IngestClient.snapshot()."""
+
+    def test_none_renders_full_zero_shape(self):
+        from sentinel_tpu.transport.prometheus import render_worker_metrics
+
+        text = render_worker_metrics(None)
+        for fam in ("sentinel_worker_entries_total",
+                    "sentinel_worker_bulk_rows_total",
+                    "sentinel_worker_sheds_total",
+                    "sentinel_worker_policy_served_total",
+                    "sentinel_worker_reconnects_total",
+                    "sentinel_worker_frames_per_entry",
+                    "sentinel_worker_engine_alive",
+                    "sentinel_worker_live_admissions",
+                    "sentinel_worker_pending_waits",
+                    "sentinel_worker_buffered_exits"):
+            assert f"# TYPE {fam} " in text, fam
+        assert "sentinel_worker_entries_total 0" in text
+        # No worker attached -> slot id is the -1 sentinel.
+        assert "sentinel_worker_id -1" in text
+
+    def test_live_client_values(self, manual_clock, engine):
+        from sentinel_tpu.ipc.plane import IngestPlane
+        from sentinel_tpu.ipc.worker import IngestClient
+        from sentinel_tpu.transport.prometheus import render_worker_metrics
+
+        st.flow_rule_manager.load_rules([st.FlowRule("wres", count=100)])
+        plane = IngestPlane(engine)
+        cli = IngestClient(plane.channel(0), 0)
+        try:
+            for _ in range(3):
+                cli.entry("wres", acquire=1)
+            cli.bulk("wres", 4)
+            text = render_worker_metrics(cli)
+        finally:
+            cli.close()
+            plane.close()
+        assert "sentinel_worker_entries_total 3" in text
+        assert "sentinel_worker_bulk_rows_total 4" in text
+        assert "sentinel_worker_engine_alive 1" in text
+        assert "sentinel_worker_id 0" in text
+        # 3 per-call frames + 1 bulk frame over 7 admission rows.
+        assert "sentinel_worker_frames_per_entry 0.5714" in text
+        assert "sentinel_worker_live_admissions 7" in text
+
+    def test_openmetrics_dialect(self):
+        from sentinel_tpu.transport.prometheus import render_worker_metrics
+
+        text = render_worker_metrics(None, openmetrics=True)
+        assert text.endswith("# EOF\n")
+        # Counter family names drop the _total suffix in OM metadata;
+        # the sample line keeps it.
+        assert "# TYPE sentinel_worker_entries counter" in text
+        assert "sentinel_worker_entries_total 0" in text
+
+
+class TestClusterServerRender:
+    def test_none_renders_full_zero_shape(self):
+        from sentinel_tpu.transport.prometheus import (
+            render_cluster_server_metrics,
+        )
+
+        text = render_cluster_server_metrics(None)
+        assert "sentinel_cluster_server_decisions_total 0" in text
+        assert "sentinel_cluster_server_frames_total 0" in text
+        assert "sentinel_cluster_server_busy_seconds_total 0" in text
+        assert "sentinel_cluster_server_lease_grants_total 0" in text
+        assert ('sentinel_cluster_server_connections{namespace="default"} 0'
+                in text)
+        assert ('sentinel_cluster_server_stat_total{category="flow",'
+                'outcome="pass"} 0' in text)
+
+    def test_live_server_values(self):
+        from sentinel_tpu.cluster import stat_log
+        from sentinel_tpu.cluster.server import SentinelTokenServer
+        from sentinel_tpu.cluster.token_service import DefaultTokenService
+        from sentinel_tpu.transport.prometheus import (
+            render_cluster_server_metrics,
+        )
+
+        stat_log.reset_counters()
+        srv = SentinelTokenServer(port=0, service=DefaultTokenService())
+        srv._note_work(5, 0.25)
+        srv._note_work(2, 0.125)
+        srv.lease_grants = 3
+        stat_log.log("flow", "pass", 1, 2)
+        stat_log.log("flow", "block", 1)
+        text = render_cluster_server_metrics(srv)
+        assert "sentinel_cluster_server_decisions_total 7" in text
+        assert "sentinel_cluster_server_frames_total 2" in text
+        assert "sentinel_cluster_server_busy_seconds_total 0.375" in text
+        assert "sentinel_cluster_server_lease_grants_total 3" in text
+        assert 'outcome="pass"} 2' in text
+        assert 'outcome="block"} 1' in text
+        stat_log.reset_counters()
+
+    def test_openmetrics_dialect(self):
+        from sentinel_tpu.transport.prometheus import (
+            render_cluster_server_metrics,
+        )
+
+        text = render_cluster_server_metrics(None, openmetrics=True)
+        assert text.endswith("# EOF\n")
+        assert "# TYPE sentinel_cluster_server_stat counter" in text

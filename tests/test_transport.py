@@ -244,3 +244,110 @@ class TestCommandCenterRobustness:
             assert api_ok()
         finally:
             cc.stop()
+
+
+class TestFleetHeartbeat:
+    """PR-18 fleet fields: the heartbeat carries engine lifecycle
+    provenance and the dashboard rolls it up per app."""
+
+    def test_heartbeat_carries_engine_epoch_and_workers(
+        self, manual_clock, engine
+    ):
+        from sentinel_tpu.ipc.plane import IngestPlane
+
+        plane = IngestPlane(engine)
+        engine.ipc_plane = plane
+        dash = DashboardServer(port=0, fetch_interval_sec=999).start()
+        try:
+            hb = HeartbeatSender(
+                f"127.0.0.1:{dash.port}", command_port=9999,
+                app_name="fleet-app", engine=engine,
+            )
+            assert hb.heartbeat_once() is True
+            (m,) = dash.apps.machines_of("fleet-app")
+            assert m.engine_epoch == 1
+            assert m.restarts_total == 0
+            assert m.workers == 0  # attached, nobody spawned
+            _status, body = http_get(dash.port, "apps")
+            (row,) = json.loads(body)["fleet-app"]
+            assert row["engine_epoch"] == 1 and row["workers"] == 0
+            assert row["restarts_total"] == 0
+        finally:
+            engine.ipc_plane = None
+            plane.close()
+            dash.stop()
+
+    def test_fleet_rollup_and_stale_epochs(self):
+        dash = DashboardServer(port=0, fetch_interval_sec=999).start()
+        try:
+            # Two machines: one restarted twice (epoch 3), one stale
+            # on epoch 1 with active shedding.
+            http_get(dash.port, "registry/machine", app="a",
+                     ip="10.0.0.1", port=1, engine_epoch=3,
+                     restarts_total=2, workers=4)
+            http_get(dash.port, "registry/machine", app="a",
+                     ip="10.0.0.2", port=1, engine_epoch=1,
+                     restarts_total=0, workers=2, shed_total=7,
+                     shedding=1)
+            _status, body = http_get(dash.port, "fleet")
+            fleet = json.loads(body)["a"]
+            assert fleet["machines"] == 2 and fleet["healthy"] == 2
+            assert fleet["workers"] == 6
+            assert fleet["restarts_total"] == 2
+            assert fleet["shed_total"] == 7 and fleet["shedding"] == 1
+            assert fleet["max_epoch"] == 3
+            assert fleet["stale_epochs"] == 1
+        finally:
+            dash.stop()
+
+    def test_fleet_rollup_empty_and_unreported_epochs(self):
+        dash = DashboardServer(port=0, fetch_interval_sec=999).start()
+        try:
+            _status, body = http_get(dash.port, "fleet")
+            assert json.loads(body) == {}
+            # A machine that never reported an epoch (pre-PR-18
+            # sender) must not count as stale.
+            http_get(dash.port, "registry/machine", app="b",
+                     ip="10.0.0.3", port=1)
+            _status, body = http_get(dash.port, "fleet")
+            fleet = json.loads(body)["b"]
+            assert fleet["max_epoch"] == 0 and fleet["stale_epochs"] == 0
+        finally:
+            dash.stop()
+
+
+class TestSpansCommand:
+    @pytest.fixture()
+    def cc(self):
+        center = CommandCenter(port=0).start()
+        yield center
+        center.stop()
+
+    def test_snapshot_filter_and_spill(self, cc, manual_clock, engine,
+                                       tmp_path):
+        from sentinel_tpu.metrics import spans as spans_mod
+        from sentinel_tpu.utils.config import config as _cfg
+
+        _cfg.set(_cfg.SPANS_ENABLED, "true")
+        _cfg.set(_cfg.SPANS_DIR, str(tmp_path))
+        spans_mod.reset_journal()
+        try:
+            spj = spans_mod.get_journal("engine")
+            spj.record("admit", "worker", 100.0, 1.0, wid=0, seq=1)
+            spj.record("drain", "engine", 101.0, 0.5, frames=1, rows=1)
+            _status, body = http_get(cc.port, "spans")
+            out = json.loads(body)
+            assert out["enabled"] is True and out["role"] == "engine"
+            assert out["buffered"] == 2 and "spans" not in out
+            _status, body = http_get(cc.port, "spans", n=10, cat="engine")
+            out = json.loads(body)
+            assert [s["name"] for s in out["spans"]] == ["drain"]
+            _status, body = http_get(cc.port, "spans", spill=1)
+            out = json.loads(body)
+            assert out["spilled_to"]
+            loaded = spans_mod.load_journal(out["spilled_to"])
+            assert len(loaded["spans"]) == 2
+        finally:
+            _cfg.set(_cfg.SPANS_ENABLED, "false")
+            _cfg.set(_cfg.SPANS_DIR, "")
+            spans_mod.reset_journal()

@@ -185,6 +185,17 @@ STAGE_METRICS: Dict[str, Tuple[str, float]] = {
     # Gossip merge cost: one merge_remote + fleet-view query, pure
     # numpy in-process — latency-class band.
     "cluster_gossip_merge_ms": ("lower", 2.00),
+    # Fleet span decomposition (PR 18). The span-derived percentiles
+    # get the latency-class bands; the armed/unarmed p50 ratio and the
+    # wire share are same-run RATIOS (box noise cancels) — overhead
+    # must stay near 1.0, so it gets the tight ratio band.
+    "ipc_span_e2e_p50_us": ("lower", 2.00),
+    "ipc_span_e2e_p99_us": ("lower", 5.00),
+    "ipc_span_drain_p50_us": ("lower", 2.00),
+    "ipc_span_overhead": ("lower", 0.30),
+    "cluster_rpc_p50_ms": ("lower", 2.00),
+    "cluster_rpc_p99_ms": ("lower", 5.00),
+    "cluster_serve_p50_ms": ("lower", 2.00),
 }
 
 # Host-identity token (PR 14): device_kind + jax_version cannot tell
@@ -223,7 +234,9 @@ STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
      ("ipc_workers_ops_per_sec", "ipc_inproc_ops_per_sec",
       "ipc_vs_inproc", "ipc_entry_p50_us", "ipc_entry_p99_us",
       "ipc_entry_adaptive_p50_us", "ipc_entry_adaptive_p99_us",
-      "ipc_wakeup_speedup", "ipc_restart_outage_ms")),
+      "ipc_wakeup_speedup", "ipc_restart_outage_ms",
+      "ipc_span_e2e_p50_us", "ipc_span_e2e_p99_us",
+      "ipc_span_drain_p50_us", "ipc_span_overhead")),
     # The sweep carries its own rung key so a truncated/smoke run
     # never reads as a slowdown (and pre-PR-14 baselines, which lack
     # both the key and the metrics, simply don't compare here).
@@ -238,7 +251,9 @@ STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
      ("cluster_percall_ops_per_sec", "cluster_window_ops_per_sec",
       "cluster_lease_ops_per_sec", "cluster_frames_per_op_window",
       "cluster_frames_per_op_lease", "cluster_lease_hit_rate",
-      "cluster_window_amortization")),
+      "cluster_window_amortization",
+      "cluster_rpc_p50_ms", "cluster_rpc_p99_ms",
+      "cluster_serve_p50_ms")),
     # Shard sweep (PR 17): keyed on its own rung size so truncated
     # runs and pre-PR-17 baselines never compare here.
     (("cluster_shard_ops",),

@@ -48,6 +48,16 @@ JAX_PLATFORMS=cpu python tools/ipc_launch.py --smoke >/dev/null
 echo "== ci_check 2c: sharded token plane smoke =="
 JAX_PLATFORMS=cpu python tools/shard_smoke.py >/dev/null
 
+# Fleet timeline smoke (always): 2 spawned ingest workers + this
+# engine + 2 spawned token shards with span journals armed; every
+# journal spills and fleetdump must merge them into ONE Perfetto
+# trace carrying all three process-type track families with flow
+# arrows crossing both boundaries (worker->engine on wid+seq,
+# client->shard on port+xid).
+echo "== ci_check 2d: fleet timeline (fleetdump) smoke =="
+JAX_PLATFORMS=cpu python tools/fleetdump.py --smoke \
+    --out /tmp/ci-fleet-trace.json >/dev/null
+
 if [ "${CI_CHECK_SKIP_BENCH:-0}" = "1" ]; then
     echo "== ci_check 3/3: bench gate SKIPPED (CI_CHECK_SKIP_BENCH=1) =="
     # The ipc stage still smokes even when the full bench is skipped:

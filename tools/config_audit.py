@@ -16,11 +16,16 @@ Three checks:
   architecture doc is a key that drifts.
 * **metrics** (``audit_metrics``) — every Prometheus metric FAMILY the
   exporter emits (read from a live ``render_metrics`` against a fresh
-  default engine, so a family added anywhere in the render path is
+  default engine, PLUS the worker-process and cluster-server renders'
+  zero-value shapes, so a family added anywhere in any render path is
   caught) and every ``TelemetryBus`` counter key must appear VERBATIM
   in ``docs/ARCHITECTURE.md``. The PR-7 config-key rule applied to the
   metric plane: an alert an operator cannot look up is an alert that
   gets ignored.
+* **commands** (``audit_commands``) — every command the transport's
+  ``@command_mapping`` registry exposes must appear backtick-quoted in
+  ``docs/ARCHITECTURE.md``. A command an operator cannot find is a
+  command that only its author ever calls.
 
 This is the guard that lets a new key family (like
 ``sentinel.tpu.ingest.*`` / ``sentinel.tpu.speculative.shaping.*``)
@@ -128,9 +133,20 @@ def prometheus_families() -> Set[str]:
     any helper (histogram buckets, the bounded resource export, a
     future module) cannot dodge the audit."""
     from sentinel_tpu.runtime.engine import Engine
-    from sentinel_tpu.transport.prometheus import render_metrics
+    from sentinel_tpu.transport.prometheus import (
+        render_cluster_server_metrics,
+        render_metrics,
+        render_worker_metrics,
+    )
 
-    text = render_metrics(Engine())
+    # The worker/server renders accept None and emit every family at
+    # its zero value exactly so this audit (and first scrapes) see the
+    # full shape without spinning up a worker plane or a token server.
+    text = "\n".join([
+        render_metrics(Engine()),
+        render_worker_metrics(None),
+        render_cluster_server_metrics(None),
+    ])
     return {
         line.split()[2]
         for line in text.splitlines()
@@ -175,6 +191,46 @@ def audit_metrics(
     return missing_fams, missing_ctrs
 
 
+def transport_commands() -> Set[str]:
+    """Every command name the transport's ``@command_mapping`` registry
+    exposes (transport/handlers.py) — introspection off the live
+    registry, so a handler added anywhere import-time-reachable cannot
+    dodge the audit."""
+    from sentinel_tpu.transport.handlers import all_commands
+
+    return set(all_commands())
+
+
+def audit_commands(
+    doc_path: str = "docs/ARCHITECTURE.md",
+    commands: Optional[Set[str]] = None,
+) -> List[str]:
+    """Registered command names NOT backtick-quoted in the doc —
+    sorted; empty when clean. Backtick-quoting is required (not a bare
+    word match): command names like ``basicInfo`` or ``metrics`` are
+    ordinary prose words, and prose must not satisfy the audit. A
+    missing/unreadable doc reports every command. ``commands``
+    injection is the test seam; production callers omit it."""
+    try:
+        with open(doc_path, "r", encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        text = ""
+    # `cmd`, `cmd?arg=...`, or `path/cmd` inside backticks all count.
+    # Scanned per LINE: pairing backticks across the whole document
+    # lets one ``` fence line flip the pairing parity for everything
+    # after it; markdown inline code never spans lines anyway.
+    quoted: Set[str] = set()
+    for line in text.splitlines():
+        for span in re.findall(r"`([^`]+)`", line):
+            for tok in re.split(r"[\s,]+", span):
+                quoted.add(tok)
+                quoted.add(tok.split("?")[0])
+    if commands is None:
+        commands = transport_commands()
+    return sorted(c for c in commands if c not in quoted)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default="sentinel_tpu")
@@ -184,6 +240,11 @@ def main() -> int:
         help="skip the metric-plane audit (it builds an Engine, which "
              "needs a working jax backend)",
     )
+    ap.add_argument(
+        "--no-commands", action="store_true",
+        help="skip the command-registry audit (it imports the "
+             "transport handlers)",
+    )
     args = ap.parse_args()
     missing, refs = audit(args.root)
     undocumented = audit_docs(args.doc)
@@ -191,8 +252,12 @@ def main() -> int:
     bad_ctrs: List[str] = []
     if not args.no_metrics:
         bad_fams, bad_ctrs = audit_metrics(args.doc)
+    bad_cmds: List[str] = []
+    if not args.no_commands:
+        bad_cmds = audit_commands(args.doc)
     n_refs = sum(len(v) for v in refs.values())
-    if not missing and not undocumented and not bad_fams and not bad_ctrs:
+    if (not missing and not undocumented and not bad_fams
+            and not bad_ctrs and not bad_cmds):
         print(
             f"config audit OK: {len(refs)} distinct sentinel.tpu.* keys "
             f"({n_refs} mentions) all declared in utils/config.py and "
@@ -200,6 +265,9 @@ def main() -> int:
             + ("" if args.no_metrics
                else "; every Prometheus family and telemetry counter "
                     "documented")
+            + ("" if args.no_commands
+               else f"; all {len(transport_commands())} transport "
+                    "commands documented")
         )
         return 0
     if missing:
@@ -223,6 +291,11 @@ def main() -> int:
         print(f"config audit FAILED — TelemetryBus counters not "
               f"documented in {args.doc}:")
         for name in bad_ctrs:
+            print(f"  {name}")
+    if bad_cmds:
+        print(f"config audit FAILED — transport commands registered "
+              f"but not backtick-documented in {args.doc}:")
+        for name in bad_cmds:
             print(f"  {name}")
     return 1
 

@@ -428,6 +428,49 @@ def main() -> None:
     except Exception as exc:
         print(f"[k2probe] ipc stage skipped: {exc}", file=sys.stderr)
 
+    # --- fleet span stamp cost (metrics/spans.py) ----------------------
+    # One armed SpanJournal.record() as the admission call sites issue
+    # it — two wall_ms reads bracketing the span plus the dict build +
+    # locked ring append — and the disabled path's single bool read.
+    # Reported in ns per stamp (not ms): these are the numbers the
+    # ≤2% armed-overhead budget is built from, far below report()'s
+    # ms resolution.
+    try:
+        from sentinel_tpu.metrics.spans import SpanJournal
+        from sentinel_tpu.metrics.spans import wall_ms as _wms
+
+        spj = SpanJournal(role="probe", enabled=True, ring=8192,
+                          spill_every=0)
+        n_st = 20000
+
+        def _stamp(i: int) -> None:
+            t0s = _wms()
+            spj.record("probe", "worker", t0s, _wms() - t0s,
+                       wid=0, seq=i, push_ms=0.01, v=t0s, win=1, adm=1)
+
+        for i in range(2048):  # warm the deque + dict shapes
+            _stamp(i)
+        t0 = time.perf_counter()
+        for i in range(n_st):
+            _stamp(i)
+        armed_ns = (time.perf_counter() - t0) / n_st * 1e9
+        results["span_stamp_ns"] = round(armed_ns, 1)
+        print(f"[k2probe] span_stamp_ns: {armed_ns:.0f} ns",
+              file=sys.stderr, flush=True)
+
+        spj.enabled = False
+        t0 = time.perf_counter()
+        for i in range(n_st):
+            if spj.enabled:
+                _stamp(i)
+        off_ns = (time.perf_counter() - t0) / n_st * 1e9
+        results["span_disabled_ns"] = round(off_ns, 2)
+        print(f"[k2probe] span_disabled_ns: {off_ns:.1f} ns",
+              file=sys.stderr, flush=True)
+        print(json.dumps(results), file=sys.stderr, flush=True)
+    except Exception as exc:
+        print(f"[k2probe] span stage skipped: {exc}", file=sys.stderr)
+
     # --- cluster token plane round trips (sentinel_tpu/cluster) --------
     # One real TCP server on loopback: the three wire stances a token
     # decision can take — per-call frame, 8-row batch frame (cost shown
