@@ -470,6 +470,77 @@ def _run_engine_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         f" (dispatch {t_p_dispatch / iters:.1f} drain {t_p_drain / iters:.1f} ms,"
         f" occupancy {occupancy:.2f})"
     )
+
+    # Flight-recorder (runtime/capture.py) overhead: same-run A/B on
+    # the bulk surface at pipeline depths {0, 2}. The journal's spill
+    # path is one vectorized encode_entries_columns frame per bulk
+    # group, so the ratio should sit near 1.0. The full-size bulk
+    # flush is the wrong sample here — per-flush wall time on a shared
+    # box drifts ±20%, far more than the effect — so the A/B uses a
+    # smaller window (16 groups × 1024 rows, ~10× cheaper per sample),
+    # INTERLEAVES off/on pairs (settle + 2 timed flushes per arm per
+    # rep), and takes min(on)/min(off): the box noise is one-sided
+    # additive, and min over 5 reps filters it.
+    import shutil
+    import tempfile
+
+    from sentinel_tpu.runtime.capture import CaptureJournal
+
+    cap_groups, cap_bulk_n = 16, 1024
+
+    def _cap_timed_unit():
+        for _j in range(2):
+            for i in range(cap_groups):
+                eng.submit_bulk(f"r{i % n_rules}", cap_bulk_n)
+            eng.flush()
+            eng.drain()
+
+    cap_cols = {}
+    cap_tmp = tempfile.mkdtemp(prefix="bench-capture-")
+    try:
+        for depth in (0, 2):
+            eng.pipeline_depth = depth
+            off_s, on_s = [], []
+            cap = None
+            for _rep in range(5):
+                if cap is not None:
+                    cap.close()
+                    eng.capture = None
+                    cap = None
+                _cap_timed_unit()  # settle
+                t0 = time.perf_counter()
+                _cap_timed_unit()
+                off_s.append(time.perf_counter() - t0)
+                cap = CaptureJournal(eng, directory=cap_tmp)
+                cap.segment_bytes = 1 << 30  # no rollover I/O in the loop
+                eng.capture = cap
+                _cap_timed_unit()  # settle
+                t0 = time.perf_counter()
+                _cap_timed_unit()
+                on_s.append(time.perf_counter() - t0)
+            cap_bytes = (
+                cap.snapshot()["counters"]["bytes"] if cap is not None else 0
+            )
+            if cap is not None:
+                cap.close()
+                eng.capture = None
+            ratio = min(on_s) / min(off_s)
+            cap_cols[f"engine_capture_overhead_d{depth}"] = round(ratio, 4)
+            if depth == 0:
+                # Journal growth per armed flush (KiB) — the disk-rate
+                # context for the overhead ratio. The last rep's
+                # journal saw exactly 4 armed flushes (settle + timed).
+                cap_cols["engine_capture_kb_per_flush"] = round(
+                    cap_bytes / 4 / 1024.0, 1
+                )
+            _log(
+                f"engine capture overhead depth {depth}: "
+                f"{(ratio - 1) * 100:+.2f}% "
+                f"(off {min(off_s) * 1e3:.0f} ms on {min(on_s) * 1e3:.0f} ms)"
+            )
+    finally:
+        eng.pipeline_depth = 0
+        shutil.rmtree(cap_tmp, ignore_errors=True)
     partial = {
         "engine_ops_per_sec": round(ops_per_sec, 1),
         "engine_n_rules": n_rules,
@@ -493,6 +564,9 @@ def _run_engine_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         "dispatch_ms": round(t_p_dispatch / iters, 3),
         "drain_ms": round(t_p_drain / iters, 3),
         "pipeline_occupancy": round(occupancy, 3),
+        # Flight-recorder arming cost (same-run on/off median ratio on
+        # the bulk loop): ~1.0 means capture is free at flush scale.
+        **cap_cols,
         # Flight-recorder view of the whole stage (metrics/telemetry.py):
         # latency tails + arena hit rate + blocked sketch — the numbers
         # the /metrics scrape and the telemetry command would serve.

@@ -241,6 +241,18 @@ class ShardedTokenClient(TokenService):
                 c.stop()
             with self._token_lock:
                 self._token_shards.clear()
+            # Rule-timeline stream of the capture journal: a reshard
+            # changes which server decides cluster flows, so replay's
+            # explainer must be able to date it. Peek at the installed
+            # engine only — never construct one from a token client.
+            from sentinel_tpu.core import api as _core_api
+
+            eng = _core_api._engine
+            cap = getattr(eng, "capture", None) if eng is not None else None
+            if cap is not None:
+                cap.note_shard(
+                    new_map.version, ",".join(new_map.endpoints)
+                )
             return True
 
     def _client_for(self, flow_id: int) -> ClusterTokenClient:

@@ -162,6 +162,17 @@ def encode_args(args: Sequence[object]) -> bytes:
     return b"".join(out)
 
 
+def _args_need(buf: bytes, off: int, nbytes: int) -> None:
+    # Truncated payloads must raise (never silently short-slice into a
+    # misdecoded value): args blobs are now a DURABLE format (capture
+    # segments), not just ring slots sliced to exact length.
+    if off + nbytes > len(buf):
+        raise ValueError(
+            f"truncated args payload: need {nbytes} bytes at {off}, "
+            f"have {len(buf) - off}"
+        )
+
+
 def _dec_value(buf: bytes, off: int) -> Tuple[object, int]:
     tag = buf[off : off + 1]
     off += 1
@@ -172,17 +183,22 @@ def _dec_value(buf: bytes, off: int) -> Tuple[object, int]:
     if tag == b"F":
         return False, off
     if tag == b"i":
+        _args_need(buf, off, 8)
         return _I64.unpack_from(buf, off)[0], off + 8
     if tag == b"f":
+        _args_need(buf, off, 8)
         return _F64.unpack_from(buf, off)[0], off + 8
     if tag in (b"s", b"b"):
+        _args_need(buf, off, 4)
         n = _U32.unpack_from(buf, off)[0]
         off += 4
+        _args_need(buf, off, n)
         raw = buf[off : off + n]
         return (
             raw.decode("utf-8", "surrogatepass") if tag == b"s" else raw
         ), off + n
     if tag == b"(":
+        _args_need(buf, off, 2)
         n = _U16.unpack_from(buf, off)[0]
         off += 2
         items = []
@@ -196,6 +212,7 @@ def _dec_value(buf: bytes, off: int) -> Tuple[object, int]:
 def decode_args(buf: bytes) -> Tuple[object, ...]:
     if not buf:
         return ()
+    _args_need(buf, 0, 2)
     n = _U16.unpack_from(buf, 0)[0]
     off = 2
     out = []
@@ -303,13 +320,64 @@ def encode_entries(
     )
 
 
+def encode_entries_columns(
+    worker_id: int,
+    base_seq: int,
+    ts,
+    acquire,
+    entry_type: int,
+    resource_id: int,
+    context_id: int,
+    origin_id: int,
+    interns: Sequence[Tuple[int, bytes]],
+    intern_gen: int,
+    kind: int = KIND_BULK,
+) -> bytes:
+    """Vectorized ENTRY/BULK frame for a uniform columnar group: one
+    (resource, context, origin, entry_type) shared by all rows,
+    ``seq = base_seq + arange(n)``, per-row ``ts``/``acquire`` arrays,
+    no traces, no args, no group meta. Byte-identical to
+    ``encode_entries`` over the equivalent EntryRow list — the capture
+    journal's bulk spill uses this because a Python row loop at bulk
+    group sizes would cost more than the admission work it records."""
+    ts = np.ascontiguousarray(ts, np.int64)
+    acq = np.ascontiguousarray(acquire, np.int32)
+    n = len(ts)
+    seqs = np.arange(base_seq, base_seq + n, dtype=np.uint64)
+    zeros_u32 = np.zeros(n, np.uint32).tobytes()
+    intern_parts: List[bytes] = []
+    for iid, raw in interns:
+        intern_parts.append(_INTERN_HDR.pack(iid, len(raw)))
+        intern_parts.append(raw)
+    hdr = _HDR.pack(
+        kind, 0, worker_id, n, base_seq if n else 0,
+        intern_gen & 0xFFFFFFFF, 0, len(interns), 0,
+    )
+    return b"".join(
+        (
+            hdr, b"".join(intern_parts),
+            seqs.tobytes(), ts.tobytes(), acq.tobytes(),
+            np.full(n, entry_type, np.int8).tobytes(),
+            np.full(n, resource_id, np.int32).tobytes(),
+            np.full(n, context_id, np.int32).tobytes(),
+            np.full(n, origin_id, np.int32).tobytes(),
+            EMPTY_TRACE * n,
+            zeros_u32, zeros_u32,
+        )
+    )
+
+
 def encode_exits(
     worker_id: int,
     rows: Sequence[ExitRow],
     interns: Sequence[Tuple[int, bytes]],
     intern_gen: int,
     shed_count: int,
+    extras: bytes = b"",
 ) -> bytes:
+    """EXIT frame bytes. ``extras`` (optional) rides as the frame's
+    varbytes region — the ring clients never set it; the capture
+    journal uses it for the per-exit param-thread-row sidecar."""
     n = len(rows)
     seqs = np.fromiter((r.seq for r in rows), np.uint64, n)
     ts = np.fromiter((r.ts for r in rows), np.int64, n)
@@ -328,14 +396,14 @@ def encode_exits(
     hdr = _HDR.pack(
         KIND_EXIT, 0, worker_id, n, int(rows[0].seq) if n else 0,
         intern_gen & 0xFFFFFFFF, shed_count & 0xFFFFFFFF,
-        len(interns), 0,
+        len(interns), len(extras),
     )
     return b"".join(
         (
             hdr, b"".join(intern_parts),
             seqs.tobytes(), ts.tobytes(), rid.tobytes(), cid.tobytes(),
             oid.tobytes(), etype.tobytes(), rt.tobytes(), count.tobytes(),
-            err.tobytes(), spec.tobytes(),
+            err.tobytes(), spec.tobytes(), extras,
         )
     )
 
@@ -390,20 +458,36 @@ class DecodedFrame(NamedTuple):
     flags: int = 0
 
 
+def _need(payload: bytes, off: int, nbytes: int, what: str) -> None:
+    # Every region read is bounds-checked up front so a torn segment
+    # tail (or a fuzzer's truncation) raises ONE clean ValueError
+    # instead of struct.error / a silently short np.frombuffer slice
+    # that would misalign every column after it.
+    if off + nbytes > len(payload):
+        raise ValueError(
+            f"truncated frame: {what} needs {nbytes} bytes at {off}, "
+            f"payload is {len(payload)}"
+        )
+
+
 def decode_frame(payload: bytes) -> DecodedFrame:
+    _need(payload, 0, _HDR.size, "header")
     (
         kind, _flags, worker_id, n, _base, gen, shed, n_interns, var_len,
     ) = _HDR.unpack_from(payload, 0)
     off = _HDR.size
     interns: List[Tuple[int, bytes]] = []
     for _ in range(n_interns):
+        _need(payload, off, _INTERN_HDR.size, "intern header")
         iid, ln = _INTERN_HDR.unpack_from(payload, off)
         off += _INTERN_HDR.size
+        _need(payload, off, ln, "intern bytes")
         interns.append((iid, payload[off : off + ln]))
         off += ln
 
     def col(dtype, count=n):
         nonlocal off
+        _need(payload, off, np.dtype(dtype).itemsize * count, "column")
         a = np.frombuffer(payload, dtype=dtype, count=count, offset=off)
         off += a.nbytes
         return a
@@ -419,10 +503,12 @@ def decode_frame(payload: bytes) -> DecodedFrame:
         columns["resource_id"] = col(np.int32)
         columns["context_id"] = col(np.int32)
         columns["origin_id"] = col(np.int32)
+        _need(payload, off, n * _TRACE_BYTES, "trace column")
         traces = payload[off : off + n * _TRACE_BYTES]
         off += n * _TRACE_BYTES
         columns["args_off"] = col(np.uint32)
         columns["args_len"] = col(np.uint32)
+        _need(payload, off, var_len, "varbytes")
         varbytes = payload[off : off + var_len]
     elif kind == KIND_EXIT:
         columns["seq"] = col(np.uint64)
@@ -435,6 +521,8 @@ def decode_frame(payload: bytes) -> DecodedFrame:
         columns["count"] = col(np.int32)
         columns["err"] = col(np.int32)
         columns["spec"] = col(np.int8)
+        _need(payload, off, var_len, "varbytes")
+        varbytes = payload[off : off + var_len]
     elif kind == KIND_VERDICT:
         columns["seq"] = col(np.uint64)
         columns["admitted"] = col(np.uint8)

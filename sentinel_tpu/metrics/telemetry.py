@@ -309,6 +309,17 @@ class TelemetryBus:
             # sentinel.tpu.cluster.wait.cap.ms — the pre-cap path slept
             # per op back-to-back, unbounded).
             "cluster_wait_ms": 0,
+            # Black-box flight recorder (runtime/capture.py): chunks
+            # and frame records spilled, bytes written, segment
+            # rollovers, postmortem freezes, and bulk rows whose args
+            # column could not be serialized (those rows replay
+            # without args).
+            "capture_chunks": 0,
+            "capture_records": 0,
+            "capture_bytes": 0,
+            "capture_rollovers": 0,
+            "capture_freezes": 0,
+            "capture_args_dropped": 0,
         }
         # Bounded ring of health transitions (now_ms is engine-clock
         # relative ms): the flight-recorder view of the failover state
@@ -494,6 +505,27 @@ class TelemetryBus:
         with self._lock:
             self.counters["ingest_shed"] += n
 
+    # ------------------------------------------------------------------
+    # black-box flight recorder (runtime/capture.py)
+    # ------------------------------------------------------------------
+    def note_capture(
+        self, chunks: int, records: int, nbytes: int,
+        rollovers: int = 0, args_dropped: int = 0,
+    ) -> None:
+        """One journal flush interval's deltas — the capture journal
+        batches its counter publishes so the hot path stays at one
+        attribute read plus the spill itself."""
+        with self._lock:
+            self.counters["capture_chunks"] += chunks
+            self.counters["capture_records"] += records
+            self.counters["capture_bytes"] += nbytes
+            self.counters["capture_rollovers"] += rollovers
+            self.counters["capture_args_dropped"] += args_dropped
+
+    def note_capture_freeze(self, n: int = 1) -> None:
+        with self._lock:
+            self.counters["capture_freezes"] += n
+
     def note_window(self, reqs: int) -> None:
         """One adapter-edge batch window flushed with ``reqs`` coalesced
         requests (runtime/window.py)."""
@@ -670,155 +702,10 @@ def spans_to_trace(
     spans: Sequence[FlushSpan], pid: int = 1, records: Sequence = None
 ) -> dict:
     """Convert flight-recorder spans to the Chrome trace-event JSON
-    object format (Perfetto loads it directly).
+    object format (Perfetto loads it directly). The emission mechanics
+    and layout live in :func:`metrics.perfetto.spans_to_trace` — the
+    shared home of trace-event building for tracedump / fleetdump /
+    replay; this name stays as the stable import surface."""
+    from sentinel_tpu.metrics.perfetto import spans_to_trace as _impl
 
-    Layout: every span's ``encode`` and ``dispatch`` slices go on tid 1
-    (``host``) — flush dispatches are serialized under the engine's
-    flush lock, so they never overlap. The dispatch→settle window of a
-    deferred flush (``inflight``: device execution + fetch latency)
-    goes on the first free ``inflight-N`` tid (greedy interval
-    assignment), so a depth-K pipeline shows K parallel tracks whose
-    slices overlap the NEXT flush's encode on the host track — the
-    visual proof that host encode overlaps device execution.
-
-    ``records`` (admission_trace.AdmissionRecord) adds a ``requests``
-    track (tid 2): one slice per sampled admission spanning
-    enqueue→verdict, plus a Perfetto flow arrow (``ph: s``/``f`` pair)
-    from the admission to the flush span that DECIDED it (matched on
-    ``flush_seq``) — the request-level half of the pipeline picture:
-    you can see a 429'd call, hover its trace id, and follow the arrow
-    into the flush that produced the verdict.
-
-    All ``ts``/``dur`` are µs relative to the earliest span/record."""
-    spans = list(spans)
-    records = list(records) if records else []
-    if not spans and not records:
-        return {"traceEvents": [], "displayTimeUnit": "ms"}
-    base = min(
-        [s.t0 for s in spans] + [r.t0 for r in records]
-    )
-
-    def us(t: float) -> float:
-        return (t - base) * 1e6
-
-    events: List[dict] = [
-        {"ph": "M", "pid": pid, "tid": 1, "name": "thread_name",
-         "args": {"name": "host"}},
-    ]
-    # Greedy tid assignment for in-flight windows: slot i is free when
-    # its last end <= the new start (small epsilon for fp jitter).
-    slot_ends: List[float] = []
-    named_slots = set()
-    # flush_id -> a ts inside that span's dispatch slice (flow-arrow
-    # anchor: a flow endpoint must land within a slice on its tid).
-    dispatch_anchor: Dict[int, float] = {}
-    for s in sorted(spans, key=lambda s: s.t0):
-        enc_start = us(s.t0)
-        enc_dur = s.encode_ms * 1e3
-        disp_start = enc_start + enc_dur
-        disp_dur = s.dispatch_ms * 1e3
-        args = {
-            "flush_id": s.flush_id, "rows": s.rows, "depth": s.depth,
-            "inflight": s.inflight, "deferred": s.deferred,
-        }
-        events.append({
-            "ph": "X", "pid": pid, "tid": 1, "name": "encode",
-            "cat": "flush", "ts": enc_start, "dur": enc_dur, "args": args,
-        })
-        events.append({
-            "ph": "X", "pid": pid, "tid": 1, "name": "dispatch",
-            "cat": "flush", "ts": disp_start, "dur": disp_dur, "args": args,
-        })
-        dispatch_anchor[s.flush_id] = disp_start + disp_dur * 0.5
-        if s.settled and s.settle_end > s.t0:
-            fly_start = disp_start + disp_dur
-            fly_end = us(s.settle_end)
-            fly_dur = max(fly_end - fly_start, 0.0)
-            slot = None
-            for i, end in enumerate(slot_ends):
-                if end <= fly_start + 1e-3:
-                    slot = i
-                    break
-            if slot is None:
-                slot = len(slot_ends)
-                slot_ends.append(0.0)
-            slot_ends[slot] = fly_start + fly_dur
-            tid = 10 + slot
-            if tid not in named_slots:
-                named_slots.add(tid)
-                events.append({
-                    "ph": "M", "pid": pid, "tid": tid,
-                    "name": "thread_name",
-                    "args": {"name": f"inflight-{slot}"},
-                })
-            events.append({
-                "ph": "X", "pid": pid, "tid": tid, "name": "inflight",
-                "cat": "device", "ts": fly_start, "dur": fly_dur,
-                "args": args,
-            })
-    if records:
-        # Concurrent admissions overlap in time (a whole chunk settles
-        # together), so request slices get the same greedy slot-track
-        # assignment as the inflight windows: tids 100+N, capped — a
-        # dump with thousands of concurrent sampled requests overflows
-        # onto the last track rather than exploding the track count.
-        REQ_TID0, REQ_TRACKS_MAX = 100, 16
-        req_slot_ends: List[float] = []
-        named_req = set()
-        for i, r in enumerate(sorted(records, key=lambda r: r.t0)):
-            req_start = us(r.t0)
-            req_dur = max(r.latency_ms * 1e3, 1.0)
-            slot = None
-            for j, end in enumerate(req_slot_ends):
-                if end <= req_start + 1e-3:
-                    slot = j
-                    break
-            if slot is None:
-                if len(req_slot_ends) < REQ_TRACKS_MAX:
-                    slot = len(req_slot_ends)
-                    req_slot_ends.append(0.0)
-                else:
-                    slot = REQ_TRACKS_MAX - 1
-            req_slot_ends[slot] = max(req_slot_ends[slot],
-                                      req_start + req_dur)
-            tid = REQ_TID0 + slot
-            if tid not in named_req:
-                named_req.add(tid)
-                events.append({
-                    "ph": "M", "pid": pid, "tid": tid,
-                    "name": "thread_name",
-                    "args": {"name": f"requests-{slot}"},
-                })
-            events.append({
-                "ph": "X", "pid": pid, "tid": tid,
-                "name": r.resource, "cat": "admission",
-                "ts": req_start, "dur": req_dur,
-                "args": {
-                    "trace_id": r.trace_id, "span_id": r.span_id,
-                    "admitted": r.admitted, "reason": r.reason,
-                    "reason_name": r.reason_name,
-                    "flush_seq": r.flush_seq,
-                    "origin": r.origin,
-                },
-            })
-            anchor = dispatch_anchor.get(r.flush_seq)
-            if anchor is None or anchor < req_start:
-                # No linkable flush span in the dump (telemetry off,
-                # span evicted from the ring, or clock skew) — the
-                # request slice still renders, just without an arrow.
-                continue
-            flow = {
-                "cat": "admission", "name": "decide", "id": i + 1,
-                "pid": pid,
-            }
-            # Arrow: admission enqueue (request track) → deciding
-            # flush's dispatch slice (tid 1). Chrome flows require
-            # s.ts <= f.ts; an op is always enqueued before its flush
-            # dispatches, and the start is clamped below the anchor in
-            # case the dispatch followed within the nudge.
-            events.append({**flow, "ph": "s", "tid": tid,
-                           "ts": min(req_start + min(req_dur * 0.25, 1.0),
-                                     anchor)})
-            events.append({**flow, "ph": "f", "bp": "e", "tid": 1,
-                           "ts": anchor})
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    return _impl(spans, pid=pid, records=records)

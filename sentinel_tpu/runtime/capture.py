@@ -1,0 +1,901 @@
+"""Black-box flight recorder: durable capture of the admission stream.
+
+The metric log and the span planes record *aggregates*; none of them
+can answer "what exact traffic tripped this breaker, and would the fix
+have admitted it?". The capture journal is the missing black box: a
+bounded rolling on-disk spill of the columnar admission stream itself
+— every chunk the engine dispatches (singles, BatchWindow groups,
+bulk, IPC-drained frames: they all meet in ``_run_chunk``) plus the
+verdicts the device (or the degraded host fallback) produced for it —
+in the ``ipc/frames.py`` codec, so the durable format is the one wire
+format the repo already fuzzes and version-guards.
+
+Segment format (``seg-NNNNNN.cap``)::
+
+    magic "STPUCAP1" | u32 header_len | header JSON | records...
+
+The JSON header carries the deciding world: a config snapshot
+(``config.runtime_snapshot``), the boot id, the engine-clock /
+wall-clock anchor pair (the control-header wall-ms ruler offset when
+the fleet span journal has observed a beat), and the capture row
+cursor. Each record is::
+
+    rkind u8 | flags u8 | reserved u16 | len u32 | flush_seq i64 |
+    clock_ms i64 | wall_ms u64 | payload[len]
+
+``RK_ENTRIES``/``RK_BULK``/``RK_EXITS``/``RK_BULK_EXITS``/``RK_VERDICT``
+payloads are single ipc frames; ``RK_FLUSH`` marks one dispatched
+chunk's boundary (the recorded virtual-clock ``now_ms`` the kernel
+read, and the engine ``flush_seq``); ``RK_RULES``/``RK_HEALTH``/
+``RK_SKETCH``/``RK_SHARD``/``RK_FREEZE`` are the JSON rule-timeline
+stream replay applies to reconstruct the deciding rule world. String
+interning is scoped per segment (every segment decodes standalone —
+a torn tail or a deleted predecessor never strands a name id).
+
+Postmortem freeze: a breaker opening, a shed streak, a DEGRADED
+transition, an on-demand ``capture`` transport command — or engine
+death (the next boot renames the dead process's live segments to
+``frozen-death-*`` before it writes a byte) — pins the last
+``freeze.seconds`` of segments against rollover deletion.
+
+Everything is off by default: ``engine.capture is None`` and every hot
+path pays exactly one attribute read. See ``tools/replay.py`` for the
+deterministic replay / verify / explain side.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sentinel_tpu.ipc import frames
+from sentinel_tpu.utils.config import config
+
+MAGIC = b"STPUCAP1"
+
+# Record header: rkind u8, flags u8, reserved u16, payload_len u32,
+# flush_seq i64 (engine flush seq, -1 for degraded/no-seq chunks and
+# timeline records), clock_ms i64 (engine clock), wall_ms u64.
+_REC = struct.Struct("<BBHIqqQ")
+
+RK_ENTRIES = 1      # one KIND_ENTRY frame: the chunk's single ops
+RK_BULK = 2         # one KIND_BULK frame per columnar group
+RK_EXITS = 3        # one KIND_EXIT frame: the chunk's single exits
+RK_BULK_EXITS = 4   # one KIND_EXIT frame per columnar exit group
+RK_VERDICT = 5      # one KIND_VERDICT frame: settled verdicts by cap seq
+RK_FLUSH = 6        # chunk boundary: recorded now_ms + flush_seq
+RK_RULES = 7        # rule-timeline: a set_*_rules reload
+RK_HEALTH = 8       # failover transitions / breaker openings
+RK_SKETCH = 9       # sketch promotions/demotions (informational)
+RK_SHARD = 10       # cluster shard-map version bump
+RK_FREEZE = 11      # postmortem freeze marker
+
+_RECORD_NAMES = {
+    RK_ENTRIES: "entries", RK_BULK: "bulk", RK_EXITS: "exits",
+    RK_BULK_EXITS: "bulk_exits", RK_VERDICT: "verdict",
+    RK_FLUSH: "flush", RK_RULES: "rules", RK_HEALTH: "health",
+    RK_SKETCH: "sketch", RK_SHARD: "shard", RK_FREEZE: "freeze",
+}
+
+# Verdict-row flag bits beyond the ipc pair (F_SPECULATIVE=1,
+# F_DEGRADED=2): a row whose op had no settled verdict at record time.
+F_VERDICT_MISSING = 128
+
+# EntryRow.entry_type packing for captured ops: bit 0 = EntryType.IN,
+# bit 6 = prioritized (occupy) entry.
+_ET_IN = 1
+_ET_PRIO = 0x40
+
+
+def _wall_ms() -> float:
+    from sentinel_tpu.metrics.spans import wall_ms
+
+    return wall_ms()
+
+
+def maybe_build_capture(engine) -> Optional["CaptureJournal"]:
+    """None unless ``sentinel.tpu.capture.enabled`` — the disabled
+    footprint is ``engine.capture is None``, one attribute read."""
+    if not config.get_bool(config.CAPTURE_ENABLED, False):
+        return None
+    return CaptureJournal(engine)
+
+
+class CaptureJournal:
+    """Bounded rolling on-disk capture of one engine's admission
+    stream. All writers funnel through one internal lock (chunk spills
+    run under the engine's flush lock, but verdict fills arrive from
+    drain threads and freezes from transport/health threads)."""
+
+    def __init__(self, engine, directory: Optional[str] = None) -> None:
+        self._engine = engine
+        self.dir = (
+            directory
+            or config.get(config.CAPTURE_DIR)
+            or "sentinel-capture"
+        )
+        self.segment_bytes = max(
+            64 * 1024, config.get_int(config.CAPTURE_SEGMENT_BYTES, 4 * 1024 * 1024)
+        )
+        self.segments_max = max(2, config.get_int(config.CAPTURE_SEGMENTS_MAX, 8))
+        self.frozen_max = max(1, config.get_int(config.CAPTURE_FROZEN_MAX, 16))
+        self.freeze_ms = 1000 * max(
+            1, config.get_int(config.CAPTURE_FREEZE_SECONDS, 30)
+        )
+        self.shed_streak = max(
+            0, config.get_int(config.CAPTURE_SHED_STREAK, 64)
+        )
+        self._lock = threading.Lock()
+        self._boot_id = os.urandom(8).hex()
+        self.counters: Dict[str, int] = {
+            "chunks": 0, "frames": 0, "bytes": 0, "rollovers": 0,
+            "freezes": 0, "args_dropped": 0,
+        }
+        self._tele_pub = dict(self.counters)
+        os.makedirs(self.dir, exist_ok=True)
+        # Engine death is the one freeze trigger that cannot run in the
+        # dying process: the NEXT boot pins its predecessor's leftover
+        # live segments before writing a byte of its own.
+        self._preserve_death_segments()
+        self._f: Optional[io.BufferedWriter] = None
+        self._seg_index = 0
+        self._seg_bytes = 0
+        # Live (rollover-eligible) segments, oldest first:
+        # [(index, path, last_wall_ms)].
+        self._live: List[List[Any]] = []
+        self._interns: Dict[str, int] = {}
+        self._cap_seq = 0
+        self._shed_run = 0
+        self._open_segment_locked()
+
+    # ------------------------------------------------------------------
+    # segment lifecycle
+    # ------------------------------------------------------------------
+    def _preserve_death_segments(self) -> None:
+        try:
+            leftovers = sorted(
+                fn for fn in os.listdir(self.dir)
+                if fn.startswith("seg-") and fn.endswith(".cap")
+            )
+        except OSError:
+            return
+        for fn in leftovers:
+            dst = os.path.join(self.dir, f"frozen-death-{fn}")
+            i = 1
+            while os.path.exists(dst):
+                dst = os.path.join(self.dir, f"frozen-death-{i}-{fn}")
+                i += 1
+            try:
+                os.rename(os.path.join(self.dir, fn), dst)
+            except OSError:
+                pass
+        if leftovers:
+            self._trim_frozen()
+
+    def _segment_path(self, index: int) -> str:
+        return os.path.join(self.dir, f"seg-{index:06d}.cap")
+
+    def _open_segment_locked(self) -> None:
+        eng = self._engine
+        header: Dict[str, Any] = {
+            "version": 1,
+            "segment": self._seg_index,
+            "boot_id": self._boot_id,
+            "app": config.app_name,
+            "wall_ms": round(_wall_ms(), 3),
+            "clock_ms": int(eng.clock.now_ms()),
+            "cap_seq": self._cap_seq,
+            "config": config.runtime_snapshot("sentinel.tpu."),
+            "rules": self._rules_snapshot(),
+        }
+        try:
+            from sentinel_tpu.metrics.spans import get_journal
+
+            meta = get_journal("engine")._meta()
+            if "ruler_off_ms" in meta:
+                # The control-header wall-ms ruler (ipc plane): lets
+                # fleetdump/replay place this capture on the merged
+                # fleet timeline despite per-process clock skew.
+                header["ruler_off_ms"] = meta["ruler_off_ms"]
+        except Exception:
+            pass
+        blob = json.dumps(header, sort_keys=True).encode("utf-8")
+        path = self._segment_path(self._seg_index)
+        self._f = open(path, "wb")
+        self._f.write(MAGIC)
+        self._f.write(struct.pack("<I", len(blob)))
+        self._f.write(blob)
+        self._seg_bytes = len(MAGIC) + 4 + len(blob)
+        # Header hits disk immediately: a process that dies before its
+        # first chunk still leaves a parseable (empty) segment.
+        self._f.flush()
+        self._interns = {}
+        self._live.append([self._seg_index, path, _wall_ms()])
+
+    def _rules_snapshot(self) -> Dict[str, Any]:
+        """The rule world at segment open — every segment replays
+        standalone (a reader never needs the previous segment's
+        timeline to reconstruct the deciding rules). Sketch-tier
+        synthetics are excluded on purpose: replay arms its own sketch
+        tier under the captured config and re-derives them."""
+        eng = self._engine
+        return {
+            "flow": [r.to_dict() for r in eng.flow_index.user_rules()],
+            "degrade": [r.to_dict() for r in eng.degrade_index.rules],
+            "param": [
+                r.to_dict()
+                for pairs in getattr(eng.param_index, "by_resource", {}).values()
+                for _gid, r in pairs
+                if not getattr(r, "from_sketch", False)
+            ],
+            "authority": {
+                res: r.to_dict() for res, r in eng.authority_rules.items()
+            },
+            "system": _system_to_dict(eng.system_config),
+        }
+
+    def _roll_locked(self) -> None:
+        self._f.close()
+        self._seg_index += 1
+        self.counters["rollovers"] += 1
+        self._open_segment_locked()
+        while len(self._live) > self.segments_max:
+            _idx, path, _w = self._live.pop(0)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _trim_frozen(self) -> None:
+        try:
+            frozen = sorted(
+                (os.path.getmtime(os.path.join(self.dir, fn)), fn)
+                for fn in os.listdir(self.dir)
+                if fn.startswith("frozen-") and fn.endswith(".cap")
+            )
+        except OSError:
+            return
+        while len(frozen) > self.frozen_max:
+            _t, fn = frozen.pop(0)
+            try:
+                os.remove(os.path.join(self.dir, fn))
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+    # record writing
+    # ------------------------------------------------------------------
+    def _write_locked(self, rkind: int, payload: bytes, flush_seq: int = -1) -> None:
+        if self._f is None:
+            return  # closed journal: a late exit-flush spill is dropped
+        now_wall = _wall_ms()
+        hdr = _REC.pack(
+            rkind, 0, 0, len(payload), flush_seq,
+            int(self._engine.clock.now_ms()), int(now_wall),
+        )
+        self._f.write(hdr)
+        self._f.write(payload)
+        self._seg_bytes += _REC.size + len(payload)
+        self._live[-1][2] = now_wall
+        self.counters["frames"] += 1
+        self.counters["bytes"] += _REC.size + len(payload)
+
+    def _json_locked(self, rkind: int, obj: Any, flush_seq: int = -1) -> None:
+        self._write_locked(
+            rkind, json.dumps(obj, sort_keys=True).encode("utf-8"), flush_seq
+        )
+
+    def _iid(self, name: Optional[str], fresh: List[Tuple[int, bytes]]) -> int:
+        """Per-segment string interning; id 0 is reserved for None."""
+        if name is None:
+            return 0
+        iid = self._interns.get(name)
+        if iid is None:
+            iid = len(self._interns) + 1
+            self._interns[name] = iid
+            fresh.append((iid, name.encode("utf-8", "surrogatepass")))
+        return iid
+
+    # ------------------------------------------------------------------
+    # hot-path hooks (engine._run_chunk / fill)
+    # ------------------------------------------------------------------
+    def note_chunk(
+        self, entries, exits, bulk, bulk_exits, now_ms: int, seq: int,
+    ) -> List[Optional[int]]:
+        """Spill one dispatched chunk's inputs BEFORE the kernel runs
+        (a dispatch fault must not lose the traffic that caused it).
+        Returns the verdict token ``[cap_base]`` that the fill path
+        hands back to :meth:`note_verdicts` exactly once. Runs under
+        the engine flush lock; the internal lock orders it against
+        drain-thread verdict fills and transport freezes."""
+        with self._lock:
+            base = self._cap_seq
+            n_rows = len(entries) + sum(g.n for g in bulk)
+            self._cap_seq += n_rows
+            self._shed_run = 0
+            self.counters["chunks"] += 1
+            self._json_locked(
+                RK_FLUSH,
+                {
+                    "cap_seq": base,
+                    "now_ms": int(now_ms),
+                    "rows": n_rows,
+                    "n_entries": len(entries),
+                    "n_bulk": [g.n for g in bulk],
+                    "n_exits": len(exits),
+                    "n_bulk_exits": [g.n for g in bulk_exits],
+                },
+                flush_seq=seq,
+            )
+            gen = self._seg_index
+            if entries:
+                fresh: List[Tuple[int, bytes]] = []
+                rows = []
+                for i, op in enumerate(entries):
+                    et = (_ET_IN if op.rows[3] != -1 else 0) | (
+                        _ET_PRIO if op.prio else 0
+                    )
+                    rows.append(frames.EntryRow(
+                        seq=base + i,
+                        resource_id=self._iid(op.resource, fresh),
+                        context_id=self._iid(op.context_name, fresh),
+                        origin_id=self._iid(op.origin, fresh),
+                        entry_type=et,
+                        acquire=int(op.acquire),
+                        ts=int(op.ts),
+                        trace=frames.EMPTY_TRACE,
+                        args=frames.encode_args(op.args) if op.args else b"",
+                    ))
+                self._write_locked(
+                    RK_ENTRIES,
+                    frames.encode_entries(0, rows, fresh, gen, 0),
+                    flush_seq=seq,
+                )
+            off = base + len(entries)
+            for g in bulk:
+                fresh = []
+                et = _ET_IN if g.rows[3] != -1 else 0
+                args_col = self._bulk_args(g)
+                if args_col is None:
+                    # Argless group: the vectorized spill — a Python
+                    # row loop at bulk sizes would dominate the very
+                    # admission cost being recorded.
+                    self._write_locked(
+                        RK_BULK,
+                        frames.encode_entries_columns(
+                            0, off, g.ts, g.acquire, et,
+                            self._iid(g.resource, fresh),
+                            self._iid(g.context_name, fresh),
+                            self._iid(g.origin, fresh),
+                            fresh, gen,
+                        ),
+                        flush_seq=seq,
+                    )
+                    off += g.n
+                    continue
+                rows = []
+                for j in range(g.n):
+                    a = b""
+                    if args_col is not None:
+                        tup = args_col[j]
+                        if tup:
+                            a = frames.encode_args(tuple(tup))
+                    rows.append(frames.EntryRow(
+                        seq=off + j,
+                        resource_id=self._iid(g.resource, fresh),
+                        context_id=self._iid(g.context_name, fresh),
+                        origin_id=self._iid(g.origin, fresh),
+                        entry_type=et,
+                        acquire=int(g.acquire[j]),
+                        ts=int(g.ts[j]),
+                        trace=frames.EMPTY_TRACE,
+                        args=a,
+                    ))
+                self._write_locked(
+                    RK_BULK,
+                    frames.encode_entries(
+                        0, rows, fresh, gen, 0, kind=frames.KIND_BULK
+                    ),
+                    flush_seq=seq,
+                )
+                off += g.n
+            if exits:
+                fresh = []
+                xrows = [
+                    frames.ExitRow(
+                        seq=_pack_exit_seq(op.rows[3], self._iid(op.resource, fresh)),
+                        resource_id=int(op.rows[0]),
+                        context_id=int(op.rows[1]),
+                        origin_id=int(op.rows[2]),
+                        entry_type=_clamp_i8(op.thr),
+                        ts=int(op.ts),
+                        rt=int(op.rt),
+                        count=int(op.count),
+                        err=int(op.err),
+                        spec=0,
+                    )
+                    for op in exits
+                ]
+                extras = b""
+                if any(op.p_rows for op in exits):
+                    extras = frames.encode_args(
+                        [tuple(int(r) for r in op.p_rows) for op in exits]
+                    )
+                self._write_locked(
+                    RK_EXITS,
+                    frames.encode_exits(0, xrows, fresh, gen, 0, extras=extras),
+                    flush_seq=seq,
+                )
+            for gx in bulk_exits:
+                fresh = []
+                sfield = _pack_exit_seq(
+                    gx.rows[3], self._iid(gx.resource, fresh)
+                )
+                xrows = [
+                    frames.ExitRow(
+                        seq=sfield,
+                        resource_id=int(gx.rows[0]),
+                        context_id=int(gx.rows[1]),
+                        origin_id=int(gx.rows[2]),
+                        entry_type=_clamp_i8(gx.thr),
+                        ts=int(gx.ts[j]),
+                        rt=int(gx.rt[j]),
+                        count=int(gx.count[j]),
+                        err=int(gx.err[j]),
+                        spec=0,
+                    )
+                    for j in range(gx.n)
+                ]
+                self._write_locked(
+                    RK_BULK_EXITS,
+                    frames.encode_exits(0, xrows, fresh, gen, 0),
+                    flush_seq=seq,
+                )
+            if self._f is not None:
+                if self._seg_bytes >= self.segment_bytes:
+                    self._roll_locked()
+                self._f.flush()
+            self._publish_tele_locked()
+        return [base]
+
+    def _publish_tele_locked(self) -> None:
+        tele = getattr(self._engine, "telemetry", None)
+        if tele is None or not tele.enabled:
+            return
+        c, p = self.counters, self._tele_pub
+        tele.note_capture(
+            c["chunks"] - p["chunks"], c["frames"] - p["frames"],
+            c["bytes"] - p["bytes"], c["rollovers"] - p["rollovers"],
+            c["args_dropped"] - p["args_dropped"],
+        )
+        self._tele_pub = dict(c)
+
+    def _bulk_args(self, g) -> Optional[Sequence]:
+        col = g.args_column
+        if col is None:
+            return None
+        try:
+            first = col[0]
+        except Exception:
+            first = None
+        if isinstance(first, (tuple, list)):
+            return col
+        # Pre-split adapter columns (ArgsColumns) don't reconstruct to
+        # per-row tuples cheaply — counted, never silent: a capture
+        # with dropped args will not replay bit-exact under param rules.
+        self.counters["args_dropped"] += g.n
+        return None
+
+    def note_verdicts(self, token, entries, bulk, degraded: bool = False) -> None:
+        """Spill the settled verdicts of one captured chunk (called
+        from the fill path — sync, deferred materialization, degraded
+        fill, or quarantine — exactly once per token)."""
+        if token is None:
+            return
+        base = token[0]
+        if base is None:
+            return
+        token[0] = None
+        n = len(entries) + sum(g.n for g in bulk)
+        if n == 0:
+            return
+        seqs = np.empty(n, np.uint64)
+        admitted = np.zeros(n, np.uint8)
+        reason = np.zeros(n, np.int16)
+        wait = np.zeros(n, np.int32)
+        flags = np.zeros(n, np.uint8)
+        i = 0
+        for op in entries:
+            v = op._verdict
+            seqs[i] = base + i
+            if v is None:
+                flags[i] = F_VERDICT_MISSING
+            else:
+                admitted[i] = 1 if v.admitted else 0
+                reason[i] = v.reason
+                wait[i] = v.wait_ms
+                f = 0
+                if v.speculative:
+                    f |= frames.F_SPECULATIVE
+                if v.degraded:
+                    f |= frames.F_DEGRADED
+                flags[i] = f
+            i += 1
+        for g in bulk:
+            sl = slice(i, i + g.n)
+            seqs[sl] = np.arange(base + i, base + i + g.n, dtype=np.uint64)
+            if g._admitted is None:
+                flags[sl] = F_VERDICT_MISSING
+            else:
+                admitted[sl] = g._admitted.astype(np.uint8)
+                reason[sl] = g._reason.astype(np.int16)
+                wait[sl] = g._wait_ms.astype(np.int32)
+                if degraded:
+                    flags[sl] = frames.F_DEGRADED
+            i += g.n
+        payload = frames.encode_verdicts(0, seqs, admitted, reason, wait, flags)
+        with self._lock:
+            self._write_locked(RK_VERDICT, payload)
+            if self._f is not None:
+                self._f.flush()
+
+    # ------------------------------------------------------------------
+    # rule-timeline / event hooks
+    # ------------------------------------------------------------------
+    def note_rules(self, kind: str, rules: Any, from_sketch: bool = False) -> None:
+        with self._lock:
+            self._json_locked(
+                RK_RULES,
+                {"kind": kind, "rules": rules, "from_sketch": from_sketch},
+            )
+            if self._f is not None:
+                self._f.flush()
+
+    def note_health(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            self._json_locked(RK_HEALTH, event)
+            if self._f is not None:
+                self._f.flush()
+        if event.get("to") == "DEGRADED":
+            self.freeze("degraded")
+
+    def note_breaker_open(self, resources: List[str]) -> None:
+        self.note_health({"event": "breaker_open", "resources": resources})
+        self.freeze("breaker")
+
+    def note_sketch(self, info: Dict[str, Any]) -> None:
+        with self._lock:
+            self._json_locked(RK_SKETCH, info)
+
+    def note_shard(self, version: int, mapping: str = "") -> None:
+        with self._lock:
+            self._json_locked(
+                RK_SHARD, {"version": int(version), "map": mapping}
+            )
+
+    def note_shed(self, n: int = 1) -> None:
+        """Shed-streak freeze trigger: ``n`` consecutive valve sheds
+        with no dispatched chunk in between (note_chunk resets the
+        run) pin the traffic that saturated the engine."""
+        if self.shed_streak <= 0:
+            return
+        with self._lock:
+            self._shed_run += n
+            fire = self._shed_run >= self.shed_streak
+            if fire:
+                self._shed_run = 0
+        if fire:
+            self.freeze("shed")
+
+    # ------------------------------------------------------------------
+    # freeze / snapshot / close
+    # ------------------------------------------------------------------
+    def freeze(self, reason: str) -> List[str]:
+        """Pin the last ``freeze.seconds`` of segments against
+        rollover: the current segment closes (after an RK_FREEZE
+        marker), every recent live segment is renamed ``frozen-*`` (out
+        of the rollover set), and a fresh segment opens. Returns the
+        frozen paths."""
+        frozen: List[str] = []
+        with self._lock:
+            if self._f is None:
+                return frozen
+            self._json_locked(RK_FREEZE, {"reason": reason})
+            self._f.close()
+            cutoff = _wall_ms() - self.freeze_ms
+            keep: List[List[Any]] = []
+            for ent in self._live:
+                idx, path, last = ent
+                if last >= cutoff:
+                    dst = os.path.join(
+                        self.dir,
+                        f"frozen-{reason}-{os.path.basename(path)}",
+                    )
+                    i = 1
+                    while os.path.exists(dst):
+                        dst = os.path.join(
+                            self.dir,
+                            f"frozen-{reason}-{i}-{os.path.basename(path)}",
+                        )
+                        i += 1
+                    try:
+                        os.rename(path, dst)
+                        frozen.append(dst)
+                    except OSError:
+                        keep.append(ent)
+                else:
+                    keep.append(ent)
+            self._live = keep
+            self.counters["freezes"] += 1
+            self._seg_index += 1
+            self._open_segment_locked()
+        self._trim_frozen()
+        tele = getattr(self._engine, "telemetry", None)
+        if tele is not None and tele.enabled:
+            tele.note_capture_freeze()
+        return frozen
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            try:
+                files = sorted(
+                    fn for fn in os.listdir(self.dir) if fn.endswith(".cap")
+                )
+            except OSError:
+                files = []
+            return {
+                "dir": self.dir,
+                "boot_id": self._boot_id,
+                "segment": self._seg_index,
+                "segment_bytes": self._seg_bytes,
+                "cap_seq": self._cap_seq,
+                "counters": dict(self.counters),
+                "live": [os.path.basename(p) for _i, p, _w in self._live],
+                "frozen": [f for f in files if f.startswith("frozen-")],
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def _pack_exit_seq(thread_row: int, resource_iid: int) -> int:
+    """Exit rows have no spare wide column for (thread_row, explicit
+    resource): pack both into the u64 seq field — low 32 bits the
+    resource intern id (0 = None), high bits thread_row + 1."""
+    return ((int(thread_row) + 1) << 32) | (resource_iid & 0xFFFFFFFF)
+
+
+def _unpack_exit_seq(seq: int) -> Tuple[int, int]:
+    return (int(seq) >> 32) - 1, int(seq) & 0xFFFFFFFF
+
+
+def _clamp_i8(v: int) -> int:
+    return max(-128, min(127, int(v)))
+
+
+def _system_to_dict(cfg) -> Optional[Dict[str, Any]]:
+    if cfg is None:
+        return None
+    out: Dict[str, Any] = {}
+    for f in (
+        "qps", "max_thread", "max_rt", "highest_system_load",
+        "highest_cpu_usage",
+    ):
+        if hasattr(cfg, f):
+            out[f] = getattr(cfg, f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reader side (tools/replay.py, tests, chaos checks)
+# ---------------------------------------------------------------------------
+class Record:
+    __slots__ = ("rkind", "flags", "flush_seq", "clock_ms", "wall_ms", "payload")
+
+    def __init__(self, rkind, flags, flush_seq, clock_ms, wall_ms, payload):
+        self.rkind = rkind
+        self.flags = flags
+        self.flush_seq = flush_seq
+        self.clock_ms = clock_ms
+        self.wall_ms = wall_ms
+        self.payload = payload
+
+    @property
+    def name(self) -> str:
+        return _RECORD_NAMES.get(self.rkind, f"rk{self.rkind}")
+
+    def json(self) -> Any:
+        return json.loads(self.payload.decode("utf-8"))
+
+
+def read_segment(path: str) -> Tuple[Dict[str, Any], List[Record]]:
+    """Parse one segment: (header, records). A torn tail (the process
+    died mid-write) terminates the record list cleanly — everything
+    before the tear is returned, nothing raises."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if blob[: len(MAGIC)] != MAGIC:
+        raise ValueError(f"{path}: not a capture segment (bad magic)")
+    off = len(MAGIC)
+    if off + 4 > len(blob):
+        raise ValueError(f"{path}: truncated segment header length")
+    (hlen,) = struct.unpack_from("<I", blob, off)
+    off += 4
+    if off + hlen > len(blob):
+        raise ValueError(f"{path}: truncated segment header")
+    header = json.loads(blob[off : off + hlen].decode("utf-8"))
+    off += hlen
+    records: List[Record] = []
+    while off + _REC.size <= len(blob):
+        rkind, flags, _res, plen, fseq, clk, wall = _REC.unpack_from(blob, off)
+        if rkind not in _RECORD_NAMES:
+            break  # tear or corruption: stop cleanly at the last good record
+        body_off = off + _REC.size
+        if body_off + plen > len(blob):
+            break  # torn tail mid-payload
+        records.append(
+            Record(rkind, flags, fseq, clk, wall, blob[body_off : body_off + plen])
+        )
+        off = body_off + plen
+    return header, records
+
+
+def capture_paths(directory: str, frozen: bool = False) -> List[str]:
+    """Segment paths of one capture directory in stream order. With
+    ``frozen`` the frozen-* postmortem files are included (ordered by
+    their embedded segment index)."""
+    try:
+        names = [fn for fn in os.listdir(directory) if fn.endswith(".cap")]
+    except OSError:
+        return []
+    picked = []
+    for fn in sorted(names):
+        if fn.startswith("seg-") or (frozen and fn.startswith("frozen-")):
+            picked.append(os.path.join(directory, fn))
+    keyed = []
+    for p in picked:
+        try:
+            header, _recs = read_segment(p)
+        except (OSError, ValueError):
+            continue
+        keyed.append(((header.get("wall_ms", 0), header.get("segment", 0)), p))
+    return [p for _k, p in sorted(keyed)]
+
+
+class CapturedChunk:
+    """One dispatched chunk decoded back to submission-shaped data."""
+
+    __slots__ = (
+        "flush_seq", "now_ms", "cap_seq", "rows", "entries", "bulk",
+        "exits", "bulk_exits", "verdicts",
+    )
+
+    def __init__(self, flush_seq, now_ms, cap_seq, rows):
+        self.flush_seq = flush_seq
+        self.now_ms = now_ms
+        self.cap_seq = cap_seq
+        self.rows = rows
+        self.entries: List[Dict[str, Any]] = []
+        self.bulk: List[Dict[str, Any]] = []
+        self.exits: List[Dict[str, Any]] = []
+        self.bulk_exits: List[Dict[str, Any]] = []
+        # (admitted u8, reason i16, wait i32, flags u8) aligned to
+        # cap_seq..cap_seq+rows, or None when the capture ended before
+        # the chunk's fill landed.
+        self.verdicts: Optional[Tuple[np.ndarray, ...]] = None
+
+
+def _decode_entry_frame(payload: bytes, names: Dict[int, Optional[str]]) -> List[Dict[str, Any]]:
+    df = frames.decode_frame(payload)
+    for iid, raw in df.interns:
+        names[iid] = raw.decode("utf-8", "surrogatepass")
+    cols = df.columns
+    out = []
+    var = df.varbytes
+    for i in range(df.n):
+        et = int(cols["entry_type"][i])
+        alen = int(cols["args_len"][i])
+        aoff = int(cols["args_off"][i])
+        out.append({
+            "seq": int(cols["seq"][i]),
+            "resource": names.get(int(cols["resource_id"][i])),
+            "context": names.get(int(cols["context_id"][i])) or "",
+            "origin": names.get(int(cols["origin_id"][i])) or "",
+            "in": bool(et & _ET_IN),
+            "prio": bool(et & _ET_PRIO),
+            "acquire": int(cols["acquire"][i]),
+            "ts": int(cols["ts"][i]),
+            "args": frames.decode_args(var[aoff : aoff + alen]) if alen else (),
+        })
+    return out
+
+
+def _decode_exit_frame(payload: bytes, names: Dict[int, Optional[str]]) -> List[Dict[str, Any]]:
+    df = frames.decode_frame(payload)
+    for iid, raw in df.interns:
+        names[iid] = raw.decode("utf-8", "surrogatepass")
+    cols = df.columns
+    p_rows: Sequence[Tuple[int, ...]] = ()
+    if df.varbytes:
+        p_rows = frames.decode_args(df.varbytes)
+    out = []
+    for i in range(df.n):
+        trow, riid = _unpack_exit_seq(int(cols["seq"][i]))
+        out.append({
+            "rows": (
+                int(cols["resource_id"][i]), int(cols["context_id"][i]),
+                int(cols["origin_id"][i]), trow,
+            ),
+            "thr": int(cols["entry_type"][i]),
+            "ts": int(cols["ts"][i]),
+            "rt": int(cols["rt"][i]),
+            "count": int(cols["count"][i]),
+            "err": int(cols["err"][i]),
+            "resource": names.get(riid) if riid else None,
+            "p_rows": tuple(p_rows[i]) if i < len(p_rows) else (),
+        })
+    return out
+
+
+def decode_capture(paths: Sequence[str]) -> Dict[str, Any]:
+    """Decode segments into the replay stream: ``header`` (first
+    segment's), ``stream`` — an ordered list of ("chunk", CapturedChunk)
+    / ("rules"|"health"|"sketch"|"shard"|"freeze", dict) items — and
+    ``chunks`` indexed by cap_seq (verdict frames attach out-of-band:
+    at pipeline depth K a chunk's RK_VERDICT lands up to K chunks
+    later in the file)."""
+    stream: List[Tuple[str, Any]] = []
+    chunks: Dict[int, CapturedChunk] = {}
+    first_header: Optional[Dict[str, Any]] = None
+    open_chunk: Optional[CapturedChunk] = None
+    for path in paths:
+        header, records = read_segment(path)
+        if first_header is None:
+            first_header = header
+        names: Dict[int, Optional[str]] = {0: None}
+        for rec in records:
+            if rec.rkind == RK_FLUSH:
+                meta = rec.json()
+                open_chunk = CapturedChunk(
+                    rec.flush_seq, meta["now_ms"], meta["cap_seq"],
+                    meta["rows"],
+                )
+                chunks[open_chunk.cap_seq] = open_chunk
+                stream.append(("chunk", open_chunk))
+            elif rec.rkind == RK_ENTRIES and open_chunk is not None:
+                open_chunk.entries.extend(_decode_entry_frame(rec.payload, names))
+            elif rec.rkind == RK_BULK and open_chunk is not None:
+                open_chunk.bulk.append(_decode_entry_frame(rec.payload, names))
+            elif rec.rkind == RK_EXITS and open_chunk is not None:
+                open_chunk.exits.extend(_decode_exit_frame(rec.payload, names))
+            elif rec.rkind == RK_BULK_EXITS and open_chunk is not None:
+                open_chunk.bulk_exits.append(_decode_exit_frame(rec.payload, names))
+            elif rec.rkind == RK_VERDICT:
+                df = frames.decode_frame(rec.payload)
+                if df.n == 0:
+                    continue
+                vbase = int(df.columns["seq"][0])
+                ck = chunks.get(vbase)
+                if ck is not None:
+                    ck.verdicts = (
+                        np.array(df.columns["admitted"]),
+                        np.array(df.columns["reason"]),
+                        np.array(df.columns["wait_ms"]),
+                        np.array(df.columns["flags"]),
+                    )
+            elif rec.rkind in (RK_RULES, RK_HEALTH, RK_SKETCH, RK_SHARD, RK_FREEZE):
+                stream.append((rec.name, rec.json()))
+    return {
+        "header": first_header or {},
+        "stream": stream,
+        "chunks": chunks,
+    }

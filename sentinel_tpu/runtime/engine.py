@@ -126,6 +126,7 @@ class _PendingFetch:
     __slots__ = (
         "_engine", "_entries", "_bulk", "_exits", "_bulk_exits", "_refs",
         "_fill", "_done", "_error", "_lock", "_staging", "_span", "_seq",
+        "_cap_tok",
     )
 
     def __init__(
@@ -133,6 +134,7 @@ class _PendingFetch:
         fill, staging: Optional[List[tuple]] = None, span=None,
         bulk: Optional[List["BulkOp"]] = None, seq: int = -1,
         exits: Optional[list] = None, bulk_exits: Optional[list] = None,
+        cap_tok=None,
     ) -> None:
         self._engine = engine
         self._entries = entries
@@ -158,6 +160,10 @@ class _PendingFetch:
         # Engine flush sequence number of the dispatched chunk — the
         # fault injector's key and the watchdog's attribution.
         self._seq = seq
+        # Capture-journal verdict token (one-shot): the fill closure
+        # spills verdicts on success; a quarantine spills the degraded
+        # policy verdicts instead.
+        self._cap_tok = cap_tok
 
     def materialize(self, got: Optional[tuple] = None) -> None:
         """Fetch + verdict fill + post work, exactly once. ``got`` is
@@ -260,6 +266,11 @@ class _PendingFetch:
         # replay (the chunk postdates any stored checkpoint).
         items = fo.fill_degraded(entries, exits, bulk, bulk_exits,
                                  run_custom_slots=False)
+        cap_tok, self._cap_tok = self._cap_tok, None
+        if cap_tok is not None and self._engine.capture is not None:
+            self._engine.capture.note_verdicts(
+                cap_tok, entries, bulk, degraded=True
+            )
         self._engine._post_flush((entries, items))
 
     def quarantine(self) -> None:
@@ -847,6 +858,12 @@ class Engine:
             from sentinel_tpu.ipc.plane import IngestPlane
 
             IngestPlane(self)  # registers itself as self.ipc_plane
+        # Black-box flight recorder (runtime/capture.py). Disarmed (the
+        # default) this attribute is the entire footprint: every hot
+        # path pays exactly one `is None` read.
+        from sentinel_tpu.runtime.capture import maybe_build_capture
+
+        self.capture = maybe_build_capture(self)
 
     # ------------------------------------------------------------------
     # multi-chip mode
@@ -965,6 +982,14 @@ class Engine:
                     self.flow_index = findex
                     self.flow_dyn = findex.make_dyn_state()
                 self.speculative.on_rules_reloaded()
+                if self.capture is not None:
+                    self.capture.note_rules(
+                        "flow",
+                        [r.to_dict() for r in rules],
+                        from_sketch=any(
+                            getattr(r, "from_sketch", False) for r in rules
+                        ),
+                    )
         finally:
             self._post_flush(drained)
     def set_degrade_rules(self, rules: Sequence[DegradeRule]) -> None:
@@ -979,6 +1004,10 @@ class Engine:
                     self.degrade_dyn = self.degrade_index.make_dyn_state()
                     self._reset_breaker_mirror()
                 self.speculative.on_rules_reloaded()
+                if self.capture is not None:
+                    self.capture.note_rules(
+                        "degrade", [r.to_dict() for r in rules]
+                    )
         finally:
             self._post_flush(drained)
     def set_param_rules(self, by_resource: Dict[str, List[ParamFlowRule]]) -> None:
@@ -993,6 +1022,19 @@ class Engine:
                     self.param_index = pindex
                     self.param_dyn = make_param_state(8)
                 self.speculative.on_rules_reloaded()
+                if self.capture is not None:
+                    rows = [
+                        r.to_dict() for rs in by_resource.values() for r in rs
+                    ]
+                    self.capture.note_rules(
+                        "param",
+                        rows,
+                        from_sketch=any(
+                            getattr(r, "from_sketch", False)
+                            for rs in by_resource.values()
+                            for r in rs
+                        ),
+                    )
         finally:
             self._post_flush(drained)
     def set_system_config(self, cfg) -> None:
@@ -1009,6 +1051,12 @@ class Engine:
                         or self.system_config.highest_cpu_usage >= 0
                     ):
                         system_sampler.start()
+                if self.capture is not None:
+                    from sentinel_tpu.runtime.capture import _system_to_dict
+
+                    self.capture.note_rules(
+                        "system", _system_to_dict(self.system_config)
+                    )
         finally:
             self._post_flush(drained)
     def set_authority_rules(self, by_resource: Dict[str, AuthorityRule]) -> None:
@@ -1018,6 +1066,11 @@ class Engine:
                 self._flush_locked(drained)
                 with self._lock:
                     self.authority_rules = dict(by_resource)
+                if self.capture is not None:
+                    self.capture.note_rules(
+                        "authority",
+                        {res: r.to_dict() for res, r in by_resource.items()},
+                    )
         finally:
             self._post_flush(drained)
     def _system_device(self) -> SystemDevice:
@@ -2570,6 +2623,8 @@ class Engine:
         if self.gossip is not None:
             self.gossip.stop()
         self.failover.close()
+        if self.capture is not None:
+            self.capture.close()
 
     @property
     def last_flush_host_ms(self) -> Dict[str, float]:
@@ -3543,6 +3598,15 @@ class Engine:
         # One flush sequence number per dispatched chunk — the fault
         # injector's key and the checkpoint cadence counter.
         seq = self._next_flush_seq()
+        # Flight recorder: spill the chunk's inputs BEFORE dispatch (a
+        # dispatch fault must not lose the traffic that caused it); the
+        # verdicts follow from the fill path via the one-shot token.
+        cap = self.capture
+        cap_tok = (
+            cap.note_chunk(entries, exits, bulk, bulk_exits, now_host, seq)
+            if cap is not None
+            else None
+        )
 
         def _dispatch():
             if self.faults is not None:
@@ -3588,7 +3652,8 @@ class Engine:
             return self._degraded_chunk(fo, entries, exits, bulk,
                                         bulk_exits, defer,
                                         run_custom_slots=False,
-                                        quarantined=True)
+                                        quarantined=True,
+                                        cap_tok=cap_tok)
         (
             self.stats, self.flow_dyn, self.degrade_dyn, self.param_dyn,
             new_skstate, result,
@@ -3650,7 +3715,14 @@ class Engine:
         # every flip, so the post-flush breaker state rides EVERY
         # flush's coalesced fetch while the tier is on (fire_transitions
         # is a no-op walk when no user observers are registered).
-        if breaker_events.has_observers() or self.speculative.enabled:
+        # The capture journal also rides as a standing observer: its
+        # postmortem freeze fires off breaker openings, so the
+        # post-flush state must travel with every captured flush.
+        if (
+            breaker_events.has_observers()
+            or self.speculative.enabled
+            or cap is not None
+        ):
             self._breaker_seq += 1
             # Deferred fetches must NOT hold the live dyn-state buffer:
             # the next flush donates degrade_dyn into its kernel, which
@@ -3753,13 +3825,16 @@ class Engine:
             if ckpt_meta is not None:
                 fo.store_checkpoint(ckpt_meta, got[-1])
                 got = got[:-1]
-            return self._fill_results(
+            res = self._fill_results(
                 got, entries, exits, bulk, bulk_exits, findex, dindex,
                 auth_rules, k, kd, breaker_snap=breaker_snap,
                 blk_topk=has_blk, flush_seq=flush_seq,
                 shaping_snap=shaping_snap is not None,
                 sketch_snap=sk_snap is not None,
             )
+            if cap_tok is not None:
+                cap.note_verdicts(cap_tok, entries, bulk)
+            return res
 
         refs = self._result_refs(result, breaker_snap, shaping_snap, sk_snap)
         if ckpt_meta is not None:
@@ -3770,6 +3845,7 @@ class Engine:
             rec = _PendingFetch(
                 self, entries, refs, _fill, staging=staging, span=span,
                 bulk=bulk, seq=seq, exits=exits, bulk_exits=bulk_exits,
+                cap_tok=cap_tok,
             )
             for op in entries:
                 op._pending = rec
@@ -3793,6 +3869,7 @@ class Engine:
                 res = self._degraded_chunk(
                     fo, entries, exits, bulk, bulk_exits, defer,
                     span=span, run_custom_slots=False, quarantined=True,
+                    cap_tok=cap_tok,
                 )
         finally:
             with self._timing_lock:
@@ -3817,7 +3894,7 @@ class Engine:
 
     def _degraded_chunk(
         self, fo, entries, exits, bulk, bulk_exits, defer, span=None,
-        run_custom_slots=True, quarantined=False,
+        run_custom_slots=True, quarantined=False, cap_tok=None,
     ) -> Optional[List[tuple]]:
         """Fill one chunk's verdicts from the host fallback (device
         fault mid-flush, or the engine degraded before this chunk
@@ -3836,8 +3913,18 @@ class Engine:
             self.telemetry.settle(
                 span, time.perf_counter(), time.perf_counter()
             )
+        cap = self.capture
+        if cap is not None and cap_tok is None:
+            # Degraded before dispatch: the chunk never passed the
+            # note_chunk hook in _run_chunk — capture it here (seq -1:
+            # no flush sequence number was ever assigned).
+            cap_tok = cap.note_chunk(
+                entries, exits, bulk, bulk_exits, self.clock.now_ms(), -1
+            )
         items = fo.fill_degraded(entries, exits, bulk, bulk_exits,
                                  run_custom_slots=run_custom_slots)
+        if cap is not None:
+            cap.note_verdicts(cap_tok, entries, bulk, degraded=True)
         if defer:
             self._post_flush((entries, items))
             return None
@@ -3878,6 +3965,15 @@ class Engine:
             self._breaker_mirror_valid = True
         if fire:
             breaker_events.fire_transitions(prev, new_state, dindex)
+            cap = self.capture
+            if cap is not None and np.any((new_state == 1) & (prev != 1)):
+                # A breaker OPENED: pin the traffic that tripped it.
+                opened = [
+                    r.resource
+                    for gid in np.nonzero((new_state == 1) & (prev != 1))[0]
+                    if (r := dindex.rule_of_gid(int(gid))) is not None
+                ]
+                cap.note_breaker_open(opened)
 
     @staticmethod
     def _result_refs(result, breaker_snap, shaping_snap=None, sk_snap=None) -> tuple:

@@ -1325,6 +1325,15 @@ class FailoverManager:
         tele = self._engine.telemetry
         if tele.enabled:
             tele.note_health(frm, to, reason, now_ms=now)
+        cap = getattr(self._engine, "capture", None)
+        if cap is not None:
+            # Every transition rides the capture's rule-timeline; a
+            # transition INTO DEGRADED additionally freezes the recent
+            # segments (the traffic that rode the fault).
+            cap.note_health({
+                "event": "failover", "from": frm, "to": to,
+                "reason": reason, "now_ms": now,
+            })
 
     def trip(self, where: str, exc: BaseException, seq: object = -1) -> None:
         """A device fault (dispatch/fetch failure or watchdog timeout):

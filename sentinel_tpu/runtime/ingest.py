@@ -169,6 +169,11 @@ class IngestValve:
         tele = self._engine.telemetry
         if tele.enabled:
             tele.note_ingest_shed(entries + rows)
+        cap = getattr(self._engine, "capture", None)
+        if cap is not None:
+            # Shed-streak postmortem trigger: a saturated engine is
+            # exactly when the black box matters most.
+            cap.note_shed(entries + rows)
 
     # ------------------------------------------------------------------
     # lifecycle / readers

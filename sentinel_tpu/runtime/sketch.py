@@ -1154,6 +1154,21 @@ class SketchTier:
                 tele.note_sketch_promotion(promos)
             if demos:
                 tele.note_sketch_demotion(demos)
+        cap = getattr(self._engine, "capture", None)
+        if cap is not None and (promos or demos):
+            # Rule-timeline stream: informational only — replay arms
+            # its own sketch tier and re-derives the same promotions
+            # from the captured traffic; the record lets the explainer
+            # date a promotion without re-running the controller.
+            with self._lock:
+                cap.note_sketch({
+                    "promotions": promos,
+                    "demotions": demos,
+                    "promoted_resources": sorted(self._promoted_res),
+                    "promoted_values": {
+                        r: sorted(v) for r, v in self._promoted_vals.items()
+                    },
+                })
 
     def _cold_locked(
         self, key: str, cnt: int, floor: float, wid: int

@@ -515,6 +515,36 @@ def sketch_handler(req: CommandRequest) -> CommandResponse:
 
 
 @command_mapping(
+    "capture",
+    "black-box flight recorder: segment/counter snapshot;"
+    " freeze=<reason> pins the recent segments on demand",
+)
+def capture_handler(req: CommandRequest) -> CommandResponse:
+    """The admission black box (runtime/capture.py): live/frozen
+    segment inventory, spill counters and the capture row cursor. With
+    ``?freeze=<reason>`` the recent segments are pinned against
+    rollover first (an on-demand postmortem — same mechanics as the
+    breaker/shed/DEGRADED triggers) and the frozen paths are
+    returned."""
+    engine = _engine()
+    cap = getattr(engine, "capture", None)
+    if cap is None:
+        return CommandResponse.of_json(
+            {"enabled": False, "flush_seq": engine.flush_seq}
+        )
+    reason = req.params.get("freeze")
+    out = {"enabled": True}
+    if reason:
+        safe = "".join(
+            ch for ch in reason[:32] if ch.isalnum() or ch in "-_"
+        ) or "manual"
+        out["frozen_now"] = [os.path.basename(p) for p in cap.freeze(safe)]
+    out.update(cap.snapshot())
+    out["flush_seq"] = engine.flush_seq
+    return CommandResponse.of_json(out)
+
+
+@command_mapping(
     "autotune",
     "self-tuning control plane: chosen depth/window, decision log,"
     " param-path cost memo",

@@ -434,6 +434,45 @@ def engine_telemetry_lines(engine, openmetrics: bool = False) -> List[str]:
             c.get("sketch_cold_blocks", 0),
         )
 
+    # Black-box flight recorder (runtime/capture.py). Rendered even
+    # when capture is off (zeros) so dashboards keep their series.
+    cap = getattr(engine, "capture", None)
+    out += _gauge(
+        f"{p}_capture_enabled",
+        "Admission capture journal armed (sentinel.tpu.capture.enabled)",
+        1 if cap is not None else 0,
+    )
+    out += ctr(
+        f"{p}_capture_chunks_total",
+        "Dispatched chunks spilled to the capture journal",
+        c.get("capture_chunks", 0),
+    )
+    out += ctr(
+        f"{p}_capture_records_total",
+        "Frame/timeline records written to capture segments",
+        c.get("capture_records", 0),
+    )
+    out += ctr(
+        f"{p}_capture_bytes_total",
+        "Bytes written to capture segments (headers + payloads)",
+        c.get("capture_bytes", 0),
+    )
+    out += ctr(
+        f"{p}_capture_rollovers_total",
+        "Capture segment rollovers (oldest live segment deleted past the bound)",
+        c.get("capture_rollovers", 0),
+    )
+    out += ctr(
+        f"{p}_capture_freezes_total",
+        "Postmortem freezes (breaker trip / shed streak / DEGRADED / on-demand)",
+        c.get("capture_freezes", 0),
+    )
+    out += ctr(
+        f"{p}_capture_args_dropped_total",
+        "Bulk rows captured without their args column (non-serializable column)",
+        c.get("capture_args_dropped", 0),
+    )
+
     # Multi-process ingest plane (sentinel_tpu/ipc): ring/worker/frame
     # counters plus the live ring-occupancy and worker gauges. Rendered
     # even when the plane is down (zeros) so dashboards keep their

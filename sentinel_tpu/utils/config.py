@@ -452,6 +452,27 @@ class SentinelConfig:
     # Spill to the journal file automatically once this many spans
     # accumulate since the last spill (0 = only explicit/close spills).
     SPANS_SPILL_EVERY = "sentinel.tpu.spans.spill.every"
+    # Black-box flight recorder (runtime/capture.py): bounded rolling
+    # on-disk capture of the columnar admission stream in the
+    # ipc/frames.py codec, replayable bit-exactly by tools/replay.py.
+    # Off by default — the disabled footprint is one attribute read per
+    # flush and verdicts are bit-identical either way.
+    CAPTURE_ENABLED = "sentinel.tpu.capture.enabled"
+    # Segment directory ("" = ./sentinel-capture).
+    CAPTURE_DIR = "sentinel.tpu.capture.dir"
+    # Rollover size per segment file and the live (rollover-eligible)
+    # segment count bound; oldest live segments are deleted past it.
+    CAPTURE_SEGMENT_BYTES = "sentinel.tpu.capture.segment.bytes"
+    CAPTURE_SEGMENTS_MAX = "sentinel.tpu.capture.segments.max"
+    # Postmortem freeze: segments whose last record is younger than
+    # freeze.seconds are renamed frozen-* (pinned against rollover) on
+    # a breaker opening, a DEGRADED transition, a shed streak of
+    # freeze.shed.streak consecutive valve sheds, the `capture`
+    # transport command, or (next boot) engine death. frozen.max bounds
+    # the pinned set, oldest deleted first.
+    CAPTURE_FREEZE_SECONDS = "sentinel.tpu.capture.freeze.seconds"
+    CAPTURE_FROZEN_MAX = "sentinel.tpu.capture.frozen.max"
+    CAPTURE_SHED_STREAK = "sentinel.tpu.capture.freeze.shed.streak"
     LOG_DIR = "csp.sentinel.log.dir"
 
     DEFAULTS: Dict[str, str] = {
@@ -576,6 +597,13 @@ class SentinelConfig:
         SPANS_RING: "8192",
         SPANS_DIR: "",
         SPANS_SPILL_EVERY: "0",
+        CAPTURE_ENABLED: "false",
+        CAPTURE_DIR: "",
+        CAPTURE_SEGMENT_BYTES: "4194304",
+        CAPTURE_SEGMENTS_MAX: "8",
+        CAPTURE_FREEZE_SECONDS: "30",
+        CAPTURE_FROZEN_MAX: "16",
+        CAPTURE_SHED_STREAK: "64",
     }
 
     def __init__(self, load_env: bool = True, config_file: Optional[str] = None) -> None:

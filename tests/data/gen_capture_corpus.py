@@ -1,0 +1,117 @@
+"""Regenerate the golden capture corpus (tests/data/capture_corpus/).
+
+Run from the repo root::
+
+    JAX_PLATFORMS=cpu python tests/data/gen_capture_corpus.py
+
+The corpus is a small but adversarial capture: mixed single/bulk
+traffic with args, admitted and blocked rows across four rule kinds
+(flow QPS, flow THREAD, degrade, param), exits releasing gauges, a
+mid-stream rule reload, a segment rollover and a manual freeze — all
+on a ManualClock so the bytes are deterministic up to the boot id and
+wall-ms stamps (which replay never diffs on). The tier-1 pin
+(tests/test_replay_corpus.py) replays the COMMITTED files at pipeline
+depths {0, 2} and requires zero verdict diffs; regenerate only when
+the capture format itself changes, and re-run that test after.
+"""
+
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "capture_corpus")
+
+
+def main() -> None:
+    from sentinel_tpu.models import constants as C
+    from sentinel_tpu.models.rules import DegradeRule, FlowRule, ParamFlowRule
+    from sentinel_tpu.runtime.engine import Engine
+    from sentinel_tpu.utils.clock import ManualClock, set_default_clock
+    from sentinel_tpu.utils.config import config
+
+    shutil.rmtree(CORPUS_DIR, ignore_errors=True)
+    config.set(config.CAPTURE_ENABLED, "true")
+    config.set(config.CAPTURE_DIR, CORPUS_DIR)
+    clk = ManualClock(start_ms=0)
+    set_default_clock(clk)
+    eng = Engine(clock=clk)
+    eng.capture.segment_bytes = 64 * 1024  # force a mid-corpus rollover
+
+    eng.set_flow_rules([
+        FlowRule("api/pay", count=3),
+        FlowRule("api/search", count=2, grade=C.FLOW_GRADE_THREAD),
+        FlowRule("api/open", count=1e9),
+    ])
+    eng.set_degrade_rules([
+        DegradeRule("api/slow", grade=C.DEGRADE_GRADE_RT, count=5,
+                    time_window=2, min_request_amount=3,
+                    slow_ratio_threshold=0.5),
+    ])
+    eng.set_param_rules({
+        "api/param": [ParamFlowRule(resource="api/param", param_idx=0,
+                                    count=2.0)],
+    })
+
+    held = []
+    for w in range(14):
+        if w == 7:
+            # Mid-stream reload: the QPS budget tightens — replay must
+            # apply this from the timeline, not the segment header.
+            eng.set_flow_rules([
+                FlowRule("api/pay", count=1),
+                FlowRule("api/search", count=2, grade=C.FLOW_GRADE_THREAD),
+                FlowRule("api/open", count=1e9),
+            ])
+        ops = []
+        for i in range(5):
+            ops.append(eng.submit_entry(
+                "api/pay", origin=f"caller-{i % 2}", args=("pay", i),
+            ))
+        for i in range(4):
+            ops.append(eng.submit_entry("api/search", acquire=1))
+        for i in range(6):
+            ops.append(eng.submit_entry(
+                "api/param", args=(f"user-{i % 3}",),
+            ))
+        # Slow calls feed the degrade (RT breaker) window.
+        slow = [eng.submit_entry("api/slow") for _ in range(4)]
+        g = eng.submit_bulk("api/open", 8, context_name="batch",
+                            origin="bulk-src")
+        eng.flush()
+        eng.drain()
+        for op in slow:
+            if op.verdict.admitted:
+                eng.submit_exit(op.rows, rt=40 if w % 2 else 1,
+                                resource="api/slow")
+        for op in ops:
+            v = op.verdict
+            if v.admitted and op.resource == "api/search":
+                held.append(op)
+        # Release half the held THREAD admissions (the other half keeps
+        # the gauge charged so later windows block on THREAD).
+        while len(held) > 2:
+            op = held.pop(0)
+            eng.submit_exit(op.rows, rt=3, resource="api/search")
+        clk.advance(300)
+    eng.capture.freeze("corpus")
+    # A couple of post-freeze windows so live segments exist too.
+    for w in range(2):
+        for i in range(3):
+            eng.submit_entry("api/pay", args=("tail", i))
+        eng.flush()
+        eng.drain()
+        clk.advance(300)
+    eng.close()
+    set_default_clock(None)
+    config.set(config.CAPTURE_ENABLED, "false")
+    config.set(config.CAPTURE_DIR, "")
+    names = sorted(os.listdir(CORPUS_DIR))
+    print(f"wrote {len(names)} segments to {CORPUS_DIR}:")
+    for fn in names:
+        print(" ", fn, os.path.getsize(os.path.join(CORPUS_DIR, fn)), "bytes")
+
+
+if __name__ == "__main__":
+    main()

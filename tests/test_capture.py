@@ -1,0 +1,326 @@
+"""Black-box flight recorder tests (runtime/capture.py).
+
+Covers the write side of the capture journal: the capture-off
+differential (arming capture must not change a single verdict bit),
+segment roundtrip through the reader, bounded rollover, every freeze
+trigger (manual, breaker, shed streak, DEGRADED, engine death), and
+the ``capture`` transport command. The replay side (tools/replay.py)
+is pinned separately by the golden-corpus differential.
+"""
+
+import json
+import os
+
+import pytest
+
+from sentinel_tpu.models.rules import DegradeRule, FlowRule
+from sentinel_tpu.runtime import capture as cap_mod
+from sentinel_tpu.runtime.engine import Engine
+from sentinel_tpu.utils.clock import ManualClock
+from sentinel_tpu.utils.config import config
+
+
+RULES = [
+    FlowRule("cap-qps", count=3),
+    FlowRule("cap-open", count=1e9),
+]
+
+
+def _drive(eng, clk, windows=6):
+    """Deterministic mixed traffic; returns the flat verdict tuple list
+    in submission order."""
+    out = []
+    for w in range(windows):
+        ops = [
+            eng.submit_entry("cap-qps", origin=f"svc-{i % 2}", args=("k", i))
+            for i in range(5)
+        ]
+        ops.append(eng.submit_entry("cap-open", acquire=2))
+        g = eng.submit_bulk("cap-open", 4, context_name="bulk-ctx")
+        eng.flush()
+        for op in ops:
+            v = op.verdict
+            out.append((v.admitted, v.reason, v.wait_ms))
+            if v.admitted:
+                eng.submit_exit(op.rows, rt=5)
+        if g is not None:
+            for j in range(4):
+                out.append((
+                    bool(g.admitted[j]), int(g.reason[j]), int(g.wait_ms[j]),
+                ))
+        clk.advance(250)
+    eng.drain()
+    return out
+
+
+@pytest.fixture()
+def cap_dir(tmp_path, manual_clock):
+    """Capture armed into a per-test directory; restores config."""
+    d = str(tmp_path / "cap")
+    config.set(config.CAPTURE_ENABLED, "true")
+    config.set(config.CAPTURE_DIR, d)
+    try:
+        yield d
+    finally:
+        config.set(config.CAPTURE_ENABLED, "false")
+        config.set(config.CAPTURE_DIR, "")
+
+
+class TestCaptureDifferential:
+    def test_disabled_is_one_attribute(self, manual_clock, engine):
+        # Default-off footprint: the hot path reads .capture once.
+        assert engine.capture is None
+
+    def test_capture_on_is_bit_identical(self, tmp_path, manual_clock):
+        """The tentpole acceptance bit: arming capture must not perturb
+        admission — same traffic, same clock, identical verdicts."""
+        clk_off = ManualClock(start_ms=0)
+        eng_off = Engine(clock=clk_off)
+        eng_off.set_flow_rules(RULES)
+        baseline = _drive(eng_off, clk_off)
+        eng_off.close()
+        assert any(not adm for adm, _r, _w in baseline)  # some blocked
+        assert any(adm for adm, _r, _w in baseline)
+
+        config.set(config.CAPTURE_ENABLED, "true")
+        config.set(config.CAPTURE_DIR, str(tmp_path / "cap"))
+        try:
+            clk_on = ManualClock(start_ms=0)
+            eng_on = Engine(clock=clk_on)
+            assert eng_on.capture is not None
+            eng_on.set_flow_rules(RULES)
+            captured = _drive(eng_on, clk_on)
+            eng_on.close()
+        finally:
+            config.set(config.CAPTURE_ENABLED, "false")
+            config.set(config.CAPTURE_DIR, "")
+        assert captured == baseline
+
+
+class TestCaptureRoundtrip:
+    def test_segments_decode_back_to_the_traffic(self, cap_dir, manual_clock):
+        clk = ManualClock(start_ms=0)
+        eng = Engine(clock=clk)
+        eng.set_flow_rules(RULES)
+        live = _drive(eng, clk)
+        snap = eng.capture.snapshot()
+        eng.close()
+
+        paths = cap_mod.capture_paths(cap_dir)
+        assert paths
+        decoded = cap_mod.decode_capture(paths)
+        hdr = decoded["header"]
+        assert hdr["boot_id"] == snap["boot_id"]
+        assert hdr["config"][config.CAPTURE_ENABLED] == "true"
+        # Segment 0 opened before set_flow_rules, so its header rule
+        # snapshot is empty and the reload rides the timeline stream —
+        # the record replay applies before the first chunk.
+        assert hdr["rules"]["flow"] == []
+        reloads = [
+            d for k, d in decoded["stream"]
+            if k == "rules" and d["kind"] == "flow"
+        ]
+        assert {r["resource"] for r in reloads[0]["rules"]} == {
+            "cap-qps", "cap-open",
+        }
+
+        all_chunks = [ck for kind, ck in decoded["stream"] if kind == "chunk"]
+        # 6 traffic windows + the close-time exits-only flush.
+        chunks = [ck for ck in all_chunks if ck.rows]
+        assert len(chunks) == 6
+        replayed = []
+        for ck in chunks:
+            assert ck.verdicts is not None
+            adm, rea, wait, flags = ck.verdicts
+            assert not any(int(f) & cap_mod.F_VERDICT_MISSING for f in flags)
+            # Entry rows decode back to submission shape.
+            assert [e["resource"] for e in ck.entries] == \
+                ["cap-qps"] * 5 + ["cap-open"]
+            assert ck.entries[0]["args"] == ("k", 0)
+            assert ck.entries[5]["acquire"] == 2
+            assert len(ck.bulk) == 1 and len(ck.bulk[0]) == 4
+            assert ck.bulk[0][0]["context"] == "bulk-ctx"
+            for i in range(ck.rows):
+                replayed.append((bool(adm[i]), int(rea[i]), int(wait[i])))
+        assert replayed == live
+        # Admitted ops' exits were captured too (windows 1.. see the
+        # previous window's releases).
+        assert any(ck.exits for ck in all_chunks)
+        counters = snap["counters"]
+        assert counters["chunks"] == 6
+        assert counters["frames"] > 6 and counters["bytes"] > 0
+
+    def test_telemetry_counters_flow(self, cap_dir, manual_clock):
+        clk = ManualClock(start_ms=0)
+        eng = Engine(clock=clk)
+        eng.set_flow_rules(RULES)
+        _drive(eng, clk, windows=2)
+        tele = eng.telemetry.counters_snapshot()
+        eng.close()
+        assert tele["capture_chunks"] == 2
+        assert tele["capture_records"] > 0
+        assert tele["capture_bytes"] > 0
+
+
+class TestRolloverAndFreeze:
+    def test_rollover_is_bounded(self, cap_dir, manual_clock):
+        clk = ManualClock(start_ms=0)
+        eng = Engine(clock=clk)
+        eng.set_flow_rules(RULES)
+        cap = eng.capture
+        cap.segment_bytes = 2048  # force a roll every couple of chunks
+        cap.segments_max = 3
+        _drive(eng, clk, windows=30)
+        snap = cap.snapshot()
+        eng.close()
+        assert snap["counters"]["rollovers"] > 3
+        assert len(snap["live"]) <= 3
+        on_disk = [f for f in os.listdir(cap_dir) if f.startswith("seg-")]
+        assert len(on_disk) <= 3
+        # The bounded survivors still decode and carry verdicts.
+        decoded = cap_mod.decode_capture(cap_mod.capture_paths(cap_dir))
+        chunks = [ck for k, ck in decoded["stream"] if k == "chunk"]
+        assert chunks and any(ck.verdicts is not None for ck in chunks)
+
+    def test_manual_freeze_pins_segments(self, cap_dir, manual_clock):
+        clk = ManualClock(start_ms=0)
+        eng = Engine(clock=clk)
+        eng.set_flow_rules(RULES)
+        _drive(eng, clk, windows=2)
+        frozen = eng.capture.freeze("manual")
+        assert frozen and all("frozen-manual-" in p for p in frozen)
+        # Recording continues into a fresh segment after the freeze.
+        _drive(eng, clk, windows=1)
+        snap = eng.capture.snapshot()
+        eng.close()
+        assert snap["counters"]["freezes"] == 1
+        assert snap["frozen"] and snap["live"]
+        # Frozen segments decode standalone, with the freeze marker.
+        hdr, recs = cap_mod.read_segment(frozen[0])
+        assert recs[-1].rkind == cap_mod.RK_FREEZE
+        assert recs[-1].json()["reason"] == "manual"
+
+    def test_breaker_shed_and_degraded_triggers(self, cap_dir, manual_clock):
+        clk = ManualClock(start_ms=0)
+        eng = Engine(clock=clk)
+        eng.set_flow_rules(RULES)
+        cap = eng.capture
+        _drive(eng, clk, windows=1)
+        cap.note_breaker_open(["cap-qps"])
+        _drive(eng, clk, windows=1)
+        cap.note_health({"event": "transition", "to": "DEGRADED"})
+        _drive(eng, clk, windows=1)
+        cap.shed_streak = 4
+        cap.note_shed(3)   # below streak: no freeze
+        assert cap.counters["freezes"] == 2
+        cap.note_shed(1)   # crosses: freeze fires
+        snap = cap.snapshot()
+        eng.close()
+        assert snap["counters"]["freezes"] == 3
+        reasons = {f.split("-")[1] for f in snap["frozen"]}
+        assert reasons == {"breaker", "degraded", "shed"}
+        # The health events rode the rule-timeline stream.
+        decoded = cap_mod.decode_capture(
+            cap_mod.capture_paths(cap_dir, frozen=True)
+        )
+        health = [d for k, d in decoded["stream"] if k == "health"]
+        assert {"breaker_open"} <= {h.get("event") for h in health}
+        assert any(h.get("to") == "DEGRADED" for h in health)
+
+    def test_frozen_set_is_trimmed(self, cap_dir, manual_clock):
+        clk = ManualClock(start_ms=0)
+        eng = Engine(clock=clk)
+        eng.set_flow_rules(RULES)
+        cap = eng.capture
+        cap.frozen_max = 2
+        for i in range(4):
+            _drive(eng, clk, windows=1)
+            cap.freeze(f"f{i}")
+        frozen = [f for f in os.listdir(cap_dir) if f.startswith("frozen-")]
+        eng.close()
+        assert len(frozen) <= 2
+
+
+class TestDeathPreservation:
+    def test_next_boot_preserves_dead_segments(self, cap_dir, manual_clock):
+        """kill -9 leaves live seg-*.cap files behind; the next boot
+        must rename them frozen-death-* BEFORE writing a byte, and a
+        torn tail (death mid-record) must decode cleanly."""
+        clk = ManualClock(start_ms=0)
+        eng = Engine(clock=clk)
+        eng.set_flow_rules(RULES)
+        _drive(eng, clk, windows=3)
+        dead_seg = eng.capture._live[-1][1]
+        eng.capture.close()   # simulate death: no freeze, files left
+        eng.close()
+        # Tear the tail mid-record, as a dying write would.
+        with open(dead_seg, "ab") as f:
+            f.write(cap_mod._REC.pack(cap_mod.RK_FLUSH, 0, 0, 999, -1, 0, 0))
+            f.write(b"{tr")  # payload cut short
+        hdr, recs = cap_mod.read_segment(dead_seg)
+        assert recs and recs[-1].rkind != 999
+
+        eng2 = Engine(clock=ManualClock(start_ms=0))
+        boot2 = eng2.capture._boot_id
+        assert not [
+            f for f in os.listdir(cap_dir)
+            if f.startswith("seg-") and cap_mod.read_segment(
+                os.path.join(cap_dir, f)
+            )[0]["boot_id"] != boot2
+        ]
+        death = [
+            f for f in os.listdir(cap_dir) if f.startswith("frozen-death-")
+        ]
+        assert death
+        # The preserved postmortem still decodes to chunks + verdicts.
+        decoded = cap_mod.decode_capture(
+            [os.path.join(cap_dir, f) for f in sorted(death)]
+        )
+        chunks = [ck for k, ck in decoded["stream"] if k == "chunk"]
+        assert len(chunks) == 3
+        assert all(ck.verdicts is not None for ck in chunks)
+        eng2.close()
+
+
+class TestCaptureCommand:
+    def test_command_disabled_and_armed(self, tmp_path, manual_clock):
+        from sentinel_tpu.core import api
+        from sentinel_tpu.transport import handlers
+        from sentinel_tpu.transport.command_center import CommandRequest
+
+        resp = handlers.capture_handler(
+            CommandRequest(path="capture", params={}, body="")
+        )
+        assert json.loads(resp.result)["enabled"] is False
+
+        config.set(config.CAPTURE_ENABLED, "true")
+        config.set(config.CAPTURE_DIR, str(tmp_path / "cmdcap"))
+        try:
+            api.reset(clock=manual_clock)
+            eng = api.get_engine()
+            eng.set_flow_rules(RULES)
+            _drive(eng, manual_clock, windows=2)
+            resp = handlers.capture_handler(
+                CommandRequest(path="capture", params={}, body="")
+            )
+            d = json.loads(resp.result)
+            assert d["enabled"] is True
+            assert d["counters"]["chunks"] == 2 and d["live"]
+            # freeze=<reason> is the on-demand postmortem.
+            resp = handlers.capture_handler(
+                CommandRequest(
+                    path="capture", params={"freeze": "oncall page!"}, body=""
+                )
+            )
+            d = json.loads(resp.result)
+            assert d["frozen_now"]
+            assert all(f.startswith("frozen-oncallpage-") for f in d["frozen_now"])
+            from sentinel_tpu.transport.prometheus import render_metrics
+
+            text = render_metrics(eng)
+            assert "_capture_enabled 1" in text
+            assert "_capture_freezes_total" in text
+        finally:
+            config.set(config.CAPTURE_ENABLED, "false")
+            config.set(config.CAPTURE_DIR, "")
+            api.reset(clock=manual_clock)

@@ -1,0 +1,335 @@
+"""Fuzz-hardening for the ipc frame codec (sentinel_tpu/ipc/frames.py).
+
+The codec is no longer just ring-slot transport: capture segments
+(runtime/capture.py) persist these exact bytes as the DURABLE black-box
+format, so a torn tail or a corrupted byte must fail as one clean
+``ValueError`` — never a ``struct.error``, a silently short
+``np.frombuffer`` view that misaligns every later column, or a decode
+that fabricates rows. Seeded randomized roundtrips over every frame
+kind, plus adversarial truncation/garbage sweeps, pin that contract.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from sentinel_tpu.ipc import frames
+
+
+def _rand_value(rng: random.Random, depth: int = 0):
+    kinds = ["none", "bool", "int", "float", "str", "bytes"]
+    if depth < 2:
+        kinds.append("tuple")
+    k = rng.choice(kinds)
+    if k == "none":
+        return None
+    if k == "bool":
+        return rng.random() < 0.5
+    if k == "int":
+        return rng.randint(-(2**62), 2**62)
+    if k == "float":
+        return rng.uniform(-1e12, 1e12)
+    if k == "str":
+        n = rng.choice([0, 1, 7, 255, 4096])
+        return "".join(rng.choice("abcdefg é中") for _ in range(n))
+    if k == "bytes":
+        return bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 64)))
+    return tuple(
+        _rand_value(rng, depth + 1) for _ in range(rng.randint(0, 4))
+    )
+
+
+def _rand_args(rng: random.Random):
+    return tuple(_rand_value(rng) for _ in range(rng.randint(0, 6)))
+
+
+def _rand_interns(rng: random.Random, max_name: int = 8192):
+    """Intern table with ragged name sizes up to ``max_name`` (the
+    max-size-name class: one segment-scoped id can carry a huge
+    resource string)."""
+    out = []
+    for iid in range(1, rng.randint(1, 6)):
+        n = rng.choice([1, 8, 300, max_name])
+        out.append((iid, bytes(rng.getrandbits(7) | 1 for _ in range(n))))
+    return out
+
+
+def _rand_entry_rows(rng: random.Random, n: int):
+    rows = []
+    for i in range(n):
+        traced = rng.random() < 0.3
+        trace = (
+            frames.pack_trace("ab" * 16, "cd" * 8, True)
+            if traced else frames.EMPTY_TRACE
+        )
+        rows.append(frames.EntryRow(
+            seq=rng.randint(0, 2**63),
+            resource_id=rng.randint(0, 2**31 - 1),
+            context_id=rng.randint(0, 2**31 - 1),
+            origin_id=rng.randint(0, 2**31 - 1),
+            entry_type=rng.randint(-128, 127),
+            acquire=rng.randint(-(2**31), 2**31 - 1),
+            ts=rng.randint(-1, 2**62),
+            trace=trace,
+            args=frames.encode_args(_rand_args(rng)),
+        ))
+    return rows
+
+
+def _rand_exit_rows(rng: random.Random, n: int):
+    return [
+        frames.ExitRow(
+            seq=rng.randint(0, 2**63),
+            resource_id=rng.randint(-1, 2**31 - 1),
+            context_id=rng.randint(-1, 2**31 - 1),
+            origin_id=rng.randint(-1, 2**31 - 1),
+            entry_type=rng.randint(-128, 127),
+            ts=rng.randint(0, 2**62),
+            rt=rng.randint(0, 2**31 - 1),
+            count=rng.randint(0, 2**31 - 1),
+            err=rng.randint(0, 2**31 - 1),
+            spec=rng.randint(0, 2),
+        )
+        for _ in range(n)
+    ]
+
+
+class TestArgsCodec:
+    def test_roundtrip_randomized(self):
+        rng = random.Random(0xA465)
+        for _ in range(300):
+            args = _rand_args(rng)
+            assert frames.decode_args(frames.encode_args(args)) == args
+
+    def test_empty(self):
+        assert frames.encode_args(()) == b""
+        assert frames.decode_args(b"") == ()
+
+    def test_truncated_tails_raise_cleanly(self):
+        rng = random.Random(0xBEEF)
+        for _ in range(40):
+            blob = frames.encode_args(_rand_args(rng) or ("pad",))
+            for cut in range(1, len(blob)):
+                try:
+                    frames.decode_args(blob[:cut])
+                except ValueError:
+                    continue
+                # A prefix that still parses must be a whole value
+                # boundary artifact — never a crash, never a non-
+                # ValueError (struct.error, IndexError...).
+
+    def test_bad_tag_raises(self):
+        with pytest.raises(ValueError):
+            frames.decode_args(b"\x01\x00Z")
+
+
+class TestFrameRoundtrips:
+    def test_entry_and_bulk_randomized(self):
+        rng = random.Random(0xC0DE)
+        for kind in (frames.KIND_ENTRY, frames.KIND_BULK):
+            for _ in range(25):
+                n = rng.choice([1, 2, 17, 128])
+                rows = _rand_entry_rows(rng, n)
+                interns = _rand_interns(rng)
+                meta = (
+                    bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 40)))
+                    if kind == frames.KIND_BULK else None
+                )
+                payload = frames.encode_entries(
+                    rng.randint(0, 65535), rows, interns,
+                    rng.randint(0, 2**31), rng.randint(0, 2**31),
+                    kind=kind, group_meta=meta,
+                )
+                df = frames.decode_frame(payload)
+                assert df.kind == kind and df.n == n
+                assert df.interns == interns
+                for i, r in enumerate(rows):
+                    assert int(df.columns["seq"][i]) == r.seq
+                    assert int(df.columns["ts"][i]) == r.ts
+                    assert int(df.columns["acquire"][i]) == r.acquire
+                    assert int(df.columns["entry_type"][i]) == r.entry_type
+                    assert int(df.columns["resource_id"][i]) == r.resource_id
+                    a0 = int(df.columns["args_off"][i])
+                    a1 = a0 + int(df.columns["args_len"][i])
+                    assert frames.decode_args(df.varbytes[a0:a1]) == \
+                        frames.decode_args(r.args)
+                    t = df.traces[i * 26:(i + 1) * 26]
+                    assert t == r.trace
+
+    def test_exit_randomized_with_extras(self):
+        rng = random.Random(0xE417)
+        for _ in range(40):
+            n = rng.choice([1, 3, 64])
+            rows = _rand_exit_rows(rng, n)
+            extras = frames.encode_args(
+                [tuple(rng.randint(0, 100) for _ in range(rng.randint(0, 3)))
+                 for _ in range(n)]
+            ) if rng.random() < 0.5 else b""
+            payload = frames.encode_exits(
+                rng.randint(0, 65535), rows, _rand_interns(rng),
+                rng.randint(0, 2**31), 0, extras=extras,
+            )
+            df = frames.decode_frame(payload)
+            assert df.kind == frames.KIND_EXIT and df.n == n
+            assert df.varbytes == extras
+            for i, r in enumerate(rows):
+                assert int(df.columns["seq"][i]) == r.seq
+                assert int(df.columns["rt"][i]) == r.rt
+                assert int(df.columns["err"][i]) == r.err
+                assert int(df.columns["spec"][i]) == r.spec
+
+    def test_verdict_randomized(self):
+        rng = np.random.default_rng(0x7E4D)
+        for _ in range(25):
+            n = int(rng.integers(1, 200))
+            seqs = rng.integers(0, 2**63, n, dtype=np.uint64)
+            adm = rng.integers(0, 2, n, dtype=np.uint8)
+            rea = rng.integers(-9, 10, n, dtype=np.int16)
+            wait = rng.integers(0, 10_000, n, dtype=np.int32)
+            flags = rng.integers(0, 256, n, dtype=np.uint8)
+            df = frames.decode_frame(
+                frames.encode_verdicts(3, seqs, adm, rea, wait, flags)
+            )
+            assert df.kind == frames.KIND_VERDICT and df.n == n
+            np.testing.assert_array_equal(df.columns["seq"], seqs)
+            np.testing.assert_array_equal(df.columns["admitted"], adm)
+            np.testing.assert_array_equal(df.columns["reason"], rea)
+            np.testing.assert_array_equal(df.columns["wait_ms"], wait)
+            np.testing.assert_array_equal(df.columns["flags"], flags)
+
+    def test_reassert_randomized(self):
+        rng = random.Random(0x4EA5)
+        for head in (False, True):
+            rows = [
+                frames.ReassertRow(
+                    resource_id=rng.randint(0, 1000),
+                    context_id=rng.randint(0, 1000),
+                    origin_id=rng.randint(0, 1000),
+                    entry_type=rng.randint(-1, 1),
+                    spec=rng.randint(0, 1),
+                    acquire=rng.randint(1, 8),
+                    count=rng.randint(1, 10_000),
+                )
+                for _ in range(rng.randint(1, 40))
+            ]
+            df = frames.decode_frame(
+                frames.encode_reasserts(1, rows, [], 0, 0, head=head)
+            )
+            assert df.kind == frames.KIND_REASSERT
+            assert bool(df.flags & frames.F_FRAME_RECONNECT) is head
+            assert [int(x) for x in df.columns["count"]] == \
+                [r.count for r in rows]
+
+    def test_columnar_encoder_is_byte_identical(self):
+        """encode_entries_columns (the capture journal's vectorized
+        bulk spill) must produce the EXACT bytes of encode_entries over
+        the equivalent row list — same decoder, same durable format."""
+        rng = random.Random(0xB01C)
+        for n in (0, 1, 7, 333):
+            base = rng.randint(0, 2**40)
+            ts = [rng.randint(0, 2**40) for _ in range(n)]
+            acq = [rng.randint(1, 100) for _ in range(n)]
+            interns = _rand_interns(rng)
+            rows = [
+                frames.EntryRow(
+                    seq=base + j, resource_id=3, context_id=2, origin_id=1,
+                    entry_type=0x41, acquire=acq[j], ts=ts[j],
+                    trace=frames.EMPTY_TRACE, args=b"",
+                )
+                for j in range(n)
+            ]
+            want = frames.encode_entries(
+                5, rows, interns, 9, 0, kind=frames.KIND_BULK
+            )
+            got = frames.encode_entries_columns(
+                5, base, np.array(ts, np.int64), np.array(acq, np.int32),
+                0x41, 3, 2, 1, interns, 9,
+            )
+            assert got == want
+
+    def test_zero_row_preambles(self):
+        """Zero-row frames are legal (a chunk can be exits-only or a
+        fresh connection's intern-only preamble) and must decode."""
+        for payload in (
+            frames.encode_entries(1, [], [(1, b"res")], 7, 0),
+            frames.encode_entries(1, [], [], 0, 0, kind=frames.KIND_BULK),
+            frames.encode_exits(1, [], [], 0, 0),
+            frames.encode_exits(1, [], [], 0, 0, extras=b"xx"),
+            frames.encode_verdicts(
+                1, *(np.empty(0, d) for d in
+                     (np.uint64, np.uint8, np.int16, np.int32, np.uint8))
+            ),
+            frames.encode_reasserts(1, [], [], 0, 0),
+        ):
+            df = frames.decode_frame(payload)
+            assert df.n == 0
+
+
+class TestFrameAdversarial:
+    def _samples(self):
+        rng = random.Random(0x7541)
+        out = [
+            frames.encode_entries(
+                2, _rand_entry_rows(rng, 9), _rand_interns(rng), 1, 0
+            ),
+            frames.encode_entries(
+                2, _rand_entry_rows(rng, 4), [], 1, 0,
+                kind=frames.KIND_BULK, group_meta=b"metameta",
+            ),
+            frames.encode_exits(
+                2, _rand_exit_rows(rng, 6), _rand_interns(rng), 1, 0,
+                extras=frames.encode_args([(1, 2)] * 6),
+            ),
+            frames.encode_verdicts(
+                2,
+                np.arange(5, dtype=np.uint64),
+                np.ones(5, np.uint8),
+                np.zeros(5, np.int16),
+                np.zeros(5, np.int32),
+                np.zeros(5, np.uint8),
+            ),
+            frames.encode_reasserts(
+                2,
+                [frames.ReassertRow(1, 2, 3, 0, 0, 1, 5)] * 3,
+                [(1, b"r")], 0, 0, head=True,
+            ),
+        ]
+        return out
+
+    def test_every_truncated_tail_raises_valueerror(self):
+        """EVERY strict prefix of every frame kind must raise ONE clean
+        ValueError — the torn-segment-tail contract the capture journal
+        leans on."""
+        for payload in self._samples():
+            for cut in range(0, len(payload)):
+                with pytest.raises(ValueError):
+                    frames.decode_frame(payload[:cut])
+
+    def test_unknown_kind_raises(self):
+        payload = bytearray(self._samples()[0])
+        payload[0] = 99
+        with pytest.raises(ValueError):
+            frames.decode_frame(bytes(payload))
+
+    def test_random_garbage_never_crashes(self):
+        """Pure garbage either raises ValueError or decodes to an empty
+        /consistent frame — never any other exception type."""
+        rng = random.Random(0x6A4B)
+        for _ in range(400):
+            blob = bytes(rng.getrandbits(8)
+                         for _ in range(rng.randint(0, 200)))
+            try:
+                df = frames.decode_frame(blob)
+            except ValueError:
+                continue
+            assert df.n >= 0
+
+    def test_corrupt_intern_count_raises(self):
+        payload = bytearray(self._samples()[0])
+        # n_interns field lives at header offset 24 (<BBHIQIIII): blow
+        # it up so the intern loop runs off the payload.
+        import struct
+        struct.pack_into("<I", payload, 24, 2**31)
+        with pytest.raises(ValueError):
+            frames.decode_frame(bytes(payload))

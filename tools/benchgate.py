@@ -68,6 +68,11 @@ STAGE_METRICS: Dict[str, Tuple[str, float]] = {
     "engine_adapter_ops_per_sec": ("higher", 0.60),
     "engine_pipelined_ops_per_sec": ("higher", 0.60),
     "engine_sync_latency_ms": ("lower", 2.00),
+    # Flight-recorder arming cost (PR 19): same-run on/off median
+    # ratios on the bulk loop — box noise cancels, so they get the
+    # tight ratio band next to ipc_span_overhead.
+    "engine_capture_overhead_d0": ("lower", 0.30),
+    "engine_capture_overhead_d2": ("lower", 0.30),
     "spec_ops_per_sec": ("higher", 0.60),
     "spec_entry_p50_us": ("lower", 2.00),
     "spec_entry_p99_us": ("lower", 5.00),
@@ -216,7 +221,8 @@ STAGE_CONTEXT: List[Tuple[Tuple[str, ...], Tuple[str, ...]]] = [
     (("engine_n_rules", "engine_n_ops"),
      ("engine_ops_per_sec", "engine_bulk_ops_per_sec",
       "engine_adapter_ops_per_sec", "engine_pipelined_ops_per_sec",
-      "engine_sync_latency_ms")),
+      "engine_sync_latency_ms",
+      "engine_capture_overhead_d0", "engine_capture_overhead_d2")),
     ((), ("spec_ops_per_sec", "spec_entry_p50_us", "spec_entry_p99_us",
           "spec_entry_sys_p50_us", "spec_entry_sys_p99_us",
           "shed_entry_p50_us", "shed_entry_p99_us")),

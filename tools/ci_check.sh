@@ -58,6 +58,19 @@ echo "== ci_check 2d: fleet timeline (fleetdump) smoke =="
 JAX_PLATFORMS=cpu python tools/fleetdump.py --smoke \
     --out /tmp/ci-fleet-trace.json >/dev/null
 
+# Flight-recorder replay smoke (always): the committed golden capture
+# (tests/data/capture_corpus/ — mixed single/bulk traffic, a
+# mid-stream reload, a rollover, breaker + manual freezes) must replay
+# through a fresh engine BIT-EXACTLY — --verify exits non-zero on the
+# first verdict diff. This is the postmortem contract end-to-end: the
+# same decode + frozen-clock replay path an operator runs on a
+# production capture.
+echo "== ci_check 2e: flight-recorder replay smoke =="
+JAX_PLATFORMS=cpu python tools/replay.py \
+    --dir tests/data/capture_corpus --verify >/dev/null
+JAX_PLATFORMS=cpu python tools/replay.py \
+    --dir tests/data/capture_corpus --verify --depth 2 >/dev/null
+
 if [ "${CI_CHECK_SKIP_BENCH:-0}" = "1" ]; then
     echo "== ci_check 3/3: bench gate SKIPPED (CI_CHECK_SKIP_BENCH=1) =="
     # The ipc stage still smokes even when the full bench is skipped:

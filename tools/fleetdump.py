@@ -61,12 +61,13 @@ def merge_journals(journals) -> dict:
     """[{"meta": ..., "spans": [...]}] -> Chrome trace-event object.
 
     Spans become ``X`` slices (ts/dur in µs); cross-process admissions
-    and RPCs become ``s``/``f`` flow-arrow pairs. The ``f`` anchor is
-    clamped to ``max(target.ts, source.ts)`` — Perfetto drops arrows
-    that point backwards in time, and one ruler beat of residual skew
-    can put a frame's dequeue stamp marginally before the worker's
-    join stamp."""
-    events = []
+    and RPCs become ``s``/``f`` flow-arrow pairs. Event mechanics
+    (emit-once metadata, the backwards-arrow clamp) come from
+    metrics/perfetto.py's :class:`TraceBuilder`; this function owns
+    only the fleet-specific span matching."""
+    from sentinel_tpu.metrics.perfetto import TraceBuilder
+
+    tb = TraceBuilder()
     admits = []   # (ts_us, pid, tid, wid, seq, trace_id)
     frames = []   # (ts_us, pid, tid, wid, seq_lo, seq_hi)
     rpcs = []     # (ts_us, pid, tid, port, xid)
@@ -78,31 +79,17 @@ def merge_journals(journals) -> dict:
         role = str(meta.get("role", "proc"))
         pid = int(meta.get("pid", 0) or (100 + i))
         off_ms = float(meta.get("ruler_off_ms", 0.0) or 0.0)
-        events.append({
-            "name": "process_name", "ph": "M", "pid": pid,
-            "args": {"name": f"sentinel-{role}"},
-        })
-        cats_seen = set()
+        tb.process(f"sentinel-{role}", pid=pid)
         for sp in spans:
             cat = str(sp.get("cat", role))
-            tid = _cat_tid(cat)
-            if cat not in cats_seen:
-                cats_seen.add(cat)
-                events.append({
-                    "name": "thread_name", "ph": "M", "pid": pid,
-                    "tid": tid, "args": {"name": cat},
-                })
+            tid = tb.thread(pid, cat, tid=_cat_tid(cat))
             ts = int(round((float(sp["t0"]) - off_ms) * 1000.0))
             dur = max(1, int(round(float(sp.get("dur", 0.0)) * 1000.0)))
             args = {
                 k: v for k, v in sp.items()
                 if k not in ("name", "cat", "t0", "dur")
             }
-            events.append({
-                "name": sp["name"], "cat": cat, "ph": "X",
-                "pid": pid, "tid": tid, "ts": ts, "dur": dur,
-                "args": args,
-            })
+            tb.slice(pid, tid, sp["name"], ts, dur, cat=cat, args=args)
             name = sp["name"]
             if cat == "worker" and name in ("admit", "admit.bulk"):
                 if "wid" in sp and "seq" in sp:
@@ -119,16 +106,6 @@ def merge_journals(journals) -> dict:
                 key = (int(sp.get("port", 0)), int(sp.get("xid", 0)))
                 serves[key] = (ts, pid, tid)
 
-    def arrow(flow_id, name, s, f):
-        s_ts, s_pid, s_tid = s
-        f_ts, f_pid, f_tid = f
-        events.append({"name": name, "cat": "fleet", "ph": "s",
-                       "id": flow_id, "pid": s_pid, "tid": s_tid,
-                       "ts": s_ts})
-        events.append({"name": name, "cat": "fleet", "ph": "f",
-                       "bp": "e", "id": flow_id, "pid": f_pid,
-                       "tid": f_tid, "ts": max(f_ts, s_ts)})
-
     # Admission arrows: the worker's admit span into the engine frame
     # that carried its seq. seq is monotone per worker, so at most one
     # frame matches.
@@ -136,17 +113,18 @@ def merge_journals(journals) -> dict:
         for f_ts, f_pid, f_tid, f_wid, lo, hi in frames:
             if f_wid == wid and lo <= seq <= hi:
                 fid = str(trace_id) if trace_id else f"adm-{wid}-{seq}"
-                arrow(fid, "admission", (ts, pid, tid),
-                      (f_ts, f_pid, f_tid))
+                tb.flow(fid, "admission", (ts, pid, tid),
+                        (f_ts, f_pid, f_tid), cat="fleet")
                 break
     # RPC arrows: the client frame into the shard that served its xid
     # (xids count per client connection; the port disambiguates).
     for ts, pid, tid, port, xid in rpcs:
         hit = serves.get((port, xid))
         if hit is not None:
-            arrow(f"rpc-{port}-{xid}", "rpc", (ts, pid, tid), hit)
+            tb.flow(f"rpc-{port}-{xid}", "rpc", (ts, pid, tid), hit,
+                    cat="fleet")
 
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    return tb.build()
 
 
 def merge_files(paths) -> dict:

@@ -471,6 +471,88 @@ def main() -> None:
     except Exception as exc:
         print(f"[k2probe] span stage skipped: {exc}", file=sys.stderr)
 
+    # --- flight-recorder hook cost (runtime/capture.py) ----------------
+    # The two numbers the capture ≤2%-armed budget is built from: the
+    # ARMED per-flush hook cost (note_chunk columnar spill + the
+    # note_verdicts fill, measured by wrapping the real hooks inside a
+    # real bulk flush loop — reported in ms/flush and ns/row) and the
+    # DISABLED path, which is one attribute-is-None read per flush.
+    try:
+        import shutil
+        import tempfile
+
+        from sentinel_tpu.models.rules import FlowRule
+        from sentinel_tpu.runtime.capture import CaptureJournal
+        from sentinel_tpu.runtime.engine import Engine
+
+        cap_tmp = tempfile.mkdtemp(prefix="k2probe-cap-")
+        ceng = Engine()
+        ceng.set_flow_rules(
+            [FlowRule(f"cap{i}", count=1e9) for i in range(16)]
+        )
+        cap = CaptureJournal(ceng, directory=cap_tmp)
+        cap.segment_bytes = 1 << 30
+        ceng.capture = cap
+        hook_s = [0.0]
+        orig_chunk, orig_verd = cap.note_chunk, cap.note_verdicts
+
+        def _timed_chunk(*a, **kw):
+            t0 = time.perf_counter()
+            try:
+                return orig_chunk(*a, **kw)
+            finally:
+                hook_s[0] += time.perf_counter() - t0
+
+        def _timed_verd(*a, **kw):
+            t0 = time.perf_counter()
+            try:
+                return orig_verd(*a, **kw)
+            finally:
+                hook_s[0] += time.perf_counter() - t0
+
+        cap.note_chunk = _timed_chunk
+        cap.note_verdicts = _timed_verd
+        cap_rows = 16 * 1024
+
+        def _cap_win():
+            for i in range(16):
+                ceng.submit_bulk(f"cap{i}", 1024)
+            ceng.flush()
+            ceng.drain()
+
+        _cap_win()  # warm: interning + kernel shape + first segment
+        hook_s[0] = 0.0
+        n_fl = 10
+        for _ in range(n_fl):
+            _cap_win()
+        armed_ms = hook_s[0] / n_fl * 1e3
+        results["capture_hook_ms_per_flush"] = round(armed_ms, 3)
+        results["capture_hook_ns_per_row"] = round(
+            hook_s[0] / (n_fl * cap_rows) * 1e9, 1
+        )
+        print(
+            f"[k2probe] capture_hook_ms_per_flush: {armed_ms:.3f} ms"
+            f" ({results['capture_hook_ns_per_row']:.0f} ns/row)",
+            file=sys.stderr, flush=True,
+        )
+        cap.note_chunk, cap.note_verdicts = orig_chunk, orig_verd
+        cap.close()
+        ceng.capture = None
+        n_ck = 200000
+        t0 = time.perf_counter()
+        for _ in range(n_ck):
+            if ceng.capture is not None:
+                _cap_win()  # never taken
+        off_ns = (time.perf_counter() - t0) / n_ck * 1e9
+        results["capture_disabled_ns"] = round(off_ns, 2)
+        print(f"[k2probe] capture_disabled_ns: {off_ns:.1f} ns",
+              file=sys.stderr, flush=True)
+        print(json.dumps(results), file=sys.stderr, flush=True)
+        ceng.close()
+        shutil.rmtree(cap_tmp, ignore_errors=True)
+    except Exception as exc:
+        print(f"[k2probe] capture stage skipped: {exc}", file=sys.stderr)
+
     # --- cluster token plane round trips (sentinel_tpu/cluster) --------
     # One real TCP server on loopback: the three wire stances a token
     # decision can take — per-call frame, 8-row batch frame (cost shown

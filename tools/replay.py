@@ -1,0 +1,635 @@
+"""Deterministic replay of a black-box capture (runtime/capture.py).
+
+A capture directory holds the columnar admission stream an engine
+actually dispatched — entries, bulk groups, exits, the settled verdicts,
+and the rule-timeline events (reloads, sketch promotions, shard-map
+bumps, health transitions) that shaped them — stamped with the engine's
+virtual clock. This tool reconstructs the deciding world (config
+snapshot + rule snapshot from the segment header, then the rule
+timeline in stream order), feeds the captured traffic to a FRESH engine
+on a ``ManualClock`` pinned to each chunk's recorded ``now_ms``, and
+flushes exactly at the captured chunk boundaries. Verdicts are pure
+functions of ``(rules, windows, now)``, so the replayed verdicts must
+be bit-identical to the captured ones — any diff is a real divergence
+(a codec bug, a nondeterministic slot, or un-replayable inputs like
+dropped bulk args columns).
+
+Rows the differ EXCLUDES by construction (counted, reported, never
+silently): captured verdicts carrying ``F_DEGRADED`` (the host fallback
+decided while the device was lost — replay has a healthy device),
+``F_SPECULATIVE`` (the speculative host tier decided pre-settle; replay
+runs single-threaded without it), and ``F_VERDICT_MISSING`` (the
+capture ended before that chunk's fill landed). ``--strict`` diffs them
+anyway.
+
+Modes::
+
+    python tools/replay.py --dir CAPDIR --verify [--strict] [--depth K]
+    python tools/replay.py --dir CAPDIR --bench  [--depth K]
+    python tools/replay.py --dir CAPDIR --explain SEQ
+    python tools/replay.py --dir CAPDIR --trace out.json
+
+``--verify`` prints the bit-exact differential report (exit 1 on any
+diff); ``--bench`` reuses the capture as a load generator and reports
+replay throughput; ``--explain SEQ`` replays through the chunk that
+decided captured row ``SEQ`` and prints the deciding rule row, slot,
+threshold vs. the observed window stat, and the pre/post admission
+state; ``--trace`` exports the capture timeline (chunks, rule reloads,
+freezes) as Chrome trace-event JSON via ``metrics/perfetto.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+
+# Captured-verdict flag bits a non-strict diff masks out (see module doc).
+def _skip_mask_bits():
+    from sentinel_tpu.ipc import frames
+    from sentinel_tpu.runtime import capture as cap
+
+    return frames.F_DEGRADED | frames.F_SPECULATIVE | cap.F_VERDICT_MISSING
+
+
+def load_capture(directory: str, frozen: bool = True) -> Dict[str, Any]:
+    """Decode a capture directory into the replay stream, restricted to
+    ONE boot (the newest, unless every segment already agrees): mixed
+    boots cannot share a virtual clock or a cap_seq space."""
+    from sentinel_tpu.runtime import capture as cap
+
+    paths = cap.capture_paths(directory, frozen=frozen)
+    if not paths:
+        raise SystemExit(f"replay: no capture segments under {directory!r}")
+    by_boot: Dict[str, List[str]] = {}
+    boot_wall: Dict[str, float] = {}
+    for p in paths:
+        header, _recs = cap.read_segment(p)
+        b = header.get("boot_id", "?")
+        by_boot.setdefault(b, []).append(p)
+        boot_wall[b] = max(boot_wall.get(b, 0), header.get("wall_ms", 0))
+    boot = max(boot_wall, key=boot_wall.get)
+    if len(by_boot) > 1:
+        print(
+            f"replay: {len(by_boot)} boots in {directory!r}; "
+            f"replaying newest boot {boot} "
+            f"({len(by_boot[boot])}/{len(paths)} segments)"
+        )
+    return cap.decode_capture(by_boot[boot])
+
+
+# Config keys the replay engine force-overrides after applying the
+# captured snapshot: the capture itself (no recursive recording), the
+# multi-process / batching / async planes (the captured stream is
+# already the post-plane chunk sequence), and the host-side tiers whose
+# verdicts the differ masks anyway.
+def _forced_overrides(depth: int) -> Dict[str, str]:
+    from sentinel_tpu.utils.config import config as C
+
+    return {
+        C.CAPTURE_ENABLED: "false",
+        C.IPC_ENABLED: "false",
+        C.IPC_WORKER_MODE: "false",
+        C.SPANS_ENABLED: "false",
+        C.INGEST_MAX_PENDING: "0",
+        C.INGEST_MAX_PENDING_BULK: "0",
+        C.INGEST_DEADLINE_MS: "0",
+        C.INGEST_BATCH_WINDOW_MS: "0",
+        C.AUTOTUNE_ENABLED: "false",
+        C.SPECULATIVE_ENABLED: "false",
+        C.FAILOVER_ENABLED: "false",
+        C.PIPELINE_DEPTH: str(depth),
+    }
+
+
+def build_engine(header: Dict[str, Any], depth: int = 0):
+    """A fresh engine under the captured config + rule snapshot, on a
+    ManualClock anchored at the segment header's engine-clock ms."""
+    from sentinel_tpu.utils.clock import ManualClock
+    from sentinel_tpu.utils.config import config
+
+    for k, v in (header.get("config") or {}).items():
+        config.set(k, v)
+    for k, v in _forced_overrides(depth).items():
+        config.set(k, v)
+
+    from sentinel_tpu.runtime.engine import Engine
+
+    clk = ManualClock(start_ms=int(header.get("clock_ms", 0)))
+    eng = Engine(clock=clk)
+    apply_rules(eng, header.get("rules") or {})
+    return eng, clk
+
+
+def apply_rules(eng, snap: Dict[str, Any]) -> None:
+    """Apply one header rule snapshot (all five kinds)."""
+    _apply_rules_event(eng, "flow", snap.get("flow") or [])
+    _apply_rules_event(eng, "degrade", snap.get("degrade") or [])
+    _apply_rules_event(eng, "param", snap.get("param") or [])
+    _apply_rules_event(eng, "authority", snap.get("authority") or {})
+    _apply_rules_event(eng, "system", snap.get("system"))
+
+
+def _apply_rules_event(eng, kind: str, rules: Any) -> None:
+    from sentinel_tpu.models.rules import (
+        AuthorityRule,
+        DegradeRule,
+        FlowRule,
+        ParamFlowRule,
+        rules_from_json,
+    )
+
+    if kind == "flow":
+        eng.set_flow_rules(rules_from_json(rules, FlowRule))
+    elif kind == "degrade":
+        eng.set_degrade_rules(rules_from_json(rules, DegradeRule))
+    elif kind == "param":
+        by_res: Dict[str, List[ParamFlowRule]] = {}
+        for r in rules_from_json(rules, ParamFlowRule):
+            by_res.setdefault(r.resource, []).append(r)
+        eng.set_param_rules(by_res)
+    elif kind == "authority":
+        by_res_a = {}
+        for res, rd in (rules or {}).items():
+            by_res_a[res] = rules_from_json([rd], AuthorityRule)[0]
+        eng.set_authority_rules(by_res_a)
+    elif kind == "system":
+        from sentinel_tpu.rules.system_manager import SystemConfig
+
+        eng.set_system_config(SystemConfig(**rules) if rules else None)
+
+
+def _replay_chunk(eng, clk, ck) -> Tuple[list, list]:
+    """Re-submit one captured chunk and flush at its boundary. Returns
+    (entry_ops, bulk_ops) aligned to the chunk's cap_seq row order."""
+    from sentinel_tpu.models import constants as C
+
+    clk.set_ms(int(ck.now_ms))
+    entry_ops = []
+    for e in ck.entries:
+        entry_ops.append(eng.submit_entry(
+            e["resource"],
+            context_name=e["context"] or C.CONTEXT_DEFAULT_NAME,
+            origin=e["origin"],
+            acquire=e["acquire"],
+            entry_type=C.EntryType.IN if e["in"] else C.EntryType.OUT,
+            prio=e["prio"],
+            ts=e["ts"],
+            args=e["args"],
+        ))
+    bulk_ops = []
+    for group in ck.bulk:
+        first = group[0]
+        args_col = None
+        if any(e["args"] for e in group):
+            args_col = [tuple(e["args"]) for e in group]
+        bulk_ops.append(eng.submit_bulk(
+            first["resource"],
+            len(group),
+            ts=np.array([e["ts"] for e in group], dtype=np.int64),
+            acquire=np.array([e["acquire"] for e in group], dtype=np.int32),
+            context_name=first["context"] or C.CONTEXT_DEFAULT_NAME,
+            origin=first["origin"],
+            entry_type=C.EntryType.IN if first["in"] else C.EntryType.OUT,
+            args_column=args_col,
+        ))
+    for x in ck.exits:
+        thr = x["thr"]
+        if thr == -1:
+            eng.submit_exit(
+                x["rows"], x["rt"], count=x["count"], err=x["err"],
+                ts=x["ts"], resource=x["resource"],
+                param_rows=x["p_rows"], speculative=False,
+            )
+        elif thr == 0:
+            # Tracer exit: captured as count=0/err=N (engine.submit_trace).
+            eng.submit_trace(x["rows"], count=x["err"], ts=x["ts"])
+        else:
+            # Speculative-reconciler gauge compensation (±thr, no stats).
+            eng._submit_gauge_comp(x["rows"], thr)
+    for group in ck.bulk_exits:
+        first = group[0]
+        n = len(group)
+        eng.submit_exit_bulk(
+            first["rows"], n,
+            rt=np.array([x["rt"] for x in group], dtype=np.int64),
+            count=np.array([x["count"] for x in group], dtype=np.int64),
+            err=np.array([x["err"] for x in group], dtype=np.int64),
+            ts=np.array([x["ts"] for x in group], dtype=np.int64),
+            resource=first["resource"], speculative=False,
+        )
+    eng.flush()
+    return entry_ops, bulk_ops
+
+
+def replay(
+    decoded: Dict[str, Any],
+    depth: int = 0,
+    stop_after_seq: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Drive the full stream; returns ``{"engine", "clock", "chunks":
+    [(CapturedChunk, entry_ops, bulk_ops)], "skipped_rules"}``. Rule
+    events the sketch tier synthesized are skipped — the replay
+    engine's OWN sketch tier re-derives promotions from the same
+    traffic (they are host-tier state, not inputs)."""
+    eng, clk = build_engine(decoded["header"], depth=depth)
+    out: List[Tuple[Any, list, list]] = []
+    skipped_rules = 0
+    try:
+        for kind, item in decoded["stream"]:
+            if kind == "rules":
+                if item.get("from_sketch"):
+                    skipped_rules += 1
+                    continue
+                _apply_rules_event(eng, item["kind"], item["rules"])
+            elif kind == "chunk":
+                entry_ops, bulk_ops = _replay_chunk(eng, clk, item)
+                out.append((item, entry_ops, bulk_ops))
+                if (
+                    stop_after_seq is not None
+                    and item.cap_seq + item.rows > stop_after_seq
+                ):
+                    break
+            # health / sketch / shard / freeze records are annotations:
+            # replay re-derives engine state from traffic alone.
+        eng.drain()
+    except BaseException:
+        eng.close()
+        raise
+    return {
+        "engine": eng, "clock": clk, "chunks": out,
+        "skipped_rules": skipped_rules,
+    }
+
+
+def _replayed_arrays(ck, entry_ops, bulk_ops):
+    """(admitted u8, reason i16, wait i32, have u8) for one replayed
+    chunk, aligned to cap_seq row order."""
+    n = ck.rows
+    admitted = np.zeros(n, np.uint8)
+    reason = np.zeros(n, np.int16)
+    wait = np.zeros(n, np.int32)
+    have = np.zeros(n, np.uint8)
+    i = 0
+    for op in entry_ops:
+        if op is not None:
+            v = op.verdict
+            if v is not None:
+                admitted[i] = 1 if v.admitted else 0
+                reason[i] = v.reason
+                wait[i] = v.wait_ms
+                have[i] = 1
+        i += 1
+    for gi, g in enumerate(bulk_ops):
+        gn = len(ck.bulk[gi])
+        if g is not None and g.admitted is not None:
+            sl = slice(i, i + gn)
+            admitted[sl] = g.admitted.astype(np.uint8)
+            reason[sl] = g.reason.astype(np.int16)
+            wait[sl] = g.wait_ms.astype(np.int32)
+            have[sl] = 1
+        i += gn
+    return admitted, reason, wait, have
+
+
+def verify(decoded: Dict[str, Any], depth: int = 0, strict: bool = False) -> Dict[str, Any]:
+    """The differential report: replay and diff against the captured
+    RK_VERDICT rows. Returns counts + at most 20 sample diffs."""
+    res = replay(decoded, depth=depth)
+    skip_bits = 0 if strict else _skip_mask_bits()
+    report = {
+        "chunks": len(res["chunks"]),
+        "rows": 0,
+        "compared": 0,
+        "diffs": 0,
+        "skipped_flagged": 0,   # degraded / speculative / missing rows
+        "no_captured_verdict": 0,
+        "not_replayed": 0,      # submit returned None (pass-through)
+        "skipped_sketch_rules": res["skipped_rules"],
+        "samples": [],
+    }
+    try:
+        for ck, entry_ops, bulk_ops in res["chunks"]:
+            report["rows"] += ck.rows
+            if ck.verdicts is None:
+                report["no_captured_verdict"] += ck.rows
+                continue
+            c_adm, c_rea, c_wait, c_flags = ck.verdicts
+            r_adm, r_rea, r_wait, r_have = _replayed_arrays(
+                ck, entry_ops, bulk_ops
+            )
+            for i in range(ck.rows):
+                if skip_bits and (int(c_flags[i]) & skip_bits):
+                    report["skipped_flagged"] += 1
+                    continue
+                if not r_have[i]:
+                    report["not_replayed"] += 1
+                    continue
+                report["compared"] += 1
+                if (
+                    c_adm[i] != r_adm[i]
+                    or c_rea[i] != r_rea[i]
+                    or c_wait[i] != r_wait[i]
+                ):
+                    report["diffs"] += 1
+                    if len(report["samples"]) < 20:
+                        report["samples"].append({
+                            "seq": ck.cap_seq + i,
+                            "flush_seq": ck.flush_seq,
+                            "captured": {
+                                "admitted": int(c_adm[i]),
+                                "reason": int(c_rea[i]),
+                                "wait_ms": int(c_wait[i]),
+                            },
+                            "replayed": {
+                                "admitted": int(r_adm[i]),
+                                "reason": int(r_rea[i]),
+                                "wait_ms": int(r_wait[i]),
+                            },
+                        })
+    finally:
+        res["engine"].close()
+    return report
+
+
+# ---------------------------------------------------------------------------
+# --explain
+# ---------------------------------------------------------------------------
+def explain(decoded: Dict[str, Any], seq: int, depth: int = 0) -> Dict[str, Any]:
+    """Replay through the chunk that decided captured row ``seq`` and
+    attribute the verdict: the deciding rule row (the blocked rule
+    bean), the slot family, the threshold vs. the observed one-second
+    window stat, and the pre/post admission state of the resource."""
+    from sentinel_tpu.core import errors as E
+
+    target_ck = None
+    for ck in decoded["chunks"].values():
+        if ck.cap_seq <= seq < ck.cap_seq + ck.rows:
+            target_ck = ck
+            break
+    if target_ck is None:
+        raise SystemExit(f"replay: seq {seq} is not in this capture")
+
+    # One-second observed window, reconstructed from the captured
+    # stream itself (what the deciding kernel saw: every admitted
+    # acquire on the row's resource inside the trailing 1000 ms).
+    row = _row_of(target_ck, seq - target_ck.cap_seq)
+    resource = row["resource"]
+    now = int(target_ck.now_ms)
+    observed_qps = 0.0
+    for ck in decoded["chunks"].values():
+        if ck.verdicts is None or ck.now_ms > now:
+            continue
+        c_adm = ck.verdicts[0]
+        i = 0
+        for e in ck.entries:
+            if (
+                e["resource"] == resource
+                and now - 1000 < e["ts"] <= now
+                and i < len(c_adm) and c_adm[i]
+            ):
+                observed_qps += e["acquire"]
+            i += 1
+        for group in ck.bulk:
+            for e in group:
+                if (
+                    e["resource"] == resource
+                    and now - 1000 < e["ts"] <= now
+                    and i < len(c_adm) and c_adm[i]
+                ):
+                    observed_qps += e["acquire"]
+                i += 1
+
+    res = replay(decoded, depth=depth, stop_after_seq=seq)
+    try:
+        ck, entry_ops, bulk_ops = res["chunks"][-1]
+        idx = seq - ck.cap_seq
+        v = None
+        if idx < len(entry_ops):
+            op = entry_ops[idx]
+            v = op.verdict if op is not None else None
+        else:
+            j = idx - len(entry_ops)
+            for gi, group in enumerate(ck.bulk):
+                if j < len(group):
+                    g = bulk_ops[gi]
+                    if g is not None and g.admitted is not None:
+                        from sentinel_tpu.runtime.engine import Verdict
+
+                        blocked = None
+                        if not g.admitted[j]:
+                            # Bulk verdict arrays carry no rule bean;
+                            # attribute from the replay engine's live
+                            # rule tables by (resource, reason code).
+                            blocked = _attribute_rule(
+                                res["engine"], resource, int(g.reason[j])
+                            )
+                        v = Verdict(
+                            admitted=bool(g.admitted[j]),
+                            reason=int(g.reason[j]),
+                            wait_ms=int(g.wait_ms[j]),
+                            blocked_rule=blocked,
+                        )
+                    break
+                j -= len(group)
+
+        pre = post = None
+        if ck.verdicts is not None:
+            c_adm = ck.verdicts[0]
+            rows_res = [
+                i for i in range(ck.rows)
+                if _row_of(ck, i)["resource"] == resource
+            ]
+            before = [i for i in rows_res if i < idx]
+            pre = {
+                "resource_rows_in_chunk": len(rows_res),
+                "admitted_before_row": int(sum(c_adm[i] for i in before)),
+            }
+            post = {
+                "admitted_total": int(sum(c_adm[i] for i in rows_res)),
+                "blocked_total": int(
+                    len(rows_res) - sum(c_adm[i] for i in rows_res)
+                ),
+            }
+
+        out: Dict[str, Any] = {
+            "seq": seq,
+            "flush_seq": ck.flush_seq,
+            "now_ms": now,
+            "row": row,
+            "observed_window_qps": observed_qps,
+        }
+        if ck.verdicts is not None:
+            out["captured"] = {
+                "admitted": int(ck.verdicts[0][idx]),
+                "reason": int(ck.verdicts[1][idx]),
+                "reason_name": E.exc_name_for_code(int(ck.verdicts[1][idx]))
+                if ck.verdicts[1][idx] else "PASS",
+                "wait_ms": int(ck.verdicts[2][idx]),
+                "flags": int(ck.verdicts[3][idx]),
+            }
+        if v is not None:
+            rule = getattr(v, "blocked_rule", None)
+            out["replayed"] = {
+                "admitted": bool(v.admitted),
+                "reason": int(v.reason),
+                "reason_name": E.exc_name_for_code(v.reason)
+                if v.reason else "PASS",
+                "wait_ms": int(v.wait_ms),
+                "limit_type": v.limit_type,
+                "slot_name": v.slot_name,
+                "deciding_rule": rule.to_dict() if rule is not None else None,
+                "threshold": getattr(rule, "count", None),
+            }
+        if pre is not None:
+            out["pre"] = pre
+            out["post"] = post
+        return out
+    finally:
+        res["engine"].close()
+
+
+def _attribute_rule(eng, resource: str, reason: int):
+    """Best-effort rule attribution for bulk rows (whose verdict
+    arrays carry only the reason code): the live rule of that kind on
+    that resource, from the replay engine's current tables."""
+    from sentinel_tpu.core import errors as E
+
+    if reason == E.BLOCK_FLOW:
+        for r in eng.flow_index.user_rules():
+            if r.resource == resource:
+                return r
+    elif reason == E.BLOCK_DEGRADE:
+        for r in eng.degrade_index.rules:
+            if r.resource == resource:
+                return r
+    elif reason == E.BLOCK_PARAM:
+        for pairs in getattr(eng.param_index, "by_resource", {}).values():
+            for _gid, r in pairs:
+                if r.resource == resource:
+                    return r
+    elif reason == E.BLOCK_AUTHORITY:
+        return eng.authority_rules.get(resource)
+    return None
+
+
+def _row_of(ck, idx: int) -> Dict[str, Any]:
+    if idx < len(ck.entries):
+        return ck.entries[idx]
+    j = idx - len(ck.entries)
+    for group in ck.bulk:
+        if j < len(group):
+            return group[j]
+        j -= len(group)
+    raise IndexError(f"row {idx} outside chunk of {ck.rows} rows")
+
+
+# ---------------------------------------------------------------------------
+# --bench / --trace
+# ---------------------------------------------------------------------------
+def bench(decoded: Dict[str, Any], depth: int = 0) -> Dict[str, Any]:
+    """The capture as a load generator: time a full replay (submit +
+    flush + drain) and report throughput, bench.py-style."""
+    rows = sum(ck.rows for ck in decoded["chunks"].values())
+    t0 = time.perf_counter()
+    res = replay(decoded, depth=depth)
+    elapsed = time.perf_counter() - t0
+    res["engine"].close()
+    return {
+        "chunks": len(res["chunks"]),
+        "rows": rows,
+        "elapsed_s": round(elapsed, 4),
+        "rows_per_s": round(rows / elapsed, 1) if elapsed > 0 else 0.0,
+        "depth": depth,
+    }
+
+
+def trace_dict(decoded: Dict[str, Any]) -> Dict[str, Any]:
+    """Capture timeline as Chrome trace-event JSON: one slice per chunk
+    on a ``capture`` track, instants for rule reloads / health /
+    freezes (metrics/perfetto.py emission)."""
+    from sentinel_tpu.metrics.perfetto import TraceBuilder
+
+    tb = TraceBuilder()
+    pid = tb.process(decoded["header"].get("app", "capture"))
+    tid = tb.thread(pid, "chunks")
+    ev_tid = tb.thread(pid, "timeline")
+    last_ms: Optional[int] = None
+    for kind, item in decoded["stream"]:
+        if kind == "chunk":
+            start = item.now_ms if last_ms is None else min(item.now_ms, last_ms)
+            tb.slice(
+                pid, tid, "chunk", item.now_ms * 1000.0, 1000.0,
+                args={
+                    "flush_seq": item.flush_seq, "cap_seq": item.cap_seq,
+                    "rows": item.rows,
+                },
+            )
+            last_ms = item.now_ms
+        else:
+            ts = (last_ms or 0) * 1000.0
+            tb.instant(pid, ev_tid, kind, ts, args=item)
+    return tb.build()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", required=True, help="capture directory")
+    ap.add_argument("--depth", type=int, default=0,
+                    help="replay pipeline depth (default 0 = sync)")
+    ap.add_argument("--no-frozen", action="store_true",
+                    help="ignore frozen-* postmortem segments")
+    ap.add_argument("--verify", action="store_true",
+                    help="diff replayed verdicts against captured ones")
+    ap.add_argument("--strict", action="store_true",
+                    help="with --verify: diff degraded/speculative/"
+                         "missing rows too")
+    ap.add_argument("--bench", action="store_true",
+                    help="time a full replay as a load generator")
+    ap.add_argument("--explain", type=int, default=None, metavar="SEQ",
+                    help="attribute the verdict of captured row SEQ")
+    ap.add_argument("--trace", default=None, metavar="OUT",
+                    help="export the capture timeline as trace-event JSON")
+    ap.add_argument("--platform", default=None,
+                    help="JAX platform override (e.g. cpu)")
+    args = ap.parse_args()
+    if args.platform:
+        os.environ.setdefault("JAX_PLATFORMS", args.platform)
+
+    decoded = load_capture(args.dir, frozen=not args.no_frozen)
+    did = False
+    if args.trace:
+        trace = trace_dict(decoded)
+        with open(args.trace, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        print(f"wrote {args.trace}: {len(trace['traceEvents'])} events")
+        did = True
+    if args.explain is not None:
+        print(json.dumps(explain(decoded, args.explain, depth=args.depth),
+                         indent=2, default=str))
+        did = True
+    if args.bench:
+        print(json.dumps(bench(decoded, depth=args.depth), indent=2))
+        did = True
+    if args.verify or not did:
+        report = verify(decoded, depth=args.depth, strict=args.strict)
+        print(json.dumps(report, indent=2))
+        if report["diffs"]:
+            raise SystemExit(1)
+        print(
+            f"replay verified: {report['compared']} verdicts bit-exact "
+            f"({report['skipped_flagged']} flagged rows skipped, "
+            f"depth {args.depth})"
+        )
+
+
+if __name__ == "__main__":
+    main()
