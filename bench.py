@@ -1681,6 +1681,78 @@ def _run_ipc_stage(n_rules: int, n_ops: int, iters: int) -> dict:
                 config.IPC_SHM_PREFIX,
             ):
                 config.set(key, config.DEFAULTS[key])
+
+        # --- warm standby + planned handoff (PR 20): the same kill -9
+        # with a pre-forked compile-warmed standby armed (the outage
+        # should be ≈ the detection window, the cold-boot term gone),
+        # then one operator handoff cycle (zero policy-served is the
+        # acceptance bit; the column is the worst held-verdict gap).
+        standby_cols: dict = {}
+        ckpt_sb = _os.path.join(
+            "/dev/shm" if _os.path.isdir("/dev/shm")
+            else _tempfile.gettempdir(),
+            f"stpu-bench-sb-{_os.getpid()}.bin",
+        )
+        try:
+            config.set(config.IPC_HEARTBEAT_MS, "50")
+            config.set(config.IPC_ENGINE_DEAD_MS, "2000")
+            config.set(config.IPC_ENGINE_DEAD_CONFIRM_MS, "1000")
+            config.set(config.IPC_HANDOFF_WAIT_MS, "30000")
+            config.set(config.SUPERVISE_BACKOFF_MS, "200")
+            config.set(config.SUPERVISE_STANDBY, "true")
+            config.set(config.SUPERVISE_STANDBY_WARM_MS, "500")
+            config.set(config.FAILOVER_ENABLED, "true")
+            config.set(config.FAILOVER_CHECKPOINT_EVERY, "2")
+            config.set(config.FAILOVER_CKPT_PATH, ckpt_sb)
+            from sentinel_tpu.ipc.supervise import (
+                measure_handoff_outage,
+                measure_standby_outage,
+            )
+
+            out = measure_standby_outage(
+                _bench_restart_setup, "r0", timeout_s=240
+            )
+            standby_cols = {
+                "ipc_standby_outage_ms": round(out["outage_ms"], 1),
+                "ipc_standby_warm_boot_ms": round(
+                    out["standby_warm_boot_ms"] or 0.0, 1
+                ),
+                "ipc_standby_takeovers": out["standby_takeovers"],
+            }
+            _log(
+                f"ipc standby outage {out['outage_ms']:.0f} ms "
+                f"(warm boot {out['standby_warm_boot_ms']:.0f} ms off "
+                f"the outage path, {out['standby_takeovers']} takeover)"
+            )
+            out = measure_handoff_outage(
+                _bench_restart_setup, "r0", timeout_s=240
+            )
+            standby_cols["ipc_handoff_outage_ms"] = round(
+                out["handoff_outage_ms"], 1
+            )
+            standby_cols["ipc_handoff_policy_served"] = out["policy_served"]
+            _log(
+                f"ipc handoff worst verdict gap "
+                f"{out['handoff_outage_ms']:.0f} ms "
+                f"({out['policy_served']} policy-served, "
+                f"{out['handoffs']} handoff)"
+            )
+        except Exception as e:
+            _log(f"ipc standby measurement failed ({e}) — columns omitted")
+        finally:
+            try:
+                _os.unlink(ckpt_sb)
+            except OSError:
+                pass
+            for key in (
+                config.IPC_HEARTBEAT_MS, config.IPC_ENGINE_DEAD_MS,
+                config.IPC_ENGINE_DEAD_CONFIRM_MS, config.IPC_HANDOFF_WAIT_MS,
+                config.SUPERVISE_BACKOFF_MS, config.SUPERVISE_STANDBY,
+                config.SUPERVISE_STANDBY_WARM_MS, config.FAILOVER_ENABLED,
+                config.FAILOVER_CHECKPOINT_EVERY, config.FAILOVER_CKPT_PATH,
+                config.IPC_SHM_PREFIX,
+            ):
+                config.set(key, config.DEFAULTS[key])
     finally:
         for key in (
             config.SPECULATIVE_ENABLED, config.SPECULATIVE_FLUSH_BATCH,
@@ -1732,6 +1804,7 @@ def _run_ipc_stage(n_rules: int, n_ops: int, iters: int) -> dict:
         "ipc_client_sheds": cli_counters.get("sheds", 0),
         "ipc_adaptive_policy_served": cli2_policy,
         **restart_cols,
+        **standby_cols,
         "platform": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "jax_version": jax.__version__,

@@ -48,6 +48,7 @@ from sentinel_tpu.ipc import frames as fr
 from sentinel_tpu.ipc.ring import (
     HEALTH_CLOSED,
     HEALTH_DEGRADED,
+    HEALTH_HANDOFF,
     HEALTH_HEALTHY,
     ControlBlock,
     ShmRing,
@@ -199,6 +200,10 @@ class IngestPlane:
         self._claimed: set = set()
         self._stop = threading.Event()
         self.closed = False
+        # Planned-handoff drain (handoff()): while set, the control
+        # heartbeat publishes HANDOFF — workers hold new admissions for
+        # the successor instead of serving policy verdicts.
+        self._handoff = False
         self._thread: Optional[threading.Thread] = None
         self._ctrl: Optional[threading.Thread] = None
         # The intern generation starts at 1 so a worker attaching to a
@@ -209,6 +214,12 @@ class IngestPlane:
         # workers react to the change with the reconnect protocol
         # (re-intern, ledger re-assert, buffered-exit replay).
         self.engine_epoch = self.control.bump_engine_boot()
+        # Engine pid for the worker-side death-confirmation probe
+        # (dead.confirm.ms): a stale wall clock + a live pid means
+        # pegged-not-dead.
+        import os as _os
+
+        self.control.set_engine_pid(_os.getpid())
         # Frames still in a re-attached ring belong to the DEAD world:
         # their callers were policy-served long ago and their intern ids
         # mean nothing here — drop anything below the post-attach
@@ -935,6 +946,8 @@ class IngestPlane:
         fo = eng.failover
         if fo.armed and fo.state != HEALTHY:
             health = HEALTH_DEGRADED
+        if self._handoff:
+            health = HEALTH_HANDOFF
         if self.closed:
             health = HEALTH_CLOSED
         self.control.beat_engine(health)
@@ -1067,9 +1080,82 @@ class IngestPlane:
             "engine_epoch": self.engine_epoch,
             "shm_prefix": self.prefix,
             "reattached": self.attached,
+            "handoff": self._handoff,
             "counters": counters,
             "workers": live,
         }
+
+    def handoff(self, wait_ms: Optional[int] = None) -> dict:
+        """Planned live handoff, old-world side: publish HANDOFF on
+        the control header (workers HOLD new admissions for the
+        successor instead of serving policy verdicts), keep draining
+        until the request ring stays empty for a couple of heartbeats
+        (in-flight admissions and completions settle against THIS
+        engine), then detach abandon-style — no CLOSED word, no worker
+        reap, no unlink — leaving the rings, the worker ledgers and the
+        HANDOFF word in place for the successor's attach (boot-epoch
+        bump -> normal reconnect/reassert). Returns drain stats."""
+        if self.closed:
+            return {"drained": False, "drain_ms": 0.0}
+        if wait_ms is None:
+            wait_ms = config.get_int(config.IPC_HANDOFF_WAIT_MS, 3000)
+        self._handoff = True
+        try:
+            self._publish_control(force=True)
+        except (ValueError, TypeError):
+            pass
+        # Sustained-empty: one observation of an empty ring can race a
+        # worker mid-push; require it to STAY empty for two heartbeat
+        # periods (covers the worker window flusher and any frame the
+        # drainer is currently deciding — the thread join below waits
+        # out the final _drain_once).
+        quiet_s = 2.0 * self.heartbeat_ms / 1e3
+        deadline = time.monotonic() + max(1, int(wait_ms)) / 1e3
+        t0 = time.monotonic()
+        quiet_since: Optional[float] = None
+        drained = False
+        while time.monotonic() < deadline:
+            if self.request.occupancy() > 0.0:
+                quiet_since = None
+            elif quiet_since is None:
+                quiet_since = time.monotonic()
+            elif time.monotonic() - quiet_since >= quiet_s:
+                drained = True
+                break
+            time.sleep(0.001)
+        drain_ms = (time.monotonic() - t0) * 1e3
+        self.closed = True
+        self._stop.set()
+        for t in (self._thread, self._ctrl):
+            if t is not None:
+                t.join(5.0)
+        self._thread = None
+        self._ctrl = None
+        # Straggler sweep: a worker that read a pre-HANDOFF health word
+        # and then got descheduled can land a frame between the quiet
+        # window's last occupancy read and the drainer join above — it
+        # would otherwise sit in the ring as dead-world backlog (gen-
+        # gated by the successor) with its caller parked to the policy
+        # timeout. Answer it from THIS world before detaching.
+        try:
+            while self.request.occupancy() > 0.0:
+                if not self._drain_once():
+                    break
+        except (ValueError, OSError):
+            pass
+        if self._engine.ipc_plane is self:
+            self._engine.ipc_plane = None
+        if self._spans.enabled:
+            try:
+                self._spans.spill()
+            except OSError:
+                pass
+        self.request.close()
+        for r in self.responses:
+            if r is not None:
+                r.close()
+        self.control.close()
+        return {"drained": drained, "drain_ms": round(drain_ms, 3)}
 
     def abandon(self) -> None:
         """Chaos/test hook: die like ``kill -9`` would — stop the

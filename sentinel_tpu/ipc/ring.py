@@ -332,6 +332,19 @@ class ShmRing:
             except (TypeError, ValueError):
                 pass
 
+    def nudge(self) -> None:
+        """Ring the consumer doorbell unconditionally (worker-side
+        death-confirmation probe: wake a parked drainer so a merely
+        idle engine beats before the declaration lands). At most one
+        spurious consumer wake per call; no-op without a doorbell."""
+        d = self._doorbell
+        if d is None:
+            return
+        try:
+            d.release()
+        except (OSError, ValueError):
+            pass
+
     # -- readers --------------------------------------------------------
     def occupancy(self) -> float:
         """Published head minus published tail over capacity (0..1) —
@@ -378,7 +391,10 @@ class ShmRing:
 #       re-interns, re-asserts its live-admission ledger and replays
 #       buffered completions (ipc/worker.py reconnect protocol).
 #   48  u32 workers_max at create (attach validates geometry)
-#   52  .. reserved to 64
+#   52  u32 engine pid (written at plane attach; the worker-side
+#       liveness CONFIRMATION ruler — a stale wall clock plus a live
+#       pid means "pegged, not dead", ipc/worker.py)
+#   56  .. reserved to 64
 #   64  worker slots: WORKERS_MAX x 32 bytes
 #       [u64 heartbeat epoch, u64 wall ms, u32 pid, u32 shed count,
 #        u64 reserved]
@@ -392,11 +408,16 @@ POLICY_CAP = 4096
 HEALTH_HEALTHY = 0
 HEALTH_DEGRADED = 1
 HEALTH_CLOSED = 2
+# Planned-handoff drain: the OLD engine is alive and settling in-flight
+# work but accepts no NEW admissions — workers hold (bounded) for the
+# successor's boot-epoch bump instead of falling to the policy path.
+HEALTH_HANDOFF = 3
 
 HEALTH_NAMES = {
     HEALTH_HEALTHY: "HEALTHY",
     HEALTH_DEGRADED: "DEGRADED",
     HEALTH_CLOSED: "CLOSED",
+    HEALTH_HANDOFF: "HANDOFF",
 }
 
 
@@ -485,6 +506,17 @@ class ControlBlock:
         racing close() must not see a phantom restart)."""
         try:
             return _U64.unpack_from(self._buf, 40)[0]
+        except (TypeError, ValueError):
+            return 0
+
+    def set_engine_pid(self, pid: int) -> None:
+        """Publish the engine process id (written once per plane
+        attach) — the death-confirmation probe target for workers."""
+        _U32.pack_into(self._buf, 52, pid & 0xFFFFFFFF)
+
+    def engine_pid(self) -> int:
+        try:
+            return _U32.unpack_from(self._buf, 52)[0]
         except (TypeError, ValueError):
             return 0
 

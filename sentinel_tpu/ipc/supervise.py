@@ -25,6 +25,27 @@ start):
   (``sentinel.tpu.supervise.backoff.{ms,max.ms}``), bounded by
   ``sentinel.tpu.supervise.restarts.max`` (0 = unlimited).
 
+PR 20 closes the cold-boot gap with a **warm standby** and a
+**planned live handoff**:
+
+* with ``sentinel.tpu.supervise.standby.enabled`` the supervisor
+  pre-forks a SECOND engine child (``standby_main``) that imports JAX,
+  loads rules, warm-compiles the flush kernels via probe batches
+  (``FailoverManager.warm_probe``) and re-warms from the durable
+  checkpoint every ``standby.warm.interval.ms`` — parked WITHOUT
+  attaching to the rings. On primary death the supervisor sends it
+  ``attach`` instead of cold-respawning: the measured outage collapses
+  from cold-boot seconds to ≈ the detection window, and the NEXT
+  standby is pre-forked immediately;
+* ``EngineSupervisor.handoff()`` (SIGUSR1 / the ``handoff`` transport
+  command) triggers a planned drain: the primary publishes HANDOFF on
+  the control header (workers HOLD new admissions instead of serving
+  policy verdicts), settles in-flight flushes, spills a final durable
+  checkpoint, marks its capture segments orderly-closed and exits with
+  ``EXIT_HANDOFF`` — the standby takes over with zero policy-served
+  verdicts. This is the mechanism for rolling engine upgrades and
+  rule-table recompiles served from the standby.
+
 The public faces are ``api.run_engine_supervised`` (embedders) and
 ``tools/ipc_launch.py --supervise`` (CLI).
 """
@@ -40,6 +61,11 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 from sentinel_tpu.utils.config import config
+
+# Exit code of an engine child that completed a PLANNED handoff drain:
+# the watcher promotes the standby immediately — no backoff, no restart
+# budget spent (an orderly drain is not a crash).
+EXIT_HANDOFF = 42
 
 
 @dataclass
@@ -226,8 +252,202 @@ def engine_main(handles: PlaneHandles, overrides, setup, setup_args) -> None:
         "[supervise] engine child up (pid %d, epoch %d)",
         os.getpid(), eng.ipc_plane.engine_epoch,
     )
+    raise SystemExit(_serve(eng, stop))
+
+
+def _serve(eng, stop: threading.Event) -> int:
+    """Park an ATTACHED engine child until shutdown. Returns the
+    process exit code: 0 for an orderly SIGTERM close, ``EXIT_HANDOFF``
+    after a planned handoff drain (SIGUSR1 or the ``handoff`` transport
+    command) — the watcher promotes the warm standby on that code
+    without touching the restart backoff."""
+    handoff_evt = threading.Event()
+
+    def _on_usr1(_sig, _frm):
+        handoff_evt.set()
+
+    try:
+        signal.signal(signal.SIGUSR1, _on_usr1)
+    except (ValueError, OSError):
+        pass
+    requested = getattr(eng, "handoff_requested", None)
     while not stop.is_set():
+        if handoff_evt.is_set() or (
+            requested is not None and requested.is_set()
+        ):
+            _perform_handoff(eng)
+            return EXIT_HANDOFF
         stop.wait(0.2)
+    eng.close()
+    return 0
+
+
+def _perform_handoff(eng) -> None:
+    """The old-world half of a planned handoff, in drain order:
+    (1) arm a one-shot checkpoint so the settling flush carries the
+    freshest state; (2) ``plane.handoff()`` — publish HANDOFF (workers
+    hold), drain the request ring to sustained-empty, detach WITHOUT
+    the CLOSED word so the rings and worker ledgers survive for the
+    successor; (3) settle in-flight flushes; (4) spill the final
+    durable checkpoint synchronously; (5) mark the capture segments
+    orderly-closed so the successor's death sweep files them as
+    ``frozen-close-*``, not ``frozen-death-*``; (6) close the engine
+    (the plane is already detached — no CLOSED is ever published)."""
+    from sentinel_tpu.utils.record_log import record_log
+
+    fo = eng.failover
+    durable = fo.armed and fo.durable_path
+    if durable:
+        fo.request_checkpoint()
+    plane = eng.ipc_plane
+    if plane is not None:
+        try:
+            stats = plane.handoff()
+            record_log.info("[supervise] handoff drain: %s", stats)
+        except Exception:
+            record_log.error(
+                "[supervise] handoff drain failed — closing anyway",
+                exc_info=True,
+            )
+    try:
+        eng.flush()
+        eng.drain()
+    except Exception:
+        record_log.error(
+            "[supervise] handoff settle failed", exc_info=True
+        )
+    if durable:
+        fo.spill_durable_now()
+    if eng.capture is not None:
+        try:
+            eng.capture.mark_orderly_close("handoff")
+        except Exception:
+            record_log.error(
+                "[supervise] orderly-close marker failed", exc_info=True
+            )
+    eng.close()
+
+
+def standby_main(
+    handles: PlaneHandles, overrides, setup, setup_args, conn
+) -> None:
+    """Spawn target: a warm STANDBY engine child. It does everything
+    ``engine_main`` does EXCEPT attach: import JAX, load rules,
+    warm-start from the durable checkpoint, warm-compile the flush
+    kernels via probe batches — then park, re-warming from the durable
+    file every ``standby.warm.interval.ms`` until the supervisor sends
+    ``attach`` (primary died or drained), at which point it does a
+    final restore, re-arms the flight recorder and attaches to the
+    existing rings (boot-epoch bump → normal worker reconnect).
+
+    Pipe protocol: child sends ``("ready", warm_boot_ms)`` once
+    compiled, ``("attached", attach_ms)`` after the plane is up;
+    parent sends ``"attach"`` or ``"stop"``. The flight recorder stays
+    DISARMED until promotion — a standby's CaptureJournal would run
+    the next-boot death sweep against the LIVE primary's segments in
+    the shared capture directory."""
+    for k, v in (overrides or {}).items():
+        config.set(k, v)
+    config.set(config.IPC_ENABLED, "false")
+    cap_override = (overrides or {}).get(config.CAPTURE_ENABLED, "")
+    config.set(config.CAPTURE_ENABLED, "false")
+    from sentinel_tpu.core import api
+    from sentinel_tpu.ipc.plane import IngestPlane
+    from sentinel_tpu.utils.record_log import record_log
+
+    stop = threading.Event()
+
+    def _on_term(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
+    t0 = time.perf_counter()
+    eng = api.get_engine()
+    if setup is not None:
+        try:
+            setup(eng, *(setup_args or ()))
+        except Exception:
+            record_log.error(
+                "[standby] engine setup failed — serving without it",
+                exc_info=True,
+            )
+    warm_s = max(
+        0.05,
+        config.get_int(config.SUPERVISE_STANDBY_WARM_MS, 2000) / 1e3,
+    )
+
+    def _rewarm() -> None:
+        if eng.failover.armed and eng.failover.durable_path:
+            try:
+                eng.failover.restore_durable()
+            except Exception:
+                record_log.error(
+                    "[standby] durable re-warm raised — keeping last "
+                    "state", exc_info=True,
+                )
+
+    _rewarm()
+    try:
+        # The restore path may have probed already (try_recover); this
+        # guarantees the jit cache is populated even when failover is
+        # unarmed or the durable file does not exist yet.
+        eng.failover.warm_probe()
+    except Exception:
+        record_log.error(
+            "[standby] warm probe failed — reporting ready anyway "
+            "(first flush will compile)", exc_info=True,
+        )
+    warm_boot_ms = (time.perf_counter() - t0) * 1e3
+    try:
+        conn.send(("ready", warm_boot_ms))
+    except (OSError, ValueError, BrokenPipeError):
+        return
+    record_log.info(
+        "[standby] warm and parked (pid %d, %.0f ms boot)",
+        os.getpid(), warm_boot_ms,
+    )
+    while not stop.is_set():
+        try:
+            msg = conn.recv() if conn.poll(warm_s) else None
+        except (EOFError, OSError):
+            return  # supervisor died — the fleet dies with it
+        if msg == "stop":
+            eng.close()
+            return
+        if msg != "attach":
+            if msg is None:
+                _rewarm()
+            continue
+        # Promotion: final warm pass, re-arm the flight recorder (its
+        # death sweep now runs AFTER the predecessor stopped writing
+        # and honors the orderly-close marker), attach LAST so worker
+        # re-assertions land on the restored world.
+        _rewarm()
+        if cap_override:
+            config.set(config.CAPTURE_ENABLED, cap_override)
+            try:
+                from sentinel_tpu.runtime.capture import maybe_build_capture
+
+                eng.capture = maybe_build_capture(eng)
+            except Exception:
+                record_log.error(
+                    "[standby] capture re-arm failed — serving without "
+                    "the flight recorder", exc_info=True,
+                )
+        t_att = time.perf_counter()
+        IngestPlane(eng, handles=handles)
+        attach_ms = (time.perf_counter() - t_att) * 1e3
+        try:
+            conn.send(("attached", attach_ms))
+        except (OSError, ValueError, BrokenPipeError):
+            pass
+        record_log.info(
+            "[standby] took over (pid %d, epoch %d, attach %.1f ms)",
+            os.getpid(), eng.ipc_plane.engine_epoch, attach_ms,
+        )
+        raise SystemExit(_serve(eng, stop))
     eng.close()
 
 
@@ -273,7 +493,22 @@ class EngineSupervisor:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.gave_up = False
+        # Warm standby (sentinel.tpu.supervise.standby.enabled): one
+        # pre-forked, compile-warmed engine child parked off-plane; on
+        # primary death (or planned handoff) it attaches instead of a
+        # cold respawn, and the NEXT standby is pre-forked immediately.
+        self.standby_enabled = config.get_bool(config.SUPERVISE_STANDBY, False)
+        self.standby_takeovers = 0
+        self.handoffs = 0
+        self.standby_warm_boot_ms: Optional[float] = None
+        self.standby_attach_ms: Optional[float] = None
+        self._standby: Optional[dict] = None
+        # Promoted standbys keep their pipe alive here (the reader
+        # thread still consumes the "attached" ack after promotion).
+        self._retired: List[dict] = []
         self._proc = self._spawn_engine()
+        if self.standby_enabled:
+            self._standby = self._spawn_standby()
         self._watcher = threading.Thread(
             target=self._watch, name="sentinel-supervisor", daemon=True
         )
@@ -289,6 +524,98 @@ class EngineSupervisor:
         )
         p.start()
         return p
+
+    def _spawn_standby(self) -> dict:
+        parent, child = self._ctx.Pipe()
+        p = self._ctx.Process(
+            target=standby_main,
+            args=(self.handles, self._overrides, self._setup,
+                  self._setup_args, child),
+            daemon=True,
+        )
+        p.start()
+        child.close()
+        sb = {
+            "proc": p, "conn": parent,
+            "ready": threading.Event(), "attached": threading.Event(),
+            "warm_ms": None, "attach_ms": None,
+        }
+        t = threading.Thread(
+            target=self._standby_reader, args=(sb,),
+            name="sentinel-standby-reader", daemon=True,
+        )
+        t.start()
+        return sb
+
+    def _standby_reader(self, sb: dict) -> None:
+        """Owns all RECEIVES on one standby's pipe (sends may come
+        from any thread) — runs until the child closes its end."""
+        while True:
+            try:
+                msg = sb["conn"].recv()
+            except (EOFError, OSError):
+                return
+            if not (isinstance(msg, tuple) and msg):
+                continue
+            if msg[0] == "ready":
+                sb["warm_ms"] = msg[1]
+                sb["ready"].set()
+            elif msg[0] == "attached":
+                sb["attach_ms"] = msg[1]
+                sb["attached"].set()
+
+    def _promote_standby(self, planned: bool, timeout_s: float = 180.0) -> bool:
+        """Hand the rings to the warm standby: wait for its ready
+        report (a standby still compiling is STILL faster than a cold
+        respawn — its boot is already in progress), send ``attach``,
+        adopt it as the serving child and pre-fork the next standby.
+        False (→ caller falls back to the cold-respawn path) when no
+        live standby exists."""
+        from sentinel_tpu.utils.record_log import record_log
+
+        sb = self._standby
+        self._standby = None
+        if sb is None:
+            return False
+        proc = sb["proc"]
+        deadline = time.monotonic() + timeout_s
+        while (
+            proc.is_alive()
+            and not sb["ready"].is_set()
+            and time.monotonic() < deadline
+            and not self._stop.is_set()
+        ):
+            time.sleep(0.01)
+        if not proc.is_alive() or not sb["ready"].is_set():
+            record_log.warn(
+                "[supervise] standby unusable (alive=%s ready=%s) — "
+                "falling back to cold respawn", proc.is_alive(),
+                sb["ready"].is_set(),
+            )
+            if proc.is_alive():
+                proc.terminate()
+            try:
+                sb["conn"].close()
+            except OSError:
+                pass
+            return False
+        try:
+            sb["conn"].send("attach")
+        except (OSError, ValueError, BrokenPipeError):
+            return False
+        self.standby_warm_boot_ms = sb["warm_ms"]
+        with self._lock:
+            self._proc = proc
+        self._retired.append(sb)
+        if sb["attached"].wait(timeout_s):
+            self.standby_attach_ms = sb["attach_ms"]
+        record_log.info(
+            "[supervise] standby promoted (pid %d, %s, warm boot "
+            "%.0f ms)", proc.pid, "planned handoff" if planned else
+            "crash takeover", sb["warm_ms"] or -1.0,
+        )
+        self._standby = self._spawn_standby()
+        return True
 
     def _watch(self) -> None:
         from sentinel_tpu.utils.record_log import record_log
@@ -310,6 +637,17 @@ class EngineSupervisor:
                     self._backoff.reset()
                 continue
             if self._stop.is_set():
+                continue
+            planned = p.exitcode == EXIT_HANDOFF
+            if self.standby_enabled and self._promote_standby(planned):
+                # A takeover is not a restart: the budget and the
+                # backoff streak meter crash LOOPS of the cold path,
+                # and a planned drain is not a crash at all.
+                if planned:
+                    self.handoffs += 1
+                else:
+                    self.standby_takeovers += 1
+                spawned_at = time.monotonic()
                 continue
             if (
                 self.restarts_max
@@ -377,6 +715,44 @@ class EngineSupervisor:
         os.kill(p.pid, signal.SIGKILL)
         return p.pid
 
+    def wait_standby_ready(self, timeout_s: float = 180.0) -> bool:
+        """Block until the CURRENT standby reports warm (rules
+        loaded, kernels compiled, durable state restored). False when
+        standby mode is off or the report never arrives."""
+        sb = self._standby
+        if sb is None:
+            return False
+        return sb["ready"].wait(timeout_s)
+
+    def handoff(self, timeout_s: float = 120.0) -> bool:
+        """Operator-triggered planned handoff (rolling upgrade /
+        rule-table recompile served from standby): SIGUSR1 the serving
+        engine — it drains (workers HOLD on the HANDOFF word), spills
+        a final durable checkpoint and exits ``EXIT_HANDOFF``; the
+        watcher promotes the warm standby. True once a DIFFERENT
+        engine child is serving a fresh heartbeat."""
+        with self._lock:
+            p = self._proc
+        if not p.is_alive() or p.pid is None:
+            return False
+        old_pid = p.pid
+        try:
+            os.kill(old_pid, signal.SIGUSR1)
+        except OSError:
+            return False
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and not self._stop.is_set():
+            with self._lock:
+                cur = self._proc
+            if (
+                cur.pid != old_pid
+                and cur.is_alive()
+                and self.wait_engine_up(timeout_s=1.0)
+            ):
+                return True
+            time.sleep(0.02)
+        return False
+
     def wait_engine_up(self, timeout_s: float = 120.0) -> bool:
         """Block until the CURRENT engine child publishes a heartbeat
         (control header wall-ms fresh) — readiness, not liveness."""
@@ -417,6 +793,21 @@ class EngineSupervisor:
             p.terminate()
             p.join(5.0)
         self._watcher.join(timeout=5.0)
+        sb = self._standby
+        self._standby = None
+        if sb is not None:
+            try:
+                sb["conn"].send("stop")
+            except (OSError, ValueError, BrokenPipeError):
+                pass
+            sb["proc"].join(timeout_s)
+            if sb["proc"].is_alive():
+                sb["proc"].terminate()
+                sb["proc"].join(5.0)
+            try:
+                sb["conn"].close()
+            except OSError:
+                pass
         destroy_segments(self._segs)
         self._segs = []
 
@@ -490,6 +881,175 @@ def measure_restart_outage(
         raise RuntimeError(
             f"no recovery within {timeout_s}s (restarts={sup.restarts})"
         )
+    finally:
+        if cli is not None:
+            cli.close()
+        sup.stop()
+
+
+def measure_standby_outage(
+    setup,
+    resource: str,
+    prefix: Optional[str] = None,
+    timeout_s: float = 180.0,
+    entry_timeout_ms: int = 30000,
+) -> dict:
+    """``measure_restart_outage`` with a warm standby armed: the same
+    zero→kill→recover cycle, but the supervisor promotes the
+    pre-forked standby instead of cold-booting — the measured outage is
+    ≈ the detection window (`ipc.engine.dead.ms`), with the JAX-import
+    and first-compile terms gone from the outage path. The caller must
+    have set ``sentinel.tpu.supervise.standby.enabled`` (raises
+    otherwise — measuring the cold path under this name would report a
+    lie). Shared by the bench ``standby_outage_ms`` column, the
+    ``ipc_launch --smoke`` standby phase, and the chaos tests."""
+    from sentinel_tpu.ipc.worker import IngestClient
+
+    sup = EngineSupervisor(setup=setup, n_workers=1, prefix=prefix)
+    if not sup.standby_enabled:
+        sup.stop()
+        raise RuntimeError(
+            "measure_standby_outage needs "
+            "sentinel.tpu.supervise.standby.enabled=true"
+        )
+    cli = None
+    try:
+        if not sup.wait_engine_up(timeout_s):
+            raise RuntimeError("supervised engine never came up")
+        if not sup.wait_standby_ready(timeout_s):
+            raise RuntimeError("standby never reported warm")
+        cli = IngestClient(sup.handles.channel(0), 0)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            v = cli.entry(resource, timeout_ms=entry_timeout_ms)
+            if v.admitted and not v.degraded:
+                cli.exit(resource)
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError("engine never served a live verdict")
+            time.sleep(0.02)
+        killed_pid = sup.kill_engine()
+        t0 = time.monotonic()
+        saw_dead = False
+        policy_served = 0
+        while time.monotonic() - t0 < timeout_s:
+            v = cli.entry(resource, timeout_ms=entry_timeout_ms)
+            if v.degraded or not v.admitted:
+                saw_dead = True
+                policy_served += 1
+            elif v.admitted:
+                cli.exit(resource)
+                if saw_dead:
+                    outage_ms = (time.monotonic() - t0) * 1e3
+                    grace = time.monotonic() + 10.0
+                    while (
+                        cli.counters.get("reconnects", 0) == 0
+                        and time.monotonic() < grace
+                    ):
+                        time.sleep(0.05)
+                    return {
+                        "outage_ms": outage_ms,
+                        "policy_served": policy_served,
+                        "standby_takeovers": sup.standby_takeovers,
+                        "standby_warm_boot_ms": sup.standby_warm_boot_ms,
+                        "standby_attach_ms": sup.standby_attach_ms,
+                        "restarts": sup.restarts,
+                        "reconnects": cli.counters.get("reconnects", 0),
+                        "killed_pid": killed_pid,
+                    }
+            time.sleep(0.002)
+        raise RuntimeError(
+            f"no standby takeover within {timeout_s}s "
+            f"(takeovers={sup.standby_takeovers})"
+        )
+    finally:
+        if cli is not None:
+            cli.close()
+        sup.stop()
+
+
+def measure_handoff_outage(
+    setup,
+    resource: str,
+    prefix: Optional[str] = None,
+    timeout_s: float = 180.0,
+    entry_timeout_ms: int = 30000,
+) -> dict:
+    """One planned config-push handoff cycle under continuous probing:
+    start a supervised engine with a warm standby, probe until live,
+    trigger ``EngineSupervisor.handoff()`` and keep probing through the
+    drain → detach → standby-attach window. Reports the worst gap
+    between consecutive live verdicts (``handoff_outage_ms`` — callers
+    were HELD, not failed, for that long) and the policy-served /
+    non-admitted counts, which an orderly handoff keeps at ZERO."""
+    from sentinel_tpu.ipc.worker import IngestClient
+
+    sup = EngineSupervisor(setup=setup, n_workers=1, prefix=prefix)
+    if not sup.standby_enabled:
+        sup.stop()
+        raise RuntimeError(
+            "measure_handoff_outage needs "
+            "sentinel.tpu.supervise.standby.enabled=true"
+        )
+    cli = None
+    try:
+        if not sup.wait_engine_up(timeout_s):
+            raise RuntimeError("supervised engine never came up")
+        if not sup.wait_standby_ready(timeout_s):
+            raise RuntimeError("standby never reported warm")
+        cli = IngestClient(sup.handles.channel(0), 0)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            v = cli.entry(resource, timeout_ms=entry_timeout_ms)
+            if v.admitted and not v.degraded:
+                cli.exit(resource)
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError("engine never served a live verdict")
+            time.sleep(0.02)
+        pol0 = cli.counters.get("policy_served", 0)
+        old_pid = sup.engine_pid()
+        result: dict = {}
+        ho = threading.Thread(
+            target=lambda: result.update(ok=sup.handoff(timeout_s)),
+            daemon=True,
+        )
+        t0 = time.monotonic()
+        ho.start()
+        last_live = t0
+        max_gap = 0.0
+        not_admitted = 0
+        live_after = 0
+        while time.monotonic() - t0 < timeout_s:
+            v = cli.entry(resource, timeout_ms=entry_timeout_ms)
+            now = time.monotonic()
+            if v.admitted and not v.degraded:
+                cli.exit(resource)
+                max_gap = max(max_gap, now - last_live)
+                last_live = now
+                if not ho.is_alive() and sup.engine_pid() not in (
+                    None, old_pid
+                ):
+                    live_after += 1
+                    if live_after >= 3:
+                        break
+            else:
+                not_admitted += 1
+            time.sleep(0.002)
+        ho.join(timeout_s)
+        if not result.get("ok"):
+            raise RuntimeError(
+                f"handoff never completed (handoffs={sup.handoffs})"
+            )
+        return {
+            "handoff_outage_ms": max_gap * 1e3,
+            "policy_served": cli.counters.get("policy_served", 0) - pol0,
+            "not_admitted": not_admitted,
+            "handoffs": sup.handoffs,
+            "standby_warm_boot_ms": sup.standby_warm_boot_ms,
+            "standby_attach_ms": sup.standby_attach_ms,
+            "reconnects": cli.counters.get("reconnects", 0),
+        }
     finally:
         if cli is not None:
             cli.close()

@@ -41,6 +41,7 @@ from sentinel_tpu.core import errors as E
 from sentinel_tpu.ipc import frames as fr
 from sentinel_tpu.ipc.ring import (
     HEALTH_CLOSED,
+    HEALTH_HANDOFF,
     ControlBlock,
     ShmRing,
     _wall_ms,
@@ -140,6 +141,27 @@ class IngestClient:
             1, config.get_int(config.IPC_ENGINE_DEAD_MS, 1000)
         )
         self.timeout_ms = max(1, config.get_int(config.IPC_TIMEOUT_MS, 5000))
+        # Death confirmation (sentinel.tpu.ipc.engine.dead.confirm.ms):
+        # with confirm > 0 a stale wall clock alone does not declare the
+        # engine dead — the worker opens a SUSPICION episode, rings the
+        # request doorbell once (wakes a parked drainer) and probes the
+        # published engine pid; a provably-alive engine gets up to
+        # dead.ms + confirm.ms before the declaration lands, so
+        # sub-second dead.ms on a pegged box has a measured
+        # false-positive story instead of a flappy one. 0 (default) is
+        # the PR-15 wall-staleness predicate exactly.
+        self.dead_confirm_ms = max(
+            0, config.get_int(config.IPC_ENGINE_DEAD_CONFIRM_MS, 0)
+        )
+        self.handoff_wait_ms = max(
+            0, config.get_int(config.IPC_HANDOFF_WAIT_MS, 3000)
+        )
+        # Episode state has its OWN lock: engine_alive() runs under the
+        # client lock on the window-flush path, so it must never take it.
+        self._suspect_lock = threading.Lock()
+        self._suspect_epoch = -1
+        self._suspect_declared = False
+        self._in_handoff = False
         # Adaptive wakeup (sentinel.tpu.ipc.wakeup=adaptive): the
         # reader spins briefly then parks on the response-ring doorbell
         # instead of the fixed 200 µs sleep-poll. Only meaningful when
@@ -173,6 +195,8 @@ class IngestClient:
             "entries": 0, "bulk_rows": 0, "exits": 0, "exits_dropped": 0,
             "sheds": 0, "policy_served": 0, "frames": 0,
             "window_flushes": 0, "reconnects": 0, "exits_buffered": 0,
+            "dead_suspicions": 0, "dead_false_alarms": 0,
+            "dead_declared": 0, "handoff_holds": 0,
         }
         # Engine hot-restart reconnect (sentinel.tpu.ipc.reconnect.*):
         # the client keeps its OWN live-admission ledger — one line per
@@ -499,7 +523,7 @@ class IngestClient:
     # engine liveness + policy fallback
     # ------------------------------------------------------------------
     def engine_alive(self) -> bool:
-        _epoch, health, _gen, wall = self.control.engine_view()
+        epoch, health, _gen, wall = self.control.engine_view()
         if health == HEALTH_CLOSED:
             return False
         if wall == 0:
@@ -508,7 +532,82 @@ class IngestClient:
             # The header beat IS the shared ruler: remember the latest
             # one so each journal spill carries this process's skew.
             self._spans.note_ruler(wall)
-        return (_wall_ms() - wall) <= self.engine_dead_ms
+        self._in_handoff = health == HEALTH_HANDOFF
+        stale = _wall_ms() - wall
+        if stale <= self.engine_dead_ms:
+            if self._suspect_epoch != -1:
+                self._close_suspicion()
+            return True
+        if self.dead_confirm_ms <= 0:
+            return False
+        return self._confirm_alive(epoch, stale)
+
+    def _close_suspicion(self) -> None:
+        """The heartbeat resumed while a suspicion episode was open:
+        the confirmation step held a pegged-but-alive engine out of the
+        policy path — count the would-have-been false positive."""
+        with self._suspect_lock:
+            if self._suspect_epoch == -1:
+                return
+            if not self._suspect_declared:
+                self.counters["dead_false_alarms"] += 1
+            self._suspect_epoch = -1
+            self._suspect_declared = False
+
+    def _confirm_alive(self, epoch: int, stale: float) -> bool:
+        """Wall clock stale past ``dead.ms`` with confirmation armed:
+        defer the death declaration while the engine is PROVABLY alive
+        (published pid answers signal 0), up to ``dead.ms +
+        dead.confirm.ms``. One doorbell nudge per episode wakes a
+        parked drainer whose control thread is merely starved."""
+        with self._suspect_lock:
+            if self._suspect_epoch != epoch:
+                # New episode (keyed on the heartbeat epoch the engine
+                # stalled at — a beat-then-stall restarts the clock).
+                self._suspect_epoch = epoch
+                self._suspect_declared = False
+                self.counters["dead_suspicions"] += 1
+                self.request.nudge()
+            if self._suspect_declared:
+                return False
+            if stale > self.engine_dead_ms + self.dead_confirm_ms:
+                self._suspect_declared = True
+                self.counters["dead_declared"] += 1
+                return False
+            pid = self.control.engine_pid()
+            if pid and _pid_alive(pid):
+                return True
+            self._suspect_declared = True
+            self.counters["dead_declared"] += 1
+            return False
+
+    def _handoff_hold(self) -> bool:
+        """The control header published HANDOFF: the old engine is
+        draining in-flight work for a successor that attaches to the
+        SAME rings. Hold this NEW admission (bounded by
+        ``handoff.wait.ms``) until the successor beats and our beat
+        loop has adopted its boot epoch — a planned handoff then serves
+        ZERO policy verdicts. The hold spans the old world's
+        detach->successor-attach gap (HANDOFF word with a stale wall
+        still means "wait", not "dead"). Returns True when the push may
+        proceed against the new world, False when the hold expired."""
+        self.counters["handoff_holds"] += 1
+        deadline = time.monotonic() + self.handoff_wait_ms / 1e3
+        while time.monotonic() < deadline:
+            if self._stop.is_set():
+                return False
+            _epoch, health, _gen, wall = self.control.engine_view()
+            if health == HEALTH_CLOSED or wall == 0:
+                return False
+            if health != HEALTH_HANDOFF:
+                self._in_handoff = False
+                # Successor up: wait for OUR reconnect (beat loop) to
+                # adopt its boot — pushing before the intern-generation
+                # refresh would be gen-gated as dead-world backlog.
+                if self._boot in (0, self.control.engine_boot()):
+                    return (_wall_ms() - wall) <= self.engine_dead_ms
+            time.sleep(0.002)
+        return False
 
     def _policy_verdict(self, resource: str) -> fr.IpcVerdict:
         default, overrides = self.control.read_policy()
@@ -823,7 +922,10 @@ class IngestClient:
         TraceContext); None reads the ambient contextvar so adapter
         code keeps working unchanged inside a worker."""
         _check_entry_type(entry_type)
-        if not self.engine_alive():
+        alive = self.engine_alive()
+        if self._in_handoff:
+            alive = self._handoff_hold()
+        if not alive:
             return self._policy_verdict(resource)
         if trace is None:
             trace = _ambient_trace()
@@ -930,7 +1032,10 @@ class IngestClient:
         if n < 1:
             raise ValueError("bulk: n must be >= 1")
         _check_entry_type(entry_type)
-        if not self.engine_alive():
+        alive = self.engine_alive()
+        if self._in_handoff:
+            alive = self._handoff_hold()
+        if not alive:
             v = self._policy_verdict(resource)
             return _dense(n, v)
         ts_col = np.broadcast_to(
@@ -1210,7 +1315,14 @@ class IngestClient:
                             )
                     return out
                 w.event.clear()
-            if time.monotonic() > deadline or not self.engine_alive():
+            # During a planned handoff the stale wall (and the exiting
+            # old engine's pid) must not convert a parked caller into a
+            # policy verdict — the old world answers in-flight frames
+            # before detaching; only the deadline bounds the wait.
+            # engine_alive() itself refreshes _in_handoff.
+            if time.monotonic() > deadline or (
+                not self.engine_alive() and not self._in_handoff
+            ):
                 with self._lock:
                     self._waiters.pop(seq, None)
                 return self._policy_verdict(resource)
@@ -1226,7 +1338,9 @@ class IngestClient:
                 if len(w.verdicts) >= w.need:
                     break
                 w.event.clear()
-            if time.monotonic() > deadline or not self.engine_alive():
+            if time.monotonic() > deadline or (
+                not self.engine_alive() and not self._in_handoff
+            ):
                 break
         with self._lock:
             for s in seqs:
@@ -1398,6 +1512,20 @@ def _check_entry_type(entry_type) -> None:
         raise ValueError(
             f"entry_type must be 0 (IN) or 1 (OUT), got {entry_type!r}"
         )
+
+
+def _pid_alive(pid: int) -> bool:
+    """Signal-0 probe (same host by shared-memory construction).
+    EPERM still means "exists" — a privilege boundary is not death."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
 
 
 def _ambient_trace():

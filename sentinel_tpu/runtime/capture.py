@@ -48,6 +48,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import re
 import struct
 import threading
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -75,12 +76,14 @@ RK_HEALTH = 8       # failover transitions / breaker openings
 RK_SKETCH = 9       # sketch promotions/demotions (informational)
 RK_SHARD = 10       # cluster shard-map version bump
 RK_FREEZE = 11      # postmortem freeze marker
+RK_CLOSE = 12       # orderly-close marker (planned handoff / drain)
 
 _RECORD_NAMES = {
     RK_ENTRIES: "entries", RK_BULK: "bulk", RK_EXITS: "exits",
     RK_BULK_EXITS: "bulk_exits", RK_VERDICT: "verdict",
     RK_FLUSH: "flush", RK_RULES: "rules", RK_HEALTH: "health",
     RK_SKETCH: "sketch", RK_SHARD: "shard", RK_FREEZE: "freeze",
+    RK_CLOSE: "close",
 }
 
 # Verdict-row flag bits beyond the ipc pair (F_SPECULATIVE=1,
@@ -158,25 +161,83 @@ class CaptureJournal:
     # segment lifecycle
     # ------------------------------------------------------------------
     def _preserve_death_segments(self) -> None:
+        """Next-boot sweep of the predecessor's leftover live segments.
+        A boot that DIED mid-stream is preserved as ``frozen-death-*``
+        (the flight-recorder postmortem); a boot that drained in an
+        orderly handoff left a ``closed-<boot_id>.marker`` sidecar
+        (mark_orderly_close) and its segments file as ``frozen-close-*``
+        instead — PR 19's death sweep must not misfile a planned drain
+        as a crash. Markers are consumed (deleted) by the sweep."""
         try:
-            leftovers = sorted(
-                fn for fn in os.listdir(self.dir)
-                if fn.startswith("seg-") and fn.endswith(".cap")
-            )
+            names = os.listdir(self.dir)
         except OSError:
             return
+        leftovers = sorted(
+            fn for fn in names
+            if fn.startswith("seg-") and fn.endswith(".cap")
+        )
+        markers = [fn for fn in names if _ORDERLY_RE.match(fn)]
+        orderly = {_ORDERLY_RE.match(fn).group(1) for fn in markers}
         for fn in leftovers:
-            dst = os.path.join(self.dir, f"frozen-death-{fn}")
+            path = os.path.join(self.dir, fn)
+            kind = (
+                "close"
+                if orderly and _segment_boot_id(path) in orderly
+                else "death"
+            )
+            dst = os.path.join(self.dir, f"frozen-{kind}-{fn}")
             i = 1
             while os.path.exists(dst):
-                dst = os.path.join(self.dir, f"frozen-death-{i}-{fn}")
+                dst = os.path.join(self.dir, f"frozen-{kind}-{i}-{fn}")
                 i += 1
             try:
-                os.rename(os.path.join(self.dir, fn), dst)
+                os.rename(path, dst)
+            except OSError:
+                pass
+        for fn in markers:
+            # One marker describes one dead boot: once its segments are
+            # filed the marker has no further meaning (and a stale one
+            # must not whitewash a FUTURE crash's segments).
+            try:
+                os.remove(os.path.join(self.dir, fn))
             except OSError:
                 pass
         if leftovers:
             self._trim_frozen()
+
+    def mark_orderly_close(self, reason: str = "handoff") -> None:
+        """Declare this boot's eventual leftover segments ORDERLY: an
+        RK_CLOSE record ends the current segment's stream and a
+        ``closed-<boot_id>.marker`` sidecar tells the successor's death
+        sweep to file them as ``frozen-close-*``, not
+        ``frozen-death-*``. Idempotent; called on the planned-handoff
+        drain path before the process exits."""
+        safe = (
+            "".join(ch for ch in reason[:32] if ch.isalnum() or ch in "-_")
+            or "close"
+        )
+        with self._lock:
+            if self._f is not None:
+                self._json_locked(
+                    RK_CLOSE, {"reason": safe, "boot_id": self._boot_id}
+                )
+                try:
+                    self._f.flush()
+                except OSError:
+                    pass
+            marker = os.path.join(self.dir, f"closed-{self._boot_id}.marker")
+            try:
+                with open(marker, "w", encoding="utf-8") as mf:
+                    json.dump(
+                        {
+                            "boot_id": self._boot_id,
+                            "reason": safe,
+                            "wall_ms": round(_wall_ms(), 3),
+                        },
+                        mf,
+                    )
+            except OSError:
+                pass
 
     def _segment_path(self, index: int) -> str:
         return os.path.join(self.dir, f"seg-{index:06d}.cap")
@@ -717,6 +778,30 @@ class Record:
         return json.loads(self.payload.decode("utf-8"))
 
 
+_ORDERLY_RE = re.compile(r"^closed-([0-9a-f]+)\.marker$")
+
+
+def _segment_boot_id(path: str) -> Optional[str]:
+    """The boot_id from a segment's JSON header (header-only read —
+    the sweep must not pay a full-segment parse per leftover file).
+    None on any structural surprise: an unreadable header files as
+    death, the conservative default."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(len(MAGIC) + 4)
+            if head[: len(MAGIC)] != MAGIC or len(head) < len(MAGIC) + 4:
+                return None
+            (hlen,) = struct.unpack_from("<I", head, len(MAGIC))
+            if hlen > 4 * 1024 * 1024:
+                return None
+            blob = f.read(hlen)
+        if len(blob) < hlen:
+            return None
+        return json.loads(blob.decode("utf-8")).get("boot_id")
+    except (OSError, ValueError):
+        return None
+
+
 def read_segment(path: str) -> Tuple[Dict[str, Any], List[Record]]:
     """Parse one segment: (header, records). A torn tail (the process
     died mid-write) terminates the record list cleanly — everything
@@ -892,7 +977,10 @@ def decode_capture(paths: Sequence[str]) -> Dict[str, Any]:
                         np.array(df.columns["wait_ms"]),
                         np.array(df.columns["flags"]),
                     )
-            elif rec.rkind in (RK_RULES, RK_HEALTH, RK_SKETCH, RK_SHARD, RK_FREEZE):
+            elif rec.rkind in (
+                RK_RULES, RK_HEALTH, RK_SKETCH, RK_SHARD, RK_FREEZE,
+                RK_CLOSE,
+            ):
                 stream.append((rec.name, rec.json()))
     return {
         "header": first_header or {},

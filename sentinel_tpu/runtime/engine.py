@@ -864,6 +864,11 @@ class Engine:
         from sentinel_tpu.runtime.capture import maybe_build_capture
 
         self.capture = maybe_build_capture(self)
+        # Planned-handoff trigger (ipc/supervise.py `_serve`): the
+        # `handoff` transport command sets this and the supervised
+        # serve loop drains + exits EXIT_HANDOFF so the warm standby
+        # takes over. Unsupervised engines never read it.
+        self.handoff_requested = threading.Event()
 
     # ------------------------------------------------------------------
     # multi-chip mode

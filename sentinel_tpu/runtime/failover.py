@@ -1283,6 +1283,7 @@ class FailoverManager:
             0, config.get_int(config.FAILOVER_CKPT_INTERVAL_MS, 1000)
         )
         self._durable_pending: Optional[Checkpoint] = None
+        self._ckpt_force = False
         self._durable_event = threading.Event()
         self._durable_stop = False
         self._durable_thread: Optional[threading.Thread] = None
@@ -1698,13 +1699,25 @@ class FailoverManager:
     # checkpoint / restore
     # ------------------------------------------------------------------
     def checkpoint_due(self, seq: int) -> bool:
+        # Sharded device states restore as single-chip arrays; skip
+        # checkpoints under a mesh rather than restore wrong.
+        if self._engine.mesh is not None:
+            return False
+        if self._ckpt_force:
+            # One-shot (planned handoff): the NEXT flush checkpoints
+            # regardless of the cadence so the final durable spill
+            # carries the freshest state the successor can warm from.
+            self._ckpt_force = False
+            return True
         return (
             self.checkpoint_every > 0
             and seq % self.checkpoint_every == 0
-            # Sharded device states restore as single-chip arrays;
-            # skip checkpoints under a mesh rather than restore wrong.
-            and self._engine.mesh is None
         )
+
+    def request_checkpoint(self) -> None:
+        """Arm a one-shot checkpoint on the next flush (planned
+        handoff's final-spill hook)."""
+        self._ckpt_force = True
 
     def begin_checkpoint(self, seq, now_ms, findex, dindex, pindex) -> Checkpoint:
         """Metadata for a checkpoint whose state arrays ride the
@@ -2317,6 +2330,54 @@ class FailoverManager:
             self._last_attempt_ms = None
         self.fallback.clear_gauge_deltas()
         self.fallback.end_degraded()
+
+    def warm_probe(self, k: int = 1) -> float:
+        """Standby warm-compile: drive ``k`` all-invalid probe batches
+        through the REAL flush kernel (dispatch → execute → fetch) so
+        every jit cache entry the serving path needs exists before this
+        engine ever attaches to the rings. Probe batches are pow2-padded
+        to the serving shapes, so the first real flush after takeover
+        pays zero compiles. Returns elapsed milliseconds (the bench's
+        ``standby_warm_boot_ms`` numerator). Raises on kernel faults —
+        a standby that cannot run the kernel must not report ready."""
+        eng = self._engine
+        t0 = time.perf_counter()
+        with eng._flush_lock:
+            for _ in range(max(1, int(k))):
+                self._probe_locked()
+        return (time.perf_counter() - t0) * 1e3
+
+    def spill_durable_now(self) -> bool:
+        """Planned-handoff final spill: write the newest checkpoint
+        (pending-for-the-writer first, else last-good) synchronously on
+        the CALLER's thread — the async writer's rate limit must not
+        hold the draining engine's exit, and the successor's final
+        restore wants this state on disk before the old process dies.
+        Returns True on a successful write; never raises."""
+        if not self.durable_path:
+            return False
+        with self._lock:
+            meta = self._durable_pending or self._ckpt
+            self._durable_pending = None
+        if meta is None or meta.states is None:
+            return False
+        try:
+            t0 = time.perf_counter()
+            nbytes = self._durable_spill(meta)
+            with self._lock:
+                self.counters["durable_writes"] += 1
+                self.last_durable = (
+                    int(time.time() * 1000), meta.seq,
+                    (time.perf_counter() - t0) * 1e3, nbytes,
+                )
+            return True
+        except Exception:
+            with self._lock:
+                self.counters["durable_write_errors"] += 1
+            record_log.error(
+                "[Failover] final durable spill failed", exc_info=True
+            )
+            return False
 
     def close(self) -> None:
         """Retire the idle watchdog waiter pool (engine shutdown) —

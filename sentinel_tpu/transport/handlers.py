@@ -585,6 +585,30 @@ def ipc_handler(req: CommandRequest) -> CommandResponse:
 
 
 @command_mapping(
+    "handoff",
+    "request a planned engine handoff: drain, final durable spill,"
+    " standby takeover (supervised engines only)",
+)
+def handoff_handler(req: CommandRequest) -> CommandResponse:
+    """Operator trigger for the planned live handoff
+    (ipc/supervise.py): sets the engine's ``handoff_requested`` event;
+    the supervised serve loop drains in-flight flushes, spills a final
+    durable checkpoint, publishes the HANDOFF control word and exits
+    ``EXIT_HANDOFF`` so the warm standby attaches. On an unsupervised
+    engine the event is set but nothing consumes it — the response
+    says so instead of pretending a drain happened."""
+    engine = _engine()
+    evt = getattr(engine, "handoff_requested", None)
+    if evt is None:
+        return CommandResponse.of_failure("engine has no handoff support")
+    supervised = getattr(engine, "ipc_plane", None) is not None
+    evt.set()
+    return CommandResponse.of_json(
+        {"ok": True, "handoff": "requested", "ipc_plane": supervised}
+    )
+
+
+@command_mapping(
     "cluster",
     "batched cluster token plane: client counters, RPC latency,"
     " live leases, per-shard rows, gossip state, window config",

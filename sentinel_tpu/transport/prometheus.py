@@ -875,6 +875,19 @@ def worker_metric_lines(client=None, openmetrics: bool = False) -> List[str]:
     out += ctr(f"{p}_reconnects_total",
                "Engine hot-restart reconnects (boot epoch bumps seen)",
                c.get("reconnects", 0))
+    out += ctr(f"{p}_dead_suspicions_total",
+               "Death-confirmation episodes opened (heartbeat stale past "
+               "ipc.engine.dead.ms)", c.get("dead_suspicions", 0))
+    out += ctr(f"{p}_dead_false_alarms_total",
+               "Suspicion episodes cleared by a fresh heartbeat or live "
+               "pid probe (engine was busy, not dead)",
+               c.get("dead_false_alarms", 0))
+    out += ctr(f"{p}_dead_declared_total",
+               "Suspicion episodes that ended in a confirmed death "
+               "declaration", c.get("dead_declared", 0))
+    out += ctr(f"{p}_handoff_holds_total",
+               "Admissions held through a planned-handoff window instead "
+               "of failing to the policy path", c.get("handoff_holds", 0))
     ops = c.get("entries", 0) + c.get("bulk_rows", 0)
     out += _gauge(
         f"{p}_frames_per_entry",

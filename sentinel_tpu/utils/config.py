@@ -315,6 +315,15 @@ class SentinelConfig:
     # waiting and serves verdicts from the fail-open/closed failover
     # policy snapshot published in the control header.
     IPC_ENGINE_DEAD_MS = "sentinel.tpu.ipc.engine.dead.ms"
+    # Death-confirmation grace (ipc/worker.py): with dead.confirm.ms
+    # > 0, a stale engine wall clock alone does not flip a worker to
+    # the policy path — the worker first re-reads the heartbeat epoch,
+    # probes the published engine pid (signal 0) and rings the request
+    # doorbell; while the process is provably alive the declaration is
+    # deferred up to dead.ms + dead.confirm.ms, so sub-second dead.ms
+    # on a pegged-but-alive box does not produce false positives.
+    # 0 (the default) keeps the PR-15 wall-staleness predicate exactly.
+    IPC_ENGINE_DEAD_CONFIRM_MS = "sentinel.tpu.ipc.engine.dead.confirm.ms"
     # Max time a worker blocks on one verdict before consulting the
     # engine-death path above (bounds a wedged-but-heartbeating engine).
     IPC_TIMEOUT_MS = "sentinel.tpu.ipc.timeout.ms"
@@ -366,6 +375,13 @@ class SentinelConfig:
     # completions and a returning engine starts with empty ledgers.
     IPC_RECONNECT = "sentinel.tpu.ipc.reconnect.enabled"
     IPC_RECONNECT_EXITS_MAX = "sentinel.tpu.ipc.reconnect.exits.max"
+    # Planned live handoff (ipc/plane.py handoff() + supervise.py):
+    # how long a worker HOLDS a new admission when the control header
+    # publishes HANDOFF (old engine draining) before giving up and
+    # serving the failover policy snapshot. The hold also covers the
+    # detach->successor-attach gap, so an orderly config-push handoff
+    # serves ZERO policy verdicts.
+    IPC_HANDOFF_WAIT_MS = "sentinel.tpu.ipc.handoff.wait.ms"
     # Engine supervision (ipc/supervise.py run_engine_supervised /
     # tools/ipc_launch.py --supervise): restart backoff (shared
     # datasource Backoff shape: capped exponential) and a restart
@@ -373,6 +389,16 @@ class SentinelConfig:
     SUPERVISE_BACKOFF_MS = "sentinel.tpu.supervise.backoff.ms"
     SUPERVISE_BACKOFF_MAX_MS = "sentinel.tpu.supervise.backoff.max.ms"
     SUPERVISE_RESTARTS_MAX = "sentinel.tpu.supervise.restarts.max"
+    # Warm standby (ipc/supervise.py): pre-fork a SECOND engine child
+    # that imports JAX, loads rules, warm-compiles the flush kernels
+    # via probe batches and re-warms from the durable checkpoint every
+    # warm.interval.ms — parked WITHOUT attaching to the rings. On
+    # primary death (or planned handoff) it attaches immediately,
+    # cutting the outage from cold-boot seconds to the detection
+    # window; the supervisor pre-forks the next standby right after.
+    # Off (the default) keeps PR-15 cold-respawn supervision exactly.
+    SUPERVISE_STANDBY = "sentinel.tpu.supervise.standby.enabled"
+    SUPERVISE_STANDBY_WARM_MS = "sentinel.tpu.supervise.standby.warm.interval.ms"
     # Per-resource provenance metric plane (metrics/provenance.py):
     # (second, resource) speculative/degraded/shed/drift ledger drained
     # into MetricNodeLine v2 columns and the bounded
@@ -563,6 +589,7 @@ class SentinelConfig:
         IPC_HEARTBEAT_MS: "100",
         IPC_WORKER_DEAD_MS: "1000",
         IPC_ENGINE_DEAD_MS: "1000",
+        IPC_ENGINE_DEAD_CONFIRM_MS: "0",
         IPC_TIMEOUT_MS: "5000",
         IPC_POLL_US: "200",
         IPC_CLIENT_WINDOW_MS: "0",
@@ -574,9 +601,12 @@ class SentinelConfig:
         IPC_SHM_PREFIX: "",
         IPC_RECONNECT: "true",
         IPC_RECONNECT_EXITS_MAX: "4096",
+        IPC_HANDOFF_WAIT_MS: "3000",
         SUPERVISE_BACKOFF_MS: "500",
         SUPERVISE_BACKOFF_MAX_MS: "10000",
         SUPERVISE_RESTARTS_MAX: "0",
+        SUPERVISE_STANDBY: "false",
+        SUPERVISE_STANDBY_WARM_MS: "2000",
         CLUSTER_CLIENT_WINDOW_MS: "0",
         CLUSTER_CLIENT_WINDOW_MAX: "128",
         CLUSTER_LEASE_ENABLED: "false",

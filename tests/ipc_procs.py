@@ -236,6 +236,24 @@ def restart_setup(engine):
     engine.set_flow_rules([FlowRule(resource="chaos-res", count=1e9)])
 
 
+def standby_setup(engine):
+    """Supervised-engine setup for the warm-standby chaos tests: the
+    open chaos resource plus a THREAD-grade rule whose gauge survives a
+    takeover only if the reassert machinery carried it — the parity
+    probes and the behavioral gauges-are-0 check both key off it."""
+    from sentinel_tpu.models import constants as C
+    from sentinel_tpu.models.rules import FlowRule
+
+    engine.set_flow_rules(
+        [
+            FlowRule(resource="chaos-res", count=1e9),
+            FlowRule(
+                resource="sb-thread", count=3, grade=C.FLOW_GRADE_THREAD
+            ),
+        ]
+    )
+
+
 def worker_mode_admit_and_hang(channel, wid, resource_path, n, q):
     """Worker-mode kill -9 target: hold ``n`` admitted WSGI requests
     open (the app never returns, so their entries never exit) — the

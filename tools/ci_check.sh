@@ -33,11 +33,14 @@ echo "== ci_check 2/3: config + doc + metrics audit =="
 JAX_PLATFORMS=cpu python tools/config_audit.py \
     --root sentinel_tpu --doc docs/ARCHITECTURE.md
 
-# Worker-mode + engine-restart smoke (always): spawned workers serve a
-# real WSGI adapter entirely through the rings, then a SUPERVISED
-# engine is kill -9'd mid-probing and must come back on the same rings
-# (epoch bump → client reconnect → device verdicts again) — the two
-# surfaces tier-1's in-process tests cannot fully cover.
+# Worker-mode + engine-restart + standby/handoff smoke (always):
+# spawned workers serve a real WSGI adapter entirely through the
+# rings; a SUPERVISED engine is kill -9'd mid-probing and must come
+# back on the same rings (epoch bump → client reconnect → device
+# verdicts again); then phase 3 arms the WARM STANDBY — the same kill
+# must be a takeover (not a cold respawn) and a planned handoff cycle
+# must complete with zero policy-served verdicts — the surfaces
+# tier-1's in-process tests cannot fully cover.
 echo "== ci_check 2b: ipc worker-mode + engine-restart smoke =="
 JAX_PLATFORMS=cpu python tools/ipc_launch.py --smoke >/dev/null
 

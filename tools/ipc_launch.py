@@ -212,6 +212,65 @@ def _smoke_restart() -> int:
             pass
 
 
+def _smoke_standby() -> int:
+    """Smoke phase 3: the zero-outage lifecycle — a warm standby
+    takeover after ``kill -9`` (outage bounded by the detection window,
+    not a cold boot) followed by a planned handoff cycle that completes
+    with ZERO policy-served verdicts (callers held, never failed)."""
+    import os
+    import tempfile
+
+    from sentinel_tpu.ipc.supervise import (
+        measure_handoff_outage,
+        measure_standby_outage,
+    )
+    from sentinel_tpu.utils.config import config
+
+    config.set(config.IPC_HEARTBEAT_MS, "50")
+    config.set(config.IPC_ENGINE_DEAD_MS, "2000")
+    config.set(config.IPC_ENGINE_DEAD_CONFIRM_MS, "1000")
+    config.set(config.IPC_WORKER_DEAD_MS, "60000")
+    config.set(config.IPC_HANDOFF_WAIT_MS, "30000")
+    config.set(config.SUPERVISE_BACKOFF_MS, "200")
+    config.set(config.SUPERVISE_STANDBY, "true")
+    config.set(config.SUPERVISE_STANDBY_WARM_MS, "500")
+    config.set(config.FAILOVER_ENABLED, "true")
+    config.set(config.FAILOVER_CHECKPOINT_EVERY, "2")
+    ckpt_dir = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+    ckpt = os.path.join(ckpt_dir, f"stpu-smoke-sb-{os.getpid()}.bin")
+    config.set(config.FAILOVER_CKPT_PATH, ckpt)
+    try:
+        out = measure_standby_outage(
+            smoke_engine_setup, "web-total", timeout_s=240
+        )
+        assert out["standby_takeovers"] >= 1, out
+        assert out["restarts"] == 0, out  # takeover, not cold respawn
+        print(
+            f"[ipc_launch] standby smoke OK: outage "
+            f"{out['outage_ms']:.0f} ms (warm boot "
+            f"{out['standby_warm_boot_ms']:.0f} ms off the outage "
+            f"path), {out['policy_served']} policy-served probes, "
+            f"{out['standby_takeovers']} takeover(s)"
+        )
+        out = measure_handoff_outage(
+            smoke_engine_setup, "web-total", timeout_s=240
+        )
+        assert out["handoffs"] >= 1, out
+        assert out["policy_served"] == 0, out
+        assert out["not_admitted"] == 0, out
+        print(
+            f"[ipc_launch] handoff smoke OK: worst verdict gap "
+            f"{out['handoff_outage_ms']:.0f} ms, 0 policy-served, "
+            f"{out['handoffs']} handoff(s)"
+        )
+        return 0
+    finally:
+        try:
+            os.unlink(ckpt)
+        except OSError:
+            pass
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("app", nargs="?", default="-",
@@ -232,7 +291,7 @@ def main() -> int:
                          "engine child (called as fn(engine))")
     ap.add_argument("--smoke", action="store_true",
                     help="run the ci_check worker-mode + engine-restart "
-                         "self-test and exit")
+                         "+ standby/handoff self-test and exit")
     args = ap.parse_args()
 
     from sentinel_tpu.utils.config import config
@@ -245,7 +304,10 @@ def main() -> int:
         rc = _smoke(n_workers=min(2, max(1, args.workers)))
         if rc:
             return rc
-        return _smoke_restart()
+        rc = _smoke_restart()
+        if rc:
+            return rc
+        return _smoke_standby()
 
     from sentinel_tpu.core import api
 
